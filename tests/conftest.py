@@ -1,0 +1,40 @@
+"""Test harness: 8 virtual CPU devices so every sharding path runs hardware-free.
+
+This is the test strategy SURVEY.md §4 mandates: the reference ships zero
+tests (its whole QA story is in-band runtime gates), so tpufw invents the
+pyramid — and the JAX tier runs on an emulated 8-device mesh via
+``--xla_force_host_platform_device_count``, mirroring how the driver's
+``dryrun_multichip`` validates multi-chip sharding without chips.
+
+Must run before any ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Strip any pre-existing device-count flag so the suite always gets 8.
+xla_flags = " ".join(
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+)
+os.environ["XLA_FLAGS"] = (
+    xla_flags + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# A sitecustomize may have imported jax (and pinned a TPU platform) before
+# this file ran, making the env vars above too late — force CPU via config,
+# which wins as long as no backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,26 @@
+"""tpufw — a TPU-native cluster-enablement + JAX training framework.
+
+Capability-parity build for ``mysticrenji/kubernetes-with-nvidia-gpu``
+(see SURVEY.md): the reference is a layered, health-gated recipe that takes a
+bare machine to a Kubernetes cluster where one ``kubectl apply`` runs an
+accelerator workload with log-visible proof (reference ``README.md:303-335``).
+This package is the TPU-side half of that capability: the JAX/XLA workloads
+(BASELINE configs 1-5), the device-mesh parallelism layer that replaces
+NCCL-env wiring, and the multi-host bootstrap that replaces single-node
+assumptions. The cluster-side half (C++ device plugin, Helm chart, recipe,
+verify gates) lives in ``deviceplugin/``, ``deploy/``, ``recipe/``,
+``verify/`` at the repo root.
+
+Subpackages
+-----------
+- ``mesh``     — device mesh construction, named axes, logical sharding rules
+- ``models``   — Flax model families: Llama-3, Mixtral (MoE), ResNet-50
+- ``ops``      — Pallas TPU kernels (flash attention, fused norms) + fallbacks
+- ``parallel`` — sequence/context parallelism (ring attention), shard_map utils
+- ``train``    — train loop, train state, metrics (tokens/sec/chip, MFU), ckpt
+- ``cluster``  — jax.distributed bootstrap from JobSet/GKE pod environment
+- ``configs``  — dataclass configs + the YAML-of-record per BASELINE config
+- ``utils``    — hardware specs (peak FLOPs/HBM per chip), logging, trees
+"""
+
+__version__ = "0.1.0"

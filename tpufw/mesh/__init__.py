@@ -1,0 +1,12 @@
+from tpufw.mesh.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+    MESH_AXES,
+    MeshConfig,
+    build_mesh,
+    logical_axis_rules,
+    mesh_sharding,
+)

@@ -1,0 +1,164 @@
+"""Device mesh + named-axis sharding: tpufw's communication backend.
+
+The reference wires no communication backend at all — it is single-node,
+single-GPU, and the north star names NCCL env-var wiring only as the thing to
+*replace* (SURVEY.md §2c). tpufw's replacement is the TPU-idiomatic one: a
+``jax.sharding.Mesh`` with five named axes, GSPMD/pjit sharding annotations,
+and XLA-inserted collectives riding ICI. No user-level comm code exists
+anywhere in this framework; every parallelism strategy is a (logical axis ->
+mesh axis) rule set consumed here.
+
+Axes
+----
+- ``data``     — pure data parallelism (gradient psum across replicas)
+- ``fsdp``     — data parallelism with parameter/optimizer sharding (ZeRO-3
+                 style: XLA all-gathers params per layer, reduce-scatters grads)
+- ``sequence`` — context parallelism for long sequences (ring attention /
+                 all-to-all, see tpufw.parallel)
+- ``tensor``   — Megatron-style tensor parallelism inside a host's ICI domain
+- ``expert``   — expert parallelism for MoE (Mixtral, BASELINE config 5)
+
+Any axis of size 1 is free; configs 1-5 are all instances of one MeshConfig.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_SEQUENCE = "sequence"
+AXIS_TENSOR = "tensor"
+AXIS_EXPERT = "expert"
+
+# Order matters: leftmost axes get the slowest-varying device dimension, so
+# `tensor` (rightmost) stays within the densest ICI neighborhood and `data`
+# (leftmost) spans hosts/DCN — the layout the scaling playbook prescribes.
+MESH_AXES: tuple[str, ...] = (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_EXPERT,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Sizes for the five named mesh axes. -1 on at most one axis = "fill"."""
+
+    data: int = 1
+    fsdp: int = -1
+    expert: int = 1
+    sequence: int = 1
+    tensor: int = 1
+
+    def sizes(self, n_devices: int) -> dict[str, int]:
+        raw = {
+            AXIS_DATA: self.data,
+            AXIS_FSDP: self.fsdp,
+            AXIS_EXPERT: self.expert,
+            AXIS_SEQUENCE: self.sequence,
+            AXIS_TENSOR: self.tensor,
+        }
+        fills = [k for k, v in raw.items() if v == -1]
+        if len(fills) > 1:
+            raise ValueError(f"at most one axis may be -1, got {fills}")
+        fixed = math.prod(v for v in raw.values() if v != -1)
+        if fills:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {raw}"
+                )
+            raw[fills[0]] = n_devices // fixed
+            fixed = n_devices
+        if fixed != n_devices:
+            raise ValueError(
+                f"mesh {raw} needs {fixed} devices, have {n_devices}"
+            )
+        return raw
+
+    def model_parallel_size(self, n_devices: int) -> int:
+        """Devices holding one replica's model shards (excl. data/fsdp)."""
+        sizes = self.sizes(n_devices)
+        return sizes[AXIS_TENSOR] * sizes[AXIS_SEQUENCE] * sizes[AXIS_EXPERT]
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the named device mesh for a MeshConfig.
+
+    Uses ``mesh_utils.create_device_mesh`` when the devices are real TPUs so
+    the physical ICI topology is respected; falls back to a plain reshape for
+    CPU/virtual meshes (tests, dryrun_multichip).
+    """
+    config = config or MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    sizes = config.sizes(len(devices))
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    if devices[0].platform == "tpu":
+        try:
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except (ValueError, NotImplementedError):
+            dev_array = np.array(devices).reshape(shape)
+    else:
+        dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+# Logical axis names used by every model in tpufw.models. Sharding strategy
+# changes are rule edits here, never model edits.
+def logical_axis_rules(
+    *,
+    fsdp_also_data: bool = True,
+) -> tuple[tuple[str, tuple[str, ...] | None], ...]:
+    """(logical axis -> mesh axes) rules for flax logical partitioning.
+
+    ``batch`` spans every data-like axis; parameters shard their largest dim
+    over ``fsdp`` (ZeRO-3) and their model-parallel dim over ``tensor``;
+    ``expert`` maps experts onto the expert axis; activations' sequence dim
+    maps onto ``sequence`` for context parallelism.
+    """
+    batch_axes: tuple[str, ...] = (
+        (AXIS_DATA, AXIS_FSDP) if fsdp_also_data else (AXIS_DATA,)
+    )
+    return (
+        ("batch", batch_axes),
+        ("act_seq", (AXIS_SEQUENCE,)),
+        ("act_embed", None),
+        ("act_heads", (AXIS_TENSOR,)),
+        ("act_mlp", (AXIS_TENSOR,)),
+        ("act_vocab", (AXIS_TENSOR,)),
+        # Parameter axes.
+        ("embed", (AXIS_FSDP,)),
+        ("mlp", (AXIS_TENSOR,)),
+        ("heads", (AXIS_TENSOR,)),
+        ("q_heads", (AXIS_TENSOR,)),
+        ("kv_heads", (AXIS_TENSOR,)),
+        ("head_dim", None),
+        ("vocab", (AXIS_TENSOR,)),
+        ("expert", (AXIS_EXPERT,)),
+        ("expert_mlp", (AXIS_TENSOR,)),
+        ("norm", None),
+        # Conv/ResNet axes.
+        ("conv_hw", None),
+        ("conv_in", None),
+        ("conv_out", (AXIS_FSDP,)),
+    )
+
+
+def mesh_sharding(
+    mesh: Mesh, spec: PartitionSpec | None = None
+) -> NamedSharding:
+    return NamedSharding(mesh, spec if spec is not None else PartitionSpec())

@@ -1,0 +1,6 @@
+from tpufw.utils.hardware import (  # noqa: F401
+    ChipSpec,
+    CHIP_SPECS,
+    detect_chip,
+    peak_flops_per_chip,
+)

@@ -1,0 +1,76 @@
+"""Chip specs and detection — the numbers MFU accounting depends on.
+
+The reference's only hardware contract is an environmental claim ("tested on
+4GB+ GPUs", reference ``README.md:7``) and a health gate (``nvidia-smi``,
+``README.md:81-84``). The TPU-native equivalent needs real per-chip peak
+numbers because MFU — the BASELINE north-star metric (>=35% on v5e-16) — is
+tokens/sec * model FLOPs per token / peak FLOPs, and "peak FLOPs" is a
+per-generation constant, not something discoverable at runtime.
+
+Public sources for the table: Google Cloud TPU system architecture docs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Static description of one accelerator chip generation."""
+
+    name: str
+    # Peak dense matmul throughput in FLOP/s at the listed dtype.
+    peak_bf16_flops: float
+    hbm_bytes: int
+    # ICI links per chip — used by the mesh layer to sanity-check topologies.
+    ici_links: int = 4
+
+    @property
+    def hbm_gib(self) -> float:
+        return self.hbm_bytes / 2**30
+
+
+# Peak bf16 FLOP/s per chip. v5e: 197 TFLOP/s bf16, 16 GiB HBM.
+# v5p: 459 TFLOP/s bf16, 95 GiB HBM. v4: 275 TFLOP/s, 32 GiB.
+# v6e (Trillium): 918 TFLOP/s bf16, 32 GiB.
+CHIP_SPECS: dict[str, ChipSpec] = {
+    "v4": ChipSpec("v4", 275e12, 32 * 2**30),
+    "v5e": ChipSpec("v5e", 197e12, 16 * 2**30),
+    "v5p": ChipSpec("v5p", 459e12, 95 * 2**30),
+    "v6e": ChipSpec("v6e", 918e12, 32 * 2**30),
+    # CPU fallback so MFU accounting degrades gracefully in tests / dryruns.
+    # ~100 GFLOP/s is a nominal single-socket figure; tests never assert on it.
+    "cpu": ChipSpec("cpu", 100e9, 16 * 2**30, ici_links=0),
+}
+
+_KIND_PATTERNS: list[tuple[str, str]] = [
+    (r"v6e|v6 ?lite|trillium", "v6e"),
+    (r"v5p", "v5p"),
+    (r"v5 ?lite|v5e|v5litepod", "v5e"),
+    (r"v4", "v4"),
+    (r"cpu", "cpu"),
+]
+
+
+def detect_chip(device=None) -> ChipSpec:
+    """Map a jax device (default: ``jax.devices()[0]``) to its ChipSpec.
+
+    Works off ``device.device_kind`` strings like "TPU v5 lite" / "TPU v5e".
+    Unknown accelerators fall back to v5e (the BASELINE target hardware)
+    rather than raising — benchmarks should run, and report, not crash.
+    """
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for pattern, name in _KIND_PATTERNS:
+        if re.search(pattern, kind):
+            return CHIP_SPECS[name]
+    return CHIP_SPECS["v5e"]
+
+
+def peak_flops_per_chip(device=None) -> float:
+    return detect_chip(device).peak_bf16_flops
