@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""tpufw headline benchmark: Llama train-step throughput on real hardware.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
+measured MFU / 0.35 — the BASELINE.json north-star MFU target. >1.0 beats
+the target.
+
+Runs on whatever jax.devices() provides: the driver's single v5e chip, or a
+CPU fallback (still one JSON line, flagged "platform": "cpu").
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+
+import jax
+
+
+def main() -> None:
+    devices = jax.devices()
+    platform = devices[0].platform
+    on_tpu = platform == "tpu" or "tpu" in devices[0].device_kind.lower()
+
+    from tpufw.configs import BENCH_CONFIG_NAME, bench_model_config
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import Llama, LLAMA_CONFIGS
+    from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+    from tpufw.utils import detect_chip
+
+    if on_tpu:
+        model_cfg = bench_model_config()
+        # batch 4: fp32 params+Adam for 600M is ~9.6G of 16G HBM; batch
+        # 6/8 OOM on the fp32 logits+grads (measured) — chunked-vocab CE
+        # would unlock them.
+        batch_size, seq_len = 4, 2048
+        warmup, measured = 3, 10
+        name = BENCH_CONFIG_NAME
+    else:  # keep the CPU path fast but real
+        model_cfg = LLAMA_CONFIGS["llama3_tiny"]
+        batch_size, seq_len = 4, 128
+        warmup, measured = 1, 3
+        name = "llama3_tiny_cpu"
+
+    trainer = Trainer(
+        Llama(model_cfg),
+        TrainerConfig(
+            batch_size=batch_size,
+            seq_len=seq_len,
+            total_steps=warmup + measured,
+            lr=1e-4,
+            warmup_steps=2,
+        ),
+        MeshConfig(),  # all devices on fsdp
+    )
+    trainer.init_state()
+    flops_per_token = model_cfg.flops_per_token(seq_len - 1)
+    data = synthetic_batches(batch_size, seq_len, model_cfg.vocab_size)
+    history = trainer.run(data, model_flops_per_token=flops_per_token)
+
+    steady = history[warmup:]
+    tps = statistics.median(m.tokens_per_sec_per_chip for m in steady)
+    mfu = statistics.median(m.mfu for m in steady)
+    chip = detect_chip()
+
+    print(
+        json.dumps(
+            {
+                "metric": f"tokens_per_sec_per_chip_{name}",
+                "value": round(tps, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / 0.35, 4),
+                "mfu": round(mfu, 4),
+                "chip": chip.name,
+                "platform": platform,
+                "n_devices": len(devices),
+                "batch_size": batch_size,
+                "seq_len": seq_len,
+                "model_params": model_cfg.n_params(),
+                "final_loss": round(history[-1].loss, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+"""Regression: sequence lengths whose 128-padding isn't a 512 multiple
+(640, 768, 1152) must still tile exactly — the bug class where the grid and
+kv loop silently truncated the tail block."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpufw.ops.attention import xla_attention
+from tpufw.ops.flash import flash_attention
+
+
+@pytest.mark.parametrize("t", [640, 768, 200])
+def test_flash_odd_lengths(t):
+    b, h, kh, d = 1, 2, 1, 64
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, t, h, d))
+    k = jax.random.normal(ks[1], (b, t, kh, d))
+    v = jax.random.normal(ks[2], (b, t, kh, d))
+    ref = xla_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+    g = jax.grad(
+        lambda q: (
+            flash_attention(q, k, v, causal=True, interpret=True) ** 2
+        ).sum()
+    )(q)
+    g_ref = jax.grad(
+        lambda q: (xla_attention(q, k, v, causal=True) ** 2).sum()
+    )(q)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(g_ref), atol=5e-4, rtol=5e-4
+    )

@@ -1,0 +1,53 @@
+"""Ring attention vs single-device reference on the sequence-sharded mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.mesh import MeshConfig, build_mesh
+from tpufw.ops.attention import xla_attention
+from tpufw.parallel import ring_attention, use_mesh
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("seq_devices", [4, 8])
+def test_ring_matches_reference(devices8, causal, seq_devices):
+    mesh = build_mesh(MeshConfig(fsdp=8 // seq_devices, sequence=seq_devices))
+    b, t, h, kh, d = 2, 64 * seq_devices, 4, 2, 32
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (b, t, h, d))
+    k = jax.random.normal(ks[1], (b, t, kh, d))
+    v = jax.random.normal(ks[2], (b, t, kh, d))
+    ref = xla_attention(q, k, v, causal=causal)
+    with use_mesh(mesh):
+        out = jax.jit(
+            lambda q, k, v: ring_attention(q, k, v, causal=causal)
+        )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_ring_grads_flow(devices8):
+    """Ring attention must be differentiable (ppermute has a transpose)."""
+    mesh = build_mesh(MeshConfig(sequence=4, fsdp=2))
+    b, t, h, d = 2, 128, 2, 32
+    q = jax.random.normal(jax.random.key(1), (b, t, h, d))
+
+    def loss(q):
+        with use_mesh(mesh):
+            return (ring_attention(q, q, q, causal=True) ** 2).sum()
+
+    g = jax.grad(loss)(q)
+    # Reference grad through xla attention.
+    g_ref = jax.grad(lambda q: (xla_attention(q, q, q, causal=True) ** 2).sum())(q)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(g_ref), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_ring_requires_mesh():
+    q = jnp.zeros((1, 16, 2, 8))
+    with pytest.raises(ValueError, match="needs a mesh"):
+        ring_attention(q, q, q)

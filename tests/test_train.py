@@ -1,0 +1,95 @@
+"""End-to-end sharded training on the 8-device CPU mesh: loss goes down,
+metrics are produced, checkpoints round-trip."""
+
+import numpy as np
+import pytest
+
+from tpufw.mesh import MeshConfig
+from tpufw.models import Llama, LLAMA_CONFIGS
+from tpufw.train import (
+    Trainer,
+    TrainerConfig,
+    pack_documents,
+    synthetic_batches,
+)
+
+TINY = LLAMA_CONFIGS["llama3_tiny"]
+
+
+@pytest.fixture(scope="module")
+def trained():
+    cfg = TrainerConfig(
+        batch_size=8, seq_len=33, total_steps=12, lr=1e-2, warmup_steps=2
+    )
+    trainer = Trainer(
+        Llama(TINY), cfg, MeshConfig(data=2, fsdp=2, tensor=2)
+    )
+    trainer.init_state()
+    data = synthetic_batches(8, 33, TINY.vocab_size, seed=0)
+    history = trainer.run(
+        data, model_flops_per_token=TINY.flops_per_token(32)
+    )
+    return trainer, history
+
+
+def test_loss_decreases(trained):
+    _, history = trained
+    assert len(history) == 12
+    # Synthetic uniform data: loss should fall from ~ln(256) toward entropy.
+    assert history[-1].loss < history[0].loss
+    assert np.isfinite(history[-1].loss)
+
+
+def test_metrics_populated(trained):
+    _, history = trained
+    m = history[-1]
+    assert m.tokens_per_sec_per_chip > 0
+    assert 0 <= m.mfu  # CPU mesh: no meaningful bound, just well-formed.
+    assert m.step_time_s > 0
+
+
+def test_state_is_sharded(trained):
+    trainer, _ = trained
+    gate = trainer.state.params["layers"]["mlp"]["gate"]["kernel"]
+    # Scanned mlp gate kernel: [layers, embed, mlp]; mlp dim sharded on tensor.
+    assert gate.shape == (TINY.n_layers, TINY.d_model, TINY.d_ff)
+    spec = gate.sharding.spec
+    assert "tensor" in str(spec)
+
+
+def test_checkpoint_roundtrip(tmp_path, trained):
+    import jax
+
+    from tpufw.train import CheckpointManager
+
+    trainer, _ = trained
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    step = int(trainer.state.step)
+    assert mgr.save(step, trainer.state, force=True)
+    mgr.wait()
+    assert mgr.latest_step() == step
+
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+        trainer.state,
+    )
+    restored = mgr.restore(abstract)
+    orig_leaf = np.asarray(
+        trainer.state.params["layers"]["attn"]["q"]["kernel"]
+    )
+    rest_leaf = np.asarray(restored.params["layers"]["attn"]["q"]["kernel"])
+    np.testing.assert_array_equal(orig_leaf, rest_leaf)
+    assert int(restored.step) == step
+    mgr.close()
+
+
+def test_pack_documents_masks_and_shapes():
+    docs = [np.arange(1, 20), np.arange(1, 8), np.arange(1, 50)]
+    batches = list(pack_documents(iter(docs), batch_size=2, seq_len=16))
+    total_real = sum(int(b["loss_mask"].sum()) for b in batches)
+    assert total_real == 19 + 7 + 49
+    for b in batches:
+        assert b["tokens"].shape == (2, 16)
+        assert b["segment_ids"].shape == (2, 16)
+        # Padding has segment 0 and no loss.
+        assert np.all((b["segment_ids"] > 0) == (b["loss_mask"] > 0))

@@ -1,0 +1,1 @@
+from tpufw.configs.presets import BENCH_CONFIG_NAME, bench_model_config  # noqa: F401

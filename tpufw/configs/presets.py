@@ -1,0 +1,34 @@
+"""Benchmark/workload presets — the YAML-of-record side lives in deploy/.
+
+The single-chip bench model is the Llama-3 architecture sized for one v5e
+chip (16 GiB HBM, ``tpufw.utils.hardware``): fp32 params + Adam moments for
+~600M params is ~7 GiB, leaving headroom for remat'd activations at
+batch 8 x 2048. Scaling the *architecture* down (not the math) keeps the MFU
+measurement representative of the 8B target.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpufw.models.llama import LlamaConfig
+
+BENCH_CONFIG_NAME = "llama3_600m_bench"
+
+
+def bench_model_config() -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=32_768,
+        d_model=1536,
+        n_layers=14,
+        n_heads=12,
+        n_kv_heads=6,
+        head_dim=128,
+        d_ff=6144,
+        max_seq_len=2048,
+        dtype=jnp.bfloat16,
+        param_dtype=jnp.float32,
+        attention_backend="flash",
+        remat=True,
+        scan_layers=True,
+    )

@@ -68,6 +68,9 @@ class MeshConfig:
             AXIS_SEQUENCE: self.sequence,
             AXIS_TENSOR: self.tensor,
         }
+        bad = [k for k, v in raw.items() if v != -1 and v < 1]
+        if bad:
+            raise ValueError(f"axis sizes must be >=1 or -1 (fill), got {raw}")
         fills = [k for k, v in raw.items() if v == -1]
         if len(fills) > 1:
             raise ValueError(f"at most one axis may be -1, got {fills}")

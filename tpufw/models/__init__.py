@@ -1,0 +1,1 @@
+from tpufw.models.llama import Llama, LlamaConfig, LLAMA_CONFIGS  # noqa: F401

@@ -1,0 +1,317 @@
+"""Llama-3 model family, TPU-first (Flax linen + logical partitioning).
+
+BASELINE configs 3-4 name Llama-3-8B as the flagship training workload; the
+reference itself ships no models (its workload is ``nvidia-smi``, reference
+``README.md:314``), so this implementation is additive per SURVEY.md §0.
+
+TPU-first choices:
+- bfloat16 activations, fp32 RMSNorm/softmax accumulation — keeps the MXU on
+  its fast path without fp16-style loss-scale machinery.
+- ``nn.scan`` over the layer stack — one compiled block body instead of
+  L inlined copies; XLA compile time stays flat as L grows.
+- every parameter carries *logical* axis names (``embed``, ``mlp``,
+  ``q_heads``...); the (logical -> mesh) mapping lives in
+  ``tpufw.mesh.logical_axis_rules`` so tp/fsdp/sp/ep layout changes never
+  touch this file.
+- attention is dispatched through ``tpufw.ops.multi_head_attention`` so the
+  Pallas flash kernel and ring (sequence-parallel) backends drop in by
+  config string.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpufw.ops import multi_head_attention, rms_norm
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    d_ff: int = 14_336
+    rope_theta: float = 500_000.0
+    rms_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    attention_backend: str = "xla"
+    remat: bool = True
+    scan_layers: bool = True
+
+    def n_params(self, include_embed: bool = True) -> int:
+        """Analytic parameter count (exact for this architecture)."""
+        d, l = self.d_model, self.n_layers
+        attn = l * (
+            d * self.n_heads * self.head_dim
+            + 2 * d * self.n_kv_heads * self.head_dim
+            + self.n_heads * self.head_dim * d
+        )
+        mlp = l * 3 * d * self.d_ff
+        norms = (2 * l + 1) * d
+        embed = self.vocab_size * d
+        head = 0 if self.tie_embeddings else d * self.vocab_size
+        total = attn + mlp + norms
+        if include_embed:
+            total += embed + head
+        return total
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs per token: 6*N_matmul + 6*L*d_model*T (causal).
+
+        6*N covers fwd (2N) + bwd (4N) for all matmul params incl. the LM
+        head but not the embedding gather; the attention term is the
+        QK^T/AV score FLOPs, causal-halved, x3 for fwd+bwd.
+        """
+        d, l = self.d_model, self.n_layers
+        n_matmul = (
+            l
+            * (
+                d * self.n_heads * self.head_dim
+                + 2 * d * self.n_kv_heads * self.head_dim
+                + self.n_heads * self.head_dim * d
+                + 3 * d * self.d_ff
+            )
+            + d * self.vocab_size
+        )
+        attn_score = 6 * l * self.n_heads * self.head_dim * seq_len
+        return 6.0 * n_matmul + attn_score
+
+
+# Presets. 8B matches Meta's Llama-3-8B shape; the proxies are the same
+# architecture scaled to fit one v5e chip (16 GiB HBM) for bench/smoke runs.
+LLAMA_CONFIGS: dict[str, LlamaConfig] = {
+    "llama3_8b": LlamaConfig(),
+    "llama3_1b_proxy": LlamaConfig(
+        vocab_size=32_768,
+        d_model=2048,
+        n_layers=16,
+        n_heads=16,
+        n_kv_heads=8,
+        head_dim=128,
+        d_ff=8192,
+        max_seq_len=4096,
+    ),
+    "llama3_tiny": LlamaConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        d_ff=128,
+        max_seq_len=128,
+        remat=False,
+    ),
+}
+
+
+def apply_rope(
+    x: jax.Array, positions: jax.Array, theta: float
+) -> jax.Array:
+    """Rotary embeddings. x: [B, T, H, D], positions: [B, T] -> same shape."""
+    d = x.shape[-1]
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    )  # [D/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), ("norm",)),
+            (x.shape[-1],),
+            jnp.float32,
+        )
+        return rms_norm(x, w, self.eps)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        dense = lambda feats, names, name: nn.DenseGeneral(  # noqa: E731
+            features=feats,
+            axis=-1,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), names
+            ),
+            name=name,
+        )
+        q = dense(
+            (cfg.n_heads, cfg.head_dim), ("embed", "q_heads", "head_dim"), "q"
+        )(x)
+        k = dense(
+            (cfg.n_kv_heads, cfg.head_dim),
+            ("embed", "kv_heads", "head_dim"),
+            "k",
+        )(x)
+        v = dense(
+            (cfg.n_kv_heads, cfg.head_dim),
+            ("embed", "kv_heads", "head_dim"),
+            "v",
+        )(x)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        q = nn.with_logical_constraint(
+            q, ("batch", "act_seq", "act_heads", "head_dim")
+        )
+        k = nn.with_logical_constraint(
+            k, ("batch", "act_seq", "act_heads", "head_dim")
+        )
+        v = nn.with_logical_constraint(
+            v, ("batch", "act_seq", "act_heads", "head_dim")
+        )
+        out = multi_head_attention(
+            q,
+            k,
+            v,
+            causal=True,
+            segment_ids=segment_ids,
+            backend=cfg.attention_backend,
+        )
+        proj = nn.DenseGeneral(
+            features=cfg.d_model,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("heads", "head_dim", "embed")
+            ),
+            name="o",
+        )
+        return proj(out)
+
+
+class MLP(nn.Module):
+    """SwiGLU feed-forward."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feats, names, name: nn.DenseGeneral(  # noqa: E731
+            features=feats,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), names
+            ),
+            name=name,
+        )
+        gate = dense(cfg.d_ff, ("embed", "mlp"), "gate")(x)
+        up = dense(cfg.d_ff, ("embed", "mlp"), "up")(x)
+        h = nn.silu(gate) * up
+        h = nn.with_logical_constraint(h, ("batch", "act_seq", "act_mlp"))
+        return dense(cfg.d_model, ("mlp", "embed"), "down")(h)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.rms_eps, name="attn_norm")(x), positions, segment_ids
+        )
+        x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.rms_eps, name="mlp_norm")(x))
+        return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+
+
+class Llama(nn.Module):
+    """Decoder-only Llama-3 LM. Returns logits [B, T, vocab]."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, segment_ids=None):
+        cfg = self.cfg
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape
+            )
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=1.0), ("vocab", "embed")
+            ),
+            name="embed",
+        )
+        x = embed(tokens)
+        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+
+        block_cls = LlamaBlock
+        if cfg.remat:
+            block_cls = nn.remat(
+                LlamaBlock,
+                policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                prevent_cse=not cfg.scan_layers,
+            )
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (
+                    mdl(carry, positions, segment_ids),
+                    None,
+                ),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block_cls(cfg, name="layers"), x, None)
+        else:
+            for i in range(cfg.n_layers):
+                x = block_cls(cfg, name=f"layer_{i}")(
+                    x, positions, segment_ids
+                )
+
+        x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.DenseGeneral(
+                features=cfg.vocab_size,
+                use_bias=False,
+                dtype=jnp.float32,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), ("embed", "vocab")
+                ),
+                name="lm_head",
+            )(x)
+        return nn.with_logical_constraint(
+            logits, ("batch", "act_seq", "act_vocab")
+        )

@@ -1,0 +1,2 @@
+from tpufw.ops.attention import multi_head_attention, xla_attention  # noqa: F401
+from tpufw.ops.norms import rms_norm  # noqa: F401

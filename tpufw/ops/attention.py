@@ -1,0 +1,113 @@
+"""Attention ops with switchable backends.
+
+The reference has no compute ops at all (its workload is ``nvidia-smi``,
+reference ``README.md:314``); attention exists here because BASELINE configs
+3-5 are Llama/Mixtral training. Backends:
+
+- ``"xla"``    — einsum softmax attention; XLA fuses it well and it runs
+                 anywhere (CPU tests, dryruns). The correctness reference.
+- ``"flash"``  — Pallas TPU flash-attention kernel (tpufw.ops.flash),
+                 blockwise online-softmax in VMEM; long-seq memory O(T).
+- ``"ring"``   — sequence-parallel ring attention over the ``sequence`` mesh
+                 axis (tpufw.parallel.ring), for contexts longer than one
+                 chip's HBM share.
+
+All backends take [B, T, H, D] q and [B, S, K, D] k/v with K (kv heads)
+dividing H (GQA: each kv head serves H//K query heads).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, K, D] -> [B, S, K*n_rep, D] by repeating each kv head."""
+    if n_rep == 1:
+        return x
+    b, s, k, d = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, k, n_rep, d))
+    return x.reshape(b, s, k * n_rep, d)
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    logits_soft_cap: Optional[float] = None,
+) -> jax.Array:
+    """Reference softmax attention. q:[B,T,H,D], k/v:[B,S,K,D] -> [B,T,H,D].
+
+    ``segment_ids`` ([B, T] int) masks cross-segment attention for packed
+    sequences. Softmax is computed in float32 regardless of input dtype —
+    bf16 logits lose too much precision at long T.
+    """
+    b, t, h, d = q.shape
+    _, s, kh, _ = k.shape
+    if h % kh:
+        raise ValueError(f"q heads {h} not divisible by kv heads {kh}")
+    k = _repeat_kv(k, h // kh)
+    v = _repeat_kv(v, h // kh)
+
+    scale = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    if logits_soft_cap is not None:
+        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+
+    mask = None
+    if causal:
+        # For decode (t < s), align query i with absolute position s-t+i.
+        offset = s - t
+        qpos = jnp.arange(t)[:, None] + offset
+        kpos = jnp.arange(s)[None, :]
+        mask = qpos >= kpos  # [T, S]
+        mask = mask[None, None, :, :]
+    if segment_ids is not None:
+        seg_mask = (segment_ids[:, :, None] == segment_ids[:, None, :])
+        seg_mask = seg_mask[:, None, :, :]
+        mask = seg_mask if mask is None else (mask & seg_mask)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def multi_head_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    logits_soft_cap: Optional[float] = None,
+    backend: str = "xla",
+) -> jax.Array:
+    """Backend dispatcher — the single attention entry point for all models."""
+    if backend == "xla":
+        return xla_attention(
+            q,
+            k,
+            v,
+            causal=causal,
+            segment_ids=segment_ids,
+            logits_soft_cap=logits_soft_cap,
+        )
+    if backend == "flash":
+        from tpufw.ops.flash import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids
+        )
+    if backend == "ring":
+        from tpufw.parallel.ring import ring_attention
+
+        return ring_attention(q, k, v, causal=causal)
+    raise ValueError(f"unknown attention backend {backend!r}")

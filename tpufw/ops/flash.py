@@ -1,0 +1,425 @@
+"""Pallas TPU flash attention (forward + backward), FlashAttention-2 style.
+
+Replaces the O(T*S) materialized-logits attention with blockwise online
+softmax in VMEM: per (batch, head, q-block) the kernel streams K/V blocks
+from VMEM-resident [S, D] slabs, keeping running max/sum statistics. This is
+the memory lever that lets the single-chip bench run larger batches (the
+xla backend's [B, H, T, S] fp32 logits were the OOM driver) and the building
+block the ring (sequence-parallel) backend reuses per shard.
+
+Layout notes (see /opt/skills/guides/pallas_guide.md):
+- blocks are (bq, D) / (bkv, D) with D=head_dim (128 for Llama) — lane dim
+  aligned; bq/bkv are 128 multiples; inputs are padded to block multiples
+  and masked via static-shape iota comparisons.
+- GQA never materializes repeated K/V: the kv BlockSpec index_map divides
+  the head index (h // rep) so all rep query heads stream the same slab.
+- softmax statistics accumulate in fp32; matmuls request
+  preferred_element_type=f32 so the MXU accumulates in fp32 from bf16 inputs.
+
+Backward recomputes P from (q, k, lse) — the flash trick — in two kernels:
+dq (grid over q blocks) and dk/dv (grid over kv blocks, per *query* head,
+summed over the GQA group outside the kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _causal_mask(i_block, j_block, bq, bkv, offset):
+    """[bq, bkv] bool mask: query global pos (+offset) >= key global pos."""
+    q_pos = i_block * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, bkv), 0
+    ) + offset
+    k_pos = j_block * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+    return q_pos >= k_pos
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bkv, s_actual, causal, offset, scale
+):
+    i = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, D]
+    n_kv = k_ref.shape[2] // bkv
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[0, 0, pl.ds(j * bkv, bkv), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * bkv, bkv), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q,
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bkv]
+        k_pos = j * bkv + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bkv), 1
+        )
+        mask = k_pos < s_actual
+        if causal:
+            mask = mask & _causal_mask(i, j, bq, bkv, offset)
+        logits = jnp.where(mask, logits, NEG_INF)
+        m_cur = jnp.max(logits, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc
+
+    d = q_ref.shape[-1]
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    if causal:
+        # Only stream kv blocks that intersect the causal triangle.
+        n_needed = jax.lax.div(
+            (i + 1) * bq + offset + bkv - 1, bkv
+        )
+        n_iter = jnp.minimum(n_needed, n_kv)
+    else:
+        n_iter = n_kv
+    m, l, acc = jax.lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0, 0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, bq, bkv, s_actual, causal, offset, scale
+):
+    i = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, 0][:, None]  # [bq, 1]
+    delta = delta_ref[0, 0, 0][:, None]
+    n_kv = k_ref.shape[2] // bkv
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * bkv, bkv), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(j * bkv, bkv), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        k_pos = j * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+        mask = k_pos < s_actual
+        if causal:
+            mask = mask & _causal_mask(i, j, bq, bkv, offset)
+        p = jnp.where(mask, jnp.exp(logits - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    d = q_ref.shape[-1]
+    if causal:
+        n_needed = jax.lax.div((i + 1) * bq + offset + bkv - 1, bkv)
+        n_iter = jnp.minimum(n_needed, n_kv)
+    else:
+        n_iter = n_kv
+    dq = jax.lax.fori_loop(
+        0, n_iter, body, jnp.zeros((bq, d), jnp.float32)
+    )
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, bq, bkv, t_actual, causal, offset, scale
+):
+    j = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)  # [bkv, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    n_q = q_ref.shape[2] // bq
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32) * scale
+        do = do_ref[0, 0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0, pl.ds(i * bq, bq)][:, None]
+        delta = delta_ref[0, 0, 0, pl.ds(i * bq, bq)][:, None]
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+        mask = q_pos < t_actual
+        if causal:
+            mask = mask & _causal_mask(i, j, bq, bkv, offset)
+        p = jnp.where(mask, jnp.exp(logits - lse), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    if causal:
+        # q blocks strictly before this kv block never attend to it.
+        first = jax.lax.div(j * bkv - offset, bq)
+        i0 = jnp.maximum(first, 0)
+    else:
+        i0 = 0
+    d = k_ref.shape[-1]
+    dk0 = jnp.zeros((bkv, d), jnp.float32)
+    dv0 = jnp.zeros((bkv, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(i0, n_q, body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _heads_layout(q, k, v):
+    """[B,T,H,D] -> [B,H,T,D] for all three."""
+    return (
+        jnp.transpose(q, (0, 2, 1, 3)),
+        jnp.transpose(k, (0, 2, 1, 3)),
+        jnp.transpose(v, (0, 2, 1, 3)),
+    )
+
+
+def _block_sizes(t_pad, s_pad):
+    """Largest block sizes (<=512) that DIVIDE the padded lengths — the grid
+    and the in-kernel kv loop both assume exact tiling (inputs are padded to
+    128 multiples, so 128 always divides)."""
+
+    def pick(n):
+        for b in (512, 256, 128):
+            if n % b == 0:
+                return b
+        return n  # n < 128 can't happen post-padding; defensive.
+
+    return pick(t_pad), pick(s_pad)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4)
+)
+def _flash(q, k, v, causal, interpret):
+    out, _ = _flash_fwd_impl(q, k, v, causal, interpret)
+    return out
+
+
+def _flash_fwd_impl(q, k, v, causal, interpret):
+    b, t, h, d = q.shape
+    _, s, kh, _ = k.shape
+    rep = h // kh
+    scale = 1.0 / math.sqrt(d)
+    offset = s - t  # decode alignment: query i sits at abs pos offset+i
+
+    qh, kh_, vh = _heads_layout(q, k, v)
+    t_pad_mult = 128
+    qh = _pad_to(qh, 2, t_pad_mult)
+    kh_ = _pad_to(kh_, 2, t_pad_mult)
+    vh = _pad_to(vh, 2, t_pad_mult)
+    t_p, s_p = qh.shape[2], kh_.shape[2]
+    bq, bkv = _block_sizes(t_p, s_p)
+
+    grid = (b, h, t_p // bq)
+    kernel = functools.partial(
+        _fwd_kernel,
+        bq=bq,
+        bkv=bkv,
+        s_actual=s,
+        causal=causal,
+        offset=offset,
+        scale=scale,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, s_p, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, s_p, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i)
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, t_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh_, vh)
+    out_bthd = jnp.transpose(out[:, :, :t, :], (0, 2, 1, 3))
+    return out_bthd, (q, k, v, out_bthd, lse)
+
+
+def _flash_bwd_impl(causal, interpret, res, g):
+    q, k, v, out, lse = res
+    b, t, h, d = q.shape
+    _, s, kh, _ = k.shape
+    rep = h // kh
+    scale = 1.0 / math.sqrt(d)
+    offset = s - t
+
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [B, T, H]
+    delta = jnp.transpose(delta, (0, 2, 1))[:, :, None, :]  # [B,H,1,T]
+
+    qh, kh_, vh = _heads_layout(q, k, v)
+    doh = jnp.transpose(g, (0, 2, 1, 3))
+    qh = _pad_to(qh, 2, 128)
+    kh_ = _pad_to(kh_, 2, 128)
+    vh = _pad_to(vh, 2, 128)
+    doh = _pad_to(doh, 2, 128)
+    delta_p = _pad_to(delta, 3, 128)
+    lse_p = lse  # stored padded in the residual
+    t_p, s_p = qh.shape[2], kh_.shape[2]
+    bq, bkv = _block_sizes(t_p, s_p)
+
+    # dq: grid over q blocks.
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel,
+            bq=bq,
+            bkv=bkv,
+            s_actual=s,
+            causal=causal,
+            offset=offset,
+            scale=scale,
+        ),
+        grid=(b, h, t_p // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec(
+                (1, 1, s_p, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, s_p, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0)
+            ),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec(
+                (1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i)
+            ),
+            pl.BlockSpec(
+                (1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, t_p, d), q.dtype),
+        interpret=interpret,
+    )(qh, kh_, vh, doh, lse_p, delta_p)
+
+    # dk/dv: grid over kv blocks, per *query* head; GQA-summed after.
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel,
+            bq=bq,
+            bkv=bkv,
+            t_actual=t,
+            causal=causal,
+            offset=offset,
+            scale=scale,
+        ),
+        grid=(b, h, s_p // bkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, t_p, d), lambda b_, h_, j: (b_, h_, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, bkv, d), lambda b_, h_, j: (b_, h_ // rep, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, bkv, d), lambda b_, h_, j: (b_, h_ // rep, j, 0)
+            ),
+            pl.BlockSpec((1, 1, t_p, d), lambda b_, h_, j: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, 1, t_p), lambda b_, h_, j: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, 1, t_p), lambda b_, h_, j: (b_, h_, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bkv, d), lambda b_, h_, j: (b_, h_, j, 0)),
+            pl.BlockSpec((1, 1, bkv, d), lambda b_, h_, j: (b_, h_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s_p, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh_, vh, doh, lse_p, delta_p)
+
+    dq = jnp.transpose(dq[:, :, :t, :], (0, 2, 1, 3))
+    dk = dk_full[:, :, :s, :].reshape(b, kh, rep, s, d).sum(2)
+    dv = dv_full[:, :, :s, :].reshape(b, kh, rep, s, d).sum(2)
+    dk = jnp.transpose(dk, (0, 2, 1, 3)).astype(k.dtype)
+    dv = jnp.transpose(dv, (0, 2, 1, 3)).astype(v.dtype)
+    return dq, dk, dv
+
+
+def _flash_fwd_rule(q, k, v, causal, interpret):
+    out, res = _flash_fwd_impl(q, k, v, causal, interpret)
+    return out, res
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_impl)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids=None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Flash attention. q:[B,T,H,D], k/v:[B,S,K,D] -> [B,T,H,D].
+
+    ``interpret=None`` auto-selects the Pallas interpreter on CPU backends
+    (tests, dryruns); any accelerator backend gets the real Mosaic lowering.
+    """
+    if segment_ids is not None:
+        raise NotImplementedError(
+            "flash backend does not take packed segment_ids yet; "
+            "use backend='xla' for packed batches"
+        )
+    h, kh = q.shape[2], k.shape[2]
+    if h % kh:
+        raise ValueError(f"q heads {h} not divisible by kv heads {kh}")
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    return _flash(q, k, v, causal, interpret)

@@ -1,0 +1,19 @@
+"""Normalization ops. RMSNorm is the Llama/Mixtral norm; computed in fp32."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """RMSNorm with fp32 accumulation, output cast back to x.dtype.
+
+    XLA fuses this into neighbors on TPU; a Pallas fusion only pays off when
+    combined with quantization, so the plain version is the default.
+    """
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)

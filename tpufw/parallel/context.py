@@ -1,0 +1,37 @@
+"""Process-wide current-mesh registry.
+
+Model code runs under ``jax.jit`` tracing and can't take a Mesh argument
+through flax module signatures without plumbing it everywhere; the Trainer
+(or user) registers the active mesh here and mesh-aware ops (ring attention)
+pick it up. Explicit ``mesh=`` arguments always override.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from jax.sharding import Mesh
+
+_current: Optional[Mesh] = None
+
+
+def set_current_mesh(mesh: Optional[Mesh]) -> None:
+    global _current
+    _current = mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _current
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    global _current
+    prev = _current
+    _current = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _current = prev

@@ -1,0 +1,143 @@
+"""Ring attention: sequence/context parallelism over the ``sequence`` mesh axis.
+
+Long-context design per SURVEY.md §5: activations are sharded along the
+sequence dimension; K/V shards rotate around the ring via
+``jax.lax.ppermute`` (XLA lowers it to ICI collective-permute) while each
+device accumulates attention for its resident Q shard with online-softmax
+merging — attention over a context n_seq times longer than one chip could
+hold, with comms riding neighbor ICI links instead of all-gathers.
+
+The global causal mask falls out of absolute positions: device d holds
+positions [d*L, (d+1)*L); masks compare global q/k positions, so the
+same SPMD code handles the full/partial/empty chunk cases.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from tpufw.mesh.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQUENCE, AXIS_TENSOR
+from tpufw.ops.attention import _repeat_kv
+from tpufw.parallel.context import current_mesh
+
+NEG_INF = -1e30
+
+
+def _chunk_attn(q, k, v, q_start, k_start, causal, scale, rep):
+    """Attention of local q against one kv chunk; returns (acc, m, l) stats.
+
+    q: [B,T,H,D], k/v: [B,S,K,D] with H = K*rep (GQA repeat happens here,
+    post-ppermute, so the ring never rotates repeated bytes).
+    m/l: [B,H,T,1] running max / normalizer in fp32.
+    """
+    k = _repeat_kv(k, rep)
+    v = _repeat_kv(v, rep)
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    if causal:
+        t, s = q.shape[1], k.shape[1]
+        q_pos = q_start + jnp.arange(t)[:, None]
+        k_pos = k_start + jnp.arange(s)[None, :]
+        mask = (q_pos >= k_pos)[None, None]
+        logits = jnp.where(mask, logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)  # [B,H,T,1]
+    p = jnp.exp(logits - m)
+    # Guard fully-masked chunks: exp(NEG_INF - NEG_INF) would be 1.
+    p = jnp.where(m <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhts,bshd->bhtd", p.astype(q.dtype), v).astype(
+        jnp.float32
+    )
+    return acc, m, l
+
+
+def _ring_attn_local(q, k, v, *, causal, axis_name, scale, rep):
+    """Body run per-device under shard_map. q: [B,L,H,D], k/v: [B,L,K,D]."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    b, _, h, d = q.shape
+
+    m0 = jnp.full((b, h, t_local, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(step, carry):
+        k_cur, v_cur, m, l, acc = carry
+        src_chunk = (idx - step) % n
+        acc_c, m_c, l_c = _chunk_attn(
+            q,
+            k_cur,
+            v_cur,
+            q_start=idx * t_local,
+            k_start=src_chunk * t_local,
+            causal=causal,
+            scale=scale,
+            rep=rep,
+        )
+        m_new = jnp.maximum(m, m_c)
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        beta = jnp.where(m_c <= NEG_INF / 2, 0.0, jnp.exp(m_c - m_new))
+        l_new = l * alpha + l_c * beta
+        acc_new = acc * alpha + acc_c * beta
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m_new, l_new, acc_new
+
+    _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l_safe).astype(q.dtype)  # [B,H,T,D]
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = AXIS_SEQUENCE,
+) -> jax.Array:
+    """Sequence-parallel attention. q:[B,T,H,D], k/v:[B,S,K,D] global shapes.
+
+    Wraps its own ``shard_map`` over (batch=data+fsdp, seq=sequence,
+    heads=tensor); requires a registered current mesh (tpufw.parallel.context)
+    or an explicit ``mesh``. T must equal S (self-attention) and divide
+    evenly by the sequence-axis size.
+    """
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise ValueError(
+            "ring_attention needs a mesh: pass mesh= or register one via "
+            "tpufw.parallel.context.use_mesh(...)"
+        )
+    if q.shape[1] != k.shape[1]:
+        raise ValueError(
+            f"ring attention is self-attention only: T={q.shape[1]} != "
+            f"S={k.shape[1]}"
+        )
+    rep = q.shape[2] // k.shape[2]
+    spec = P((AXIS_DATA, AXIS_FSDP), AXIS_SEQUENCE, AXIS_TENSOR, None)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    fn = shard_map(
+        functools.partial(
+            _ring_attn_local,
+            causal=causal,
+            axis_name=axis_name,
+            scale=scale,
+            rep=rep,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

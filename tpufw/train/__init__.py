@@ -1,0 +1,12 @@
+from tpufw.train.trainer import (  # noqa: F401
+    TrainState,
+    Trainer,
+    TrainerConfig,
+    cross_entropy_loss,
+    default_optimizer,
+    state_shardings,
+    train_step,
+)
+from tpufw.train.metrics import Meter, StepMetrics  # noqa: F401
+from tpufw.train.checkpoint import CheckpointManager  # noqa: F401
+from tpufw.train.data import pack_documents, synthetic_batches  # noqa: F401

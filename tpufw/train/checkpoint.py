@@ -1,0 +1,62 @@
+"""Checkpoint/resume via Orbax — the recovery half of elastic training.
+
+The reference's only recovery primitive is ``restartPolicy: OnFailure`` on its
+test pod (reference ``README.md:309``); SURVEY.md §5 mandates the real thing
+for the TPU build: gang-restarted JobSets only make sense if workers resume
+from a recent checkpoint. Async saves keep serialization off the step path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Thin wrapper over ocp.CheckpointManager for TrainState pytrees.
+
+    Saves are async (background thread does the device-to-host + write);
+    ``restore`` reshards directly onto the current mesh via the abstract
+    target — a checkpoint written on one topology restores onto another,
+    which is what makes slice-size changes and elastic restarts cheap.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+    ):
+        self.directory = os.path.abspath(directory)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=True,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+
+    def restore(self, abstract_state: Any, step: Optional[int] = None) -> Any:
+        """Restore ``step`` (default: latest) sharded per ``abstract_state``
+        (a jax.eval_shape pytree whose leaves carry .sharding)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state)
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,91 @@
+"""Input pipelines: synthetic LM batches and packed token streams.
+
+The reference has no data path (nothing to feed ``nvidia-smi``); training
+configs need one. Synthetic data is the benchmarking default (zero-IO,
+deterministic); the packed stream handles real tokenized corpora with
+sequence packing + segment ids so no FLOPs are spent on padding.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+def synthetic_batches(
+    batch_size: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int = 0,
+    n_batches: Optional[int] = None,
+) -> Iterator[dict]:
+    """Deterministic random-token batches, generated host-side with numpy so
+    device compute is purely the model (what a benchmark wants)."""
+    rng = np.random.default_rng(seed)
+    i = 0
+    while n_batches is None or i < n_batches:
+        yield {
+            "tokens": rng.integers(
+                0, vocab_size, (batch_size, seq_len), dtype=np.int32
+            )
+        }
+        i += 1
+
+
+def _emit(batch_toks: list, batch_segs: list) -> dict:
+    segs = np.array(batch_segs, np.int32)
+    return {
+        "tokens": np.array(batch_toks, np.int32),
+        "segment_ids": segs,
+        "loss_mask": (segs > 0).astype(np.float32),
+    }
+
+
+def pack_documents(
+    docs: Iterator[np.ndarray],
+    batch_size: int,
+    seq_len: int,
+    pad_id: int = 0,
+) -> Iterator[dict]:
+    """Pack variable-length token docs into fixed [B, T] batches.
+
+    Emits ``tokens``, ``segment_ids`` (per-doc ids so attention can't cross
+    documents — wired to the model's segment masking), and ``loss_mask``
+    (0 on padding). Documents longer than T are split; no tokens dropped.
+    """
+    row_tokens: list[int] = []
+    row_segs: list[int] = []
+    seg = 1
+    batch_toks, batch_segs = [], []
+
+    def flush_row():
+        nonlocal row_tokens, row_segs, seg
+        pad = seq_len - len(row_tokens)
+        toks = row_tokens + [pad_id] * pad
+        segs = row_segs + [0] * pad
+        batch_toks.append(toks)
+        batch_segs.append(segs)
+        row_tokens, row_segs = [], []
+        seg = 1
+
+    for doc in docs:
+        doc = list(np.asarray(doc, dtype=np.int32))
+        while doc:
+            space = seq_len - len(row_tokens)
+            take, doc = doc[:space], doc[space:]
+            row_tokens.extend(take)
+            row_segs.extend([seg] * len(take))
+            seg += 1
+            if len(row_tokens) == seq_len:
+                flush_row()
+            if len(batch_toks) == batch_size:
+                yield _emit(batch_toks, batch_segs)
+                batch_toks, batch_segs = [], []
+    if row_tokens:
+        flush_row()
+    if batch_toks:
+        while len(batch_toks) < batch_size:
+            batch_toks.append([pad_id] * seq_len)
+            batch_segs.append([0] * seq_len)
+        yield _emit(batch_toks, batch_segs)

@@ -1,0 +1,68 @@
+"""Training metrics: tokens/sec/chip and MFU as first-class measured outputs.
+
+BASELINE's headline metric is tokens/sec/chip for Llama-3-8B and >=35% MFU on
+v5e-16 (SURVEY.md §6); the reference has no metrics at all (its verification
+channel is ``kubectl logs`` of ``nvidia-smi``, reference ``README.md:331-335``).
+MFU here is *model* FLOPs utilization: analytic model FLOPs per token (from
+the model config) — not XLA's executed-FLOPs counter, which would reward
+rematerialization for doing extra work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from tpufw.utils.hardware import ChipSpec, detect_chip
+
+
+@dataclasses.dataclass
+class StepMetrics:
+    step: int
+    loss: float
+    step_time_s: float
+    tokens_per_sec_per_chip: float
+    mfu: float
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Meter:
+    """Accumulates step timings and converts to tokens/sec/chip + MFU.
+
+    ``flops_per_token`` comes from ``config.flops_per_token(seq_len)``;
+    ``n_chips`` is the global device count (the denominator that makes
+    tokens/sec/chip comparable across slice sizes).
+    """
+
+    def __init__(
+        self,
+        tokens_per_step: int,
+        flops_per_token: float,
+        n_chips: int,
+        chip: ChipSpec | None = None,
+    ):
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_token = flops_per_token
+        self.n_chips = max(n_chips, 1)
+        self.chip = chip or detect_chip()
+        self._t0: float | None = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, step: int, loss: float) -> StepMetrics:
+        if self._t0 is None:
+            raise RuntimeError("Meter.stop() without start()")
+        dt = time.perf_counter() - self._t0
+        self._t0 = None
+        tps_chip = self.tokens_per_step / dt / self.n_chips
+        mfu = tps_chip * self.flops_per_token / self.chip.peak_bf16_flops
+        return StepMetrics(
+            step=step,
+            loss=float(loss),
+            step_time_s=dt,
+            tokens_per_sec_per_chip=tps_chip,
+            mfu=mfu,
+        )

@@ -1,0 +1,301 @@
+"""Sharded training: state, loss, jitted step, and the Trainer driver.
+
+Everything runs through one ``jax.jit``-compiled train step whose in/out
+shardings are derived from the model's logical partitioning metadata + the
+mesh rules (tpufw.mesh). XLA inserts all collectives (grad psum over
+data/fsdp, all-gathers for fsdp params, tensor-parallel reductions) — there
+is no hand-written communication anywhere, per SURVEY.md §2c.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax import struct
+from flax.core import meta
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpufw.mesh import MeshConfig, build_mesh, logical_axis_rules
+from tpufw.train.metrics import Meter, StepMetrics
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    # Static fields (not traced).
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads):
+        updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        return self.replace(
+            step=self.step + 1,
+            params=optax.apply_updates(self.params, updates),
+            opt_state=new_opt,
+        )
+
+
+def cross_entropy_loss(
+    logits: jax.Array,
+    targets: jax.Array,
+    mask: Optional[jax.Array] = None,
+    z_loss_weight: float = 1e-4,
+) -> tuple[jax.Array, jax.Array]:
+    """Token CE with z-loss regularization (keeps the softmax normalizer
+    bounded — standard for large-vocab LM training). Returns (loss, n_tokens).
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1
+    )[..., 0]
+    ce = logz - label_logits
+    if z_loss_weight:
+        ce = ce + z_loss_weight * jnp.square(logz)
+    if mask is None:
+        return ce.mean(), jnp.array(ce.size, jnp.float32)
+    n = jnp.maximum(mask.sum(), 1.0)
+    return (ce * mask).sum() / n, n
+
+
+def default_optimizer(
+    lr: float = 3e-4,
+    warmup_steps: int = 100,
+    total_steps: int = 10_000,
+    weight_decay: float = 0.1,
+    grad_clip: float = 1.0,
+    b1: float = 0.9,
+    b2: float = 0.95,
+) -> optax.GradientTransformation:
+    """AdamW + cosine schedule + global-norm clipping — the Llama recipe."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup_steps, max(total_steps, warmup_steps + 1), lr * 0.1
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+def train_step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+    """One fwd+bwd+update. batch: tokens [B,T] (+ optional loss_mask,
+    segment_ids). Targets are tokens shifted left; the final position is
+    masked out.
+    """
+    tokens = batch["tokens"]
+    inputs = tokens[:, :-1]
+    targets = tokens[:, 1:]
+    seg = batch.get("segment_ids")
+    seg_in = None if seg is None else seg[:, :-1]
+    mask = batch.get("loss_mask")
+    mask = None if mask is None else mask[:, 1:].astype(jnp.float32)
+    if seg is not None:
+        # Don't train boundary positions to predict the next document's
+        # first token — attention (correctly) can't see across segments.
+        same_seg = (seg[:, :-1] == seg[:, 1:]).astype(jnp.float32)
+        mask = same_seg if mask is None else mask * same_seg
+
+    def loss_fn(params):
+        logits = state.apply_fn(
+            {"params": params}, inputs, segment_ids=seg_in
+        )
+        loss, _ = cross_entropy_loss(logits, targets, mask)
+        return loss
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    new_state = state.apply_gradients(grads)
+    metrics = {
+        "loss": loss,
+        "grad_norm": optax.global_norm(grads),
+    }
+    return new_state, metrics
+
+
+def state_shardings(
+    abstract_state: TrainState, mesh: Mesh, rules=None
+) -> TrainState:
+    """Derive NamedShardings for a TrainState pytree from logical metadata.
+
+    Params carry flax ``Partitioned`` metadata; optimizer moments mirror the
+    param they track (optax keeps the tree structure), so
+    ``nn.logical_to_mesh_sharding`` resolves both. Scalars replicate.
+    """
+    rules = rules or logical_axis_rules()
+    specs = nn.get_partition_spec(abstract_state)
+    return nn.logical_to_mesh_sharding(specs, mesh, rules)
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    batch_size: int = 8
+    seq_len: int = 2048
+    total_steps: int = 100
+    lr: float = 3e-4
+    warmup_steps: int = 10
+    log_every: int = 10
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 1000
+
+
+class Trainer:
+    """Builds mesh + sharded state and runs the step loop with MFU metrics."""
+
+    def __init__(
+        self,
+        model: nn.Module,
+        trainer_cfg: TrainerConfig,
+        mesh_cfg: MeshConfig | None = None,
+        mesh: Mesh | None = None,
+        tx: optax.GradientTransformation | None = None,
+    ):
+        self.model = model
+        self.cfg = trainer_cfg
+        self.mesh = mesh if mesh is not None else build_mesh(mesh_cfg)
+        self.tx = tx or default_optimizer(
+            lr=trainer_cfg.lr,
+            warmup_steps=trainer_cfg.warmup_steps,
+            total_steps=trainer_cfg.total_steps,
+        )
+        self._compiled = None
+        self.state = None
+        self.state_sharding = None
+
+    def _abstract_state(self, rng):
+        tokens = jnp.zeros(
+            (self.cfg.batch_size, self.cfg.seq_len), jnp.int32
+        )
+
+        def init_fn(rng):
+            variables = self.model.init(rng, tokens[:, :-1])
+            params = variables["params"]
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=self.tx.init(params),
+                apply_fn=self.model.apply,
+                tx=self.tx,
+            )
+
+        return init_fn, jax.eval_shape(init_fn, rng)
+
+    def init_state(self, seed: int = 0) -> TrainState:
+        rng = jax.random.key(seed)
+        init_fn, abstract = self._abstract_state(rng)
+        self.state_sharding = state_shardings(abstract, self.mesh)
+        with self.mesh:
+            self.state = jax.jit(
+                init_fn, out_shardings=self.state_sharding
+            )(rng)
+        # Unbox flax Partitioned wrappers: downstream code wants raw arrays.
+        self.state = meta.unbox(self.state)
+        self.state_sharding = meta.unbox(self.state_sharding)
+        return self.state
+
+    def maybe_restore(self) -> bool:
+        """Resume from the latest checkpoint in cfg.checkpoint_dir, if any —
+        the JobSet gang-restart resume path (SURVEY.md §5)."""
+        if not self.cfg.checkpoint_dir:
+            return False
+        from tpufw.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(self.cfg.checkpoint_dir)
+        try:
+            if mgr.latest_step() is None:
+                return False
+            if self.state is not None:
+                abstract = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        x.shape, x.dtype, sharding=x.sharding
+                    ),
+                    self.state,
+                )
+            else:
+                # Shapes + shardings WITHOUT materializing a throwaway init
+                # (an 8B init would allocate full params+Adam just to be
+                # overwritten by the restore).
+                rng = jax.random.key(0)
+                _, boxed = self._abstract_state(rng)
+                self.state_sharding = meta.unbox(
+                    state_shardings(boxed, self.mesh)
+                )
+                abstract = jax.tree.map(
+                    lambda x, s: jax.ShapeDtypeStruct(
+                        x.shape, x.dtype, sharding=s
+                    ),
+                    meta.unbox(boxed),
+                    self.state_sharding,
+                )
+            self.state = mgr.restore(abstract)
+            return True
+        finally:
+            mgr.close()
+
+    def compiled_step(self, batch: dict | None = None):
+        """Jitted train step; batch shardings derived from the batch's own
+        structure (every leaf is batch-major: shard dim 0 on data+fsdp)."""
+        key = None if batch is None else tuple(sorted(batch.keys()))
+        if self._compiled is None or self._compiled[0] != key:
+            row = NamedSharding(self.mesh, P(("data", "fsdp")))
+            batch_sharding = (
+                {"tokens": row}
+                if batch is None
+                else {k: row for k in batch}
+            )
+            self._compiled = (
+                key,
+                jax.jit(
+                    train_step,
+                    in_shardings=(self.state_sharding, batch_sharding),
+                    out_shardings=(self.state_sharding, None),
+                    donate_argnums=(0,),
+                ),
+            )
+        return self._compiled[1]
+
+    def run(
+        self,
+        data: Iterator[dict],
+        model_flops_per_token: float,
+        on_metrics: Callable[[StepMetrics], None] | None = None,
+    ) -> list[StepMetrics]:
+        if self.state is None:
+            self.init_state()
+        meter = Meter(
+            tokens_per_step=self.cfg.batch_size * (self.cfg.seq_len - 1),
+            flops_per_token=model_flops_per_token,
+            n_chips=len(self.mesh.devices.flatten()),
+        )
+        ckpt = None
+        if self.cfg.checkpoint_dir:
+            from tpufw.train.checkpoint import CheckpointManager
+
+            ckpt = CheckpointManager(
+                self.cfg.checkpoint_dir,
+                save_interval_steps=self.cfg.checkpoint_every,
+            )
+        history: list[StepMetrics] = []
+        with self.mesh:
+            for i, batch in enumerate(data):
+                if i >= self.cfg.total_steps:
+                    break
+                step_fn = self.compiled_step(batch)
+                meter.start()
+                self.state, m = step_fn(self.state, batch)
+                loss = jax.block_until_ready(m["loss"])
+                sm = meter.stop(int(self.state.step), loss)
+                history.append(sm)
+                if on_metrics and (i % self.cfg.log_every == 0):
+                    on_metrics(sm)
+                if ckpt is not None:
+                    ckpt.save(int(self.state.step), self.state)
+        if ckpt is not None:
+            ckpt.wait()
+            ckpt.close()
+        return history
