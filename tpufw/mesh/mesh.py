@@ -155,7 +155,8 @@ def logical_axis_rules(
         ("expert_mlp", (AXIS_TENSOR,)),
         ("norm", None),
         # Conv/ResNet axes.
-        ("conv_hw", None),
+        ("conv_h", None),
+        ("conv_w", None),
         ("conv_in", None),
         ("conv_out", (AXIS_FSDP,)),
     )

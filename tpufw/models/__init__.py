@@ -1,1 +1,8 @@
 from tpufw.models.llama import Llama, LlamaConfig, LLAMA_CONFIGS  # noqa: F401
+from tpufw.models.mixtral import (  # noqa: F401
+    MIXTRAL_CONFIGS,
+    Mixtral,
+    MixtralConfig,
+    MoEMLP,
+)
+from tpufw.models.resnet import ResNet, ResNetConfig, resnet50  # noqa: F401

@@ -249,6 +249,84 @@ class LlamaBlock(nn.Module):
         return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
 
 
+def decoder_lm(cfg, block_base, tokens, positions, segment_ids, with_aux):
+    """Shared decoder trunk: embed -> remat/scan block stack -> norm -> head.
+
+    Used by both Llama and Mixtral (the only difference is the block class
+    and whether blocks thread an aux-loss carry) so the two families can't
+    drift. Must be called from inside a compact ``__call__``.
+
+    Returns ``logits`` or ``(logits, aux)`` when ``with_aux``.
+    """
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+    embed = nn.Embed(
+        cfg.vocab_size,
+        cfg.d_model,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        embedding_init=nn.with_logical_partitioning(
+            nn.initializers.normal(stddev=1.0), ("vocab", "embed")
+        ),
+        name="embed",
+    )
+    x = embed(tokens)
+    x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+
+    block_cls = block_base
+    if cfg.remat:
+        block_cls = nn.remat(
+            block_base,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            prevent_cse=not cfg.scan_layers,
+        )
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.scan_layers:
+
+        def body(mdl, carry, _):
+            h, aux_acc = carry
+            out = mdl(h, positions, segment_ids)
+            if with_aux:
+                h, a = out
+                return (h, aux_acc + a), None
+            return (out, aux_acc), None
+
+        (x, aux), _ = nn.scan(
+            body,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            length=cfg.n_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(block_cls(cfg, name="layers"), (x, aux), None)
+    else:
+        for i in range(cfg.n_layers):
+            out = block_cls(cfg, name=f"layer_{i}")(x, positions, segment_ids)
+            if with_aux:
+                x, a = out
+                aux = aux + a
+            else:
+                x = out
+
+    x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+    if cfg.tie_embeddings:
+        logits = embed.attend(x.astype(jnp.float32))
+    else:
+        logits = nn.DenseGeneral(
+            features=cfg.vocab_size,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            name="lm_head",
+        )(x)
+    logits = nn.with_logical_constraint(
+        logits, ("batch", "act_seq", "act_vocab")
+    )
+    return (logits, aux) if with_aux else logits
+
+
 class Llama(nn.Module):
     """Decoder-only Llama-3 LM. Returns logits [B, T, vocab]."""
 
@@ -256,62 +334,6 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, segment_ids=None):
-        cfg = self.cfg
-        if positions is None:
-            positions = jnp.broadcast_to(
-                jnp.arange(tokens.shape[1]), tokens.shape
-            )
-        embed = nn.Embed(
-            cfg.vocab_size,
-            cfg.d_model,
-            dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
-            embedding_init=nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=1.0), ("vocab", "embed")
-            ),
-            name="embed",
-        )
-        x = embed(tokens)
-        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
-
-        block_cls = LlamaBlock
-        if cfg.remat:
-            block_cls = nn.remat(
-                LlamaBlock,
-                policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-                prevent_cse=not cfg.scan_layers,
-            )
-        if cfg.scan_layers:
-            x, _ = nn.scan(
-                lambda mdl, carry, _: (
-                    mdl(carry, positions, segment_ids),
-                    None,
-                ),
-                variable_axes={"params": 0},
-                split_rngs={"params": True},
-                length=cfg.n_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )(block_cls(cfg, name="layers"), x, None)
-        else:
-            for i in range(cfg.n_layers):
-                x = block_cls(cfg, name=f"layer_{i}")(
-                    x, positions, segment_ids
-                )
-
-        x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
-        if cfg.tie_embeddings:
-            logits = embed.attend(x.astype(jnp.float32))
-        else:
-            logits = nn.DenseGeneral(
-                features=cfg.vocab_size,
-                use_bias=False,
-                dtype=jnp.float32,
-                param_dtype=cfg.param_dtype,
-                kernel_init=nn.with_logical_partitioning(
-                    nn.initializers.lecun_normal(), ("embed", "vocab")
-                ),
-                name="lm_head",
-            )(x)
-        return nn.with_logical_constraint(
-            logits, ("batch", "act_seq", "act_vocab")
+        return decoder_lm(
+            self.cfg, LlamaBlock, tokens, positions, segment_ids, False
         )

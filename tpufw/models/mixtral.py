@@ -1,0 +1,237 @@
+"""Mixtral-8x7B MoE family — BASELINE config 5 (expert parallel, stretch).
+
+Sparse mixture-of-experts with top-k routing, built the GSPMD way: routing
+is pure einsum algebra over a capacity-bounded dispatch tensor, expert
+weights carry an ``expert`` logical axis that tpufw.mesh maps onto the
+``expert`` mesh axis, and XLA's partitioner emits the all-to-alls. No
+per-expert Python loops, no send/recv — the dispatch einsum IS the
+communication, which is exactly how expert parallelism should look on an
+ICI-connected TPU mesh (vs. the NCCL alltoall wiring a GPU MoE stack
+hand-rolls; the reference itself has no parallelism at all, SURVEY.md §2c).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpufw.models.llama import (
+    Attention,
+    LlamaConfig,
+    RMSNorm,
+    decoder_lm,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    n_experts: int = 8
+    experts_per_token: int = 2
+    # Per-expert buffer = capacity_factor * (tokens * k / n_experts).
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.02
+    router_z_weight: float = 1e-3
+
+    def n_params(self, include_embed: bool = True) -> int:
+        d, l = self.d_model, self.n_layers
+        attn = l * (
+            d * self.n_heads * self.head_dim
+            + 2 * d * self.n_kv_heads * self.head_dim
+            + self.n_heads * self.head_dim * d
+        )
+        moe = l * (3 * d * self.d_ff * self.n_experts + d * self.n_experts)
+        norms = (2 * l + 1) * d
+        total = attn + moe + norms
+        if include_embed:
+            total += self.vocab_size * d
+            if not self.tie_embeddings:
+                total += d * self.vocab_size
+        return total
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Active-parameter FLOPs: only k experts run per token."""
+        d, l, k = self.d_model, self.n_layers, self.experts_per_token
+        n_active = (
+            l
+            * (
+                d * self.n_heads * self.head_dim
+                + 2 * d * self.n_kv_heads * self.head_dim
+                + self.n_heads * self.head_dim * d
+                + 3 * d * self.d_ff * k
+                + d * self.n_experts
+            )
+            + d * self.vocab_size
+        )
+        attn_score = 6 * l * self.n_heads * self.head_dim * seq_len
+        return 6.0 * n_active + attn_score
+
+
+MIXTRAL_CONFIGS: dict[str, MixtralConfig] = {
+    "mixtral_8x7b": MixtralConfig(
+        vocab_size=32_000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=128,
+        d_ff=14_336,
+        rope_theta=1e6,
+        max_seq_len=32_768,
+        n_experts=8,
+        experts_per_token=2,
+    ),
+    "mixtral_tiny": MixtralConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        d_ff=128,
+        max_seq_len=128,
+        n_experts=4,
+        experts_per_token=2,
+        remat=False,
+    ),
+}
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed SwiGLU experts with capacity-bounded einsum dispatch.
+
+    Returns (y, aux_loss): aux = load-balance loss (Switch-style fraction *
+    probability product) + router z-loss, pre-weighted by the config.
+    """
+
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, t, d = x.shape
+        e, k = cfg.n_experts, cfg.experts_per_token
+        g = b * t
+        capacity = max(int(cfg.capacity_factor * g * k / e), k)
+
+        router_logits = nn.DenseGeneral(
+            features=e,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "expert")
+            ),
+            name="router",
+        )(x.astype(jnp.float32))
+        router_logits = router_logits.reshape(g, e)
+        probs = jax.nn.softmax(router_logits, axis=-1)  # [G, E]
+
+        topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [G, k]
+        topk_probs = topk_probs / jnp.sum(
+            topk_probs, axis=-1, keepdims=True
+        )
+
+        # Priority order: expert slot 0 of every token beats slot 1, and
+        # earlier tokens beat later ones — [k, G, E] cumsum order.
+        mask = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)  # [G, k, E]
+        mask_kge = jnp.transpose(mask, (1, 0, 2)).reshape(k * g, e)
+        pos_flat = jnp.cumsum(mask_kge, axis=0) - mask_kge  # pre-count
+        pos = pos_flat.reshape(k, g, e).transpose(1, 0, 2)  # [G, k, E]
+        within_cap = (pos < capacity) & (mask > 0)
+        slot = jnp.sum(pos * mask, axis=-1)  # [G, k] slot per assignment
+        dispatch = (
+            jax.nn.one_hot(topk_idx, e, dtype=x.dtype)[..., None]
+            * jax.nn.one_hot(slot.astype(jnp.int32), capacity, dtype=x.dtype)[
+                :, :, None, :
+            ]
+            * jnp.any(within_cap, axis=-1, keepdims=True)[..., None].astype(
+                x.dtype
+            )
+        )  # [G, k, E, C]
+        combine = dispatch * topk_probs[..., None, None].astype(x.dtype)
+        dispatch = jnp.sum(dispatch, axis=1)  # [G, E, C]
+        combine = jnp.sum(combine, axis=1)
+
+        xf = x.reshape(g, d)
+        xe = jnp.einsum("gec,gd->ecd", dispatch, xf)  # [E, C, d]
+        xe = nn.with_logical_constraint(xe, ("expert", None, "act_embed"))
+
+        def expert_param(name, shape, names):
+            return self.param(
+                name,
+                nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(), names
+                ),
+                shape,
+                cfg.param_dtype,
+            )
+
+        w_gate = expert_param(
+            "w_gate", (e, d, cfg.d_ff), ("expert", "embed", "expert_mlp")
+        )
+        w_up = expert_param(
+            "w_up", (e, d, cfg.d_ff), ("expert", "embed", "expert_mlp")
+        )
+        w_down = expert_param(
+            "w_down", (e, cfg.d_ff, d), ("expert", "expert_mlp", "embed")
+        )
+        xe = xe.astype(cfg.dtype)
+        h = nn.silu(
+            jnp.einsum("ecd,edf->ecf", xe, w_gate.astype(cfg.dtype))
+        ) * jnp.einsum("ecd,edf->ecf", xe, w_up.astype(cfg.dtype))
+        h = nn.with_logical_constraint(h, ("expert", None, "act_mlp"))
+        out_e = jnp.einsum("ecf,efd->ecd", h, w_down.astype(cfg.dtype))
+        y = jnp.einsum("gec,ecd->gd", combine, out_e).reshape(b, t, d)
+
+        # Switch-transformer load-balance loss over top-1 fractions.
+        top1_mask = mask[:, 0, :]  # [G, E]
+        frac_tokens = jnp.mean(top1_mask, axis=0)
+        frac_probs = jnp.mean(probs, axis=0)
+        aux = e * jnp.sum(frac_tokens * frac_probs)
+        z = jnp.mean(
+            jnp.square(jax.scipy.special.logsumexp(router_logits, axis=-1))
+        )
+        aux_loss = (
+            cfg.router_aux_weight * aux + cfg.router_z_weight * z
+        )
+        return y, aux_loss
+
+
+class MixtralBlock(nn.Module):
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.rms_eps, name="attn_norm")(x), positions, segment_ids
+        )
+        y, aux = MoEMLP(cfg, name="moe")(
+            RMSNorm(cfg.rms_eps, name="moe_norm")(x)
+        )
+        x = nn.with_logical_constraint(
+            x + y, ("batch", "act_seq", "act_embed")
+        )
+        return x, aux
+
+
+class Mixtral(nn.Module):
+    """Decoder-only MoE LM. Returns (logits, aux_loss) when return_aux else
+    logits — train_step adds aux_loss into the objective."""
+
+    cfg: MixtralConfig
+
+    @nn.compact
+    def __call__(
+        self, tokens, positions=None, segment_ids=None, return_aux=True
+    ):
+        cfg = self.cfg
+        logits, aux = decoder_lm(
+            cfg, MixtralBlock, tokens, positions, segment_ids, True
+        )
+        if return_aux:
+            return logits, aux / cfg.n_layers
+        return logits

@@ -1,0 +1,144 @@
+"""ResNet-50 (Flax) — BASELINE config 2: single-TPU-pod image training.
+
+The reference's only workload is ``nvidia-smi`` (reference ``README.md:314``);
+ResNet-50 is the first *real* accelerator workload in the TPU build plan
+(SURVEY.md §7.3 C5, the end of the minimum slice). TPU-first notes: NHWC
+layout (XLA's native conv layout on TPU), bf16 activations with fp32
+batch-norm statistics, and logical axes on conv kernels so fsdp sharding
+works without model edits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    stage_sizes: tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    def flops_per_image(self, image_size: int = 224) -> float:
+        """~4.1 GFLOP forward for 224x224 ResNet-50; x3 for fwd+bwd."""
+        # Scale quadratically with resolution from the canonical 224 number.
+        fwd = 4.1e9 * (image_size / 224) ** 2
+        return 3.0 * fwd
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.cfg
+        conv = partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+                ("conv_h", "conv_w", "conv_in", "conv_out"),
+            ),
+        )
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+        )
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = conv(
+            self.filters, (3, 3), strides=(self.strides, self.strides),
+            name="conv2",
+        )(y)
+        y = norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        # Zero-init the last BN scale: residual branches start as identity,
+        # the standard trick for stable large-batch training.
+        y = norm(name="bn3", scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = conv(
+                self.filters * 4,
+                (1, 1),
+                strides=(self.strides, self.strides),
+                name="proj",
+            )(residual)
+            residual = norm(name="bn_proj")(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    """ResNet-v1.5 bottleneck network. Input NHWC, returns [B, num_classes]."""
+
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        cfg = self.cfg
+        x = images.astype(cfg.dtype)
+        x = nn.Conv(
+            cfg.width,
+            (7, 7),
+            strides=(2, 2),
+            padding=[(3, 3), (3, 3)],
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.variance_scaling(2.0, "fan_out", "normal"),
+                ("conv_h", "conv_w", "conv_in", "conv_out"),
+            ),
+            name="conv_init",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            name="bn_init",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, n_blocks in enumerate(cfg.stage_sizes):
+            for block in range(n_blocks):
+                x = BottleneckBlock(
+                    filters=cfg.width * 2**stage,
+                    strides=2 if block == 0 and stage > 0 else 1,
+                    cfg=cfg,
+                    name=f"stage{stage}_block{block}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(
+            cfg.num_classes,
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            name="head",
+        )(x)
+        return x
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(ResNetConfig(num_classes=num_classes, **kw))

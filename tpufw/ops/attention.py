@@ -57,7 +57,14 @@ def xla_attention(
     v = _repeat_kv(v, h // kh)
 
     scale = 1.0 / math.sqrt(d)
-    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    # fp32 accumulation on the MXU: bf16 logits would already have lost the
+    # precision the fp32 softmax is supposed to protect.
+    logits = (
+        jnp.einsum(
+            "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
     if logits_soft_cap is not None:
         logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
 
@@ -100,6 +107,11 @@ def multi_head_attention(
             segment_ids=segment_ids,
             logits_soft_cap=logits_soft_cap,
         )
+    if backend in ("flash", "ring") and logits_soft_cap is not None:
+        raise NotImplementedError(
+            f"logits_soft_cap is not supported by backend={backend!r}; "
+            "use backend='xla'"
+        )
     if backend == "flash":
         from tpufw.ops.flash import flash_attention
 
@@ -109,5 +121,10 @@ def multi_head_attention(
     if backend == "ring":
         from tpufw.parallel.ring import ring_attention
 
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "ring backend does not take packed segment_ids yet; "
+                "use backend='xla' for packed batches"
+            )
         return ring_attention(q, k, v, causal=causal)
     raise ValueError(f"unknown attention backend {backend!r}")

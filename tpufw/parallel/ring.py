@@ -39,7 +39,12 @@ def _chunk_attn(q, k, v, q_start, k_start, causal, scale, rep):
     """
     k = _repeat_kv(k, rep)
     v = _repeat_kv(v, rep)
-    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    logits = (
+        jnp.einsum(
+            "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
     if causal:
         t, s = q.shape[1], k.shape[1]
         q_pos = q_start + jnp.arange(t)[:, None]

@@ -10,3 +10,10 @@ from tpufw.train.trainer import (  # noqa: F401
 from tpufw.train.metrics import Meter, StepMetrics  # noqa: F401
 from tpufw.train.checkpoint import CheckpointManager  # noqa: F401
 from tpufw.train.data import pack_documents, synthetic_batches  # noqa: F401
+from tpufw.train.vision import (  # noqa: F401
+    VisionTrainer,
+    VisionTrainerConfig,
+    VisionTrainState,
+    synthetic_images,
+    vision_train_step,
+)

@@ -22,6 +22,7 @@ from flax.core import meta
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpufw.mesh import MeshConfig, build_mesh, logical_axis_rules
+from tpufw.parallel.context import use_mesh
 from tpufw.train.metrics import Meter, StepMetrics
 
 
@@ -103,11 +104,16 @@ def train_step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         mask = same_seg if mask is None else mask * same_seg
 
     def loss_fn(params):
-        logits = state.apply_fn(
+        out = state.apply_fn(
             {"params": params}, inputs, segment_ids=seg_in
         )
-        loss, _ = cross_entropy_loss(logits, targets, mask)
-        return loss
+        # MoE models return (logits, aux_loss) — router losses join the
+        # objective here.
+        aux = 0.0
+        if isinstance(out, tuple):
+            out, aux = out
+        loss, _ = cross_entropy_loss(out, targets, mask)
+        return loss + aux
 
     loss, grads = jax.value_and_grad(loss_fn)(state.params)
     new_state = state.apply_gradients(grads)
@@ -163,7 +169,7 @@ class Trainer:
             warmup_steps=trainer_cfg.warmup_steps,
             total_steps=trainer_cfg.total_steps,
         )
-        self._compiled = None
+        self._compiled: dict = {}
         self.state = None
         self.state_sharding = None
 
@@ -183,13 +189,17 @@ class Trainer:
                 tx=self.tx,
             )
 
-        return init_fn, jax.eval_shape(init_fn, rng)
+        # Trace under the mesh context: mesh-aware ops (ring attention)
+        # resolve the current mesh during eval_shape too.
+        with use_mesh(self.mesh):
+            abstract = jax.eval_shape(init_fn, rng)
+        return init_fn, abstract
 
     def init_state(self, seed: int = 0) -> TrainState:
         rng = jax.random.key(seed)
         init_fn, abstract = self._abstract_state(rng)
         self.state_sharding = state_shardings(abstract, self.mesh)
-        with self.mesh:
+        with use_mesh(self.mesh):
             self.state = jax.jit(
                 init_fn, out_shardings=self.state_sharding
             )(rng)
@@ -240,24 +250,21 @@ class Trainer:
     def compiled_step(self, batch: dict | None = None):
         """Jitted train step; batch shardings derived from the batch's own
         structure (every leaf is batch-major: shard dim 0 on data+fsdp)."""
-        key = None if batch is None else tuple(sorted(batch.keys()))
-        if self._compiled is None or self._compiled[0] != key:
+        key = (
+            ("tokens",)
+            if batch is None
+            else tuple(sorted(batch.keys()))
+        )
+        if key not in self._compiled:
             row = NamedSharding(self.mesh, P(("data", "fsdp")))
-            batch_sharding = (
-                {"tokens": row}
-                if batch is None
-                else {k: row for k in batch}
+            batch_sharding = {k: row for k in key}
+            self._compiled[key] = jax.jit(
+                train_step,
+                in_shardings=(self.state_sharding, batch_sharding),
+                out_shardings=(self.state_sharding, None),
+                donate_argnums=(0,),
             )
-            self._compiled = (
-                key,
-                jax.jit(
-                    train_step,
-                    in_shardings=(self.state_sharding, batch_sharding),
-                    out_shardings=(self.state_sharding, None),
-                    donate_argnums=(0,),
-                ),
-            )
-        return self._compiled[1]
+        return self._compiled[key]
 
     def run(
         self,
@@ -281,7 +288,7 @@ class Trainer:
                 save_interval_steps=self.cfg.checkpoint_every,
             )
         history: list[StepMetrics] = []
-        with self.mesh:
+        with use_mesh(self.mesh):
             for i, batch in enumerate(data):
                 if i >= self.cfg.total_steps:
                     break
