@@ -1,0 +1,60 @@
+// Device-plugin protocol core: every kubelet-facing message is built and
+// parsed here (C++/protobuf); transports stay thin. This is the in-repo
+// replacement for the role the GPU Operator's device plugin plays in the
+// reference stack (SURVEY.md §2b X8, reference README.md:268-296).
+#pragma once
+
+#include <cstdint>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "tpuplugin/discovery.h"
+
+namespace tpuplugin {
+
+struct CoreConfig {
+  std::string resource_name = "google.com/tpu";
+  std::string endpoint = "tpufw-tpu.sock";  // under the kubelet plugin dir
+  std::string libtpu_host_path = "/home/kubernetes/bin/libtpu.so";
+  std::string libtpu_container_path = "/lib/libtpu.so";
+  // Physical chips-per-host topology advertised to workloads, e.g. "2,2,1"
+  // (v5e-4 host). Empty = derived as "<n>,1,1".
+  std::string chips_per_host_bounds;
+};
+
+CoreConfig CoreConfigFromEnv();
+
+class PluginCore {
+ public:
+  PluginCore(CoreConfig cfg, DiscoveryConfig disc);
+
+  // Serialized v1beta1.DevicePluginOptions.
+  std::string Options() const;
+  // Serialized v1beta1.RegisterRequest for the kubelet Registration dial.
+  std::string RegisterRequest() const;
+  // Serialized v1beta1.ListAndWatchResponse for current device state.
+  std::string ListAndWatchCurrent();
+  // Re-probe health; bumps generation when device state changed. The
+  // transport polls this to decide when to push a new ListAndWatch frame.
+  uint64_t Generation();
+  bool RefreshNow();
+  // Serialized v1beta1.AllocateResponse for a serialized AllocateRequest.
+  // On parse failure returns empty string and sets *error.
+  std::string Allocate(const std::string& request_bytes, std::string* error);
+  // Serialized v1beta1.PreferredAllocationResponse: prefer NUMA-clustered,
+  // index-contiguous chips (ICI neighbors share low indices on a host).
+  std::string PreferredAllocation(const std::string& request_bytes,
+                                  std::string* error);
+
+  std::vector<TpuDevice> snapshot_devices();
+
+ private:
+  CoreConfig cfg_;
+  DiscoveryConfig disc_;
+  std::mutex mu_;
+  std::vector<TpuDevice> devices_;
+  uint64_t generation_ = 1;
+};
+
+}  // namespace tpuplugin

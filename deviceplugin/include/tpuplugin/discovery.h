@@ -1,0 +1,40 @@
+// TPU chip discovery + health: the L1-equivalent layer of the TPU stack.
+// The reference's analog is the NVIDIA driver + nvidia-smi gate
+// (reference README.md:67-84); here chips surface as /dev/accel* (Google
+// TPU kernel driver) or /dev/vfio/* device nodes, with NUMA affinity read
+// from sysfs. A fake mode (TPUFW_FAKE_DEVICES=N) backs hardware-free tests
+// and kind clusters, per SURVEY.md §4.
+#pragma once
+
+#include <optional>
+#include <string>
+#include <vector>
+
+namespace tpuplugin {
+
+struct TpuDevice {
+  std::string id;        // stable device-plugin ID, e.g. "tpu-0"
+  std::string dev_path;  // host /dev node, e.g. "/dev/accel0"
+  int numa_node = -1;    // -1 = unknown
+  bool healthy = true;
+};
+
+struct DiscoveryConfig {
+  // Primary and fallback glob directories; overridable for tests.
+  std::string dev_dir = "/dev";
+  std::string sysfs_accel = "/sys/class/accel";
+  // TPUFW_FAKE_DEVICES=N wins over real scanning when set.
+  std::optional<int> fake_devices;
+};
+
+DiscoveryConfig ConfigFromEnv();
+
+// Enumerate chips. Order is stable (sorted by index) so device IDs are
+// deterministic across restarts — kubelet allocations reference these IDs.
+std::vector<TpuDevice> Discover(const DiscoveryConfig& cfg);
+
+// Re-check health of previously discovered devices (node still present and
+// openable). Returns true if any device changed state.
+bool RefreshHealth(std::vector<TpuDevice>& devices);
+
+}  // namespace tpuplugin

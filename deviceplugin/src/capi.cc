@@ -1,0 +1,93 @@
+// C ABI for transports (python ctypes shim today; a grpc++ transport when
+// the build image gains one). All buffers are malloc'd here and released
+// via tpuplugin_free.
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "tpuplugin/core.h"
+
+using tpuplugin::ConfigFromEnv;
+using tpuplugin::CoreConfigFromEnv;
+using tpuplugin::PluginCore;
+
+namespace {
+
+PluginCore* g_core = nullptr;
+
+char* CopyOut(const std::string& s, size_t* out_len) {
+  char* buf = static_cast<char*>(std::malloc(s.size() + 1));
+  std::memcpy(buf, s.data(), s.size());
+  buf[s.size()] = '\0';
+  if (out_len) *out_len = s.size();
+  return buf;
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpuplugin_init() {
+  delete g_core;
+  g_core = new PluginCore(CoreConfigFromEnv(), ConfigFromEnv());
+  return static_cast<int>(g_core->snapshot_devices().size());
+}
+
+void tpuplugin_shutdown() {
+  delete g_core;
+  g_core = nullptr;
+}
+
+char* tpuplugin_options(size_t* out_len) {
+  if (!g_core) return nullptr;
+  return CopyOut(g_core->Options(), out_len);
+}
+
+char* tpuplugin_register_request(size_t* out_len) {
+  if (!g_core) return nullptr;
+  return CopyOut(g_core->RegisterRequest(), out_len);
+}
+
+char* tpuplugin_list_and_watch(size_t* out_len) {
+  if (!g_core) return nullptr;
+  return CopyOut(g_core->ListAndWatchCurrent(), out_len);
+}
+
+unsigned long long tpuplugin_generation() {
+  return g_core ? g_core->Generation() : 0;
+}
+
+int tpuplugin_refresh() { return g_core && g_core->RefreshNow() ? 1 : 0; }
+
+// Returns response bytes or nullptr; on error *err_out is a malloc'd
+// message.
+char* tpuplugin_allocate(const char* req, size_t req_len, size_t* out_len,
+                         char** err_out) {
+  if (err_out) *err_out = nullptr;
+  if (!g_core) return nullptr;
+  std::string error;
+  std::string resp = g_core->Allocate(std::string(req, req_len), &error);
+  if (!error.empty()) {
+    if (err_out) *err_out = CopyOut(error, nullptr);
+    return nullptr;
+  }
+  return CopyOut(resp, out_len);
+}
+
+char* tpuplugin_preferred_allocation(const char* req, size_t req_len,
+                                     size_t* out_len, char** err_out) {
+  if (err_out) *err_out = nullptr;
+  if (!g_core) return nullptr;
+  std::string error;
+  std::string resp =
+      g_core->PreferredAllocation(std::string(req, req_len), &error);
+  if (!error.empty()) {
+    if (err_out) *err_out = CopyOut(error, nullptr);
+    return nullptr;
+  }
+  return CopyOut(resp, out_len);
+}
+
+void tpuplugin_free(char* p) { std::free(p); }
+
+}  // extern "C"

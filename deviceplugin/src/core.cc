@@ -1,0 +1,207 @@
+#include "tpuplugin/core.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <map>
+#include <sstream>
+
+#include "deviceplugin.pb.h"
+
+namespace tpuplugin {
+
+CoreConfig CoreConfigFromEnv() {
+  CoreConfig cfg;
+  if (const char* v = std::getenv("TPUFW_RESOURCE_NAME"))
+    cfg.resource_name = v;
+  if (const char* v = std::getenv("TPUFW_PLUGIN_ENDPOINT")) cfg.endpoint = v;
+  if (const char* v = std::getenv("TPUFW_LIBTPU_PATH"))
+    cfg.libtpu_host_path = v;
+  if (const char* v = std::getenv("TPUFW_LIBTPU_CONTAINER_PATH"))
+    cfg.libtpu_container_path = v;
+  if (const char* v = std::getenv("TPUFW_CHIPS_PER_HOST_BOUNDS"))
+    cfg.chips_per_host_bounds = v;
+  return cfg;
+}
+
+// Physical chips-per-host grids for common TPU host shapes; "<n>,1,1"
+// would misdescribe e.g. the 2x2 v5e-4 host and break libtpu mesh setup.
+std::string DefaultHostBounds(size_t n) {
+  switch (n) {
+    case 1: return "1,1,1";
+    case 2: return "1,2,1";
+    case 4: return "2,2,1";
+    case 8: return "2,4,1";
+    case 16: return "4,4,1";
+    default: return std::to_string(n) + ",1,1";
+  }
+}
+
+PluginCore::PluginCore(CoreConfig cfg, DiscoveryConfig disc)
+    : cfg_(std::move(cfg)), disc_(std::move(disc)) {
+  devices_ = Discover(disc_);
+}
+
+std::string PluginCore::Options() const {
+  v1beta1::DevicePluginOptions opts;
+  opts.set_pre_start_required(false);
+  opts.set_get_preferred_allocation_available(true);
+  return opts.SerializeAsString();
+}
+
+std::string PluginCore::RegisterRequest() const {
+  v1beta1::RegisterRequest req;
+  req.set_version("v1beta1");
+  req.set_endpoint(cfg_.endpoint);
+  req.set_resource_name(cfg_.resource_name);
+  req.mutable_options()->set_pre_start_required(false);
+  req.mutable_options()->set_get_preferred_allocation_available(true);
+  return req.SerializeAsString();
+}
+
+std::string PluginCore::ListAndWatchCurrent() {
+  std::lock_guard<std::mutex> lock(mu_);
+  v1beta1::ListAndWatchResponse resp;
+  for (const auto& d : devices_) {
+    auto* dev = resp.add_devices();
+    dev->set_id(d.id);
+    dev->set_health(d.healthy ? "Healthy" : "Unhealthy");
+    if (d.numa_node >= 0) {
+      dev->mutable_topology()->add_nodes()->set_id(d.numa_node);
+    }
+  }
+  return resp.SerializeAsString();
+}
+
+uint64_t PluginCore::Generation() {
+  std::lock_guard<std::mutex> lock(mu_);
+  return generation_;
+}
+
+bool PluginCore::RefreshNow() {
+  std::lock_guard<std::mutex> lock(mu_);
+  // Pick up hot-plugged/removed nodes as well as health flips.
+  auto fresh = Discover(disc_);
+  bool changed = fresh.size() != devices_.size();
+  if (!changed) {
+    for (size_t i = 0; i < fresh.size(); ++i) {
+      if (fresh[i].id != devices_[i].id ||
+          fresh[i].healthy != devices_[i].healthy) {
+        changed = true;
+        break;
+      }
+    }
+  }
+  if (changed) {
+    devices_ = std::move(fresh);
+    ++generation_;
+  }
+  return changed;
+}
+
+std::string PluginCore::Allocate(const std::string& request_bytes,
+                                 std::string* error) {
+  v1beta1::AllocateRequest req;
+  if (!req.ParseFromString(request_bytes)) {
+    *error = "failed to parse AllocateRequest";
+    return "";
+  }
+  std::lock_guard<std::mutex> lock(mu_);
+  std::map<std::string, const TpuDevice*> by_id;
+  for (const auto& d : devices_) by_id[d.id] = &d;
+
+  v1beta1::AllocateResponse resp;
+  for (const auto& creq : req.container_requests()) {
+    auto* cresp = resp.add_container_responses();
+    std::vector<int> chip_indices;
+    for (const auto& id : creq.devices_ids()) {
+      auto it = by_id.find(id);
+      if (it == by_id.end()) {
+        *error = "unknown device id: " + id;
+        return "";
+      }
+      const TpuDevice* d = it->second;
+      auto* spec = cresp->add_devices();
+      spec->set_host_path(d->dev_path);
+      spec->set_container_path(d->dev_path);
+      spec->set_permissions("rw");
+      // "tpu-<N>" -> N
+      chip_indices.push_back(
+          std::atoi(d->id.substr(d->id.rfind('-') + 1).c_str()));
+    }
+    std::sort(chip_indices.begin(), chip_indices.end());
+
+    // libtpu mount — the toolkit-injection analog of the reference's
+    // nvidia runtime hook (README.md:147-154), done the idiomatic
+    // device-plugin way instead of an OCI runtime patch.
+    auto* mount = cresp->add_mounts();
+    mount->set_host_path(cfg_.libtpu_host_path);
+    mount->set_container_path(cfg_.libtpu_container_path);
+    mount->set_read_only(true);
+
+    std::ostringstream chips;
+    for (size_t i = 0; i < chip_indices.size(); ++i) {
+      if (i) chips << ",";
+      chips << chip_indices[i];
+    }
+    auto& envs = *cresp->mutable_envs();
+    envs["TPU_VISIBLE_CHIPS"] = chips.str();
+    envs["TPU_CHIPS_PER_HOST_BOUNDS"] =
+        !cfg_.chips_per_host_bounds.empty()
+            ? cfg_.chips_per_host_bounds
+            : DefaultHostBounds(chip_indices.size());
+    envs["TPU_RUNTIME_METRICS_PORTS"] = "8431";
+    envs["TPUFW_RESOURCE"] = cfg_.resource_name;
+
+    auto& ann = *cresp->mutable_annotations();
+    ann["tpufw.dev/chips"] = chips.str();
+  }
+  return resp.SerializeAsString();
+}
+
+std::string PluginCore::PreferredAllocation(const std::string& request_bytes,
+                                            std::string* error) {
+  v1beta1::PreferredAllocationRequest req;
+  if (!req.ParseFromString(request_bytes)) {
+    *error = "failed to parse PreferredAllocationRequest";
+    return "";
+  }
+  std::lock_guard<std::mutex> lock(mu_);
+  std::map<std::string, const TpuDevice*> by_id;
+  for (const auto& d : devices_) by_id[d.id] = &d;
+
+  v1beta1::PreferredAllocationResponse resp;
+  for (const auto& creq : req.container_requests()) {
+    auto* cresp = resp.add_container_responses();
+    // Sort available by (numa_node, chip index): contiguous chips on one
+    // NUMA node share the densest ICI links.
+    std::vector<std::pair<std::pair<int, int>, std::string>> avail;
+    for (const auto& id : creq.available_deviceids()) {
+      int numa = 0, idx = 0;
+      auto it = by_id.find(id);
+      if (it != by_id.end()) {
+        numa = it->second->numa_node;
+        idx = std::atoi(id.substr(id.rfind('-') + 1).c_str());
+      }
+      avail.push_back({{numa, idx}, id});
+    }
+    std::sort(avail.begin(), avail.end());
+    // must_include first, then best-sorted fill.
+    std::vector<std::string> chosen(creq.must_include_deviceids().begin(),
+                                    creq.must_include_deviceids().end());
+    for (const auto& [key, id] : avail) {
+      if ((int)chosen.size() >= creq.allocation_size()) break;
+      if (std::find(chosen.begin(), chosen.end(), id) == chosen.end()) {
+        chosen.push_back(id);
+      }
+    }
+    for (const auto& id : chosen) cresp->add_deviceids(id);
+  }
+  return resp.SerializeAsString();
+}
+
+std::vector<TpuDevice> PluginCore::snapshot_devices() {
+  std::lock_guard<std::mutex> lock(mu_);
+  return devices_;
+}
+
+}  // namespace tpuplugin

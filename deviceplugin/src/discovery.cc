@@ -1,0 +1,101 @@
+#include "tpuplugin/discovery.h"
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdlib>
+#include <filesystem>
+#include <fstream>
+#include <regex>
+
+namespace tpuplugin {
+
+namespace fs = std::filesystem;
+
+DiscoveryConfig ConfigFromEnv() {
+  DiscoveryConfig cfg;
+  if (const char* d = std::getenv("TPUFW_DEV_DIR")) cfg.dev_dir = d;
+  if (const char* s = std::getenv("TPUFW_SYSFS_ACCEL")) cfg.sysfs_accel = s;
+  if (const char* f = std::getenv("TPUFW_FAKE_DEVICES")) {
+    cfg.fake_devices = std::atoi(f);
+  }
+  return cfg;
+}
+
+static int ReadNumaNode(const std::string& sysfs_accel, int index) {
+  // /sys/class/accel/accel<N>/device/numa_node
+  std::ifstream in(sysfs_accel + "/accel" + std::to_string(index) +
+                   "/device/numa_node");
+  int node = -1;
+  if (in >> node) return node;
+  return -1;
+}
+
+static bool Openable(const std::string& path) {
+  int fd = ::open(path.c_str(), O_RDONLY | O_NONBLOCK | O_CLOEXEC);
+  if (fd >= 0) {
+    ::close(fd);
+    return true;
+  }
+  // EBUSY/EPERM still prove the node exists and the driver answers; only
+  // ENOENT/ENXIO count as gone.
+  return errno != ENOENT && errno != ENXIO;
+}
+
+std::vector<TpuDevice> Discover(const DiscoveryConfig& cfg) {
+  std::vector<TpuDevice> out;
+  if (cfg.fake_devices) {
+    for (int i = 0; i < *cfg.fake_devices; ++i) {
+      out.push_back(TpuDevice{"tpu-" + std::to_string(i),
+                              "/dev/null",  // mountable stand-in
+                              i % 2, true});
+    }
+    return out;
+  }
+  // Primary: TPU kernel driver nodes /dev/accel<N> (also accel_accel<N>
+  // on some driver versions), fallback: /dev/vfio/<N>.
+  std::regex accel_re("^accel(?:_accel)?([0-9]+)$");
+  std::error_code ec;
+  std::vector<std::pair<int, std::string>> found;
+  for (const auto& entry : fs::directory_iterator(cfg.dev_dir, ec)) {
+    std::smatch m;
+    std::string name = entry.path().filename().string();
+    if (std::regex_match(name, m, accel_re)) {
+      found.emplace_back(std::stoi(m[1]), entry.path().string());
+    }
+  }
+  if (found.empty()) {
+    fs::path vfio = fs::path(cfg.dev_dir) / "vfio";
+    for (const auto& entry : fs::directory_iterator(vfio, ec)) {
+      std::string name = entry.path().filename().string();
+      if (std::all_of(name.begin(), name.end(), ::isdigit)) {
+        found.emplace_back(std::stoi(name), entry.path().string());
+      }
+    }
+  }
+  std::sort(found.begin(), found.end());
+  for (const auto& [idx, path] : found) {
+    TpuDevice d;
+    d.id = "tpu-" + std::to_string(idx);
+    d.dev_path = path;
+    d.numa_node = ReadNumaNode(cfg.sysfs_accel, idx);
+    d.healthy = Openable(path);
+    out.push_back(std::move(d));
+  }
+  return out;
+}
+
+bool RefreshHealth(std::vector<TpuDevice>& devices) {
+  bool changed = false;
+  for (auto& d : devices) {
+    bool now = d.dev_path == "/dev/null" ? true : Openable(d.dev_path);
+    if (now != d.healthy) {
+      d.healthy = now;
+      changed = true;
+    }
+  }
+  return changed;
+}
+
+}  // namespace tpuplugin

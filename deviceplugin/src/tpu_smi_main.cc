@@ -1,0 +1,89 @@
+// tpu_smi — chip enumeration + health gate, the TPU-native `nvidia-smi`.
+//
+// The reference makes `nvidia-smi` the layer-1 do-not-proceed gate
+// (reference README.md:81-84: "Do not proceed until nvidia-smi works");
+// tpu_smi carries the same contract: exit 0 with a device table when chips
+// are usable, exit 1 otherwise, so recipe steps can gate on it.
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "tpuplugin/discovery.h"
+
+static bool CheckLibtpu(std::string* path_out) {
+  const char* candidates[] = {
+      std::getenv("TPUFW_LIBTPU_PATH"),
+      "/home/kubernetes/bin/libtpu.so",
+      "/lib/libtpu.so",
+      "/usr/lib/libtpu.so",
+  };
+  for (const char* c : candidates) {
+    if (!c) continue;
+    void* h = dlopen(c, RTLD_LAZY | RTLD_LOCAL);
+    if (h) {
+      *path_out = c;
+      dlclose(h);
+      return true;
+    }
+  }
+  // Also honor a loadable libtpu on the default search path.
+  if (void* h = dlopen("libtpu.so", RTLD_LAZY | RTLD_LOCAL)) {
+    *path_out = "libtpu.so (search path)";
+    dlclose(h);
+    return true;
+  }
+  return false;
+}
+
+int main(int argc, char** argv) {
+  bool allow_none = false;
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--allow-none")) allow_none = true;
+    if (!std::strcmp(argv[i], "--help")) {
+      std::printf(
+          "tpu_smi: enumerate TPU chips and report health.\n"
+          "  exit 0: chips present and healthy (the gate passes)\n"
+          "  exit 1: no chips / unhealthy chips (do not proceed)\n"
+          "  --allow-none  exit 0 even with zero chips (CPU smoke nodes)\n"
+          "env: TPUFW_FAKE_DEVICES=N, TPUFW_DEV_DIR, TPUFW_LIBTPU_PATH\n");
+      return 0;
+    }
+  }
+
+  auto cfg = tpuplugin::ConfigFromEnv();
+  auto devices = tpuplugin::Discover(cfg);
+
+  std::printf("+------------------------ tpufw tpu_smi ------------------------+\n");
+  std::printf("| %-8s | %-16s | %-5s | %-9s |\n", "ID", "DEVICE", "NUMA",
+              "HEALTH");
+  std::printf("|----------+------------------+-------+-----------|\n");
+  int healthy = 0;
+  for (const auto& d : devices) {
+    std::printf("| %-8s | %-16s | %-5d | %-9s |\n", d.id.c_str(),
+                d.dev_path.c_str(), d.numa_node,
+                d.healthy ? "Healthy" : "UNHEALTHY");
+    if (d.healthy) ++healthy;
+  }
+  if (devices.empty()) {
+    std::printf("| %-51s |\n", "no TPU device nodes found");
+  }
+  std::printf("+----------------------------------------------------------------+\n");
+
+  std::string libtpu_path;
+  bool libtpu = CheckLibtpu(&libtpu_path);
+  std::printf("libtpu: %s\n",
+              libtpu ? libtpu_path.c_str() : "NOT FOUND (workloads need it mounted)");
+  std::printf("chips: %d healthy / %zu total%s\n", healthy, devices.size(),
+              cfg.fake_devices ? " (FAKE mode)" : "");
+
+  if (devices.empty() || healthy == 0) {
+    if (allow_none) return 0;
+    std::fprintf(stderr,
+                 "tpu_smi: gate FAILED — do not proceed to the next layer "
+                 "(reference analog: README.md:84)\n");
+    return 1;
+  }
+  return 0;
+}

@@ -1,0 +1,89 @@
+"""Minimal protobuf wire-format encode/parse for tests.
+
+The image's Python protobuf runtime (6.x) rejects gencode from the system
+protoc (3.21), so tests speak raw wire format to the C++ core — which also
+makes the tests an independent check on the C++ serialization.
+"""
+
+from __future__ import annotations
+
+
+def varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def tag(field: int, wire: int) -> bytes:
+    return varint((field << 3) | wire)
+
+
+def ld(field: int, payload: bytes) -> bytes:
+    """Length-delimited field (strings, messages, bytes)."""
+    return tag(field, 2) + varint(len(payload)) + payload
+
+
+def vint(field: int, value: int) -> bytes:
+    return tag(field, 0) + varint(value)
+
+
+def parse(buf: bytes) -> dict[int, list]:
+    """Parse one message level: {field: [int or bytes, ...]}."""
+    out: dict[int, list] = {}
+    i = 0
+    while i < len(buf):
+        key = 0
+        shift = 0
+        while True:
+            b = buf[i]
+            i += 1
+            key |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                val |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+        elif wire == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            val = buf[i : i + ln]
+            i += ln
+        elif wire == 5:
+            val = buf[i : i + 4]
+            i += 4
+        elif wire == 1:
+            val = buf[i : i + 8]
+            i += 8
+        else:
+            raise ValueError(f"wire type {wire} unsupported")
+        out.setdefault(field, []).append(val)
+    return out
+
+
+def parse_map_str(entries: list[bytes]) -> dict[str, str]:
+    """map<string,string> entries -> dict."""
+    out = {}
+    for e in entries:
+        kv = parse(e)
+        out[kv[1][0].decode()] = kv[2][0].decode()
+    return out

@@ -1,0 +1,76 @@
+"""Cluster bootstrap resolution tests (pure env-dict logic, no network)."""
+
+import pytest
+
+from tpufw.cluster import ClusterConfig, initialize_cluster, resolve_cluster_env
+
+
+def test_single_process_default():
+    cfg = resolve_cluster_env({})
+    assert not cfg.is_distributed
+    assert cfg.num_processes == 1 and cfg.process_id == 0
+    # initialize is a no-op single-process.
+    out = initialize_cluster(cfg)
+    assert out is cfg
+
+
+def test_explicit_env_wins():
+    cfg = resolve_cluster_env(
+        {
+            "TPUFW_COORDINATOR": "10.0.0.1:8476",
+            "TPUFW_NUM_PROCESSES": "4",
+            "TPUFW_PROCESS_ID": "2",
+            "JOBSET_NAME": "ignored",
+            "JOB_COMPLETION_INDEX": "9",
+        }
+    )
+    assert cfg.source == "explicit"
+    assert cfg.coordinator_address == "10.0.0.1:8476"
+    assert cfg.num_processes == 4 and cfg.process_id == 2
+
+
+def test_jobset_env():
+    cfg = resolve_cluster_env(
+        {
+            "JOBSET_NAME": "llama16",
+            "REPLICATED_JOB_NAME": "workers",
+            "JOB_COMPLETION_INDEX": "3",
+            "TPUFW_WORKERS_PER_SLICE": "4",
+        }
+    )
+    assert cfg.source == "jobset"
+    assert cfg.coordinator_address == "llama16-workers-0-0.llama16:8476"
+    assert cfg.num_processes == 4 and cfg.process_id == 3
+    assert cfg.is_distributed
+
+
+def test_jobset_env_with_svc_override():
+    cfg = resolve_cluster_env(
+        {
+            "JOBSET_NAME": "j",
+            "JOB_COMPLETION_INDEX": "0",
+            "TPUFW_WORKERS_PER_SLICE": "2",
+            "TPUFW_COORDINATOR_SVC": "coord.default.svc",
+            "TPUFW_COORDINATOR_PORT": "9000",
+        }
+    )
+    assert cfg.coordinator_address == "coord.default.svc:9000"
+
+
+def test_gke_tpu_env():
+    cfg = resolve_cluster_env(
+        {
+            "TPU_WORKER_ID": "1",
+            "TPU_WORKER_HOSTNAMES": "host-0,host-1,host-2,host-3",
+        }
+    )
+    assert cfg.source == "gke_tpu"
+    assert cfg.coordinator_address == "host-0:8476"
+    assert cfg.num_processes == 4 and cfg.process_id == 1
+
+
+def test_bad_process_id_rejected():
+    with pytest.raises(ValueError):
+        initialize_cluster(
+            ClusterConfig("x:1", num_processes=2, process_id=5)
+        )

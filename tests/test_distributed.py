@@ -1,0 +1,53 @@
+"""Two-process jax.distributed integration: bootstrap + cross-process psum.
+
+This is the SURVEY.md §4 multi-process tier: real jax.distributed.initialize
+over localhost, CPU backend, one device per process.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_psum():
+    port = _free_port()
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "distributed_worker.py")
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            {
+                "TPUFW_COORDINATOR": f"127.0.0.1:{port}",
+                "TPUFW_NUM_PROCESSES": "2",
+                "TPUFW_PROCESS_ID": str(pid),
+                # Fresh XLA flags per process (conftest set 8 devices here).
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            }
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, worker],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                cwd=root,
+            )
+        )
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=150)
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout={out}\nstderr={err}"
+        assert "PSUM_OK:" in out, out

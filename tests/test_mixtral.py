@@ -79,3 +79,40 @@ def test_mixtral_trains_on_expert_mesh(devices8):
     assert len(hist) == 3
     assert np.isfinite(hist[-1].loss)
     assert hist[-1].loss < hist[0].loss + 1.0
+
+
+def test_moe_pads_do_not_consume_capacity():
+    """With tight capacity, invalid (pad) tokens must not evict real ones:
+    the valid rows' outputs must match a pad-free run."""
+    import jax.numpy as jnp
+
+    cfg = MixtralConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=2, n_kv_heads=1,
+        head_dim=16, d_ff=64, n_experts=4, experts_per_token=2,
+        capacity_factor=1.0, remat=False,
+    )
+    layer = MoEMLP(cfg)
+    x_real = jax.random.normal(jax.random.key(0), (1, 8, cfg.d_model))
+    pad = jnp.zeros((1, 8, cfg.d_model))
+    x_padded = jnp.concatenate([x_real, pad], axis=1)  # [1, 16, d]
+    valid = jnp.concatenate(
+        [jnp.ones((1, 8), bool), jnp.zeros((1, 8), bool)], axis=1
+    )
+    # Same g (16) and therefore same capacity in both layouts; only the
+    # *position* of the pads changes. If pads consumed capacity, the
+    # pads-first layout would evict the (later) real tokens.
+    x_first = jnp.concatenate([pad, x_real], axis=1)
+    valid_first = jnp.concatenate(
+        [jnp.zeros((1, 8), bool), jnp.ones((1, 8), bool)], axis=1
+    )
+    params = layer.init(jax.random.key(1), x_padded, valid=valid)
+    y_last, _ = layer.apply(params, x_padded, valid=valid)
+    y_first, _ = layer.apply(params, x_first, valid=valid_first)
+    np.testing.assert_allclose(
+        np.asarray(y_last[:, :8]),
+        np.asarray(y_first[:, 8:]),
+        atol=2e-5,
+        rtol=2e-5,
+    )
+    # And real tokens actually flow through experts (not all dropped).
+    assert float(jnp.abs(y_first[:, 8:]).sum()) > 0

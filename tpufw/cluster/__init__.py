@@ -1,0 +1,5 @@
+from tpufw.cluster.bootstrap import (  # noqa: F401
+    ClusterConfig,
+    initialize_cluster,
+    resolve_cluster_env,
+)

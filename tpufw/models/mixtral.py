@@ -109,7 +109,10 @@ class MoEMLP(nn.Module):
     cfg: MixtralConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, valid=None):
+        """x: [B,T,d]; valid: optional [B,T] bool — False rows (padding in
+        packed batches) are excluded from routing, capacity, and the aux
+        statistics so pads can't evict real tokens from experts."""
         cfg = self.cfg
         b, t, d = x.shape
         e, k = cfg.n_experts, cfg.experts_per_token
@@ -134,9 +137,17 @@ class MoEMLP(nn.Module):
             topk_probs, axis=-1, keepdims=True
         )
 
+        validf = (
+            None
+            if valid is None
+            else valid.reshape(g).astype(jnp.float32)
+        )
+
         # Priority order: expert slot 0 of every token beats slot 1, and
         # earlier tokens beat later ones — [k, G, E] cumsum order.
         mask = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)  # [G, k, E]
+        if validf is not None:
+            mask = mask * validf[:, None, None]
         mask_kge = jnp.transpose(mask, (1, 0, 2)).reshape(k * g, e)
         pos_flat = jnp.cumsum(mask_kge, axis=0) - mask_kge  # pre-count
         pos = pos_flat.reshape(k, g, e).transpose(1, 0, 2)  # [G, k, E]
@@ -151,6 +162,8 @@ class MoEMLP(nn.Module):
                 x.dtype
             )
         )  # [G, k, E, C]
+        if validf is not None:
+            dispatch = dispatch * validf[:, None, None, None].astype(x.dtype)
         combine = dispatch * topk_probs[..., None, None].astype(x.dtype)
         dispatch = jnp.sum(dispatch, axis=1)  # [G, E, C]
         combine = jnp.sum(combine, axis=1)
@@ -186,14 +199,34 @@ class MoEMLP(nn.Module):
         out_e = jnp.einsum("ecf,efd->ecd", h, w_down.astype(cfg.dtype))
         y = jnp.einsum("gec,ecd->gd", combine, out_e).reshape(b, t, d)
 
-        # Switch-transformer load-balance loss over top-1 fractions.
-        top1_mask = mask[:, 0, :]  # [G, E]
-        frac_tokens = jnp.mean(top1_mask, axis=0)
-        frac_probs = jnp.mean(probs, axis=0)
+        # Switch-transformer load-balance loss over top-1 fractions,
+        # computed over valid tokens only.
+        top1_mask = mask[:, 0, :]  # [G, E] (already zeroed on invalid)
+        if validf is None:
+            n_valid = float(g)
+            frac_tokens = jnp.sum(top1_mask, axis=0) / n_valid
+            frac_probs = jnp.mean(probs, axis=0)
+            z = jnp.mean(
+                jnp.square(
+                    jax.scipy.special.logsumexp(router_logits, axis=-1)
+                )
+            )
+        else:
+            n_valid = jnp.maximum(jnp.sum(validf), 1.0)
+            frac_tokens = jnp.sum(top1_mask, axis=0) / n_valid
+            frac_probs = (
+                jnp.sum(probs * validf[:, None], axis=0) / n_valid
+            )
+            z = (
+                jnp.sum(
+                    jnp.square(
+                        jax.scipy.special.logsumexp(router_logits, axis=-1)
+                    )
+                    * validf
+                )
+                / n_valid
+            )
         aux = e * jnp.sum(frac_tokens * frac_probs)
-        z = jnp.mean(
-            jnp.square(jax.scipy.special.logsumexp(router_logits, axis=-1))
-        )
         aux_loss = (
             cfg.router_aux_weight * aux + cfg.router_z_weight * z
         )
@@ -210,7 +243,8 @@ class MixtralBlock(nn.Module):
             RMSNorm(cfg.rms_eps, name="attn_norm")(x), positions, segment_ids
         )
         y, aux = MoEMLP(cfg, name="moe")(
-            RMSNorm(cfg.rms_eps, name="moe_norm")(x)
+            RMSNorm(cfg.rms_eps, name="moe_norm")(x),
+            valid=None if segment_ids is None else segment_ids > 0,
         )
         x = nn.with_logical_constraint(
             x + y, ("batch", "act_seq", "act_embed")

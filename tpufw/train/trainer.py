@@ -99,9 +99,12 @@ def train_step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
     mask = None if mask is None else mask[:, 1:].astype(jnp.float32)
     if seg is not None:
         # Don't train boundary positions to predict the next document's
-        # first token — attention (correctly) can't see across segments.
+        # first token — attention (correctly) can't see across segments —
+        # and never train on padding targets (segment 0).
         same_seg = (seg[:, :-1] == seg[:, 1:]).astype(jnp.float32)
-        mask = same_seg if mask is None else mask * same_seg
+        nonpad = (seg[:, 1:] > 0).astype(jnp.float32)
+        seg_mask = same_seg * nonpad
+        mask = seg_mask if mask is None else mask * seg_mask
 
     def loss_fn(params):
         out = state.apply_fn(
