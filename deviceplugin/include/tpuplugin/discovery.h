@@ -33,8 +33,4 @@ DiscoveryConfig ConfigFromEnv();
 // deterministic across restarts — kubelet allocations reference these IDs.
 std::vector<TpuDevice> Discover(const DiscoveryConfig& cfg);
 
-// Re-check health of previously discovered devices (node still present and
-// openable). Returns true if any device changed state.
-bool RefreshHealth(std::vector<TpuDevice>& devices);
-
 }  // namespace tpuplugin

@@ -145,10 +145,13 @@ std::string PluginCore::Allocate(const std::string& request_bytes,
     }
     auto& envs = *cresp->mutable_envs();
     envs["TPU_VISIBLE_CHIPS"] = chips.str();
+    // Bounds describe the HOST's chip grid, not this allocation: a container
+    // allocated chips {0,2} of a 4-chip host must still see the 2x2 grid or
+    // chip index 2 is out of range for libtpu's mesh setup.
     envs["TPU_CHIPS_PER_HOST_BOUNDS"] =
         !cfg_.chips_per_host_bounds.empty()
             ? cfg_.chips_per_host_bounds
-            : DefaultHostBounds(chip_indices.size());
+            : DefaultHostBounds(devices_.size());
     envs["TPU_RUNTIME_METRICS_PORTS"] = "8431";
     envs["TPUFW_RESOURCE"] = cfg_.resource_name;
 

@@ -86,16 +86,4 @@ std::vector<TpuDevice> Discover(const DiscoveryConfig& cfg) {
   return out;
 }
 
-bool RefreshHealth(std::vector<TpuDevice>& devices) {
-  bool changed = false;
-  for (auto& d : devices) {
-    bool now = d.dev_path == "/dev/null" ? true : Openable(d.dev_path);
-    if (now != d.healthy) {
-      d.healthy = now;
-      changed = true;
-    }
-  }
-  return changed;
-}
-
 }  // namespace tpuplugin

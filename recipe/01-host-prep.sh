@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Step 1 — L0 Host OS preparation.
+#
+# TPU retarget of reference README.md:13-56 (SURVEY.md R2): disable swap
+# (kubelet requirement), persist the overlay + br_netfilter kernel modules,
+# and set the bridge/ip-forward sysctls the CNI needs. This layer is
+# accelerator-agnostic and carries over unchanged (SURVEY.md §2b X1).
+#
+# Gate: swap reports 0 and both sysctls read 1.
+
+source "$(dirname "$0")/lib.sh"
+require_root
+
+log "updating base system"
+apt-get update -y
+apt-get upgrade -y
+
+log "disabling swap (kubelet refuses to start with swap on)"
+swapoff -a
+# Comment out swap entries so the setting survives reboot.
+sed -ri 's@^([^#].*\sswap\s.*)$@# \1@' /etc/fstab
+
+log "persisting kernel modules: overlay (container image FS), br_netfilter (bridged pod traffic through iptables)"
+cat <<'EOF' >/etc/modules-load.d/k8s.conf
+overlay
+br_netfilter
+EOF
+modprobe overlay
+modprobe br_netfilter
+
+log "persisting sysctls for CNI bridge traffic + forwarding"
+cat <<'EOF' >/etc/sysctl.d/k8s.conf
+net.bridge.bridge-nf-call-iptables  = 1
+net.bridge.bridge-nf-call-ip6tables = 1
+net.ipv4.ip_forward                 = 1
+EOF
+sysctl --system >/dev/null
+
+swap_off() { [ "$(swapon --show | wc -l)" -eq 0 ]; }
+sysctls_ok() {
+  [ "$(sysctl -n net.bridge.bridge-nf-call-iptables)" = 1 ] &&
+    [ "$(sysctl -n net.ipv4.ip_forward)" = 1 ]
+}
+
+gate "swap disabled" swap_off
+gate "bridge + forward sysctls active" sysctls_ok
+log "host prep complete — proceed to 02-tpu-runtime.sh"

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Step 2 — L1 Accelerator runtime (the nvidia-driver-535 analog).
+#
+# TPU retarget of reference README.md:60-84 (SURVEY.md R3, X2). NVIDIA needs
+# a kernel driver install plus a mandatory reboot; Cloud TPU VMs ship the
+# accelerator exposed as /dev/accel* (or /dev/vfio/*) with the runtime
+# userland in libtpu.so — there is no reboot, but the reference's hard
+# sequencing rule is preserved: the health gate below is the `nvidia-smi`
+# equivalent and later layers must not be attempted until it passes.
+#
+# Gate: tpu_smi (C++ chip-enumeration tool, deviceplugin/tools) finds >=1
+# chip, or — before the tool is built — raw device nodes + libtpu exist.
+
+source "$(dirname "$0")/lib.sh"
+
+LIBTPU_PATHS=(/lib/libtpu.so /usr/lib/libtpu.so /usr/local/lib/libtpu.so)
+TPU_SMI="${TPU_SMI:-$(dirname "$0")/../deviceplugin/build/tpu_smi}"
+
+libtpu_present() {
+  local p
+  for p in "${LIBTPU_PATHS[@]}"; do [ -e "$p" ] && return 0; done
+  python3 -c 'import importlib.util,sys; sys.exit(0 if importlib.util.find_spec("libtpu") else 1)' 2>/dev/null
+}
+
+device_nodes_present() {
+  compgen -G '/dev/accel*' >/dev/null || compgen -G '/dev/vfio/*' >/dev/null
+}
+
+log "checking for the TPU runtime userland (libtpu)"
+if ! libtpu_present; then
+  log "libtpu not found — on a GCE TPU VM it is preinstalled; elsewhere install the libtpu wheel into the system python"
+fi
+
+if [ -x "$TPU_SMI" ]; then
+  log "running tpu_smi health gate"
+  gate "tpu_smi enumerates >=1 TPU chip" "$TPU_SMI" --require-chips 1
+else
+  log "tpu_smi not built (cmake -B build -G Ninja && ninja -C build in deviceplugin/); falling back to device-node check"
+  gate "TPU device nodes present (/dev/accel* or /dev/vfio/*)" device_nodes_present
+fi
+
+log "TPU runtime healthy — proceed to 03-containerd.sh"

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Step 3 — L2 Container runtime.
+#
+# TPU retarget of reference README.md:88-155 (SURVEY.md R4-R6, X3-X4).
+# containerd install + SystemdCgroup flip are identical to the reference.
+# The NVIDIA Container Toolkit / `nvidia-ctk runtime configure` step has NO
+# TPU analog and is deliberately absent: TPU containers need no special OCI
+# runtime — device nodes, libtpu mounts, and TPU env vars are injected by
+# the device plugin's Allocate response (deviceplugin/, SURVEY.md §2b X4),
+# which is the idiomatic Kubernetes mechanism.
+#
+# Gate: containerd active and config has SystemdCgroup = true.
+
+source "$(dirname "$0")/lib.sh"
+require_root
+
+log "installing containerd"
+apt-get update -y
+apt-get install -y containerd apt-transport-https ca-certificates curl gpg
+
+log "generating default config with SystemdCgroup = true"
+mkdir -p /etc/containerd
+containerd config default >/etc/containerd/config.toml
+sed -i 's/SystemdCgroup = false/SystemdCgroup = true/' /etc/containerd/config.toml
+
+systemctl enable containerd
+systemctl restart containerd
+
+containerd_active() { systemctl is-active --quiet containerd; }
+cgroup_flag_set() { grep -q 'SystemdCgroup = true' /etc/containerd/config.toml; }
+
+gate "containerd service active" containerd_active
+gate "SystemdCgroup = true" cgroup_flag_set
+containerd --version
+log "container runtime ready — proceed to 04-kubernetes-packages.sh"

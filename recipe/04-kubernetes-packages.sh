@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Step 4 — L3 Kubernetes node agents.
+#
+# TPU retarget of reference README.md:159-188 (SURVEY.md R7, X5): pinned
+# v1.34 pkgs.k8s.io repo with GPG signing key, kubelet/kubeadm/kubectl
+# install, apt-mark hold so unattended upgrades cannot skew the cluster
+# version, kubelet enabled.
+#
+# Gate: all three binaries resolve and kubelet is enabled.
+
+source "$(dirname "$0")/lib.sh"
+require_root
+
+K8S_CHANNEL="${K8S_CHANNEL:-v1.34}"
+
+log "adding pinned Kubernetes apt repo ($K8S_CHANNEL)"
+mkdir -p /etc/apt/keyrings
+curl -fsSL "https://pkgs.k8s.io/core:/stable:/$K8S_CHANNEL/deb/Release.key" |
+  gpg --dearmor -o /etc/apt/keyrings/kubernetes-apt-keyring.gpg
+cat <<EOF >/etc/apt/sources.list.d/kubernetes.list
+deb [signed-by=/etc/apt/keyrings/kubernetes-apt-keyring.gpg] https://pkgs.k8s.io/core:/stable:/$K8S_CHANNEL/deb/ /
+EOF
+
+apt-get update -y
+apt-get install -y kubelet kubeadm kubectl
+apt-mark hold kubelet kubeadm kubectl
+
+systemctl enable kubelet
+
+binaries_ok() { command -v kubelet && command -v kubeadm && command -v kubectl; } >/dev/null
+kubelet_enabled() { systemctl is-enabled --quiet kubelet; }
+
+gate "kubelet/kubeadm/kubectl installed" binaries_ok
+gate "kubelet service enabled" kubelet_enabled
+kubeadm version -o short
+log "node agents ready — proceed to 05-cluster-init.sh"

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Step 5 — L4 Control plane init.
+#
+# TPU retarget of reference README.md:191-222 (SURVEY.md R8): kubeadm init
+# with the pod CIDR chosen to match Flannel's default, then the admin
+# kubeconfig copied for the invoking user. As in the reference, the node
+# reporting NotReady at this point is EXPECTED — the CNI lands in step 6.
+#
+# Gate: API server answers `kubectl get nodes` (NotReady is a pass here).
+
+source "$(dirname "$0")/lib.sh"
+require_root
+
+POD_CIDR="${POD_CIDR:-10.244.0.0/16}" # Flannel default
+
+log "initializing control plane (pod CIDR $POD_CIDR)"
+kubeadm init --pod-network-cidr="$POD_CIDR"
+
+TARGET_USER="${SUDO_USER:-root}"
+TARGET_HOME="$(getent passwd "$TARGET_USER" | cut -d: -f6)"
+log "installing kubeconfig for $TARGET_USER"
+mkdir -p "$TARGET_HOME/.kube"
+cp -i /etc/kubernetes/admin.conf "$TARGET_HOME/.kube/config"
+chown "$(id -u "$TARGET_USER")":"$(id -g "$TARGET_USER")" "$TARGET_HOME/.kube/config"
+
+api_answers() { KUBECONFIG=/etc/kubernetes/admin.conf kubectl get nodes >/dev/null; }
+
+retry_gate "API server reachable" 12 5 api_answers
+log "NOTE: node will report NotReady until the CNI is installed — that is expected"
+log "single-host TPU training needs no other nodes; for a multi-host slice"
+log "run the printed 'kubeadm join' on each worker VM of the slice first"
+log "control plane up — proceed to 06-cni-flannel.sh"

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Step 6 — L5 Pod networking (CNI).
+#
+# TPU retarget of reference README.md:225-243 (SURVEY.md R9, X6): apply the
+# upstream Flannel manifest, wait for its pods, then for node Ready. For the
+# TPU build this network additionally carries the multi-host DCN bootstrap:
+# `jax.distributed.initialize` worker->coordinator dials ride pod networking
+# (tpufw/cluster/bootstrap.py); ICI collectives never touch it.
+#
+# Gate: flannel pods Running, then every node Ready.
+
+source "$(dirname "$0")/lib.sh"
+
+FLANNEL_URL="${FLANNEL_URL:-https://github.com/flannel-io/flannel/releases/latest/download/kube-flannel.yml}"
+
+log "applying Flannel CNI"
+kubectl apply -f "$FLANNEL_URL"
+
+flannel_running() {
+  local want got
+  want=$(kubectl get pods -n kube-flannel --no-headers 2>/dev/null | wc -l)
+  got=$(kubectl get pods -n kube-flannel --no-headers 2>/dev/null | grep -c ' Running ' || true)
+  [ "$want" -gt 0 ] && [ "$got" -eq "$want" ]
+}
+nodes_ready() {
+  ! kubectl get nodes --no-headers | awk '{print $2}' | grep -qv '^Ready$'
+}
+
+retry_gate "flannel pods Running" 30 5 flannel_running
+retry_gate "all nodes Ready" 30 5 nodes_ready
+log "pod networking up — proceed to 07-tpu-stack.sh"

@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Step 7 — L6 Accelerator enablement (the GPU Operator analog).
+#
+# TPU retarget of reference README.md:247-272 (SURVEY.md R10, X7-X8): Helm
+# install of our in-repo `tpu-stack` chart, which deploys the C++
+# `google.com/tpu` kubelet device plugin DaemonSet (deviceplugin/) plus a
+# validator Job. `--set libtpu.hostInstalled=true` is the exact analog of
+# the reference's `--set driver.enabled=false` — tell the stack the
+# accelerator runtime pre-exists on the host rather than installing it.
+#
+# Gate: stack pods converged AND the node advertises allocatable
+# google.com/tpu (the reference's README.md:292-296 pattern).
+
+source "$(dirname "$0")/lib.sh"
+
+CHART_DIR="$(dirname "$0")/../deploy/charts/tpu-stack"
+NAMESPACE="${NAMESPACE:-tpu-stack}"
+
+if ! command -v helm >/dev/null; then
+  log "installing helm"
+  curl -fsSL https://raw.githubusercontent.com/helm/helm/main/scripts/get-helm-3 | bash
+fi
+
+log "installing tpu-stack chart (libtpu.hostInstalled=true: runtime pre-exists on host)"
+helm upgrade --install tpu-stack "$CHART_DIR" \
+  --namespace "$NAMESPACE" --create-namespace \
+  --set libtpu.hostInstalled=true
+
+stack_converged() {
+  local want got
+  want=$(kubectl get pods -n "$NAMESPACE" --no-headers 2>/dev/null | grep -cv Completed || true)
+  got=$(kubectl get pods -n "$NAMESPACE" --no-headers 2>/dev/null | grep -c ' Running ' || true)
+  [ "$want" -gt 0 ] && [ "$got" -eq "$want" ]
+}
+tpu_allocatable() {
+  kubectl get nodes -o jsonpath='{range .items[*]}{.status.allocatable.google\.com/tpu}{"\n"}{end}' |
+    grep -q '[1-9]'
+}
+
+retry_gate "tpu-stack pods Running" 30 5 stack_converged
+retry_gate "node advertises allocatable google.com/tpu" 30 5 tpu_allocatable
+kubectl describe nodes | grep -A1 'google.com/tpu' | head -4 || true
+log "TPU schedulable — proceed to 08-verify-workload.sh"

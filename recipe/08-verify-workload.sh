@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Step 8 — L7 Workload verification (end-to-end gate).
+#
+# TPU retarget of reference README.md:276-335 (SURVEY.md R11-R12): apply the
+# smoke-test Pod (deploy/manifests/01-smoke-matmul.yaml — requests
+# google.com/tpu: 1 and runs the tpufw smoke workload), wait for it, and
+# read the logs back. Success criterion: `jax.devices()` lists TPU cores in
+# the pod logs — the `nvidia-smi`-table-in-logs analog.
+#
+# Gate: pod Succeeded and logs contain "TpuDevice".
+
+source "$(dirname "$0")/lib.sh"
+
+MANIFEST="${MANIFEST:-$(dirname "$0")/../deploy/manifests/02-smoke-tpu.yaml}"
+POD="${POD:-tpufw-smoke-tpu}"
+
+log "applying end-to-end smoke pod ($MANIFEST)"
+kubectl apply -f "$MANIFEST"
+
+pod_done() {
+  [ "$(kubectl get pod "$POD" -o jsonpath='{.status.phase}' 2>/dev/null)" = Succeeded ]
+}
+logs_prove_device() {
+  kubectl logs "$POD" | grep -Eq 'TpuDevice|TPU v'
+}
+
+retry_gate "smoke pod Succeeded" 40 5 pod_done
+gate "pod logs list TPU devices" logs_prove_device
+log "--- pod logs ---"
+kubectl logs "$POD"
+log "END-TO-END VERIFIED: kubectl apply -> scheduled on google.com/tpu -> device proof in logs"
+log "next: apply deploy/manifests/03-resnet50-v5e1.yaml (single-chip training)"
+log "      or deploy/manifests/05-llama3-8b-v5e16-jobset.yaml (multi-host)"

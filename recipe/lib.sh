@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Shared helpers for the layered recipe scripts.
+#
+# The reference's defining pattern (SURVEY.md §3.4) is "every layer has an
+# observable gate before the next layer is attempted", with hard sequencing
+# rules ("Do not proceed until nvidia-smi works", reference README.md:84).
+# `gate` is that pattern as code: it runs a check command, prints PASS/FAIL,
+# and a FAIL aborts the script so the next layer cannot be attempted.
+
+set -euo pipefail
+
+log() { printf '\033[1;34m[recipe]\033[0m %s\n' "$*"; }
+
+die() {
+  printf '\033[1;31m[recipe] FATAL:\033[0m %s\n' "$*" >&2
+  exit 1
+}
+
+require_root() {
+  [ "$(id -u)" -eq 0 ] || die "this step must run as root (sudo $0)"
+}
+
+# gate NAME CMD... — run CMD; on success print "GATE PASS: NAME", on failure
+# print the do-not-proceed banner and exit nonzero.
+gate() {
+  local name="$1"
+  shift
+  if "$@"; then
+    printf '\033[1;32m[recipe] GATE PASS:\033[0m %s\n' "$name"
+  else
+    printf '\033[1;31m[recipe] GATE FAIL:\033[0m %s\n' "$name" >&2
+    printf '\033[1;31m[recipe] Do not proceed to the next step until this gate passes.\033[0m\n' >&2
+    printf '[recipe] See recipe/TROUBLESHOOTING.md\n' >&2
+    exit 1
+  fi
+}
+
+# retry_gate NAME TRIES SLEEP_S CMD... — poll CMD (for gates that converge,
+# e.g. node NotReady -> Ready, the reference's README.md:218-243 pattern).
+retry_gate() {
+  local name="$1" tries="$2" sleep_s="$3"
+  shift 3
+  local i
+  for ((i = 1; i <= tries; i++)); do
+    if "$@"; then
+      printf '\033[1;32m[recipe] GATE PASS:\033[0m %s (attempt %d)\n' "$name" "$i"
+      return 0
+    fi
+    log "gate '$name' not ready (attempt $i/$tries); sleeping ${sleep_s}s"
+    sleep "$sleep_s"
+  done
+  gate "$name" false # reuse the FAIL banner
+}

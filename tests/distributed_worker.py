@@ -31,7 +31,6 @@ def main():
 
     devices = jax.devices()  # global devices across processes
     mesh = Mesh(devices, ("data",))
-    x = jnp.ones((len(devices), 4)) * (cfg.process_id + 1)
 
     # Each process contributes its local shard; the jitted sum needs a
     # cross-process collective to produce the global total.

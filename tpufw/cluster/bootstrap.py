@@ -49,9 +49,18 @@ def resolve_cluster_env(
     env = os.environ if env is None else env
 
     if "TPUFW_COORDINATOR" in env:
+        if "TPUFW_NUM_PROCESSES" not in env:
+            # Same silent-gang-split hazard as the JobSet branch below: a
+            # coordinator with a defaulted process count of 1 would no-op
+            # the distributed init on every pod. Fail loudly instead.
+            raise ValueError(
+                "TPUFW_COORDINATOR is set but TPUFW_NUM_PROCESSES is "
+                "missing — set it to the gang size (and TPUFW_PROCESS_ID "
+                "per worker)"
+            )
         return ClusterConfig(
             coordinator_address=env["TPUFW_COORDINATOR"],
-            num_processes=int(env.get("TPUFW_NUM_PROCESSES", "1")),
+            num_processes=int(env["TPUFW_NUM_PROCESSES"]),
             process_id=int(env.get("TPUFW_PROCESS_ID", "0")),
             source="explicit",
         )
@@ -119,6 +128,8 @@ def initialize_cluster(
     config = config or resolve_cluster_env()
     if not config.is_distributed:
         return config
+    if jax.distributed.is_initialized():
+        return config
     if config.process_id >= config.num_processes or config.process_id < 0:
         raise ValueError(
             f"process_id {config.process_id} out of range for "
@@ -137,7 +148,10 @@ def initialize_cluster(
             )
             return config
         except RuntimeError as e:
-            if "already initialized" in str(e).lower():
+            msg = str(e).lower()
+            # jax has raised both "already initialized" and "should only be
+            # called once" for a repeat initialize across versions.
+            if "already initialized" in msg or "called once" in msg:
                 return config
             last_err = e
             time.sleep(min(5.0, max(0.5, deadline - time.monotonic())))
