@@ -1,0 +1,10 @@
+{{- define "tpu-stack.labels" -}}
+app.kubernetes.io/name: {{ .Chart.Name }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end }}
+
+{{- define "tpu-stack.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag }}
+{{- end }}

@@ -39,16 +39,28 @@ static bool CheckLibtpu(std::string* path_out) {
 
 int main(int argc, char** argv) {
   bool allow_none = false;
+  int require_chips = 1;
   for (int i = 1; i < argc; ++i) {
-    if (!std::strcmp(argv[i], "--allow-none")) allow_none = true;
-    if (!std::strcmp(argv[i], "--help")) {
+    if (!std::strcmp(argv[i], "--allow-none")) {
+      allow_none = true;
+    } else if (!std::strncmp(argv[i], "--require-chips=", 16)) {
+      require_chips = std::atoi(argv[i] + 16);
+    } else if (!std::strcmp(argv[i], "--require-chips") && i + 1 < argc) {
+      require_chips = std::atoi(argv[++i]);
+    } else if (!std::strcmp(argv[i], "--help")) {
       std::printf(
           "tpu_smi: enumerate TPU chips and report health.\n"
           "  exit 0: chips present and healthy (the gate passes)\n"
           "  exit 1: no chips / unhealthy chips (do not proceed)\n"
-          "  --allow-none  exit 0 even with zero chips (CPU smoke nodes)\n"
+          "  --allow-none       exit 0 even with zero chips (CPU smoke nodes)\n"
+          "  --require-chips N  gate on >=N healthy chips (default 1)\n"
           "env: TPUFW_FAKE_DEVICES=N, TPUFW_DEV_DIR, TPUFW_LIBTPU_PATH\n");
       return 0;
+    } else {
+      // A silently ignored flag turns a gate into a no-op; fail closed.
+      std::fprintf(stderr, "tpu_smi: unknown argument '%s' (see --help)\n",
+                   argv[i]);
+      return 2;
     }
   }
 
@@ -78,11 +90,12 @@ int main(int argc, char** argv) {
   std::printf("chips: %d healthy / %zu total%s\n", healthy, devices.size(),
               cfg.fake_devices ? " (FAKE mode)" : "");
 
-  if (devices.empty() || healthy == 0) {
-    if (allow_none) return 0;
+  if (healthy < require_chips) {
+    if (allow_none && devices.empty()) return 0;
     std::fprintf(stderr,
-                 "tpu_smi: gate FAILED — do not proceed to the next layer "
-                 "(reference analog: README.md:84)\n");
+                 "tpu_smi: gate FAILED — %d healthy < %d required; do not "
+                 "proceed to the next layer (reference analog: README.md:84)\n",
+                 healthy, require_chips);
     return 1;
   }
   return 0;

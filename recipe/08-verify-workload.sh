@@ -2,7 +2,7 @@
 # Step 8 — L7 Workload verification (end-to-end gate).
 #
 # TPU retarget of reference README.md:276-335 (SURVEY.md R11-R12): apply the
-# smoke-test Pod (deploy/manifests/01-smoke-matmul.yaml — requests
+# smoke-test Pod (deploy/manifests/02-smoke-tpu.yaml — requests
 # google.com/tpu: 1 and runs the tpufw smoke workload), wait for it, and
 # read the logs back. Success criterion: `jax.devices()` lists TPU cores in
 # the pod logs — the `nvidia-smi`-table-in-logs analog.

@@ -1,0 +1,219 @@
+"""Deploy-layer tests: manifests and chart are data — verify them as data.
+
+The critical one is the bootstrap-contract test: it extracts each JobSet
+manifest's env exactly as the kubelet would materialize it and feeds it to
+the REAL ``tpufw.cluster.bootstrap`` resolver, proving manifest and code
+agree on gang size, process identity, and coordinator address (SURVEY.md
+§7.4 hard-part #2 — the failure mode is a silent N-way gang split).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+import yaml
+
+from tpufw.cluster import resolve_cluster_env
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+MANIFESTS = sorted((REPO / "deploy" / "manifests").glob("*.yaml"))
+CHART = REPO / "deploy" / "charts" / "tpu-stack"
+
+
+def load(path: pathlib.Path) -> list[dict]:
+    return [d for d in yaml.safe_load_all(path.read_text()) if d]
+
+
+@pytest.mark.parametrize("path", MANIFESTS, ids=lambda p: p.name)
+def test_manifest_parses_and_is_k8s_object(path):
+    for doc in load(path):
+        assert {"apiVersion", "kind", "metadata"} <= doc.keys(), path.name
+
+
+def _pod_spec(doc: dict) -> dict:
+    kind = doc["kind"]
+    if kind == "Pod":
+        return doc["spec"]
+    if kind == "Job":
+        return doc["spec"]["template"]["spec"]
+    if kind == "JobSet":
+        [rj] = doc["spec"]["replicatedJobs"]
+        return rj["template"]["spec"]["template"]["spec"]
+    raise AssertionError(f"unhandled kind {kind}")
+
+
+def _containers(doc: dict) -> list[dict]:
+    return _pod_spec(doc)["containers"]
+
+
+def test_all_baseline_configs_covered():
+    # SURVEY.md §7.3 / BASELINE.md: configs 1-5 each have a manifest, plus
+    # smoke-TPU enablement proof and the shared checkpoint PVC.
+    names = [p.name for p in MANIFESTS]
+    assert len(names) == 7
+    kinds = [d["kind"] for p in MANIFESTS for d in load(p)]
+    assert kinds.count("Pod") == 3
+    assert kinds.count("Job") == 1
+    assert kinds.count("JobSet") == 2
+    assert kinds.count("PersistentVolumeClaim") == 1
+
+
+def test_tpu_workloads_request_the_extended_resource():
+    # Reference README.md:353-355: a pod without the resource limit is the
+    # #1 troubleshooting class; only the CPU smoke pod may omit it.
+    for path in MANIFESTS:
+        for doc in load(path):
+            if doc["kind"] == "PersistentVolumeClaim":
+                continue
+            for c in _containers(doc):
+                limits = c.get("resources", {}).get("limits", {})
+                if "smoke-cpu" in path.name:
+                    assert "google.com/tpu" not in limits
+                else:
+                    assert int(limits["google.com/tpu"]) >= 1, path.name
+
+
+def _env_as_kubelet_would(doc: dict, completion_index: int) -> dict:
+    """Materialize container env for worker `completion_index`, resolving
+    the downward-API refs the way kubelet does."""
+    meta = doc["metadata"]
+    fields = {
+        "metadata.labels['jobset.sigs.k8s.io/jobset-name']": meta["name"],
+        "metadata.labels['jobset.sigs.k8s.io/replicatedjob-name']":
+            doc["spec"]["replicatedJobs"][0]["name"],
+        "metadata.annotations['batch.kubernetes.io/job-completion-index']":
+            str(completion_index),
+    }
+    env = {}
+    [container] = _containers(doc)
+    for e in container["env"]:
+        if "value" in e:
+            env[e["name"]] = e["value"]
+        else:
+            env[e["name"]] = fields[e["valueFrom"]["fieldRef"]["fieldPath"]]
+    return env
+
+
+@pytest.mark.parametrize(
+    "path", [p for p in MANIFESTS if "jobset" in p.name], ids=lambda p: p.name
+)
+def test_jobset_env_satisfies_bootstrap_contract(path):
+    [doc] = load(path)
+    [rj] = doc["spec"]["replicatedJobs"]
+    parallelism = rj["template"]["spec"]["parallelism"]
+    assert rj["template"]["spec"]["completionMode"] == "Indexed"
+
+    for idx in (0, parallelism - 1):
+        cfg = resolve_cluster_env(_env_as_kubelet_would(doc, idx))
+        assert cfg.source == "jobset"
+        assert cfg.num_processes == parallelism
+        assert cfg.process_id == idx
+        name, job = doc["metadata"]["name"], rj["name"]
+        assert cfg.coordinator_address == f"{name}-{job}-0-0.{name}:8476"
+
+    # Mesh must cover exactly slice chips: hosts x chips-per-host.
+    env = _env_as_kubelet_would(doc, 0)
+    [container] = _containers(doc)
+    chips = parallelism * int(container["resources"]["limits"]["google.com/tpu"])
+    mesh = 1
+    for ax in ("DATA", "FSDP", "EXPERT", "SEQUENCE", "TENSOR"):
+        mesh *= int(env.get(f"TPUFW_MESH_{ax}", 1))
+    assert mesh == chips, f"{path.name}: mesh product {mesh} != {chips} chips"
+
+    # Gang restart needs checkpoint-resume to be meaningful (SURVEY.md §5).
+    assert doc["spec"]["failurePolicy"]["maxRestarts"] >= 1
+    assert env.get("TPUFW_CHECKPOINT_DIR")
+
+
+def test_jobset_models_exist():
+    from tpufw.models import LLAMA_CONFIGS, MIXTRAL_CONFIGS
+
+    known = set(LLAMA_CONFIGS) | set(MIXTRAL_CONFIGS) | {"llama3_600m_bench"}
+    for path in MANIFESTS:
+        for doc in load(path):
+            if doc["kind"] == "PersistentVolumeClaim":
+                continue
+            for c in _containers(doc):
+                for e in c.get("env", []):
+                    if e["name"] == "TPUFW_MODEL":
+                        assert e["value"] in known, (path.name, e["value"])
+
+
+def test_workload_modules_exist():
+    import importlib
+
+    for path in MANIFESTS:
+        for doc in load(path):
+            if doc["kind"] == "PersistentVolumeClaim":
+                continue
+            for c in _containers(doc):
+                cmd = c["command"]
+                if cmd[:2] == ["python", "-m"]:
+                    assert importlib.util.find_spec(cmd[2]), (path.name, cmd)
+
+
+# --- chart ---------------------------------------------------------------
+
+HELM = shutil.which("helm")
+
+
+def test_chart_structure():
+    assert (CHART / "Chart.yaml").exists()
+    values = yaml.safe_load((CHART / "values.yaml").read_text())
+    assert values["resourceName"] == "google.com/tpu"
+    # The driver.enabled=false analog must exist and default to host mode.
+    assert values["libtpu"]["hostInstalled"] is True
+    templates = {p.name for p in (CHART / "templates").glob("*.yaml")}
+    assert {"daemonset.yaml", "rbac.yaml", "validator-job.yaml",
+            "metrics-service.yaml"} <= templates
+
+
+@pytest.mark.skipif(HELM is None, reason="helm not in image")
+def test_chart_renders_with_helm():
+    out = subprocess.run(
+        [HELM, "template", "tpu-stack", str(CHART)],
+        check=True, capture_output=True, text=True,
+    ).stdout
+    docs = [d for d in yaml.safe_load_all(out) if d]
+    kinds = {d["kind"] for d in docs}
+    assert {"DaemonSet", "ServiceAccount", "Service", "Job"} <= kinds
+    ds = next(d for d in docs if d["kind"] == "DaemonSet")
+    env = {e["name"]: e.get("value")
+           for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["TPUFW_RESOURCE_NAME"] == "google.com/tpu"
+
+
+def test_validator_fails_closed_without_devices(capsys, monkeypatch):
+    # In this container there are no /dev/accel* nodes: the validator must
+    # FAIL (tree #3 semantics), not green-light a broken allocation.
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)
+    monkeypatch.setenv("TPUFW_VALIDATE_REQUIRE_JAX", "0")
+    from tpufw.workloads import validate
+
+    # Empty /dev on purpose: a host with vfio loaded would otherwise pass
+    # the device-node check and break this test's premise.
+    monkeypatch.setattr(validate.glob, "glob", lambda pat: [])
+    assert validate.main() == 1
+    out = capsys.readouterr().out
+    assert "VALIDATION FAILED" in out
+    assert "FAIL: TPU device nodes mounted" in out
+
+
+def test_validator_passes_with_faked_allocation(tmp_path, monkeypatch, capsys):
+    from tpufw.workloads import validate
+
+    fake_lib = tmp_path / "libtpu.so"
+    fake_lib.write_bytes(b"")
+    monkeypatch.setenv("TPU_LIBRARY_PATH", str(fake_lib))
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0")
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "1,1,1")
+    monkeypatch.setattr(
+        validate.glob, "glob",
+        lambda pat: ["/dev/accel0"] if "accel" in pat else [],
+    )
+    results = validate.run_checks(require_jax_tpu=False)
+    assert all(ok for _, ok in results)
+    assert "PASS" in capsys.readouterr().out

@@ -1,0 +1,9 @@
+"""Runnable workload entry points — what the deploy/ manifests execute.
+
+Each module has a ``main()`` and is invocable as ``python -m
+tpufw.workloads.<name>``; configuration comes from ``TPUFW_*`` environment
+variables so a Kubernetes manifest is the config-of-record (SURVEY.md §5
+"config/flag system": YAML manifest -> env -> dataclass, no flag DSL).
+"""
+
+from tpufw.workloads.env import env_bool, env_float, env_int, env_str  # noqa: F401

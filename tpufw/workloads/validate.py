@@ -1,0 +1,76 @@
+"""In-container enablement validator (the chart's validator Job payload).
+
+The GPU Operator ships validation pods that check the runtime injected the
+driver correctly (SURVEY.md §2b X8); this is the TPU analog, run inside a
+container that REQUESTS the accelerator. Checks ascend the same ladder as
+recipe/TROUBLESHOOTING.md tree #3: device nodes mounted -> libtpu visible ->
+allocation env present -> jax actually enumerates TPU cores. Exit 0 only if
+every applicable check passes; each check prints PASS/FAIL so the Job log is
+the diagnosis.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+
+def _report(name: str, ok: bool, detail: str = "") -> bool:
+    print(f"{'PASS' if ok else 'FAIL'}: {name}" + (f" — {detail}" if detail else ""))
+    return ok
+
+
+def run_checks(require_jax_tpu: bool = True) -> list[tuple[str, bool]]:
+    results: list[tuple[str, bool]] = []
+
+    nodes = glob.glob("/dev/accel*") + glob.glob("/dev/vfio/*")
+    results.append(("TPU device nodes mounted", bool(nodes)))
+    _report("TPU device nodes mounted", bool(nodes), ", ".join(nodes) or "none under /dev")
+
+    libtpu_candidates = [
+        os.environ.get("TPU_LIBRARY_PATH", ""),
+        "/lib/libtpu.so",
+        "/usr/lib/libtpu.so",
+        "/usr/local/lib/libtpu.so",
+    ]
+    lib = next((p for p in libtpu_candidates if p and os.path.exists(p)), None)
+    results.append(("libtpu present", lib is not None))
+    _report("libtpu present", lib is not None, lib or "not found")
+
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")
+    env_ok = bool(visible and bounds)
+    results.append(("allocation env injected", env_ok))
+    _report(
+        "allocation env injected", env_ok,
+        f"TPU_VISIBLE_CHIPS={visible!r} TPU_CHIPS_PER_HOST_BOUNDS={bounds!r}",
+    )
+
+    if require_jax_tpu:
+        try:
+            import jax
+
+            devs = jax.devices()
+            ok = any(d.platform == "tpu" for d in devs)
+            detail = str(devs)
+        except Exception as e:  # backend init failure IS the finding
+            ok, detail = False, f"{type(e).__name__}: {e}"
+        results.append(("jax enumerates TPU cores", ok))
+        _report("jax enumerates TPU cores", ok, detail)
+
+    return results
+
+
+def main() -> int:
+    require_jax = os.environ.get("TPUFW_VALIDATE_REQUIRE_JAX", "1") != "0"
+    results = run_checks(require_jax_tpu=require_jax)
+    failed = [n for n, ok in results if not ok]
+    if failed:
+        print(f"VALIDATION FAILED: {failed} — see recipe/TROUBLESHOOTING.md tree #3")
+        return 1
+    print("VALIDATION OK: container is TPU-enabled end to end")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
