@@ -15,6 +15,7 @@ CPU fallback (still one JSON line, flagged "platform": "cpu").
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 
@@ -22,6 +23,17 @@ import jax
 
 
 def main() -> None:
+    # Persistent XLA compile cache: first bench run pays the (slow) TPU
+    # compile once; reruns — including the driver's end-of-round run —
+    # start in seconds. Same lever as the deploy manifests' cache PV.
+    from tpufw.utils.profiling import enable_compile_cache
+
+    enable_compile_cache(
+        os.environ.get(
+            "TPUFW_COMPILE_CACHE_DIR",
+            os.path.join(os.path.dirname(__file__), ".xla-cache"),
+        )
+    )
     devices = jax.devices()
     platform = devices[0].platform
     on_tpu = platform == "tpu" or "tpu" in devices[0].device_kind.lower()
@@ -34,10 +46,11 @@ def main() -> None:
 
     if on_tpu:
         model_cfg = bench_model_config()
-        # batch 4: fp32 params+Adam for 600M is ~9.6G of 16G HBM; batch
-        # 6/8 OOM on the fp32 logits+grads (measured) — chunked-vocab CE
-        # would unlock them.
-        batch_size, seq_len = 4, 2048
+        # fp32 params+Adam for 600M is ~9.6G of 16G HBM. Full fp32 logits
+        # capped the batch at 4 (measured: 6/8 OOM); chunked-vocab CE
+        # (tpufw.ops.loss) keeps peak logits at one 512-position chunk and
+        # unlocks batch 8.
+        batch_size, seq_len = 8, 2048
         warmup, measured = 3, 10
         name = BENCH_CONFIG_NAME
     else:  # keep the CPU path fast but real
@@ -54,6 +67,7 @@ def main() -> None:
             total_steps=warmup + measured,
             lr=1e-4,
             warmup_steps=2,
+            loss_chunk_size=512,
         ),
         MeshConfig(),  # all devices on fsdp
     )

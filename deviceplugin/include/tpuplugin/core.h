@@ -49,6 +49,13 @@ class PluginCore {
 
   std::vector<TpuDevice> snapshot_devices();
 
+  // Prometheus text exposition for the /metrics endpoint: per-chip health,
+  // allocation state, and (when sysfs/fake telemetry is available) duty
+  // cycle, HBM usage, and temperature — the DCGM-exporter analog
+  // (SURVEY.md §5; reference's metrics live in the external GPU Operator
+  // black box, reference README.md:268-271).
+  std::string Metrics();
+
  private:
   CoreConfig cfg_;
   DiscoveryConfig disc_;

@@ -33,4 +33,21 @@ DiscoveryConfig ConfigFromEnv();
 // deterministic across restarts — kubelet allocations reference these IDs.
 std::vector<TpuDevice> Discover(const DiscoveryConfig& cfg);
 
+// Best-effort per-chip telemetry for the metrics endpoint. Real values
+// come from optional sysfs attributes published by the TPU kernel driver
+// (absent fields stay invalid=NaN-equivalent and are skipped in the
+// exposition); fake mode synthesizes deterministic values so the metrics
+// path is testable without hardware.
+struct ChipTelemetry {
+  bool has_duty = false;
+  double duty_cycle_pct = 0;
+  bool has_hbm = false;
+  long long hbm_used_bytes = 0;
+  long long hbm_total_bytes = 0;
+  bool has_temp = false;
+  double temp_c = 0;
+};
+
+ChipTelemetry ReadTelemetry(const DiscoveryConfig& cfg, int chip_index);
+
 }  // namespace tpuplugin

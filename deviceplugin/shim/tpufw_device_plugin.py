@@ -42,7 +42,7 @@ class Core:
         self.lib = ctypes.CDLL(lib_path)
         self.lib.tpuplugin_init.restype = ctypes.c_int
         for fn in ("tpuplugin_options", "tpuplugin_register_request",
-                   "tpuplugin_list_and_watch"):
+                   "tpuplugin_list_and_watch", "tpuplugin_metrics"):
             getattr(self.lib, fn).restype = ctypes.c_void_p
             getattr(self.lib, fn).argtypes = [ctypes.POINTER(ctypes.c_size_t)]
         self.lib.tpuplugin_generation.restype = ctypes.c_ulonglong
@@ -81,6 +81,9 @@ class Core:
     def list_and_watch(self) -> bytes:
         return self._simple("tpuplugin_list_and_watch")
 
+    def metrics(self) -> bytes:
+        return self._simple("tpuplugin_metrics")
+
     def generation(self) -> int:
         return self.lib.tpuplugin_generation()
 
@@ -110,6 +113,61 @@ class Core:
 
 def _identity(x):
     return x
+
+
+class MetricsServer:
+    """HTTP sidecar for the helm metrics Service: GET /metrics returns the
+    C++ core's Prometheus exposition; GET /healthz is 200 while any chip is
+    healthy (503 otherwise) — the liveness gate for the DaemonSet."""
+
+    def __init__(self, core: Core, port: int, host: str = "0.0.0.0"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        metrics_core = core
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                if self.path == "/metrics":
+                    body = metrics_core.metrics()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                elif self.path == "/healthz":
+                    # Only actual health samples count — substring checks
+                    # would match the HELP header / generation counter.
+                    ok = any(
+                        line.startswith("tpufw_tpu_health{")
+                        and line.rstrip().endswith(" 1")
+                        for line in metrics_core.metrics().decode().splitlines()
+                    )
+                    body = b"ok\n" if ok else b"no healthy chips\n"
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type", "text/plain")
+                else:
+                    body = b"not found\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                log.debug("metrics: " + fmt, *args)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+        log.info("metrics on :%d/metrics", self.port)
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
 
 
 class PluginServer:
@@ -268,18 +326,29 @@ def main(argv=None) -> int:
     ))
     parser.add_argument("--oneshot", action="store_true",
                         help="serve+register once, no watch loop (tests)")
+    parser.add_argument("--metrics-port", type=int, default=int(
+        os.environ.get("TPUFW_METRICS_PORT", "2112")),
+        help="Prometheus /metrics port; 0 disables")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
     core = Core(os.path.abspath(args.lib))
+    metrics = None
+    if args.metrics_port:
+        metrics = MetricsServer(core, args.metrics_port)
+        metrics.start()
     plugin = PluginServer(core, args.kubelet_dir, args.endpoint)
-    if args.oneshot:
-        plugin.serve()
-        plugin.register()
-        plugin.stop_event.wait()
-        return 0
-    plugin.run_forever()
+    try:
+        if args.oneshot:
+            plugin.serve()
+            plugin.register()
+            plugin.stop_event.wait()
+            return 0
+        plugin.run_forever()
+    finally:
+        if metrics:
+            metrics.stop()
     return 0
 
 

@@ -88,6 +88,12 @@ char* tpuplugin_preferred_allocation(const char* req, size_t req_len,
   return CopyOut(resp, out_len);
 }
 
+// Prometheus text exposition (UTF-8, not protobuf).
+char* tpuplugin_metrics(size_t* out_len) {
+  if (!g_core) return nullptr;
+  return CopyOut(g_core->Metrics(), out_len);
+}
+
 void tpuplugin_free(char* p) { std::free(p); }
 
 }  // extern "C"

@@ -207,4 +207,49 @@ std::vector<TpuDevice> PluginCore::snapshot_devices() {
   return devices_;
 }
 
+std::string PluginCore::Metrics() {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::ostringstream out;
+  out << "# HELP tpufw_plugin_devices_total chips discovered on this host\n"
+      << "# TYPE tpufw_plugin_devices_total gauge\n"
+      << "tpufw_plugin_devices_total " << devices_.size() << "\n"
+      << "# HELP tpufw_plugin_generation bumps on device state change\n"
+      << "# TYPE tpufw_plugin_generation counter\n"
+      << "tpufw_plugin_generation " << generation_ << "\n"
+      << "# HELP tpufw_tpu_health 1 = chip healthy (device node answers)\n"
+      << "# TYPE tpufw_tpu_health gauge\n"
+      << "# HELP tpufw_tpu_duty_cycle_percent chip busy fraction\n"
+      << "# TYPE tpufw_tpu_duty_cycle_percent gauge\n"
+      << "# HELP tpufw_tpu_hbm_used_bytes HBM in use\n"
+      << "# TYPE tpufw_tpu_hbm_used_bytes gauge\n"
+      << "# HELP tpufw_tpu_hbm_total_bytes HBM capacity\n"
+      << "# TYPE tpufw_tpu_hbm_total_bytes gauge\n"
+      << "# HELP tpufw_tpu_temperature_celsius chip temperature\n"
+      << "# TYPE tpufw_tpu_temperature_celsius gauge\n";
+  for (const auto& d : devices_) {
+    const std::string labels =
+        "{chip=\"" + d.id + "\",numa=\"" + std::to_string(d.numa_node) +
+        "\"}";
+    out << "tpufw_tpu_health" << labels << " " << (d.healthy ? 1 : 0)
+        << "\n";
+    int idx = std::atoi(d.id.substr(d.id.rfind('-') + 1).c_str());
+    ChipTelemetry t = ReadTelemetry(disc_, idx);
+    if (t.has_duty) {
+      out << "tpufw_tpu_duty_cycle_percent" << labels << " "
+          << t.duty_cycle_pct << "\n";
+    }
+    if (t.has_hbm) {
+      out << "tpufw_tpu_hbm_used_bytes" << labels << " " << t.hbm_used_bytes
+          << "\n";
+      out << "tpufw_tpu_hbm_total_bytes" << labels << " "
+          << t.hbm_total_bytes << "\n";
+    }
+    if (t.has_temp) {
+      out << "tpufw_tpu_temperature_celsius" << labels << " " << t.temp_c
+          << "\n";
+    }
+  }
+  return out.str();
+}
+
 }  // namespace tpuplugin

@@ -86,4 +86,38 @@ std::vector<TpuDevice> Discover(const DiscoveryConfig& cfg) {
   return out;
 }
 
+template <typename T>
+static bool ReadSysfsValue(const std::string& path, T* out) {
+  std::ifstream in(path);
+  return static_cast<bool>(in >> *out);
+}
+
+ChipTelemetry ReadTelemetry(const DiscoveryConfig& cfg, int chip_index) {
+  ChipTelemetry t;
+  if (cfg.fake_devices) {
+    // Deterministic per-chip values: tests assert on these, and kind
+    // clusters get non-trivial dashboards.
+    t.has_duty = true;
+    t.duty_cycle_pct = 50.0 + 5.0 * chip_index;
+    t.has_hbm = true;
+    t.hbm_total_bytes = 16LL << 30;
+    t.hbm_used_bytes = (1LL + chip_index) << 30;
+    t.has_temp = true;
+    t.temp_c = 40.0 + chip_index;
+    return t;
+  }
+  const std::string base =
+      cfg.sysfs_accel + "/accel" + std::to_string(chip_index) + "/device/";
+  t.has_duty = ReadSysfsValue(base + "duty_cycle_pct", &t.duty_cycle_pct);
+  long long used = 0, total = 0;
+  if (ReadSysfsValue(base + "mem_used_bytes", &used) &&
+      ReadSysfsValue(base + "mem_total_bytes", &total)) {
+    t.has_hbm = true;
+    t.hbm_used_bytes = used;
+    t.hbm_total_bytes = total;
+  }
+  t.has_temp = ReadSysfsValue(base + "temp_c", &t.temp_c);
+  return t;
+}
+
 }  // namespace tpuplugin

@@ -7,6 +7,7 @@
 #include <dlfcn.h>
 
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 
@@ -37,16 +38,27 @@ static bool CheckLibtpu(std::string* path_out) {
   return false;
 }
 
+// Strict integer parse: atoi's silent 0 on garbage would turn
+// "--require-chips=4x" into a disabled gate. Fail closed instead.
+static bool ParseInt(const char* s, int* out) {
+  char* end = nullptr;
+  long v = std::strtol(s, &end, 10);
+  if (end == s || *end != '\0' || v < 0 || v > 1 << 20) return false;
+  *out = static_cast<int>(v);
+  return true;
+}
+
 int main(int argc, char** argv) {
   bool allow_none = false;
   int require_chips = 1;
   for (int i = 1; i < argc; ++i) {
+    const char* chips_arg = nullptr;
     if (!std::strcmp(argv[i], "--allow-none")) {
       allow_none = true;
     } else if (!std::strncmp(argv[i], "--require-chips=", 16)) {
-      require_chips = std::atoi(argv[i] + 16);
+      chips_arg = argv[i] + 16;
     } else if (!std::strcmp(argv[i], "--require-chips") && i + 1 < argc) {
-      require_chips = std::atoi(argv[++i]);
+      chips_arg = argv[++i];
     } else if (!std::strcmp(argv[i], "--help")) {
       std::printf(
           "tpu_smi: enumerate TPU chips and report health.\n"
@@ -60,6 +72,13 @@ int main(int argc, char** argv) {
       // A silently ignored flag turns a gate into a no-op; fail closed.
       std::fprintf(stderr, "tpu_smi: unknown argument '%s' (see --help)\n",
                    argv[i]);
+      return 2;
+    }
+    if (chips_arg && !ParseInt(chips_arg, &require_chips)) {
+      std::fprintf(stderr,
+                   "tpu_smi: --require-chips needs a non-negative integer, "
+                   "got '%s'\n",
+                   chips_arg);
       return 2;
     }
   }

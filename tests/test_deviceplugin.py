@@ -89,7 +89,9 @@ def test_core_allocate(core):
     cresp = pw.parse(resp[1][0])
     envs = pw.parse_map_str(cresp[1])
     assert envs["TPU_VISIBLE_CHIPS"] == "0,2"
-    assert envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "1,2,1"
+    # Bounds describe the HOST's 2x2 grid, not the 2-chip allocation:
+    # TPU_VISIBLE_CHIPS indexes into the host grid, so chip 2 needs it.
+    assert envs["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
     mounts = [pw.parse(m) for m in cresp[2]]
     assert any(b"libtpu" in m[2][0] for m in mounts)
     device_specs = [pw.parse(d) for d in cresp[3]]
@@ -114,6 +116,43 @@ def test_core_preferred_allocation(core):
     # Fake devices alternate NUMA 0/1: tpu-0 (numa0) and tpu-2 absent, so
     # sorted-by-(numa,idx) picks tpu-0 then tpu-1... tpu-2 not offered.
     assert chosen[0] == "tpu-0"
+
+
+def test_core_metrics_exposition(core):
+    text = core.metrics().decode()
+    assert "tpufw_plugin_devices_total 4" in text
+    assert 'tpufw_tpu_health{chip="tpu-0",numa="0"} 1' in text
+    # Fake telemetry is deterministic: chip i -> duty 50+5i, hbm (1+i) GiB.
+    assert 'tpufw_tpu_duty_cycle_percent{chip="tpu-2",numa="0"} 60' in text
+    assert (
+        'tpufw_tpu_hbm_used_bytes{chip="tpu-1",numa="1"} %d' % (2 << 30)
+        in text
+    )
+    assert 'tpufw_tpu_temperature_celsius{chip="tpu-3",numa="1"} 43' in text
+
+
+def test_metrics_http_server(core):
+    import urllib.request
+
+    sys.path.insert(0, os.path.join(ROOT, "deviceplugin", "shim"))
+    import tpufw_device_plugin as dp
+
+    srv = dp.MetricsServer(core, port=0, host="127.0.0.1")
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            assert r.status == 200
+            assert b"tpufw_tpu_health" in r.read()
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            assert r.status == 200
+        try:
+            urllib.request.urlopen(base + "/nope", timeout=5)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
 
 
 def test_grpc_e2e_with_fake_kubelet(native_build, tmp_path, monkeypatch):

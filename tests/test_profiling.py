@@ -1,0 +1,70 @@
+"""Profiling + compile-cache subsystem (SURVEY.md §5 tracing; §7.4 lever)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from tpufw.utils.profiling import StepProfiler, enable_compile_cache
+
+
+def test_compile_cache_enable(tmp_path):
+    prev = {
+        n: getattr(jax.config, n)
+        for n in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+        )
+    }
+    cache = tmp_path / "xla-cache"
+    try:
+        got = enable_compile_cache(str(cache))
+        assert got == str(cache)
+        assert os.path.isdir(cache)
+        # A fresh compile must leave a persisted entry behind.
+        jax.jit(lambda x: x * 2 + 1)(jnp.arange(128.0)).block_until_ready()
+        assert any(cache.iterdir())
+    finally:
+        for name, value in prev.items():
+            jax.config.update(name, value)
+
+
+def test_compile_cache_noop_without_config(monkeypatch):
+    monkeypatch.delenv("TPUFW_COMPILE_CACHE_DIR", raising=False)
+    assert enable_compile_cache() is None
+
+
+def test_step_profiler_inactive_is_free():
+    prof = StepProfiler(None)
+    for i in range(5):
+        prof.maybe_start(i)
+        with prof.step(i):
+            pass
+        prof.maybe_stop(i)
+    prof.close()
+
+
+def test_trainer_writes_trace(tmp_path):
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import Llama, LLAMA_CONFIGS
+    from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+    tiny = LLAMA_CONFIGS["llama3_tiny"]
+    trace_dir = tmp_path / "trace"
+    cfg = TrainerConfig(
+        batch_size=8, seq_len=17, total_steps=4, lr=1e-3,
+        profile_dir=str(trace_dir), profile_start=1, profile_stop=3,
+    )
+    trainer = Trainer(Llama(tiny), cfg, MeshConfig())
+    trainer.init_state()
+    trainer.run(
+        synthetic_batches(8, 17, tiny.vocab_size),
+        model_flops_per_token=tiny.flops_per_token(16),
+    )
+    # XProf writes plugins/profile/<run>/ with .xplane.pb capture files.
+    found = [
+        f for _, _, files in os.walk(trace_dir) for f in files
+        if f.endswith(".xplane.pb")
+    ]
+    assert found, f"no xplane capture under {trace_dir}"

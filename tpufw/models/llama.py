@@ -249,14 +249,21 @@ class LlamaBlock(nn.Module):
         return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
 
 
-def decoder_lm(cfg, block_base, tokens, positions, segment_ids, with_aux):
+def decoder_lm(
+    cfg, block_base, tokens, positions, segment_ids, with_aux,
+    return_hidden=False,
+):
     """Shared decoder trunk: embed -> remat/scan block stack -> norm -> head.
 
     Used by both Llama and Mixtral (the only difference is the block class
     and whether blocks thread an aux-loss carry) so the two families can't
     drift. Must be called from inside a compact ``__call__``.
 
-    Returns ``logits`` or ``(logits, aux)`` when ``with_aux``.
+    Returns ``logits`` or ``(logits, aux)`` when ``with_aux``. With
+    ``return_hidden`` the head matmul is skipped and the post-final-norm
+    hidden states [B, T, D] take the place of logits — the chunked-vocab
+    loss path (tpufw.ops.loss) computes CE straight from these plus the
+    head kernel, never materializing [B, T, V].
     """
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
@@ -308,6 +315,8 @@ def decoder_lm(cfg, block_base, tokens, positions, segment_ids, with_aux):
                 x = out
 
     x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+    if return_hidden:
+        return (x, aux) if with_aux else x
     if cfg.tie_embeddings:
         logits = embed.attend(x.astype(jnp.float32))
     else:
@@ -333,7 +342,10 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, segment_ids=None):
+    def __call__(
+        self, tokens, positions=None, segment_ids=None, return_hidden=False
+    ):
         return decoder_lm(
-            self.cfg, LlamaBlock, tokens, positions, segment_ids, False
+            self.cfg, LlamaBlock, tokens, positions, segment_ids, False,
+            return_hidden=return_hidden,
         )

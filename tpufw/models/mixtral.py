@@ -260,11 +260,13 @@ class Mixtral(nn.Module):
 
     @nn.compact
     def __call__(
-        self, tokens, positions=None, segment_ids=None, return_aux=True
+        self, tokens, positions=None, segment_ids=None, return_aux=True,
+        return_hidden=False,
     ):
         cfg = self.cfg
         logits, aux = decoder_lm(
-            cfg, MixtralBlock, tokens, positions, segment_ids, True
+            cfg, MixtralBlock, tokens, positions, segment_ids, True,
+            return_hidden=return_hidden,
         )
         if return_aux:
             return logits, aux / cfg.n_layers

@@ -1,0 +1,102 @@
+"""Chunked-vocab cross-entropy: LM loss without the [B, T, V] fp32 tensor.
+
+On a 16 GiB-HBM chip the fp32 logits for batch 8 x 2048 x 32k vocab are
+2 GiB *before* the backward's matching gradient buffer — the single biggest
+activation in the whole train step, and it caps the global batch (measured:
+batch > 4 OOMs the bench config with full logits). The fix is the standard
+TPU one: never materialize the full logits. The sequence axis is cut into
+chunks inside a ``lax.scan`` whose (rematted) body computes one chunk's
+logits on the MXU — bf16 inputs, fp32 accumulation via
+``preferred_element_type`` — reduces it to per-token CE statistics, and
+discards it; the backward pass recomputes each chunk's logits instead of
+keeping them alive. Peak logits memory drops from O(T) to O(T / n_chunks)
+at the cost of one extra head-matmul in the backward (a few % of model
+FLOPs, bought back many times over by the larger batch it enables).
+
+The reference has no ML layer at all (workload is ``nvidia-smi``, reference
+``README.md:314``); this op serves BASELINE configs 3-5's training path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _chunk_stats(h, kernel, targets, z_loss_weight, compute_dtype):
+    """CE statistics for one sequence chunk. h: [B, C, D], kernel: [D, V],
+    targets: [B, C] -> per-token ce [B, C] (z-loss included)."""
+    logits = jnp.einsum(
+        "bcd,dv->bcv",
+        h.astype(compute_dtype),
+        kernel.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    label = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    ce = logz - label
+    if z_loss_weight:
+        ce = ce + z_loss_weight * jnp.square(logz)
+    return ce
+
+
+def chunked_cross_entropy(
+    hidden: jax.Array,
+    kernel: jax.Array,
+    targets: jax.Array,
+    mask: Optional[jax.Array] = None,
+    z_loss_weight: float = 1e-4,
+    chunk_size: int = 256,
+    compute_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, jax.Array]:
+    """Token CE from pre-head hidden states, chunked over the sequence axis.
+
+    Args:
+      hidden: [B, T, D] final hidden states (post final-norm).
+      kernel: [D, V] LM-head kernel (for tied embeddings pass ``embed.T``).
+      targets: [B, T] int token ids.
+      mask: optional [B, T] float weights (0 drops a position).
+      z_loss_weight: softmax-normalizer regularizer, matches
+        ``cross_entropy_loss``.
+      chunk_size: sequence positions per scan step; peak logits memory is
+        ``B * chunk_size * V`` fp32.
+      compute_dtype: head-matmul input dtype. bf16 is the MXU fast path
+        (accumulation is always fp32); use fp32 for bit-exact parity with
+        the unchunked loss.
+
+    Returns:
+      (mean loss over unmasked tokens, number of unmasked tokens).
+    """
+    b, t, d = hidden.shape
+    if mask is None:
+        mask = jnp.ones((b, t), jnp.float32)
+    mask = mask.astype(jnp.float32)
+
+    n_chunks = -(-t // chunk_size)
+    pad = n_chunks * chunk_size - t
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+
+    # [n_chunks, B, chunk, ...] so scan walks the sequence axis.
+    hs = hidden.reshape(b, n_chunks, chunk_size, d).swapaxes(0, 1)
+    ts = targets.reshape(b, n_chunks, chunk_size).swapaxes(0, 1)
+    ms = mask.reshape(b, n_chunks, chunk_size).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        h_c, t_c, m_c = xs
+        ce = _chunk_stats(h_c, kernel, t_c, z_loss_weight, compute_dtype)
+        ce_sum, n_sum = carry
+        return (ce_sum + (ce * m_c).sum(), n_sum + m_c.sum()), None
+
+    (ce_sum, n), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (hs, ts, ms),
+    )
+    n_safe = jnp.maximum(n, 1.0)
+    return ce_sum / n_safe, n

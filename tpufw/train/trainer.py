@@ -85,10 +85,22 @@ def default_optimizer(
     )
 
 
-def train_step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+def head_kernel(params) -> jax.Array:
+    """The [D, V] LM-head matrix from a decoder_lm param tree — the
+    dedicated ``lm_head`` kernel, or the transposed embedding when tied."""
+    if "lm_head" in params:
+        return params["lm_head"]["kernel"]
+    return params["embed"]["embedding"].T
+
+
+def train_step(
+    state: TrainState, batch: dict, loss_chunk_size: Optional[int] = None
+) -> tuple[TrainState, dict]:
     """One fwd+bwd+update. batch: tokens [B,T] (+ optional loss_mask,
     segment_ids). Targets are tokens shifted left; the final position is
-    masked out.
+    masked out. ``loss_chunk_size`` switches to the chunked-vocab CE
+    (tpufw.ops.loss): the model skips its head matmul and loss is computed
+    from hidden states chunk-by-chunk, never materializing [B,T,V] logits.
     """
     tokens = batch["tokens"]
     inputs = tokens[:, :-1]
@@ -107,15 +119,24 @@ def train_step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
         mask = seg_mask if mask is None else mask * seg_mask
 
     def loss_fn(params):
-        out = state.apply_fn(
-            {"params": params}, inputs, segment_ids=seg_in
-        )
+        kwargs = {"segment_ids": seg_in}
+        if loss_chunk_size:
+            kwargs["return_hidden"] = True
+        out = state.apply_fn({"params": params}, inputs, **kwargs)
         # MoE models return (logits, aux_loss) — router losses join the
         # objective here.
         aux = 0.0
         if isinstance(out, tuple):
             out, aux = out
-        loss, _ = cross_entropy_loss(out, targets, mask)
+        if loss_chunk_size:
+            from tpufw.ops.loss import chunked_cross_entropy
+
+            loss, _ = chunked_cross_entropy(
+                out, head_kernel(params), targets, mask,
+                chunk_size=loss_chunk_size,
+            )
+        else:
+            loss, _ = cross_entropy_loss(out, targets, mask)
         return loss + aux
 
     loss, grads = jax.value_and_grad(loss_fn)(state.params)
@@ -151,6 +172,14 @@ class TrainerConfig:
     log_every: int = 10
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1000
+    # Sequence positions per chunked-CE scan step; None = full logits.
+    loss_chunk_size: Optional[int] = None
+    # XProf capture: trace steps [profile_start, profile_stop) into
+    # profile_dir (None disables). Step 0 is excluded by default so the
+    # window holds steady-state steps, not the XLA compile.
+    profile_dir: Optional[str] = None
+    profile_start: int = 3
+    profile_stop: int = 6
 
 
 class Trainer:
@@ -262,7 +291,9 @@ class Trainer:
             row = NamedSharding(self.mesh, P(("data", "fsdp")))
             batch_sharding = {k: row for k in key}
             self._compiled[key] = jax.jit(
-                train_step,
+                partial(
+                    train_step, loss_chunk_size=self.cfg.loss_chunk_size
+                ),
                 in_shardings=(self.state_sharding, batch_sharding),
                 out_shardings=(self.state_sharding, None),
                 donate_argnums=(0,),
@@ -290,22 +321,37 @@ class Trainer:
                 self.cfg.checkpoint_dir,
                 save_interval_steps=self.cfg.checkpoint_every,
             )
+        from tpufw.utils.profiling import StepProfiler
+
+        prof = StepProfiler(
+            self.cfg.profile_dir,
+            self.cfg.profile_start,
+            self.cfg.profile_stop,
+        )
         history: list[StepMetrics] = []
-        with use_mesh(self.mesh):
-            for i, batch in enumerate(data):
-                if i >= self.cfg.total_steps:
-                    break
-                step_fn = self.compiled_step(batch)
-                meter.start()
-                self.state, m = step_fn(self.state, batch)
-                loss = jax.block_until_ready(m["loss"])
-                sm = meter.stop(int(self.state.step), loss)
-                history.append(sm)
-                if on_metrics and (i % self.cfg.log_every == 0):
-                    on_metrics(sm)
-                if ckpt is not None:
-                    ckpt.save(int(self.state.step), self.state)
-        if ckpt is not None:
-            ckpt.wait()
-            ckpt.close()
+        try:
+            with use_mesh(self.mesh):
+                for i, batch in enumerate(data):
+                    if i >= self.cfg.total_steps:
+                        break
+                    step_fn = self.compiled_step(batch)
+                    prof.maybe_start(i)
+                    meter.start()
+                    with prof.step(i):
+                        self.state, m = step_fn(self.state, batch)
+                        loss = jax.block_until_ready(m["loss"])
+                    sm = meter.stop(int(self.state.step), loss)
+                    prof.maybe_stop(i)
+                    history.append(sm)
+                    if on_metrics and (i % self.cfg.log_every == 0):
+                        on_metrics(sm)
+                    if ckpt is not None:
+                        ckpt.save(int(self.state.step), self.state)
+        finally:
+            # Flush even on a mid-loop crash: the trace and the last
+            # checkpoint are exactly what post-mortems need.
+            prof.close()
+            if ckpt is not None:
+                ckpt.wait()
+                ckpt.close()
         return history

@@ -1,0 +1,99 @@
+"""Profiling + persistent compile cache — SURVEY.md §5's tracing subsystem
+and the §7.4 cold-start lever.
+
+The reference's only observability channel is ``kubectl logs`` and a
+``watch`` loop (reference ``README.md:282-286, 331-335``); there is no
+profiler to port. The TPU-native build gets two real mechanisms:
+
+- **XProf traces**: ``StepProfiler`` wraps ``jax.profiler`` so the trainer
+  captures a window of steps (skipping compile-dominated step 0) into a
+  TensorBoard-loadable directory. Per-step named scopes come for free via
+  ``jax.profiler.StepTraceAnnotation``.
+- **Persistent XLA compile cache**: first-compile dominates TPU pod
+  cold-start -> first-step (the BASELINE metric); pointing the cache at a
+  PV/GCS path makes recompiles across pod restarts near-free. This is the
+  TPU analog of the reference's image-pull/reboot wall-clock sink
+  (``README.md:70-74, 202``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+# Env var consumed by workload entry points (set in deploy/ manifests).
+COMPILE_CACHE_ENV = "TPUFW_COMPILE_CACHE_DIR"
+
+
+def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Turn on XLA's persistent compilation cache at ``path``.
+
+    ``path`` defaults to ``$TPUFW_COMPILE_CACHE_DIR``; no-op (returning
+    None) when neither is set, so workloads can call this unconditionally.
+    """
+    path = path or os.environ.get(COMPILE_CACHE_ENV)
+    if not path:
+        return None
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache everything: tiny compiles are still worth skipping on restart.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return path
+
+
+class StepProfiler:
+    """Captures steps [start, stop) of a train loop into an XProf trace.
+
+    Usage from a step loop::
+
+        prof = StepProfiler(dir, start_step=3, stop_step=6)
+        for i, batch in enumerate(data):
+            prof.maybe_start(i)
+            with prof.step(i):
+                run_step(batch)
+            prof.maybe_stop(i)
+
+    Inactive (``dir=None``) it is free: every method returns immediately.
+    Start defaults past step 0 so the capture window holds steady-state
+    steps, not the XLA compile.
+    """
+
+    def __init__(
+        self,
+        trace_dir: Optional[str],
+        start_step: int = 3,
+        stop_step: int = 6,
+    ):
+        self.trace_dir = trace_dir
+        self.start_step = start_step
+        self.stop_step = stop_step
+        self._active = False
+
+    def maybe_start(self, step: int) -> None:
+        if self.trace_dir and not self._active and step == self.start_step:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+
+    def step(self, step: int):
+        if self._active:
+            return jax.profiler.StepTraceAnnotation("train", step_num=step)
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def maybe_stop(self, step: int) -> None:
+        if self._active and step + 1 >= self.stop_step:
+            # Block so the trace includes completed device work.
+            jax.effects_barrier()
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            jax.effects_barrier()
+            jax.profiler.stop_trace()
+            self._active = False

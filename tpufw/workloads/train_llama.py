@@ -55,6 +55,11 @@ def build_trainer():
         log_every=env_int("log_every", 10),
         checkpoint_dir=env_str("checkpoint_dir", "") or None,
         checkpoint_every=env_int("checkpoint_every", 100),
+        # 0/unset = full logits; >0 enables chunked-vocab CE.
+        loss_chunk_size=env_int("loss_chunk_size", 512) or None,
+        profile_dir=env_str("profile_dir", "") or None,
+        profile_start=env_int("profile_start", 3),
+        profile_stop=env_int("profile_stop", 6),
     )
     mesh_cfg = MeshConfig(
         data=env_int("mesh_data", 1),
@@ -68,7 +73,11 @@ def build_trainer():
 
 def main() -> int:
     from tpufw.cluster import initialize_cluster
+    from tpufw.utils.profiling import enable_compile_cache
 
+    # Before any compile: persistent XLA cache makes pod-restart recompiles
+    # near-free (cold-start -> first-step, the BASELINE metric).
+    cache = enable_compile_cache()
     cluster = initialize_cluster()
 
     import jax
@@ -80,6 +89,7 @@ def main() -> int:
         f"tpufw train_llama: process {cluster.process_id}/"
         f"{cluster.num_processes} devices={len(jax.devices())} "
         f"mesh={dict(trainer.mesh.shape)} params={model_cfg.n_params():,}"
+        + (f" compile_cache={cache}" if cache else "")
     )
 
     resumed = trainer.maybe_restore()

@@ -14,7 +14,9 @@ from tpufw.workloads.env import env_int
 
 def main() -> int:
     from tpufw.cluster import initialize_cluster
+    from tpufw.utils.profiling import enable_compile_cache
 
+    enable_compile_cache()
     cluster = initialize_cluster()
 
     import jax

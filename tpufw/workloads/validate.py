@@ -23,9 +23,14 @@ def _report(name: str, ok: bool, detail: str = "") -> bool:
 def run_checks(require_jax_tpu: bool = True) -> list[tuple[str, bool]]:
     results: list[tuple[str, bool]] = []
 
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        results.append((name, _report(name, ok, detail)))
+
     nodes = glob.glob("/dev/accel*") + glob.glob("/dev/vfio/*")
-    results.append(("TPU device nodes mounted", bool(nodes)))
-    _report("TPU device nodes mounted", bool(nodes), ", ".join(nodes) or "none under /dev")
+    check(
+        "TPU device nodes mounted", bool(nodes),
+        ", ".join(nodes) or "none under /dev",
+    )
 
     libtpu_candidates = [
         os.environ.get("TPU_LIBRARY_PATH", ""),
@@ -34,15 +39,12 @@ def run_checks(require_jax_tpu: bool = True) -> list[tuple[str, bool]]:
         "/usr/local/lib/libtpu.so",
     ]
     lib = next((p for p in libtpu_candidates if p and os.path.exists(p)), None)
-    results.append(("libtpu present", lib is not None))
-    _report("libtpu present", lib is not None, lib or "not found")
+    check("libtpu present", lib is not None, lib or "not found")
 
     visible = os.environ.get("TPU_VISIBLE_CHIPS")
     bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")
-    env_ok = bool(visible and bounds)
-    results.append(("allocation env injected", env_ok))
-    _report(
-        "allocation env injected", env_ok,
+    check(
+        "allocation env injected", bool(visible and bounds),
         f"TPU_VISIBLE_CHIPS={visible!r} TPU_CHIPS_PER_HOST_BOUNDS={bounds!r}",
     )
 
@@ -55,8 +57,7 @@ def run_checks(require_jax_tpu: bool = True) -> list[tuple[str, bool]]:
             detail = str(devs)
         except Exception as e:  # backend init failure IS the finding
             ok, detail = False, f"{type(e).__name__}: {e}"
-        results.append(("jax enumerates TPU cores", ok))
-        _report("jax enumerates TPU cores", ok, detail)
+        check("jax enumerates TPU cores", ok, detail)
 
     return results
 
