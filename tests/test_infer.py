@@ -1,0 +1,138 @@
+"""Inference stack: KV-cache decode parity vs full forward, ragged
+left-padded batches, EOS handling, sampling transforms."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.infer import (
+    SamplingConfig,
+    apply_top_k,
+    apply_top_p,
+    generate,
+    generate_text,
+    pad_prompts,
+)
+from tpufw.models import Llama, LLAMA_CONFIGS, MIXTRAL_CONFIGS, Mixtral
+
+TINY = LLAMA_CONFIGS["llama3_tiny"]
+
+
+@pytest.fixture(scope="module")
+def llama_params():
+    model = Llama(TINY)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    return model.init(jax.random.key(0), tokens)["params"]
+
+
+def _naive_greedy(params, prompt, n):
+    """Reference: re-run the FULL forward on the growing sequence."""
+    model = Llama(TINY)
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        logits = model.apply(
+            {"params": params}, jnp.asarray([toks], jnp.int32)
+        )
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+def test_cached_decode_matches_full_forward(llama_params):
+    prompt = [5, 17, 101, 7, 42]
+    want = _naive_greedy(llama_params, prompt, 6)
+    decode_model = Llama(TINY.decode_config())
+    got = generate_text(
+        decode_model, llama_params, [prompt], max_new_tokens=6
+    )[0]
+    assert got == want
+
+
+def test_ragged_batch_matches_per_example(llama_params):
+    """Left-padded batch rows must decode exactly like solo runs."""
+    prompts = [[5, 17, 101, 7, 42], [9, 3], [77, 12, 200]]
+    decode_model = Llama(TINY.decode_config())
+    batched = generate_text(
+        decode_model, llama_params, prompts, max_new_tokens=5
+    )
+    for p, got in zip(prompts, batched):
+        solo = generate_text(
+            decode_model, llama_params, [p], max_new_tokens=5
+        )[0]
+        assert got == solo == _naive_greedy(llama_params, p, 5)
+
+
+def test_eos_freezes_row(llama_params):
+    decode_model = Llama(TINY.decode_config())
+    prompt = [5, 17, 101]
+    free = _naive_greedy(llama_params, prompt, 8)
+    eos = free[2]  # force an EOS three tokens in
+    got = generate(
+        decode_model,
+        llama_params,
+        jnp.asarray([prompt], jnp.int32),
+        jnp.zeros((1,), jnp.int32),
+        jax.random.key(0),
+        max_new_tokens=8,
+        pad_id=0,
+        eos_id=eos,
+    )
+    row = np.asarray(got)[0].tolist()
+    assert row[:3] == free[:3]
+    assert row[2] == eos
+    assert all(t == 0 for t in row[3:])  # padded after EOS
+
+
+def test_mixtral_cached_decode_runs():
+    cfg = MIXTRAL_CONFIGS["mixtral_tiny"]
+    model = Mixtral(cfg)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    decode_model = Mixtral(cfg.decode_config())
+    out = generate_text(
+        decode_model, params, [[3, 1, 4, 1, 5]], max_new_tokens=4
+    )[0]
+    assert len(out) == 4
+    assert all(0 <= t < cfg.vocab_size for t in out)
+
+
+def test_pad_prompts_left_pads():
+    toks, pads = pad_prompts([[1, 2, 3], [7]], pad_id=9)
+    np.testing.assert_array_equal(toks, [[1, 2, 3], [9, 9, 7]])
+    np.testing.assert_array_equal(pads, [0, 2])
+
+
+def test_top_k_masks_all_but_k():
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0]])
+    masked = apply_top_k(logits, 2)
+    assert masked[0, 1] == 5.0 and masked[0, 2] == 3.0
+    assert masked[0, 0] < -1e29 and masked[0, 3] < -1e29
+
+
+def test_top_p_keeps_nucleus():
+    # softmax of [2, 1, 0, -1] ~ [0.64, 0.24, 0.09, 0.03]
+    logits = jnp.asarray([[2.0, 1.0, 0.0, -1.0]])
+    masked = apply_top_p(logits, 0.7)
+    # 0.64 < 0.7 -> token 1 also kept (mass before it = 0.64 < p).
+    assert masked[0, 0] == 2.0 and masked[0, 1] == 1.0
+    assert masked[0, 2] < -1e29 and masked[0, 3] < -1e29
+    # p=1 keeps everything.
+    np.testing.assert_array_equal(apply_top_p(logits, 1.0), logits)
+
+
+def test_sampled_generation_respects_vocab(llama_params):
+    decode_model = Llama(TINY.decode_config())
+    out = generate_text(
+        decode_model,
+        llama_params,
+        [[5, 6, 7]],
+        max_new_tokens=10,
+        sampling=SamplingConfig(temperature=0.8, top_k=50, top_p=0.95),
+        seed=7,
+    )[0]
+    assert len(out) == 10
+    assert all(0 <= t < TINY.vocab_size for t in out)

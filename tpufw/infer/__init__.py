@@ -1,0 +1,11 @@
+from tpufw.infer.generate import (  # noqa: F401
+    generate,
+    generate_text,
+    pad_prompts,
+)
+from tpufw.infer.sampling import (  # noqa: F401
+    SamplingConfig,
+    apply_top_k,
+    apply_top_p,
+    sample_token,
+)

@@ -1,0 +1,160 @@
+"""Autoregressive generation: KV-cache prefill + lax.scan decode, jitted.
+
+TPU-first shape discipline: prompts are LEFT-padded to one static length,
+the KV cache is a fixed [B, max_seq_len] ring of slots, and the decode loop
+is a ``lax.scan`` over a static number of steps — one compiled program
+regardless of prompt lengths or early EOS (finished rows keep stepping but
+their outputs are frozen to ``pad_id``; masking, not control flow). The
+reference has no inference stack to mirror (workload is ``nvidia-smi``,
+reference ``README.md:314``) — this is the serving half a complete
+framework needs next to the trainer.
+
+Left-padding is what makes ragged batches one program: every live token
+sits flush against the cache cursor, RoPE positions are slot - pad_len,
+and pad slots carry segment 0 so attention never sees them
+(tpufw.models.llama Attention._cached_attention).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpufw.infer.sampling import SamplingConfig, sample_token
+
+
+def pad_prompts(
+    prompts: Sequence[Sequence[int]], pad_id: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Left-pad ragged prompts to [B, max_len]; returns (tokens, pad_lens)."""
+    max_len = max(len(p) for p in prompts)
+    out = np.full((len(prompts), max_len), pad_id, np.int32)
+    pads = np.zeros((len(prompts),), np.int32)
+    for i, p in enumerate(prompts):
+        pads[i] = max_len - len(p)
+        if len(p):
+            out[i, pads[i]:] = np.asarray(p, np.int32)
+    return out, pads
+
+
+@partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "sampling", "pad_id", "eos_id"),
+)
+def generate(
+    model,
+    params,
+    prompt_tokens: jax.Array,
+    pad_lens: jax.Array,
+    rng: jax.Array,
+    *,
+    max_new_tokens: int,
+    sampling: SamplingConfig = SamplingConfig(),
+    pad_id: int = 0,
+    eos_id: Optional[int] = None,
+) -> jax.Array:
+    """Generate continuations. Returns [B, max_new_tokens] int32.
+
+    Args:
+      model: a decode-mode module (``Llama(cfg.decode_config())`` or
+        ``Mixtral(...)``) — must populate the "cache" collection.
+      params: trained params (the training-mode tree; identical structure).
+      prompt_tokens: [B, P] int32, LEFT-padded (see ``pad_prompts``).
+      pad_lens: [B] int32 pad count per row.
+      rng: sampling key (unused for greedy).
+      max_new_tokens: static decode length; rows that hit ``eos_id`` emit
+        ``pad_id`` from then on.
+    """
+    b, p = prompt_tokens.shape
+    max_seq = getattr(getattr(model, "cfg", None), "max_seq_len", None)
+    if max_seq is not None and p + max_new_tokens > max_seq:
+        # Past max_seq_len the cache cursor clamps and silently overwrites
+        # the last slot — fail at trace time instead.
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"the KV cache (max_seq_len={max_seq})"
+        )
+    seg = (jnp.arange(p)[None, :] >= pad_lens[:, None]).astype(jnp.int32)
+    positions = jnp.maximum(jnp.arange(p)[None, :] - pad_lens[:, None], 0)
+
+    def apply(cache, tokens, positions, seg):
+        out, vars_ = model.apply(
+            {"params": params, **cache},
+            tokens,
+            positions=positions,
+            segment_ids=seg,
+            mutable=["cache"],
+        )
+        logits = out[0] if isinstance(out, tuple) else out  # MoE aux dropped
+        return logits, {"cache": vars_["cache"]}
+
+    # Prefill: one pass over the whole (padded) prompt. Left-padding makes
+    # the last column the final real token of every row.
+    logits, cache = apply(
+        {}, prompt_tokens, positions, seg
+    )
+    next_rng, rng = jax.random.split(rng)
+    first = sample_token(logits[:, -1, :], sampling, rng)
+    # The EOS token itself is emitted; only rows ALREADY done emit pad.
+    done = jnp.zeros((b,), bool) if eos_id is None else first == eos_id
+
+    def step(carry, rng_step):
+        cache, token, pos, done = carry
+        logits, cache = apply(
+            cache,
+            token[:, None],
+            pos[:, None],
+            jnp.ones((b, 1), jnp.int32),
+        )
+        nxt = sample_token(logits[:, -1, :], sampling, rng_step)
+        emitted = jnp.where(done, pad_id, nxt)
+        if eos_id is not None:
+            done = done | (nxt == eos_id)
+        return (cache, emitted, pos + 1, done), emitted
+
+    # Positions continue from each row's real length (p - pad_len).
+    pos0 = p - pad_lens
+    step_rngs = jax.random.split(next_rng, max(max_new_tokens - 1, 1))
+    if max_new_tokens == 1:
+        return first[:, None]
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (cache, first, pos0, done), step_rngs
+    )
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def generate_text(
+    model,
+    params,
+    prompts: Sequence[Sequence[int]],
+    *,
+    max_new_tokens: int,
+    sampling: SamplingConfig = SamplingConfig(),
+    pad_id: int = 0,
+    eos_id: Optional[int] = None,
+    seed: int = 0,
+) -> list[list[int]]:
+    """Convenience wrapper: ragged python prompts in, ragged lists out."""
+    tokens, pads = pad_prompts(prompts, pad_id)
+    out = generate(
+        model,
+        params,
+        jnp.asarray(tokens),
+        jnp.asarray(pads),
+        jax.random.key(seed),
+        max_new_tokens=max_new_tokens,
+        sampling=sampling,
+        pad_id=pad_id,
+        eos_id=eos_id,
+    )
+    result = []
+    for row in np.asarray(out):
+        toks = row.tolist()
+        if eos_id is not None and eos_id in toks:
+            toks = toks[: toks.index(eos_id) + 1]
+        result.append(toks)
+    return result

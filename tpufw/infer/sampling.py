@@ -1,0 +1,65 @@
+"""Token sampling transforms: temperature, top-k, top-p, greedy.
+
+Pure [B, V] logits -> [B] token functions, compiled into the decode loop
+(tpufw.infer.generate). All masking is static-shape friendly: top-k uses
+``lax.top_k``'s threshold rather than a gather, top-p masks on the sorted
+cumulative distribution — no data-dependent shapes anywhere, per the XLA
+tracing rules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    # 0.0 = greedy (argmax); otherwise logits are divided by temperature.
+    temperature: float = 0.0
+    # Keep only the k most likely tokens (0/None disables).
+    top_k: Optional[int] = None
+    # Nucleus sampling: keep the smallest set of tokens whose cumulative
+    # probability reaches top_p (1.0/None disables).
+    top_p: Optional[float] = None
+
+
+def apply_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Mask all but the k highest logits. [B, V] -> [B, V]."""
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, _NEG, logits)
+
+
+def apply_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus mask: keep the smallest prefix of the sorted distribution
+    with cumulative probability >= p (the top token always survives)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Token i is kept while the mass BEFORE it is < p.
+    keep_sorted = (cum - probs) < p
+    # Threshold = smallest kept logit; everything below it is masked.
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < threshold, _NEG, logits)
+
+
+def sample_token(
+    logits: jax.Array, cfg: SamplingConfig, rng: jax.Array
+) -> jax.Array:
+    """[B, V] float logits -> [B] int32 sampled tokens."""
+    logits = logits.astype(jnp.float32)
+    if cfg.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / cfg.temperature
+    if cfg.top_k:
+        logits = apply_top_k(logits, cfg.top_k)
+    if cfg.top_p is not None and cfg.top_p < 1.0:
+        logits = apply_top_p(logits, cfg.top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -50,6 +50,17 @@ class LlamaConfig:
     attention_backend: str = "xla"
     remat: bool = True
     scan_layers: bool = True
+    # Autoregressive KV-cache mode (tpufw.infer): attention reads/writes a
+    # [B, max_seq_len] cache ("cache" flax collection) instead of attending
+    # within the call's own tokens. Build with cfg.decode_config().
+    decode: bool = False
+
+    def decode_config(self) -> "LlamaConfig":
+        """This architecture re-dressed for inference: KV-cache on, remat
+        off (no backward pass), xla attention (flash/ring are trainers')."""
+        return dataclasses.replace(
+            self, decode=True, remat=False, attention_backend="xla"
+        )
 
     def n_params(self, include_embed: bool = True) -> int:
         """Analytic parameter count (exact for this architecture)."""
@@ -189,14 +200,17 @@ class Attention(nn.Module):
         v = nn.with_logical_constraint(
             v, ("batch", "act_seq", "act_heads", "head_dim")
         )
-        out = multi_head_attention(
-            q,
-            k,
-            v,
-            causal=True,
-            segment_ids=segment_ids,
-            backend=cfg.attention_backend,
-        )
+        if cfg.decode:
+            out = self._cached_attention(q, k, v, segment_ids, positions)
+        else:
+            out = multi_head_attention(
+                q,
+                k,
+                v,
+                causal=True,
+                segment_ids=segment_ids,
+                backend=cfg.attention_backend,
+            )
         proj = nn.DenseGeneral(
             features=cfg.d_model,
             axis=(-2, -1),
@@ -209,6 +223,55 @@ class Attention(nn.Module):
             name="o",
         )
         return proj(out)
+
+    def _cached_attention(self, q, k, v, segment_ids, positions):
+        """KV-cache step: append this call's k/v at the cache cursor, then
+        attend q (at ``positions``) over the whole cache. Static shapes —
+        the cache is always [B, max_seq_len] and masking does the rest:
+        never-written slots keep segment 0, so the segment mask hides them
+        (prompt pad slots stay 0 too, handled by the same mechanism).
+        """
+        cfg = self.cfg
+        b, t = q.shape[:2]
+        shape = (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim)
+        ck = self.variable("cache", "cached_key", jnp.zeros, shape, cfg.dtype)
+        cv = self.variable(
+            "cache", "cached_value", jnp.zeros, shape, cfg.dtype
+        )
+        cseg = self.variable(
+            "cache", "cached_segment_ids",
+            jnp.zeros, (b, cfg.max_seq_len), jnp.int32,
+        )
+        cursor = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        cur = cursor.value
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(cfg.dtype), (0, cur, 0, 0)
+        )
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cfg.dtype), (0, cur, 0, 0)
+        )
+        seg = (
+            jnp.ones((b, t), jnp.int32) if segment_ids is None
+            else segment_ids.astype(jnp.int32)
+        )
+        cseg.value = jax.lax.dynamic_update_slice(cseg.value, seg, (0, cur))
+        cursor.value = cur + t
+        # Causality is over cache SLOTS, not RoPE positions — under
+        # left-padding a token's RoPE position lags its slot by pad_len and
+        # would wrongly mask valid recent slots.
+        slot_positions = jnp.broadcast_to(cur + jnp.arange(t), (b, t))
+        return multi_head_attention(
+            q,
+            ck.value,
+            cv.value,
+            causal=True,
+            segment_ids=seg,
+            kv_segment_ids=cseg.value,
+            q_positions=slot_positions,
+            backend="xla",
+        )
 
 
 class MLP(nn.Module):
@@ -300,7 +363,7 @@ def decoder_lm(
 
         (x, aux), _ = nn.scan(
             body,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True},
             length=cfg.n_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},

@@ -41,13 +41,20 @@ def xla_attention(
     *,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    q_positions: Optional[jax.Array] = None,
     logits_soft_cap: Optional[float] = None,
 ) -> jax.Array:
     """Reference softmax attention. q:[B,T,H,D], k/v:[B,S,K,D] -> [B,T,H,D].
 
     ``segment_ids`` ([B, T] int) masks cross-segment attention for packed
-    sequences. Softmax is computed in float32 regardless of input dtype —
-    bf16 logits lose too much precision at long T.
+    sequences; ``kv_segment_ids`` ([B, S]) gives the key side its own ids
+    when q and kv lengths differ (KV-cache decode — cached pad slots carry
+    segment 0 and are never attended). ``q_positions`` ([B, T] int) are the
+    queries' absolute positions in the S-long key axis for causal masking;
+    default assumes queries are the final T positions. Softmax is computed
+    in float32 regardless of input dtype — bf16 logits lose too much
+    precision at long T.
     """
     b, t, h, d = q.shape
     _, s, kh, _ = k.shape
@@ -69,16 +76,19 @@ def xla_attention(
         logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
 
     mask = None
+    kpos = jnp.arange(s)[None, None, None, :]  # [1,1,1,S]
     if causal:
-        # For decode (t < s), align query i with absolute position s-t+i.
-        offset = s - t
-        qpos = jnp.arange(t)[:, None] + offset
-        kpos = jnp.arange(s)[None, :]
-        mask = qpos >= kpos  # [T, S]
-        mask = mask[None, None, :, :]
+        if q_positions is None:
+            # Align query i with absolute position s-t+i.
+            qpos = (jnp.arange(t) + (s - t))[None, None, :, None]
+        else:
+            qpos = q_positions[:, None, :, None]  # [B,1,T,1]
+        mask = qpos >= kpos
     if segment_ids is not None:
-        seg_mask = (segment_ids[:, :, None] == segment_ids[:, None, :])
-        seg_mask = seg_mask[:, None, :, :]
+        kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
+        seg_mask = (
+            segment_ids[:, None, :, None] == kv_seg[:, None, None, :]
+        )
         mask = seg_mask if mask is None else (mask & seg_mask)
     if mask is not None:
         logits = jnp.where(mask, logits, -1e30)
@@ -94,6 +104,8 @@ def multi_head_attention(
     *,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    q_positions: Optional[jax.Array] = None,
     logits_soft_cap: Optional[float] = None,
     backend: str = "xla",
 ) -> jax.Array:
@@ -105,7 +117,14 @@ def multi_head_attention(
             v,
             causal=causal,
             segment_ids=segment_ids,
+            kv_segment_ids=kv_segment_ids,
+            q_positions=q_positions,
             logits_soft_cap=logits_soft_cap,
+        )
+    if kv_segment_ids is not None or q_positions is not None:
+        raise NotImplementedError(
+            f"KV-cache decode (kv_segment_ids/q_positions) requires "
+            f"backend='xla', got {backend!r}"
         )
     if backend in ("flash", "ring") and logits_soft_cap is not None:
         raise NotImplementedError(

@@ -26,6 +26,23 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def token_cross_entropy(
+    logits: jax.Array, targets: jax.Array, z_loss_weight: float = 1e-4
+) -> jax.Array:
+    """Per-token CE with z-loss, in fp32. [..., V] logits, [...] targets ->
+    [...] ce. The ONE implementation of the LM objective's token term —
+    both the full-logits loss (tpufw.train.trainer.cross_entropy_loss) and
+    the chunked path below use it, so they cannot diverge.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    label = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    ce = logz - label
+    if z_loss_weight:
+        ce = ce + z_loss_weight * jnp.square(logz)
+    return ce
+
+
 def _chunk_stats(h, kernel, targets, z_loss_weight, compute_dtype):
     """CE statistics for one sequence chunk. h: [B, C, D], kernel: [D, V],
     targets: [B, C] -> per-token ce [B, C] (z-loss included)."""
@@ -35,12 +52,7 @@ def _chunk_stats(h, kernel, targets, z_loss_weight, compute_dtype):
         kernel.astype(compute_dtype),
         preferred_element_type=jnp.float32,
     )
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    label = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    ce = logz - label
-    if z_loss_weight:
-        ce = ce + z_loss_weight * jnp.square(logz)
-    return ce
+    return token_cross_entropy(logits, targets, z_loss_weight)
 
 
 def chunked_cross_entropy(

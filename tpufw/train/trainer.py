@@ -51,15 +51,12 @@ def cross_entropy_loss(
 ) -> tuple[jax.Array, jax.Array]:
     """Token CE with z-loss regularization (keeps the softmax normalizer
     bounded — standard for large-vocab LM training). Returns (loss, n_tokens).
+    The per-token math lives in tpufw.ops.loss.token_cross_entropy, shared
+    with the chunked-vocab path.
     """
-    logits = logits.astype(jnp.float32)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    label_logits = jnp.take_along_axis(
-        logits, targets[..., None], axis=-1
-    )[..., 0]
-    ce = logz - label_logits
-    if z_loss_weight:
-        ce = ce + z_loss_weight * jnp.square(logz)
+    from tpufw.ops.loss import token_cross_entropy
+
+    ce = token_cross_entropy(logits, targets, z_loss_weight)
     if mask is None:
         return ce.mean(), jnp.array(ce.size, jnp.float32)
     n = jnp.maximum(mask.sum(), 1.0)
@@ -94,7 +91,10 @@ def head_kernel(params) -> jax.Array:
 
 
 def train_step(
-    state: TrainState, batch: dict, loss_chunk_size: Optional[int] = None
+    state: TrainState,
+    batch: dict,
+    loss_chunk_size: Optional[int] = None,
+    loss_chunk_dtype: str = "bfloat16",
 ) -> tuple[TrainState, dict]:
     """One fwd+bwd+update. batch: tokens [B,T] (+ optional loss_mask,
     segment_ids). Targets are tokens shifted left; the final position is
@@ -134,6 +134,7 @@ def train_step(
             loss, _ = chunked_cross_entropy(
                 out, head_kernel(params), targets, mask,
                 chunk_size=loss_chunk_size,
+                compute_dtype=jnp.dtype(loss_chunk_dtype),
             )
         else:
             loss, _ = cross_entropy_loss(out, targets, mask)
@@ -174,6 +175,10 @@ class TrainerConfig:
     checkpoint_every: int = 1000
     # Sequence positions per chunked-CE scan step; None = full logits.
     loss_chunk_size: Optional[int] = None
+    # Head-matmul input dtype for the chunked path. "bfloat16" is the MXU
+    # fast path (fp32 accumulation either way); "float32" restores bitwise
+    # full-logits numerics at ~2x head-matmul cost.
+    loss_chunk_dtype: str = "bfloat16"
     # XProf capture: trace steps [profile_start, profile_stop) into
     # profile_dir (None disables). Step 0 is excluded by default so the
     # window holds steady-state steps, not the XLA compile.
@@ -292,7 +297,9 @@ class Trainer:
             batch_sharding = {k: row for k in key}
             self._compiled[key] = jax.jit(
                 partial(
-                    train_step, loss_chunk_size=self.cfg.loss_chunk_size
+                    train_step,
+                    loss_chunk_size=self.cfg.loss_chunk_size,
+                    loss_chunk_dtype=self.cfg.loss_chunk_dtype,
                 ),
                 in_shardings=(self.state_sharding, batch_sharding),
                 out_shardings=(self.state_sharding, None),

@@ -57,6 +57,8 @@ def build_trainer():
         checkpoint_every=env_int("checkpoint_every", 100),
         # 0/unset = full logits; >0 enables chunked-vocab CE.
         loss_chunk_size=env_int("loss_chunk_size", 512) or None,
+        # "float32" restores exact full-logits numerics (slower head).
+        loss_chunk_dtype=env_str("loss_chunk_dtype", "bfloat16"),
         profile_dir=env_str("profile_dir", "") or None,
         profile_start=env_int("profile_start", 3),
         profile_stop=env_int("profile_stop", 6),
