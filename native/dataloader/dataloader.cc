@@ -1,0 +1,239 @@
+#include "dataloader.h"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+#include <numeric>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+struct Mmap {
+  const uint8_t* data = nullptr;
+  size_t size = 0;
+
+  bool Open(const char* path) {
+    int fd = ::open(path, O_RDONLY | O_CLOEXEC);
+    if (fd < 0) {
+      g_error = std::string("open failed: ") + path;
+      return false;
+    }
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size < 0) {
+      g_error = std::string("stat failed: ") + path;
+      ::close(fd);
+      return false;
+    }
+    size = static_cast<size_t>(st.st_size);
+    if (size) {
+      void* p = mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+      if (p == MAP_FAILED) {
+        g_error = std::string("mmap failed: ") + path;
+        ::close(fd);
+        return false;
+      }
+      data = static_cast<const uint8_t*>(p);
+    }
+    ::close(fd);
+    return true;
+  }
+
+  void Close() {
+    if (data) munmap(const_cast<uint8_t*>(data), size);
+    data = nullptr;
+  }
+};
+
+// splitmix64 — tiny deterministic RNG for the epoch shuffle.
+uint64_t SplitMix64(uint64_t& state) {
+  uint64_t z = (state += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+struct Loader {
+  Mmap bin, idx;
+  const uint32_t* tokens = nullptr;
+  const uint64_t* offsets = nullptr;  // n_docs + 1 entries
+  uint64_t n_docs = 0;
+
+  // Epoch iteration state.
+  std::vector<uint64_t> order;
+  uint64_t doc_pos = 0;     // index into order
+  uint64_t intra_doc = 0;   // tokens of current doc already consumed
+  bool exhausted = true;
+
+  // Current (partially filled) row, carried across batches.
+  std::vector<int32_t> row_tokens, row_segs;
+  int32_t seg = 1;
+};
+
+bool FillRowsFromDocs(Loader* L, int32_t seq) {
+  // Consume docs until the current row is full or the epoch runs dry.
+  while (static_cast<int32_t>(L->row_tokens.size()) < seq) {
+    if (L->doc_pos >= L->n_docs) return false;  // dry
+    uint64_t doc = L->order[L->doc_pos];
+    uint64_t start = L->offsets[doc] + L->intra_doc;
+    uint64_t end = L->offsets[doc + 1];
+    if (start >= end) {  // empty doc or fully consumed
+      ++L->doc_pos;
+      L->intra_doc = 0;
+      continue;
+    }
+    uint64_t space = seq - L->row_tokens.size();
+    uint64_t take = std::min<uint64_t>(space, end - start);
+    for (uint64_t i = 0; i < take; ++i) {
+      L->row_tokens.push_back(static_cast<int32_t>(L->tokens[start + i]));
+      L->row_segs.push_back(L->seg);
+    }
+    L->seg += 1;
+    L->intra_doc += take;
+    if (L->offsets[doc] + L->intra_doc >= end) {
+      ++L->doc_pos;
+      L->intra_doc = 0;
+    }
+  }
+  return true;
+}
+
+void EmitRow(Loader* L, int32_t seq, int32_t* toks, int32_t* segs,
+             float* mask) {
+  size_t n = L->row_tokens.size();
+  for (int32_t i = 0; i < seq; ++i) {
+    bool real = static_cast<size_t>(i) < n;
+    toks[i] = real ? L->row_tokens[i] : 0;
+    segs[i] = real ? L->row_segs[i] : 0;
+    mask[i] = real ? 1.0f : 0.0f;
+  }
+  L->row_tokens.clear();
+  L->row_segs.clear();
+  L->seg = 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tpufwdata_open(const char* bin_path, const char* idx_path) {
+  auto* L = new Loader();
+  if (!L->bin.Open(bin_path) || !L->idx.Open(idx_path)) {
+    tpufwdata_close(L);
+    return nullptr;
+  }
+  if (L->idx.size < sizeof(uint64_t) || L->idx.size % sizeof(uint64_t)) {
+    g_error = "idx file must hold >=1 uint64 offsets";
+    tpufwdata_close(L);
+    return nullptr;
+  }
+  L->tokens = reinterpret_cast<const uint32_t*>(L->bin.data);
+  L->offsets = reinterpret_cast<const uint64_t*>(L->idx.data);
+  L->n_docs = L->idx.size / sizeof(uint64_t) - 1;
+  uint64_t total = L->offsets[L->n_docs];
+  if (total * sizeof(uint32_t) != L->bin.size) {
+    g_error = "idx final offset does not match bin token count";
+    tpufwdata_close(L);
+    return nullptr;
+  }
+  // Every offset must be monotonic: a corrupt intermediate offset would
+  // send FillRowsFromDocs reading past the mmap.
+  for (uint64_t i = 0; i < L->n_docs; ++i) {
+    if (L->offsets[i] > L->offsets[i + 1]) {
+      g_error = "idx offsets are not monotonically non-decreasing";
+      tpufwdata_close(L);
+      return nullptr;
+    }
+  }
+  return L;
+}
+
+void tpufwdata_close(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  if (!L) return;
+  L->bin.Close();
+  L->idx.Close();
+  delete L;
+}
+
+const char* tpufwdata_error() { return g_error.c_str(); }
+
+uint64_t tpufwdata_n_docs(void* handle) {
+  return static_cast<Loader*>(handle)->n_docs;
+}
+
+uint64_t tpufwdata_n_tokens(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  return L->offsets[L->n_docs];
+}
+
+void tpufwdata_begin_epoch(void* handle, int shuffle, uint64_t seed,
+                           uint64_t epoch) {
+  auto* L = static_cast<Loader*>(handle);
+  L->order.resize(L->n_docs);
+  std::iota(L->order.begin(), L->order.end(), 0);
+  if (shuffle && L->n_docs > 1) {
+    uint64_t state = seed * 0x2545F4914F6CDD1DULL + epoch + 1;
+    for (uint64_t i = L->n_docs - 1; i > 0; --i) {
+      uint64_t j = SplitMix64(state) % (i + 1);
+      std::swap(L->order[i], L->order[j]);
+    }
+  }
+  L->doc_pos = 0;
+  L->intra_doc = 0;
+  L->row_tokens.clear();
+  L->row_segs.clear();
+  L->seg = 1;
+  L->exhausted = false;
+}
+
+int tpufwdata_next_batch(void* handle, int32_t batch, int32_t seq,
+                         int32_t* out_tokens, int32_t* out_segments,
+                         float* out_loss_mask) {
+  auto* L = static_cast<Loader*>(handle);
+  if (L->exhausted) return 0;
+  int32_t rows = 0;
+  bool dry = false;
+  for (; rows < batch; ++rows) {
+    if (!FillRowsFromDocs(L, seq)) {
+      dry = true;
+      break;
+    }
+    EmitRow(L, seq, out_tokens + static_cast<size_t>(rows) * seq,
+            out_segments + static_cast<size_t>(rows) * seq,
+            out_loss_mask + static_cast<size_t>(rows) * seq);
+  }
+  if (!dry) return 1;
+  // Epoch ran dry mid-batch: flush any partial row, pad out empty rows —
+  // mirrors pack_documents' tail handling. An entirely empty batch (dry
+  // hit on row 0 with nothing carried) emits nothing.
+  bool have_partial = !L->row_tokens.empty();
+  if (rows == 0 && !have_partial) {
+    L->exhausted = true;
+    return 0;
+  }
+  if (have_partial) {
+    EmitRow(L, seq, out_tokens + static_cast<size_t>(rows) * seq,
+            out_segments + static_cast<size_t>(rows) * seq,
+            out_loss_mask + static_cast<size_t>(rows) * seq);
+    ++rows;
+  }
+  for (; rows < batch; ++rows) {
+    int32_t* t = out_tokens + static_cast<size_t>(rows) * seq;
+    int32_t* s = out_segments + static_cast<size_t>(rows) * seq;
+    float* m = out_loss_mask + static_cast<size_t>(rows) * seq;
+    std::memset(t, 0, sizeof(int32_t) * seq);
+    std::memset(s, 0, sizeof(int32_t) * seq);
+    for (int32_t i = 0; i < seq; ++i) m[i] = 0.0f;
+  }
+  L->exhausted = true;
+  return 1;
+}
+
+}  // extern "C"

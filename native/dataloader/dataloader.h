@@ -1,0 +1,48 @@
+// tpufw native data loader: mmap'd token corpus -> packed LM batches.
+//
+// The reference delegates its data path entirely (there is none — its
+// workload is `nvidia-smi`, reference README.md:314); a training framework
+// needs one, and the packing loop is the reference-stack role (GPU
+// dataloader workers) implemented native per the runtime-in-C++ design:
+// the packer walks millions of small docs per epoch, which is Python-loop
+// territory only a compiled loop keeps off the step path.
+//
+// Corpus format (the Megatron/nanoGPT-style flat layout):
+//   <prefix>.bin  — uint32 tokens, all docs concatenated
+//   <prefix>.idx  — uint64 little-endian doc START offsets (n_docs+1
+//                   entries; last = total token count)
+//
+// Packing semantics are EXACTLY tpufw.train.data.pack_documents: greedy
+// row fill, docs split across rows/batches, per-row segment ids starting
+// at 1, zero-padded tails, trailing partial batch padded with empty rows.
+// Parity is pinned by tests/test_native_data.py.
+#pragma once
+
+#include <cstdint>
+
+extern "C" {
+
+// Opens a corpus; returns an opaque handle or null (see tpufwdata_error).
+void* tpufwdata_open(const char* bin_path, const char* idx_path);
+void tpufwdata_close(void* handle);
+
+// Last error message for a failed open (thread-local, static storage).
+const char* tpufwdata_error();
+
+uint64_t tpufwdata_n_docs(void* handle);
+uint64_t tpufwdata_n_tokens(void* handle);
+
+// Start an epoch: doc order is identity when shuffle=0, else a
+// deterministic permutation from (seed, epoch).
+void tpufwdata_begin_epoch(void* handle, int shuffle, uint64_t seed,
+                           uint64_t epoch);
+
+// Fill one packed batch. out_tokens/out_segments are [batch*seq] int32,
+// out_loss_mask is [batch*seq] float32 (1.0 on real tokens). Returns 1
+// if a batch was produced, 0 when the epoch is exhausted (call
+// begin_epoch again for the next one).
+int tpufwdata_next_batch(void* handle, int32_t batch, int32_t seq,
+                         int32_t* out_tokens, int32_t* out_segments,
+                         float* out_loss_mask);
+
+}  // extern "C"

@@ -124,6 +124,32 @@ def test_top_p_keeps_nucleus():
     np.testing.assert_array_equal(apply_top_p(logits, 1.0), logits)
 
 
+def test_top_p_zero_keeps_top_token():
+    logits = jnp.asarray([[2.0, 1.0, 0.0, -1.0]])
+    masked = apply_top_p(logits, 0.0)
+    assert masked[0, 0] == 2.0  # degrades to greedy, never mask-all
+    assert all(masked[0, i] < -1e29 for i in (1, 2, 3))
+
+
+def test_generate_rejects_zero_new_tokens(llama_params):
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate_text(
+            Llama(TINY.decode_config()), llama_params, [[1, 2]],
+            max_new_tokens=0,
+        )
+
+
+def test_cache_guard_off_by_one(llama_params):
+    """p + n - 1 == max_seq_len is valid (last token never fed back)."""
+    decode_model = Llama(TINY.decode_config())
+    p = TINY.max_seq_len - 4
+    out = generate_text(
+        decode_model, llama_params, [list(range(1, p + 1))],
+        max_new_tokens=5,
+    )[0]
+    assert len(out) == 5
+
+
 def test_sampled_generation_respects_vocab(llama_params):
     decode_model = Llama(TINY.decode_config())
     out = generate_text(

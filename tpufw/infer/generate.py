@@ -70,10 +70,13 @@ def generate(
         ``pad_id`` from then on.
     """
     b, p = prompt_tokens.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     max_seq = getattr(getattr(model, "cfg", None), "max_seq_len", None)
-    if max_seq is not None and p + max_new_tokens > max_seq:
-        # Past max_seq_len the cache cursor clamps and silently overwrites
-        # the last slot — fail at trace time instead.
+    # Only p + max_new_tokens - 1 slots are written (the final sampled
+    # token is never fed back). Past max_seq_len the cache cursor clamps
+    # and silently overwrites the last slot — fail at trace time instead.
+    if max_seq is not None and p + max_new_tokens - 1 > max_seq:
         raise ValueError(
             f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"the KV cache (max_seq_len={max_seq})"

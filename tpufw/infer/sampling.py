@@ -41,8 +41,10 @@ def apply_top_p(logits: jax.Array, p: float) -> jax.Array:
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    # Token i is kept while the mass BEFORE it is < p.
+    # Token i is kept while the mass BEFORE it is < p; the top token always
+    # survives (p <= 0 must degrade to greedy-candidates, not mask-all).
     keep_sorted = (cum - probs) < p
+    keep_sorted = keep_sorted.at[..., 0].set(True)
     # Threshold = smallest kept logit; everything below it is masked.
     threshold = jnp.min(
         jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True

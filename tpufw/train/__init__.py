@@ -10,6 +10,11 @@ from tpufw.train.trainer import (  # noqa: F401
 from tpufw.train.metrics import Meter, StepMetrics  # noqa: F401
 from tpufw.train.checkpoint import CheckpointManager  # noqa: F401
 from tpufw.train.data import pack_documents, synthetic_batches  # noqa: F401
+from tpufw.train.native_data import (  # noqa: F401
+    TokenCorpus,
+    write_token_corpus,
+)
+from tpufw.train.prefetch import prefetch_to_device  # noqa: F401
 from tpufw.train.vision import (  # noqa: F401
     VisionTrainer,
     VisionTrainerConfig,

@@ -1,0 +1,187 @@
+"""Native token-corpus loader: ctypes over libtpufwdata.so (native/).
+
+The C++ packer (native/dataloader) walks an mmap'd corpus and fills
+preallocated numpy buffers — the per-doc packing loop never runs in
+Python. Falls back to the pure-Python ``pack_documents`` pipeline when the
+native library isn't built, so tests and dev boxes work either way. With
+``shuffle=False`` the two paths are bit-identical (pinned by
+tests/test_native_data.py); with ``shuffle=True`` the permutations differ
+(splitmix64 vs numpy) — a warning is logged because data ORDER then
+depends on which path loaded.
+
+Corpus layout (<prefix>.bin / <prefix>.idx) is documented in
+native/dataloader/dataloader.h; ``write_token_corpus`` produces it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+_DEFAULT_LIB_CANDIDATES = (
+    os.path.join(
+        os.path.dirname(__file__), "..", "..", "build-native",
+        "libtpufwdata.so",
+    ),
+    "/opt/tpufw/libtpufwdata.so",
+)
+
+
+def write_token_corpus(
+    prefix: str, docs: Sequence[Sequence[int]]
+) -> tuple[str, str]:
+    """Write docs as <prefix>.bin (uint32 tokens) + <prefix>.idx (uint64
+    doc-start offsets, n_docs+1 entries). Returns the two paths."""
+    bin_path, idx_path = prefix + ".bin", prefix + ".idx"
+    offsets = [0]
+    with open(bin_path, "wb") as f:
+        for d in docs:
+            arr = np.asarray(d, np.uint32)
+            f.write(arr.tobytes())
+            offsets.append(offsets[-1] + arr.size)
+    np.asarray(offsets, np.uint64).tofile(idx_path)
+    return bin_path, idx_path
+
+
+def _load_lib(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
+    candidates = [path] if path else [
+        os.environ.get("TPUFWDATA_LIB"), *_DEFAULT_LIB_CANDIDATES
+    ]
+    for c in candidates:
+        if c and os.path.exists(c):
+            lib = ctypes.CDLL(os.path.abspath(c))
+            lib.tpufwdata_open.restype = ctypes.c_void_p
+            lib.tpufwdata_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+            lib.tpufwdata_close.argtypes = [ctypes.c_void_p]
+            lib.tpufwdata_error.restype = ctypes.c_char_p
+            lib.tpufwdata_n_docs.restype = ctypes.c_uint64
+            lib.tpufwdata_n_docs.argtypes = [ctypes.c_void_p]
+            lib.tpufwdata_n_tokens.restype = ctypes.c_uint64
+            lib.tpufwdata_n_tokens.argtypes = [ctypes.c_void_p]
+            lib.tpufwdata_begin_epoch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
+                ctypes.c_uint64,
+            ]
+            lib.tpufwdata_next_batch.restype = ctypes.c_int
+            lib.tpufwdata_next_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_float),
+            ]
+            return lib
+    return None
+
+
+class TokenCorpus:
+    """Iterator factory over a packed token corpus.
+
+    ``epochs=None`` streams forever (reshuffling per epoch when ``shuffle``);
+    an integer stops after that many passes — mirrors what the trainer's
+    ``total_steps`` expects either way.
+    """
+
+    def __init__(
+        self,
+        prefix: str,
+        batch_size: int,
+        seq_len: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        epochs: Optional[int] = None,
+        lib_path: Optional[str] = None,
+    ):
+        self.prefix = prefix
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epochs = epochs
+        self._lib = _load_lib(lib_path)
+
+    @property
+    def native(self) -> bool:
+        return self._lib is not None
+
+    def __iter__(self) -> Iterator[dict]:
+        if self._lib is not None:
+            yield from self._iter_native()
+        else:
+            yield from self._iter_python()
+
+    def _iter_native(self) -> Iterator[dict]:
+        lib = self._lib
+        handle = lib.tpufwdata_open(
+            (self.prefix + ".bin").encode(), (self.prefix + ".idx").encode()
+        )
+        if not handle:
+            raise FileNotFoundError(
+                f"tpufwdata_open({self.prefix}): "
+                f"{lib.tpufwdata_error().decode()}"
+            )
+        try:
+            epoch = 0
+            while self.epochs is None or epoch < self.epochs:
+                lib.tpufwdata_begin_epoch(
+                    handle, int(self.shuffle), self.seed, epoch
+                )
+                while True:
+                    toks = np.empty(
+                        (self.batch_size, self.seq_len), np.int32
+                    )
+                    segs = np.empty_like(toks)
+                    mask = np.empty(
+                        (self.batch_size, self.seq_len), np.float32
+                    )
+                    ok = lib.tpufwdata_next_batch(
+                        handle, self.batch_size, self.seq_len,
+                        toks.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                        segs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    )
+                    if not ok:
+                        break
+                    yield {
+                        "tokens": toks,
+                        "segment_ids": segs,
+                        "loss_mask": mask,
+                    }
+                epoch += 1
+        finally:
+            lib.tpufwdata_close(handle)
+
+    def _docs(self, epoch: int) -> Iterator[np.ndarray]:
+        tokens = np.memmap(self.prefix + ".bin", np.uint32, "r")
+        offsets = np.fromfile(self.prefix + ".idx", np.uint64)
+        order = np.arange(len(offsets) - 1)
+        if self.shuffle:
+            # Note: python fallback shuffle order differs from native's
+            # splitmix64 permutation; only shuffle=False is bit-identical.
+            order = np.random.default_rng(
+                (self.seed, epoch)
+            ).permutation(order)
+        for d in order:
+            yield np.asarray(
+                tokens[int(offsets[d]):int(offsets[d + 1])], np.int32
+            )
+
+    def _iter_python(self) -> Iterator[dict]:
+        from tpufw.train.data import pack_documents
+
+        if self.shuffle:
+            import logging
+
+            logging.getLogger("tpufw.data").warning(
+                "libtpufwdata.so not found: python fallback shuffles in a "
+                "DIFFERENT order than the native loader — runs are not "
+                "reproducible across the two (build native/ to pin order)"
+            )
+        epoch = 0
+        while self.epochs is None or epoch < self.epochs:
+            yield from pack_documents(
+                self._docs(epoch), self.batch_size, self.seq_len
+            )
+            epoch += 1

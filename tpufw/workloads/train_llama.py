@@ -102,10 +102,27 @@ def main() -> int:
 
     cfg = trainer.cfg
     flops_per_token = model_cfg.flops_per_token(cfg.seq_len - 1)
-    data = synthetic_batches(
-        cfg.batch_size, cfg.seq_len, model_cfg.vocab_size,
-        seed=env_int("data_seed", 0),
-    )
+    data_prefix = env_str("data_prefix", "")
+    if data_prefix:
+        # Real corpus (native/ mmap packer; TPUFW_DATA_PREFIX points at the
+        # <prefix>.bin/.idx pair) with H2D transfer prefetched off the
+        # step path.
+        from tpufw.train import TokenCorpus, prefetch_to_device
+
+        data = prefetch_to_device(
+            iter(
+                TokenCorpus(
+                    data_prefix, cfg.batch_size, cfg.seq_len,
+                    shuffle=True, seed=env_int("data_seed", 0),
+                )
+            ),
+            trainer.mesh,
+        )
+    else:
+        data = synthetic_batches(
+            cfg.batch_size, cfg.seq_len, model_cfg.vocab_size,
+            seed=env_int("data_seed", 0),
+        )
     history = trainer.run(
         data,
         model_flops_per_token=flops_per_token,
