@@ -9,7 +9,9 @@ measured MFU / 0.35 — the BASELINE.json north-star MFU target. >1.0 beats
 the target.
 
 Runs on whatever jax.devices() provides: the driver's single v5e chip, or a
-CPU fallback (still one JSON line, flagged "platform": "cpu").
+CPU fallback (still one JSON line, flagged "platform": "cpu"). On TPU it
+tries descending batch tiers so an OOM on the big config degrades to a
+smaller measured number instead of a failed run.
 """
 
 from __future__ import annotations
@@ -20,6 +22,31 @@ import statistics
 import sys
 
 import jax
+
+
+def _run_tier(model_cfg, batch_size, seq_len, warmup, measured, chunk):
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import Llama
+    from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+    trainer = Trainer(
+        Llama(model_cfg),
+        TrainerConfig(
+            batch_size=batch_size,
+            seq_len=seq_len,
+            total_steps=warmup + measured,
+            lr=1e-4,
+            warmup_steps=2,
+            loss_chunk_size=chunk,
+        ),
+        MeshConfig(),  # all devices on fsdp
+    )
+    trainer.init_state()
+    data = synthetic_batches(batch_size, seq_len, model_cfg.vocab_size)
+    return trainer.run(
+        data,
+        model_flops_per_token=model_cfg.flops_per_token(seq_len - 1),
+    )
 
 
 def main() -> None:
@@ -39,42 +66,45 @@ def main() -> None:
     on_tpu = platform == "tpu" or "tpu" in devices[0].device_kind.lower()
 
     from tpufw.configs import BENCH_CONFIG_NAME, bench_model_config
-    from tpufw.mesh import MeshConfig
-    from tpufw.models import Llama, LLAMA_CONFIGS
-    from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+    from tpufw.models import LLAMA_CONFIGS
     from tpufw.utils import detect_chip
 
     if on_tpu:
         model_cfg = bench_model_config()
+        name = BENCH_CONFIG_NAME
+        warmup, measured = 3, 10
         # fp32 params+Adam for 600M is ~9.6G of 16G HBM. Full fp32 logits
         # capped the batch at 4 (measured: 6/8 OOM); chunked-vocab CE
         # (tpufw.ops.loss) keeps peak logits at one 512-position chunk and
-        # unlocks batch 8.
-        batch_size, seq_len = 8, 2048
-        warmup, measured = 3, 10
-        name = BENCH_CONFIG_NAME
+        # unlocks batch 8. Tiers: degrade on OOM rather than fail.
+        tiers = [(8, 2048, 512), (4, 2048, 512), (4, 2048, None)]
     else:  # keep the CPU path fast but real
         model_cfg = LLAMA_CONFIGS["llama3_tiny"]
-        batch_size, seq_len = 4, 128
-        warmup, measured = 1, 3
         name = "llama3_tiny_cpu"
+        warmup, measured = 1, 3
+        # Batch must divide over every device (data+fsdp row sharding).
+        tiers = [(max(4, len(devices)), 128, None)]
 
-    trainer = Trainer(
-        Llama(model_cfg),
-        TrainerConfig(
-            batch_size=batch_size,
-            seq_len=seq_len,
-            total_steps=warmup + measured,
-            lr=1e-4,
-            warmup_steps=2,
-            loss_chunk_size=512,
-        ),
-        MeshConfig(),  # all devices on fsdp
-    )
-    trainer.init_state()
-    flops_per_token = model_cfg.flops_per_token(seq_len - 1)
-    data = synthetic_batches(batch_size, seq_len, model_cfg.vocab_size)
-    history = trainer.run(data, model_flops_per_token=flops_per_token)
+    history = None
+    last_err = None
+    for batch_size, seq_len, chunk in tiers:
+        try:
+            history = _run_tier(
+                model_cfg, batch_size, seq_len, warmup, measured, chunk
+            )
+            break
+        except Exception as e:  # OOM on a tier -> try the next one down
+            print(
+                f"bench tier (batch={batch_size}, chunk={chunk}) failed: "
+                f"{type(e).__name__}: {e}; falling back",
+                file=sys.stderr,
+            )
+            # Drop the traceback: its _run_tier frame pins the failed
+            # tier's trainer (params + Adam state in HBM), which would
+            # keep the very memory pressure the fallback needs released.
+            last_err = type(e)(str(e))
+    if history is None:
+        raise last_err
 
     steady = history[warmup:]
     tps = statistics.median(m.tokens_per_sec_per_chip for m in steady)
@@ -94,6 +124,7 @@ def main() -> None:
                 "n_devices": len(devices),
                 "batch_size": batch_size,
                 "seq_len": seq_len,
+                "loss_chunk_size": chunk,
                 "model_params": model_cfg.n_params(),
                 "final_loss": round(history[-1].loss, 4),
             }

@@ -74,3 +74,39 @@ def test_logical_rules_cover_model_axes():
     for name in ("batch", "embed", "mlp", "heads", "vocab", "expert", "act_seq"):
         assert name in rules
     assert rules["expert"] == ("expert",)
+
+
+def test_dcn_multislice_mesh(devices8):
+    """dcn_data=2 x per-slice (fsdp=2, tensor=2): data axis spans slices."""
+    mesh = build_mesh(MeshConfig(dcn_data=2, data=1, fsdp=2, tensor=2))
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["fsdp"] == 2
+    assert mesh.shape["tensor"] == 2
+    # DCN is the slowest-varying dim: slice 0 = first 4 devices.
+    flat = mesh.devices.reshape(2, -1)
+    ids = [[d.id for d in row] for row in flat]
+    assert ids[0] == [0, 1, 2, 3] and ids[1] == [4, 5, 6, 7]
+
+
+def test_dcn_multislice_trains(devices8):
+    """One train step over a 2-slice hybrid mesh (dp over DCN, fsdp in-slice)."""
+    from tpufw.models import Llama, LLAMA_CONFIGS
+    from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+    tiny = LLAMA_CONFIGS["llama3_tiny"]
+    trainer = Trainer(
+        Llama(tiny),
+        TrainerConfig(batch_size=8, seq_len=17, total_steps=2, lr=1e-3),
+        MeshConfig(dcn_data=2, fsdp=2, tensor=2),
+    )
+    trainer.init_state()
+    hist = trainer.run(
+        synthetic_batches(8, 17, tiny.vocab_size),
+        model_flops_per_token=tiny.flops_per_token(16),
+    )
+    assert len(hist) == 2 and np.isfinite(hist[-1].loss)
+
+
+def test_dcn_indivisible_raises(devices8):
+    with pytest.raises(ValueError, match="DCN"):
+        build_mesh(MeshConfig(dcn_data=3))

@@ -52,15 +52,26 @@ MESH_AXES: tuple[str, ...] = (
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
-    """Sizes for the five named mesh axes. -1 on at most one axis = "fill"."""
+    """Sizes for the five named mesh axes. -1 on at most one axis = "fill".
+
+    ``dcn_data`` > 1 declares a multi-slice deployment: that many ICI
+    slices joined over DCN, with pure data parallelism across slices (the
+    only parallelism whose collectives amortize over DCN's bandwidth).
+    The other five sizes then describe ONE slice; the built mesh's
+    ``data`` axis has size ``dcn_data * data`` with DCN as the
+    slowest-varying dimension, so every other axis's collectives stay
+    inside a slice's ICI domain.
+    """
 
     data: int = 1
     fsdp: int = -1
     expert: int = 1
     sequence: int = 1
     tensor: int = 1
+    dcn_data: int = 1
 
     def sizes(self, n_devices: int) -> dict[str, int]:
+        """Per-slice axis sizes (n_devices = devices in one slice)."""
         raw = {
             AXIS_DATA: self.data,
             AXIS_FSDP: self.fsdp,
@@ -108,6 +119,30 @@ def build_mesh(
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
+    if config.dcn_data > 1:
+        if len(devices) % config.dcn_data:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into "
+                f"{config.dcn_data} DCN slices"
+            )
+        sizes = config.sizes(len(devices) // config.dcn_data)
+        shape = tuple(sizes[a] for a in MESH_AXES)
+        dcn_shape = tuple(
+            config.dcn_data if a == AXIS_DATA else 1 for a in MESH_AXES
+        )
+        if devices[0].platform == "tpu":
+            # Real slices: let a genuine misconfiguration (wrong slice
+            # count / ICI-incompatible shape) raise — a silent reshape
+            # would put per-step collectives over DCN.
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                shape, dcn_shape, devices=devices
+            )
+        else:
+            # CPU/virtual devices carry no slice_index: emulate with DCN as
+            # the slowest-varying dim (same layout the hybrid mesh yields).
+            combined = tuple(a * b for a, b in zip(dcn_shape, shape))
+            dev_array = np.array(devices).reshape(combined)
+        return Mesh(dev_array, MESH_AXES)
     sizes = config.sizes(len(devices))
     shape = tuple(sizes[a] for a in MESH_AXES)
     if devices[0].platform == "tpu":

@@ -32,7 +32,9 @@ def prefetch_to_device(
     batch layout. Exceptions in the source iterator propagate to the
     consumer at the point of the failed batch.
     """
-    sharding = NamedSharding(mesh, spec or P(("data", "fsdp")))
+    sharding = NamedSharding(
+        mesh, spec if spec is not None else P(("data", "fsdp"))
+    )
     q: queue.Queue = queue.Queue(maxsize=buffer_size)
     abandoned = threading.Event()
 

@@ -69,6 +69,8 @@ def build_trainer():
         expert=env_int("mesh_expert", 1),
         sequence=env_int("mesh_sequence", 1),
         tensor=env_int("mesh_tensor", 1),
+        # >1 = multi-slice: data parallelism across slices over DCN.
+        dcn_data=env_int("mesh_dcn_data", 1),
     )
     return Trainer(model, trainer_cfg, mesh_cfg), model_cfg
 
