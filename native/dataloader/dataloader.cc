@@ -79,7 +79,7 @@ struct Loader {
 bool FillRowsFromDocs(Loader* L, int32_t seq) {
   // Consume docs until the current row is full or the epoch runs dry.
   while (static_cast<int32_t>(L->row_tokens.size()) < seq) {
-    if (L->doc_pos >= L->n_docs) return false;  // dry
+    if (L->doc_pos >= L->order.size()) return false;  // dry (shard end)
     uint64_t doc = L->order[L->doc_pos];
     uint64_t start = L->offsets[doc] + L->intra_doc;
     uint64_t end = L->offsets[doc + 1];
@@ -174,7 +174,8 @@ uint64_t tpufwdata_n_tokens(void* handle) {
 }
 
 void tpufwdata_begin_epoch(void* handle, int shuffle, uint64_t seed,
-                           uint64_t epoch) {
+                           uint64_t epoch, uint32_t shard,
+                           uint32_t num_shards) {
   auto* L = static_cast<Loader*>(handle);
   L->order.resize(L->n_docs);
   std::iota(L->order.begin(), L->order.end(), 0);
@@ -184,6 +185,13 @@ void tpufwdata_begin_epoch(void* handle, int shuffle, uint64_t seed,
       uint64_t j = SplitMix64(state) % (i + 1);
       std::swap(L->order[i], L->order[j]);
     }
+  }
+  if (num_shards > 1) {
+    std::vector<uint64_t> mine;
+    for (uint64_t i = shard; i < L->order.size(); i += num_shards) {
+      mine.push_back(L->order[i]);
+    }
+    L->order = std::move(mine);
   }
   L->doc_pos = 0;
   L->intra_doc = 0;

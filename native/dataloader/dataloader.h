@@ -33,9 +33,12 @@ uint64_t tpufwdata_n_docs(void* handle);
 uint64_t tpufwdata_n_tokens(void* handle);
 
 // Start an epoch: doc order is identity when shuffle=0, else a
-// deterministic permutation from (seed, epoch).
+// deterministic permutation from (seed, epoch). shard/num_shards split
+// the (post-shuffle) doc order round-robin across data-parallel hosts —
+// each host packs a disjoint document subset (num_shards=1 = all docs).
 void tpufwdata_begin_epoch(void* handle, int shuffle, uint64_t seed,
-                           uint64_t epoch);
+                           uint64_t epoch, uint32_t shard,
+                           uint32_t num_shards);
 
 // Fill one packed batch. out_tokens/out_segments are [batch*seq] int32,
 // out_loss_mask is [batch*seq] float32 (1.0 on real tokens). Returns 1

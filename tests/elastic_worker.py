@@ -1,0 +1,82 @@
+"""Worker subprocess for the elastic-recovery (gang restart) test.
+
+Trains tiny-Llama with checkpointing on a 2-process CPU gang. With
+TPUFW_CRASH_AT_STEP set, the process aborts mid-training after that step
+(both workers crash — a JobSet gang restart kills and restarts the whole
+slice, which is the semantics tpufw targets: SURVEY.md §5 failure
+detection / elastic recovery). On restart, Trainer.maybe_restore picks up
+the latest checkpoint and the run completes the remaining steps only.
+
+Prints RESUMED:<step> when it restored, and DONE:<final_step> at the end.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpufw.cluster import initialize_cluster, resolve_cluster_env  # noqa: E402
+
+
+def main():
+    cfg = resolve_cluster_env()
+    initialize_cluster(cfg, timeout_s=60)
+
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import LLAMA_CONFIGS, Llama
+    from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+    tiny = LLAMA_CONFIGS["llama3_tiny"]
+    total_steps = int(os.environ["TPUFW_TOTAL_STEPS"])
+    crash_at = int(os.environ.get("TPUFW_CRASH_AT_STEP", "0"))
+    trainer = Trainer(
+        Llama(tiny),
+        TrainerConfig(
+            batch_size=4,
+            seq_len=17,
+            total_steps=total_steps,
+            lr=1e-3,
+            log_every=1,  # crash hook must see every step
+            checkpoint_dir=os.environ["TPUFW_CHECKPOINT_DIR"],
+            checkpoint_every=2,
+        ),
+        MeshConfig(data=jax.device_count(), fsdp=1),
+    )
+
+    if trainer.maybe_restore():
+        start = int(trainer.state.step)
+        print(f"RESUMED:{start}", flush=True)
+    else:
+        trainer.init_state()
+        start = 0
+
+    steps_left = total_steps - start
+
+    def crash_hook(metrics):
+        if crash_at and metrics.step >= crash_at:
+            # Simulated worker death: skip atexit/orbax cleanup, like a
+            # kill -9'd pod.
+            os._exit(17)
+
+    trainer.cfg.total_steps = steps_left
+    # batch_size is GLOBAL; each process feeds its local shard (seeded by
+    # process_id so shards differ, as a real per-host loader's would).
+    local_bs = 4 // jax.process_count()
+    trainer.run(
+        synthetic_batches(
+            local_bs, 17, tiny.vocab_size, seed=start * 100 + cfg.process_id
+        ),
+        model_flops_per_token=tiny.flops_per_token(16),
+        on_metrics=crash_hook,
+    )
+    print(f"DONE:{int(trainer.state.step)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

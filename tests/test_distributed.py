@@ -18,36 +18,74 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_psum():
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_gang(script: str, n: int, extra_env: dict) -> list:
     port = _free_port()
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    worker = os.path.join(root, "tests", "distributed_worker.py")
     procs = []
-    for pid in range(2):
+    for pid in range(n):
         env = dict(os.environ)
         env.update(
             {
                 "TPUFW_COORDINATOR": f"127.0.0.1:{port}",
-                "TPUFW_NUM_PROCESSES": "2",
+                "TPUFW_NUM_PROCESSES": str(n),
                 "TPUFW_PROCESS_ID": str(pid),
                 # Fresh XLA flags per process (conftest set 8 devices here).
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                **extra_env,
             }
         )
         procs.append(
             subprocess.Popen(
-                [sys.executable, worker],
+                [sys.executable, os.path.join(ROOT, "tests", script)],
                 env=env,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE,
                 text=True,
-                cwd=root,
+                cwd=ROOT,
             )
         )
     outs = []
     for p in procs:
-        out, err = p.communicate(timeout=150)
+        out, err = p.communicate(timeout=240)
         outs.append((p.returncode, out, err))
+    return outs
+
+
+def test_two_process_psum():
+    outs = _spawn_gang("distributed_worker.py", 2, {})
     for rc, out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\nstdout={out}\nstderr={err}"
         assert "PSUM_OK:" in out, out
+
+
+def test_gang_restart_resumes_from_checkpoint(tmp_path):
+    """Chaos tier (SURVEY.md §5 elastic recovery): the whole 2-process gang
+    crashes mid-training (simulated kill), is restarted JobSet-style, and
+    must resume from the latest checkpoint and finish the remaining steps."""
+    ckpt = str(tmp_path / "ckpt")
+    base = {"TPUFW_CHECKPOINT_DIR": ckpt, "TPUFW_TOTAL_STEPS": "8"}
+
+    # Run 1: both workers die after step >= 4 (checkpoints at 2 and 4).
+    outs = _spawn_gang(
+        "elastic_worker.py", 2, {**base, "TPUFW_CRASH_AT_STEP": "4"}
+    )
+    for rc, out, err in outs:
+        assert rc == 17, f"expected simulated crash rc=17, got {rc}\n{err}"
+        assert "RESUMED" not in out
+
+    # Run 2: gang restart — must resume (not restart from step 0) and
+    # complete through step 8. The resume step is whichever async save
+    # had fully flushed before the kill (>=1, <=4) — exactly the
+    # guarantee a kill -9'd pod gets.
+    outs = _spawn_gang("elastic_worker.py", 2, base)
+    for rc, out, err in outs:
+        assert rc == 0, f"restart failed rc={rc}\nstdout={out}\nstderr={err}"
+        resumed = [
+            int(line.split(":")[1])
+            for line in out.splitlines()
+            if line.startswith("RESUMED:")
+        ]
+        assert resumed and 1 <= resumed[0] <= 4, out
+        assert "DONE:8" in out, out

@@ -63,7 +63,7 @@ def _load_lib(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
             lib.tpufwdata_n_tokens.argtypes = [ctypes.c_void_p]
             lib.tpufwdata_begin_epoch.argtypes = [
                 ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
-                ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
             ]
             lib.tpufwdata_next_batch.restype = ctypes.c_int
             lib.tpufwdata_next_batch.argtypes = [
@@ -93,6 +93,8 @@ class TokenCorpus:
         seed: int = 0,
         epochs: Optional[int] = None,
         lib_path: Optional[str] = None,
+        shard_id: int = 0,
+        num_shards: int = 1,
     ):
         self.prefix = prefix
         self.batch_size = batch_size
@@ -100,6 +102,14 @@ class TokenCorpus:
         self.shuffle = shuffle
         self.seed = seed
         self.epochs = epochs
+        if not (0 <= shard_id < num_shards):
+            raise ValueError(
+                f"shard_id {shard_id} out of range for {num_shards} shards"
+            )
+        # Data-parallel hosts pass (process_id, process_count): each packs
+        # a disjoint round-robin subset of the (post-shuffle) doc order.
+        self.shard_id = shard_id
+        self.num_shards = num_shards
         self._lib = _load_lib(lib_path)
 
     @property
@@ -126,7 +136,8 @@ class TokenCorpus:
             epoch = 0
             while self.epochs is None or epoch < self.epochs:
                 lib.tpufwdata_begin_epoch(
-                    handle, int(self.shuffle), self.seed, epoch
+                    handle, int(self.shuffle), self.seed, epoch,
+                    self.shard_id, self.num_shards,
                 )
                 while True:
                     toks = np.empty(
@@ -163,6 +174,7 @@ class TokenCorpus:
             order = np.random.default_rng(
                 (self.seed, epoch)
             ).permutation(order)
+        order = order[self.shard_id::self.num_shards]
         for d in order:
             yield np.asarray(
                 tokens[int(offsets[d]):int(offsets[d + 1])], np.int32

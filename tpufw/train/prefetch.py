@@ -35,6 +35,14 @@ def prefetch_to_device(
     sharding = NamedSharding(
         mesh, spec if spec is not None else P(("data", "fsdp"))
     )
+    if jax.process_count() > 1:
+        # Each process's iterator yields its LOCAL rows; assemble into a
+        # global array (device_put with a multi-host sharding is invalid).
+        transfer = lambda x: jax.make_array_from_process_local_data(  # noqa: E731
+            sharding, x
+        )
+    else:
+        transfer = lambda x: jax.device_put(x, sharding)  # noqa: E731
     q: queue.Queue = queue.Queue(maxsize=buffer_size)
     abandoned = threading.Event()
 
@@ -56,9 +64,7 @@ def prefetch_to_device(
         try:
             try:
                 for batch in batches:
-                    device_batch = jax.tree.map(
-                        lambda x: jax.device_put(x, sharding), batch
-                    )
+                    device_batch = jax.tree.map(transfer, batch)
                     if not put(device_batch):
                         return
             finally:

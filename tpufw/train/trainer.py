@@ -284,6 +284,26 @@ class Trainer:
         finally:
             mgr.close()
 
+    def globalize_batch(self, batch: dict) -> dict:
+        """Multi-process: assemble each process's LOCAL batch shard into a
+        global jax.Array (jit rejects raw numpy under a multi-host mesh).
+
+        Contract: ``cfg.batch_size`` is the GLOBAL batch; each process's
+        data iterator yields ``batch_size / process_count`` rows. In
+        single-process runs this is the identity.
+        """
+        if jax.process_count() == 1:
+            return batch
+        row = NamedSharding(self.mesh, P(("data", "fsdp")))
+        return {
+            # Leaves that are already jax.Arrays (e.g. from
+            # prefetch_to_device) are global already; only raw host
+            # numpy needs assembling.
+            k: v if isinstance(v, jax.Array)
+            else jax.make_array_from_process_local_data(row, v)
+            for k, v in batch.items()
+        }
+
     def compiled_step(self, batch: dict | None = None):
         """Jitted train step; batch shardings derived from the batch's own
         structure (every leaf is batch-major: shard dim 0 on data+fsdp)."""
@@ -341,6 +361,7 @@ class Trainer:
                 for i, batch in enumerate(data):
                     if i >= self.cfg.total_steps:
                         break
+                    batch = self.globalize_batch(batch)
                     step_fn = self.compiled_step(batch)
                     prof.maybe_start(i)
                     meter.start()

@@ -104,26 +104,35 @@ def main() -> int:
 
     cfg = trainer.cfg
     flops_per_token = model_cfg.flops_per_token(cfg.seq_len - 1)
+    # cfg.batch_size is GLOBAL; each process loads its local shard.
+    n_proc = cluster.num_processes
+    if cfg.batch_size % n_proc:
+        raise ValueError(
+            f"global batch {cfg.batch_size} not divisible by "
+            f"{n_proc} processes"
+        )
+    local_bs = cfg.batch_size // n_proc
     data_prefix = env_str("data_prefix", "")
     if data_prefix:
         # Real corpus (native/ mmap packer; TPUFW_DATA_PREFIX points at the
-        # <prefix>.bin/.idx pair) with H2D transfer prefetched off the
-        # step path.
+        # <prefix>.bin/.idx pair): disjoint per-process doc shards, H2D
+        # transfer prefetched off the step path.
         from tpufw.train import TokenCorpus, prefetch_to_device
 
         data = prefetch_to_device(
             iter(
                 TokenCorpus(
-                    data_prefix, cfg.batch_size, cfg.seq_len,
+                    data_prefix, local_bs, cfg.seq_len,
                     shuffle=True, seed=env_int("data_seed", 0),
+                    shard_id=cluster.process_id, num_shards=n_proc,
                 )
             ),
             trainer.mesh,
         )
     else:
         data = synthetic_batches(
-            cfg.batch_size, cfg.seq_len, model_cfg.vocab_size,
-            seed=env_int("data_seed", 0),
+            local_bs, cfg.seq_len, model_cfg.vocab_size,
+            seed=env_int("data_seed", 0) * 1000 + cluster.process_id,
         )
     history = trainer.run(
         data,
