@@ -208,14 +208,24 @@ std::vector<TpuDevice> PluginCore::snapshot_devices() {
 }
 
 std::string PluginCore::Metrics() {
-  std::lock_guard<std::mutex> lock(mu_);
+  // Snapshot under the lock, then read telemetry unlocked: ReadTelemetry
+  // hits sysfs, and a hung attribute (wedged drivers — exactly when metrics
+  // get scraped) must not block the health monitor / ListAndWatch behind
+  // the scrape.
+  std::vector<TpuDevice> devices;
+  uint64_t generation;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    devices = devices_;
+    generation = generation_;
+  }
   std::ostringstream out;
   out << "# HELP tpufw_plugin_devices_total chips discovered on this host\n"
       << "# TYPE tpufw_plugin_devices_total gauge\n"
-      << "tpufw_plugin_devices_total " << devices_.size() << "\n"
+      << "tpufw_plugin_devices_total " << devices.size() << "\n"
       << "# HELP tpufw_plugin_generation bumps on device state change\n"
       << "# TYPE tpufw_plugin_generation counter\n"
-      << "tpufw_plugin_generation " << generation_ << "\n"
+      << "tpufw_plugin_generation " << generation << "\n"
       << "# HELP tpufw_tpu_health 1 = chip healthy (device node answers)\n"
       << "# TYPE tpufw_tpu_health gauge\n"
       << "# HELP tpufw_tpu_duty_cycle_percent chip busy fraction\n"
@@ -226,7 +236,7 @@ std::string PluginCore::Metrics() {
       << "# TYPE tpufw_tpu_hbm_total_bytes gauge\n"
       << "# HELP tpufw_tpu_temperature_celsius chip temperature\n"
       << "# TYPE tpufw_tpu_temperature_celsius gauge\n";
-  for (const auto& d : devices_) {
+  for (const auto& d : devices) {
     const std::string labels =
         "{chip=\"" + d.id + "\",numa=\"" + std::to_string(d.numa_node) +
         "\"}";

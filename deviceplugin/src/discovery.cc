@@ -99,24 +99,81 @@ ChipTelemetry ReadTelemetry(const DiscoveryConfig& cfg, int chip_index) {
     // clusters get non-trivial dashboards.
     t.has_duty = true;
     t.duty_cycle_pct = 50.0 + 5.0 * chip_index;
+    t.duty_source = "(fake)";
     t.has_hbm = true;
     t.hbm_total_bytes = 16LL << 30;
     t.hbm_used_bytes = (1LL + chip_index) << 30;
+    t.hbm_source = "(fake)";
     t.has_temp = true;
     t.temp_c = 40.0 + chip_index;
+    t.temp_source = "(fake)";
     return t;
   }
-  const std::string base =
-      cfg.sysfs_accel + "/accel" + std::to_string(chip_index) + "/device/";
-  t.has_duty = ReadSysfsValue(base + "duty_cycle_pct", &t.duty_cycle_pct);
-  long long used = 0, total = 0;
-  if (ReadSysfsValue(base + "mem_used_bytes", &used) &&
-      ReadSysfsValue(base + "mem_total_bytes", &total)) {
-    t.has_hbm = true;
-    t.hbm_used_bytes = used;
-    t.hbm_total_bytes = total;
+  // Driver generations disagree on attribute names and on whether they
+  // hang off accelN/ or accelN/device/; probe both bases x candidate
+  // names and record what answered (surfaced by tpu_smi).
+  const std::string accel =
+      cfg.sysfs_accel + "/accel" + std::to_string(chip_index);
+  const std::string bases[] = {accel + "/device/", accel + "/"};
+
+  static const char* kDutyNames[] = {"duty_cycle_pct", "duty_cycle",
+                                     "tensorcore_util"};
+  static const std::pair<const char*, const char*> kHbmPairs[] = {
+      {"mem_used_bytes", "mem_total_bytes"},
+      {"hbm_used_bytes", "hbm_total_bytes"},
+      {"memory_used", "memory_total"},
+  };
+  static const char* kTempNames[] = {"temp_c", "temp", "temperature"};
+
+  for (const auto& base : bases) {
+    if (!t.has_duty) {
+      for (const char* name : kDutyNames) {
+        if (ReadSysfsValue(base + name, &t.duty_cycle_pct)) {
+          t.has_duty = true;
+          t.duty_source = base + name;
+          break;
+        }
+      }
+    }
+    if (!t.has_hbm) {
+      for (const auto& [used_n, total_n] : kHbmPairs) {
+        long long used = 0, total = 0;
+        if (ReadSysfsValue(base + used_n, &used) &&
+            ReadSysfsValue(base + total_n, &total)) {
+          t.has_hbm = true;
+          t.hbm_used_bytes = used;
+          t.hbm_total_bytes = total;
+          t.hbm_source = base + used_n;
+          break;
+        }
+      }
+    }
+    if (!t.has_temp) {
+      for (const char* name : kTempNames) {
+        if (ReadSysfsValue(base + name, &t.temp_c)) {
+          t.has_temp = true;
+          t.temp_source = base + name;
+          break;
+        }
+      }
+    }
   }
-  t.has_temp = ReadSysfsValue(base + "temp_c", &t.temp_c);
+  if (!t.has_temp) {
+    // hwmon convention: <accel>/device/hwmon/hwmonK/temp1_input in
+    // millidegrees — the layout PCI-attached accelerators commonly use.
+    std::error_code ec;
+    for (const auto& entry :
+         fs::directory_iterator(accel + "/device/hwmon", ec)) {
+      std::string p = entry.path().string() + "/temp1_input";
+      long long milli = 0;
+      if (ReadSysfsValue(p, &milli)) {
+        t.has_temp = true;
+        t.temp_c = static_cast<double>(milli) / 1000.0;
+        t.temp_source = p;
+        break;
+      }
+    }
+  }
   return t;
 }
 

@@ -109,6 +109,31 @@ int main(int argc, char** argv) {
   std::printf("chips: %d healthy / %zu total%s\n", healthy, devices.size(),
               cfg.fake_devices ? " (FAKE mode)" : "");
 
+  // Telemetry provenance: attribute names vary across TPU driver
+  // generations, so ReadTelemetry probes candidate layouts — print which
+  // sysfs paths actually answered so a real host documents its own layout
+  // (and absent metrics are an explicit statement, not silence).
+  if (!devices.empty()) {
+    int idx0 = std::atoi(
+        devices[0].id.substr(devices[0].id.rfind('-') + 1).c_str());
+    auto t = tpuplugin::ReadTelemetry(cfg, idx0);
+    std::printf("telemetry sources (chip %d):\n", idx0);
+    std::printf("  duty: %s\n",
+                t.has_duty ? t.duty_source.c_str() : "none found");
+    std::printf("  hbm:  %s\n",
+                t.has_hbm ? t.hbm_source.c_str() : "none found");
+    std::printf("  temp: %s\n",
+                t.has_temp ? t.temp_source.c_str() : "none found");
+    if (t.has_duty) {
+      std::printf("  duty_cycle: %.1f%%\n", t.duty_cycle_pct);
+    }
+    if (t.has_hbm) {
+      std::printf("  hbm: %lld / %lld bytes\n", t.hbm_used_bytes,
+                  t.hbm_total_bytes);
+    }
+    if (t.has_temp) std::printf("  temp: %.1fC\n", t.temp_c);
+  }
+
   if (healthy < require_chips) {
     if (allow_none && devices.empty()) return 0;
     std::fprintf(stderr,

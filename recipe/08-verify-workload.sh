@@ -31,3 +31,4 @@ kubectl logs "$POD"
 log "END-TO-END VERIFIED: kubectl apply -> scheduled on google.com/tpu -> device proof in logs"
 log "next: apply deploy/manifests/03-resnet50-v5e1.yaml (single-chip training)"
 log "      or deploy/manifests/05-llama3-8b-v5e16-jobset.yaml (multi-host)"
+log "      or deploy/manifests/07-infer-v5e1.yaml (serving: checkpoint -> generation)"

@@ -51,14 +51,16 @@ def _containers(doc: dict) -> list[dict]:
 
 def test_all_baseline_configs_covered():
     # SURVEY.md §7.3 / BASELINE.md: configs 1-5 each have a manifest, plus
-    # smoke-TPU enablement proof and the shared checkpoint PVC.
+    # smoke-TPU enablement proof, the shared checkpoint PVC, and the
+    # inference serving Job+Service (07, VERDICT r1 item 9).
     names = [p.name for p in MANIFESTS]
-    assert len(names) == 7
+    assert len(names) == 8
     kinds = [d["kind"] for p in MANIFESTS for d in load(p)]
     assert kinds.count("Pod") == 3
-    assert kinds.count("Job") == 1
+    assert kinds.count("Job") == 2
     assert kinds.count("JobSet") == 2
     assert kinds.count("PersistentVolumeClaim") == 1
+    assert kinds.count("Service") == 1
 
 
 def test_tpu_workloads_request_the_extended_resource():
@@ -66,7 +68,7 @@ def test_tpu_workloads_request_the_extended_resource():
     # #1 troubleshooting class; only the CPU smoke pod may omit it.
     for path in MANIFESTS:
         for doc in load(path):
-            if doc["kind"] == "PersistentVolumeClaim":
+            if doc["kind"] in ("PersistentVolumeClaim", "Service"):
                 continue
             for c in _containers(doc):
                 limits = c.get("resources", {}).get("limits", {})
@@ -134,7 +136,7 @@ def test_jobset_models_exist():
     known = set(LLAMA_CONFIGS) | set(MIXTRAL_CONFIGS) | {"llama3_600m_bench"}
     for path in MANIFESTS:
         for doc in load(path):
-            if doc["kind"] == "PersistentVolumeClaim":
+            if doc["kind"] in ("PersistentVolumeClaim", "Service"):
                 continue
             for c in _containers(doc):
                 for e in c.get("env", []):
@@ -147,7 +149,7 @@ def test_workload_modules_exist():
 
     for path in MANIFESTS:
         for doc in load(path):
-            if doc["kind"] == "PersistentVolumeClaim":
+            if doc["kind"] in ("PersistentVolumeClaim", "Service"):
                 continue
             for c in _containers(doc):
                 cmd = c["command"]

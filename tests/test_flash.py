@@ -88,11 +88,76 @@ def test_flash_grads_unaligned_gqa():
         )
 
 
-def test_flash_rejects_segments():
-    q = jnp.zeros((1, 8, 2, 64))
-    with pytest.raises(NotImplementedError):
-        flash_attention(
-            q, q, q, segment_ids=jnp.zeros((1, 8), jnp.int32)
+def _packed_segments(b, t):
+    """Two docs + trailing padding (segment 0), the native_data layout."""
+    seg = np.zeros((b, t), np.int32)
+    c1, c2 = int(t * 0.4), int(t * 0.85)
+    seg[:, :c1] = 1
+    seg[:, c1:c2] = 2
+    return jnp.asarray(seg)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_segments_fwd_matches_xla(causal):
+    """Packed-batch masking: flash must cut cross-segment attention exactly
+    like the xla reference (VERDICT r1 item 2: the production packed-data
+    path must keep the flash kernel)."""
+    b, t, h, kh, d = 2, 256, 4, 2, 64
+    ks = jax.random.split(jax.random.key(7), 3)
+    q = _rand(ks[0], (b, t, h, d))
+    k = _rand(ks[1], (b, t, kh, d))
+    v = _rand(ks[2], (b, t, kh, d))
+    seg = _packed_segments(b, t)
+    ref = xla_attention(q, k, v, causal=causal, segment_ids=seg)
+    out = flash_attention(
+        q, k, v, causal=causal, segment_ids=seg, interpret=True
+    )
+    real = np.asarray(seg) > 0  # pad rows are loss-masked downstream
+    np.testing.assert_allclose(
+        np.asarray(out)[real], np.asarray(ref)[real], atol=2e-5, rtol=2e-5
+    )
+
+
+def test_flash_segments_grads_match_xla():
+    b, t, h, kh, d = 1, 256, 4, 2, 64
+    ks = jax.random.split(jax.random.key(8), 3)
+    q = _rand(ks[0], (b, t, h, d))
+    k = _rand(ks[1], (b, t, kh, d))
+    v = _rand(ks[2], (b, t, kh, d))
+    seg = _packed_segments(b, t)
+    real = jnp.asarray(np.asarray(seg) > 0)[:, :, None, None]
+
+    def loss(attn, q, k, v):
+        out = attn(q, k, v)
+        # Mask pad-row outputs like the trainer's loss mask does; their
+        # in-segment values are arbitrary (all-masked rows).
+        return (jnp.where(real, out, 0.0) ** 2).sum()
+
+    g_flash = jax.grad(
+        lambda q, k, v: loss(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, segment_ids=seg, interpret=True
+            ),
+            q, k, v,
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: loss(
+            lambda q, k, v: xla_attention(
+                q, k, v, causal=True, segment_ids=seg
+            ),
+            q, k, v,
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf),
+            np.asarray(gr),
+            atol=5e-4,
+            rtol=5e-4,
+            err_msg=f"d{name} mismatch",
         )
 
 

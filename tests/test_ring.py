@@ -47,6 +47,62 @@ def test_ring_grads_flow(devices8):
     )
 
 
+def test_ring_grads_separate_args(devices8):
+    """Per-argument grad parity vs xla: tied q=k=v (above) sums dq+dk+dv and
+    can hide bugs that move gradient between them (VERDICT r1 item 5)."""
+    mesh = build_mesh(MeshConfig(sequence=4, fsdp=2))
+    b, t, h, kh, d = 2, 128, 4, 2, 32
+    ks = jax.random.split(jax.random.key(5), 3)
+    q = jax.random.normal(ks[0], (b, t, h, d))
+    k = jax.random.normal(ks[1], (b, t, kh, d))
+    v = jax.random.normal(ks[2], (b, t, kh, d))
+
+    def loss_ring(q, k, v):
+        with use_mesh(mesh):
+            return (ring_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (xla_attention(q, k, v, causal=True) ** 2).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gr, gx, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gr),
+            np.asarray(gx),
+            atol=1e-4,
+            rtol=1e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_segments_match_reference(devices8, causal):
+    """Packed batches through the ring: key-side segment ids rotate with
+    their kv chunk; output must match xla's segment masking."""
+    mesh = build_mesh(MeshConfig(fsdp=2, sequence=4))
+    b, t, h, kh, d = 2, 256, 4, 2, 32
+    ks = jax.random.split(jax.random.key(6), 3)
+    q = jax.random.normal(ks[0], (b, t, h, d))
+    k = jax.random.normal(ks[1], (b, t, kh, d))
+    v = jax.random.normal(ks[2], (b, t, kh, d))
+    seg = np.zeros((b, t), np.int32)
+    seg[:, :100] = 1
+    seg[:, 100:230] = 2  # trailing pad = segment 0
+    seg = jnp.asarray(seg)
+    ref = xla_attention(q, k, v, causal=causal, segment_ids=seg)
+    with use_mesh(mesh):
+        out = jax.jit(
+            lambda q, k, v: ring_attention(
+                q, k, v, causal=causal, segment_ids=seg
+            )
+        )(q, k, v)
+    real = np.asarray(seg) > 0
+    np.testing.assert_allclose(
+        np.asarray(out)[real], np.asarray(ref)[real], atol=2e-5, rtol=2e-5
+    )
+
+
 def test_ring_requires_mesh():
     q = jnp.zeros((1, 16, 2, 8))
     with pytest.raises(ValueError, match="needs a mesh"):

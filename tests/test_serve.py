@@ -1,0 +1,128 @@
+"""Serving workload: checkpoint restore -> batch generate, and HTTP mode.
+
+Covers the 07-infer manifest's code path (VERDICT r1 item 9): a checkpoint
+written by the Trainer is loaded by tpufw.workloads.serve, generation is
+deterministic (greedy), and the HTTP server answers /generate + /healthz.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpufw.mesh import MeshConfig
+from tpufw.models import LLAMA_CONFIGS, Llama
+from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+
+@pytest.fixture()
+def tiny_env(tmp_path, monkeypatch):
+    """Train llama3_tiny for 2 steps, checkpoint it, point TPUFW_* at it."""
+    ckpt = str(tmp_path / "ckpt")
+    cfg = LLAMA_CONFIGS["llama3_tiny"]
+    trainer = Trainer(
+        Llama(cfg),
+        TrainerConfig(
+            batch_size=8,  # divides the 8-device fsdp test mesh
+            seq_len=16,
+            total_steps=2,
+            lr=1e-3,
+            checkpoint_dir=ckpt,
+            checkpoint_every=1,
+        ),
+        MeshConfig(),
+    )
+    trainer.init_state()
+    trainer.run(
+        synthetic_batches(8, 16, cfg.vocab_size),
+        model_flops_per_token=cfg.flops_per_token(15),
+    )
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_CHECKPOINT_DIR", ckpt)
+    monkeypatch.setenv("TPUFW_MAX_NEW_TOKENS", "4")
+    return cfg, trainer
+
+
+def test_batch_generate_restores_checkpoint(tiny_env):
+    from tpufw.workloads.serve import run_batch
+
+    cfg, trainer = tiny_env
+    results = run_batch([[1, 5, 9], [2]], max_new_tokens=4)
+    assert len(results) == 2
+    for r in results:
+        assert r["restored_checkpoint"] is True
+        assert len(r["output"]) == 4
+        assert all(0 <= t < cfg.vocab_size for t in r["output"])
+
+    # Greedy generation from the restored params must equal generation
+    # from the in-memory trained params: restore really round-tripped.
+    from tpufw.infer import SamplingConfig, generate_text
+
+    want = generate_text(
+        Llama(cfg.decode_config()),
+        trainer.state.params,
+        [[1, 5, 9]],
+        max_new_tokens=4,
+        sampling=SamplingConfig(temperature=0.0),
+    )[0]
+    assert results[0]["output"] == want
+
+
+def test_batch_generate_without_checkpoint(monkeypatch, tmp_path):
+    from tpufw.workloads.serve import run_batch
+
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_CHECKPOINT_DIR", str(tmp_path / "empty"))
+    results = run_batch([[3, 1, 4]], max_new_tokens=3)
+    assert results[0]["restored_checkpoint"] is False
+    assert len(results[0]["output"]) == 3
+
+
+def test_http_server_generate(tiny_env):
+    from tpufw.workloads.serve import _Server
+
+    srv = _Server(port=0, max_new_tokens=4)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    # serve_forever resolves port 0 before printing its banner; poll until
+    # the listener is up.
+    import time
+
+    deadline = time.time() + 30
+    while not hasattr(srv, "httpd") and time.time() < deadline:
+        time.sleep(0.05)
+    base = f"http://127.0.0.1:{srv.port}"
+
+    with urllib.request.urlopen(base + "/healthz", timeout=30) as resp:
+        health = json.loads(resp.read())
+    assert health["ok"] is True
+
+    req = urllib.request.Request(
+        base + "/generate",
+        data=json.dumps(
+            {"prompts": [[1, 5, 9], [2, 7]], "max_new_tokens": 3}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        out = json.loads(resp.read())
+    assert len(out["outputs"]) == 2
+    assert all(len(o) == 3 for o in out["outputs"])
+
+    # Bad request -> 400 with an error body, server stays up.
+    bad = urllib.request.Request(
+        base + "/generate",
+        data=json.dumps({"prompts": "nope"}).encode(),
+        method="POST",
+    )
+    try:
+        urllib.request.urlopen(bad, timeout=30)
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    srv.httpd.shutdown()

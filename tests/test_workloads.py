@@ -33,12 +33,17 @@ def test_train_llama_main_env_config(capsys, monkeypatch):
     out = capsys.readouterr().out
     assert "TRAIN OK: 3 steps" in out
     # JSON metric lines are parseable and carry the headline fields.
-    metrics = [
+    lines = [
         json.loads(line) for line in out.splitlines()
         if line.startswith("{")
     ]
+    metrics = [m for m in lines if "loss" in m]
     assert len(metrics) == 3
     assert {"loss", "tokens_per_sec_per_chip", "mfu"} <= metrics[0].keys()
+    # Cold-start→first-step (BASELINE.md metric 2) precedes the metrics.
+    cold = [m for m in lines if "cold_start_to_first_step_s" in m]
+    assert len(cold) == 1
+    assert cold[0]["cold_start_to_first_step_s"] > 0
 
 
 def test_train_llama_rejects_unknown_model(monkeypatch):

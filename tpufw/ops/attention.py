@@ -140,10 +140,7 @@ def multi_head_attention(
     if backend == "ring":
         from tpufw.parallel.ring import ring_attention
 
-        if segment_ids is not None:
-            raise NotImplementedError(
-                "ring backend does not take packed segment_ids yet; "
-                "use backend='xla' for packed batches"
-            )
-        return ring_attention(q, k, v, causal=causal)
+        return ring_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids
+        )
     raise ValueError(f"unknown attention backend {backend!r}")

@@ -15,6 +15,12 @@ Layout notes (see /opt/skills/guides/pallas_guide.md):
   the head index (h // rep) so all rep query heads stream the same slab.
 - softmax statistics accumulate in fp32; matmuls request
   preferred_element_type=f32 so the MXU accumulates in fp32 from bf16 inputs.
+- packed batches: int32 segment ids ([B, T] query-side, [B, S] key-side)
+  stream alongside q/k and add a same-segment term to the mask, so the
+  packed-corpus data path (tpufw.train.native_data emits segment_ids) keeps
+  the flash kernel instead of falling back to materialized logits. Padded
+  positions carry segment 0 on both sides; cross-segment and pad→real
+  attention are both cut by the equality test.
 
 Backward recomputes P from (q, k, lse) — the flash trick — in two kernels:
 dq (grid over q blocks) and dk/dv (grid over kv blocks, per *query* head,
@@ -54,8 +60,14 @@ def _causal_mask(i_block, j_block, bq, bkv, offset):
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bkv, s_actual, causal, offset, scale
+    *refs, bq, bkv, s_actual, causal, offset, scale, has_seg
 ):
+    if has_seg:
+        q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref = refs
+        qseg = qseg_ref[0][:, None]  # [bq, 1]
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        kseg_ref = qseg = None
     i = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale  # [bq, D]
     n_kv = k_ref.shape[2] // bkv
@@ -76,6 +88,9 @@ def _fwd_kernel(
         mask = k_pos < s_actual
         if causal:
             mask = mask & _causal_mask(i, j, bq, bkv, offset)
+        if has_seg:
+            kseg = kseg_ref[0, pl.ds(j * bkv, bkv)][None, :]  # [1, bkv]
+            mask = mask & (qseg == kseg)
         logits = jnp.where(mask, logits, NEG_INF)
         m_cur = jnp.max(logits, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -106,9 +121,15 @@ def _fwd_kernel(
 
 
 def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, bq, bkv, s_actual, causal, offset, scale
+    *refs, bq, bkv, s_actual, causal, offset, scale, has_seg
 ):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         qseg_ref, kseg_ref, dq_ref) = refs
+        qseg = qseg_ref[0][:, None]
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref) = refs
+        kseg_ref = qseg = None
     i = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale
     do = do_ref[0, 0].astype(jnp.float32)
@@ -127,6 +148,9 @@ def _dq_kernel(
         mask = k_pos < s_actual
         if causal:
             mask = mask & _causal_mask(i, j, bq, bkv, offset)
+        if has_seg:
+            kseg = kseg_ref[0, pl.ds(j * bkv, bkv)][None, :]
+            mask = mask & (qseg == kseg)
         p = jnp.where(mask, jnp.exp(logits - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -148,9 +172,16 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, bq, bkv, t_actual, causal, offset, scale
+    *refs, bq, bkv, t_actual, causal, offset, scale, has_seg
 ):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         qseg_ref, kseg_ref, dk_ref, dv_ref) = refs
+        kseg = kseg_ref[0][None, :]  # [1, bkv]
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref) = refs
+        qseg_ref = kseg = None
     j = pl.program_id(2)
     k = k_ref[0, 0].astype(jnp.float32)  # [bkv, D]
     v = v_ref[0, 0].astype(jnp.float32)
@@ -170,6 +201,9 @@ def _dkv_kernel(
         mask = q_pos < t_actual
         if causal:
             mask = mask & _causal_mask(i, j, bq, bkv, offset)
+        if has_seg:
+            qseg = qseg_ref[0, pl.ds(i * bq, bq)][:, None]  # [bq, 1]
+            mask = mask & (qseg == kseg)
         p = jnp.where(mask, jnp.exp(logits - lse), 0.0)
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -224,19 +258,20 @@ def _block_sizes(t_pad, s_pad):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4)
+    jax.custom_vjp, nondiff_argnums=(5, 6)
 )
-def _flash(q, k, v, causal, interpret):
-    out, _ = _flash_fwd_impl(q, k, v, causal, interpret)
+def _flash(q, k, v, qseg, kseg, causal, interpret):
+    out, _ = _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret)
     return out
 
 
-def _flash_fwd_impl(q, k, v, causal, interpret):
+def _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret):
     b, t, h, d = q.shape
     _, s, kh, _ = k.shape
     rep = h // kh
     scale = 1.0 / math.sqrt(d)
     offset = s - t  # decode alignment: query i sits at abs pos offset+i
+    has_seg = qseg is not None
 
     qh, kh_, vh = _heads_layout(q, k, v)
     t_pad_mult = 128
@@ -255,21 +290,33 @@ def _flash_fwd_impl(q, k, v, causal, interpret):
         causal=causal,
         offset=offset,
         scale=scale,
+        has_seg=has_seg,
     )
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, s_p, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, s_p, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0)
+        ),
+    ]
+    inputs = [qh, kh_, vh]
+    if has_seg:
+        # Pad with segment 0 == the padding segment on both sides.
+        qseg_p = _pad_to(qseg.astype(jnp.int32), 1, t_pad_mult)
+        kseg_p = _pad_to(kseg.astype(jnp.int32), 1, t_pad_mult)
+        in_specs += [
+            pl.BlockSpec((1, bq), lambda b_, h_, i: (b_, i)),
+            pl.BlockSpec((1, s_p), lambda b_, h_, i: (b_, 0)),
+        ]
+        inputs += [qseg_p, kseg_p]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, s_p, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, s_p, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0)
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec(
                 (1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)
@@ -283,18 +330,19 @@ def _flash_fwd_impl(q, k, v, causal, interpret):
             jax.ShapeDtypeStruct((b, h, 1, t_p), jnp.float32),
         ],
         interpret=interpret,
-    )(qh, kh_, vh)
+    )(*inputs)
     out_bthd = jnp.transpose(out[:, :, :t, :], (0, 2, 1, 3))
-    return out_bthd, (q, k, v, out_bthd, lse)
+    return out_bthd, (q, k, v, qseg, kseg, out_bthd, lse)
 
 
 def _flash_bwd_impl(causal, interpret, res, g):
-    q, k, v, out, lse = res
+    q, k, v, qseg, kseg, out, lse = res
     b, t, h, d = q.shape
     _, s, kh, _ = k.shape
     rep = h // kh
     scale = 1.0 / math.sqrt(d)
     offset = s - t
+    has_seg = qseg is not None
 
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
@@ -311,8 +359,34 @@ def _flash_bwd_impl(causal, interpret, res, g):
     lse_p = lse  # stored padded in the residual
     t_p, s_p = qh.shape[2], kh_.shape[2]
     bq, bkv = _block_sizes(t_p, s_p)
+    if has_seg:
+        qseg_p = _pad_to(qseg.astype(jnp.int32), 1, 128)
+        kseg_p = _pad_to(kseg.astype(jnp.int32), 1, 128)
 
     # dq: grid over q blocks.
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec(
+            (1, 1, s_p, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, s_p, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0)
+        ),
+        pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec(
+            (1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i)
+        ),
+        pl.BlockSpec(
+            (1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i)
+        ),
+    ]
+    dq_inputs = [qh, kh_, vh, doh, lse_p, delta_p]
+    if has_seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, bq), lambda b_, h_, i: (b_, i)),
+            pl.BlockSpec((1, s_p), lambda b_, h_, i: (b_, 0)),
+        ]
+        dq_inputs += [qseg_p, kseg_p]
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel,
@@ -322,32 +396,37 @@ def _flash_bwd_impl(causal, interpret, res, g):
             causal=causal,
             offset=offset,
             scale=scale,
+            has_seg=has_seg,
         ),
         grid=(b, h, t_p // bq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec(
-                (1, 1, s_p, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, s_p, d), lambda b_, h_, i: (b_, h_ // rep, 0, 0)
-            ),
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec(
-                (1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i)
-            ),
-            pl.BlockSpec(
-                (1, 1, 1, bq), lambda b_, h_, i: (b_, h_, 0, i)
-            ),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, bq, d), lambda b_, h_, i: (b_, h_, i, 0)
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, t_p, d), q.dtype),
         interpret=interpret,
-    )(qh, kh_, vh, doh, lse_p, delta_p)
+    )(*dq_inputs)
 
     # dk/dv: grid over kv blocks, per *query* head; GQA-summed after.
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, t_p, d), lambda b_, h_, j: (b_, h_, 0, 0)),
+        pl.BlockSpec(
+            (1, 1, bkv, d), lambda b_, h_, j: (b_, h_ // rep, j, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, bkv, d), lambda b_, h_, j: (b_, h_ // rep, j, 0)
+        ),
+        pl.BlockSpec((1, 1, t_p, d), lambda b_, h_, j: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, 1, 1, t_p), lambda b_, h_, j: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, 1, 1, t_p), lambda b_, h_, j: (b_, h_, 0, 0)),
+    ]
+    dkv_inputs = [qh, kh_, vh, doh, lse_p, delta_p]
+    if has_seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, t_p), lambda b_, h_, j: (b_, 0)),
+            pl.BlockSpec((1, bkv), lambda b_, h_, j: (b_, j)),
+        ]
+        dkv_inputs += [qseg_p, kseg_p]
     dk_full, dv_full = pl.pallas_call(
         functools.partial(
             _dkv_kernel,
@@ -357,20 +436,10 @@ def _flash_bwd_impl(causal, interpret, res, g):
             causal=causal,
             offset=offset,
             scale=scale,
+            has_seg=has_seg,
         ),
         grid=(b, h, s_p // bkv),
-        in_specs=[
-            pl.BlockSpec((1, 1, t_p, d), lambda b_, h_, j: (b_, h_, 0, 0)),
-            pl.BlockSpec(
-                (1, 1, bkv, d), lambda b_, h_, j: (b_, h_ // rep, j, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, bkv, d), lambda b_, h_, j: (b_, h_ // rep, j, 0)
-            ),
-            pl.BlockSpec((1, 1, t_p, d), lambda b_, h_, j: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, 1, t_p), lambda b_, h_, j: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, 1, t_p), lambda b_, h_, j: (b_, h_, 0, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bkv, d), lambda b_, h_, j: (b_, h_, j, 0)),
             pl.BlockSpec((1, 1, bkv, d), lambda b_, h_, j: (b_, h_, j, 0)),
@@ -380,18 +449,18 @@ def _flash_bwd_impl(causal, interpret, res, g):
             jax.ShapeDtypeStruct((b, h, s_p, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qh, kh_, vh, doh, lse_p, delta_p)
+    )(*dkv_inputs)
 
     dq = jnp.transpose(dq[:, :, :t, :], (0, 2, 1, 3))
     dk = dk_full[:, :, :s, :].reshape(b, kh, rep, s, d).sum(2)
     dv = dv_full[:, :, :s, :].reshape(b, kh, rep, s, d).sum(2)
     dk = jnp.transpose(dk, (0, 2, 1, 3)).astype(k.dtype)
     dv = jnp.transpose(dv, (0, 2, 1, 3)).astype(v.dtype)
-    return dq, dk, dv
+    return dq, dk, dv, None, None
 
 
-def _flash_fwd_rule(q, k, v, causal, interpret):
-    out, res = _flash_fwd_impl(q, k, v, causal, interpret)
+def _flash_fwd_rule(q, k, v, qseg, kseg, causal, interpret):
+    out, res = _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret)
     return out, res
 
 
@@ -405,21 +474,34 @@ def flash_attention(
     *,
     causal: bool = True,
     segment_ids=None,
+    kv_segment_ids=None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention. q:[B,T,H,D], k/v:[B,S,K,D] -> [B,T,H,D].
 
+    ``segment_ids`` ([B, T] int) masks cross-segment attention for packed
+    batches; ``kv_segment_ids`` ([B, S]) defaults to ``segment_ids`` (which
+    then requires T == S, the self-attention training path).
+
     ``interpret=None`` auto-selects the Pallas interpreter on CPU backends
     (tests, dryruns); any accelerator backend gets the real Mosaic lowering.
     """
-    if segment_ids is not None:
-        raise NotImplementedError(
-            "flash backend does not take packed segment_ids yet; "
-            "use backend='xla' for packed batches"
-        )
     h, kh = q.shape[2], k.shape[2]
     if h % kh:
         raise ValueError(f"q heads {h} not divisible by kv heads {kh}")
+    qseg = segment_ids
+    kseg = kv_segment_ids if kv_segment_ids is not None else segment_ids
+    if (qseg is None) != (kseg is None):
+        raise ValueError(
+            "segment_ids and kv_segment_ids must be given together"
+        )
+    if qseg is not None and kv_segment_ids is None and (
+        q.shape[1] != k.shape[1]
+    ):
+        raise ValueError(
+            f"segment_ids without kv_segment_ids requires T==S "
+            f"(self-attention); got T={q.shape[1]}, S={k.shape[1]}"
+        )
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
-    return _flash(q, k, v, causal, interpret)
+    return _flash(q, k, v, qseg, kseg, causal, interpret)

@@ -30,11 +30,15 @@ from tpufw.parallel.context import current_mesh
 NEG_INF = -1e30
 
 
-def _chunk_attn(q, k, v, q_start, k_start, causal, scale, rep):
+def _chunk_attn(
+    q, k, v, q_start, k_start, causal, scale, rep, qseg=None, kseg=None
+):
     """Attention of local q against one kv chunk; returns (acc, m, l) stats.
 
     q: [B,T,H,D], k/v: [B,S,K,D] with H = K*rep (GQA repeat happens here,
     post-ppermute, so the ring never rotates repeated bytes).
+    qseg [B,T] / kseg [B,S]: packed-batch segment ids; the key-side ids
+    rotate around the ring with their kv chunk.
     m/l: [B,H,T,1] running max / normalizer in fp32.
     """
     k = _repeat_kv(k, rep)
@@ -45,11 +49,16 @@ def _chunk_attn(q, k, v, q_start, k_start, causal, scale, rep):
         )
         * scale
     )
+    mask = None
     if causal:
         t, s = q.shape[1], k.shape[1]
         q_pos = q_start + jnp.arange(t)[:, None]
         k_pos = k_start + jnp.arange(s)[None, :]
         mask = (q_pos >= k_pos)[None, None]
+    if qseg is not None:
+        seg_mask = qseg[:, None, :, None] == kseg[:, None, None, :]
+        mask = seg_mask if mask is None else (mask & seg_mask)
+    if mask is not None:
         logits = jnp.where(mask, logits, NEG_INF)
     m = jnp.max(logits, axis=-1, keepdims=True)  # [B,H,T,1]
     p = jnp.exp(logits - m)
@@ -62,20 +71,30 @@ def _chunk_attn(q, k, v, q_start, k_start, causal, scale, rep):
     return acc, m, l
 
 
-def _ring_attn_local(q, k, v, *, causal, axis_name, scale, rep):
-    """Body run per-device under shard_map. q: [B,L,H,D], k/v: [B,L,K,D]."""
+def _ring_attn_local(q, k, v, *seg, causal, axis_name, scale, rep):
+    """Body run per-device under shard_map. q: [B,L,H,D], k/v: [B,L,K,D].
+    ``seg`` is () or (qseg [B,L], kseg [B,L]); kseg rides the ring with kv."""
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     t_local = q.shape[1]
     b, _, h, d = q.shape
+    qseg, kseg0 = seg if seg else (None, None)
 
     m0 = jnp.full((b, h, t_local, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
     acc0 = jnp.zeros((b, h, t_local, d), jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
+    # kseg rotates with its kv chunk. ``seg`` is a static (Python-level)
+    # choice, so the unsegmented trace carries no dummy array and issues no
+    # extra ppermute.
+    has_seg = qseg is not None
 
     def body(step, carry):
-        k_cur, v_cur, m, l, acc = carry
+        if has_seg:
+            k_cur, v_cur, kseg_cur, m, l, acc = carry
+        else:
+            k_cur, v_cur, m, l, acc = carry
+            kseg_cur = None
         src_chunk = (idx - step) % n
         acc_c, m_c, l_c = _chunk_attn(
             q,
@@ -86,6 +105,8 @@ def _ring_attn_local(q, k, v, *, causal, axis_name, scale, rep):
             causal=causal,
             scale=scale,
             rep=rep,
+            qseg=qseg,
+            kseg=kseg_cur,
         )
         m_new = jnp.maximum(m, m_c)
         alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
@@ -94,9 +115,14 @@ def _ring_attn_local(q, k, v, *, causal, axis_name, scale, rep):
         acc_new = acc * alpha + acc_c * beta
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        if has_seg:
+            kseg_nxt = jax.lax.ppermute(kseg_cur, axis_name, perm)
+            return k_nxt, v_nxt, kseg_nxt, m_new, l_new, acc_new
         return k_nxt, v_nxt, m_new, l_new, acc_new
 
-    _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    init = (k, v, kseg0, m0, l0, acc0) if has_seg else (k, v, m0, l0, acc0)
+    out_carry = jax.lax.fori_loop(0, n, body, init)
+    m, l, acc = out_carry[-3], out_carry[-2], out_carry[-1]
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = (acc / l_safe).astype(q.dtype)  # [B,H,T,D]
     return jnp.transpose(out, (0, 2, 1, 3))
@@ -108,15 +134,25 @@ def ring_attention(
     v: jax.Array,
     *,
     causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
     mesh: Optional[Mesh] = None,
     axis_name: str = AXIS_SEQUENCE,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """Sequence-parallel attention. q:[B,T,H,D], k/v:[B,S,K,D] global shapes.
 
     Wraps its own ``shard_map`` over (batch=data+fsdp, seq=sequence,
     heads=tensor); requires a registered current mesh (tpufw.parallel.context)
     or an explicit ``mesh``. T must equal S (self-attention) and divide
-    evenly by the sequence-axis size.
+    evenly by the sequence-axis size. ``segment_ids`` ([B, T] int) masks
+    cross-segment attention for packed batches; the key-side copy rotates
+    around the ring with its kv chunk.
+
+    ``impl``: "flash" = Pallas flash kernel per shard (O(L) memory,
+    tpufw.parallel.ring_flash — the long-context scaling path); "einsum" =
+    materialized per-chunk logits (the reference implementation). Default
+    (None) picks flash on TPU for the causal LM path and einsum elsewhere;
+    the two are numerically interchangeable (tests/test_ring_flash.py).
     """
     mesh = mesh or current_mesh()
     if mesh is None:
@@ -124,6 +160,21 @@ def ring_attention(
             "ring_attention needs a mesh: pass mesh= or register one via "
             "tpufw.parallel.context.use_mesh(...)"
         )
+    if impl is None:
+        on_tpu = mesh.devices.flatten()[0].platform == "tpu"
+        impl = "flash" if (causal and on_tpu) else "einsum"
+    if impl == "flash":
+        from tpufw.parallel.ring_flash import ring_flash_attention
+
+        return ring_flash_attention(
+            q, k, v,
+            causal=causal,
+            segment_ids=segment_ids,
+            mesh=mesh,
+            axis_name=axis_name,
+        )
+    if impl != "einsum":
+        raise ValueError(f"unknown ring impl {impl!r}")
     if q.shape[1] != k.shape[1]:
         raise ValueError(
             f"ring attention is self-attention only: T={q.shape[1]} != "
@@ -131,18 +182,30 @@ def ring_attention(
         )
     rep = q.shape[2] // k.shape[2]
     spec = P((AXIS_DATA, AXIS_FSDP), AXIS_SEQUENCE, AXIS_TENSOR, None)
+    seg_spec = P((AXIS_DATA, AXIS_FSDP), AXIS_SEQUENCE)
     scale = 1.0 / math.sqrt(q.shape[-1])
+    local = functools.partial(
+        _ring_attn_local,
+        causal=causal,
+        axis_name=axis_name,
+        scale=scale,
+        rep=rep,
+    )
+    if segment_ids is None:
+        fn = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
     fn = shard_map(
-        functools.partial(
-            _ring_attn_local,
-            causal=causal,
-            axis_name=axis_name,
-            scale=scale,
-            rep=rep,
-        ),
+        local,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, seg_spec, seg_spec),
         out_specs=spec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    seg = segment_ids.astype(jnp.int32)
+    return fn(q, k, v, seg, seg)

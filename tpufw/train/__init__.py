@@ -9,7 +9,11 @@ from tpufw.train.trainer import (  # noqa: F401
 )
 from tpufw.train.metrics import Meter, StepMetrics  # noqa: F401
 from tpufw.train.checkpoint import CheckpointManager  # noqa: F401
-from tpufw.train.data import pack_documents, synthetic_batches  # noqa: F401
+from tpufw.train.data import (  # noqa: F401
+    pack_documents,
+    synthetic_batches,
+    synthetic_packed_batches,
+)
 from tpufw.train.native_data import (  # noqa: F401
     TokenCorpus,
     write_token_corpus,

@@ -33,6 +33,33 @@ def synthetic_batches(
         i += 1
 
 
+def synthetic_packed_batches(
+    batch_size: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int = 0,
+    mean_doc_len: int = 512,
+    n_batches: Optional[int] = None,
+) -> Iterator[dict]:
+    """Synthetic PACKED batches: random docs of geometric length packed via
+    ``pack_documents`` — the production data shape (segment_ids +
+    loss_mask) without IO, so the bench can measure the packed/flash path
+    (VERDICT r1 item 2: the measured number and the production path must
+    not diverge)."""
+    rng = np.random.default_rng(seed)
+
+    def docs():
+        while True:
+            n = 1 + min(rng.geometric(1.0 / mean_doc_len), 4 * mean_doc_len)
+            yield rng.integers(0, vocab_size, (n,), dtype=np.int32)
+
+    it = pack_documents(docs(), batch_size, seq_len)
+    for i, batch in enumerate(it):
+        if n_batches is not None and i >= n_batches:
+            break
+        yield batch
+
+
 def _emit(batch_toks: list, batch_segs: list) -> dict:
     segs = np.array(batch_segs, np.int32)
     return {

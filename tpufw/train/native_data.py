@@ -106,6 +106,17 @@ class TokenCorpus:
             raise ValueError(
                 f"shard_id {shard_id} out of range for {num_shards} shards"
             )
+        if num_shards > 1 and epochs is not None:
+            # Round-robin doc sharding gives shards unequal token counts,
+            # so finite epochs would end at different batch counts per
+            # process — the early-exhausted host stops iterating while the
+            # rest block in make_array_from_process_local_data, hanging the
+            # gang. Stream forever (epochs=None) and bound by total_steps.
+            raise ValueError(
+                "num_shards > 1 requires epochs=None (stream + stop by "
+                "trainer total_steps): finite epochs yield unequal batch "
+                "counts across shards and deadlock multi-host gangs"
+            )
         # Data-parallel hosts pass (process_id, process_count): each packs
         # a disjoint round-robin subset of the (post-shuffle) doc order.
         self.shard_id = shard_id

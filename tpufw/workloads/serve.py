@@ -1,0 +1,271 @@
+"""Inference serving workload: checkpoint -> batch generation / HTTP server.
+
+The serving half of the same `kubectl apply` flow the trainer uses
+(deploy/manifests/07-infer-v5e1.yaml): load the latest checkpoint from
+TPUFW_CHECKPOINT_DIR, build the decode-mode model (KV cache + jitted
+lax.scan loop, tpufw.infer.generate), and either
+
+- batch mode (default): generate continuations for TPUFW_PROMPTS_FILE
+  (JSON: list of token-id lists) or built-in demo prompts, printing one
+  JSON line per prompt — `kubectl logs` is the result channel, the
+  reference's verification pattern (reference README.md:331-335);
+- server mode (TPUFW_SERVE_PORT > 0): a stdlib ThreadingHTTPServer with
+  POST /generate {"prompts": [[ids]], "max_new_tokens": N} -> outputs and
+  GET /healthz. Prompt lengths are bucketed (multiples of 64) and batch
+  rows padded to a power of two so repeat traffic reuses compiled programs
+  instead of recompiling per ragged shape — the static-shape discipline
+  XLA serving needs.
+
+Without a checkpoint the model initializes randomly (flagged in output):
+the manifest flow stays verifiable end-to-end before any training ran.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from tpufw.workloads.env import env_int, env_str
+
+_T0 = time.time()
+
+DEMO_PROMPTS = [[1, 42, 7, 99], [1, 5], [1, 1000, 2000, 3000, 17]]
+
+
+def build_generator():
+    """Construct (decode_model, params, cfg, restored) from TPUFW_* env."""
+    import dataclasses
+
+    import jax
+
+    from tpufw.configs import bench_model_config
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import LLAMA_CONFIGS, Llama, MIXTRAL_CONFIGS, Mixtral
+    from tpufw.train import Trainer, TrainerConfig
+
+    name = env_str("model", "llama3_600m_bench")
+    if name == "llama3_600m_bench":
+        model_cfg = bench_model_config()
+        model_cls = Llama
+    elif name in LLAMA_CONFIGS:
+        model_cfg, model_cls = LLAMA_CONFIGS[name], Llama
+    elif name in MIXTRAL_CONFIGS:
+        model_cfg, model_cls = MIXTRAL_CONFIGS[name], Mixtral
+    else:
+        raise ValueError(
+            f"unknown TPUFW_MODEL={name!r}; choose from "
+            f"{['llama3_600m_bench', *LLAMA_CONFIGS, *MIXTRAL_CONFIGS]}"
+        )
+    # Serving wants the full sequence budget but no training-only features.
+    model_cfg = dataclasses.replace(
+        model_cfg,
+        max_seq_len=env_int("max_seq_len", model_cfg.max_seq_len),
+    )
+
+    # Reuse the trainer's restore machinery (abstract state + reshard-on-
+    # restore) rather than reimplementing orbax plumbing; params are then
+    # pulled out of the restored TrainState.
+    trainer = Trainer(
+        model_cls(model_cfg),
+        TrainerConfig(
+            batch_size=1,
+            seq_len=min(32, model_cfg.max_seq_len),
+            total_steps=1,
+            checkpoint_dir=env_str("checkpoint_dir", "") or None,
+        ),
+        MeshConfig(),
+    )
+    restored = trainer.maybe_restore()
+    if not restored:
+        trainer.init_state(seed=env_int("seed", 0))
+    params = trainer.state.params
+    del trainer.state  # drop optimizer moments; serving only needs params
+
+    decode_model = model_cls(model_cfg.decode_config())
+    _ = jax  # backend initialized above via Trainer
+    return decode_model, params, model_cfg, restored
+
+
+def _bucket(n: int, mult: int) -> int:
+    return ((max(n, 1) + mult - 1) // mult) * mult
+
+
+def _pad_batch(prompts: list[list[int]]) -> tuple[list[list[int]], int]:
+    """Pad the batch to a power of two (filler rows = [0]) so the jitted
+    generate specializes on few batch shapes. Returns (padded, real_n)."""
+    n = len(prompts)
+    size = 1
+    while size < n:
+        size *= 2
+    return prompts + [[0]] * (size - n), n
+
+
+def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
+    from tpufw.infer import SamplingConfig, generate_text
+
+    decode_model, params, cfg, restored = build_generator()
+    padded, real_n = _pad_batch(prompts)
+    outs = generate_text(
+        decode_model,
+        params,
+        padded,
+        max_new_tokens=max_new_tokens,
+        sampling=SamplingConfig(temperature=0.0),  # greedy: deterministic
+        eos_id=None,
+    )[:real_n]
+    return [
+        {
+            "prompt": p,
+            "output": o,
+            "restored_checkpoint": restored,
+            "model_params": cfg.n_params(),
+        }
+        for p, o in zip(prompts, outs)
+    ]
+
+
+class _Server:
+    """Minimal HTTP serving loop over the jitted generator."""
+
+    def __init__(self, port: int, max_new_tokens: int):
+        from tpufw.infer import SamplingConfig, generate_text
+
+        self._generate_text = generate_text
+        self._sampling = SamplingConfig(temperature=0.0)
+        (
+            self.model,
+            self.params,
+            self.cfg,
+            self.restored,
+        ) = build_generator()
+        self.default_new = max_new_tokens
+        self.lock = threading.Lock()
+        self.port = port
+
+    def generate(self, prompts: list[list[int]], max_new: int):
+        # Bucket prompt length via extra LEFT padding (pad_lens absorbs
+        # it) and batch size via filler rows: few shapes -> few compiles.
+        longest = _bucket(max(len(p) for p in prompts), 64)
+        bucketed = [[0] * (longest - len(p)) + list(p) for p in prompts]
+        padded, real_n = _pad_batch(bucketed)
+        with self.lock:  # one compiled program at a time
+            outs = self._generate_text(
+                self.model,
+                self.params,
+                padded,
+                max_new_tokens=max_new,
+                sampling=self._sampling,
+                eos_id=None,
+            )
+        return outs[:real_n]
+
+    def serve_forever(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet access log
+                pass
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(
+                        200,
+                        {
+                            "ok": True,
+                            "restored_checkpoint": outer.restored,
+                            "uptime_s": round(time.time() - _T0, 1),
+                        },
+                    )
+                else:
+                    self._reply(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._reply(404, {"error": "unknown path"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    prompts = req["prompts"]
+                    if not prompts or not all(
+                        isinstance(p, list) and all(
+                            isinstance(t, int) for t in p
+                        )
+                        for p in prompts
+                    ):
+                        raise ValueError(
+                            "prompts must be a non-empty list of "
+                            "token-id lists"
+                        )
+                    max_new = int(
+                        req.get("max_new_tokens", outer.default_new)
+                    )
+                    outs = outer.generate(prompts, max_new)
+                    self._reply(200, {"outputs": outs})
+                except Exception as e:  # noqa: BLE001 — serving loop
+                    self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+
+        httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = httpd.server_address[1]  # resolve port 0 -> actual
+        self.httpd = httpd
+        print(
+            json.dumps(
+                {
+                    "serving": True,
+                    "port": self.port,
+                    "model_params": self.cfg.n_params(),
+                    "restored_checkpoint": self.restored,
+                    "startup_s": round(time.time() - _T0, 1),
+                }
+            ),
+            flush=True,
+        )
+        httpd.serve_forever()
+
+
+def main() -> int:
+    from tpufw.utils.profiling import enable_compile_cache
+
+    enable_compile_cache()
+    max_new = env_int("max_new_tokens", 16)
+    port = env_int("serve_port", 0)
+    if port:
+        _Server(port, max_new).serve_forever()
+        return 0
+
+    prompts_file = env_str("prompts_file", "")
+    if prompts_file:
+        with open(prompts_file) as f:
+            prompts = json.load(f)
+    else:
+        prompts = DEMO_PROMPTS
+    for result in run_batch(prompts, max_new):
+        print(json.dumps(result), flush=True)
+    print(
+        json.dumps(
+            {
+                "generate_ok": True,
+                "n_prompts": len(prompts),
+                "max_new_tokens": max_new,
+                "total_s": round(time.time() - _T0, 1),
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,8 +12,14 @@ telemetry.
 from __future__ import annotations
 
 import json
+import time
 
 from tpufw.workloads.env import env_float, env_int, env_str
+
+# Import time ~= process start: the anchor for cold-start→first-step
+# (BASELINE.md metric 2 — the reference's analog is its unmeasured
+# Steps 1-9 wall clock, reference README.md:70-74).
+_T0 = time.time()
 
 
 def build_trainer():
@@ -134,10 +140,28 @@ def main() -> int:
             local_bs, cfg.seq_len, model_cfg.vocab_size,
             seed=env_int("data_seed", 0) * 1000 + cluster.process_id,
         )
+    first_step: dict = {}
+
+    def on_metrics(m):
+        if not first_step:
+            first_step["t"] = time.time()
+            print(
+                json.dumps(
+                    {
+                        "cold_start_to_first_step_s": round(
+                            first_step["t"] - _T0, 1
+                        ),
+                        "compile_cache": cache or None,
+                    }
+                ),
+                flush=True,
+            )
+        print(json.dumps(m.as_dict()), flush=True)
+
     history = trainer.run(
         data,
         model_flops_per_token=flops_per_token,
-        on_metrics=lambda m: print(json.dumps(m.as_dict()), flush=True),
+        on_metrics=on_metrics,
     )
     if history:
         last = history[-1]
