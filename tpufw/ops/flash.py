@@ -39,6 +39,32 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Mosaic tiling: the last two dims of every block must be (divisible by 8,
+# divisible by 128) or equal to the array dims. 2-D [B, T] segment-id
+# arrays can't satisfy that (a (1, bq) block has sublane size 1), so they
+# ship lanes/sublanes-broadcast — query ids as [B, T, LANES] blocks
+# (bq, 128), kv ids as [B, SUBLANES, S] blocks (8, bkv) — the layout the
+# official TPU flash kernel uses. Caught on real hardware in round 2: the
+# CPU interpreter never enforces tiling, so tests alone missed it.
+_LANES = 128
+_SUBLANES = 8
+
+
+def _qseg_lanes(qseg_p: jax.Array) -> jax.Array:
+    b, t_p = qseg_p.shape
+    return jnp.broadcast_to(qseg_p[:, :, None], (b, t_p, _LANES))
+
+
+def _kseg_sublanes(kseg_p: jax.Array) -> jax.Array:
+    b, s_p = kseg_p.shape
+    return jnp.broadcast_to(kseg_p[:, None, :], (b, _SUBLANES, s_p))
+
+
+def _seg_mask(qseg_block: jax.Array, kseg_row: jax.Array) -> jax.Array:
+    """[bq, LANES] lanes-broadcast q ids x [1, bkv] kv ids -> [bq, bkv]."""
+    bkv = kseg_row.shape[-1]
+    return jnp.tile(qseg_block, (1, bkv // _LANES)) == kseg_row
+
 
 def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
     size = x.shape[axis]
@@ -64,7 +90,7 @@ def _fwd_kernel(
 ):
     if has_seg:
         q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref = refs
-        qseg = qseg_ref[0][:, None]  # [bq, 1]
+        qseg = qseg_ref[0]  # [bq, LANES]
     else:
         q_ref, k_ref, v_ref, o_ref, lse_ref = refs
         kseg_ref = qseg = None
@@ -89,8 +115,8 @@ def _fwd_kernel(
         if causal:
             mask = mask & _causal_mask(i, j, bq, bkv, offset)
         if has_seg:
-            kseg = kseg_ref[0, pl.ds(j * bkv, bkv)][None, :]  # [1, bkv]
-            mask = mask & (qseg == kseg)
+            kseg = kseg_ref[0, :1, pl.ds(j * bkv, bkv)]  # [1, bkv]
+            mask = mask & _seg_mask(qseg, kseg)
         logits = jnp.where(mask, logits, NEG_INF)
         m_cur = jnp.max(logits, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -126,7 +152,7 @@ def _dq_kernel(
     if has_seg:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          qseg_ref, kseg_ref, dq_ref) = refs
-        qseg = qseg_ref[0][:, None]
+        qseg = qseg_ref[0]  # [bq, LANES]
     else:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref) = refs
         kseg_ref = qseg = None
@@ -149,8 +175,8 @@ def _dq_kernel(
         if causal:
             mask = mask & _causal_mask(i, j, bq, bkv, offset)
         if has_seg:
-            kseg = kseg_ref[0, pl.ds(j * bkv, bkv)][None, :]
-            mask = mask & (qseg == kseg)
+            kseg = kseg_ref[0, :1, pl.ds(j * bkv, bkv)]
+            mask = mask & _seg_mask(qseg, kseg)
         p = jnp.where(mask, jnp.exp(logits - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -177,7 +203,7 @@ def _dkv_kernel(
     if has_seg:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          qseg_ref, kseg_ref, dk_ref, dv_ref) = refs
-        kseg = kseg_ref[0][None, :]  # [1, bkv]
+        kseg = kseg_ref[0, :1, :]  # [1, bkv]
     else:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref) = refs
@@ -202,8 +228,8 @@ def _dkv_kernel(
         if causal:
             mask = mask & _causal_mask(i, j, bq, bkv, offset)
         if has_seg:
-            qseg = qseg_ref[0, pl.ds(i * bq, bq)][:, None]  # [bq, 1]
-            mask = mask & (qseg == kseg)
+            qseg = qseg_ref[0, pl.ds(i * bq, bq), :]  # [bq, LANES]
+            mask = mask & _seg_mask(qseg, kseg)
         p = jnp.where(mask, jnp.exp(logits - lse), 0.0)
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -309,10 +335,14 @@ def _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret):
         qseg_p = _pad_to(qseg.astype(jnp.int32), 1, t_pad_mult)
         kseg_p = _pad_to(kseg.astype(jnp.int32), 1, t_pad_mult)
         in_specs += [
-            pl.BlockSpec((1, bq), lambda b_, h_, i: (b_, i)),
-            pl.BlockSpec((1, s_p), lambda b_, h_, i: (b_, 0)),
+            pl.BlockSpec(
+                (1, bq, _LANES), lambda b_, h_, i: (b_, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, _SUBLANES, s_p), lambda b_, h_, i: (b_, 0, 0)
+            ),
         ]
-        inputs += [qseg_p, kseg_p]
+        inputs += [_qseg_lanes(qseg_p), _kseg_sublanes(kseg_p)]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -360,8 +390,8 @@ def _flash_bwd_impl(causal, interpret, res, g):
     t_p, s_p = qh.shape[2], kh_.shape[2]
     bq, bkv = _block_sizes(t_p, s_p)
     if has_seg:
-        qseg_p = _pad_to(qseg.astype(jnp.int32), 1, 128)
-        kseg_p = _pad_to(kseg.astype(jnp.int32), 1, 128)
+        qseg_l = _qseg_lanes(_pad_to(qseg.astype(jnp.int32), 1, 128))
+        kseg_s = _kseg_sublanes(_pad_to(kseg.astype(jnp.int32), 1, 128))
 
     # dq: grid over q blocks.
     dq_in_specs = [
@@ -383,10 +413,14 @@ def _flash_bwd_impl(causal, interpret, res, g):
     dq_inputs = [qh, kh_, vh, doh, lse_p, delta_p]
     if has_seg:
         dq_in_specs += [
-            pl.BlockSpec((1, bq), lambda b_, h_, i: (b_, i)),
-            pl.BlockSpec((1, s_p), lambda b_, h_, i: (b_, 0)),
+            pl.BlockSpec(
+                (1, bq, _LANES), lambda b_, h_, i: (b_, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, _SUBLANES, s_p), lambda b_, h_, i: (b_, 0, 0)
+            ),
         ]
-        dq_inputs += [qseg_p, kseg_p]
+        dq_inputs += [qseg_l, kseg_s]
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel,
@@ -423,10 +457,14 @@ def _flash_bwd_impl(causal, interpret, res, g):
     dkv_inputs = [qh, kh_, vh, doh, lse_p, delta_p]
     if has_seg:
         dkv_in_specs += [
-            pl.BlockSpec((1, t_p), lambda b_, h_, j: (b_, 0)),
-            pl.BlockSpec((1, bkv), lambda b_, h_, j: (b_, j)),
+            pl.BlockSpec(
+                (1, t_p, _LANES), lambda b_, h_, j: (b_, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, _SUBLANES, bkv), lambda b_, h_, j: (b_, 0, j)
+            ),
         ]
-        dkv_inputs += [qseg_p, kseg_p]
+        dkv_inputs += [qseg_l, kseg_s]
     dk_full, dv_full = pl.pallas_call(
         functools.partial(
             _dkv_kernel,
