@@ -31,6 +31,16 @@ from tpufw.ops import multi_head_attention, rms_norm
 
 Dtype = Any
 
+# Remat (rematerialization) policies: what survives the forward pass for
+# backward, vs recomputed. jax names the "no batch dims" policy after
+# dot_general batch dims, which plain x@W projections don't have — so
+# "dots" saves EVERY projection output, not "almost nothing".
+_REMAT_POLICIES = {
+    "dots": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    "nothing": jax.checkpoint_policies.nothing_saveable,
+    "everything": jax.checkpoint_policies.everything_saveable,
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
@@ -49,6 +59,13 @@ class LlamaConfig:
     param_dtype: Dtype = jnp.float32
     attention_backend: str = "xla"
     remat: bool = True
+    # What the block remat saves for backward (tpufw.models.llama
+    # _REMAT_POLICIES): "dots" saves every projection-matmul output
+    # (fast bwd, memory-heavy: the [B,T,d_ff] MLP intermediates dominate
+    # HBM); "nothing" recomputes the whole block from its input (full
+    # remat: smallest footprint, ~1 extra fwd of FLOPs) — the standard
+    # memory/compute trade, selectable per run.
+    remat_policy: str = "dots"
     scan_layers: bool = True
     # Autoregressive KV-cache mode (tpufw.infer): attention reads/writes a
     # [B, max_seq_len] cache ("cache" flax collection) instead of attending
@@ -345,9 +362,15 @@ def decoder_lm(
 
     block_cls = block_base
     if cfg.remat:
+        policy_name = getattr(cfg, "remat_policy", "dots")
+        if policy_name not in _REMAT_POLICIES:
+            raise ValueError(
+                f"unknown remat_policy {policy_name!r}; choose from "
+                f"{sorted(_REMAT_POLICIES)}"
+            )
         block_cls = nn.remat(
             block_base,
-            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            policy=_REMAT_POLICIES[policy_name],
             prevent_cse=not cfg.scan_layers,
         )
     aux = jnp.zeros((), jnp.float32)
