@@ -1,0 +1,88 @@
+"""Held-out evaluation: forward-only loss/perplexity over the sharded mesh.
+
+The eval objective is the SAME function as training (batch_loss), so the
+key property to pin is consistency: eval on the training distribution
+tracks the train loss, evaluation never mutates state, and the in-loop
+eval hook fires on schedule.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from tpufw.mesh import MeshConfig
+from tpufw.models import Llama, LLAMA_CONFIGS
+from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+TINY = LLAMA_CONFIGS["llama3_tiny"]
+
+
+@pytest.fixture(scope="module")
+def trainer():
+    t = Trainer(
+        Llama(TINY),
+        TrainerConfig(
+            batch_size=8, seq_len=33, total_steps=6, lr=1e-2,
+            warmup_steps=2,
+        ),
+        MeshConfig(data=2, fsdp=4),
+    )
+    t.init_state()
+    return t
+
+
+def test_evaluate_reports_weighted_loss(trainer):
+    out = trainer.evaluate(
+        synthetic_batches(8, 33, TINY.vocab_size, seed=7), n_batches=3
+    )
+    assert out["eval_batches"] == 3
+    assert out["eval_tokens"] == 3 * 8 * 32
+    assert np.isfinite(out["eval_loss"])
+    # Untrained model on uniform tokens: loss ~= ln(vocab) +- slack.
+    assert abs(out["eval_loss"] - np.log(TINY.vocab_size)) < 1.5
+    assert out["eval_ppl"] == pytest.approx(
+        np.exp(out["eval_loss"]), rel=1e-6
+    )
+
+
+def test_evaluate_does_not_mutate_state(trainer):
+    before = jax.tree.map(lambda x: np.asarray(x).copy(), trainer.state.params)
+    trainer.evaluate(
+        synthetic_batches(8, 33, TINY.vocab_size, seed=8), n_batches=2
+    )
+    after = trainer.state.params
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+        before,
+        after,
+    )
+    assert int(trainer.state.step) == 0
+
+
+def test_eval_hook_fires_on_schedule():
+    t = Trainer(
+        Llama(TINY),
+        TrainerConfig(
+            batch_size=8, seq_len=33, total_steps=6, lr=1e-2,
+            warmup_steps=2, eval_every=2, eval_batches=1,
+        ),
+        MeshConfig(data=2, fsdp=4),
+    )
+    t.init_state()
+    evals = []
+    t.run(
+        synthetic_batches(8, 33, TINY.vocab_size),
+        model_flops_per_token=TINY.flops_per_token(32),
+        eval_data=lambda: synthetic_batches(
+            8, 33, TINY.vocab_size, seed=99
+        ),
+        on_eval=evals.append,
+    )
+    assert [e["step"] for e in evals] == [2, 4, 6]
+    # Training on the same distribution: held-out loss should drop too.
+    assert evals[-1]["eval_loss"] < evals[0]["eval_loss"]
+
+
+def test_empty_eval_iterator_is_loud(trainer):
+    with pytest.raises(ValueError, match="empty eval iterator"):
+        trainer.evaluate(iter(()))

@@ -2,8 +2,10 @@ from tpufw.train.trainer import (  # noqa: F401
     TrainState,
     Trainer,
     TrainerConfig,
+    batch_loss,
     cross_entropy_loss,
     default_optimizer,
+    eval_step,
     state_shardings,
     train_step,
 )

@@ -90,17 +90,21 @@ def head_kernel(params) -> jax.Array:
     return params["embed"]["embedding"].T
 
 
-def train_step(
-    state: TrainState,
+def batch_loss(
+    apply_fn: Callable,
+    params,
     batch: dict,
     loss_chunk_size: Optional[int] = None,
     loss_chunk_dtype: str = "bfloat16",
-) -> tuple[TrainState, dict]:
-    """One fwd+bwd+update. batch: tokens [B,T] (+ optional loss_mask,
-    segment_ids). Targets are tokens shifted left; the final position is
-    masked out. ``loss_chunk_size`` switches to the chunked-vocab CE
-    (tpufw.ops.loss): the model skips its head matmul and loss is computed
-    from hidden states chunk-by-chunk, never materializing [B,T,V] logits.
+) -> tuple[jax.Array, jax.Array]:
+    """LM objective for one batch: (loss, n_target_tokens).
+
+    batch: tokens [B,T] (+ optional loss_mask, segment_ids). Targets are
+    tokens shifted left; the final position is masked out.
+    ``loss_chunk_size`` switches to the chunked-vocab CE (tpufw.ops.loss):
+    the model skips its head matmul and loss is computed from hidden
+    states chunk-by-chunk, never materializing [B,T,V] logits. Shared by
+    the train and eval steps so their objectives can't drift.
     """
     tokens = batch["tokens"]
     inputs = tokens[:, :-1]
@@ -118,27 +122,41 @@ def train_step(
         seg_mask = same_seg * nonpad
         mask = seg_mask if mask is None else mask * seg_mask
 
-    def loss_fn(params):
-        kwargs = {"segment_ids": seg_in}
-        if loss_chunk_size:
-            kwargs["return_hidden"] = True
-        out = state.apply_fn({"params": params}, inputs, **kwargs)
-        # MoE models return (logits, aux_loss) — router losses join the
-        # objective here.
-        aux = 0.0
-        if isinstance(out, tuple):
-            out, aux = out
-        if loss_chunk_size:
-            from tpufw.ops.loss import chunked_cross_entropy
+    kwargs = {"segment_ids": seg_in}
+    if loss_chunk_size:
+        kwargs["return_hidden"] = True
+    out = apply_fn({"params": params}, inputs, **kwargs)
+    # MoE models return (logits, aux_loss) — router losses join the
+    # objective here.
+    aux = 0.0
+    if isinstance(out, tuple):
+        out, aux = out
+    if loss_chunk_size:
+        from tpufw.ops.loss import chunked_cross_entropy
 
-            loss, _ = chunked_cross_entropy(
-                out, head_kernel(params), targets, mask,
-                chunk_size=loss_chunk_size,
-                compute_dtype=jnp.dtype(loss_chunk_dtype),
-            )
-        else:
-            loss, _ = cross_entropy_loss(out, targets, mask)
-        return loss + aux
+        loss, n = chunked_cross_entropy(
+            out, head_kernel(params), targets, mask,
+            chunk_size=loss_chunk_size,
+            compute_dtype=jnp.dtype(loss_chunk_dtype),
+        )
+    else:
+        loss, n = cross_entropy_loss(out, targets, mask)
+    return loss + aux, n
+
+
+def train_step(
+    state: TrainState,
+    batch: dict,
+    loss_chunk_size: Optional[int] = None,
+    loss_chunk_dtype: str = "bfloat16",
+) -> tuple[TrainState, dict]:
+    """One fwd+bwd+update (objective: ``batch_loss``)."""
+
+    def loss_fn(params):
+        loss, _ = batch_loss(
+            state.apply_fn, params, batch, loss_chunk_size, loss_chunk_dtype
+        )
+        return loss
 
     loss, grads = jax.value_and_grad(loss_fn)(state.params)
     new_state = state.apply_gradients(grads)
@@ -147,6 +165,20 @@ def train_step(
         "grad_norm": optax.global_norm(grads),
     }
     return new_state, metrics
+
+
+def eval_step(
+    state: TrainState,
+    batch: dict,
+    loss_chunk_size: Optional[int] = None,
+    loss_chunk_dtype: str = "bfloat16",
+) -> dict:
+    """Forward-only objective on one held-out batch: {loss, n_tokens}."""
+    loss, n = batch_loss(
+        state.apply_fn, state.params, batch, loss_chunk_size,
+        loss_chunk_dtype,
+    )
+    return {"loss": loss, "n_tokens": n}
 
 
 def state_shardings(
@@ -185,6 +217,11 @@ class TrainerConfig:
     profile_dir: Optional[str] = None
     profile_start: int = 3
     profile_stop: int = 6
+    # Held-out evaluation: every eval_every steps (0 = off) run
+    # eval_batches forward-only batches from the eval iterator passed to
+    # ``Trainer.run(eval_data=...)``.
+    eval_every: int = 0
+    eval_batches: int = 8
 
 
 class Trainer:
@@ -327,11 +364,64 @@ class Trainer:
             )
         return self._compiled[key]
 
+    def compiled_eval_step(self, batch: dict):
+        """Jitted forward-only step (no donation: state survives)."""
+        key = ("eval", *sorted(batch.keys()))
+        if key not in self._compiled:
+            row = NamedSharding(self.mesh, P(("data", "fsdp")))
+            batch_sharding = {k: row for k in sorted(batch.keys())}
+            self._compiled[key] = jax.jit(
+                partial(
+                    eval_step,
+                    loss_chunk_size=self.cfg.loss_chunk_size,
+                    loss_chunk_dtype=self.cfg.loss_chunk_dtype,
+                ),
+                in_shardings=(self.state_sharding, batch_sharding),
+                out_shardings=None,
+            )
+        return self._compiled[key]
+
+    def evaluate(
+        self, data: Iterator[dict], n_batches: Optional[int] = None
+    ) -> dict:
+        """Token-weighted held-out loss + perplexity over ``n_batches``
+        (None = until the iterator ends). The objective matches training
+        (``batch_loss``, incl. z-loss / MoE aux), so eval_loss is directly
+        comparable to the train curve; ppl = exp(eval_loss)."""
+        if self.state is None:
+            raise RuntimeError("evaluate() before init_state()/restore")
+        total_loss = 0.0
+        total_n = 0.0
+        n_seen = 0
+        with use_mesh(self.mesh):
+            for i, batch in enumerate(data):
+                if n_batches is not None and i >= n_batches:
+                    break
+                batch = self.globalize_batch(batch)
+                out = self.compiled_eval_step(batch)(self.state, batch)
+                n = float(out["n_tokens"])
+                total_loss += float(out["loss"]) * n
+                total_n += n
+                n_seen += 1
+        if n_seen == 0:
+            raise ValueError("evaluate(): empty eval iterator")
+        loss = total_loss / max(total_n, 1.0)
+        import math
+
+        return {
+            "eval_loss": loss,
+            "eval_ppl": math.exp(min(loss, 50.0)),
+            "eval_tokens": int(total_n),
+            "eval_batches": n_seen,
+        }
+
     def run(
         self,
         data: Iterator[dict],
         model_flops_per_token: float,
         on_metrics: Callable[[StepMetrics], None] | None = None,
+        eval_data: Callable[[], Iterator[dict]] | None = None,
+        on_eval: Callable[[dict], None] | None = None,
     ) -> list[StepMetrics]:
         if self.state is None:
             self.init_state()
@@ -373,6 +463,17 @@ class Trainer:
                     history.append(sm)
                     if on_metrics and (i % self.cfg.log_every == 0):
                         on_metrics(sm)
+                    if (
+                        self.cfg.eval_every
+                        and eval_data is not None
+                        and int(self.state.step) % self.cfg.eval_every == 0
+                    ):
+                        ev = self.evaluate(
+                            eval_data(), self.cfg.eval_batches
+                        )
+                        ev["step"] = int(self.state.step)
+                        if on_eval:
+                            on_eval(ev)
                     if ckpt is not None:
                         ckpt.save(int(self.state.step), self.state)
         finally:
