@@ -1,0 +1,185 @@
+"""YAML-of-record config loader tests (SURVEY.md §5 "Config/flag system").
+
+Two contracts:
+1. Every ``deploy/configs/*.yaml`` loads into the framework's own
+   dataclasses, with hard errors on drift (unknown keys, mesh/hardware
+   chip-count mismatch).
+2. The deploy manifests agree with their YAML of record: every TPUFW_*
+   value a manifest sets equals what ``to_env`` renders from the YAML —
+   the anti-drift test VERDICT r1 asked the config layer to enable.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import textwrap
+
+import pytest
+import yaml
+
+from tpufw.configs.loader import RunConfig, load_run_config, to_env
+from tpufw.mesh import MeshConfig
+from tpufw.train.trainer import TrainerConfig
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CONFIGS = sorted((REPO / "deploy" / "configs").glob("*.yaml"))
+MANIFESTS = REPO / "deploy" / "manifests"
+
+
+def test_configs_exist_for_training_baselines():
+    names = [p.name for p in CONFIGS]
+    assert "bench-v5e1.yaml" in names
+    for n in ("03-", "04-", "05-", "06-"):
+        assert any(name.startswith(n) for name in names), names
+
+
+@pytest.mark.parametrize("path", CONFIGS, ids=lambda p: p.name)
+def test_yaml_of_record_loads(path):
+    run = load_run_config(path)
+    assert isinstance(run, RunConfig)
+    assert run.hardware.n_chips >= 1
+    assert run.family in ("llama", "mixtral", "resnet")
+    if run.family != "resnet":
+        assert isinstance(run.trainer, TrainerConfig)
+        assert isinstance(run.mesh, MeshConfig)
+
+
+def _manifest_env(name: str) -> dict:
+    """All literal TPUFW_* env values from a manifest (any nesting)."""
+    docs = [
+        d
+        for d in yaml.safe_load_all((MANIFESTS / name).read_text())
+        if d
+    ]
+    env: dict[str, str] = {}
+
+    def walk(node):
+        if isinstance(node, dict):
+            if (
+                isinstance(node.get("name"), str)
+                and node["name"].startswith("TPUFW_")
+                and isinstance(node.get("value"), str)
+            ):
+                env[node["name"]] = node["value"]
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+
+    walk(docs)
+    return env
+
+
+@pytest.mark.parametrize(
+    "cfg_name, manifest_name",
+    [
+        ("03-resnet50-v5e1.yaml", "03-resnet50-v5e1.yaml"),
+        ("04-llama3-8b-v5e4.yaml", "04-llama3-8b-v5e4.yaml"),
+        ("05-llama3-8b-v5e16.yaml", "05-llama3-8b-v5e16-jobset.yaml"),
+        ("06-mixtral-8x7b-v5p32.yaml", "06-mixtral-8x7b-v5p32-jobset.yaml"),
+    ],
+)
+def test_manifest_matches_yaml_of_record(cfg_name, manifest_name):
+    run = load_run_config(REPO / "deploy" / "configs" / cfg_name)
+    want = to_env(run)
+    got = _manifest_env(manifest_name)
+    # Every key the YAML of record implies must be in the manifest with
+    # the same value; and no manifest TPUFW_* key that the YAML also
+    # implies may disagree (drift in either direction fails).
+    for key, val in want.items():
+        assert got.get(key) == val, (
+            f"{manifest_name}: {key}={got.get(key)!r} but YAML of record "
+            f"{cfg_name} says {val!r}"
+        )
+
+
+def test_mesh_hardware_mismatch_is_loud(tmp_path):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            name: bad
+            hardware: {slice: v5e-4, hosts: 1, chips_per_host: 4}
+            model: {preset: llama3_8b}
+            mesh: {fsdp: 8}
+            """
+        )
+    )
+    with pytest.raises(
+        ValueError, match="needs 8 devices, have 4|mesh covers 8 chips"
+    ):
+        load_run_config(bad)
+
+
+def test_unknown_keys_are_loud(tmp_path):
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            model: {preset: llama3_8b}
+            trainer: {batch_sz: 8}
+            """
+        )
+    )
+    with pytest.raises(ValueError, match="unknown keys.*batch_sz"):
+        load_run_config(bad)
+
+
+def test_model_overrides_applied_and_checked(tmp_path):
+    import jax.numpy as jnp
+
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(
+        textwrap.dedent(
+            """
+            model:
+              preset: llama3_tiny
+              overrides: {attention_backend: xla, param_dtype: bfloat16}
+            """
+        )
+    )
+    run = load_run_config(cfg)
+    assert run.model_cfg.attention_backend == "xla"
+    assert run.model_cfg.param_dtype == jnp.bfloat16
+
+    bad = tmp_path / "b.yaml"
+    bad.write_text(
+        "model: {preset: llama3_tiny, overrides: {n_headz: 2}}\n"
+    )
+    with pytest.raises(ValueError, match="unknown keys.*n_headz"):
+        load_run_config(bad)
+
+
+def test_env_overrides_yaml_in_build_trainer(monkeypatch):
+    """TPUFW_CONFIG is the base layer; TPUFW_* env wins on top."""
+    from tpufw.workloads.train_llama import build_trainer
+
+    for k in list(__import__("os").environ):
+        if k.startswith("TPUFW_"):
+            monkeypatch.delenv(k, raising=False)
+    cfg = REPO / "deploy" / "configs" / "04-llama3-8b-v5e4.yaml"
+    monkeypatch.setenv("TPUFW_CONFIG", str(cfg))
+    # Keep it CPU-buildable: shrink the model via env override.
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_TOTAL_STEPS", "7")
+    monkeypatch.setenv("TPUFW_MESH_FSDP", "-1")
+    trainer, model_cfg = build_trainer()
+    # From env (override):
+    assert trainer.cfg.total_steps == 7
+    assert model_cfg.n_layers < 8
+    # From YAML (base):
+    assert trainer.cfg.batch_size == 8
+    assert trainer.cfg.seq_len == 2048
+    assert trainer.cfg.checkpoint_dir == "/checkpoints/llama3-8b-v5e4"
+
+
+def test_bench_yaml_matches_bench_tier():
+    """bench.py's first TPU tier is the bench YAML of record — keep them
+    in sync (batch 24, seq 2048, chunk 512, full remat; round-2 sweep)."""
+    run = load_run_config(REPO / "deploy" / "configs" / "bench-v5e1.yaml")
+    assert run.model_preset == "llama3_600m_bench"
+    assert run.trainer.batch_size == 24
+    assert run.trainer.seq_len == 2048
+    assert run.trainer.loss_chunk_size == 512
+    assert run.model_cfg.remat_policy == "nothing"

@@ -1,0 +1,235 @@
+"""YAML-of-record run configs (SURVEY.md §5 "Config/flag system").
+
+The reference configures everything as literal values inside commands
+(driver version at README.md:67, pod CIDR at README.md:198, GPU count at
+README.md:317); tpufw's equivalent is **one YAML file of record per
+BASELINE config** under ``deploy/configs/``, loaded here into the plain
+dataclasses the code already uses — no bespoke flag DSL.
+
+Resolution order (lowest to highest precedence):
+
+  YAML file (``TPUFW_CONFIG=<path>`` or an explicit ``load_run_config``)
+    < ``TPUFW_*`` env vars (what the deploy manifests set)
+
+so a manifest can point at the YAML of record and override only what is
+deployment-specific (checkpoint dir, step count).  ``to_env`` renders a
+RunConfig back to the ``TPUFW_*`` dict, which is how the tests prove the
+deploy manifests and the YAML of record agree instead of drifting.
+
+Schema (all sections optional except ``model``)::
+
+    name: llama3-8b-v5e16
+    hardware: {slice: v5e-16, topology: 4x4, hosts: 4, chips_per_host: 4}
+    model:
+      preset: llama3_8b          # LLAMA_CONFIGS / MIXTRAL_CONFIGS /
+                                 # llama3_600m_bench / resnet50
+      overrides: {attention_backend: flash}   # dataclasses.replace fields
+    trainer:  {batch_size: 32, seq_len: 2048, ...}   # TrainerConfig fields
+    mesh:     {fsdp: 16}                             # MeshConfig fields
+
+Unknown keys anywhere are hard errors — config drift should fail loudly at
+load time, not silently at step 1000.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pathlib
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import yaml
+
+from tpufw.mesh import MeshConfig
+from tpufw.train.trainer import TrainerConfig
+from tpufw.train.vision import VisionTrainerConfig
+
+#: Fields whose YAML spelling maps to a dtype object on the model config.
+_DTYPE_FIELDS = ("dtype", "param_dtype")
+
+
+@dataclass(frozen=True)
+class HardwareConfig:
+    """Slice shape of record — what the manifest's nodeSelector must match."""
+
+    slice: str = "v5e-1"
+    topology: Optional[str] = None
+    hosts: int = 1
+    chips_per_host: int = 1
+
+    @property
+    def n_chips(self) -> int:
+        return self.hosts * self.chips_per_host
+
+
+@dataclass(frozen=True)
+class RunConfig:
+    name: str
+    hardware: HardwareConfig
+    model_preset: str
+    model_cfg: Any  # LlamaConfig | MixtralConfig | ResNetConfig
+    trainer: Any  # TrainerConfig (LM) | VisionTrainerConfig (resnet)
+    mesh: MeshConfig
+
+    @property
+    def family(self) -> str:
+        return type(self.model_cfg).__name__.removesuffix("Config").lower()
+
+
+def _reject_unknown(section: str, given: dict, allowed: set[str]) -> None:
+    unknown = set(given) - allowed
+    if unknown:
+        raise ValueError(
+            f"{section}: unknown keys {sorted(unknown)}; "
+            f"allowed: {sorted(allowed)}"
+        )
+
+
+def _build_dataclass(cls, section: str, given: dict):
+    fields = {f.name for f in dataclasses.fields(cls)}
+    _reject_unknown(section, given, fields)
+    return cls(**given)
+
+
+def _resolve_preset(preset: str):
+    from tpufw.configs.presets import BENCH_CONFIG_NAME, bench_model_config
+    from tpufw.models import LLAMA_CONFIGS, MIXTRAL_CONFIGS
+    from tpufw.models.resnet import ResNetConfig
+
+    if preset == BENCH_CONFIG_NAME:
+        return bench_model_config()
+    if preset in LLAMA_CONFIGS:
+        return LLAMA_CONFIGS[preset]
+    if preset in MIXTRAL_CONFIGS:
+        return MIXTRAL_CONFIGS[preset]
+    if preset == "resnet50":
+        return ResNetConfig()
+    raise ValueError(
+        f"unknown model preset {preset!r}; choose from "
+        f"[{BENCH_CONFIG_NAME!r}, 'resnet50', "
+        f"*{list(LLAMA_CONFIGS)}, *{list(MIXTRAL_CONFIGS)}]"
+    )
+
+
+def _apply_model_overrides(cfg, overrides: dict):
+    import jax.numpy as jnp
+
+    fields = {f.name for f in dataclasses.fields(cfg)}
+    _reject_unknown(f"model.overrides ({type(cfg).__name__})",
+                    overrides, fields)
+    coerced = dict(overrides)
+    for k in _DTYPE_FIELDS:
+        if isinstance(coerced.get(k), str):
+            coerced[k] = jnp.dtype(coerced[k]).type
+    return dataclasses.replace(cfg, **coerced)
+
+
+def load_run_config(path: str | os.PathLike) -> RunConfig:
+    """Parse one YAML of record into the framework's own dataclasses."""
+    raw = yaml.safe_load(pathlib.Path(path).read_text())
+    if not isinstance(raw, dict):
+        raise ValueError(f"{path}: top level must be a mapping")
+    _reject_unknown(
+        str(path), raw, {"name", "hardware", "model", "trainer", "mesh"}
+    )
+    model_sec = raw.get("model")
+    if not isinstance(model_sec, dict) or "preset" not in model_sec:
+        raise ValueError(f"{path}: required section model.preset missing")
+    _reject_unknown("model", model_sec, {"preset", "overrides"})
+
+    model_cfg = _apply_model_overrides(
+        _resolve_preset(model_sec["preset"]),
+        model_sec.get("overrides") or {},
+    )
+    hardware = _build_dataclass(
+        HardwareConfig, "hardware", raw.get("hardware") or {}
+    )
+    trainer_cls = (
+        VisionTrainerConfig if model_sec["preset"] == "resnet50"
+        else TrainerConfig
+    )
+    trainer = _build_dataclass(
+        trainer_cls, "trainer", raw.get("trainer") or {}
+    )
+    mesh = _build_dataclass(MeshConfig, "mesh", raw.get("mesh") or {})
+
+    # Cross-checks that catch the silent-gang-split class of drift early.
+    per_slice = dict(
+        mesh.sizes(max(1, hardware.n_chips // max(1, mesh.dcn_data)))
+    )
+    mesh_chips = mesh.dcn_data
+    for v in per_slice.values():
+        mesh_chips *= v
+    if hardware.n_chips != mesh_chips:
+        raise ValueError(
+            f"{path}: mesh covers {mesh_chips} chips but hardware "
+            f"declares {hardware.n_chips} ({hardware.slice})"
+        )
+    return RunConfig(
+        name=raw.get("name") or pathlib.Path(path).stem,
+        hardware=hardware,
+        model_preset=model_sec["preset"],
+        model_cfg=model_cfg,
+        trainer=trainer,
+        mesh=mesh,
+    )
+
+
+#: TrainerConfig/MeshConfig fields -> the TPUFW_* env names the deploy
+#: manifests use (tpufw/workloads/env.py strips the prefix + lowercases).
+_TRAINER_ENV = {
+    "batch_size": "BATCH_SIZE",
+    "seq_len": "SEQ_LEN",
+    "total_steps": "TOTAL_STEPS",
+    "lr": "LR",
+    "warmup_steps": "WARMUP_STEPS",
+    "log_every": "LOG_EVERY",
+    "checkpoint_dir": "CHECKPOINT_DIR",
+    "checkpoint_every": "CHECKPOINT_EVERY",
+    "loss_chunk_size": "LOSS_CHUNK_SIZE",
+    "loss_chunk_dtype": "LOSS_CHUNK_DTYPE",
+    "eval_every": "EVAL_EVERY",
+    "eval_batches": "EVAL_BATCHES",
+}
+_VISION_ENV = {
+    "batch_size": "BATCH_SIZE",
+    "image_size": "IMAGE_SIZE",
+    "num_classes": "NUM_CLASSES",
+    "total_steps": "TOTAL_STEPS",
+}
+_MESH_ENV = {
+    "data": "MESH_DATA",
+    "fsdp": "MESH_FSDP",
+    "expert": "MESH_EXPERT",
+    "sequence": "MESH_SEQUENCE",
+    "tensor": "MESH_TENSOR",
+    "dcn_data": "MESH_DCN_DATA",
+}
+
+
+def to_env(run: RunConfig, *, defaults_too: bool = False) -> dict[str, str]:
+    """Render a RunConfig as the TPUFW_* env dict a manifest would set.
+
+    With ``defaults_too=False`` only non-default values are emitted —
+    exactly the keys a minimal manifest must carry to reproduce the YAML
+    of record (the drift test's contract).
+    """
+    env = {} if run.family == "resnet" else {"TPUFW_MODEL": run.model_preset}
+    trainer_map = (
+        (run.trainer, _VISION_ENV, VisionTrainerConfig())
+        if run.family == "resnet"
+        else (run.trainer, _TRAINER_ENV, TrainerConfig())
+    )
+    for cfg, mapping, defaults in (
+        trainer_map,
+        (run.mesh, _MESH_ENV, MeshConfig()),
+    ):
+        for field, suffix in mapping.items():
+            val = getattr(cfg, field)
+            if not defaults_too and val == getattr(defaults, field):
+                continue
+            if val is None:
+                continue
+            env[f"TPUFW_{suffix}"] = str(val)
+    return env

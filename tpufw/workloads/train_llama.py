@@ -23,8 +23,13 @@ _T0 = time.time()
 
 
 def build_trainer():
-    """Construct (trainer, model_cfg) from TPUFW_* env. Import-light so
-    tests can exercise config resolution without touching a backend."""
+    """Construct (trainer, model_cfg) from config layers. Import-light so
+    tests can exercise config resolution without touching a backend.
+
+    Precedence (lowest first): ``TPUFW_CONFIG`` YAML of record
+    (tpufw.configs.loader, SURVEY.md §5) < ``TPUFW_*`` env vars — so a
+    manifest points at the YAML and overrides only deployment-specifics.
+    """
     import dataclasses
 
     from tpufw.configs import bench_model_config
@@ -32,8 +37,27 @@ def build_trainer():
     from tpufw.models import LLAMA_CONFIGS, Llama, MIXTRAL_CONFIGS, Mixtral
     from tpufw.train import Trainer, TrainerConfig
 
-    name = env_str("model", "llama3_600m_bench")
-    if name == "llama3_600m_bench":
+    run = None
+    cfg_path = env_str("config", "")
+    if cfg_path:
+        from tpufw.configs.loader import load_run_config
+
+        run = load_run_config(cfg_path)
+        if not isinstance(run.trainer, TrainerConfig):
+            raise ValueError(
+                f"{cfg_path}: preset {run.model_preset!r} is not an LM "
+                "config; use tpufw.workloads.train_resnet for vision runs"
+            )
+    base_t = run.trainer if run else TrainerConfig()
+    base_m = run.mesh if run else MeshConfig()
+
+    name = env_str("model", run.model_preset if run else "llama3_600m_bench")
+    if run and name == run.model_preset:
+        model_cfg = run.model_cfg  # keeps the YAML's model.overrides
+        model = Mixtral(model_cfg) if "Mixtral" in type(
+            model_cfg
+        ).__name__ else None
+    elif name == "llama3_600m_bench":
         model_cfg, model = bench_model_config(), None
     elif name in LLAMA_CONFIGS:
         model_cfg, model = LLAMA_CONFIGS[name], None
@@ -53,30 +77,42 @@ def build_trainer():
         model = Llama(model_cfg)
 
     trainer_cfg = TrainerConfig(
-        batch_size=env_int("batch_size", 8),
-        seq_len=env_int("seq_len", model_cfg.max_seq_len),
-        total_steps=env_int("total_steps", 100),
-        lr=env_float("lr", 3e-4),
-        warmup_steps=env_int("warmup_steps", 10),
-        log_every=env_int("log_every", 10),
-        checkpoint_dir=env_str("checkpoint_dir", "") or None,
-        checkpoint_every=env_int("checkpoint_every", 100),
+        batch_size=env_int("batch_size", base_t.batch_size),
+        seq_len=env_int(
+            "seq_len",
+            base_t.seq_len if run else model_cfg.max_seq_len,
+        ),
+        total_steps=env_int("total_steps", base_t.total_steps),
+        lr=env_float("lr", base_t.lr if run else 3e-4),
+        warmup_steps=env_int("warmup_steps", base_t.warmup_steps),
+        log_every=env_int("log_every", base_t.log_every),
+        checkpoint_dir=env_str("checkpoint_dir", base_t.checkpoint_dir or "")
+        or None,
+        checkpoint_every=env_int(
+            "checkpoint_every", base_t.checkpoint_every if run else 100
+        ),
         # 0/unset = full logits; >0 enables chunked-vocab CE.
-        loss_chunk_size=env_int("loss_chunk_size", 512) or None,
+        loss_chunk_size=env_int(
+            "loss_chunk_size",
+            (base_t.loss_chunk_size or 0) if run else 512,
+        )
+        or None,
         # "float32" restores exact full-logits numerics (slower head).
-        loss_chunk_dtype=env_str("loss_chunk_dtype", "bfloat16"),
-        profile_dir=env_str("profile_dir", "") or None,
-        profile_start=env_int("profile_start", 3),
-        profile_stop=env_int("profile_stop", 6),
+        loss_chunk_dtype=env_str("loss_chunk_dtype", base_t.loss_chunk_dtype),
+        profile_dir=env_str("profile_dir", base_t.profile_dir or "") or None,
+        profile_start=env_int("profile_start", base_t.profile_start),
+        profile_stop=env_int("profile_stop", base_t.profile_stop),
+        eval_every=env_int("eval_every", base_t.eval_every),
+        eval_batches=env_int("eval_batches", base_t.eval_batches),
     )
     mesh_cfg = MeshConfig(
-        data=env_int("mesh_data", 1),
-        fsdp=env_int("mesh_fsdp", -1),
-        expert=env_int("mesh_expert", 1),
-        sequence=env_int("mesh_sequence", 1),
-        tensor=env_int("mesh_tensor", 1),
+        data=env_int("mesh_data", base_m.data),
+        fsdp=env_int("mesh_fsdp", base_m.fsdp),
+        expert=env_int("mesh_expert", base_m.expert),
+        sequence=env_int("mesh_sequence", base_m.sequence),
+        tensor=env_int("mesh_tensor", base_m.tensor),
         # >1 = multi-slice: data parallelism across slices over DCN.
-        dcn_data=env_int("mesh_dcn_data", 1),
+        dcn_data=env_int("mesh_dcn_data", base_m.dcn_data),
     )
     return Trainer(model, trainer_cfg, mesh_cfg), model_cfg
 
@@ -138,8 +174,35 @@ def main() -> int:
     else:
         data = synthetic_batches(
             local_bs, cfg.seq_len, model_cfg.vocab_size,
-            seed=env_int("data_seed", 0) * 1000 + cluster.process_id,
+            # Even seed space; the synthetic eval stream uses odd.
+            seed=env_int("data_seed", 0) * 2000 + 2 * cluster.process_id,
         )
+    # Held-out eval stream (TPUFW_EVAL_EVERY > 0 enables): a disjoint
+    # corpus prefix when given, else synthetic batches from a disjoint
+    # seed space (train seeds are even, eval seeds odd — no collision
+    # for any TPUFW_DATA_SEED / process id).
+    eval_data = None
+    if cfg.eval_every:
+        eval_prefix = env_str("eval_data_prefix", "")
+        if eval_prefix:
+            from tpufw.train import TokenCorpus
+
+            def eval_data():
+                return iter(
+                    TokenCorpus(
+                        eval_prefix, local_bs, cfg.seq_len,
+                        shard_id=cluster.process_id, num_shards=n_proc,
+                    )
+                )
+        else:
+
+            def eval_data():
+                return synthetic_batches(
+                    local_bs, cfg.seq_len, model_cfg.vocab_size,
+                    seed=env_int("data_seed", 0) * 2000
+                    + 2 * cluster.process_id + 1,
+                )
+
     first_step: dict = {}
 
     def on_metrics(m):
@@ -162,6 +225,8 @@ def main() -> int:
         data,
         model_flops_per_token=flops_per_token,
         on_metrics=on_metrics,
+        eval_data=eval_data,
+        on_eval=lambda ev: print(json.dumps(ev), flush=True),
     )
     if history:
         last = history[-1]
