@@ -1,0 +1,62 @@
+"""pack_corpus CLI: text -> native corpus format -> TokenCorpus round-trip."""
+
+import json
+
+import numpy as np
+
+from tpufw.tools.pack_corpus import byte_tokenizer, main, pack_corpus
+from tpufw.train import TokenCorpus
+
+
+def test_byte_tokenizer_reserves_pad_id():
+    ids = byte_tokenizer("ab")
+    assert ids == [ord("a") + 1, ord("b") + 1]
+    assert 0 not in ids
+
+
+def test_pack_txt_and_jsonl_round_trip(tmp_path):
+    (tmp_path / "a.txt").write_text("hello world")
+    (tmp_path / "b.jsonl").write_text(
+        json.dumps({"text": "doc two"}) + "\n"
+        + json.dumps({"text": "doc three"}) + "\n"
+        + "\n"
+    )
+    out = tmp_path / "corpus"
+    stats = pack_corpus(
+        [str(tmp_path / "a.txt"), str(tmp_path / "b.jsonl")], str(out)
+    )
+    assert stats["n_docs"] == 3
+    assert stats["n_tokens"] == len("hello world") + len("doc two") + len(
+        "doc three"
+    )
+
+    # The training loader consumes it directly.
+    corpus = TokenCorpus(str(out), batch_size=2, seq_len=16, epochs=1)
+    batches = list(corpus)
+    assert batches, "corpus yielded no batches"
+    toks = batches[0]["tokens"]
+    segs = batches[0]["segment_ids"]
+    assert toks.shape == (2, 16)
+    # First doc decodes back to the original text.
+    row = toks[0][segs[0] == 1]
+    assert bytes(b - 1 for b in row.tolist()[: len("hello world")]) == (
+        b"hello world"
+    )
+
+
+def test_per_line_mode(tmp_path):
+    (tmp_path / "lines.txt").write_text("one\ntwo\n\nthree\n")
+    stats = pack_corpus(
+        [str(tmp_path / "lines.txt")], str(tmp_path / "c"), per_line=True
+    )
+    assert stats["n_docs"] == 3
+
+
+def test_cli_main_prints_stats(tmp_path, capsys):
+    (tmp_path / "a.txt").write_text("abc")
+    rc = main([str(tmp_path / "a.txt"), "--out", str(tmp_path / "c")])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["n_docs"] == 1 and stats["n_tokens"] == 3
+    idx = np.fromfile(tmp_path / "c.idx", np.uint64)
+    assert idx.tolist() == [0, 3]

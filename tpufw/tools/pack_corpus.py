@@ -1,0 +1,142 @@
+"""Corpus prep CLI: text files -> packed token corpus (<prefix>.bin/.idx).
+
+The reference ships no data tooling (its workload is a diagnostic CLI,
+reference README.md:314); tpufw's training path consumes the native
+corpus format documented in native/dataloader/dataloader.h. This tool is
+the missing first step: tokenize raw text into that format so
+``TPUFW_DATA_PREFIX`` points at something a user can actually produce.
+
+    python -m tpufw.tools.pack_corpus --out /data/corpus \
+        --tokenizer meta-llama/Meta-Llama-3-8B file1.txt file2.jsonl
+
+Tokenizers:
+- ``--tokenizer <hf-name-or-path>``: HuggingFace AutoTokenizer
+  (transformers is an optional dependency — a clear error tells you if
+  it's missing). Token ids must fit the corpus format's uint32.
+- ``--tokenizer bytes`` (default): dependency-free byte-level ids
+  (utf-8 byte + 1; 0 is reserved for padding) — enough for smoke tests
+  and the unit suite, deterministic everywhere.
+
+Documents: one per line for ``.jsonl`` (key ``text``) / ``.txt`` files
+with ``--per-line``; otherwise whole file = one document. Empty docs are
+dropped (zero-length docs would emit empty segments).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import Callable, Iterator, List, Sequence
+
+
+def byte_tokenizer(text: str) -> List[int]:
+    """utf-8 byte ids shifted by 1 so id 0 stays the pad id."""
+    return [b + 1 for b in text.encode("utf-8")]
+
+
+def hf_tokenizer(name: str) -> Callable[[str], List[int]]:
+    try:
+        from transformers import AutoTokenizer
+    except ImportError as e:  # pragma: no cover - environment-dependent
+        raise SystemExit(
+            "--tokenizer requires the 'transformers' package for "
+            f"anything but 'bytes' (got {name!r}): {e}"
+        )
+    tok = AutoTokenizer.from_pretrained(name)
+
+    def encode(text: str) -> List[int]:
+        return tok.encode(text)
+
+    return encode
+
+
+def iter_documents(
+    paths: Sequence[str], per_line: bool = False
+) -> Iterator[str]:
+    """Yield raw document strings from .txt / .jsonl inputs."""
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.suffix == ".jsonl":
+            with path.open() as f:
+                for ln in f:
+                    ln = ln.strip()
+                    if not ln:
+                        continue
+                    doc = json.loads(ln)
+                    text = doc["text"] if isinstance(doc, dict) else str(doc)
+                    if text:
+                        yield text
+        elif per_line:
+            with path.open() as f:
+                for ln in f:
+                    if ln.strip():
+                        yield ln.rstrip("\n")
+        else:
+            text = path.read_text()
+            if text:
+                yield text
+
+
+def pack_corpus(
+    inputs: Sequence[str],
+    out_prefix: str,
+    tokenizer: str = "bytes",
+    per_line: bool = False,
+) -> dict:
+    """Tokenize and write the corpus; returns summary stats."""
+    from tpufw.train.native_data import write_token_corpus
+
+    encode = (
+        byte_tokenizer if tokenizer == "bytes" else hf_tokenizer(tokenizer)
+    )
+    docs: List[List[int]] = []
+    for text in iter_documents(inputs, per_line=per_line):
+        ids = encode(text)
+        if not ids:
+            continue
+        if any(i < 0 or i >= 2**32 for i in ids):
+            raise ValueError(
+                f"tokenizer {tokenizer!r} produced ids outside uint32"
+            )
+        docs.append(ids)
+    if not docs:
+        raise SystemExit("no non-empty documents found")
+    bin_path, idx_path = write_token_corpus(out_prefix, docs)
+    return {
+        "bin": bin_path,
+        "idx": idx_path,
+        "n_docs": len(docs),
+        "n_tokens": sum(len(d) for d in docs),
+        "tokenizer": tokenizer,
+    }
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpufw.tools.pack_corpus", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("inputs", nargs="+", help=".txt / .jsonl files")
+    ap.add_argument(
+        "--out", required=True,
+        help="output prefix (writes <out>.bin and <out>.idx)",
+    )
+    ap.add_argument(
+        "--tokenizer", default="bytes",
+        help="'bytes' (default) or a HuggingFace tokenizer name/path",
+    )
+    ap.add_argument(
+        "--per-line", action="store_true",
+        help="treat each line of .txt inputs as its own document",
+    )
+    args = ap.parse_args(argv)
+    stats = pack_corpus(
+        args.inputs, args.out, args.tokenizer, args.per_line
+    )
+    print(json.dumps(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
