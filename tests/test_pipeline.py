@@ -1,0 +1,156 @@
+"""Pipeline parallelism: GPipe schedule == sequential evaluation.
+
+The oracle is ``reference_forward`` — the SAME parameter pytree evaluated
+layer-by-layer with no pipe axis. The schedule (microbatch streaming,
+ppermute handoffs, bubble masking, psum combine) must be numerically
+invisible: logits and gradients match to float tolerance, composed with
+data parallelism on the same mesh.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.mesh import MeshConfig, build_mesh
+from tpufw.models import LLAMA_CONFIGS
+from tpufw.parallel.pipeline import (
+    PipelineConfig,
+    init_pipeline_params,
+    pipeline_forward,
+    pipeline_loss,
+    pipeline_param_shardings,
+    pipeline_train_step,
+    reference_forward,
+)
+
+# fp32 end to end so parity is tight (bf16 would hide schedule bugs in
+# rounding noise).
+CFG = dataclasses.replace(
+    LLAMA_CONFIGS["llama3_tiny"],
+    n_layers=4,
+    dtype=jnp.float32,
+    param_dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(MeshConfig(data=2, pipe=2, fsdp=2))
+
+
+@pytest.fixture(scope="module")
+def setup(mesh):
+    pipe = PipelineConfig(n_stages=2, n_microbatches=4)
+    params = init_pipeline_params(jax.random.key(0), CFG, pipe)
+    shardings = pipeline_param_shardings(mesh, params)
+    params = jax.device_put(params, shardings)
+    tokens = jax.random.randint(
+        jax.random.key(1), (16, 17), 0, CFG.vocab_size
+    )
+    return params, tokens, pipe
+
+
+def test_forward_matches_sequential(setup, mesh):
+    params, tokens, pipe = setup
+    got = jax.jit(
+        lambda p, t: pipeline_forward(p, t, CFG, pipe, mesh)
+    )(params, tokens)
+    want = reference_forward(params, tokens, CFG)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_grads_match_sequential(setup, mesh):
+    params, tokens, pipe = setup
+
+    def ref_loss(p, t):
+        from tpufw.train.trainer import cross_entropy_loss
+
+        logits = reference_forward(p, t[:, :-1], CFG)
+        return cross_entropy_loss(logits, t[:, 1:])[0]
+
+    l_pipe, g_pipe = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: pipeline_loss(p, t, CFG, pipe, mesh)
+        )
+    )(params, tokens)
+    l_ref, g_ref = jax.value_and_grad(ref_loss)(params, tokens)
+    np.testing.assert_allclose(float(l_pipe), float(l_ref), rtol=1e-5)
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pipe)
+    flat_r, _ = jax.tree_util.tree_flatten_with_path(g_ref)
+    for (path, a), (_, b) in zip(flat_p, flat_r):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_stage_params_are_sharded_on_pipe(setup):
+    params, _, _ = setup
+    wq = params["stages"]["wq"]
+    assert "pipe" in str(wq.sharding.spec)
+    # Two stages x two layers per stage.
+    assert wq.shape[:2] == (2, 2)
+
+
+def test_train_step_learns(setup, mesh):
+    import optax
+
+    params, tokens, pipe = setup
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+    step = jax.jit(
+        lambda p, o, t: pipeline_train_step(
+            p, o, t, tx, CFG, pipe, mesh
+        )
+    )
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses[-1])
+
+
+def test_four_stages_on_pipe4(setup):
+    mesh4 = build_mesh(MeshConfig(data=2, pipe=4, fsdp=1))
+    pipe = PipelineConfig(n_stages=4, n_microbatches=8)
+    params = init_pipeline_params(jax.random.key(2), CFG, pipe)
+    params = jax.device_put(
+        params, pipeline_param_shardings(mesh4, params)
+    )
+    tokens = jax.random.randint(
+        jax.random.key(3), (16, 9), 0, CFG.vocab_size
+    )
+    got = jax.jit(
+        lambda p, t: pipeline_forward(p, t, CFG, pipe, mesh4)
+    )(params, tokens)
+    want = reference_forward(params, tokens, CFG)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_validation_is_loud(mesh):
+    pipe = PipelineConfig(n_stages=3, n_microbatches=4)
+    with pytest.raises(ValueError, match="not divisible by 3 stages"):
+        pipe.validate(CFG, batch_size=8)
+    pipe = PipelineConfig(n_stages=2, n_microbatches=3)
+    with pytest.raises(ValueError, match="not divisible by 3 microbatches"):
+        pipe.validate(CFG, batch_size=8)
+
+
+def test_stage_mesh_mismatch_is_loud(setup, mesh):
+    params, tokens, _ = setup
+    pipe = PipelineConfig(n_stages=4, n_microbatches=4)  # mesh pipe=2
+    with pytest.raises(ValueError, match="mesh pipe axis has size 2"):
+        pipeline_forward(params, tokens, CFG, pipe, mesh)
+
+
+def test_bubble_fraction():
+    assert PipelineConfig(2, 4).bubble_fraction() == pytest.approx(1 / 5)
+    assert PipelineConfig(4, 16).bubble_fraction() == pytest.approx(3 / 19)

@@ -2,6 +2,7 @@ from tpufw.mesh.mesh import (  # noqa: F401
     AXIS_DATA,
     AXIS_EXPERT,
     AXIS_FSDP,
+    AXIS_PIPE,
     AXIS_SEQUENCE,
     AXIS_TENSOR,
     MESH_AXES,

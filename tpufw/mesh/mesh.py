@@ -11,6 +11,8 @@ mesh axis) rule set consumed here.
 Axes
 ----
 - ``data``     — pure data parallelism (gradient psum across replicas)
+- ``pipe``     — pipeline parallelism over the layer stack (GPipe schedule,
+                 point-to-point ppermute handoffs — tpufw.parallel.pipeline)
 - ``fsdp``     — data parallelism with parameter/optimizer sharding (ZeRO-3
                  style: XLA all-gathers params per layer, reduce-scatters grads)
 - ``sequence`` — context parallelism for long sequences (ring attention /
@@ -33,6 +35,7 @@ from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 AXIS_DATA = "data"
+AXIS_PIPE = "pipe"
 AXIS_FSDP = "fsdp"
 AXIS_SEQUENCE = "sequence"
 AXIS_TENSOR = "tensor"
@@ -41,8 +44,11 @@ AXIS_EXPERT = "expert"
 # Order matters: leftmost axes get the slowest-varying device dimension, so
 # `tensor` (rightmost) stays within the densest ICI neighborhood and `data`
 # (leftmost) spans hosts/DCN — the layout the scaling playbook prescribes.
+# `pipe` sits next to `data`: stage handoffs are low-bandwidth point-to-point
+# activations, the cheapest collective to push toward the sparse end.
 MESH_AXES: tuple[str, ...] = (
     AXIS_DATA,
+    AXIS_PIPE,
     AXIS_FSDP,
     AXIS_EXPERT,
     AXIS_SEQUENCE,
@@ -64,6 +70,7 @@ class MeshConfig:
     """
 
     data: int = 1
+    pipe: int = 1
     fsdp: int = -1
     expert: int = 1
     sequence: int = 1
@@ -74,6 +81,7 @@ class MeshConfig:
         """Per-slice axis sizes (n_devices = devices in one slice)."""
         raw = {
             AXIS_DATA: self.data,
+            AXIS_PIPE: self.pipe,
             AXIS_FSDP: self.fsdp,
             AXIS_EXPERT: self.expert,
             AXIS_SEQUENCE: self.sequence,
@@ -102,7 +110,12 @@ class MeshConfig:
     def model_parallel_size(self, n_devices: int) -> int:
         """Devices holding one replica's model shards (excl. data/fsdp)."""
         sizes = self.sizes(n_devices)
-        return sizes[AXIS_TENSOR] * sizes[AXIS_SEQUENCE] * sizes[AXIS_EXPERT]
+        return (
+            sizes[AXIS_TENSOR]
+            * sizes[AXIS_SEQUENCE]
+            * sizes[AXIS_EXPERT]
+            * sizes[AXIS_PIPE]
+        )
 
 
 def build_mesh(

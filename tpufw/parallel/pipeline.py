@@ -1,0 +1,318 @@
+"""Pipeline parallelism: GPipe schedule over the ``pipe`` mesh axis.
+
+The reference implements no parallelism of any kind (SURVEY.md §2c); tpufw
+treats the device mesh as the communication backend, and this module adds
+the pipeline dimension: the layer stack is split into S stages, each stage
+owned by one rank of the ``pipe`` mesh axis, and microbatches stream
+through the stages with activations handed off by ``lax.ppermute`` —
+point-to-point neighbor traffic, the cheapest collective on the mesh.
+
+TPU-first shape of the implementation:
+- the schedule is a ``lax.scan`` over M + S - 1 ticks inside one
+  ``shard_map`` region — no per-tick Python, one compiled program, and
+  the backward pass (autodiff through scan + ppermute) is the reverse
+  schedule for free. Bubble fraction is (S-1)/(M+S-1): pick
+  ``n_microbatches >> n_stages``.
+- stage parameters are stacked on a leading [S] axis sharded over
+  ``pipe`` — each device materializes only its own stage's layers.
+- within a stage, layers run under ``lax.scan`` over a [layers_per_stage]
+  axis (same one-block-compile property as the flax trunk).
+- composes with data parallelism: the microbatch batch dim is sharded
+  over (``data``, ``fsdp``); ``tensor``/``sequence``/``expert`` must be 1
+  in this first cut (asserted).
+
+The block math matches ``tpufw.models.llama`` (RMSNorm -> GQA attention
+with RoPE -> SwiGLU), reusing the same functional ops
+(``tpufw.ops.rms_norm`` / ``multi_head_attention`` /
+``tpufw.models.llama.apply_rope``), so a pipeline stage is numerically the
+same transformer block — pinned by the parity tests
+(tests/test_pipeline.py) against a sequential evaluation of the identical
+parameters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpufw.mesh import AXIS_DATA, AXIS_FSDP, AXIS_PIPE
+from tpufw.models.llama import LlamaConfig, apply_rope
+from tpufw.ops import multi_head_attention, rms_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Pipeline schedule hyperparameters on top of a LlamaConfig."""
+
+    n_stages: int
+    n_microbatches: int
+
+    def validate(self, model: LlamaConfig, batch_size: int) -> None:
+        if model.n_layers % self.n_stages:
+            raise ValueError(
+                f"n_layers {model.n_layers} not divisible by "
+                f"{self.n_stages} stages"
+            )
+        if batch_size % self.n_microbatches:
+            raise ValueError(
+                f"batch {batch_size} not divisible by "
+                f"{self.n_microbatches} microbatches"
+            )
+
+    def bubble_fraction(self) -> float:
+        s, m = self.n_stages, self.n_microbatches
+        return (s - 1) / (m + s - 1)
+
+
+# ----------------------------------------------------------------------
+# Parameters
+# ----------------------------------------------------------------------
+
+
+def init_pipeline_params(
+    key: jax.Array, cfg: LlamaConfig, pipe: PipelineConfig
+) -> dict:
+    """Explicit param pytree; stage weights stacked on a leading [S] axis.
+
+    Initializers match the flax trunk (normal embed, lecun-style fan-in
+    scaling elsewhere); stored in ``cfg.param_dtype``.
+    """
+    s = pipe.n_stages
+    lps = cfg.n_layers // s
+    d, h, kh, dh, f = (
+        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff,
+    )
+    keys = jax.random.split(key, 9)
+
+    def w(k, shape, fan_in):
+        return (
+            jax.random.normal(k, shape, jnp.float32)
+            / math.sqrt(fan_in)
+        ).astype(cfg.param_dtype)
+
+    return {
+        "embed": jax.random.normal(
+            keys[0], (cfg.vocab_size, d), jnp.float32
+        ).astype(cfg.param_dtype),
+        "stages": {
+            "attn_norm": jnp.ones((s, lps, d), jnp.float32),
+            "wq": w(keys[1], (s, lps, d, h, dh), d),
+            "wk": w(keys[2], (s, lps, d, kh, dh), d),
+            "wv": w(keys[3], (s, lps, d, kh, dh), d),
+            "wo": w(keys[4], (s, lps, h, dh, d), h * dh),
+            "mlp_norm": jnp.ones((s, lps, d), jnp.float32),
+            "w_gate": w(keys[5], (s, lps, d, f), d),
+            "w_up": w(keys[6], (s, lps, d, f), d),
+            "w_down": w(keys[7], (s, lps, f, d), f),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "head": w(keys[8], (d, cfg.vocab_size), d),
+    }
+
+
+def pipeline_param_shardings(mesh: Mesh, params: dict) -> dict:
+    """NamedShardings: stage stacks split over ``pipe``, rest replicated."""
+    stage = NamedSharding(mesh, P(AXIS_PIPE))
+    rep = NamedSharding(mesh, P())
+    return {
+        "embed": rep,
+        "stages": jax.tree.map(lambda _: stage, params["stages"]),
+        "final_norm": rep,
+        "head": rep,
+    }
+
+
+# ----------------------------------------------------------------------
+# Block / stage math (numerically the tpufw.models.llama block)
+# ----------------------------------------------------------------------
+
+
+def _block(p: dict, x: jax.Array, cfg: LlamaConfig, backend: str):
+    """One decoder block; p leaves have no leading layer axis."""
+    dt = cfg.dtype
+    positions = jnp.broadcast_to(
+        jnp.arange(x.shape[1]), x.shape[:2]
+    )
+    h = rms_norm(x, p["attn_norm"], cfg.rms_eps)
+    q = jnp.einsum("btd,dhk->bthk", h, p["wq"].astype(dt))
+    k = jnp.einsum("btd,dhk->bthk", h, p["wk"].astype(dt))
+    v = jnp.einsum("btd,dhk->bthk", h, p["wv"].astype(dt))
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    att = multi_head_attention(q, k, v, causal=True, backend=backend)
+    x = x + jnp.einsum("bthk,hkd->btd", att, p["wo"].astype(dt))
+    h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
+    g = jnp.einsum("btd,df->btf", h, p["w_gate"].astype(dt))
+    u = jnp.einsum("btd,df->btf", h, p["w_up"].astype(dt))
+    x = x + jnp.einsum(
+        "btf,fd->btd", jax.nn.silu(g) * u, p["w_down"].astype(dt)
+    )
+    return x
+
+
+def _stage(stage_params: dict, x: jax.Array, cfg, backend: str):
+    """Run this stage's [layers_per_stage] blocks via lax.scan."""
+
+    def body(h, layer_p):
+        return _block(layer_p, h, cfg, backend), None
+
+    out, _ = jax.lax.scan(body, x, stage_params)
+    return out
+
+
+# ----------------------------------------------------------------------
+# GPipe schedule
+# ----------------------------------------------------------------------
+
+
+def _gpipe_local(stage_params, x_mb, *, cfg, backend):
+    """Per-device body (inside shard_map): stream M microbatches through
+    the pipe ring. x_mb: [M, mb_local, T, D]; returns same shape (valid
+    data produced on the last stage, zeros elsewhere, psum-combined)."""
+    s = jax.lax.axis_size(AXIS_PIPE)
+    sidx = jax.lax.axis_index(AXIS_PIPE)
+    # Local leading stage dim is 1 after sharding: drop it.
+    stage_params = jax.tree.map(lambda a: a[0], stage_params)
+    m = x_mb.shape[0]
+    perm = [(i, (i + 1) % s) for i in range(s)]
+
+    def tick(carry, t):
+        recv, outs = carry
+        x_in = jnp.where(
+            sidx == 0, x_mb[jnp.clip(t, 0, m - 1)], recv
+        )
+        out = _stage(stage_params, x_in, cfg, backend)
+        nxt = jax.lax.ppermute(out, AXIS_PIPE, perm)
+        # Last stage finishes microbatch t-(s-1) at tick t.
+        oidx = jnp.clip(t - (s - 1), 0, m - 1)
+        valid = (t >= s - 1) & (sidx == s - 1)
+        cur = jax.lax.dynamic_index_in_dim(outs, oidx, 0, keepdims=False)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(valid, out, cur), oidx, 0
+        )
+        return (nxt, outs), None
+
+    zeros = jnp.zeros_like(x_mb[0])
+    outs0 = jnp.zeros_like(x_mb)
+    (_, outs), _ = jax.lax.scan(
+        tick, (zeros, outs0), jnp.arange(m + s - 1)
+    )
+    # Non-last stages hold zeros; the psum replicates the real result
+    # across the pipe axis (required: `pipe` is unmentioned in out_specs).
+    return jax.lax.psum(outs, AXIS_PIPE)
+
+
+def pipeline_forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    pipe: PipelineConfig,
+    mesh: Mesh,
+    backend: Optional[str] = None,
+) -> jax.Array:
+    """Full LM forward with the block stack pipelined: logits [B, T, V].
+
+    Embedding and the head run outside the pipeline region (they are a
+    small fraction of compute and live replicated / batch-sharded);
+    everything between — the whole layer stack — runs on the pipe ring.
+    """
+    for ax in ("tensor", "sequence", "expert"):
+        if mesh.shape[ax] != 1:
+            raise NotImplementedError(
+                f"pipeline composes with data/fsdp only for now; mesh "
+                f"axis {ax} has size {mesh.shape[ax]}"
+            )
+    if mesh.shape[AXIS_PIPE] != pipe.n_stages:
+        # Without this, sharding a [S, ...] stack over a differently-sized
+        # pipe axis silently drops (or duplicates) stages' layers.
+        raise ValueError(
+            f"PipelineConfig.n_stages={pipe.n_stages} but mesh pipe axis "
+            f"has size {mesh.shape[AXIS_PIPE]}"
+        )
+    pipe.validate(cfg, tokens.shape[0])
+    backend = backend or cfg.attention_backend
+    b, t = tokens.shape
+    m = pipe.n_microbatches
+    dp = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+    if (b // m) % dp:
+        raise ValueError(
+            f"microbatch rows {b // m} (batch {b} / {m} microbatches) "
+            f"not divisible over data x fsdp = {dp} devices"
+        )
+
+    x = params["embed"].astype(cfg.dtype)[tokens]  # [B, T, D]
+    x = x.reshape(m, b // m, t, cfg.d_model)
+
+    mb_spec = P(None, (AXIS_DATA, AXIS_FSDP), None, None)
+    hidden = shard_map(
+        partial(_gpipe_local, cfg=cfg, backend=backend),
+        mesh=mesh,
+        in_specs=(P(AXIS_PIPE), mb_spec),
+        out_specs=mb_spec,
+        check_vma=False,
+    )(params["stages"], x)
+    hidden = hidden.reshape(b, t, cfg.d_model)
+
+    h = rms_norm(hidden, params["final_norm"], cfg.rms_eps)
+    return h.astype(jnp.float32) @ params["head"].astype(jnp.float32)
+
+
+def reference_forward(
+    params: dict, tokens: jax.Array, cfg: LlamaConfig, backend: str = "xla"
+) -> jax.Array:
+    """Sequential evaluation of the SAME params (no pipe axis) — the
+    parity oracle for the schedule."""
+    b, t = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    flat = jax.tree.map(
+        lambda a: a.reshape(-1, *a.shape[2:]), params["stages"]
+    )
+
+    def body(h, layer_p):
+        return _block(layer_p, h, cfg, backend), None
+
+    x, _ = jax.lax.scan(body, x, flat)
+    h = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return h.astype(jnp.float32) @ params["head"].astype(jnp.float32)
+
+
+def pipeline_loss(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    pipe: PipelineConfig,
+    mesh: Mesh,
+) -> jax.Array:
+    """Next-token CE through the pipelined forward (shift-left targets,
+    same objective shape as tpufw.train.trainer.batch_loss)."""
+    from tpufw.train.trainer import cross_entropy_loss
+
+    logits = pipeline_forward(params, tokens[:, :-1], cfg, pipe, mesh)
+    loss, _ = cross_entropy_loss(logits, tokens[:, 1:])
+    return loss
+
+
+def pipeline_train_step(
+    params: dict,
+    opt_state: Any,
+    tokens: jax.Array,
+    tx,
+    cfg: LlamaConfig,
+    pipe: PipelineConfig,
+    mesh: Mesh,
+) -> tuple[dict, Any, jax.Array]:
+    """One SGD/AdamW step over the pipelined model (jit this)."""
+    import optax
+
+    loss, grads = jax.value_and_grad(pipeline_loss)(
+        params, tokens, cfg, pipe, mesh
+    )
+    updates, opt_state = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss
