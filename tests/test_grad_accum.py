@@ -1,0 +1,167 @@
+"""Gradient accumulation: A microbatches == one big batch, cheaper memory.
+
+The invariant is numerical: with identical params and the same global
+batch, the accumulated step must produce the same loss and (to fp
+summation tolerance) the same updated parameters as the one-shot step —
+including token-weighted combination when loss_mask makes microbatch
+token counts unequal.
+"""
+
+import numpy as np
+import pytest
+
+from tpufw.mesh import MeshConfig
+from tpufw.models import Llama, LLAMA_CONFIGS
+from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+TINY = LLAMA_CONFIGS["llama3_tiny"]
+
+
+def _one_batch(batch_size, seq_len, masked=False, seed=3):
+    batch = next(
+        iter(synthetic_batches(batch_size, seq_len, TINY.vocab_size, seed))
+    )
+    if masked:
+        rng = np.random.default_rng(7)
+        # Unequal token counts per row -> microbatch weights differ.
+        mask = (rng.random((batch_size, seq_len)) < 0.7).astype(np.float32)
+        mask[:, 0] = 1.0
+        batch["loss_mask"] = mask
+    return batch
+
+
+def _step_once(grad_accum, batch, seed=0):
+    import optax
+
+    trainer = Trainer(
+        Llama(TINY),
+        TrainerConfig(
+            batch_size=batch["tokens"].shape[0],
+            seq_len=batch["tokens"].shape[1],
+            total_steps=1,
+            lr=1e-2,
+            warmup_steps=0,
+            grad_accum=grad_accum,
+        ),
+        # dp = 4 so batch 16 / accum 4 = 4 rows per microbatch divides.
+        MeshConfig(data=2, fsdp=2, tensor=2),
+        # SGD: the update is linear in the gradient, so parity holds to
+        # fp tolerance. (Adam's first step is ~sign(g) and flips on
+        # epsilon-sized summation-order differences near zero.)
+        tx=optax.sgd(1e-2),
+    )
+    trainer.init_state(seed=seed)
+    step = trainer.compiled_step(batch)
+    state, metrics = step(trainer.state, batch)
+    return state, metrics
+
+
+@pytest.mark.parametrize("masked", [False, True], ids=["plain", "masked"])
+def test_accum_matches_one_shot(masked):
+    batch = _one_batch(16, 33, masked=masked)
+    s1, m1 = _step_once(1, batch)
+    s4, m4 = _step_once(4, batch)
+    np.testing.assert_allclose(
+        float(m1["loss"]), float(m4["loss"]), rtol=1e-5
+    )
+    import jax
+
+    flat1, _ = jax.tree_util.tree_flatten_with_path(s1.params)
+    flat4, _ = jax.tree_util.tree_flatten_with_path(s4.params)
+    for (path, a), (_, b) in zip(flat1, flat4):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_accum_trains(devices8):
+    trainer = Trainer(
+        Llama(TINY),
+        TrainerConfig(
+            batch_size=16, seq_len=33, total_steps=8, lr=1e-2,
+            warmup_steps=2, grad_accum=2,
+        ),
+        MeshConfig(data=2, fsdp=4),
+    )
+    trainer.init_state()
+    hist = trainer.run(
+        synthetic_batches(16, 33, TINY.vocab_size),
+        model_flops_per_token=TINY.flops_per_token(32),
+    )
+    assert hist[-1].loss < hist[0].loss
+
+
+def test_bf16_mu_halves_moment_and_trains(devices8):
+    import jax
+    import jax.numpy as jnp
+
+    trainer = Trainer(
+        Llama(TINY),
+        TrainerConfig(
+            batch_size=8, seq_len=33, total_steps=6, lr=1e-2,
+            warmup_steps=1, adam_mu_dtype="bfloat16",
+        ),
+        MeshConfig(data=2, fsdp=4),
+    )
+    trainer.init_state()
+    mus = [
+        x.dtype
+        for x in jax.tree.leaves(trainer.state.opt_state)
+        if hasattr(x, "dtype") and x.dtype == jnp.bfloat16
+    ]
+    assert mus, "no bf16 moment buffers found in opt_state"
+    hist = trainer.run(
+        synthetic_batches(8, 33, TINY.vocab_size),
+        model_flops_per_token=TINY.flops_per_token(32),
+    )
+    assert hist[-1].loss < hist[0].loss
+
+
+def test_accum_with_bf16_params(devices8):
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    cfg = dataclasses.replace(TINY, param_dtype=jnp.bfloat16)
+    trainer = Trainer(
+        Llama(cfg),
+        TrainerConfig(
+            batch_size=16, seq_len=33, total_steps=4, lr=1e-2,
+            warmup_steps=1, grad_accum=2,
+        ),
+        MeshConfig(data=2, fsdp=4),
+    )
+    trainer.init_state()
+    hist = trainer.run(
+        synthetic_batches(16, 33, cfg.vocab_size),
+        model_flops_per_token=cfg.flops_per_token(32),
+    )
+    assert np.isfinite(hist[-1].loss)
+
+
+def test_zero_accum_is_loud():
+    trainer = Trainer(
+        Llama(TINY),
+        TrainerConfig(
+            batch_size=16, seq_len=33, total_steps=1, grad_accum=0
+        ),
+        MeshConfig(data=2, fsdp=4),
+    )
+    trainer.init_state()
+    with pytest.raises(ValueError, match="grad_accum must be >= 1"):
+        trainer.compiled_step(_one_batch(16, 33))
+
+
+def test_bad_divisibility_is_loud():
+    trainer = Trainer(
+        Llama(TINY),
+        TrainerConfig(
+            batch_size=16, seq_len=33, total_steps=1, grad_accum=4
+        ),
+        MeshConfig(data=2, fsdp=4),  # 16/4 = 4 rows, dp = 8 -> invalid
+    )
+    trainer.init_state()
+    batch = _one_batch(16, 33)
+    with pytest.raises(ValueError, match="grad_accum=4"):
+        trainer.compiled_step(batch)

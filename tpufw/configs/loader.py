@@ -191,6 +191,8 @@ _TRAINER_ENV = {
     "loss_chunk_dtype": "LOSS_CHUNK_DTYPE",
     "eval_every": "EVAL_EVERY",
     "eval_batches": "EVAL_BATCHES",
+    "grad_accum": "GRAD_ACCUM",
+    "adam_mu_dtype": "ADAM_MU_DTYPE",
 }
 _VISION_ENV = {
     "batch_size": "BATCH_SIZE",

@@ -71,14 +71,26 @@ def default_optimizer(
     grad_clip: float = 1.0,
     b1: float = 0.9,
     b2: float = 0.95,
+    mu_dtype: Optional[str] = None,
 ) -> optax.GradientTransformation:
-    """AdamW + cosine schedule + global-norm clipping — the Llama recipe."""
+    """AdamW + cosine schedule + global-norm clipping — the Llama recipe.
+
+    ``mu_dtype="bfloat16"`` stores the first moment in bf16 (half the mu
+    buffer; the momentum direction tolerates bf16 rounding). The second
+    moment stays fp32 — it feeds a sqrt and small values underflow bf16.
+    """
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, lr, warmup_steps, max(total_steps, warmup_steps + 1), lr * 0.1
     )
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+        optax.adamw(
+            schedule,
+            b1=b1,
+            b2=b2,
+            weight_decay=weight_decay,
+            mu_dtype=jnp.dtype(mu_dtype) if mu_dtype else None,
+        ),
     )
 
 
@@ -149,16 +161,66 @@ def train_step(
     batch: dict,
     loss_chunk_size: Optional[int] = None,
     loss_chunk_dtype: str = "bfloat16",
+    grad_accum: int = 1,
 ) -> tuple[TrainState, dict]:
-    """One fwd+bwd+update (objective: ``batch_loss``)."""
+    """One optimizer update (objective: ``batch_loss``).
 
-    def loss_fn(params):
-        loss, _ = batch_loss(
-            state.apply_fn, params, batch, loss_chunk_size, loss_chunk_dtype
+    ``grad_accum`` > 1 splits the batch into that many microbatches and
+    accumulates token-weighted gradients under ``lax.scan`` before the
+    single update — same numbers as the one-shot step (modulo fp
+    summation order), at 1/A the activation memory. Microbatch rows are
+    taken strided (row m, m+A, ...) so each device's local shard
+    contributes equally to every microbatch and no resharding is needed.
+    """
+
+    def loss_and_n(params, mb):
+        def lf(p):
+            return batch_loss(
+                state.apply_fn, p, mb, loss_chunk_size, loss_chunk_dtype
+            )
+
+        (loss, n), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        return loss, n, grads
+
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    if grad_accum == 1:
+        loss, _, grads = loss_and_n(state.params, batch)
+    else:
+        mbs = jax.tree.map(
+            lambda x: x.reshape(
+                x.shape[0] // grad_accum, grad_accum, *x.shape[1:]
+            ).swapaxes(0, 1),
+            batch,
         )
-        return loss
 
-    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        def body(carry, mb):
+            l_acc, n_acc, g_acc = carry
+            loss, n, grads = loss_and_n(state.params, mb)
+            return (
+                l_acc + loss * n,
+                n_acc + n,
+                jax.tree.map(lambda a, g: a + g * n, g_acc, grads),
+            ), None
+
+        # Accumulate in fp32 regardless of param dtype: the body's
+        # `g * n` promotes to fp32 (n is fp32), so a bf16-params carry
+        # would be a scan dtype mismatch — and fp32 accumulation is the
+        # numerically right call anyway. Cast back at the end.
+        zero_g = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+        (l_sum, n_sum, g_sum), _ = jax.lax.scan(
+            body,
+            (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), zero_g),
+            mbs,
+        )
+        n_safe = jnp.maximum(n_sum, 1.0)
+        loss = l_sum / n_safe
+        grads = jax.tree.map(
+            lambda g, p: (g / n_safe).astype(p.dtype), g_sum, state.params
+        )
+
     new_state = state.apply_gradients(grads)
     metrics = {
         "loss": loss,
@@ -222,6 +284,12 @@ class TrainerConfig:
     # ``Trainer.run(eval_data=...)``.
     eval_every: int = 0
     eval_batches: int = 8
+    # Gradient accumulation: microbatches per optimizer step (1 = off).
+    # Batch rows per microbatch must still divide over data x fsdp.
+    grad_accum: int = 1
+    # Adam first-moment storage dtype (None = fp32). "bfloat16" halves
+    # the mu buffer — see default_optimizer.
+    adam_mu_dtype: Optional[str] = None
 
 
 class Trainer:
@@ -242,6 +310,7 @@ class Trainer:
             lr=trainer_cfg.lr,
             warmup_steps=trainer_cfg.warmup_steps,
             total_steps=trainer_cfg.total_steps,
+            mu_dtype=trainer_cfg.adam_mu_dtype,
         )
         self._compiled: dict = {}
         self.state = None
@@ -350,6 +419,21 @@ class Trainer:
             else tuple(sorted(batch.keys()))
         )
         if key not in self._compiled:
+            accum = self.cfg.grad_accum
+            if accum < 1:
+                raise ValueError(f"grad_accum must be >= 1, got {accum}")
+            if accum > 1:
+                dp = (
+                    self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+                )
+                if self.cfg.batch_size % accum or (
+                    self.cfg.batch_size // accum
+                ) % dp:
+                    raise ValueError(
+                        f"grad_accum={accum}: batch {self.cfg.batch_size} "
+                        f"must split into {accum} microbatches whose rows "
+                        f"divide over data x fsdp = {dp}"
+                    )
             row = NamedSharding(self.mesh, P(("data", "fsdp")))
             batch_sharding = {k: row for k in key}
             self._compiled[key] = jax.jit(
@@ -357,6 +441,7 @@ class Trainer:
                     train_step,
                     loss_chunk_size=self.cfg.loss_chunk_size,
                     loss_chunk_dtype=self.cfg.loss_chunk_dtype,
+                    grad_accum=self.cfg.grad_accum,
                 ),
                 in_shardings=(self.state_sharding, batch_sharding),
                 out_shardings=(self.state_sharding, None),

@@ -104,6 +104,11 @@ def build_trainer():
         profile_stop=env_int("profile_stop", base_t.profile_stop),
         eval_every=env_int("eval_every", base_t.eval_every),
         eval_batches=env_int("eval_batches", base_t.eval_batches),
+        grad_accum=env_int("grad_accum", base_t.grad_accum),
+        adam_mu_dtype=env_str(
+            "adam_mu_dtype", base_t.adam_mu_dtype or ""
+        )
+        or None,
     )
     mesh_cfg = MeshConfig(
         data=env_int("mesh_data", base_m.data),
