@@ -341,6 +341,55 @@ def _worker() -> int:
                 "error": f"{type(e).__name__}: {e}"[:500],
             }
 
+    # Decode tier: KV-cache autoregressive generation throughput on the
+    # same architecture (the serving half, tpufw.infer). Fresh random
+    # params — decode speed is weight-value-independent.
+    decode = None
+    if on_tpu and os.environ.get("TPUFW_BENCH_DECODE", "1") != "0":
+        try:
+            import gc
+
+            import jax.numpy as jnp
+
+            from tpufw.infer import SamplingConfig, generate
+            from tpufw.models import Llama as _Llama
+
+            gc.collect()  # drop any lingering trainer state before alloc
+            dcfg = model_cfg.decode_config()
+            dmodel = _Llama(dcfg)
+            d_b, d_prompt, d_new = 8, 128, 128
+            prompts = jax.random.randint(
+                jax.random.key(0), (d_b, d_prompt), 0, dcfg.vocab_size
+            )
+            pads = jnp.zeros((d_b,), jnp.int32)
+            d_params = jax.jit(dmodel.init)(
+                jax.random.key(1), prompts
+            )["params"]
+
+            def _gen():
+                return generate(
+                    dmodel, d_params, prompts, pads, jax.random.key(2),
+                    max_new_tokens=d_new, sampling=SamplingConfig(),
+                )
+
+            jax.block_until_ready(_gen())  # compile + warm
+            t0 = time.perf_counter()
+            jax.block_until_ready(_gen())
+            dt = time.perf_counter() - t0
+            decode = {
+                "batch_size": d_b,
+                "prompt_len": d_prompt,
+                "new_tokens": d_new,
+                # generate() is plain jit on the default device — this is
+                # a SINGLE-chip number by construction (no / n_devices).
+                "decode_tokens_per_sec_per_chip": round(
+                    d_b * d_new / dt, 1
+                ),
+            }
+            del d_params
+        except Exception as e:  # noqa: BLE001
+            decode = {"error": f"{type(e).__name__}: {e}"[:500]}
+
     payload = {
         "metric": f"tokens_per_sec_per_chip_{name}",
         "value": round(tps, 1),
@@ -366,6 +415,8 @@ def _worker() -> int:
         payload["packed"] = packed
     if long_seq is not None:
         payload["long_seq"] = long_seq
+    if decode is not None:
+        payload["decode"] = decode
     if os.environ.get("TPUFW_BENCH_TPU_ERROR"):
         payload["tpu_error"] = os.environ["TPUFW_BENCH_TPU_ERROR"]
     _emit(payload)
