@@ -30,6 +30,21 @@ import jax  # noqa: E402
 # which wins as long as no backend has been initialized yet.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache for the CPU tier: the suite is dominated by
+# 8-device XLA compiles (the second full run drops from ~35 min to ~8).
+# Keyed by HLO hash, so code changes invalidate naturally. The env var
+# is jax's own, so subprocess tests (test_distributed workers) inherit
+# the cache without any tpufw code in the worker.
+_cache_dir = os.path.abspath(
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(__file__), "..", ".xla-cache-tests"),
+    )
+)
+from tpufw.utils.profiling import enable_compile_cache  # noqa: E402
+
+enable_compile_cache(_cache_dir)
+
 import pytest  # noqa: E402
 
 

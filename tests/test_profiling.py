@@ -28,6 +28,15 @@ def test_compile_cache_enable(tmp_path):
     finally:
         for name, value in prev.items():
             jax.config.update(name, value)
+        # Re-BIND the persistent cache, not just the config: the cache
+        # object latches onto whatever dir it initialized with, and the
+        # suite-wide conftest cache must survive this test (otherwise
+        # every later test persists compiles into this tmp_path).
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+
+        _cc.reset_cache()
 
 
 def test_compile_cache_noop_without_config(monkeypatch):

@@ -41,6 +41,15 @@ def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
     # Cache everything: tiny compiles are still worth skipping on restart.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # jax binds the persistent cache to the FIRST dir it initializes
+    # with; re-pointing the config alone would silently keep writing to
+    # the old dir. Reset unconditionally — re-init is lazy and cheap,
+    # and conditional resets invite stale-binding bugs.
+    from jax.experimental.compilation_cache import (
+        compilation_cache as _cc,
+    )
+
+    _cc.reset_cache()
     return path
 
 
