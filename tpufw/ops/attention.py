@@ -126,7 +126,7 @@ def multi_head_attention(
             f"KV-cache decode (kv_segment_ids/q_positions) requires "
             f"backend='xla', got {backend!r}"
         )
-    if backend in ("flash", "ring") and logits_soft_cap is not None:
+    if backend in ("flash", "ring", "ulysses") and logits_soft_cap is not None:
         raise NotImplementedError(
             f"logits_soft_cap is not supported by backend={backend!r}; "
             "use backend='xla'"
@@ -141,6 +141,12 @@ def multi_head_attention(
         from tpufw.parallel.ring import ring_attention
 
         return ring_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids
+        )
+    if backend == "ulysses":
+        from tpufw.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(
             q, k, v, causal=causal, segment_ids=segment_ids
         )
     raise ValueError(f"unknown attention backend {backend!r}")

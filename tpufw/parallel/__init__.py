@@ -1,3 +1,4 @@
 from tpufw.parallel.context import current_mesh, set_current_mesh, use_mesh  # noqa: F401
 from tpufw.parallel.ring import ring_attention  # noqa: F401
 from tpufw.parallel.ring_flash import ring_flash_attention  # noqa: F401
+from tpufw.parallel.ulysses import ulysses_attention  # noqa: F401
