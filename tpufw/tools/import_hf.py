@@ -1,0 +1,203 @@
+"""Import HuggingFace Llama checkpoints into tpufw parameter trees.
+
+Interoperability path: users coming from the torch/HF ecosystem load
+their existing Llama weights (e.g. Meta-Llama-3-8B) straight into the
+tpufw trainer/server. The reference has no model layer to import into
+(its workload is ``nvidia-smi``, reference README.md:314); this is part
+of the additive ML stack.
+
+The mapping is purely structural (no numerics): HF ``nn.Linear`` stores
+``weight`` as [out, in] while flax DenseGeneral kernels are [in, ...out],
+so projections transpose; per-layer tensors stack onto the leading
+``layers`` axis of the ``nn.scan`` trunk. RoPE conventions already agree
+(HF's rotate_half == tpufw.models.llama.apply_rope half-split), which is
+what makes logits-level parity possible — pinned by
+tests/test_import_hf.py against a real ``transformers`` forward.
+
+Works from an in-memory HF model / state_dict (tests) or a checkpoint
+directory with ``*.safetensors`` (production).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from typing import Any, Mapping
+
+import numpy as np
+
+from tpufw.models.llama import LlamaConfig
+
+
+def _to_np(t: Any) -> np.ndarray:
+    """torch.Tensor / np.ndarray -> float32 numpy (bf16-safe)."""
+    if isinstance(t, np.ndarray):
+        return t.astype(np.float32)
+    # torch tensor (possibly bf16, which numpy can't represent directly).
+    return t.detach().to("cpu").float().numpy()
+
+
+def config_from_hf(hf_config: Any) -> LlamaConfig:
+    """LlamaConfig from a transformers LlamaConfig (object or dict)."""
+    get = (
+        hf_config.get
+        if isinstance(hf_config, Mapping)
+        else lambda k, d=None: getattr(hf_config, k, d)
+    )
+    d_model = get("hidden_size")
+    n_heads = get("num_attention_heads")
+    return LlamaConfig(
+        vocab_size=get("vocab_size"),
+        d_model=d_model,
+        n_layers=get("num_hidden_layers"),
+        n_heads=n_heads,
+        n_kv_heads=get("num_key_value_heads") or n_heads,
+        head_dim=get("head_dim") or d_model // n_heads,
+        d_ff=get("intermediate_size"),
+        rope_theta=float(get("rope_theta") or 10_000.0),
+        rms_eps=float(get("rms_norm_eps") or 1e-5),
+        max_seq_len=get("max_position_embeddings") or 8192,
+        tie_embeddings=bool(get("tie_word_embeddings") or False),
+    )
+
+
+def _load_state_dict(path: str | os.PathLike) -> dict[str, np.ndarray]:
+    """Read every ``*.safetensors`` shard in a checkpoint directory."""
+    from safetensors import safe_open
+
+    path = pathlib.Path(path)
+    shards = sorted(path.glob("*.safetensors"))
+    if not shards:
+        raise FileNotFoundError(f"no *.safetensors under {path}")
+    out: dict[str, np.ndarray] = {}
+    for shard in shards:
+        with safe_open(str(shard), framework="np") as f:
+            for key in f.keys():
+                out[key] = f.get_tensor(key)
+    return out
+
+
+def from_hf_llama(
+    source: Any,
+    cfg: LlamaConfig,
+    dtype: Any = None,
+) -> dict:
+    """Convert HF Llama weights to a tpufw ``Llama`` param tree.
+
+    ``source``: a transformers model (has ``.state_dict()``), a state
+    dict, or a checkpoint directory path. ``dtype`` defaults to
+    ``cfg.param_dtype``. Returns the raw (unboxed) param pytree the
+    trainer/apply path consumes; layout matches ``cfg.scan_layers``.
+    """
+    import jax.numpy as jnp
+
+    if isinstance(source, (str, os.PathLike)):
+        sd = _load_state_dict(source)
+    elif hasattr(source, "state_dict"):
+        sd = source.state_dict()
+    else:
+        sd = dict(source)
+    sd = {k.removeprefix("model."): v for k, v in sd.items()}
+
+    dt = jnp.dtype(dtype if dtype is not None else cfg.param_dtype)
+    d, h, kh, dh = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def take(key: str, target=None):
+        """One tensor, cast straight to its final dtype — per-tensor
+        conversion keeps the host-memory peak at ~one checkpoint copy
+        (an 8B bf16 import must not balloon to 3x through fp32
+        intermediates). Norm scales default to fp32 (RMSNorm convention).
+        """
+        if key not in sd:
+            raise KeyError(
+                f"HF checkpoint is missing {key!r} (have "
+                f"{sorted(sd)[:8]}...); not a Llama-family state dict?"
+            )
+        return jnp.asarray(_to_np(sd[key]), target or dt)
+
+    def layer(i: int) -> dict:
+        pre = f"layers.{i}."
+        return {
+            "attn_norm": {
+                "scale": take(
+                    pre + "input_layernorm.weight", jnp.float32
+                )
+            },
+            "attn": {
+                "q": {
+                    "kernel": take(pre + "self_attn.q_proj.weight")
+                    .T.reshape(d, h, dh)
+                },
+                "k": {
+                    "kernel": take(pre + "self_attn.k_proj.weight")
+                    .T.reshape(d, kh, dh)
+                },
+                "v": {
+                    "kernel": take(pre + "self_attn.v_proj.weight")
+                    .T.reshape(d, kh, dh)
+                },
+                "o": {
+                    "kernel": take(pre + "self_attn.o_proj.weight")
+                    .T.reshape(h, dh, d)
+                },
+            },
+            "mlp_norm": {
+                "scale": take(
+                    pre + "post_attention_layernorm.weight", jnp.float32
+                )
+            },
+            "mlp": {
+                "gate": {"kernel": take(pre + "mlp.gate_proj.weight").T},
+                "up": {"kernel": take(pre + "mlp.up_proj.weight").T},
+                "down": {"kernel": take(pre + "mlp.down_proj.weight").T},
+            },
+        }
+
+    layers = [layer(i) for i in range(cfg.n_layers)]
+    params: dict = {
+        "embed": {"embedding": take("embed_tokens.weight")},
+        "final_norm": {"scale": take("norm.weight", jnp.float32)},
+    }
+    if cfg.scan_layers:
+        import jax
+
+        params["layers"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs, axis=0), *layers
+        )
+    else:
+        for i, lp in enumerate(layers):
+            params[f"layer_{i}"] = lp
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": take("lm_head.weight").T}
+    return params
+
+
+def main(argv=None) -> int:
+    """CLI: convert an HF checkpoint dir to an Orbax checkpoint dir."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tpufw.tools.import_hf",
+        description="HF Llama checkpoint -> tpufw params (Orbax)",
+    )
+    ap.add_argument("src", help="HF checkpoint dir (config.json + *.safetensors)")
+    ap.add_argument("--out", required=True, help="Orbax checkpoint dir")
+    args = ap.parse_args(argv)
+
+    with open(os.path.join(args.src, "config.json")) as f:
+        cfg = config_from_hf(json.load(f))
+    params = from_hf_llama(args.src, cfg)
+
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(args.out), params)
+    ckptr.wait_until_finished()
+    n = sum(x.size for x in __import__("jax").tree.leaves(params))
+    print(json.dumps({"out": args.out, "n_params": int(n)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
