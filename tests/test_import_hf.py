@@ -81,6 +81,59 @@ def test_logits_match_transformers(hf_model, scan_layers):
     )
 
 
+def test_mixtral_logits_match_transformers():
+    """MoE import parity: with capacity high enough to never drop a
+    token, tpufw's einsum dispatch must reproduce transformers'
+    MixtralForCausalLM logits (routing convention softmax -> top-k ->
+    renormalize agrees by construction)."""
+    import dataclasses
+
+    from tpufw.models import Mixtral
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        rope_theta=500000.0,
+        tie_word_embeddings=False,
+        attention_bias=False,
+    )
+    torch.manual_seed(1)
+    hf_model = transformers.MixtralForCausalLM(hf_cfg)
+    hf_model.eval()
+
+    cfg = dataclasses.replace(
+        config_from_hf(hf_model.config),
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        remat=False,
+        # capacity_factor >= n_experts guarantees dropless dispatch, the
+        # regime where the capacity-bounded einsum == HF's dense gather.
+        capacity_factor=4.0,
+    )
+    assert cfg.n_experts == 4 and cfg.experts_per_token == 2
+    params = from_hf_llama(hf_model, cfg)
+
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 17), dtype=np.int64)
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    got, _aux = Mixtral(cfg).apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), want, atol=5e-4, rtol=5e-3
+    )
+
+
 def test_missing_key_is_loud(hf_model):
     cfg = config_from_hf(hf_model.config)
     sd = {
@@ -89,6 +142,58 @@ def test_missing_key_is_loud(hf_model):
     }
     with pytest.raises(KeyError, match="q_proj"):
         from_hf_llama(sd, cfg)
+
+
+def test_serve_from_hf_checkpoint_dir(hf_model, tmp_path, monkeypatch):
+    """TPUFW_HF_CHECKPOINT: the serving workload loads a safetensors
+    checkpoint dir end to end (dir -> config_from_hf -> params -> decode
+    model), proving the no-Orbax on-ramp including the shard reader."""
+    ckpt = tmp_path / "hf"
+    hf_model.save_pretrained(str(ckpt), safe_serialization=True)
+    for k in list(__import__("os").environ):
+        if k.startswith("TPUFW_"):
+            monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("TPUFW_HF_CHECKPOINT", str(ckpt))
+
+    from tpufw.workloads.serve import build_generator
+
+    decode_model, params, cfg, restored = build_generator()
+    assert restored
+    assert cfg.d_model == 64 and cfg.n_layers == 2
+    from tpufw.infer import generate_text
+
+    out = generate_text(decode_model, params, [[3, 4]], max_new_tokens=3)
+    assert len(out) == 1 and len(out[0]) == 3
+
+
+def test_serve_mixtral_hf_checkpoint_dir(tmp_path, monkeypatch):
+    """A Mixtral safetensors dir picks the Mixtral decode module."""
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(2)
+    model = transformers.MixtralForCausalLM(hf_cfg)
+    ckpt = tmp_path / "mixtral"
+    model.save_pretrained(str(ckpt), safe_serialization=True)
+    for k in list(__import__("os").environ):
+        if k.startswith("TPUFW_"):
+            monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("TPUFW_HF_CHECKPOINT", str(ckpt))
+    monkeypatch.setenv("TPUFW_MODEL", "not-a-real-model")  # must be ignored
+
+    from tpufw.models import Mixtral
+    from tpufw.workloads.serve import build_generator
+
+    decode_model, params, cfg, restored = build_generator()
+    assert isinstance(decode_model, Mixtral) and restored
+    from tpufw.infer import generate_text
+
+    out = generate_text(decode_model, params, [[3, 4]], max_new_tokens=3)
+    assert len(out) == 1 and len(out[0]) == 3
 
 
 def test_generate_from_imported_weights(hf_model):

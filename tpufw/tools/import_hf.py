@@ -39,7 +39,8 @@ def _to_np(t: Any) -> np.ndarray:
 
 
 def config_from_hf(hf_config: Any) -> LlamaConfig:
-    """LlamaConfig from a transformers LlamaConfig (object or dict)."""
+    """tpufw config from a transformers Llama/Mixtral config (object or
+    dict). ``model_type == "mixtral"`` yields a MixtralConfig."""
     get = (
         hf_config.get
         if isinstance(hf_config, Mapping)
@@ -47,7 +48,7 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
     )
     d_model = get("hidden_size")
     n_heads = get("num_attention_heads")
-    return LlamaConfig(
+    common = dict(
         vocab_size=get("vocab_size"),
         d_model=d_model,
         n_layers=get("num_hidden_layers"),
@@ -60,6 +61,15 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         max_seq_len=get("max_position_embeddings") or 8192,
         tie_embeddings=bool(get("tie_word_embeddings") or False),
     )
+    if get("model_type") == "mixtral":
+        from tpufw.models.mixtral import MixtralConfig
+
+        return MixtralConfig(
+            **common,
+            n_experts=get("num_local_experts"),
+            experts_per_token=get("num_experts_per_tok"),
+        )
+    return LlamaConfig(**common)
 
 
 def _load_state_dict(path: str | os.PathLike) -> dict[str, np.ndarray]:
@@ -78,19 +88,25 @@ def _load_state_dict(path: str | os.PathLike) -> dict[str, np.ndarray]:
     return out
 
 
-def from_hf_llama(
+def from_hf(
     source: Any,
     cfg: LlamaConfig,
     dtype: Any = None,
 ) -> dict:
-    """Convert HF Llama weights to a tpufw ``Llama`` param tree.
+    """Convert HF Llama/Mixtral weights to the tpufw param tree.
 
     ``source``: a transformers model (has ``.state_dict()``), a state
     dict, or a checkpoint directory path. ``dtype`` defaults to
     ``cfg.param_dtype``. Returns the raw (unboxed) param pytree the
     trainer/apply path consumes; layout matches ``cfg.scan_layers``.
+    A MixtralConfig maps the block_sparse_moe experts (w1=gate, w3=up,
+    w2=down, gate=router) onto the stacked [E, ...] expert weights.
     """
     import jax.numpy as jnp
+
+    from tpufw.models.mixtral import MixtralConfig
+
+    is_moe = isinstance(cfg, MixtralConfig)
 
     if isinstance(source, (str, os.PathLike)):
         sd = _load_state_dict(source)
@@ -118,7 +134,7 @@ def from_hf_llama(
 
     def layer(i: int) -> dict:
         pre = f"layers.{i}."
-        return {
+        out = {
             "attn_norm": {
                 "scale": take(
                     pre + "input_layernorm.weight", jnp.float32
@@ -142,17 +158,37 @@ def from_hf_llama(
                     .T.reshape(h, dh, d)
                 },
             },
-            "mlp_norm": {
-                "scale": take(
-                    pre + "post_attention_layernorm.weight", jnp.float32
+        }
+        post_norm = take(
+            pre + "post_attention_layernorm.weight", jnp.float32
+        )
+        if is_moe:
+            moe_pre = pre + "block_sparse_moe."
+
+            def experts(w: str) -> Any:
+                return jnp.stack(
+                    [
+                        take(f"{moe_pre}experts.{e}.{w}.weight").T
+                        for e in range(cfg.n_experts)
+                    ],
+                    axis=0,
                 )
-            },
-            "mlp": {
+
+            out["moe_norm"] = {"scale": post_norm}
+            out["moe"] = {
+                "router": {"kernel": take(moe_pre + "gate.weight").T},
+                "w_gate": experts("w1"),  # [E, D, F]
+                "w_up": experts("w3"),
+                "w_down": experts("w2"),  # [E, F, D]
+            }
+        else:
+            out["mlp_norm"] = {"scale": post_norm}
+            out["mlp"] = {
                 "gate": {"kernel": take(pre + "mlp.gate_proj.weight").T},
                 "up": {"kernel": take(pre + "mlp.up_proj.weight").T},
                 "down": {"kernel": take(pre + "mlp.down_proj.weight").T},
-            },
-        }
+            }
+        return out
 
     layers = [layer(i) for i in range(cfg.n_layers)]
     params: dict = {
@@ -171,6 +207,10 @@ def from_hf_llama(
     if not cfg.tie_embeddings:
         params["lm_head"] = {"kernel": take("lm_head.weight").T}
     return params
+
+
+#: Back-compat alias (the function now also handles Mixtral).
+from_hf_llama = from_hf
 
 
 def main(argv=None) -> int:

@@ -45,6 +45,29 @@ def build_generator():
     from tpufw.models import LLAMA_CONFIGS, Llama, MIXTRAL_CONFIGS, Mixtral
     from tpufw.train import Trainer, TrainerConfig
 
+    hf_dir = env_str("hf_checkpoint", "")
+    if hf_dir:
+        # Serve HF weights directly (TPUFW_HF_CHECKPOINT=<dir with
+        # config.json + *.safetensors>): the torch-ecosystem on-ramp —
+        # no Orbax conversion step needed. The HF config.json is the
+        # source of truth for the architecture, so this branch runs
+        # FIRST and TPUFW_MODEL is genuinely ignored (stale manifest
+        # values can't break it).
+        import json as _json
+
+        from tpufw.models.mixtral import MixtralConfig
+        from tpufw.tools.import_hf import config_from_hf, from_hf
+
+        with open(os.path.join(hf_dir, "config.json")) as f:
+            hf_cfg = config_from_hf(_json.load(f))
+        hf_cfg = dataclasses.replace(
+            hf_cfg,
+            max_seq_len=env_int("max_seq_len", hf_cfg.max_seq_len),
+        )
+        params = from_hf(hf_dir, hf_cfg)
+        cls = Mixtral if isinstance(hf_cfg, MixtralConfig) else Llama
+        return cls(hf_cfg.decode_config()), params, hf_cfg, True
+
     name = env_str("model", "llama3_600m_bench")
     if name == "llama3_600m_bench":
         model_cfg = bench_model_config()
