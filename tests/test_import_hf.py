@@ -134,6 +134,108 @@ def test_mixtral_logits_match_transformers():
     )
 
 
+def test_export_round_trip_through_transformers(hf_model, tmp_path):
+    """tpufw -> HF dir -> transformers.from_pretrained -> same logits.
+
+    The strongest export proof: transformers itself loads the exported
+    config.json + model.safetensors, and its forward matches the tpufw
+    forward on the same weights.
+    """
+    import dataclasses
+
+    from tpufw.tools.import_hf import export_hf
+
+    cfg = dataclasses.replace(
+        config_from_hf(hf_model.config),
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = from_hf_llama(hf_model, cfg)  # weights of record
+    out = tmp_path / "export"
+    stats = export_hf(params, cfg, str(out))
+    assert stats["n_params"] == cfg.n_params()
+
+    reloaded = transformers.LlamaForCausalLM.from_pretrained(str(out))
+    reloaded.eval()
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 11), dtype=np.int64)
+    with torch.no_grad():
+        want = reloaded(torch.from_numpy(tokens)).logits.numpy()
+    got = Llama(cfg).apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), want, atol=2e-4, rtol=2e-3
+    )
+
+
+def test_export_mixtral_state_dict_round_trips():
+    """to_hf(from_hf(sd)) == sd for the MoE family (key and value
+    equality pins both directions of the expert mapping)."""
+    import dataclasses
+
+    from tpufw.tools.import_hf import to_hf
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=8, num_local_experts=2,
+        num_experts_per_tok=2, tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    model = transformers.MixtralForCausalLM(hf_cfg)
+    cfg = dataclasses.replace(
+        config_from_hf(model.config), param_dtype=jnp.float32
+    )
+    sd_in = {
+        k: v.detach().float().numpy() for k, v in model.state_dict().items()
+    }
+    sd_out = to_hf(from_hf_llama(sd_in, cfg), cfg)
+    assert set(sd_out) == set(sd_in)
+    for k in sd_in:
+        np.testing.assert_allclose(
+            sd_out[k], sd_in[k], atol=1e-6, err_msg=k
+        )
+
+
+def test_unsupported_arch_features_are_loud():
+    """Llama-3.1-style rope_scaling (not implemented) must refuse to
+    import rather than silently produce wrong-position logits."""
+    cfg = {
+        "model_type": "llama",
+        "vocab_size": 256,
+        "hidden_size": 64,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "intermediate_size": 128,
+        "rope_scaling": {"rope_type": "llama3", "factor": 8.0},
+    }
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        config_from_hf(cfg)
+    cfg.pop("rope_scaling")
+    assert config_from_hf(cfg).d_model == 64  # clean config still loads
+    cfg["attention_bias"] = True
+    with pytest.raises(NotImplementedError, match="attention_bias"):
+        config_from_hf(cfg)
+
+
+def test_imported_mixtral_defaults_to_dropless_capacity():
+    cfg = config_from_hf(
+        {
+            "model_type": "mixtral",
+            "vocab_size": 64,
+            "hidden_size": 32,
+            "num_hidden_layers": 2,
+            "num_attention_heads": 4,
+            "intermediate_size": 48,
+            "num_local_experts": 8,
+            "num_experts_per_tok": 2,
+        }
+    )
+    assert cfg.capacity_factor == 8.0
+
+
 def test_missing_key_is_loud(hf_model):
     cfg = config_from_hf(hf_model.config)
     sd = {

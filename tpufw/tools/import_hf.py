@@ -46,6 +46,25 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         if isinstance(hf_config, Mapping)
         else lambda k, d=None: getattr(hf_config, k, d)
     )
+    # Reject, loudly, configs whose architecture tpufw doesn't implement —
+    # importing them would produce silently wrong logits (e.g. Llama-3.1
+    # checkpoints need rope_scaling, which apply_rope doesn't apply).
+    unsupported = {
+        "rope_scaling": lambda v: v not in (None, {}),
+        "attention_bias": bool,
+        "mlp_bias": bool,
+        "hidden_act": lambda v: v not in (None, "silu"),
+        "sliding_window": lambda v: bool(v),
+    }
+    bad = {
+        k: get(k) for k, is_bad in unsupported.items() if is_bad(get(k))
+    }
+    if bad:
+        raise NotImplementedError(
+            f"HF config uses features tpufw's Llama/Mixtral don't "
+            f"implement: {bad}; importing would silently change the "
+            "model's math"
+        )
     d_model = get("hidden_size")
     n_heads = get("num_attention_heads")
     common = dict(
@@ -68,6 +87,11 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
             **common,
             n_experts=get("num_local_experts"),
             experts_per_token=get("num_experts_per_tok"),
+            # HF Mixtral routes dropless (dense top-k gather); default
+            # imported checkpoints to a capacity that can't drop tokens
+            # so served outputs match the checkpoint's semantics. Users
+            # fine-tuning at scale can lower this explicitly.
+            capacity_factor=float(get("num_local_experts")),
         )
     return LlamaConfig(**common)
 
@@ -211,6 +235,140 @@ def from_hf(
 
 #: Back-compat alias (the function now also handles Mixtral).
 from_hf_llama = from_hf
+
+
+# ----------------------------------------------------------------------
+# Export: tpufw params -> HF state dict / checkpoint dir
+# ----------------------------------------------------------------------
+
+
+def hf_config_dict(cfg: LlamaConfig) -> dict:
+    """The transformers config.json contents for a tpufw config."""
+    from tpufw.models.mixtral import MixtralConfig
+
+    out = {
+        "model_type": "llama",
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.d_model,
+        "num_hidden_layers": cfg.n_layers,
+        "num_attention_heads": cfg.n_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "head_dim": cfg.head_dim,
+        "intermediate_size": cfg.d_ff,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_eps,
+        "max_position_embeddings": cfg.max_seq_len,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "attention_bias": False,
+        "mlp_bias": False,
+        "hidden_act": "silu",
+        "torch_dtype": "float32",
+    }
+    if isinstance(cfg, MixtralConfig):
+        out.update(
+            model_type="mixtral",
+            architectures=["MixtralForCausalLM"],
+            num_local_experts=cfg.n_experts,
+            num_experts_per_tok=cfg.experts_per_token,
+        )
+        out.pop("mlp_bias")
+    return out
+
+
+def to_hf(params: dict, cfg: LlamaConfig) -> dict[str, np.ndarray]:
+    """Inverse of ``from_hf``: tpufw param tree -> HF-keyed state dict
+    (numpy fp32, HF [out, in] Linear layout, ``model.``-prefixed keys).
+    Accepts both scan-stacked and per-layer trees."""
+    from tpufw.models.mixtral import MixtralConfig
+
+    is_moe = isinstance(cfg, MixtralConfig)
+    d = cfg.d_model
+
+    def np32(x) -> np.ndarray:
+        return np.asarray(x, np.float32)
+
+    def layer_tree(i: int) -> Mapping:
+        if cfg.scan_layers:
+            import jax
+
+            return jax.tree.map(lambda x: x[i], params["layers"])
+        return params[f"layer_{i}"]
+
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np32(params["embed"]["embedding"]),
+        "model.norm.weight": np32(params["final_norm"]["scale"]),
+    }
+    if not cfg.tie_embeddings:
+        sd["lm_head.weight"] = np32(params["lm_head"]["kernel"]).T
+    for i in range(cfg.n_layers):
+        lp = layer_tree(i)
+        pre = f"model.layers.{i}."
+        attn = lp["attn"]
+        sd[pre + "input_layernorm.weight"] = np32(
+            lp["attn_norm"]["scale"]
+        )
+        sd[pre + "self_attn.q_proj.weight"] = (
+            np32(attn["q"]["kernel"]).reshape(d, -1).T
+        )
+        sd[pre + "self_attn.k_proj.weight"] = (
+            np32(attn["k"]["kernel"]).reshape(d, -1).T
+        )
+        sd[pre + "self_attn.v_proj.weight"] = (
+            np32(attn["v"]["kernel"]).reshape(d, -1).T
+        )
+        sd[pre + "self_attn.o_proj.weight"] = (
+            np32(attn["o"]["kernel"]).reshape(-1, d).T
+        )
+        norm_key = "moe_norm" if is_moe else "mlp_norm"
+        sd[pre + "post_attention_layernorm.weight"] = np32(
+            lp[norm_key]["scale"]
+        )
+        if is_moe:
+            moe = lp["moe"]
+            sd[pre + "block_sparse_moe.gate.weight"] = np32(
+                moe["router"]["kernel"]
+            ).T
+            for e in range(cfg.n_experts):
+                ep = pre + f"block_sparse_moe.experts.{e}."
+                sd[ep + "w1.weight"] = np32(moe["w_gate"][e]).T
+                sd[ep + "w3.weight"] = np32(moe["w_up"][e]).T
+                sd[ep + "w2.weight"] = np32(moe["w_down"][e]).T
+        else:
+            mlp = lp["mlp"]
+            sd[pre + "mlp.gate_proj.weight"] = np32(
+                mlp["gate"]["kernel"]
+            ).T
+            sd[pre + "mlp.up_proj.weight"] = np32(mlp["up"]["kernel"]).T
+            sd[pre + "mlp.down_proj.weight"] = np32(
+                mlp["down"]["kernel"]
+            ).T
+    return sd
+
+
+def export_hf(params: dict, cfg: LlamaConfig, out_dir: str) -> dict:
+    """Write an HF checkpoint dir (config.json + model.safetensors) that
+    ``transformers.*ForCausalLM.from_pretrained`` loads directly."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(hf_config_dict(cfg), f, indent=2)
+    # ascontiguousarray: to_hf returns transposed VIEWS, and safetensors
+    # serializes raw buffers — a non-contiguous view would be written in
+    # its underlying (un-transposed) byte order, silently scrambling
+    # every projection (caught by the transformers-reload parity test).
+    # Replace per key so each fp32 base buffer is dropped as soon as its
+    # contiguous copy exists (peak ~one model copy, not two).
+    sd = to_hf(params, cfg)
+    for k in list(sd):
+        sd[k] = np.ascontiguousarray(sd[k])
+    save_file(sd, os.path.join(out_dir, "model.safetensors"))
+    return {
+        "out": out_dir,
+        "n_tensors": len(sd),
+        "n_params": int(sum(v.size for v in sd.values())),
+    }
 
 
 def main(argv=None) -> int:

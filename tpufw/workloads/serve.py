@@ -52,19 +52,21 @@ def build_generator():
         # no Orbax conversion step needed. The HF config.json is the
         # source of truth for the architecture, so this branch runs
         # FIRST and TPUFW_MODEL is genuinely ignored (stale manifest
-        # values can't break it).
-        import json as _json
-
+        # values can't break it). Params load onto the default device in
+        # the activation dtype (bf16 — serving keeps no fp32 master
+        # copy); for models larger than one chip, convert once via
+        # `python -m tpufw.tools.import_hf` and use the Orbax path,
+        # which restores sharded over the mesh.
         from tpufw.models.mixtral import MixtralConfig
         from tpufw.tools.import_hf import config_from_hf, from_hf
 
         with open(os.path.join(hf_dir, "config.json")) as f:
-            hf_cfg = config_from_hf(_json.load(f))
+            hf_cfg = config_from_hf(json.load(f))
         hf_cfg = dataclasses.replace(
             hf_cfg,
             max_seq_len=env_int("max_seq_len", hf_cfg.max_seq_len),
         )
-        params = from_hf(hf_dir, hf_cfg)
+        params = from_hf(hf_dir, hf_cfg, dtype=hf_cfg.dtype)
         cls = Mixtral if isinstance(hf_cfg, MixtralConfig) else Llama
         return cls(hf_cfg.decode_config()), params, hf_cfg, True
 
