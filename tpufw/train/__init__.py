@@ -10,6 +10,10 @@ from tpufw.train.trainer import (  # noqa: F401
     train_step,
 )
 from tpufw.train.metrics import Meter, StepMetrics  # noqa: F401
+from tpufw.train.pipeline_trainer import (  # noqa: F401
+    PipelineTrainer,
+    PipeTrainState,
+)
 from tpufw.train.checkpoint import CheckpointManager  # noqa: F401
 from tpufw.train.data import (  # noqa: F401
     pack_documents,

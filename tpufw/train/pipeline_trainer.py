@@ -1,0 +1,269 @@
+"""Pipeline-parallel trainer: the Trainer's operational surface over the
+GPipe schedule (tpufw.parallel.pipeline).
+
+The Trainer's operational surface — jitted donated-state step,
+tokens/s-per-chip + MFU metrics, async Orbax checkpoint/resume,
+multi-host batch globalization — with the layer stack executing on the
+``pipe`` mesh axis instead of under the flax scan trunk. The functional
+pipeline params (stage stacks sharded over ``pipe``) replace the flax
+TrainState; Meter, CheckpointManager, optimizer recipe, and
+globalize_batch are the shared machinery.
+
+Scope (validated loudly in ``__init__``/``run``): unsegmented LM batches
+only — the pipeline blocks don't take segment ids yet — and the
+TrainerConfig features the schedule doesn't implement (grad_accum,
+chunked-vocab CE, profiling, in-loop eval) are rejected rather than
+silently ignored.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from tpufw.mesh import MeshConfig, build_mesh
+from tpufw.models.llama import LlamaConfig
+from tpufw.parallel.pipeline import (
+    PipelineConfig,
+    init_pipeline_params,
+    pipeline_loss,
+    pipeline_param_shardings,
+)
+from tpufw.train.metrics import Meter, StepMetrics
+from tpufw.train.trainer import TrainerConfig, default_optimizer
+
+
+class PipeTrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def _pipe_state_step(
+    state: PipeTrainState,
+    batch: dict,
+    tx,
+    model_cfg: LlamaConfig,
+    pipe: PipelineConfig,
+    mesh,
+) -> tuple[PipeTrainState, dict]:
+    """TrainState-shaped step (the functional
+    tpufw.parallel.pipeline.pipeline_train_step stays the public
+    params/opt_state API; this private wrapper is the trainer's)."""
+    loss, grads = jax.value_and_grad(pipeline_loss)(
+        state.params, batch["tokens"], model_cfg, pipe, mesh
+    )
+    updates, new_opt = tx.update(grads, state.opt_state, state.params)
+    return (
+        PipeTrainState(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            opt_state=new_opt,
+        ),
+        {"loss": loss, "grad_norm": optax.global_norm(grads)},
+    )
+
+
+class PipelineTrainer:
+    """Drives pipeline-parallel training with the standard tpufw surface."""
+
+    def __init__(
+        self,
+        model_cfg: LlamaConfig,
+        pipe: PipelineConfig,
+        trainer_cfg: TrainerConfig,
+        mesh_cfg: MeshConfig | None = None,
+        tx: optax.GradientTransformation | None = None,
+    ):
+        if mesh_cfg is None:
+            mesh_cfg = MeshConfig(pipe=pipe.n_stages, fsdp=-1)
+        if mesh_cfg.pipe != pipe.n_stages:
+            raise ValueError(
+                f"mesh_cfg.pipe={mesh_cfg.pipe} != "
+                f"PipelineConfig.n_stages={pipe.n_stages}"
+            )
+        pipe.validate(model_cfg, trainer_cfg.batch_size)
+        unsupported = {
+            "grad_accum": trainer_cfg.grad_accum != 1,
+            "loss_chunk_size": bool(trainer_cfg.loss_chunk_size),
+            "profile_dir": bool(trainer_cfg.profile_dir),
+            "eval_every": bool(trainer_cfg.eval_every),
+        }
+        bad = [k for k, v in unsupported.items() if v]
+        if bad:
+            raise NotImplementedError(
+                f"PipelineTrainer does not implement TrainerConfig "
+                f"fields {bad}; unset them (the flax Trainer supports "
+                "them all)"
+            )
+        self.model_cfg = model_cfg
+        self.pipe = pipe
+        self.cfg = trainer_cfg
+        self.mesh = build_mesh(mesh_cfg)
+        self.tx = tx or default_optimizer(
+            lr=trainer_cfg.lr,
+            warmup_steps=trainer_cfg.warmup_steps,
+            total_steps=trainer_cfg.total_steps,
+            mu_dtype=trainer_cfg.adam_mu_dtype,
+        )
+        self.state: PipeTrainState | None = None
+        self._step_fn = None
+
+    # -- state ---------------------------------------------------------
+
+    def _init_fn(self, key):
+        """ONE init body for both the abstract (restore-target) and real
+        state so the two can never diverge."""
+        params = init_pipeline_params(key, self.model_cfg, self.pipe)
+        return PipeTrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=self.tx.init(params),
+        )
+
+    def _abstract_state(self) -> PipeTrainState:
+        return jax.eval_shape(self._init_fn, jax.random.key(0))
+
+    def _state_shardings(self, abstract: PipeTrainState) -> PipeTrainState:
+        p_sh = pipeline_param_shardings(self.mesh, abstract.params)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self.mesh, P())
+        # Optimizer moments mirror the params they track. optax state
+        # trees interleave param-shaped moment trees with scalars, so
+        # match by FULL shape against the stage stacks — every stage
+        # stack is >=3-D with a distinct shape, so a collision would
+        # need an identically-shaped replicated tensor (none exist).
+        stage_shapes = {
+            tuple(x.shape)
+            for x in jax.tree.leaves(abstract.params["stages"])
+        }
+
+        def opt_shard(leaf):
+            if (
+                hasattr(leaf, "shape")
+                and tuple(leaf.shape) in stage_shapes
+            ):
+                return NamedSharding(self.mesh, P("pipe"))
+            return rep
+
+        return PipeTrainState(
+            step=rep,
+            params=p_sh,
+            opt_state=jax.tree.map(opt_shard, abstract.opt_state),
+        )
+
+    def init_state(self, seed: int = 0) -> PipeTrainState:
+        shardings = self._state_shardings(self._abstract_state())
+        self.state = jax.jit(self._init_fn, out_shardings=shardings)(
+            jax.random.key(seed)
+        )
+        self._shardings = shardings
+        return self.state
+
+    def maybe_restore(self) -> bool:
+        if not self.cfg.checkpoint_dir:
+            return False
+        from tpufw.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(self.cfg.checkpoint_dir)
+        try:
+            if mgr.latest_step() is None:
+                return False
+            abstract = self._abstract_state()
+            shardings = self._state_shardings(abstract)
+            target = jax.tree.map(
+                lambda x, s: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=s
+                ),
+                abstract,
+                shardings,
+                is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+            )
+            self.state = mgr.restore(target)
+            self._shardings = shardings
+            return True
+        finally:
+            mgr.close()
+
+    # -- loop ----------------------------------------------------------
+
+    def _compiled_step(self):
+        if self._step_fn is None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            batch_sh = {
+                "tokens": NamedSharding(self.mesh, P(("data", "fsdp")))
+            }
+            self._step_fn = jax.jit(
+                partial(
+                    _pipe_state_step,
+                    tx=self.tx,
+                    model_cfg=self.model_cfg,
+                    pipe=self.pipe,
+                    mesh=self.mesh,
+                ),
+                in_shardings=(self._shardings, batch_sh),
+                out_shardings=(self._shardings, None),
+                donate_argnums=(0,),
+            )
+        return self._step_fn
+
+    def run(
+        self,
+        data: Iterator[dict],
+        model_flops_per_token: float,
+        on_metrics: Callable[[StepMetrics], None] | None = None,
+    ) -> list[StepMetrics]:
+        if self.state is None:
+            self.init_state()
+        meter = Meter(
+            tokens_per_step=self.cfg.batch_size * (self.cfg.seq_len - 1),
+            flops_per_token=model_flops_per_token,
+            n_chips=len(self.mesh.devices.flatten()),
+        )
+        ckpt = None
+        if self.cfg.checkpoint_dir:
+            from tpufw.train.checkpoint import CheckpointManager
+
+            ckpt = CheckpointManager(
+                self.cfg.checkpoint_dir,
+                save_interval_steps=self.cfg.checkpoint_every,
+            )
+        from tpufw.train.trainer import globalize_batch
+
+        step_fn = self._compiled_step()
+        history: list[StepMetrics] = []
+        try:
+            for i, batch in enumerate(data):
+                if i >= self.cfg.total_steps:
+                    break
+                if "segment_ids" in batch or "loss_mask" in batch:
+                    raise NotImplementedError(
+                        "PipelineTrainer trains unsegmented batches "
+                        "only (the pipeline blocks don't thread segment "
+                        "ids yet); use the flax Trainer for packed data"
+                    )
+                meter.start()
+                batch = globalize_batch(self.mesh, batch)
+                self.state, m = step_fn(
+                    self.state, {"tokens": batch["tokens"]}
+                )
+                loss = jax.block_until_ready(m["loss"])
+                sm = meter.stop(int(self.state.step), loss)
+                history.append(sm)
+                if on_metrics and (i % self.cfg.log_every == 0):
+                    on_metrics(sm)
+                if ckpt is not None:
+                    ckpt.save(int(self.state.step), self.state)
+        finally:
+            if ckpt is not None:
+                ckpt.wait()
+                ckpt.close()
+        return history

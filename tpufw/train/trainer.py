@@ -243,6 +243,29 @@ def eval_step(
     return {"loss": loss, "n_tokens": n}
 
 
+def globalize_batch(mesh: Mesh, batch: dict) -> dict:
+    """Multi-process: assemble each process's LOCAL batch shard into a
+    global jax.Array (jit rejects raw numpy under a multi-host mesh).
+
+    Contract: the configured batch size is the GLOBAL batch; each
+    process's data iterator yields ``batch_size / process_count`` rows.
+    In single-process runs this is the identity. Shared by the flax
+    Trainer and the PipelineTrainer so the multi-host contract can't
+    drift between them.
+    """
+    if jax.process_count() == 1:
+        return batch
+    row = NamedSharding(mesh, P(("data", "fsdp")))
+    return {
+        # Leaves that are already jax.Arrays (e.g. from
+        # prefetch_to_device) are global already; only raw host
+        # numpy needs assembling.
+        k: v if isinstance(v, jax.Array)
+        else jax.make_array_from_process_local_data(row, v)
+        for k, v in batch.items()
+    }
+
+
 def state_shardings(
     abstract_state: TrainState, mesh: Mesh, rules=None
 ) -> TrainState:
@@ -391,24 +414,7 @@ class Trainer:
             mgr.close()
 
     def globalize_batch(self, batch: dict) -> dict:
-        """Multi-process: assemble each process's LOCAL batch shard into a
-        global jax.Array (jit rejects raw numpy under a multi-host mesh).
-
-        Contract: ``cfg.batch_size`` is the GLOBAL batch; each process's
-        data iterator yields ``batch_size / process_count`` rows. In
-        single-process runs this is the identity.
-        """
-        if jax.process_count() == 1:
-            return batch
-        row = NamedSharding(self.mesh, P(("data", "fsdp")))
-        return {
-            # Leaves that are already jax.Arrays (e.g. from
-            # prefetch_to_device) are global already; only raw host
-            # numpy needs assembling.
-            k: v if isinstance(v, jax.Array)
-            else jax.make_array_from_process_local_data(row, v)
-            for k, v in batch.items()
-        }
+        return globalize_batch(self.mesh, batch)
 
     def compiled_step(self, batch: dict | None = None):
         """Jitted train step; batch shardings derived from the batch's own
