@@ -54,11 +54,12 @@ def test_all_baseline_configs_covered():
     # smoke-TPU enablement proof, the shared checkpoint PVC, and the
     # inference serving Job+Service (07, VERDICT r1 item 9).
     names = [p.name for p in MANIFESTS]
-    assert len(names) == 8
+    assert len(names) == 9
     kinds = [d["kind"] for p in MANIFESTS for d in load(p)]
     assert kinds.count("Pod") == 3
     assert kinds.count("Job") == 2
-    assert kinds.count("JobSet") == 2
+    # 05 v5e-16, 06 mixtral ep, 08 pipeline-parallel.
+    assert kinds.count("JobSet") == 3
     assert kinds.count("PersistentVolumeClaim") == 1
     assert kinds.count("Service") == 1
 
@@ -123,6 +124,9 @@ def test_jobset_env_satisfies_bootstrap_contract(path):
     mesh = 1
     for ax in ("DATA", "FSDP", "EXPERT", "SEQUENCE", "TENSOR"):
         mesh *= int(env.get(f"TPUFW_MESH_{ax}", 1))
+    # Pipeline manifests size the pipe axis via TPUFW_PIPE_STAGES (the
+    # workload derives mesh pipe from it — one source of truth).
+    mesh *= int(env.get("TPUFW_PIPE_STAGES", 1))
     assert mesh == chips, f"{path.name}: mesh product {mesh} != {chips} chips"
 
     # Gang restart needs checkpoint-resume to be meaningful (SURVEY.md §5).

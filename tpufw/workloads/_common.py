@@ -1,0 +1,62 @@
+"""Shared scaffolding for the training workload entry points.
+
+One copy of the JSON-lines telemetry channel (cold-start record + step
+metrics — ``kubectl logs`` is the metrics surface, the reference's
+verification pattern, reference README.md:331-335) so train_llama and
+train_pipeline can't silently diverge.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Optional
+
+from tpufw.train.metrics import StepMetrics
+
+
+def check_global_batch(batch_size: int, n_processes: int) -> int:
+    """Global-batch contract: returns the LOCAL batch size per process."""
+    if batch_size % n_processes:
+        raise ValueError(
+            f"global batch {batch_size} not divisible by "
+            f"{n_processes} processes"
+        )
+    return batch_size // n_processes
+
+
+def metrics_printer(
+    t0: float, compile_cache: Optional[str]
+) -> Callable[[StepMetrics], None]:
+    """on_metrics callback: first call emits the cold-start->first-step
+    record (BASELINE.md metric 2), every call emits the step JSON line."""
+    first_step: dict = {}
+
+    def on_metrics(m: StepMetrics) -> None:
+        if not first_step:
+            first_step["t"] = time.time()
+            print(
+                json.dumps(
+                    {
+                        "cold_start_to_first_step_s": round(
+                            first_step["t"] - t0, 1
+                        ),
+                        "compile_cache": compile_cache or None,
+                    }
+                ),
+                flush=True,
+            )
+        print(json.dumps(m.as_dict()), flush=True)
+
+    return on_metrics
+
+
+def print_summary(history: list[StepMetrics]) -> None:
+    if not history:
+        return
+    last = history[-1]
+    print(
+        f"TRAIN OK: {len(history)} steps, final loss {last.loss:.4f}, "
+        f"{last.tokens_per_sec_per_chip:.0f} tok/s/chip, "
+        f"MFU {last.mfu:.1%}"
+    )

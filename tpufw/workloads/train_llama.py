@@ -149,16 +149,17 @@ def main() -> int:
     else:
         trainer.init_state(seed=env_int("seed", 0))
 
+    from tpufw.workloads._common import (
+        check_global_batch,
+        metrics_printer,
+        print_summary,
+    )
+
     cfg = trainer.cfg
     flops_per_token = model_cfg.flops_per_token(cfg.seq_len - 1)
     # cfg.batch_size is GLOBAL; each process loads its local shard.
     n_proc = cluster.num_processes
-    if cfg.batch_size % n_proc:
-        raise ValueError(
-            f"global batch {cfg.batch_size} not divisible by "
-            f"{n_proc} processes"
-        )
-    local_bs = cfg.batch_size // n_proc
+    local_bs = check_global_batch(cfg.batch_size, n_proc)
     data_prefix = env_str("data_prefix", "")
     if data_prefix:
         # Real corpus (native/ mmap packer; TPUFW_DATA_PREFIX points at the
@@ -208,38 +209,14 @@ def main() -> int:
                     + 2 * cluster.process_id + 1,
                 )
 
-    first_step: dict = {}
-
-    def on_metrics(m):
-        if not first_step:
-            first_step["t"] = time.time()
-            print(
-                json.dumps(
-                    {
-                        "cold_start_to_first_step_s": round(
-                            first_step["t"] - _T0, 1
-                        ),
-                        "compile_cache": cache or None,
-                    }
-                ),
-                flush=True,
-            )
-        print(json.dumps(m.as_dict()), flush=True)
-
     history = trainer.run(
         data,
         model_flops_per_token=flops_per_token,
-        on_metrics=on_metrics,
+        on_metrics=metrics_printer(_T0, cache),
         eval_data=eval_data,
         on_eval=lambda ev: print(json.dumps(ev), flush=True),
     )
-    if history:
-        last = history[-1]
-        print(
-            f"TRAIN OK: {len(history)} steps, final loss {last.loss:.4f}, "
-            f"{last.tokens_per_sec_per_chip:.0f} tok/s/chip, "
-            f"MFU {last.mfu:.1%}"
-        )
+    print_summary(history)
     return 0
 
 

@@ -1,0 +1,133 @@
+"""Pipeline-parallel LM training workload (GPipe over the ``pipe`` axis).
+
+The deploy-facing entry for tpufw.train.PipelineTrainer: same JSON-lines
+metrics channel as train_llama (``kubectl logs`` is the telemetry
+surface, the reference's verification pattern upgraded —
+reference README.md:331-335), driven by TPUFW_* env:
+
+  TPUFW_PIPE_STAGES (required, >1)   pipeline stages == mesh pipe size
+  TPUFW_PIPE_MICROBATCHES (default 2*stages)
+  TPUFW_MODEL / TPUFW_BATCH_SIZE / TPUFW_SEQ_LEN / ... (as train_llama)
+  TPUFW_MESH_DATA / TPUFW_MESH_FSDP  data-parallel axes alongside pipe
+
+Data: synthetic unsegmented batches (the pipeline blocks don't thread
+segment ids yet — PipelineTrainer rejects packed data loudly).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from tpufw.workloads.env import env_float, env_int, env_str
+
+_T0 = time.time()
+
+
+def build_trainer():
+    """(PipelineTrainer, model_cfg) from TPUFW_* env; import-light."""
+    from tpufw.configs import bench_model_config
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import LLAMA_CONFIGS
+    from tpufw.parallel.pipeline import PipelineConfig
+    from tpufw.train import PipelineTrainer, TrainerConfig
+
+    stages = env_int("pipe_stages", 0)
+    if stages < 2:
+        raise ValueError(
+            f"TPUFW_PIPE_STAGES={stages}: pipeline training needs >= 2 "
+            "stages (use tpufw.workloads.train_llama for pipe=1)"
+        )
+    name = env_str("model", "llama3_600m_bench")
+    if name == "llama3_600m_bench":
+        model_cfg = bench_model_config()
+    elif name in LLAMA_CONFIGS:
+        model_cfg = LLAMA_CONFIGS[name]
+    else:
+        raise ValueError(
+            f"unknown TPUFW_MODEL={name!r} for pipeline training; choose "
+            f"from {['llama3_600m_bench', *LLAMA_CONFIGS]}"
+        )
+    pipe = PipelineConfig(
+        n_stages=stages,
+        n_microbatches=env_int("pipe_microbatches", 2 * stages),
+    )
+    trainer_cfg = TrainerConfig(
+        batch_size=env_int("batch_size", 8),
+        seq_len=env_int("seq_len", model_cfg.max_seq_len),
+        total_steps=env_int("total_steps", 100),
+        lr=env_float("lr", 3e-4),
+        warmup_steps=env_int("warmup_steps", 10),
+        log_every=env_int("log_every", 10),
+        checkpoint_dir=env_str("checkpoint_dir", "") or None,
+        checkpoint_every=env_int("checkpoint_every", 100),
+        adam_mu_dtype=env_str("adam_mu_dtype", "") or None,
+        # Features PipelineTrainer doesn't implement are still READ here
+        # so its loud NotImplementedError fires on a configured-but-
+        # ignored knob instead of training silently without it.
+        grad_accum=env_int("grad_accum", 1),
+        loss_chunk_size=env_int("loss_chunk_size", 0) or None,
+        profile_dir=env_str("profile_dir", "") or None,
+        eval_every=env_int("eval_every", 0),
+    )
+    mesh_cfg = MeshConfig(
+        data=env_int("mesh_data", 1),
+        pipe=stages,
+        fsdp=env_int("mesh_fsdp", -1),
+    )
+    return PipelineTrainer(model_cfg, pipe, trainer_cfg, mesh_cfg), model_cfg
+
+
+def main() -> int:
+    from tpufw.cluster import initialize_cluster
+    from tpufw.utils.profiling import enable_compile_cache
+
+    cache = enable_compile_cache()
+    cluster = initialize_cluster()
+
+    import jax
+
+    from tpufw.train import synthetic_batches
+
+    trainer, model_cfg = build_trainer()
+    print(
+        f"tpufw train_pipeline: process {cluster.process_id}/"
+        f"{cluster.num_processes} devices={len(jax.devices())} "
+        f"mesh={dict(trainer.mesh.shape)} "
+        f"stages={trainer.pipe.n_stages} "
+        f"microbatches={trainer.pipe.n_microbatches} "
+        f"bubble={trainer.pipe.bubble_fraction():.1%} "
+        f"params={model_cfg.n_params():,}"
+        + (f" compile_cache={cache}" if cache else "")
+    )
+
+    resumed = trainer.maybe_restore()
+    if resumed:
+        print(f"resumed from checkpoint at step {int(trainer.state.step)}")
+    else:
+        trainer.init_state(seed=env_int("seed", 0))
+
+    from tpufw.workloads._common import (
+        check_global_batch,
+        metrics_printer,
+        print_summary,
+    )
+
+    cfg = trainer.cfg
+    local_bs = check_global_batch(cfg.batch_size, cluster.num_processes)
+    history = trainer.run(
+        synthetic_batches(
+            local_bs,
+            cfg.seq_len,
+            model_cfg.vocab_size,
+            seed=env_int("data_seed", 0) * 2000 + 2 * cluster.process_id,
+        ),
+        model_flops_per_token=model_cfg.flops_per_token(cfg.seq_len - 1),
+        on_metrics=metrics_printer(_T0, cache),
+    )
+    print_summary(history)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
