@@ -29,7 +29,7 @@ MANIFESTS = REPO / "deploy" / "manifests"
 def test_configs_exist_for_training_baselines():
     names = [p.name for p in CONFIGS]
     assert "bench-v5e1.yaml" in names
-    for n in ("03-", "04-", "05-", "06-"):
+    for n in ("03-", "04-", "05-", "06-", "08-"):
         assert any(name.startswith(n) for name in names), names
 
 
@@ -78,6 +78,7 @@ def _manifest_env(name: str) -> dict:
         ("04-llama3-8b-v5e4.yaml", "04-llama3-8b-v5e4.yaml"),
         ("05-llama3-8b-v5e16.yaml", "05-llama3-8b-v5e16-jobset.yaml"),
         ("06-mixtral-8x7b-v5p32.yaml", "06-mixtral-8x7b-v5p32-jobset.yaml"),
+        ("08-llama3-8b-pipeline.yaml", "08-llama3-8b-pipeline-jobset.yaml"),
     ],
 )
 def test_manifest_matches_yaml_of_record(cfg_name, manifest_name):
@@ -172,6 +173,39 @@ def test_env_overrides_yaml_in_build_trainer(monkeypatch):
     assert trainer.cfg.batch_size == 8
     assert trainer.cfg.seq_len == 2048
     assert trainer.cfg.checkpoint_dir == "/checkpoints/llama3-8b-v5e4"
+
+
+def test_pipeline_section_sizes_mesh_and_validates(tmp_path):
+    good = tmp_path / "p.yaml"
+    good.write_text(
+        textwrap.dedent(
+            """
+            hardware: {slice: v5e-4, hosts: 1, chips_per_host: 4}
+            model: {preset: llama3_tiny}
+            trainer: {batch_size: 8}
+            mesh: {fsdp: 2}
+            pipeline: {n_stages: 2, n_microbatches: 4}
+            """
+        )
+    )
+    run = load_run_config(good)
+    assert run.mesh.pipe == 2  # sized from the pipeline section
+    env = to_env(run)
+    assert env["TPUFW_PIPE_STAGES"] == "2"
+    assert "TPUFW_MESH_PIPE" not in env  # PIPE_STAGES is the one source
+
+    bad = tmp_path / "b.yaml"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            model: {preset: llama3_tiny}
+            mesh: {pipe: 4}
+            pipeline: {n_stages: 2, n_microbatches: 2}
+            """
+        )
+    )
+    with pytest.raises(ValueError, match="mesh.pipe=4"):
+        load_run_config(bad)
 
 
 def test_bench_yaml_matches_bench_tier():

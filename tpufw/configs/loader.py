@@ -26,6 +26,8 @@ Schema (all sections optional except ``model``)::
       overrides: {attention_backend: flash}   # dataclasses.replace fields
     trainer:  {batch_size: 32, seq_len: 2048, ...}   # TrainerConfig fields
     mesh:     {fsdp: 16}                             # MeshConfig fields
+    pipeline: {n_stages: 2, n_microbatches: 4}       # PipelineConfig
+                                 # (sizes mesh.pipe; train_pipeline runs)
 
 Unknown keys anywhere are hard errors — config drift should fail loudly at
 load time, not silently at step 1000.
@@ -71,6 +73,7 @@ class RunConfig:
     model_cfg: Any  # LlamaConfig | MixtralConfig | ResNetConfig
     trainer: Any  # TrainerConfig (LM) | VisionTrainerConfig (resnet)
     mesh: MeshConfig
+    pipeline: Any = None  # Optional[PipelineConfig] (train_pipeline runs)
 
     @property
     def family(self) -> str:
@@ -131,7 +134,9 @@ def load_run_config(path: str | os.PathLike) -> RunConfig:
     if not isinstance(raw, dict):
         raise ValueError(f"{path}: top level must be a mapping")
     _reject_unknown(
-        str(path), raw, {"name", "hardware", "model", "trainer", "mesh"}
+        str(path),
+        raw,
+        {"name", "hardware", "model", "trainer", "mesh", "pipeline"},
     )
     model_sec = raw.get("model")
     if not isinstance(model_sec, dict) or "preset" not in model_sec:
@@ -153,6 +158,21 @@ def load_run_config(path: str | os.PathLike) -> RunConfig:
         trainer_cls, "trainer", raw.get("trainer") or {}
     )
     mesh = _build_dataclass(MeshConfig, "mesh", raw.get("mesh") or {})
+    pipeline = None
+    if raw.get("pipeline"):
+        from tpufw.parallel.pipeline import PipelineConfig
+
+        pipeline = _build_dataclass(
+            PipelineConfig, "pipeline", raw["pipeline"]
+        )
+        if mesh.pipe == 1:
+            mesh = dataclasses.replace(mesh, pipe=pipeline.n_stages)
+        elif mesh.pipe != pipeline.n_stages:
+            raise ValueError(
+                f"{path}: mesh.pipe={mesh.pipe} != "
+                f"pipeline.n_stages={pipeline.n_stages}"
+            )
+        pipeline.validate(model_cfg, trainer.batch_size)
 
     # Cross-checks that catch the silent-gang-split class of drift early.
     per_slice = dict(
@@ -173,6 +193,7 @@ def load_run_config(path: str | os.PathLike) -> RunConfig:
         model_cfg=model_cfg,
         trainer=trainer,
         mesh=mesh,
+        pipeline=pipeline,
     )
 
 
@@ -202,6 +223,7 @@ _VISION_ENV = {
 }
 _MESH_ENV = {
     "data": "MESH_DATA",
+    "pipe": "MESH_PIPE",
     "fsdp": "MESH_FSDP",
     "expert": "MESH_EXPERT",
     "sequence": "MESH_SEQUENCE",
@@ -228,10 +250,17 @@ def to_env(run: RunConfig, *, defaults_too: bool = False) -> dict[str, str]:
         (run.mesh, _MESH_ENV, MeshConfig()),
     ):
         for field, suffix in mapping.items():
+            if field == "pipe" and run.pipeline is not None:
+                # Pipeline manifests size the pipe axis via
+                # TPUFW_PIPE_STAGES (one source of truth).
+                continue
             val = getattr(cfg, field)
             if not defaults_too and val == getattr(defaults, field):
                 continue
             if val is None:
                 continue
             env[f"TPUFW_{suffix}"] = str(val)
+    if run.pipeline is not None:
+        env["TPUFW_PIPE_STAGES"] = str(run.pipeline.n_stages)
+        env["TPUFW_PIPE_MICROBATCHES"] = str(run.pipeline.n_microbatches)
     return env
