@@ -114,15 +114,29 @@ def test_http_server_generate(tiny_env):
     assert len(out["outputs"]) == 2
     assert all(len(o) == 3 for o in out["outputs"])
 
-    # Bad request -> 400 with an error body, server stays up.
-    bad = urllib.request.Request(
+    # Text prompts (byte codec default): encoded server-side, outputs
+    # decoded back to text alongside the raw ids.
+    treq = urllib.request.Request(
         base + "/generate",
-        data=json.dumps({"prompts": "nope"}).encode(),
+        data=json.dumps({"texts": ["hi", "ok"], "max_new_tokens": 3}).encode(),
+        headers={"Content-Type": "application/json"},
         method="POST",
     )
-    try:
-        urllib.request.urlopen(bad, timeout=30)
-        raise AssertionError("expected HTTP 400")
-    except urllib.error.HTTPError as e:
-        assert e.code == 400
+    with urllib.request.urlopen(treq, timeout=120) as resp:
+        tout = json.loads(resp.read())
+    assert len(tout["outputs"]) == 2 and len(tout["texts"]) == 2
+    assert all(isinstance(s, str) for s in tout["texts"])
+
+    # Bad request -> 400 with an error body, server stays up.
+    for bad_body in ({"prompts": "nope"}, {"texts": [""]}):
+        bad = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps(bad_body).encode(),
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(bad, timeout=30)
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
     srv.httpd.shutdown()

@@ -117,6 +117,33 @@ def _bucket(n: int, mult: int) -> int:
     return ((max(n, 1) + mult - 1) // mult) * mult
 
 
+def text_codec():
+    """(encode, decode) for text prompts, from TPUFW_TOKENIZER.
+
+    "bytes" (default) is the dependency-free byte-level codec shared
+    with tpufw.tools.pack_corpus (id 0 reserved for padding); any other
+    value is a HuggingFace tokenizer name/path — pair it with
+    TPUFW_HF_CHECKPOINT so ids match the served model's vocab.
+    """
+    name = env_str("tokenizer", "bytes")
+    if name == "bytes":
+        from tpufw.tools.pack_corpus import byte_tokenizer
+
+        def decode(ids: list[int]) -> str:
+            return bytes(
+                t - 1 for t in ids if 0 < t <= 256
+            ).decode("utf-8", errors="replace")
+
+        return byte_tokenizer, decode
+    from tpufw.tools.pack_corpus import hf_tokenizer
+
+    encode = hf_tokenizer(name)
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(name)
+    return encode, tok.decode
+
+
 def _pad_batch(prompts: list[list[int]]) -> tuple[list[list[int]], int]:
     """Pad the batch to a power of two (filler rows = [0]) so the jitted
     generate specializes on few batch shapes. Returns (padded, real_n)."""
@@ -168,6 +195,12 @@ class _Server:
         self.default_new = max_new_tokens
         self.lock = threading.Lock()
         self.port = port
+        self._codec = None
+
+    def codec(self):
+        if self._codec is None:
+            self._codec = text_codec()
+        return self._codec
 
     def generate(self, prompts: list[list[int]], max_new: int):
         # Bucket prompt length via extra LEFT padding (pad_lens absorbs
@@ -223,22 +256,38 @@ class _Server:
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     req = json.loads(self.rfile.read(n) or b"{}")
-                    prompts = req["prompts"]
-                    if not prompts or not all(
-                        isinstance(p, list) and all(
-                            isinstance(t, int) for t in p
-                        )
-                        for p in prompts
-                    ):
-                        raise ValueError(
-                            "prompts must be a non-empty list of "
-                            "token-id lists"
-                        )
+                    as_text = "texts" in req
+                    if as_text:
+                        texts = req["texts"]
+                        if not texts or not all(
+                            isinstance(t, str) and t for t in texts
+                        ):
+                            raise ValueError(
+                                "texts must be a non-empty list of "
+                                "non-empty strings"
+                            )
+                        encode, decode = outer.codec()
+                        prompts = [encode(t) for t in texts]
+                    else:
+                        prompts = req["prompts"]
+                        if not prompts or not all(
+                            isinstance(p, list) and all(
+                                isinstance(t, int) for t in p
+                            )
+                            for p in prompts
+                        ):
+                            raise ValueError(
+                                "prompts must be a non-empty list of "
+                                "token-id lists"
+                            )
                     max_new = int(
                         req.get("max_new_tokens", outer.default_new)
                     )
                     outs = outer.generate(prompts, max_new)
-                    self._reply(200, {"outputs": outs})
+                    payload = {"outputs": outs}
+                    if as_text:
+                        payload["texts"] = [decode(o) for o in outs]
+                    self._reply(200, payload)
                 except Exception as e:  # noqa: BLE001 — serving loop
                     self._reply(400, {"error": f"{type(e).__name__}: {e}"})
 
