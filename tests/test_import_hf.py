@@ -199,6 +199,57 @@ def test_export_mixtral_state_dict_round_trips():
         )
 
 
+def test_cli_to_orbax_then_finetune_and_serve(hf_model, tmp_path, monkeypatch):
+    """The full on-ramp loop: HF dir -> import CLI (Orbax bare params) ->
+    Trainer.init_from_params picks them up for fine-tuning, and the
+    serving workload loads them via TPUFW_PARAMS_CHECKPOINT."""
+    import dataclasses
+
+    from tpufw.mesh import MeshConfig
+    from tpufw.tools.import_hf import main as import_main
+    from tpufw.train import Trainer, TrainerConfig
+
+    hf_dir = tmp_path / "hf"
+    hf_model.save_pretrained(str(hf_dir), safe_serialization=True)
+    out = tmp_path / "orbax"
+    assert import_main([str(hf_dir), "--out", str(out)]) == 0
+
+    cfg = dataclasses.replace(
+        config_from_hf(hf_model.config),
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        remat=False,
+    )
+    trainer = Trainer(
+        Llama(cfg),
+        TrainerConfig(batch_size=8, seq_len=16, total_steps=1),
+        MeshConfig(),
+    )
+    trainer.init_from_params(str(out))
+    want = from_hf_llama(hf_model, cfg)
+    np.testing.assert_allclose(
+        np.asarray(trainer.state.params["embed"]["embedding"]),
+        np.asarray(want["embed"]["embedding"]),
+        atol=1e-6,
+    )
+    assert int(trainer.state.step) == 0  # fresh run, not a resume
+
+    for k in list(__import__("os").environ):
+        if k.startswith("TPUFW_"):
+            monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("TPUFW_PARAMS_CHECKPOINT", str(out))
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")  # same architecture
+    from tpufw.workloads.serve import build_generator
+
+    decode_model, params, _, restored = build_generator()
+    assert restored
+    np.testing.assert_allclose(
+        np.asarray(params["embed"]["embedding"]),
+        np.asarray(want["embed"]["embedding"]),
+        atol=1e-6,
+    )
+
+
 def test_unsupported_arch_features_are_loud():
     """Llama-3.1-style rope_scaling (not implemented) must refuse to
     import rather than silently produce wrong-position logits."""

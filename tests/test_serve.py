@@ -128,7 +128,11 @@ def test_http_server_generate(tiny_env):
     assert all(isinstance(s, str) for s in tout["texts"])
 
     # Bad request -> 400 with an error body, server stays up.
-    for bad_body in ({"prompts": "nope"}, {"texts": [""]}):
+    for bad_body in (
+        {"prompts": "nope"},
+        {"texts": [""]},
+        {"texts": "hello"},  # bare string must not iterate as chars
+    ):
         bad = urllib.request.Request(
             base + "/generate",
             data=json.dumps(bad_body).encode(),

@@ -10,6 +10,7 @@ is no hand-written communication anywhere, per SURVEY.md §2c.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Any, Callable, Iterator, Optional
 
@@ -372,6 +373,27 @@ class Trainer:
         # Unbox flax Partitioned wrappers: downstream code wants raw arrays.
         self.state = meta.unbox(self.state)
         self.state_sharding = meta.unbox(self.state_sharding)
+        return self.state
+
+    def init_from_params(self, path: str, seed: int = 0) -> TrainState:
+        """Start training FROM a bare-params Orbax checkpoint (the
+        ``tpufw.tools.import_hf`` CLI's output): fresh optimizer state,
+        step 0, params restored sharded onto this trainer's mesh — the
+        fine-tune-from-imported-weights entry point, distinct from
+        ``maybe_restore`` (which resumes a full TrainState mid-run)."""
+        import orbax.checkpoint as ocp
+
+        if self.state is None:
+            self.init_state(seed=seed)
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=x.sharding
+            ),
+            self.state.params,
+        )
+        with ocp.StandardCheckpointer() as ckptr:
+            params = ckptr.restore(os.path.abspath(path), abstract)
+        self.state = self.state.replace(params=params)
         return self.state
 
     def maybe_restore(self) -> bool:

@@ -89,6 +89,38 @@ def build_generator():
         max_seq_len=env_int("max_seq_len", model_cfg.max_seq_len),
     )
 
+    params_dir = env_str("params_checkpoint", "")
+    if params_dir:
+        # Bare-params Orbax checkpoint (tpufw.tools.import_hf CLI
+        # output) — TPUFW_MODEL still names the architecture. Restored
+        # SHARDED onto the mesh via the abstract param tree (no
+        # throwaway init materializes), so multi-chip models load
+        # split, not on device 0.
+        import orbax.checkpoint as ocp
+        from flax.core import meta
+
+        from tpufw.train.trainer import state_shardings
+
+        shape_trainer = Trainer(
+            model_cls(model_cfg),
+            TrainerConfig(
+                batch_size=1, seq_len=min(32, model_cfg.max_seq_len)
+            ),
+            MeshConfig(),
+        )
+        _, boxed = shape_trainer._abstract_state(jax.random.key(0))
+        shardings = meta.unbox(
+            state_shardings(boxed, shape_trainer.mesh)
+        )
+        abstract = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            meta.unbox(boxed).params,
+            shardings.params,
+        )
+        with ocp.StandardCheckpointer() as ckptr:
+            params = ckptr.restore(os.path.abspath(params_dir), abstract)
+        return model_cls(model_cfg.decode_config()), params, model_cfg, True
+
     # Reuse the trainer's restore machinery (abstract state + reshard-on-
     # restore) rather than reimplementing orbax plumbing; params are then
     # pulled out of the restored TrainState.
@@ -135,13 +167,10 @@ def text_codec():
             ).decode("utf-8", errors="replace")
 
         return byte_tokenizer, decode
-    from tpufw.tools.pack_corpus import hf_tokenizer
-
-    encode = hf_tokenizer(name)
     from transformers import AutoTokenizer
 
     tok = AutoTokenizer.from_pretrained(name)
-    return encode, tok.decode
+    return tok.encode, tok.decode
 
 
 def _pad_batch(prompts: list[list[int]]) -> tuple[list[list[int]], int]:
@@ -259,8 +288,12 @@ class _Server:
                     as_text = "texts" in req
                     if as_text:
                         texts = req["texts"]
-                        if not texts or not all(
-                            isinstance(t, str) and t for t in texts
+                        if (
+                            not isinstance(texts, list)
+                            or not texts
+                            or not all(
+                                isinstance(t, str) and t for t in texts
+                            )
                         ):
                             raise ValueError(
                                 "texts must be a non-empty list of "

@@ -147,7 +147,14 @@ def main() -> int:
     if resumed:
         print(f"resumed from checkpoint at step {int(trainer.state.step)}")
     else:
-        trainer.init_state(seed=env_int("seed", 0))
+        init_from = env_str("init_from", "")
+        if init_from:
+            # Bare-params checkpoint (tpufw.tools.import_hf CLI output):
+            # fine-tune from imported weights, fresh optimizer state.
+            trainer.init_from_params(init_from, seed=env_int("seed", 0))
+            print(f"initialized params from {init_from}")
+        else:
+            trainer.init_state(seed=env_int("seed", 0))
 
     from tpufw.workloads._common import (
         check_global_batch,
