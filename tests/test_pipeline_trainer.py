@@ -90,15 +90,22 @@ def test_unsupported_features_are_loud(devices8):
             TrainerConfig(batch_size=16, seq_len=33, grad_accum=2),
             MESH,
         )
-    t = _trainer(total_steps=1)
-    t.init_state()
+
+
+def test_packed_batches_train(devices8):
+    """segment_ids + loss_mask flow through the pipe ring with the same
+    masking as the flax trainer."""
     from tpufw.train import synthetic_packed_batches
 
-    with pytest.raises(NotImplementedError, match="unsegmented"):
-        t.run(
-            synthetic_packed_batches(16, 33, CFG.vocab_size),
-            model_flops_per_token=CFG.flops_per_token(32),
-        )
+    t = _trainer(total_steps=6)
+    t.init_state()
+    hist = t.run(
+        synthetic_packed_batches(16, 33, CFG.vocab_size, mean_doc_len=8),
+        model_flops_per_token=CFG.flops_per_token(32),
+    )
+    assert len(hist) == 6
+    assert np.isfinite(hist[-1].loss)
+    assert hist[-1].loss < hist[0].loss
 
 
 def test_mesh_stage_mismatch_is_loud():

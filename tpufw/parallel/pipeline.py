@@ -134,7 +134,9 @@ def pipeline_param_shardings(mesh: Mesh, params: dict) -> dict:
 # ----------------------------------------------------------------------
 
 
-def _block(p: dict, x: jax.Array, cfg: LlamaConfig, backend: str):
+def _block(
+    p: dict, x: jax.Array, cfg: LlamaConfig, backend: str, seg=None
+):
     """One decoder block; p leaves have no leading layer axis."""
     dt = cfg.dtype
     positions = jnp.broadcast_to(
@@ -146,7 +148,9 @@ def _block(p: dict, x: jax.Array, cfg: LlamaConfig, backend: str):
     v = jnp.einsum("btd,dhk->bthk", h, p["wv"].astype(dt))
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
-    att = multi_head_attention(q, k, v, causal=True, backend=backend)
+    att = multi_head_attention(
+        q, k, v, causal=True, segment_ids=seg, backend=backend
+    )
     x = x + jnp.einsum("bthk,hkd->btd", att, p["wo"].astype(dt))
     h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
     g = jnp.einsum("btd,df->btf", h, p["w_gate"].astype(dt))
@@ -157,11 +161,11 @@ def _block(p: dict, x: jax.Array, cfg: LlamaConfig, backend: str):
     return x
 
 
-def _stage(stage_params: dict, x: jax.Array, cfg, backend: str):
+def _stage(stage_params: dict, x: jax.Array, cfg, backend: str, seg=None):
     """Run this stage's [layers_per_stage] blocks via lax.scan."""
 
     def body(h, layer_p):
-        return _block(layer_p, h, cfg, backend), None
+        return _block(layer_p, h, cfg, backend, seg), None
 
     out, _ = jax.lax.scan(body, x, stage_params)
     return out
@@ -172,23 +176,34 @@ def _stage(stage_params: dict, x: jax.Array, cfg, backend: str):
 # ----------------------------------------------------------------------
 
 
-def _gpipe_local(stage_params, x_mb, *, cfg, backend):
+def _gpipe_local(stage_params, x_mb, *seg_mb, cfg, backend):
     """Per-device body (inside shard_map): stream M microbatches through
-    the pipe ring. x_mb: [M, mb_local, T, D]; returns same shape (valid
-    data produced on the last stage, zeros elsewhere, psum-combined)."""
+    the pipe ring. x_mb: [M, mb_local, T, D]; seg_mb is () or one
+    [M, mb_local, T] int32 array of segment ids. Returns x_mb's shape
+    (valid data produced on the last stage, zeros elsewhere,
+    psum-combined)."""
     s = jax.lax.axis_size(AXIS_PIPE)
     sidx = jax.lax.axis_index(AXIS_PIPE)
     # Local leading stage dim is 1 after sharding: drop it.
     stage_params = jax.tree.map(lambda a: a[0], stage_params)
     m = x_mb.shape[0]
     perm = [(i, (i + 1) % s) for i in range(s)]
+    has_seg = bool(seg_mb)
+    seg_all = seg_mb[0] if has_seg else None
 
     def tick(carry, t):
         recv, outs = carry
-        x_in = jnp.where(
-            sidx == 0, x_mb[jnp.clip(t, 0, m - 1)], recv
-        )
-        out = _stage(stage_params, x_in, cfg, backend)
+        x_in = jnp.where(sidx == 0, x_mb[jnp.clip(t, 0, m - 1)], recv)
+        if has_seg:
+            # Stage sidx processes microbatch t - sidx at tick t (the
+            # same invariant the output collection uses). seg_all is
+            # replicated across the pipe axis (its spec doesn't mention
+            # pipe), so the ids are indexed locally — no need to
+            # ppermute them around the ring with the activations.
+            seg_in = seg_all[jnp.clip(t - sidx, 0, m - 1)]
+        else:
+            seg_in = None
+        out = _stage(stage_params, x_in, cfg, backend, seg_in)
         nxt = jax.lax.ppermute(out, AXIS_PIPE, perm)
         # Last stage finishes microbatch t-(s-1) at tick t.
         oidx = jnp.clip(t - (s - 1), 0, m - 1)
@@ -216,12 +231,15 @@ def pipeline_forward(
     pipe: PipelineConfig,
     mesh: Mesh,
     backend: Optional[str] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Full LM forward with the block stack pipelined: logits [B, T, V].
 
     Embedding and the head run outside the pipeline region (they are a
     small fraction of compute and live replicated / batch-sharded);
     everything between — the whole layer stack — runs on the pipe ring.
+    ``segment_ids`` [B, T] masks cross-document attention for packed
+    batches; ids ride the ring with their microbatch's activations.
     """
     for ax in ("tensor", "sequence", "expert"):
         if mesh.shape[ax] != 1:
@@ -251,13 +269,25 @@ def pipeline_forward(
     x = x.reshape(m, b // m, t, cfg.d_model)
 
     mb_spec = P(None, (AXIS_DATA, AXIS_FSDP), None, None)
-    hidden = shard_map(
-        partial(_gpipe_local, cfg=cfg, backend=backend),
-        mesh=mesh,
-        in_specs=(P(AXIS_PIPE), mb_spec),
-        out_specs=mb_spec,
-        check_vma=False,
-    )(params["stages"], x)
+    local = partial(_gpipe_local, cfg=cfg, backend=backend)
+    if segment_ids is None:
+        hidden = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(AXIS_PIPE), mb_spec),
+            out_specs=mb_spec,
+            check_vma=False,
+        )(params["stages"], x)
+    else:
+        seg = segment_ids.astype(jnp.int32).reshape(m, b // m, t)
+        seg_spec = P(None, (AXIS_DATA, AXIS_FSDP), None)
+        hidden = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(AXIS_PIPE), mb_spec, seg_spec),
+            out_specs=mb_spec,
+            check_vma=False,
+        )(params["stages"], x, seg)
     hidden = hidden.reshape(b, t, cfg.d_model)
 
     h = rms_norm(hidden, params["final_norm"], cfg.rms_eps)
@@ -265,7 +295,11 @@ def pipeline_forward(
 
 
 def reference_forward(
-    params: dict, tokens: jax.Array, cfg: LlamaConfig, backend: str = "xla"
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    backend: str = "xla",
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Sequential evaluation of the SAME params (no pipe axis) — the
     parity oracle for the schedule."""
@@ -274,9 +308,12 @@ def reference_forward(
     flat = jax.tree.map(
         lambda a: a.reshape(-1, *a.shape[2:]), params["stages"]
     )
+    seg = (
+        None if segment_ids is None else segment_ids.astype(jnp.int32)
+    )
 
     def body(h, layer_p):
-        return _block(layer_p, h, cfg, backend), None
+        return _block(layer_p, h, cfg, backend, seg), None
 
     x, _ = jax.lax.scan(body, x, flat)
     h = rms_norm(x, params["final_norm"], cfg.rms_eps)
@@ -285,17 +322,25 @@ def reference_forward(
 
 def pipeline_loss(
     params: dict,
-    tokens: jax.Array,
+    batch: dict | jax.Array,
     cfg: LlamaConfig,
     pipe: PipelineConfig,
     mesh: Mesh,
 ) -> jax.Array:
-    """Next-token CE through the pipelined forward (shift-left targets,
-    same objective shape as tpufw.train.trainer.batch_loss)."""
-    from tpufw.train.trainer import cross_entropy_loss
+    """LM objective through the pipelined forward — the SAME shift +
+    packed-batch masking as the flax trainer (shift_and_mask), so the
+    two training paths can't diverge on what they optimize. ``batch``
+    is {tokens [+ segment_ids, loss_mask]} (a bare token array is
+    wrapped for back-compat)."""
+    from tpufw.train.trainer import cross_entropy_loss, shift_and_mask
 
-    logits = pipeline_forward(params, tokens[:, :-1], cfg, pipe, mesh)
-    loss, _ = cross_entropy_loss(logits, tokens[:, 1:])
+    if not isinstance(batch, dict):
+        batch = {"tokens": batch}
+    inputs, targets, seg_in, mask = shift_and_mask(batch)
+    logits = pipeline_forward(
+        params, inputs, cfg, pipe, mesh, segment_ids=seg_in
+    )
+    loss, _ = cross_entropy_loss(logits, targets, mask)
     return loss
 
 

@@ -9,11 +9,11 @@ pipeline params (stage stacks sharded over ``pipe``) replace the flax
 TrainState; Meter, CheckpointManager, optimizer recipe, and
 globalize_batch are the shared machinery.
 
-Scope (validated loudly in ``__init__``/``run``): unsegmented LM batches
-only — the pipeline blocks don't take segment ids yet — and the
-TrainerConfig features the schedule doesn't implement (grad_accum,
-chunked-vocab CE, profiling, in-loop eval) are rejected rather than
-silently ignored.
+Packed batches (segment_ids + loss_mask) train with the same masking as
+the flax trainer (shift_and_mask); segment ids ride the pipe ring with
+their microbatch. TrainerConfig features the schedule doesn't implement
+(grad_accum, chunked-vocab CE, profiling, in-loop eval) are rejected
+loudly in ``__init__`` rather than silently ignored.
 """
 
 from __future__ import annotations
@@ -57,7 +57,7 @@ def _pipe_state_step(
     tpufw.parallel.pipeline.pipeline_train_step stays the public
     params/opt_state API; this private wrapper is the trainer's)."""
     loss, grads = jax.value_and_grad(pipeline_loss)(
-        state.params, batch["tokens"], model_cfg, pipe, mesh
+        state.params, batch, model_cfg, pipe, mesh
     )
     updates, new_opt = tx.update(grads, state.opt_state, state.params)
     return (
@@ -194,14 +194,16 @@ class PipelineTrainer:
 
     # -- loop ----------------------------------------------------------
 
-    def _compiled_step(self):
+    def _compiled_step(self, batch: dict):
+        key = tuple(sorted(batch.keys()))
         if self._step_fn is None:
+            self._step_fn = {}
+        if key not in self._step_fn:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            batch_sh = {
-                "tokens": NamedSharding(self.mesh, P(("data", "fsdp")))
-            }
-            self._step_fn = jax.jit(
+            row = NamedSharding(self.mesh, P(("data", "fsdp")))
+            batch_sh = {k: row for k in key}
+            self._step_fn[key] = jax.jit(
                 partial(
                     _pipe_state_step,
                     tx=self.tx,
@@ -213,7 +215,7 @@ class PipelineTrainer:
                 out_shardings=(self._shardings, None),
                 donate_argnums=(0,),
             )
-        return self._step_fn
+        return self._step_fn[key]
 
     def run(
         self,
@@ -238,22 +240,15 @@ class PipelineTrainer:
             )
         from tpufw.train.trainer import globalize_batch
 
-        step_fn = self._compiled_step()
         history: list[StepMetrics] = []
         try:
             for i, batch in enumerate(data):
                 if i >= self.cfg.total_steps:
                     break
-                if "segment_ids" in batch or "loss_mask" in batch:
-                    raise NotImplementedError(
-                        "PipelineTrainer trains unsegmented batches "
-                        "only (the pipeline blocks don't thread segment "
-                        "ids yet); use the flax Trainer for packed data"
-                    )
                 meter.start()
                 batch = globalize_batch(self.mesh, batch)
-                self.state, m = step_fn(
-                    self.state, {"tokens": batch["tokens"]}
+                self.state, m = self._compiled_step(batch)(
+                    self.state, batch
                 )
                 loss = jax.block_until_ready(m["loss"])
                 sm = meter.stop(int(self.state.step), loss)

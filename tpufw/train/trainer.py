@@ -103,6 +103,29 @@ def head_kernel(params) -> jax.Array:
     return params["embed"]["embedding"].T
 
 
+def shift_and_mask(batch: dict):
+    """LM target shift + packed-batch masking, shared by every objective.
+
+    Returns (inputs, targets, input_segment_ids, loss_mask). With
+    segment_ids: never train boundary positions to predict the next
+    document's first token — attention (correctly) can't see across
+    segments — and never train on padding targets (segment 0).
+    """
+    tokens = batch["tokens"]
+    inputs = tokens[:, :-1]
+    targets = tokens[:, 1:]
+    seg = batch.get("segment_ids")
+    seg_in = None if seg is None else seg[:, :-1]
+    mask = batch.get("loss_mask")
+    mask = None if mask is None else mask[:, 1:].astype(jnp.float32)
+    if seg is not None:
+        same_seg = (seg[:, :-1] == seg[:, 1:]).astype(jnp.float32)
+        nonpad = (seg[:, 1:] > 0).astype(jnp.float32)
+        seg_mask = same_seg * nonpad
+        mask = seg_mask if mask is None else mask * seg_mask
+    return inputs, targets, seg_in, mask
+
+
 def batch_loss(
     apply_fn: Callable,
     params,
@@ -119,21 +142,7 @@ def batch_loss(
     states chunk-by-chunk, never materializing [B,T,V] logits. Shared by
     the train and eval steps so their objectives can't drift.
     """
-    tokens = batch["tokens"]
-    inputs = tokens[:, :-1]
-    targets = tokens[:, 1:]
-    seg = batch.get("segment_ids")
-    seg_in = None if seg is None else seg[:, :-1]
-    mask = batch.get("loss_mask")
-    mask = None if mask is None else mask[:, 1:].astype(jnp.float32)
-    if seg is not None:
-        # Don't train boundary positions to predict the next document's
-        # first token — attention (correctly) can't see across segments —
-        # and never train on padding targets (segment 0).
-        same_seg = (seg[:, :-1] == seg[:, 1:]).astype(jnp.float32)
-        nonpad = (seg[:, 1:] > 0).astype(jnp.float32)
-        seg_mask = same_seg * nonpad
-        mask = seg_mask if mask is None else mask * seg_mask
+    inputs, targets, seg_in, mask = shift_and_mask(batch)
 
     kwargs = {"segment_ids": seg_in}
     if loss_chunk_size:
@@ -375,25 +384,56 @@ class Trainer:
         self.state_sharding = meta.unbox(self.state_sharding)
         return self.state
 
-    def init_from_params(self, path: str, seed: int = 0) -> TrainState:
-        """Start training FROM a bare-params Orbax checkpoint (the
-        ``tpufw.tools.import_hf`` CLI's output): fresh optimizer state,
-        step 0, params restored sharded onto this trainer's mesh — the
-        fine-tune-from-imported-weights entry point, distinct from
-        ``maybe_restore`` (which resumes a full TrainState mid-run)."""
+    def restore_params(self, path: str):
+        """Restore a bare-params Orbax checkpoint (the
+        ``tpufw.tools.import_hf`` CLI's output) sharded onto this
+        trainer's mesh, WITHOUT materializing any state — the abstract
+        tree comes from eval_shape, same no-throwaway-init discipline as
+        ``maybe_restore``. Returns (params, full_state_sharding)."""
         import orbax.checkpoint as ocp
 
-        if self.state is None:
-            self.init_state(seed=seed)
+        _, boxed = self._abstract_state(jax.random.key(0))
+        shardings = meta.unbox(state_shardings(boxed, self.mesh))
         abstract = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=x.sharding
-            ),
-            self.state.params,
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            meta.unbox(boxed).params,
+            shardings.params,
         )
         with ocp.StandardCheckpointer() as ckptr:
             params = ckptr.restore(os.path.abspath(path), abstract)
-        self.state = self.state.replace(params=params)
+        return params, shardings
+
+    def init_from_params(self, path: str, seed: int = 0) -> TrainState:
+        """Start training FROM a bare-params Orbax checkpoint: step 0,
+        FRESH optimizer state, params restored sharded — the
+        fine-tune-from-imported-weights entry point, distinct from
+        ``maybe_restore`` (which resumes a full TrainState mid-run).
+        Must be called on a fresh trainer: silently mixing restored
+        params with an existing step/optimizer would corrupt the run."""
+        del seed  # params come from the checkpoint, nothing is sampled
+        if self.state is not None:
+            raise RuntimeError(
+                "init_from_params on an already-initialized trainer; "
+                "construct a fresh Trainer (or use maybe_restore to "
+                "resume a full TrainState)"
+            )
+        params, self.state_sharding = self.restore_params(path)
+
+        def make_state(p):
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=p,
+                opt_state=self.tx.init(p),
+                apply_fn=self.model.apply,
+                tx=self.tx,
+            )
+
+        with use_mesh(self.mesh):
+            self.state = jax.jit(
+                make_state,
+                out_shardings=self.state_sharding,
+                donate_argnums=(0,),
+            )(params)
         return self.state
 
     def maybe_restore(self) -> bool:

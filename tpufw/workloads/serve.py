@@ -93,14 +93,9 @@ def build_generator():
     if params_dir:
         # Bare-params Orbax checkpoint (tpufw.tools.import_hf CLI
         # output) — TPUFW_MODEL still names the architecture. Restored
-        # SHARDED onto the mesh via the abstract param tree (no
-        # throwaway init materializes), so multi-chip models load
+        # SHARDED onto the mesh via the trainer's abstract-tree helper
+        # (no throwaway init materializes), so multi-chip models load
         # split, not on device 0.
-        import orbax.checkpoint as ocp
-        from flax.core import meta
-
-        from tpufw.train.trainer import state_shardings
-
         shape_trainer = Trainer(
             model_cls(model_cfg),
             TrainerConfig(
@@ -108,17 +103,7 @@ def build_generator():
             ),
             MeshConfig(),
         )
-        _, boxed = shape_trainer._abstract_state(jax.random.key(0))
-        shardings = meta.unbox(
-            state_shardings(boxed, shape_trainer.mesh)
-        )
-        abstract = jax.tree.map(
-            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
-            meta.unbox(boxed).params,
-            shardings.params,
-        )
-        with ocp.StandardCheckpointer() as ckptr:
-            params = ckptr.restore(os.path.abspath(params_dir), abstract)
+        params, _ = shape_trainer.restore_params(params_dir)
         return model_cls(model_cfg.decode_config()), params, model_cfg, True
 
     # Reuse the trainer's restore machinery (abstract state + reshard-on-
