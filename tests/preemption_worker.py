@@ -1,0 +1,87 @@
+"""Worker subprocess for the gang-consistent preemption test.
+
+Trains tiny-Llama on a 2-process CPU gang with a GracefulShutdown
+installed. Only the process whose id == TPUFW_SIGNAL_PROCESS sends itself
+SIGTERM (after the step in TPUFW_SIGNAL_AT_STEP) — k8s never delivers the
+gang's SIGTERMs between the same two steps, and this is the worst case:
+one process knows, the other doesn't. The collective stop decision in
+GracefulShutdown.should_stop must still make BOTH processes leave the
+loop at the same step (otherwise the unsignalled one deadlocks in the
+next step's collectives, and the 120s test timeout catches it).
+
+Prints PREEMPTED:<step> and CKPT_LATEST:<step> on clean exit.
+"""
+
+import os
+import signal
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpufw.cluster import initialize_cluster, resolve_cluster_env  # noqa: E402
+
+
+def main():
+    cfg = resolve_cluster_env()
+    initialize_cluster(cfg, timeout_s=60)
+
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import LLAMA_CONFIGS, Llama
+    from tpufw.train import (
+        GracefulShutdown,
+        Trainer,
+        TrainerConfig,
+        synthetic_batches,
+    )
+    from tpufw.train.checkpoint import CheckpointManager
+
+    tiny = LLAMA_CONFIGS["llama3_tiny"]
+    ckpt_dir = os.environ["TPUFW_CHECKPOINT_DIR"]
+    signal_proc = int(os.environ["TPUFW_SIGNAL_PROCESS"])
+    signal_at = int(os.environ["TPUFW_SIGNAL_AT_STEP"])
+    trainer = Trainer(
+        Llama(tiny),
+        TrainerConfig(
+            batch_size=4,
+            seq_len=17,
+            total_steps=64,  # far past the signal step: must not finish
+            lr=1e-3,
+            log_every=1,  # signal hook must see every step
+            checkpoint_dir=ckpt_dir,
+            checkpoint_every=1000,  # periodic saves off: only the forced one
+        ),
+        MeshConfig(data=jax.device_count(), fsdp=1),
+    )
+    trainer.init_state()
+
+    shutdown = GracefulShutdown()
+
+    def signal_hook(metrics):
+        if cfg.process_id == signal_proc and metrics.step >= signal_at:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    local_bs = 4 // jax.process_count()
+    trainer.run(
+        synthetic_batches(local_bs, 17, tiny.vocab_size, seed=cfg.process_id),
+        model_flops_per_token=tiny.flops_per_token(16),
+        on_metrics=signal_hook,
+        shutdown=shutdown,
+    )
+    assert trainer.preempted, "run() finished all 64 steps despite SIGTERM"
+    print(f"PREEMPTED:{int(trainer.state.step)}", flush=True)
+
+    mgr = CheckpointManager(ckpt_dir)
+    try:
+        print(f"CKPT_LATEST:{mgr.latest_step()}", flush=True)
+    finally:
+        mgr.close()
+
+
+if __name__ == "__main__":
+    main()

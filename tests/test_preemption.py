@@ -1,0 +1,140 @@
+"""Preemption-aware shutdown (tpufw.train.preemption).
+
+k8s pod termination = SIGTERM + grace window (the reference's pods rely on
+``restartPolicy: OnFailure`` alone, reference README.md:309); tpufw turns
+that window into a forced final checkpoint and a clean exit. Single-process
+semantics here; the 2-process gang-consistency case (only one process gets
+the signal, both must stop at the same step) lives in the worker-spawning
+test at the bottom, following tests/test_distributed.py's harness.
+"""
+
+import os
+import signal
+
+import jax
+import pytest
+
+from tpufw.train.preemption import GracefulShutdown
+
+
+def test_sigterm_latches_flag():
+    with GracefulShutdown() as sd:
+        assert not sd.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert sd.requested
+        assert sd.should_stop()
+        # Latched: stays True with no further collectives.
+        assert sd.should_stop()
+
+
+def test_previous_handler_chains():
+    hits = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+    try:
+        with GracefulShutdown() as sd:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert sd.requested
+            assert hits == [signal.SIGTERM]
+        # uninstall restored our handler.
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert hits == [signal.SIGTERM, signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_request_without_signal():
+    sd = GracefulShutdown(signals=())
+    assert not sd.should_stop()
+    sd.request()
+    assert sd.should_stop()
+
+
+def test_sync_every_amortizes_the_collective():
+    sd = GracefulShutdown(signals=(), sync_every=2)
+    assert not sd.should_stop()  # call 1: syncs, nothing requested
+    sd.request()
+    assert not sd.should_stop()  # call 2: off-cycle, returns last agreement
+    assert sd.should_stop()  # call 3: syncs, sees the request
+    assert sd.should_stop()  # latched
+
+
+def test_bad_sync_every():
+    with pytest.raises(ValueError):
+        GracefulShutdown(signals=(), sync_every=0)
+
+
+def test_trainer_stops_and_checkpoints_on_preemption(tmp_path):
+    """Trainer.run leaves the loop within one step of the request and
+    force-saves a checkpoint at the stop step, beyond the periodic
+    schedule (checkpoint_every is set far past total_steps)."""
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import LLAMA_CONFIGS, Llama
+    from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+    from tpufw.train.checkpoint import CheckpointManager
+
+    tiny = LLAMA_CONFIGS["llama3_tiny"]
+    ckpt_dir = str(tmp_path / "ckpt")
+    trainer = Trainer(
+        Llama(tiny),
+        TrainerConfig(
+            batch_size=8,
+            seq_len=17,
+            total_steps=32,
+            lr=1e-3,
+            log_every=1,
+            checkpoint_dir=ckpt_dir,
+            checkpoint_every=1000,
+        ),
+        MeshConfig(data=jax.device_count(), fsdp=1),
+    )
+    sd = GracefulShutdown(signals=())  # flag-only: no real signal in-test
+
+    def hook(metrics):
+        if metrics.step >= 3:
+            sd.request()
+
+    history = trainer.run(
+        synthetic_batches(8, 17, tiny.vocab_size),
+        model_flops_per_token=tiny.flops_per_token(16),
+        on_metrics=hook,
+        shutdown=sd,
+    )
+    assert trainer.preempted
+    stop_step = int(trainer.state.step)
+    assert 3 <= stop_step < 32, stop_step
+    assert len(history) == stop_step
+    mgr = CheckpointManager(ckpt_dir)
+    try:
+        assert mgr.latest_step() == stop_step
+    finally:
+        mgr.close()
+
+
+def test_two_process_gang_stops_at_same_step(tmp_path):
+    """Only process 1 is signalled; the collective stop decision must pull
+    process 0 out of the loop at the same step, with the forced
+    checkpoint written at that step."""
+    from tests.test_distributed import _spawn_gang
+
+    outs = _spawn_gang(
+        "preemption_worker.py",
+        2,
+        {
+            "TPUFW_CHECKPOINT_DIR": str(tmp_path / "ckpt"),
+            "TPUFW_SIGNAL_PROCESS": "1",
+            "TPUFW_SIGNAL_AT_STEP": "3",
+        },
+    )
+    stop_steps = []
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\nstdout={out}\nstderr={err}"
+        steps = [
+            int(line.split(":")[1])
+            for line in out.splitlines()
+            if line.startswith("PREEMPTED:")
+        ]
+        assert steps, out
+        stop_steps.append(steps[0])
+        assert f"CKPT_LATEST:{steps[0]}" in out, out
+    assert stop_steps[0] == stop_steps[1], stop_steps
+    assert stop_steps[0] >= 3

@@ -214,6 +214,8 @@ _TRAINER_ENV = {
     "eval_batches": "EVAL_BATCHES",
     "grad_accum": "GRAD_ACCUM",
     "adam_mu_dtype": "ADAM_MU_DTYPE",
+    "handle_preemption": "HANDLE_PREEMPTION",
+    "preemption_sync_every": "PREEMPTION_SYNC_EVERY",
 }
 _VISION_ENV = {
     "batch_size": "BATCH_SIZE",

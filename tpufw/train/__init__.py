@@ -15,6 +15,7 @@ from tpufw.train.pipeline_trainer import (  # noqa: F401
     PipeTrainState,
 )
 from tpufw.train.checkpoint import CheckpointManager  # noqa: F401
+from tpufw.train.preemption import GracefulShutdown  # noqa: F401
 from tpufw.train.data import (  # noqa: F401
     pack_documents,
     synthetic_batches,

@@ -38,6 +38,11 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
+        # force=True is the preemption path ("make sure THIS step is on
+        # disk"); if the periodic schedule already saved it, that's
+        # satisfied — not an error.
+        if force and step in self._mgr.all_steps():
+            return False
         return self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )

@@ -222,9 +222,12 @@ class PipelineTrainer:
         data: Iterator[dict],
         model_flops_per_token: float,
         on_metrics: Callable[[StepMetrics], None] | None = None,
+        shutdown: "GracefulShutdown | None" = None,
     ) -> list[StepMetrics]:
         if self.state is None:
             self.init_state()
+        owns_shutdown = False
+        self.preempted = False
         meter = Meter(
             tokens_per_step=self.cfg.batch_size * (self.cfg.seq_len - 1),
             flops_per_token=model_flops_per_token,
@@ -240,6 +243,15 @@ class PipelineTrainer:
             )
         from tpufw.train.trainer import globalize_batch
 
+        # Installed LAST in setup, right before the try whose finally
+        # uninstalls it — a setup failure must not leak the handler.
+        if shutdown is None and self.cfg.handle_preemption:
+            from tpufw.train.preemption import GracefulShutdown
+
+            shutdown = GracefulShutdown(
+                sync_every=self.cfg.preemption_sync_every
+            )
+            owns_shutdown = True
         history: list[StepMetrics] = []
         try:
             for i, batch in enumerate(data):
@@ -257,8 +269,18 @@ class PipelineTrainer:
                     on_metrics(sm)
                 if ckpt is not None:
                     ckpt.save(int(self.state.step), self.state)
+                # Gang-consistent preemption stop (tpufw.train.preemption).
+                if shutdown is not None and shutdown.should_stop():
+                    self.preempted = True
+                    if ckpt is not None:
+                        ckpt.save(
+                            int(self.state.step), self.state, force=True
+                        )
+                    break
         finally:
             if ckpt is not None:
                 ckpt.wait()
                 ckpt.close()
+            if owns_shutdown:
+                shutdown.uninstall()
         return history

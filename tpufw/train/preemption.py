@@ -1,0 +1,131 @@
+"""Preemption-aware graceful shutdown: SIGTERM → checkpoint → clean exit.
+
+Kubernetes terminates pods by sending SIGTERM and waiting
+``terminationGracePeriodSeconds`` before SIGKILL — that window is the whole
+elastic-recovery budget. The reference's only recovery primitive is
+``restartPolicy: OnFailure`` (reference README.md:309), i.e. die and redo;
+SURVEY.md §5 mandates the real thing: a preempted trainer should save a
+final checkpoint inside the grace window so the JobSet gang restart resumes
+from the *current* step, not the last periodic save.
+
+The subtlety is multi-host: every pod in the gang receives SIGTERM, but not
+between the same two steps — clocks and signal delivery skew. If process A
+decides "stop after step N" while process B decides "stop after step N+1",
+B blocks forever in step N+1's collectives. The stop decision must
+therefore itself be collective: each step, processes agree on
+``any(local_flag)`` via a tiny all-gather, so the gang always stops — and
+checkpoints — at the same step. (Single-process runs skip the collective.)
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+
+class GracefulShutdown:
+    """Latches termination signals and turns them into a gang-consistent
+    per-step stop decision.
+
+    Usage::
+
+        shutdown = GracefulShutdown()          # installs SIGTERM handler
+        for step, batch in enumerate(data):
+            train(batch)
+            if shutdown.should_stop():         # collective across processes
+                ckpt.save(step, state, force=True)
+                break
+
+    Handlers chain: a previously-installed Python-level handler still runs
+    after the flag is latched. Installation is skipped (flag-only mode) off
+    the main thread, where CPython forbids ``signal.signal``.
+    """
+
+    def __init__(
+        self,
+        signals: tuple = (signal.SIGTERM,),
+        sync_every: int = 1,
+    ):
+        self._flag = threading.Event()
+        self._prev: dict = {}
+        self._signals = tuple(signals)
+        if sync_every < 1:
+            raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+        self._sync_every = sync_every
+        self._calls = 0
+        self._stop_latched = False
+        for sig in self._signals:
+            try:
+                self._prev[sig] = signal.signal(sig, self._handle)
+            except ValueError:
+                # Not the main thread — signals can't be installed here;
+                # request() still works (tests, embedded use).
+                self._prev.pop(sig, None)
+
+    def _handle(self, signum, frame):
+        self._flag.set()
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+    def request(self) -> None:
+        """Set the local stop flag programmatically (what the signal does)."""
+        self._flag.set()
+
+    @property
+    def requested(self) -> bool:
+        """This process's local flag — NOT gang-safe; use should_stop()."""
+        return self._flag.is_set()
+
+    def should_stop(self) -> bool:
+        """Gang-consistent stop decision: True iff ANY process has latched
+        a signal. Every process must call this the same number of times
+        (it is a collective when process_count > 1) — call it exactly once
+        per training step. Once True, stays True without further
+        collectives. ``sync_every`` amortizes the all-gather: non-sync
+        calls return the last agreed value, so a stop is acted on within
+        ``sync_every`` steps of the signal."""
+        if self._stop_latched:
+            return True
+        self._calls += 1
+        if (self._calls - 1) % self._sync_every:
+            return False
+        import jax
+
+        if jax.process_count() == 1:
+            self._stop_latched = self._flag.is_set()
+            return self._stop_latched
+
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([self._flag.is_set()], dtype=np.int32)
+        )
+        self._stop_latched = bool(np.asarray(flags).sum() > 0)
+        return self._stop_latched
+
+    def uninstall(self) -> None:
+        """Restore the previous signal handlers (tests / nested use).
+
+        ``prev`` is None when the prior disposition was installed at the
+        C level (signal.signal couldn't report it) — irrestorable from
+        Python, so our (now inert: chains to nothing, sets a flag nobody
+        reads) handler stays rather than guessing SIG_DFL.
+        """
+        for sig, prev in self._prev.items():
+            if prev is None:
+                continue
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):
+                pass
+        self._prev.clear()
+
+    def __enter__(self) -> "GracefulShutdown":
+        return self
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        self.uninstall()
+        return None

@@ -323,6 +323,16 @@ class TrainerConfig:
     # Adam first-moment storage dtype (None = fp32). "bfloat16" halves
     # the mu buffer — see default_optimizer.
     adam_mu_dtype: Optional[str] = None
+    # Preemption handling: latch SIGTERM (k8s pod termination) and exit
+    # the step loop cleanly with a forced final checkpoint, so a JobSet
+    # gang restart resumes from the current step (tpufw.train.preemption).
+    # Default ON — one default for library and deployed use; the handler
+    # chains to any prior one and is uninstalled when run() returns.
+    handle_preemption: bool = True
+    # Steps between gang-consistency syncs of the stop flag (the
+    # cross-host allgather in GracefulShutdown.should_stop); a stop is
+    # acted on within this many steps of the signal. 1 = every step.
+    preemption_sync_every: int = 1
 
 
 class Trainer:
@@ -575,9 +585,12 @@ class Trainer:
         on_metrics: Callable[[StepMetrics], None] | None = None,
         eval_data: Callable[[], Iterator[dict]] | None = None,
         on_eval: Callable[[dict], None] | None = None,
+        shutdown: "GracefulShutdown | None" = None,
     ) -> list[StepMetrics]:
         if self.state is None:
             self.init_state()
+        owns_shutdown = False
+        self.preempted = False
         meter = Meter(
             tokens_per_step=self.cfg.batch_size * (self.cfg.seq_len - 1),
             flops_per_token=model_flops_per_token,
@@ -598,6 +611,16 @@ class Trainer:
             self.cfg.profile_start,
             self.cfg.profile_stop,
         )
+        # Installed LAST in setup, right before the try whose finally
+        # uninstalls it — a setup failure must not leak the process-level
+        # signal handler.
+        if shutdown is None and self.cfg.handle_preemption:
+            from tpufw.train.preemption import GracefulShutdown
+
+            shutdown = GracefulShutdown(
+                sync_every=self.cfg.preemption_sync_every
+            )
+            owns_shutdown = True
         history: list[StepMetrics] = []
         try:
             with use_mesh(self.mesh):
@@ -629,6 +652,15 @@ class Trainer:
                             on_eval(ev)
                     if ckpt is not None:
                         ckpt.save(int(self.state.step), self.state)
+                    # Collective decision (see preemption.py): the whole
+                    # gang breaks at the same step or not at all.
+                    if shutdown is not None and shutdown.should_stop():
+                        self.preempted = True
+                        if ckpt is not None:
+                            ckpt.save(
+                                int(self.state.step), self.state, force=True
+                            )
+                        break
         finally:
             # Flush even on a mid-loop crash: the trace and the last
             # checkpoint are exactly what post-mortems need.
@@ -636,4 +668,6 @@ class Trainer:
             if ckpt is not None:
                 ckpt.wait()
                 ckpt.close()
+            if owns_shutdown:
+                shutdown.uninstall()
         return history

@@ -14,7 +14,7 @@ from __future__ import annotations
 import json
 import time
 
-from tpufw.workloads.env import env_float, env_int, env_str
+from tpufw.workloads.env import env_bool, env_float, env_int, env_str
 
 # Import time ~= process start: the anchor for cold-start→first-step
 # (BASELINE.md metric 2 — the reference's analog is its unmeasured
@@ -109,6 +109,14 @@ def build_trainer():
             "adam_mu_dtype", base_t.adam_mu_dtype or ""
         )
         or None,
+        # Deployed pods handle SIGTERM by default: k8s termination →
+        # forced final checkpoint → clean exit → JobSet restart resumes.
+        handle_preemption=env_bool(
+            "handle_preemption", base_t.handle_preemption
+        ),
+        preemption_sync_every=env_int(
+            "preemption_sync_every", base_t.preemption_sync_every
+        ),
     )
     mesh_cfg = MeshConfig(
         data=env_int("mesh_data", base_m.data),
@@ -223,6 +231,15 @@ def main() -> int:
         eval_data=eval_data,
         on_eval=lambda ev: print(json.dumps(ev), flush=True),
     )
+    if getattr(trainer, "preempted", False):
+        # SIGTERM inside the grace window: the forced checkpoint is down,
+        # exit clean so the JobSet restart policy resumes, not redoes.
+        print(
+            json.dumps(
+                {"preempted": True, "step": int(trainer.state.step)}
+            ),
+            flush=True,
+        )
     print_summary(history)
     return 0
 

@@ -19,7 +19,7 @@ from __future__ import annotations
 import json
 import time
 
-from tpufw.workloads.env import env_float, env_int, env_str
+from tpufw.workloads.env import env_bool, env_float, env_int, env_str
 
 _T0 = time.time()
 
@@ -69,6 +69,9 @@ def build_trainer():
         loss_chunk_size=env_int("loss_chunk_size", 0) or None,
         profile_dir=env_str("profile_dir", "") or None,
         eval_every=env_int("eval_every", 0),
+        # Same SIGTERM-to-forced-checkpoint contract as train_llama.
+        handle_preemption=env_bool("handle_preemption", True),
+        preemption_sync_every=env_int("preemption_sync_every", 1),
     )
     mesh_cfg = MeshConfig(
         data=env_int("mesh_data", 1),
@@ -125,6 +128,13 @@ def main() -> int:
         model_flops_per_token=model_cfg.flops_per_token(cfg.seq_len - 1),
         on_metrics=metrics_printer(_T0, cache),
     )
+    if getattr(trainer, "preempted", False):
+        print(
+            json.dumps(
+                {"preempted": True, "step": int(trainer.state.step)}
+            ),
+            flush=True,
+        )
     print_summary(history)
     return 0
 
