@@ -56,15 +56,14 @@ def main():
         trainer.init_state()
         start = 0
 
-    steps_left = total_steps - start
-
     def crash_hook(metrics):
         if crash_at and metrics.step >= crash_at:
             # Simulated worker death: skip atexit/orbax cleanup, like a
             # kill -9'd pod.
             os._exit(17)
 
-    trainer.cfg.total_steps = steps_left
+    # total_steps is a GLOBAL budget: the resumed run finishes the
+    # remainder on its own, no manual steps-left arithmetic.
     # batch_size is GLOBAL; each process feeds its local shard (seeded by
     # process_id so shards differ, as a real per-host loader's would).
     local_bs = 4 // jax.process_count()

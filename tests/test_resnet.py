@@ -66,3 +66,52 @@ def test_vision_trainer_end_to_end(devices8):
     )
     assert len(hist) == 3
     assert np.isfinite(hist[-1].loss)
+
+
+def test_vision_checkpoint_resume_and_preemption(devices8, tmp_path):
+    """VisionTrainer now shares the LM trainer's recovery contract:
+    preemption stop → forced checkpoint at the stop step → a fresh
+    trainer resumes from it (params, BN stats, and opt state restored)."""
+    from tpufw.mesh import MeshConfig
+    from tpufw.train import (
+        GracefulShutdown,
+        VisionTrainer,
+        VisionTrainerConfig,
+        synthetic_images,
+    )
+
+    ckpt = str(tmp_path / "ckpt")
+    cfg = VisionTrainerConfig(
+        batch_size=8, image_size=32, num_classes=10, total_steps=32,
+        lr=0.05, checkpoint_dir=ckpt, checkpoint_every=1000,
+    )
+    trainer = VisionTrainer(_tiny_resnet(), cfg, MeshConfig(data=2, fsdp=4))
+    trainer.init_state()
+    sd = GracefulShutdown(signals=())
+
+    def hook(m):
+        if m.step >= 2:
+            sd.request()
+
+    hist = trainer.run(
+        synthetic_images(8, 32, 10), flops_per_image=1e6,
+        on_metrics=hook, shutdown=sd,
+    )
+    assert trainer.preempted
+    stop = int(trainer.state.step)
+    assert 2 <= stop < 32 and len(hist) == stop
+
+    resumed = VisionTrainer(
+        _tiny_resnet(), cfg, MeshConfig(data=2, fsdp=4)
+    )
+    assert resumed.maybe_restore()
+    assert int(resumed.state.step) == stop
+    np.testing.assert_allclose(
+        np.asarray(jax.tree.leaves(resumed.state.batch_stats)[0]),
+        np.asarray(jax.tree.leaves(trainer.state.batch_stats)[0]),
+    )
+    # total_steps is a GLOBAL budget: finish the remainder only.
+    resumed.cfg.total_steps = stop + 2
+    hist2 = resumed.run(synthetic_images(8, 32, 10), flops_per_image=1e6)
+    assert len(hist2) == 2
+    assert int(resumed.state.step) == stop + 2

@@ -222,6 +222,10 @@ _VISION_ENV = {
     "image_size": "IMAGE_SIZE",
     "num_classes": "NUM_CLASSES",
     "total_steps": "TOTAL_STEPS",
+    "checkpoint_dir": "CHECKPOINT_DIR",
+    "checkpoint_every": "CHECKPOINT_EVERY",
+    "handle_preemption": "HANDLE_PREEMPTION",
+    "preemption_sync_every": "PREEMPTION_SYNC_EVERY",
 }
 _MESH_ENV = {
     "data": "MESH_DATA",

@@ -114,6 +114,7 @@ class PipelineTrainer:
         )
         self.state: PipeTrainState | None = None
         self._step_fn = None
+        self.preempted = False
 
     # -- state ---------------------------------------------------------
 
@@ -243,19 +244,19 @@ class PipelineTrainer:
             )
         from tpufw.train.trainer import globalize_batch
 
-        # Installed LAST in setup, right before the try whose finally
-        # uninstalls it — a setup failure must not leak the handler.
-        if shutdown is None and self.cfg.handle_preemption:
-            from tpufw.train.preemption import GracefulShutdown
+        from tpufw.train.preemption import checkpoint_stop, owned_shutdown
 
-            shutdown = GracefulShutdown(
-                sync_every=self.cfg.preemption_sync_every
-            )
-            owns_shutdown = True
+        shutdown, owns_shutdown = owned_shutdown(
+            shutdown,
+            self.cfg.handle_preemption,
+            self.cfg.preemption_sync_every,
+        )
+        # Global step budget: a restored run finishes the remainder.
+        remaining = max(0, self.cfg.total_steps - int(self.state.step))
         history: list[StepMetrics] = []
         try:
             for i, batch in enumerate(data):
-                if i >= self.cfg.total_steps:
+                if i >= remaining:
                     break
                 meter.start()
                 batch = globalize_batch(self.mesh, batch)
@@ -270,12 +271,10 @@ class PipelineTrainer:
                 if ckpt is not None:
                     ckpt.save(int(self.state.step), self.state)
                 # Gang-consistent preemption stop (tpufw.train.preemption).
-                if shutdown is not None and shutdown.should_stop():
+                if checkpoint_stop(
+                    shutdown, ckpt, int(self.state.step), self.state
+                ):
                     self.preempted = True
-                    if ckpt is not None:
-                        ckpt.save(
-                            int(self.state.step), self.state, force=True
-                        )
                     break
         finally:
             if ckpt is not None:

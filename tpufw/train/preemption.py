@@ -129,3 +129,33 @@ class GracefulShutdown:
     def __exit__(self, *exc) -> Optional[bool]:
         self.uninstall()
         return None
+
+
+def owned_shutdown(
+    shutdown: Optional[GracefulShutdown],
+    enabled: bool,
+    sync_every: int,
+) -> tuple[Optional[GracefulShutdown], bool]:
+    """Trainer-side ownership helper: construct a GracefulShutdown iff the
+    caller passed none and the config enables handling. Returns
+    (shutdown, owns); the caller must ``uninstall()`` in its run-loop
+    ``finally`` when ``owns`` — call this LAST in run() setup, right
+    before that try, so a setup failure can't leak the signal handler.
+    """
+    if shutdown is not None or not enabled:
+        return shutdown, False
+    return GracefulShutdown(sync_every=sync_every), True
+
+
+def checkpoint_stop(
+    shutdown: Optional[GracefulShutdown], ckpt, step: int, state
+) -> bool:
+    """The per-step stop block shared by every trainer loop: gang-consistent
+    stop check (call exactly once per step — it is a collective), and on
+    stop a forced checkpoint of ``step`` so the restart resumes here.
+    Returns True when the loop should break."""
+    if shutdown is None or not shutdown.should_stop():
+        return False
+    if ckpt is not None:
+        ckpt.save(step, state, force=True)
+    return True

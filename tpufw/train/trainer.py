@@ -358,6 +358,7 @@ class Trainer:
         self._compiled: dict = {}
         self.state = None
         self.state_sharding = None
+        self.preempted = False
 
     def _abstract_state(self, rng):
         tokens = jnp.zeros(
@@ -611,21 +612,22 @@ class Trainer:
             self.cfg.profile_start,
             self.cfg.profile_stop,
         )
-        # Installed LAST in setup, right before the try whose finally
-        # uninstalls it — a setup failure must not leak the process-level
-        # signal handler.
-        if shutdown is None and self.cfg.handle_preemption:
-            from tpufw.train.preemption import GracefulShutdown
+        from tpufw.train.preemption import checkpoint_stop, owned_shutdown
 
-            shutdown = GracefulShutdown(
-                sync_every=self.cfg.preemption_sync_every
-            )
-            owns_shutdown = True
+        shutdown, owns_shutdown = owned_shutdown(
+            shutdown,
+            self.cfg.handle_preemption,
+            self.cfg.preemption_sync_every,
+        )
+        # total_steps is the GLOBAL optimizer-step budget (it sized the LR
+        # schedule): a restored run finishes the remaining steps, it does
+        # not train total_steps more.
+        remaining = max(0, self.cfg.total_steps - int(self.state.step))
         history: list[StepMetrics] = []
         try:
             with use_mesh(self.mesh):
                 for i, batch in enumerate(data):
-                    if i >= self.cfg.total_steps:
+                    if i >= remaining:
                         break
                     batch = self.globalize_batch(batch)
                     step_fn = self.compiled_step(batch)
@@ -654,12 +656,10 @@ class Trainer:
                         ckpt.save(int(self.state.step), self.state)
                     # Collective decision (see preemption.py): the whole
                     # gang breaks at the same step or not at all.
-                    if shutdown is not None and shutdown.should_stop():
+                    if checkpoint_stop(
+                        shutdown, ckpt, int(self.state.step), self.state
+                    ):
                         self.preempted = True
-                        if ckpt is not None:
-                            ckpt.save(
-                                int(self.state.step), self.state, force=True
-                            )
                         break
         finally:
             # Flush even on a mid-loop crash: the trace and the last

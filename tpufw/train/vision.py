@@ -75,6 +75,14 @@ class VisionTrainerConfig:
     momentum: float = 0.9
     weight_decay: float = 1e-4
     warmup_steps: int = 5
+    # Orbax checkpoint/resume (None = off) — same elastic-recovery
+    # contract as the LM TrainerConfig.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 100
+    # SIGTERM → gang-consistent stop → forced final checkpoint
+    # (tpufw.train.preemption); same semantics as TrainerConfig.
+    handle_preemption: bool = True
+    preemption_sync_every: int = 1
 
 
 class VisionTrainer:
@@ -108,8 +116,9 @@ class VisionTrainer:
         self.state = None
         self.state_sharding = None
         self._compiled = None
+        self.preempted = False
 
-    def init_state(self, seed: int = 0) -> VisionTrainState:
+    def _abstract_state(self, rng):
         imgs = jnp.zeros(
             (
                 self.cfg.batch_size,
@@ -131,8 +140,11 @@ class VisionTrainer:
                 tx=self.tx,
             )
 
+        return init_fn, jax.eval_shape(init_fn, rng)
+
+    def init_state(self, seed: int = 0) -> VisionTrainState:
         rng = jax.random.key(seed)
-        abstract = jax.eval_shape(init_fn, rng)
+        init_fn, abstract = self._abstract_state(rng)
         self.state_sharding = state_shardings(abstract, self.mesh)
         with use_mesh(self.mesh):
             self.state = jax.jit(
@@ -141,6 +153,33 @@ class VisionTrainer:
         self.state = meta.unbox(self.state)
         self.state_sharding = meta.unbox(self.state_sharding)
         return self.state
+
+    def maybe_restore(self) -> bool:
+        """Resume from the latest checkpoint in cfg.checkpoint_dir, if any
+        — same pod-restart resume contract as the LM Trainer, without
+        materializing a throwaway init."""
+        if not self.cfg.checkpoint_dir:
+            return False
+        from tpufw.train.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(self.cfg.checkpoint_dir)
+        try:
+            if mgr.latest_step() is None:
+                return False
+            rng = jax.random.key(0)
+            _, boxed = self._abstract_state(rng)
+            self.state_sharding = meta.unbox(
+                state_shardings(boxed, self.mesh)
+            )
+            abstract = jax.tree.map(
+                lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+                meta.unbox(boxed),
+                self.state_sharding,
+            )
+            self.state = mgr.restore(abstract)
+            return True
+        finally:
+            mgr.close()
 
     def compiled_step(self):
         if self._compiled is None:
@@ -161,6 +200,7 @@ class VisionTrainer:
         data: Iterator[dict],
         flops_per_image: Optional[float] = None,
         on_metrics: Callable[[StepMetrics], None] | None = None,
+        shutdown: "GracefulShutdown | None" = None,
     ) -> list[StepMetrics]:
         if self.state is None:
             self.init_state()
@@ -170,18 +210,52 @@ class VisionTrainer:
             flops_per_token=flops_per_image or 0.0,
             n_chips=len(self.mesh.devices.flatten()),
         )
+        owns_shutdown = False
+        self.preempted = False
+        ckpt = None
+        if self.cfg.checkpoint_dir:
+            from tpufw.train.checkpoint import CheckpointManager
+
+            ckpt = CheckpointManager(
+                self.cfg.checkpoint_dir,
+                save_interval_steps=self.cfg.checkpoint_every,
+            )
+        from tpufw.train.preemption import checkpoint_stop, owned_shutdown
+
+        shutdown, owns_shutdown = owned_shutdown(
+            shutdown,
+            self.cfg.handle_preemption,
+            self.cfg.preemption_sync_every,
+        )
+        # Global step budget: a restored run finishes the remainder.
+        remaining = max(0, self.cfg.total_steps - int(self.state.step))
         history = []
-        with use_mesh(self.mesh):
-            for i, batch in enumerate(data):
-                if i >= self.cfg.total_steps:
-                    break
-                meter.start()
-                self.state, m = step_fn(self.state, batch)
-                loss = jax.block_until_ready(m["loss"])
-                sm = meter.stop(int(self.state.step), loss)
-                history.append(sm)
-                if on_metrics:
-                    on_metrics(sm)
+        try:
+            with use_mesh(self.mesh):
+                for i, batch in enumerate(data):
+                    if i >= remaining:
+                        break
+                    meter.start()
+                    self.state, m = step_fn(self.state, batch)
+                    loss = jax.block_until_ready(m["loss"])
+                    sm = meter.stop(int(self.state.step), loss)
+                    history.append(sm)
+                    if on_metrics:
+                        on_metrics(sm)
+                    if ckpt is not None:
+                        ckpt.save(int(self.state.step), self.state)
+                    # Gang-consistent preemption stop (preemption.py).
+                    if checkpoint_stop(
+                        shutdown, ckpt, int(self.state.step), self.state
+                    ):
+                        self.preempted = True
+                        break
+        finally:
+            if ckpt is not None:
+                ckpt.wait()
+                ckpt.close()
+            if owns_shutdown:
+                shutdown.uninstall()
         return history
 
 

@@ -51,6 +51,18 @@ def metrics_printer(
     return on_metrics
 
 
+def report_preemption(trainer) -> None:
+    """One JSON line when the run stopped on SIGTERM (the forced
+    checkpoint is down; a clean exit lets the JobSet policy resume)."""
+    if getattr(trainer, "preempted", False):
+        print(
+            json.dumps(
+                {"preempted": True, "step": int(trainer.state.step)}
+            ),
+            flush=True,
+        )
+
+
 def print_summary(history: list[StepMetrics]) -> None:
     if not history:
         return

@@ -231,15 +231,9 @@ def main() -> int:
         eval_data=eval_data,
         on_eval=lambda ev: print(json.dumps(ev), flush=True),
     )
-    if getattr(trainer, "preempted", False):
-        # SIGTERM inside the grace window: the forced checkpoint is down,
-        # exit clean so the JobSet restart policy resumes, not redoes.
-        print(
-            json.dumps(
-                {"preempted": True, "step": int(trainer.state.step)}
-            ),
-            flush=True,
-        )
+    from tpufw.workloads._common import report_preemption
+
+    report_preemption(trainer)
     print_summary(history)
     return 0
 

@@ -128,13 +128,9 @@ def main() -> int:
         model_flops_per_token=model_cfg.flops_per_token(cfg.seq_len - 1),
         on_metrics=metrics_printer(_T0, cache),
     )
-    if getattr(trainer, "preempted", False):
-        print(
-            json.dumps(
-                {"preempted": True, "step": int(trainer.state.step)}
-            ),
-            flush=True,
-        )
+    from tpufw.workloads._common import report_preemption
+
+    report_preemption(trainer)
     print_summary(history)
     return 0
 

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import json
 
-from tpufw.workloads.env import env_int
+from tpufw.workloads.env import env_bool, env_int, env_str
 
 
 def main() -> int:
@@ -33,13 +33,20 @@ def main() -> int:
         image_size=env_int("image_size", 224),
         num_classes=env_int("num_classes", 1000),
         total_steps=env_int("total_steps", 50),
+        checkpoint_dir=env_str("checkpoint_dir", "") or None,
+        checkpoint_every=env_int("checkpoint_every", 100),
+        handle_preemption=env_bool("handle_preemption", True),
+        preemption_sync_every=env_int("preemption_sync_every", 1),
     )
     print(
         f"tpufw train_resnet: process {cluster.process_id}/"
         f"{cluster.num_processes} devices={jax.devices()}"
     )
     trainer = VisionTrainer(resnet50(cfg.num_classes), cfg)
-    trainer.init_state(seed=env_int("seed", 0))
+    if trainer.maybe_restore():
+        print(f"resumed from checkpoint at step {int(trainer.state.step)}")
+    else:
+        trainer.init_state(seed=env_int("seed", 0))
 
     flops = ResNetConfig().flops_per_image(cfg.image_size)
     history = trainer.run(
@@ -47,12 +54,16 @@ def main() -> int:
         flops_per_image=flops,
         on_metrics=lambda m: print(json.dumps(m.as_dict()), flush=True),
     )
-    last = history[-1]
-    imgs_per_sec = last.tokens_per_sec_per_chip  # tokens == images here
-    print(
-        f"TRAIN OK: {len(history)} steps, final loss {last.loss:.4f}, "
-        f"{imgs_per_sec:.1f} images/s/chip, MFU {last.mfu:.1%}"
-    )
+    from tpufw.workloads._common import report_preemption
+
+    report_preemption(trainer)
+    if history:
+        last = history[-1]
+        imgs_per_sec = last.tokens_per_sec_per_chip  # tokens == images
+        print(
+            f"TRAIN OK: {len(history)} steps, final loss {last.loss:.4f}, "
+            f"{imgs_per_sec:.1f} images/s/chip, MFU {last.mfu:.1%}"
+        )
     return 0
 
 
