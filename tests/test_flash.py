@@ -173,3 +173,104 @@ def test_flash_decode_offset():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
     )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_soft_cap_fwd_matches_xla(causal):
+    """Gemma-style logit soft-capping inside the kernel vs the xla
+    reference (tpufw/ops/attention.py applies the same cap*tanh)."""
+    b, t, h, kh, d = 1, 256, 4, 2, 64
+    ks = jax.random.split(jax.random.key(5), 3)
+    # Scale up so the cap actually bends logits (tanh region matters).
+    q = _rand(ks[0], (b, t, h, d)) * 3.0
+    k = _rand(ks[1], (b, t, kh, d)) * 3.0
+    v = _rand(ks[2], (b, t, kh, d))
+    ref = xla_attention(q, k, v, causal=causal, logits_soft_cap=20.0)
+    out = flash_attention(
+        q, k, v, causal=causal, logits_soft_cap=20.0, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+    # And the cap must actually change the answer.
+    uncapped = flash_attention(q, k, v, causal=causal, interpret=True)
+    assert np.abs(np.asarray(out) - np.asarray(uncapped)).max() > 1e-3
+
+
+def test_flash_soft_cap_grads_match_xla():
+    b, t, h, kh, d = 1, 128, 4, 2, 64
+    ks = jax.random.split(jax.random.key(6), 3)
+    q = _rand(ks[0], (b, t, h, d)) * 3.0
+    k = _rand(ks[1], (b, t, kh, d)) * 3.0
+    v = _rand(ks[2], (b, t, kh, d))
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(
+                q, k, v, causal=True, logits_soft_cap=20.0,
+                interpret=True,
+            ) ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (
+            xla_attention(q, k, v, causal=True, logits_soft_cap=20.0) ** 2
+        ).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf),
+            np.asarray(gr),
+            atol=5e-4,
+            rtol=5e-4,
+            err_msg=f"d{name} soft-cap mismatch",
+        )
+
+
+def test_flash_soft_cap_with_segments():
+    """Cap composes with packed-batch segment masking, fwd + grads."""
+    b, t, h, kh, d = 1, 128, 2, 2, 64
+    ks = jax.random.split(jax.random.key(7), 3)
+    q = _rand(ks[0], (b, t, h, d)) * 3.0
+    k = _rand(ks[1], (b, t, kh, d)) * 3.0
+    v = _rand(ks[2], (b, t, kh, d))
+    seg = jnp.concatenate(
+        [jnp.full((b, 64), 1), jnp.full((b, 64), 2)], axis=1
+    ).astype(jnp.int32)
+
+    ref = xla_attention(
+        q, k, v, causal=True, segment_ids=seg, logits_soft_cap=20.0
+    )
+    out = flash_attention(
+        q, k, v, causal=True, segment_ids=seg, logits_soft_cap=20.0,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(
+                q, k, v, causal=True, segment_ids=seg,
+                logits_soft_cap=20.0, interpret=True,
+            ) ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (
+            xla_attention(
+                q, k, v, causal=True, segment_ids=seg,
+                logits_soft_cap=20.0,
+            ) ** 2
+        ).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} soft-cap+segments mismatch",
+        )

@@ -126,16 +126,17 @@ def multi_head_attention(
             f"KV-cache decode (kv_segment_ids/q_positions) requires "
             f"backend='xla', got {backend!r}"
         )
-    if backend in ("flash", "ring", "ulysses") and logits_soft_cap is not None:
+    if backend in ("ring", "ulysses") and logits_soft_cap is not None:
         raise NotImplementedError(
             f"logits_soft_cap is not supported by backend={backend!r}; "
-            "use backend='xla'"
+            "use backend='xla' or 'flash'"
         )
     if backend == "flash":
         from tpufw.ops.flash import flash_attention
 
         return flash_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            logits_soft_cap=logits_soft_cap,
         )
     if backend == "ring":
         from tpufw.parallel.ring import ring_attention

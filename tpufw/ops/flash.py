@@ -86,7 +86,7 @@ def _causal_mask(i_block, j_block, bq, bkv, offset):
 
 
 def _fwd_kernel(
-    *refs, bq, bkv, s_actual, causal, offset, scale, has_seg
+    *refs, bq, bkv, s_actual, causal, offset, scale, has_seg, soft_cap
 ):
     if has_seg:
         q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref = refs
@@ -108,6 +108,11 @@ def _fwd_kernel(
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [bq, bkv]
+        if soft_cap is not None:
+            # Gemma-style logit soft-capping on the SCALED logits (q is
+            # pre-scaled), matching tpufw.ops.attention.xla_attention.
+            # Applied before masking: cap(NEG_INF) would squash the mask.
+            logits = soft_cap * jnp.tanh(logits / soft_cap)
         k_pos = j * bkv + jax.lax.broadcasted_iota(
             jnp.int32, (bq, bkv), 1
         )
@@ -147,7 +152,7 @@ def _fwd_kernel(
 
 
 def _dq_kernel(
-    *refs, bq, bkv, s_actual, causal, offset, scale, has_seg
+    *refs, bq, bkv, s_actual, causal, offset, scale, has_seg, soft_cap
 ):
     if has_seg:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -177,12 +182,19 @@ def _dq_kernel(
         if has_seg:
             kseg = kseg_ref[0, :1, pl.ds(j * bkv, bkv)]
             mask = mask & _seg_mask(qseg, kseg)
-        p = jnp.where(mask, jnp.exp(logits - lse), 0.0)
+        if soft_cap is not None:
+            capped = soft_cap * jnp.tanh(logits / soft_cap)
+        else:
+            capped = logits
+        p = jnp.where(mask, jnp.exp(capped - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta)
+        if soft_cap is not None:
+            # d(cap*tanh(x/cap))/dx = 1 - tanh^2 = 1 - (capped/cap)^2.
+            ds = ds * (1.0 - (capped / soft_cap) ** 2)
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     d = q_ref.shape[-1]
@@ -198,7 +210,7 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
-    *refs, bq, bkv, t_actual, causal, offset, scale, has_seg
+    *refs, bq, bkv, t_actual, causal, offset, scale, has_seg, soft_cap
 ):
     if has_seg:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -230,7 +242,11 @@ def _dkv_kernel(
         if has_seg:
             qseg = qseg_ref[0, pl.ds(i * bq, bq), :]  # [bq, LANES]
             mask = mask & _seg_mask(qseg, kseg)
-        p = jnp.where(mask, jnp.exp(logits - lse), 0.0)
+        if soft_cap is not None:
+            capped = soft_cap * jnp.tanh(logits / soft_cap)
+        else:
+            capped = logits
+        p = jnp.where(mask, jnp.exp(capped - lse), 0.0)
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -240,6 +256,8 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta)
+        if soft_cap is not None:
+            ds = ds * (1.0 - (capped / soft_cap) ** 2)
         dk = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -284,14 +302,16 @@ def _block_sizes(t_pad, s_pad):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7)
 )
-def _flash(q, k, v, qseg, kseg, causal, interpret):
-    out, _ = _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret)
+def _flash(q, k, v, qseg, kseg, causal, interpret, soft_cap):
+    out, _ = _flash_fwd_impl(
+        q, k, v, qseg, kseg, causal, interpret, soft_cap
+    )
     return out
 
 
-def _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret):
+def _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret, soft_cap):
     b, t, h, d = q.shape
     _, s, kh, _ = k.shape
     rep = h // kh
@@ -317,6 +337,7 @@ def _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret):
         offset=offset,
         scale=scale,
         has_seg=has_seg,
+        soft_cap=soft_cap,
     )
     in_specs = [
         pl.BlockSpec(
@@ -365,7 +386,7 @@ def _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret):
     return out_bthd, (q, k, v, qseg, kseg, out_bthd, lse)
 
 
-def _flash_bwd_impl(causal, interpret, res, g):
+def _flash_bwd_impl(causal, interpret, soft_cap, res, g):
     q, k, v, qseg, kseg, out, lse = res
     b, t, h, d = q.shape
     _, s, kh, _ = k.shape
@@ -431,6 +452,7 @@ def _flash_bwd_impl(causal, interpret, res, g):
             offset=offset,
             scale=scale,
             has_seg=has_seg,
+            soft_cap=soft_cap,
         ),
         grid=(b, h, t_p // bq),
         in_specs=dq_in_specs,
@@ -475,6 +497,7 @@ def _flash_bwd_impl(causal, interpret, res, g):
             offset=offset,
             scale=scale,
             has_seg=has_seg,
+            soft_cap=soft_cap,
         ),
         grid=(b, h, s_p // bkv),
         in_specs=dkv_in_specs,
@@ -497,8 +520,10 @@ def _flash_bwd_impl(causal, interpret, res, g):
     return dq, dk, dv, None, None
 
 
-def _flash_fwd_rule(q, k, v, qseg, kseg, causal, interpret):
-    out, res = _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret)
+def _flash_fwd_rule(q, k, v, qseg, kseg, causal, interpret, soft_cap):
+    out, res = _flash_fwd_impl(
+        q, k, v, qseg, kseg, causal, interpret, soft_cap
+    )
     return out, res
 
 
@@ -513,6 +538,7 @@ def flash_attention(
     causal: bool = True,
     segment_ids=None,
     kv_segment_ids=None,
+    logits_soft_cap: float | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention. q:[B,T,H,D], k/v:[B,S,K,D] -> [B,T,H,D].
@@ -520,6 +546,9 @@ def flash_attention(
     ``segment_ids`` ([B, T] int) masks cross-segment attention for packed
     batches; ``kv_segment_ids`` ([B, S]) defaults to ``segment_ids`` (which
     then requires T == S, the self-attention training path).
+    ``logits_soft_cap`` applies Gemma-style ``cap * tanh(logits/cap)`` to
+    the scaled logits inside the kernel (fwd and both bwd kernels),
+    matching ``xla_attention``'s semantics.
 
     ``interpret=None`` auto-selects the Pallas interpreter on CPU backends
     (tests, dryruns); any accelerator backend gets the real Mosaic lowering.
@@ -542,4 +571,5 @@ def flash_attention(
         )
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
-    return _flash(q, k, v, qseg, kseg, causal, interpret)
+    cap = None if logits_soft_cap is None else float(logits_soft_cap)
+    return _flash(q, k, v, qseg, kseg, causal, interpret, cap)

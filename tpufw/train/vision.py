@@ -229,12 +229,15 @@ class VisionTrainer:
         )
         # Global step budget: a restored run finishes the remainder.
         remaining = max(0, self.cfg.total_steps - int(self.state.step))
+        from tpufw.train.trainer import globalize_batch
+
         history = []
         try:
             with use_mesh(self.mesh):
                 for i, batch in enumerate(data):
                     if i >= remaining:
                         break
+                    batch = globalize_batch(self.mesh, batch)
                     meter.start()
                     self.state, m = step_fn(self.state, batch)
                     loss = jax.block_until_ready(m["loss"])
