@@ -274,3 +274,45 @@ def test_flash_soft_cap_with_segments():
             np.asarray(gf), np.asarray(gr), atol=5e-4, rtol=5e-4,
             err_msg=f"d{name} soft-cap+segments mismatch",
         )
+
+
+@pytest.mark.parametrize("window", [100, 128, 300])
+def test_flash_sliding_window_matches_xla(window):
+    """Window masking across MULTIPLE kv blocks (T=384 -> 128-blocks), so
+    the in-kernel first-visible-block skip is actually exercised. fwd and
+    all three grads vs the xla reference."""
+    b, t, h, kh, d = 1, 384, 2, 1, 64
+    ks = jax.random.split(jax.random.key(8), 3)
+    q = _rand(ks[0], (b, t, h, d))
+    k = _rand(ks[1], (b, t, kh, d))
+    v = _rand(ks[2], (b, t, kh, d))
+
+    ref = xla_attention(q, k, v, causal=True, sliding_window=window)
+    out = flash_attention(
+        q, k, v, causal=True, sliding_window=window, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(
+                q, k, v, causal=True, sliding_window=window,
+                interpret=True,
+            ) ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (
+            xla_attention(q, k, v, causal=True, sliding_window=window)
+            ** 2
+        ).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} window={window} mismatch",
+        )

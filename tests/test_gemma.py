@@ -1,0 +1,265 @@
+"""Gemma-2 family: architecture, sliding window, training, and HF parity.
+
+The HF-logits test is the load-bearing one: it simultaneously pins the
+(1+w) RMSNorm offset, sandwich norm placement, GeGLU, sqrt(d) embedding
+scaling, both soft-caps, query_pre_attn_scalar, the local/global layer
+alternation, and the pair-scanned weight layout.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpufw.models import GEMMA_CONFIGS, Gemma, GemmaConfig
+
+
+def test_odd_layers_rejected():
+    cfg = GemmaConfig(n_layers=3)  # config constructs fine...
+    with pytest.raises(ValueError, match="even"):  # ...the model objects
+        jax.eval_shape(
+            Gemma(cfg).init, jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )
+
+
+def test_param_count_matches_analytic():
+    cfg = GEMMA_CONFIGS["gemma2_tiny"]
+    params = jax.eval_shape(
+        Gemma(cfg).init, jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    n = sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(params)
+    )
+    assert n == cfg.n_params()
+
+
+def test_final_logits_capped():
+    cfg = GEMMA_CONFIGS["gemma2_tiny"]
+    model = Gemma(cfg)
+    tokens = jax.random.randint(
+        jax.random.key(0), (2, 48), 0, cfg.vocab_size
+    )
+    params = model.init(jax.random.key(1), tokens)
+    logits = model.apply(params, tokens)
+    assert jnp.isfinite(logits).all()
+    assert float(jnp.abs(logits).max()) <= cfg.final_logit_soft_cap
+
+
+def test_sliding_window_changes_even_layers_only():
+    """A token beyond the window must still be reachable through global
+    (odd) layers but invisible to local (even) ones: growing the window
+    to cover the full sequence must change the logits."""
+    cfg = GEMMA_CONFIGS["gemma2_tiny"]  # window 32
+    tokens = jax.random.randint(
+        jax.random.key(0), (1, 96), 0, cfg.vocab_size
+    )
+    params = Gemma(cfg).init(jax.random.key(1), tokens)
+    local = Gemma(cfg).apply(params, tokens)
+    wide = Gemma(
+        dataclasses.replace(cfg, sliding_window=256)
+    ).apply(params, tokens)
+    assert np.abs(np.asarray(local) - np.asarray(wide)).max() > 1e-4
+
+
+def test_flash_backend_matches_xla():
+    """The whole Gemma stack (caps + windows) through the flash kernel
+    (Pallas interpreter on CPU) vs the xla backend."""
+    cfg = dataclasses.replace(
+        GEMMA_CONFIGS["gemma2_tiny"],
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    tokens = jax.random.randint(
+        jax.random.key(2), (1, 64), 0, cfg.vocab_size
+    )
+    params = Gemma(cfg).init(jax.random.key(3), tokens)
+    ref = Gemma(cfg).apply(params, tokens)
+    out = Gemma(
+        dataclasses.replace(cfg, attention_backend="flash")
+    ).apply(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5
+    )
+
+
+def test_trains_with_chunked_ce(devices8):
+    """End-to-end train steps on the mesh, chunked-vocab CE path (the
+    final soft-cap rides through tpufw.ops.loss per chunk)."""
+    from tpufw.mesh import MeshConfig
+    from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+    cfg = GEMMA_CONFIGS["gemma2_tiny"]
+    trainer = Trainer(
+        Gemma(cfg),
+        TrainerConfig(
+            batch_size=8, seq_len=33, total_steps=3, lr=1e-3,
+            loss_chunk_size=16,
+        ),
+        MeshConfig(data=2, fsdp=4),
+    )
+    trainer.init_state()
+    hist = trainer.run(
+        synthetic_batches(8, 33, cfg.vocab_size),
+        model_flops_per_token=cfg.flops_per_token(32),
+    )
+    assert len(hist) == 3
+    assert np.isfinite(hist[-1].loss)
+
+
+def test_chunked_ce_matches_full_logits():
+    """The chunked path (which must re-apply the final cap itself) agrees
+    with the model's own capped full-logits loss."""
+    from tpufw.train import batch_loss
+
+    cfg = dataclasses.replace(
+        GEMMA_CONFIGS["gemma2_tiny"],
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    model = Gemma(cfg)
+    tokens = jax.random.randint(
+        jax.random.key(4), (2, 33), 0, cfg.vocab_size
+    )
+    from flax.core import meta
+
+    params = meta.unbox(model.init(jax.random.key(5), tokens))["params"]
+    batch = {"tokens": tokens}
+    full, _ = batch_loss(model.apply, params, batch)
+    chunked, _ = batch_loss(
+        model.apply, params, batch,
+        loss_chunk_size=16, loss_chunk_dtype="float32",
+        final_logit_soft_cap=cfg.final_logit_soft_cap,
+    )
+    np.testing.assert_allclose(
+        float(chunked), float(full), rtol=1e-6
+    )
+
+
+def test_generate_decodes():
+    """KV-cache decode through the window-aware cached attention."""
+    from tpufw.infer import SamplingConfig, generate
+
+    cfg = GEMMA_CONFIGS["gemma2_tiny"]
+    dcfg = cfg.decode_config()
+    model = Gemma(dcfg)
+    prompts = jax.random.randint(
+        jax.random.key(6), (2, 12), 0, cfg.vocab_size
+    )
+    pads = jnp.zeros((2,), jnp.int32)
+    params = jax.jit(Gemma(cfg).init)(jax.random.key(7), prompts)["params"]
+    toks = generate(
+        model, params, prompts, pads, jax.random.key(8),
+        max_new_tokens=8, sampling=SamplingConfig(temperature=0.0),
+    )
+    assert toks.shape == (2, 8)
+    assert ((toks >= 0) & (toks < cfg.vocab_size)).all()
+
+
+# ----------------------------------------------------------------------
+# HF parity
+# ----------------------------------------------------------------------
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def hf_gemma():
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        query_pre_attn_scalar=16,
+        sliding_window=32,
+        hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+        attention_bias=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.Gemma2ForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_hf_config_mapping(hf_gemma):
+    from tpufw.tools.import_hf import config_from_hf
+
+    cfg = config_from_hf(hf_gemma.config)
+    assert isinstance(cfg, GemmaConfig)
+    assert cfg.d_model == 64 and cfg.n_layers == 4
+    assert cfg.attn_logit_soft_cap == 50.0
+    assert cfg.final_logit_soft_cap == 30.0
+    assert cfg.sliding_window == 32
+    assert cfg.query_pre_attn_scalar == 16.0
+    assert cfg.tie_embeddings
+
+
+@pytest.mark.parametrize("scan_layers", [True, False])
+def test_hf_logits_parity(hf_gemma, scan_layers):
+    """Random-weight Gemma2ForCausalLM vs tpufw Gemma, same tokens.
+    Long enough (48 > window 32) that the sliding-window layers actually
+    mask something."""
+    from tpufw.tools.import_hf import config_from_hf, from_hf
+
+    cfg = dataclasses.replace(
+        config_from_hf(hf_gemma.config),
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        scan_layers=scan_layers,
+        remat=False,
+    )
+    params = from_hf(hf_gemma, cfg)
+
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 48), dtype=np.int64)
+
+    with torch.no_grad():
+        want = hf_gemma(torch.from_numpy(tokens)).logits.numpy()
+
+    got = Gemma(cfg).apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), want, atol=2e-4, rtol=2e-3
+    )
+
+
+def test_odd_pair_count_forward():
+    """26- and 42-layer presets have ODD pair counts; the pair-halving
+    must not re-trigger layer-count validation (regression: both real
+    presets crashed on every forward)."""
+    cfg = dataclasses.replace(
+        GEMMA_CONFIGS["gemma2_tiny"], n_layers=6
+    )  # 3 pairs
+    from flax.core import meta
+
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    shapes = meta.unbox(
+        jax.eval_shape(Gemma(cfg).init, jax.random.key(0), tokens)
+    )
+    assert shapes["params"]["layers"]["local"]["attn"]["q"][
+        "kernel"
+    ].shape[0] == 3
+
+
+def test_real_preset_shapes():
+    """The 2b preset (26 layers) builds and matches its analytic count."""
+    cfg = GEMMA_CONFIGS["gemma2_2b"]
+    params = jax.eval_shape(
+        Gemma(cfg).init, jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    assert n == cfg.n_params()
+    assert 2.5e9 < n < 2.7e9  # the "2b" is ~2.6B with the 256k vocab

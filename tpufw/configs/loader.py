@@ -97,7 +97,7 @@ def _build_dataclass(cls, section: str, given: dict):
 
 def _resolve_preset(preset: str):
     from tpufw.configs.presets import BENCH_CONFIG_NAME, bench_model_config
-    from tpufw.models import LLAMA_CONFIGS, MIXTRAL_CONFIGS
+    from tpufw.models import GEMMA_CONFIGS, LLAMA_CONFIGS, MIXTRAL_CONFIGS
     from tpufw.models.resnet import ResNetConfig
 
     if preset == BENCH_CONFIG_NAME:
@@ -106,12 +106,15 @@ def _resolve_preset(preset: str):
         return LLAMA_CONFIGS[preset]
     if preset in MIXTRAL_CONFIGS:
         return MIXTRAL_CONFIGS[preset]
+    if preset in GEMMA_CONFIGS:
+        return GEMMA_CONFIGS[preset]
     if preset == "resnet50":
         return ResNetConfig()
     raise ValueError(
         f"unknown model preset {preset!r}; choose from "
         f"[{BENCH_CONFIG_NAME!r}, 'resnet50', "
-        f"*{list(LLAMA_CONFIGS)}, *{list(MIXTRAL_CONFIGS)}]"
+        f"*{list(LLAMA_CONFIGS)}, *{list(MIXTRAL_CONFIGS)}, "
+        f"*{list(GEMMA_CONFIGS)}]"
     )
 
 

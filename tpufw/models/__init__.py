@@ -1,3 +1,8 @@
+from tpufw.models.gemma import (  # noqa: F401
+    GEMMA_CONFIGS,
+    Gemma,
+    GemmaConfig,
+)
 from tpufw.models.llama import Llama, LlamaConfig, LLAMA_CONFIGS  # noqa: F401
 from tpufw.models.mixtral import (  # noqa: F401
     MIXTRAL_CONFIGS,

@@ -1,0 +1,225 @@
+"""Gemma-2 family on the shared decoder machinery (tpufw.models.llama).
+
+The reference has no ML layer at all (its workload is ``nvidia-smi``,
+reference README.md:314); Gemma-2 extends the additive model zoo beyond
+the BASELINE-mandated Llama/Mixtral/ResNet using the same trunk
+(``decoder_lm``), attention entry point, and logical-axis sharding —
+only the block differs. Gemma-2 specifics, all HF-parity-pinned
+(tests/test_gemma.py):
+
+- sandwich norms: pre AND post RMSNorm around both attention and MLP,
+  all in the (1 + w) offset parameterization (zeros-init weights);
+- GeGLU MLP (tanh-approximate gelu gate, cfg.mlp_activation);
+- sqrt(d_model) embedding scaling (cfg.embed_scale);
+- attention logit soft-cap (50.0) and final logit soft-cap (30.0) —
+  both run inside the flash kernel / chunked-CE paths, not just xla;
+- alternating local/global attention: even layers use a sliding window
+  (cfg.sliding_window), odd layers attend globally. The layer stack
+  scans PAIRS (one local + one global block) so ``nn.scan`` still sees
+  a uniform unit; ``n_layers`` must be even;
+- query scaling by query_pre_attn_scalar**-0.5 instead of
+  head_dim**-0.5 (equal for 2b/9b, differs for 27b).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpufw.models.llama import (
+    MLP,
+    Attention,
+    RMSNorm,
+    decoder_lm,
+)
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class GemmaConfig:
+    vocab_size: int = 256_000
+    d_model: int = 2304
+    n_layers: int = 26
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    head_dim: int = 256
+    d_ff: int = 9216
+    rope_theta: float = 10_000.0
+    rms_eps: float = 1e-6
+    max_seq_len: int = 8192
+    tie_embeddings: bool = True
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    attention_backend: str = "xla"
+    remat: bool = True
+    remat_policy: str = "dots"
+    scan_layers: bool = True
+    decode: bool = False
+    # Gemma-2 specifics (read by the shared trunk/blocks via getattr).
+    attn_logit_soft_cap: Optional[float] = 50.0
+    final_logit_soft_cap: Optional[float] = 30.0
+    sliding_window: Optional[int] = 4096
+    query_pre_attn_scalar: Optional[float] = 256.0
+    mlp_activation: str = "gelu_tanh"
+    embed_scale: bool = True
+    rms_offset: bool = True
+
+    def decode_config(self) -> "GemmaConfig":
+        """Inference dress: KV cache on, remat off, xla attention."""
+        return dataclasses.replace(
+            self, decode=True, remat=False, attention_backend="xla"
+        )
+
+    def n_params(self, include_embed: bool = True) -> int:
+        d, l = self.d_model, self.n_layers
+        attn = l * (
+            d * self.n_heads * self.head_dim
+            + 2 * d * self.n_kv_heads * self.head_dim
+            + self.n_heads * self.head_dim * d
+        )
+        mlp = l * 3 * d * self.d_ff
+        norms = (4 * l + 1) * d  # sandwich: 4 norms per layer + final
+        total = attn + mlp + norms
+        if include_embed:
+            total += self.vocab_size * d  # head tied
+            if not self.tie_embeddings:
+                total += d * self.vocab_size
+        return total
+
+    def flops_per_token(self, seq_len: int) -> float:
+        d, l = self.d_model, self.n_layers
+        n_matmul = (
+            l
+            * (
+                d * self.n_heads * self.head_dim
+                + 2 * d * self.n_kv_heads * self.head_dim
+                + self.n_heads * self.head_dim * d
+                + 3 * d * self.d_ff
+            )
+            + d * self.vocab_size
+        )
+        # Attention score FLOPs: global layers see the full causal
+        # triangle (~seq/2 keys per query); local layers at most the
+        # window. Half the layers each.
+        global_keys = seq_len / 2
+        local_keys = min(
+            float(self.sliding_window or seq_len), seq_len / 2
+        )
+        attn_score = (
+            6.0
+            * self.n_heads
+            * self.head_dim
+            * (l / 2)
+            * 2.0  # QK^T and AV
+            * (global_keys + local_keys)
+        )
+        return 6.0 * n_matmul + attn_score
+
+
+class GemmaBlock(nn.Module):
+    """One Gemma-2 block: sandwich-normed attention + GeGLU MLP."""
+
+    cfg: GemmaConfig
+    window: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        norm = lambda name: RMSNorm(  # noqa: E731
+            cfg.rms_eps, offset=True, name=name
+        )
+        a = Attention(cfg, window=self.window, name="attn")(
+            norm("pre_attn_norm")(x), positions, segment_ids
+        )
+        x = x + norm("post_attn_norm")(a)
+        m = MLP(cfg, name="mlp")(norm("pre_mlp_norm")(x))
+        x = x + norm("post_mlp_norm")(m)
+        return nn.with_logical_constraint(
+            x, ("batch", "act_seq", "act_embed")
+        )
+
+
+class GemmaPair(nn.Module):
+    """The scanned unit: local (sliding-window) block then global block —
+    Gemma-2's alternation with layer 0 local, matching HF's layer_types
+    (even index -> sliding_window)."""
+
+    cfg: GemmaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        x = GemmaBlock(
+            cfg, window=cfg.sliding_window, name="local"
+        )(x, positions, segment_ids)
+        x = GemmaBlock(cfg, window=None, name="global")(
+            x, positions, segment_ids
+        )
+        return x
+
+
+class Gemma(nn.Module):
+    """Decoder-only Gemma-2 LM. Returns logits [B, T, vocab]."""
+
+    cfg: GemmaConfig
+
+    @nn.compact
+    def __call__(
+        self, tokens, positions=None, segment_ids=None, return_hidden=False
+    ):
+        # Validated here, not in the (frozen) config's __post_init__ —
+        # the pair-halving replace() below would re-trigger a post-init
+        # check and reject any pair count that is itself odd (26-layer
+        # 2b, 42-layer 9b).
+        if self.cfg.n_layers % 2:
+            raise ValueError(
+                f"Gemma-2 alternates local/global layers; n_layers must "
+                f"be even, got {self.cfg.n_layers}"
+            )
+        # The trunk scans pairs: halve n_layers for the scan length.
+        trunk_cfg = dataclasses.replace(
+            self.cfg, n_layers=self.cfg.n_layers // 2
+        )
+        out = decoder_lm(
+            trunk_cfg, GemmaPair, tokens, positions, segment_ids, False,
+            return_hidden=return_hidden,
+        )
+        cap = self.cfg.final_logit_soft_cap
+        if cap is not None and not return_hidden:
+            # Hidden-states callers (the chunked-vocab CE path) apply the
+            # cap per chunk in tpufw.ops.loss.chunked_cross_entropy.
+            from tpufw.ops.attention import tanh_soft_cap
+
+            out = tanh_soft_cap(out, cap)
+        return out
+
+
+GEMMA_CONFIGS: dict[str, GemmaConfig] = {
+    "gemma2_2b": GemmaConfig(),  # 2.6B: the HF google/gemma-2-2b shape
+    "gemma2_9b": GemmaConfig(
+        d_model=3584,
+        n_layers=42,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=14_336,
+    ),
+    "gemma2_tiny": GemmaConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=4,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        d_ff=128,
+        max_seq_len=128,
+        sliding_window=32,
+        query_pre_attn_scalar=16.0,
+        attn_logit_soft_cap=50.0,
+        final_logit_soft_cap=30.0,
+        remat=False,
+    ),
+}

@@ -21,6 +21,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Optional
 
 import jax
@@ -164,20 +165,31 @@ def apply_rope(
 
 class RMSNorm(nn.Module):
     eps: float = 1e-5
+    # Gemma parameterization: weight stored as an offset from 1 (zeros
+    # init, applied as 1 + w) — matches HF so checkpoints interchange.
+    offset: bool = False
 
     @nn.compact
     def __call__(self, x):
+        init = (
+            nn.initializers.zeros_init()
+            if self.offset
+            else nn.initializers.ones_init()
+        )
         w = self.param(
             "scale",
-            nn.with_logical_partitioning(nn.initializers.ones_init(), ("norm",)),
+            nn.with_logical_partitioning(init, ("norm",)),
             (x.shape[-1],),
             jnp.float32,
         )
-        return rms_norm(x, w, self.eps)
+        return rms_norm(x, w + 1.0 if self.offset else w, self.eps)
 
 
 class Attention(nn.Module):
     cfg: LlamaConfig
+    # Sliding-window size for this layer (None = global attention).
+    # Gemma-2 alternates local/global layers, so this is per-block.
+    window: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
@@ -208,6 +220,12 @@ class Attention(nn.Module):
         )(x)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
+        # Non-default query scaling (Gemma's query_pre_attn_scalar):
+        # backends scale by head_dim**-0.5 internally, so pre-multiply q
+        # by the ratio to the desired qpas**-0.5.
+        qpas = getattr(cfg, "query_pre_attn_scalar", None)
+        if qpas is not None and float(qpas) != float(cfg.head_dim):
+            q = q * (math.sqrt(cfg.head_dim) / math.sqrt(float(qpas)))
         q = nn.with_logical_constraint(
             q, ("batch", "act_seq", "act_heads", "head_dim")
         )
@@ -226,6 +244,8 @@ class Attention(nn.Module):
                 v,
                 causal=True,
                 segment_ids=segment_ids,
+                logits_soft_cap=getattr(cfg, "attn_logit_soft_cap", None),
+                sliding_window=self.window,
                 backend=cfg.attention_backend,
             )
         proj = nn.DenseGeneral(
@@ -287,6 +307,8 @@ class Attention(nn.Module):
             segment_ids=seg,
             kv_segment_ids=cseg.value,
             q_positions=slot_positions,
+            logits_soft_cap=getattr(cfg, "attn_logit_soft_cap", None),
+            sliding_window=self.window,
             backend="xla",
         )
 
@@ -311,7 +333,14 @@ class MLP(nn.Module):
         )
         gate = dense(cfg.d_ff, ("embed", "mlp"), "gate")(x)
         up = dense(cfg.d_ff, ("embed", "mlp"), "up")(x)
-        h = nn.silu(gate) * up
+        act_name = getattr(cfg, "mlp_activation", "silu")
+        if act_name == "silu":
+            act = nn.silu(gate)
+        elif act_name == "gelu_tanh":  # Gemma GeGLU
+            act = nn.gelu(gate, approximate=True)
+        else:
+            raise ValueError(f"unknown mlp_activation {act_name!r}")
+        h = act * up
         h = nn.with_logical_constraint(h, ("batch", "act_seq", "act_mlp"))
         return dense(cfg.d_model, ("mlp", "embed"), "down")(h)
 
@@ -347,17 +376,30 @@ def decoder_lm(
     """
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+    # Scaled-embedding models (Gemma) store embeddings ~1/sqrt(d) and
+    # multiply by sqrt(d) at lookup, keeping the TIED head's logits O(1);
+    # initializing at stddev 1.0 there would saturate the final soft-cap
+    # from step 0 (observed: init loss 29 vs ln(V)~5.5).
+    embed_std = (
+        cfg.d_model ** -0.5 if getattr(cfg, "embed_scale", False) else 1.0
+    )
     embed = nn.Embed(
         cfg.vocab_size,
         cfg.d_model,
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
         embedding_init=nn.with_logical_partitioning(
-            nn.initializers.normal(stddev=1.0), ("vocab", "embed")
+            nn.initializers.normal(stddev=embed_std), ("vocab", "embed")
         ),
         name="embed",
     )
     x = embed(tokens)
+    if getattr(cfg, "embed_scale", False):
+        # Gemma scales embeddings by sqrt(d_model), cast through the
+        # activation dtype exactly as HF does (bf16 rounding included).
+        x = x * jnp.asarray(
+            math.sqrt(cfg.d_model), cfg.dtype
+        ).astype(x.dtype)
     x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
 
     block_cls = block_base
@@ -400,7 +442,11 @@ def decoder_lm(
             else:
                 x = out
 
-    x = RMSNorm(cfg.rms_eps, name="final_norm")(x)
+    x = RMSNorm(
+        cfg.rms_eps,
+        offset=getattr(cfg, "rms_offset", False),
+        name="final_norm",
+    )(x)
     if return_hidden:
         return (x, aux) if with_aux else x
     if cfg.tie_embeddings:

@@ -25,6 +25,14 @@ import jax
 import jax.numpy as jnp
 
 
+def tanh_soft_cap(x: jax.Array, cap: float) -> jax.Array:
+    """Gemma-style logit soft-capping: cap * tanh(x / cap). The ONE
+    implementation — the xla backend, the Pallas flash kernels, the
+    chunked-CE loss, and the Gemma head all call this, so the numerics
+    cannot drift between them."""
+    return cap * jnp.tanh(x / cap)
+
+
 def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     """[B, S, K, D] -> [B, S, K*n_rep, D] by repeating each kv head."""
     if n_rep == 1:
@@ -44,6 +52,7 @@ def xla_attention(
     kv_segment_ids: Optional[jax.Array] = None,
     q_positions: Optional[jax.Array] = None,
     logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
 ) -> jax.Array:
     """Reference softmax attention. q:[B,T,H,D], k/v:[B,S,K,D] -> [B,T,H,D].
 
@@ -54,7 +63,8 @@ def xla_attention(
     queries' absolute positions in the S-long key axis for causal masking;
     default assumes queries are the final T positions. Softmax is computed
     in float32 regardless of input dtype — bf16 logits lose too much
-    precision at long T.
+    precision at long T. ``sliding_window`` masks keys more than that
+    many positions behind the query (local attention).
     """
     b, t, h, d = q.shape
     _, s, kh, _ = k.shape
@@ -73,17 +83,23 @@ def xla_attention(
         * scale
     )
     if logits_soft_cap is not None:
-        logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+        logits = tanh_soft_cap(logits, logits_soft_cap)
 
     mask = None
     kpos = jnp.arange(s)[None, None, None, :]  # [1,1,1,S]
-    if causal:
+    if causal or sliding_window is not None:
         if q_positions is None:
             # Align query i with absolute position s-t+i.
             qpos = (jnp.arange(t) + (s - t))[None, None, :, None]
         else:
             qpos = q_positions[:, None, :, None]  # [B,1,T,1]
-        mask = qpos >= kpos
+        if causal:
+            mask = qpos >= kpos
+        if sliding_window is not None:
+            # Local attention (Gemma-style): only the last
+            # ``sliding_window`` positions are visible.
+            near = (qpos - kpos) < sliding_window
+            mask = near if mask is None else (mask & near)
     if segment_ids is not None:
         kv_seg = kv_segment_ids if kv_segment_ids is not None else segment_ids
         seg_mask = (
@@ -107,6 +123,7 @@ def multi_head_attention(
     kv_segment_ids: Optional[jax.Array] = None,
     q_positions: Optional[jax.Array] = None,
     logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
     backend: str = "xla",
 ) -> jax.Array:
     """Backend dispatcher — the single attention entry point for all models."""
@@ -120,16 +137,19 @@ def multi_head_attention(
             kv_segment_ids=kv_segment_ids,
             q_positions=q_positions,
             logits_soft_cap=logits_soft_cap,
+            sliding_window=sliding_window,
         )
     if kv_segment_ids is not None or q_positions is not None:
         raise NotImplementedError(
             f"KV-cache decode (kv_segment_ids/q_positions) requires "
             f"backend='xla', got {backend!r}"
         )
-    if backend in ("ring", "ulysses") and logits_soft_cap is not None:
+    if backend in ("ring", "ulysses") and (
+        logits_soft_cap is not None or sliding_window is not None
+    ):
         raise NotImplementedError(
-            f"logits_soft_cap is not supported by backend={backend!r}; "
-            "use backend='xla' or 'flash'"
+            f"logits_soft_cap/sliding_window are not supported by "
+            f"backend={backend!r}; use backend='xla' or 'flash'"
         )
     if backend == "flash":
         from tpufw.ops.flash import flash_attention
@@ -137,6 +157,7 @@ def multi_head_attention(
         return flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids,
             logits_soft_cap=logits_soft_cap,
+            sliding_window=sliding_window,
         )
     if backend == "ring":
         from tpufw.parallel.ring import ring_attention

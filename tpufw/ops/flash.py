@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpufw.ops.attention import tanh_soft_cap
+
 NEG_INF = -1e30
 
 # Mosaic tiling: the last two dims of every block must be (divisible by 8,
@@ -85,8 +87,31 @@ def _causal_mask(i_block, j_block, bq, bkv, offset):
     return q_pos >= k_pos
 
 
+def _first_kv_block(i_block, bq, bkv, offset, window):
+    """First kv block a sliding-window query block can see (0 without a
+    window): the block holding position q_pos_min - window + 1. Blocks
+    before it are fully masked — skipping them is where local attention's
+    FLOP/bandwidth savings actually come from (the mask alone only zeroes
+    already-done work)."""
+    if window is None:
+        return 0
+    lo = i_block * bq + offset - window + 1
+    return jnp.maximum(jax.lax.div(lo, bkv), 0)
+
+
+def _window_mask(i_block, j_block, bq, bkv, offset, window):
+    """[bq, bkv] bool mask: key within ``window`` positions of the query
+    (sliding-window / local attention, Gemma-style)."""
+    q_pos = i_block * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, bkv), 0
+    ) + offset
+    k_pos = j_block * bkv + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+    return (q_pos - k_pos) < window
+
+
 def _fwd_kernel(
-    *refs, bq, bkv, s_actual, causal, offset, scale, has_seg, soft_cap
+    *refs, bq, bkv, s_actual, causal, offset, scale, has_seg, soft_cap,
+    window,
 ):
     if has_seg:
         q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref = refs
@@ -109,16 +134,16 @@ def _fwd_kernel(
             preferred_element_type=jnp.float32,
         )  # [bq, bkv]
         if soft_cap is not None:
-            # Gemma-style logit soft-capping on the SCALED logits (q is
-            # pre-scaled), matching tpufw.ops.attention.xla_attention.
             # Applied before masking: cap(NEG_INF) would squash the mask.
-            logits = soft_cap * jnp.tanh(logits / soft_cap)
+            logits = tanh_soft_cap(logits, soft_cap)
         k_pos = j * bkv + jax.lax.broadcasted_iota(
             jnp.int32, (bq, bkv), 1
         )
         mask = k_pos < s_actual
         if causal:
             mask = mask & _causal_mask(i, j, bq, bkv, offset)
+        if window is not None:
+            mask = mask & _window_mask(i, j, bq, bkv, offset, window)
         if has_seg:
             kseg = kseg_ref[0, :1, pl.ds(j * bkv, bkv)]  # [1, bkv]
             mask = mask & _seg_mask(qseg, kseg)
@@ -145,14 +170,16 @@ def _fwd_kernel(
         n_iter = jnp.minimum(n_needed, n_kv)
     else:
         n_iter = n_kv
-    m, l, acc = jax.lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
+    j0 = _first_kv_block(i, bq, bkv, offset, window)
+    m, l, acc = jax.lax.fori_loop(j0, n_iter, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[0, 0, 0] = (m + jnp.log(l_safe))[:, 0]
 
 
 def _dq_kernel(
-    *refs, bq, bkv, s_actual, causal, offset, scale, has_seg, soft_cap
+    *refs, bq, bkv, s_actual, causal, offset, scale, has_seg, soft_cap,
+    window,
 ):
     if has_seg:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -179,11 +206,13 @@ def _dq_kernel(
         mask = k_pos < s_actual
         if causal:
             mask = mask & _causal_mask(i, j, bq, bkv, offset)
+        if window is not None:
+            mask = mask & _window_mask(i, j, bq, bkv, offset, window)
         if has_seg:
             kseg = kseg_ref[0, :1, pl.ds(j * bkv, bkv)]
             mask = mask & _seg_mask(qseg, kseg)
         if soft_cap is not None:
-            capped = soft_cap * jnp.tanh(logits / soft_cap)
+            capped = tanh_soft_cap(logits, soft_cap)
         else:
             capped = logits
         p = jnp.where(mask, jnp.exp(capped - lse), 0.0)
@@ -203,14 +232,16 @@ def _dq_kernel(
         n_iter = jnp.minimum(n_needed, n_kv)
     else:
         n_iter = n_kv
+    j0 = _first_kv_block(i, bq, bkv, offset, window)
     dq = jax.lax.fori_loop(
-        0, n_iter, body, jnp.zeros((bq, d), jnp.float32)
+        j0, n_iter, body, jnp.zeros((bq, d), jnp.float32)
     )
     dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
-    *refs, bq, bkv, t_actual, causal, offset, scale, has_seg, soft_cap
+    *refs, bq, bkv, t_actual, causal, offset, scale, has_seg, soft_cap,
+    window,
 ):
     if has_seg:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -239,11 +270,13 @@ def _dkv_kernel(
         mask = q_pos < t_actual
         if causal:
             mask = mask & _causal_mask(i, j, bq, bkv, offset)
+        if window is not None:
+            mask = mask & _window_mask(i, j, bq, bkv, offset, window)
         if has_seg:
             qseg = qseg_ref[0, pl.ds(i * bq, bq), :]  # [bq, LANES]
             mask = mask & _seg_mask(qseg, kseg)
         if soft_cap is not None:
-            capped = soft_cap * jnp.tanh(logits / soft_cap)
+            capped = tanh_soft_cap(logits, soft_cap)
         else:
             capped = logits
         p = jnp.where(mask, jnp.exp(capped - lse), 0.0)
@@ -270,10 +303,18 @@ def _dkv_kernel(
         i0 = jnp.maximum(first, 0)
     else:
         i0 = 0
+    if window is not None:
+        # q blocks entirely beyond the window never see this kv block:
+        # the largest visible q_pos is (j+1)*bkv - 1 + window - 1.
+        last_q = j * bkv + bkv - 1 + window - 1 - offset
+        i_hi = jnp.minimum(jax.lax.div(last_q, bq) + 1, n_q)
+        i_hi = jnp.maximum(i_hi, i0)  # never negative-length loops
+    else:
+        i_hi = n_q
     d = k_ref.shape[-1]
     dk0 = jnp.zeros((bkv, d), jnp.float32)
     dv0 = jnp.zeros((bkv, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(i0, n_q, body, (dk0, dv0))
+    dk, dv = jax.lax.fori_loop(i0, i_hi, body, (dk0, dv0))
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
@@ -302,16 +343,18 @@ def _block_sizes(t_pad, s_pad):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
 )
-def _flash(q, k, v, qseg, kseg, causal, interpret, soft_cap):
+def _flash(q, k, v, qseg, kseg, causal, interpret, soft_cap, window):
     out, _ = _flash_fwd_impl(
-        q, k, v, qseg, kseg, causal, interpret, soft_cap
+        q, k, v, qseg, kseg, causal, interpret, soft_cap, window
     )
     return out
 
 
-def _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret, soft_cap):
+def _flash_fwd_impl(
+    q, k, v, qseg, kseg, causal, interpret, soft_cap, window=None
+):
     b, t, h, d = q.shape
     _, s, kh, _ = k.shape
     rep = h // kh
@@ -338,6 +381,7 @@ def _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret, soft_cap):
         scale=scale,
         has_seg=has_seg,
         soft_cap=soft_cap,
+        window=window,
     )
     in_specs = [
         pl.BlockSpec(
@@ -386,7 +430,7 @@ def _flash_fwd_impl(q, k, v, qseg, kseg, causal, interpret, soft_cap):
     return out_bthd, (q, k, v, qseg, kseg, out_bthd, lse)
 
 
-def _flash_bwd_impl(causal, interpret, soft_cap, res, g):
+def _flash_bwd_impl(causal, interpret, soft_cap, window, res, g):
     q, k, v, qseg, kseg, out, lse = res
     b, t, h, d = q.shape
     _, s, kh, _ = k.shape
@@ -453,6 +497,7 @@ def _flash_bwd_impl(causal, interpret, soft_cap, res, g):
             scale=scale,
             has_seg=has_seg,
             soft_cap=soft_cap,
+            window=window,
         ),
         grid=(b, h, t_p // bq),
         in_specs=dq_in_specs,
@@ -498,6 +543,7 @@ def _flash_bwd_impl(causal, interpret, soft_cap, res, g):
             scale=scale,
             has_seg=has_seg,
             soft_cap=soft_cap,
+            window=window,
         ),
         grid=(b, h, s_p // bkv),
         in_specs=dkv_in_specs,
@@ -520,9 +566,11 @@ def _flash_bwd_impl(causal, interpret, soft_cap, res, g):
     return dq, dk, dv, None, None
 
 
-def _flash_fwd_rule(q, k, v, qseg, kseg, causal, interpret, soft_cap):
+def _flash_fwd_rule(
+    q, k, v, qseg, kseg, causal, interpret, soft_cap, window
+):
     out, res = _flash_fwd_impl(
-        q, k, v, qseg, kseg, causal, interpret, soft_cap
+        q, k, v, qseg, kseg, causal, interpret, soft_cap, window
     )
     return out, res
 
@@ -539,6 +587,7 @@ def flash_attention(
     segment_ids=None,
     kv_segment_ids=None,
     logits_soft_cap: float | None = None,
+    sliding_window: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention. q:[B,T,H,D], k/v:[B,S,K,D] -> [B,T,H,D].
@@ -572,4 +621,5 @@ def flash_attention(
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
     cap = None if logits_soft_cap is None else float(logits_soft_cap)
-    return _flash(q, k, v, qseg, kseg, causal, interpret, cap)
+    win = None if sliding_window is None else int(sliding_window)
+    return _flash(q, k, v, qseg, kseg, causal, interpret, cap, win)

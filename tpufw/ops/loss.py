@@ -43,7 +43,9 @@ def token_cross_entropy(
     return ce
 
 
-def _chunk_stats(h, kernel, targets, z_loss_weight, compute_dtype):
+def _chunk_stats(
+    h, kernel, targets, z_loss_weight, compute_dtype, logits_soft_cap
+):
     """CE statistics for one sequence chunk. h: [B, C, D], kernel: [D, V],
     targets: [B, C] -> per-token ce [B, C] (z-loss included)."""
     logits = jnp.einsum(
@@ -52,6 +54,12 @@ def _chunk_stats(h, kernel, targets, z_loss_weight, compute_dtype):
         kernel.astype(compute_dtype),
         preferred_element_type=jnp.float32,
     )
+    if logits_soft_cap is not None:
+        from tpufw.ops.attention import tanh_soft_cap
+
+        # Gemma final-logit soft-cap: elementwise, so it distributes over
+        # chunks — parity with the model's full-logits forward.
+        logits = tanh_soft_cap(logits, logits_soft_cap)
     return token_cross_entropy(logits, targets, z_loss_weight)
 
 
@@ -63,6 +71,7 @@ def chunked_cross_entropy(
     z_loss_weight: float = 1e-4,
     chunk_size: int = 256,
     compute_dtype=jnp.bfloat16,
+    logits_soft_cap: Optional[float] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Token CE from pre-head hidden states, chunked over the sequence axis.
 
@@ -102,7 +111,10 @@ def chunked_cross_entropy(
     @jax.checkpoint
     def body(carry, xs):
         h_c, t_c, m_c = xs
-        ce = _chunk_stats(h_c, kernel, t_c, z_loss_weight, compute_dtype)
+        ce = _chunk_stats(
+            h_c, kernel, t_c, z_loss_weight, compute_dtype,
+            logits_soft_cap,
+        )
         ce_sum, n_sum = carry
         return (ce_sum + (ce * m_c).sum(), n_sum + m_c.sum()), None
 

@@ -55,7 +55,7 @@ def _chunk_fwd(case, q, k, v, qseg, kseg, interpret):
     def run(causal):
         def f(q, k, v, qseg, kseg):
             out, res = F._flash_fwd_impl(q, k, v, qseg, kseg, causal,
-                                         interpret, None)
+                                         interpret, None, None)
             lse = res[-1][:, :, 0, :l]  # un-pad [B,H,1,Tp] -> [B,H,L]
             return out.astype(jnp.float32), lse
 
@@ -79,7 +79,7 @@ def _chunk_bwd(case, q, k, v, qseg, kseg, out, lse_pad, g, interpret):
     def run(causal):
         def f(q, k, v, qseg, kseg, out, lse_pad, g):
             dq, dk, dv, _, _ = F._flash_bwd_impl(
-                causal, interpret, None,
+                causal, interpret, None, None,
                 (q, k, v, qseg, kseg, out, lse_pad), g,
             )
             return (

@@ -46,6 +46,8 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         if isinstance(hf_config, Mapping)
         else lambda k, d=None: getattr(hf_config, k, d)
     )
+    if get("model_type") == "gemma2":
+        return _gemma_config_from_hf(get)
     # Reject, loudly, configs whose architecture tpufw doesn't implement —
     # importing them would produce silently wrong logits (e.g. Llama-3.1
     # checkpoints need rope_scaling, which apply_rope doesn't apply).
@@ -96,6 +98,134 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
     return LlamaConfig(**common)
 
 
+def _gemma_config_from_hf(get) -> "GemmaConfig":
+    """tpufw GemmaConfig from a transformers Gemma2Config.
+
+    Rejects non-Gemma-2 feature combos loudly (same policy as the
+    Llama path): tpufw implements exactly HF Gemma2's architecture —
+    gelu_pytorch_tanh GeGLU, sandwich norms, alternating sliding
+    window on even layers, logit soft-caps, tied embeddings.
+    """
+    from tpufw.models.gemma import GemmaConfig
+
+    act = get("hidden_activation") or get("hidden_act")
+    if act not in (None, "gelu_pytorch_tanh"):
+        raise NotImplementedError(
+            f"Gemma2 import supports gelu_pytorch_tanh only, got {act!r}"
+        )
+    if not (get("tie_word_embeddings") is None or
+            bool(get("tie_word_embeddings"))):
+        raise NotImplementedError(
+            "Gemma2 import assumes tied embeddings (all released "
+            "Gemma-2 checkpoints tie them)"
+        )
+    d_model = get("hidden_size")
+    n_heads = get("num_attention_heads")
+    return GemmaConfig(
+        vocab_size=get("vocab_size"),
+        d_model=d_model,
+        n_layers=get("num_hidden_layers"),
+        n_heads=n_heads,
+        n_kv_heads=get("num_key_value_heads") or n_heads,
+        head_dim=get("head_dim") or d_model // n_heads,
+        d_ff=get("intermediate_size"),
+        rope_theta=float(get("rope_theta") or 10_000.0),
+        rms_eps=float(get("rms_norm_eps") or 1e-6),
+        max_seq_len=get("max_position_embeddings") or 8192,
+        tie_embeddings=True,
+        attn_logit_soft_cap=get("attn_logit_softcapping"),
+        final_logit_soft_cap=get("final_logit_softcapping"),
+        sliding_window=get("sliding_window"),
+        query_pre_attn_scalar=float(
+            get("query_pre_attn_scalar") or
+            (get("head_dim") or d_model // n_heads)
+        ),
+    )
+
+
+def _gemma_from_hf(sd, cfg, dt) -> dict:
+    """HF Gemma2 state dict -> tpufw Gemma param tree (pairs layout).
+
+    HF layer 2p (sliding) -> pair p "local"; layer 2p+1 -> "global".
+    Norm weights copy directly: both sides store the offset-from-1.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    d, h, kh, dh = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def take(key: str, target=None):
+        if key not in sd:
+            raise KeyError(
+                f"HF checkpoint is missing {key!r}; not a Gemma-2 "
+                "state dict?"
+            )
+        return jnp.asarray(_to_np(sd[key]), target or dt)
+
+    def block(i: int) -> dict:
+        pre = f"layers.{i}."
+        return {
+            "pre_attn_norm": {
+                "scale": take(pre + "input_layernorm.weight", jnp.float32)
+            },
+            "post_attn_norm": {
+                "scale": take(
+                    pre + "post_attention_layernorm.weight", jnp.float32
+                )
+            },
+            "pre_mlp_norm": {
+                "scale": take(
+                    pre + "pre_feedforward_layernorm.weight", jnp.float32
+                )
+            },
+            "post_mlp_norm": {
+                "scale": take(
+                    pre + "post_feedforward_layernorm.weight", jnp.float32
+                )
+            },
+            "attn": {
+                "q": {
+                    "kernel": take(pre + "self_attn.q_proj.weight")
+                    .T.reshape(d, h, dh)
+                },
+                "k": {
+                    "kernel": take(pre + "self_attn.k_proj.weight")
+                    .T.reshape(d, kh, dh)
+                },
+                "v": {
+                    "kernel": take(pre + "self_attn.v_proj.weight")
+                    .T.reshape(d, kh, dh)
+                },
+                "o": {
+                    "kernel": take(pre + "self_attn.o_proj.weight")
+                    .T.reshape(h, dh, d)
+                },
+            },
+            "mlp": {
+                "gate": {"kernel": take(pre + "mlp.gate_proj.weight").T},
+                "up": {"kernel": take(pre + "mlp.up_proj.weight").T},
+                "down": {"kernel": take(pre + "mlp.down_proj.weight").T},
+            },
+        }
+
+    pairs = [
+        {"local": block(2 * p), "global": block(2 * p + 1)}
+        for p in range(cfg.n_layers // 2)
+    ]
+    params: dict = {
+        "embed": {"embedding": take("embed_tokens.weight")},
+        "final_norm": {"scale": take("norm.weight", jnp.float32)},
+    }
+    if cfg.scan_layers:
+        params["layers"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs, axis=0), *pairs
+        )
+    else:
+        for i, lp in enumerate(pairs):
+            params[f"layer_{i}"] = lp
+    return params
+
+
 def _load_state_dict(path: str | os.PathLike) -> dict[str, np.ndarray]:
     """Read every ``*.safetensors`` shard in a checkpoint directory."""
     from safetensors import safe_open
@@ -128,6 +258,7 @@ def from_hf(
     """
     import jax.numpy as jnp
 
+    from tpufw.models.gemma import GemmaConfig
     from tpufw.models.mixtral import MixtralConfig
 
     is_moe = isinstance(cfg, MixtralConfig)
@@ -141,6 +272,8 @@ def from_hf(
     sd = {k.removeprefix("model."): v for k, v in sd.items()}
 
     dt = jnp.dtype(dtype if dtype is not None else cfg.param_dtype)
+    if isinstance(cfg, GemmaConfig):
+        return _gemma_from_hf(sd, cfg, dt)
     d, h, kh, dh = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     def take(key: str, target=None):
@@ -280,8 +413,14 @@ def to_hf(params: dict, cfg: LlamaConfig) -> dict[str, np.ndarray]:
     """Inverse of ``from_hf``: tpufw param tree -> HF-keyed state dict
     (numpy fp32, HF [out, in] Linear layout, ``model.``-prefixed keys).
     Accepts both scan-stacked and per-layer trees."""
+    from tpufw.models.gemma import GemmaConfig
     from tpufw.models.mixtral import MixtralConfig
 
+    if isinstance(cfg, GemmaConfig):
+        raise NotImplementedError(
+            "to_hf/export_hf cover Llama/Mixtral; Gemma export is not "
+            "implemented (import IS: from_hf/config_from_hf)"
+        )
     is_moe = isinstance(cfg, MixtralConfig)
     d = cfg.d_model
 

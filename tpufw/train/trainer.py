@@ -132,6 +132,7 @@ def batch_loss(
     batch: dict,
     loss_chunk_size: Optional[int] = None,
     loss_chunk_dtype: str = "bfloat16",
+    final_logit_soft_cap: Optional[float] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """LM objective for one batch: (loss, n_target_tokens).
 
@@ -160,6 +161,9 @@ def batch_loss(
             out, head_kernel(params), targets, mask,
             chunk_size=loss_chunk_size,
             compute_dtype=jnp.dtype(loss_chunk_dtype),
+            # Gemma-style cap; the model skipped its head (and cap) via
+            # return_hidden, so the chunked path applies it per chunk.
+            logits_soft_cap=final_logit_soft_cap,
         )
     else:
         loss, n = cross_entropy_loss(out, targets, mask)
@@ -172,6 +176,7 @@ def train_step(
     loss_chunk_size: Optional[int] = None,
     loss_chunk_dtype: str = "bfloat16",
     grad_accum: int = 1,
+    final_logit_soft_cap: Optional[float] = None,
 ) -> tuple[TrainState, dict]:
     """One optimizer update (objective: ``batch_loss``).
 
@@ -186,7 +191,8 @@ def train_step(
     def loss_and_n(params, mb):
         def lf(p):
             return batch_loss(
-                state.apply_fn, p, mb, loss_chunk_size, loss_chunk_dtype
+                state.apply_fn, p, mb, loss_chunk_size, loss_chunk_dtype,
+                final_logit_soft_cap,
             )
 
         (loss, n), grads = jax.value_and_grad(lf, has_aux=True)(params)
@@ -244,11 +250,12 @@ def eval_step(
     batch: dict,
     loss_chunk_size: Optional[int] = None,
     loss_chunk_dtype: str = "bfloat16",
+    final_logit_soft_cap: Optional[float] = None,
 ) -> dict:
     """Forward-only objective on one held-out batch: {loss, n_tokens}."""
     loss, n = batch_loss(
         state.apply_fn, state.params, batch, loss_chunk_size,
-        loss_chunk_dtype,
+        loss_chunk_dtype, final_logit_soft_cap,
     )
     return {"loss": loss, "n_tokens": n}
 
@@ -486,6 +493,12 @@ class Trainer:
         finally:
             mgr.close()
 
+    def _final_soft_cap(self) -> Optional[float]:
+        """The model's final-logit soft-cap (Gemma), applied inside the
+        chunked-CE path since return_hidden skips the model's own cap."""
+        cfg = getattr(self.model, "cfg", None)
+        return getattr(cfg, "final_logit_soft_cap", None)
+
     def globalize_batch(self, batch: dict) -> dict:
         return globalize_batch(self.mesh, batch)
 
@@ -521,6 +534,7 @@ class Trainer:
                     loss_chunk_size=self.cfg.loss_chunk_size,
                     loss_chunk_dtype=self.cfg.loss_chunk_dtype,
                     grad_accum=self.cfg.grad_accum,
+                    final_logit_soft_cap=self._final_soft_cap(),
                 ),
                 in_shardings=(self.state_sharding, batch_sharding),
                 out_shardings=(self.state_sharding, None),
@@ -539,6 +553,7 @@ class Trainer:
                     eval_step,
                     loss_chunk_size=self.cfg.loss_chunk_size,
                     loss_chunk_dtype=self.cfg.loss_chunk_dtype,
+                    final_logit_soft_cap=self._final_soft_cap(),
                 ),
                 in_shardings=(self.state_sharding, batch_sharding),
                 out_shardings=None,

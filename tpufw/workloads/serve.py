@@ -42,7 +42,14 @@ def build_generator():
 
     from tpufw.configs import bench_model_config
     from tpufw.mesh import MeshConfig
-    from tpufw.models import LLAMA_CONFIGS, Llama, MIXTRAL_CONFIGS, Mixtral
+    from tpufw.models import (
+        GEMMA_CONFIGS,
+        Gemma,
+        LLAMA_CONFIGS,
+        Llama,
+        MIXTRAL_CONFIGS,
+        Mixtral,
+    )
     from tpufw.train import Trainer, TrainerConfig
 
     hf_dir = env_str("hf_checkpoint", "")
@@ -57,6 +64,7 @@ def build_generator():
         # copy); for models larger than one chip, convert once via
         # `python -m tpufw.tools.import_hf` and use the Orbax path,
         # which restores sharded over the mesh.
+        from tpufw.models.gemma import GemmaConfig
         from tpufw.models.mixtral import MixtralConfig
         from tpufw.tools.import_hf import config_from_hf, from_hf
 
@@ -67,7 +75,12 @@ def build_generator():
             max_seq_len=env_int("max_seq_len", hf_cfg.max_seq_len),
         )
         params = from_hf(hf_dir, hf_cfg, dtype=hf_cfg.dtype)
-        cls = Mixtral if isinstance(hf_cfg, MixtralConfig) else Llama
+        if isinstance(hf_cfg, MixtralConfig):
+            cls = Mixtral
+        elif isinstance(hf_cfg, GemmaConfig):
+            cls = Gemma
+        else:
+            cls = Llama
         return cls(hf_cfg.decode_config()), params, hf_cfg, True
 
     name = env_str("model", "llama3_600m_bench")
@@ -78,10 +91,12 @@ def build_generator():
         model_cfg, model_cls = LLAMA_CONFIGS[name], Llama
     elif name in MIXTRAL_CONFIGS:
         model_cfg, model_cls = MIXTRAL_CONFIGS[name], Mixtral
+    elif name in GEMMA_CONFIGS:
+        model_cfg, model_cls = GEMMA_CONFIGS[name], Gemma
     else:
         raise ValueError(
             f"unknown TPUFW_MODEL={name!r}; choose from "
-            f"{['llama3_600m_bench', *LLAMA_CONFIGS, *MIXTRAL_CONFIGS]}"
+            f"{['llama3_600m_bench', *LLAMA_CONFIGS, *MIXTRAL_CONFIGS, *GEMMA_CONFIGS]}"
         )
     # Serving wants the full sequence budget but no training-only features.
     model_cfg = dataclasses.replace(

@@ -34,7 +34,14 @@ def build_trainer():
 
     from tpufw.configs import bench_model_config
     from tpufw.mesh import MeshConfig
-    from tpufw.models import LLAMA_CONFIGS, Llama, MIXTRAL_CONFIGS, Mixtral
+    from tpufw.models import (
+        GEMMA_CONFIGS,
+        Gemma,
+        LLAMA_CONFIGS,
+        Llama,
+        MIXTRAL_CONFIGS,
+        Mixtral,
+    )
     from tpufw.train import Trainer, TrainerConfig
 
     run = None
@@ -52,11 +59,17 @@ def build_trainer():
     base_m = run.mesh if run else MeshConfig()
 
     name = env_str("model", run.model_preset if run else "llama3_600m_bench")
+    def model_for(model_cfg):
+        tname = type(model_cfg).__name__
+        if "Mixtral" in tname:
+            return Mixtral(model_cfg)
+        if "Gemma" in tname:
+            return Gemma(model_cfg)
+        return None  # Llama built after the backend override below
+
     if run and name == run.model_preset:
         model_cfg = run.model_cfg  # keeps the YAML's model.overrides
-        model = Mixtral(model_cfg) if "Mixtral" in type(
-            model_cfg
-        ).__name__ else None
+        model = model_for(model_cfg)
     elif name == "llama3_600m_bench":
         model_cfg, model = bench_model_config(), None
     elif name in LLAMA_CONFIGS:
@@ -64,10 +77,13 @@ def build_trainer():
     elif name in MIXTRAL_CONFIGS:
         model_cfg = MIXTRAL_CONFIGS[name]
         model = Mixtral(model_cfg)
+    elif name in GEMMA_CONFIGS:
+        model_cfg = GEMMA_CONFIGS[name]
+        model = Gemma(model_cfg)
     else:
         raise ValueError(
             f"unknown TPUFW_MODEL={name!r}; choose from "
-            f"{['llama3_600m_bench', *LLAMA_CONFIGS, *MIXTRAL_CONFIGS]}"
+            f"{['llama3_600m_bench', *LLAMA_CONFIGS, *MIXTRAL_CONFIGS, *GEMMA_CONFIGS]}"
         )
     backend = env_str("attention", "")
     if backend:
