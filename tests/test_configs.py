@@ -38,7 +38,7 @@ def test_yaml_of_record_loads(path):
     run = load_run_config(path)
     assert isinstance(run, RunConfig)
     assert run.hardware.n_chips >= 1
-    assert run.family in ("llama", "mixtral", "resnet")
+    assert run.family in ("llama", "mixtral", "gemma", "resnet")
     if run.family != "resnet":
         assert isinstance(run.trainer, TrainerConfig)
         assert isinstance(run.mesh, MeshConfig)
@@ -79,6 +79,7 @@ def _manifest_env(name: str) -> dict:
         ("05-llama3-8b-v5e16.yaml", "05-llama3-8b-v5e16-jobset.yaml"),
         ("06-mixtral-8x7b-v5p32.yaml", "06-mixtral-8x7b-v5p32-jobset.yaml"),
         ("08-llama3-8b-pipeline.yaml", "08-llama3-8b-pipeline-jobset.yaml"),
+        ("09-gemma2-2b-v5e4.yaml", "09-gemma2-2b-v5e4.yaml"),
     ],
 )
 def test_manifest_matches_yaml_of_record(cfg_name, manifest_name):

@@ -54,10 +54,11 @@ def test_all_baseline_configs_covered():
     # smoke-TPU enablement proof, the shared checkpoint PVC, and the
     # inference serving Job+Service (07, VERDICT r1 item 9).
     names = [p.name for p in MANIFESTS]
-    assert len(names) == 9
+    assert len(names) == 10
     kinds = [d["kind"] for p in MANIFESTS for d in load(p)]
     assert kinds.count("Pod") == 3
-    assert kinds.count("Job") == 2
+    # 04 llama v5e-4, 07 infer, 09 gemma2 v5e-4.
+    assert kinds.count("Job") == 3
     # 05 v5e-16, 06 mixtral ep, 08 pipeline-parallel.
     assert kinds.count("JobSet") == 3
     assert kinds.count("PersistentVolumeClaim") == 1
@@ -135,9 +136,12 @@ def test_jobset_env_satisfies_bootstrap_contract(path):
 
 
 def test_jobset_models_exist():
-    from tpufw.models import LLAMA_CONFIGS, MIXTRAL_CONFIGS
+    from tpufw.models import GEMMA_CONFIGS, LLAMA_CONFIGS, MIXTRAL_CONFIGS
 
-    known = set(LLAMA_CONFIGS) | set(MIXTRAL_CONFIGS) | {"llama3_600m_bench"}
+    known = (
+        set(LLAMA_CONFIGS) | set(MIXTRAL_CONFIGS) | set(GEMMA_CONFIGS)
+        | {"llama3_600m_bench"}
+    )
     for path in MANIFESTS:
         for doc in load(path):
             if doc["kind"] in ("PersistentVolumeClaim", "Service"):

@@ -113,6 +113,13 @@ def _gemma_config_from_hf(get) -> "GemmaConfig":
         raise NotImplementedError(
             f"Gemma2 import supports gelu_pytorch_tanh only, got {act!r}"
         )
+    if bool(get("attention_bias")):
+        # Same reject-loudly policy as the Llama path: the weight mapper
+        # reads only the keys it knows, so bias tensors would be DROPPED
+        # silently — wrong logits, not an error.
+        raise NotImplementedError(
+            "Gemma2 import does not implement attention_bias=True"
+        )
     if not (get("tie_word_embeddings") is None or
             bool(get("tie_word_embeddings"))):
         raise NotImplementedError(
