@@ -118,3 +118,40 @@ def test_mesh_stage_mismatch_is_loud():
             TrainerConfig(batch_size=16, seq_len=33),
             MeshConfig(pipe=4, fsdp=2),
         )
+
+
+def test_evaluate_token_weighted(devices8):
+    """Forward-only pipeline eval: token-weighted loss/ppl with the same
+    reporting surface as Trainer.evaluate."""
+    t = _trainer(total_steps=2)
+    t.init_state()
+    t.run(
+        synthetic_batches(16, 33, CFG.vocab_size),
+        model_flops_per_token=CFG.flops_per_token(32),
+    )
+    ev = t.evaluate(synthetic_batches(16, 33, CFG.vocab_size, seed=9), 3)
+    assert ev["eval_batches"] == 3
+    assert ev["eval_tokens"] == 3 * 16 * 32
+    assert np.isfinite(ev["eval_loss"])
+    assert ev["eval_ppl"] == pytest.approx(
+        np.exp(ev["eval_loss"]), rel=1e-6
+    )
+    # Eval must not touch training state (no donation of params).
+    ev2 = t.evaluate(synthetic_batches(16, 33, CFG.vocab_size, seed=9), 3)
+    assert ev2["eval_loss"] == pytest.approx(ev["eval_loss"], rel=1e-6)
+
+
+def test_eval_every_in_run(devices8):
+    """cfg.eval_every fires the in-loop eval hook (previously rejected as
+    unimplemented)."""
+    seen = []
+    t = _trainer(total_steps=4, eval_every=2, eval_batches=2)
+    t.init_state()
+    t.run(
+        synthetic_batches(16, 33, CFG.vocab_size),
+        model_flops_per_token=CFG.flops_per_token(32),
+        eval_data=lambda: synthetic_batches(16, 33, CFG.vocab_size, seed=9),
+        on_eval=seen.append,
+    )
+    assert [ev["step"] for ev in seen] == [2, 4]
+    assert all(np.isfinite(ev["eval_loss"]) for ev in seen)

@@ -332,6 +332,20 @@ def pipeline_loss(
     two training paths can't diverge on what they optimize. ``batch``
     is {tokens [+ segment_ids, loss_mask]} (a bare token array is
     wrapped for back-compat)."""
+    return pipeline_eval(params, batch, cfg, pipe, mesh)["loss"]
+
+
+def pipeline_eval(
+    params: dict,
+    batch: dict | jax.Array,
+    cfg: LlamaConfig,
+    pipe: PipelineConfig,
+    mesh: Mesh,
+) -> dict:
+    """Forward-only objective through the pipelined model:
+    {loss, n_tokens} — the held-out-eval analog of ``pipeline_loss``
+    (same shift/mask, no gradient), so PipelineTrainer.evaluate reports
+    numbers directly comparable to the flax Trainer's."""
     from tpufw.train.trainer import cross_entropy_loss, shift_and_mask
 
     if not isinstance(batch, dict):
@@ -340,8 +354,8 @@ def pipeline_loss(
     logits = pipeline_forward(
         params, inputs, cfg, pipe, mesh, segment_ids=seg_in
     )
-    loss, _ = cross_entropy_loss(logits, targets, mask)
-    return loss
+    loss, n = cross_entropy_loss(logits, targets, mask)
+    return {"loss": loss, "n_tokens": n}
 
 
 def pipeline_train_step(

@@ -11,9 +11,11 @@ globalize_batch are the shared machinery.
 
 Packed batches (segment_ids + loss_mask) train with the same masking as
 the flax trainer (shift_and_mask); segment ids ride the pipe ring with
-their microbatch. TrainerConfig features the schedule doesn't implement
-(grad_accum, chunked-vocab CE, profiling, in-loop eval) are rejected
-loudly in ``__init__`` rather than silently ignored.
+their microbatch. Held-out eval runs the forward-only pipeline
+(pipeline_eval) with the flax trainer's token-weighted loss/ppl
+surface. TrainerConfig features the schedule doesn't implement
+(grad_accum, chunked-vocab CE, profiling) are rejected loudly in
+``__init__`` rather than silently ignored.
 """
 
 from __future__ import annotations
@@ -32,6 +34,7 @@ from tpufw.models.llama import LlamaConfig
 from tpufw.parallel.pipeline import (
     PipelineConfig,
     init_pipeline_params,
+    pipeline_eval,
     pipeline_loss,
     pipeline_param_shardings,
 )
@@ -93,7 +96,6 @@ class PipelineTrainer:
             "grad_accum": trainer_cfg.grad_accum != 1,
             "loss_chunk_size": bool(trainer_cfg.loss_chunk_size),
             "profile_dir": bool(trainer_cfg.profile_dir),
-            "eval_every": bool(trainer_cfg.eval_every),
         }
         bad = [k for k, v in unsupported.items() if v]
         if bad:
@@ -114,6 +116,7 @@ class PipelineTrainer:
         )
         self.state: PipeTrainState | None = None
         self._step_fn = None
+        self._eval_fn = None
         self.preempted = False
 
     # -- state ---------------------------------------------------------
@@ -218,11 +221,51 @@ class PipelineTrainer:
             )
         return self._step_fn[key]
 
+    def _compiled_eval(self, batch: dict):
+        key = tuple(sorted(batch.keys()))
+        if self._eval_fn is None:
+            self._eval_fn = {}
+        if key not in self._eval_fn:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            row = NamedSharding(self.mesh, P(("data", "fsdp")))
+            batch_sh = {k: row for k in key}
+            self._eval_fn[key] = jax.jit(
+                partial(
+                    pipeline_eval,
+                    cfg=self.model_cfg,
+                    pipe=self.pipe,
+                    mesh=self.mesh,
+                ),
+                in_shardings=(self._shardings.params, batch_sh),
+                out_shardings=None,
+            )
+        return self._eval_fn[key]
+
+    def evaluate(
+        self, data: Iterator[dict], n_batches: Optional[int] = None
+    ) -> dict:
+        """Token-weighted held-out loss + perplexity through the
+        forward-only pipeline — same reporting surface as
+        Trainer.evaluate, so curves are directly comparable."""
+        if self.state is None:
+            raise RuntimeError("evaluate() before init_state()/restore")
+        from tpufw.train.trainer import globalize_batch, run_evaluation
+
+        return run_evaluation(
+            data,
+            n_batches,
+            lambda b: self._compiled_eval(b)(self.state.params, b),
+            lambda b: globalize_batch(self.mesh, b),
+        )
+
     def run(
         self,
         data: Iterator[dict],
         model_flops_per_token: float,
         on_metrics: Callable[[StepMetrics], None] | None = None,
+        eval_data: Callable[[], Iterator[dict]] | None = None,
+        on_eval: Callable[[dict], None] | None = None,
         shutdown: "GracefulShutdown | None" = None,
     ) -> list[StepMetrics]:
         if self.state is None:
@@ -268,6 +311,15 @@ class PipelineTrainer:
                 history.append(sm)
                 if on_metrics and (i % self.cfg.log_every == 0):
                     on_metrics(sm)
+                if (
+                    self.cfg.eval_every
+                    and eval_data is not None
+                    and int(self.state.step) % self.cfg.eval_every == 0
+                ):
+                    ev = self.evaluate(eval_data(), self.cfg.eval_batches)
+                    ev["step"] = int(self.state.step)
+                    if on_eval:
+                        on_eval(ev)
                 if ckpt is not None:
                     ckpt.save(int(self.state.step), self.state)
                 # Gang-consistent preemption stop (tpufw.train.preemption).

@@ -283,6 +283,41 @@ def globalize_batch(mesh: Mesh, batch: dict) -> dict:
     }
 
 
+def run_evaluation(
+    data, n_batches, eval_batch_fn, globalize
+) -> dict:
+    """The ONE token-weighted held-out eval loop: accumulate
+    {loss, n_tokens} outputs of ``eval_batch_fn(batch)`` over up to
+    ``n_batches`` batches and report {eval_loss, eval_ppl, eval_tokens,
+    eval_batches}. Shared by Trainer and PipelineTrainer so their eval
+    reporting surfaces cannot drift."""
+    total_loss = 0.0
+    total_n = 0.0
+    n_seen = 0
+    for i, batch in enumerate(data):
+        if n_batches is not None and i >= n_batches:
+            break
+        if not isinstance(batch, dict):
+            batch = {"tokens": batch}
+        batch = globalize(batch)
+        out = eval_batch_fn(batch)
+        n = float(out["n_tokens"])
+        total_loss += float(out["loss"]) * n
+        total_n += n
+        n_seen += 1
+    if n_seen == 0:
+        raise ValueError("evaluate(): empty eval iterator")
+    import math
+
+    loss = total_loss / max(total_n, 1.0)
+    return {
+        "eval_loss": loss,
+        "eval_ppl": math.exp(min(loss, 50.0)),
+        "eval_tokens": int(total_n),
+        "eval_batches": n_seen,
+    }
+
+
 def state_shardings(
     abstract_state: TrainState, mesh: Mesh, rules=None
 ) -> TrainState:
@@ -569,30 +604,13 @@ class Trainer:
         comparable to the train curve; ppl = exp(eval_loss)."""
         if self.state is None:
             raise RuntimeError("evaluate() before init_state()/restore")
-        total_loss = 0.0
-        total_n = 0.0
-        n_seen = 0
         with use_mesh(self.mesh):
-            for i, batch in enumerate(data):
-                if n_batches is not None and i >= n_batches:
-                    break
-                batch = self.globalize_batch(batch)
-                out = self.compiled_eval_step(batch)(self.state, batch)
-                n = float(out["n_tokens"])
-                total_loss += float(out["loss"]) * n
-                total_n += n
-                n_seen += 1
-        if n_seen == 0:
-            raise ValueError("evaluate(): empty eval iterator")
-        loss = total_loss / max(total_n, 1.0)
-        import math
-
-        return {
-            "eval_loss": loss,
-            "eval_ppl": math.exp(min(loss, 50.0)),
-            "eval_tokens": int(total_n),
-            "eval_batches": n_seen,
-        }
+            return run_evaluation(
+                data,
+                n_batches,
+                lambda b: self.compiled_eval_step(b)(self.state, b),
+                self.globalize_batch,
+            )
 
     def run(
         self,

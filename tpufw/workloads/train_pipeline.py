@@ -10,8 +10,10 @@ reference README.md:331-335), driven by TPUFW_* env:
   TPUFW_MODEL / TPUFW_BATCH_SIZE / TPUFW_SEQ_LEN / ... (as train_llama)
   TPUFW_MESH_DATA / TPUFW_MESH_FSDP  data-parallel axes alongside pipe
 
-Data: synthetic unsegmented batches (the pipeline blocks don't thread
-segment ids yet — PipelineTrainer rejects packed data loudly).
+Data: synthetic batches; TPUFW_EVAL_EVERY > 0 adds the in-loop
+held-out eval (forward-only pipeline, token-weighted loss/ppl JSON
+lines). Packed batches (segment_ids + loss_mask) are supported — the
+masks ride the pipe ring with their microbatch.
 """
 
 from __future__ import annotations
@@ -68,7 +70,9 @@ def build_trainer():
         grad_accum=env_int("grad_accum", 1),
         loss_chunk_size=env_int("loss_chunk_size", 0) or None,
         profile_dir=env_str("profile_dir", "") or None,
+        # In-loop held-out eval IS implemented here (pipeline_eval).
         eval_every=env_int("eval_every", 0),
+        eval_batches=env_int("eval_batches", 8),
         # Same SIGTERM-to-forced-checkpoint contract as train_llama.
         handle_preemption=env_bool("handle_preemption", True),
         preemption_sync_every=env_int("preemption_sync_every", 1),
@@ -118,6 +122,18 @@ def main() -> int:
 
     cfg = trainer.cfg
     local_bs = check_global_batch(cfg.batch_size, cluster.num_processes)
+    # Held-out eval stream (TPUFW_EVAL_EVERY > 0 enables) — same disjoint
+    # odd-seed space convention as train_llama.
+    eval_data = None
+    if cfg.eval_every:
+
+        def eval_data():
+            return synthetic_batches(
+                local_bs, cfg.seq_len, model_cfg.vocab_size,
+                seed=env_int("data_seed", 0) * 2000
+                + 2 * cluster.process_id + 1,
+            )
+
     history = trainer.run(
         synthetic_batches(
             local_bs,
@@ -127,6 +143,8 @@ def main() -> int:
         ),
         model_flops_per_token=model_cfg.flops_per_token(cfg.seq_len - 1),
         on_metrics=metrics_printer(_T0, cache),
+        eval_data=eval_data,
+        on_eval=lambda ev: print(json.dumps(ev), flush=True),
     )
     from tpufw.workloads._common import report_preemption
 
