@@ -155,3 +155,46 @@ def test_eval_every_in_run(devices8):
     )
     assert [ev["step"] for ev in seen] == [2, 4]
     assert all(np.isfinite(ev["eval_loss"]) for ev in seen)
+
+
+def test_chunked_ce_matches_full_logits(devices8):
+    """Pipeline chunked-vocab CE (head inside tpufw.ops.loss, hidden
+    states from the pipelined forward) agrees with the full-logits
+    objective at fp32."""
+    from tpufw.parallel.pipeline import pipeline_eval
+
+    t = _trainer(total_steps=1)
+    t.init_state()
+    batch = next(synthetic_batches(16, 33, CFG.vocab_size))
+    full = pipeline_eval(t.state.params, batch, CFG, PIPE, t.mesh)
+    chunked = pipeline_eval(
+        t.state.params, batch, CFG, PIPE, t.mesh,
+        loss_chunk_size=16, loss_chunk_dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(
+        float(chunked["loss"]), float(full["loss"]), rtol=1e-6
+    )
+    assert float(chunked["n_tokens"]) == float(full["n_tokens"])
+
+
+def test_trains_with_chunked_ce_and_profiler(tmp_path, devices8):
+    """loss_chunk_size + profile_dir both previously raised; now the
+    trainer runs with the chunked objective and writes an XProf trace."""
+    prof_dir = str(tmp_path / "prof")
+    t = _trainer(
+        total_steps=3,
+        loss_chunk_size=16,
+        profile_dir=prof_dir,
+        profile_start=1,
+        profile_stop=2,
+    )
+    t.init_state()
+    hist = t.run(
+        synthetic_batches(16, 33, CFG.vocab_size),
+        model_flops_per_token=CFG.flops_per_token(32),
+    )
+    assert len(hist) == 3
+    assert np.isfinite(hist[-1].loss)
+    import os
+
+    assert any(os.scandir(prof_dir)), "no XProf trace written"

@@ -232,8 +232,12 @@ def pipeline_forward(
     mesh: Mesh,
     backend: Optional[str] = None,
     segment_ids: Optional[jax.Array] = None,
+    return_hidden: bool = False,
 ) -> jax.Array:
-    """Full LM forward with the block stack pipelined: logits [B, T, V].
+    """Full LM forward with the block stack pipelined: logits [B, T, V]
+    (or, with ``return_hidden``, the post-final-norm hidden states
+    [B, T, D] for the chunked-vocab CE path, which applies the head
+    per sequence chunk and never materializes full logits).
 
     Embedding and the head run outside the pipeline region (they are a
     small fraction of compute and live replicated / batch-sharded);
@@ -291,6 +295,8 @@ def pipeline_forward(
     hidden = hidden.reshape(b, t, cfg.d_model)
 
     h = rms_norm(hidden, params["final_norm"], cfg.rms_eps)
+    if return_hidden:
+        return h
     return h.astype(jnp.float32) @ params["head"].astype(jnp.float32)
 
 
@@ -326,13 +332,17 @@ def pipeline_loss(
     cfg: LlamaConfig,
     pipe: PipelineConfig,
     mesh: Mesh,
+    loss_chunk_size: Optional[int] = None,
+    loss_chunk_dtype=None,
 ) -> jax.Array:
     """LM objective through the pipelined forward — the SAME shift +
     packed-batch masking as the flax trainer (shift_and_mask), so the
     two training paths can't diverge on what they optimize. ``batch``
     is {tokens [+ segment_ids, loss_mask]} (a bare token array is
     wrapped for back-compat)."""
-    return pipeline_eval(params, batch, cfg, pipe, mesh)["loss"]
+    return pipeline_eval(
+        params, batch, cfg, pipe, mesh, loss_chunk_size, loss_chunk_dtype
+    )["loss"]
 
 
 def pipeline_eval(
@@ -341,16 +351,33 @@ def pipeline_eval(
     cfg: LlamaConfig,
     pipe: PipelineConfig,
     mesh: Mesh,
+    loss_chunk_size: Optional[int] = None,
+    loss_chunk_dtype=None,
 ) -> dict:
     """Forward-only objective through the pipelined model:
     {loss, n_tokens} — the held-out-eval analog of ``pipeline_loss``
     (same shift/mask, no gradient), so PipelineTrainer.evaluate reports
-    numbers directly comparable to the flax Trainer's."""
+    numbers directly comparable to the flax Trainer's. With
+    ``loss_chunk_size`` the head runs inside the chunked-vocab CE
+    (tpufw.ops.loss) and [B, T, V] logits never materialize."""
     from tpufw.train.trainer import cross_entropy_loss, shift_and_mask
 
     if not isinstance(batch, dict):
         batch = {"tokens": batch}
     inputs, targets, seg_in, mask = shift_and_mask(batch)
+    if loss_chunk_size:
+        from tpufw.ops.loss import chunked_cross_entropy
+
+        hidden = pipeline_forward(
+            params, inputs, cfg, pipe, mesh, segment_ids=seg_in,
+            return_hidden=True,
+        )
+        loss, n = chunked_cross_entropy(
+            hidden, params["head"], targets, mask,
+            chunk_size=loss_chunk_size,
+            compute_dtype=loss_chunk_dtype or jnp.bfloat16,
+        )
+        return {"loss": loss, "n_tokens": n}
     logits = pipeline_forward(
         params, inputs, cfg, pipe, mesh, segment_ids=seg_in
     )

@@ -13,9 +13,10 @@ Packed batches (segment_ids + loss_mask) train with the same masking as
 the flax trainer (shift_and_mask); segment ids ride the pipe ring with
 their microbatch. Held-out eval runs the forward-only pipeline
 (pipeline_eval) with the flax trainer's token-weighted loss/ppl
-surface. TrainerConfig features the schedule doesn't implement
-(grad_accum, chunked-vocab CE, profiling) are rejected loudly in
-``__init__`` rather than silently ignored.
+surface. Chunked-vocab CE runs the head inside tpufw.ops.loss (the
+pipelined forward returns hidden states), and XProf step windows work
+as in the flax trainer; grad_accum is rejected loudly — microbatching
+IS the GPipe schedule (size it via PipelineConfig.n_microbatches).
 """
 
 from __future__ import annotations
@@ -39,7 +40,11 @@ from tpufw.parallel.pipeline import (
     pipeline_param_shardings,
 )
 from tpufw.train.metrics import Meter, StepMetrics
-from tpufw.train.trainer import TrainerConfig, default_optimizer
+from tpufw.train.trainer import (
+    TrainerConfig,
+    default_optimizer,
+    maybe_inloop_eval,
+)
 
 
 class PipeTrainState(struct.PyTreeNode):
@@ -55,12 +60,15 @@ def _pipe_state_step(
     model_cfg: LlamaConfig,
     pipe: PipelineConfig,
     mesh,
+    loss_chunk_size=None,
+    loss_chunk_dtype=None,
 ) -> tuple[PipeTrainState, dict]:
     """TrainState-shaped step (the functional
     tpufw.parallel.pipeline.pipeline_train_step stays the public
     params/opt_state API; this private wrapper is the trainer's)."""
     loss, grads = jax.value_and_grad(pipeline_loss)(
-        state.params, batch, model_cfg, pipe, mesh
+        state.params, batch, model_cfg, pipe, mesh,
+        loss_chunk_size, loss_chunk_dtype,
     )
     updates, new_opt = tx.update(grads, state.opt_state, state.params)
     return (
@@ -93,9 +101,10 @@ class PipelineTrainer:
             )
         pipe.validate(model_cfg, trainer_cfg.batch_size)
         unsupported = {
+            # grad accumulation IS the GPipe schedule: n_microbatches
+            # already splits the batch; a second accumulation layer
+            # would just change the schedule's own knob.
             "grad_accum": trainer_cfg.grad_accum != 1,
-            "loss_chunk_size": bool(trainer_cfg.loss_chunk_size),
-            "profile_dir": bool(trainer_cfg.profile_dir),
         }
         bad = [k for k, v in unsupported.items() if v]
         if bad:
@@ -198,15 +207,27 @@ class PipelineTrainer:
 
     # -- loop ----------------------------------------------------------
 
+    def _chunk_dtype(self):
+        return (
+            jnp.dtype(self.cfg.loss_chunk_dtype)
+            if self.cfg.loss_chunk_size
+            else None
+        )
+
+    def _batch_shardings(self, key) -> dict:
+        """Batch-major row sharding over data x fsdp — ONE definition so
+        the train and eval jits cannot disagree on batch layout."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        row = NamedSharding(self.mesh, P(("data", "fsdp")))
+        return {k: row for k in key}
+
     def _compiled_step(self, batch: dict):
         key = tuple(sorted(batch.keys()))
         if self._step_fn is None:
             self._step_fn = {}
         if key not in self._step_fn:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            row = NamedSharding(self.mesh, P(("data", "fsdp")))
-            batch_sh = {k: row for k in key}
+            batch_sh = self._batch_shardings(key)
             self._step_fn[key] = jax.jit(
                 partial(
                     _pipe_state_step,
@@ -214,6 +235,8 @@ class PipelineTrainer:
                     model_cfg=self.model_cfg,
                     pipe=self.pipe,
                     mesh=self.mesh,
+                    loss_chunk_size=self.cfg.loss_chunk_size,
+                    loss_chunk_dtype=self._chunk_dtype(),
                 ),
                 in_shardings=(self._shardings, batch_sh),
                 out_shardings=(self._shardings, None),
@@ -226,16 +249,15 @@ class PipelineTrainer:
         if self._eval_fn is None:
             self._eval_fn = {}
         if key not in self._eval_fn:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            row = NamedSharding(self.mesh, P(("data", "fsdp")))
-            batch_sh = {k: row for k in key}
+            batch_sh = self._batch_shardings(key)
             self._eval_fn[key] = jax.jit(
                 partial(
                     pipeline_eval,
                     cfg=self.model_cfg,
                     pipe=self.pipe,
                     mesh=self.mesh,
+                    loss_chunk_size=self.cfg.loss_chunk_size,
+                    loss_chunk_dtype=self._chunk_dtype(),
                 ),
                 in_shardings=(self._shardings.params, batch_sh),
                 out_shardings=None,
@@ -288,7 +310,13 @@ class PipelineTrainer:
         from tpufw.train.trainer import globalize_batch
 
         from tpufw.train.preemption import checkpoint_stop, owned_shutdown
+        from tpufw.utils.profiling import StepProfiler
 
+        prof = StepProfiler(
+            self.cfg.profile_dir,
+            self.cfg.profile_start,
+            self.cfg.profile_stop,
+        )
         shutdown, owns_shutdown = owned_shutdown(
             shutdown,
             self.cfg.handle_preemption,
@@ -301,25 +329,22 @@ class PipelineTrainer:
             for i, batch in enumerate(data):
                 if i >= remaining:
                     break
+                prof.maybe_start(i)
                 meter.start()
                 batch = globalize_batch(self.mesh, batch)
-                self.state, m = self._compiled_step(batch)(
-                    self.state, batch
-                )
-                loss = jax.block_until_ready(m["loss"])
+                with prof.step(i):
+                    self.state, m = self._compiled_step(batch)(
+                        self.state, batch
+                    )
+                    loss = jax.block_until_ready(m["loss"])
                 sm = meter.stop(int(self.state.step), loss)
+                prof.maybe_stop(i)
                 history.append(sm)
                 if on_metrics and (i % self.cfg.log_every == 0):
                     on_metrics(sm)
-                if (
-                    self.cfg.eval_every
-                    and eval_data is not None
-                    and int(self.state.step) % self.cfg.eval_every == 0
-                ):
-                    ev = self.evaluate(eval_data(), self.cfg.eval_batches)
-                    ev["step"] = int(self.state.step)
-                    if on_eval:
-                        on_eval(ev)
+                maybe_inloop_eval(
+                    self, int(self.state.step), eval_data, on_eval
+                )
                 if ckpt is not None:
                     ckpt.save(int(self.state.step), self.state)
                 # Gang-consistent preemption stop (tpufw.train.preemption).
@@ -329,6 +354,7 @@ class PipelineTrainer:
                     self.preempted = True
                     break
         finally:
+            prof.close()
             if ckpt is not None:
                 ckpt.wait()
                 ckpt.close()

@@ -318,6 +318,20 @@ def run_evaluation(
     }
 
 
+def maybe_inloop_eval(trainer, step: int, eval_data, on_eval) -> None:
+    """The ONE in-loop eval trigger (cadence + reporting), shared by the
+    flax and pipeline trainers so eval cadence cannot drift."""
+    cfg = trainer.cfg
+    if not (cfg.eval_every and eval_data is not None):
+        return
+    if step % cfg.eval_every:
+        return
+    ev = trainer.evaluate(eval_data(), cfg.eval_batches)
+    ev["step"] = step
+    if on_eval:
+        on_eval(ev)
+
+
 def state_shardings(
     abstract_state: TrainState, mesh: Mesh, rules=None
 ) -> TrainState:
@@ -674,17 +688,9 @@ class Trainer:
                     history.append(sm)
                     if on_metrics and (i % self.cfg.log_every == 0):
                         on_metrics(sm)
-                    if (
-                        self.cfg.eval_every
-                        and eval_data is not None
-                        and int(self.state.step) % self.cfg.eval_every == 0
-                    ):
-                        ev = self.evaluate(
-                            eval_data(), self.cfg.eval_batches
-                        )
-                        ev["step"] = int(self.state.step)
-                        if on_eval:
-                            on_eval(ev)
+                    maybe_inloop_eval(
+                        self, int(self.state.step), eval_data, on_eval
+                    )
                     if ckpt is not None:
                         ckpt.save(int(self.state.step), self.state)
                     # Collective decision (see preemption.py): the whole

@@ -64,13 +64,15 @@ def build_trainer():
         checkpoint_dir=env_str("checkpoint_dir", "") or None,
         checkpoint_every=env_int("checkpoint_every", 100),
         adam_mu_dtype=env_str("adam_mu_dtype", "") or None,
-        # Features PipelineTrainer doesn't implement are still READ here
-        # so its loud NotImplementedError fires on a configured-but-
-        # ignored knob instead of training silently without it.
+        # grad_accum is still READ so PipelineTrainer's loud
+        # NotImplementedError fires on a configured-but-ignored knob
+        # (microbatching IS the schedule; size n_microbatches instead).
         grad_accum=env_int("grad_accum", 1),
         loss_chunk_size=env_int("loss_chunk_size", 0) or None,
+        loss_chunk_dtype=env_str("loss_chunk_dtype", "bfloat16"),
         profile_dir=env_str("profile_dir", "") or None,
-        # In-loop held-out eval IS implemented here (pipeline_eval).
+        profile_start=env_int("profile_start", 3),
+        profile_stop=env_int("profile_stop", 6),
         eval_every=env_int("eval_every", 0),
         eval_batches=env_int("eval_batches", 8),
         # Same SIGTERM-to-forced-checkpoint contract as train_llama.
