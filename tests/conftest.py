@@ -53,3 +53,16 @@ def devices8():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture
+def clear_tpufw_env(monkeypatch):
+    """Scrub every ambient TPUFW_* variable — the ONE copy of the env
+    scrub the workload-config tests need (they must see exactly the env
+    they set, not whatever the harness exported)."""
+    import os
+
+    for k in list(os.environ):
+        if k.startswith("TPUFW_"):
+            monkeypatch.delenv(k, raising=False)
+    return monkeypatch

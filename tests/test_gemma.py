@@ -263,3 +263,22 @@ def test_real_preset_shapes():
     n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
     assert n == cfg.n_params()
     assert 2.5e9 < n < 2.7e9  # the "2b" is ~2.6B with the 256k vocab
+
+
+def test_serve_gemma_hf_checkpoint_dir(hf_gemma, tmp_path, clear_tpufw_env):
+    """TPUFW_HF_CHECKPOINT with a Gemma-2 safetensors dir picks the Gemma
+    decode module and generates — the torch-ecosystem serving on-ramp for
+    the new family."""
+    ckpt = tmp_path / "gemma"
+    hf_gemma.save_pretrained(str(ckpt), safe_serialization=True)
+    clear_tpufw_env.setenv("TPUFW_HF_CHECKPOINT", str(ckpt))
+
+    from tpufw.workloads.serve import build_generator
+
+    decode_model, params, cfg, restored = build_generator()
+    assert isinstance(decode_model, Gemma) and restored
+    assert isinstance(cfg, GemmaConfig) and cfg.decode is False
+    from tpufw.infer import generate_text
+
+    out = generate_text(decode_model, params, [[3, 4]], max_new_tokens=3)
+    assert len(out) == 1 and len(out[0]) == 3

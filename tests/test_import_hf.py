@@ -199,7 +199,7 @@ def test_export_mixtral_state_dict_round_trips():
         )
 
 
-def test_cli_to_orbax_then_finetune_and_serve(hf_model, tmp_path, monkeypatch):
+def test_cli_to_orbax_then_finetune_and_serve(hf_model, tmp_path, clear_tpufw_env):
     """The full on-ramp loop: HF dir -> import CLI (Orbax bare params) ->
     Trainer.init_from_params picks them up for fine-tuning, and the
     serving workload loads them via TPUFW_PARAMS_CHECKPOINT."""
@@ -233,12 +233,8 @@ def test_cli_to_orbax_then_finetune_and_serve(hf_model, tmp_path, monkeypatch):
         atol=1e-6,
     )
     assert int(trainer.state.step) == 0  # fresh run, not a resume
-
-    for k in list(__import__("os").environ):
-        if k.startswith("TPUFW_"):
-            monkeypatch.delenv(k, raising=False)
-    monkeypatch.setenv("TPUFW_PARAMS_CHECKPOINT", str(out))
-    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")  # same architecture
+    clear_tpufw_env.setenv("TPUFW_PARAMS_CHECKPOINT", str(out))
+    clear_tpufw_env.setenv("TPUFW_MODEL", "llama3_tiny")  # same architecture
     from tpufw.workloads.serve import build_generator
 
     decode_model, params, _, restored = build_generator()
@@ -297,16 +293,13 @@ def test_missing_key_is_loud(hf_model):
         from_hf_llama(sd, cfg)
 
 
-def test_serve_from_hf_checkpoint_dir(hf_model, tmp_path, monkeypatch):
+def test_serve_from_hf_checkpoint_dir(hf_model, tmp_path, clear_tpufw_env):
     """TPUFW_HF_CHECKPOINT: the serving workload loads a safetensors
     checkpoint dir end to end (dir -> config_from_hf -> params -> decode
     model), proving the no-Orbax on-ramp including the shard reader."""
     ckpt = tmp_path / "hf"
     hf_model.save_pretrained(str(ckpt), safe_serialization=True)
-    for k in list(__import__("os").environ):
-        if k.startswith("TPUFW_"):
-            monkeypatch.delenv(k, raising=False)
-    monkeypatch.setenv("TPUFW_HF_CHECKPOINT", str(ckpt))
+    clear_tpufw_env.setenv("TPUFW_HF_CHECKPOINT", str(ckpt))
 
     from tpufw.workloads.serve import build_generator
 
@@ -319,7 +312,7 @@ def test_serve_from_hf_checkpoint_dir(hf_model, tmp_path, monkeypatch):
     assert len(out) == 1 and len(out[0]) == 3
 
 
-def test_serve_mixtral_hf_checkpoint_dir(tmp_path, monkeypatch):
+def test_serve_mixtral_hf_checkpoint_dir(tmp_path, clear_tpufw_env):
     """A Mixtral safetensors dir picks the Mixtral decode module."""
     hf_cfg = transformers.MixtralConfig(
         vocab_size=256, hidden_size=64, intermediate_size=128,
@@ -332,11 +325,8 @@ def test_serve_mixtral_hf_checkpoint_dir(tmp_path, monkeypatch):
     model = transformers.MixtralForCausalLM(hf_cfg)
     ckpt = tmp_path / "mixtral"
     model.save_pretrained(str(ckpt), safe_serialization=True)
-    for k in list(__import__("os").environ):
-        if k.startswith("TPUFW_"):
-            monkeypatch.delenv(k, raising=False)
-    monkeypatch.setenv("TPUFW_HF_CHECKPOINT", str(ckpt))
-    monkeypatch.setenv("TPUFW_MODEL", "not-a-real-model")  # must be ignored
+    clear_tpufw_env.setenv("TPUFW_HF_CHECKPOINT", str(ckpt))
+    clear_tpufw_env.setenv("TPUFW_MODEL", "not-a-real-model")  # must be ignored
 
     from tpufw.models import Mixtral
     from tpufw.workloads.serve import build_generator

@@ -8,10 +8,6 @@ from tpufw.workloads.train_pipeline import build_trainer
 def _clear(monkeypatch):
     import os
 
-    for k in list(os.environ):
-        if k.startswith("TPUFW_"):
-            monkeypatch.delenv(k, raising=False)
-
 
 def test_requires_stages(monkeypatch):
     _clear(monkeypatch)
