@@ -144,13 +144,6 @@ def multi_head_attention(
             f"KV-cache decode (kv_segment_ids/q_positions) requires "
             f"backend='xla', got {backend!r}"
         )
-    if backend in ("ring", "ulysses") and (
-        logits_soft_cap is not None or sliding_window is not None
-    ):
-        raise NotImplementedError(
-            f"logits_soft_cap/sliding_window are not supported by "
-            f"backend={backend!r}; use backend='xla' or 'flash'"
-        )
     if backend == "flash":
         from tpufw.ops.flash import flash_attention
 
@@ -163,12 +156,16 @@ def multi_head_attention(
         from tpufw.parallel.ring import ring_attention
 
         return ring_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            logits_soft_cap=logits_soft_cap,
+            sliding_window=sliding_window,
         )
     if backend == "ulysses":
         from tpufw.parallel.ulysses import ulysses_attention
 
         return ulysses_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            logits_soft_cap=logits_soft_cap,
+            sliding_window=sliding_window,
         )
     raise ValueError(f"unknown attention backend {backend!r}")

@@ -24,14 +24,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from tpufw.mesh.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQUENCE, AXIS_TENSOR
-from tpufw.ops.attention import _repeat_kv
+from tpufw.ops.attention import _repeat_kv, tanh_soft_cap
 from tpufw.parallel.context import current_mesh
 
 NEG_INF = -1e30
 
 
 def _chunk_attn(
-    q, k, v, q_start, k_start, causal, scale, rep, qseg=None, kseg=None
+    q, k, v, q_start, k_start, causal, scale, rep, qseg=None, kseg=None,
+    soft_cap=None, window=None,
 ):
     """Attention of local q against one kv chunk; returns (acc, m, l) stats.
 
@@ -49,12 +50,20 @@ def _chunk_attn(
         )
         * scale
     )
+    if soft_cap is not None:
+        # Position-independent and elementwise: capping per chunk before
+        # the online-softmax merge equals capping the full logits.
+        logits = tanh_soft_cap(logits, soft_cap)
     mask = None
-    if causal:
+    if causal or window is not None:
         t, s = q.shape[1], k.shape[1]
         q_pos = q_start + jnp.arange(t)[:, None]
         k_pos = k_start + jnp.arange(s)[None, :]
-        mask = (q_pos >= k_pos)[None, None]
+        if causal:
+            mask = (q_pos >= k_pos)[None, None]
+        if window is not None:
+            near = ((q_pos - k_pos) < window)[None, None]
+            mask = near if mask is None else (mask & near)
     if qseg is not None:
         seg_mask = qseg[:, None, :, None] == kseg[:, None, None, :]
         mask = seg_mask if mask is None else (mask & seg_mask)
@@ -71,7 +80,9 @@ def _chunk_attn(
     return acc, m, l
 
 
-def _ring_attn_local(q, k, v, *seg, causal, axis_name, scale, rep):
+def _ring_attn_local(
+    q, k, v, *seg, causal, axis_name, scale, rep, soft_cap, window
+):
     """Body run per-device under shard_map. q: [B,L,H,D], k/v: [B,L,K,D].
     ``seg`` is () or (qseg [B,L], kseg [B,L]); kseg rides the ring with kv."""
     n = jax.lax.psum(1, axis_name)
@@ -107,6 +118,8 @@ def _ring_attn_local(q, k, v, *seg, causal, axis_name, scale, rep):
             rep=rep,
             qseg=qseg,
             kseg=kseg_cur,
+            soft_cap=soft_cap,
+            window=window,
         )
         m_new = jnp.maximum(m, m_c)
         alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
@@ -138,6 +151,8 @@ def ring_attention(
     mesh: Optional[Mesh] = None,
     axis_name: str = AXIS_SEQUENCE,
     impl: Optional[str] = None,
+    logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
 ) -> jax.Array:
     """Sequence-parallel attention. q:[B,T,H,D], k/v:[B,S,K,D] global shapes.
 
@@ -153,6 +168,15 @@ def ring_attention(
     materialized per-chunk logits (the reference implementation). Default
     (None) picks flash on TPU for the causal LM path and einsum elsewhere;
     the two are numerically interchangeable (tests/test_ring_flash.py).
+
+    ``logits_soft_cap`` (Gemma) works on both impls (elementwise, so
+    per-chunk capping commutes with the online-softmax merge).
+    ``sliding_window`` is a GLOBAL position relation: the per-shard flash
+    kernels only see chunk-local positions (their offset is static, the
+    ring's chunk offset is traced), so a window FORCES the einsum impl —
+    per-chunk [B, H, T/P, T/P] logits instead of O(L) memory. Known
+    perf cliff for windowed (Gemma local) layers under ring SP; lifting
+    it needs the flash kernels to take the chunk offset as an operand.
     """
     mesh = mesh or current_mesh()
     if mesh is None:
@@ -163,7 +187,17 @@ def ring_attention(
     if impl is None:
         on_tpu = mesh.devices.flatten()[0].platform == "tpu"
         impl = "flash" if (causal and on_tpu) else "einsum"
+        if sliding_window is not None:
+            # The per-shard flash calls see only local positions, so the
+            # window (a GLOBAL position relation) runs on the einsum
+            # impl, whose chunk math carries global q/k offsets.
+            impl = "einsum"
     if impl == "flash":
+        if sliding_window is not None:
+            raise NotImplementedError(
+                "ring impl='flash' does not support sliding_window; "
+                "use impl='einsum' (the default picks it automatically)"
+            )
         from tpufw.parallel.ring_flash import ring_flash_attention
 
         return ring_flash_attention(
@@ -172,6 +206,7 @@ def ring_attention(
             segment_ids=segment_ids,
             mesh=mesh,
             axis_name=axis_name,
+            logits_soft_cap=logits_soft_cap,
         )
     if impl != "einsum":
         raise ValueError(f"unknown ring impl {impl!r}")
@@ -190,6 +225,8 @@ def ring_attention(
         axis_name=axis_name,
         scale=scale,
         rep=rep,
+        soft_cap=logits_soft_cap,
+        window=sliding_window,
     )
     if segment_ids is None:
         fn = shard_map(

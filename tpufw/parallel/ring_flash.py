@@ -47,7 +47,7 @@ from tpufw.parallel.context import current_mesh
 NEG_INF = F.NEG_INF
 
 
-def _chunk_fwd(case, q, k, v, qseg, kseg, interpret):
+def _chunk_fwd(case, q, k, v, qseg, kseg, interpret, soft_cap=None):
     """One q-shard x kv-chunk flash forward. Returns (o [B,L,H,D] fp32
     normalized, lse [B,H,L] fp32). case: 0 full / 1 causal-diag / 2 empty."""
     b, l, h, d = q.shape
@@ -55,7 +55,7 @@ def _chunk_fwd(case, q, k, v, qseg, kseg, interpret):
     def run(causal):
         def f(q, k, v, qseg, kseg):
             out, res = F._flash_fwd_impl(q, k, v, qseg, kseg, causal,
-                                         interpret, None, None)
+                                         interpret, soft_cap, None)
             lse = res[-1][:, :, 0, :l]  # un-pad [B,H,1,Tp] -> [B,H,L]
             return out.astype(jnp.float32), lse
 
@@ -72,14 +72,16 @@ def _chunk_fwd(case, q, k, v, qseg, kseg, interpret):
     )
 
 
-def _chunk_bwd(case, q, k, v, qseg, kseg, out, lse_pad, g, interpret):
+def _chunk_bwd(
+    case, q, k, v, qseg, kseg, out, lse_pad, g, interpret, soft_cap=None
+):
     """Per-chunk gradients via the flash backward kernels with the GLOBAL
     lse. Returns (dq, dk, dv) in fp32."""
 
     def run(causal):
         def f(q, k, v, qseg, kseg, out, lse_pad, g):
             dq, dk, dv, _, _ = F._flash_bwd_impl(
-                causal, interpret, None, None,
+                causal, interpret, soft_cap, None,
                 (q, k, v, qseg, kseg, out, lse_pad), g,
             )
             return (
@@ -113,7 +115,10 @@ def _merge(out, lse, o_c, lse_c):
     return t(w1) * out + t(w2) * o_c, lse_new
 
 
-def _make_local(n: int, axis_name: str, interpret: bool, has_seg: bool):
+def _make_local(
+    n: int, axis_name: str, interpret: bool, has_seg: bool,
+    soft_cap=None,
+):
     """Build the per-device custom-VJP ring-flash body for a ring of n."""
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -131,7 +136,7 @@ def _make_local(n: int, axis_name: str, interpret: bool, has_seg: bool):
             src = (idx - step) % n
             o_c, lse_c = _chunk_fwd(
                 case_of(src, idx), q, k_cur, v_cur, qseg, kseg_cur,
-                interpret,
+                interpret, soft_cap,
             )
             out, lse = _merge(out, lse, o_c, lse_c)
             if step < n - 1:
@@ -164,7 +169,7 @@ def _make_local(n: int, axis_name: str, interpret: bool, has_seg: bool):
             src = (idx - step) % n
             dq_c, dk_c, dv_c = _chunk_bwd(
                 case_of(src, idx), q, k_cur, v_cur, qseg, kseg_cur,
-                out, lse_pad, g, interpret,
+                out, lse_pad, g, interpret, soft_cap,
             )
             dq = dq + dq_c
             dk_acc = dk_acc + dk_c
@@ -199,6 +204,7 @@ def ring_flash_attention(
     mesh: Optional[Mesh] = None,
     axis_name: str = AXIS_SEQUENCE,
     interpret: Optional[bool] = None,
+    logits_soft_cap: Optional[float] = None,
 ) -> jax.Array:
     """Sequence-parallel flash attention. Global shapes q:[B,T,H,D],
     k/v:[B,T,K,D]; sharded over (batch=data+fsdp, seq=sequence,
@@ -225,7 +231,8 @@ def ring_flash_attention(
     if interpret is None:
         interpret = mesh.devices.flatten()[0].platform == "cpu"
     has_seg = segment_ids is not None
-    local = _make_local(n, axis_name, interpret, has_seg)
+    cap = None if logits_soft_cap is None else float(logits_soft_cap)
+    local = _make_local(n, axis_name, interpret, has_seg, cap)
 
     spec = P((AXIS_DATA, AXIS_FSDP), AXIS_SEQUENCE, AXIS_TENSOR, None)
     seg_spec = P((AXIS_DATA, AXIS_FSDP), AXIS_SEQUENCE)

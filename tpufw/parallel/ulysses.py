@@ -38,7 +38,9 @@ from tpufw.ops.attention import _repeat_kv, multi_head_attention
 from tpufw.parallel.context import current_mesh
 
 
-def _ulysses_local(q, k, v, *seg, axis_name, causal, backend):
+def _ulysses_local(
+    q, k, v, *seg, axis_name, causal, backend, soft_cap, window
+):
     """Per-device body. q: [B, T/P, Hl, D], k/v: [B, T/P, Kl, D] local
     shapes (Hl = heads already divided by any tensor sharding outside).
     ``seg`` is () or (qseg [B, T/P],)."""
@@ -71,6 +73,8 @@ def _ulysses_local(q, k, v, *seg, axis_name, causal, backend):
         q_g, k_g, v_g,
         causal=causal,
         segment_ids=seg_full,
+        logits_soft_cap=soft_cap,
+        sliding_window=window,
         backend=backend,
     )  # [B, T, H/P, D]
     # Reverse swap: back to [B, T/P, H, D].
@@ -89,6 +93,8 @@ def ulysses_attention(
     mesh: Optional[Mesh] = None,
     axis_name: str = AXIS_SEQUENCE,
     backend: Optional[str] = None,
+    logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
 ) -> jax.Array:
     """Sequence-parallel attention via all-to-all. Global shapes
     q: [B,T,H,D], k/v: [B,S,K,D]; self-attention only (T == S), T must
@@ -98,6 +104,9 @@ def ulysses_attention(
     ``backend`` is the LOCAL attention implementation each device runs on
     its head group ("xla" or "flash"); default picks flash on TPU for the
     causal path, xla elsewhere — mirroring ring_attention's choice.
+    ``logits_soft_cap``/``sliding_window`` pass straight through to the
+    local kernel: each device sees the FULL sequence for its heads, so
+    Gemma-style capping and local attention need no extra handling here.
     """
     mesh = mesh or current_mesh()
     if mesh is None:
@@ -126,6 +135,8 @@ def ulysses_attention(
         axis_name=axis_name,
         causal=causal,
         backend=backend,
+        soft_cap=logits_soft_cap,
+        window=sliding_window,
     )
     if segment_ids is None:
         fn = shard_map(
