@@ -1,0 +1,232 @@
+"""LoRA fine-tuning: adapters, freezing, merge, and the import on-ramp.
+
+The contract chain: a rank-r model equals its base at init (B = 0);
+training updates ONLY adapter params; merge_lora folds the trained
+adapters into base kernels so a rank-0 model reproduces the fine-tuned
+forward; init_from_params restores a BASE checkpoint into a LoRA model.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpufw.mesh import MeshConfig
+from tpufw.models import (
+    GEMMA_CONFIGS,
+    Gemma,
+    LLAMA_CONFIGS,
+    Llama,
+    has_lora,
+    lora_mask,
+    merge_lora,
+)
+from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+BASE = dataclasses.replace(
+    LLAMA_CONFIGS["llama3_tiny"], dtype=jnp.float32, param_dtype=jnp.float32
+)
+LORA = dataclasses.replace(BASE, lora_rank=4)
+
+
+def _tokens(n=2, t=17, seed=0):
+    return jax.random.randint(
+        jax.random.key(seed), (n, t), 0, BASE.vocab_size
+    )
+
+
+def test_rank0_has_no_adapters():
+    params = jax.eval_shape(
+        Llama(BASE).init, jax.random.key(0), _tokens()
+    )["params"]
+    assert not has_lora(params)
+
+
+def test_init_equals_base():
+    """B = 0 at init: the LoRA model's forward is exactly the base's."""
+    tokens = _tokens()
+    lp = Llama(LORA).init(jax.random.key(1), tokens)["params"]
+    assert has_lora(lp)
+
+    def strip(node):
+        if not isinstance(node, dict):
+            return node
+        return {
+            k: strip(v)
+            for k, v in node.items()
+            if not (k.endswith("_lora_a") or k.endswith("_lora_b"))
+        }
+
+    base_params = strip(lp)
+    out_lora = Llama(LORA).apply({"params": lp}, tokens)
+    out_base = Llama(BASE).apply({"params": base_params}, tokens)
+    np.testing.assert_array_equal(np.asarray(out_lora), np.asarray(out_base))
+
+
+def test_training_updates_only_adapters(devices8):
+    trainer = Trainer(
+        Llama(LORA),
+        TrainerConfig(batch_size=8, seq_len=17, total_steps=3, lr=1e-2),
+        MeshConfig(data=2, fsdp=4),
+    )
+    trainer.init_state()
+    before = jax.tree.map(np.asarray, trainer.state.params)
+    trainer.run(
+        synthetic_batches(8, 17, LORA.vocab_size),
+        model_flops_per_token=LORA.flops_per_token(16),
+    )
+    after = jax.tree.map(np.asarray, trainer.state.params)
+    mask = lora_mask(before)
+    changed = jax.tree.map(
+        lambda a, b: bool(np.any(a != b)), before, after
+    )
+    n_adapter_changed = 0
+    for m, c in zip(jax.tree.leaves(mask), jax.tree.leaves(changed)):
+        if m:
+            n_adapter_changed += int(c)
+        else:
+            assert not c, "frozen base parameter changed"
+    assert n_adapter_changed > 0, "no adapter learned anything"
+
+
+def test_merge_reproduces_finetuned_forward(devices8):
+    trainer = Trainer(
+        Llama(LORA),
+        TrainerConfig(batch_size=8, seq_len=17, total_steps=3, lr=1e-2),
+        MeshConfig(data=2, fsdp=4),
+    )
+    trainer.init_state()
+    trainer.run(
+        synthetic_batches(8, 17, LORA.vocab_size),
+        model_flops_per_token=LORA.flops_per_token(16),
+    )
+    tokens = _tokens(seed=3)
+    tuned = Llama(LORA).apply({"params": trainer.state.params}, tokens)
+    merged = merge_lora(
+        jax.tree.map(np.asarray, trainer.state.params),
+        rank=LORA.lora_rank,
+        alpha=LORA.lora_alpha,
+    )
+    assert not has_lora(merged)
+    out = Llama(BASE).apply({"params": merged}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(tuned), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_merge_gemma_pairs():
+    """Merge handles the pair-scanned Gemma layout (stacked kernels)."""
+    cfg = dataclasses.replace(
+        GEMMA_CONFIGS["gemma2_tiny"],
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        lora_rank=4,
+    )
+    from flax.core import meta
+
+    tokens = jax.random.randint(jax.random.key(5), (1, 16), 0, 256)
+    params = meta.unbox(
+        Gemma(cfg).init(jax.random.key(6), tokens)
+    )["params"]
+    # Give B nonzero values so the merge has a real delta to fold.
+    params = jax.tree_util.tree_map_with_path(
+        lambda p, x: x + 0.01 if any(
+            getattr(k, "key", "").endswith("_lora_b") for k in p
+            if hasattr(k, "key")
+        ) else x,
+        params,
+    )
+    tuned = Gemma(cfg).apply({"params": params}, tokens)
+    merged = merge_lora(params, rank=4, alpha=cfg.lora_alpha)
+    base_cfg = dataclasses.replace(cfg, lora_rank=0)
+    out = Gemma(base_cfg).apply({"params": merged}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(tuned), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_merge_without_adapters_is_loud():
+    params = Llama(BASE).init(jax.random.key(0), _tokens())["params"]
+    with pytest.raises(ValueError, match="no .*lora"):
+        merge_lora(params, rank=4)
+
+
+def test_init_from_base_checkpoint(tmp_path, devices8):
+    """A bare-params BASE checkpoint restores into a LoRA trainer: base
+    kernels from disk, fresh zero adapters — forward equals the
+    checkpointed model at step 0."""
+    import orbax.checkpoint as ocp
+
+    from flax.core import meta
+
+    base_params = meta.unbox(
+        Llama(BASE).init(jax.random.key(7), _tokens())
+    )["params"]
+    path = str(tmp_path / "base-ckpt")
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, base_params)
+
+    trainer = Trainer(
+        Llama(LORA),
+        TrainerConfig(batch_size=8, seq_len=17, total_steps=2, lr=1e-2),
+        MeshConfig(data=2, fsdp=4),
+    )
+    trainer.init_from_params(path)
+    tokens = _tokens(seed=8)
+    out = Llama(LORA).apply({"params": trainer.state.params}, tokens)
+    want = Llama(BASE).apply({"params": base_params}, tokens)
+    # Sharded-vs-unsharded fp accumulation order: not bitwise.
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(want), atol=1e-5, rtol=1e-5
+    )
+    # And it trains from there, adapters only.
+    hist = trainer.run(
+        synthetic_batches(8, 17, LORA.vocab_size),
+        model_flops_per_token=LORA.flops_per_token(16),
+    )
+    assert len(hist) == 2 and np.isfinite(hist[-1].loss)
+
+
+def test_merge_cli_on_trainstate_checkpoint(tmp_path, devices8):
+    """The merge CLI takes the Trainer's own TrainState checkpoint and
+    writes a bare merged params dir whose forward equals the tuned
+    model — the serving handoff of the fine-tune loop."""
+    import orbax.checkpoint as ocp
+
+    from tpufw.tools import merge_lora as cli
+
+    ckpt = str(tmp_path / "lora-ckpt")
+    trainer = Trainer(
+        Llama(LORA),
+        TrainerConfig(
+            batch_size=8, seq_len=17, total_steps=2, lr=1e-2,
+            checkpoint_dir=ckpt, checkpoint_every=1,
+        ),
+        MeshConfig(data=2, fsdp=4),
+    )
+    trainer.init_state()
+    trainer.run(
+        synthetic_batches(8, 17, LORA.vocab_size),
+        model_flops_per_token=LORA.flops_per_token(16),
+    )
+    tokens = _tokens(seed=11)
+    tuned = Llama(LORA).apply({"params": trainer.state.params}, tokens)
+
+    import os
+
+    step_dir = os.path.join(ckpt, str(int(trainer.state.step)))
+    out_dir = str(tmp_path / "merged")
+    assert cli.main(
+        [step_dir, "--out", out_dir, "--rank", str(LORA.lora_rank)]
+    ) == 0
+
+    with ocp.StandardCheckpointer() as ckptr:
+        merged = ckptr.restore(out_dir)
+    assert not has_lora(merged)
+    out = Llama(BASE).apply({"params": merged}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(tuned), atol=1e-5, rtol=1e-5
+    )

@@ -198,6 +198,7 @@ def logical_axis_rules(
         ("q_heads", (AXIS_TENSOR,)),
         ("kv_heads", (AXIS_TENSOR,)),
         ("head_dim", None),
+        ("lora", None),  # LoRA rank axis: tiny, replicated
         ("vocab", (AXIS_TENSOR,)),
         ("expert", (AXIS_EXPERT,)),
         ("expert_mlp", (AXIS_TENSOR,)),

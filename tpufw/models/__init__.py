@@ -11,3 +11,8 @@ from tpufw.models.mixtral import (  # noqa: F401
     MoEMLP,
 )
 from tpufw.models.resnet import ResNet, ResNetConfig, resnet50  # noqa: F401
+from tpufw.models.lora import (  # noqa: F401
+    has_lora,
+    lora_mask,
+    merge_lora,
+)

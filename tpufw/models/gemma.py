@@ -67,6 +67,9 @@ class GemmaConfig:
     mlp_activation: str = "gelu_tanh"
     embed_scale: bool = True
     rms_offset: bool = True
+    # LoRA adapters on attention/MLP projections (see LlamaConfig).
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     def decode_config(self) -> "GemmaConfig":
         """Inference dress: KV cache on, remat off, xla attention."""

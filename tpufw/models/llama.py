@@ -72,6 +72,14 @@ class LlamaConfig:
     # [B, max_seq_len] cache ("cache" flax collection) instead of attending
     # within the call's own tokens. Build with cfg.decode_config().
     decode: bool = False
+    # LoRA (parameter-efficient fine-tuning): rank > 0 adds frozen-base
+    # low-rank adapters to every attention/MLP projection (B zero-init,
+    # so step 0 equals the base model); the Trainer then updates ONLY
+    # adapter params (tpufw.train.trainer lora masking), and
+    # tpufw.models.lora.merge_lora folds trained adapters back into the
+    # base kernels for serving/export.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     def decode_config(self) -> "LlamaConfig":
         """This architecture re-dressed for inference: KV-cache on, remat
@@ -185,6 +193,61 @@ class RMSNorm(nn.Module):
         return rms_norm(x, w + 1.0 if self.offset else w, self.eps)
 
 
+def lora_delta(cfg, x, features, axis, in_names, out_names, name):
+    """Low-rank adapter delta for the projection ``name``: x @ A @ B
+    scaled by alpha/rank; 0.0 when LoRA is off. A uses the projection's
+    fan-in init, B starts at ZERO — step 0 output equals the base model,
+    the standard LoRA init. Params land as ``{name}_lora_a/b`` siblings
+    of the base module, so a base-only checkpoint stays a strict subtree
+    (import/export and bare-params restore are unaffected)."""
+    r = getattr(cfg, "lora_rank", 0)
+    if not r:
+        return 0.0
+    a = nn.DenseGeneral(
+        features=r,
+        axis=axis,
+        use_bias=False,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), (*in_names, "lora")
+        ),
+        name=f"{name}_lora_a",
+    )(x)
+    b = nn.DenseGeneral(
+        features=features,
+        axis=-1,
+        use_bias=False,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), ("lora", *out_names)
+        ),
+        name=f"{name}_lora_b",
+    )(a)
+    return b * (getattr(cfg, "lora_alpha", 16.0) / r)
+
+
+def projection(cfg, x, features, axis, in_names, out_names, name):
+    """Dense projection + optional LoRA delta — the ONE composition every
+    adapted matmul (attention q/k/v/o, MLP gate/up/down) goes through.
+    Must be called from inside a compact ``__call__``."""
+    base = nn.DenseGeneral(
+        features=features,
+        axis=axis,
+        use_bias=False,
+        dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.lecun_normal(), (*in_names, *out_names)
+        ),
+        name=name,
+    )(x)
+    return base + lora_delta(
+        cfg, x, features, axis, in_names, out_names, name
+    )
+
+
 class Attention(nn.Module):
     cfg: LlamaConfig
     # Sliding-window size for this layer (None = global attention).
@@ -194,30 +257,18 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
         cfg = self.cfg
-        dense = lambda feats, names, name: nn.DenseGeneral(  # noqa: E731
-            features=feats,
-            axis=-1,
-            use_bias=False,
-            dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), names
-            ),
-            name=name,
+        q = projection(
+            cfg, x, (cfg.n_heads, cfg.head_dim), -1,
+            ("embed",), ("q_heads", "head_dim"), "q",
         )
-        q = dense(
-            (cfg.n_heads, cfg.head_dim), ("embed", "q_heads", "head_dim"), "q"
-        )(x)
-        k = dense(
-            (cfg.n_kv_heads, cfg.head_dim),
-            ("embed", "kv_heads", "head_dim"),
-            "k",
-        )(x)
-        v = dense(
-            (cfg.n_kv_heads, cfg.head_dim),
-            ("embed", "kv_heads", "head_dim"),
-            "v",
-        )(x)
+        k = projection(
+            cfg, x, (cfg.n_kv_heads, cfg.head_dim), -1,
+            ("embed",), ("kv_heads", "head_dim"), "k",
+        )
+        v = projection(
+            cfg, x, (cfg.n_kv_heads, cfg.head_dim), -1,
+            ("embed",), ("kv_heads", "head_dim"), "v",
+        )
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
         # Non-default query scaling (Gemma's query_pre_attn_scalar):
@@ -248,18 +299,10 @@ class Attention(nn.Module):
                 sliding_window=self.window,
                 backend=cfg.attention_backend,
             )
-        proj = nn.DenseGeneral(
-            features=cfg.d_model,
-            axis=(-2, -1),
-            use_bias=False,
-            dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), ("heads", "head_dim", "embed")
-            ),
-            name="o",
+        return projection(
+            cfg, out, cfg.d_model, (-2, -1),
+            ("heads", "head_dim"), ("embed",), "o",
         )
-        return proj(out)
 
     def _cached_attention(self, q, k, v, segment_ids, positions):
         """KV-cache step: append this call's k/v at the cache cursor, then
@@ -321,18 +364,10 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        dense = lambda feats, names, name: nn.DenseGeneral(  # noqa: E731
-            features=feats,
-            use_bias=False,
-            dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.lecun_normal(), names
-            ),
-            name=name,
+        gate = projection(
+            cfg, x, cfg.d_ff, -1, ("embed",), ("mlp",), "gate"
         )
-        gate = dense(cfg.d_ff, ("embed", "mlp"), "gate")(x)
-        up = dense(cfg.d_ff, ("embed", "mlp"), "up")(x)
+        up = projection(cfg, x, cfg.d_ff, -1, ("embed",), ("mlp",), "up")
         act_name = getattr(cfg, "mlp_activation", "silu")
         if act_name == "silu":
             act = nn.silu(gate)
@@ -342,7 +377,9 @@ class MLP(nn.Module):
             raise ValueError(f"unknown mlp_activation {act_name!r}")
         h = act * up
         h = nn.with_logical_constraint(h, ("batch", "act_seq", "act_mlp"))
-        return dense(cfg.d_model, ("mlp", "embed"), "down")(h)
+        return projection(
+            cfg, h, cfg.d_model, -1, ("mlp",), ("embed",), "down"
+        )
 
 
 class LlamaBlock(nn.Module):

@@ -1,0 +1,117 @@
+"""LoRA utilities: adapter detection, optimizer masking, kernel merge.
+
+The adapters themselves live where the projections live
+(``tpufw.models.llama.lora_delta``, shared by Llama/Gemma blocks and
+Mixtral's attention — MoE expert MLPs are not adapted). This module is
+the everything-else: picking adapter leaves out of a param tree (the
+Trainer freezes the rest), and folding trained adapters back into the
+base kernels so serving/export see a plain dense model.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+_A, _B = "_lora_a", "_lora_b"
+
+
+def is_lora_path(path) -> bool:
+    """True for a jax.tree_util key path inside a LoRA adapter module."""
+    for k in path:
+        name = getattr(k, "key", None)
+        if isinstance(name, str) and (name.endswith(_A) or name.endswith(_B)):
+            return True
+    return False
+
+
+def lora_mask(params: Any) -> Any:
+    """Bool pytree: True on adapter leaves — feed to ``optax.masked`` so
+    the optimizer updates ONLY the adapters (and allocates moments only
+    for them: an 8B base at rank 16 keeps ~0.2% of Adam state)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: is_lora_path(path), params
+    )
+
+
+def has_lora(params: Any) -> bool:
+    return any(jax.tree_util.tree_leaves(lora_mask(params)))
+
+
+def merge_lora(
+    params: Any, rank: int | None = None, alpha: float = 16.0
+) -> Any:
+    """Fold adapters into base kernels: kernel += (A ⊗ B) * alpha/rank,
+    then drop the adapter params. Returns a plain base-model tree (the
+    shape a rank-0 config initializes / ``to_hf`` exports / the serving
+    path restores). ``tensordot`` over the rank axis handles every
+    projection shape: A is [*in_dims, r], B is [r, *out_dims], kernel is
+    [*in_dims, *out_dims].
+
+    ``rank`` is recoverable from the adapters themselves (A's trailing
+    dim), so passing it is optional — but if passed it is VALIDATED:
+    a stale --rank would otherwise silently mis-scale every kernel.
+    """
+    ranks = {
+        leaf.shape[-1]
+        for path, leaf in jax.tree_util.tree_leaves_with_path(params)
+        if any(
+            getattr(k, "key", None) == "kernel"
+            and isinstance(getattr(prev, "key", None), str)
+            and prev.key.endswith(_A)
+            for prev, k in zip(path, path[1:])
+        )
+    }
+    if len(ranks) == 1:
+        actual = ranks.pop()
+        if rank is not None and rank != actual:
+            raise ValueError(
+                f"merge_lora: rank={rank} but the adapters were trained "
+                f"at rank {actual} — merging would mis-scale every kernel"
+            )
+        rank = actual
+    if rank is None or rank <= 0:
+        raise ValueError(
+            f"merge_lora: could not infer a single adapter rank "
+            f"(found {sorted(ranks) if ranks else 'none'}) and no valid "
+            f"rank was given"
+        )
+    scale = alpha / rank
+    merged_any = []
+
+    def delta(a, b, kernel):
+        a = a.astype(jnp.float32)
+        b = b.astype(jnp.float32)
+        if (a.ndim - 1) + (b.ndim - 1) == kernel.ndim:
+            return jnp.tensordot(a, b, axes=([-1], [0]))
+        # nn.scan-stacked kernels carry a leading layer axis on all
+        # three tensors: batch the contraction over it.
+        return jax.vmap(
+            lambda aa, bb: jnp.tensordot(aa, bb, axes=([-1], [0]))
+        )(a, b)
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for key, val in node.items():
+            if key.endswith(_A) or key.endswith(_B):
+                continue  # consumed below / dropped
+            a_mod = node.get(key + _A)
+            b_mod = node.get(key + _B)
+            if a_mod is not None and b_mod is not None:
+                kernel = val["kernel"]
+                d = delta(a_mod["kernel"], b_mod["kernel"], kernel) * scale
+                out[key] = {**val, "kernel": kernel + d.astype(kernel.dtype)}
+                merged_any.append(key)
+            else:
+                out[key] = walk(val)
+        return out
+
+    merged = walk(params)
+    if not merged_any:
+        # Defensive: merging a tree with no adapters is a caller bug.
+        raise ValueError("merge_lora: no *_lora_a/_lora_b modules found")
+    return merged

@@ -1,0 +1,63 @@
+"""CLI: fold trained LoRA adapters into base kernels for serving/export.
+
+Completes the parameter-efficient fine-tune loop::
+
+    python -m tpufw.tools.import_hf <hf-dir> --out base/   # base params
+    TPUFW_INIT_FROM=base/ TPUFW_LORA_RANK=16 \\
+        python -m tpufw.workloads.train_llama                # adapters
+    python -m tpufw.tools.merge_lora <ckpt> --out merged/ --rank 16
+    TPUFW_CHECKPOINT_DIR=... tpufw.workloads.serve           # or export_hf
+
+Accepts either a bare-params tree (tpufw.tools.import_hf output shape)
+or a full TrainState checkpoint (what Trainer.run saves — its
+``params`` subtree is used; step/opt_state are dropped, as a merged
+model starts a fresh serving/export life).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tpufw.tools.merge_lora",
+        description="LoRA checkpoint -> merged base-model params (Orbax)",
+    )
+    ap.add_argument("src", help="Orbax checkpoint dir (bare params or TrainState)")
+    ap.add_argument("--out", required=True, help="merged Orbax params dir")
+    ap.add_argument("--rank", type=int, default=None,
+                    help="the model's lora_rank (default: inferred from "
+                         "the adapters; if given it is validated)")
+    ap.add_argument("--alpha", type=float, default=16.0,
+                    help="the model's lora_alpha (default 16.0)")
+    args = ap.parse_args(argv)
+
+    import orbax.checkpoint as ocp
+
+    from tpufw.models.lora import merge_lora
+
+    src = os.path.abspath(args.src)
+    # A CheckpointManager step dir nests the tree under its item name
+    # ("default"); a bare StandardCheckpointer dir holds it directly.
+    if os.path.isdir(os.path.join(src, "default")):
+        src = os.path.join(src, "default")
+
+    with ocp.StandardCheckpointer() as ckptr:
+        tree = ckptr.restore(src)
+        params = tree.get("params", tree) if isinstance(tree, dict) else tree
+        merged = merge_lora(params, rank=args.rank, alpha=args.alpha)
+        ckptr.save(os.path.abspath(args.out), merged)
+        ckptr.wait_until_finished()
+    import jax
+
+    n = sum(x.size for x in jax.tree.leaves(merged))
+    print(json.dumps({"out": args.out, "n_params": int(n)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

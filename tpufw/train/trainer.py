@@ -411,6 +411,21 @@ class Trainer:
             total_steps=trainer_cfg.total_steps,
             mu_dtype=trainer_cfg.adam_mu_dtype,
         )
+        if getattr(getattr(model, "cfg", None), "lora_rank", 0) > 0:
+            # LoRA fine-tune: update ONLY adapter params; the frozen
+            # base gets set_to_zero (optax.masked would PASS ITS RAW
+            # GRADIENTS THROUGH, silently training the base). Moments
+            # are allocated only for the adapter partition.
+            from tpufw.models.lora import lora_mask
+
+            def labels(params):
+                return jax.tree.map(
+                    lambda m: "lora" if m else "frozen", lora_mask(params)
+                )
+
+            self.tx = optax.multi_transform(
+                {"lora": self.tx, "frozen": optax.set_to_zero()}, labels
+            )
         self._compiled: dict = {}
         self.state = None
         self.state_sharding = None
@@ -476,14 +491,21 @@ class Trainer:
         fine-tune-from-imported-weights entry point, distinct from
         ``maybe_restore`` (which resumes a full TrainState mid-run).
         Must be called on a fresh trainer: silently mixing restored
-        params with an existing step/optimizer would corrupt the run."""
-        del seed  # params come from the checkpoint, nothing is sampled
+        params with an existing step/optimizer would corrupt the run.
+
+        With LoRA enabled on the model (cfg.lora_rank > 0) the
+        checkpoint holds only the BASE tree: base kernels restore from
+        disk, adapters initialize fresh (B = 0, so step 0 equals the
+        checkpointed model) — the import -> LoRA-fine-tune on-ramp."""
         if self.state is not None:
             raise RuntimeError(
                 "init_from_params on an already-initialized trainer; "
                 "construct a fresh Trainer (or use maybe_restore to "
                 "resume a full TrainState)"
             )
+        if getattr(getattr(self.model, "cfg", None), "lora_rank", 0) > 0:
+            return self._init_lora_from_params(path, seed)
+        del seed  # params come from the checkpoint, nothing is sampled
         params, self.state_sharding = self.restore_params(path)
 
         def make_state(p):
@@ -501,6 +523,50 @@ class Trainer:
                 out_shardings=self.state_sharding,
                 donate_argnums=(0,),
             )(params)
+        return self.state
+
+    def _init_lora_from_params(self, path: str, seed: int) -> TrainState:
+        """Base kernels from the checkpoint + fresh adapters (see
+        init_from_params). The checkpoint tree is exactly what a rank-0
+        twin of this model initializes, so its abstract/restore target
+        comes from that twin; the restored leaves then overwrite the
+        matching leaves of a fresh full init (adapters keep theirs)."""
+        base_model = type(self.model)(
+            dataclasses.replace(self.model.cfg, lora_rank=0)
+        )
+        base = Trainer(base_model, self.cfg, mesh=self.mesh, tx=self.tx)
+        base_params, _ = base.restore_params(path)
+
+        rng = jax.random.key(seed)
+        init_fn, abstract = self._abstract_state(rng)
+        self.state_sharding = meta.unbox(
+            state_shardings(abstract, self.mesh)
+        )
+
+        def graft(full, restored):
+            if isinstance(restored, dict):
+                return {
+                    k: graft(full[k], restored[k]) if k in restored else v
+                    for k, v in full.items()
+                }
+            return restored
+
+        def make_state(restored):
+            # Full init traced, then base leaves replaced by the donated
+            # checkpoint: XLA dead-code-eliminates the unused base random
+            # init, so peak memory is ~one param tree + adapters (the
+            # no-throwaway-init discipline, LoRA edition).
+            state = meta.unbox(init_fn(rng))
+            return state.replace(
+                params=graft(state.params, restored)
+            )
+
+        with use_mesh(self.mesh):
+            self.state = jax.jit(
+                make_state,
+                out_shardings=self.state_sharding,
+                donate_argnums=(0,),
+            )(base_params)
         return self.state
 
     def maybe_restore(self) -> bool:

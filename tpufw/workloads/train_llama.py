@@ -89,6 +89,19 @@ def build_trainer():
     if backend:
         model_cfg = dataclasses.replace(model_cfg, attention_backend=backend)
         model = None if model is None else type(model)(model_cfg)
+    # LoRA fine-tune: TPUFW_LORA_RANK > 0 adds adapters and freezes the
+    # base (pairs with TPUFW_INIT_FROM pointing at a bare-params
+    # checkpoint, e.g. an import_hf conversion).
+    lora_rank = env_int("lora_rank", getattr(model_cfg, "lora_rank", 0))
+    if lora_rank != getattr(model_cfg, "lora_rank", 0):
+        model_cfg = dataclasses.replace(
+            model_cfg,
+            lora_rank=lora_rank,
+            lora_alpha=env_float(
+                "lora_alpha", getattr(model_cfg, "lora_alpha", 16.0)
+            ),
+        )
+        model = None if model is None else type(model)(model_cfg)
     if model is None:
         model = Llama(model_cfg)
 
