@@ -220,7 +220,8 @@ def test_merge_cli_on_trainstate_checkpoint(tmp_path, devices8):
     step_dir = os.path.join(ckpt, str(int(trainer.state.step)))
     out_dir = str(tmp_path / "merged")
     assert cli.main(
-        [step_dir, "--out", out_dir, "--rank", str(LORA.lora_rank)]
+        [step_dir, "--out", out_dir, "--rank", str(LORA.lora_rank),
+         "--alpha", str(LORA.lora_alpha)]
     ) == 0
 
     with ocp.StandardCheckpointer() as ckptr:

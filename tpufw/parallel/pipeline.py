@@ -65,6 +65,11 @@ class PipelineConfig:
                 f"batch {batch_size} not divisible by "
                 f"{self.n_microbatches} microbatches"
             )
+        if _is_gemma(model) and (model.n_layers // self.n_stages) % 2:
+            raise ValueError(
+                f"Gemma pipelines scan local/global PAIRS: layers per "
+                f"stage ({model.n_layers}/{self.n_stages}) must be even"
+            )
 
     def bubble_fraction(self) -> float:
         s, m = self.n_stages, self.n_microbatches
@@ -74,6 +79,12 @@ class PipelineConfig:
 # ----------------------------------------------------------------------
 # Parameters
 # ----------------------------------------------------------------------
+
+
+def _is_gemma(cfg) -> bool:
+    from tpufw.models.gemma import GemmaConfig
+
+    return isinstance(cfg, GemmaConfig)
 
 
 def init_pipeline_params(
@@ -96,6 +107,44 @@ def init_pipeline_params(
             jax.random.normal(k, shape, jnp.float32)
             / math.sqrt(fan_in)
         ).astype(cfg.param_dtype)
+
+    if _is_gemma(cfg):
+        # Pair layout (local sliding-window block + global block), the
+        # functional mirror of tpufw.models.gemma.GemmaPair: stage
+        # stacks are [S, pairs_per_stage, ...]; sandwich norms store the
+        # (1 + w) offset (zeros init); embeddings are tied (no head)
+        # and stored at 1/sqrt(d) for the sqrt(d) lookup scaling.
+        pairs = lps // 2
+
+        def block(k):
+            ks = jax.random.split(k, 7)
+            return {
+                "pre_attn_norm": jnp.zeros((s, pairs, d), jnp.float32),
+                "post_attn_norm": jnp.zeros((s, pairs, d), jnp.float32),
+                "pre_mlp_norm": jnp.zeros((s, pairs, d), jnp.float32),
+                "post_mlp_norm": jnp.zeros((s, pairs, d), jnp.float32),
+                "wq": w(ks[0], (s, pairs, d, h, dh), d),
+                "wk": w(ks[1], (s, pairs, d, kh, dh), d),
+                "wv": w(ks[2], (s, pairs, d, kh, dh), d),
+                "wo": w(ks[3], (s, pairs, h, dh, d), h * dh),
+                "w_gate": w(ks[4], (s, pairs, d, f), d),
+                "w_up": w(ks[5], (s, pairs, d, f), d),
+                "w_down": w(ks[6], (s, pairs, f, d), f),
+            }
+
+        return {
+            "embed": (
+                jax.random.normal(
+                    keys[0], (cfg.vocab_size, d), jnp.float32
+                )
+                / math.sqrt(d)
+            ).astype(cfg.param_dtype),
+            "stages": {
+                "local": block(keys[1]),
+                "global": block(keys[2]),
+            },
+            "final_norm": jnp.zeros((d,), jnp.float32),
+        }
 
     return {
         "embed": jax.random.normal(
@@ -121,12 +170,14 @@ def pipeline_param_shardings(mesh: Mesh, params: dict) -> dict:
     """NamedShardings: stage stacks split over ``pipe``, rest replicated."""
     stage = NamedSharding(mesh, P(AXIS_PIPE))
     rep = NamedSharding(mesh, P())
-    return {
+    out = {
         "embed": rep,
         "stages": jax.tree.map(lambda _: stage, params["stages"]),
         "final_norm": rep,
-        "head": rep,
     }
+    if "head" in params:
+        out["head"] = rep
+    return out
 
 
 # ----------------------------------------------------------------------
@@ -161,8 +212,54 @@ def _block(
     return x
 
 
+def _gemma_block(p, x, cfg, backend, seg, window):
+    """One Gemma-2 block (sandwich (1+w) norms, GeGLU, caps, qpas
+    scaling) — the functional mirror of tpufw.models.gemma.GemmaBlock."""
+    dt = cfg.dtype
+    positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+
+    def norm(which, h):
+        return rms_norm(h, p[which] + 1.0, cfg.rms_eps)
+
+    h = norm("pre_attn_norm", x)
+    q = jnp.einsum("btd,dhk->bthk", h, p["wq"].astype(dt))
+    k = jnp.einsum("btd,dhk->bthk", h, p["wk"].astype(dt))
+    v = jnp.einsum("btd,dhk->bthk", h, p["wv"].astype(dt))
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    qpas = cfg.query_pre_attn_scalar
+    if qpas is not None and float(qpas) != float(cfg.head_dim):
+        q = q * (math.sqrt(cfg.head_dim) / math.sqrt(float(qpas)))
+    att = multi_head_attention(
+        q, k, v, causal=True, segment_ids=seg,
+        logits_soft_cap=cfg.attn_logit_soft_cap,
+        sliding_window=window,
+        backend=backend,
+    )
+    x = x + norm(
+        "post_attn_norm",
+        jnp.einsum("bthk,hkd->btd", att, p["wo"].astype(dt)),
+    )
+    h = norm("pre_mlp_norm", x)
+    g = jnp.einsum("btd,df->btf", h, p["w_gate"].astype(dt))
+    u = jnp.einsum("btd,df->btf", h, p["w_up"].astype(dt))
+    m = jnp.einsum(
+        "btf,fd->btd",
+        jax.nn.gelu(g, approximate=True) * u,
+        p["w_down"].astype(dt),
+    )
+    return x + norm("post_mlp_norm", m)
+
+
 def _stage(stage_params: dict, x: jax.Array, cfg, backend: str, seg=None):
-    """Run this stage's [layers_per_stage] blocks via lax.scan."""
+    """Run this stage's [layers_per_stage] blocks via lax.scan. For
+    Gemma the scanned unit is a local+global PAIR (the alternation is a
+    static per-block property, so it cannot ride a plain layer scan)."""
+    if _is_gemma(cfg):
+        out, _ = jax.lax.scan(
+            _gemma_pair_body(cfg, backend, seg), x, stage_params
+        )
+        return out
 
     def body(h, layer_p):
         return _block(layer_p, h, cfg, backend, seg), None
@@ -270,6 +367,10 @@ def pipeline_forward(
         )
 
     x = params["embed"].astype(cfg.dtype)[tokens]  # [B, T, D]
+    if _is_gemma(cfg):
+        x = x * jnp.asarray(
+            math.sqrt(cfg.d_model), cfg.dtype
+        ).astype(x.dtype)
     x = x.reshape(m, b // m, t, cfg.d_model)
 
     mb_spec = P(None, (AXIS_DATA, AXIS_FSDP), None, None)
@@ -294,10 +395,51 @@ def pipeline_forward(
         )(params["stages"], x, seg)
     hidden = hidden.reshape(b, t, cfg.d_model)
 
-    h = rms_norm(hidden, params["final_norm"], cfg.rms_eps)
     if return_hidden:
-        return h
-    return h.astype(jnp.float32) @ params["head"].astype(jnp.float32)
+        fnorm = params["final_norm"]
+        if _is_gemma(cfg):
+            fnorm = fnorm + 1.0
+        return rms_norm(hidden, fnorm, cfg.rms_eps)
+    return _logits_epilogue(params, hidden, cfg)
+
+
+def _head_kernel(params: dict) -> jax.Array:
+    """[D, V] LM head: dedicated, or the transposed tied embedding."""
+    return (
+        params["head"] if "head" in params else params["embed"].T
+    )
+
+
+def _logits_epilogue(params: dict, hidden: jax.Array, cfg) -> jax.Array:
+    """final norm -> head -> optional soft-cap: ONE copy shared by the
+    pipelined and sequential (parity-oracle) forwards."""
+    fnorm = params["final_norm"]
+    if _is_gemma(cfg):
+        fnorm = fnorm + 1.0
+    h = rms_norm(hidden, fnorm, cfg.rms_eps)
+    logits = h.astype(jnp.float32) @ _head_kernel(params).astype(
+        jnp.float32
+    )
+    cap = getattr(cfg, "final_logit_soft_cap", None)
+    if cap is not None:
+        from tpufw.ops.attention import tanh_soft_cap
+
+        logits = tanh_soft_cap(logits, cap)
+    return logits
+
+
+def _gemma_pair_body(cfg, backend, seg):
+    """The scanned local+global pair: ONE copy for the staged schedule
+    and the sequential oracle."""
+
+    def body(h, pair_p):
+        h = _gemma_block(
+            pair_p["local"], h, cfg, backend, seg, cfg.sliding_window
+        )
+        h = _gemma_block(pair_p["global"], h, cfg, backend, seg, None)
+        return h, None
+
+    return body
 
 
 def reference_forward(
@@ -311,6 +453,10 @@ def reference_forward(
     parity oracle for the schedule."""
     b, t = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
+    if _is_gemma(cfg):
+        x = x * jnp.asarray(
+            math.sqrt(cfg.d_model), cfg.dtype
+        ).astype(x.dtype)
     flat = jax.tree.map(
         lambda a: a.reshape(-1, *a.shape[2:]), params["stages"]
     )
@@ -318,12 +464,15 @@ def reference_forward(
         None if segment_ids is None else segment_ids.astype(jnp.int32)
     )
 
-    def body(h, layer_p):
-        return _block(layer_p, h, cfg, backend, seg), None
+    if _is_gemma(cfg):
+        body = _gemma_pair_body(cfg, backend, seg)
+    else:
+
+        def body(h, layer_p):
+            return _block(layer_p, h, cfg, backend, seg), None
 
     x, _ = jax.lax.scan(body, x, flat)
-    h = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    return h.astype(jnp.float32) @ params["head"].astype(jnp.float32)
+    return _logits_epilogue(params, x, cfg)
 
 
 def pipeline_loss(
@@ -373,9 +522,10 @@ def pipeline_eval(
             return_hidden=True,
         )
         loss, n = chunked_cross_entropy(
-            hidden, params["head"], targets, mask,
+            hidden, _head_kernel(params), targets, mask,
             chunk_size=loss_chunk_size,
             compute_dtype=loss_chunk_dtype or jnp.bfloat16,
+            logits_soft_cap=getattr(cfg, "final_logit_soft_cap", None),
         )
         return {"loss": loss, "n_tokens": n}
     logits = pipeline_forward(

@@ -32,8 +32,10 @@ def main(argv=None) -> int:
     ap.add_argument("--rank", type=int, default=None,
                     help="the model's lora_rank (default: inferred from "
                          "the adapters; if given it is validated)")
-    ap.add_argument("--alpha", type=float, default=16.0,
-                    help="the model's lora_alpha (default 16.0)")
+    ap.add_argument("--alpha", type=float, required=True,
+                    help="the model's lora_alpha — REQUIRED: unlike rank "
+                         "it is not recoverable from the adapters, and a "
+                         "wrong value silently mis-scales every kernel")
     args = ap.parse_args(argv)
 
     import orbax.checkpoint as ocp

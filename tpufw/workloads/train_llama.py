@@ -93,13 +93,15 @@ def build_trainer():
     # base (pairs with TPUFW_INIT_FROM pointing at a bare-params
     # checkpoint, e.g. an import_hf conversion).
     lora_rank = env_int("lora_rank", getattr(model_cfg, "lora_rank", 0))
-    if lora_rank != getattr(model_cfg, "lora_rank", 0):
+    lora_alpha = env_float(
+        "lora_alpha", getattr(model_cfg, "lora_alpha", 16.0)
+    )
+    if (lora_rank, lora_alpha) != (
+        getattr(model_cfg, "lora_rank", 0),
+        getattr(model_cfg, "lora_alpha", 16.0),
+    ):
         model_cfg = dataclasses.replace(
-            model_cfg,
-            lora_rank=lora_rank,
-            lora_alpha=env_float(
-                "lora_alpha", getattr(model_cfg, "lora_alpha", 16.0)
-            ),
+            model_cfg, lora_rank=lora_rank, lora_alpha=lora_alpha
         )
         model = None if model is None else type(model)(model_cfg)
     if model is None:

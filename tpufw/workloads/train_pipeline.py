@@ -30,7 +30,7 @@ def build_trainer():
     """(PipelineTrainer, model_cfg) from TPUFW_* env; import-light."""
     from tpufw.configs import bench_model_config
     from tpufw.mesh import MeshConfig
-    from tpufw.models import LLAMA_CONFIGS
+    from tpufw.models import GEMMA_CONFIGS, LLAMA_CONFIGS
     from tpufw.parallel.pipeline import PipelineConfig
     from tpufw.train import PipelineTrainer, TrainerConfig
 
@@ -45,10 +45,12 @@ def build_trainer():
         model_cfg = bench_model_config()
     elif name in LLAMA_CONFIGS:
         model_cfg = LLAMA_CONFIGS[name]
+    elif name in GEMMA_CONFIGS:
+        model_cfg = GEMMA_CONFIGS[name]
     else:
         raise ValueError(
             f"unknown TPUFW_MODEL={name!r} for pipeline training; choose "
-            f"from {['llama3_600m_bench', *LLAMA_CONFIGS]}"
+            f"from {['llama3_600m_bench', *LLAMA_CONFIGS, *GEMMA_CONFIGS]}"
         )
     pipe = PipelineConfig(
         n_stages=stages,
