@@ -462,6 +462,41 @@ def _worker() -> int:
                     d_b * d_new / dt, 1
                 ),
             }
+            # int8 weight-only variant: decode is HBM-bandwidth-bound,
+            # so this is the serving-throughput lever (tpufw.ops.quant).
+            # Own try: a failure here must not discard the fp baseline
+            # already recorded in ``decode``.
+            if _time_left() > 240:
+                try:
+                    import dataclasses as _dc
+
+                    from tpufw.ops.quant import quantize_params
+
+                    q_params = quantize_params(d_params)
+                    q_model = _Llama(
+                        _dc.replace(dcfg, quantized_weights=True)
+                    )
+
+                    def _qgen():
+                        return generate(
+                            q_model, q_params, prompts, pads,
+                            jax.random.key(2), max_new_tokens=d_new,
+                            sampling=SamplingConfig(),
+                        )
+
+                    jax.block_until_ready(_qgen())
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(_qgen())
+                    qdt = time.perf_counter() - t0
+                    decode["int8_tokens_per_sec_per_chip"] = round(
+                        d_b * d_new / qdt, 1
+                    )
+                    decode["int8_speedup"] = round(dt / qdt, 3)
+                    del q_params
+                except Exception as e:  # noqa: BLE001
+                    decode["int8_error"] = (
+                        f"{type(e).__name__}: {e}"[:300]
+                    )
             del d_params
         except Exception as e:  # noqa: BLE001
             decode = {"error": f"{type(e).__name__}: {e}"[:500]}

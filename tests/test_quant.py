@@ -1,0 +1,133 @@
+"""Weight-only int8 serving (tpufw.ops.quant + QuantDenseGeneral).
+
+Contract: quantize_params on a trained tree + quantized_weights=True on
+the config reproduces the fp forward within int8 rounding error, across
+plain / scan-stacked / Gemma pair-stacked layouts, through KV-cache
+generate, and via the TPUFW_QUANTIZE serving env flag.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+
+from tpufw.models import GEMMA_CONFIGS, Gemma, LLAMA_CONFIGS, Llama
+from tpufw.ops.quant import quantize_kernel, quantize_params
+
+BASE = dataclasses.replace(
+    LLAMA_CONFIGS["llama3_tiny"], dtype=jnp.float32, param_dtype=jnp.float32
+)
+
+
+def _params(cfg, model_cls=Llama, seed=0):
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    return meta.unbox(
+        model_cls(cfg).init(jax.random.key(seed), tokens)
+    )["params"]
+
+
+def test_quantize_kernel_roundtrip():
+    w = jax.random.normal(jax.random.key(0), (64, 4, 16))
+    q = quantize_kernel(w, (0,))
+    assert q["q_kernel"].dtype == jnp.int8
+    assert q["scale"].shape == (4, 16)
+    back = q["q_kernel"].astype(jnp.float32) * q["scale"]
+    # Per-channel int8: worst-case error is scale/2 per element.
+    err = np.abs(np.asarray(back - w))
+    bound = np.asarray(q["scale"])[None] / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+@pytest.mark.parametrize("scan_layers", [True, False])
+def test_llama_quantized_forward_close(scan_layers):
+    cfg = dataclasses.replace(BASE, scan_layers=scan_layers, remat=False)
+    params = _params(cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 33), 0, 256)
+    ref = Llama(cfg).apply({"params": params}, tokens)
+    qp = quantize_params(params)
+    qcfg = dataclasses.replace(cfg, quantized_weights=True)
+    out = Llama(qcfg).apply({"params": qp}, tokens)
+    # int8 weights: logits agree to ~1% of the logit scale.
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref),
+        atol=0.05 * float(np.abs(np.asarray(ref)).max()), rtol=0,
+    )
+
+
+def test_gemma_quantized_forward_close():
+    cfg = dataclasses.replace(
+        GEMMA_CONFIGS["gemma2_tiny"],
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    params = _params(cfg, Gemma)
+    tokens = jax.random.randint(jax.random.key(2), (1, 48), 0, 256)
+    ref = Gemma(cfg).apply({"params": params}, tokens)
+    qp = quantize_params(params)
+    qcfg = dataclasses.replace(cfg, quantized_weights=True)
+    out = Gemma(qcfg).apply({"params": qp}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref),
+        atol=0.05 * float(np.abs(np.asarray(ref)).max()), rtol=0,
+    )
+
+
+def test_quantized_generate():
+    from tpufw.infer import SamplingConfig, generate
+
+    cfg = BASE
+    params = _params(cfg)
+    qp = quantize_params(params)
+    qcfg = dataclasses.replace(cfg, quantized_weights=True)
+    model = Llama(qcfg.decode_config())
+    prompts = jax.random.randint(jax.random.key(3), (2, 12), 0, 256)
+    toks = generate(
+        model, qp, prompts, jnp.zeros((2,), jnp.int32),
+        jax.random.key(4), max_new_tokens=6,
+        sampling=SamplingConfig(temperature=0.0),
+    )
+    assert toks.shape == (2, 6)
+    # Greedy decode from near-identical logits: most tokens match fp.
+    ref = generate(
+        Llama(cfg.decode_config()), params, prompts,
+        jnp.zeros((2,), jnp.int32), jax.random.key(4),
+        max_new_tokens=6, sampling=SamplingConfig(temperature=0.0),
+    )
+    match = float((toks == ref).mean())
+    assert match >= 0.5, f"only {match:.0%} of greedy tokens match fp"
+
+
+def test_lora_tree_rejected():
+    lcfg = dataclasses.replace(BASE, lora_rank=4)
+    params = _params(lcfg)
+    with pytest.raises(ValueError, match="merge_lora"):
+        quantize_params(params)
+
+
+def test_quantized_with_lora_config_rejected():
+    bad = dataclasses.replace(BASE, lora_rank=4, quantized_weights=True)
+    with pytest.raises(ValueError, match="merge"):
+        Llama(bad).init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+
+
+def test_serve_env_flag(clear_tpufw_env):
+    """TPUFW_QUANTIZE=int8 through build_generator: quantized module +
+    params, generation works."""
+    clear_tpufw_env.setenv("TPUFW_MODEL", "llama3_tiny")
+    clear_tpufw_env.setenv("TPUFW_QUANTIZE", "int8")
+
+    from tpufw.infer import generate_text
+    from tpufw.workloads.serve import build_generator
+
+    decode_model, params, cfg, restored = build_generator()
+    assert cfg.quantized_weights
+    assert not restored
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    assert any(
+        getattr(p[-1], "key", None) == "q_kernel" for p, _ in leaves
+    )
+    out = generate_text(decode_model, params, [[3, 4]], max_new_tokens=3)
+    assert len(out) == 1 and len(out[0]) == 3

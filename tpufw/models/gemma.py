@@ -70,6 +70,8 @@ class GemmaConfig:
     # LoRA adapters on attention/MLP projections (see LlamaConfig).
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # Weight-only int8 serving form (see LlamaConfig / tpufw.ops.quant).
+    quantized_weights: bool = False
 
     def decode_config(self) -> "GemmaConfig":
         """Inference dress: KV cache on, remat off, xla attention."""

@@ -80,6 +80,12 @@ class LlamaConfig:
     # base kernels for serving/export.
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # Weight-only int8 serving (tpufw.ops.quant): projection kernels are
+    # stored int8 + per-output-channel scales, halving decode's HBM
+    # weight traffic. Params come from quantize_params on a trained
+    # tree; this flag makes the modules DECLARE the quantized form.
+    # Serving-only — there is no gradient through the rounded weights.
+    quantized_weights: bool = False
 
     def decode_config(self) -> "LlamaConfig":
         """This architecture re-dressed for inference: KV-cache on, remat
@@ -228,10 +234,72 @@ def lora_delta(cfg, x, features, axis, in_names, out_names, name):
     return b * (getattr(cfg, "lora_alpha", 16.0) / r)
 
 
+class QuantDenseGeneral(nn.Module):
+    """DenseGeneral over int8 weights + per-output-channel scales —
+    the serving twin of the fp projection (tpufw.ops.quant). Param
+    shapes match ``quantize_params`` output; logical axes mirror the fp
+    kernel's so sharded serving lays out identically."""
+
+    features: Any
+    axis: Any
+    dtype: Any
+    in_names: tuple
+    out_names: tuple
+
+    @nn.compact
+    def __call__(self, x):
+        from tpufw.ops.quant import quant_contract
+
+        axes = (
+            (self.axis,) if isinstance(self.axis, int) else tuple(self.axis)
+        )
+        n_in = len(axes)
+        in_dims = tuple(x.shape[a] for a in axes)
+        out_dims = (
+            (self.features,)
+            if isinstance(self.features, int)
+            else tuple(self.features)
+        )
+        q = self.param(
+            "q_kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(),
+                (*self.in_names, *self.out_names),
+            ),
+            (*in_dims, *out_dims),
+            jnp.int8,
+        )
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(
+                nn.initializers.ones_init(), self.out_names
+            ),
+            out_dims,
+            jnp.float32,
+        )
+        return quant_contract(x.astype(self.dtype), q, scale, n_in)
+
+
 def projection(cfg, x, features, axis, in_names, out_names, name):
     """Dense projection + optional LoRA delta — the ONE composition every
     adapted matmul (attention q/k/v/o, MLP gate/up/down) goes through.
-    Must be called from inside a compact ``__call__``."""
+    Must be called from inside a compact ``__call__``. With
+    ``cfg.quantized_weights`` the int8 serving twin is declared instead
+    (mutually exclusive with LoRA — merge adapters first)."""
+    if getattr(cfg, "quantized_weights", False):
+        if getattr(cfg, "lora_rank", 0):
+            raise ValueError(
+                "quantized_weights with lora_rank > 0: merge the "
+                "adapters (tools/merge_lora) before quantizing"
+            )
+        return QuantDenseGeneral(
+            features=features,
+            axis=axis,
+            dtype=cfg.dtype,
+            in_names=tuple(in_names),
+            out_names=tuple(out_names),
+            name=name,
+        )(x)
     base = nn.DenseGeneral(
         features=features,
         axis=axis,

@@ -1,0 +1,110 @@
+"""Weight-only int8 quantization for serving.
+
+Autoregressive decode is HBM-bandwidth-bound: every step streams every
+weight once to produce one token per sequence. Storing projection
+weights as int8 + a per-output-channel fp scale halves the bytes moved
+(vs bf16), which is the first-order decode-throughput lever on TPU; the
+matmul itself still runs in the activation dtype (the int8->bf16 cast
+and the scale multiply fuse into the surrounding ops under XLA).
+
+Scope: the seven projection kernels per block (attention q/k/v/o, MLP
+gate/up/down) — the bulk of weight bytes. Embedding (a gather) and the
+LM head stay full precision in v1. Per-OUTPUT-channel symmetric scales
+keep the quantization error independent per output unit, and scaling
+AFTER the contraction is algebraically exact for that granularity.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+#: projection module name -> number of CONTRACTED (input) dims of its
+#: kernel; remaining trailing dims are output channels. Extra LEADING
+#: dims (nn.scan layer stacks, Gemma pair stacks) are batch dims.
+_PROJ_IN_DIMS = {
+    "q": 1, "k": 1, "v": 1, "o": 2,
+    "gate": 1, "up": 1, "down": 1,
+}
+#: unstacked kernel rank per module (leading dims beyond this = stacks).
+_PROJ_RANK = {
+    "q": 3, "k": 3, "v": 3, "o": 3,
+    "gate": 2, "up": 2, "down": 2,
+}
+
+
+def quantize_kernel(w: jax.Array, in_axes: tuple) -> dict:
+    """[*stack, *in, *out] fp kernel -> {"q_kernel" int8, "scale" fp32}
+    with per-output-channel symmetric scales (reduced over ``in_axes``;
+    scale shape = the remaining dims)."""
+    amax = jnp.max(jnp.abs(w), axis=in_axes, keepdims=False)
+    scale = (amax / 127.0 + 1e-12).astype(jnp.float32)
+    # Broadcast scale back across the reduced axes for the division.
+    bshape = list(w.shape)
+    for ax in in_axes:
+        bshape[ax] = 1
+    q = jnp.clip(
+        jnp.round(w / scale.reshape(bshape)), -127, 127
+    ).astype(jnp.int8)
+    return {"q_kernel": q, "scale": scale}
+
+
+def quantize_params(params: Any) -> Any:
+    """Walk a decoder param tree and replace every projection kernel
+    with its int8 form ({"q_kernel", "scale"} in place of {"kernel"}).
+    Handles plain, nn.scan-stacked, and Gemma pair-stacked layouts.
+    Raises if the tree carries LoRA adapters (merge first)."""
+    from tpufw.models.lora import has_lora
+
+    if has_lora(params):
+        raise ValueError(
+            "quantize_params on a LoRA tree: run merge_lora first "
+            "(adapters must fold into the kernels they modify)"
+        )
+    hit = []
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for key, val in node.items():
+            if (
+                key in _PROJ_IN_DIMS
+                and isinstance(val, dict)
+                and "kernel" in val
+                and len(val) == 1
+            ):
+                w = val["kernel"]
+                n_in = _PROJ_IN_DIMS[key]
+                n_stack = w.ndim - _PROJ_RANK[key]
+                in_axes = tuple(range(n_stack, n_stack + n_in))
+                out[key] = quantize_kernel(w, in_axes)
+                hit.append(key)
+            else:
+                out[key] = walk(val)
+        return out
+
+    quantized = walk(params)
+    if not hit:
+        raise ValueError(
+            "quantize_params: no projection kernels found (expected "
+            f"modules named {sorted(_PROJ_IN_DIMS)})"
+        )
+    return quantized
+
+
+def quant_contract(
+    x: jax.Array, q_kernel: jax.Array, scale: jax.Array, n_in: int
+) -> jax.Array:
+    """x ⋅ dequant(kernel): contract x's trailing ``n_in`` dims with the
+    kernel's input dims, then apply the per-output-channel scale. The
+    int8->activation-dtype cast happens here, fused by XLA — HBM only
+    ever streams the int8 bytes."""
+    w = q_kernel.astype(x.dtype)
+    y = jnp.tensordot(
+        x, w,
+        axes=(tuple(range(x.ndim - n_in, x.ndim)), tuple(range(n_in))),
+    )
+    return y * scale.astype(x.dtype)

@@ -366,11 +366,7 @@ def pipeline_forward(
             f"not divisible over data x fsdp = {dp} devices"
         )
 
-    x = params["embed"].astype(cfg.dtype)[tokens]  # [B, T, D]
-    if _is_gemma(cfg):
-        x = x * jnp.asarray(
-            math.sqrt(cfg.d_model), cfg.dtype
-        ).astype(x.dtype)
+    x = _embed(params, tokens, cfg)  # [B, T, D]
     x = x.reshape(m, b // m, t, cfg.d_model)
 
     mb_spec = P(None, (AXIS_DATA, AXIS_FSDP), None, None)
@@ -396,10 +392,7 @@ def pipeline_forward(
     hidden = hidden.reshape(b, t, cfg.d_model)
 
     if return_hidden:
-        fnorm = params["final_norm"]
-        if _is_gemma(cfg):
-            fnorm = fnorm + 1.0
-        return rms_norm(hidden, fnorm, cfg.rms_eps)
+        return _final_norm(params, hidden, cfg)
     return _logits_epilogue(params, hidden, cfg)
 
 
@@ -410,13 +403,30 @@ def _head_kernel(params: dict) -> jax.Array:
     )
 
 
-def _logits_epilogue(params: dict, hidden: jax.Array, cfg) -> jax.Array:
-    """final norm -> head -> optional soft-cap: ONE copy shared by the
-    pipelined and sequential (parity-oracle) forwards."""
+def _embed(params: dict, tokens: jax.Array, cfg) -> jax.Array:
+    """Token embedding lookup incl. Gemma's sqrt(d) scaling — ONE copy
+    for the pipelined and sequential forwards."""
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    if _is_gemma(cfg):
+        x = x * jnp.asarray(
+            math.sqrt(cfg.d_model), cfg.dtype
+        ).astype(x.dtype)
+    return x
+
+
+def _final_norm(params: dict, hidden: jax.Array, cfg) -> jax.Array:
+    """Final RMSNorm incl. Gemma's (1+w) offset — ONE copy for the
+    logits epilogue and the return_hidden (chunked-CE) path."""
     fnorm = params["final_norm"]
     if _is_gemma(cfg):
         fnorm = fnorm + 1.0
-    h = rms_norm(hidden, fnorm, cfg.rms_eps)
+    return rms_norm(hidden, fnorm, cfg.rms_eps)
+
+
+def _logits_epilogue(params: dict, hidden: jax.Array, cfg) -> jax.Array:
+    """final norm -> head -> optional soft-cap: ONE copy shared by the
+    pipelined and sequential (parity-oracle) forwards."""
+    h = _final_norm(params, hidden, cfg)
     logits = h.astype(jnp.float32) @ _head_kernel(params).astype(
         jnp.float32
     )
@@ -452,11 +462,7 @@ def reference_forward(
     """Sequential evaluation of the SAME params (no pipe axis) — the
     parity oracle for the schedule."""
     b, t = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    if _is_gemma(cfg):
-        x = x * jnp.asarray(
-            math.sqrt(cfg.d_model), cfg.dtype
-        ).astype(x.dtype)
+    x = _embed(params, tokens, cfg)
     flat = jax.tree.map(
         lambda a: a.reshape(-1, *a.shape[2:]), params["stages"]
     )

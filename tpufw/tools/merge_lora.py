@@ -5,7 +5,8 @@ Completes the parameter-efficient fine-tune loop::
     python -m tpufw.tools.import_hf <hf-dir> --out base/   # base params
     TPUFW_INIT_FROM=base/ TPUFW_LORA_RANK=16 \\
         python -m tpufw.workloads.train_llama                # adapters
-    python -m tpufw.tools.merge_lora <ckpt> --out merged/ --rank 16
+    python -m tpufw.tools.merge_lora <ckpt> --out merged/ \\
+        --rank 16 --alpha 16
     TPUFW_CHECKPOINT_DIR=... tpufw.workloads.serve           # or export_hf
 
 Accepts either a bare-params tree (tpufw.tools.import_hf output shape)

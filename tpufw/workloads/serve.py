@@ -81,6 +81,7 @@ def build_generator():
             cls = Gemma
         else:
             cls = Llama
+        hf_cfg, params = _maybe_quantize(hf_cfg, params)
         return cls(hf_cfg.decode_config()), params, hf_cfg, True
 
     name = env_str("model", "llama3_600m_bench")
@@ -119,6 +120,7 @@ def build_generator():
             MeshConfig(),
         )
         params, _ = shape_trainer.restore_params(params_dir)
+        model_cfg, params = _maybe_quantize(model_cfg, params)
         return model_cls(model_cfg.decode_config()), params, model_cfg, True
 
     # Reuse the trainer's restore machinery (abstract state + reshard-on-
@@ -140,9 +142,32 @@ def build_generator():
     params = trainer.state.params
     del trainer.state  # drop optimizer moments; serving only needs params
 
+    model_cfg, params = _maybe_quantize(model_cfg, params)
     decode_model = model_cls(model_cfg.decode_config())
     _ = jax  # backend initialized above via Trainer
     return decode_model, params, model_cfg, restored
+
+
+def _maybe_quantize(model_cfg, params):
+    """TPUFW_QUANTIZE=int8: convert projection weights to the int8
+    serving form (tpufw.ops.quant) and flip the config so the modules
+    declare the quantized params. Applied to EVERY build_generator
+    source (HF dir, bare params, TrainState checkpoint)."""
+    import dataclasses as _dc
+
+    mode = env_str("quantize", "")
+    if not mode:
+        return model_cfg, params
+    if mode != "int8":
+        raise ValueError(
+            f"TPUFW_QUANTIZE={mode!r}: only 'int8' is implemented"
+        )
+    from tpufw.ops.quant import quantize_params
+
+    return (
+        _dc.replace(model_cfg, quantized_weights=True),
+        quantize_params(params),
+    )
 
 
 def _bucket(n: int, mult: int) -> int:
