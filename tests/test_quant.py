@@ -131,3 +131,35 @@ def test_serve_env_flag(clear_tpufw_env):
     )
     out = generate_text(decode_model, params, [[3, 4]], max_new_tokens=3)
     assert len(out) == 1 and len(out[0]) == 3
+
+
+def test_mixtral_attention_quantized_experts_fp():
+    """Mixtral: attention projections quantize, MoE expert weights (bare
+    arrays, not {kernel} modules) stay fp — and the forward still runs."""
+    from tpufw.models import MIXTRAL_CONFIGS, Mixtral
+
+    cfg = dataclasses.replace(
+        MIXTRAL_CONFIGS["mixtral_tiny"],
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    params = _params(cfg, Mixtral)
+    qp = quantize_params(params)
+    leaves = jax.tree_util.tree_leaves_with_path(qp)
+    assert any(
+        getattr(p[-1], "key", None) == "q_kernel" for p, _ in leaves
+    )
+    # Expert stacks survive untouched (fp leaves named w_gate/w_up/w_down).
+    kinds = {
+        getattr(p[-1], "key", None): l.dtype
+        for p, l in leaves
+        if getattr(p[-1], "key", None) in ("w_gate", "w_up", "w_down")
+    }
+    assert kinds and all(d == jnp.float32 for d in kinds.values())
+    qcfg = dataclasses.replace(cfg, quantized_weights=True)
+    tokens = jax.random.randint(jax.random.key(9), (2, 17), 0, 256)
+    ref, _ = Mixtral(cfg).apply({"params": params}, tokens)
+    out, _ = Mixtral(qcfg).apply({"params": qp}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref),
+        atol=0.05 * float(np.abs(np.asarray(ref)).max()), rtol=0,
+    )
