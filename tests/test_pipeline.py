@@ -294,3 +294,25 @@ def test_gemma_pipeline_odd_pairs_loud():
     gcfg = dataclasses.replace(GEMMA_CONFIGS["gemma2_tiny"], n_layers=6)
     with pytest.raises(ValueError, match="PAIRS"):
         PipelineConfig(n_stages=2, n_microbatches=2).validate(gcfg, 4)
+
+
+def test_mixtral_pipeline_rejected_loudly():
+    """MixtralConfig subclasses LlamaConfig: without a guard the pipeline
+    would silently build DENSE stacks from an MoE config."""
+    from tpufw.models import MIXTRAL_CONFIGS
+
+    with pytest.raises(NotImplementedError, match="MoE"):
+        PipelineConfig(n_stages=2, n_microbatches=2).validate(
+            MIXTRAL_CONFIGS["mixtral_tiny"], 4
+        )
+
+
+def test_mixtral_rejected_at_every_entry():
+    from tpufw.models import MIXTRAL_CONFIGS
+
+    cfg = MIXTRAL_CONFIGS["mixtral_tiny"]
+    pipe = PipelineConfig(n_stages=2, n_microbatches=2)
+    with pytest.raises(NotImplementedError, match="MoE"):
+        init_pipeline_params(jax.random.key(0), cfg, pipe)
+    with pytest.raises(NotImplementedError, match="MoE"):
+        reference_forward({}, jnp.zeros((1, 4), jnp.int32), cfg)

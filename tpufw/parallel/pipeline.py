@@ -55,6 +55,7 @@ class PipelineConfig:
     n_microbatches: int
 
     def validate(self, model: LlamaConfig, batch_size: int) -> None:
+        _reject_moe(model)
         if model.n_layers % self.n_stages:
             raise ValueError(
                 f"n_layers {model.n_layers} not divisible by "
@@ -81,6 +82,20 @@ class PipelineConfig:
 # ----------------------------------------------------------------------
 
 
+def _reject_moe(cfg) -> None:
+    """MixtralConfig subclasses LlamaConfig, so without this every
+    pipeline entry point would silently build DENSE llama stacks (no
+    experts, no router) from an MoE config."""
+    from tpufw.models.mixtral import MixtralConfig
+
+    if isinstance(cfg, MixtralConfig):
+        raise NotImplementedError(
+            "pipeline parallelism implements Llama and Gemma blocks; "
+            "Mixtral's MoE layers are not pipelined (use the flax "
+            "Trainer with expert parallelism instead)"
+        )
+
+
 def _is_gemma(cfg) -> bool:
     from tpufw.models.gemma import GemmaConfig
 
@@ -95,6 +110,7 @@ def init_pipeline_params(
     Initializers match the flax trunk (normal embed, lecun-style fan-in
     scaling elsewhere); stored in ``cfg.param_dtype``.
     """
+    _reject_moe(cfg)
     s = pipe.n_stages
     lps = cfg.n_layers // s
     d, h, kh, dh, f = (
@@ -461,6 +477,7 @@ def reference_forward(
 ) -> jax.Array:
     """Sequential evaluation of the SAME params (no pipe axis) — the
     parity oracle for the schedule."""
+    _reject_moe(cfg)
     b, t = tokens.shape
     x = _embed(params, tokens, cfg)
     flat = jax.tree.map(
