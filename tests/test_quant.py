@@ -163,3 +163,18 @@ def test_mixtral_attention_quantized_experts_fp():
         np.asarray(out), np.asarray(ref),
         atol=0.05 * float(np.abs(np.asarray(ref)).max()), rtol=0,
     )
+
+
+def test_lm_head_quantized_when_untied():
+    """The dedicated LM head ([D, V], decode's biggest matmul) is part of
+    the int8 form; tied (Gemma) embeddings stay fp."""
+    params = _params(BASE)
+    qp = quantize_params(params)
+    assert "q_kernel" in qp["lm_head"]
+    assert qp["lm_head"]["q_kernel"].dtype == jnp.int8
+    gcfg = dataclasses.replace(
+        GEMMA_CONFIGS["gemma2_tiny"],
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    gqp = quantize_params(_params(gcfg, Gemma))
+    assert gqp["embed"]["embedding"].dtype == jnp.float32

@@ -556,6 +556,15 @@ def decoder_lm(
         return (x, aux) if with_aux else x
     if cfg.tie_embeddings:
         logits = embed.attend(x.astype(jnp.float32))
+    elif getattr(cfg, "quantized_weights", False):
+        logits = QuantDenseGeneral(
+            features=cfg.vocab_size,
+            axis=-1,
+            dtype=jnp.float32,
+            in_names=("embed",),
+            out_names=("vocab",),
+            name="lm_head",
+        )(x)
     else:
         logits = nn.DenseGeneral(
             features=cfg.vocab_size,

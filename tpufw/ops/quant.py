@@ -8,8 +8,9 @@ matmul itself still runs in the activation dtype (the int8->bf16 cast
 and the scale multiply fuse into the surrounding ops under XLA).
 
 Scope: the seven projection kernels per block (attention q/k/v/o, MLP
-gate/up/down) — the bulk of weight bytes. Embedding (a gather) and the
-LM head stay full precision in v1. Per-OUTPUT-channel symmetric scales
+gate/up/down) plus the dedicated LM head. Embeddings stay full
+precision (a gather, and for tied heads the two uses want incompatible
+scale granularities). Per-OUTPUT-channel symmetric scales
 keep the quantization error independent per output unit, and scaling
 AFTER the contraction is algebraically exact for that granularity.
 """
@@ -27,11 +28,16 @@ import jax.numpy as jnp
 _PROJ_IN_DIMS = {
     "q": 1, "k": 1, "v": 1, "o": 2,
     "gate": 1, "up": 1, "down": 1,
+    # The dedicated LM head ([D, V]) is the largest single matmul a
+    # decode step streams; tied (Gemma) embeddings stay fp — the gather
+    # and the attend contraction want incompatible scale granularities.
+    "lm_head": 1,
 }
 #: unstacked kernel rank per module (leading dims beyond this = stacks).
 _PROJ_RANK = {
     "q": 3, "k": 3, "v": 3, "o": 3,
     "gate": 2, "up": 2, "down": 2,
+    "lm_head": 2,
 }
 
 
