@@ -23,6 +23,10 @@ class StepMetrics:
     step_time_s: float
     tokens_per_sec_per_chip: float
     mfu: float
+    # Host time spent waiting on the data iterator BEFORE this step —
+    # input-boundness is invisible in step_time (the fetch happens
+    # between steps), so it gets its own number.
+    data_wait_s: float = 0.0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -52,7 +56,9 @@ class Meter:
     def start(self) -> None:
         self._t0 = time.perf_counter()
 
-    def stop(self, step: int, loss: float) -> StepMetrics:
+    def stop(
+        self, step: int, loss: float, data_wait_s: float = 0.0
+    ) -> StepMetrics:
         if self._t0 is None:
             raise RuntimeError("Meter.stop() without start()")
         dt = time.perf_counter() - self._t0
@@ -65,4 +71,19 @@ class Meter:
             step_time_s=dt,
             tokens_per_sec_per_chip=tps_chip,
             mfu=mfu,
+            data_wait_s=data_wait_s,
         )
+
+
+def timed_batches(data):
+    """Wrap an iterator, yielding (data_wait_s, batch) — the ONE place
+    host blocking on the input pipeline is measured (all three trainer
+    loops use it)."""
+    it = iter(data)
+    while True:
+        t0 = time.perf_counter()
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        yield time.perf_counter() - t0, batch

@@ -39,7 +39,7 @@ from tpufw.parallel.pipeline import (
     pipeline_loss,
     pipeline_param_shardings,
 )
-from tpufw.train.metrics import Meter, StepMetrics
+from tpufw.train.metrics import Meter, StepMetrics, timed_batches
 from tpufw.train.trainer import (
     TrainerConfig,
     default_optimizer,
@@ -326,7 +326,7 @@ class PipelineTrainer:
         remaining = max(0, self.cfg.total_steps - int(self.state.step))
         history: list[StepMetrics] = []
         try:
-            for i, batch in enumerate(data):
+            for i, (wait, batch) in enumerate(timed_batches(data)):
                 if i >= remaining:
                     break
                 prof.maybe_start(i)
@@ -337,7 +337,9 @@ class PipelineTrainer:
                         self.state, batch
                     )
                     loss = jax.block_until_ready(m["loss"])
-                sm = meter.stop(int(self.state.step), loss)
+                sm = meter.stop(
+                    int(self.state.step), loss, data_wait_s=wait
+                )
                 prof.maybe_stop(i)
                 history.append(sm)
                 if on_metrics and (i % self.cfg.log_every == 0):

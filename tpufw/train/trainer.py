@@ -24,7 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpufw.mesh import MeshConfig, build_mesh, logical_axis_rules
 from tpufw.parallel.context import use_mesh
-from tpufw.train.metrics import Meter, StepMetrics
+from tpufw.train.metrics import Meter, StepMetrics, timed_batches
 
 
 class TrainState(struct.PyTreeNode):
@@ -739,7 +739,7 @@ class Trainer:
         history: list[StepMetrics] = []
         try:
             with use_mesh(self.mesh):
-                for i, batch in enumerate(data):
+                for i, (wait, batch) in enumerate(timed_batches(data)):
                     if i >= remaining:
                         break
                     batch = self.globalize_batch(batch)
@@ -749,7 +749,9 @@ class Trainer:
                     with prof.step(i):
                         self.state, m = step_fn(self.state, batch)
                         loss = jax.block_until_ready(m["loss"])
-                    sm = meter.stop(int(self.state.step), loss)
+                    sm = meter.stop(
+                        int(self.state.step), loss, data_wait_s=wait
+                    )
                     prof.maybe_stop(i)
                     history.append(sm)
                     if on_metrics and (i % self.cfg.log_every == 0):

@@ -21,7 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpufw.mesh import MeshConfig, build_mesh
 from tpufw.parallel.context import use_mesh
-from tpufw.train.metrics import Meter, StepMetrics
+from tpufw.train.metrics import Meter, StepMetrics, timed_batches
 from tpufw.train.trainer import state_shardings
 
 
@@ -234,14 +234,16 @@ class VisionTrainer:
         history = []
         try:
             with use_mesh(self.mesh):
-                for i, batch in enumerate(data):
+                for i, (wait, batch) in enumerate(timed_batches(data)):
                     if i >= remaining:
                         break
                     batch = globalize_batch(self.mesh, batch)
                     meter.start()
                     self.state, m = step_fn(self.state, batch)
                     loss = jax.block_until_ready(m["loss"])
-                    sm = meter.stop(int(self.state.step), loss)
+                    sm = meter.stop(
+                        int(self.state.step), loss, data_wait_s=wait
+                    )
                     history.append(sm)
                     if on_metrics:
                         on_metrics(sm)
