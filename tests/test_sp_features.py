@@ -137,10 +137,12 @@ def test_ulysses_cap_window(devices8, window):
     _check_grads(out, ref, q, k, v)
 
 
-def test_gemma_ring_backend_matches_xla(devices8):
-    """Whole-model check: tiny Gemma (caps + alternating windows) with
-    attention_backend='ring' on the sequence-sharded mesh equals the
-    single-device xla forward."""
+@pytest.mark.parametrize("backend", ["ring", "ulysses"])
+def test_gemma_sp_backend_matches_xla(devices8, backend):
+    """Whole-model check: tiny Gemma (caps + alternating windows) with a
+    sequence-parallel attention backend on the sharded mesh equals the
+    single-device xla forward. Ulysses also exercises the GQA repeat (2
+    kv heads over the 4-device sequence axis)."""
     import dataclasses
 
     from tpufw.models import GEMMA_CONFIGS, Gemma
@@ -158,7 +160,7 @@ def test_gemma_ring_backend_matches_xla(devices8):
         params = Gemma(cfg).init(jax.random.key(3), tokens)
         ref = Gemma(cfg).apply(params, tokens)
         out = Gemma(
-            dataclasses.replace(cfg, attention_backend="ring")
+            dataclasses.replace(cfg, attention_backend=backend)
         ).apply(params, tokens)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5

@@ -129,3 +129,34 @@ def test_packed_data_through_flash_backend(devices8):
         losses["flash"], losses["xla"], rtol=2e-4,
         err_msg="flash-vs-xla packed loss diverged",
     )
+
+
+def test_data_wait_is_measured(devices8):
+    """data_wait_s reflects host blocking in the data iterator — a
+    deliberately slow iterator must show up in the telemetry."""
+    import time as _time
+
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import LLAMA_CONFIGS, Llama
+    from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+    tiny = LLAMA_CONFIGS["llama3_tiny"]
+
+    def slow(inner, delay):
+        for b in inner:
+            _time.sleep(delay)
+            yield b
+
+    trainer = Trainer(
+        Llama(tiny),
+        TrainerConfig(batch_size=8, seq_len=17, total_steps=3, lr=1e-3),
+        MeshConfig(data=8),
+    )
+    trainer.init_state()
+    hist = trainer.run(
+        slow(synthetic_batches(8, 17, tiny.vocab_size), 0.05),
+        model_flops_per_token=tiny.flops_per_token(16),
+    )
+    assert all(m.data_wait_s >= 0.04 for m in hist), [
+        m.data_wait_s for m in hist
+    ]
