@@ -282,3 +282,28 @@ def test_serve_gemma_hf_checkpoint_dir(hf_gemma, tmp_path, clear_tpufw_env):
 
     out = generate_text(decode_model, params, [[3, 4]], max_new_tokens=3)
     assert len(out) == 1 and len(out[0]) == 3
+
+
+def test_export_hf_roundtrip(hf_gemma, tmp_path):
+    """tpufw Gemma params -> export_hf dir -> transformers from_pretrained
+    -> logits parity. Closes the export half (import parity is above)."""
+    from tpufw.tools.import_hf import config_from_hf, export_hf, from_hf
+
+    cfg = dataclasses.replace(
+        config_from_hf(hf_gemma.config),
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    params = from_hf(hf_gemma, cfg)
+    out_dir = str(tmp_path / "export")
+    info = export_hf(params, cfg, out_dir)
+    assert info["n_tensors"] > 0
+
+    reloaded = transformers.Gemma2ForCausalLM.from_pretrained(out_dir)
+    reloaded.eval()
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 48), dtype=np.int64)
+    with torch.no_grad():
+        want = hf_gemma(torch.from_numpy(tokens)).logits.numpy()
+        got = reloaded(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)

@@ -413,6 +413,21 @@ def hf_config_dict(cfg: LlamaConfig) -> dict:
             num_experts_per_tok=cfg.experts_per_token,
         )
         out.pop("mlp_bias")
+    from tpufw.models.gemma import GemmaConfig
+
+    if isinstance(cfg, GemmaConfig):
+        out.update(
+            model_type="gemma2",
+            architectures=["Gemma2ForCausalLM"],
+            hidden_activation="gelu_pytorch_tanh",
+            attn_logit_softcapping=cfg.attn_logit_soft_cap,
+            final_logit_softcapping=cfg.final_logit_soft_cap,
+            sliding_window=cfg.sliding_window,
+            query_pre_attn_scalar=cfg.query_pre_attn_scalar,
+            tie_word_embeddings=True,
+        )
+        out.pop("mlp_bias")
+        out.pop("hidden_act")
     return out
 
 
@@ -424,22 +439,14 @@ def to_hf(params: dict, cfg: LlamaConfig) -> dict[str, np.ndarray]:
     from tpufw.models.mixtral import MixtralConfig
 
     if isinstance(cfg, GemmaConfig):
-        raise NotImplementedError(
-            "to_hf/export_hf cover Llama/Mixtral; Gemma export is not "
-            "implemented (import IS: from_hf/config_from_hf)"
-        )
+        return _gemma_to_hf(params, cfg)
     is_moe = isinstance(cfg, MixtralConfig)
     d = cfg.d_model
 
-    def np32(x) -> np.ndarray:
-        return np.asarray(x, np.float32)
+    np32 = _np32
 
     def layer_tree(i: int) -> Mapping:
-        if cfg.scan_layers:
-            import jax
-
-            return jax.tree.map(lambda x: x[i], params["layers"])
-        return params[f"layer_{i}"]
+        return _slice_stack(params, "layer_", cfg.scan_layers, i)
 
     sd: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np32(params["embed"]["embedding"]),
@@ -450,22 +457,10 @@ def to_hf(params: dict, cfg: LlamaConfig) -> dict[str, np.ndarray]:
     for i in range(cfg.n_layers):
         lp = layer_tree(i)
         pre = f"model.layers.{i}."
-        attn = lp["attn"]
         sd[pre + "input_layernorm.weight"] = np32(
             lp["attn_norm"]["scale"]
         )
-        sd[pre + "self_attn.q_proj.weight"] = (
-            np32(attn["q"]["kernel"]).reshape(d, -1).T
-        )
-        sd[pre + "self_attn.k_proj.weight"] = (
-            np32(attn["k"]["kernel"]).reshape(d, -1).T
-        )
-        sd[pre + "self_attn.v_proj.weight"] = (
-            np32(attn["v"]["kernel"]).reshape(d, -1).T
-        )
-        sd[pre + "self_attn.o_proj.weight"] = (
-            np32(attn["o"]["kernel"]).reshape(-1, d).T
-        )
+        _emit_attn(sd, pre, lp, d)
         norm_key = "moe_norm" if is_moe else "mlp_norm"
         sd[pre + "post_attention_layernorm.weight"] = np32(
             lp[norm_key]["scale"]
@@ -481,14 +476,84 @@ def to_hf(params: dict, cfg: LlamaConfig) -> dict[str, np.ndarray]:
                 sd[ep + "w3.weight"] = np32(moe["w_up"][e]).T
                 sd[ep + "w2.weight"] = np32(moe["w_down"][e]).T
         else:
-            mlp = lp["mlp"]
-            sd[pre + "mlp.gate_proj.weight"] = np32(
-                mlp["gate"]["kernel"]
-            ).T
-            sd[pre + "mlp.up_proj.weight"] = np32(mlp["up"]["kernel"]).T
-            sd[pre + "mlp.down_proj.weight"] = np32(
-                mlp["down"]["kernel"]
-            ).T
+            _emit_mlp(sd, pre, lp)
+    return sd
+
+
+def _np32(x) -> np.ndarray:
+    return np.asarray(x, np.float32)
+
+
+def _slice_stack(params: dict, key_prefix: str, scan_layers: bool, i: int):
+    """Layer/pair ``i`` of the (possibly scan-stacked) block params."""
+    if scan_layers:
+        import jax
+
+        return jax.tree.map(lambda x: x[i], params["layers"])
+    return params[f"{key_prefix}{i}"]
+
+
+def _emit_attn(sd: dict, pre: str, lp: Mapping, d: int) -> None:
+    """q/k/v/o -> HF [out, in] keys; ONE copy for every export branch."""
+    attn = lp["attn"]
+    sd[pre + "self_attn.q_proj.weight"] = (
+        _np32(attn["q"]["kernel"]).reshape(d, -1).T
+    )
+    sd[pre + "self_attn.k_proj.weight"] = (
+        _np32(attn["k"]["kernel"]).reshape(d, -1).T
+    )
+    sd[pre + "self_attn.v_proj.weight"] = (
+        _np32(attn["v"]["kernel"]).reshape(d, -1).T
+    )
+    sd[pre + "self_attn.o_proj.weight"] = (
+        _np32(attn["o"]["kernel"]).reshape(-1, d).T
+    )
+
+
+def _emit_mlp(sd: dict, pre: str, lp: Mapping) -> None:
+    """Dense gate/up/down -> HF keys (Llama and Gemma blocks)."""
+    mlp = lp["mlp"]
+    sd[pre + "mlp.gate_proj.weight"] = _np32(mlp["gate"]["kernel"]).T
+    sd[pre + "mlp.up_proj.weight"] = _np32(mlp["up"]["kernel"]).T
+    sd[pre + "mlp.down_proj.weight"] = _np32(mlp["down"]["kernel"]).T
+
+
+def _gemma_to_hf(params: dict, cfg) -> dict[str, np.ndarray]:
+    """Inverse of ``_gemma_from_hf``: pair p "local" -> HF layer 2p,
+    "global" -> 2p+1; norm offsets copy directly (both sides store the
+    offset-from-1); tied embeddings mean no lm_head tensor."""
+    d = cfg.d_model
+    np32 = _np32
+
+    if not cfg.tie_embeddings:
+        raise NotImplementedError(
+            "Gemma export assumes tied embeddings (every released "
+            "Gemma-2 checkpoint ties them); exporting an untied tree "
+            "would silently re-tie the head to the embedding"
+        )
+
+    def pair_tree(p: int) -> Mapping:
+        return _slice_stack(params, "layer_", cfg.scan_layers, p)
+
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np32(params["embed"]["embedding"]),
+        "model.norm.weight": np32(params["final_norm"]["scale"]),
+    }
+    norms = {
+        "pre_attn_norm": "input_layernorm",
+        "post_attn_norm": "post_attention_layernorm",
+        "pre_mlp_norm": "pre_feedforward_layernorm",
+        "post_mlp_norm": "post_feedforward_layernorm",
+    }
+    for p in range(cfg.n_layers // 2):
+        pt = pair_tree(p)
+        for which, i in (("local", 2 * p), ("global", 2 * p + 1)):
+            lp = pt[which]
+            pre = f"model.layers.{i}."
+            for ours, theirs in norms.items():
+                sd[pre + theirs + ".weight"] = np32(lp[ours]["scale"])
+            _emit_attn(sd, pre, lp, d)
+            _emit_mlp(sd, pre, lp)
     return sd
 
 
