@@ -356,3 +356,80 @@ def test_generate_from_imported_weights(hf_model):
         dmodel, params, [[5, 6, 7], [9]], max_new_tokens=4
     )
     assert len(out) == 2 and all(len(o) == 4 for o in out)
+
+
+def test_cli_export_roundtrip(hf_model, tmp_path):
+    """import CLI -> export CLI -> transformers reload: the full
+    orbax<->HF loop through the command-line surface."""
+    from tpufw.tools.import_hf import main as cli
+
+    ckpt = tmp_path / "hf-src"
+    hf_model.save_pretrained(str(ckpt), safe_serialization=True)
+    orbax_dir = str(tmp_path / "orbax")
+    assert cli([str(ckpt), "--out", orbax_dir]) == 0
+
+    # The tiny fixture matches llama3_tiny's architecture exactly.
+    out_dir = str(tmp_path / "hf-out")
+    assert cli(
+        [orbax_dir, "--out", out_dir, "--export", "llama3_tiny"]
+    ) == 0
+    reloaded = transformers.LlamaForCausalLM.from_pretrained(out_dir)
+    reloaded.eval()
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, 256, (2, 17), dtype=np.int64)
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens)).logits.numpy()
+        got = reloaded(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+
+def test_cli_export_from_trainstate_checkpoint(tmp_path):
+    """--export on a training checkpoint step dir restores ONLY the
+    params item (PLACEHOLDER skips step/opt_state) and writes a loadable
+    HF dir."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import LLAMA_CONFIGS, Llama
+    from tpufw.tools.import_hf import main as cli
+    from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+    tiny = LLAMA_CONFIGS["llama3_tiny"]
+    ckpt = str(tmp_path / "train-ckpt")
+    trainer = Trainer(
+        Llama(tiny),
+        TrainerConfig(
+            batch_size=8, seq_len=17, total_steps=2, lr=1e-3,
+            checkpoint_dir=ckpt, checkpoint_every=1,
+        ),
+        MeshConfig(data=jax.device_count()),
+    )
+    trainer.init_state()
+    trainer.run(
+        synthetic_batches(8, 17, tiny.vocab_size),
+        model_flops_per_token=tiny.flops_per_token(16),
+    )
+    step_dir = os.path.join(ckpt, str(int(trainer.state.step)))
+    out_dir = str(tmp_path / "hf-out")
+    assert cli(
+        [step_dir, "--out", out_dir, "--export", "llama3_tiny"]
+    ) == 0
+
+    reloaded = transformers.LlamaForCausalLM.from_pretrained(out_dir)
+    reloaded.eval()
+    tokens = np.random.default_rng(4).integers(0, 256, (2, 17))
+    with torch.no_grad():
+        got = reloaded(torch.from_numpy(tokens)).logits.numpy()
+    want = Llama(
+        __import__("dataclasses").replace(
+            tiny, dtype=jnp.float32, param_dtype=jnp.float32
+        )
+    ).apply({"params": trainer.state.params},
+            jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(
+        got, np.asarray(want),
+        atol=0.01 * float(np.abs(np.asarray(want)).max()), rtol=0,
+    )

@@ -95,6 +95,12 @@ def _build_dataclass(cls, section: str, given: dict):
     return cls(**given)
 
 
+def resolve_model_preset(preset: str):
+    """Public preset-name -> model config resolution (the ONE registry;
+    the import/export CLI uses it too)."""
+    return _resolve_preset(preset)
+
+
 def _resolve_preset(preset: str):
     from tpufw.configs.presets import BENCH_CONFIG_NAME, bench_model_config
     from tpufw.models import GEMMA_CONFIGS, LLAMA_CONFIGS, MIXTRAL_CONFIGS

@@ -446,7 +446,7 @@ def to_hf(params: dict, cfg: LlamaConfig) -> dict[str, np.ndarray]:
     np32 = _np32
 
     def layer_tree(i: int) -> Mapping:
-        return _slice_stack(params, "layer_", cfg.scan_layers, i)
+        return _slice_stack(params, cfg.scan_layers, i)
 
     sd: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np32(params["embed"]["embedding"]),
@@ -484,13 +484,13 @@ def _np32(x) -> np.ndarray:
     return np.asarray(x, np.float32)
 
 
-def _slice_stack(params: dict, key_prefix: str, scan_layers: bool, i: int):
+def _slice_stack(params: dict, scan_layers: bool, i: int):
     """Layer/pair ``i`` of the (possibly scan-stacked) block params."""
     if scan_layers:
         import jax
 
         return jax.tree.map(lambda x: x[i], params["layers"])
-    return params[f"{key_prefix}{i}"]
+    return params[f"layer_{i}"]
 
 
 def _emit_attn(sd: dict, pre: str, lp: Mapping, d: int) -> None:
@@ -533,7 +533,7 @@ def _gemma_to_hf(params: dict, cfg) -> dict[str, np.ndarray]:
         )
 
     def pair_tree(p: int) -> Mapping:
-        return _slice_stack(params, "layer_", cfg.scan_layers, p)
+        return _slice_stack(params, cfg.scan_layers, p)
 
     sd: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np32(params["embed"]["embedding"]),
@@ -562,6 +562,10 @@ def export_hf(params: dict, cfg: LlamaConfig, out_dir: str) -> dict:
     ``transformers.*ForCausalLM.from_pretrained`` loads directly."""
     from safetensors.numpy import save_file
 
+    # Map BEFORE touching the filesystem: a validation error (e.g. an
+    # untied Gemma tree) must not leave a half-written dir with a
+    # config.json that from_pretrained then fails on confusingly.
+    sd = to_hf(params, cfg)
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, "config.json"), "w") as f:
         json.dump(hf_config_dict(cfg), f, indent=2)
@@ -571,7 +575,6 @@ def export_hf(params: dict, cfg: LlamaConfig, out_dir: str) -> dict:
     # every projection (caught by the transformers-reload parity test).
     # Replace per key so each fp32 base buffer is dropped as soon as its
     # contiguous copy exists (peak ~one model copy, not two).
-    sd = to_hf(params, cfg)
     for k in list(sd):
         sd[k] = np.ascontiguousarray(sd[k])
     save_file(sd, os.path.join(out_dir, "model.safetensors"))
@@ -583,22 +586,97 @@ def export_hf(params: dict, cfg: LlamaConfig, out_dir: str) -> dict:
 
 
 def main(argv=None) -> int:
-    """CLI: convert an HF checkpoint dir to an Orbax checkpoint dir."""
+    """CLI. Default: HF checkpoint dir -> Orbax params dir. With
+    ``--export MODEL``: the reverse — an Orbax bare-params dir (or a
+    training TrainState checkpoint step dir) -> an HF checkpoint dir
+    ``from_pretrained`` loads."""
     import argparse
 
     ap = argparse.ArgumentParser(
         prog="tpufw.tools.import_hf",
-        description="HF Llama checkpoint -> tpufw params (Orbax)",
+        description="HF checkpoint <-> tpufw params (Orbax)",
     )
-    ap.add_argument("src", help="HF checkpoint dir (config.json + *.safetensors)")
-    ap.add_argument("--out", required=True, help="Orbax checkpoint dir")
+    ap.add_argument(
+        "src",
+        help="HF checkpoint dir (config.json + *.safetensors); with "
+             "--export, an Orbax params / TrainState checkpoint dir",
+    )
+    ap.add_argument("--out", required=True, help="output dir")
+    ap.add_argument(
+        "--export",
+        metavar="MODEL",
+        default=None,
+        help="reverse direction: export the Orbax tree at SRC as an HF "
+             "checkpoint; MODEL names the architecture preset "
+             "(LLAMA_CONFIGS / MIXTRAL_CONFIGS / GEMMA_CONFIGS)",
+    )
     args = ap.parse_args(argv)
+
+    import orbax.checkpoint as ocp
+
+    if args.export:
+        if args.export.endswith((".yaml", ".yml")):
+            # YAML of record: honors model.overrides, so the exported
+            # config.json matches what was actually trained (a bare
+            # preset name would silently drop e.g. a rope_theta
+            # override).
+            from tpufw.configs.loader import load_run_config
+
+            cfg = load_run_config(args.export).model_cfg
+        else:
+            from tpufw.configs.loader import resolve_model_preset
+
+            cfg = resolve_model_preset(args.export)
+        src = os.path.abspath(args.src)
+        if os.path.isdir(os.path.join(src, "default")):
+            src = os.path.join(src, "default")  # CheckpointManager step
+        with ocp.StandardCheckpointer() as ckptr:
+            meta_tree = ckptr.metadata(src).item_metadata.tree
+        if isinstance(meta_tree, dict) and "params" in meta_tree:
+            # TrainState checkpoint: restore ONLY the params item —
+            # PLACEHOLDER leaves (step, Adam moments, ~2x params) are
+            # skipped, keeping peak memory at one model copy. Arrays
+            # come back as host numpy (no device or sharding needed —
+            # export is a host-side serialization job).
+            import jax
+
+            def abstract(m):
+                return jax.ShapeDtypeStruct(tuple(m.shape), m.dtype)
+
+            target = {
+                k: jax.tree.map(
+                    abstract if k == "params"
+                    else (lambda _: ocp.PLACEHOLDER),
+                    v,
+                )
+                for k, v in meta_tree.items()
+            }
+
+            def rargs(x):
+                if x is ocp.PLACEHOLDER:
+                    return ocp.RestoreArgs()
+                return ocp.ArrayRestoreArgs(restore_type=np.ndarray)
+
+            restore_args = jax.tree.map(
+                rargs, target, is_leaf=lambda x: x is ocp.PLACEHOLDER
+            )
+            with ocp.PyTreeCheckpointer() as ckptr:
+                params = ckptr.restore(
+                    src,
+                    ocp.args.PyTreeRestore(
+                        item=target, restore_args=restore_args
+                    ),
+                )["params"]
+        else:
+            with ocp.StandardCheckpointer() as ckptr:
+                params = ckptr.restore(src)
+        info = export_hf(params, cfg, args.out)
+        print(json.dumps(info))
+        return 0
 
     with open(os.path.join(args.src, "config.json")) as f:
         cfg = config_from_hf(json.load(f))
     params = from_hf_llama(args.src, cfg)
-
-    import orbax.checkpoint as ocp
 
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.abspath(args.out), params)
