@@ -231,3 +231,80 @@ def test_merge_cli_on_trainstate_checkpoint(tmp_path, devices8):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(tuned), atol=1e-5, rtol=1e-5
     )
+
+
+def test_full_interop_loop(tmp_path, devices8):
+    """Capstone: HF import -> LoRA fine-tune -> merge -> HF export ->
+    transformers reload reproduces the fine-tuned logits. Every interop
+    surface in one chain."""
+    import torch
+    import transformers
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16,
+        max_position_embeddings=128, rope_theta=500000.0,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+    )
+    torch.manual_seed(1)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg)
+    hf_model.eval()
+
+    from tpufw.tools.import_hf import config_from_hf, export_hf, from_hf
+
+    cfg = dataclasses.replace(
+        config_from_hf(hf_cfg),
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    base_params = from_hf(hf_model, cfg)
+
+    import orbax.checkpoint as ocp
+
+    base_dir = str(tmp_path / "base")
+    with ocp.StandardCheckpointer() as ck:
+        ck.save(base_dir, base_params)
+
+    lcfg = dataclasses.replace(cfg, lora_rank=4)
+    trainer = Trainer(
+        Llama(lcfg),
+        TrainerConfig(batch_size=8, seq_len=17, total_steps=3, lr=1e-2),
+        MeshConfig(data=2, fsdp=4),
+    )
+    trainer.init_from_params(base_dir)
+    trainer.run(
+        synthetic_batches(8, 17, lcfg.vocab_size),
+        model_flops_per_token=lcfg.flops_per_token(16),
+    )
+    tuned_params = jax.tree.map(np.asarray, trainer.state.params)
+    merged = merge_lora(tuned_params, alpha=lcfg.lora_alpha)
+
+    out_dir = str(tmp_path / "hf-out")
+    export_hf(merged, cfg, out_dir)
+    reloaded = transformers.LlamaForCausalLM.from_pretrained(out_dir)
+    reloaded.eval()
+
+    tokens = np.random.default_rng(7).integers(0, 256, (2, 17))
+    want = Llama(cfg).apply(
+        {"params": merged}, jnp.asarray(tokens, jnp.int32)
+    )
+    with torch.no_grad():
+        got = reloaded(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(
+        got, np.asarray(want), atol=2e-4, rtol=2e-3
+    )
+    # And the fine-tune actually moved the weights off the base.
+    base_out = hf_model(torch.from_numpy(tokens)).logits.detach().numpy()
+    assert np.abs(got - base_out).max() > 1e-3
+
+
+def test_export_unmerged_lora_is_loud():
+    from flax.core import meta
+
+    from tpufw.tools.import_hf import to_hf
+
+    params = meta.unbox(
+        Llama(LORA).init(jax.random.key(0), _tokens())
+    )["params"]
+    with pytest.raises(ValueError, match="merge_lora"):
+        to_hf(params, LORA)

@@ -436,8 +436,18 @@ def to_hf(params: dict, cfg: LlamaConfig) -> dict[str, np.ndarray]:
     (numpy fp32, HF [out, in] Linear layout, ``model.``-prefixed keys).
     Accepts both scan-stacked and per-layer trees."""
     from tpufw.models.gemma import GemmaConfig
+    from tpufw.models.lora import has_lora
     from tpufw.models.mixtral import MixtralConfig
 
+    if has_lora(params):
+        # The emitters read only base kernels; exporting an un-merged
+        # LoRA tree would silently ship the FROZEN base and drop the
+        # entire fine-tune.
+        raise ValueError(
+            "to_hf/export_hf on a LoRA tree: run "
+            "tpufw.tools.merge_lora first (adapters must fold into the "
+            "kernels they modify)"
+        )
     if isinstance(cfg, GemmaConfig):
         return _gemma_to_hf(params, cfg)
     is_moe = isinstance(cfg, MixtralConfig)
