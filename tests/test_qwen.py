@@ -1,0 +1,162 @@
+"""Qwen-2.5 family (Llama trunk + qkv biases): HF parity + interop.
+
+The bias is the single architectural delta, so the logits-parity test
+against a real Qwen2ForCausalLM pins it (a dropped or misreshaped bias
+shows up immediately), and the export round trip proves the inverse.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from flax.core import meta
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from tpufw.models import LLAMA_CONFIGS, Llama  # noqa: E402
+from tpufw.tools.import_hf import (  # noqa: E402
+    config_from_hf,
+    export_hf,
+    from_hf,
+)
+
+TINY = dataclasses.replace(
+    LLAMA_CONFIGS["qwen25_tiny"], dtype=jnp.float32, param_dtype=jnp.float32
+)
+
+
+@pytest.fixture(scope="module")
+def hf_qwen():
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_theta=500000.0,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        use_sliding_window=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen2ForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_config_mapping(hf_qwen):
+    cfg = config_from_hf(hf_qwen.config)
+    assert cfg.attention_qkv_bias
+    assert cfg.d_model == 64 and cfg.n_kv_heads == 2
+    assert not cfg.tie_embeddings
+
+
+def test_param_count_matches_analytic():
+    params = meta.unbox(
+        Llama(TINY).init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    assert n == TINY.n_params()
+
+
+@pytest.mark.parametrize("scan_layers", [True, False])
+def test_hf_logits_parity(hf_qwen, scan_layers):
+    cfg = dataclasses.replace(
+        config_from_hf(hf_qwen.config),
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        scan_layers=scan_layers,
+        remat=False,
+    )
+    params = from_hf(hf_qwen, cfg)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 17), dtype=np.int64)
+    with torch.no_grad():
+        want = hf_qwen(torch.from_numpy(tokens)).logits.numpy()
+    got = Llama(cfg).apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), want, atol=2e-4, rtol=2e-3
+    )
+
+
+def test_export_roundtrip(hf_qwen, tmp_path):
+    cfg = dataclasses.replace(
+        config_from_hf(hf_qwen.config),
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    params = from_hf(hf_qwen, cfg)
+    out_dir = str(tmp_path / "export")
+    export_hf(params, cfg, out_dir)
+    reloaded = transformers.Qwen2ForCausalLM.from_pretrained(out_dir)
+    reloaded.eval()
+    tokens = np.random.default_rng(2).integers(0, 256, (2, 17))
+    with torch.no_grad():
+        want = hf_qwen(torch.from_numpy(tokens)).logits.numpy()
+        got = reloaded(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+
+def test_quantized_forward_keeps_biases():
+    from tpufw.ops.quant import quantize_params
+
+    params = meta.unbox(
+        Llama(TINY).init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    tokens = jax.random.randint(jax.random.key(2), (2, 17), 0, 256)
+    ref = Llama(TINY).apply({"params": params}, tokens)
+    qp = quantize_params(params)
+    # qkv kernels quantize, their biases survive as fp.
+    q_mod = jax.tree.leaves(
+        {"q": qp["layers"]["attn"]["q"]}
+    )
+    assert qp["layers"]["attn"]["q"]["q_kernel"].dtype == jnp.int8
+    assert qp["layers"]["attn"]["q"]["bias"].dtype == jnp.float32
+    del q_mod
+    qcfg = dataclasses.replace(TINY, quantized_weights=True)
+    out = Llama(qcfg).apply({"params": qp}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref),
+        atol=0.05 * float(np.abs(np.asarray(ref)).max()), rtol=0,
+    )
+
+
+def test_generate_decodes():
+    from tpufw.infer import SamplingConfig, generate
+
+    params = meta.unbox(
+        Llama(TINY).init(jax.random.key(3), jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    model = Llama(TINY.decode_config())
+    prompts = jax.random.randint(jax.random.key(4), (2, 12), 0, 256)
+    toks = generate(
+        model, params, prompts, jnp.zeros((2,), jnp.int32),
+        jax.random.key(5), max_new_tokens=6,
+        sampling=SamplingConfig(temperature=0.0),
+    )
+    assert toks.shape == (2, 6)
+
+
+def test_export_guards():
+    """Export is representable-HF-or-loud: Mixtral+bias and nonstandard
+    head_dim both raise instead of writing unloadable checkpoints."""
+    from tpufw.models import MIXTRAL_CONFIGS
+    from tpufw.tools.import_hf import hf_config_dict
+
+    bad_moe = dataclasses.replace(
+        MIXTRAL_CONFIGS["mixtral_tiny"], attention_qkv_bias=True
+    )
+    with pytest.raises(NotImplementedError, match="Mixtral"):
+        hf_config_dict(bad_moe)
+
+    bad_head = dataclasses.replace(TINY, head_dim=32)
+    with pytest.raises(NotImplementedError, match="head_dim"):
+        hf_config_dict(bad_head)

@@ -80,6 +80,10 @@ class LlamaConfig:
     # base kernels for serving/export.
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # Qwen-2 style attention: biases on the q/k/v projections only
+    # (o and the MLP stay bias-free). The one architectural delta
+    # between Llama and the Qwen-2/2.5 family.
+    attention_qkv_bias: bool = False
     # Weight-only int8 serving (tpufw.ops.quant): projection kernels are
     # stored int8 + per-output-channel scales, halving decode's HBM
     # weight traffic. Params come from quantize_params on a trained
@@ -102,6 +106,11 @@ class LlamaConfig:
             + 2 * d * self.n_kv_heads * self.head_dim
             + self.n_heads * self.head_dim * d
         )
+        if self.attention_qkv_bias:
+            attn += l * (
+                self.n_heads * self.head_dim
+                + 2 * self.n_kv_heads * self.head_dim
+            )
         mlp = l * 3 * d * self.d_ff
         norms = (2 * l + 1) * d
         embed = self.vocab_size * d
@@ -157,6 +166,33 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
         d_ff=128,
         max_seq_len=128,
         remat=False,
+    ),
+    # Qwen-2.5: the Llama architecture + qkv biases. 7B matches the HF
+    # Qwen/Qwen2.5-7B shape (untied); the tiny is the test proxy.
+    "qwen25_7b": LlamaConfig(
+        vocab_size=152_064,
+        d_model=3584,
+        n_layers=28,
+        n_heads=28,
+        n_kv_heads=4,
+        head_dim=128,
+        d_ff=18_944,
+        rope_theta=1_000_000.0,
+        rms_eps=1e-6,
+        max_seq_len=32_768,
+        attention_qkv_bias=True,
+    ),
+    "qwen25_tiny": LlamaConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        d_ff=128,
+        max_seq_len=128,
+        remat=False,
+        attention_qkv_bias=True,
     ),
 }
 
@@ -245,6 +281,7 @@ class QuantDenseGeneral(nn.Module):
     dtype: Any
     in_names: tuple
     out_names: tuple
+    use_bias: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -277,15 +314,30 @@ class QuantDenseGeneral(nn.Module):
             out_dims,
             jnp.float32,
         )
-        return quant_contract(x.astype(self.dtype), q, scale, n_in)
+        y = quant_contract(x.astype(self.dtype), q, scale, n_in)
+        if self.use_bias:
+            b = self.param(
+                "bias",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), self.out_names
+                ),
+                out_dims,
+                jnp.float32,
+            )
+            y = y + b.astype(y.dtype)
+        return y
 
 
-def projection(cfg, x, features, axis, in_names, out_names, name):
+def projection(
+    cfg, x, features, axis, in_names, out_names, name, use_bias=False
+):
     """Dense projection + optional LoRA delta — the ONE composition every
     adapted matmul (attention q/k/v/o, MLP gate/up/down) goes through.
     Must be called from inside a compact ``__call__``. With
     ``cfg.quantized_weights`` the int8 serving twin is declared instead
-    (mutually exclusive with LoRA — merge adapters first)."""
+    (mutually exclusive with LoRA — merge adapters first); biased
+    projections (Qwen qkv) keep a full-precision bias vector either way
+    (it is tiny — the kernel carries the bandwidth)."""
     if getattr(cfg, "quantized_weights", False):
         if getattr(cfg, "lora_rank", 0):
             raise ValueError(
@@ -298,16 +350,20 @@ def projection(cfg, x, features, axis, in_names, out_names, name):
             dtype=cfg.dtype,
             in_names=tuple(in_names),
             out_names=tuple(out_names),
+            use_bias=use_bias,
             name=name,
         )(x)
     base = nn.DenseGeneral(
         features=features,
         axis=axis,
-        use_bias=False,
+        use_bias=use_bias,
         dtype=cfg.dtype,
         param_dtype=cfg.param_dtype,
         kernel_init=nn.with_logical_partitioning(
             nn.initializers.lecun_normal(), (*in_names, *out_names)
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), tuple(out_names)
         ),
         name=name,
     )(x)
@@ -325,17 +381,18 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
         cfg = self.cfg
+        qkv_bias = getattr(cfg, "attention_qkv_bias", False)
         q = projection(
             cfg, x, (cfg.n_heads, cfg.head_dim), -1,
-            ("embed",), ("q_heads", "head_dim"), "q",
+            ("embed",), ("q_heads", "head_dim"), "q", use_bias=qkv_bias,
         )
         k = projection(
             cfg, x, (cfg.n_kv_heads, cfg.head_dim), -1,
-            ("embed",), ("kv_heads", "head_dim"), "k",
+            ("embed",), ("kv_heads", "head_dim"), "k", use_bias=qkv_bias,
         )
         v = projection(
             cfg, x, (cfg.n_kv_heads, cfg.head_dim), -1,
-            ("embed",), ("kv_heads", "head_dim"), "v",
+            ("embed",), ("kv_heads", "head_dim"), "v", use_bias=qkv_bias,
         )
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)

@@ -80,13 +80,17 @@ def quantize_params(params: Any) -> Any:
                 key in _PROJ_IN_DIMS
                 and isinstance(val, dict)
                 and "kernel" in val
-                and len(val) == 1
+                and set(val) <= {"kernel", "bias"}
             ):
                 w = val["kernel"]
                 n_in = _PROJ_IN_DIMS[key]
                 n_stack = w.ndim - _PROJ_RANK[key]
                 in_axes = tuple(range(n_stack, n_stack + n_in))
                 out[key] = quantize_kernel(w, in_axes)
+                if "bias" in val:
+                    # Qwen qkv bias: tiny, stays fp (the kernel carries
+                    # the bandwidth; QuantDenseGeneral adds it back).
+                    out[key]["bias"] = val["bias"]
                 hit.append(key)
             else:
                 out[key] = walk(val)

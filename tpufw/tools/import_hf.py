@@ -48,15 +48,24 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
     )
     if get("model_type") == "gemma2":
         return _gemma_config_from_hf(get)
+    is_qwen2 = get("model_type") == "qwen2"
+    if is_qwen2 and get("use_sliding_window"):
+        raise NotImplementedError(
+            "Qwen2 import: use_sliding_window=True (layer-windowed "
+            "attention) is not implemented"
+        )
     # Reject, loudly, configs whose architecture tpufw doesn't implement —
     # importing them would produce silently wrong logits (e.g. Llama-3.1
     # checkpoints need rope_scaling, which apply_rope doesn't apply).
     unsupported = {
         "rope_scaling": lambda v: v not in (None, {}),
-        "attention_bias": bool,
+        # Qwen2 carries qkv biases by construction; Llama-family configs
+        # with attention_bias remain rejected (their bias is on ALL four
+        # projections, which the blocks don't implement).
+        "attention_bias": lambda v: bool(v) and not is_qwen2,
         "mlp_bias": bool,
         "hidden_act": lambda v: v not in (None, "silu"),
-        "sliding_window": lambda v: bool(v),
+        "sliding_window": lambda v: bool(v) and not is_qwen2,
     }
     bad = {
         k: get(k) for k, is_bad in unsupported.items() if is_bad(get(k))
@@ -81,6 +90,7 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         rms_eps=float(get("rms_norm_eps") or 1e-5),
         max_seq_len=get("max_position_embeddings") or 8192,
         tie_embeddings=bool(get("tie_word_embeddings") or False),
+        attention_qkv_bias=bool(is_qwen2),
     )
     if get("model_type") == "mixtral":
         from tpufw.models.mixtral import MixtralConfig
@@ -323,6 +333,18 @@ def from_hf(
                 },
             },
         }
+        if getattr(cfg, "attention_qkv_bias", False):
+            # Qwen2: biases on q/k/v only, stored flat [H*dh] in HF.
+            attn_out = out["attn"]
+            attn_out["q"]["bias"] = take(
+                pre + "self_attn.q_proj.bias", jnp.float32
+            ).reshape(h, dh)
+            attn_out["k"]["bias"] = take(
+                pre + "self_attn.k_proj.bias", jnp.float32
+            ).reshape(kh, dh)
+            attn_out["v"]["bias"] = take(
+                pre + "self_attn.v_proj.bias", jnp.float32
+            ).reshape(kh, dh)
         post_norm = take(
             pre + "post_attention_layernorm.weight", jnp.float32
         )
@@ -413,6 +435,33 @@ def hf_config_dict(cfg: LlamaConfig) -> dict:
             num_experts_per_tok=cfg.experts_per_token,
         )
         out.pop("mlp_bias")
+    if getattr(cfg, "attention_qkv_bias", False):
+        if isinstance(cfg, MixtralConfig):
+            # Mixtral shares llama.Attention so the COMBINATION trains,
+            # but no HF architecture expresses MoE + qkv-bias — export
+            # would emit a nonsense config.
+            raise NotImplementedError(
+                "export of a Mixtral config with attention_qkv_bias is "
+                "not representable as an HF architecture"
+            )
+        if cfg.head_dim != cfg.d_model // cfg.n_heads:
+            # Qwen2Config has no head_dim field: transformers recomputes
+            # it as hidden_size // num_attention_heads, so any other
+            # value would export a checkpoint from_pretrained cannot
+            # load (size mismatch at reload, long after this "success").
+            raise NotImplementedError(
+                f"Qwen2 export requires head_dim == d_model//n_heads "
+                f"({cfg.d_model}//{cfg.n_heads}="
+                f"{cfg.d_model // cfg.n_heads}), got {cfg.head_dim}"
+            )
+        out.update(
+            model_type="qwen2",
+            architectures=["Qwen2ForCausalLM"],
+            use_sliding_window=False,
+        )
+        out.pop("attention_bias", None)
+        out.pop("mlp_bias", None)
+        out.pop("head_dim", None)
     from tpufw.models.gemma import GemmaConfig
 
     if isinstance(cfg, GemmaConfig):
@@ -518,6 +567,11 @@ def _emit_attn(sd: dict, pre: str, lp: Mapping, d: int) -> None:
     sd[pre + "self_attn.o_proj.weight"] = (
         _np32(attn["o"]["kernel"]).reshape(-1, d).T
     )
+    for p in ("q", "k", "v"):
+        if "bias" in attn[p]:
+            sd[pre + f"self_attn.{p}_proj.bias"] = _np32(
+                attn[p]["bias"]
+            ).reshape(-1)
 
 
 def _emit_mlp(sd: dict, pre: str, lp: Mapping) -> None:
