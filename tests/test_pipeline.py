@@ -316,3 +316,29 @@ def test_mixtral_rejected_at_every_entry():
         init_pipeline_params(jax.random.key(0), cfg, pipe)
     with pytest.raises(NotImplementedError, match="MoE"):
         reference_forward({}, jnp.zeros((1, 4), jnp.int32), cfg)
+
+
+def test_mistral_window_reaches_pipeline_blocks(devices8):
+    """cfg.sliding_window must flow into the pipelined attention: with a
+    sequence longer than the window, windowed vs global logits differ,
+    and the schedule matches the sequential oracle."""
+    mcfg = dataclasses.replace(
+        LLAMA_CONFIGS["mistral_tiny"],
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    pipe = PipelineConfig(n_stages=2, n_microbatches=2)
+    mesh = build_mesh(MeshConfig(data=2, pipe=2, fsdp=2))
+    params = init_pipeline_params(jax.random.key(0), mcfg, pipe)
+    tokens = jax.random.randint(jax.random.key(1), (8, 64), 0, 256)
+    want = reference_forward(params, tokens, mcfg)
+    got = jax.jit(
+        lambda p, t: pipeline_forward(p, t, mcfg, pipe, mesh)
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+    wide = reference_forward(
+        params, tokens, dataclasses.replace(mcfg, sliding_window=None)
+    )
+    assert np.abs(np.asarray(want) - np.asarray(wide)).max() > 1e-4

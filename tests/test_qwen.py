@@ -115,12 +115,8 @@ def test_quantized_forward_keeps_biases():
     ref = Llama(TINY).apply({"params": params}, tokens)
     qp = quantize_params(params)
     # qkv kernels quantize, their biases survive as fp.
-    q_mod = jax.tree.leaves(
-        {"q": qp["layers"]["attn"]["q"]}
-    )
     assert qp["layers"]["attn"]["q"]["q_kernel"].dtype == jnp.int8
     assert qp["layers"]["attn"]["q"]["bias"].dtype == jnp.float32
-    del q_mod
     qcfg = dataclasses.replace(TINY, quantized_weights=True)
     out = Llama(qcfg).apply({"params": qp}, tokens)
     np.testing.assert_allclose(
@@ -160,3 +156,19 @@ def test_export_guards():
     bad_head = dataclasses.replace(TINY, head_dim=32)
     with pytest.raises(NotImplementedError, match="head_dim"):
         hf_config_dict(bad_head)
+
+
+def test_pipeline_rejects_qkv_bias():
+    from tpufw.parallel.pipeline import PipelineConfig
+
+    with pytest.raises(NotImplementedError, match="qkv_bias"):
+        PipelineConfig(n_stages=2, n_microbatches=2).validate(
+            dataclasses.replace(TINY, n_layers=4), 4
+        )
+
+
+def test_export_bias_plus_window_is_loud():
+    from tpufw.tools.import_hf import hf_config_dict
+
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        hf_config_dict(dataclasses.replace(TINY, sliding_window=32))

@@ -80,6 +80,9 @@ class LlamaConfig:
     # base kernels for serving/export.
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # Mistral-style local attention: ONE window on EVERY layer (unlike
+    # Gemma-2's alternation). None = global attention.
+    sliding_window: Optional[int] = None
     # Qwen-2 style attention: biases on the q/k/v projections only
     # (o and the MLP stay bias-free). The one architectural delta
     # between Llama and the Qwen-2/2.5 family.
@@ -165,6 +168,32 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
         head_dim=16,
         d_ff=128,
         max_seq_len=128,
+        remat=False,
+    ),
+    # Mistral-7B (v0.1): Llama architecture + a 4096-token sliding
+    # window on every layer.
+    "mistral_7b": LlamaConfig(
+        vocab_size=32_000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        head_dim=128,
+        d_ff=14_336,
+        rope_theta=10_000.0,
+        max_seq_len=32_768,
+        sliding_window=4096,
+    ),
+    "mistral_tiny": LlamaConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        head_dim=16,
+        d_ff=128,
+        max_seq_len=128,
+        sliding_window=32,
         remat=False,
     ),
     # Qwen-2.5: the Llama architecture + qkv biases. 7B matches the HF
@@ -513,7 +542,9 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
         cfg = self.cfg
-        x = x + Attention(cfg, name="attn")(
+        x = x + Attention(
+            cfg, window=getattr(cfg, "sliding_window", None), name="attn"
+        )(
             RMSNorm(cfg.rms_eps, name="attn_norm")(x), positions, segment_ids
         )
         x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.rms_eps, name="mlp_norm")(x))

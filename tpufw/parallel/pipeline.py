@@ -56,6 +56,14 @@ class PipelineConfig:
 
     def validate(self, model: LlamaConfig, batch_size: int) -> None:
         _reject_moe(model)
+        if getattr(model, "attention_qkv_bias", False):
+            # The functional pipeline blocks carry no bias params;
+            # running a Qwen config here would silently train a
+            # bias-free non-Qwen model (same principle as _reject_moe).
+            raise NotImplementedError(
+                "pipeline blocks do not implement attention_qkv_bias "
+                "(Qwen); use the flax Trainer for this family"
+            )
         if model.n_layers % self.n_stages:
             raise ValueError(
                 f"n_layers {model.n_layers} not divisible by "
@@ -216,7 +224,10 @@ def _block(
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     att = multi_head_attention(
-        q, k, v, causal=True, segment_ids=seg, backend=backend
+        q, k, v, causal=True, segment_ids=seg,
+        # Mistral-style uniform window (None for plain Llama).
+        sliding_window=getattr(cfg, "sliding_window", None),
+        backend=backend,
     )
     x = x + jnp.einsum("bthk,hkd->btd", att, p["wo"].astype(dt))
     h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)

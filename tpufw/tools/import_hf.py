@@ -49,6 +49,7 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
     if get("model_type") == "gemma2":
         return _gemma_config_from_hf(get)
     is_qwen2 = get("model_type") == "qwen2"
+    is_mistral = get("model_type") == "mistral"
     if is_qwen2 and get("use_sliding_window"):
         raise NotImplementedError(
             "Qwen2 import: use_sliding_window=True (layer-windowed "
@@ -65,7 +66,8 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         "attention_bias": lambda v: bool(v) and not is_qwen2,
         "mlp_bias": bool,
         "hidden_act": lambda v: v not in (None, "silu"),
-        "sliding_window": lambda v: bool(v) and not is_qwen2,
+        "sliding_window": lambda v: bool(v)
+        and not (is_qwen2 or is_mistral),
     }
     bad = {
         k: get(k) for k, is_bad in unsupported.items() if is_bad(get(k))
@@ -91,6 +93,11 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         max_seq_len=get("max_position_embeddings") or 8192,
         tie_embeddings=bool(get("tie_word_embeddings") or False),
         attention_qkv_bias=bool(is_qwen2),
+        # Mistral: one window on every layer (None when the checkpoint
+        # disabled it, as v0.2+ does).
+        sliding_window=(
+            get("sliding_window") if is_mistral else None
+        ),
     )
     if get("model_type") == "mixtral":
         from tpufw.models.mixtral import MixtralConfig
@@ -435,7 +442,24 @@ def hf_config_dict(cfg: LlamaConfig) -> dict:
             num_experts_per_tok=cfg.experts_per_token,
         )
         out.pop("mlp_bias")
+    if (
+        getattr(cfg, "sliding_window", None)
+        and not getattr(cfg, "attention_qkv_bias", False)
+        and not isinstance(cfg, MixtralConfig)
+    ):
+        out.update(
+            model_type="mistral",
+            architectures=["MistralForCausalLM"],
+            sliding_window=cfg.sliding_window,
+        )
+        out.pop("mlp_bias", None)
     if getattr(cfg, "attention_qkv_bias", False):
+        if getattr(cfg, "sliding_window", None):
+            raise NotImplementedError(
+                "export of qkv-bias + sliding_window is not implemented "
+                "(the qwen2 branch would silently write "
+                "use_sliding_window=False, changing the attention math)"
+            )
         if isinstance(cfg, MixtralConfig):
             # Mixtral shares llama.Attention so the COMBINATION trains,
             # but no HF architecture expresses MoE + qkv-bias — export
