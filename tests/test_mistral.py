@@ -129,3 +129,55 @@ def test_generate_decodes():
         sampling=SamplingConfig(temperature=0.0),
     )
     assert toks.shape == (2, 6)
+
+
+def test_serve_hf_checkpoint_dir(hf_mistral, tmp_path, clear_tpufw_env):
+    """TPUFW_HF_CHECKPOINT with a Mistral safetensors dir serves directly
+    (windowed decode through the slot-based cache)."""
+    ckpt = tmp_path / "mistral"
+    hf_mistral.save_pretrained(str(ckpt), safe_serialization=True)
+    clear_tpufw_env.setenv("TPUFW_HF_CHECKPOINT", str(ckpt))
+
+    from tpufw.infer import generate_text
+    from tpufw.workloads.serve import build_generator
+
+    decode_model, params, cfg, restored = build_generator()
+    assert restored and cfg.sliding_window == 32
+    out = generate_text(decode_model, params, [[3, 4]], max_new_tokens=3)
+    assert len(out) == 1 and len(out[0]) == 3
+
+
+def test_gemma_export_unaffected_by_mistral_branch():
+    """Regression: GemmaConfig carries sliding_window=4096, which must
+    NOT route it through the mistral export branch."""
+    from tpufw.models import GEMMA_CONFIGS
+    from tpufw.tools.import_hf import hf_config_dict
+
+    out = hf_config_dict(GEMMA_CONFIGS["gemma2_tiny"])
+    assert out["model_type"] == "gemma2"
+
+
+def test_mixtral_window_honored_and_exported():
+    """MixtralConfig(sliding_window=...) applies in the forward (it
+    descends from Mistral) and survives into the exported config."""
+    from tpufw.models import MIXTRAL_CONFIGS, Mixtral
+    from tpufw.tools.import_hf import hf_config_dict
+
+    cfg = dataclasses.replace(
+        MIXTRAL_CONFIGS["mixtral_tiny"],
+        dtype=jnp.float32, param_dtype=jnp.float32,
+        sliding_window=16,
+    )
+    out = hf_config_dict(cfg)
+    assert out["model_type"] == "mixtral"
+    assert out["sliding_window"] == 16
+
+    params = Mixtral(
+        dataclasses.replace(cfg, sliding_window=None)
+    ).init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    tokens = jax.random.randint(jax.random.key(1), (1, 48), 0, 256)
+    local, _ = Mixtral(cfg).apply(params, tokens)
+    global_, _ = Mixtral(
+        dataclasses.replace(cfg, sliding_window=None)
+    ).apply(params, tokens)
+    assert np.abs(np.asarray(local) - np.asarray(global_)).max() > 1e-5

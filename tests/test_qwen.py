@@ -172,3 +172,19 @@ def test_export_bias_plus_window_is_loud():
 
     with pytest.raises(NotImplementedError, match="sliding_window"):
         hf_config_dict(dataclasses.replace(TINY, sliding_window=32))
+
+
+def test_serve_hf_checkpoint_dir(hf_qwen, tmp_path, clear_tpufw_env):
+    """TPUFW_HF_CHECKPOINT with a Qwen2 safetensors dir serves directly
+    (config detection -> biased params -> decode)."""
+    ckpt = tmp_path / "qwen"
+    hf_qwen.save_pretrained(str(ckpt), safe_serialization=True)
+    clear_tpufw_env.setenv("TPUFW_HF_CHECKPOINT", str(ckpt))
+
+    from tpufw.infer import generate_text
+    from tpufw.workloads.serve import build_generator
+
+    decode_model, params, cfg, restored = build_generator()
+    assert restored and cfg.attention_qkv_bias
+    out = generate_text(decode_model, params, [[3, 4]], max_new_tokens=3)
+    assert len(out) == 1 and len(out[0]) == 3

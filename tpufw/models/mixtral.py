@@ -239,7 +239,9 @@ class MixtralBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
         cfg = self.cfg
-        x = x + Attention(cfg, name="attn")(
+        x = x + Attention(
+            cfg, window=getattr(cfg, "sliding_window", None), name="attn"
+        )(
             RMSNorm(cfg.rms_eps, name="attn_norm")(x), positions, segment_ids
         )
         y, aux = MoEMLP(cfg, name="moe")(

@@ -434,6 +434,8 @@ def hf_config_dict(cfg: LlamaConfig) -> dict:
         "hidden_act": "silu",
         "torch_dtype": "float32",
     }
+    from tpufw.models.gemma import GemmaConfig as _GemmaConfig
+
     if isinstance(cfg, MixtralConfig):
         out.update(
             model_type="mixtral",
@@ -441,11 +443,15 @@ def hf_config_dict(cfg: LlamaConfig) -> dict:
             num_local_experts=cfg.n_experts,
             num_experts_per_tok=cfg.experts_per_token,
         )
+        if getattr(cfg, "sliding_window", None):
+            # HF Mixtral carries the field too (it descends from
+            # Mistral); the tpufw blocks honor it, so export must.
+            out["sliding_window"] = cfg.sliding_window
         out.pop("mlp_bias")
-    if (
+    elif (
         getattr(cfg, "sliding_window", None)
         and not getattr(cfg, "attention_qkv_bias", False)
-        and not isinstance(cfg, MixtralConfig)
+        and not isinstance(cfg, _GemmaConfig)
     ):
         out.update(
             model_type="mistral",
