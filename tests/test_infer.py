@@ -162,3 +162,51 @@ def test_sampled_generation_respects_vocab(llama_params):
     )[0]
     assert len(out) == 10
     assert all(0 <= t < TINY.vocab_size for t in out)
+
+
+def test_min_p_masks_below_threshold():
+    from tpufw.infer.sampling import apply_min_p
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.2, 0.05]]))
+    out = apply_min_p(logits, 0.5)  # threshold = 0.25
+    kept = np.asarray(out[0]) > -1e29
+    np.testing.assert_array_equal(kept, [True, True, False, False])
+
+
+def test_repetition_penalty_rule():
+    """HF rule: seen positive logits divide, seen negative multiply."""
+    from tpufw.infer.sampling import apply_repetition_penalty
+
+    logits = jnp.asarray([[2.0, -2.0, 2.0, -2.0]])
+    seen = jnp.asarray([[True, True, False, False]])
+    out = np.asarray(apply_repetition_penalty(logits, seen, 2.0))[0]
+    np.testing.assert_allclose(out, [1.0, -4.0, 2.0, -2.0])
+
+
+def test_generate_with_repetition_penalty_differs():
+    """The penalty must reach the decode loop: greedy decode with a huge
+    penalty cannot emit any token twice (every emitted token joins the
+    seen set and gets crushed), so outputs differ from unpenalized."""
+    cfg = LLAMA_CONFIGS["llama3_tiny"]
+    dcfg = cfg.decode_config()
+    model = Llama(dcfg)
+    prompts = jax.random.randint(jax.random.key(0), (2, 8), 1, 255)
+    pads = jnp.zeros((2,), jnp.int32)
+    params = jax.jit(Llama(cfg).init)(jax.random.key(1), prompts)["params"]
+
+    plain = generate(
+        model, params, prompts, pads, jax.random.key(2),
+        max_new_tokens=8, sampling=SamplingConfig(temperature=0.0),
+    )
+    pen = generate(
+        model, params, prompts, pads, jax.random.key(2),
+        max_new_tokens=8,
+        sampling=SamplingConfig(
+            temperature=0.0, repetition_penalty=1e9
+        ),
+    )
+    assert pen.shape == (2, 8)
+    for row in np.asarray(pen):
+        # No repeats at all under an effectively-infinite penalty.
+        assert len(set(row.tolist())) == len(row), row
+    assert (np.asarray(plain) != np.asarray(pen)).any()

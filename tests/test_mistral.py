@@ -181,3 +181,18 @@ def test_mixtral_window_honored_and_exported():
         dataclasses.replace(cfg, sliding_window=None)
     ).apply(params, tokens)
     assert np.abs(np.asarray(local) - np.asarray(global_)).max() > 1e-5
+
+
+def test_windowed_mixtral_config_roundtrips():
+    """A mixtral config.json with sliding_window imports (the blocks
+    honor it) instead of being rejected/dropped."""
+    from tpufw.tools.import_hf import config_from_hf, hf_config_dict
+    from tpufw.models import MIXTRAL_CONFIGS
+
+    cfg = dataclasses.replace(
+        MIXTRAL_CONFIGS["mixtral_tiny"], sliding_window=16
+    )
+    out = hf_config_dict(cfg)
+    back = config_from_hf(out)
+    assert back.sliding_window == 16
+    assert type(back).__name__ == "MixtralConfig"

@@ -144,3 +144,26 @@ def test_http_server_generate(tiny_env):
         except urllib.error.HTTPError as e:
             assert e.code == 400
     srv.httpd.shutdown()
+
+
+def test_sampling_env_resolution(clear_tpufw_env):
+    clear_tpufw_env.setenv("TPUFW_TEMPERATURE", "0.7")
+    clear_tpufw_env.setenv("TPUFW_TOP_K", "40")
+    clear_tpufw_env.setenv("TPUFW_MIN_P", "0.05")
+    clear_tpufw_env.setenv("TPUFW_REPETITION_PENALTY", "1.2")
+
+    from tpufw.workloads.serve import sampling_from_env
+
+    s = sampling_from_env()
+    assert s.temperature == 0.7 and s.top_k == 40
+    assert s.top_p is None and s.min_p == 0.05
+    assert s.repetition_penalty == 1.2
+
+
+def test_sampling_env_defaults_greedy(clear_tpufw_env):
+    from tpufw.workloads.serve import sampling_from_env
+
+    s = sampling_from_env()
+    assert s.temperature == 0.0
+    assert s.top_k is None and s.top_p is None and s.min_p is None
+    assert s.repetition_penalty is None

@@ -100,32 +100,52 @@ def generate(
     logits, cache = apply(
         {}, prompt_tokens, positions, seg
     )
+    # Repetition penalty needs a [B, V] presence mask of every token the
+    # model has seen (prompt + generated). Built only when enabled — it
+    # costs B*V bools in the scan carry.
+    track_seen = (
+        sampling.repetition_penalty is not None
+        and sampling.repetition_penalty != 1.0
+    )
+    vocab = logits.shape[-1]
+    seen = None
+    if track_seen:
+        real = seg > 0  # seg is always built above; 0 marks padding
+        seen = (
+            jnp.zeros((b, vocab), bool)
+            .at[jnp.arange(b)[:, None], prompt_tokens]
+            .max(real)
+        )
     next_rng, rng = jax.random.split(rng)
-    first = sample_token(logits[:, -1, :], sampling, rng)
+    first = sample_token(logits[:, -1, :], sampling, rng, seen)
+    if track_seen:
+        seen = seen.at[jnp.arange(b), first].set(True)
     # The EOS token itself is emitted; only rows ALREADY done emit pad.
     done = jnp.zeros((b,), bool) if eos_id is None else first == eos_id
 
     def step(carry, rng_step):
-        cache, token, pos, done = carry
+        cache, token, pos, done, seen = carry
         logits, cache = apply(
             cache,
             token[:, None],
             pos[:, None],
             jnp.ones((b, 1), jnp.int32),
         )
-        nxt = sample_token(logits[:, -1, :], sampling, rng_step)
+        nxt = sample_token(logits[:, -1, :], sampling, rng_step, seen)
+        if track_seen:
+            seen = seen.at[jnp.arange(b), nxt].set(True)
         emitted = jnp.where(done, pad_id, nxt)
         if eos_id is not None:
             done = done | (nxt == eos_id)
-        return (cache, emitted, pos + 1, done), emitted
+        return (cache, emitted, pos + 1, done, seen), emitted
 
     # Positions continue from each row's real length (p - pad_len).
     pos0 = p - pad_lens
     step_rngs = jax.random.split(next_rng, max(max_new_tokens - 1, 1))
     if max_new_tokens == 1:
         return first[:, None]
-    (_, _, _, _), rest = jax.lax.scan(
-        step, (cache, first, pos0, done), step_rngs
+    (_, _, _, _, _), rest = jax.lax.scan(
+        step, (cache, first, pos0, done, seen), step_rngs
     )
     return jnp.concatenate([first[:, None], rest.T], axis=1)
 

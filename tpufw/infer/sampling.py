@@ -27,6 +27,14 @@ class SamplingConfig:
     # Nucleus sampling: keep the smallest set of tokens whose cumulative
     # probability reaches top_p (1.0/None disables).
     top_p: Optional[float] = None
+    # Drop tokens whose probability is below min_p * max probability
+    # (None disables) — a length-adaptive alternative to top_p.
+    min_p: Optional[float] = None
+    # HF-style repetition penalty (> 1.0 discourages): logits of tokens
+    # already seen (prompt + generated so far) are divided by the
+    # penalty when positive, multiplied when negative. 1.0/None
+    # disables. Applied BEFORE temperature, matching transformers.
+    repetition_penalty: Optional[float] = None
 
 
 def apply_top_k(logits: jax.Array, k: int) -> jax.Array:
@@ -52,11 +60,41 @@ def apply_top_p(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits < threshold, _NEG, logits)
 
 
-def sample_token(
-    logits: jax.Array, cfg: SamplingConfig, rng: jax.Array
+def apply_min_p(logits: jax.Array, p: float) -> jax.Array:
+    """Mask tokens with probability < p * max probability."""
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    threshold = logprobs.max(axis=-1, keepdims=True) + jnp.log(p)
+    return jnp.where(logprobs < threshold, _NEG, logits)
+
+
+def apply_repetition_penalty(
+    logits: jax.Array, seen: jax.Array, penalty: float
 ) -> jax.Array:
-    """[B, V] float logits -> [B] int32 sampled tokens."""
+    """HF rule: for tokens in ``seen`` ([B, V] bool), positive logits
+    divide by the penalty, negative multiply — both push probability
+    down for penalty > 1."""
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
+def sample_token(
+    logits: jax.Array,
+    cfg: SamplingConfig,
+    rng: jax.Array,
+    seen: Optional[jax.Array] = None,
+) -> jax.Array:
+    """[B, V] float logits -> [B] int32 sampled tokens. ``seen`` is the
+    [B, V] bool presence mask the repetition penalty applies to (the
+    decode loop maintains it; None skips the penalty)."""
     logits = logits.astype(jnp.float32)
+    if (
+        cfg.repetition_penalty is not None
+        and cfg.repetition_penalty != 1.0
+        and seen is not None
+    ):
+        logits = apply_repetition_penalty(
+            logits, seen, cfg.repetition_penalty
+        )
     if cfg.temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / cfg.temperature
@@ -64,4 +102,6 @@ def sample_token(
         logits = apply_top_k(logits, cfg.top_k)
     if cfg.top_p is not None and cfg.top_p < 1.0:
         logits = apply_top_p(logits, cfg.top_p)
+    if cfg.min_p is not None and cfg.min_p > 0.0:
+        logits = apply_min_p(logits, cfg.min_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

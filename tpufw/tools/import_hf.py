@@ -50,6 +50,7 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         return _gemma_config_from_hf(get)
     is_qwen2 = get("model_type") == "qwen2"
     is_mistral = get("model_type") == "mistral"
+    is_mixtral = get("model_type") == "mixtral"
     if is_qwen2 and get("use_sliding_window"):
         raise NotImplementedError(
             "Qwen2 import: use_sliding_window=True (layer-windowed "
@@ -67,7 +68,7 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         "mlp_bias": bool,
         "hidden_act": lambda v: v not in (None, "silu"),
         "sliding_window": lambda v: bool(v)
-        and not (is_qwen2 or is_mistral),
+        and not (is_qwen2 or is_mistral or is_mixtral),
     }
     bad = {
         k: get(k) for k, is_bad in unsupported.items() if is_bad(get(k))
@@ -93,13 +94,15 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
         max_seq_len=get("max_position_embeddings") or 8192,
         tie_embeddings=bool(get("tie_word_embeddings") or False),
         attention_qkv_bias=bool(is_qwen2),
-        # Mistral: one window on every layer (None when the checkpoint
-        # disabled it, as v0.2+ does).
+        # Mistral/Mixtral: one window on every layer (None when the
+        # checkpoint disabled it, as Mistral v0.2+ and Mixtral do).
         sliding_window=(
-            get("sliding_window") if is_mistral else None
+            get("sliding_window")
+            if (is_mistral or is_mixtral)
+            else None
         ),
     )
-    if get("model_type") == "mixtral":
+    if is_mixtral:
         from tpufw.models.mixtral import MixtralConfig
 
         return MixtralConfig(

@@ -27,7 +27,7 @@ import os
 import threading
 import time
 
-from tpufw.workloads.env import env_int, env_str
+from tpufw.workloads.env import env_float, env_int, env_str
 
 _T0 = time.time()
 
@@ -198,6 +198,24 @@ def text_codec():
     return tok.encode, tok.decode
 
 
+def sampling_from_env():
+    """SamplingConfig from TPUFW_* env — ONE resolution for the batch
+    and HTTP serving modes. Default stays greedy/deterministic."""
+    from tpufw.infer import SamplingConfig
+
+    return SamplingConfig(
+        temperature=env_float("temperature", 0.0),
+        top_k=env_int("top_k", 0) or None,
+        top_p=(lambda v: v if v < 1.0 else None)(env_float("top_p", 1.0)),
+        min_p=env_float("min_p", 0.0) or None,
+        repetition_penalty=(
+            (lambda v: v if v != 1.0 else None)(
+                env_float("repetition_penalty", 1.0)
+            )
+        ),
+    )
+
+
 def _pad_batch(prompts: list[list[int]]) -> tuple[list[list[int]], int]:
     """Pad the batch to a power of two (filler rows = [0]) so the jitted
     generate specializes on few batch shapes. Returns (padded, real_n)."""
@@ -209,7 +227,7 @@ def _pad_batch(prompts: list[list[int]]) -> tuple[list[list[int]], int]:
 
 
 def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
-    from tpufw.infer import SamplingConfig, generate_text
+    from tpufw.infer import generate_text
 
     decode_model, params, cfg, restored = build_generator()
     padded, real_n = _pad_batch(prompts)
@@ -218,7 +236,7 @@ def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
         params,
         padded,
         max_new_tokens=max_new_tokens,
-        sampling=SamplingConfig(temperature=0.0),  # greedy: deterministic
+        sampling=sampling_from_env(),  # default greedy: deterministic
         eos_id=None,
     )[:real_n]
     return [
@@ -236,10 +254,10 @@ class _Server:
     """Minimal HTTP serving loop over the jitted generator."""
 
     def __init__(self, port: int, max_new_tokens: int):
-        from tpufw.infer import SamplingConfig, generate_text
+        from tpufw.infer import generate_text
 
         self._generate_text = generate_text
-        self._sampling = SamplingConfig(temperature=0.0)
+        self._sampling = sampling_from_env()
         (
             self.model,
             self.params,
@@ -257,11 +275,15 @@ class _Server:
         return self._codec
 
     def generate(self, prompts: list[list[int]], max_new: int):
-        # Bucket prompt length via extra LEFT padding (pad_lens absorbs
-        # it) and batch size via filler rows: few shapes -> few compiles.
+        # Bucket prompt length and batch size so the jitted generate
+        # specializes on few shapes. The length bucket rides
+        # pad_prompts' OWN left padding (a max-length filler row forces
+        # it), so bucketing zeros are real padding — pad_lens masks
+        # them, and the repetition penalty's seen-set never counts them
+        # (literal [0]*k prefixes would look like real tokens).
         longest = _bucket(max(len(p) for p in prompts), 64)
-        bucketed = [[0] * (longest - len(p)) + list(p) for p in prompts]
-        padded, real_n = _pad_batch(bucketed)
+        padded, real_n = _pad_batch(prompts)
+        padded = padded + [[0] * longest]  # length-bucket filler row
         with self.lock:  # one compiled program at a time
             outs = self._generate_text(
                 self.model,
