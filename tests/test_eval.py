@@ -86,3 +86,106 @@ def test_eval_hook_fires_on_schedule():
 def test_empty_eval_iterator_is_loud(trainer):
     with pytest.raises(ValueError, match="empty eval iterator"):
         trainer.evaluate(iter(()))
+
+
+def test_eval_ppl_cli(tmp_path, devices8):
+    """The standalone CLI: bare params + packed corpus -> one JSON line
+    with the trainers' token-weighted numbers."""
+    import json
+
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+    from flax.core import meta
+
+    from tpufw.models import LLAMA_CONFIGS, Llama
+    from tpufw.tools import eval_ppl
+    from tpufw.train import write_token_corpus
+
+    tiny = LLAMA_CONFIGS["llama3_tiny"]
+    params = meta.unbox(
+        Llama(tiny).init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    )["params"]
+    params_dir = str(tmp_path / "params")
+    with ocp.StandardCheckpointer() as ck:
+        ck.save(params_dir, params)
+
+    rng = np.random.default_rng(0)
+    docs = [rng.integers(1, 255, rng.integers(5, 60)).tolist()
+            for _ in range(64)]
+    prefix = str(tmp_path / "corpus")
+    write_token_corpus(prefix, docs)
+
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = eval_ppl.main([
+            "--model", "llama3_tiny",
+            "--params", params_dir,
+            "--data", prefix,
+            "--batch-size", "8",
+            "--seq-len", "17",
+            "--batches", "3",
+            "--loss-chunk-size", "0",
+        ])
+    assert rc == 0
+    line = [l for l in buf.getvalue().splitlines() if l.startswith("{")][-1]
+    res = json.loads(line)
+    assert res["eval_batches"] == 3
+    assert np.isfinite(res["eval_loss"])
+    assert res["eval_ppl"] == pytest.approx(
+        np.exp(res["eval_loss"]), rel=1e-6
+    )
+
+
+def test_eval_ppl_cli_from_trainstate(tmp_path, devices8):
+    """--checkpoint mode: the saved TrainState (with optimizer moments)
+    restores and evaluates."""
+    import contextlib
+    import io
+    import json
+
+    from tpufw.mesh import MeshConfig as _MeshCfg
+    from tpufw.tools import eval_ppl
+    from tpufw.train import write_token_corpus
+
+    tiny = LLAMA_CONFIGS["llama3_tiny"]
+    ckpt = str(tmp_path / "ckpt")
+    trainer = Trainer(
+        Llama(tiny),
+        TrainerConfig(
+            batch_size=8, seq_len=17, total_steps=2, lr=1e-3,
+            checkpoint_dir=ckpt, checkpoint_every=1,
+        ),
+        _MeshCfg(data=jax.device_count()),
+    )
+    trainer.init_state()
+    trainer.run(
+        synthetic_batches(8, 17, tiny.vocab_size),
+        model_flops_per_token=tiny.flops_per_token(16),
+    )
+
+    rng = np.random.default_rng(1)
+    prefix = str(tmp_path / "corpus")
+    write_token_corpus(
+        prefix,
+        [rng.integers(1, 255, rng.integers(5, 60)).tolist()
+         for _ in range(64)],
+    )
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = eval_ppl.main([
+            "--model", "llama3_tiny",
+            "--checkpoint", ckpt,
+            "--data", prefix,
+            "--batch-size", "8",
+            "--seq-len", "17",
+            "--batches", "2",
+            "--loss-chunk-size", "0",
+        ])
+    assert rc == 0
+    res = json.loads(
+        [l for l in buf.getvalue().splitlines() if l.startswith("{")][-1]
+    )
+    assert res["eval_batches"] == 2 and np.isfinite(res["eval_loss"])

@@ -16,3 +16,24 @@ from tpufw.models.lora import (  # noqa: F401
     lora_mask,
     merge_lora,
 )
+
+
+def model_for_config(cfg):
+    """Model class instance for a config dataclass — the ONE
+    config->architecture dispatch (serving, eval tools)."""
+    from tpufw.models.gemma import GemmaConfig
+    from tpufw.models.mixtral import MixtralConfig
+    from tpufw.models.resnet import ResNetConfig
+
+    if isinstance(cfg, ResNetConfig):
+        raise ValueError(
+            "model_for_config covers the LM families; vision runs use "
+            "tpufw.train.VisionTrainer / workloads.train_resnet"
+        )
+    if isinstance(cfg, MixtralConfig):
+        return Mixtral(cfg)
+    if isinstance(cfg, GemmaConfig):
+        return Gemma(cfg)
+    if isinstance(cfg, LlamaConfig):
+        return Llama(cfg)
+    raise TypeError(f"unknown model config type {type(cfg).__name__}")

@@ -1,0 +1,104 @@
+"""CLI: held-out perplexity of a checkpoint over a packed corpus.
+
+Closes the evaluate-a-checkpoint workflow without a training run::
+
+    python -m tpufw.tools.eval_ppl --model llama3_8b \\
+        --params base/ --data corpus \\
+        --batch-size 8 --seq-len 2048 --batches 64
+
+(``--params``: bare params from import_hf/merge_lora; ``--data``: a
+pack_corpus .bin/.idx prefix.)
+
+``--checkpoint`` instead of ``--params`` evaluates a training
+TrainState dir (latest step). Prints ONE JSON line with the same
+token-weighted numbers the trainers report in-loop (shared
+``run_evaluation`` loop — the objective cannot drift from training).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tpufw.tools.eval_ppl",
+        description="checkpoint + packed corpus -> token-weighted ppl",
+    )
+    ap.add_argument("--model", required=True,
+                    help="model preset or run-config YAML path")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--params", help="bare-params Orbax dir")
+    src.add_argument("--checkpoint",
+                     help="training checkpoint dir (latest TrainState)")
+    ap.add_argument("--data", required=True,
+                    help="pack_corpus output prefix (.bin/.idx)")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=2048)
+    ap.add_argument("--batches", type=int, default=64,
+                    help="number of eval batches (0 = whole corpus)")
+    ap.add_argument("--loss-chunk-size", type=int, default=512,
+                    help="chunked-vocab CE chunk (0 = full logits)")
+    args = ap.parse_args(argv)
+
+    from tpufw.utils.profiling import enable_compile_cache
+
+    enable_compile_cache()
+
+    if args.model.endswith((".yaml", ".yml")):
+        from tpufw.configs.loader import load_run_config
+
+        model_cfg = load_run_config(args.model).model_cfg
+    else:
+        from tpufw.configs.loader import resolve_model_preset
+
+        model_cfg = resolve_model_preset(args.model)
+
+    from tpufw.models import model_for_config
+
+    model = model_for_config(model_cfg)  # loud on non-LM configs
+
+    import optax
+
+    from tpufw.train import TokenCorpus, Trainer, TrainerConfig
+
+    trainer = Trainer(
+        model,
+        TrainerConfig(
+            batch_size=args.batch_size,
+            seq_len=args.seq_len,
+            loss_chunk_size=args.loss_chunk_size or None,
+            checkpoint_dir=args.checkpoint,
+            handle_preemption=False,  # no step loop to stop
+        ),
+        # --params: stateless optimizer, so forward-only evaluation
+        # never allocates AdamW moments (~2x params of dead fp32 at
+        # 8B). --checkpoint must keep the default tx: maybe_restore's
+        # abstract tree must match the SAVED TrainState (which carries
+        # the moments).
+        tx=optax.identity() if args.params else None,
+    )
+    if args.params:
+        trainer.init_from_params(args.params)
+    else:
+        if not trainer.maybe_restore():
+            raise SystemExit(
+                f"no checkpoint found under {args.checkpoint!r}"
+            )
+
+    data = iter(
+        TokenCorpus(
+            args.data, args.batch_size, args.seq_len,
+            shuffle=False, epochs=1,
+        )
+    )
+    result = trainer.evaluate(data, args.batches or None)
+    result["model_params"] = model_cfg.n_params()
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -75,14 +75,15 @@ def build_generator():
             max_seq_len=env_int("max_seq_len", hf_cfg.max_seq_len),
         )
         params = from_hf(hf_dir, hf_cfg, dtype=hf_cfg.dtype)
-        if isinstance(hf_cfg, MixtralConfig):
-            cls = Mixtral
-        elif isinstance(hf_cfg, GemmaConfig):
-            cls = Gemma
-        else:
-            cls = Llama
+        from tpufw.models import model_for_config
+
         hf_cfg, params = _maybe_quantize(hf_cfg, params)
-        return cls(hf_cfg.decode_config()), params, hf_cfg, True
+        return (
+            model_for_config(hf_cfg.decode_config()),
+            params,
+            hf_cfg,
+            True,
+        )
 
     name = env_str("model", "llama3_600m_bench")
     if name == "llama3_600m_bench":
