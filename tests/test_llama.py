@@ -81,6 +81,34 @@ def test_flops_per_token_scale():
     assert cfg.flops_per_token(8192) > 6 * (cfg.n_params() - cfg.vocab_size * cfg.d_model)
 
 
+def test_flops_per_token_sliding_window_cap():
+    """Windowed attention (Mistral/Mixtral) must not charge full-causal
+    score FLOPs at long seq_len — reverting the cap would overstate
+    bench MFU ~4x at 32k/4k-window (ADVICE r2)."""
+    import dataclasses
+
+    from tpufw.models.mixtral import MIXTRAL_CONFIGS
+
+    for cfg in (
+        LLAMA_CONFIGS["mistral_7b"],
+        dataclasses.replace(
+            MIXTRAL_CONFIGS["mixtral_8x7b"], sliding_window=4096
+        ),
+    ):
+        assert cfg.sliding_window == 4096
+        nowin = dataclasses.replace(cfg, sliding_window=None)
+        win_f, full_f = cfg.flops_per_token(32_768), nowin.flops_per_token(32_768)
+        assert win_f < full_f
+        # The score-term gap is exactly 6*l*h*dh*2*(T/2 - W).
+        expect = (
+            6.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim
+            * 2.0 * (32_768 / 2 - 4096)
+        )
+        assert abs((full_f - win_f) - expect) < 1e3
+        # Short sequences (T/2 <= W) are unchanged.
+        assert cfg.flops_per_token(1024) == nowin.flops_per_token(1024)
+
+
 def test_sharded_init_on_mesh(devices8):
     """Init under a tensor x fsdp mesh: params come out with logical metadata
     and can be materialized with mesh shardings."""

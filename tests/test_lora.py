@@ -151,7 +151,7 @@ def test_merge_gemma_pairs():
 def test_merge_without_adapters_is_loud():
     params = Llama(BASE).init(jax.random.key(0), _tokens())["params"]
     with pytest.raises(ValueError, match="no .*lora"):
-        merge_lora(params, rank=4)
+        merge_lora(params, rank=4, alpha=16.0)
 
 
 def test_init_from_base_checkpoint(tmp_path, devices8):

@@ -296,6 +296,39 @@ def test_gemma_pipeline_odd_pairs_loud():
         PipelineConfig(n_stages=2, n_microbatches=2).validate(gcfg, 4)
 
 
+def test_init_params_guards_direct_callers():
+    """init_pipeline_params must re-check divisibility/pair-parity itself:
+    direct callers bypass PipelineConfig.validate and would otherwise get
+    a silently truncated layer stack (ADVICE r2 + review follow-up)."""
+    import dataclasses
+
+    from tpufw.models import GEMMA_CONFIGS
+    from tpufw.models.llama import LLAMA_CONFIGS
+    from tpufw.parallel.pipeline import PipelineConfig, init_pipeline_params
+
+    pipe = PipelineConfig(n_stages=4, n_microbatches=2)
+    lcfg = dataclasses.replace(LLAMA_CONFIGS["llama3_tiny"], n_layers=10)
+    with pytest.raises(ValueError, match="divisible"):
+        init_pipeline_params(jax.random.key(0), lcfg, pipe)
+    # Gemma with divisible-but-odd layers per stage (10/2 = 5).
+    gcfg = dataclasses.replace(GEMMA_CONFIGS["gemma2_tiny"], n_layers=10)
+    with pytest.raises(ValueError, match="PAIRS"):
+        init_pipeline_params(
+            jax.random.key(0), gcfg,
+            PipelineConfig(n_stages=2, n_microbatches=2),
+        )
+    # Qwen (qkv biases): the blocks carry no bias params, so a direct
+    # caller would silently train a bias-free non-Qwen model.
+    qcfg = dataclasses.replace(
+        LLAMA_CONFIGS["llama3_tiny"], attention_qkv_bias=True
+    )
+    with pytest.raises(NotImplementedError, match="qkv_bias"):
+        init_pipeline_params(
+            jax.random.key(0), qcfg,
+            PipelineConfig(n_stages=2, n_microbatches=2),
+        )
+
+
 def test_mixtral_pipeline_rejected_loudly():
     """MixtralConfig subclasses LlamaConfig: without a guard the pipeline
     would silently build DENSE stacks from an MoE config."""

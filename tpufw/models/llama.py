@@ -141,8 +141,23 @@ class LlamaConfig:
             )
             + d * self.vocab_size
         )
-        attn_score = 6 * l * self.n_heads * self.head_dim * seq_len
-        return 6.0 * n_matmul + attn_score
+        return 6.0 * n_matmul + self._attn_score_flops(seq_len)
+
+    def _attn_score_flops(self, seq_len: int) -> float:
+        """QK^T/AV score FLOPs per token, fwd+bwd (x3), both matmuls
+        (x2). Per-query key count: seq/2 for the causal triangle, capped
+        at the sliding window (Mistral/Mixtral) — mirrors GemmaConfig's
+        local layers; without the cap, windowed runs at long seq_len
+        report inflated model FLOPs and overstate MFU. Shared by the
+        Llama and Mixtral flops_per_token (only their matmul term
+        differs)."""
+        keys = seq_len / 2
+        if self.sliding_window is not None:
+            keys = min(float(self.sliding_window), keys)
+        return (
+            6.0 * self.n_layers * self.n_heads * self.head_dim
+            * 2.0 * keys
+        )
 
 
 # Presets. 8B matches Meta's Llama-3-8B shape; the proxies are the same

@@ -41,7 +41,7 @@ def has_lora(params: Any) -> bool:
 
 
 def merge_lora(
-    params: Any, rank: int | None = None, alpha: float = 16.0
+    params: Any, rank: int | None = None, *, alpha: float
 ) -> Any:
     """Fold adapters into base kernels: kernel += (A ⊗ B) * alpha/rank,
     then drop the adapter params. Returns a plain base-model tree (the
@@ -53,6 +53,10 @@ def merge_lora(
     ``rank`` is recoverable from the adapters themselves (A's trailing
     dim), so passing it is optional — but if passed it is VALIDATED:
     a stale --rank would otherwise silently mis-scale every kernel.
+    ``alpha`` is NOT recoverable from shapes, so it is a required
+    keyword: a defaulted alpha would silently mis-scale every merged
+    kernel for models trained with a non-default lora_alpha (pass
+    ``cfg.lora_alpha``).
     """
     ranks = {
         leaf.shape[-1]

@@ -65,8 +65,7 @@ class MixtralConfig(LlamaConfig):
             )
             + d * self.vocab_size
         )
-        attn_score = 6 * l * self.n_heads * self.head_dim * seq_len
-        return 6.0 * n_active + attn_score
+        return 6.0 * n_active + self._attn_score_flops(seq_len)
 
 
 MIXTRAL_CONFIGS: dict[str, MixtralConfig] = {

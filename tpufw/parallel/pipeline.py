@@ -55,29 +55,11 @@ class PipelineConfig:
     n_microbatches: int
 
     def validate(self, model: LlamaConfig, batch_size: int) -> None:
-        _reject_moe(model)
-        if getattr(model, "attention_qkv_bias", False):
-            # The functional pipeline blocks carry no bias params;
-            # running a Qwen config here would silently train a
-            # bias-free non-Qwen model (same principle as _reject_moe).
-            raise NotImplementedError(
-                "pipeline blocks do not implement attention_qkv_bias "
-                "(Qwen); use the flax Trainer for this family"
-            )
-        if model.n_layers % self.n_stages:
-            raise ValueError(
-                f"n_layers {model.n_layers} not divisible by "
-                f"{self.n_stages} stages"
-            )
+        _check_model_split(model, self.n_stages)
         if batch_size % self.n_microbatches:
             raise ValueError(
                 f"batch {batch_size} not divisible by "
                 f"{self.n_microbatches} microbatches"
-            )
-        if _is_gemma(model) and (model.n_layers // self.n_stages) % 2:
-            raise ValueError(
-                f"Gemma pipelines scan local/global PAIRS: layers per "
-                f"stage ({model.n_layers}/{self.n_stages}) must be even"
             )
 
     def bubble_fraction(self) -> float:
@@ -110,6 +92,33 @@ def _is_gemma(cfg) -> bool:
     return isinstance(cfg, GemmaConfig)
 
 
+def _check_model_split(cfg, n_stages: int) -> None:
+    """Model-side pipelineability checks, shared by
+    ``PipelineConfig.validate`` (trainer path) and
+    ``init_pipeline_params`` (direct callers) so the two can't drift:
+    an unchecked config silently builds a truncated or wrong-family
+    model."""
+    _reject_moe(cfg)
+    if getattr(cfg, "attention_qkv_bias", False):
+        # The functional pipeline blocks carry no bias params; running
+        # a Qwen config here would silently train a bias-free non-Qwen
+        # model (same principle as _reject_moe).
+        raise NotImplementedError(
+            "pipeline blocks do not implement attention_qkv_bias "
+            "(Qwen); use the flax Trainer for this family"
+        )
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by "
+            f"{n_stages} stages"
+        )
+    if _is_gemma(cfg) and (cfg.n_layers // n_stages) % 2:
+        raise ValueError(
+            f"Gemma pipelines scan local/global PAIRS: layers per "
+            f"stage ({cfg.n_layers}/{n_stages}) must be even"
+        )
+
+
 def init_pipeline_params(
     key: jax.Array, cfg: LlamaConfig, pipe: PipelineConfig
 ) -> dict:
@@ -118,8 +127,8 @@ def init_pipeline_params(
     Initializers match the flax trunk (normal embed, lecun-style fan-in
     scaling elsewhere); stored in ``cfg.param_dtype``.
     """
-    _reject_moe(cfg)
     s = pipe.n_stages
+    _check_model_split(cfg, s)
     lps = cfg.n_layers // s
     d, h, kh, dh, f = (
         cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff,

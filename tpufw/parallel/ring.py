@@ -30,6 +30,23 @@ from tpufw.parallel.context import current_mesh
 NEG_INF = -1e30
 
 
+@functools.cache
+def _warn_window_einsum_once() -> None:
+    """One-time visibility for the sliding-window → einsum perf cliff
+    (ADVICE r2): materialized per-chunk [B,H,T/P,T/P] logits on exactly
+    the long-context configs ring SP targets."""
+    import warnings
+
+    warnings.warn(
+        "ring_attention: sliding_window forces impl='einsum' "
+        "(materialized per-chunk logits) — the flash kernel only sees "
+        "chunk-local positions. Expect higher memory/lower throughput "
+        "on windowed (Mistral/Gemma-local) layers under ring SP.",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 def _chunk_attn(
     q, k, v, q_start, k_start, causal, scale, rep, qseg=None, kseg=None,
     soft_cap=None, window=None,
@@ -191,6 +208,8 @@ def ring_attention(
             # The per-shard flash calls see only local positions, so the
             # window (a GLOBAL position relation) runs on the einsum
             # impl, whose chunk math carries global q/k offsets.
+            if impl == "flash":
+                _warn_window_einsum_once()
             impl = "einsum"
     if impl == "flash":
         if sliding_window is not None:
