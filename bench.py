@@ -10,24 +10,31 @@ the target.
 
 Robustness contract (round-1 postmortem: BENCH_r01.json rc=1 because
 ``jax.devices()`` raised at backend init and nothing caught it, and the
-same call can also *hang* — reproduced here: >7min with no return):
+same call can also *hang*; round-2 postmortem: one wedged TPU attempt ate
+the whole 1200s budget and polluted the cold-start metric):
 
-- Stage 0 (orchestrator, no jax import): runs the real bench as a child
-  process with a hard timeout (TPUFW_BENCH_TIMEOUT, default 1200s — TPU
-  init + compile can legitimately take minutes; a subprocess is the only
-  reliable watchdog, SIGALRM cannot interrupt a C call wedged inside PJRT
-  client creation). On child failure OR timeout it retries once with
-  ``JAX_PLATFORMS=cpu`` (TPUFW_BENCH_CPU_TIMEOUT, default 600s); the TPU
-  error is carried through the environment and lands in the final JSON as
-  ``"tpu_error"``. One attempt, one init: nothing is double-initialized
-  and the cold-start metric stays honest.
+- Stage 0 (orchestrator, no jax import) budgets TPUFW_BENCH_TOTAL
+  (default 1800s) across child processes — subprocesses are the only
+  reliable watchdog, SIGALRM cannot interrupt a C call wedged inside
+  PJRT client creation:
+  1. **init probe** (TPUFW_BENCH_PROBE_TIMEOUT, default 150s): a child
+     that just answers ``jax.devices()``. Decides whether the big TPU
+     budget is worth committing at all.
+  2. probe ok → **TPU worker** (up to TPUFW_BENCH_TIMEOUT, default
+     1200s, capped to leave CPU-fallback headroom).
+  3. probe dead or worker failed → **CPU worker** immediately
+     (TPUFW_BENCH_CPU_TIMEOUT, default 600s) — then, while wall clock
+     allows, re-probe the TPU periodically (tunnel wedges clear on
+     far-side lease expiry) and upgrade to a TPU line if it comes back.
+  4. budget left → **warm-restart child**: re-runs the headline tier
+     against the now-warm compile cache and reports
+     ``warm_start_to_first_step_s`` next to the main (cold) number.
+- ``cold_start_to_first_step_s`` is measured from the REPORTING worker's
+  own start (a real cold-start number); time burned on failed TPU
+  attempts is reported separately as ``tpu_attempt_s`` / ``tpu_probe_s``,
+  with ``total_wall_s`` for the whole orchestration.
 - Whatever happens, exactly one JSON line is printed and the exit code is
   0. Total-failure paths emit ``{"metric": ..., "value": 0, "error": ...}``.
-
-Also reports cold-start→first-step (BASELINE.md metric 2): wall-clock from
-orchestrator start (so a failed TPU attempt is honestly included in the cpu
-fallback's number) to the first completed optimizer step, plus whether the
-persistent XLA compile cache was warm.
 """
 
 from __future__ import annotations
@@ -39,8 +46,10 @@ import sys
 import time
 
 _T0 = float(os.environ.get("TPUFW_BENCH_T0") or time.time())
-_IS_WORKER = os.environ.get("TPUFW_BENCH_STAGE") == "worker"
-# The worker's share of the orchestrator watchdog (it started ~at _T0).
+_STAGE = os.environ.get("TPUFW_BENCH_STAGE", "")
+_IS_WORKER = _STAGE == "worker"
+# The worker's share of its orchestrator-assigned watchdog budget
+# (it started ~at _T0).
 _BUDGET_S = int(os.environ.get("TPUFW_BENCH_TIMEOUT", "1200"))
 
 
@@ -73,15 +82,34 @@ def _fail_line(err: str) -> None:
 # ----------------------------------------------------------------------
 
 
+def _last_json_line(text: str) -> str | None:
+    """The last stdout line that looks like a JSON object — the one
+    emission contract every child stage shares."""
+    return next(
+        (
+            ln
+            for ln in reversed((text or "").strip().splitlines())
+            if ln.startswith("{")
+        ),
+        None,
+    )
+
+
 def _run_worker(extra_env: dict, timeout: int) -> tuple[str | None, str]:
     """Run this script as a worker child. Returns (json_line, error);
-    exactly one of the two is meaningful (json_line None = failed)."""
+    exactly one of the two is meaningful (json_line None = failed).
+
+    The child's T0 is ITS OWN spawn time and its budget is the actual
+    ``timeout`` allocated here — so cold-start numbers and aux-tier
+    time-boxing are per-attempt, never polluted by earlier failed
+    attempts (VERDICT r2 weak #2)."""
     import subprocess
 
     env = dict(os.environ)
     env.update(extra_env)
     env["TPUFW_BENCH_STAGE"] = "worker"
-    env["TPUFW_BENCH_T0"] = repr(_T0)
+    env["TPUFW_BENCH_T0"] = repr(time.time())
+    env["TPUFW_BENCH_TIMEOUT"] = str(int(timeout))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -96,14 +124,7 @@ def _run_worker(extra_env: dict, timeout: int) -> tuple[str | None, str]:
         out = te.stdout or ""
         if isinstance(out, bytes):
             out = out.decode(errors="replace")
-        line = next(
-            (
-                ln
-                for ln in reversed(out.strip().splitlines())
-                if ln.startswith("{")
-            ),
-            None,
-        )
+        line = _last_json_line(out)
         if line is not None:
             sys.stderr.write(
                 f"bench: worker hit {timeout}s watchdog after the "
@@ -111,43 +132,212 @@ def _run_worker(extra_env: dict, timeout: int) -> tuple[str | None, str]:
             )
             return line, ""
         return None, f"bench worker exceeded {timeout}s (hung; killed)"
-    # Pass worker diagnostics (tier OOM notes, tracebacks) through.
-    sys.stderr.write(proc.stderr)
-    line = next(
-        (
-            ln
-            for ln in reversed(proc.stdout.strip().splitlines())
-            if ln.startswith("{")
-        ),
-        None,
-    )
+    # Pass worker diagnostics (tier OOM notes, tracebacks) through —
+    # minus XLA's cpu_aot_loader machine-feature spray: with the cache
+    # keyed per-machine (tpufw.utils.profiling.machine_fingerprint) the
+    # only remaining trigger is XLA recording its own +prefer-no-scatter
+    # /+prefer-no-gather codegen *preferences* as target features and
+    # then not modeling them in the load-time host check — a same-host
+    # false positive (the r2 bench executed fine through it), not a real
+    # ISA mismatch.
+    dropped = 0
+    for ln in proc.stderr.splitlines(keepends=True):
+        if "cpu_aot_loader" in ln and "machine features" in ln.lower():
+            dropped += 1
+            continue
+        sys.stderr.write(ln)
+    if dropped:
+        sys.stderr.write(
+            f"bench: dropped {dropped} cpu_aot_loader machine-feature "
+            "lines (known same-host false positive: XLA prefer-no-* "
+            "codegen preferences; cache is keyed per-machine)\n"
+        )
+    line = _last_json_line(proc.stdout)
     if proc.returncode == 0 and line:
         return line, ""
     tail = (proc.stderr or proc.stdout or "").strip().splitlines()
     return None, "worker failed: " + " | ".join(tail[-4:])
 
 
+_PROBE_SRC = """\
+import json
+import jax
+d = jax.devices()
+print(json.dumps(
+    {"platform": d[0].platform, "n": len(d), "kind": d[0].device_kind}
+))
+"""
+
+
+def _probe_tpu(timeout: int) -> tuple[str, str]:
+    """Cheap init probe: is ``jax.devices()`` answerable, and is it a
+    TPU? A wedged tunnel hangs inside PJRT client creation for hours
+    (round-2 postmortem), so this child decides — in ~probe-timeout
+    worst case instead of the full bench budget — whether to commit.
+
+    Returns (status, detail): "tpu" = commit the budget; "no_tpu" =
+    answered with a non-TPU platform (DEFINITIVE — no TPU backend is
+    registered, retrying cannot help); "error" = hang or init failure
+    (a wedge: retrying later can succeed, tunnels come back on far-side
+    lease expiry)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            env=dict(os.environ),
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return (
+            "error", f"jax.devices() unanswered after {timeout}s (hang)"
+        )
+    line = _last_json_line(proc.stdout)
+    if proc.returncode != 0 or line is None:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return "error", ("probe failed: " + " | ".join(tail[-3:]))[:500]
+    try:
+        info = json.loads(line)
+    except ValueError:
+        return "error", f"probe output unparseable: {line[:200]}"
+    plat = str(info.get("platform", ""))
+    if plat == "tpu" or "tpu" in str(info.get("kind", "")).lower():
+        return "tpu", plat
+    return "no_tpu", f"probe found platform {plat!r}, not tpu"
+
+
 def _orchestrate() -> int:
-    timeout = int(os.environ.get("TPUFW_BENCH_TIMEOUT", "1200"))
+    t_start = time.time()
+    total = int(os.environ.get("TPUFW_BENCH_TOTAL", "1800"))
+    tpu_timeout = int(os.environ.get("TPUFW_BENCH_TIMEOUT", "1200"))
     cpu_timeout = int(os.environ.get("TPUFW_BENCH_CPU_TIMEOUT", "600"))
+    probe_timeout = int(os.environ.get("TPUFW_BENCH_PROBE_TIMEOUT", "150"))
 
-    attempts: list[tuple[dict, int]] = []
-    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        attempts.append(({}, timeout))
-    attempts.append(({"JAX_PLATFORMS": "cpu"}, cpu_timeout))
+    def left() -> float:
+        return total - (time.time() - t_start)
 
-    err = ""
-    for extra_env, t in attempts:
-        if err:
-            extra_env = dict(extra_env)
-            extra_env["TPUFW_BENCH_TPU_ERROR"] = err[-2000:]
-        line, this_err = _run_worker(extra_env, t)
+    want_tpu = os.environ.get("JAX_PLATFORMS", "") != "cpu"
+    tpu_time = 0.0  # every second spent probing/attempting the TPU
+    probe_s = None
+    tpu_errs: list[str] = []  # kept in order; first is the most telling
+    line: str | None = None
+    platform_used = None
+
+    # Phase 1+2: probe, and commit the big budget only if it answers.
+    probe = "skipped"
+    if want_tpu:
+        t0 = time.time()
+        probe, info = _probe_tpu(probe_timeout)
+        probe_s = time.time() - t0
+        tpu_time += probe_s
+        if probe != "tpu":
+            tpu_errs.append(f"init probe: {info}")
+            sys.stderr.write(
+                f"bench: TPU probe: {info}; CPU "
+                + (
+                    "only (definitive: no TPU backend)\n"
+                    if probe == "no_tpu"
+                    else "first, will re-probe if wall clock allows\n"
+                )
+            )
+    if probe == "tpu":
+        # Keep headroom for a CPU fallback line if the worker dies.
+        budget = int(min(tpu_timeout, left() - 120))
+        if budget > 120:
+            t0 = time.time()
+            line, err = _run_worker({}, budget)
+            tpu_time += time.time() - t0
+            if line is None:
+                tpu_errs.append(f"tpu worker: {err}")
+                sys.stderr.write(
+                    f"bench: TPU worker failed ({err}); cpu fallback\n"
+                )
+            else:
+                platform_used = "tpu"
+
+    # Phase 3: CPU path (fallback, or first line while the TPU is down).
+    if line is None:
+        budget = int(min(cpu_timeout, max(60, left() - 30)))
+        line, err = _run_worker({"JAX_PLATFORMS": "cpu"}, budget)
         if line is not None:
-            print(line)
+            platform_used = "cpu"
+        else:
+            _fail_line(" | ".join([*tpu_errs, err]))
             return 0
-        err = this_err
-        sys.stderr.write(f"bench: attempt failed ({err}); falling back\n")
-    _fail_line(err)
+
+    # Phase 4: late TPU retries — tunnel wedges clear on far-side lease
+    # expiry (observed round 2: down ~6.5h, then back). Only worth it
+    # when the probe result was a RETRYABLE failure ("error"): a
+    # definitive "no_tpu" answer means no TPU backend exists here, and
+    # looping would stall every CPU-only environment by the whole
+    # remaining budget. Each retry needs probe + a meaningful worker
+    # budget.
+    while (
+        want_tpu
+        and platform_used == "cpu"
+        and probe == "error"
+        and left() > probe_timeout + 420
+    ):
+        t0 = time.time()
+        probe, info = _probe_tpu(probe_timeout)
+        dt = time.time() - t0
+        tpu_time += dt
+        if probe == "tpu":
+            t0 = time.time()
+            tline, err = _run_worker({}, int(min(tpu_timeout, left() - 60)))
+            tpu_time += time.time() - t0
+            if tline is not None:
+                line, platform_used, tpu_errs = tline, "tpu", []
+            else:
+                tpu_errs.append(f"late tpu worker: {err}")
+            break
+        if not tpu_errs or tpu_errs[-1] != f"re-probe: {info}":
+            tpu_errs.append(f"re-probe: {info}")
+        # A hung probe already burned its timeout; a fast-fail needs a
+        # pause before the wedge could plausibly have cleared.
+        time.sleep(min(60.0, max(0.0, probe_timeout - dt)))
+
+    try:
+        payload = json.loads(line)
+    except ValueError:
+        print(line)  # unparseable but measured: emit verbatim
+        return 0
+
+    # Phase 5: warm-restart child — same headline tier, now-warm compile
+    # cache: the BASELINE metric-2 pair (cold vs warm first-contact).
+    if payload.get("cold_start_to_first_step_s") is not None and left() > (
+        300 if platform_used == "tpu" else 90
+    ):
+        tier = {
+            k: payload.get(k)
+            for k in (
+                "batch_size", "seq_len", "loss_chunk_size", "remat_policy",
+            )
+        }
+        extra = {"TPUFW_BENCH_WARM_TIER": json.dumps(tier)}
+        if platform_used == "cpu":
+            extra["JAX_PLATFORMS"] = "cpu"
+        wline, werr = _run_worker(extra, int(min(left() - 30, 600)))
+        if wline is not None:
+            try:
+                payload["warm_start_to_first_step_s"] = json.loads(
+                    wline
+                ).get("warm_start_to_first_step_s")
+            except ValueError:
+                pass
+        else:
+            payload["warm_start_error"] = werr[:300]
+
+    payload["tpu_probe_s"] = (
+        round(probe_s, 1) if probe_s is not None else None
+    )
+    payload["tpu_attempt_s"] = round(tpu_time, 1)
+    payload["total_wall_s"] = round(time.time() - t_start, 1)
+    if tpu_errs and platform_used != "tpu":
+        payload["tpu_error"] = " | ".join(tpu_errs)[-2000:]
+    _emit(payload)
     return 0
 
 
@@ -225,12 +415,17 @@ def _worker() -> int:
     # start in seconds. Same lever as the deploy manifests' cache PV.
     from tpufw.utils.profiling import enable_compile_cache
 
-    cache_dir = os.environ.get(
-        "TPUFW_COMPILE_CACHE_DIR",
-        os.path.join(os.path.dirname(__file__), ".xla-cache"),
+    # enable_compile_cache keys the dir by machine fingerprint, so a
+    # cache written through the tunnel (or checked in from another host)
+    # can never serve this machine a wrong-ISA executable (BENCH_r02's
+    # SIGILL warning spray).
+    cache_dir = enable_compile_cache(
+        os.environ.get(
+            "TPUFW_COMPILE_CACHE_DIR",
+            os.path.join(os.path.dirname(__file__), ".xla-cache"),
+        )
     )
-    cache_warm = os.path.isdir(cache_dir) and bool(os.listdir(cache_dir))
-    enable_compile_cache(cache_dir)
+    cache_warm = bool(cache_dir) and bool(os.listdir(cache_dir))
 
     import jax
 
@@ -246,6 +441,32 @@ def _worker() -> int:
     from tpufw.configs import BENCH_CONFIG_NAME, bench_model_config
     from tpufw.models import LLAMA_CONFIGS
     from tpufw.utils import detect_chip
+
+    warm_tier = os.environ.get("TPUFW_BENCH_WARM_TIER")
+    if warm_tier:
+        # Warm-restart mode: re-run ONLY the headline tier against the
+        # now-warm compile cache and report this process's own
+        # start -> first-step. Paired with the main worker's cold
+        # number (BASELINE metric 2: first-contact experience).
+        tier = json.loads(warm_tier)
+        w_cfg = bench_model_config() if on_tpu else LLAMA_CONFIGS[
+            "llama3_tiny"
+        ]
+        w_first: dict = {}
+        _run_tier(
+            w_cfg, tier["batch_size"], tier["seq_len"], 0, 2,
+            tier.get("loss_chunk_size"), w_first,
+            remat_policy=tier.get("remat_policy"),
+        )
+        _emit(
+            {
+                "warm_start_to_first_step_s": round(
+                    w_first["t"] - _T0, 1
+                ),
+                "platform": platform,
+            }
+        )
+        return 0
 
     if on_tpu:
         model_cfg = bench_model_config()
@@ -327,8 +548,6 @@ def _worker() -> int:
         else None,
         "compile_cache_warm": cache_warm,
     }
-    if os.environ.get("TPUFW_BENCH_TPU_ERROR"):
-        payload["tpu_error"] = os.environ["TPUFW_BENCH_TPU_ERROR"]
     # Headline-first emission: if an aux tier below blows the watchdog,
     # the orchestrator salvages this line instead of losing the run.
     _emit(payload)

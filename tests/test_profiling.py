@@ -19,12 +19,21 @@ def test_compile_cache_enable(tmp_path):
     }
     cache = tmp_path / "xla-cache"
     try:
+        from tpufw.utils.profiling import machine_fingerprint
+
         got = enable_compile_cache(str(cache))
-        assert got == str(cache)
-        assert os.path.isdir(cache)
+        # Per-machine keying: a shared dir cannot serve executables
+        # compiled for another host's CPU features (BENCH_r02 SIGILL
+        # warning); identical machines map to the same subdir.
+        assert got == str(cache / machine_fingerprint())
+        assert os.path.isdir(got)
+        assert enable_compile_cache(str(cache), per_machine=False) == str(
+            cache
+        )
+        got = enable_compile_cache(str(cache))
         # A fresh compile must leave a persisted entry behind.
         jax.jit(lambda x: x * 2 + 1)(jnp.arange(128.0)).block_until_ready()
-        assert any(cache.iterdir())
+        assert any(os.listdir(got))
     finally:
         for name, value in prev.items():
             jax.config.update(name, value)

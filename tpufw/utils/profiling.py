@@ -27,15 +27,49 @@ import jax
 COMPILE_CACHE_ENV = "TPUFW_COMPILE_CACHE_DIR"
 
 
-def enable_compile_cache(path: Optional[str] = None) -> Optional[str]:
+def machine_fingerprint() -> str:
+    """Short stable id of this host's CPU architecture + feature flags.
+
+    XLA CPU executables are compiled for the build host's exact feature
+    set; reusing a cache dir across heterogeneous machines can SIGILL
+    (observed as a warning spray in BENCH_r02). Keying cache dirs by
+    this fingerprint gives each machine class its own namespace while
+    identical pods still share.
+    """
+    import hashlib
+    import platform
+
+    bits = [platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 "flags", arm64 "Features": first hit describes
+                # every core uniformly on the machines we care about.
+                if line.startswith(("flags", "Features")):
+                    bits.append(" ".join(sorted(line.split(":", 1)[1].split())))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(" ".join(bits).encode()).hexdigest()[:10]
+
+
+def enable_compile_cache(
+    path: Optional[str] = None, per_machine: bool = True
+) -> Optional[str]:
     """Turn on XLA's persistent compilation cache at ``path``.
 
     ``path`` defaults to ``$TPUFW_COMPILE_CACHE_DIR``; no-op (returning
     None) when neither is set, so workloads can call this unconditionally.
+    With ``per_machine`` (default) the cache lives in a
+    ``machine_fingerprint()`` subdir, so a dir shared across machine
+    types (PV, checked-in cache) cannot serve an executable compiled
+    for another host's CPU features.
     """
     path = path or os.environ.get(COMPILE_CACHE_ENV)
     if not path:
         return None
+    if per_machine:
+        path = os.path.join(path, machine_fingerprint())
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # Cache everything: tiny compiles are still worth skipping on restart.
