@@ -153,6 +153,36 @@ def test_model_overrides_applied_and_checked(tmp_path):
         load_run_config(bad)
 
 
+def test_rope_scaling_override_coerced(tmp_path):
+    """A rope_scaling mapping in YAML becomes the frozen RopeScaling
+    dataclass; unknown keys inside it fail loudly like any section."""
+    from tpufw.models.llama import RopeScaling
+
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(
+        textwrap.dedent(
+            """
+            model:
+              preset: llama3_tiny
+              overrides:
+                rope_scaling: {factor: 4.0, original_max_position_embeddings: 64}
+            """
+        )
+    )
+    run = load_run_config(cfg)
+    assert run.model_cfg.rope_scaling == RopeScaling(
+        factor=4.0, original_max_position_embeddings=64
+    )
+
+    bad = tmp_path / "b.yaml"
+    bad.write_text(
+        "model: {preset: llama3_tiny, "
+        "overrides: {rope_scaling: {rope_type: llama3}}}\n"
+    )
+    with pytest.raises(ValueError, match="unknown keys.*rope_type"):
+        load_run_config(bad)
+
+
 def test_env_overrides_yaml_in_build_trainer(monkeypatch):
     """TPUFW_CONFIG is the base layer; TPUFW_* env wins on top."""
     from tpufw.workloads.train_llama import build_trainer

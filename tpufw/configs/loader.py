@@ -134,6 +134,18 @@ def _apply_model_overrides(cfg, overrides: dict):
     for k in _DTYPE_FIELDS:
         if isinstance(coerced.get(k), str):
             coerced[k] = jnp.dtype(coerced[k]).type
+    if isinstance(coerced.get("rope_scaling"), dict):
+        # YAML spells the Llama-3.1 rope transform as a mapping; the
+        # config stores the frozen dataclass (unknown keys are hard
+        # errors like everywhere else in this loader).
+        from tpufw.models.llama import RopeScaling
+
+        _reject_unknown(
+            "model.overrides.rope_scaling",
+            coerced["rope_scaling"],
+            {f.name for f in dataclasses.fields(RopeScaling)},
+        )
+        coerced["rope_scaling"] = RopeScaling(**coerced["rope_scaling"])
     return dataclasses.replace(cfg, **coerced)
 
 

@@ -3,7 +3,12 @@ from tpufw.models.gemma import (  # noqa: F401
     Gemma,
     GemmaConfig,
 )
-from tpufw.models.llama import Llama, LlamaConfig, LLAMA_CONFIGS  # noqa: F401
+from tpufw.models.llama import (  # noqa: F401
+    Llama,
+    LlamaConfig,
+    LLAMA_CONFIGS,
+    RopeScaling,
+)
 from tpufw.models.mixtral import (  # noqa: F401
     MIXTRAL_CONFIGS,
     Mixtral,

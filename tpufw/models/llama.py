@@ -44,6 +44,23 @@ _REMAT_POLICIES = {
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1 "llama3" rotary frequency transform (HF
+    ``rope_scaling.rope_type == "llama3"``): low-frequency components are
+    slowed by ``factor`` (extending the usable context), high-frequency
+    components are kept, and a smooth ramp interpolates between the two
+    wavelength bands. The only rope_type tpufw implements — yarn /
+    linear / dynamic are rejected at import (tools/import_hf.py) rather
+    than silently approximated.
+    """
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 128_256
     d_model: int = 4096
@@ -53,6 +70,8 @@ class LlamaConfig:
     head_dim: int = 128
     d_ff: int = 14_336
     rope_theta: float = 500_000.0
+    # Llama-3.1+ long-context rope transform (None = plain RoPE).
+    rope_scaling: Optional[RopeScaling] = None
     rms_eps: float = 1e-5
     max_seq_len: int = 8192
     tie_embeddings: bool = False
@@ -164,6 +183,13 @@ class LlamaConfig:
 # architecture scaled to fit one v5e chip (16 GiB HBM) for bench/smoke runs.
 LLAMA_CONFIGS: dict[str, LlamaConfig] = {
     "llama3_8b": LlamaConfig(),
+    # Llama-3.1-8B: same shape as 3.0, llama3 rope transform (Meta's
+    # published scaling params are RopeScaling's defaults), 128k
+    # context window.
+    "llama31_8b": LlamaConfig(
+        max_seq_len=131_072,
+        rope_scaling=RopeScaling(),
+    ),
     "llama3_1b_proxy": LlamaConfig(
         vocab_size=32_768,
         d_model=2048,
@@ -241,14 +267,43 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
 }
 
 
+def _scale_rope_freqs(
+    freqs: jax.Array, s: RopeScaling
+) -> jax.Array:
+    """The "llama3" frequency transform (matches HF
+    ``_compute_llama3_parameters`` so imported Llama-3.1 checkpoints are
+    bit-comparable): components with wavelength beyond
+    ``original_max/low_freq_factor`` are slowed by ``factor``, those
+    below ``original_max/high_freq_factor`` are kept, and the band
+    between is linearly interpolated in smooth-factor space."""
+    old_len = float(s.original_max_position_embeddings)
+    wavelen = 2.0 * math.pi / freqs
+    scaled = jnp.where(
+        wavelen > old_len / s.low_freq_factor, freqs / s.factor, freqs
+    )
+    smooth = (old_len / wavelen - s.low_freq_factor) / (
+        s.high_freq_factor - s.low_freq_factor
+    )
+    smoothed = (1.0 - smooth) * freqs / s.factor + smooth * freqs
+    is_medium = (wavelen <= old_len / s.low_freq_factor) & (
+        wavelen >= old_len / s.high_freq_factor
+    )
+    return jnp.where(is_medium, smoothed, scaled)
+
+
 def apply_rope(
-    x: jax.Array, positions: jax.Array, theta: float
+    x: jax.Array,
+    positions: jax.Array,
+    theta: float,
+    scaling: Optional[RopeScaling] = None,
 ) -> jax.Array:
     """Rotary embeddings. x: [B, T, H, D], positions: [B, T] -> same shape."""
     d = x.shape[-1]
     freqs = 1.0 / (
         theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
     )  # [D/2]
+    if scaling is not None:
+        freqs = _scale_rope_freqs(freqs, scaling)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, D/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -438,8 +493,9 @@ class Attention(nn.Module):
             cfg, x, (cfg.n_kv_heads, cfg.head_dim), -1,
             ("embed",), ("kv_heads", "head_dim"), "v", use_bias=qkv_bias,
         )
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        rope_scaling = getattr(cfg, "rope_scaling", None)
+        q = apply_rope(q, positions, cfg.rope_theta, rope_scaling)
+        k = apply_rope(k, positions, cfg.rope_theta, rope_scaling)
         # Non-default query scaling (Gemma's query_pre_attn_scalar):
         # backends scale by head_dim**-0.5 internally, so pre-multiply q
         # by the ratio to the desired qpas**-0.5.

@@ -38,6 +38,38 @@ def _to_np(t: Any) -> np.ndarray:
     return t.detach().to("cpu").float().numpy()
 
 
+def _rope_scaling_from_hf(rs: Any):
+    """HF ``rope_scaling`` dict -> tpufw RopeScaling (or None).
+
+    Only ``rope_type == "llama3"`` (Llama-3.1/3.3 family) is
+    implemented; anything else (yarn, linear, dynamic, longrope) is
+    rejected loudly — a silently-dropped transform would import a model
+    whose logits drift with position."""
+    if not rs:
+        return None
+    from tpufw.models.llama import RopeScaling
+
+    get = rs.get if isinstance(rs, Mapping) else lambda k, d=None: getattr(
+        rs, k, d
+    )
+    # transformers renamed "type" -> "rope_type"; accept both.
+    rtype = get("rope_type") or get("type")
+    if rtype != "llama3":
+        raise NotImplementedError(
+            f"rope_scaling rope_type={rtype!r} is not implemented "
+            "(only 'llama3'); importing would silently change rotary "
+            "frequencies"
+        )
+    return RopeScaling(
+        factor=float(get("factor")),
+        low_freq_factor=float(get("low_freq_factor")),
+        high_freq_factor=float(get("high_freq_factor")),
+        original_max_position_embeddings=int(
+            get("original_max_position_embeddings")
+        ),
+    )
+
+
 def config_from_hf(hf_config: Any) -> LlamaConfig:
     """tpufw config from a transformers Llama/Mixtral config (object or
     dict). ``model_type == "mixtral"`` yields a MixtralConfig."""
@@ -57,10 +89,8 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
             "attention) is not implemented"
         )
     # Reject, loudly, configs whose architecture tpufw doesn't implement —
-    # importing them would produce silently wrong logits (e.g. Llama-3.1
-    # checkpoints need rope_scaling, which apply_rope doesn't apply).
+    # importing them would produce silently wrong logits.
     unsupported = {
-        "rope_scaling": lambda v: v not in (None, {}),
         # Qwen2 carries qkv biases by construction; Llama-family configs
         # with attention_bias remain rejected (their bias is on ALL four
         # projections, which the blocks don't implement).
@@ -82,6 +112,7 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
     d_model = get("hidden_size")
     n_heads = get("num_attention_heads")
     common = dict(
+        rope_scaling=_rope_scaling_from_hf(get("rope_scaling")),
         vocab_size=get("vocab_size"),
         d_model=d_model,
         n_layers=get("num_hidden_layers"),
@@ -430,6 +461,21 @@ def hf_config_dict(cfg: LlamaConfig) -> dict:
         "intermediate_size": cfg.d_ff,
         "rope_theta": cfg.rope_theta,
         "rms_norm_eps": cfg.rms_eps,
+        **(
+            {
+                "rope_scaling": {
+                    "rope_type": "llama3",
+                    "factor": cfg.rope_scaling.factor,
+                    "low_freq_factor": cfg.rope_scaling.low_freq_factor,
+                    "high_freq_factor": cfg.rope_scaling.high_freq_factor,
+                    "original_max_position_embeddings": (
+                        cfg.rope_scaling.original_max_position_embeddings
+                    ),
+                }
+            }
+            if getattr(cfg, "rope_scaling", None) is not None
+            else {}
+        ),
         "max_position_embeddings": cfg.max_seq_len,
         "tie_word_embeddings": cfg.tie_embeddings,
         "attention_bias": False,
