@@ -375,3 +375,138 @@ def test_mistral_window_reaches_pipeline_blocks(devices8):
         params, tokens, dataclasses.replace(mcfg, sliding_window=None)
     )
     assert np.abs(np.asarray(want) - np.asarray(wide)).max() > 1e-4
+
+
+# ----------------------------------------------------------------------
+# pp x tp composition (VERDICT r2 #3): Megatron tensor split inside the
+# GPipe stages — schedule + tensor sharding must stay numerically
+# invisible vs the sequential oracle.
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def pptp_mesh():
+    return build_mesh(MeshConfig(data=1, pipe=2, fsdp=2, tensor=2))
+
+
+@pytest.fixture(scope="module")
+def pptp_setup(pptp_mesh):
+    pipe = PipelineConfig(n_stages=2, n_microbatches=4)
+    params = init_pipeline_params(jax.random.key(2), CFG, pipe)
+    params = jax.device_put(
+        params, pipeline_param_shardings(pptp_mesh, params)
+    )
+    tokens = jax.random.randint(
+        jax.random.key(3), (8, 17), 0, CFG.vocab_size
+    )
+    return params, tokens, pipe
+
+
+def test_pptp_params_sharded_on_tensor(pptp_setup):
+    params, _, _ = pptp_setup
+    assert "tensor" in str(params["stages"]["wq"].sharding.spec)
+    assert "tensor" in str(params["stages"]["w_down"].sharding.spec)
+    assert "tensor" not in str(params["stages"]["attn_norm"].sharding.spec)
+
+
+def test_pptp_forward_matches_sequential(pptp_setup, pptp_mesh):
+    params, tokens, pipe = pptp_setup
+    got = jax.jit(
+        lambda p, t: pipeline_forward(p, t, CFG, pipe, pptp_mesh)
+    )(params, tokens)
+    want = reference_forward(params, tokens, CFG)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pptp_grads_match_sequential(pptp_setup, pptp_mesh):
+    params, tokens, pipe = pptp_setup
+
+    def ref_loss(p, t):
+        from tpufw.train.trainer import cross_entropy_loss
+
+        logits = reference_forward(p, t[:, :-1], CFG)
+        return cross_entropy_loss(logits, t[:, 1:])[0]
+
+    l_pipe, g_pipe = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: pipeline_loss(p, t, CFG, pipe, pptp_mesh)
+        )
+    )(params, tokens)
+    l_ref, g_ref = jax.value_and_grad(ref_loss)(params, tokens)
+    np.testing.assert_allclose(float(l_pipe), float(l_ref), rtol=1e-5)
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pipe)
+    flat_r, _ = jax.tree_util.tree_flatten_with_path(g_ref)
+    for (path, a), (_, b) in zip(flat_p, flat_r):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_pptp_gemma_forward_matches_sequential(pptp_mesh):
+    """Gemma pairs under pp x tp: the psum-before-post-norm ordering is
+    load-bearing (RMSNorm of a partial sum would silently diverge)."""
+    from tpufw.models import GEMMA_CONFIGS
+
+    gcfg = dataclasses.replace(
+        GEMMA_CONFIGS["gemma2_tiny"],
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        n_layers=8,
+    )
+    pipe = PipelineConfig(n_stages=2, n_microbatches=4)
+    params = init_pipeline_params(jax.random.key(4), gcfg, pipe)
+    params = jax.device_put(
+        params, pipeline_param_shardings(pptp_mesh, params)
+    )
+    tokens = jax.random.randint(
+        jax.random.key(5), (8, 32), 0, gcfg.vocab_size
+    )
+    want = reference_forward(params, tokens, gcfg)
+    got = jax.jit(
+        lambda p, t: pipeline_forward(p, t, gcfg, pipe, pptp_mesh)
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_pptp_indivisible_heads_loud(pptp_mesh):
+    """tensor=2 with odd kv heads must fail before building shardings."""
+    bad = dataclasses.replace(CFG, n_kv_heads=1, n_heads=3)
+    pipe = PipelineConfig(n_stages=2, n_microbatches=4)
+    params = init_pipeline_params(jax.random.key(6), bad, pipe)
+    tokens = jnp.zeros((8, 17), jnp.int32)
+    with pytest.raises(ValueError, match="must divide n_heads"):
+        pipeline_forward(params, tokens, bad, pipe, pptp_mesh)
+
+
+def test_pptp_trainer_step(pptp_mesh):
+    """PipelineTrainer end to end on a pp=2 x tp=2 x fsdp=2 mesh: opt
+    moments inherit the tensor split and a step runs + learns."""
+    from tpufw.train import TrainerConfig, synthetic_batches
+    from tpufw.train.pipeline_trainer import PipelineTrainer
+
+    pipe = PipelineConfig(n_stages=2, n_microbatches=4)
+    tr = PipelineTrainer(
+        CFG,
+        pipe,
+        TrainerConfig(batch_size=8, seq_len=17, total_steps=3, lr=1e-2),
+        MeshConfig(data=1, pipe=2, fsdp=2, tensor=2),
+    )
+    tr.init_state()
+    wq_m = None
+    for leaf in jax.tree.leaves(tr.state.opt_state):
+        if hasattr(leaf, "shape") and leaf.shape == tr.state.params[
+            "stages"
+        ]["wq"].shape:
+            wq_m = leaf
+            break
+    assert wq_m is not None and "tensor" in str(wq_m.sharding.spec)
+    hist = tr.run(
+        synthetic_batches(8, 17, CFG.vocab_size),
+        model_flops_per_token=CFG.flops_per_token(16),
+    )
+    assert len(hist) == 3 and np.isfinite(hist[-1].loss)

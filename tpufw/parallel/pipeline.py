@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpufw.mesh import AXIS_DATA, AXIS_FSDP, AXIS_PIPE
+from tpufw.mesh import AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_TENSOR
 from tpufw.models.llama import LlamaConfig, apply_rope
 from tpufw.ops import multi_head_attention, rms_norm
 
@@ -199,13 +199,55 @@ def init_pipeline_params(
     }
 
 
+#: Which axis of each stage-stack leaf shards over ``tensor``
+#: (Megatron-style): q/k/v split output heads, o splits input heads,
+#: gate/up split d_ff columns, down splits d_ff rows — so each block
+#: needs exactly two psums (post-attention, post-MLP). Leaf names are
+#: shared by the Llama ([S, lps, ...]) and Gemma ([S, pairs, ...])
+#: layouts, whose leaves have identical ranks.
+_TENSOR_LEAF_AXIS = {
+    "wq": 3, "wk": 3, "wv": 3,  # [S, L, d, H, dh] -> head axis
+    "wo": 2,                    # [S, L, H, dh, d] -> head axis
+    "w_gate": 3, "w_up": 3,     # [S, L, d, f] -> ffn columns
+    "w_down": 2,                # [S, L, f, d] -> ffn rows
+}
+
+
+def stage_partition_specs(stages: dict) -> Any:
+    """Per-leaf PartitionSpecs for a stage-stack pytree: leading [S]
+    axis over ``pipe``, plus the Megatron tensor split per
+    ``_TENSOR_LEAF_AXIS``. Used both as ``shard_map`` in_specs and (via
+    ``pipeline_param_shardings``) as the physical param layout, so the
+    two can't disagree."""
+
+    def spec(path, leaf):
+        name = next(
+            (
+                k.key
+                for k in reversed(path)
+                if isinstance(getattr(k, "key", None), str)
+            ),
+            "",
+        )
+        axes: list = [AXIS_PIPE] + [None] * (leaf.ndim - 1)
+        t = _TENSOR_LEAF_AXIS.get(name)
+        if t is not None:
+            axes[t] = AXIS_TENSOR
+        return P(*axes)
+
+    return jax.tree_util.tree_map_with_path(spec, stages)
+
+
 def pipeline_param_shardings(mesh: Mesh, params: dict) -> dict:
-    """NamedShardings: stage stacks split over ``pipe``, rest replicated."""
-    stage = NamedSharding(mesh, P(AXIS_PIPE))
+    """NamedShardings: stage stacks split over ``pipe`` (+ ``tensor``
+    on head/ffn axes), rest replicated."""
     rep = NamedSharding(mesh, P())
     out = {
         "embed": rep,
-        "stages": jax.tree.map(lambda _: stage, params["stages"]),
+        "stages": jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            stage_partition_specs(params["stages"]),
+        ),
         "final_norm": rep,
     }
     if "head" in params:
@@ -218,10 +260,20 @@ def pipeline_param_shardings(mesh: Mesh, params: dict) -> dict:
 # ----------------------------------------------------------------------
 
 
+def _tp_psum(y: jax.Array, tp: bool) -> jax.Array:
+    """Combine row-parallel partial sums over ``tensor``. ``tp`` is a
+    trace-time bool: False in the sequential oracle (no mesh axes
+    bound) and on tensor=1 meshes (psum would be identity)."""
+    return jax.lax.psum(y, AXIS_TENSOR) if tp else y
+
+
 def _block(
-    p: dict, x: jax.Array, cfg: LlamaConfig, backend: str, seg=None
+    p: dict, x: jax.Array, cfg: LlamaConfig, backend: str, seg=None,
+    tp: bool = False,
 ):
-    """One decoder block; p leaves have no leading layer axis."""
+    """One decoder block; p leaves have no leading layer axis. With
+    ``tp`` the head/ffn axes of p are LOCAL shards (Megatron split per
+    ``_TENSOR_LEAF_AXIS``); the two partial-sum einsums are psummed."""
     dt = cfg.dtype
     positions = jnp.broadcast_to(
         jnp.arange(x.shape[1]), x.shape[:2]
@@ -230,27 +282,35 @@ def _block(
     q = jnp.einsum("btd,dhk->bthk", h, p["wq"].astype(dt))
     k = jnp.einsum("btd,dhk->bthk", h, p["wk"].astype(dt))
     v = jnp.einsum("btd,dhk->bthk", h, p["wv"].astype(dt))
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    rs = getattr(cfg, "rope_scaling", None)
+    q = apply_rope(q, positions, cfg.rope_theta, rs)
+    k = apply_rope(k, positions, cfg.rope_theta, rs)
     att = multi_head_attention(
         q, k, v, causal=True, segment_ids=seg,
         # Mistral-style uniform window (None for plain Llama).
         sliding_window=getattr(cfg, "sliding_window", None),
         backend=backend,
     )
-    x = x + jnp.einsum("bthk,hkd->btd", att, p["wo"].astype(dt))
+    x = x + _tp_psum(
+        jnp.einsum("bthk,hkd->btd", att, p["wo"].astype(dt)), tp
+    )
     h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
     g = jnp.einsum("btd,df->btf", h, p["w_gate"].astype(dt))
     u = jnp.einsum("btd,df->btf", h, p["w_up"].astype(dt))
-    x = x + jnp.einsum(
-        "btf,fd->btd", jax.nn.silu(g) * u, p["w_down"].astype(dt)
+    x = x + _tp_psum(
+        jnp.einsum(
+            "btf,fd->btd", jax.nn.silu(g) * u, p["w_down"].astype(dt)
+        ),
+        tp,
     )
     return x
 
 
-def _gemma_block(p, x, cfg, backend, seg, window):
+def _gemma_block(p, x, cfg, backend, seg, window, tp: bool = False):
     """One Gemma-2 block (sandwich (1+w) norms, GeGLU, caps, qpas
-    scaling) — the functional mirror of tpufw.models.gemma.GemmaBlock."""
+    scaling) — the functional mirror of tpufw.models.gemma.GemmaBlock.
+    Under ``tp`` the partial sums are combined BEFORE the post-norms
+    (RMSNorm is nonlinear; psum must see the full activation)."""
     dt = cfg.dtype
     positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
 
@@ -261,8 +321,9 @@ def _gemma_block(p, x, cfg, backend, seg, window):
     q = jnp.einsum("btd,dhk->bthk", h, p["wq"].astype(dt))
     k = jnp.einsum("btd,dhk->bthk", h, p["wk"].astype(dt))
     v = jnp.einsum("btd,dhk->bthk", h, p["wv"].astype(dt))
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    rs = getattr(cfg, "rope_scaling", None)
+    q = apply_rope(q, positions, cfg.rope_theta, rs)
+    k = apply_rope(k, positions, cfg.rope_theta, rs)
     qpas = cfg.query_pre_attn_scalar
     if qpas is not None and float(qpas) != float(cfg.head_dim):
         q = q * (math.sqrt(cfg.head_dim) / math.sqrt(float(qpas)))
@@ -274,31 +335,39 @@ def _gemma_block(p, x, cfg, backend, seg, window):
     )
     x = x + norm(
         "post_attn_norm",
-        jnp.einsum("bthk,hkd->btd", att, p["wo"].astype(dt)),
+        _tp_psum(
+            jnp.einsum("bthk,hkd->btd", att, p["wo"].astype(dt)), tp
+        ),
     )
     h = norm("pre_mlp_norm", x)
     g = jnp.einsum("btd,df->btf", h, p["w_gate"].astype(dt))
     u = jnp.einsum("btd,df->btf", h, p["w_up"].astype(dt))
-    m = jnp.einsum(
-        "btf,fd->btd",
-        jax.nn.gelu(g, approximate=True) * u,
-        p["w_down"].astype(dt),
+    m = _tp_psum(
+        jnp.einsum(
+            "btf,fd->btd",
+            jax.nn.gelu(g, approximate=True) * u,
+            p["w_down"].astype(dt),
+        ),
+        tp,
     )
     return x + norm("post_mlp_norm", m)
 
 
-def _stage(stage_params: dict, x: jax.Array, cfg, backend: str, seg=None):
+def _stage(
+    stage_params: dict, x: jax.Array, cfg, backend: str, seg=None,
+    tp: bool = False,
+):
     """Run this stage's [layers_per_stage] blocks via lax.scan. For
     Gemma the scanned unit is a local+global PAIR (the alternation is a
     static per-block property, so it cannot ride a plain layer scan)."""
     if _is_gemma(cfg):
         out, _ = jax.lax.scan(
-            _gemma_pair_body(cfg, backend, seg), x, stage_params
+            _gemma_pair_body(cfg, backend, seg, tp), x, stage_params
         )
         return out
 
     def body(h, layer_p):
-        return _block(layer_p, h, cfg, backend, seg), None
+        return _block(layer_p, h, cfg, backend, seg, tp), None
 
     out, _ = jax.lax.scan(body, x, stage_params)
     return out
@@ -317,6 +386,9 @@ def _gpipe_local(stage_params, x_mb, *seg_mb, cfg, backend):
     psum-combined)."""
     s = jax.lax.axis_size(AXIS_PIPE)
     sidx = jax.lax.axis_index(AXIS_PIPE)
+    # Static (trace-time) tensor-parallel degree: the stage weights'
+    # head/ffn axes arrive pre-sharded per _TENSOR_LEAF_AXIS.
+    tp = jax.lax.axis_size(AXIS_TENSOR) > 1
     # Local leading stage dim is 1 after sharding: drop it.
     stage_params = jax.tree.map(lambda a: a[0], stage_params)
     m = x_mb.shape[0]
@@ -336,7 +408,7 @@ def _gpipe_local(stage_params, x_mb, *seg_mb, cfg, backend):
             seg_in = seg_all[jnp.clip(t - sidx, 0, m - 1)]
         else:
             seg_in = None
-        out = _stage(stage_params, x_in, cfg, backend, seg_in)
+        out = _stage(stage_params, x_in, cfg, backend, seg_in, tp)
         nxt = jax.lax.ppermute(out, AXIS_PIPE, perm)
         # Last stage finishes microbatch t-(s-1) at tick t.
         oidx = jnp.clip(t - (s - 1), 0, m - 1)
@@ -378,12 +450,26 @@ def pipeline_forward(
     ``segment_ids`` [B, T] masks cross-document attention for packed
     batches; ids ride the ring with their microbatch's activations.
     """
-    for ax in ("tensor", "sequence", "expert"):
+    for ax in ("sequence", "expert"):
         if mesh.shape[ax] != 1:
             raise NotImplementedError(
-                f"pipeline composes with data/fsdp only for now; mesh "
-                f"axis {ax} has size {mesh.shape[ax]}"
+                f"pipeline composes with data/fsdp/tensor only for now; "
+                f"mesh axis {ax} has size {mesh.shape[ax]}"
             )
+    tp = mesh.shape[AXIS_TENSOR]
+    if tp > 1:
+        # Megatron split: heads over q/k/v/o, d_ff over gate/up/down.
+        # Uneven splits would silently mis-shard the stacked weights.
+        for fname, v in (
+            ("n_heads", cfg.n_heads),
+            ("n_kv_heads", cfg.n_kv_heads),
+            ("d_ff", cfg.d_ff),
+        ):
+            if v % tp:
+                raise ValueError(
+                    f"mesh tensor={tp} must divide {fname}={v} "
+                    f"for pipelined tensor parallelism"
+                )
     if mesh.shape[AXIS_PIPE] != pipe.n_stages:
         # Without this, sharding a [S, ...] stack over a differently-sized
         # pipe axis silently drops (or duplicates) stages' layers.
@@ -406,12 +492,13 @@ def pipeline_forward(
     x = x.reshape(m, b // m, t, cfg.d_model)
 
     mb_spec = P(None, (AXIS_DATA, AXIS_FSDP), None, None)
+    stage_specs = stage_partition_specs(params["stages"])
     local = partial(_gpipe_local, cfg=cfg, backend=backend)
     if segment_ids is None:
         hidden = shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(AXIS_PIPE), mb_spec),
+            in_specs=(stage_specs, mb_spec),
             out_specs=mb_spec,
             check_vma=False,
         )(params["stages"], x)
@@ -421,7 +508,7 @@ def pipeline_forward(
         hidden = shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(AXIS_PIPE), mb_spec, seg_spec),
+            in_specs=(stage_specs, mb_spec, seg_spec),
             out_specs=mb_spec,
             check_vma=False,
         )(params["stages"], x, seg)
@@ -474,15 +561,17 @@ def _logits_epilogue(params: dict, hidden: jax.Array, cfg) -> jax.Array:
     return logits
 
 
-def _gemma_pair_body(cfg, backend, seg):
+def _gemma_pair_body(cfg, backend, seg, tp: bool = False):
     """The scanned local+global pair: ONE copy for the staged schedule
     and the sequential oracle."""
 
     def body(h, pair_p):
         h = _gemma_block(
-            pair_p["local"], h, cfg, backend, seg, cfg.sliding_window
+            pair_p["local"], h, cfg, backend, seg, cfg.sliding_window, tp
         )
-        h = _gemma_block(pair_p["global"], h, cfg, backend, seg, None)
+        h = _gemma_block(
+            pair_p["global"], h, cfg, backend, seg, None, tp
+        )
         return h, None
 
     return body

@@ -153,17 +153,21 @@ class PipelineTrainer:
         # match by FULL shape against the stage stacks — every stage
         # stack is >=3-D with a distinct shape, so a collision would
         # need an identically-shaped replicated tensor (none exist).
-        stage_shapes = {
-            tuple(x.shape)
-            for x in jax.tree.leaves(abstract.params["stages"])
+        # The looked-up sharding is the param's own (pipe + tensor
+        # split), so pp x tp moments shard exactly like their weights.
+        stage_sharding_by_shape = {
+            tuple(x.shape): s
+            for x, s in zip(
+                jax.tree.leaves(abstract.params["stages"]),
+                jax.tree.leaves(p_sh["stages"]),
+            )
         }
 
         def opt_shard(leaf):
-            if (
-                hasattr(leaf, "shape")
-                and tuple(leaf.shape) in stage_shapes
-            ):
-                return NamedSharding(self.mesh, P("pipe"))
+            if hasattr(leaf, "shape"):
+                hit = stage_sharding_by_shape.get(tuple(leaf.shape))
+                if hit is not None:
+                    return hit
             return rep
 
         return PipeTrainState(
