@@ -182,8 +182,35 @@ def test_pod_schedules_and_gets_injection(tpu_stack):
         )
         return out in ("Succeeded", "Failed"), out
 
-    phase = _wait(done, 180, "smoke pod completion")
-    logs = _kubectl("logs", "tpufw-it-smoke", "-n", NS)
+    try:
+        phase = _wait(done, 180, "smoke pod completion")
+        logs = _kubectl("logs", "tpufw-it-smoke", "-n", NS, check=False)
+    finally:
+        # Evidence dump for CI artifact upload (kind-integration.yml):
+        # the recorded proof of the admission flow — written in a
+        # finally so a pod stuck Pending still leaves diagnostics for
+        # the failing run.
+        evidence = "/tmp/tpufw-kind-evidence"
+        os.makedirs(evidence, exist_ok=True)
+        with open(os.path.join(evidence, "smoke-pod-logs.txt"), "w") as f:
+            f.write(
+                _kubectl("logs", "tpufw-it-smoke", "-n", NS, check=False)
+            )
+        with open(os.path.join(evidence, "smoke-pod-describe.txt"), "w") as f:
+            f.write(
+                _kubectl(
+                    "describe", "pod", "tpufw-it-smoke", "-n", NS,
+                    check=False,
+                )
+            )
+        with open(os.path.join(evidence, "node-describe.txt"), "w") as f:
+            f.write(_kubectl("describe", "nodes", check=False))
+        with open(os.path.join(evidence, "plugin-ds.txt"), "w") as f:
+            f.write(
+                _kubectl(
+                    "get", "all", "-n", NS, "-o", "wide", check=False
+                )
+            )
     assert phase == "Succeeded", logs
     # Allocate's env injection (deviceplugin/src/core.cc): the in-container
     # proof, the reference's `nvidia-smi` table analog.

@@ -1,0 +1,252 @@
+"""Record a kubelet-level transcript of the device-plugin conversation.
+
+VERDICT r2 item 5: the kind tier (tests/integration/test_kind.py) is the
+real-scheduler proof of the ``google.com/tpu`` admission flow, but it
+needs kind+docker, which the build container doesn't have. This recorder
+produces the next-best executed evidence: it drives the SAME plugin
+binary through the SAME kubelet gRPC protocol (Registration ->
+GetDevicePluginOptions -> ListAndWatch -> PreferredAllocation ->
+Allocate) over real unix-socket gRPC, and writes every message — decoded
+field by field — to a markdown transcript with provenance.
+
+The committed golden lives at docs/evidence/DEVICEPLUGIN_E2E_TRANSCRIPT.md.
+Regenerate (and diff) with::
+
+    python tests/record_deviceplugin_transcript.py --out <path>
+
+What this proves: the kubelet⇄plugin boundary of SURVEY.md §3.2-3.3 —
+the exact conversation a real kubelet has before a scheduler can admit a
+pod requesting ``google.com/tpu``. What still needs kind: the scheduler
+fit predicate + kubelet Allocate trigger from a real Pod spec
+(.github/workflows/kind-integration.yml runs that tier where docker
+exists).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import io
+import os
+import platform
+import subprocess
+import sys
+import threading
+import time
+from concurrent import futures
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tests"))
+sys.path.insert(0, os.path.join(ROOT, "deviceplugin", "shim"))
+
+import protowire as pw  # noqa: E402
+
+BUILD = os.path.join(ROOT, "build-dp")
+LIB = os.path.join(BUILD, "libtpuplugin.so")
+
+
+def _ensure_built() -> None:
+    if os.path.exists(LIB):
+        return
+    subprocess.run(
+        ["cmake", "-S", os.path.join(ROOT, "deviceplugin"), "-B", BUILD,
+         "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(["ninja", "-C", BUILD], check=True, capture_output=True)
+
+
+def _fmt_devices(law_bytes: bytes) -> list[str]:
+    out = []
+    for d in pw.parse(law_bytes)[1]:
+        f = pw.parse(d)
+        out.append(f"id={f[1][0].decode()} health={f[2][0].decode()}")
+    return out
+
+
+def record(out_path: str, n_devices: int = 4) -> None:
+    import grpc
+
+    import tpufw_device_plugin as dp
+
+    os.environ["TPUFW_FAKE_DEVICES"] = str(n_devices)
+    os.environ["TPUFW_RESOURCE_NAME"] = "google.com/tpu"
+
+    buf = io.StringIO()
+
+    def log(line: str = "") -> None:
+        buf.write(line + "\n")
+
+    log("# Device-plugin kubelet-protocol transcript (recorded run)")
+    log()
+    log(
+        "Recorded by `tests/record_deviceplugin_transcript.py` — real "
+        "gRPC over unix sockets between the tpufw device plugin "
+        "(C++ core `deviceplugin/src/core.cc` via the Python gRPC shim) "
+        "and a fake kubelet Registration server. This is the "
+        "kubelet⇄plugin boundary of the `google.com/tpu` admission flow "
+        "(SURVEY.md §3.2-3.3); the scheduler-level half runs in "
+        "`.github/workflows/kind-integration.yml` where docker exists."
+    )
+    log()
+    log(f"- date: {datetime.datetime.now(datetime.UTC).isoformat()}")
+    log(f"- host: {platform.platform()} python={platform.python_version()}")
+    log(f"- fake devices: {n_devices} (TPUFW_FAKE_DEVICES)")
+    git = subprocess.run(
+        ["git", "-C", ROOT, "rev-parse", "HEAD"],
+        capture_output=True, text=True,
+    )
+    log(f"- repo commit: {git.stdout.strip() or 'unknown'}")
+    log()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as kubelet_dir:
+        registered = threading.Event()
+        reg_payload: dict = {}
+
+        def register_handler(request: bytes, context) -> bytes:
+            reg_payload["bytes"] = request
+            registered.set()
+            return b""
+
+        kubelet = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        kubelet.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                "v1beta1.Registration",
+                {
+                    "Register": grpc.unary_unary_rpc_method_handler(
+                        register_handler,
+                        request_deserializer=lambda x: x,
+                        response_serializer=lambda x: x,
+                    )
+                },
+            ),
+        ))
+        kubelet.add_insecure_port(
+            f"unix://{os.path.join(kubelet_dir, dp.KUBELET_SOCKET)}"
+        )
+        kubelet.start()
+
+        core = dp.Core(LIB)
+        plugin = dp.PluginServer(core, kubelet_dir, "tpufw-tpu.sock")
+        plugin.serve()
+        t0 = time.monotonic()
+
+        def stamp() -> str:
+            return f"t+{time.monotonic() - t0:6.3f}s"
+
+        log("## 1. Registration (plugin -> kubelet)")
+        plugin.register(timeout_s=10)
+        registered.wait(timeout=5)
+        reg = pw.parse(reg_payload["bytes"])
+        log(f"- {stamp()} kubelet received `Register` on "
+            f"`{dp.KUBELET_SOCKET}`:")
+        log(f"  - version: `{reg[1][0].decode()}`")
+        log(f"  - endpoint: `{reg[2][0].decode()}`")
+        log(f"  - resource_name: `{reg[3][0].decode()}`")
+        log()
+
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            log("## 2. GetDevicePluginOptions (kubelet -> plugin)")
+            opts = ch.unary_unary(
+                "/v1beta1.DevicePlugin/GetDevicePluginOptions",
+                request_serializer=lambda x: x,
+                response_deserializer=lambda x: x,
+            )(b"", timeout=5)
+            pf = pw.parse(opts)
+            log(f"- {stamp()} options: "
+                f"get_preferred_allocation_available="
+                f"{bool(pf.get(2, [0])[0])}")
+            log()
+
+            log("## 3. ListAndWatch (kubelet -> plugin, server stream)")
+            stream = ch.unary_stream(
+                "/v1beta1.DevicePlugin/ListAndWatch",
+                request_serializer=lambda x: x,
+                response_deserializer=lambda x: x,
+            )(b"", timeout=10)
+            first = next(iter(stream))
+            log(f"- {stamp()} first ListAndWatchResponse "
+                f"(node allocatable becomes `google.com/tpu: "
+                f"{len(pw.parse(first)[1])}`):")
+            for line in _fmt_devices(first):
+                log(f"  - {line}")
+            log()
+
+            log("## 4. GetPreferredAllocation (kubelet -> plugin)")
+            creq = (
+                pw.ld(1, b"tpu-3") + pw.ld(1, b"tpu-0")
+                + pw.ld(1, b"tpu-1") + pw.vint(3, 2)
+            )
+            pref = ch.unary_unary(
+                "/v1beta1.DevicePlugin/GetPreferredAllocation",
+                request_serializer=lambda x: x,
+                response_deserializer=lambda x: x,
+            )(pw.ld(1, creq), timeout=5)
+            chosen = [
+                x.decode() for x in pw.parse(pw.parse(pref)[1][0])[1]
+            ]
+            log(f"- {stamp()} available=[tpu-3, tpu-0, tpu-1] size=2 "
+                f"-> preferred={chosen} (NUMA/index sort)")
+            log()
+
+            log("## 5. Allocate (kubelet -> plugin; the admission step)")
+            alloc = ch.unary_unary(
+                "/v1beta1.DevicePlugin/Allocate",
+                request_serializer=lambda x: x,
+                response_deserializer=lambda x: x,
+            )(pw.ld(1, pw.ld(1, b"tpu-0") + pw.ld(1, b"tpu-2")), timeout=5)
+            cresp = pw.parse(pw.parse(alloc)[1][0])
+            envs = pw.parse_map_str(cresp[1])
+            log(f"- {stamp()} AllocateResponse for devices "
+                "[tpu-0, tpu-2]:")
+            log("  - env:")
+            for k in sorted(envs):
+                log(f"    - `{k}={envs[k]}`")
+            log("  - mounts:")
+            for m in cresp.get(2, []):
+                mf = pw.parse(m)
+                log(
+                    f"    - container `{mf[1][0].decode()}` <- host "
+                    f"`{mf[2][0].decode()}`"
+                )
+            log("  - devices:")
+            for d in cresp.get(3, []):
+                df = pw.parse(d)
+                log(
+                    f"    - container `{df[1][0].decode()}` <- host "
+                    f"`{df[2][0].decode()}` ({df[3][0].decode()})"
+                )
+        log()
+        log("Transcript complete: the plugin advertised, watched, "
+            "preferred, and allocated `google.com/tpu` through the "
+            "real kubelet wire protocol.")
+
+        plugin.stop()
+        kubelet.stop(grace=0.5)
+        core.lib.tpuplugin_shutdown()
+
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        f.write(buf.getvalue())
+    print(f"wrote {out_path}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument(
+        "--out",
+        default=os.path.join(
+            ROOT, "docs", "evidence", "DEVICEPLUGIN_E2E_TRANSCRIPT.md"
+        ),
+    )
+    p.add_argument("--devices", type=int, default=4)
+    args = p.parse_args(argv)
+    _ensure_built()
+    record(args.out, args.devices)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
