@@ -369,6 +369,18 @@ def lora_delta(cfg, x, features, axis, in_names, out_names, name):
     return b * (getattr(cfg, "lora_alpha", 16.0) / r)
 
 
+def reject_quant_lora(cfg) -> None:
+    """The one statement of the serving invariant: int8 weights carry no
+    gradient path, so adapters must be merged (tools/merge_lora) before
+    quantizing. Shared by every quantized module (llama.projection,
+    mixtral MoEMLP)."""
+    if getattr(cfg, "lora_rank", 0):
+        raise ValueError(
+            "quantized_weights with lora_rank > 0: merge the "
+            "adapters (tools/merge_lora) before quantizing"
+        )
+
+
 class QuantDenseGeneral(nn.Module):
     """DenseGeneral over int8 weights + per-output-channel scales —
     the serving twin of the fp projection (tpufw.ops.quant). Param
@@ -438,11 +450,7 @@ def projection(
     projections (Qwen qkv) keep a full-precision bias vector either way
     (it is tiny — the kernel carries the bandwidth)."""
     if getattr(cfg, "quantized_weights", False):
-        if getattr(cfg, "lora_rank", 0):
-            raise ValueError(
-                "quantized_weights with lora_rank > 0: merge the "
-                "adapters (tools/merge_lora) before quantizing"
-            )
+        reject_quant_lora(cfg)
         return QuantDenseGeneral(
             features=features,
             axis=axis,

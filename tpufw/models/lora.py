@@ -2,10 +2,13 @@
 
 The adapters themselves live where the projections live
 (``tpufw.models.llama.lora_delta``, shared by Llama/Gemma blocks and
-Mixtral's attention — MoE expert MLPs are not adapted). This module is
-the everything-else: picking adapter leaves out of a param tree (the
-Trainer freezes the rest), and folding trained adapters back into the
-base kernels so serving/export see a plain dense model.
+Mixtral's attention; ``tpufw.models.mixtral.MoEMLP._expert_matmul``
+adapts the expert stacks as raw [E, in, r]/[E, r, out] arrays). This
+module is the everything-else: picking adapter leaves out of a param
+tree (the Trainer freezes the rest), and folding trained adapters back
+into the base kernels so serving/export see a plain dense model —
+handling both the module layout ({name}_lora_a/kernel) and the
+raw-array layout ({name}_lora_a beside the stack).
 """
 
 from __future__ import annotations
@@ -58,16 +61,21 @@ def merge_lora(
     kernel for models trained with a non-default lora_alpha (pass
     ``cfg.lora_alpha``).
     """
-    ranks = {
-        leaf.shape[-1]
-        for path, leaf in jax.tree_util.tree_leaves_with_path(params)
+    ranks = set()
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        # Module-shaped adapters: .../{name}_lora_a/kernel.
         if any(
             getattr(k, "key", None) == "kernel"
             and isinstance(getattr(prev, "key", None), str)
             and prev.key.endswith(_A)
             for prev, k in zip(path, path[1:])
-        )
-    }
+        ):
+            ranks.add(leaf.shape[-1])
+        # Raw-array adapters (Mixtral expert stacks): the leaf ITSELF is
+        # named {name}_lora_a; rank is its trailing dim.
+        last = getattr(path[-1], "key", None) if path else None
+        if isinstance(last, str) and last.endswith(_A):
+            ranks.add(leaf.shape[-1])
     if len(ranks) == 1:
         actual = ranks.pop()
         if rank is not None and rank != actual:
@@ -85,16 +93,20 @@ def merge_lora(
     scale = alpha / rank
     merged_any = []
 
-    def delta(a, b, kernel):
-        a = a.astype(jnp.float32)
-        b = b.astype(jnp.float32)
-        if (a.ndim - 1) + (b.ndim - 1) == kernel.ndim:
+    def _delta(a, b, kernel_ndim):
+        if (a.ndim - 1) + (b.ndim - 1) == kernel_ndim:
             return jnp.tensordot(a, b, axes=([-1], [0]))
-        # nn.scan-stacked kernels carry a leading layer axis on all
-        # three tensors: batch the contraction over it.
+        # Leading batch axes shared by a, b, and the kernel — the
+        # nn.scan layer stack, the Mixtral expert axis, or both
+        # ([L, E, in, r]): strip one per vmap level.
         return jax.vmap(
-            lambda aa, bb: jnp.tensordot(aa, bb, axes=([-1], [0]))
+            lambda aa, bb: _delta(aa, bb, kernel_ndim - 1)
         )(a, b)
+
+    def delta(a, b, kernel):
+        return _delta(
+            a.astype(jnp.float32), b.astype(jnp.float32), kernel.ndim
+        )
 
     def walk(node):
         if not isinstance(node, dict):
@@ -106,9 +118,22 @@ def merge_lora(
             a_mod = node.get(key + _A)
             b_mod = node.get(key + _B)
             if a_mod is not None and b_mod is not None:
-                kernel = val["kernel"]
-                d = delta(a_mod["kernel"], b_mod["kernel"], kernel) * scale
-                out[key] = {**val, "kernel": kernel + d.astype(kernel.dtype)}
+                if isinstance(val, dict):
+                    # Module layout: {name}/{kernel}, adapters are
+                    # sibling modules with their own kernels.
+                    kernel = val["kernel"]
+                    d = (
+                        delta(a_mod["kernel"], b_mod["kernel"], kernel)
+                        * scale
+                    )
+                    out[key] = {
+                        **val, "kernel": kernel + d.astype(kernel.dtype)
+                    }
+                else:
+                    # Raw-array layout (Mixtral expert stacks): base and
+                    # adapters are bare [E, ...] arrays side by side.
+                    d = delta(a_mod, b_mod, val) * scale
+                    out[key] = val + d.astype(val.dtype)
                 merged_any.append(key)
             else:
                 out[key] = walk(val)

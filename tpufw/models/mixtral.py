@@ -13,6 +13,7 @@ hand-rolls; the reference itself has no parallelism at all, SURVEY.md §2c).
 from __future__ import annotations
 
 import dataclasses
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -98,6 +99,41 @@ MIXTRAL_CONFIGS: dict[str, MixtralConfig] = {
 }
 
 
+class QuantExpertKernel(nn.Module):
+    """int8 expert-stacked kernel [E, in, out] + per-(expert,
+    out-channel) fp32 scale — the MoE serving twin of
+    ``llama.QuantDenseGeneral``. Param shapes match what
+    ``tpufw.ops.quant.quantize_params`` emits for the raw expert
+    stacks; logical axes mirror the fp weights so sharded serving lays
+    out identically (expert axis stays on the ``expert`` mesh axis)."""
+
+    shape: tuple  # (E, d_in, d_out)
+    names: tuple  # logical axes of the fp kernel
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, xe: jax.Array) -> jax.Array:
+        e, _, d_out = self.shape
+        q = self.param(
+            "q_kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), self.names
+            ),
+            self.shape,
+            jnp.int8,
+        )
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(
+                nn.initializers.ones_init(), (self.names[0], self.names[2])
+            ),
+            (e, d_out),
+            jnp.float32,
+        )
+        y = jnp.einsum("eci,eio->eco", xe, q.astype(self.dtype))
+        return y * scale[:, None, :].astype(y.dtype)
+
+
 class MoEMLP(nn.Module):
     """Top-k routed SwiGLU experts with capacity-bounded einsum dispatch.
 
@@ -106,6 +142,66 @@ class MoEMLP(nn.Module):
     """
 
     cfg: MixtralConfig
+
+    def _expert_matmul(
+        self, name: str, xe: jax.Array, shape: tuple, names: tuple
+    ) -> jax.Array:
+        """One expert-stacked contraction [E,C,in] @ [E,in,out] ->
+        [E,C,out], through whichever weight form the config declares:
+
+        - fp kernel (training default), with optional per-expert LoRA
+          (``cfg.lora_rank``): A [E,in,r] fan-in init, B [E,r,out] zero
+          init — step 0 equals the base model, exactly like the shared
+          ``lora_delta`` on attention projections. Params land as
+          ``{name}_lora_a/b`` RAW-array siblings of the base stack
+          (models/lora.py merges both layouts).
+        - int8 + per-(expert, out-channel) scale for serving
+          (``cfg.quantized_weights``; shapes match ``quantize_params``).
+        """
+        cfg = self.cfg
+        e, d_in, d_out = shape
+        if getattr(cfg, "quantized_weights", False):
+            from tpufw.models.llama import reject_quant_lora
+
+            reject_quant_lora(cfg)
+            sub = QuantExpertKernel(
+                shape=shape, names=names, dtype=cfg.dtype, name=name
+            )
+            return sub(xe)
+        w = self.param(
+            name,
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), names
+            ),
+            shape,
+            cfg.param_dtype,
+        )
+        y = jnp.einsum("eci,eio->eco", xe, w.astype(cfg.dtype))
+        r = getattr(cfg, "lora_rank", 0)
+        if r:
+            a = self.param(
+                f"{name}_lora_a",
+                nn.with_logical_partitioning(
+                    nn.initializers.lecun_normal(),
+                    (names[0], names[1], "lora"),
+                ),
+                (e, d_in, r),
+                cfg.param_dtype,
+            )
+            bw = self.param(
+                f"{name}_lora_b",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(),
+                    (names[0], "lora", names[2]),
+                ),
+                (e, r, d_out),
+                cfg.param_dtype,
+            )
+            lo = jnp.einsum("eci,eir->ecr", xe, a.astype(cfg.dtype))
+            y = y + jnp.einsum(
+                "ecr,ero->eco", lo, bw.astype(cfg.dtype)
+            ) * (getattr(cfg, "lora_alpha", 16.0) / r)
+        return y
 
     @nn.compact
     def __call__(self, x, valid=None):
@@ -170,32 +266,22 @@ class MoEMLP(nn.Module):
         xf = x.reshape(g, d)
         xe = jnp.einsum("gec,gd->ecd", dispatch, xf)  # [E, C, d]
         xe = nn.with_logical_constraint(xe, ("expert", None, "act_embed"))
-
-        def expert_param(name, shape, names):
-            return self.param(
-                name,
-                nn.with_logical_partitioning(
-                    nn.initializers.lecun_normal(), names
-                ),
-                shape,
-                cfg.param_dtype,
-            )
-
-        w_gate = expert_param(
-            "w_gate", (e, d, cfg.d_ff), ("expert", "embed", "expert_mlp")
-        )
-        w_up = expert_param(
-            "w_up", (e, d, cfg.d_ff), ("expert", "embed", "expert_mlp")
-        )
-        w_down = expert_param(
-            "w_down", (e, cfg.d_ff, d), ("expert", "expert_mlp", "embed")
-        )
         xe = xe.astype(cfg.dtype)
-        h = nn.silu(
-            jnp.einsum("ecd,edf->ecf", xe, w_gate.astype(cfg.dtype))
-        ) * jnp.einsum("ecd,edf->ecf", xe, w_up.astype(cfg.dtype))
+
+        gate_out = self._expert_matmul(
+            "w_gate", xe, (e, d, cfg.d_ff),
+            ("expert", "embed", "expert_mlp"),
+        )
+        up_out = self._expert_matmul(
+            "w_up", xe, (e, d, cfg.d_ff),
+            ("expert", "embed", "expert_mlp"),
+        )
+        h = nn.silu(gate_out) * up_out
         h = nn.with_logical_constraint(h, ("expert", None, "act_mlp"))
-        out_e = jnp.einsum("ecf,efd->ecd", h, w_down.astype(cfg.dtype))
+        out_e = self._expert_matmul(
+            "w_down", h, (e, cfg.d_ff, d),
+            ("expert", "expert_mlp", "embed"),
+        )
         y = jnp.einsum("gec,ecd->gd", combine, out_e).reshape(b, t, d)
 
         # Switch-transformer load-balance loss over top-1 fractions,

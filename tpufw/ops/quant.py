@@ -8,11 +8,13 @@ matmul itself still runs in the activation dtype (the int8->bf16 cast
 and the scale multiply fuse into the surrounding ops under XLA).
 
 Scope: the seven projection kernels per block (attention q/k/v/o, MLP
-gate/up/down) plus the dedicated LM head. Embeddings stay full
-precision (a gather, and for tied heads the two uses want incompatible
-scale granularities). Per-OUTPUT-channel symmetric scales
-keep the quantization error independent per output unit, and scaling
-AFTER the contraction is algebraically exact for that granularity.
+gate/up/down), the dedicated LM head, and Mixtral's raw expert stacks
+(w_gate/w_up/w_down under the ``moe`` scope; the router stays fp —
+it's tiny). Embeddings stay full precision (a gather, and for tied
+heads the two uses want incompatible scale granularities).
+Per-OUTPUT-channel symmetric scales keep the quantization error
+independent per output unit, and scaling AFTER the contraction is
+algebraically exact for that granularity.
 """
 
 from __future__ import annotations
@@ -39,6 +41,10 @@ _PROJ_RANK = {
     "gate": 2, "up": 2, "down": 2,
     "lm_head": 2,
 }
+#: Mixtral expert stacks: RAW [E, in, out] arrays (not {kernel} modules)
+#: named w_* inside the moe scope; input dim is always axis -2, scale is
+#: per (expert, out-channel). The router stays fp (tiny).
+_EXPERT_KEYS = {"w_gate", "w_up", "w_down"}
 
 
 def quantize_kernel(w: jax.Array, in_axes: tuple) -> dict:
@@ -71,7 +77,7 @@ def quantize_params(params: Any) -> Any:
         )
     hit = []
 
-    def walk(node):
+    def walk(node, parent=""):
         if not isinstance(node, dict):
             return node
         out = {}
@@ -92,8 +98,22 @@ def quantize_params(params: Any) -> Any:
                     # the bandwidth; QuantDenseGeneral adds it back).
                     out[key]["bias"] = val["bias"]
                 hit.append(key)
+            elif (
+                key in _EXPERT_KEYS
+                and parent == "moe"
+                and not isinstance(val, dict)
+                and getattr(val, "ndim", 0) >= 3
+            ):
+                # [*stack, E, in, out] expert stack (nn.scan adds a
+                # leading layer dim) -> int8 + per-(…, E, out) scales
+                # (tpufw.models.mixtral.QuantExpertKernel's shapes).
+                # Gated on the 'moe' parent scope: the functional
+                # pipeline params carry same-named DENSE stacks that
+                # must stay untouched.
+                out[key] = quantize_kernel(val, (val.ndim - 2,))
+                hit.append(key)
             else:
-                out[key] = walk(val)
+                out[key] = walk(val, parent=key)
         return out
 
     quantized = walk(params)
