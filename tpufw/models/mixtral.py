@@ -24,6 +24,7 @@ from tpufw.models.llama import (
     LlamaConfig,
     RMSNorm,
     decoder_lm,
+    reject_quant_lora,
 )
 
 
@@ -161,8 +162,6 @@ class MoEMLP(nn.Module):
         cfg = self.cfg
         e, d_in, d_out = shape
         if getattr(cfg, "quantized_weights", False):
-            from tpufw.models.llama import reject_quant_lora
-
             reject_quant_lora(cfg)
             sub = QuantExpertKernel(
                 shape=shape, names=names, dtype=cfg.dtype, name=name

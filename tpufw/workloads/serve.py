@@ -251,6 +251,119 @@ def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
     ]
 
 
+class _Pending:
+    """One enqueued /generate request awaiting its tick."""
+
+    __slots__ = ("prompts", "max_new", "done", "outputs", "error",
+                 "batched_with")
+
+    def __init__(self, prompts: list[list[int]], max_new: int):
+        self.prompts = prompts
+        self.max_new = max_new
+        self.done = threading.Event()
+        self.outputs: list | None = None
+        self.error: Exception | None = None
+        self.batched_with = 1
+
+
+class _Batcher:
+    """Continuous batching at request granularity (VERDICT r2 #7).
+
+    Requests enqueue; one worker thread drains the queue per tick,
+    coalescing every waiting request into ONE batched generate call
+    (rows concatenated, padded to a power of two; max_new_tokens run to
+    the tick's bucketed max and sliced per request). While a tick's
+    generate runs on the device, new arrivals accumulate for the next
+    tick — so N concurrent clients cost ~one batched call instead of N
+    serialized full-latency calls. A short coalescing window
+    (TPUFW_BATCH_WAIT_MS, default 5) after the first dequeue lets
+    near-simultaneous requests land in the same tick; TPUFW_BATCH_MAX_ROWS
+    (default 64) caps rows per tick, the rest stay queued.
+    """
+
+    def __init__(self, run_tick):
+        self._run_tick = run_tick
+        self._queue: list[_Pending] = []
+        self._cv = threading.Condition()
+        self.max_rows = env_int("batch_max_rows", 64)
+        self.wait_s = env_int("batch_wait_ms", 5) / 1000.0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, prompts: list[list[int]], max_new: int):
+        p = _Pending(prompts, max_new)
+        with self._cv:
+            self._queue.append(p)
+            self._cv.notify()
+        p.done.wait()
+        if p.error is not None:
+            raise p.error
+        return p.outputs, p.batched_with
+
+    def _take_tick(self) -> list[_Pending]:
+        with self._cv:
+            while not self._queue:
+                self._cv.wait()
+        time.sleep(self.wait_s)  # let near-simultaneous arrivals land
+        with self._cv:
+            tick: list[_Pending] = []
+            rows = 0
+            while self._queue:
+                nxt = self._queue[0]
+                if tick and rows + len(nxt.prompts) > self.max_rows:
+                    break  # stays queued for the next tick
+                tick.append(self._queue.pop(0))
+                rows += len(nxt.prompts)
+            return tick
+
+    def _run_group(self, group: list[_Pending]) -> None:
+        """Run one coalesced device call for ``group``; raises on
+        failure without touching the pendings (the caller decides
+        whether to isolate)."""
+        all_prompts = [p for pend in group for p in pend.prompts]
+        # Bucket the group's max_new to a power of two: the scan
+        # length is a compiled-shape dimension, so arbitrary
+        # per-request values would each compile a fresh program.
+        want = max(p.max_new for p in group)
+        run_new = 1
+        while run_new < want:
+            run_new *= 2
+        outs = self._run_tick(all_prompts, run_new)
+        i = 0
+        for pend in group:
+            rows = outs[i: i + len(pend.prompts)]
+            pend.outputs = [r[: pend.max_new] for r in rows]
+            pend.batched_with = len(group)
+            i += len(pend.prompts)
+
+    def _loop(self):
+        while True:
+            tick = self._take_tick()
+            try:
+                try:
+                    self._run_group(tick)
+                except Exception:  # noqa: BLE001 — serving loop
+                    if len(tick) == 1:
+                        raise
+                    # Failure isolation: coalescing must not create a
+                    # shared fate — one invalid request (or a prompt/
+                    # max_new combination that only overflows the KV
+                    # budget when COMBINED with a co-batched request's
+                    # bucket) falls back to per-request runs so the
+                    # innocent ones still succeed.
+                    for pend in tick:
+                        try:
+                            self._run_group([pend])
+                        except Exception as e:  # noqa: BLE001
+                            pend.error = e
+            except Exception as e:  # noqa: BLE001 — serving loop
+                for pend in tick:
+                    pend.error = e
+            finally:
+                for pend in tick:
+                    pend.done.set()
+
+
 class _Server:
     """Minimal HTTP serving loop over the jitted generator."""
 
@@ -266,35 +379,45 @@ class _Server:
             self.restored,
         ) = build_generator()
         self.default_new = max_new_tokens
-        self.lock = threading.Lock()
         self.port = port
         self._codec = None
+        self._batcher = _Batcher(self._run_tick)
 
     def codec(self):
         if self._codec is None:
             self._codec = text_codec()
         return self._codec
 
-    def generate(self, prompts: list[list[int]], max_new: int):
-        # Bucket prompt length and batch size so the jitted generate
-        # specializes on few shapes. The length bucket rides
-        # pad_prompts' OWN left padding (a max-length filler row forces
-        # it), so bucketing zeros are real padding — pad_lens masks
-        # them, and the repetition penalty's seen-set never counts them
-        # (literal [0]*k prefixes would look like real tokens).
+    def _run_tick(self, prompts: list[list[int]], max_new: int):
+        """One device call for one coalesced tick — only the batcher
+        thread runs this, so device work is serialized by construction
+        (the old per-request lock is gone).
+
+        Bucket prompt length and batch size so the jitted generate
+        specializes on few shapes. The length bucket rides
+        pad_prompts' OWN left padding (a max-length filler row forces
+        it), so bucketing zeros are real padding — pad_lens masks
+        them, and the repetition penalty's seen-set never counts them
+        (literal [0]*k prefixes would look like real tokens).
+        """
         longest = _bucket(max(len(p) for p in prompts), 64)
         padded, real_n = _pad_batch(prompts)
         padded = padded + [[0] * longest]  # length-bucket filler row
-        with self.lock:  # one compiled program at a time
-            outs = self._generate_text(
-                self.model,
-                self.params,
-                padded,
-                max_new_tokens=max_new,
-                sampling=self._sampling,
-                eos_id=None,
-            )
+        outs = self._generate_text(
+            self.model,
+            self.params,
+            padded,
+            max_new_tokens=max_new,
+            sampling=self._sampling,
+            eos_id=None,
+        )
         return outs[:real_n]
+
+    def generate(self, prompts: list[list[int]], max_new: int):
+        """Returns (outputs, batched_with): how many requests shared
+        this device tick — surfaced in the response for observability
+        (and the concurrency test pins coalescing actually happens)."""
+        return self._batcher.submit(prompts, max_new)
 
     def serve_forever(self):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -364,8 +487,20 @@ class _Server:
                     max_new = int(
                         req.get("max_new_tokens", outer.default_new)
                     )
-                    outs = outer.generate(prompts, max_new)
-                    payload = {"outputs": outs}
+                    if max_new < 1:
+                        # Validate BEFORE the batcher: the tick's
+                        # pow2-bucketed run length would bypass
+                        # generate()'s own >= 1 check and a negative
+                        # per-request slice would return
+                        # batch-composition-dependent output.
+                        raise ValueError("max_new_tokens must be >= 1")
+                    outs, batched_with = outer.generate(
+                        prompts, max_new
+                    )
+                    payload = {
+                        "outputs": outs,
+                        "batched_with": batched_with,
+                    }
                     if as_text:
                         payload["texts"] = [decode(o) for o in outs]
                     self._reply(200, payload)
