@@ -73,23 +73,22 @@ def test_ring_einsum_cap_window(devices8, window):
     _check_grads(out, ref, q, k, v)
 
 
-def test_ring_auto_picks_einsum_for_window(devices8):
-    """Default impl selection must not route a window to ring-flash."""
+def test_ring_window_on_both_impls(devices8):
+    """A sliding window now runs on BOTH ring impls (the flash path
+    passes the static per-step chunk distance as the kernel offset);
+    default selection and the explicit impls all match xla."""
     mesh = _mesh()
     q, k, v = _qkv()
-    with use_mesh(mesh):
-        out = ring_attention(
-            q, k, v, causal=True, sliding_window=WIN
-        )  # impl=None: must auto-pick einsum, not raise
     ref = xla_attention(q, k, v, causal=True, sliding_window=WIN)
-    np.testing.assert_allclose(
-        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
-    )
-    with use_mesh(mesh):
-        with pytest.raises(NotImplementedError, match="sliding_window"):
-            ring_attention(
-                q, k, v, causal=True, impl="flash", sliding_window=WIN
+    for impl in (None, "einsum", "flash"):
+        with use_mesh(mesh):
+            out = ring_attention(
+                q, k, v, causal=True, sliding_window=WIN, impl=impl
             )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5,
+            err_msg=f"impl={impl}",
+        )
 
 
 def test_ring_flash_cap(devices8):

@@ -353,13 +353,19 @@ def _flash(q, k, v, qseg, kseg, causal, interpret, soft_cap, window):
 
 
 def _flash_fwd_impl(
-    q, k, v, qseg, kseg, causal, interpret, soft_cap, window=None
+    q, k, v, qseg, kseg, causal, interpret, soft_cap, window=None,
+    offset=None,
 ):
+    """``offset``: query i sits at absolute position offset+i relative
+    to the keys. Default s - t (decode alignment); ring attention passes
+    the static chunk distance step*L so window masks see GLOBAL
+    positions (tpufw.parallel.ring_flash)."""
     b, t, h, d = q.shape
     _, s, kh, _ = k.shape
     rep = h // kh
     scale = 1.0 / math.sqrt(d)
-    offset = s - t  # decode alignment: query i sits at abs pos offset+i
+    if offset is None:
+        offset = s - t
     has_seg = qseg is not None
 
     qh, kh_, vh = _heads_layout(q, k, v)
@@ -430,13 +436,14 @@ def _flash_fwd_impl(
     return out_bthd, (q, k, v, qseg, kseg, out_bthd, lse)
 
 
-def _flash_bwd_impl(causal, interpret, soft_cap, window, res, g):
+def _flash_bwd_impl(causal, interpret, soft_cap, window, res, g, offset=None):
     q, k, v, qseg, kseg, out, lse = res
     b, t, h, d = q.shape
     _, s, kh, _ = k.shape
     rep = h // kh
     scale = 1.0 / math.sqrt(d)
-    offset = s - t
+    if offset is None:
+        offset = s - t
     has_seg = qseg is not None
 
     delta = jnp.sum(

@@ -30,23 +30,6 @@ from tpufw.parallel.context import current_mesh
 NEG_INF = -1e30
 
 
-@functools.cache
-def _warn_window_einsum_once() -> None:
-    """One-time visibility for the sliding-window → einsum perf cliff
-    (ADVICE r2): materialized per-chunk [B,H,T/P,T/P] logits on exactly
-    the long-context configs ring SP targets."""
-    import warnings
-
-    warnings.warn(
-        "ring_attention: sliding_window forces impl='einsum' "
-        "(materialized per-chunk logits) — the flash kernel only sees "
-        "chunk-local positions. Expect higher memory/lower throughput "
-        "on windowed (Mistral/Gemma-local) layers under ring SP.",
-        RuntimeWarning,
-        stacklevel=3,
-    )
-
-
 def _chunk_attn(
     q, k, v, q_start, k_start, causal, scale, rep, qseg=None, kseg=None,
     soft_cap=None, window=None,
@@ -188,12 +171,11 @@ def ring_attention(
 
     ``logits_soft_cap`` (Gemma) works on both impls (elementwise, so
     per-chunk capping commutes with the online-softmax merge).
-    ``sliding_window`` is a GLOBAL position relation: the per-shard flash
-    kernels only see chunk-local positions (their offset is static, the
-    ring's chunk offset is traced), so a window FORCES the einsum impl —
-    per-chunk [B, H, T/P, T/P] logits instead of O(L) memory. Known
-    perf cliff for windowed (Gemma local) layers under ring SP; lifting
-    it needs the flash kernels to take the chunk offset as an operand.
+    ``sliding_window`` (Mistral/Gemma-local) works on both impls too:
+    the flash path passes the ring step's STATIC chunk distance as the
+    kernel's position offset, so window masks see global positions, and
+    chunks entirely beyond the window skip compute and rotation — a
+    window spanning w shards runs ~w of n ring steps.
     """
     mesh = mesh or current_mesh()
     if mesh is None:
@@ -201,22 +183,20 @@ def ring_attention(
             "ring_attention needs a mesh: pass mesh= or register one via "
             "tpufw.parallel.context.use_mesh(...)"
         )
+    if sliding_window is not None and sliding_window < 1:
+        # Checked here so BOTH impls fail loudly: window=0 would mask
+        # every logit (einsum would silently emit uniform-softmax means).
+        raise ValueError(
+            f"sliding_window must be >= 1, got {sliding_window}"
+        )
     if impl is None:
         on_tpu = mesh.devices.flatten()[0].platform == "tpu"
         impl = "flash" if (causal and on_tpu) else "einsum"
-        if sliding_window is not None:
-            # The per-shard flash calls see only local positions, so the
-            # window (a GLOBAL position relation) runs on the einsum
-            # impl, whose chunk math carries global q/k offsets.
-            if impl == "flash":
-                _warn_window_einsum_once()
-            impl = "einsum"
     if impl == "flash":
-        if sliding_window is not None:
-            raise NotImplementedError(
-                "ring impl='flash' does not support sliding_window; "
-                "use impl='einsum' (the default picks it automatically)"
-            )
+        # sliding_window runs in-kernel: the per-step chunk distance is
+        # static on the unrolled ring, so window masks see global
+        # positions without traced offsets, and out-of-window chunks
+        # skip compute AND rotation (tpufw.parallel.ring_flash).
         from tpufw.parallel.ring_flash import ring_flash_attention
 
         return ring_flash_attention(
@@ -226,6 +206,7 @@ def ring_attention(
             mesh=mesh,
             axis_name=axis_name,
             logits_soft_cap=logits_soft_cap,
+            sliding_window=sliding_window,
         )
     if impl != "einsum":
         raise ValueError(f"unknown ring impl {impl!r}")

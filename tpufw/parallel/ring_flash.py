@@ -47,15 +47,27 @@ from tpufw.parallel.context import current_mesh
 NEG_INF = F.NEG_INF
 
 
-def _chunk_fwd(case, q, k, v, qseg, kseg, interpret, soft_cap=None):
+def _chunk_fwd(
+    case, q, k, v, qseg, kseg, interpret, soft_cap=None, window=None,
+    offset=0,
+):
     """One q-shard x kv-chunk flash forward. Returns (o [B,L,H,D] fp32
-    normalized, lse [B,H,L] fp32). case: 0 full / 1 causal-diag / 2 empty."""
+    normalized, lse [B,H,L] fp32). case: 0 full / 1 causal-diag / 2 empty.
+
+    ``offset`` is the STATIC global distance of the q shard ahead of the
+    visiting kv chunk (step*L on the ring) — with a ``window`` it makes
+    the in-kernel (q_pos - k_pos) < window mask see global positions.
+    Only the "full" branch uses it (the diagonal branch is only
+    reachable at step 0, offset 0); for offset >= L every pair is
+    already causal, so causal=False there stays correct."""
     b, l, h, d = q.shape
 
     def run(causal):
         def f(q, k, v, qseg, kseg):
-            out, res = F._flash_fwd_impl(q, k, v, qseg, kseg, causal,
-                                         interpret, soft_cap, None)
+            out, res = F._flash_fwd_impl(
+                q, k, v, qseg, kseg, causal, interpret, soft_cap,
+                window, offset=(0 if causal else offset),
+            )
             lse = res[-1][:, :, 0, :l]  # un-pad [B,H,1,Tp] -> [B,H,L]
             return out.astype(jnp.float32), lse
 
@@ -73,16 +85,19 @@ def _chunk_fwd(case, q, k, v, qseg, kseg, interpret, soft_cap=None):
 
 
 def _chunk_bwd(
-    case, q, k, v, qseg, kseg, out, lse_pad, g, interpret, soft_cap=None
+    case, q, k, v, qseg, kseg, out, lse_pad, g, interpret, soft_cap=None,
+    window=None, offset=0,
 ):
     """Per-chunk gradients via the flash backward kernels with the GLOBAL
-    lse. Returns (dq, dk, dv) in fp32."""
+    lse. Returns (dq, dk, dv) in fp32. ``window``/``offset`` as in
+    ``_chunk_fwd``."""
 
     def run(causal):
         def f(q, k, v, qseg, kseg, out, lse_pad, g):
             dq, dk, dv, _, _ = F._flash_bwd_impl(
-                causal, interpret, soft_cap, None,
+                causal, interpret, soft_cap, window,
                 (q, k, v, qseg, kseg, out, lse_pad), g,
+                offset=(0 if causal else offset),
             )
             return (
                 dq.astype(jnp.float32),
@@ -115,9 +130,26 @@ def _merge(out, lse, o_c, lse_c):
     return t(w1) * out + t(w2) * o_c, lse_new
 
 
+def _n_live_steps(n: int, l: int, window) -> int:
+    """How many ring steps can contribute under a sliding window.
+
+    At step s > 0 the visiting chunk sits exactly s*L positions behind
+    the q shard, so the closest pair is (s-1)*L + 1 apart; once that
+    reaches the window the chunk — and every later (farther) one — is
+    statically invisible. This is where windowed ring attention's
+    savings come from: ceil-bounded rotations instead of n (e.g. a 4k
+    window over 8 x 8k shards runs 2 of 8 steps)."""
+    if window is None:
+        return n
+    s = 1
+    while s < n and (s - 1) * l + 1 < window:
+        s += 1
+    return s
+
+
 def _make_local(
     n: int, axis_name: str, interpret: bool, has_seg: bool,
-    soft_cap=None,
+    soft_cap=None, window=None,
 ):
     """Build the per-device custom-VJP ring-flash body for a ring of n."""
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -129,17 +161,18 @@ def _make_local(
     def fwd(q, k, v, qseg, kseg):
         idx = jax.lax.axis_index(axis_name)
         b, l, h, d = q.shape
+        steps = _n_live_steps(n, l, window)
         out = jnp.zeros((b, l, h, d), jnp.float32)
         lse = jnp.full((b, h, l), NEG_INF, jnp.float32)
         k_cur, v_cur, kseg_cur = k, v, kseg
-        for step in range(n):  # unrolled: n is the static mesh-axis size
+        for step in range(steps):  # unrolled: static mesh-axis size
             src = (idx - step) % n
             o_c, lse_c = _chunk_fwd(
                 case_of(src, idx), q, k_cur, v_cur, qseg, kseg_cur,
-                interpret, soft_cap,
+                interpret, soft_cap, window, offset=step * l,
             )
             out, lse = _merge(out, lse, o_c, lse_c)
-            if step < n - 1:
+            if step < steps - 1:
                 k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
                 v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
                 if has_seg:
@@ -158,6 +191,7 @@ def _make_local(
         q, k, v, qseg, kseg, out, lse = res
         idx = jax.lax.axis_index(axis_name)
         l = q.shape[1]
+        steps = _n_live_steps(n, l, window)
         # The flash bwd kernels take lse in the padded [B,H,1,Tp] layout.
         l_pad = -l % 128
         lse_pad = jnp.pad(lse, ((0, 0), (0, 0), (0, l_pad)))[:, :, None, :]
@@ -165,23 +199,34 @@ def _make_local(
         k_cur, v_cur, kseg_cur = k, v, kseg
         dk_acc = jnp.zeros(k.shape, jnp.float32)
         dv_acc = jnp.zeros(v.shape, jnp.float32)
-        for step in range(n):
+        for step in range(steps):
             src = (idx - step) % n
             dq_c, dk_c, dv_c = _chunk_bwd(
                 case_of(src, idx), q, k_cur, v_cur, qseg, kseg_cur,
-                out, lse_pad, g, interpret, soft_cap,
+                out, lse_pad, g, interpret, soft_cap, window,
+                offset=step * l,
             )
             dq = dq + dq_c
             dk_acc = dk_acc + dk_c
             dv_acc = dv_acc + dv_c
-            # Rotate accumulators WITH their chunk every step (n total):
-            # after the loop each chunk's grads are home on its owner.
-            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-            dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
-            dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
-            if has_seg:
-                kseg_cur = jax.lax.ppermute(kseg_cur, axis_name, perm)
+            # Rotate accumulators WITH their chunk every live step; the
+            # final hop home happens below in ONE collective.
+            if step < steps - 1:
+                k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+                dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm)
+                dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm)
+                if has_seg:
+                    kseg_cur = jax.lax.ppermute(kseg_cur, axis_name, perm)
+        # After steps-1 rotations a chunk owned by device o sits on
+        # device (o + steps - 1) % n: one ppermute of distance
+        # n - (steps - 1) sends every accumulator home (with a full
+        # window this is the same single +1 hop the old loop ended on).
+        home = (n - (steps - 1)) % n
+        if home:
+            perm_home = [(i, (i + home) % n) for i in range(n)]
+            dk_acc = jax.lax.ppermute(dk_acc, axis_name, perm_home)
+            dv_acc = jax.lax.ppermute(dv_acc, axis_name, perm_home)
         return (
             dq.astype(q.dtype),
             dk_acc.astype(k.dtype),
@@ -205,11 +250,18 @@ def ring_flash_attention(
     axis_name: str = AXIS_SEQUENCE,
     interpret: Optional[bool] = None,
     logits_soft_cap: Optional[float] = None,
+    sliding_window: Optional[int] = None,
 ) -> jax.Array:
     """Sequence-parallel flash attention. Global shapes q:[B,T,H,D],
     k/v:[B,T,K,D]; sharded over (batch=data+fsdp, seq=sequence,
     heads=tensor) like the einsum ring. Causal only (the LM path): the
     chunk-level case analysis assumes it.
+
+    ``sliding_window`` (Mistral/Gemma-local layers) runs in-kernel with
+    GLOBAL positions — the per-step chunk distance is static on the
+    unrolled ring, so the window needs no traced offsets — and cuts the
+    ring short: chunks entirely beyond the window are never computed or
+    rotated (``_n_live_steps``).
     """
     if not causal:
         raise NotImplementedError(
@@ -232,7 +284,10 @@ def ring_flash_attention(
         interpret = mesh.devices.flatten()[0].platform == "cpu"
     has_seg = segment_ids is not None
     cap = None if logits_soft_cap is None else float(logits_soft_cap)
-    local = _make_local(n, axis_name, interpret, has_seg, cap)
+    win = None if sliding_window is None else int(sliding_window)
+    if win is not None and win < 1:
+        raise ValueError(f"sliding_window must be >= 1, got {win}")
+    local = _make_local(n, axis_name, interpret, has_seg, cap, win)
 
     spec = P((AXIS_DATA, AXIS_FSDP), AXIS_SEQUENCE, AXIS_TENSOR, None)
     seg_spec = P((AXIS_DATA, AXIS_FSDP), AXIS_SEQUENCE)
