@@ -210,3 +210,41 @@ def test_generate_with_repetition_penalty_differs():
         # No repeats at all under an effectively-infinite penalty.
         assert len(set(row.tolist())) == len(row), row
     assert (np.asarray(plain) != np.asarray(pen)).any()
+
+
+def test_generate_with_mesh_sharded_params(devices8):
+    """Serving models larger than one chip: generate works with params
+    laid out over a mesh (the Orbax serve path restores them sharded) —
+    jit propagates the shardings, no replication onto device 0."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpufw.mesh import MeshConfig, build_mesh
+    from tpufw.models import LLAMA_CONFIGS, Llama
+
+    cfg = dataclasses.replace(
+        LLAMA_CONFIGS["llama3_tiny"], dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    mesh = build_mesh(MeshConfig(fsdp=8))
+    dmodel = Llama(cfg.decode_config())
+    prompts = [[5, 6, 7], [9]]
+    tokens, pads = pad_prompts(prompts)
+    params = jax.jit(dmodel.init)(
+        jax.random.key(0), jnp.asarray(tokens)
+    )["params"]
+    ref = generate_text(dmodel, params, prompts, max_new_tokens=4)
+    # Shard every >=1-D leaf's first divisible axis over fsdp.
+    def shard(x):
+        for ax, n in enumerate(x.shape):
+            if n % 8 == 0:
+                spec = [None] * x.ndim
+                spec[ax] = "fsdp"
+                return jax.device_put(
+                    x, NamedSharding(mesh, P(*spec))
+                )
+        return jax.device_put(x, NamedSharding(mesh, P()))
+    sharded = jax.tree.map(shard, params)
+    out = generate_text(dmodel, sharded, prompts, max_new_tokens=4)
+    assert out == ref

@@ -305,3 +305,22 @@ def test_http_server_batching_failure_isolation(tiny_env, monkeypatch):
     assert results["good"][0] == 200, results["good"]
     assert len(results["good"][1]["outputs"][0]) == 4
     srv.httpd.shutdown()
+
+
+def test_eos_env_truncates_batch_outputs(monkeypatch, tmp_path):
+    """TPUFW_EOS_ID flows into both serving modes: rows stop at the eos
+    token (emitted, then truncated) instead of running to max_new."""
+    from tpufw.workloads.serve import eos_from_env, run_batch
+
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_CHECKPOINT_DIR", str(tmp_path / "none"))
+    monkeypatch.delenv("TPUFW_EOS_ID", raising=False)
+    assert eos_from_env() is None
+    base = run_batch([[3, 1, 4]], max_new_tokens=6)[0]["output"]
+    assert len(base) == 6
+    # Greedy decode is deterministic: whatever token the model emits
+    # first IS a reachable eos — set it and the row must stop there.
+    monkeypatch.setenv("TPUFW_EOS_ID", str(base[0]))
+    assert eos_from_env() == base[0]
+    out = run_batch([[3, 1, 4]], max_new_tokens=6)[0]["output"]
+    assert out == [base[0]]

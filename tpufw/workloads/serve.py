@@ -26,6 +26,7 @@ import json
 import os
 import threading
 import time
+from typing import Optional
 
 from tpufw.workloads.env import env_float, env_int, env_str
 
@@ -217,6 +218,14 @@ def sampling_from_env():
     )
 
 
+def eos_from_env() -> Optional[int]:
+    """TPUFW_EOS_ID: stop rows at this token (the token itself is
+    emitted, outputs are truncated after it — tpufw.infer.generate).
+    Unset/negative = run every row to max_new_tokens."""
+    eos = env_int("eos_id", -1)
+    return eos if eos >= 0 else None
+
+
 def _pad_batch(prompts: list[list[int]]) -> tuple[list[list[int]], int]:
     """Pad the batch to a power of two (filler rows = [0]) so the jitted
     generate specializes on few batch shapes. Returns (padded, real_n)."""
@@ -238,7 +247,7 @@ def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
         padded,
         max_new_tokens=max_new_tokens,
         sampling=sampling_from_env(),  # default greedy: deterministic
-        eos_id=None,
+        eos_id=eos_from_env(),
     )[:real_n]
     return [
         {
@@ -379,6 +388,7 @@ class _Server:
             self.restored,
         ) = build_generator()
         self.default_new = max_new_tokens
+        self._eos_id = eos_from_env()
         self.port = port
         self._codec = None
         self._batcher = _Batcher(self._run_tick)
@@ -409,7 +419,7 @@ class _Server:
             padded,
             max_new_tokens=max_new,
             sampling=self._sampling,
-            eos_id=None,
+            eos_id=self._eos_id,
         )
         return outs[:real_n]
 
