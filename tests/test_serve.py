@@ -324,3 +324,54 @@ def test_eos_env_truncates_batch_outputs(monkeypatch, tmp_path):
     assert eos_from_env() == base[0]
     out = run_batch([[3, 1, 4]], max_new_tokens=6)[0]["output"]
     assert out == [base[0]]
+
+
+def test_http_server_speculative_draft(tiny_env, monkeypatch):
+    """TPUFW_DRAFT_MODEL turns the tick into greedy speculative decode;
+    outputs are EXACTLY the plain server's greedy outputs (the draft
+    only changes speed), and non-greedy sampling is rejected loudly."""
+    import time
+
+    from tpufw.workloads.serve import _Server, build_draft_generator
+
+    srv = _Server(port=0, max_new_tokens=6)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    deadline = time.time() + 30
+    while not hasattr(srv, "httpd") and time.time() < deadline:
+        time.sleep(0.05)
+
+    def post(port, prompts):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(
+                {"prompts": prompts, "max_new_tokens": 6}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return json.loads(resp.read())["outputs"]
+
+    prompts = [[1, 5, 9], [2, 7]]
+    want = post(srv.port, prompts)
+    srv.httpd.shutdown()
+
+    monkeypatch.setenv("TPUFW_DRAFT_MODEL", "llama3_tiny")
+    srv2 = _Server(port=0, max_new_tokens=6)
+    assert srv2._draft is not None
+    t2 = threading.Thread(target=srv2.serve_forever, daemon=True)
+    t2.start()
+    deadline = time.time() + 30
+    while not hasattr(srv2, "httpd") and time.time() < deadline:
+        time.sleep(0.05)
+    got = post(srv2.port, prompts)
+    srv2.httpd.shutdown()
+    assert got == want
+
+    # Non-greedy + draft = loud.
+    monkeypatch.setenv("TPUFW_TEMPERATURE", "0.7")
+    from tpufw.workloads.serve import sampling_from_env
+
+    with pytest.raises(ValueError, match="greedy"):
+        build_draft_generator(sampling_from_env())

@@ -1,0 +1,146 @@
+"""Greedy speculative decoding == the target model's plain greedy decode.
+
+The oracle is exact: whatever the draft proposes, acceptance compares
+against the target's own argmax, so `speculative_generate` must emit
+token-for-token what `generate` emits — across ragged prompts, draft
+quality (self-draft = always accept; unrelated draft = frequent
+rejects), eos freezing, and k sizes. Stats sanity-check the speedup
+mechanism (self-draft ≈ k+1 tokens/iteration).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.infer import (
+    SamplingConfig,
+    generate_text,
+    speculative_generate_text,
+)
+from tpufw.models import LLAMA_CONFIGS, Llama
+
+TINY = dataclasses.replace(
+    LLAMA_CONFIGS["llama3_tiny"],
+    dtype=jnp.float32,
+    param_dtype=jnp.float32,
+    max_seq_len=128,
+)
+PROMPTS = [[5, 6, 7], [9], [1, 2, 3, 4, 5, 6]]
+
+
+@pytest.fixture(scope="module")
+def target():
+    model = Llama(TINY.decode_config())
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def draft():
+    """A DIFFERENT tiny model (own weights, fewer layers): realistic
+    partial acceptance."""
+    cfg = dataclasses.replace(TINY, n_layers=1)
+    model = Llama(cfg.decode_config())
+    params = jax.jit(model.init)(
+        jax.random.key(99), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def _greedy(target, max_new, eos_id=None):
+    model, params = target
+    return generate_text(
+        model, params, PROMPTS, max_new_tokens=max_new,
+        sampling=SamplingConfig(temperature=0.0), eos_id=eos_id,
+    )
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_matches_plain_greedy_with_unrelated_draft(target, draft, k):
+    want = _greedy(target, 12)
+    got, stats = speculative_generate_text(
+        draft[0], draft[1], target[0], target[1], PROMPTS,
+        max_new_tokens=12, k=k,
+    )
+    assert got == want, f"k={k}: {got} != {want}"
+    assert stats["emitted"] == 12
+    # Worst case one token per iteration.
+    assert stats["iterations"] <= 12
+
+
+def test_self_draft_accepts_everything(target):
+    """Draft == target: every proposal matches, so each iteration emits
+    k+1 tokens — the mechanism's upper bound."""
+    k = 4
+    want = _greedy(target, 15)
+    got, stats = speculative_generate_text(
+        target[0], target[1], target[0], target[1], PROMPTS,
+        max_new_tokens=15, k=k,
+    )
+    assert got == want
+    # ceil(15 / (k+1)) iterations when everything accepts.
+    assert stats["iterations"] == -(-15 // (k + 1))
+
+
+def test_eos_rows_freeze(target, draft):
+    """Force an eos: pick the 3rd greedy token of row 0 as eos_id —
+    outputs must truncate exactly like plain generate's."""
+    base = _greedy(target, 10)
+    eos = base[0][2]
+    want = _greedy(target, 10, eos_id=eos)
+    got, _ = speculative_generate_text(
+        draft[0], draft[1], target[0], target[1], PROMPTS,
+        max_new_tokens=10, k=3, eos_id=eos,
+    )
+    assert got == want
+
+
+def test_single_token(target, draft):
+    want = _greedy(target, 1)
+    got, _ = speculative_generate_text(
+        draft[0], draft[1], target[0], target[1], PROMPTS,
+        max_new_tokens=1, k=4,
+    )
+    assert got == want
+
+
+def test_cache_budget_is_loud(target, draft):
+    with pytest.raises(ValueError, match="KV cache"):
+        speculative_generate_text(
+            draft[0], draft[1], target[0], target[1],
+            [list(range(1, 100))], max_new_tokens=30, k=4,
+        )
+
+
+def test_live_rows_mask_preserves_real_rows(target, draft):
+    """A degenerate filler row excluded via live_rows must not change
+    the live rows' outputs (and they stay exact greedy) even though the
+    filler's own acceptance would have dragged the batch-min."""
+    want = _greedy(target, 10)
+    padded = PROMPTS + [[0] * 32]  # serving-style length filler
+    got, _ = speculative_generate_text(
+        draft[0], draft[1], target[0], target[1], padded,
+        max_new_tokens=10, k=3,
+        live_rows=[True, True, True, False],
+    )
+    assert got[: len(PROMPTS)] == want
+
+
+def test_serve_draft_rejects_repetition_penalty(monkeypatch):
+    """Repetition penalty changes the temp-0 argmax, so the exact-greedy
+    speculative contract requires rejecting it loudly."""
+    from tpufw.workloads.serve import (
+        build_draft_generator,
+        sampling_from_env,
+    )
+
+    monkeypatch.setenv("TPUFW_DRAFT_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_TEMPERATURE", "0")
+    monkeypatch.setenv("TPUFW_REPETITION_PENALTY", "1.3")
+    with pytest.raises(ValueError, match="greedy"):
+        build_draft_generator(sampling_from_env())

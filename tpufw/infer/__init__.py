@@ -3,6 +3,10 @@ from tpufw.infer.generate import (  # noqa: F401
     generate_text,
     pad_prompts,
 )
+from tpufw.infer.speculative import (  # noqa: F401
+    speculative_generate,
+    speculative_generate_text,
+)
 from tpufw.infer.sampling import (  # noqa: F401
     SamplingConfig,
     apply_top_k,

@@ -1,0 +1,304 @@
+"""Greedy speculative decoding: draft proposes, target verifies in one pass.
+
+Autoregressive decode is HBM-bandwidth-bound — every emitted token
+streams every target weight once. Speculative decoding spends a small
+draft model's tokens to buy back target bandwidth: the draft proposes
+``k`` tokens autoregressively, the target scores ALL of them in ONE
+cached forward (k+1 tokens through the weights instead of k+1 separate
+full-weight streams), and the longest prefix agreeing with the target's
+own greedy choice is accepted plus one bonus token from the target's
+logits. Worst case one token per iteration (plain decode cost + draft
+overhead); best case k+1.
+
+Greedy only: acceptance compares the draft token to the target argmax,
+which makes the output EXACTLY the target model's greedy continuation —
+pinned against ``tpufw.infer.generate`` in tests/test_speculative.py.
+(Stochastic speculative sampling needs the rejection-resample scheme;
+not implemented.)
+
+TPU-first shape discipline, mirroring ``generate``:
+- the whole loop is one jitted program: ``lax.while_loop`` over
+  iterations (dynamic trip count, bounded by max_new_tokens since every
+  iteration emits at least one token), static k, static buffer sizes;
+- acceptance is uniform across the batch (the min over rows): the
+  KV-cache cursor is one scalar. Rows that matched further simply take
+  the bonus token — which equals their draft token there, so every row
+  still gets its exact greedy continuation;
+- cache rollback is O(1) bookkeeping: rewind the scalar ``cache_index``
+  and zero ``cached_segment_ids`` beyond it — never-valid slots are
+  masked by segment 0 exactly like never-written ones
+  (tpufw.models.llama Attention._cached_attention), and the next
+  iteration's write overwrites them.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpufw.infer.generate import pad_prompts
+
+
+def _rollback(cache: dict, new_cursor: jax.Array) -> dict:
+    """Rewind a decode cache to ``new_cursor`` valid entries: slots at
+    or beyond the cursor become segment-0 (masked) and the next write
+    lands on them. Keys/values stay — masking, not control flow."""
+
+    def fix(path, leaf):
+        name = getattr(path[-1], "key", None)
+        if name == "cache_index":
+            # nn.scan stacks per-layer cursors into [L]; keep the shape.
+            return jnp.full(leaf.shape, new_cursor, leaf.dtype)
+        if name == "cached_segment_ids":
+            # [*stack, B, S]: mask the trailing slot axis.
+            live = jnp.arange(leaf.shape[-1]) < new_cursor
+            return jnp.where(live, leaf, 0)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def _cursor(cache: dict) -> jax.Array:
+    """The shared cache_index of a decode cache pytree as a scalar
+    (nn.scan stacks identical per-layer cursors into [L])."""
+    for path, leaf in jax.tree_util.tree_leaves_with_path(cache):
+        if getattr(path[-1], "key", None) == "cache_index":
+            return jnp.max(leaf)
+    raise ValueError("no cache_index in cache pytree")
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "draft_model", "model", "k", "max_new_tokens", "pad_id", "eos_id",
+    ),
+)
+def speculative_generate(
+    draft_model,
+    draft_params,
+    model,
+    params,
+    prompt_tokens: jax.Array,
+    pad_lens: jax.Array,
+    *,
+    max_new_tokens: int,
+    k: int = 4,
+    pad_id: int = 0,
+    eos_id: Optional[int] = None,
+    live_rows: Optional[jax.Array] = None,
+) -> tuple[jax.Array, dict]:
+    """Greedy-decode ``model`` with ``draft_model`` speculation.
+
+    Same contract as ``tpufw.infer.generate`` (left-padded prompts,
+    [B, max_new_tokens] out, eos rows freeze to pad) plus a stats dict
+    {"iterations", "emitted"} — mean tokens/iteration is the speedup
+    diagnostic (k+1 max). Both models must share the tokenizer/vocab;
+    the output is exactly ``model``'s greedy continuation regardless of
+    draft quality (only speed varies).
+
+    ``live_rows`` ([B] bool): rows whose acceptance should count toward
+    the batch-min. Serving passes False for its shape-bucketing filler
+    rows — otherwise a degenerate filler prompt drags every tick's
+    acceptance toward zero and the real rows pay the draft overhead for
+    ~1 token/iteration. Dead rows' outputs are NOT guaranteed to be
+    their greedy continuation (draft tokens past their own match point
+    go unvalidated) — the caller must discard them, which is exactly
+    what serving's filler-row slicing does.
+    """
+    b, p = prompt_tokens.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    for m, who in ((model, "model"), (draft_model, "draft_model")):
+        max_seq = getattr(getattr(m, "cfg", None), "max_seq_len", None)
+        # The verify block may overrun the accepted stream by up to k
+        # slots before rollback, so budget for it.
+        if max_seq is not None and p + max_new_tokens + k > max_seq:
+            raise ValueError(
+                f"prompt ({p}) + max_new_tokens ({max_new_tokens}) + "
+                f"k ({k}) exceeds {who}'s KV cache "
+                f"(max_seq_len={max_seq})"
+            )
+
+    seg = (jnp.arange(p)[None, :] >= pad_lens[:, None]).astype(jnp.int32)
+    positions = jnp.maximum(jnp.arange(p)[None, :] - pad_lens[:, None], 0)
+
+    def apply(m, prm, cache, tokens, pos, sg):
+        out, vars_ = m.apply(
+            {"params": prm, **cache},
+            tokens,
+            positions=pos,
+            segment_ids=sg,
+            mutable=["cache"],
+        )
+        logits = out[0] if isinstance(out, tuple) else out
+        return logits, {"cache": vars_["cache"]}
+
+    # Prefill both models over the (padded) prompt.
+    t_logits, t_cache = apply(model, params, {}, prompt_tokens, positions, seg)
+    _, d_cache = apply(
+        draft_model, draft_params, {}, prompt_tokens, positions, seg
+    )
+    first = jnp.argmax(t_logits[:, -1, :], axis=-1).astype(jnp.int32)
+    done0 = (
+        jnp.zeros((b,), bool) if eos_id is None else first == eos_id
+    )
+
+    # Output buffer with k+1 slack: a block write near the end may
+    # overrun max_new_tokens; the tail is sliced off at return.
+    buf = jnp.full((b, max_new_tokens + k + 1), pad_id, jnp.int32)
+    buf = buf.at[:, 0].set(first)  # the eos token itself is emitted
+    pos0 = p - pad_lens  # `first`'s RoPE position when fed back, per row
+
+    ones = jnp.ones((b, 1), jnp.int32)
+
+    def draft_propose(d_cache, prev, pos):
+        """k proposals + one filler step so the draft cache holds every
+        proposed token (the a == k acceptance case needs d_k cached)."""
+        toks = []
+        tok = prev
+        for i in range(k + 1):
+            logits, d_cache = apply(
+                draft_model, draft_params, d_cache,
+                tok[:, None], (pos + i)[:, None], ones,
+            )
+            tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            if i < k:
+                toks.append(tok)
+        return jnp.stack(toks, axis=1), d_cache  # [B, k]
+
+    def body(carry):
+        t_cache, d_cache, prev, pos, done, n, buf, iters = carry
+        t_cur0 = _cursor(t_cache)
+        d_cur0 = _cursor(d_cache)
+        drafts, d_cache = draft_propose(d_cache, prev, pos)
+
+        # One target pass scores prev + all k drafts: logits[:, i] is
+        # the target's next-token distribution after input i.
+        verify_in = jnp.concatenate([prev[:, None], drafts], axis=1)
+        verify_pos = pos[:, None] + jnp.arange(k + 1)[None, :]
+        t_logits, t_cache = apply(
+            model, params, t_cache, verify_in, verify_pos,
+            jnp.ones((b, k + 1), jnp.int32),
+        )
+        greedy = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B,k+1]
+
+        # Per-row longest accepted prefix, then the batch-uniform min
+        # (one scalar cache cursor). Rows that matched further lose
+        # nothing: their bonus token equals their draft token there.
+        match = drafts == greedy[:, :k]  # [B, k]
+        row_accept = jnp.sum(jnp.cumprod(match.astype(jnp.int32), 1), 1)
+        if live_rows is not None:
+            row_accept = jnp.where(live_rows, row_accept, k)
+        a = jnp.min(row_accept)  # scalar in [0, k]
+
+        # Emitted block: drafts[0..a-1] then the bonus greedy[a].
+        cols = jnp.arange(k + 1)[None, :]
+        block = jnp.where(
+            cols < a,
+            jnp.pad(drafts, ((0, 0), (0, 1))),
+            jnp.take_along_axis(
+                greedy, jnp.broadcast_to(a[None, None], (b, 1)), 1
+            ),
+        )  # [B, k+1]; cols > a are dont-cares (masked below)
+        n_block = jnp.minimum(a + 1, max_new_tokens - n)
+
+        # EOS + emission masking: freeze rows after their eos, blank
+        # columns beyond this block's length.
+        live_col = cols < n_block
+        if eos_id is None:
+            done_before = jnp.broadcast_to(done[:, None], (b, k + 1))
+            new_done = done
+        else:
+            hits = (block == eos_id) & live_col
+            ihits = hits.astype(jnp.int32)
+            # done before col j = done at entry, or an eos hit in a
+            # STRICTLY earlier column (the eos itself is emitted).
+            done_before = done[:, None] | (
+                (jnp.cumsum(ihits, axis=1) - ihits) > 0
+            )
+            new_done = done | jnp.any(hits, axis=1)
+        emitted = jnp.where(
+            live_col & ~done_before, block, pad_id
+        ).astype(jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, emitted, (0, n))
+
+        # Rollback: target verified k+1 inputs but only prev + a drafts
+        # are part of the stream; draft processed prev + k drafts, keep
+        # prev + a. (The next iteration re-feeds the bonus token to
+        # both.)
+        t_cache = _rollback(t_cache, t_cur0 + a + 1)
+        d_cache = _rollback(d_cache, d_cur0 + a + 1)
+
+        # Next input token = the bonus (block col a, traced index).
+        nxt = jax.lax.dynamic_index_in_dim(
+            block, a, axis=1, keepdims=False
+        )
+        return (
+            t_cache, d_cache, nxt, pos + a + 1, new_done,
+            n + n_block, buf, iters + 1,
+        )
+
+    def cond(carry):
+        return carry[5] < max_new_tokens  # carry[5] = tokens emitted
+
+    if max_new_tokens == 1:
+        return buf[:, :1], {
+            "iterations": jnp.zeros((), jnp.int32),
+            "emitted": jnp.ones((), jnp.int32),
+        }
+
+    init = (
+        t_cache, d_cache, first, pos0, done0,
+        jnp.asarray(1, jnp.int32), buf, jnp.asarray(0, jnp.int32),
+    )
+    *_, n_final, buf, iters = jax.lax.while_loop(cond, body, init)
+    return buf[:, :max_new_tokens], {
+        "iterations": iters,
+        "emitted": jnp.minimum(n_final, max_new_tokens),
+    }
+
+
+def speculative_generate_text(
+    draft_model,
+    draft_params,
+    model,
+    params,
+    prompts: Sequence[Sequence[int]],
+    *,
+    max_new_tokens: int,
+    k: int = 4,
+    pad_id: int = 0,
+    eos_id: Optional[int] = None,
+    live_rows: Optional[Sequence[bool]] = None,
+) -> tuple[list[list[int]], dict]:
+    """Ragged-python convenience wrapper (mirrors ``generate_text``).
+    Returns (outputs, stats) with stats as plain ints."""
+    tokens, pads = pad_prompts(prompts, pad_id)
+    out, stats = speculative_generate(
+        draft_model,
+        draft_params,
+        model,
+        params,
+        jnp.asarray(tokens),
+        jnp.asarray(pads),
+        max_new_tokens=max_new_tokens,
+        k=k,
+        pad_id=pad_id,
+        eos_id=eos_id,
+        live_rows=(
+            None if live_rows is None else jnp.asarray(live_rows, bool)
+        ),
+    )
+    result = []
+    for row in np.asarray(out):
+        toks = row.tolist()
+        if eos_id is not None and eos_id in toks:
+            toks = toks[: toks.index(eos_id) + 1]
+        result.append(toks)
+    return result, {k_: int(v) for k_, v in stats.items()}

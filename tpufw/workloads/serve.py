@@ -111,17 +111,9 @@ def build_generator():
     if params_dir:
         # Bare-params Orbax checkpoint (tpufw.tools.import_hf CLI
         # output) — TPUFW_MODEL still names the architecture. Restored
-        # SHARDED onto the mesh via the trainer's abstract-tree helper
-        # (no throwaway init materializes), so multi-chip models load
-        # split, not on device 0.
-        shape_trainer = Trainer(
-            model_cls(model_cfg),
-            TrainerConfig(
-                batch_size=1, seq_len=min(32, model_cfg.max_seq_len)
-            ),
-            MeshConfig(),
-        )
-        params, _ = shape_trainer.restore_params(params_dir)
+        # SHARDED onto the mesh (no throwaway init materializes), so
+        # multi-chip models load split, not on device 0.
+        params = _restore_bare_params(model_cfg, params_dir)
         model_cfg, params = _maybe_quantize(model_cfg, params)
         return model_cls(model_cfg.decode_config()), params, model_cfg, True
 
@@ -226,6 +218,77 @@ def eos_from_env() -> Optional[int]:
     return eos if eos >= 0 else None
 
 
+def build_draft_generator(sampling):
+    """TPUFW_DRAFT_MODEL: enable greedy speculative decoding
+    (tpufw.infer.speculative) with this preset as the draft.
+
+    Draft weights come from TPUFW_DRAFT_PARAMS_CHECKPOINT (bare Orbax
+    params, e.g. an import_hf of the small family member) — without it
+    the draft initializes randomly, which is only useful for wiring
+    tests (proposals rarely match, throughput degrades to ~plain decode
+    plus draft overhead; outputs stay exactly the target's greedy
+    continuation either way). Returns (draft_model, draft_params, k) or
+    None when speculation is off."""
+    import dataclasses
+
+    import jax
+
+    name = env_str("draft_model", "")
+    if not name:
+        return None
+    if sampling.temperature != 0.0 or sampling.repetition_penalty:
+        # top_k/top_p/min_p are genuine no-ops at temperature 0, but a
+        # repetition penalty changes the temp-0 argmax — silently
+        # emitting UNpenalized tokens would break the exact-greedy
+        # contract.
+        raise ValueError(
+            "TPUFW_DRAFT_MODEL requires plain greedy sampling "
+            "(TPUFW_TEMPERATURE=0, no TPUFW_REPETITION_PENALTY): "
+            "speculative acceptance compares against the target argmax"
+        )
+    from tpufw.configs.loader import resolve_model_preset
+    from tpufw.models import model_for_config
+
+    base = resolve_model_preset(name)
+    cfg = dataclasses.replace(
+        base, max_seq_len=env_int("max_seq_len", base.max_seq_len)
+    )
+    ckpt = env_str("draft_params_checkpoint", "")
+    if ckpt:
+        params = _restore_bare_params(cfg, ckpt)
+    else:
+        model = model_for_config(cfg)
+        params = jax.jit(model.init)(
+            jax.random.key(env_int("seed", 0) + 1),
+            jax.numpy.zeros((1, min(8, cfg.max_seq_len)), jax.numpy.int32),
+        )["params"]
+    return (
+        model_for_config(cfg.decode_config()),
+        params,
+        env_int("draft_k", 4),
+    )
+
+
+def _restore_bare_params(model_cfg, params_dir: str):
+    """Bare-params Orbax restore via the trainer's abstract-tree helper
+    — sharded onto the mesh, no throwaway init. ONE copy for the target
+    (TPUFW_PARAMS_CHECKPOINT) and draft (TPUFW_DRAFT_PARAMS_CHECKPOINT)
+    paths."""
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import model_for_config
+    from tpufw.train import Trainer, TrainerConfig
+
+    shape_trainer = Trainer(
+        model_for_config(model_cfg),
+        TrainerConfig(
+            batch_size=1, seq_len=min(32, model_cfg.max_seq_len)
+        ),
+        MeshConfig(),
+    )
+    params, _ = shape_trainer.restore_params(params_dir)
+    return params
+
+
 def _pad_batch(prompts: list[list[int]]) -> tuple[list[list[int]], int]:
     """Pad the batch to a power of two (filler rows = [0]) so the jitted
     generate specializes on few batch shapes. Returns (padded, real_n)."""
@@ -237,18 +300,35 @@ def _pad_batch(prompts: list[list[int]]) -> tuple[list[list[int]], int]:
 
 
 def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
-    from tpufw.infer import generate_text
+    from tpufw.infer import generate_text, speculative_generate_text
 
     decode_model, params, cfg, restored = build_generator()
+    sampling = sampling_from_env()  # default greedy: deterministic
+    draft = build_draft_generator(sampling)
     padded, real_n = _pad_batch(prompts)
-    outs = generate_text(
-        decode_model,
-        params,
-        padded,
-        max_new_tokens=max_new_tokens,
-        sampling=sampling_from_env(),  # default greedy: deterministic
-        eos_id=eos_from_env(),
-    )[:real_n]
+    if draft is not None:
+        draft_model, draft_params, k = draft
+        outs, _stats = speculative_generate_text(
+            draft_model,
+            draft_params,
+            decode_model,
+            params,
+            padded,
+            max_new_tokens=max_new_tokens,
+            eos_id=eos_from_env(),
+            k=k,
+            live_rows=[i < real_n for i in range(len(padded))],
+        )
+        outs = outs[:real_n]
+    else:
+        outs = generate_text(
+            decode_model,
+            params,
+            padded,
+            max_new_tokens=max_new_tokens,
+            sampling=sampling,
+            eos_id=eos_from_env(),
+        )[:real_n]
     return [
         {
             "prompt": p,
@@ -389,6 +469,7 @@ class _Server:
         ) = build_generator()
         self.default_new = max_new_tokens
         self._eos_id = eos_from_env()
+        self._draft = build_draft_generator(self._sampling)
         self.port = port
         self._codec = None
         self._batcher = _Batcher(self._run_tick)
@@ -413,6 +494,25 @@ class _Server:
         longest = _bucket(max(len(p) for p in prompts), 64)
         padded, real_n = _pad_batch(prompts)
         padded = padded + [[0] * longest]  # length-bucket filler row
+        if self._draft is not None:
+            from tpufw.infer import speculative_generate_text
+
+            draft_model, draft_params, k = self._draft
+            outs, _stats = speculative_generate_text(
+                draft_model,
+                draft_params,
+                self.model,
+                self.params,
+                padded,
+                max_new_tokens=max_new,
+                k=k,
+                eos_id=self._eos_id,
+                # Filler rows (pow-2 + length bucket) must not drag the
+                # batch-min acceptance to zero; their outputs are
+                # sliced off below anyway.
+                live_rows=[i < real_n for i in range(len(padded))],
+            )
+            return outs[:real_n]
         outs = self._generate_text(
             self.model,
             self.params,
