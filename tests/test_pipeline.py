@@ -329,28 +329,6 @@ def test_init_params_guards_direct_callers():
         )
 
 
-def test_mixtral_pipeline_rejected_loudly():
-    """MixtralConfig subclasses LlamaConfig: without a guard the pipeline
-    would silently build DENSE stacks from an MoE config."""
-    from tpufw.models import MIXTRAL_CONFIGS
-
-    with pytest.raises(NotImplementedError, match="MoE"):
-        PipelineConfig(n_stages=2, n_microbatches=2).validate(
-            MIXTRAL_CONFIGS["mixtral_tiny"], 4
-        )
-
-
-def test_mixtral_rejected_at_every_entry():
-    from tpufw.models import MIXTRAL_CONFIGS
-
-    cfg = MIXTRAL_CONFIGS["mixtral_tiny"]
-    pipe = PipelineConfig(n_stages=2, n_microbatches=2)
-    with pytest.raises(NotImplementedError, match="MoE"):
-        init_pipeline_params(jax.random.key(0), cfg, pipe)
-    with pytest.raises(NotImplementedError, match="MoE"):
-        reference_forward({}, jnp.zeros((1, 4), jnp.int32), cfg)
-
-
 def test_mistral_window_reaches_pipeline_blocks(devices8):
     """cfg.sliding_window must flow into the pipelined attention: with a
     sequence longer than the window, windowed vs global logits differ,

@@ -1,0 +1,228 @@
+"""Pipelined Mixtral: GPipe schedule x expert parallelism == grouped oracle.
+
+MoE routing capacity is a per-group property — the schedule routes each
+(microbatch x data-shard) group independently — so the oracle
+(``reference_forward`` with ``group_rows``) groups the same way and the
+comparison is exact: logits, router aux loss, and gradients must match
+to float tolerance. Expert sharding (``expert`` mesh axis) slices the
+SAME dispatch algebra to local experts + one psum, so ep must be
+numerically invisible at any degree.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.mesh import MeshConfig, build_mesh
+from tpufw.models import MIXTRAL_CONFIGS
+from tpufw.parallel.pipeline import (
+    PipelineConfig,
+    init_pipeline_params,
+    pipeline_forward,
+    pipeline_loss,
+    pipeline_param_shardings,
+    pipeline_train_step,
+    reference_forward,
+)
+
+# fp32 end to end so parity is tight (bf16 would hide schedule bugs in
+# rounding noise); generous capacity so no assignment drops distract
+# from schedule correctness (drop behavior is pinned separately below).
+CFG = dataclasses.replace(
+    MIXTRAL_CONFIGS["mixtral_tiny"],
+    dtype=jnp.float32,
+    param_dtype=jnp.float32,
+    capacity_factor=2.0,
+)
+B, T, M = 8, 17, 2
+
+
+@pytest.fixture(scope="module")
+def ep_mesh():
+    # pipe=2 x fsdp=2 x expert=2 on the 8-device CPU mesh: batch rows
+    # shard over fsdp only, so each routing group is (B/M)/2 rows.
+    return build_mesh(MeshConfig(data=1, pipe=2, fsdp=2, expert=2))
+
+
+@pytest.fixture(scope="module")
+def ep_setup(ep_mesh):
+    pipe = PipelineConfig(n_stages=2, n_microbatches=M)
+    params = init_pipeline_params(jax.random.key(0), CFG, pipe)
+    params = jax.device_put(
+        params, pipeline_param_shardings(ep_mesh, params)
+    )
+    tokens = jax.random.randint(
+        jax.random.key(1), (B, T), 0, CFG.vocab_size
+    )
+    return params, tokens, pipe
+
+
+def _group_rows(mesh):
+    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    return (B // M) // dp
+
+
+def test_moe_stacks_sharded_on_expert_and_pipe(ep_setup):
+    params, _, _ = ep_setup
+    for leaf in ("w_gate", "w_up", "w_down"):
+        spec = str(params["stages"][leaf].sharding.spec)
+        assert "pipe" in spec and "expert" in spec
+    assert "expert" not in str(params["stages"]["router"].sharding.spec)
+
+
+def test_moe_forward_and_aux_match_grouped_oracle(ep_setup, ep_mesh):
+    params, tokens, pipe = ep_setup
+    logits, aux = jax.jit(
+        lambda p, t: pipeline_forward(p, t, CFG, pipe, ep_mesh)
+    )(params, tokens)
+    ref_logits, ref_aux = reference_forward(
+        params, tokens, CFG, group_rows=_group_rows(ep_mesh)
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), atol=2e-4, rtol=2e-4
+    )
+    np.testing.assert_allclose(
+        float(aux), float(ref_aux), rtol=1e-5
+    )
+
+
+def test_moe_grads_match_grouped_oracle(ep_setup, ep_mesh):
+    """d(CE + aux)/d params through the schedule+ep == the oracle's —
+    in particular no tensor/expert-degree overcount on the replicated
+    router cotangent."""
+    from tpufw.train.trainer import cross_entropy_loss, shift_and_mask
+
+    params, tokens, pipe = ep_setup
+
+    def ref_loss(p, toks):
+        inputs, targets, _, mask = shift_and_mask({"tokens": toks})
+        logits, aux = reference_forward(
+            p, inputs, CFG, group_rows=_group_rows(ep_mesh)
+        )
+        loss, _ = cross_entropy_loss(logits, targets, mask)
+        return loss + aux
+
+    g_pipe = jax.jit(
+        jax.grad(
+            lambda p, t: pipeline_loss(p, t, CFG, pipe, ep_mesh)
+        )
+    )(params, tokens)
+    g_ref = jax.jit(jax.grad(ref_loss))(params, tokens)
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pipe)
+    flat_r = jax.tree.leaves(g_ref)
+    for (path, a), b in zip(flat_p, flat_r):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_moe_pptp_ep_forward_matches_oracle():
+    """The full composition: pipe=2 x tensor=2 x expert=2 (dp=1)."""
+    mesh = build_mesh(
+        MeshConfig(data=1, pipe=2, fsdp=1, tensor=2, expert=2)
+    )
+    pipe = PipelineConfig(n_stages=2, n_microbatches=M)
+    params = init_pipeline_params(jax.random.key(2), CFG, pipe)
+    params = jax.device_put(params, pipeline_param_shardings(mesh, params))
+    tokens = jax.random.randint(
+        jax.random.key(3), (B, T), 0, CFG.vocab_size
+    )
+    logits, aux = jax.jit(
+        lambda p, t: pipeline_forward(p, t, CFG, pipe, mesh)
+    )(params, tokens)
+    ref_logits, ref_aux = reference_forward(
+        params, tokens, CFG, group_rows=B // M
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), atol=2e-4, rtol=2e-4
+    )
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-5)
+
+
+def test_moe_packed_segments_match_oracle(ep_setup, ep_mesh):
+    """Packed batches: segment ids mask cross-doc attention AND exclude
+    pad rows (id 0) from routing/capacity, identically in both paths."""
+    params, tokens, pipe = ep_setup
+    rng = np.random.default_rng(7)
+    seg = np.ones((B, T), np.int32)
+    for r in range(B):
+        cut = rng.integers(4, T - 4)
+        seg[r, cut:] = 2
+        if r % 3 == 0:
+            seg[r, -3:] = 0  # padding tail
+    seg = jnp.asarray(seg)
+    logits, aux = jax.jit(
+        lambda p, t, s: pipeline_forward(
+            p, t, CFG, pipe, ep_mesh, segment_ids=s
+        )
+    )(params, tokens, seg)
+    ref_logits, ref_aux = reference_forward(
+        params, tokens, CFG, segment_ids=seg,
+        group_rows=_group_rows(ep_mesh),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), atol=2e-4, rtol=2e-4
+    )
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-5)
+
+
+def test_moe_capacity_drops_are_identical():
+    """With a TIGHT capacity (factor < 1) overflow tokens drop; the
+    schedule and oracle must drop the SAME tokens (priority order is
+    part of the routing contract, not an implementation detail)."""
+    tight = dataclasses.replace(CFG, capacity_factor=0.5)
+    mesh = build_mesh(MeshConfig(data=1, pipe=2, fsdp=2, expert=2))
+    pipe = PipelineConfig(n_stages=2, n_microbatches=M)
+    params = init_pipeline_params(jax.random.key(4), tight, pipe)
+    params = jax.device_put(params, pipeline_param_shardings(mesh, params))
+    tokens = jax.random.randint(
+        jax.random.key(5), (B, T), 0, tight.vocab_size
+    )
+    logits, _ = jax.jit(
+        lambda p, t: pipeline_forward(p, t, tight, pipe, mesh)
+    )(params, tokens)
+    ref_logits, _ = reference_forward(
+        params, tokens, tight, group_rows=_group_rows(mesh)
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), atol=2e-4, rtol=2e-4
+    )
+
+
+def test_moe_train_step_learns(ep_setup, ep_mesh):
+    import optax
+
+    params, tokens, pipe = ep_setup
+    tx = optax.adam(1e-2)
+    p = jax.tree.map(jnp.copy, params)
+    opt = tx.init(p)
+    losses = []
+    step = jax.jit(
+        lambda p, o, t: pipeline_train_step(
+            p, o, t, tx, CFG, pipe, ep_mesh
+        )
+    )
+    for _ in range(8):
+        p, opt, loss = step(p, opt, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_ep_requires_moe_and_divisibility(ep_mesh):
+    from tpufw.models import LLAMA_CONFIGS
+
+    dense = dataclasses.replace(LLAMA_CONFIGS["llama3_tiny"], n_layers=2)
+    pipe = PipelineConfig(n_stages=2, n_microbatches=M)
+    dp_params = init_pipeline_params(jax.random.key(0), dense, pipe)
+    toks = jnp.zeros((B, T), jnp.int32)
+    with pytest.raises(NotImplementedError, match="no experts"):
+        pipeline_forward(dp_params, toks, dense, pipe, ep_mesh)
+
+    odd = dataclasses.replace(CFG, n_experts=3)
+    o_params = init_pipeline_params(jax.random.key(0), odd, pipe)
+    with pytest.raises(ValueError, match="must divide n_experts"):
+        pipeline_forward(o_params, toks, odd, pipe, ep_mesh)

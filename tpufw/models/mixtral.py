@@ -26,6 +26,7 @@ from tpufw.models.llama import (
     decoder_lm,
     reject_quant_lora,
 )
+from tpufw.ops.moe import expert_capacity, route_topk_capacity
 
 
 @dataclasses.dataclass(frozen=True)
@@ -211,7 +212,7 @@ class MoEMLP(nn.Module):
         b, t, d = x.shape
         e, k = cfg.n_experts, cfg.experts_per_token
         g = b * t
-        capacity = max(int(cfg.capacity_factor * g * k / e), k)
+        capacity = expert_capacity(g, k, e, cfg.capacity_factor)
 
         router_logits = nn.DenseGeneral(
             features=e,
@@ -224,43 +225,12 @@ class MoEMLP(nn.Module):
             name="router",
         )(x.astype(jnp.float32))
         router_logits = router_logits.reshape(g, e)
-        probs = jax.nn.softmax(router_logits, axis=-1)  # [G, E]
 
-        topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [G, k]
-        topk_probs = topk_probs / jnp.sum(
-            topk_probs, axis=-1, keepdims=True
+        dispatch, combine, aux, z = route_topk_capacity(
+            router_logits, k, capacity,
+            valid=None if valid is None else valid.reshape(g),
+            dtype=x.dtype,
         )
-
-        validf = (
-            None
-            if valid is None
-            else valid.reshape(g).astype(jnp.float32)
-        )
-
-        # Priority order: expert slot 0 of every token beats slot 1, and
-        # earlier tokens beat later ones — [k, G, E] cumsum order.
-        mask = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)  # [G, k, E]
-        if validf is not None:
-            mask = mask * validf[:, None, None]
-        mask_kge = jnp.transpose(mask, (1, 0, 2)).reshape(k * g, e)
-        pos_flat = jnp.cumsum(mask_kge, axis=0) - mask_kge  # pre-count
-        pos = pos_flat.reshape(k, g, e).transpose(1, 0, 2)  # [G, k, E]
-        within_cap = (pos < capacity) & (mask > 0)
-        slot = jnp.sum(pos * mask, axis=-1)  # [G, k] slot per assignment
-        dispatch = (
-            jax.nn.one_hot(topk_idx, e, dtype=x.dtype)[..., None]
-            * jax.nn.one_hot(slot.astype(jnp.int32), capacity, dtype=x.dtype)[
-                :, :, None, :
-            ]
-            * jnp.any(within_cap, axis=-1, keepdims=True)[..., None].astype(
-                x.dtype
-            )
-        )  # [G, k, E, C]
-        if validf is not None:
-            dispatch = dispatch * validf[:, None, None, None].astype(x.dtype)
-        combine = dispatch * topk_probs[..., None, None].astype(x.dtype)
-        dispatch = jnp.sum(dispatch, axis=1)  # [G, E, C]
-        combine = jnp.sum(combine, axis=1)
 
         xf = x.reshape(g, d)
         xe = jnp.einsum("gec,gd->ecd", dispatch, xf)  # [E, C, d]
@@ -283,34 +253,6 @@ class MoEMLP(nn.Module):
         )
         y = jnp.einsum("gec,ecd->gd", combine, out_e).reshape(b, t, d)
 
-        # Switch-transformer load-balance loss over top-1 fractions,
-        # computed over valid tokens only.
-        top1_mask = mask[:, 0, :]  # [G, E] (already zeroed on invalid)
-        if validf is None:
-            n_valid = float(g)
-            frac_tokens = jnp.sum(top1_mask, axis=0) / n_valid
-            frac_probs = jnp.mean(probs, axis=0)
-            z = jnp.mean(
-                jnp.square(
-                    jax.scipy.special.logsumexp(router_logits, axis=-1)
-                )
-            )
-        else:
-            n_valid = jnp.maximum(jnp.sum(validf), 1.0)
-            frac_tokens = jnp.sum(top1_mask, axis=0) / n_valid
-            frac_probs = (
-                jnp.sum(probs * validf[:, None], axis=0) / n_valid
-            )
-            z = (
-                jnp.sum(
-                    jnp.square(
-                        jax.scipy.special.logsumexp(router_logits, axis=-1)
-                    )
-                    * validf
-                )
-                / n_valid
-            )
-        aux = e * jnp.sum(frac_tokens * frac_probs)
         aux_loss = (
             cfg.router_aux_weight * aux + cfg.router_z_weight * z
         )

@@ -1,0 +1,119 @@
+"""Top-k capacity-bounded MoE routing as pure einsum algebra.
+
+One routing implementation shared by the flax MoE layer
+(``tpufw.models.mixtral.MoEMLP``) and the functional pipeline MoE block
+(``tpufw.parallel.pipeline``): the reference has no MoE (or any ML) at
+all — expert parallelism enters via BASELINE config 5 — and the whole
+point of the einsum formulation is that the dispatch/combine tensors ARE
+the communication: sharding the expert axis makes XLA emit the
+all-to-alls/psums, no per-expert Python and no hand-written send/recv
+(SURVEY.md §2c).
+
+The capacity discipline is GShard-style: per routing group of G tokens,
+each expert accepts at most C slots; assignment priority is expert slot 0
+of every token over slot 1, earlier tokens over later ones. Overflowing
+assignments are dropped (the residual stream carries those tokens
+unchanged).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(g: int, k: int, e: int, capacity_factor: float) -> int:
+    """Per-expert slot count for a routing group of ``g`` tokens:
+    ``capacity_factor`` x the perfectly-balanced load (g*k/e), never
+    below ``k``. ONE definition for the flax and pipelined MoE paths —
+    capacity determines which tokens drop, so a drift here would
+    silently change drop behavior in only one path."""
+    return max(int(capacity_factor * g * k / e), k)
+
+
+def route_topk_capacity(
+    router_logits: jax.Array,
+    k: int,
+    capacity: int,
+    valid: Optional[jax.Array] = None,
+    dtype=jnp.bfloat16,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Route G tokens to top-``k`` of E experts under a per-expert
+    ``capacity``.
+
+    Args:
+      router_logits: [G, E] float32 router scores.
+      k: experts per token.
+      capacity: max tokens per expert (slots).
+      valid: optional [G] bool/float — False rows (padding in packed
+        batches) are excluded from routing, capacity, and the aux
+        statistics so pads can't evict real tokens from experts.
+      dtype: dtype of the returned dispatch/combine tensors (the
+        activation dtype they will be contracted in).
+
+    Returns:
+      (dispatch [G, E, C], combine [G, E, C], aux_lb, z):
+      ``dispatch`` is 0/1 token->slot assignment, ``combine`` is
+      dispatch * renormalized top-k gate probability; ``aux_lb`` is the
+      Switch-style load-balance statistic ``E * sum(frac_tokens *
+      frac_probs)`` over top-1 assignments, ``z`` the mean squared
+      router logsumexp — both raw (callers apply their config weights).
+    """
+    g, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [G, E]
+
+    topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [G, k]
+    topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+
+    validf = None if valid is None else valid.reshape(g).astype(jnp.float32)
+
+    # Priority order: expert slot 0 of every token beats slot 1, and
+    # earlier tokens beat later ones — [k, G, E] cumsum order.
+    mask = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)  # [G, k, E]
+    if validf is not None:
+        mask = mask * validf[:, None, None]
+    mask_kge = jnp.transpose(mask, (1, 0, 2)).reshape(k * g, e)
+    pos_flat = jnp.cumsum(mask_kge, axis=0) - mask_kge  # pre-count
+    pos = pos_flat.reshape(k, g, e).transpose(1, 0, 2)  # [G, k, E]
+    within_cap = (pos < capacity) & (mask > 0)
+    slot = jnp.sum(pos * mask, axis=-1)  # [G, k] slot per assignment
+    dispatch = (
+        jax.nn.one_hot(topk_idx, e, dtype=dtype)[..., None]
+        * jax.nn.one_hot(slot.astype(jnp.int32), capacity, dtype=dtype)[
+            :, :, None, :
+        ]
+        * jnp.any(within_cap, axis=-1, keepdims=True)[..., None].astype(dtype)
+    )  # [G, k, E, C]
+    if validf is not None:
+        dispatch = dispatch * validf[:, None, None, None].astype(dtype)
+    combine = dispatch * topk_probs[..., None, None].astype(dtype)
+    dispatch = jnp.sum(dispatch, axis=1)  # [G, E, C]
+    combine = jnp.sum(combine, axis=1)
+
+    # Switch-transformer load-balance statistic over top-1 fractions,
+    # computed over valid tokens only.
+    top1_mask = mask[:, 0, :]  # [G, E] (already zeroed on invalid)
+    if validf is None:
+        n_valid = float(g)
+        frac_tokens = jnp.sum(top1_mask, axis=0) / n_valid
+        frac_probs = jnp.mean(probs, axis=0)
+        z = jnp.mean(
+            jnp.square(jax.scipy.special.logsumexp(router_logits, axis=-1))
+        )
+    else:
+        n_valid = jnp.maximum(jnp.sum(validf), 1.0)
+        frac_tokens = jnp.sum(top1_mask, axis=0) / n_valid
+        frac_probs = jnp.sum(probs * validf[:, None], axis=0) / n_valid
+        z = (
+            jnp.sum(
+                jnp.square(
+                    jax.scipy.special.logsumexp(router_logits, axis=-1)
+                )
+                * validf
+            )
+            / n_valid
+        )
+    aux_lb = e * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux_lb, z

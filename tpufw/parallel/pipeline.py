@@ -17,17 +17,20 @@ TPU-first shape of the implementation:
   ``pipe`` — each device materializes only its own stage's layers.
 - within a stage, layers run under ``lax.scan`` over a [layers_per_stage]
   axis (same one-block-compile property as the flax trunk).
-- composes with data parallelism: the microbatch batch dim is sharded
-  over (``data``, ``fsdp``); ``tensor``/``sequence``/``expert`` must be 1
-  in this first cut (asserted).
+- composes with data parallelism (microbatch rows sharded over
+  (``data``, ``fsdp``)), tensor parallelism (Megatron head/ffn split
+  inside each stage, two psums per block), and — for Mixtral — expert
+  parallelism (expert stacks sharded over ``expert``, dispatch sliced
+  to local experts, one psum combines); ``sequence`` must be 1.
 
 The block math matches ``tpufw.models.llama`` (RMSNorm -> GQA attention
-with RoPE -> SwiGLU), reusing the same functional ops
-(``tpufw.ops.rms_norm`` / ``multi_head_attention`` /
-``tpufw.models.llama.apply_rope``), so a pipeline stage is numerically the
-same transformer block — pinned by the parity tests
-(tests/test_pipeline.py) against a sequential evaluation of the identical
-parameters.
+with RoPE -> SwiGLU) / ``tpufw.models.mixtral`` (routed MoE MLP via the
+shared ``tpufw.ops.moe`` routing algebra), reusing the same functional
+ops (``tpufw.ops.rms_norm`` / ``multi_head_attention`` /
+``tpufw.models.llama.apply_rope``), so a pipeline stage is numerically
+the same transformer block — pinned by the parity tests
+(tests/test_pipeline.py, tests/test_pipeline_moe.py) against a
+sequential evaluation of the identical parameters.
 """
 
 from __future__ import annotations
@@ -42,9 +45,16 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpufw.mesh import AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_TENSOR
+from tpufw.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_PIPE,
+    AXIS_TENSOR,
+)
 from tpufw.models.llama import LlamaConfig, apply_rope
 from tpufw.ops import multi_head_attention, rms_norm
+from tpufw.ops.moe import expert_capacity, route_topk_capacity
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,18 +82,13 @@ class PipelineConfig:
 # ----------------------------------------------------------------------
 
 
-def _reject_moe(cfg) -> None:
-    """MixtralConfig subclasses LlamaConfig, so without this every
-    pipeline entry point would silently build DENSE llama stacks (no
-    experts, no router) from an MoE config."""
+def _is_moe(cfg) -> bool:
+    """MixtralConfig subclasses LlamaConfig: every pipeline entry point
+    must branch on this or it would silently build DENSE llama stacks
+    (no experts, no router) from an MoE config."""
     from tpufw.models.mixtral import MixtralConfig
 
-    if isinstance(cfg, MixtralConfig):
-        raise NotImplementedError(
-            "pipeline parallelism implements Llama and Gemma blocks; "
-            "Mixtral's MoE layers are not pipelined (use the flax "
-            "Trainer with expert parallelism instead)"
-        )
+    return isinstance(cfg, MixtralConfig)
 
 
 def _is_gemma(cfg) -> bool:
@@ -98,7 +103,6 @@ def _check_model_split(cfg, n_stages: int) -> None:
     ``init_pipeline_params`` (direct callers) so the two can't drift:
     an unchecked config silently builds a truncated or wrong-family
     model."""
-    _reject_moe(cfg)
     if getattr(cfg, "attention_qkv_bias", False):
         # The functional pipeline blocks carry no bias params; running
         # a Qwen config here would silently train a bias-free non-Qwen
@@ -179,6 +183,34 @@ def init_pipeline_params(
             "final_norm": jnp.zeros((d,), jnp.float32),
         }
 
+    if _is_moe(cfg):
+        # Expert stacks carry an [E] axis after the layer axis —
+        # [S, lps, E, in, out] — which stage_partition_specs maps onto
+        # the ``expert`` mesh axis (pp x ep). The router stays
+        # replicated: its logits must cover ALL experts on every rank
+        # so the capacity/slot assignment agrees globally.
+        e = cfg.n_experts
+        mkeys = jax.random.split(keys[8], 3)
+        return {
+            "embed": jax.random.normal(
+                keys[0], (cfg.vocab_size, d), jnp.float32
+            ).astype(cfg.param_dtype),
+            "stages": {
+                "attn_norm": jnp.ones((s, lps, d), jnp.float32),
+                "wq": w(keys[1], (s, lps, d, h, dh), d),
+                "wk": w(keys[2], (s, lps, d, kh, dh), d),
+                "wv": w(keys[3], (s, lps, d, kh, dh), d),
+                "wo": w(keys[4], (s, lps, h, dh, d), h * dh),
+                "moe_norm": jnp.ones((s, lps, d), jnp.float32),
+                "router": w(keys[5], (s, lps, d, e), d),
+                "w_gate": w(keys[6], (s, lps, e, d, f), d),
+                "w_up": w(keys[7], (s, lps, e, d, f), d),
+                "w_down": w(mkeys[0], (s, lps, e, f, d), f),
+            },
+            "final_norm": jnp.ones((d,), jnp.float32),
+            "head": w(mkeys[1], (d, cfg.vocab_size), d),
+        }
+
     return {
         "embed": jax.random.normal(
             keys[0], (cfg.vocab_size, d), jnp.float32
@@ -202,21 +234,29 @@ def init_pipeline_params(
 #: Which axis of each stage-stack leaf shards over ``tensor``
 #: (Megatron-style): q/k/v split output heads, o splits input heads,
 #: gate/up split d_ff columns, down splits d_ff rows — so each block
-#: needs exactly two psums (post-attention, post-MLP). Leaf names are
-#: shared by the Llama ([S, lps, ...]) and Gemma ([S, pairs, ...])
-#: layouts, whose leaves have identical ranks.
+#: needs exactly two psums (post-attention, post-MLP). Axes are counted
+#: FROM THE END so one table covers the Llama ([S, lps, ...]), Gemma
+#: ([S, pairs, ...]), and Mixtral expert ([S, lps, E, in, out]) stack
+#: ranks: the contraction dims sit at fixed offsets from the tail in
+#: all three layouts.
 _TENSOR_LEAF_AXIS = {
-    "wq": 3, "wk": 3, "wv": 3,  # [S, L, d, H, dh] -> head axis
-    "wo": 2,                    # [S, L, H, dh, d] -> head axis
-    "w_gate": 3, "w_up": 3,     # [S, L, d, f] -> ffn columns
-    "w_down": 2,                # [S, L, f, d] -> ffn rows
+    "wq": -2, "wk": -2, "wv": -2,  # [..., d, H, dh] -> head axis
+    "wo": -3,                      # [..., H, dh, d] -> head axis
+    "w_gate": -1, "w_up": -1,      # [..., d, f] -> ffn columns
+    "w_down": -2,                  # [..., f, d] -> ffn rows
 }
+
+#: Mixtral expert stacks are rank 5 ([S, lps, E, in, out]); their [E]
+#: axis shards over ``expert`` (pp x ep). Dense w_* leaves are rank 4
+#: and never match.
+_EXPERT_LEAVES = ("w_gate", "w_up", "w_down")
 
 
 def stage_partition_specs(stages: dict) -> Any:
     """Per-leaf PartitionSpecs for a stage-stack pytree: leading [S]
-    axis over ``pipe``, plus the Megatron tensor split per
-    ``_TENSOR_LEAF_AXIS``. Used both as ``shard_map`` in_specs and (via
+    axis over ``pipe``, the Megatron tensor split per
+    ``_TENSOR_LEAF_AXIS``, and the expert split for rank-5 MoE stacks.
+    Used both as ``shard_map`` in_specs and (via
     ``pipeline_param_shardings``) as the physical param layout, so the
     two can't disagree."""
 
@@ -232,7 +272,9 @@ def stage_partition_specs(stages: dict) -> Any:
         axes: list = [AXIS_PIPE] + [None] * (leaf.ndim - 1)
         t = _TENSOR_LEAF_AXIS.get(name)
         if t is not None:
-            axes[t] = AXIS_TENSOR
+            axes[leaf.ndim + t] = AXIS_TENSOR
+        if name in _EXPERT_LEAVES and leaf.ndim == 5:
+            axes[2] = AXIS_EXPERT
         return P(*axes)
 
     return jax.tree_util.tree_map_with_path(spec, stages)
@@ -267,13 +309,14 @@ def _tp_psum(y: jax.Array, tp: bool) -> jax.Array:
     return jax.lax.psum(y, AXIS_TENSOR) if tp else y
 
 
-def _block(
+def _attn_sublayer(
     p: dict, x: jax.Array, cfg: LlamaConfig, backend: str, seg=None,
     tp: bool = False,
-):
-    """One decoder block; p leaves have no leading layer axis. With
-    ``tp`` the head/ffn axes of p are LOCAL shards (Megatron split per
-    ``_TENSOR_LEAF_AXIS``); the two partial-sum einsums are psummed."""
+) -> jax.Array:
+    """Pre-norm GQA attention with RoPE + residual add — the half of
+    the decoder block shared verbatim by the dense (``_block``) and
+    MoE (``_mixtral_block``) layouts. With ``tp`` the head axes of p
+    are LOCAL shards; the output projection partial-sum is psummed."""
     dt = cfg.dtype
     positions = jnp.broadcast_to(
         jnp.arange(x.shape[1]), x.shape[:2]
@@ -291,9 +334,20 @@ def _block(
         sliding_window=getattr(cfg, "sliding_window", None),
         backend=backend,
     )
-    x = x + _tp_psum(
+    return x + _tp_psum(
         jnp.einsum("bthk,hkd->btd", att, p["wo"].astype(dt)), tp
     )
+
+
+def _block(
+    p: dict, x: jax.Array, cfg: LlamaConfig, backend: str, seg=None,
+    tp: bool = False,
+):
+    """One decoder block; p leaves have no leading layer axis. With
+    ``tp`` the head/ffn axes of p are LOCAL shards (Megatron split per
+    ``_TENSOR_LEAF_AXIS``); the two partial-sum einsums are psummed."""
+    dt = cfg.dtype
+    x = _attn_sublayer(p, x, cfg, backend, seg, tp)
     h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
     g = jnp.einsum("btd,df->btf", h, p["w_gate"].astype(dt))
     u = jnp.einsum("btd,df->btf", h, p["w_up"].astype(dt))
@@ -304,6 +358,81 @@ def _block(
         tp,
     )
     return x
+
+
+def _moe_mlp(
+    p: dict, h: jax.Array, cfg, valid, tp: bool, ep: bool
+) -> tuple[jax.Array, jax.Array]:
+    """Functional top-k MoE MLP over this device's LOCAL experts.
+
+    Routing (``tpufw.ops.moe.route_topk_capacity`` — the SAME algebra
+    as the flax MoEMLP, so the two paths can't drift) runs over ALL
+    experts on every rank: the router kernel is replicated and the
+    slot/capacity assignment must agree globally. Under ``ep`` each
+    rank then slices the dispatch/combine tensors down to its own [E /
+    ep] expert stack — no all-to-all is needed because the batch rides
+    ``data``/``fsdp``, never ``expert``, so activations are already
+    replicated across the expert axis and one psum combines the expert
+    partial sums (+ the ``tp`` d_ff partial sums in the same
+    collective).
+
+    The routing group is this device's microbatch shard (G = local
+    rows x T), i.e. capacity is per (microbatch, data-shard) group —
+    the standard pipelined-MoE discipline; the flax path's group is
+    the global batch.
+    """
+    b, t, d = h.shape
+    e, k = cfg.n_experts, cfg.experts_per_token
+    g = b * t
+    capacity = expert_capacity(g, k, e, cfg.capacity_factor)
+
+    logits = jnp.einsum(
+        "btd,de->bte",
+        h.astype(jnp.float32),
+        p["router"].astype(jnp.float32),
+    ).reshape(g, e)
+    dispatch, combine, aux, z = route_topk_capacity(
+        logits, k, capacity,
+        valid=None if valid is None else valid.reshape(g),
+        dtype=cfg.dtype,
+    )
+
+    if ep:
+        e_local = p["w_gate"].shape[0]
+        off = jax.lax.axis_index(AXIS_EXPERT) * e_local
+        dispatch = jax.lax.dynamic_slice_in_dim(dispatch, off, e_local, 1)
+        combine = jax.lax.dynamic_slice_in_dim(combine, off, e_local, 1)
+
+    dt = cfg.dtype
+    xf = h.reshape(g, d).astype(dt)
+    xe = jnp.einsum("gec,gd->ecd", dispatch, xf)  # [E_local, C, d]
+    gate = jnp.einsum("ecd,edf->ecf", xe, p["w_gate"].astype(dt))
+    up = jnp.einsum("ecd,edf->ecf", xe, p["w_up"].astype(dt))
+    down = jnp.einsum(
+        "ecf,efd->ecd", jax.nn.silu(gate) * up, p["w_down"].astype(dt)
+    )
+    y = jnp.einsum("gec,ecd->gd", combine, down)
+    axes = (AXIS_EXPERT,) * ep + (AXIS_TENSOR,) * tp
+    if axes:
+        y = jax.lax.psum(y, axes)
+    aux_loss = cfg.router_aux_weight * aux + cfg.router_z_weight * z
+    return y.reshape(b, t, d), aux_loss
+
+
+def _mixtral_block(
+    p: dict, x: jax.Array, cfg, backend: str, seg=None,
+    tp: bool = False, ep: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """One Mixtral decoder block (attention + routed MoE MLP); returns
+    (x, router aux loss). ``valid`` for routing mirrors the flax
+    MixtralBlock: padding rows of packed batches (segment id 0) are
+    excluded from routing and capacity."""
+    x = _attn_sublayer(p, x, cfg, backend, seg, tp)
+    h = rms_norm(x, p["moe_norm"], cfg.rms_eps)
+    y, aux = _moe_mlp(
+        p, h, cfg, None if seg is None else seg > 0, tp, ep
+    )
+    return x + y, aux
 
 
 def _gemma_block(p, x, cfg, backend, seg, window, tp: bool = False):
@@ -355,22 +484,36 @@ def _gemma_block(p, x, cfg, backend, seg, window, tp: bool = False):
 
 def _stage(
     stage_params: dict, x: jax.Array, cfg, backend: str, seg=None,
-    tp: bool = False,
+    tp: bool = False, ep: bool = False,
 ):
-    """Run this stage's [layers_per_stage] blocks via lax.scan. For
-    Gemma the scanned unit is a local+global PAIR (the alternation is a
-    static per-block property, so it cannot ride a plain layer scan)."""
+    """Run this stage's [layers_per_stage] blocks via lax.scan; returns
+    (out, aux) where aux is the summed router loss of this stage's MoE
+    layers (0.0 for dense families). For Gemma the scanned unit is a
+    local+global PAIR (the alternation is a static per-block property,
+    so it cannot ride a plain layer scan)."""
     if _is_gemma(cfg):
         out, _ = jax.lax.scan(
             _gemma_pair_body(cfg, backend, seg, tp), x, stage_params
         )
-        return out
+        return out, jnp.zeros((), jnp.float32)
+
+    if _is_moe(cfg):
+
+        def moe_body(carry, layer_p):
+            h, aux = carry
+            h, a = _mixtral_block(layer_p, h, cfg, backend, seg, tp, ep)
+            return (h, aux + a.astype(jnp.float32)), None
+
+        (out, aux), _ = jax.lax.scan(
+            moe_body, (x, jnp.zeros((), jnp.float32)), stage_params
+        )
+        return out, aux
 
     def body(h, layer_p):
         return _block(layer_p, h, cfg, backend, seg, tp), None
 
     out, _ = jax.lax.scan(body, x, stage_params)
-    return out
+    return out, jnp.zeros((), jnp.float32)
 
 
 # ----------------------------------------------------------------------
@@ -381,14 +524,17 @@ def _stage(
 def _gpipe_local(stage_params, x_mb, *seg_mb, cfg, backend):
     """Per-device body (inside shard_map): stream M microbatches through
     the pipe ring. x_mb: [M, mb_local, T, D]; seg_mb is () or one
-    [M, mb_local, T] int32 array of segment ids. Returns x_mb's shape
-    (valid data produced on the last stage, zeros elsewhere,
-    psum-combined)."""
+    [M, mb_local, T] int32 array of segment ids. Returns (outs, aux):
+    outs in x_mb's shape (valid data produced on the last stage, zeros
+    elsewhere, psum-combined); aux the global-mean router loss scalar
+    (0.0 for dense families), replicated on every device."""
     s = jax.lax.axis_size(AXIS_PIPE)
     sidx = jax.lax.axis_index(AXIS_PIPE)
-    # Static (trace-time) tensor-parallel degree: the stage weights'
-    # head/ffn axes arrive pre-sharded per _TENSOR_LEAF_AXIS.
+    # Static (trace-time) tensor/expert-parallel degrees: the stage
+    # weights' head/ffn/expert axes arrive pre-sharded per
+    # _TENSOR_LEAF_AXIS / _EXPERT_LEAVES.
     tp = jax.lax.axis_size(AXIS_TENSOR) > 1
+    ep = jax.lax.axis_size(AXIS_EXPERT) > 1
     # Local leading stage dim is 1 after sharding: drop it.
     stage_params = jax.tree.map(lambda a: a[0], stage_params)
     m = x_mb.shape[0]
@@ -397,7 +543,7 @@ def _gpipe_local(stage_params, x_mb, *seg_mb, cfg, backend):
     seg_all = seg_mb[0] if has_seg else None
 
     def tick(carry, t):
-        recv, outs = carry
+        recv, outs, aux_acc = carry
         x_in = jnp.where(sidx == 0, x_mb[jnp.clip(t, 0, m - 1)], recv)
         if has_seg:
             # Stage sidx processes microbatch t - sidx at tick t (the
@@ -408,7 +554,7 @@ def _gpipe_local(stage_params, x_mb, *seg_mb, cfg, backend):
             seg_in = seg_all[jnp.clip(t - sidx, 0, m - 1)]
         else:
             seg_in = None
-        out = _stage(stage_params, x_in, cfg, backend, seg_in, tp)
+        out, aux = _stage(stage_params, x_in, cfg, backend, seg_in, tp, ep)
         nxt = jax.lax.ppermute(out, AXIS_PIPE, perm)
         # Last stage finishes microbatch t-(s-1) at tick t.
         oidx = jnp.clip(t - (s - 1), 0, m - 1)
@@ -417,16 +563,31 @@ def _gpipe_local(stage_params, x_mb, *seg_mb, cfg, backend):
         outs = jax.lax.dynamic_update_index_in_dim(
             outs, jnp.where(valid, out, cur), oidx, 0
         )
-        return (nxt, outs), None
+        # Bubble ticks run the stage on clip-duplicated (garbage)
+        # microbatches; only ticks where stage sidx holds a REAL
+        # microbatch (t - sidx in [0, m)) contribute router loss.
+        real = (t >= sidx) & (t < sidx + m)
+        aux_acc = aux_acc + jnp.where(real, aux, 0.0)
+        return (nxt, outs, aux_acc), None
 
     zeros = jnp.zeros_like(x_mb[0])
     outs0 = jnp.zeros_like(x_mb)
-    (_, outs), _ = jax.lax.scan(
-        tick, (zeros, outs0), jnp.arange(m + s - 1)
+    (_, outs, aux_sum), _ = jax.lax.scan(
+        tick, (zeros, outs0, jnp.zeros((), jnp.float32)),
+        jnp.arange(m + s - 1),
     )
     # Non-last stages hold zeros; the psum replicates the real result
     # across the pipe axis (required: `pipe` is unmentioned in out_specs).
-    return jax.lax.psum(outs, AXIS_PIPE)
+    outs = jax.lax.psum(outs, AXIS_PIPE)
+    # aux: sum over stages (pipe) = sum over all layers; mean over the
+    # m x (data x fsdp shards) routing groups. tensor/expert ranks
+    # compute identical copies (router is replicated), so they are NOT
+    # psum axes — the result is already replicated across them.
+    dp = jax.lax.axis_size(AXIS_DATA) * jax.lax.axis_size(AXIS_FSDP)
+    aux = jax.lax.psum(
+        aux_sum, (AXIS_PIPE, AXIS_DATA, AXIS_FSDP)
+    ) / float(m * dp)
+    return outs, aux
 
 
 def pipeline_forward(
@@ -442,7 +603,10 @@ def pipeline_forward(
     """Full LM forward with the block stack pipelined: logits [B, T, V]
     (or, with ``return_hidden``, the post-final-norm hidden states
     [B, T, D] for the chunked-vocab CE path, which applies the head
-    per sequence chunk and never materializes full logits).
+    per sequence chunk and never materializes full logits). For MoE
+    configs the return value is a TUPLE (logits_or_hidden, aux): the
+    mean router loss (already /n_layers, matching the flax Mixtral
+    convention) that the training objective must add.
 
     Embedding and the head run outside the pipeline region (they are a
     small fraction of compute and live replicated / batch-sharded);
@@ -450,11 +614,23 @@ def pipeline_forward(
     ``segment_ids`` [B, T] masks cross-document attention for packed
     batches; ids ride the ring with their microbatch's activations.
     """
-    for ax in ("sequence", "expert"):
-        if mesh.shape[ax] != 1:
+    is_moe = _is_moe(cfg)
+    if mesh.shape["sequence"] != 1:
+        raise NotImplementedError(
+            "pipeline composes with data/fsdp/tensor/expert only for "
+            f"now; mesh axis sequence has size {mesh.shape['sequence']}"
+        )
+    ep = mesh.shape[AXIS_EXPERT]
+    if ep > 1:
+        if not is_moe:
             raise NotImplementedError(
-                f"pipeline composes with data/fsdp/tensor only for now; "
-                f"mesh axis {ax} has size {mesh.shape[ax]}"
+                f"mesh expert axis has size {ep} but {type(cfg).__name__}"
+                " has no experts to shard over it"
+            )
+        if cfg.n_experts % ep:
+            raise ValueError(
+                f"mesh expert={ep} must divide n_experts="
+                f"{cfg.n_experts} for pipelined expert parallelism"
             )
     tp = mesh.shape[AXIS_TENSOR]
     if tp > 1:
@@ -495,28 +671,33 @@ def pipeline_forward(
     stage_specs = stage_partition_specs(params["stages"])
     local = partial(_gpipe_local, cfg=cfg, backend=backend)
     if segment_ids is None:
-        hidden = shard_map(
+        hidden, aux = shard_map(
             local,
             mesh=mesh,
             in_specs=(stage_specs, mb_spec),
-            out_specs=mb_spec,
+            out_specs=(mb_spec, P()),
             check_vma=False,
         )(params["stages"], x)
     else:
         seg = segment_ids.astype(jnp.int32).reshape(m, b // m, t)
         seg_spec = P(None, (AXIS_DATA, AXIS_FSDP), None)
-        hidden = shard_map(
+        hidden, aux = shard_map(
             local,
             mesh=mesh,
             in_specs=(stage_specs, mb_spec, seg_spec),
-            out_specs=mb_spec,
+            out_specs=(mb_spec, P()),
             check_vma=False,
         )(params["stages"], x, seg)
     hidden = hidden.reshape(b, t, cfg.d_model)
 
-    if return_hidden:
-        return _final_norm(params, hidden, cfg)
-    return _logits_epilogue(params, hidden, cfg)
+    out = (
+        _final_norm(params, hidden, cfg)
+        if return_hidden
+        else _logits_epilogue(params, hidden, cfg)
+    )
+    if is_moe:
+        return out, aux / cfg.n_layers
+    return out
 
 
 def _head_kernel(params: dict) -> jax.Array:
@@ -583,10 +764,18 @@ def reference_forward(
     cfg: LlamaConfig,
     backend: str = "xla",
     segment_ids: Optional[jax.Array] = None,
+    group_rows: Optional[int] = None,
 ) -> jax.Array:
     """Sequential evaluation of the SAME params (no pipe axis) — the
-    parity oracle for the schedule."""
-    _reject_moe(cfg)
+    parity oracle for the schedule.
+
+    For MoE configs, routing capacity is a per-group property: the
+    schedule routes each (microbatch x data-shard) group of
+    ``group_rows`` rows independently, so the oracle must group the
+    same way to be bit-comparable (vmap over row groups). Returns
+    (logits, aux) for MoE — aux meaned over groups, summed over
+    layers, /n_layers — matching ``pipeline_forward``'s accounting.
+    """
     b, t = tokens.shape
     x = _embed(params, tokens, cfg)
     flat = jax.tree.map(
@@ -595,6 +784,35 @@ def reference_forward(
     seg = (
         None if segment_ids is None else segment_ids.astype(jnp.int32)
     )
+
+    if _is_moe(cfg):
+        gr = group_rows or b
+        if b % gr:
+            raise ValueError(f"batch {b} not divisible by group_rows {gr}")
+
+        def run_group(xg, sg):
+            def body(carry, layer_p):
+                h, aux = carry
+                h, a = _mixtral_block(layer_p, h, cfg, backend, sg)
+                return (h, aux + a.astype(jnp.float32)), None
+
+            (h, aux), _ = jax.lax.scan(
+                body, (xg, jnp.zeros((), jnp.float32)), flat
+            )
+            return h, aux
+
+        xg = x.reshape(b // gr, gr, t, cfg.d_model)
+        if seg is None:
+            hidden, aux = jax.vmap(lambda xx: run_group(xx, None))(xg)
+        else:
+            hidden, aux = jax.vmap(run_group)(
+                xg, seg.reshape(b // gr, gr, t)
+            )
+        hidden = hidden.reshape(b, t, cfg.d_model)
+        return (
+            _logits_epilogue(params, hidden, cfg),
+            jnp.mean(aux) / cfg.n_layers,
+        )
 
     if _is_gemma(cfg):
         body = _gemma_pair_body(cfg, backend, seg)
@@ -646,6 +864,7 @@ def pipeline_eval(
     if not isinstance(batch, dict):
         batch = {"tokens": batch}
     inputs, targets, seg_in, mask = shift_and_mask(batch)
+    aux = 0.0  # MoE router loss joins the objective, as in the flax path
     if loss_chunk_size:
         from tpufw.ops.loss import chunked_cross_entropy
 
@@ -653,18 +872,22 @@ def pipeline_eval(
             params, inputs, cfg, pipe, mesh, segment_ids=seg_in,
             return_hidden=True,
         )
+        if _is_moe(cfg):
+            hidden, aux = hidden
         loss, n = chunked_cross_entropy(
             hidden, _head_kernel(params), targets, mask,
             chunk_size=loss_chunk_size,
             compute_dtype=loss_chunk_dtype or jnp.bfloat16,
             logits_soft_cap=getattr(cfg, "final_logit_soft_cap", None),
         )
-        return {"loss": loss, "n_tokens": n}
+        return {"loss": loss + aux, "n_tokens": n}
     logits = pipeline_forward(
         params, inputs, cfg, pipe, mesh, segment_ids=seg_in
     )
+    if _is_moe(cfg):
+        logits, aux = logits
     loss, n = cross_entropy_loss(logits, targets, mask)
-    return {"loss": loss, "n_tokens": n}
+    return {"loss": loss + aux, "n_tokens": n}
 
 
 def pipeline_train_step(
