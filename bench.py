@@ -758,7 +758,10 @@ def _worker() -> int:
                     )
                     vt.init_state()
                     r_hist = vt.run(
-                        synthetic_images(r_batch, 224, 1000),
+                        # on_device: one staging upload, not 150 MB of
+                        # images per step through the tunnel (r3 run 1
+                        # measured 14.7 img/s pure-transfer-bound).
+                        synthetic_images(r_batch, 224, 1000, on_device=True),
                         flops_per_image=ResNetConfig().flops_per_image(
                             224
                         ),

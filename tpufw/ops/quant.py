@@ -68,7 +68,15 @@ def quantize_params(params: Any) -> Any:
     with its int8 form ({"q_kernel", "scale"} in place of {"kernel"}).
     Handles plain, nn.scan-stacked, and Gemma pair-stacked layouts.
     Raises if the tree carries LoRA adapters (merge first)."""
+    from flax.linen import meta
+
     from tpufw.models.lora import has_lora
+
+    # Trees straight out of ``model.init`` carry flax AxisMetadata boxes
+    # (LogicallyPartitioned) around each leaf; unbox (identity on raw
+    # trees) so the walk below sees arrays. The quantized tree is raw —
+    # the quant modules re-declare their own logical partitioning.
+    params = meta.unbox(params)
 
     if has_lora(params):
         raise ValueError(
