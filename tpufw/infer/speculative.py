@@ -193,6 +193,13 @@ def speculative_generate(
         # nothing: their bonus token equals their draft token there.
         match = drafts == greedy[:, :k]  # [B, k]
         row_accept = jnp.sum(jnp.cumprod(match.astype(jnp.int32), 1), 1)
+        # Rows whose output no longer matters must not throttle the
+        # batch min: filler rows (live_rows) never did, and eos-DONE
+        # rows' post-eos continuations diverge target-vs-draft forever
+        # (their emissions are frozen to pad_id regardless), so without
+        # this mask one finished row pins every live row to ~1
+        # token/iteration.
+        row_accept = jnp.where(done, k, row_accept)
         if live_rows is not None:
             row_accept = jnp.where(live_rows, row_accept, k)
         a = jnp.min(row_accept)  # scalar in [0, k]
