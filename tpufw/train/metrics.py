@@ -27,6 +27,10 @@ class StepMetrics:
     # input-boundness is invisible in step_time (the fetch happens
     # between steps), so it gets its own number.
     data_wait_s: float = 0.0
+    # Steps averaged into this entry (sync_every > 1 measures a WINDOW
+    # of asynchronously-dispatched steps per host sync; step/loss are
+    # the window's last step's).
+    window_steps: int = 1
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -57,11 +61,21 @@ class Meter:
         self._t0 = time.perf_counter()
 
     def stop(
-        self, step: int, loss: float, data_wait_s: float = 0.0
+        self,
+        step: int,
+        loss: float,
+        data_wait_s: float = 0.0,
+        n_steps: int = 1,
     ) -> StepMetrics:
+        """``n_steps`` > 1: the elapsed time covers a window of that
+        many dispatched steps (one host sync per window); throughput,
+        step time, AND data_wait_s (pass the window's summed wait) are
+        all attributed per step, so their units stay consistent."""
         if self._t0 is None:
             raise RuntimeError("Meter.stop() without start()")
-        dt = time.perf_counter() - self._t0
+        n = max(n_steps, 1)
+        dt = (time.perf_counter() - self._t0) / n
+        data_wait_s = data_wait_s / n
         self._t0 = None
         tps_chip = self.tokens_per_step / dt / self.n_chips
         mfu = tps_chip * self.flops_per_token / self.chip.peak_bf16_flops
@@ -72,6 +86,7 @@ class Meter:
             tokens_per_sec_per_chip=tps_chip,
             mfu=mfu,
             data_wait_s=data_wait_s,
+            window_steps=n_steps,
         )
 
 

@@ -66,10 +66,21 @@ def _pipe_state_step(
     """TrainState-shaped step (the functional
     tpufw.parallel.pipeline.pipeline_train_step stays the public
     params/opt_state API; this private wrapper is the trainer's)."""
-    loss, grads = jax.value_and_grad(pipeline_loss)(
-        state.params, batch, model_cfg, pipe, mesh,
-        loss_chunk_size, loss_chunk_dtype,
-    )
+    if pipe.schedule == "1f1b":
+        from tpufw.parallel.pipeline_1f1b import (
+            pipeline_1f1b_value_and_grad,
+        )
+
+        loss, grads = pipeline_1f1b_value_and_grad(
+            state.params, batch, model_cfg, pipe, mesh,
+            loss_chunk_size=loss_chunk_size,
+            loss_chunk_dtype=loss_chunk_dtype,
+        )
+    else:
+        loss, grads = jax.value_and_grad(pipeline_loss)(
+            state.params, batch, model_cfg, pipe, mesh,
+            loss_chunk_size, loss_chunk_dtype,
+        )
     updates, new_opt = tx.update(grads, state.opt_state, state.params)
     return (
         PipeTrainState(
@@ -327,38 +338,68 @@ class PipelineTrainer:
             self.cfg.preemption_sync_every,
         )
         # Global step budget: a restored run finishes the remainder.
-        remaining = max(0, self.cfg.total_steps - int(self.state.step))
+        start_step = int(self.state.step)
+        remaining = max(0, self.cfg.total_steps - start_step)
+        se = max(1, self.cfg.sync_every)
+        window_n, window_wait = 0, 0.0
         history: list[StepMetrics] = []
         try:
             for i, (wait, batch) in enumerate(timed_batches(data)):
                 if i >= remaining:
                     break
                 prof.maybe_start(i)
-                meter.start()
+                if window_n == 0:
+                    meter.start()
                 batch = globalize_batch(self.mesh, batch)
                 with prof.step(i):
                     self.state, m = self._compiled_step(batch)(
                         self.state, batch
                     )
-                    loss = jax.block_until_ready(m["loss"])
-                sm = meter.stop(
-                    int(self.state.step), loss, data_wait_s=wait
-                )
+                    window_n += 1
+                    window_wait += wait
+                    py_step = start_step + i + 1
+                    # Step 1, multiples of sync_every, and the last.
+                    sync = (
+                        i == 0
+                        or py_step % se == 0
+                        or i + 1 == remaining
+                    )
+                    if sync:
+                        loss = jax.block_until_ready(m["loss"])
                 prof.maybe_stop(i)
-                history.append(sm)
-                if on_metrics and (i % self.cfg.log_every == 0):
-                    on_metrics(sm)
-                maybe_inloop_eval(
-                    self, int(self.state.step), eval_data, on_eval
+                if not sync:
+                    continue
+                sm = meter.stop(
+                    py_step, loss,
+                    data_wait_s=window_wait, n_steps=window_n,
                 )
+                window_n, window_wait = 0, 0.0
+                history.append(sm)
+                if on_metrics and (
+                    se > 1 or i % self.cfg.log_every == 0
+                ):
+                    on_metrics(sm)
+                maybe_inloop_eval(self, py_step, eval_data, on_eval)
                 if ckpt is not None:
-                    ckpt.save(int(self.state.step), self.state)
+                    ckpt.save(py_step, self.state)
                 # Gang-consistent preemption stop (tpufw.train.preemption).
                 if checkpoint_stop(
-                    shutdown, ckpt, int(self.state.step), self.state
+                    shutdown, ckpt, py_step, self.state
                 ):
                     self.preempted = True
                     break
+            # Iterator exhausted mid-window: flush the open window.
+            if window_n:
+                loss = jax.block_until_ready(m["loss"])
+                sm = meter.stop(
+                    py_step, loss,
+                    data_wait_s=window_wait, n_steps=window_n,
+                )
+                history.append(sm)
+                if on_metrics:
+                    on_metrics(sm)
+                if ckpt is not None:
+                    ckpt.save(py_step, self.state)
         finally:
             prof.close()
             if ckpt is not None:

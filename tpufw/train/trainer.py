@@ -389,6 +389,16 @@ class TrainerConfig:
     # cross-host allgather in GracefulShutdown.should_stop); a stop is
     # acted on within this many steps of the signal. 1 = every step.
     preemption_sync_every: int = 1
+    # Steps between host syncs of the loss (block_until_ready). 1 = the
+    # classic per-step sync. >1 dispatches a WINDOW of steps and syncs
+    # once: on a remote/tunneled backend every sync costs a host<->device
+    # round trip, which serializes against short steps. The loop always
+    # syncs after the first step (compile boundary / first-step latency)
+    # and the last; metrics entries then carry window averages
+    # (StepMetrics.window_steps), and checkpoint saves, in-loop eval,
+    # and preemption checks run at sync points only — align
+    # checkpoint_every/eval_every to multiples of sync_every.
+    sync_every: int = 1
 
 
 class Trainer:
@@ -735,7 +745,10 @@ class Trainer:
         # total_steps is the GLOBAL optimizer-step budget (it sized the LR
         # schedule): a restored run finishes the remaining steps, it does
         # not train total_steps more.
-        remaining = max(0, self.cfg.total_steps - int(self.state.step))
+        start_step = int(self.state.step)
+        remaining = max(0, self.cfg.total_steps - start_step)
+        se = max(1, self.cfg.sync_every)
+        window_n, window_wait = 0, 0.0
         history: list[StepMetrics] = []
         try:
             with use_mesh(self.mesh):
@@ -745,29 +758,63 @@ class Trainer:
                     batch = self.globalize_batch(batch)
                     step_fn = self.compiled_step(batch)
                     prof.maybe_start(i)
-                    meter.start()
+                    if window_n == 0:
+                        meter.start()
                     with prof.step(i):
                         self.state, m = step_fn(self.state, batch)
-                        loss = jax.block_until_ready(m["loss"])
-                    sm = meter.stop(
-                        int(self.state.step), loss, data_wait_s=wait
-                    )
+                        window_n += 1
+                        window_wait += wait
+                        # state.step advances by exactly 1 per step_fn:
+                        # tracking it host-side avoids a device fetch
+                        # (= a round trip on tunneled backends) per step.
+                        py_step = start_step + i + 1
+                        # Sync at step 1 (compile boundary), then at
+                        # steps that are MULTIPLES of sync_every — so
+                        # checkpoint_every/eval_every aligned to
+                        # sync_every actually fire — and at the last.
+                        sync = (
+                            i == 0
+                            or py_step % se == 0
+                            or i + 1 == remaining
+                        )
+                        if sync:
+                            loss = jax.block_until_ready(m["loss"])
                     prof.maybe_stop(i)
-                    history.append(sm)
-                    if on_metrics and (i % self.cfg.log_every == 0):
-                        on_metrics(sm)
-                    maybe_inloop_eval(
-                        self, int(self.state.step), eval_data, on_eval
+                    if not sync:
+                        continue
+                    sm = meter.stop(
+                        py_step, loss,
+                        data_wait_s=window_wait, n_steps=window_n,
                     )
+                    window_n, window_wait = 0, 0.0
+                    history.append(sm)
+                    if on_metrics and (
+                        se > 1 or i % self.cfg.log_every == 0
+                    ):
+                        on_metrics(sm)
+                    maybe_inloop_eval(self, py_step, eval_data, on_eval)
                     if ckpt is not None:
-                        ckpt.save(int(self.state.step), self.state)
+                        ckpt.save(py_step, self.state)
                     # Collective decision (see preemption.py): the whole
                     # gang breaks at the same step or not at all.
                     if checkpoint_stop(
-                        shutdown, ckpt, int(self.state.step), self.state
+                        shutdown, ckpt, py_step, self.state
                     ):
                         self.preempted = True
                         break
+                # Iterator exhausted mid-window: flush the open window
+                # so every executed step is metered and checkpointable.
+                if window_n:
+                    loss = jax.block_until_ready(m["loss"])
+                    sm = meter.stop(
+                        py_step, loss,
+                        data_wait_s=window_wait, n_steps=window_n,
+                    )
+                    history.append(sm)
+                    if on_metrics:
+                        on_metrics(sm)
+                    if ckpt is not None:
+                        ckpt.save(py_step, self.state)
         finally:
             # Flush even on a mid-loop crash: the trace and the last
             # checkpoint are exactly what post-mortems need.

@@ -83,6 +83,10 @@ class VisionTrainerConfig:
     # (tpufw.train.preemption); same semantics as TrainerConfig.
     handle_preemption: bool = True
     preemption_sync_every: int = 1
+    # Steps between host syncs (see TrainerConfig.sync_every): ResNet
+    # steps are short (~100-300 ms), so per-step loss fetches serialize
+    # against backend round trips; >1 dispatches a window per sync.
+    sync_every: int = 1
 
 
 class VisionTrainer:
@@ -228,7 +232,10 @@ class VisionTrainer:
             self.cfg.preemption_sync_every,
         )
         # Global step budget: a restored run finishes the remainder.
-        remaining = max(0, self.cfg.total_steps - int(self.state.step))
+        start_step = int(self.state.step)
+        remaining = max(0, self.cfg.total_steps - start_step)
+        se = max(1, self.cfg.sync_every)
+        window_n, window_wait = 0, 0.0
         from tpufw.train.trainer import globalize_batch
 
         history = []
@@ -238,23 +245,50 @@ class VisionTrainer:
                     if i >= remaining:
                         break
                     batch = globalize_batch(self.mesh, batch)
-                    meter.start()
+                    if window_n == 0:
+                        meter.start()
                     self.state, m = step_fn(self.state, batch)
+                    window_n += 1
+                    window_wait += wait
+                    py_step = start_step + i + 1
+                    # Step 1 (compile boundary), MULTIPLES of
+                    # sync_every (so aligned checkpoint_every fires),
+                    # and the last step.
+                    if not (
+                        i == 0
+                        or py_step % se == 0
+                        or i + 1 == remaining
+                    ):
+                        continue
                     loss = jax.block_until_ready(m["loss"])
                     sm = meter.stop(
-                        int(self.state.step), loss, data_wait_s=wait
+                        py_step, loss,
+                        data_wait_s=window_wait, n_steps=window_n,
+                    )
+                    window_n, window_wait = 0, 0.0
+                    history.append(sm)
+                    if on_metrics:
+                        on_metrics(sm)
+                    if ckpt is not None:
+                        ckpt.save(py_step, self.state)
+                    # Gang-consistent preemption stop (preemption.py).
+                    if checkpoint_stop(
+                        shutdown, ckpt, py_step, self.state
+                    ):
+                        self.preempted = True
+                        break
+                # Iterator exhausted mid-window: flush the open window.
+                if window_n:
+                    loss = jax.block_until_ready(m["loss"])
+                    sm = meter.stop(
+                        py_step, loss,
+                        data_wait_s=window_wait, n_steps=window_n,
                     )
                     history.append(sm)
                     if on_metrics:
                         on_metrics(sm)
                     if ckpt is not None:
-                        ckpt.save(int(self.state.step), self.state)
-                    # Gang-consistent preemption stop (preemption.py).
-                    if checkpoint_stop(
-                        shutdown, ckpt, int(self.state.step), self.state
-                    ):
-                        self.preempted = True
-                        break
+                        ckpt.save(py_step, self.state)
         finally:
             if ckpt is not None:
                 ckpt.wait()
