@@ -1,0 +1,212 @@
+"""1F1B schedule == GPipe+autodiff: loss and gradients must be identical.
+
+Both schedules compute the exact same function (same stage math, same
+shift/mask objective), so any drift is a schedule bug — the stash ring,
+the cotangent timing, the masked warmup/drain sub-ticks, or a psum
+domain — not numerics to be tolerated.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.mesh import MeshConfig, build_mesh
+from tpufw.models import LLAMA_CONFIGS
+from tpufw.parallel.pipeline import (
+    PipelineConfig,
+    init_pipeline_params,
+    pipeline_loss,
+    pipeline_param_shardings,
+)
+from tpufw.parallel.pipeline_1f1b import pipeline_1f1b_value_and_grad
+
+CFG = dataclasses.replace(
+    LLAMA_CONFIGS["llama3_tiny"],
+    n_layers=4,
+    dtype=jnp.float32,
+    param_dtype=jnp.float32,
+)
+B, T, M = 16, 17, 4
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(MeshConfig(data=2, pipe=2, fsdp=2))
+
+
+@pytest.fixture(scope="module")
+def setup(mesh):
+    pipe = PipelineConfig(n_stages=2, n_microbatches=M)
+    params = init_pipeline_params(jax.random.key(0), CFG, pipe)
+    params = jax.device_put(params, pipeline_param_shardings(mesh, params))
+    tokens = jax.random.randint(
+        jax.random.key(1), (B, T), 0, CFG.vocab_size
+    )
+    return params, tokens, pipe
+
+
+def _assert_grads_match(g1, g2, atol=2e-4, rtol=2e-4):
+    flat1, _ = jax.tree_util.tree_flatten_with_path(g1)
+    flat2 = jax.tree.leaves(g2)
+    assert len(flat1) == len(flat2)
+    for (path, a), b in zip(flat1, flat2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=atol, rtol=rtol,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_1f1b_matches_gpipe_grads(setup, mesh):
+    params, tokens, pipe = setup
+    loss_g, grads_g = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: pipeline_loss(p, t, CFG, pipe, mesh)
+        )
+    )(params, tokens)
+    loss_f, grads_f = jax.jit(
+        lambda p, t: pipeline_1f1b_value_and_grad(
+            p, t, CFG, pipe, mesh
+        )
+    )(params, tokens)
+    np.testing.assert_allclose(
+        float(loss_f), float(loss_g), rtol=1e-5
+    )
+    _assert_grads_match(grads_f, grads_g)
+
+
+def test_1f1b_packed_batch_matches_gpipe(setup, mesh):
+    params, tokens, pipe = setup
+    rng = np.random.default_rng(3)
+    seg = np.ones((B, T), np.int32)
+    for r in range(B):
+        seg[r, rng.integers(5, T - 2):] = 2
+        if r % 4 == 0:
+            seg[r, -2:] = 0
+    batch = {
+        "tokens": tokens,
+        "segment_ids": jnp.asarray(seg),
+        "loss_mask": jnp.asarray((seg > 0).astype(np.float32)),
+    }
+    loss_g, grads_g = jax.jit(
+        jax.value_and_grad(
+            lambda p, b: pipeline_loss(p, b, CFG, pipe, mesh)
+        )
+    )(params, batch)
+    loss_f, grads_f = jax.jit(
+        lambda p, b: pipeline_1f1b_value_and_grad(p, b, CFG, pipe, mesh)
+    )(params, batch)
+    np.testing.assert_allclose(float(loss_f), float(loss_g), rtol=1e-5)
+    _assert_grads_match(grads_f, grads_g)
+
+
+def test_1f1b_pptp_matches_gpipe():
+    """Megatron tensor split inside 1F1B stages (pp=2 x tp=2 x fsdp=2):
+    per-leaf grad psum domains must match the sharding exactly."""
+    mesh = build_mesh(MeshConfig(data=1, pipe=2, fsdp=2, tensor=2))
+    pipe = PipelineConfig(n_stages=2, n_microbatches=M)
+    params = init_pipeline_params(jax.random.key(4), CFG, pipe)
+    params = jax.device_put(params, pipeline_param_shardings(mesh, params))
+    tokens = jax.random.randint(
+        jax.random.key(5), (B, T), 0, CFG.vocab_size
+    )
+    loss_g, grads_g = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: pipeline_loss(p, t, CFG, pipe, mesh)
+        )
+    )(params, tokens)
+    loss_f, grads_f = jax.jit(
+        lambda p, t: pipeline_1f1b_value_and_grad(p, t, CFG, pipe, mesh)
+    )(params, tokens)
+    np.testing.assert_allclose(float(loss_f), float(loss_g), rtol=1e-5)
+    _assert_grads_match(grads_f, grads_g)
+
+
+def test_1f1b_four_stages(setup):
+    """Deeper ring (S=4, stash lifetime 2(S-1)=6 ticks) on pipe=4."""
+    mesh4 = build_mesh(MeshConfig(data=1, pipe=4, fsdp=2))
+    pipe = PipelineConfig(n_stages=4, n_microbatches=M)
+    params = init_pipeline_params(jax.random.key(6), CFG, pipe)
+    params = jax.device_put(
+        params, pipeline_param_shardings(mesh4, params)
+    )
+    tokens = jax.random.randint(
+        jax.random.key(7), (B, T), 0, CFG.vocab_size
+    )
+    loss_g, grads_g = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: pipeline_loss(p, t, CFG, pipe, mesh4)
+        )
+    )(params, tokens)
+    loss_f, grads_f = jax.jit(
+        lambda p, t: pipeline_1f1b_value_and_grad(p, t, CFG, pipe, mesh4)
+    )(params, tokens)
+    np.testing.assert_allclose(float(loss_f), float(loss_g), rtol=1e-5)
+    _assert_grads_match(grads_f, grads_g)
+
+
+def test_1f1b_chunked_ce_matches_full(setup, mesh):
+    """loss_chunk_size engages chunked CE inside the last stage's
+    epilogue; fp32 chunk dtype is bit-comparable to full logits."""
+    params, tokens, pipe = setup
+    loss_full, grads_full = jax.jit(
+        lambda p, t: pipeline_1f1b_value_and_grad(p, t, CFG, pipe, mesh)
+    )(params, tokens)
+    loss_c, grads_c = jax.jit(
+        lambda p, t: pipeline_1f1b_value_and_grad(
+            p, t, CFG, pipe, mesh, loss_chunk_size=8
+        )
+    )(params, tokens)
+    np.testing.assert_allclose(
+        float(loss_c), float(loss_full), rtol=1e-4
+    )
+    _assert_grads_match(grads_c, grads_full, atol=5e-4, rtol=5e-3)
+
+
+def test_1f1b_pipeline_trainer_learns(mesh):
+    """schedule='1f1b' through the full PipelineTrainer surface."""
+    import optax
+
+    from tpufw.train import PipelineTrainer, TrainerConfig
+
+    pt = PipelineTrainer(
+        CFG,
+        PipelineConfig(n_stages=2, n_microbatches=M, schedule="1f1b"),
+        TrainerConfig(
+            batch_size=B, seq_len=T, total_steps=8, lr=1e-2,
+            warmup_steps=1, log_every=1,
+        ),
+        MeshConfig(data=2, pipe=2, fsdp=2),
+        tx=optax.adam(1e-2),
+    )
+    pt.init_state()
+    from tpufw.train import synthetic_batches
+
+    hist = pt.run(
+        synthetic_batches(B, T, CFG.vocab_size),
+        model_flops_per_token=CFG.flops_per_token(T - 1),
+    )
+    # Gradient EXACTNESS is pinned by the parity tests above; this is
+    # the integration check that the full trainer surface descends.
+    assert hist[-1].loss < hist[0].loss - 0.15, [m.loss for m in hist]
+
+
+def test_unknown_schedule_is_loud():
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        PipelineConfig(
+            n_stages=2, n_microbatches=2, schedule="interleaved"
+        ).validate(CFG, 4)
+
+
+def test_1f1b_rejects_gemma_and_moe(mesh):
+    from tpufw.models import GEMMA_CONFIGS, MIXTRAL_CONFIGS
+
+    pipe = PipelineConfig(n_stages=2, n_microbatches=M)
+    toks = jnp.zeros((B, T), jnp.int32)
+    for bad in (
+        GEMMA_CONFIGS["gemma2_tiny"], MIXTRAL_CONFIGS["mixtral_tiny"]
+    ):
+        with pytest.raises(NotImplementedError, match="1f1b"):
+            pipeline_1f1b_value_and_grad({}, toks, bad, pipe, mesh)

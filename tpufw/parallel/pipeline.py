@@ -59,12 +59,24 @@ from tpufw.ops.moe import expert_capacity, route_topk_capacity
 
 @dataclasses.dataclass(frozen=True)
 class PipelineConfig:
-    """Pipeline schedule hyperparameters on top of a LlamaConfig."""
+    """Pipeline schedule hyperparameters on top of a LlamaConfig.
+
+    ``schedule``: "gpipe" (autodiff through the microbatch stream;
+    activation memory grows with n_microbatches; supports Llama, Gemma,
+    Mixtral incl. expert parallelism) or "1f1b" (manual-VJP
+    one-forward-one-backward, O(n_stages) activation memory — see
+    tpufw.parallel.pipeline_1f1b; Llama-family, data/fsdp/tensor)."""
 
     n_stages: int
     n_microbatches: int
+    schedule: str = "gpipe"
 
     def validate(self, model: LlamaConfig, batch_size: int) -> None:
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pipeline schedule {self.schedule!r}; "
+                "expected 'gpipe' or '1f1b'"
+            )
         _check_model_split(model, self.n_stages)
         if batch_size % self.n_microbatches:
             raise ValueError(
