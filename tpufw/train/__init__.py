@@ -26,6 +26,11 @@ from tpufw.train.native_data import (  # noqa: F401
     write_token_corpus,
 )
 from tpufw.train.prefetch import prefetch_to_device  # noqa: F401
+from tpufw.train.sft import (  # noqa: F401
+    encode_conversation,
+    render_conversation,
+    sft_batches,
+)
 from tpufw.train.vision import (  # noqa: F401
     VisionTrainer,
     VisionTrainerConfig,

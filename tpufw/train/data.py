@@ -60,17 +60,20 @@ def synthetic_packed_batches(
         yield batch
 
 
-def _emit(batch_toks: list, batch_segs: list) -> dict:
+def _emit(batch_toks: list, batch_segs: list, batch_train: list) -> dict:
     segs = np.array(batch_segs, np.int32)
     return {
         "tokens": np.array(batch_toks, np.int32),
         "segment_ids": segs,
-        "loss_mask": (segs > 0).astype(np.float32),
+        "loss_mask": (
+            (segs > 0).astype(np.float32)
+            * np.array(batch_train, np.float32)
+        ),
     }
 
 
 def pack_documents(
-    docs: Iterator[np.ndarray],
+    docs: Iterator,
     batch_size: int,
     seq_len: int,
     pad_id: int = 0,
@@ -80,39 +83,58 @@ def pack_documents(
     Emits ``tokens``, ``segment_ids`` (per-doc ids so attention can't cross
     documents — wired to the model's segment masking), and ``loss_mask``
     (0 on padding). Documents longer than T are split; no tokens dropped.
+
+    ``docs`` yields token arrays, or ``(tokens, train_mask)`` pairs for
+    objectives that train on a SUBSET of each document's positions (SFT:
+    assistant turns only — tpufw.train.sft); the per-token mask rides
+    the packing with its tokens and lands in ``loss_mask``.
     """
     row_tokens: list[int] = []
     row_segs: list[int] = []
+    row_train: list[float] = []
     seg = 1
-    batch_toks, batch_segs = [], []
+    batch_toks, batch_segs, batch_train = [], [], []
 
     def flush_row():
-        nonlocal row_tokens, row_segs, seg
+        nonlocal row_tokens, row_segs, row_train, seg
         pad = seq_len - len(row_tokens)
-        toks = row_tokens + [pad_id] * pad
-        segs = row_segs + [0] * pad
-        batch_toks.append(toks)
-        batch_segs.append(segs)
-        row_tokens, row_segs = [], []
+        batch_toks.append(row_tokens + [pad_id] * pad)
+        batch_segs.append(row_segs + [0] * pad)
+        batch_train.append(row_train + [0.0] * pad)
+        row_tokens, row_segs, row_train = [], [], []
         seg = 1
 
     for doc in docs:
+        if isinstance(doc, tuple):
+            doc, train = doc
+            train = list(np.asarray(train, np.float32))
+        else:
+            train = None
         doc = list(np.asarray(doc, dtype=np.int32))
+        if train is None:
+            train = [1.0] * len(doc)
+        elif len(train) != len(doc):
+            raise ValueError(
+                f"train_mask length {len(train)} != doc length {len(doc)}"
+            )
         while doc:
             space = seq_len - len(row_tokens)
             take, doc = doc[:space], doc[space:]
             row_tokens.extend(take)
+            row_train.extend(train[:space])
+            train = train[space:]
             row_segs.extend([seg] * len(take))
             seg += 1
             if len(row_tokens) == seq_len:
                 flush_row()
             if len(batch_toks) == batch_size:
-                yield _emit(batch_toks, batch_segs)
-                batch_toks, batch_segs = [], []
+                yield _emit(batch_toks, batch_segs, batch_train)
+                batch_toks, batch_segs, batch_train = [], [], []
     if row_tokens:
         flush_row()
     if batch_toks:
         while len(batch_toks) < batch_size:
             batch_toks.append([pad_id] * seq_len)
             batch_segs.append([0] * seq_len)
-        yield _emit(batch_toks, batch_segs)
+            batch_train.append([0.0] * seq_len)
+        yield _emit(batch_toks, batch_segs, batch_train)

@@ -206,8 +206,43 @@ def main() -> int:
     # cfg.batch_size is GLOBAL; each process loads its local shard.
     n_proc = cluster.num_processes
     local_bs = check_global_batch(cfg.batch_size, n_proc)
+    sft_path = env_str("sft_data", "")
     data_prefix = env_str("data_prefix", "")
-    if data_prefix:
+    if sft_path:
+        # Supervised fine-tuning: JSONL conversations, chat-template
+        # rendered, assistant-masked (tpufw.train.sft). Pairs with
+        # TPUFW_INIT_FROM (imported base weights) + TPUFW_LORA_RANK.
+        from tpufw.train.sft import byte_encode, sft_batches
+
+        tok_name = env_str("sft_tokenizer", "bytes")
+        if tok_name == "bytes":
+            encode = byte_encode
+        else:
+            from transformers import AutoTokenizer
+
+            _tok = AutoTokenizer.from_pretrained(tok_name)
+
+            def encode(text):
+                return _tok.encode(text, add_special_tokens=False)
+
+        from tpufw.train import prefetch_to_device
+
+        data = prefetch_to_device(
+            sft_batches(
+                sft_path,
+                local_bs,
+                cfg.seq_len,
+                encode,
+                template=env_str("sft_template", "plain"),
+                seed=env_int("data_seed", 0),
+                # Disjoint per-process conversation shards (same
+                # contract as the TokenCorpus path below).
+                shard_id=cluster.process_id,
+                num_shards=n_proc,
+            ),
+            trainer.mesh,
+        )
+    elif data_prefix:
         # Real corpus (native/ mmap packer; TPUFW_DATA_PREFIX points at the
         # <prefix>.bin/.idx pair): disjoint per-process doc shards, H2D
         # transfer prefetched off the step path.
