@@ -323,17 +323,26 @@ def _tp_psum(y: jax.Array, tp: bool) -> jax.Array:
 
 def _attn_sublayer(
     p: dict, x: jax.Array, cfg: LlamaConfig, backend: str, seg=None,
-    tp: bool = False,
+    tp: bool = False, tp_ops=None,
 ) -> jax.Array:
     """Pre-norm GQA attention with RoPE + residual add — the half of
     the decoder block shared verbatim by the dense (``_block``) and
     MoE (``_mixtral_block``) layouts. With ``tp`` the head axes of p
-    are LOCAL shards; the output projection partial-sum is psummed."""
+    are LOCAL shards; the output projection partial-sum is psummed.
+
+    ``tp_ops`` overrides the two tensor-parallel collectives as an
+    (enter, combine) pair — the 1F1B schedule substitutes Megatron f/g
+    custom VJPs (pipeline_1f1b) because in-region ``jax.vjp`` cannot
+    transpose a plain psum; GPipe's autodiff-from-outside uses the
+    defaults (identity enter, plain psum combine)."""
+    enter, combine = tp_ops or (
+        (lambda h: h), (lambda y: _tp_psum(y, tp))
+    )
     dt = cfg.dtype
     positions = jnp.broadcast_to(
         jnp.arange(x.shape[1]), x.shape[:2]
     )
-    h = rms_norm(x, p["attn_norm"], cfg.rms_eps)
+    h = enter(rms_norm(x, p["attn_norm"], cfg.rms_eps))
     q = jnp.einsum("btd,dhk->bthk", h, p["wq"].astype(dt))
     k = jnp.einsum("btd,dhk->bthk", h, p["wk"].astype(dt))
     v = jnp.einsum("btd,dhk->bthk", h, p["wv"].astype(dt))
@@ -346,28 +355,31 @@ def _attn_sublayer(
         sliding_window=getattr(cfg, "sliding_window", None),
         backend=backend,
     )
-    return x + _tp_psum(
-        jnp.einsum("bthk,hkd->btd", att, p["wo"].astype(dt)), tp
+    return x + combine(
+        jnp.einsum("bthk,hkd->btd", att, p["wo"].astype(dt))
     )
 
 
 def _block(
     p: dict, x: jax.Array, cfg: LlamaConfig, backend: str, seg=None,
-    tp: bool = False,
+    tp: bool = False, tp_ops=None,
 ):
     """One decoder block; p leaves have no leading layer axis. With
     ``tp`` the head/ffn axes of p are LOCAL shards (Megatron split per
-    ``_TENSOR_LEAF_AXIS``); the two partial-sum einsums are psummed."""
+    ``_TENSOR_LEAF_AXIS``); the two partial-sum einsums are psummed
+    (or routed through ``tp_ops`` — see ``_attn_sublayer``)."""
+    enter, combine = tp_ops or (
+        (lambda h: h), (lambda y: _tp_psum(y, tp))
+    )
     dt = cfg.dtype
-    x = _attn_sublayer(p, x, cfg, backend, seg, tp)
-    h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
+    x = _attn_sublayer(p, x, cfg, backend, seg, tp, tp_ops)
+    h = enter(rms_norm(x, p["mlp_norm"], cfg.rms_eps))
     g = jnp.einsum("btd,df->btf", h, p["w_gate"].astype(dt))
     u = jnp.einsum("btd,df->btf", h, p["w_up"].astype(dt))
-    x = x + _tp_psum(
+    x = x + combine(
         jnp.einsum(
             "btf,fd->btd", jax.nn.silu(g) * u, p["w_down"].astype(dt)
-        ),
-        tp,
+        )
     )
     return x
 

@@ -34,6 +34,14 @@ stages and one cross-axis psum makes them exact. Gradient parity with
 the GPipe+autodiff path is pinned by tests/test_pipeline_1f1b.py —
 the two schedules must produce the SAME gradients (both are exact).
 
+Memory accounting: "O(stages)" is the ACTIVATION claim. The embed and
+head gradient accumulators are full fp32 [V, D]/[D, V] buffers per
+device — the same layout as the GPipe path, whose
+``pipeline_param_shardings`` keeps embed/head (and therefore their
+grads) replicated. Vocab-sharding both params and accumulators (with a
+psum_scatter epilogue) is the next step if those buffers ever dominate;
+it applies to the two schedules equally.
+
 Scope: Llama-family blocks (the flagship), composed with data/fsdp
 batch sharding and Megatron tensor parallelism. Gemma pairs and MoE
 are rejected loudly (GPipe supports them; extend here the same way).
@@ -57,10 +65,11 @@ from tpufw.mesh import (
     AXIS_SEQUENCE,
     AXIS_TENSOR,
 )
-from tpufw.models.llama import LlamaConfig, apply_rope
-from tpufw.ops import multi_head_attention, rms_norm
+from tpufw.models.llama import LlamaConfig
+from tpufw.ops import rms_norm
 from tpufw.parallel.pipeline import (
     PipelineConfig,
+    _block,
     _is_gemma,
     _is_moe,
     stage_partition_specs,
@@ -117,43 +126,15 @@ def _f_bwd(_, ct):
 _f_enter.defvjp(_f_fwd, _f_bwd)
 
 
-def _block_1f1b(p, x, cfg, backend, seg, tp: bool):
-    """The Llama decoder block of ``tpufw.parallel.pipeline._block``,
-    with the tensor-parallel collectives stated via f/g custom VJPs so
-    in-region ``jax.vjp`` is exact. tp=False is bit-identical to the
-    GPipe block (no collectives inserted)."""
-    dt = cfg.dtype
-    positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
-    h = rms_norm(x, p["attn_norm"], cfg.rms_eps)
-    if tp:
-        h = _f_enter(h)
-    q = jnp.einsum("btd,dhk->bthk", h, p["wq"].astype(dt))
-    k = jnp.einsum("btd,dhk->bthk", h, p["wk"].astype(dt))
-    v = jnp.einsum("btd,dhk->bthk", h, p["wv"].astype(dt))
-    rs = getattr(cfg, "rope_scaling", None)
-    q = apply_rope(q, positions, cfg.rope_theta, rs)
-    k = apply_rope(k, positions, cfg.rope_theta, rs)
-    att = multi_head_attention(
-        q, k, v, causal=True, segment_ids=seg,
-        sliding_window=getattr(cfg, "sliding_window", None),
-        backend=backend,
-    )
-    o = jnp.einsum("bthk,hkd->btd", att, p["wo"].astype(dt))
-    x = x + (_g_combine(o) if tp else o)
-    h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
-    if tp:
-        h = _f_enter(h)
-    g = jnp.einsum("btd,df->btf", h, p["w_gate"].astype(dt))
-    u = jnp.einsum("btd,df->btf", h, p["w_up"].astype(dt))
-    dn = jnp.einsum(
-        "btf,fd->btd", jax.nn.silu(g) * u, p["w_down"].astype(dt)
-    )
-    return x + (_g_combine(dn) if tp else dn)
-
-
 def _stage_1f1b(stage_params, x, cfg, backend, seg, tp: bool):
+    """The SAME Llama block as the GPipe schedule (pipeline._block),
+    with the tensor-parallel collectives routed through the f/g
+    operators above so in-region ``jax.vjp`` transposes them exactly.
+    tp=False inserts no collectives and is bit-identical to GPipe's."""
+    tp_ops = (_f_enter, _g_combine) if tp else None
+
     def body(h, layer_p):
-        return _block_1f1b(layer_p, h, cfg, backend, seg, tp), None
+        return _block(layer_p, h, cfg, backend, seg, tp, tp_ops), None
 
     out, _ = jax.lax.scan(body, x, stage_params)
     return out
@@ -287,20 +268,38 @@ def _1f1b_local(
             stash, jnp.where(f_on, x_in, old_slot), slot_f, 0
         )
 
-        # Last stage: this microbatch's loss + cotangent, NOW.
+        # Last stage: this microbatch's loss + cotangent, NOW. Gated
+        # with lax.cond — the head fwd+bwd is comparable to a whole
+        # stage forward at real vocab sizes, and only one of S stages
+        # uses the result; inside shard_map the scalar predicate stays
+        # real control flow, so the other S-1 stages skip it at
+        # runtime.
         def head_loss(hl, hidden):
             return mb_loss(hl, hidden, jf_c)
 
-        loss_j, (g_hl_j, dy_j) = jax.value_and_grad(
-            head_loss, argnums=(0, 1)
-        )(head_leaves, y)
         is_last = sidx == s - 1
         take_loss = is_last & f_on
-        loss_sum = loss_sum + jnp.where(take_loss, loss_j, 0.0)
-        g_fnorm = g_fnorm + jnp.where(
-            take_loss, g_hl_j["final_norm"], 0.0
+
+        def run_epilogue(hl, hidden):
+            return jax.value_and_grad(head_loss, argnums=(0, 1))(
+                hl, hidden
+            )
+
+        def skip_epilogue(hl, hidden):
+            return (
+                jnp.zeros((), jnp.float32),
+                (
+                    jax.tree.map(jnp.zeros_like, hl),
+                    jnp.zeros_like(hidden),
+                ),
+            )
+
+        loss_j, (g_hl_j, dy_j) = jax.lax.cond(
+            take_loss, run_epilogue, skip_epilogue, head_leaves, y
         )
-        g_head = g_head + jnp.where(take_loss, g_hl_j["head"], 0.0)
+        loss_sum = loss_sum + loss_j
+        g_fnorm = g_fnorm + g_hl_j["final_norm"]
+        g_head = g_head + g_hl_j["head"]
 
         # ---- backward sub-tick ------------------------------------
         # Cotangent in: the last stage's own loss grad for jb (== jf
