@@ -642,24 +642,38 @@ def _worker() -> int:
         "TPUFW_BENCH_DECODE", "1"
     ) != "0":
         try:
+            import dataclasses as _dc0
             import gc
 
             import jax.numpy as jnp
 
-            from tpufw.infer import SamplingConfig, generate
+            from tpufw.infer import (
+                SamplingConfig,
+                cast_decode_params,
+                generate,
+            )
             from tpufw.models import Llama as _Llama
 
             gc.collect()  # drop any lingering trainer state before alloc
-            dcfg = model_cfg.decode_config()
-            dmodel = _Llama(dcfg)
             d_b, d_prompt, d_new = 8, 128, 128
+            # Serving posture: bf16 weights (fp32 masters double the
+            # HBM bytes of the bandwidth-bound phase) and a KV cache
+            # sized to the request (256 slots, not the model's 2048 —
+            # full-cache attention/update per step is pure waste).
+            dcfg = _dc0.replace(
+                model_cfg.decode_config(),
+                max_seq_len=d_prompt + d_new,
+            )
+            dmodel = _Llama(dcfg)
             prompts = jax.random.randint(
                 jax.random.key(0), (d_b, d_prompt), 0, dcfg.vocab_size
             )
             pads = jnp.zeros((d_b,), jnp.int32)
-            d_params = jax.jit(dmodel.init)(
-                jax.random.key(1), prompts
-            )["params"]
+            d_params = cast_decode_params(
+                jax.jit(dmodel.init)(jax.random.key(1), prompts)[
+                    "params"
+                ]
+            )
 
             def _gen():
                 return generate(

@@ -248,3 +248,63 @@ def test_generate_with_mesh_sharded_params(devices8):
     sharded = jax.tree.map(shard, params)
     out = generate_text(dmodel, sharded, prompts, max_new_tokens=4)
     assert out == ref
+
+
+def test_cache_length_is_output_invariant(llama_params):
+    """A right-sized KV cache must be numerically invisible: never-
+    written slots carry segment 0 and are masked, so generate with
+    max_seq_len=32 equals max_seq_len=TINY.max_seq_len exactly (the
+    invariant the serving cache-bucket ladder relies on)."""
+    import dataclasses
+
+    prompts = [[5, 17, 101, 7, 42], [3, 9]]
+    full = generate_text(
+        Llama(TINY.decode_config()), llama_params, prompts,
+        max_new_tokens=8,
+    )
+    small_cfg = dataclasses.replace(TINY.decode_config(), max_seq_len=32)
+    small = generate_text(
+        Llama(small_cfg), llama_params, prompts, max_new_tokens=8
+    )
+    assert full == small
+
+
+def test_cast_decode_params_rules():
+    """fp32 weights -> bf16; int8 q_kernels and their fp32 scales pass
+    through untouched."""
+    import numpy as np
+
+    from tpufw.infer import cast_decode_params
+
+    tree = {
+        "w": jnp.ones((2, 2), jnp.float32),
+        "already": jnp.ones((2,), jnp.bfloat16),
+        "ids": jnp.ones((2,), jnp.int32),
+        # flax RMSNorm weight is ALSO named "scale" — no q_kernel
+        # sibling, so it must cast (only quant scales are fp32-pinned).
+        "norm": {"scale": jnp.ones((2,), jnp.float32)},
+        "proj": {
+            "q_kernel": jnp.ones((2, 2), jnp.int8),
+            "scale": jnp.ones((2,), jnp.float32),
+        },
+    }
+    out = cast_decode_params(tree)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["already"].dtype == jnp.bfloat16
+    assert out["ids"].dtype == jnp.int32
+    assert out["norm"]["scale"].dtype == jnp.bfloat16
+    assert out["proj"]["q_kernel"].dtype == jnp.int8
+    assert out["proj"]["scale"].dtype == jnp.float32
+    np.testing.assert_array_equal(
+        np.asarray(out["proj"]["scale"]), 1.0
+    )
+
+
+def test_cache_bucket_ladder():
+    from tpufw.workloads.serve import _cache_bucket
+
+    assert _cache_bucket(100, 8192) == 128
+    assert _cache_bucket(129, 8192) == 256
+    assert _cache_bucket(257, 8192) == 512
+    assert _cache_bucket(9000, 8192) == 8192  # capped at model max
+    assert _cache_bucket(1, 64) == 64  # floor still capped

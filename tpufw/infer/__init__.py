@@ -1,4 +1,5 @@
 from tpufw.infer.generate import (  # noqa: F401
+    cast_decode_params,
     generate,
     generate_text,
     pad_prompts,

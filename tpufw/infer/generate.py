@@ -27,6 +27,31 @@ import numpy as np
 from tpufw.infer.sampling import SamplingConfig, sample_token
 
 
+def cast_decode_params(params, dtype=jnp.bfloat16):
+    """Serving-precision cast: float32 weights -> ``dtype``.
+
+    Decode streams every weight once per token, so fp32 ``param_dtype``
+    (the training default — fp32 master weights) DOUBLES the
+    HBM-bandwidth bill of the bandwidth-bound phase for no serving
+    benefit; the matmuls already compute in ``cfg.dtype``. The only
+    leaves kept fp32 are int8 quant scales — identified by their
+    ``q_kernel`` SIBLING, not by name, since flax RMSNorm weights are
+    also called ``scale`` and those SHOULD cast."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            is_quant = "q_kernel" in node
+            return {
+                k: v if (is_quant and k == "scale") else walk(v)
+                for k, v in node.items()
+            }
+        if getattr(node, "dtype", None) == jnp.float32:
+            return node.astype(dtype)
+        return node
+
+    return walk(params)
+
+
 def pad_prompts(
     prompts: Sequence[Sequence[int]], pad_id: int = 0
 ) -> tuple[np.ndarray, np.ndarray]:

@@ -168,6 +168,24 @@ def _bucket(n: int, mult: int) -> int:
     return ((max(n, 1) + mult - 1) // mult) * mult
 
 
+def _pow2_ceil(n: int, floor: int = 1) -> int:
+    """Smallest power of two >= max(n, floor) — the ONE bucketing rung
+    shared by batch-size padding and the KV-cache ladder."""
+    size = floor
+    while size < n:
+        size *= 2
+    return size
+
+
+def _cache_bucket(need: int, cap: int, floor: int = 128) -> int:
+    """Smallest pow-2 KV-cache length >= ``need`` (min ``floor``),
+    capped at the model's ``cap``. Per-step attention/update traffic
+    scales with cache length, so a short chat on a long-context model
+    must not pay the full-cache bill; the pow-2 ladder bounds how many
+    cache shapes the generate jit ever specializes on."""
+    return min(_pow2_ceil(need, floor), cap)
+
+
 def text_codec():
     """(encode, decode) for text prompts, from TPUFW_TOKENIZER.
 
@@ -293,10 +311,7 @@ def _pad_batch(prompts: list[list[int]]) -> tuple[list[list[int]], int]:
     """Pad the batch to a power of two (filler rows = [0]) so the jitted
     generate specializes on few batch shapes. Returns (padded, real_n)."""
     n = len(prompts)
-    size = 1
-    while size < n:
-        size *= 2
-    return prompts + [[0]] * (size - n), n
+    return prompts + [[0]] * (_pow2_ceil(n) - n), n
 
 
 def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
@@ -467,12 +482,57 @@ class _Server:
             self.cfg,
             self.restored,
         ) = build_generator()
+        # Serving-precision cast (TPUFW_DECODE_DTYPE=bfloat16): decode
+        # is HBM-bound and fp32 master weights double the bytes per
+        # token. Off by default — bf16 weights perturb logits, and the
+        # parity tests pin exact fp32 serving.
+        cast = env_str("decode_dtype", "")
+        if cast:
+            import jax.numpy as jnp
+
+            from tpufw.infer import cast_decode_params
+
+            self.params = cast_decode_params(
+                self.params, jnp.dtype(cast)
+            )
         self.default_new = max_new_tokens
         self._eos_id = eos_from_env()
         self._draft = build_draft_generator(self._sampling)
+        if cast and self._draft is not None:
+            # The draft runs k autoregressive steps per tick — its
+            # weight streaming matters as much as the target's.
+            dm, dp, k = self._draft
+            self._draft = (dm, cast_decode_params(dp, jnp.dtype(cast)), k)
         self.port = port
         self._codec = None
         self._batcher = _Batcher(self._run_tick)
+        # KV caches sized to the request, not the model max: a pow-2
+        # ladder of decode-model variants (same params; cfg.max_seq_len
+        # is the CACHE length) — attention/update traffic per step
+        # scales with cache length, and a 256-token chat on an 8k-cache
+        # model would otherwise pay 32x the KV bytes. Masking makes the
+        # result bit-identical (never-written slots carry segment 0),
+        # pinned by tests/test_infer.py.
+        self._cache_variants: dict = {}
+
+    def _model_for(self, longest: int, max_new: int):
+        """Smallest pow-2 cache variant covering this tick (plus the
+        speculative path's k+1 bonus slack), capped at the model max."""
+        import dataclasses
+
+        slack = (self._draft[2] + 1) if self._draft else 0
+        n = _cache_bucket(
+            longest + max_new + slack, self.model.cfg.max_seq_len
+        )
+        if n == self.model.cfg.max_seq_len:
+            return self.model
+        m = self._cache_variants.get(n)
+        if m is None:
+            m = type(self.model)(
+                dataclasses.replace(self.model.cfg, max_seq_len=n)
+            )
+            self._cache_variants[n] = m
+        return m
 
     def codec(self):
         if self._codec is None:
@@ -494,14 +554,24 @@ class _Server:
         longest = _bucket(max(len(p) for p in prompts), 64)
         padded, real_n = _pad_batch(prompts)
         padded = padded + [[0] * longest]  # length-bucket filler row
+        model = self._model_for(longest, max_new)
         if self._draft is not None:
+            import dataclasses
+
             from tpufw.infer import speculative_generate_text
 
             draft_model, draft_params, k = self._draft
+            if model.cfg.max_seq_len != self.model.cfg.max_seq_len:
+                draft_model = type(draft_model)(
+                    dataclasses.replace(
+                        draft_model.cfg,
+                        max_seq_len=model.cfg.max_seq_len,
+                    )
+                )
             outs, _stats = speculative_generate_text(
                 draft_model,
                 draft_params,
-                self.model,
+                model,
                 self.params,
                 padded,
                 max_new_tokens=max_new,
@@ -514,7 +584,7 @@ class _Server:
             )
             return outs[:real_n]
         outs = self._generate_text(
-            self.model,
+            model,
             self.params,
             padded,
             max_new_tokens=max_new,
