@@ -317,16 +317,109 @@ def test_init_params_guards_direct_callers():
             jax.random.key(0), gcfg,
             PipelineConfig(n_stages=2, n_microbatches=2),
         )
-    # Qwen (qkv biases): the blocks carry no bias params, so a direct
-    # caller would silently train a bias-free non-Qwen model.
-    qcfg = dataclasses.replace(
-        LLAMA_CONFIGS["llama3_tiny"], attention_qkv_bias=True
+    # Qwen-MoE (no such stack exists): bias leaves are not in the MoE
+    # layout, so the combination must fail loudly, not drop biases.
+    from tpufw.models import MIXTRAL_CONFIGS
+
+    qmcfg = dataclasses.replace(
+        MIXTRAL_CONFIGS["mixtral_tiny"], attention_qkv_bias=True
     )
     with pytest.raises(NotImplementedError, match="qkv_bias"):
         init_pipeline_params(
-            jax.random.key(0), qcfg,
+            jax.random.key(0), qmcfg,
             PipelineConfig(n_stages=2, n_microbatches=2),
         )
+
+
+def test_qwen_bias_pipeline_matches_sequential(devices8):
+    """Qwen family (qkv biases) through the schedule: nonzero biases
+    must flow into q/k/v identically in the staged and sequential
+    paths, composed with the Megatron head split (bias head axis
+    shards over tensor)."""
+    import dataclasses
+
+    from tpufw.mesh import MeshConfig, build_mesh
+    from tpufw.parallel.pipeline import (
+        init_pipeline_params,
+        pipeline_forward,
+        pipeline_param_shardings,
+        reference_forward,
+    )
+
+    qcfg = dataclasses.replace(CFG, attention_qkv_bias=True)
+    mesh = build_mesh(MeshConfig(data=1, pipe=2, fsdp=2, tensor=2))
+    pipe = PipelineConfig(n_stages=2, n_microbatches=4)
+    params = init_pipeline_params(jax.random.key(0), qcfg, pipe)
+    # Zero-init biases would make this test blind — randomize them.
+    for name in ("bq", "bk", "bv"):
+        params["stages"][name] = 0.1 * jax.random.normal(
+            jax.random.key(hash(name) % 1000),
+            params["stages"][name].shape,
+        )
+    params = jax.device_put(params, pipeline_param_shardings(mesh, params))
+    assert "tensor" in str(params["stages"]["bq"].sharding.spec)
+    tokens = jax.random.randint(
+        jax.random.key(1), (16, 17), 0, qcfg.vocab_size
+    )
+    got = jax.jit(
+        lambda p, t: pipeline_forward(p, t, qcfg, pipe, mesh)
+    )(params, tokens)
+    want = reference_forward(params, tokens, qcfg)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+    )
+    # And biases actually matter: zeroing them changes the logits.
+    zeroed = dict(params)
+    zeroed["stages"] = {
+        **params["stages"],
+        "bq": jnp.zeros_like(params["stages"]["bq"]),
+    }
+    other = jax.jit(
+        lambda p, t: pipeline_forward(p, t, qcfg, pipe, mesh)
+    )(zeroed, tokens)
+    assert not np.allclose(np.asarray(got), np.asarray(other))
+
+
+def test_qwen_bias_1f1b_matches_gpipe(devices8):
+    """The shared-block design must carry the biases into the 1F1B
+    schedule too (grads included, incl. the bias leaves)."""
+    import dataclasses
+
+    from tpufw.mesh import MeshConfig, build_mesh
+    from tpufw.parallel.pipeline import (
+        init_pipeline_params,
+        pipeline_loss,
+        pipeline_param_shardings,
+    )
+    from tpufw.parallel.pipeline_1f1b import pipeline_1f1b_value_and_grad
+
+    qcfg = dataclasses.replace(CFG, attention_qkv_bias=True)
+    mesh = build_mesh(MeshConfig(data=2, pipe=2, fsdp=2))
+    pipe = PipelineConfig(n_stages=2, n_microbatches=4)
+    params = init_pipeline_params(jax.random.key(2), qcfg, pipe)
+    for name in ("bq", "bk", "bv"):
+        params["stages"][name] = 0.1 * jax.random.normal(
+            jax.random.key(hash(name) % 1000),
+            params["stages"][name].shape,
+        )
+    params = jax.device_put(params, pipeline_param_shardings(mesh, params))
+    tokens = jax.random.randint(
+        jax.random.key(3), (16, 17), 0, qcfg.vocab_size
+    )
+    loss_g, grads_g = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: pipeline_loss(p, t, qcfg, pipe, mesh)
+        )
+    )(params, tokens)
+    loss_f, grads_f = jax.jit(
+        lambda p, t: pipeline_1f1b_value_and_grad(p, t, qcfg, pipe, mesh)
+    )(params, tokens)
+    np.testing.assert_allclose(float(loss_f), float(loss_g), rtol=1e-5)
+    for name in ("bq", "bk", "bv"):
+        a = np.asarray(grads_f["stages"][name])
+        b = np.asarray(grads_g["stages"][name])
+        assert np.abs(b).max() > 0  # bias grads are live
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
 
 
 def test_mistral_window_reaches_pipeline_blocks(devices8):

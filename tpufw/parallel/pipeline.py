@@ -115,13 +115,11 @@ def _check_model_split(cfg, n_stages: int) -> None:
     ``init_pipeline_params`` (direct callers) so the two can't drift:
     an unchecked config silently builds a truncated or wrong-family
     model."""
-    if getattr(cfg, "attention_qkv_bias", False):
-        # The functional pipeline blocks carry no bias params; running
-        # a Qwen config here would silently train a bias-free non-Qwen
-        # model (same principle as _reject_moe).
+    if _is_moe(cfg) and getattr(cfg, "attention_qkv_bias", False):
+        # The MoE stage stacks don't carry bias leaves; building this
+        # config would silently drop the biases.
         raise NotImplementedError(
-            "pipeline blocks do not implement attention_qkv_bias "
-            "(Qwen); use the flax Trainer for this family"
+            "pipelined MoE blocks do not implement attention_qkv_bias"
         )
     if cfg.n_layers % n_stages:
         raise ValueError(
@@ -223,21 +221,29 @@ def init_pipeline_params(
             "head": w(mkeys[1], (d, cfg.vocab_size), d),
         }
 
+    stages = {
+        "attn_norm": jnp.ones((s, lps, d), jnp.float32),
+        "wq": w(keys[1], (s, lps, d, h, dh), d),
+        "wk": w(keys[2], (s, lps, d, kh, dh), d),
+        "wv": w(keys[3], (s, lps, d, kh, dh), d),
+        "wo": w(keys[4], (s, lps, h, dh, d), h * dh),
+        "mlp_norm": jnp.ones((s, lps, d), jnp.float32),
+        "w_gate": w(keys[5], (s, lps, d, f), d),
+        "w_up": w(keys[6], (s, lps, d, f), d),
+        "w_down": w(keys[7], (s, lps, f, d), f),
+    }
+    if getattr(cfg, "attention_qkv_bias", False):
+        # Qwen-2 family: zero-init biases on q/k/v only (o and the MLP
+        # stay bias-free), mirroring the flax Attention's projection
+        # use_bias — tpufw/models/llama.py Attention.__call__.
+        stages["bq"] = jnp.zeros((s, lps, h, dh), jnp.float32)
+        stages["bk"] = jnp.zeros((s, lps, kh, dh), jnp.float32)
+        stages["bv"] = jnp.zeros((s, lps, kh, dh), jnp.float32)
     return {
         "embed": jax.random.normal(
             keys[0], (cfg.vocab_size, d), jnp.float32
         ).astype(cfg.param_dtype),
-        "stages": {
-            "attn_norm": jnp.ones((s, lps, d), jnp.float32),
-            "wq": w(keys[1], (s, lps, d, h, dh), d),
-            "wk": w(keys[2], (s, lps, d, kh, dh), d),
-            "wv": w(keys[3], (s, lps, d, kh, dh), d),
-            "wo": w(keys[4], (s, lps, h, dh, d), h * dh),
-            "mlp_norm": jnp.ones((s, lps, d), jnp.float32),
-            "w_gate": w(keys[5], (s, lps, d, f), d),
-            "w_up": w(keys[6], (s, lps, d, f), d),
-            "w_down": w(keys[7], (s, lps, f, d), f),
-        },
+        "stages": stages,
         "final_norm": jnp.ones((d,), jnp.float32),
         "head": w(keys[8], (d, cfg.vocab_size), d),
     }
@@ -254,6 +260,7 @@ def init_pipeline_params(
 _TENSOR_LEAF_AXIS = {
     "wq": -2, "wk": -2, "wv": -2,  # [..., d, H, dh] -> head axis
     "wo": -3,                      # [..., H, dh, d] -> head axis
+    "bq": -2, "bk": -2, "bv": -2,  # [..., H, dh] -> head axis (Qwen)
     "w_gate": -1, "w_up": -1,      # [..., d, f] -> ffn columns
     "w_down": -2,                  # [..., f, d] -> ffn rows
 }
@@ -346,6 +353,10 @@ def _attn_sublayer(
     q = jnp.einsum("btd,dhk->bthk", h, p["wq"].astype(dt))
     k = jnp.einsum("btd,dhk->bthk", h, p["wk"].astype(dt))
     v = jnp.einsum("btd,dhk->bthk", h, p["wv"].astype(dt))
+    if "bq" in p:  # Qwen qkv biases: added pre-RoPE, like the flax path
+        q = q + p["bq"].astype(dt)
+        k = k + p["bk"].astype(dt)
+        v = v + p["bv"].astype(dt)
     rs = getattr(cfg, "rope_scaling", None)
     q = apply_rope(q, positions, cfg.rope_theta, rs)
     k = apply_rope(k, positions, cfg.rope_theta, rs)

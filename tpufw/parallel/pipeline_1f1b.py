@@ -42,9 +42,10 @@ grads) replicated. Vocab-sharding both params and accumulators (with a
 psum_scatter epilogue) is the next step if those buffers ever dominate;
 it applies to the two schedules equally.
 
-Scope: Llama-family blocks (the flagship), composed with data/fsdp
-batch sharding and Megatron tensor parallelism. Gemma pairs and MoE
-are rejected loudly (GPipe supports them; extend here the same way).
+Scope: Llama-family blocks incl. Qwen qkv biases (the shared _block
+carries them), composed with data/fsdp batch sharding and Megatron
+tensor parallelism. Gemma pairs and MoE are rejected loudly (GPipe
+supports them; extend here the same way).
 """
 
 from __future__ import annotations
@@ -170,7 +171,6 @@ def _epilogue_loss(
     """final RMSNorm -> LM head -> SUM token CE for one microbatch.
     Returns the unnormalized sum (token normalization happens once,
     globally, after the schedule)."""
-    from tpufw.ops import rms_norm
     from tpufw.ops.loss import token_cross_entropy
 
     h = rms_norm(hidden, head_leaves["final_norm"], cfg.rms_eps)

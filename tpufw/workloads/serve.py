@@ -307,6 +307,20 @@ def _restore_bare_params(model_cfg, params_dir: str):
     return params
 
 
+def _maybe_cast_decode(params):
+    """Apply the TPUFW_DECODE_DTYPE serving-precision cast (e.g.
+    ``bfloat16``; see tpufw.infer.cast_decode_params) if set — ONE
+    knob for both the HTTP server and batch mode."""
+    cast = env_str("decode_dtype", "")
+    if not cast:
+        return params
+    import jax.numpy as jnp
+
+    from tpufw.infer import cast_decode_params
+
+    return cast_decode_params(params, jnp.dtype(cast))
+
+
 def _pad_batch(prompts: list[list[int]]) -> tuple[list[list[int]], int]:
     """Pad the batch to a power of two (filler rows = [0]) so the jitted
     generate specializes on few batch shapes. Returns (padded, real_n)."""
@@ -318,11 +332,13 @@ def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
     from tpufw.infer import generate_text, speculative_generate_text
 
     decode_model, params, cfg, restored = build_generator()
+    params = _maybe_cast_decode(params)
     sampling = sampling_from_env()  # default greedy: deterministic
     draft = build_draft_generator(sampling)
     padded, real_n = _pad_batch(prompts)
     if draft is not None:
         draft_model, draft_params, k = draft
+        draft_params = _maybe_cast_decode(draft_params)
         outs, _stats = speculative_generate_text(
             draft_model,
             draft_params,
@@ -485,39 +501,30 @@ class _Server:
         # Serving-precision cast (TPUFW_DECODE_DTYPE=bfloat16): decode
         # is HBM-bound and fp32 master weights double the bytes per
         # token. Off by default — bf16 weights perturb logits, and the
-        # parity tests pin exact fp32 serving.
-        cast = env_str("decode_dtype", "")
-        if cast:
-            import jax.numpy as jnp
-
-            from tpufw.infer import cast_decode_params
-
-            self.params = cast_decode_params(
-                self.params, jnp.dtype(cast)
-            )
+        # parity tests pin exact fp32 serving. The draft's weight
+        # streaming (k autoregressive steps per tick) matters as much
+        # as the target's, so it casts too.
+        self.params = _maybe_cast_decode(self.params)
         self.default_new = max_new_tokens
         self._eos_id = eos_from_env()
         self._draft = build_draft_generator(self._sampling)
-        if cast and self._draft is not None:
-            # The draft runs k autoregressive steps per tick — its
-            # weight streaming matters as much as the target's.
+        if self._draft is not None:
             dm, dp, k = self._draft
-            self._draft = (dm, cast_decode_params(dp, jnp.dtype(cast)), k)
+            self._draft = (dm, _maybe_cast_decode(dp), k)
         self.port = port
         self._codec = None
         self._batcher = _Batcher(self._run_tick)
-        # KV caches sized to the request, not the model max: a pow-2
-        # ladder of decode-model variants (same params; cfg.max_seq_len
-        # is the CACHE length) — attention/update traffic per step
-        # scales with cache length, and a 256-token chat on an 8k-cache
-        # model would otherwise pay 32x the KV bytes. Masking makes the
-        # result bit-identical (never-written slots carry segment 0),
-        # pinned by tests/test_infer.py.
-        self._cache_variants: dict = {}
 
     def _model_for(self, longest: int, max_new: int):
-        """Smallest pow-2 cache variant covering this tick (plus the
-        speculative path's k+1 bonus slack), capped at the model max."""
+        """KV cache sized to the request, not the model max: the
+        smallest pow-2 cache variant covering this tick (plus the
+        speculative path's k+1 bonus slack), capped at the model max.
+        Attention/update traffic per step scales with cache length —
+        a 256-token chat on an 8k-cache model would otherwise pay 32x
+        the KV bytes; masking makes the result bit-identical
+        (never-written slots carry segment 0, tests/test_infer.py).
+        Variants are built inline: flax modules hash structurally, so
+        equal configs hit the generate jit cache without memoization."""
         import dataclasses
 
         slack = (self._draft[2] + 1) if self._draft else 0
@@ -526,13 +533,9 @@ class _Server:
         )
         if n == self.model.cfg.max_seq_len:
             return self.model
-        m = self._cache_variants.get(n)
-        if m is None:
-            m = type(self.model)(
-                dataclasses.replace(self.model.cfg, max_seq_len=n)
-            )
-            self._cache_variants[n] = m
-        return m
+        return type(self.model)(
+            dataclasses.replace(self.model.cfg, max_seq_len=n)
+        )
 
     def codec(self):
         if self._codec is None:
