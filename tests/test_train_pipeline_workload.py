@@ -91,3 +91,36 @@ def test_manifest_env_builds(monkeypatch, devices8):
     trainer, _ = build_trainer()
     assert trainer.pipe.n_stages == 2
     assert trainer.cfg.checkpoint_dir == "/checkpoints/llama3-8b-pipeline"
+
+
+def test_schedule_and_moe_mesh_from_env(monkeypatch, devices8):
+    """TPUFW_PIPE_SCHEDULE selects 1f1b; TPUFW_MESH_EXPERT/TENSOR reach
+    the mesh (pp x ep / pp x tp from a manifest, not just the API)."""
+    _clear(monkeypatch)
+    monkeypatch.setenv("TPUFW_PIPE_STAGES", "2")
+    monkeypatch.setenv("TPUFW_PIPE_SCHEDULE", "1f1b")
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_BATCH_SIZE", "16")
+    monkeypatch.setenv("TPUFW_SEQ_LEN", "33")
+    monkeypatch.setenv("TPUFW_MESH_TENSOR", "2")
+    trainer, _ = build_trainer()
+    assert trainer.pipe.schedule == "1f1b"
+    assert dict(trainer.mesh.shape)["tensor"] == 2
+
+    _clear(monkeypatch)
+    monkeypatch.setenv("TPUFW_PIPE_STAGES", "2")
+    monkeypatch.setenv("TPUFW_MODEL", "mixtral_tiny")
+    monkeypatch.setenv("TPUFW_BATCH_SIZE", "16")
+    monkeypatch.setenv("TPUFW_SEQ_LEN", "33")
+    monkeypatch.setenv("TPUFW_MESH_EXPERT", "2")
+    mtrainer, mcfg = build_trainer()
+    assert mcfg.n_experts == 4
+    assert dict(mtrainer.mesh.shape)["expert"] == 2
+
+    _clear(monkeypatch)
+    monkeypatch.setenv("TPUFW_PIPE_STAGES", "2")
+    monkeypatch.setenv("TPUFW_PIPE_SCHEDULE", "interleaved")
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_BATCH_SIZE", "16")
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        build_trainer()

@@ -26,7 +26,8 @@ Schema (all sections optional except ``model``)::
       overrides: {attention_backend: flash}   # dataclasses.replace fields
     trainer:  {batch_size: 32, seq_len: 2048, ...}   # TrainerConfig fields
     mesh:     {fsdp: 16}                             # MeshConfig fields
-    pipeline: {n_stages: 2, n_microbatches: 4}       # PipelineConfig
+    pipeline: {n_stages: 2, n_microbatches: 4,       # PipelineConfig
+               schedule: gpipe}  # or 1f1b (O(stages) activation memory)
                                  # (sizes mesh.pipe; train_pipeline runs)
 
 Unknown keys anywhere are hard errors — config drift should fail loudly at
@@ -290,4 +291,6 @@ def to_env(run: RunConfig, *, defaults_too: bool = False) -> dict[str, str]:
     if run.pipeline is not None:
         env["TPUFW_PIPE_STAGES"] = str(run.pipeline.n_stages)
         env["TPUFW_PIPE_MICROBATCHES"] = str(run.pipeline.n_microbatches)
+        if run.pipeline.schedule != "gpipe":
+            env["TPUFW_PIPE_SCHEDULE"] = run.pipeline.schedule
     return env

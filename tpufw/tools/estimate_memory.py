@@ -204,7 +204,10 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, required=True)
     ap.add_argument("--seq", type=int, default=None, help="train seq len")
     ap.add_argument("--fsdp", type=int, default=1, help="param shards")
-    ap.add_argument("--remat", default=None, help="dots|nothing|everything")
+    ap.add_argument(
+        "--remat", default=None,
+        choices=["dots", "nothing", "everything"],
+    )
     ap.add_argument("--ce-chunk", type=int, default=None)
     ap.add_argument("--adam-mu-dtype", default=None)
     ap.add_argument(
@@ -216,7 +219,6 @@ def main(argv=None) -> int:
         "--decode-dtype", default=None,
         help="weights dtype at decode (TPUFW_DECODE_DTYPE)",
     )
-    chip_choices = None  # filled after import below
     ap.add_argument(
         "--chip", default="v5e",
         help="chip spec to compare against (static table; 'auto' "

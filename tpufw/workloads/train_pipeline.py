@@ -1,4 +1,4 @@
-"""Pipeline-parallel LM training workload (GPipe over the ``pipe`` axis).
+"""Pipeline-parallel LM training workload over the ``pipe`` axis.
 
 The deploy-facing entry for tpufw.train.PipelineTrainer: same JSON-lines
 metrics channel as train_llama (``kubectl logs`` is the telemetry
@@ -7,8 +7,11 @@ reference README.md:331-335), driven by TPUFW_* env:
 
   TPUFW_PIPE_STAGES (required, >1)   pipeline stages == mesh pipe size
   TPUFW_PIPE_MICROBATCHES (default 2*stages)
+  TPUFW_PIPE_SCHEDULE                gpipe (default) | 1f1b
   TPUFW_MODEL / TPUFW_BATCH_SIZE / TPUFW_SEQ_LEN / ... (as train_llama)
   TPUFW_MESH_DATA / TPUFW_MESH_FSDP  data-parallel axes alongside pipe
+  TPUFW_MESH_TENSOR / TPUFW_MESH_EXPERT  in-stage Megatron split /
+                                     pipelined-MoE expert sharding
 
 Data: synthetic batches; TPUFW_EVAL_EVERY > 0 adds the in-loop
 held-out eval (forward-only pipeline, token-weighted loss/ppl JSON
@@ -40,6 +43,8 @@ def build_trainer():
             f"TPUFW_PIPE_STAGES={stages}: pipeline training needs >= 2 "
             "stages (use tpufw.workloads.train_llama for pipe=1)"
         )
+    from tpufw.models import MIXTRAL_CONFIGS
+
     name = env_str("model", "llama3_600m_bench")
     if name == "llama3_600m_bench":
         model_cfg = bench_model_config()
@@ -47,14 +52,20 @@ def build_trainer():
         model_cfg = LLAMA_CONFIGS[name]
     elif name in GEMMA_CONFIGS:
         model_cfg = GEMMA_CONFIGS[name]
+    elif name in MIXTRAL_CONFIGS:
+        # Pipelined MoE: expert stacks shard over `expert` inside the
+        # GPipe stages (pp x ep — tpufw.parallel.pipeline._moe_mlp).
+        model_cfg = MIXTRAL_CONFIGS[name]
     else:
         raise ValueError(
             f"unknown TPUFW_MODEL={name!r} for pipeline training; choose "
-            f"from {['llama3_600m_bench', *LLAMA_CONFIGS, *GEMMA_CONFIGS]}"
+            f"from {['llama3_600m_bench', *LLAMA_CONFIGS, *GEMMA_CONFIGS, *MIXTRAL_CONFIGS]}"
         )
     pipe = PipelineConfig(
         n_stages=stages,
         n_microbatches=env_int("pipe_microbatches", 2 * stages),
+        # "gpipe" (default) or "1f1b" (O(stages) activation memory).
+        schedule=env_str("pipe_schedule", "gpipe"),
     )
     trainer_cfg = TrainerConfig(
         batch_size=env_int("batch_size", 8),
@@ -85,6 +96,8 @@ def build_trainer():
         data=env_int("mesh_data", 1),
         pipe=stages,
         fsdp=env_int("mesh_fsdp", -1),
+        tensor=env_int("mesh_tensor", 1),
+        expert=env_int("mesh_expert", 1),
     )
     return PipelineTrainer(model_cfg, pipe, trainer_cfg, mesh_cfg), model_cfg
 
