@@ -158,13 +158,16 @@ def test_export_guards():
         hf_config_dict(bad_head)
 
 
-def test_pipeline_rejects_qkv_bias():
+def test_pipeline_accepts_dense_qkv_bias():
+    """Dense Qwen-style qkv-bias configs are pipeline-schedulable (both
+    schedules carry the biases; parity pinned in tests/test_pipeline.py
+    and test_pipeline_1f1b.py). Only the MoE+bias combination is still
+    rejected (tests/test_pipeline.py::test_init_params_guards_direct_callers)."""
     from tpufw.parallel.pipeline import PipelineConfig
 
-    with pytest.raises(NotImplementedError, match="qkv_bias"):
-        PipelineConfig(n_stages=2, n_microbatches=2).validate(
-            dataclasses.replace(TINY, n_layers=4), 4
-        )
+    PipelineConfig(n_stages=2, n_microbatches=2).validate(
+        dataclasses.replace(TINY, n_layers=4), 4
+    )
 
 
 def test_export_bias_plus_window_is_loud():
