@@ -51,6 +51,41 @@ def test_tiny_forward_and_bn_updates():
     assert eval_logits.shape == (2, 10)
 
 
+def test_bf16_batchnorm_matches_f32():
+    """norm_dtype=bf16 is the bench/workload default on TPU (the early
+    stages are bandwidth-bound; f32 BN doubles their HBM traffic). It
+    must be a *numerics* no-op at bf16 tolerance: flax reduces BN
+    mean/var in f32 regardless of dtype, so only the normalize/scale
+    arithmetic is low-precision."""
+    from tpufw.models import ResNet, ResNetConfig
+
+    imgs = jax.random.normal(jax.random.key(0), (4, 32, 32, 3))
+    cfg32 = ResNetConfig(num_classes=10, stage_sizes=(1, 1), width=8)
+    cfg16 = ResNetConfig(
+        num_classes=10, stage_sizes=(1, 1), width=8,
+        norm_dtype=jnp.bfloat16,
+    )
+    variables = ResNet(cfg32).init(jax.random.key(1), imgs, train=True)
+
+    out32, mut32 = ResNet(cfg32).apply(
+        variables, imgs, train=True, mutable=["batch_stats"]
+    )
+    out16, mut16 = ResNet(cfg16).apply(
+        variables, imgs, train=True, mutable=["batch_stats"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(out32), np.asarray(out16), rtol=0.1, atol=0.15
+    )
+    # Running statistics are identical (f32 reduction path in both).
+    for a, b in zip(
+        jax.tree.leaves(mut32["batch_stats"]),
+        jax.tree.leaves(mut16["batch_stats"]),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-2
+        )
+
+
 def test_vision_trainer_end_to_end(devices8):
     from tpufw.mesh import MeshConfig
     from tpufw.train import VisionTrainer, VisionTrainerConfig, synthetic_images

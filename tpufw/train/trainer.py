@@ -778,7 +778,7 @@ class Trainer:
                             or i + 1 == remaining
                         )
                         if sync:
-                            loss = jax.block_until_ready(m["loss"])
+                            loss = m["loss"]  # Meter.stop float()s it: the barrier
                     prof.maybe_stop(i)
                     if not sync:
                         continue
@@ -805,7 +805,7 @@ class Trainer:
                 # Iterator exhausted mid-window: flush the open window
                 # so every executed step is metered and checkpointable.
                 if window_n:
-                    loss = jax.block_until_ready(m["loss"])
+                    loss = m["loss"]  # Meter.stop float()s it: the barrier
                     sm = meter.stop(
                         py_step, loss,
                         data_wait_s=window_wait, n_steps=window_n,

@@ -28,6 +28,11 @@ def main() -> int:
         synthetic_images,
     )
 
+    # BatchNorm compute dtype (stats stay f32 either way). bfloat16 is
+    # the TPU-first default: the early high-resolution stages are
+    # HBM-bandwidth-bound and f32 BN doubles their activation traffic
+    # (measured on v5e: 1906 -> 2524 img/s at batch 256).
+    norm_dtype = env_str("norm_dtype", "bfloat16")
     cfg = VisionTrainerConfig(
         batch_size=env_int("batch_size", 256),
         image_size=env_int("image_size", 224),
@@ -42,7 +47,12 @@ def main() -> int:
         f"tpufw train_resnet: process {cluster.process_id}/"
         f"{cluster.num_processes} devices={jax.devices()}"
     )
-    trainer = VisionTrainer(resnet50(cfg.num_classes), cfg)
+    import jax.numpy as jnp
+
+    trainer = VisionTrainer(
+        resnet50(cfg.num_classes, norm_dtype=getattr(jnp, norm_dtype)),
+        cfg,
+    )
     if trainer.maybe_restore():
         print(f"resumed from checkpoint at step {int(trainer.state.step)}")
     else:
