@@ -54,6 +54,41 @@ def test_segment_ids_block_cross_attention(tiny_params):
     )
 
 
+def test_attn_out_remat_policy_matches_nothing():
+    """remat_policy="attn_out" (save only the tagged flash outputs, so
+    backward skips re-running the attention kernel) must be a numerics
+    no-op vs full remat — same loss, same grads."""
+    import dataclasses
+
+    tokens = jax.random.randint(
+        jax.random.key(5), (2, 16), 0, TINY.vocab_size
+    )
+
+    def loss_for(policy):
+        cfg = dataclasses.replace(
+            TINY, remat=True, remat_policy=policy, scan_layers=True
+        )
+        model = Llama(cfg)
+        params = model.init(jax.random.key(0), tokens)
+
+        def loss(p):
+            logits = model.apply(p, tokens)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+        l, g = jax.value_and_grad(loss)(params)
+        return l, g
+
+    l_nothing, g_nothing = loss_for("nothing")
+    l_attn, g_attn = loss_for("attn_out")
+    np.testing.assert_allclose(
+        np.asarray(l_nothing), np.asarray(l_attn), rtol=1e-6
+    )
+    for a, b in zip(jax.tree.leaves(g_nothing), jax.tree.leaves(g_attn)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
 def test_param_count_matches_analytic(tiny_params):
     actual = sum(
         x.size for x in jax.tree.leaves(tiny_params, is_leaf=lambda x: hasattr(x, "size"))

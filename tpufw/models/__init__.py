@@ -16,6 +16,12 @@ from tpufw.models.mixtral import (  # noqa: F401
     MoEMLP,
 )
 from tpufw.models.resnet import ResNet, ResNetConfig, resnet50  # noqa: F401
+from tpufw.models.vit import (  # noqa: F401
+    VIT_CONFIGS,
+    ViT,
+    ViTConfig,
+    vit_b16,
+)
 from tpufw.models.lora import (  # noqa: F401
     has_lora,
     lora_mask,

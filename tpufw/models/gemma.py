@@ -27,6 +27,7 @@ import dataclasses
 from typing import Any, Optional
 
 import jax.numpy as jnp
+from jax import ad_checkpoint
 from flax import linen as nn
 
 from tpufw.models.llama import (
@@ -140,6 +141,8 @@ class GemmaBlock(nn.Module):
         a = Attention(cfg, window=self.window, name="attn")(
             norm("pre_attn_norm")(x), positions, segment_ids
         )
+        # Tag for remat_policy="attn_out" (no-op under other policies).
+        a = ad_checkpoint.checkpoint_name(a, "attn_out")
         x = x + norm("post_attn_norm")(a)
         m = MLP(cfg, name="mlp")(norm("pre_mlp_norm")(x))
         x = x + norm("post_mlp_norm")(m)

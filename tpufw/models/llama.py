@@ -26,6 +26,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import ad_checkpoint
 from flax import linen as nn
 
 from tpufw.ops import multi_head_attention, rms_norm
@@ -40,6 +41,13 @@ _REMAT_POLICIES = {
     "dots": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
     "nothing": jax.checkpoint_policies.nothing_saveable,
     "everything": jax.checkpoint_policies.everything_saveable,
+    # Save ONLY each block's attention output ([B, T, D] per layer — the
+    # small tensor), recomputing everything else like "nothing" does.
+    # Backward then skips re-running the flash kernel (the one fwd op
+    # XLA can't fuse into its neighbours) at a memory cost of
+    # n_layers * B*T*D*2 bytes, while the [B, T, d_ff] MLP
+    # intermediates that make "dots" OOM still rematerialize.
+    "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out"),
 }
 
 
@@ -621,11 +629,13 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
         cfg = self.cfg
-        x = x + Attention(
+        attn_out = Attention(
             cfg, window=getattr(cfg, "sliding_window", None), name="attn"
         )(
             RMSNorm(cfg.rms_eps, name="attn_norm")(x), positions, segment_ids
         )
+        # Tag for remat_policy="attn_out" (no-op under other policies).
+        x = x + ad_checkpoint.checkpoint_name(attn_out, "attn_out")
         x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.rms_eps, name="mlp_norm")(x))
         return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
 

@@ -17,6 +17,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax import ad_checkpoint
 from flax import linen as nn
 
 from tpufw.models.llama import (
@@ -265,11 +266,13 @@ class MixtralBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
         cfg = self.cfg
-        x = x + Attention(
+        attn_out = Attention(
             cfg, window=getattr(cfg, "sliding_window", None), name="attn"
         )(
             RMSNorm(cfg.rms_eps, name="attn_norm")(x), positions, segment_ids
         )
+        # Tag for remat_policy="attn_out" (no-op under other policies).
+        x = x + ad_checkpoint.checkpoint_name(attn_out, "attn_out")
         y, aux = MoEMLP(cfg, name="moe")(
             RMSNorm(cfg.rms_eps, name="moe_norm")(x),
             valid=None if segment_ids is None else segment_ids > 0,

@@ -1,0 +1,252 @@
+"""Vision Transformer (ViT), TPU-first — the MXU-native vision family.
+
+The reference ships no ML workloads at all (its proof is ``nvidia-smi``,
+reference ``README.md:303-335``); ResNet-50 covers BASELINE config 2's
+conv path, and ViT extends the vision zoo with the architecture TPUs
+are actually built for: patchify turns the image into a short token
+sequence and EVERYTHING downstream is a large batched matmul. Measured
+motivation: ResNet's strided-conv backward holds it to ~16% MFU on v5e
+(docs/PERF.md) while transformer blocks of the same FLOP budget run at
+40%+ on the same chip.
+
+TPU-first choices, mirroring the LM trunk (tpufw.models.llama):
+- patch embedding as reshape + one [P*P*3, D] matmul (NOT a conv — the
+  identical computation, but it lowers to a plain MXU GEMM with no
+  im2col window machinery);
+- bf16 activations / f32 params, f32 LayerNorm arithmetic;
+- logical axis names shared with the LM families ("embed", "mlp",
+  "q_heads", "kv") so `tpufw.mesh.logical_axis_rules` shards it for
+  fsdp/tensor with zero model edits;
+- `nn.scan` over blocks + optional remat, same knobs as LlamaConfig;
+- attention is plain bidirectional softmax(QK^T)V via einsum: at ViT
+  sequence lengths (197 tokens for 224px/16) the score matrix is tiny
+  and XLA fuses it; the flash kernel's tiling would only add overhead.
+
+Works with the shared ``VisionTrainer`` (images/labels batches, MFU
+metering, checkpoint/preemption) — ViT simply has no batch_stats.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    # "cls" = classify from the [CLS] token (canonical ViT);
+    # "mean" = mean-pool patch tokens (no extra token).
+    pool: str = "cls"
+    remat: bool = False
+    scan_layers: bool = True
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by "
+                f"patch_size {self.patch_size}"
+            )
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must divide into n_heads")
+        if self.pool not in ("cls", "mean"):
+            raise ValueError(f"pool must be 'cls'|'mean', got {self.pool!r}")
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.n_patches + (1 if self.pool == "cls" else 0)
+
+    def n_params(self) -> int:
+        d, l, f = self.d_model, self.n_layers, self.d_ff
+        patch = (self.patch_size**2 * 3) * d + d
+        pos = self.seq_len * d + (d if self.pool == "cls" else 0)
+        attn = l * (4 * d * d + 4 * d)  # qkvo kernels + biases
+        mlp = l * (2 * d * f + f + d)
+        norms = l * 2 * 2 * d + 2 * d  # 2 LN/block + final, scale+bias
+        head = d * self.num_classes + self.num_classes
+        return patch + pos + attn + mlp + norms + head
+
+    def flops_per_image(self, image_size: Optional[int] = None) -> float:
+        """Training FLOPs per image: 3x (fwd + bwd@2x) the forward
+        matmul FLOPs (2 per MAC). Covers patchify, per-token block
+        matmuls, the bidirectional QK^T/AV score matmuls (t keys per
+        query — no causal halving), and the head."""
+        del image_size  # signature-compatible with ResNetConfig
+        d, l, t, f = self.d_model, self.n_layers, self.seq_len, self.d_ff
+        macs = (
+            self.n_patches * (self.patch_size**2 * 3 * d)  # patchify
+            + l * t * (4 * d * d + 2 * d * f)  # qkvo + MLP
+            + 2 * l * t * t * d  # QK^T and AV
+            + d * self.num_classes  # head (pooled: one token)
+        )
+        return 3.0 * 2.0 * macs
+
+
+class ViTBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda feat, axes, name: nn.Dense(  # noqa: E731
+            feat,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(), axes
+            ),
+            name=name,
+        )
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            dtype=jnp.float32, param_dtype=cfg.param_dtype, name=name
+        )
+        d, h = cfg.d_model, cfg.n_heads
+        hd = d // h
+
+        # --- bidirectional self-attention ---
+        y = ln("attn_norm")(x).astype(cfg.dtype)
+        q = dense(d, ("embed", "q_heads"), "q")(y)
+        k = dense(d, ("embed", "kv"), "k")(y)
+        v = dense(d, ("embed", "kv"), "v")(y)
+        b, t = y.shape[0], y.shape[1]
+        q = q.reshape(b, t, h, hd)
+        k = k.reshape(b, t, h, hd)
+        v = v.reshape(b, t, h, hd)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * (hd**-0.5)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, d)
+        x = x + dense(d, ("q_heads", "embed"), "o")(o)
+
+        # --- MLP ---
+        y = ln("mlp_norm")(x).astype(cfg.dtype)
+        y = dense(cfg.d_ff, ("embed", "mlp"), "up")(y)
+        y = nn.gelu(y, approximate=True)
+        x = x + dense(d, ("mlp", "embed"), "down")(y)
+        return nn.with_logical_constraint(
+            x, ("batch", "act_seq", "act_embed")
+        )
+
+
+class ViT(nn.Module):
+    """ViT classifier. Input NHWC float images, returns [B, num_classes]
+    (f32). ``train`` is accepted for VisionTrainer signature parity; the
+    model is deterministic either way (no dropout, no batch stats)."""
+
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = False):
+        del train
+        cfg = self.cfg
+        b = images.shape[0]
+        p, g = cfg.patch_size, cfg.image_size // cfg.patch_size
+        x = images.astype(cfg.dtype)
+        # Patchify as reshape->transpose->matmul: [B,H,W,C] ->
+        # [B, g*g, p*p*C] @ [p*p*C, D]. Identical math to a stride-p
+        # conv, but lowers to one clean MXU GEMM.
+        x = x.reshape(b, g, p, g, p, 3)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, g * g, p * p * 3)
+        x = nn.Dense(
+            cfg.d_model,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.xavier_uniform(), ("patch_in", "embed")
+            ),
+            name="patch_embed",
+        )(x)
+        if cfg.pool == "cls":
+            cls = self.param(
+                "cls_token",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), (None, None, "embed")
+                ),
+                (1, 1, cfg.d_model),
+                cfg.param_dtype,
+            )
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (b, 1, cfg.d_model)).astype(x.dtype), x],
+                axis=1,
+            )
+        pos = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (None, "act_seq", "embed")
+            ),
+            (1, cfg.seq_len, cfg.d_model),
+            cfg.param_dtype,
+        )
+        x = x + pos.astype(x.dtype)
+        x = nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+
+        block_cls = ViTBlock
+        if cfg.remat:
+            block_cls = nn.remat(
+                block_cls,
+                policy=jax.checkpoint_policies.nothing_saveable,
+                prevent_cse=not cfg.scan_layers,
+            )
+        if cfg.scan_layers:
+
+            def body(mdl, carry, _):
+                return mdl(carry), None
+
+            x, _ = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block_cls(cfg, name="blocks"), x, None)
+        else:
+            for i in range(cfg.n_layers):
+                x = block_cls(cfg, name=f"block{i}")(x)
+
+        x = nn.LayerNorm(
+            dtype=jnp.float32, param_dtype=cfg.param_dtype, name="final_norm"
+        )(x)
+        pooled = x[:, 0] if cfg.pool == "cls" else jnp.mean(x, axis=1)
+        return nn.Dense(
+            cfg.num_classes,
+            dtype=jnp.float32,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("embed", "vocab")
+            ),
+            name="head",
+        )(pooled)
+
+
+VIT_CONFIGS: dict[str, ViTConfig] = {
+    "vit_b16": ViTConfig(),  # ViT-Base/16: 86M params
+    "vit_l16": ViTConfig(
+        d_model=1024, n_layers=24, n_heads=16, d_ff=4096
+    ),  # ViT-Large/16: 304M
+    "vit_s16": ViTConfig(
+        d_model=384, n_layers=12, n_heads=6, d_ff=1536
+    ),  # ViT-Small/16: 22M
+}
+
+
+def vit_b16(num_classes: int = 1000, **kw) -> ViT:
+    return ViT(ViTConfig(num_classes=num_classes, **kw))

@@ -126,12 +126,22 @@ def estimate_train(
     ) * a_bytes
     if policy == "nothing":
         live = per_layer_dots  # one block recomputed at a time
+    elif policy == "attn_out":
+        # "nothing" plus one saved [rows, T, D] attention output per
+        # layer (tpufw.models.llama _REMAT_POLICIES).
+        live = per_layer_dots + l * g_tokens * d * a_bytes
     elif policy == "dots":
         live = l * per_layer_dots
-    else:  # "everything": attention internals too (scores dominate)
+    elif policy == "everything":
+        # Attention internals too (scores dominate).
         live = l * (
             per_layer_dots
             + rows * cfg.n_heads * t * t * a_bytes
+        )
+    else:
+        raise ValueError(
+            f"unknown remat_policy {policy!r}; choose from "
+            "dots|nothing|attn_out|everything"
         )
     activations = boundary + live
 

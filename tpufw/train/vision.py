@@ -47,7 +47,8 @@ def vision_train_step(state: VisionTrainState, batch: dict):
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, batch["labels"]
         ).mean()
-        return loss, (logits, mutated["batch_stats"])
+        # Stat-free models (ViT) mutate nothing: keep the empty tree.
+        return loss, (logits, mutated.get("batch_stats", state.batch_stats))
 
     (loss, (logits, new_stats)), grads = jax.value_and_grad(
         loss_fn, has_aux=True
@@ -138,7 +139,8 @@ class VisionTrainer:
             return VisionTrainState(
                 step=jnp.zeros((), jnp.int32),
                 params=variables["params"],
-                batch_stats=variables["batch_stats"],
+                # BN-free models (ViT) simply carry an empty tree here.
+                batch_stats=variables.get("batch_stats", {}),
                 opt_state=self.tx.init(variables["params"]),
                 apply_fn=self.model.apply,
                 tx=self.tx,
@@ -260,7 +262,7 @@ class VisionTrainer:
                         or i + 1 == remaining
                     ):
                         continue
-                    loss = jax.block_until_ready(m["loss"])
+                    loss = m["loss"]  # Meter.stop float()s it: the barrier
                     sm = meter.stop(
                         py_step, loss,
                         data_wait_s=window_wait, n_steps=window_n,
@@ -279,7 +281,7 @@ class VisionTrainer:
                         break
                 # Iterator exhausted mid-window: flush the open window.
                 if window_n:
-                    loss = jax.block_until_ready(m["loss"])
+                    loss = m["loss"]  # Meter.stop float()s it: the barrier
                     sm = meter.stop(
                         py_step, loss,
                         data_wait_s=window_wait, n_steps=window_n,
