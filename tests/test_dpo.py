@@ -1,0 +1,264 @@
+"""DPO: pair encoding, per-row chunked logprobs, and the preference step.
+
+Anchor invariants: at step 0 with ref == policy every reward is exactly
+0 — loss == log 2, accuracy == 0.5 (both forwards are the same compiled
+function on identical weights, so this is EXACT, not approximate) — and
+a few steps on one fixed batch must push chosen above rejected.
+
+Batch layout under test is the INTERLEAVED one (row 2i chosen, row
+2i+1 rejected): position-local pairing is what keeps multi-process
+block concatenation pair-aligned.
+"""
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.mesh import MeshConfig
+from tpufw.models import Llama, LLAMA_CONFIGS
+from tpufw.train import TrainerConfig
+from tpufw.train.dpo import (
+    DPOConfig,
+    DPOTrainer,
+    dpo_batches,
+    dpo_loss_from_logps,
+    encode_pair,
+)
+from tpufw.train.sft import byte_encode
+
+TINY = LLAMA_CONFIGS["llama3_tiny"]
+
+PAIR = {
+    "prompt": [{"role": "user", "content": "pick a word"}],
+    "chosen": "banana",
+    "rejected": "rock",
+}
+
+
+def test_encode_pair_shared_context_and_masks():
+    tc, mc, tr, mr = encode_pair(PAIR, byte_encode, "plain")
+    n_ctx = int((mc == 0).sum())
+    # Both rows share the identical rendered prompt+assistant-header.
+    assert n_ctx == int((mr == 0).sum())
+    assert np.array_equal(tc[:n_ctx], tr[:n_ctx])
+    # Masked span decodes to the response + footer, nothing else.
+    chosen = bytes(t - 1 for t, m in zip(tc, mc) if m).decode()
+    assert chosen == "banana\n"
+    rejected = bytes(t - 1 for t, m in zip(tr, mr) if m).decode()
+    assert rejected == "rock\n"
+
+
+def test_encode_pair_string_prompt_equals_user_turn():
+    a = encode_pair(PAIR, byte_encode, "plain")
+    b = encode_pair({**PAIR, "prompt": "pick a word"}, byte_encode, "plain")
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
+
+
+def test_batches_layout_and_pairing(tmp_path):
+    path = tmp_path / "pairs.jsonl"
+    with open(path, "w") as f:
+        for i in range(5):
+            f.write(json.dumps({
+                "prompt": f"q{i}", "chosen": f"yes{i}", "rejected": "no",
+            }) + "\n")
+    batches = dpo_batches(
+        path, batch_pairs=2, seq_len=32, encode=byte_encode, epochs=1
+    )
+    b = next(batches)
+    assert b["tokens"].shape == (4, 32)
+    assert set(b) == {"tokens", "loss_mask", "segment_ids"}
+    for i in range(2):
+        tok_c, tok_r = b["tokens"][2 * i], b["tokens"][2 * i + 1]
+        m_c = b["loss_mask"][2 * i]
+        # Same prompt prefix: identical until the first trained position.
+        first = int(np.argmax(m_c))
+        assert first > 0 and np.array_equal(tok_c[:first], tok_r[:first])
+        # Padding is segment 0 and never trained.
+        seg = b["segment_ids"][2 * i]
+        assert ((b["loss_mask"][2 * i] > 0) <= (seg > 0)).all()
+
+
+def test_row_truncation_keeps_response(tmp_path):
+    path = tmp_path / "long.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "prompt": "x" * 100, "chosen": "ok", "rejected": "ko",
+        }) + "\n")
+    b = next(dpo_batches(
+        path, batch_pairs=1, seq_len=24, encode=byte_encode, epochs=1
+    ))
+    # Response survives whole at the row tail; prompt lost its head.
+    chosen = bytes(
+        t - 1 for t, m in zip(b["tokens"][0], b["loss_mask"][0]) if m
+    ).decode()
+    assert chosen == "ok\n"
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "prompt": "q", "chosen": "y" * 100, "rejected": "n",
+        }) + "\n")
+    with pytest.raises(ValueError, match="does not fit"):
+        next(dpo_batches(
+            path, batch_pairs=1, seq_len=24, encode=byte_encode, epochs=1
+        ))
+
+
+def test_chunked_sequence_logprob_matches_naive():
+    from tpufw.ops.loss import chunked_sequence_logprob
+
+    key = jax.random.key(0)
+    b, t, d, v = 4, 10, 8, 32
+    hidden = jax.random.normal(key, (b, t, d), jnp.float32)
+    kernel = jax.random.normal(jax.random.key(1), (d, v), jnp.float32)
+    targets = jax.random.randint(jax.random.key(2), (b, t), 0, v)
+    mask = (jax.random.uniform(jax.random.key(3), (b, t)) > 0.3).astype(
+        jnp.float32
+    )
+    got = chunked_sequence_logprob(
+        hidden, kernel, targets, mask, chunk_size=4,
+        compute_dtype=jnp.float32,
+    )
+    logp = jax.nn.log_softmax(hidden @ kernel, axis=-1)
+    want = (
+        jnp.take_along_axis(logp, targets[..., None], -1)[..., 0] * mask
+    ).sum(-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5)
+
+
+def test_loss_from_logps_anchor_values():
+    pol = jnp.array([1.0, 2.0, 0.0, 1.0])  # 2 pairs (interleaved)
+    loss, m = dpo_loss_from_logps(pol, pol, beta=0.1)
+    assert math.isclose(float(loss), math.log(2.0), rel_tol=1e-6)
+    assert float(m["accuracy"]) == 0.5  # exact tie counts as coin flip
+    # A clearly-won pair drives loss below log 2 and accuracy to 1;
+    # interleaved layout: rows 0/2 are chosen, rows 1/3 rejected.
+    ref = jnp.zeros(4)
+    pol = jnp.array([5.0, -5.0, 5.0, -5.0])
+    loss2, m2 = dpo_loss_from_logps(pol, ref, beta=1.0)
+    assert float(loss2) < 1e-3 and float(m2["accuracy"]) == 1.0
+    assert float(m2["reward_chosen"]) == 5.0
+    assert float(m2["reward_rejected"]) == -5.0
+
+
+def test_interleaving_survives_block_concatenation():
+    """The multi-process property itself: two per-process interleaved
+    blocks concatenated row-wise still split correctly, where a
+    chosen-first half-split would mis-pair across blocks."""
+    blk1 = jnp.array([3.0, 1.0])   # process 0: pair margin +2
+    blk2 = jnp.array([0.0, 4.0])   # process 1: pair margin -4
+    pol = jnp.concatenate([blk1, blk2])
+    _, m = dpo_loss_from_logps(pol, jnp.zeros(4), beta=1.0)
+    assert float(m["margin"]) == pytest.approx((2.0 - 4.0) / 2)
+    assert float(m["accuracy"]) == 0.5
+
+
+def _pairs_file(tmp_path, n=8):
+    path = tmp_path / "prefs.jsonl"
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(json.dumps({
+                "prompt": f"item {i}",
+                "chosen": "good answer",
+                "rejected": "bad",
+            }) + "\n")
+    return path
+
+
+@pytest.fixture(scope="module")
+def dpo_run(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("dpo")
+    path = _pairs_file(tmp)
+    cfg = TrainerConfig(
+        batch_size=8, seq_len=48, total_steps=10, lr=5e-3,
+        warmup_steps=1, loss_chunk_size=16, log_every=1,
+    )
+    trainer = DPOTrainer(
+        Llama(TINY), cfg, MeshConfig(data=2, fsdp=2, tensor=2),
+        dpo=DPOConfig(beta=0.5, ref_dtype="float32"),
+    )
+    trainer.init_state()
+    step = trainer.compiled_step({
+        k: np.zeros((8, 48), np.int32) for k in
+        ("tokens", "loss_mask", "segment_ids")
+    })
+    data = dpo_batches(
+        path, batch_pairs=4, seq_len=48, encode=byte_encode, seed=1
+    )
+    first_batch = trainer.globalize_batch(next(data))
+    state0_metrics = None
+    # Step repeatedly on the SAME batch: preference separation must
+    # appear within a few updates on a tiny model.
+    metrics = None
+    for i in range(10):
+        trainer.state, metrics = step(trainer.state, first_batch)
+        if i == 0:
+            state0_metrics = {
+                k: float(v) for k, v in metrics.items()
+            }
+    return state0_metrics, {k: float(v) for k, v in metrics.items()}
+
+
+def test_step0_ref_equals_policy_anchor(dpo_run):
+    m0, _ = dpo_run
+    assert math.isclose(m0["loss"], math.log(2.0), rel_tol=1e-5)
+    assert m0["accuracy"] == 0.5
+    assert abs(m0["margin"]) < 1e-5
+    assert m0["grad_norm"] > 0  # gradient exists at the anchor point
+
+
+def test_training_separates_chosen_from_rejected(dpo_run):
+    _, m = dpo_run
+    assert m["loss"] < math.log(2.0)
+    assert m["accuracy"] == 1.0
+    assert m["margin"] > 0
+    assert m["reward_chosen"] > m["reward_rejected"]
+
+
+def test_run_loop_end_to_end(tmp_path):
+    """Through the inherited Trainer.run: metering + loop mechanics."""
+    path = _pairs_file(tmp_path)
+    cfg = TrainerConfig(
+        batch_size=8, seq_len=48, total_steps=3, lr=1e-3,
+        warmup_steps=1, loss_chunk_size=16, log_every=1,
+    )
+    trainer = DPOTrainer(Llama(TINY), cfg, MeshConfig())
+    trainer.init_state()
+    data = dpo_batches(
+        path, batch_pairs=4, seq_len=48, encode=byte_encode
+    )
+    hist = trainer.run(
+        data, model_flops_per_token=TINY.flops_per_token(47)
+    )
+    assert len(hist) == 3
+    assert all(np.isfinite(h.loss) for h in hist)
+
+
+def test_guards():
+    with pytest.raises(ValueError, match="ROW count"):
+        DPOTrainer(
+            Llama(TINY), TrainerConfig(batch_size=7), MeshConfig()
+        )
+    with pytest.raises(NotImplementedError, match="grad_accum"):
+        DPOTrainer(
+            Llama(TINY),
+            TrainerConfig(batch_size=8, grad_accum=2),
+            MeshConfig(),
+        )
+    tr = DPOTrainer(Llama(TINY), TrainerConfig(batch_size=8), MeshConfig())
+    with pytest.raises(RuntimeError, match="reference snapshot"):
+        tr.compiled_step()
+
+
+def test_undersized_shard_raises(tmp_path):
+    """A shard smaller than batch_pairs must fail loudly — with
+    epochs=None it would otherwise spin forever yielding nothing."""
+    path = _pairs_file(tmp_path, n=3)
+    with pytest.raises(ValueError, match="< batch_pairs"):
+        next(dpo_batches(
+            path, batch_pairs=2, seq_len=32, encode=byte_encode,
+            shard_id=0, num_shards=8,
+        ))

@@ -63,6 +63,24 @@ def _chunk_stats(
     return token_cross_entropy(logits, targets, z_loss_weight)
 
 
+def _chunk_seq(chunk_size: int, hidden, targets, mask):
+    """Shared sequence-axis chunking: pad T up to a chunk multiple and
+    reshape each array to [n_chunks, B, chunk, ...] for ``lax.scan`` —
+    ONE implementation of the layout both chunked reductions scan over,
+    so the padding semantics cannot diverge."""
+    b, t, d = hidden.shape
+    n_chunks = -(-t // chunk_size)
+    pad = n_chunks * chunk_size - t
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    hs = hidden.reshape(b, n_chunks, chunk_size, d).swapaxes(0, 1)
+    ts = targets.reshape(b, n_chunks, chunk_size).swapaxes(0, 1)
+    ms = mask.reshape(b, n_chunks, chunk_size).swapaxes(0, 1)
+    return hs, ts, ms
+
+
 def chunked_cross_entropy(
     hidden: jax.Array,
     kernel: jax.Array,
@@ -91,22 +109,12 @@ def chunked_cross_entropy(
     Returns:
       (mean loss over unmasked tokens, number of unmasked tokens).
     """
-    b, t, d = hidden.shape
+    b, t, _ = hidden.shape
     if mask is None:
         mask = jnp.ones((b, t), jnp.float32)
-    mask = mask.astype(jnp.float32)
-
-    n_chunks = -(-t // chunk_size)
-    pad = n_chunks * chunk_size - t
-    if pad:
-        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
-        targets = jnp.pad(targets, ((0, 0), (0, pad)))
-        mask = jnp.pad(mask, ((0, 0), (0, pad)))
-
-    # [n_chunks, B, chunk, ...] so scan walks the sequence axis.
-    hs = hidden.reshape(b, n_chunks, chunk_size, d).swapaxes(0, 1)
-    ts = targets.reshape(b, n_chunks, chunk_size).swapaxes(0, 1)
-    ms = mask.reshape(b, n_chunks, chunk_size).swapaxes(0, 1)
+    hs, ts, ms = _chunk_seq(
+        chunk_size, hidden, targets, mask.astype(jnp.float32)
+    )
 
     @jax.checkpoint
     def body(carry, xs):
@@ -124,3 +132,46 @@ def chunked_cross_entropy(
     )
     n_safe = jnp.maximum(n, 1.0)
     return ce_sum / n_safe, n
+
+
+def chunked_sequence_logprob(
+    hidden: jax.Array,
+    kernel: jax.Array,
+    targets: jax.Array,
+    mask: jax.Array,
+    chunk_size: int = 256,
+    compute_dtype=jnp.bfloat16,
+    logits_soft_cap: Optional[float] = None,
+) -> jax.Array:
+    """Per-ROW sum of target-token log-probabilities, chunked like
+    ``chunked_cross_entropy`` (same scan, same memory bound), but
+    reduced per sequence instead of over the whole batch and WITHOUT
+    z-loss — preference objectives (tpufw.train.dpo) need the pure
+    ``sum_t log pi(y_t | x_<t)`` of each response, not a regularized
+    batch mean.
+
+    Args:
+      hidden: [B, T, D] final hidden states (post final-norm).
+      kernel: [D, V] LM-head kernel.
+      targets: [B, T] int token ids (already shifted).
+      mask: [B, T] float weights; positions with 0 don't contribute.
+
+    Returns:
+      [B] fp32 masked log-prob sums.
+    """
+    b = hidden.shape[0]
+    hs, ts, ms = _chunk_seq(
+        chunk_size, hidden, targets, mask.astype(jnp.float32)
+    )
+
+    @jax.checkpoint
+    def body(carry, xs):
+        h_c, t_c, m_c = xs
+        # ce with z_loss_weight=0 is exactly -log p(target).
+        nll = _chunk_stats(
+            h_c, kernel, t_c, 0.0, compute_dtype, logits_soft_cap
+        )
+        return carry - (nll * m_c).sum(axis=-1), None
+
+    sums, _ = lax.scan(body, jnp.zeros((b,), jnp.float32), (hs, ts, ms))
+    return sums

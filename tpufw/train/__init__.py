@@ -31,6 +31,12 @@ from tpufw.train.sft import (  # noqa: F401
     render_conversation,
     sft_batches,
 )
+from tpufw.train.dpo import (  # noqa: F401
+    DPOConfig,
+    DPOTrainer,
+    dpo_batches,
+    dpo_train_step,
+)
 from tpufw.train.vision import (  # noqa: F401
     VisionTrainer,
     VisionTrainerConfig,

@@ -1,0 +1,450 @@
+"""Direct Preference Optimization: preference-pair fine-tuning.
+
+The reference ships no ML workloads at all (its "workload" is a
+diagnostic CLI, reference README.md:314); DPO is the alignment step real
+users run after SFT (tpufw.train.sft), so it rides the same substrate:
+chat templates render prompts, responses are the trained spans, and the
+trainer is a thin subclass of tpufw.train.trainer.Trainer — same mesh,
+sharding, checkpointing, preemption, and metering.
+
+TPU-first shape discipline: each batch is ``[2B, T]`` with pairs
+INTERLEAVED — row 2i is pair i's chosen, row 2i+1 its rejected — so ONE
+model forward covers both halves and the pairwise split is a strided
+[2B] vector slice after the per-row reduction; no ragged shapes, no
+second program. Interleaving (not chosen-first/rejected-last) is what
+makes multi-process data loading correct: the global batch is a
+concatenation of per-process blocks, and a stride-2 split stays
+pair-aligned under ANY concatenation of even-sized interleaved blocks,
+where a half-split would pair rows across unrelated processes. Both
+the policy and the frozen reference score sequences through
+``chunked_sequence_logprob`` (tpufw.ops.loss), so [B, T, V] logits are
+never materialized; the reference forward runs OUTSIDE the grad closure
+(no activations kept) with bf16-cast weights.
+
+Objective (Rafailov et al. 2023, plus conservative-DPO label smoothing):
+
+  r_c = beta * (log pi(y_c|x) - log ref(y_c|x))     # "rewards"
+  r_r = beta * (log pi(y_r|x) - log ref(y_r|x))
+  loss = -(1 - ls) * log sigmoid(r_c - r_r) - ls * log sigmoid(r_r - r_c)
+
+At step 0 with ref == policy every reward is exactly 0, so
+loss == log 2 and accuracy == 0.5 — pinned by tests/test_dpo.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpufw.train.sft import _TEMPLATES, render_conversation
+from tpufw.train.trainer import Trainer, head_kernel, shift_and_mask
+
+# ----------------------------------------------------------------------
+# Data: preference pairs -> [2B, T] batches
+# ----------------------------------------------------------------------
+
+
+def read_pairs(path: str | pathlib.Path) -> Iterator[dict]:
+    """JSONL preference pairs: {"prompt": <str | message list>,
+    "chosen": <str>, "rejected": <str>} per line (the common export
+    shape of preference datasets)."""
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if not (
+                isinstance(obj, dict)
+                and "prompt" in obj
+                and isinstance(obj.get("chosen"), str)
+                and isinstance(obj.get("rejected"), str)
+            ):
+                raise ValueError(
+                    f"{path}:{ln}: expected "
+                    '{"prompt": ..., "chosen": str, "rejected": str}'
+                )
+            yield obj
+
+
+def encode_pair(
+    pair: dict,
+    encode: Callable[[str], List[int]],
+    template: str = "plain",
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """One pair -> (tokens_c, mask_c, tokens_r, mask_r).
+
+    The prompt (string = a single user turn, or a full message list) is
+    rendered through the SFT chat template INCLUDING the assistant
+    header, so both responses continue from the identical context; the
+    response content + end-of-turn footer are the trained span — the
+    same mask convention as tpufw.train.sft.encode_conversation.
+    """
+    prompt = pair["prompt"]
+    if isinstance(prompt, str):
+        prompt = [{"role": "user", "content": prompt}]
+    ctx: List[int] = []
+    # render_conversation validates the template name (the one
+    # canonical check); the direct lookup below can then only succeed.
+    for text, _ in render_conversation(prompt, template):
+        ctx.extend(encode(text))
+    t = _TEMPLATES[template]
+    ctx.extend(encode(t["header"].format(role="assistant")))
+
+    rows = []
+    for resp in (pair["chosen"], pair["rejected"]):
+        resp_ids = encode(resp) + encode(t["footer"])
+        toks = np.asarray(ctx + resp_ids, np.int32)
+        mask = np.zeros(len(toks), np.float32)
+        mask[len(ctx):] = 1.0
+        rows.append((toks, mask))
+    (tc, mc), (tr, mr) = rows
+    return tc, mc, tr, mr
+
+
+def _fit_row(
+    toks: np.ndarray, mask: np.ndarray, seq_len: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Right-pad (segment 0) or left-truncate to ``seq_len``. Truncation
+    drops the OLDEST prompt tokens first — the response span must
+    survive whole or its logprob sum is meaningless."""
+    n = len(toks)
+    if n > seq_len:
+        resp = int(mask.sum())
+        if resp >= seq_len:
+            raise ValueError(
+                f"response ({resp} tokens) does not fit in "
+                f"seq_len={seq_len}; raise seq_len or filter the pair"
+            )
+        toks, mask = toks[n - seq_len:], mask[n - seq_len:]
+        n = seq_len
+    out_t = np.zeros(seq_len, np.int32)
+    out_m = np.zeros(seq_len, np.float32)
+    seg = np.zeros(seq_len, np.int32)
+    out_t[:n], out_m[:n], seg[:n] = toks, mask, 1
+    return out_t, out_m, seg
+
+
+def dpo_batches(
+    path: str | pathlib.Path,
+    batch_pairs: int,
+    seq_len: int,
+    encode: Callable[[str], List[int]],
+    template: str = "plain",
+    epochs: Optional[int] = None,
+    seed: int = 0,
+    shard_id: int = 0,
+    num_shards: int = 1,
+) -> Iterator[dict]:
+    """Yield [2B, T] DPO batches (B = ``batch_pairs``): row 2i is pair
+    i's chosen, row 2i+1 its rejected (the interleaved layout
+    ``dpo_loss_from_logps`` splits with a stride-2 slice — see the
+    module docstring for why interleaving is the multi-process-safe
+    choice). Pairs are sharded disjointly across processes BEFORE
+    shuffling (same contract as tpufw.train.sft.sft_batches) and
+    reshuffled each epoch; ``epochs=None`` cycles forever."""
+    pairs = list(read_pairs(path))
+    if not pairs:
+        raise ValueError(f"{path}: no preference pairs")
+    pairs = pairs[shard_id::num_shards]
+    encoded = [encode_pair(p, encode, template) for p in pairs]
+    if len(encoded) < batch_pairs:
+        # An undersized shard would yield ZERO batches — with
+        # epochs=None that is an infinite permute-nothing spin, so fail
+        # loudly instead (sft_batches raises on its empty-shard analog).
+        raise ValueError(
+            f"{path}: shard {shard_id}/{num_shards} holds "
+            f"{len(encoded)} pairs < batch_pairs={batch_pairs}"
+        )
+    rng = np.random.default_rng(seed)
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        order = rng.permutation(len(encoded))
+        for start in range(0, len(order) - batch_pairs + 1, batch_pairs):
+            idx = order[start:start + batch_pairs]
+            toks = np.zeros((2 * batch_pairs, seq_len), np.int32)
+            mask = np.zeros((2 * batch_pairs, seq_len), np.float32)
+            seg = np.zeros((2 * batch_pairs, seq_len), np.int32)
+            for row, i in enumerate(idx):
+                tc, mc, tr, mr = encoded[i]
+                toks[2 * row], mask[2 * row], seg[2 * row] = _fit_row(
+                    tc, mc, seq_len
+                )
+                toks[2 * row + 1], mask[2 * row + 1], seg[
+                    2 * row + 1
+                ] = _fit_row(tr, mr, seq_len)
+            yield {
+                "tokens": toks,
+                "loss_mask": mask,
+                "segment_ids": seg,
+            }
+        epoch += 1
+
+
+# ----------------------------------------------------------------------
+# Objective
+# ----------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DPOConfig:
+    # Reward scale: how hard the policy is pushed away from the
+    # reference. The standard operating range is 0.1-0.5.
+    beta: float = 0.1
+    # Conservative DPO (label noise robustness): 0 = the pure objective.
+    label_smoothing: float = 0.0
+    # Storage dtype of the frozen reference weights (its forward is
+    # score-only, so serving precision is enough; halves the extra HBM).
+    ref_dtype: str = "bfloat16"
+
+
+def _sequence_logps(
+    apply_fn,
+    params,
+    inputs,
+    targets,
+    seg_in,
+    mask,
+    chunk_size: int,
+    compute_dtype,
+    soft_cap,
+):
+    """[2B] per-row response logprob sums (+ MoE aux loss, 0.0 for
+    dense models) through the chunked head path."""
+    from tpufw.ops.loss import chunked_sequence_logprob
+
+    out = apply_fn(
+        {"params": params}, inputs, segment_ids=seg_in, return_hidden=True
+    )
+    aux = 0.0
+    if isinstance(out, tuple):
+        out, aux = out
+    logps = chunked_sequence_logprob(
+        out, head_kernel(params), targets, mask,
+        chunk_size=chunk_size, compute_dtype=compute_dtype,
+        logits_soft_cap=soft_cap,
+    )
+    return logps, aux
+
+
+def dpo_loss_from_logps(
+    policy_logps: jax.Array,
+    ref_logps: jax.Array,
+    beta: float,
+    label_smoothing: float = 0.0,
+) -> tuple[jax.Array, dict]:
+    """[2B] INTERLEAVED (even = chosen, odd = rejected) policy /
+    reference logprob sums -> (scalar loss, metrics)."""
+    rewards = beta * (policy_logps - ref_logps)
+    r_c, r_r = rewards[0::2], rewards[1::2]
+    margin = r_c - r_r
+    ls = label_smoothing
+    loss = (
+        -(1.0 - ls) * jax.nn.log_sigmoid(margin)
+        - ls * jax.nn.log_sigmoid(-margin)
+    ).mean()
+    metrics = {
+        # Exact ties count 0.5 ("coin flip"), so the step-0 anchor
+        # (ref == policy, margin identically 0) reads 0.5, not 0.
+        "accuracy": (
+            (margin > 0).astype(jnp.float32)
+            + 0.5 * (margin == 0).astype(jnp.float32)
+        ).mean(),
+        "margin": margin.mean(),
+        "reward_chosen": r_c.mean(),
+        "reward_rejected": r_r.mean(),
+    }
+    return loss, metrics
+
+
+def dpo_train_step(
+    state,
+    ref_params,
+    batch: dict,
+    beta: float = 0.1,
+    label_smoothing: float = 0.0,
+    loss_chunk_size: int = 256,
+    loss_chunk_dtype: str = "bfloat16",
+    final_logit_soft_cap: Optional[float] = None,
+):
+    """One DPO optimizer update on a [2B, T] chosen/rejected batch.
+
+    The reference forward runs outside the grad closure — no gradient,
+    no saved activations; the policy forward + per-row chunked logprob
+    reduction is the only differentiated region. MoE router aux loss
+    (load balancing) joins the objective from the POLICY forward, as in
+    tpufw.train.trainer.batch_loss.
+    """
+    inputs, targets, seg_in, mask = shift_and_mask(batch)
+    dtype = jnp.dtype(loss_chunk_dtype)
+
+    ref_logps, _ = _sequence_logps(
+        state.apply_fn, ref_params, inputs, targets, seg_in, mask,
+        loss_chunk_size, dtype, final_logit_soft_cap,
+    )
+    ref_logps = jax.lax.stop_gradient(ref_logps)
+
+    def lf(params):
+        logps, aux = _sequence_logps(
+            state.apply_fn, params, inputs, targets, seg_in, mask,
+            loss_chunk_size, dtype, final_logit_soft_cap,
+        )
+        loss, metrics = dpo_loss_from_logps(
+            logps, ref_logps, beta, label_smoothing
+        )
+        return loss + aux, metrics
+
+    (loss, metrics), grads = jax.value_and_grad(lf, has_aux=True)(
+        state.params
+    )
+    import optax
+
+    new_state = state.apply_gradients(grads)
+    return new_state, {
+        "loss": loss,
+        "grad_norm": optax.global_norm(grads),
+        **metrics,
+    }
+
+
+# ----------------------------------------------------------------------
+# Trainer
+# ----------------------------------------------------------------------
+
+
+class DPOTrainer(Trainer):
+    """tpufw.train.trainer.Trainer specialized for preference pairs:
+    run()/checkpointing/preemption/metering are inherited verbatim; only
+    the compiled step (and the frozen reference tree it closes over)
+    differs.
+
+    ``TrainerConfig.batch_size`` must be the ROW count 2B (what
+    ``dpo_batches(batch_pairs=B)`` emits) — rows are what shard over
+    data x fsdp. MFU/tokens metrics count all 2B rows; the reference
+    forward's FLOPs are not charged by default. flops_per_token is the
+    6N train convention (fwd 2N + bwd 4N); DPO adds one ref forward
+    (2N) per row, so pass ``model_flops_per_token * 4 / 3`` to ``run``
+    for exact accounting when comparing MFU against plain LM training.
+    """
+
+    def __init__(
+        self,
+        model,
+        trainer_cfg,
+        mesh_cfg=None,
+        mesh=None,
+        tx=None,
+        dpo: DPOConfig = DPOConfig(),
+    ):
+        super().__init__(model, trainer_cfg, mesh_cfg, mesh, tx)
+        if trainer_cfg.batch_size % 2:
+            raise ValueError(
+                f"DPO batch_size is the ROW count 2B; got odd "
+                f"{trainer_cfg.batch_size}"
+            )
+        if trainer_cfg.grad_accum != 1:
+            raise NotImplementedError(
+                "DPO does not implement grad_accum: microbatch slicing "
+                "would split chosen rows from their rejected partners"
+            )
+        self.dpo = dpo
+        self.ref_params = None
+
+    # -- reference snapshot ------------------------------------------------
+
+    def _snapshot_reference(self):
+        """Freeze the CURRENT policy params as the reference (cast to
+        ref_dtype). Correct at step 0 — after SFT import or fresh init —
+        which is exactly when DPO starts."""
+        dt = jnp.dtype(self.dpo.ref_dtype)
+
+        def cast(tree):
+            return jax.tree.map(
+                lambda p: p.astype(dt)
+                if jnp.issubdtype(p.dtype, jnp.floating)
+                else p,
+                tree,
+            )
+
+        # Through jit so every leaf gets a FRESH buffer even when the
+        # cast is a dtype no-op (fp32 -> fp32): the train step donates
+        # state.params, and an aliased reference would be a
+        # use-after-donate at the first step.
+        self.ref_params = jax.jit(cast)(self.state.params)
+
+    def init_state(self, seed: int = 0):
+        out = super().init_state(seed)
+        self._snapshot_reference()
+        return out
+
+    def init_from_params(self, path: str, seed: int = 0):
+        out = super().init_from_params(path, seed)
+        self._snapshot_reference()
+        return out
+
+    def maybe_restore(self) -> bool:
+        """Mid-run resume: the restored POLICY must not become the
+        reference — re-snapshot only when no reference exists yet (a
+        resumed run keeps the one captured at step 0 only if the caller
+        restores it; without a checkpointed copy we refuse rather than
+        silently anchor to the moved policy)."""
+        restored = super().maybe_restore()
+        if restored and int(self.state.step) > 0 and self.ref_params is None:
+            raise RuntimeError(
+                "resumed a DPO run mid-training without a reference "
+                "snapshot: call init_from_params on the ORIGINAL base "
+                "checkpoint first (the reference must anchor to step-0 "
+                "weights, not the resumed policy)"
+            )
+        if self.ref_params is None and self.state is not None:
+            self._snapshot_reference()
+        return restored
+
+    # -- compiled step -----------------------------------------------------
+
+    def compiled_step(self, batch: dict | None = None):
+        from functools import partial
+
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        if self.ref_params is None:
+            raise RuntimeError(
+                "DPO step before reference snapshot: call init_state() "
+                "or init_from_params() first"
+            )
+        key = (
+            ("dpo", "tokens")
+            if batch is None
+            else ("dpo", *sorted(batch.keys()))
+        )
+        if key not in self._compiled:
+            row = NamedSharding(self.mesh, P(("data", "fsdp")))
+            batch_sharding = {k: row for k in key[1:]}
+            jitted = jax.jit(
+                partial(
+                    dpo_train_step,
+                    beta=self.dpo.beta,
+                    label_smoothing=self.dpo.label_smoothing,
+                    loss_chunk_size=self.cfg.loss_chunk_size or 256,
+                    loss_chunk_dtype=self.cfg.loss_chunk_dtype,
+                    final_logit_soft_cap=self._final_soft_cap(),
+                ),
+                in_shardings=(
+                    self.state_sharding,
+                    self.state_sharding.params,
+                    batch_sharding,
+                ),
+                out_shardings=(self.state_sharding, None),
+                donate_argnums=(0,),
+            )
+            self._compiled[key] = lambda state, b: jitted(
+                state, self.ref_params, b
+            )
+        return self._compiled[key]
