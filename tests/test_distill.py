@@ -1,0 +1,218 @@
+"""Distillation: chunked KL parity, the zero-KL anchor, and training.
+
+Anchor: teacher == student makes KL exactly 0 (same weights through the
+same chunked computation), so with alpha=1 the loss is 0 at step 0; a
+student trained with pure KL against a fixed random teacher must drive
+the KL down.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.mesh import MeshConfig
+from tpufw.models import Llama, LLAMA_CONFIGS
+from tpufw.train import TrainerConfig, synthetic_batches
+from tpufw.train.distill import (
+    DistillConfig,
+    DistillTrainer,
+    chunked_distill_loss,
+)
+
+TINY = LLAMA_CONFIGS["llama3_tiny"]
+
+
+def _naive_kl_ce(s_h, s_k, t_h, t_k, targets, mask, temp):
+    s_logits = (s_h @ s_k).astype(jnp.float32)
+    t_logits = (t_h @ t_k).astype(jnp.float32)
+    s_logp = jax.nn.log_softmax(s_logits / temp, -1)
+    t_logp = jax.nn.log_softmax(t_logits / temp, -1)
+    kl = (jnp.exp(t_logp) * (t_logp - s_logp)).sum(-1)
+    ce = -jnp.take_along_axis(
+        jax.nn.log_softmax(s_logits, -1), targets[..., None], -1
+    )[..., 0]
+    n = mask.sum()
+    return temp**2 * (kl * mask).sum() / n, (ce * mask).sum() / n
+
+
+def test_chunked_matches_naive():
+    k = jax.random.key
+    b, t, ds, dt_, v = 3, 10, 8, 12, 32
+    s_h = jax.random.normal(k(0), (b, t, ds), jnp.float32)
+    s_k = jax.random.normal(k(1), (ds, v), jnp.float32)
+    t_h = jax.random.normal(k(2), (b, t, dt_), jnp.float32)
+    t_k = jax.random.normal(k(3), (dt_, v), jnp.float32)
+    targets = jax.random.randint(k(4), (b, t), 0, v)
+    mask = (jax.random.uniform(k(5), (b, t)) > 0.2).astype(jnp.float32)
+    total, kl, ce = chunked_distill_loss(
+        s_h, s_k, t_h, t_k, targets, mask,
+        temperature=2.0, alpha=0.3, chunk_size=4,
+        compute_dtype=jnp.float32,
+    )
+    kl_w, ce_w = _naive_kl_ce(s_h, s_k, t_h, t_k, targets, mask, 2.0)
+    np.testing.assert_allclose(float(kl), float(kl_w), rtol=1e-5)
+    np.testing.assert_allclose(float(ce), float(ce_w), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(total), 0.3 * float(kl_w) + 0.7 * float(ce_w), rtol=1e-5
+    )
+
+
+def test_identical_models_zero_kl():
+    k = jax.random.key
+    b, t, d, v = 2, 8, 8, 16
+    h = jax.random.normal(k(0), (b, t, d), jnp.float32)
+    kern = jax.random.normal(k(1), (d, v), jnp.float32)
+    targets = jnp.zeros((b, t), jnp.int32)
+    mask = jnp.ones((b, t), jnp.float32)
+    total, kl, _ = chunked_distill_loss(
+        h, kern, h, kern, targets, mask, temperature=1.0, alpha=1.0,
+        chunk_size=4, compute_dtype=jnp.float32,
+    )
+    assert float(kl) == pytest.approx(0.0, abs=1e-6)
+    assert float(total) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_soft_caps_match_naive_capped():
+    """Gemma-style tanh caps re-applied per chunk, separately per model
+    (return_hidden skipped the models' own cap)."""
+    k = jax.random.key
+    b, t, d, v = 2, 8, 6, 16
+    s_h = jax.random.normal(k(0), (b, t, d), jnp.float32) * 3
+    s_k = jax.random.normal(k(1), (d, v), jnp.float32) * 3
+    t_h = jax.random.normal(k(2), (b, t, d), jnp.float32) * 3
+    t_k = jax.random.normal(k(3), (d, v), jnp.float32) * 3
+    targets = jax.random.randint(k(4), (b, t), 0, v)
+    mask = jnp.ones((b, t), jnp.float32)
+    _, kl, ce = chunked_distill_loss(
+        s_h, s_k, t_h, t_k, targets, mask, temperature=2.0,
+        chunk_size=4, compute_dtype=jnp.float32,
+        student_soft_cap=5.0, teacher_soft_cap=9.0,
+    )
+    cap_s = 5.0 * jnp.tanh((s_h @ s_k) / 5.0)
+    cap_t = 9.0 * jnp.tanh((t_h @ t_k) / 9.0)
+    s_logp = jax.nn.log_softmax(cap_s / 2.0, -1)
+    t_logp = jax.nn.log_softmax(cap_t / 2.0, -1)
+    kl_w = 4.0 * (jnp.exp(t_logp) * (t_logp - s_logp)).sum(-1).mean()
+    ce_w = -jnp.take_along_axis(
+        jax.nn.log_softmax(cap_s, -1), targets[..., None], -1
+    )[..., 0].mean()
+    np.testing.assert_allclose(float(kl), float(kl_w), rtol=1e-5)
+    np.testing.assert_allclose(float(ce), float(ce_w), rtol=1e-5)
+
+
+def test_teacher_params_sharded_on_mesh():
+    """A big teacher must land SHARDED (not replicated): its embed
+    kernel's sharding spec uses mesh axes after set_teacher."""
+    trainer = DistillTrainer(
+        Llama(TINY), TrainerConfig(batch_size=8, seq_len=33),
+        MeshConfig(),  # all 8 devices on fsdp
+    )
+    trainer.init_state()
+    teacher = Llama(TINY)
+    from flax.core import meta
+
+    t_params = meta.unbox(
+        jax.jit(teacher.init)(
+            jax.random.key(0), jnp.zeros((8, 32), jnp.int32)
+        )["params"]
+    )
+    trainer.set_teacher(teacher, t_params)
+    emb = trainer.teacher_params["embed"]["embedding"]
+    assert emb.dtype == jnp.bfloat16
+    spec = emb.sharding.spec
+    assert any(s is not None for s in spec), (
+        f"teacher embed replicated: {spec}"
+    )
+
+
+def test_vocab_mismatch_rejected():
+    h = jnp.zeros((1, 4, 8))
+    with pytest.raises(ValueError, match="vocab"):
+        chunked_distill_loss(
+            h, jnp.zeros((8, 16)), h, jnp.zeros((8, 32)),
+            jnp.zeros((1, 4), jnp.int32), jnp.ones((1, 4)),
+        )
+
+
+@pytest.fixture(scope="module")
+def distilled():
+    """Student trained pure-KL against a BIGGER fixed random teacher on
+    one repeated batch, on the sharded mesh."""
+    teacher_cfg = dataclasses.replace(TINY, d_model=96, n_layers=3, d_ff=192)
+    teacher = Llama(teacher_cfg)
+    cfg = TrainerConfig(
+        batch_size=8, seq_len=33, total_steps=12, lr=5e-3,
+        warmup_steps=2, loss_chunk_size=16, log_every=1,
+    )
+    trainer = DistillTrainer(
+        Llama(TINY), cfg, MeshConfig(data=2, fsdp=2, tensor=2),
+        distill=DistillConfig(temperature=1.0, alpha=1.0),
+    )
+    trainer.init_state()
+    t_params = jax.jit(teacher.init)(
+        jax.random.key(7), jnp.zeros((8, 32), jnp.int32)
+    )["params"]
+    from flax.core import meta
+
+    trainer.set_teacher(teacher, meta.unbox(t_params))
+    batch = trainer.globalize_batch(
+        next(synthetic_batches(8, 33, TINY.vocab_size, seed=3))
+    )
+    step = trainer.compiled_step(batch)
+    history = []
+    for _ in range(12):
+        trainer.state, m = step(trainer.state, batch)
+        history.append({k: float(v) for k, v in m.items()})
+    return history
+
+
+def test_kl_decreases(distilled):
+    assert distilled[-1]["kl_loss"] < distilled[0]["kl_loss"]
+    assert np.isfinite(distilled[-1]["loss"])
+    # alpha=1: total loss IS the KL term.
+    assert distilled[-1]["loss"] == pytest.approx(
+        distilled[-1]["kl_loss"], rel=1e-6
+    )
+
+
+def test_ce_metric_reported(distilled):
+    assert all(np.isfinite(h["ce_loss"]) for h in distilled)
+    assert all(h["grad_norm"] > 0 for h in distilled)
+
+
+def test_guards():
+    trainer = DistillTrainer(
+        Llama(TINY), TrainerConfig(batch_size=8, seq_len=33), MeshConfig()
+    )
+    with pytest.raises(RuntimeError, match="set_teacher"):
+        trainer.compiled_step()
+    big_vocab = dataclasses.replace(TINY, vocab_size=512)
+    with pytest.raises(ValueError, match="vocab"):
+        trainer.set_teacher(Llama(big_vocab), {})
+
+
+def test_run_loop_end_to_end():
+    """Through the inherited Trainer.run on the default mesh."""
+    cfg = TrainerConfig(
+        batch_size=8, seq_len=33, total_steps=3, lr=1e-3,
+        warmup_steps=1, loss_chunk_size=16, log_every=1,
+    )
+    trainer = DistillTrainer(Llama(TINY), cfg, MeshConfig())
+    trainer.init_state()
+    teacher = Llama(TINY)
+    from flax.core import meta
+
+    t_params = meta.unbox(
+        jax.jit(teacher.init)(
+            jax.random.key(9), jnp.zeros((8, 32), jnp.int32)
+        )["params"]
+    )
+    trainer.set_teacher(teacher, t_params)
+    hist = trainer.run(
+        synthetic_batches(8, 33, TINY.vocab_size, seed=1),
+        model_flops_per_token=TINY.flops_per_token(32),
+    )
+    assert len(hist) == 3 and all(np.isfinite(h.loss) for h in hist)

@@ -107,6 +107,33 @@ def test_row_truncation_keeps_response(tmp_path):
         ))
 
 
+def test_asymmetric_overflow_keeps_shared_context(tmp_path):
+    """A pair whose CHOSEN overflows but REJECTED doesn't must truncate
+    BOTH rows identically — responses score against the same prompt
+    suffix (independent truncation would bias rewards by length)."""
+    path = tmp_path / "asym.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "prompt": "p" * 40, "chosen": "c" * 12, "rejected": "r",
+        }) + "\n")
+    b = next(dpo_batches(
+        path, batch_pairs=1, seq_len=48, encode=byte_encode, epochs=1
+    ))
+    tok_c, tok_r = b["tokens"][0], b["tokens"][1]
+    m_c, m_r = b["loss_mask"][0], b["loss_mask"][1]
+    first_c, first_r = int(np.argmax(m_c)), int(np.argmax(m_r))
+    # Identical (truncated) prompt prefix on both rows.
+    assert first_c == first_r > 0
+    assert np.array_equal(tok_c[:first_c], tok_r[:first_r])
+    # Responses survive whole.
+    assert bytes(
+        t - 1 for t, m in zip(tok_c, m_c) if m
+    ).decode() == "c" * 12 + "\n"
+    assert bytes(
+        t - 1 for t, m in zip(tok_r, m_r) if m
+    ).decode() == "r\n"
+
+
 def test_chunked_sequence_logprob_matches_naive():
     from tpufw.ops.loss import chunked_sequence_logprob
 
@@ -251,6 +278,22 @@ def test_guards():
     tr = DPOTrainer(Llama(TINY), TrainerConfig(batch_size=8), MeshConfig())
     with pytest.raises(RuntimeError, match="reference snapshot"):
         tr.compiled_step()
+
+
+def test_maskless_batch_rejected():
+    """A tokens-only batch (no loss_mask/segment_ids) must fail with a
+    clear message, not an AttributeError mid-trace."""
+    from tpufw.train.dpo import dpo_train_step
+
+    trainer = DPOTrainer(
+        Llama(TINY), TrainerConfig(batch_size=8, seq_len=33), MeshConfig()
+    )
+    trainer.init_state()
+    with pytest.raises(ValueError, match="response mask"):
+        dpo_train_step(
+            trainer.state, trainer.ref_params,
+            {"tokens": jnp.zeros((8, 33), jnp.int32)},
+        )
 
 
 def test_undersized_shard_raises(tmp_path):

@@ -37,6 +37,11 @@ from tpufw.train.dpo import (  # noqa: F401
     dpo_batches,
     dpo_train_step,
 )
+from tpufw.train.distill import (  # noqa: F401
+    DistillConfig,
+    DistillTrainer,
+    distill_train_step,
+)
 from tpufw.train.vision import (  # noqa: F401
     VisionTrainer,
     VisionTrainerConfig,

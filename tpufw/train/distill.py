@@ -1,0 +1,323 @@
+"""Knowledge distillation: student training against a frozen teacher.
+
+The reference ships no ML workloads at all (its "workload" is a
+diagnostic CLI, reference README.md:314); distillation is the third
+post-training workflow next to SFT (tpufw.train.sft) and DPO
+(tpufw.train.dpo), and rides the same substrate: packed LM batches, the
+Trainer's mesh/sharding/checkpoint/preemption loop, and a chunked-vocab
+objective that never materializes [B, T, V] logits for EITHER model —
+student and teacher logits are computed chunk-by-chunk inside one
+``lax.scan`` (tpufw.ops.loss._chunk_seq layout) and reduced to a scalar
+immediately.
+
+Objective (Hinton et al. 2015 softened-softmax form):
+
+  loss = alpha * T^2 * KL(softmax(teacher/T) || softmax(student/T))
+       + (1 - alpha) * CE(student, hard labels)
+
+The T^2 factor keeps gradient magnitude comparable across temperatures.
+The teacher may be a DIFFERENT architecture (bigger d_model/layers) —
+only the vocab must match; its forward runs OUTSIDE the grad closure
+(no activations kept, bf16 weights by default).
+
+Anchor invariant (tests/test_distill.py): teacher == student makes the
+KL term exactly 0, so with alpha=1 the loss is 0 at step 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpufw.ops.loss import _chunk_seq
+from tpufw.train.trainer import (
+    Trainer,
+    frozen_copy,
+    head_kernel,
+    shift_and_mask,
+)
+
+
+def chunked_distill_loss(
+    student_hidden: jax.Array,
+    student_kernel: jax.Array,
+    teacher_hidden: jax.Array,
+    teacher_kernel: jax.Array,
+    targets: jax.Array,
+    mask: jax.Array,
+    temperature: float = 1.0,
+    alpha: float = 0.5,
+    chunk_size: int = 256,
+    compute_dtype=jnp.bfloat16,
+    student_soft_cap: Optional[float] = None,
+    teacher_soft_cap: Optional[float] = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(total, kl, ce) masked means, chunked over the sequence axis.
+
+    kl is the temperature-softened KL(teacher || student) * T^2; ce is
+    the hard-label cross entropy (no z-loss — distillation already
+    regularizes the student's distribution toward the teacher's).
+    Student and teacher vocab sizes must match. The soft caps are each
+    model's final-logit tanh cap (Gemma) — return_hidden skipped the
+    models' own cap application, so it must be re-applied here BEFORE
+    temperature scaling or a capped model distills the wrong
+    distribution; the two can differ (different architectures).
+    """
+    if student_kernel.shape[-1] != teacher_kernel.shape[-1]:
+        raise ValueError(
+            f"student vocab {student_kernel.shape[-1]} != teacher vocab "
+            f"{teacher_kernel.shape[-1]}: distillation KL needs one vocab"
+        )
+    mask = mask.astype(jnp.float32)
+    hs, ts, ms = _chunk_seq(chunk_size, student_hidden, targets, mask)
+    # Teacher hidden may have a different feature dim; _chunk_seq only
+    # needs [B, T, D*]. targets/mask re-chunked identically (discarded).
+    ht, _, _ = _chunk_seq(chunk_size, teacher_hidden, targets, mask)
+
+    inv_t = 1.0 / temperature
+
+    @jax.checkpoint
+    def body(carry, xs):
+        from tpufw.ops.attention import tanh_soft_cap
+
+        h_s, h_t, t_c, m_c = xs
+        s_logits = jnp.einsum(
+            "bcd,dv->bcv",
+            h_s.astype(compute_dtype),
+            student_kernel.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        if student_soft_cap is not None:
+            s_logits = tanh_soft_cap(s_logits, student_soft_cap)
+        t_logits = jnp.einsum(
+            "bcd,dv->bcv",
+            h_t.astype(compute_dtype),
+            teacher_kernel.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        if teacher_soft_cap is not None:
+            t_logits = tanh_soft_cap(t_logits, teacher_soft_cap)
+        s_logp = jax.nn.log_softmax(s_logits * inv_t, axis=-1)
+        t_logp = jax.nn.log_softmax(t_logits * inv_t, axis=-1)
+        t_p = jnp.exp(t_logp)
+        # KL(t||s) per position; teacher term is constant in the student
+        # but kept so the metric reads as a true KL (0 at equality).
+        kl_tok = (t_p * (t_logp - s_logp)).sum(-1)
+        ce_tok = -jnp.take_along_axis(
+            jax.nn.log_softmax(s_logits, axis=-1), t_c[..., None], -1
+        )[..., 0]
+        kl_sum, ce_sum, n_sum = carry
+        return (
+            kl_sum + (kl_tok * m_c).sum(),
+            ce_sum + (ce_tok * m_c).sum(),
+            n_sum + m_c.sum(),
+        ), None
+
+    (kl_sum, ce_sum, n), _ = jax.lax.scan(
+        body,
+        (
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32),
+        ),
+        (hs, ht, ts, ms),
+    )
+    n_safe = jnp.maximum(n, 1.0)
+    kl = (temperature**2) * kl_sum / n_safe
+    ce = ce_sum / n_safe
+    return alpha * kl + (1.0 - alpha) * ce, kl, ce
+
+
+@dataclasses.dataclass(frozen=True)
+class DistillConfig:
+    # Softmax temperature for both distributions (the KL term).
+    temperature: float = 2.0
+    # KL weight; (1 - alpha) goes to hard-label CE. 1.0 = pure KL.
+    alpha: float = 0.5
+    # Storage dtype of the frozen teacher weights.
+    teacher_dtype: str = "bfloat16"
+
+
+def distill_train_step(
+    state,
+    teacher_params,
+    batch: dict,
+    teacher_apply_fn=None,
+    temperature: float = 2.0,
+    alpha: float = 0.5,
+    loss_chunk_size: int = 256,
+    loss_chunk_dtype: str = "bfloat16",
+    student_soft_cap: Optional[float] = None,
+    teacher_soft_cap: Optional[float] = None,
+):
+    """One distillation update on a packed LM batch.
+
+    The teacher forward (possibly a different architecture) runs outside
+    the grad closure. MoE student aux loss joins the objective as in
+    tpufw.train.trainer.batch_loss.
+    """
+    inputs, targets, seg_in, mask = shift_and_mask(batch)
+    dtype = jnp.dtype(loss_chunk_dtype)
+
+    def hidden_of(apply_fn, params):
+        out = apply_fn(
+            {"params": params}, inputs, segment_ids=seg_in,
+            return_hidden=True,
+        )
+        aux = 0.0
+        if isinstance(out, tuple):
+            out, aux = out
+        return out, aux
+
+    t_hidden, _ = hidden_of(teacher_apply_fn, teacher_params)
+    t_hidden = jax.lax.stop_gradient(t_hidden)
+    t_kernel = jax.lax.stop_gradient(head_kernel(teacher_params))
+
+    def lf(params):
+        s_hidden, aux = hidden_of(state.apply_fn, params)
+        total, kl, ce = chunked_distill_loss(
+            s_hidden, head_kernel(params), t_hidden, t_kernel,
+            targets, mask if mask is not None else jnp.ones_like(
+                targets, jnp.float32
+            ),
+            temperature=temperature, alpha=alpha,
+            chunk_size=loss_chunk_size, compute_dtype=dtype,
+            student_soft_cap=student_soft_cap,
+            teacher_soft_cap=teacher_soft_cap,
+        )
+        return total + aux, (kl, ce)
+
+    (loss, (kl, ce)), grads = jax.value_and_grad(lf, has_aux=True)(
+        state.params
+    )
+    new_state = state.apply_gradients(grads)
+    return new_state, {
+        "loss": loss,
+        "kl_loss": kl,
+        "ce_loss": ce,
+        "grad_norm": optax.global_norm(grads),
+    }
+
+
+class DistillTrainer(Trainer):
+    """Trainer whose objective distills a frozen teacher into the
+    (smaller) student ``model``. run()/checkpointing/preemption/metering
+    are inherited; ``set_teacher`` must be called before the first step.
+
+    The teacher's FLOPs are not charged in MFU — pass an adjusted
+    ``model_flops_per_token`` to ``run`` if comparing against plain LM
+    training (student 6N + teacher forward 2N_t per token).
+    """
+
+    def __init__(
+        self,
+        model,
+        trainer_cfg,
+        mesh_cfg=None,
+        mesh=None,
+        tx=None,
+        distill: DistillConfig = DistillConfig(),
+    ):
+        super().__init__(model, trainer_cfg, mesh_cfg, mesh, tx)
+        if trainer_cfg.grad_accum != 1:
+            raise NotImplementedError(
+                "DistillTrainer does not implement grad_accum; "
+                "silently ignoring it would change optimization "
+                "semantics vs the base Trainer"
+            )
+        self.distill = distill
+        self.teacher_model = None
+        self.teacher_params = None
+
+    def set_teacher(self, teacher_model, teacher_params):
+        """Install the frozen teacher (any decoder with the student's
+        vocab). Params are cast to ``teacher_dtype`` through jit so the
+        stored tree never aliases donated buffers."""
+        s_vocab = getattr(getattr(self.model, "cfg", None), "vocab_size", None)
+        t_vocab = getattr(
+            getattr(teacher_model, "cfg", None), "vocab_size", None
+        )
+        if s_vocab is not None and t_vocab is not None and s_vocab != t_vocab:
+            raise ValueError(
+                f"teacher vocab {t_vocab} != student vocab {s_vocab}"
+            )
+        from flax import linen as nn
+        from flax.core import meta
+
+        from tpufw.mesh import logical_axis_rules
+        from tpufw.parallel.context import use_mesh
+
+        # Lay the teacher out on the mesh with the same logical rules
+        # as any param tree: a multi-B-param teacher held unsharded
+        # would OOM exactly the configurations chunked logits exist to
+        # fit. eval_shape under the mesh recovers the flax Partitioned
+        # metadata the user's unboxed tree no longer carries.
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        with use_mesh(self.mesh):
+            abstract = jax.eval_shape(
+                lambda r: teacher_model.init(r, tokens)["params"],
+                jax.random.key(0),
+            )
+        specs = nn.get_partition_spec(abstract)
+        self._teacher_sharding = meta.unbox(
+            nn.logical_to_mesh_sharding(
+                specs, self.mesh, logical_axis_rules()
+            )
+        )
+        self.teacher_model = teacher_model
+        self.teacher_params = frozen_copy(
+            teacher_params,
+            jnp.dtype(self.distill.teacher_dtype),
+            out_shardings=self._teacher_sharding,
+        )
+
+    def compiled_step(self, batch: dict | None = None):
+        from functools import partial
+
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        if self.teacher_params is None:
+            raise RuntimeError(
+                "distillation step before set_teacher(): install the "
+                "frozen teacher first"
+            )
+        key = (
+            ("distill", "tokens")
+            if batch is None
+            else ("distill", *sorted(batch.keys()))
+        )
+        if key not in self._compiled:
+            row = NamedSharding(self.mesh, P(("data", "fsdp")))
+            batch_sharding = {k: row for k in key[1:]}
+            t_cap = getattr(
+                getattr(self.teacher_model, "cfg", None),
+                "final_logit_soft_cap", None,
+            )
+            jitted = jax.jit(
+                partial(
+                    distill_train_step,
+                    teacher_apply_fn=self.teacher_model.apply,
+                    temperature=self.distill.temperature,
+                    alpha=self.distill.alpha,
+                    loss_chunk_size=self.cfg.loss_chunk_size or 256,
+                    loss_chunk_dtype=self.cfg.loss_chunk_dtype,
+                    student_soft_cap=self._final_soft_cap(),
+                    teacher_soft_cap=t_cap,
+                ),
+                in_shardings=(
+                    self.state_sharding,
+                    self._teacher_sharding,
+                    batch_sharding,
+                ),
+                out_shardings=(self.state_sharding, None),
+                donate_argnums=(0,),
+            )
+            self._compiled[key] = lambda state, b: jitted(
+                state, self.teacher_params, b
+            )
+        return self._compiled[key]

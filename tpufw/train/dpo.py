@@ -36,14 +36,19 @@ from __future__ import annotations
 import dataclasses
 import json
 import pathlib
-from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+from typing import Callable, Iterator, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from tpufw.train.sft import _TEMPLATES, render_conversation
-from tpufw.train.trainer import Trainer, head_kernel, shift_and_mask
+from tpufw.train.trainer import (
+    Trainer,
+    frozen_copy,
+    head_kernel,
+    shift_and_mask,
+)
 
 # ----------------------------------------------------------------------
 # Data: preference pairs -> [2B, T] batches
@@ -108,27 +113,45 @@ def encode_pair(
     return tc, mc, tr, mr
 
 
-def _fit_row(
+def _pad_row(
     toks: np.ndarray, mask: np.ndarray, seq_len: int
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Right-pad (segment 0) or left-truncate to ``seq_len``. Truncation
-    drops the OLDEST prompt tokens first — the response span must
-    survive whole or its logprob sum is meaningless."""
+    """Right-pad one fitted row to ``seq_len`` (padding is segment 0)."""
     n = len(toks)
-    if n > seq_len:
-        resp = int(mask.sum())
-        if resp >= seq_len:
-            raise ValueError(
-                f"response ({resp} tokens) does not fit in "
-                f"seq_len={seq_len}; raise seq_len or filter the pair"
-            )
-        toks, mask = toks[n - seq_len:], mask[n - seq_len:]
-        n = seq_len
     out_t = np.zeros(seq_len, np.int32)
     out_m = np.zeros(seq_len, np.float32)
     seg = np.zeros(seq_len, np.int32)
     out_t[:n], out_m[:n], seg[:n] = toks, mask, 1
     return out_t, out_m, seg
+
+
+def _fit_pair(
+    tc: np.ndarray,
+    mc: np.ndarray,
+    tr: np.ndarray,
+    mr: np.ndarray,
+    seq_len: int,
+):
+    """Fit BOTH rows of a pair to ``seq_len`` with one shared left
+    truncation: both rows drop the same count of OLDEST prompt tokens
+    (the pair's worst-case overflow), so chosen and rejected keep the
+    IDENTICAL prompt suffix. Truncating each row independently would
+    score the two responses against different contexts — a systematic
+    length-correlated reward bias (DPO conditions both on the same x).
+    """
+    drop = max(len(tc), len(tr)) - seq_len
+    if drop > 0:
+        resp = max(int(mc.sum()), int(mr.sum()))
+        if resp >= seq_len:
+            raise ValueError(
+                f"response ({resp} tokens) does not fit in "
+                f"seq_len={seq_len}; raise seq_len or filter the pair"
+            )
+        # drop <= prompt length: both rows share the prompt, and the
+        # longer row is prompt + its response < prompt + seq_len.
+        tc, mc = tc[drop:], mc[drop:]
+        tr, mr = tr[drop:], mr[drop:]
+    return _pad_row(tc, mc, seq_len), _pad_row(tr, mr, seq_len)
 
 
 def dpo_batches(
@@ -173,12 +196,14 @@ def dpo_batches(
             seg = np.zeros((2 * batch_pairs, seq_len), np.int32)
             for row, i in enumerate(idx):
                 tc, mc, tr, mr = encoded[i]
-                toks[2 * row], mask[2 * row], seg[2 * row] = _fit_row(
-                    tc, mc, seq_len
-                )
-                toks[2 * row + 1], mask[2 * row + 1], seg[
-                    2 * row + 1
-                ] = _fit_row(tr, mr, seq_len)
+                (
+                    (toks[2 * row], mask[2 * row], seg[2 * row]),
+                    (
+                        toks[2 * row + 1],
+                        mask[2 * row + 1],
+                        seg[2 * row + 1],
+                    ),
+                ) = _fit_pair(tc, mc, tr, mr, seq_len)
             yield {
                 "tokens": toks,
                 "loss_mask": mask,
@@ -282,6 +307,12 @@ def dpo_train_step(
     tpufw.train.trainer.batch_loss.
     """
     inputs, targets, seg_in, mask = shift_and_mask(batch)
+    if mask is None:
+        raise ValueError(
+            "DPO batch has neither loss_mask nor segment_ids: without a "
+            "response mask the pairwise logprob sums would score entire "
+            "rows (prompt included) — use tpufw.train.dpo.dpo_batches"
+        )
     dtype = jnp.dtype(loss_chunk_dtype)
 
     ref_logps, _ = _sequence_logps(
@@ -362,21 +393,9 @@ class DPOTrainer(Trainer):
         """Freeze the CURRENT policy params as the reference (cast to
         ref_dtype). Correct at step 0 — after SFT import or fresh init —
         which is exactly when DPO starts."""
-        dt = jnp.dtype(self.dpo.ref_dtype)
-
-        def cast(tree):
-            return jax.tree.map(
-                lambda p: p.astype(dt)
-                if jnp.issubdtype(p.dtype, jnp.floating)
-                else p,
-                tree,
-            )
-
-        # Through jit so every leaf gets a FRESH buffer even when the
-        # cast is a dtype no-op (fp32 -> fp32): the train step donates
-        # state.params, and an aliased reference would be a
-        # use-after-donate at the first step.
-        self.ref_params = jax.jit(cast)(self.state.params)
+        self.ref_params = frozen_copy(
+            self.state.params, jnp.dtype(self.dpo.ref_dtype)
+        )
 
     def init_state(self, seed: int = 0):
         out = super().init_state(seed)

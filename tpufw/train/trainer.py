@@ -95,6 +95,28 @@ def default_optimizer(
     )
 
 
+def frozen_copy(tree, dtype, out_shardings=None) -> Any:
+    """Cast every floating leaf of ``tree`` to ``dtype`` THROUGH jit, so
+    each output leaf is a FRESH buffer even when the cast is a dtype
+    no-op (fp32 -> fp32): frozen side-trees (DPO reference, distillation
+    teacher) live next to a train step that donates state.params, and an
+    aliased leaf would be a use-after-donate at the first step.
+    ``out_shardings`` additionally lays the copy out on the mesh (a
+    large frozen teacher must shard like any other param tree)."""
+
+    def cast(t):
+        return jax.tree.map(
+            lambda p: p.astype(dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating)
+            else p,
+            t,
+        )
+
+    if out_shardings is None:
+        return jax.jit(cast)(tree)
+    return jax.jit(cast, out_shardings=out_shardings)(tree)
+
+
 def head_kernel(params) -> jax.Array:
     """The [D, V] LM-head matrix from a decoder_lm param tree — the
     dedicated ``lm_head`` kernel, or the transposed embedding when tied."""
