@@ -347,11 +347,19 @@ def _orchestrate() -> int:
 
 
 def _is_oom(e: Exception) -> bool:
+    """Memory-driven tier failures worth DEGRADING on (vs real bugs
+    worth raising). Through the tunneled backend, a compile-time HBM
+    bound surfaces as `HTTP 500: tpu_compile_helper subprocess exit
+    code 1` from /remote_compile (measured r3: attn_out at batch >= 20)
+    — treat it as degradable too, else the first-tier ladder aborts the
+    whole bench on a chip with slightly less free HBM."""
     msg = str(e)
     return (
         "RESOURCE_EXHAUSTED" in msg
         or "out of memory" in msg.lower()
         or "Out of memory" in msg
+        or "tpu_compile_helper" in msg
+        or "remote_compile" in msg
     )
 
 
@@ -477,17 +485,20 @@ def _worker() -> int:
         model_cfg = bench_model_config()
         name = BENCH_CONFIG_NAME
         warmup, measured = 3, 10
-        # Tier shape measured on v5e (round 2 sweeps): the "dots" remat
-        # policy saves every projection output, so the two [B,T,d_ff]
-        # MLP intermediates cap the batch at 4 (36.8% MFU). Full remat
-        # ("nothing") recomputes the block in bwd and unlocks batch 24
-        # at 46.2% MFU — recompute is cheaper than the lost batch
-        # parallelism at this size. Chunked-vocab CE (512) keeps logits
-        # off HBM either way. Tiers degrade on OOM rather than fail;
-        # (batch, seq, ce_chunk, remat_policy).
+        # Tier shapes measured on v5e (round-2/3 sweeps): the "dots"
+        # remat policy saves every projection output, so the two
+        # [B,T,d_ff] MLP intermediates cap the batch at 4 (36.8% MFU).
+        # Full remat ("nothing") unlocks batch 24 (46.2-48.8% MFU);
+        # "attn_out" saves ONLY each block's [B,T,D] attention output so
+        # backward skips re-running the flash kernel — best measured
+        # config (r3 sweep: 48.9% MFU / 27243 tok/s at batch 16, edging
+        # batch-24 full remat at 48.8%; batch >= 20 attn_out fails
+        # server-side compile on the 16G chip). Chunked-vocab CE (512)
+        # keeps logits off HBM in every tier. Tiers degrade on OOM
+        # rather than fail; (batch, seq, ce_chunk, remat_policy).
         tiers = [
+            (16, 2048, 512, "attn_out"),
             (24, 2048, 512, "nothing"),
-            (16, 2048, 512, "nothing"),
             (8, 2048, 512, "nothing"),
             (4, 2048, 512, "dots"),
         ]

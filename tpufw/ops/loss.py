@@ -44,10 +44,13 @@ def token_cross_entropy(
 
 
 def _chunk_stats(
-    h, kernel, targets, z_loss_weight, compute_dtype, logits_soft_cap
+    h, kernel, targets, z_loss_weight, compute_dtype, logits_soft_cap,
+    logits_scale: float = 1.0,
 ):
     """CE statistics for one sequence chunk. h: [B, C, D], kernel: [D, V],
-    targets: [B, C] -> per-token ce [B, C] (z-loss included)."""
+    targets: [B, C] -> per-token ce [B, C] (z-loss included).
+    ``logits_scale`` applies after the cap (temperature, see
+    chunked_token_logprob)."""
     logits = jnp.einsum(
         "bcd,dv->bcv",
         h.astype(compute_dtype),
@@ -60,6 +63,8 @@ def _chunk_stats(
         # Gemma final-logit soft-cap: elementwise, so it distributes over
         # chunks — parity with the model's full-logits forward.
         logits = tanh_soft_cap(logits, logits_soft_cap)
+    if logits_scale != 1.0:
+        logits = logits * logits_scale
     return token_cross_entropy(logits, targets, z_loss_weight)
 
 
@@ -175,3 +180,42 @@ def chunked_sequence_logprob(
 
     sums, _ = lax.scan(body, jnp.zeros((b,), jnp.float32), (hs, ts, ms))
     return sums
+
+
+def chunked_token_logprob(
+    hidden: jax.Array,
+    kernel: jax.Array,
+    targets: jax.Array,
+    chunk_size: int = 256,
+    compute_dtype=jnp.bfloat16,
+    logits_soft_cap: Optional[float] = None,
+    logits_scale: float = 1.0,
+) -> jax.Array:
+    """PER-TOKEN target log-probabilities [B, T], chunked like
+    ``chunked_cross_entropy`` (no z-loss). Policy-gradient objectives
+    (tpufw.train.grpo) need every token's log-prob for importance
+    ratios — a [B, T] fp32 output is tiny next to the [B, C, V] chunk
+    logits this scan never keeps alive.
+
+    ``logits_scale`` (= 1/sampling_temperature) is applied AFTER the
+    soft cap, matching the decode path's order exactly: the model caps
+    its own final logits, then ``sample_token`` divides by temperature
+    (tpufw.infer.sampling) — so these log-probs are the behavior
+    policy's.
+    """
+    b, t, _ = hidden.shape
+    ones = jnp.ones((b, t), jnp.float32)
+    hs, ts, _ = _chunk_seq(chunk_size, hidden, targets, ones)
+
+    @jax.checkpoint
+    def body(_, xs):
+        h_c, t_c = xs
+        nll = _chunk_stats(
+            h_c, kernel, t_c, 0.0, compute_dtype, logits_soft_cap,
+            logits_scale,
+        )
+        return None, -nll  # [B, C] per-chunk logp
+
+    _, chunks = lax.scan(body, None, (hs, ts))
+    # [n_chunks, B, C] -> [B, n_chunks * C], drop the chunk padding.
+    return chunks.swapaxes(0, 1).reshape(b, -1)[:, :t]

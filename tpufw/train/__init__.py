@@ -42,6 +42,12 @@ from tpufw.train.distill import (  # noqa: F401
     DistillTrainer,
     distill_train_step,
 )
+from tpufw.train.grpo import (  # noqa: F401
+    GRPOConfig,
+    GRPOTrainer,
+    group_advantages,
+    grpo_train_step,
+)
 from tpufw.train.vision import (  # noqa: F401
     VisionTrainer,
     VisionTrainerConfig,

@@ -1,0 +1,476 @@
+"""GRPO: Group Relative Policy Optimization (RL fine-tuning).
+
+The reference ships no ML workloads at all (its "workload" is a
+diagnostic CLI, reference README.md:314); GRPO is the on-policy RL
+stage that completes the post-training suite (SFT -> DPO/distill ->
+RL), using the critic-free group baseline of DeepSeekMath/R1: sample
+``group_size`` completions per prompt, score them with a user reward
+function, and normalize rewards WITHIN each prompt's group into
+advantages — no value network, which on TPU means no second model to
+shard or train.
+
+TPU-first shape discipline:
+- Rollout rows are RIGHT-padded [N, T] (prompt at position 0), so the
+  scoring/training forward's default absolute positions match the RoPE
+  positions the decode cache used at generation time exactly.
+- Per-token log-probs come from ``chunked_token_logprob``
+  (tpufw.ops.loss): the [B, C, V] chunk logits are never kept, the
+  [N, T] fp32 ratio inputs are tiny.
+- The generation itself is the existing jitted KV-cache scan
+  (tpufw.infer.generate) — one compiled program per rollout shape.
+
+Objective (clipped importance ratio, sequence-level group advantage,
+optional k3 KL penalty to the frozen reference):
+
+  ratio_t = exp(logpi(y_t) - logpi_old(y_t))
+  obj_t   = min(ratio_t * A, clip(ratio_t, 1-eps, 1+eps) * A)
+  kl_t    = exp(ref_t - pol_t) - (ref_t - pol_t) - 1        # k3, >= 0
+  loss    = -mean_completion_tokens(obj_t - kl_beta * kl_t)
+
+Anchor invariant (tests/test_grpo.py): immediately after a rollout the
+policy equals the old policy, so every ratio is exactly 1 and the
+clipped min() is inactive; and each group's advantages sum to ~0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpufw.ops.loss import chunked_token_logprob
+from tpufw.train.trainer import Trainer, frozen_copy, head_kernel
+
+
+@dataclasses.dataclass(frozen=True)
+class GRPOConfig:
+    # Completions sampled per prompt; advantages normalize within the
+    # group. 4-16 is the common range.
+    group_size: int = 8
+    # PPO-style ratio clip.
+    clip_eps: float = 0.2
+    # k3-KL penalty weight to the frozen reference; 0 disables the
+    # reference forward entirely.
+    kl_beta: float = 0.0
+    # Rollout sampling temperature (0 would collapse the group).
+    temperature: float = 1.0
+    # Generated tokens per completion.
+    max_new_tokens: int = 64
+    # Storage dtype of the frozen reference weights (kl_beta > 0).
+    ref_dtype: str = "bfloat16"
+    # Stop-token for completions (mask ends at the first EOS,
+    # inclusive); None = fixed-length completions.
+    eos_id: Optional[int] = None
+
+
+def group_advantages(
+    rewards: np.ndarray, group_size: int, eps: float = 1e-6
+) -> np.ndarray:
+    """[N] rewards (rows grouped CONTIGUOUSLY: rows [i*K, (i+1)*K) are
+    prompt i's K completions) -> [N] group-normalized advantages
+    (r - mean_group) / (std_group + eps). A group with identical
+    rewards gets advantage 0 — no learning signal, by design."""
+    r = np.asarray(rewards, np.float32)
+    if r.ndim != 1 or r.shape[0] % group_size:
+        raise ValueError(
+            f"rewards shape {r.shape} not divisible into groups of "
+            f"{group_size}"
+        )
+    g = r.reshape(-1, group_size)
+    adv = (g - g.mean(axis=1, keepdims=True)) / (
+        g.std(axis=1, keepdims=True) + eps
+    )
+    return adv.reshape(-1)
+
+
+def grpo_train_step(
+    state,
+    ref_params,
+    batch: dict,
+    clip_eps: float = 0.2,
+    kl_beta: float = 0.0,
+    temperature: float = 1.0,
+    loss_chunk_size: int = 256,
+    loss_chunk_dtype: str = "bfloat16",
+    final_logit_soft_cap: Optional[float] = None,
+):
+    """One GRPO update on a rollout batch.
+
+    batch: tokens [N, T] (right-padded prompt+completion),
+    loss_mask [N, T] (1 on COMPLETION tokens), segment_ids [N, T],
+    old_logp [N, T-1] (per-TARGET log-probs under the rollout policy),
+    advantages [N]. ``ref_params`` may be None when kl_beta == 0.
+
+    ``temperature`` must be the ROLLOUT sampling temperature: the
+    behavior policy the tokens were drawn from is
+    softmax(logits / temperature), so the importance ratios (and the
+    KL) are computed on the SAME tempered distribution — untempered
+    ratios would anchor at 1 but weight the objective by a
+    distribution nobody sampled from.
+    """
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    seg_in = batch["segment_ids"][:, :-1]
+    # Target-position mask, the LM shift convention (trainer.py
+    # shift_and_mask): a target position trains iff the PREDICTED token
+    # is a completion token.
+    mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
+    old_logp = batch["old_logp"]
+    adv = batch["advantages"][:, None].astype(jnp.float32)
+    dtype = jnp.dtype(loss_chunk_dtype)
+
+    def token_logps(params):
+        out = state.apply_fn(
+            {"params": params}, inputs, segment_ids=seg_in,
+            return_hidden=True,
+        )
+        aux = 0.0
+        if isinstance(out, tuple):
+            out, aux = out
+        logp = chunked_token_logprob(
+            out, head_kernel(params), targets,
+            chunk_size=loss_chunk_size, compute_dtype=dtype,
+            logits_soft_cap=final_logit_soft_cap,
+            logits_scale=1.0 / temperature,
+        )
+        return logp, aux
+
+    ref_logp = None
+    if kl_beta > 0.0:
+        ref_logp, _ = token_logps(ref_params)
+        ref_logp = jax.lax.stop_gradient(ref_logp)
+
+    n = jnp.maximum(mask.sum(), 1.0)
+
+    def lf(params):
+        logp, aux = token_logps(params)
+        ratio = jnp.exp(logp - old_logp)
+        clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps)
+        obj = jnp.minimum(ratio * adv, clipped * adv)
+        if ref_logp is not None:
+            d = ref_logp - logp
+            kl = jnp.exp(d) - d - 1.0  # k3 estimator, >= 0
+            obj = obj - kl_beta * kl
+            kl_mean = (kl * mask).sum() / n
+        else:
+            kl_mean = jnp.zeros(())
+        loss = -(obj * mask).sum() / n
+        # Fraction of tokens where the clip BINDS (the clipped term is
+        # the smaller one the min() picks).
+        clip_frac = ((clipped * adv < ratio * adv) * mask).sum() / n
+        return loss + aux, (ratio, kl_mean, clip_frac)
+
+    (loss, (ratio, kl_mean, clip_frac)), grads = jax.value_and_grad(
+        lf, has_aux=True
+    )(state.params)
+    new_state = state.apply_gradients(grads)
+    return new_state, {
+        "loss": loss,
+        "grad_norm": optax.global_norm(grads),
+        "mean_ratio": (ratio * mask).sum() / n,
+        "clip_frac": clip_frac,
+        "kl": kl_mean,
+    }
+
+
+class GRPOTrainer(Trainer):
+    """Trainer specialized for GRPO rollouts + updates.
+
+    ``TrainerConfig.batch_size`` must equal prompts_per_step *
+    group_size (the rollout row count N); ``TrainerConfig.seq_len``
+    bounds prompt + max_new_tokens. The RL loop is explicit
+    (``rollout`` then the compiled step) because data depends on the
+    current policy — see ``run_rl`` for the packaged loop.
+    """
+
+    def __init__(
+        self,
+        model,
+        trainer_cfg,
+        mesh_cfg=None,
+        mesh=None,
+        tx=None,
+        grpo: GRPOConfig = GRPOConfig(),
+    ):
+        super().__init__(model, trainer_cfg, mesh_cfg, mesh, tx)
+        if trainer_cfg.batch_size % grpo.group_size:
+            raise ValueError(
+                f"batch_size {trainer_cfg.batch_size} must be a "
+                f"multiple of group_size {grpo.group_size}"
+            )
+        if trainer_cfg.grad_accum != 1:
+            raise NotImplementedError(
+                "GRPO does not implement grad_accum: microbatch "
+                "slicing would split a prompt's group across updates"
+            )
+        self.grpo = grpo
+        self.ref_params = None
+        self._decode_model = None
+        self._score_fn = None
+
+    # -- reference ---------------------------------------------------------
+
+    def _snapshot_reference(self):
+        self.ref_params = frozen_copy(
+            self.state.params, jnp.dtype(self.grpo.ref_dtype)
+        )
+
+    def init_state(self, seed: int = 0):
+        out = super().init_state(seed)
+        if self.grpo.kl_beta > 0.0:
+            self._snapshot_reference()
+        return out
+
+    def init_from_params(self, path: str, seed: int = 0):
+        out = super().init_from_params(path, seed)
+        if self.grpo.kl_beta > 0.0:
+            self._snapshot_reference()
+        return out
+
+    def maybe_restore(self) -> bool:
+        """Mid-run resume: the restored POLICY must not become the KL
+        reference (same contract as DPOTrainer.maybe_restore) — with
+        kl_beta > 0 a reference snapshotted from the pre-restore init
+        would anchor the penalty to random weights."""
+        restored = super().maybe_restore()
+        if (
+            self.grpo.kl_beta > 0.0
+            and restored
+            and int(self.state.step) > 0
+        ):
+            raise RuntimeError(
+                "resumed a GRPO run mid-training with kl_beta > 0: the "
+                "KL reference must anchor to the ORIGINAL step-0 "
+                "weights — call init_from_params on the base "
+                "checkpoint first"
+            )
+        return restored
+
+    # -- rollout -----------------------------------------------------------
+
+    def _decode(self):
+        if self._decode_model is None:
+            cfg = dataclasses.replace(
+                self.model.cfg.decode_config(),
+                max_seq_len=self.cfg.seq_len,
+            )
+            self._decode_model = type(self.model)(cfg)
+        return self._decode_model
+
+    def _score(self, tokens, seg):
+        """Per-target log-probs of ``tokens`` under CURRENT params —
+        the rollout policy snapshot the ratios divide by."""
+        if self._score_fn is None:
+            from functools import partial
+
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            row = NamedSharding(self.mesh, P(("data", "fsdp")))
+
+            def score(params, tokens, seg):
+                out = self.model.apply(
+                    {"params": params},
+                    tokens[:, :-1],
+                    segment_ids=seg[:, :-1],
+                    return_hidden=True,
+                )
+                if isinstance(out, tuple):
+                    out = out[0]
+                # Tempered like the sampler: old_logp must be the
+                # behavior policy's distribution (see grpo_train_step).
+                return chunked_token_logprob(
+                    out, head_kernel(params), tokens[:, 1:],
+                    chunk_size=self.cfg.loss_chunk_size or 256,
+                    compute_dtype=jnp.dtype(self.cfg.loss_chunk_dtype),
+                    logits_soft_cap=self._final_soft_cap(),
+                    logits_scale=1.0 / self.grpo.temperature,
+                )
+
+            self._score_fn = jax.jit(
+                score,
+                in_shardings=(self.state_sharding.params, row, row),
+                out_shardings=None,
+            )
+        return self._score_fn(self.state.params, tokens, seg)
+
+    def rollout(
+        self,
+        prompts: Sequence[Sequence[int]],
+        reward_fn: Callable[[List[List[int]], List[List[int]]], np.ndarray],
+        rng: jax.Array,
+    ) -> tuple[dict, dict]:
+        """Sample group_size completions per prompt, score rewards, and
+        assemble one training batch.
+
+        ``reward_fn(prompt_tokens, completion_tokens) -> [N] rewards``
+        receives python token lists (N = len(prompts) * group_size,
+        completions truncated at EOS when configured); decoding to text
+        is the caller's concern.
+
+        Returns (batch, info): batch feeds ``compiled_step``; info has
+        host-side rollout metrics (mean/max reward, completion length).
+        """
+        from tpufw.infer import SamplingConfig, generate, pad_prompts
+
+        if self.state is None:
+            raise RuntimeError("rollout() before init_state()/restore")
+        g = self.grpo
+        n = len(prompts) * g.group_size
+        if n != self.cfg.batch_size:
+            raise ValueError(
+                f"{len(prompts)} prompts x group {g.group_size} = {n} "
+                f"rows != batch_size {self.cfg.batch_size}"
+            )
+        max_p = max(len(p) for p in prompts)
+        if max_p + g.max_new_tokens > self.cfg.seq_len:
+            raise ValueError(
+                f"prompt ({max_p}) + max_new_tokens "
+                f"({g.max_new_tokens}) exceeds seq_len {self.cfg.seq_len}"
+            )
+        tiled = [list(p) for p in prompts for _ in range(g.group_size)]
+        ptoks, pads = pad_prompts(tiled)
+        completions = np.asarray(
+            generate(
+                self._decode(),
+                self.state.params,
+                jnp.asarray(ptoks),
+                jnp.asarray(pads),
+                rng,
+                max_new_tokens=g.max_new_tokens,
+                sampling=SamplingConfig(temperature=g.temperature),
+                eos_id=g.eos_id,
+            )
+        )
+
+        # Right-padded training rows: prompt at position 0 (absolute
+        # positions then match the decode-time RoPE positions).
+        t = self.cfg.seq_len
+        tokens = np.zeros((n, t), np.int32)
+        loss_mask = np.zeros((n, t), np.float32)
+        seg = np.zeros((n, t), np.int32)
+        comp_lists: List[List[int]] = []
+        for i, p in enumerate(tiled):
+            comp = completions[i].tolist()
+            if g.eos_id is not None and g.eos_id in comp:
+                comp = comp[: comp.index(g.eos_id) + 1]
+            comp_lists.append(comp)
+            row = p + comp
+            tokens[i, : len(row)] = row
+            seg[i, : len(row)] = 1
+            loss_mask[i, len(p): len(row)] = 1.0
+
+        rewards = np.asarray(reward_fn(tiled, comp_lists), np.float32)
+        adv = group_advantages(rewards, g.group_size)
+        old_logp = np.asarray(self._score(tokens, seg), np.float32)
+        batch = {
+            "tokens": tokens,
+            "loss_mask": loss_mask,
+            "segment_ids": seg,
+            "old_logp": old_logp,
+            "advantages": adv,
+        }
+        info = {
+            "reward_mean": float(rewards.mean()),
+            "reward_max": float(rewards.max()),
+            "completion_len_mean": float(
+                np.mean([len(c) for c in comp_lists])
+            ),
+        }
+        return batch, info
+
+    # -- step --------------------------------------------------------------
+
+    def compiled_step(self, batch: dict | None = None):
+        from functools import partial
+
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        if self.grpo.kl_beta > 0.0 and self.ref_params is None:
+            raise RuntimeError(
+                "GRPO step with kl_beta > 0 before the reference "
+                "snapshot: call init_state()/init_from_params() first"
+            )
+        key = (
+            ("grpo", "tokens")
+            if batch is None
+            else ("grpo", *sorted(batch.keys()))
+        )
+        if key not in self._compiled:
+            row = NamedSharding(self.mesh, P(("data", "fsdp")))
+            batch_sharding = {k: row for k in key[1:]}
+            step = partial(
+                grpo_train_step,
+                clip_eps=self.grpo.clip_eps,
+                kl_beta=self.grpo.kl_beta,
+                temperature=self.grpo.temperature,
+                loss_chunk_size=self.cfg.loss_chunk_size or 256,
+                loss_chunk_dtype=self.cfg.loss_chunk_dtype,
+                final_logit_soft_cap=self._final_soft_cap(),
+            )
+            if self.grpo.kl_beta > 0.0:
+                jitted = jax.jit(
+                    step,
+                    in_shardings=(
+                        self.state_sharding,
+                        self.state_sharding.params,
+                        batch_sharding,
+                    ),
+                    out_shardings=(self.state_sharding, None),
+                    donate_argnums=(0,),
+                )
+                self._compiled[key] = lambda state, b: jitted(
+                    state, self.ref_params, b
+                )
+            else:
+                # ref_params=None (an empty pytree): never pass the
+                # donated state's own params as a dead argument — that
+                # would be a use-after-donate at execution.
+                jitted = jax.jit(
+                    lambda state, b: step(state, None, b),
+                    in_shardings=(self.state_sharding, batch_sharding),
+                    out_shardings=(self.state_sharding, None),
+                    donate_argnums=(0,),
+                )
+                self._compiled[key] = jitted
+        return self._compiled[key]
+
+    def run_rl(
+        self,
+        prompts: Sequence[Sequence[int]],
+        reward_fn,
+        seed: int = 0,
+        on_metrics: Callable[[dict], None] | None = None,
+    ) -> list[dict]:
+        """The packaged RL loop: total_steps x (rollout -> update) on a
+        fixed prompt set. Returns per-step metric dicts (rollout info +
+        step metrics). The policy the i-th rollout samples from is the
+        (i-1)-times-updated one — on-policy by construction."""
+        if self.state is None:
+            self.init_state()
+        from tpufw.parallel.context import use_mesh
+
+        history = []
+        rngs = jax.random.split(
+            jax.random.key(seed), self.cfg.total_steps
+        )
+        with use_mesh(self.mesh):
+            for i in range(self.cfg.total_steps):
+                batch, info = self.rollout(prompts, reward_fn, rngs[i])
+                batch = self.globalize_batch(batch)
+                step_fn = self.compiled_step(batch)
+                self.state, m = step_fn(self.state, batch)
+                entry = {
+                    **info,
+                    **{k: float(v) for k, v in m.items()},
+                    "step": i + 1,
+                }
+                history.append(entry)
+                if on_metrics:
+                    on_metrics(entry)
+        return history
