@@ -358,8 +358,7 @@ def _is_oom(e: Exception) -> bool:
         "RESOURCE_EXHAUSTED" in msg
         or "out of memory" in msg.lower()
         or "Out of memory" in msg
-        or "tpu_compile_helper" in msg
-        or "remote_compile" in msg
+        or "tpu_compile_helper subprocess exit code" in msg
     )
 
 

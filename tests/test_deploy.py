@@ -51,14 +51,15 @@ def _containers(doc: dict) -> list[dict]:
 
 def test_all_baseline_configs_covered():
     # SURVEY.md §7.3 / BASELINE.md: configs 1-5 each have a manifest, plus
-    # smoke-TPU enablement proof, the shared checkpoint PVC, and the
-    # inference serving Job+Service (07, VERDICT r1 item 9).
+    # smoke-TPU enablement proof, the shared checkpoint PVC, the
+    # inference serving Job+Service (07, VERDICT r1 item 9), and the
+    # post-training Jobs (10 DPO, 11 GRPO).
     names = [p.name for p in MANIFESTS]
-    assert len(names) == 10
+    assert len(names) == 12
     kinds = [d["kind"] for p in MANIFESTS for d in load(p)]
     assert kinds.count("Pod") == 3
-    # 04 llama v5e-4, 07 infer, 09 gemma2 v5e-4.
-    assert kinds.count("Job") == 3
+    # 04 llama v5e-4, 07 infer, 09 gemma2 v5e-4, 10 dpo, 11 grpo.
+    assert kinds.count("Job") == 5
     # 05 v5e-16, 06 mixtral ep, 08 pipeline-parallel.
     assert kinds.count("JobSet") == 3
     assert kinds.count("PersistentVolumeClaim") == 1

@@ -209,3 +209,35 @@ def test_guards():
             _low_token_reward,
             jax.random.key(0),
         )
+
+
+def test_run_rl_checkpoints_and_resumes(tmp_path):
+    """run_rl saves TrainState at checkpoint_every and a fresh trainer
+    resumes mid-budget (the JobSet-restart contract, same as
+    Trainer.run)."""
+    ckpt_dir = str(tmp_path / "rl-ckpt")
+
+    def make():
+        cfg = TrainerConfig(
+            batch_size=8, seq_len=24, total_steps=4, lr=1e-3,
+            warmup_steps=1, loss_chunk_size=8, log_every=1,
+            checkpoint_dir=ckpt_dir, checkpoint_every=1,
+        )
+        return GRPOTrainer(
+            Llama(TINY), cfg, MeshConfig(),
+            grpo=GRPOConfig(group_size=4, max_new_tokens=6),
+        )
+
+    t1 = make()
+    t1.init_state()
+    t1.cfg.total_steps = 2  # budget cut: stop "preempted" at step 2
+    h1 = t1.run_rl([[3, 4], [5, 6]], _low_token_reward, seed=7)
+    assert len(h1) == 2
+
+    t2 = make()
+    t2.init_state()
+    assert t2.maybe_restore()
+    assert int(t2.state.step) == 2
+    h2 = t2.run_rl([[3, 4], [5, 6]], _low_token_reward, seed=7)
+    # Global budget: only the REMAINING 2 steps run.
+    assert len(h2) == 2 and h2[-1]["step"] == 4

@@ -75,3 +75,103 @@ def test_train_resnet_main(capsys, monkeypatch):
     assert train_resnet.main() == 0
     out = capsys.readouterr().out
     assert "TRAIN OK: 2 steps" in out
+
+
+def test_train_llama_dpo_objective(capsys, monkeypatch, tmp_path):
+    """TPUFW_DPO_DATA switches the workload to DPOTrainer + pair
+    batches; the first step's loss is the log-2 anchor (ref == policy)."""
+    import math
+
+    path = tmp_path / "pairs.jsonl"
+    with open(path, "w") as f:
+        for i in range(4):
+            f.write(json.dumps({
+                "prompt": f"q {i}", "chosen": "good", "rejected": "bad",
+            }) + "\n")
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_BATCH_SIZE", "8")
+    monkeypatch.setenv("TPUFW_SEQ_LEN", "32")
+    monkeypatch.setenv("TPUFW_TOTAL_STEPS", "2")
+    monkeypatch.setenv("TPUFW_LOG_EVERY", "1")
+    monkeypatch.setenv("TPUFW_LOSS_CHUNK_SIZE", "16")
+    monkeypatch.setenv("TPUFW_DPO_DATA", str(path))
+    from tpufw.workloads import train_llama
+
+    assert train_llama.main() == 0
+    out = capsys.readouterr().out
+    metrics = [
+        json.loads(line) for line in out.splitlines()
+        if line.startswith("{") and "loss" in line
+    ]
+    assert metrics and abs(
+        metrics[0]["loss"] - math.log(2.0)
+    ) < 1e-4
+
+
+def test_train_llama_distill_objective(capsys, monkeypatch):
+    """TPUFW_DISTILL_TEACHER switches to DistillTrainer (random teacher
+    warns loudly; real deploys pass TPUFW_DISTILL_TEACHER_CKPT)."""
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_BATCH_SIZE", "8")
+    monkeypatch.setenv("TPUFW_SEQ_LEN", "33")
+    monkeypatch.setenv("TPUFW_TOTAL_STEPS", "2")
+    monkeypatch.setenv("TPUFW_LOG_EVERY", "1")
+    monkeypatch.setenv("TPUFW_LOSS_CHUNK_SIZE", "16")
+    monkeypatch.setenv("TPUFW_DISTILL_TEACHER", "llama3_tiny")
+    from tpufw.workloads import train_llama
+
+    assert train_llama.main() == 0
+    out = capsys.readouterr().out
+    assert "RANDOM-INIT" in out
+    assert "TRAIN OK: 2 steps" in out
+
+
+def test_train_llama_objectives_mutually_exclusive(monkeypatch):
+    monkeypatch.setenv("TPUFW_DPO_DATA", "/tmp/x.jsonl")
+    monkeypatch.setenv("TPUFW_DISTILL_TEACHER", "llama3_tiny")
+    from tpufw.workloads import train_llama
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        train_llama.build_trainer()
+
+
+def test_rl_workload_main(capsys, monkeypatch, tmp_path):
+    """The GRPO workload end-to-end: prompts file in, reward telemetry
+    JSON lines out."""
+    path = tmp_path / "prompts.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"prompt": "say something"}) + "\n")
+        f.write(json.dumps([40, 41, 42]) + "\n")
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_BATCH_SIZE", "8")
+    monkeypatch.setenv("TPUFW_SEQ_LEN", "24")
+    monkeypatch.setenv("TPUFW_TOTAL_STEPS", "2")
+    monkeypatch.setenv("TPUFW_LR", "1e-3")
+    monkeypatch.setenv("TPUFW_GRPO_GROUP", "4")
+    monkeypatch.setenv("TPUFW_GRPO_MAX_NEW", "6")
+    monkeypatch.setenv("TPUFW_PROMPTS_FILE", str(path))
+    from tpufw.workloads import rl
+
+    assert rl.main() == 0
+    out = capsys.readouterr().out
+    assert "RL OK: 2 steps" in out
+    metrics = [
+        json.loads(line) for line in out.splitlines()
+        if line.startswith("{") and "reward_mean" in line
+    ]
+    assert len(metrics) == 2
+    assert {"reward_mean", "clip_frac", "kl", "loss"} <= metrics[0].keys()
+
+
+def test_rl_reward_resolution():
+    from tpufw.workloads.rl import resolve_reward
+
+    low = resolve_reward("low_token", 100, 8)
+    assert low([], [[10, 80], [60, 70]]).tolist() == [0.5, 0.0]
+    length = resolve_reward("length", 100, 8)
+    assert length([], [[1, 2], [1, 2, 3, 4]]).tolist() == [0.25, 0.5]
+    # Importable spec: any pkg.mod:fn callable.
+    fn = resolve_reward("operator:length_hint", 100, 8)
+    assert callable(fn)
+    with pytest.raises(ValueError, match="TPUFW_REWARD"):
+        resolve_reward("nonsense", 100, 8)

@@ -233,10 +233,7 @@ class DistillTrainer(Trainer):
         self.teacher_model = None
         self.teacher_params = None
 
-    def set_teacher(self, teacher_model, teacher_params):
-        """Install the frozen teacher (any decoder with the student's
-        vocab). Params are cast to ``teacher_dtype`` through jit so the
-        stored tree never aliases donated buffers."""
+    def _check_vocab(self, teacher_model):
         s_vocab = getattr(getattr(self.model, "cfg", None), "vocab_size", None)
         t_vocab = getattr(
             getattr(teacher_model, "cfg", None), "vocab_size", None
@@ -245,17 +242,20 @@ class DistillTrainer(Trainer):
             raise ValueError(
                 f"teacher vocab {t_vocab} != student vocab {s_vocab}"
             )
+
+    def _teacher_layout(self, teacher_model):
+        """(abstract param tree, mesh shardings) for the teacher: lay it
+        out with the same logical rules as any param tree — a
+        multi-B-param teacher held unsharded would OOM exactly the
+        configurations chunked logits exist to fit. eval_shape under
+        the mesh recovers the flax Partitioned metadata an unboxed tree
+        no longer carries."""
         from flax import linen as nn
         from flax.core import meta
 
         from tpufw.mesh import logical_axis_rules
         from tpufw.parallel.context import use_mesh
 
-        # Lay the teacher out on the mesh with the same logical rules
-        # as any param tree: a multi-B-param teacher held unsharded
-        # would OOM exactly the configurations chunked logits exist to
-        # fit. eval_shape under the mesh recovers the flax Partitioned
-        # metadata the user's unboxed tree no longer carries.
         tokens = jnp.zeros((1, 8), jnp.int32)
         with use_mesh(self.mesh):
             abstract = jax.eval_shape(
@@ -263,16 +263,50 @@ class DistillTrainer(Trainer):
                 jax.random.key(0),
             )
         specs = nn.get_partition_spec(abstract)
-        self._teacher_sharding = meta.unbox(
+        shardings = meta.unbox(
             nn.logical_to_mesh_sharding(
                 specs, self.mesh, logical_axis_rules()
             )
         )
+        return meta.unbox(abstract), shardings
+
+    def set_teacher(self, teacher_model, teacher_params):
+        """Install the frozen teacher (any decoder with the student's
+        vocab). Params are cast to ``teacher_dtype`` through jit so the
+        stored tree never aliases donated buffers, and laid out on the
+        mesh (see ``_teacher_layout``)."""
+        self._check_vocab(teacher_model)
+        _, self._teacher_sharding = self._teacher_layout(teacher_model)
         self.teacher_model = teacher_model
         self.teacher_params = frozen_copy(
             teacher_params,
             jnp.dtype(self.distill.teacher_dtype),
             out_shardings=self._teacher_sharding,
+        )
+
+    def set_teacher_from(self, teacher_model, path: str):
+        """Install the teacher from a bare-params Orbax checkpoint (the
+        ``tpufw.tools.import_hf`` output shape), restored SHARDED onto
+        this trainer's mesh — never materialized on one host."""
+        import os
+
+        import orbax.checkpoint as ocp
+
+        self._check_vocab(teacher_model)
+        abstract, shardings = self._teacher_layout(teacher_model)
+        restore_tree = jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            abstract,
+            shardings,
+        )
+        with ocp.StandardCheckpointer() as ckptr:
+            params = ckptr.restore(os.path.abspath(path), restore_tree)
+        self._teacher_sharding = shardings
+        self.teacher_model = teacher_model
+        self.teacher_params = frozen_copy(
+            params,
+            jnp.dtype(self.distill.teacher_dtype),
+            out_shardings=shardings,
         )
 
     def compiled_step(self, batch: dict | None = None):

@@ -241,12 +241,13 @@ class GRPOTrainer(Trainer):
             self.grpo.kl_beta > 0.0
             and restored
             and int(self.state.step) > 0
+            and self.ref_params is None
         ):
             raise RuntimeError(
-                "resumed a GRPO run mid-training with kl_beta > 0: the "
-                "KL reference must anchor to the ORIGINAL step-0 "
-                "weights — call init_from_params on the base "
-                "checkpoint first"
+                "resumed a GRPO run mid-training with kl_beta > 0 and "
+                "no KL reference: call init_from_params on the "
+                "ORIGINAL base checkpoint BEFORE maybe_restore so the "
+                "reference anchors to step-0 weights"
             )
         return restored
 
@@ -334,6 +335,15 @@ class GRPOTrainer(Trainer):
             )
         tiled = [list(p) for p in prompts for _ in range(g.group_size)]
         ptoks, pads = pad_prompts(tiled)
+        # Left-pad to the FIXED width seq_len - max_new: the decode scan
+        # is jitted on prompt shape, and padding only to the batch max
+        # would recompile for every distinct window of a ragged prompt
+        # set (multi-minute server-side compiles on real chips).
+        fixed_p = self.cfg.seq_len - g.max_new_tokens
+        if ptoks.shape[1] < fixed_p:
+            extra = fixed_p - ptoks.shape[1]
+            ptoks = np.pad(ptoks, ((0, 0), (extra, 0)))
+            pads = pads + extra
         completions = np.asarray(
             generate(
                 self._decode(),
@@ -397,7 +407,10 @@ class GRPOTrainer(Trainer):
                 "snapshot: call init_state()/init_from_params() first"
             )
         key = (
-            ("grpo", "tokens")
+            (
+                "grpo", "advantages", "loss_mask", "old_logp",
+                "segment_ids", "tokens",
+            )
             if batch is None
             else ("grpo", *sorted(batch.keys()))
         )
@@ -442,35 +455,78 @@ class GRPOTrainer(Trainer):
 
     def run_rl(
         self,
-        prompts: Sequence[Sequence[int]],
+        prompts,
         reward_fn,
         seed: int = 0,
         on_metrics: Callable[[dict], None] | None = None,
     ) -> list[dict]:
-        """The packaged RL loop: total_steps x (rollout -> update) on a
-        fixed prompt set. Returns per-step metric dicts (rollout info +
-        step metrics). The policy the i-th rollout samples from is the
+        """The packaged RL loop: total_steps x (rollout -> update).
+        ``prompts`` is either a fixed prompt set (every step) or a
+        callable ``step_index -> prompt set`` (rotation/curriculum).
+        Returns per-step metric dicts (rollout info + step metrics).
+        The policy the i-th rollout samples from is the
         (i-1)-times-updated one — on-policy by construction."""
         if self.state is None:
             self.init_state()
         from tpufw.parallel.context import use_mesh
+        from tpufw.train.preemption import checkpoint_stop, owned_shutdown
 
+        get_prompts = prompts if callable(prompts) else (lambda i: prompts)
+        ckpt = None
+        if self.cfg.checkpoint_dir:
+            from tpufw.train.checkpoint import CheckpointManager
+
+            ckpt = CheckpointManager(
+                self.cfg.checkpoint_dir,
+                save_interval_steps=self.cfg.checkpoint_every,
+            )
+        shutdown, owns_shutdown = owned_shutdown(
+            None,
+            self.cfg.handle_preemption,
+            self.cfg.preemption_sync_every,
+        )
+        self.preempted = False
+        # Same global-step-budget contract as Trainer.run: a restored
+        # run finishes the remaining steps.
+        start_step = int(self.state.step)
+        remaining = max(0, self.cfg.total_steps - start_step)
         history = []
         rngs = jax.random.split(
             jax.random.key(seed), self.cfg.total_steps
         )
-        with use_mesh(self.mesh):
-            for i in range(self.cfg.total_steps):
-                batch, info = self.rollout(prompts, reward_fn, rngs[i])
-                batch = self.globalize_batch(batch)
-                step_fn = self.compiled_step(batch)
-                self.state, m = step_fn(self.state, batch)
-                entry = {
-                    **info,
-                    **{k: float(v) for k, v in m.items()},
-                    "step": i + 1,
-                }
-                history.append(entry)
-                if on_metrics:
-                    on_metrics(entry)
+        try:
+            with use_mesh(self.mesh):
+                for i in range(remaining):
+                    step_i = start_step + i
+                    batch, info = self.rollout(
+                        get_prompts(step_i), reward_fn, rngs[step_i]
+                    )
+                    batch = self.globalize_batch(batch)
+                    step_fn = self.compiled_step(batch)
+                    self.state, m = step_fn(self.state, batch)
+                    py_step = step_i + 1
+                    entry = {
+                        **info,
+                        **{k: float(v) for k, v in m.items()},
+                        "step": py_step,
+                    }
+                    history.append(entry)
+                    if on_metrics:
+                        on_metrics(entry)
+                    if ckpt is not None:
+                        ckpt.save(py_step, self.state)
+                    # SIGTERM (pod preemption): forced checkpoint, clean
+                    # break — the JobSet restart resumes via
+                    # maybe_restore (gang-consistent, preemption.py).
+                    if checkpoint_stop(
+                        shutdown, ckpt, py_step, self.state
+                    ):
+                        self.preempted = True
+                        break
+        finally:
+            if ckpt is not None:
+                ckpt.wait()
+                ckpt.close()
+            if owns_shutdown:
+                shutdown.uninstall()
         return history

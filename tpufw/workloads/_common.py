@@ -51,6 +51,25 @@ def metrics_printer(
     return on_metrics
 
 
+def resolve_encode(tok_name: str):
+    """Tokenizer selection shared by the SFT / DPO / RL data paths:
+    "bytes" = the dependency-free byte tokenizer, anything else = a HF
+    tokenizer name loaded context-free (no special-token injection, so
+    span masks stay exact)."""
+    if tok_name == "bytes":
+        from tpufw.train.sft import byte_encode
+
+        return byte_encode
+    from transformers import AutoTokenizer
+
+    _tok = AutoTokenizer.from_pretrained(tok_name)
+
+    def encode(text):
+        return _tok.encode(text, add_special_tokens=False)
+
+    return encode
+
+
 def report_preemption(trainer) -> None:
     """One JSON line when the run stopped on SIGTERM (the forced
     checkpoint is down; a clean exit lets the JobSet policy resume)."""

@@ -158,7 +158,40 @@ def build_trainer():
         # >1 = multi-slice: data parallelism across slices over DCN.
         dcn_data=env_int("mesh_dcn_data", base_m.dcn_data),
     )
-    return Trainer(model, trainer_cfg, mesh_cfg), model_cfg
+    # Objective selection: TPUFW_DPO_DATA switches to preference pairs
+    # (DPOTrainer), TPUFW_DISTILL_TEACHER to teacher-student KL
+    # (DistillTrainer); default is the LM objective. Mutually exclusive
+    # — each replaces the loss, not the data alone.
+    dpo_path = env_str("dpo_data", "")
+    teacher_name = env_str("distill_teacher", "")
+    if dpo_path and teacher_name:
+        raise ValueError(
+            "TPUFW_DPO_DATA and TPUFW_DISTILL_TEACHER are mutually "
+            "exclusive objectives"
+        )
+    if dpo_path:
+        from tpufw.train import DPOConfig, DPOTrainer
+
+        trainer = DPOTrainer(
+            model, trainer_cfg, mesh_cfg,
+            dpo=DPOConfig(
+                beta=env_float("dpo_beta", 0.1),
+                label_smoothing=env_float("dpo_label_smoothing", 0.0),
+            ),
+        )
+    elif teacher_name:
+        from tpufw.train import DistillConfig, DistillTrainer
+
+        trainer = DistillTrainer(
+            model, trainer_cfg, mesh_cfg,
+            distill=DistillConfig(
+                temperature=env_float("distill_temperature", 2.0),
+                alpha=env_float("distill_alpha", 0.5),
+            ),
+        )
+    else:
+        trainer = Trainer(model, trainer_cfg, mesh_cfg)
+    return trainer, model_cfg
 
 
 def main() -> int:
@@ -201,29 +234,107 @@ def main() -> int:
         print_summary,
     )
 
+    from tpufw.train.distill import DistillTrainer as _DT
+
+    if isinstance(trainer, _DT):
+        # Teacher preset + optional bare-params checkpoint; without a
+        # checkpoint the teacher is RANDOM — only good for smoke tests,
+        # so say so loudly.
+        from tpufw.models import (
+            GEMMA_CONFIGS as _GC,
+            LLAMA_CONFIGS as _LC,
+            MIXTRAL_CONFIGS as _MC,
+        )
+
+        t_name = env_str("distill_teacher", "")
+        t_cfgs = {**_LC, **_MC, **_GC}
+        if t_name not in t_cfgs:
+            raise ValueError(
+                f"unknown TPUFW_DISTILL_TEACHER={t_name!r}; choose "
+                f"from {sorted(t_cfgs)}"
+            )
+        from tpufw.models import Gemma as _G, Llama as _L, Mixtral as _M
+
+        t_cfg = t_cfgs[t_name]
+        t_cls = (
+            _M if "Mixtral" in type(t_cfg).__name__
+            else _G if "Gemma" in type(t_cfg).__name__
+            else _L
+        )
+        teacher = t_cls(t_cfg)
+        t_ckpt = env_str("distill_teacher_ckpt", "")
+        if t_ckpt:
+            trainer.set_teacher_from(teacher, t_ckpt)
+            print(f"teacher {t_name} restored from {t_ckpt}")
+        else:
+            from flax.core import meta as _meta
+
+            import jax.numpy as _jnp
+
+            t_params = _meta.unbox(
+                jax.jit(teacher.init)(
+                    jax.random.key(env_int("seed", 0) + 1),
+                    _jnp.zeros((2, 8), _jnp.int32),
+                )["params"]
+            )
+            trainer.set_teacher(teacher, t_params)
+            print(
+                f"WARNING: teacher {t_name} is RANDOM-INIT (no "
+                "TPUFW_DISTILL_TEACHER_CKPT) — smoke-test only"
+            )
+
     cfg = trainer.cfg
     flops_per_token = model_cfg.flops_per_token(cfg.seq_len - 1)
+    if isinstance(trainer, _DT):
+        # Teacher forward = 2N_t per token; flops_per_token is the 6N
+        # train convention, so the forward is a third of the TEACHER's
+        # own figure — without this, distill MFU undercounts real work
+        # (the DPO branch makes the matching 4/3 correction).
+        flops_per_token += (
+            trainer.teacher_model.cfg.flops_per_token(cfg.seq_len - 1)
+            / 3.0
+        )
     # cfg.batch_size is GLOBAL; each process loads its local shard.
     n_proc = cluster.num_processes
     local_bs = check_global_batch(cfg.batch_size, n_proc)
     sft_path = env_str("sft_data", "")
+    dpo_path = env_str("dpo_data", "")
     data_prefix = env_str("data_prefix", "")
-    if sft_path:
+    if dpo_path:
+        # Preference pairs (tpufw.train.dpo): local rows = 2 * pairs;
+        # interleaved layout keeps multi-process pairing correct.
+        from tpufw.train import prefetch_to_device
+        from tpufw.train.dpo import dpo_batches
+        from tpufw.workloads._common import resolve_encode
+
+        if local_bs % 2:
+            raise ValueError(
+                f"DPO local batch {local_bs} must be even (2 rows/pair)"
+            )
+        # The reference forward adds 2N FLOPs to the 6N train
+        # convention (DPOTrainer docstring).
+        flops_per_token = flops_per_token * 4.0 / 3.0
+        data = prefetch_to_device(
+            dpo_batches(
+                dpo_path,
+                local_bs // 2,
+                cfg.seq_len,
+                resolve_encode(env_str("sft_tokenizer", "bytes")),
+                template=env_str("sft_template", "plain"),
+                seed=env_int("data_seed", 0),
+                shard_id=cluster.process_id,
+                num_shards=n_proc,
+            ),
+            trainer.mesh,
+        )
+    elif sft_path:
         # Supervised fine-tuning: JSONL conversations, chat-template
         # rendered, assistant-masked (tpufw.train.sft). Pairs with
         # TPUFW_INIT_FROM (imported base weights) + TPUFW_LORA_RANK.
-        from tpufw.train.sft import byte_encode, sft_batches
+        from tpufw.train.sft import sft_batches
+        from tpufw.workloads._common import resolve_encode
 
-        tok_name = env_str("sft_tokenizer", "bytes")
-        if tok_name == "bytes":
-            encode = byte_encode
-        else:
-            from transformers import AutoTokenizer
-
-            _tok = AutoTokenizer.from_pretrained(tok_name)
-
-            def encode(text):
-                return _tok.encode(text, add_special_tokens=False)
+        encode = resolve_encode(env_str("sft_tokenizer", "bytes"))
 
         from tpufw.train import prefetch_to_device
 
