@@ -104,7 +104,12 @@ def resolve_model_preset(preset: str):
 
 def _resolve_preset(preset: str):
     from tpufw.configs.presets import BENCH_CONFIG_NAME, bench_model_config
-    from tpufw.models import GEMMA_CONFIGS, LLAMA_CONFIGS, MIXTRAL_CONFIGS
+    from tpufw.models import (
+        DEEPSEEK_CONFIGS,
+        GEMMA_CONFIGS,
+        LLAMA_CONFIGS,
+        MIXTRAL_CONFIGS,
+    )
     from tpufw.models.resnet import ResNetConfig
 
     if preset == BENCH_CONFIG_NAME:
@@ -115,13 +120,15 @@ def _resolve_preset(preset: str):
         return MIXTRAL_CONFIGS[preset]
     if preset in GEMMA_CONFIGS:
         return GEMMA_CONFIGS[preset]
+    if preset in DEEPSEEK_CONFIGS:
+        return DEEPSEEK_CONFIGS[preset]
     if preset == "resnet50":
         return ResNetConfig()
     raise ValueError(
         f"unknown model preset {preset!r}; choose from "
         f"[{BENCH_CONFIG_NAME!r}, 'resnet50', "
         f"*{list(LLAMA_CONFIGS)}, *{list(MIXTRAL_CONFIGS)}, "
-        f"*{list(GEMMA_CONFIGS)}]"
+        f"*{list(GEMMA_CONFIGS)}, *{list(DEEPSEEK_CONFIGS)}]"
     )
 
 

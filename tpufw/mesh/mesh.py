@@ -199,6 +199,10 @@ def logical_axis_rules(
         ("kv_heads", (AXIS_TENSOR,)),
         ("head_dim", None),
         ("lora", None),  # LoRA rank axis: tiny, replicated
+        # MLA (deepseek) latent axes: small next to embed/mlp dims;
+        # replicated keeps the absorbed-decode einsums local.
+        ("kv_latent", None),
+        ("q_latent", None),
         ("vocab", (AXIS_TENSOR,)),
         ("expert", (AXIS_EXPERT,)),
         ("expert_mlp", (AXIS_TENSOR,)),

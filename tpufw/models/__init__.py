@@ -1,3 +1,8 @@
+from tpufw.models.deepseek import (  # noqa: F401
+    DEEPSEEK_CONFIGS,
+    Deepseek,
+    DeepseekConfig,
+)
 from tpufw.models.gemma import (  # noqa: F401
     GEMMA_CONFIGS,
     Gemma,
@@ -32,6 +37,7 @@ from tpufw.models.lora import (  # noqa: F401
 def model_for_config(cfg):
     """Model class instance for a config dataclass — the ONE
     config->architecture dispatch (serving, eval tools)."""
+    from tpufw.models.deepseek import DeepseekConfig
     from tpufw.models.gemma import GemmaConfig
     from tpufw.models.mixtral import MixtralConfig
     from tpufw.models.resnet import ResNetConfig
@@ -41,6 +47,8 @@ def model_for_config(cfg):
             "model_for_config covers the LM families; vision runs use "
             "tpufw.train.VisionTrainer / workloads.train_resnet"
         )
+    if isinstance(cfg, DeepseekConfig):
+        return Deepseek(cfg)
     if isinstance(cfg, MixtralConfig):
         return Mixtral(cfg)
     if isinstance(cfg, GemmaConfig):

@@ -1,0 +1,422 @@
+"""DeepSeek-V2 family: Multi-head Latent Attention (MLA), TPU-first.
+
+The reference has no ML layer at all (its workload is ``nvidia-smi``,
+reference ``README.md:314``); this family joins Llama/Mistral/Qwen/
+Mixtral/Gemma-2 because MLA is THE architecture whose win is
+memory-system-shaped — exactly what a TPU framework should exploit:
+
+- **Latent KV cache.** Attention keys/values are low-rank: one shared
+  latent ``c_kv = x @ W_dkv`` of ``kv_lora_rank`` dims (plus a small
+  decoupled-RoPE key) is cached instead of per-head K and V. For the
+  V2-Lite shape the cache is ``(512 + 64)`` floats/token vs Llama-8B's
+  ``2 * 8 * 128 = 2048`` — 3.6x less HBM, and decode is HBM-bound.
+- **Absorbed decode.** The decode path never expands the latents back
+  to per-head K/V: ``W_uk`` is absorbed into the query (scores are
+  taken IN latent space against the cached ``c_kv``) and ``W_uv`` is
+  applied once to the attention-weighted latents — per step the cache
+  traffic is the latent, not H-times-expanded tensors. Training uses
+  the expanded form (one big MXU-friendly einsum per projection);
+  tests/test_deepseek.py pins prefill-vs-decode equivalence between
+  the two forms.
+- **Decoupled RoPE.** Rotary position goes through a separate
+  ``qk_rope_head_dim`` slice (queries per head, ONE shared key slice),
+  because a position rotation applied to the latent would break its
+  low-rank factorization. DeepSeek rotates INTERLEAVED pairs (HF
+  ``view_as_complex`` layout), unlike Llama's split-half — matched
+  here exactly for checkpoint parity.
+
+Structure mirrors tpufw.models.llama (same decoder trunk, RMSNorm,
+SwiGLU MLP, remat policies, logical sharding axes) so every trainer,
+parallelism mode, and tool that consumes the trunk applies unchanged.
+MoE FFN (DeepSeek's fine-grained experts) is not implemented yet:
+configs with routed experts are rejected at import rather than silently
+dense-ified.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.ad_checkpoint import checkpoint_name
+
+from tpufw.models.llama import (
+    MLP,
+    Dtype,
+    RMSNorm,
+    decoder_lm,
+    projection,
+)
+from tpufw.ops.attention import xla_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepseekConfig:
+    """DeepSeek-V2 MLA decoder. Field names follow the HF config where
+    the concepts coincide (cited: huggingface
+    ``DeepseekV2Config`` / ``modeling_deepseek_v2.py``)."""
+
+    vocab_size: int = 32_768
+    d_model: int = 2048
+    n_layers: int = 12
+    n_heads: int = 16
+    # None = full-rank q projection (the V2-Lite choice); an int adds
+    # the compressed q path (q_a -> norm -> q_b, the V2 236B choice).
+    q_lora_rank: Optional[int] = None
+    kv_lora_rank: int = 512
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+    d_ff: int = 8192
+    rope_theta: float = 10_000.0
+    max_seq_len: int = 4096
+    rms_eps: float = 1e-6
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+    # MLA needs asymmetric q/k vs v head dims; only the einsum backend
+    # handles that today (flash/ring assume one head_dim).
+    attention_backend: str = "xla"
+    remat: bool = True
+    remat_policy: str = "dots"
+    scan_layers: bool = True
+    decode: bool = False
+    tie_embeddings: bool = False
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    def decode_config(self) -> "DeepseekConfig":
+        """Inference twin: latent KV cache on, remat off."""
+        return dataclasses.replace(self, decode=True, remat=False)
+
+    def n_params(self, include_embed: bool = True) -> int:
+        d, l, h = self.d_model, self.n_layers, self.n_heads
+        if self.q_lora_rank is None:
+            q = d * h * self.qk_head_dim
+            q_norms = 0
+        else:
+            q = self.q_lora_rank * (d + h * self.qk_head_dim)
+            q_norms = self.q_lora_rank
+        kv_a = d * (self.kv_lora_rank + self.qk_rope_head_dim)
+        kv_b = self.kv_lora_rank * h * (
+            self.qk_nope_head_dim + self.v_head_dim
+        )
+        o = h * self.v_head_dim * d
+        attn = l * (q + kv_a + kv_b + o)
+        mlp = l * 3 * d * self.d_ff
+        norms = (2 * l + 1) * d + l * (self.kv_lora_rank + q_norms)
+        total = attn + mlp + norms
+        if include_embed:
+            head = 0 if self.tie_embeddings else self.vocab_size * d
+            total += self.vocab_size * d + head
+        return total
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Training FLOPs/token: 6*N_matmul + attention score FLOPs
+        (causal-halved, x3 fwd+bwd, both QK^T and AV matmuls) — same
+        convention as LlamaConfig.flops_per_token."""
+        n_matmul = (
+            self.n_params(include_embed=False)
+            # norms aren't matmuls; head is.
+            - (2 * self.n_layers + 1) * self.d_model
+            - self.n_layers * (
+                self.kv_lora_rank
+                + (self.q_lora_rank or 0)
+            )
+            + self.d_model * self.vocab_size
+        )
+        keys = seq_len / 2
+        score = (
+            6.0 * self.n_layers * self.n_heads
+            * (self.qk_head_dim + self.v_head_dim) * keys
+        )
+        return 6.0 * n_matmul + score
+
+
+def apply_rope_interleaved(
+    x: jax.Array, positions: jax.Array, theta: float
+) -> jax.Array:
+    """DeepSeek rotary: INTERLEAVED pairs (x[2i], x[2i+1]) form the
+    complex components (HF ``view_as_complex`` layout,
+    modeling_deepseek_v2.py apply_rotary_emb) — NOT Llama's split-half.
+    x: [B, T, H, D], positions: [B, T]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    )
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    out = jnp.stack(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+class MLAttention(nn.Module):
+    """Multi-head Latent Attention: expanded form for training,
+    absorbed latent form for KV-cache decode."""
+
+    cfg: DeepseekConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        h, dn, dr, dv = (
+            cfg.n_heads,
+            cfg.qk_nope_head_dim,
+            cfg.qk_rope_head_dim,
+            cfg.v_head_dim,
+        )
+
+        # Queries: full-rank, or compressed (q_a -> norm -> q_b).
+        if cfg.q_lora_rank is None:
+            q = projection(
+                cfg, x, (h, cfg.qk_head_dim), -1,
+                ("embed",), ("q_heads", "head_dim"), "q",
+            )
+        else:
+            cq = projection(
+                cfg, x, cfg.q_lora_rank, -1,
+                ("embed",), ("q_latent",), "q_a",
+            )
+            cq = RMSNorm(cfg.rms_eps, name="q_a_norm")(cq)
+            q = projection(
+                cfg, cq, (h, cfg.qk_head_dim), -1,
+                ("q_latent",), ("q_heads", "head_dim"), "q_b",
+            )
+        q_nope, q_pe = q[..., :dn], q[..., dn:]
+        q_pe = apply_rope_interleaved(q_pe, positions, cfg.rope_theta)
+
+        # Shared KV latent + decoupled-rope key (one "head").
+        ckv_kr = projection(
+            cfg, x, cfg.kv_lora_rank + dr, -1,
+            ("embed",), ("kv_latent",), "kv_a",
+        )
+        c_kv = RMSNorm(cfg.rms_eps, name="kv_a_norm")(
+            ckv_kr[..., : cfg.kv_lora_rank]
+        )
+        k_pe = apply_rope_interleaved(
+            ckv_kr[..., cfg.kv_lora_rank:][:, :, None, :],
+            positions,
+            cfg.rope_theta,
+        )  # [B, T, 1, dr]
+
+        # The latent up-projection W_ukv as a RAW kernel: the absorbed
+        # decode path contracts its W_uk / W_uv halves separately, so
+        # both paths must read the same parameter.
+        kv_b = self.param(
+            "kv_b_kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(),
+                ("kv_latent", "q_heads", "head_dim"),
+            ),
+            (cfg.kv_lora_rank, h, dn + dv),
+            cfg.param_dtype,
+        )
+
+        if cfg.decode:
+            out = self._absorbed_cached_attention(
+                q_nope, q_pe, c_kv, k_pe[:, :, 0, :], kv_b, segment_ids
+            )
+        else:
+            kv = jnp.einsum(
+                "btr,rhd->bthd",
+                c_kv.astype(cfg.dtype),
+                kv_b.astype(cfg.dtype),
+            )
+            k_nope, v = kv[..., :dn], kv[..., dn:]
+            k = jnp.concatenate(
+                [k_nope, jnp.broadcast_to(k_pe, (*k_nope.shape[:3], dr))],
+                axis=-1,
+            )
+            q = jnp.concatenate([q_nope, q_pe], axis=-1)
+            q = nn.with_logical_constraint(
+                q, ("batch", "act_seq", "act_heads", "head_dim")
+            )
+            k = nn.with_logical_constraint(
+                k, ("batch", "act_seq", "act_heads", "head_dim")
+            )
+            v = nn.with_logical_constraint(
+                v, ("batch", "act_seq", "act_heads", "head_dim")
+            )
+            if cfg.attention_backend != "xla":
+                raise NotImplementedError(
+                    "MLA's asymmetric head dims (qk "
+                    f"{cfg.qk_head_dim} vs v {dv}) need the einsum "
+                    f"backend; got {cfg.attention_backend!r}"
+                )
+            # Scale is qk_head_dim**-0.5 — xla_attention derives it
+            # from q's last dim, which IS qk_head_dim here.
+            out = xla_attention(
+                q, k, v, causal=True, segment_ids=segment_ids
+            )
+        return projection(
+            cfg, out, cfg.d_model, (-2, -1),
+            ("heads", "head_dim"), ("embed",), "o",
+        )
+
+    def _absorbed_cached_attention(
+        self, q_nope, q_pe, c_kv, k_pe, kv_b, segment_ids
+    ):
+        """Decode with the latent cache and absorbed up-projections.
+
+        Cache holds ``c_kv`` [B, S, kvr] + roped ``k_pe`` [B, S, dr]
+        (the MLA memory win). Scores: W_uk is folded into the query
+        (``q_lat = q_nope @ W_uk``), so nope-scores contract in latent
+        space; the output contracts attention-weighted latents with
+        W_uv once. Slot-ordered causality + segment masking follow
+        tpufw.models.llama Attention._cached_attention exactly.
+        """
+        cfg = self.cfg
+        b, t = q_nope.shape[:2]
+        kvr, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+        dn = cfg.qk_nope_head_dim
+
+        cc = self.variable(
+            "cache", "cached_ckv",
+            jnp.zeros, (b, cfg.max_seq_len, kvr), cfg.dtype,
+        )
+        cp = self.variable(
+            "cache", "cached_kpe",
+            jnp.zeros, (b, cfg.max_seq_len, dr), cfg.dtype,
+        )
+        cseg = self.variable(
+            "cache", "cached_segment_ids",
+            jnp.zeros, (b, cfg.max_seq_len), jnp.int32,
+        )
+        cursor = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        cur = cursor.value
+        cc.value = jax.lax.dynamic_update_slice(
+            cc.value, c_kv.astype(cfg.dtype), (0, cur, 0)
+        )
+        cp.value = jax.lax.dynamic_update_slice(
+            cp.value, k_pe.astype(cfg.dtype), (0, cur, 0)
+        )
+        seg = (
+            jnp.ones((b, t), jnp.int32) if segment_ids is None
+            else segment_ids.astype(jnp.int32)
+        )
+        cseg.value = jax.lax.dynamic_update_slice(cseg.value, seg, (0, cur))
+        cursor.value = cur + t
+
+        w_uk, w_uv = kv_b[..., :dn], kv_b[..., dn:]  # [kvr, H, dn/dv]
+        # Absorb W_uk into the query: [B,T,H,dn] x [kvr,H,dn] -> latent
+        # queries [B,T,H,kvr].
+        q_lat = jnp.einsum(
+            "bthd,rhd->bthr",
+            q_nope.astype(cfg.dtype),
+            w_uk.astype(cfg.dtype),
+        )
+        s = cfg.max_seq_len
+        logits = (
+            jnp.einsum(
+                "bthr,bsr->bhts", q_lat, cc.value,
+                preferred_element_type=jnp.float32,
+            )
+            + jnp.einsum(
+                "bthd,bsd->bhts", q_pe.astype(cfg.dtype), cp.value,
+                preferred_element_type=jnp.float32,
+            )
+        ) * (float(cfg.qk_head_dim) ** -0.5)
+        # Causality over cache SLOTS (RoPE positions lag slots under
+        # left-padding); never-written slots keep segment 0.
+        slot_pos = (cur + jnp.arange(t))[None, :, None]  # [1,T,1]
+        mask = slot_pos >= jnp.arange(s)[None, None, :]  # [1,T,S]
+        seg_mask = seg[:, :, None] == cseg.value[:, None, :]  # [B,T,S]
+        logits = jnp.where(
+            (mask & seg_mask)[:, None, :, :], logits, -1e30
+        )
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        # Attention-weighted latents, then ONE W_uv application.
+        ctx_lat = jnp.einsum("bhts,bsr->bthr", probs, cc.value)
+        return jnp.einsum(
+            "bthr,rhd->bthd", ctx_lat, w_uv.astype(cfg.dtype)
+        )
+
+
+class DeepseekBlock(nn.Module):
+    cfg: DeepseekConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        attn_out = MLAttention(cfg, name="attn")(
+            RMSNorm(cfg.rms_eps, name="attn_norm")(x), positions, segment_ids
+        )
+        x = x + checkpoint_name(attn_out, "attn_out")
+        x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.rms_eps, name="mlp_norm")(x))
+        return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+
+
+class Deepseek(nn.Module):
+    """Decoder-only DeepSeek-V2 (dense-FFN) LM. Returns [B, T, vocab]."""
+
+    cfg: DeepseekConfig
+
+    @nn.compact
+    def __call__(
+        self, tokens, positions=None, segment_ids=None, return_hidden=False
+    ):
+        return decoder_lm(
+            self.cfg, DeepseekBlock, tokens, positions, segment_ids, False,
+            return_hidden=return_hidden,
+        )
+
+
+DEEPSEEK_CONFIGS: dict[str, DeepseekConfig] = {
+    # Test-scale config (CPU mesh, parity tests).
+    "deepseek_tiny": DeepseekConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        kv_lora_rank=32,
+        qk_nope_head_dim=16,
+        qk_rope_head_dim=8,
+        v_head_dim=16,
+        d_ff=128,
+        max_seq_len=128,
+        remat=False,
+    ),
+    # Same, exercising the compressed-q path (V2-236B style).
+    "deepseek_tiny_qlora": DeepseekConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        q_lora_rank=24,
+        kv_lora_rank=32,
+        qk_nope_head_dim=16,
+        qk_rope_head_dim=8,
+        v_head_dim=16,
+        d_ff=128,
+        max_seq_len=128,
+        remat=False,
+    ),
+    # V2-Lite attention geometry (HF deepseek-ai/DeepSeek-V2-Lite:
+    # d=2048, 16 heads, kv_lora 512, 128/64/128 head dims) with a dense
+    # FFN sized to one v5e chip — the MoE FFN is not implemented, so
+    # this is NOT checkpoint-compatible with V2-Lite; it is the bench
+    # shape for the MLA attention path.
+    "deepseek_mla_bench": DeepseekConfig(
+        vocab_size=32_768,
+        d_model=2048,
+        n_layers=10,
+        n_heads=16,
+        kv_lora_rank=512,
+        qk_nope_head_dim=128,
+        qk_rope_head_dim=64,
+        v_head_dim=128,
+        d_ff=6144,
+        max_seq_len=4096,
+    ),
+}

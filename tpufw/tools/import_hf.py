@@ -80,6 +80,8 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
     )
     if get("model_type") == "gemma2":
         return _gemma_config_from_hf(get)
+    if get("model_type") == "deepseek_v2":
+        return _deepseek_config_from_hf(get)
     is_qwen2 = get("model_type") == "qwen2"
     is_mistral = get("model_type") == "mistral"
     is_mixtral = get("model_type") == "mixtral"
@@ -147,6 +149,139 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
             capacity_factor=float(get("num_local_experts")),
         )
     return LlamaConfig(**common)
+
+
+def _deepseek_config_from_hf(get):
+    """tpufw DeepseekConfig from a transformers DeepseekV2Config.
+
+    Rejects, loudly, what tpufw's MLA blocks don't implement: routed
+    experts (DeepSeek MoE FFN), yarn rope scaling, attention bias —
+    importing them would produce silently wrong logits."""
+    from tpufw.models.deepseek import DeepseekConfig
+
+    bad = {}
+    n_layers = get("num_hidden_layers")
+    # Layers >= first_k_dense_replace use the MoE FFN
+    # (modeling_deepseek_v2.py DeepseekV2DecoderLayer); all-dense
+    # checkpoints set it past the last layer.
+    first_moe = get("first_k_dense_replace") or 0
+    if get("n_routed_experts") and first_moe < n_layers:
+        bad["n_routed_experts"] = get("n_routed_experts")
+    if get("rope_scaling"):
+        bad["rope_scaling"] = get("rope_scaling")
+    if get("attention_bias"):
+        bad["attention_bias"] = get("attention_bias")
+    if get("hidden_act") not in (None, "silu"):
+        bad["hidden_act"] = get("hidden_act")
+    if bad:
+        raise NotImplementedError(
+            f"DeepseekV2 import: unsupported features {bad}; tpufw's "
+            "MLA family is dense-FFN, default-rope only (MoE FFN is "
+            "the known gap)"
+        )
+    return DeepseekConfig(
+        vocab_size=get("vocab_size"),
+        d_model=get("hidden_size"),
+        n_layers=get("num_hidden_layers"),
+        n_heads=get("num_attention_heads"),
+        q_lora_rank=get("q_lora_rank"),
+        kv_lora_rank=get("kv_lora_rank"),
+        qk_nope_head_dim=get("qk_nope_head_dim"),
+        qk_rope_head_dim=get("qk_rope_head_dim"),
+        v_head_dim=get("v_head_dim"),
+        d_ff=get("intermediate_size"),
+        rope_theta=float(get("rope_theta") or 10_000.0),
+        rms_eps=float(get("rms_norm_eps") or 1e-6),
+        max_seq_len=get("max_position_embeddings") or 4096,
+        tie_embeddings=bool(get("tie_word_embeddings") or False),
+    )
+
+
+def _deepseek_from_hf(sd, cfg, dt) -> dict:
+    """HF DeepseekV2 state dict -> tpufw Deepseek param tree.
+
+    MLA projections (modeling_deepseek_v2.py DeepseekV2Attention):
+    kv_a_proj_with_mqa packs [kv_lora_rank + qk_rope_head_dim, D];
+    kv_b_proj packs [H * (qk_nope_head_dim + v_head_dim), kv_lora_rank].
+    The rope slices need NO permutation — DeepSeek's rotary is the
+    interleaved complex layout, which apply_rope_interleaved matches.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    d, h = cfg.d_model, cfg.n_heads
+    dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+
+    def take(key: str, target=None):
+        if key not in sd:
+            raise KeyError(
+                f"HF checkpoint is missing {key!r}; not a DeepseekV2 "
+                "state dict?"
+            )
+        return jnp.asarray(_to_np(sd[key]), target or dt)
+
+    def block(i: int) -> dict:
+        pre = f"layers.{i}."
+        ap = pre + "self_attn."
+        attn: dict = {
+            "kv_a": {
+                "kernel": take(ap + "kv_a_proj_with_mqa.weight").T
+            },
+            "kv_a_norm": {
+                "scale": take(ap + "kv_a_layernorm.weight", jnp.float32)
+            },
+            "kv_b_kernel": take(ap + "kv_b_proj.weight")
+            .T.reshape(cfg.kv_lora_rank, h, dn + dv),
+            "o": {
+                "kernel": take(ap + "o_proj.weight").T.reshape(h, dv, d)
+            },
+        }
+        if cfg.q_lora_rank is None:
+            attn["q"] = {
+                "kernel": take(ap + "q_proj.weight")
+                .T.reshape(d, h, dn + dr)
+            }
+        else:
+            attn["q_a"] = {"kernel": take(ap + "q_a_proj.weight").T}
+            attn["q_a_norm"] = {
+                "scale": take(ap + "q_a_layernorm.weight", jnp.float32)
+            }
+            attn["q_b"] = {
+                "kernel": take(ap + "q_b_proj.weight")
+                .T.reshape(cfg.q_lora_rank, h, dn + dr)
+            }
+        return {
+            "attn_norm": {
+                "scale": take(pre + "input_layernorm.weight", jnp.float32)
+            },
+            "attn": attn,
+            "mlp_norm": {
+                "scale": take(
+                    pre + "post_attention_layernorm.weight", jnp.float32
+                )
+            },
+            "mlp": {
+                "gate": {"kernel": take(pre + "mlp.gate_proj.weight").T},
+                "up": {"kernel": take(pre + "mlp.up_proj.weight").T},
+                "down": {"kernel": take(pre + "mlp.down_proj.weight").T},
+            },
+        }
+
+    layers = [block(i) for i in range(cfg.n_layers)]
+    params: dict = {
+        "embed": {"embedding": take("embed_tokens.weight")},
+        "final_norm": {"scale": take("norm.weight", jnp.float32)},
+    }
+    if cfg.scan_layers:
+        params["layers"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs, axis=0), *layers
+        )
+    else:
+        for i, lp in enumerate(layers):
+            params[f"layer_{i}"] = lp
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": take("lm_head.weight").T}
+    return params
 
 
 def _gemma_config_from_hf(get) -> "GemmaConfig":
@@ -332,6 +467,10 @@ def from_hf(
     dt = jnp.dtype(dtype if dtype is not None else cfg.param_dtype)
     if isinstance(cfg, GemmaConfig):
         return _gemma_from_hf(sd, cfg, dt)
+    from tpufw.models.deepseek import DeepseekConfig
+
+    if isinstance(cfg, DeepseekConfig):
+        return _deepseek_from_hf(sd, cfg, dt)
     d, h, kh, dh = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     def take(key: str, target=None):
@@ -447,7 +586,16 @@ from_hf_llama = from_hf
 
 def hf_config_dict(cfg: LlamaConfig) -> dict:
     """The transformers config.json contents for a tpufw config."""
+    from tpufw.models.deepseek import DeepseekConfig
     from tpufw.models.mixtral import MixtralConfig
+
+    if isinstance(cfg, DeepseekConfig):
+        # Falling through to the Llama branch would emit a config.json
+        # transformers happily loads as the WRONG architecture.
+        raise NotImplementedError(
+            "export_hf for the DeepSeek MLA family is not implemented "
+            "(import-only today); file layout: _deepseek_from_hf"
+        )
 
     out = {
         "model_type": "llama",
@@ -563,10 +711,16 @@ def to_hf(params: dict, cfg: LlamaConfig) -> dict[str, np.ndarray]:
     """Inverse of ``from_hf``: tpufw param tree -> HF-keyed state dict
     (numpy fp32, HF [out, in] Linear layout, ``model.``-prefixed keys).
     Accepts both scan-stacked and per-layer trees."""
+    from tpufw.models.deepseek import DeepseekConfig
     from tpufw.models.gemma import GemmaConfig
     from tpufw.models.lora import has_lora
     from tpufw.models.mixtral import MixtralConfig
 
+    if isinstance(cfg, DeepseekConfig):
+        raise NotImplementedError(
+            "to_hf for the DeepSeek MLA family is not implemented "
+            "(import-only today)"
+        )
     if has_lora(params):
         # The emitters read only base kernels; exporting an un-merged
         # LoRA tree would silently ship the FROZEN base and drop the

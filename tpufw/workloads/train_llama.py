@@ -35,6 +35,8 @@ def build_trainer():
     from tpufw.configs import bench_model_config
     from tpufw.mesh import MeshConfig
     from tpufw.models import (
+        DEEPSEEK_CONFIGS,
+        Deepseek,
         GEMMA_CONFIGS,
         Gemma,
         LLAMA_CONFIGS,
@@ -65,6 +67,8 @@ def build_trainer():
             return Mixtral(model_cfg)
         if "Gemma" in tname:
             return Gemma(model_cfg)
+        if "Deepseek" in tname:
+            return Deepseek(model_cfg)
         return None  # Llama built after the backend override below
 
     if run and name == run.model_preset:
@@ -80,10 +84,13 @@ def build_trainer():
     elif name in GEMMA_CONFIGS:
         model_cfg = GEMMA_CONFIGS[name]
         model = Gemma(model_cfg)
+    elif name in DEEPSEEK_CONFIGS:
+        model_cfg = DEEPSEEK_CONFIGS[name]
+        model = Deepseek(model_cfg)
     else:
         raise ValueError(
             f"unknown TPUFW_MODEL={name!r}; choose from "
-            f"{['llama3_600m_bench', *LLAMA_CONFIGS, *MIXTRAL_CONFIGS, *GEMMA_CONFIGS]}"
+            f"{['llama3_600m_bench', *LLAMA_CONFIGS, *MIXTRAL_CONFIGS, *GEMMA_CONFIGS, *DEEPSEEK_CONFIGS]}"
         )
     backend = env_str("attention", "")
     if backend:
@@ -96,6 +103,12 @@ def build_trainer():
     lora_alpha = env_float(
         "lora_alpha", getattr(model_cfg, "lora_alpha", 16.0)
     )
+    if lora_rank and not hasattr(model_cfg, "lora_rank"):
+        raise NotImplementedError(
+            f"TPUFW_LORA_RANK: {type(model_cfg).__name__} does not "
+            "implement LoRA adapters (the MLA family is full-fine-tune "
+            "only today)"
+        )
     if (lora_rank, lora_alpha) != (
         getattr(model_cfg, "lora_rank", 0),
         getattr(model_cfg, "lora_alpha", 16.0),
@@ -241,27 +254,22 @@ def main() -> int:
         # checkpoint the teacher is RANDOM — only good for smoke tests,
         # so say so loudly.
         from tpufw.models import (
+            DEEPSEEK_CONFIGS as _DC,
             GEMMA_CONFIGS as _GC,
             LLAMA_CONFIGS as _LC,
             MIXTRAL_CONFIGS as _MC,
+            model_for_config,
         )
 
         t_name = env_str("distill_teacher", "")
-        t_cfgs = {**_LC, **_MC, **_GC}
+        t_cfgs = {**_LC, **_MC, **_GC, **_DC}
         if t_name not in t_cfgs:
             raise ValueError(
                 f"unknown TPUFW_DISTILL_TEACHER={t_name!r}; choose "
                 f"from {sorted(t_cfgs)}"
             )
-        from tpufw.models import Gemma as _G, Llama as _L, Mixtral as _M
-
         t_cfg = t_cfgs[t_name]
-        t_cls = (
-            _M if "Mixtral" in type(t_cfg).__name__
-            else _G if "Gemma" in type(t_cfg).__name__
-            else _L
-        )
-        teacher = t_cls(t_cfg)
+        teacher = model_for_config(t_cfg)
         t_ckpt = env_str("distill_teacher_ckpt", "")
         if t_ckpt:
             trainer.set_teacher_from(teacher, t_ckpt)
