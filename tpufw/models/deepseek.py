@@ -28,9 +28,12 @@ memory-system-shaped — exactly what a TPU framework should exploit:
 Structure mirrors tpufw.models.llama (same decoder trunk, RMSNorm,
 SwiGLU MLP, remat policies, logical sharding axes) so every trainer,
 parallelism mode, and tool that consumes the trunk applies unchanged.
-MoE FFN (DeepSeek's fine-grained experts) is not implemented yet:
-configs with routed experts are rejected at import rather than silently
-dense-ified.
+The MoE FFN (DeepSeek's fine-grained routed experts + always-on shared
+experts) rides the Mixtral einsum dispatch (tpufw.models.mixtral
+MoEMLP) with the V2 gate conventions: raw softmax top-k mass (no
+renormalization — matching the HF reference's executed behavior) times
+``routed_scaling_factor``. Known gaps, rejected loudly at import:
+group-limited routing (V2-236B) and yarn rope scaling.
 """
 
 from __future__ import annotations
@@ -50,6 +53,7 @@ from tpufw.models.llama import (
     decoder_lm,
     projection,
 )
+from tpufw.models.mixtral import MoEMLP
 from tpufw.ops.attention import xla_attention
 
 
@@ -84,6 +88,45 @@ class DeepseekConfig:
     scan_layers: bool = True
     decode: bool = False
     tie_embeddings: bool = False
+    # --- DeepSeek MoE FFN (0 routed experts = dense everywhere) ---
+    # Fine-grained routed experts per MoE layer.
+    n_routed_experts: int = 0
+    experts_per_token: int = 6
+    # Width of EACH routed/shared expert (HF moe_intermediate_size) —
+    # much narrower than the dense d_ff.
+    moe_d_ff: int = 1408
+    # Always-on shared experts (one fused MLP of n_shared * moe_d_ff).
+    n_shared_experts: int = 2
+    # Layers [0, first_k_dense) keep the dense MLP (HF
+    # first_k_dense_replace). > 0 requires scan_layers=False — a scan
+    # needs homogeneous layers.
+    first_k_dense: int = 0
+    # Multiplier on the routed output (HF routed_scaling_factor).
+    routed_scaling_factor: float = 1.0
+    # Renormalize top-k gate mass (False = V2 convention: raw softmax).
+    norm_topk_prob: bool = False
+    # GShard capacity discipline for the einsum dispatch; imports
+    # default to dropless (n_routed_experts) like Mixtral's.
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.02
+    router_z_weight: float = 1e-3
+
+    @property
+    def n_experts(self) -> int:
+        """Alias: tpufw.models.mixtral.MoEMLP reads ``cfg.n_experts``."""
+        return self.n_routed_experts
+
+    @property
+    def moe(self) -> bool:
+        return self.n_routed_experts > 0
+
+    def __post_init__(self):
+        if self.moe and self.first_k_dense > 0 and self.scan_layers:
+            raise ValueError(
+                "first_k_dense > 0 mixes dense and MoE layers — "
+                "nn.scan needs homogeneous layers; set "
+                "scan_layers=False (imports do this automatically)"
+            )
 
     @property
     def qk_head_dim(self) -> int:
@@ -107,7 +150,18 @@ class DeepseekConfig:
         )
         o = h * self.v_head_dim * d
         attn = l * (q + kv_a + kv_b + o)
-        mlp = l * 3 * d * self.d_ff
+        n_moe_layers = (
+            max(0, l - self.first_k_dense) if self.moe else 0
+        )
+        n_dense_layers = l - n_moe_layers
+        mlp = n_dense_layers * 3 * d * self.d_ff
+        if n_moe_layers:
+            per_layer = (
+                3 * d * self.moe_d_ff * self.n_routed_experts  # routed
+                + d * self.n_routed_experts  # router
+                + 3 * d * self.moe_d_ff * self.n_shared_experts  # shared
+            )
+            mlp += n_moe_layers * per_layer
         norms = (2 * l + 1) * d + l * (self.kv_lora_rank + q_norms)
         total = attn + mlp + norms
         if include_embed:
@@ -116,9 +170,10 @@ class DeepseekConfig:
         return total
 
     def flops_per_token(self, seq_len: int) -> float:
-        """Training FLOPs/token: 6*N_matmul + attention score FLOPs
-        (causal-halved, x3 fwd+bwd, both QK^T and AV matmuls) — same
-        convention as LlamaConfig.flops_per_token."""
+        """Training FLOPs/token: 6*N_active_matmul + attention score
+        FLOPs (causal-halved, x3 fwd+bwd, both QK^T and AV matmuls) —
+        same convention as Llama/MixtralConfig.flops_per_token. Under
+        MoE only experts_per_token routed experts run per token."""
         n_matmul = (
             self.n_params(include_embed=False)
             # norms aren't matmuls; head is.
@@ -129,6 +184,13 @@ class DeepseekConfig:
             )
             + self.d_model * self.vocab_size
         )
+        if self.moe:
+            # Swap total routed weights for the ACTIVE k experts.
+            n_moe_layers = max(0, self.n_layers - self.first_k_dense)
+            routed = 3 * self.d_model * self.moe_d_ff
+            n_matmul -= n_moe_layers * routed * (
+                self.n_routed_experts - self.experts_per_token
+            )
         keys = seq_len / 2
         score = (
             6.0 * self.n_layers * self.n_heads
@@ -343,8 +405,44 @@ class MLAttention(nn.Module):
         )
 
 
+class DeepseekMoE(nn.Module):
+    """DeepSeek MoE FFN: fine-grained routed experts (einsum dispatch,
+    tpufw.models.mixtral.MoEMLP with the V2 gate conventions) plus
+    always-on shared experts fused into one wide SwiGLU. Returns
+    (y, aux_loss)."""
+
+    cfg: DeepseekConfig
+
+    @nn.compact
+    def __call__(self, x, valid=None):
+        cfg = self.cfg
+        routed, aux = MoEMLP(
+            cfg,
+            d_ff=cfg.moe_d_ff,
+            norm_topk=cfg.norm_topk_prob,
+            name="routed",
+        )(x, valid=valid)
+        y = routed * cfg.routed_scaling_factor
+        if cfg.n_shared_experts:
+            y = y + MLP(
+                cfg,
+                d_ff=cfg.moe_d_ff * cfg.n_shared_experts,
+                name="shared",
+            )(x)
+        return y, aux
+
+
 class DeepseekBlock(nn.Module):
     cfg: DeepseekConfig
+
+    def _layer_index(self) -> Optional[int]:
+        """Unscanned layers are named ``layer_{i}`` by decoder_lm; the
+        scanned stack shares one set of weights across layers and has
+        no index (homogeneous by construction)."""
+        name = self.name or ""
+        if name.startswith("layer_"):
+            return int(name.split("_", 1)[1])
+        return None
 
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
@@ -353,23 +451,45 @@ class DeepseekBlock(nn.Module):
             RMSNorm(cfg.rms_eps, name="attn_norm")(x), positions, segment_ids
         )
         x = x + checkpoint_name(attn_out, "attn_out")
-        x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.rms_eps, name="mlp_norm")(x))
-        return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
+        h = RMSNorm(cfg.rms_eps, name="mlp_norm")(x)
+        idx = self._layer_index()
+        use_moe = cfg.moe and (idx is None or idx >= cfg.first_k_dense)
+        if use_moe:
+            y, aux = DeepseekMoE(cfg, name="moe")(
+                h,
+                valid=None if segment_ids is None else segment_ids > 0,
+            )
+        else:
+            y, aux = MLP(cfg, name="mlp")(h), jnp.zeros((), jnp.float32)
+        x = nn.with_logical_constraint(
+            x + y, ("batch", "act_seq", "act_embed")
+        )
+        return (x, aux) if cfg.moe else x
 
 
 class Deepseek(nn.Module):
-    """Decoder-only DeepSeek-V2 (dense-FFN) LM. Returns [B, T, vocab]."""
+    """Decoder-only DeepSeek-V2 LM (dense or MoE FFN). Returns logits,
+    or (logits, aux_loss) for MoE configs when ``return_aux`` (the
+    Mixtral contract — train_step adds aux into the objective)."""
 
     cfg: DeepseekConfig
 
     @nn.compact
     def __call__(
-        self, tokens, positions=None, segment_ids=None, return_hidden=False
+        self, tokens, positions=None, segment_ids=None, return_aux=True,
+        return_hidden=False,
     ):
-        return decoder_lm(
-            self.cfg, DeepseekBlock, tokens, positions, segment_ids, False,
+        cfg = self.cfg
+        out = decoder_lm(
+            cfg, DeepseekBlock, tokens, positions, segment_ids, cfg.moe,
             return_hidden=return_hidden,
         )
+        if not cfg.moe:
+            return out
+        logits, aux = out
+        if return_aux:
+            return logits, aux / cfg.n_layers
+        return logits
 
 
 DEEPSEEK_CONFIGS: dict[str, DeepseekConfig] = {
@@ -402,11 +522,31 @@ DEEPSEEK_CONFIGS: dict[str, DeepseekConfig] = {
         max_seq_len=128,
         remat=False,
     ),
+    # MoE test preset: 4 fine-grained routed experts top-2 + 1 shared,
+    # all-MoE (scan-compatible), V2 gate conventions.
+    "deepseek_moe_tiny": DeepseekConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        kv_lora_rank=32,
+        qk_nope_head_dim=16,
+        qk_rope_head_dim=8,
+        v_head_dim=16,
+        d_ff=128,
+        n_routed_experts=4,
+        experts_per_token=2,
+        moe_d_ff=48,
+        n_shared_experts=1,
+        capacity_factor=4.0,  # dropless at test scale
+        max_seq_len=128,
+        remat=False,
+    ),
     # V2-Lite attention geometry (HF deepseek-ai/DeepSeek-V2-Lite:
     # d=2048, 16 heads, kv_lora 512, 128/64/128 head dims) with a dense
-    # FFN sized to one v5e chip — the MoE FFN is not implemented, so
-    # this is NOT checkpoint-compatible with V2-Lite; it is the bench
-    # shape for the MLA attention path.
+    # FFN sized to one v5e chip — NOT checkpoint-compatible with
+    # V2-Lite (whose FFN is MoE and whose rope is yarn); it is the
+    # bench shape for the MLA attention path.
     "deepseek_mla_bench": DeepseekConfig(
         vocab_size=32_768,
         d_model=2048,

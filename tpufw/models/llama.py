@@ -598,17 +598,21 @@ class Attention(nn.Module):
 
 
 class MLP(nn.Module):
-    """SwiGLU feed-forward."""
+    """SwiGLU feed-forward. ``d_ff`` overrides the config width
+    (DeepSeek shared experts size theirs as a multiple of the expert
+    width, not cfg.d_ff)."""
 
     cfg: LlamaConfig
+    d_ff: Optional[int] = None
 
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
+        d_ff = self.d_ff if self.d_ff is not None else cfg.d_ff
         gate = projection(
-            cfg, x, cfg.d_ff, -1, ("embed",), ("mlp",), "gate"
+            cfg, x, d_ff, -1, ("embed",), ("mlp",), "gate"
         )
-        up = projection(cfg, x, cfg.d_ff, -1, ("embed",), ("mlp",), "up")
+        up = projection(cfg, x, d_ff, -1, ("embed",), ("mlp",), "up")
         act_name = getattr(cfg, "mlp_activation", "silu")
         if act_name == "silu":
             act = nn.silu(gate)

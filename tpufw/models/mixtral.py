@@ -13,7 +13,7 @@ hand-rolls; the reference itself has no parallelism at all, SURVEY.md §2c).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -142,9 +142,18 @@ class MoEMLP(nn.Module):
 
     Returns (y, aux_loss): aux = load-balance loss (Switch-style fraction *
     probability product) + router z-loss, pre-weighted by the config.
+
+    ``d_ff`` overrides the per-expert width (DeepSeek's fine-grained
+    experts are narrower than the dense cfg.d_ff); ``norm_topk=False``
+    keeps raw softmax combine weights (DeepSeek-V2). The ``cfg`` only
+    needs the MoE fields (n_experts, experts_per_token,
+    capacity_factor, router_*_weight) plus dtypes — DeepseekConfig
+    passes a compatible view.
     """
 
     cfg: MixtralConfig
+    d_ff: Optional[int] = None
+    norm_topk: bool = True
 
     def _expert_matmul(
         self, name: str, xe: jax.Array, shape: tuple, names: tuple
@@ -210,6 +219,7 @@ class MoEMLP(nn.Module):
         packed batches) are excluded from routing, capacity, and the aux
         statistics so pads can't evict real tokens from experts."""
         cfg = self.cfg
+        d_ff = self.d_ff if self.d_ff is not None else cfg.d_ff
         b, t, d = x.shape
         e, k = cfg.n_experts, cfg.experts_per_token
         g = b * t
@@ -231,6 +241,7 @@ class MoEMLP(nn.Module):
             router_logits, k, capacity,
             valid=None if valid is None else valid.reshape(g),
             dtype=x.dtype,
+            norm_topk=self.norm_topk,
         )
 
         xf = x.reshape(g, d)
@@ -239,17 +250,17 @@ class MoEMLP(nn.Module):
         xe = xe.astype(cfg.dtype)
 
         gate_out = self._expert_matmul(
-            "w_gate", xe, (e, d, cfg.d_ff),
+            "w_gate", xe, (e, d, d_ff),
             ("expert", "embed", "expert_mlp"),
         )
         up_out = self._expert_matmul(
-            "w_up", xe, (e, d, cfg.d_ff),
+            "w_up", xe, (e, d, d_ff),
             ("expert", "embed", "expert_mlp"),
         )
         h = nn.silu(gate_out) * up_out
         h = nn.with_logical_constraint(h, ("expert", None, "act_mlp"))
         out_e = self._expert_matmul(
-            "w_down", h, (e, cfg.d_ff, d),
+            "w_down", h, (e, d_ff, d),
             ("expert", "expert_mlp", "embed"),
         )
         y = jnp.einsum("gec,ecd->gd", combine, out_e).reshape(b, t, d)

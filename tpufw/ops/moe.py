@@ -39,6 +39,7 @@ def route_topk_capacity(
     capacity: int,
     valid: Optional[jax.Array] = None,
     dtype=jnp.bfloat16,
+    norm_topk: bool = True,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Route G tokens to top-``k`` of E experts under a per-expert
     ``capacity``.
@@ -52,6 +53,10 @@ def route_topk_capacity(
         statistics so pads can't evict real tokens from experts.
       dtype: dtype of the returned dispatch/combine tensors (the
         activation dtype they will be contracted in).
+      norm_topk: renormalize the selected top-k probabilities to sum to
+        1 (Mixtral convention). False keeps the RAW softmax mass
+        (DeepSeek-V2 ``norm_topk_prob=false`` — combine weights then
+        sum to < 1 and the residual stream carries the rest).
 
     Returns:
       (dispatch [G, E, C], combine [G, E, C], aux_lb, z):
@@ -65,7 +70,10 @@ def route_topk_capacity(
     probs = jax.nn.softmax(router_logits, axis=-1)  # [G, E]
 
     topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [G, k]
-    topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+    if norm_topk:
+        topk_probs = topk_probs / jnp.sum(
+            topk_probs, axis=-1, keepdims=True
+        )
 
     validf = None if valid is None else valid.reshape(g).astype(jnp.float32)
 

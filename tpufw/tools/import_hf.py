@@ -165,9 +165,19 @@ def _deepseek_config_from_hf(get):
     # (modeling_deepseek_v2.py DeepseekV2DecoderLayer); all-dense
     # checkpoints set it past the last layer.
     first_moe = get("first_k_dense_replace") or 0
-    if get("n_routed_experts") and first_moe < n_layers:
-        bad["n_routed_experts"] = get("n_routed_experts")
+    has_moe = bool(get("n_routed_experts")) and first_moe < n_layers
+    if has_moe:
+        # V2-Lite routes plain greedy-softmax; the 236B model's
+        # group-limited routing (n_group/topk_group) is not implemented.
+        if (get("topk_method") or "greedy") != "greedy":
+            bad["topk_method"] = get("topk_method")
+        if (get("scoring_func") or "softmax") != "softmax":
+            bad["scoring_func"] = get("scoring_func")
+        if (get("moe_layer_freq") or 1) != 1:
+            bad["moe_layer_freq"] = get("moe_layer_freq")
     if get("rope_scaling"):
+        # V2's yarn long-context scaling (incl. mscale) — not
+        # implemented; silently skipping it would shift every position.
         bad["rope_scaling"] = get("rope_scaling")
     if get("attention_bias"):
         bad["attention_bias"] = get("attention_bias")
@@ -176,8 +186,31 @@ def _deepseek_config_from_hf(get):
     if bad:
         raise NotImplementedError(
             f"DeepseekV2 import: unsupported features {bad}; tpufw's "
-            "MLA family is dense-FFN, default-rope only (MoE FFN is "
-            "the known gap)"
+            "MLA family implements greedy-softmax MoE and default rope "
+            "(yarn + group-limited routing are the known gaps)"
+        )
+    moe_kwargs = {}
+    if has_moe:
+        moe_kwargs = dict(
+            n_routed_experts=get("n_routed_experts"),
+            experts_per_token=get("num_experts_per_tok"),
+            moe_d_ff=get("moe_intermediate_size"),
+            n_shared_experts=get("n_shared_experts") or 0,
+            first_k_dense=first_moe,
+            routed_scaling_factor=float(
+                get("routed_scaling_factor") or 1.0
+            ),
+            # The HF reference STORES norm_topk_prob but never applies
+            # it (modeling_deepseek_v2.py MoEGate.forward returns raw
+            # softmax topk mass * scaling, no renormalization branch) —
+            # parity means matching the executed behavior, not the
+            # config flag.
+            norm_topk_prob=False,
+            # Dropless: HF routes without capacity bounds, so imported
+            # checkpoints must not drop tokens (Mixtral convention).
+            capacity_factor=float(get("n_routed_experts")),
+            # Mixed dense/MoE stacks can't scan (homogeneity).
+            scan_layers=first_moe == 0,
         )
     return DeepseekConfig(
         vocab_size=get("vocab_size"),
@@ -194,6 +227,7 @@ def _deepseek_config_from_hf(get):
         rms_eps=float(get("rms_norm_eps") or 1e-6),
         max_seq_len=get("max_position_embeddings") or 4096,
         tie_embeddings=bool(get("tie_word_embeddings") or False),
+        **moe_kwargs,
     )
 
 
@@ -250,7 +284,7 @@ def _deepseek_from_hf(sd, cfg, dt) -> dict:
                 "kernel": take(ap + "q_b_proj.weight")
                 .T.reshape(cfg.q_lora_rank, h, dn + dr)
             }
-        return {
+        out = {
             "attn_norm": {
                 "scale": take(pre + "input_layernorm.weight", jnp.float32)
             },
@@ -260,12 +294,53 @@ def _deepseek_from_hf(sd, cfg, dt) -> dict:
                     pre + "post_attention_layernorm.weight", jnp.float32
                 )
             },
-            "mlp": {
+        }
+        if cfg.moe and i >= cfg.first_k_dense:
+            mp = pre + "mlp."
+
+            def experts(w: str):
+                return jnp.stack(
+                    [
+                        take(f"{mp}experts.{e}.{w}_proj.weight").T
+                        for e in range(cfg.n_routed_experts)
+                    ],
+                    axis=0,
+                )
+
+            moe = {
+                "routed": {
+                    "router": {"kernel": take(mp + "gate.weight").T},
+                    "w_gate": experts("gate"),  # [E, D, F]
+                    "w_up": experts("up"),
+                    "w_down": experts("down"),  # [E, F, D]
+                },
+            }
+            if cfg.n_shared_experts:
+                moe["shared"] = {
+                    "gate": {
+                        "kernel": take(
+                            mp + "shared_experts.gate_proj.weight"
+                        ).T
+                    },
+                    "up": {
+                        "kernel": take(
+                            mp + "shared_experts.up_proj.weight"
+                        ).T
+                    },
+                    "down": {
+                        "kernel": take(
+                            mp + "shared_experts.down_proj.weight"
+                        ).T
+                    },
+                }
+            out["moe"] = moe
+        else:
+            out["mlp"] = {
                 "gate": {"kernel": take(pre + "mlp.gate_proj.weight").T},
                 "up": {"kernel": take(pre + "mlp.up_proj.weight").T},
                 "down": {"kernel": take(pre + "mlp.down_proj.weight").T},
-            },
-        }
+            }
+        return out
 
     layers = [block(i) for i in range(cfg.n_layers)]
     params: dict = {

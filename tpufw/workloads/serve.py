@@ -44,6 +44,8 @@ def build_generator():
     from tpufw.configs import bench_model_config
     from tpufw.mesh import MeshConfig
     from tpufw.models import (
+        DEEPSEEK_CONFIGS,
+        Deepseek,
         GEMMA_CONFIGS,
         Gemma,
         LLAMA_CONFIGS,
@@ -96,10 +98,12 @@ def build_generator():
         model_cfg, model_cls = MIXTRAL_CONFIGS[name], Mixtral
     elif name in GEMMA_CONFIGS:
         model_cfg, model_cls = GEMMA_CONFIGS[name], Gemma
+    elif name in DEEPSEEK_CONFIGS:
+        model_cfg, model_cls = DEEPSEEK_CONFIGS[name], Deepseek
     else:
         raise ValueError(
             f"unknown TPUFW_MODEL={name!r}; choose from "
-            f"{['llama3_600m_bench', *LLAMA_CONFIGS, *MIXTRAL_CONFIGS, *GEMMA_CONFIGS]}"
+            f"{['llama3_600m_bench', *LLAMA_CONFIGS, *MIXTRAL_CONFIGS, *GEMMA_CONFIGS, *DEEPSEEK_CONFIGS]}"
         )
     # Serving wants the full sequence budget but no training-only features.
     model_cfg = dataclasses.replace(
@@ -155,6 +159,11 @@ def _maybe_quantize(model_cfg, params):
     if mode != "int8":
         raise ValueError(
             f"TPUFW_QUANTIZE={mode!r}: only 'int8' is implemented"
+        )
+    if not hasattr(model_cfg, "quantized_weights"):
+        raise NotImplementedError(
+            f"TPUFW_QUANTIZE=int8: {type(model_cfg).__name__} does not "
+            "implement int8 serving (the MLA family serves bf16 today)"
         )
     from tpufw.ops.quant import quantize_params
 
