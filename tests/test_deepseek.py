@@ -115,10 +115,15 @@ def test_hf_unsupported_features_rejected():
     }
     with pytest.raises(NotImplementedError, match="topk_method"):
         config_from_hf({**base, "topk_method": "group_limited_greedy"})
+    # yarn is supported; OTHER scaling types still reject.
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         config_from_hf({
-            **base, "rope_scaling": {"type": "yarn", "factor": 40},
+            **base, "rope_scaling": {"type": "linear", "factor": 4},
         })
+    cfg = config_from_hf({
+        **base, "rope_scaling": {"type": "yarn", "factor": 40},
+    })
+    assert cfg.rope_scaling is not None and cfg.rope_scaling.factor == 40
     # A supported MoE config maps cleanly (mixed stack -> unscanned).
     cfg = config_from_hf(base)
     assert cfg.n_routed_experts == 64 and not cfg.scan_layers
@@ -425,3 +430,151 @@ def test_moe_decode_matches_prefill():
             atol=3e-4, rtol=3e-4,
             err_msg=f"moe decode step {i}",
         )
+
+
+# ----------------------------------------------------------------------
+# Yarn rope scaling
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def hf_deepseek_yarn():
+    """V2-Lite-style yarn rope scaling (mscale == mscale_all_dim ->
+    attention factor exactly 1.0) on the dense tiny shape."""
+    import transformers
+
+    hf_cfg = transformers.DeepseekV2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=4,
+        q_lora_rank=None,
+        kv_lora_rank=32,
+        qk_nope_head_dim=16,
+        qk_rope_head_dim=8,
+        head_dim=8,  # yarn's dim = the ROPE slice
+        v_head_dim=16,
+        first_k_dense_replace=2,
+        max_position_embeddings=256,
+        rope_theta=10_000.0,
+        rope_scaling={
+            "rope_type": "yarn",
+            "factor": 16.0,
+            "original_max_position_embeddings": 16,
+            "beta_fast": 32,
+            "beta_slow": 1,
+            "mscale": 0.707,
+            "mscale_all_dim": 0.707,
+        },
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attention_bias=False,
+    )
+    torch.manual_seed(2)
+    model = transformers.DeepseekV2ForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_yarn_config_mapping(hf_deepseek_yarn):
+    from tpufw.tools.import_hf import config_from_hf
+
+    cfg = config_from_hf(hf_deepseek_yarn.config)
+    s = cfg.rope_scaling
+    assert s is not None and s.factor == 16.0
+    assert s.original_max_position_embeddings == 16
+    assert s.mscale == s.mscale_all_dim == 0.707
+    # mscale == mscale_all_dim: factor cancels to exactly 1.
+    assert s.resolved_attention_factor() == pytest.approx(1.0)
+
+
+def test_yarn_freqs_match_hf():
+    """tpufw's ramp vs the transformers rotary embedding inv_freq."""
+    import transformers
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from tpufw.models.deepseek import YarnScaling, _yarn_freqs
+
+    hf_cfg = transformers.DeepseekV2Config(
+        hidden_size=64,
+        num_attention_heads=4,
+        qk_rope_head_dim=8,
+        head_dim=8,
+        max_position_embeddings=256,
+        rope_theta=10_000.0,
+        rope_scaling={
+            "rope_type": "yarn",
+            "factor": 8.0,
+            "original_max_position_embeddings": 32,
+            "mscale": 1.2,
+            "mscale_all_dim": 0.6,
+        },
+    )
+    inv_freq, att = ROPE_INIT_FUNCTIONS["yarn"](hf_cfg, "cpu")
+    s = YarnScaling(
+        factor=8.0, original_max_position_embeddings=32,
+        mscale=1.2, mscale_all_dim=0.6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(_yarn_freqs(8, 10_000.0, s)),
+        inv_freq.numpy(),
+        rtol=1e-6,
+    )
+    assert s.resolved_attention_factor() == pytest.approx(att)
+
+
+def test_yarn_mscale_all_dim_only_matches_reference():
+    """mscale_all_dim WITHOUT mscale must take the plain get_mscale
+    branch (the reference gates on both being truthy) — an eager 1.0
+    default would silently flip it into the ratio branch."""
+    from transformers import DeepseekV2Config
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from tpufw.tools.import_hf import config_from_hf
+
+    rs = {
+        "rope_type": "yarn", "factor": 8.0,
+        "original_max_position_embeddings": 32, "mscale_all_dim": 0.6,
+    }
+    hf_cfg = DeepseekV2Config(
+        hidden_size=64, num_attention_heads=4, qk_rope_head_dim=8,
+        head_dim=8, max_position_embeddings=256, rope_scaling=rs,
+    )
+    _, att = ROPE_INIT_FUNCTIONS["yarn"](hf_cfg, "cpu")
+    cfg = config_from_hf({
+        "model_type": "deepseek_v2", "vocab_size": 256,
+        "hidden_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "kv_lora_rank": 32,
+        "qk_nope_head_dim": 16, "qk_rope_head_dim": 8,
+        "v_head_dim": 16, "intermediate_size": 128,
+        "max_position_embeddings": 256, "rope_scaling": rs,
+    })
+    assert cfg.rope_scaling.resolved_attention_factor() == pytest.approx(
+        float(att)
+    )
+
+
+def test_yarn_hf_logits_parity(hf_deepseek_yarn):
+    """Full-model parity under yarn: positions BEYOND the original max
+    (24 > 16) exercise the interpolated band."""
+    from tpufw.tools.import_hf import config_from_hf, from_hf
+
+    cfg = dataclasses.replace(
+        config_from_hf(hf_deepseek_yarn.config),
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = from_hf(hf_deepseek_yarn, cfg)
+    rng = np.random.default_rng(5)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 24), dtype=np.int64)
+    with torch.no_grad():
+        want = hf_deepseek_yarn(torch.from_numpy(tokens)).logits.numpy()
+    got = Deepseek(cfg).apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), want, atol=3e-4, rtol=2e-3
+    )

@@ -76,6 +76,8 @@ class DeepseekConfig:
     v_head_dim: int = 128
     d_ff: int = 8192
     rope_theta: float = 10_000.0
+    # Yarn long-context scaling (V2/V2-Lite checkpoints); None = plain.
+    rope_scaling: Optional["YarnScaling"] = None
     max_seq_len: int = 4096
     rms_eps: float = 1e-6
     dtype: Dtype = jnp.bfloat16
@@ -199,17 +201,105 @@ class DeepseekConfig:
         return 6.0 * n_matmul + score
 
 
+@dataclasses.dataclass(frozen=True)
+class YarnScaling:
+    """Yarn long-context rope scaling (arXiv 2309.00071), matching the
+    transformers reference EXACTLY (modeling_rope_utils.py
+    _compute_yarn_parameters): per-dimension ramp between interpolated
+    (freq / factor) and extrapolated (unscaled) frequencies, plus an
+    ``attention_factor`` multiplied into cos/sin. Note the reference's
+    executed behavior: when ``mscale == mscale_all_dim`` (DeepSeek-
+    V2-Lite publishes 0.707 for both) the factor is exactly 1.0, and
+    transformers applies NO mscale^2 to the softmax scale — parity
+    targets what the reference runs, not the original repo's
+    remote-code variant."""
+
+    factor: float = 40.0
+    original_max_position_embeddings: int = 4096
+    beta_fast: float = 32.0
+    beta_slow: float = 1.0
+    # 0.0 = unset (falsy): the ratio branch of the attention factor
+    # needs BOTH mscale fields, exactly like the transformers gate.
+    mscale: float = 0.0
+    mscale_all_dim: float = 0.0
+    attention_factor: Optional[float] = None  # None = derive below
+    truncate: bool = True
+
+    def resolved_attention_factor(self) -> float:
+        import math
+
+        def get_mscale(scale, m=1.0):
+            if scale <= 1:
+                return 1.0
+            return 0.1 * m * math.log(scale) + 1.0
+
+        if self.attention_factor is not None:
+            return float(self.attention_factor)
+        if self.mscale and self.mscale_all_dim:
+            return get_mscale(self.factor, self.mscale) / get_mscale(
+                self.factor, self.mscale_all_dim
+            )
+        return get_mscale(self.factor)
+
+
+def _yarn_freqs(d: int, theta: float, s: YarnScaling) -> jax.Array:
+    """[d/2] yarn inverse frequencies (transformers
+    _compute_yarn_parameters, truncate semantics included)."""
+    import math
+
+    pos_freqs = theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    inv_extra = 1.0 / pos_freqs
+    inv_inter = 1.0 / (s.factor * pos_freqs)
+
+    def correction_dim(n_rot: float) -> float:
+        return (
+            d
+            * math.log(
+                s.original_max_position_embeddings / (n_rot * 2 * math.pi)
+            )
+        ) / (2 * math.log(theta))
+
+    low = correction_dim(s.beta_fast)
+    high = correction_dim(s.beta_slow)
+    if s.truncate:
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, d - 1)
+    if low == high:
+        high += 0.001
+    ramp = jnp.clip(
+        (jnp.arange(d // 2, dtype=jnp.float32) - low) / (high - low),
+        0.0,
+        1.0,
+    )
+    extrapolation_factor = 1.0 - ramp
+    return (
+        inv_inter * (1.0 - extrapolation_factor)
+        + inv_extra * extrapolation_factor
+    )
+
+
 def apply_rope_interleaved(
-    x: jax.Array, positions: jax.Array, theta: float
+    x: jax.Array,
+    positions: jax.Array,
+    theta: float,
+    scaling: Optional[YarnScaling] = None,
 ) -> jax.Array:
     """DeepSeek rotary: INTERLEAVED pairs (x[2i], x[2i+1]) form the
     complex components (HF ``view_as_complex`` layout,
     modeling_deepseek_v2.py apply_rotary_emb) — NOT Llama's split-half.
-    x: [B, T, H, D], positions: [B, T]."""
+    x: [B, T, H, D], positions: [B, T]. With yarn ``scaling``, the
+    frequencies follow the ramp and the rotated output is multiplied by
+    the attention factor (the reference multiplies cos/sin; rotation is
+    linear, so scaling the output is identical)."""
     d = x.shape[-1]
-    freqs = 1.0 / (
-        theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    )
+    if scaling is None:
+        freqs = 1.0 / (
+            theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+        )
+        att = 1.0
+    else:
+        freqs = _yarn_freqs(d, theta, scaling)
+        att = scaling.resolved_attention_factor()
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,D/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -218,6 +308,8 @@ def apply_rope_interleaved(
     out = jnp.stack(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
     ).reshape(x.shape)
+    if att != 1.0:
+        out = out * att
     return out.astype(x.dtype)
 
 
@@ -254,7 +346,9 @@ class MLAttention(nn.Module):
                 ("q_latent",), ("q_heads", "head_dim"), "q_b",
             )
         q_nope, q_pe = q[..., :dn], q[..., dn:]
-        q_pe = apply_rope_interleaved(q_pe, positions, cfg.rope_theta)
+        q_pe = apply_rope_interleaved(
+            q_pe, positions, cfg.rope_theta, cfg.rope_scaling
+        )
 
         # Shared KV latent + decoupled-rope key (one "head").
         ckv_kr = projection(
@@ -268,6 +362,7 @@ class MLAttention(nn.Module):
             ckv_kr[..., cfg.kv_lora_rank:][:, :, None, :],
             positions,
             cfg.rope_theta,
+            cfg.rope_scaling,
         )  # [B, T, 1, dr]
 
         # The latent up-projection W_ukv as a RAW kernel: the absorbed

@@ -175,10 +175,39 @@ def _deepseek_config_from_hf(get):
             bad["scoring_func"] = get("scoring_func")
         if (get("moe_layer_freq") or 1) != 1:
             bad["moe_layer_freq"] = get("moe_layer_freq")
-    if get("rope_scaling"):
-        # V2's yarn long-context scaling (incl. mscale) — not
-        # implemented; silently skipping it would shift every position.
-        bad["rope_scaling"] = get("rope_scaling")
+    yarn = None
+    rs = get("rope_scaling")
+    if rs:
+        rs_get = rs.get if isinstance(rs, Mapping) else (
+            lambda k, d=None: getattr(rs, k, d)
+        )
+        rtype = rs_get("rope_type") or rs_get("type")
+        if rtype != "yarn":
+            bad["rope_scaling"] = rs
+        else:
+            from tpufw.models.deepseek import YarnScaling
+
+            yarn = YarnScaling(
+                factor=float(rs_get("factor")),
+                original_max_position_embeddings=int(
+                    rs_get("original_max_position_embeddings")
+                    or get("max_position_embeddings")
+                    or 4096
+                ),
+                beta_fast=float(rs_get("beta_fast") or 32),
+                beta_slow=float(rs_get("beta_slow") or 1),
+                # Unset stays FALSY: the reference's attention-factor
+                # derivation gates on `mscale and mscale_all_dim` — a
+                # 1.0 default would flip a mscale_all_dim-only config
+                # into the ratio branch (wrong factor).
+                mscale=float(rs_get("mscale") or 0.0),
+                mscale_all_dim=float(rs_get("mscale_all_dim") or 0.0),
+                attention_factor=rs_get("attention_factor"),
+                truncate=bool(
+                    True if rs_get("truncate") is None
+                    else rs_get("truncate")
+                ),
+            )
     if get("attention_bias"):
         bad["attention_bias"] = get("attention_bias")
     if get("hidden_act") not in (None, "silu"):
@@ -227,6 +256,7 @@ def _deepseek_config_from_hf(get):
         rms_eps=float(get("rms_norm_eps") or 1e-6),
         max_seq_len=get("max_position_embeddings") or 4096,
         tie_embeddings=bool(get("tie_word_embeddings") or False),
+        rope_scaling=yarn,
         **moe_kwargs,
     )
 
