@@ -39,7 +39,15 @@ class Core:
     """ctypes wrapper over libtpuplugin.so."""
 
     def __init__(self, lib_path: str):
-        self.lib = ctypes.CDLL(lib_path)
+        # RTLD_DEEPBIND: the core links C++ protobuf, and a process
+        # that already executed torch has torch's OWN protobuf/absl
+        # symbols resident — without deep binding the dynamic linker
+        # resolves our calls against those incompatible copies and the
+        # first serialization segfaults (observed: any torch forward
+        # pass before Core() crashed tpuplugin_register_request).
+        # Deep binding makes this library prefer its own dependencies.
+        mode = ctypes.DEFAULT_MODE | getattr(os, "RTLD_DEEPBIND", 0)
+        self.lib = ctypes.CDLL(lib_path, mode=mode)
         self.lib.tpuplugin_init.restype = ctypes.c_int
         for fn in ("tpuplugin_options", "tpuplugin_register_request",
                    "tpuplugin_list_and_watch", "tpuplugin_metrics"):

@@ -41,12 +41,38 @@ def test_latent_cache_is_smaller_than_mha():
     assert mla * 3 < mha  # > 3x smaller
 
 
-def test_non_xla_backend_rejected():
-    cfg = dataclasses.replace(TINY, attention_backend="flash")
-    with pytest.raises(NotImplementedError, match="asymmetric"):
+def test_unplumbed_backend_rejected():
+    """xla/flash/ring are the MLA backends; ulysses SP is not plumbed
+    and must fail loudly."""
+    cfg = dataclasses.replace(TINY, attention_backend="ulysses")
+    with pytest.raises(NotImplementedError, match="ulysses"):
         Deepseek(cfg).init(
             jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
         )
+
+
+def test_ring_backend_matches_xla_on_sequence_mesh():
+    """MLA ring SP over sequence=2: logits match the single-chunk xla
+    reference (the long-context path for the latent family)."""
+    from tpufw.mesh import MeshConfig, build_mesh
+    from tpufw.parallel.context import use_mesh
+
+    cfg = dataclasses.replace(
+        TINY, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    tokens = jax.random.randint(
+        jax.random.key(9), (4, 32), 0, cfg.vocab_size
+    )
+    params = Deepseek(cfg).init(jax.random.key(10), tokens)
+    ref = Deepseek(cfg).apply(params, tokens)
+    mesh = build_mesh(MeshConfig(fsdp=-1, sequence=2))
+    with use_mesh(mesh):
+        got = Deepseek(
+            dataclasses.replace(cfg, attention_backend="ring")
+        ).apply(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=2e-4, rtol=2e-4
+    )
 
 
 @pytest.fixture(scope="module")
@@ -577,4 +603,23 @@ def test_yarn_hf_logits_parity(hf_deepseek_yarn):
     )
     np.testing.assert_allclose(
         np.asarray(got), want, atol=3e-4, rtol=2e-3
+    )
+
+
+def test_flash_backend_matches_xla():
+    """MLA through the Pallas flash kernel (interpreter on CPU) with
+    zero-padded v must match the einsum reference."""
+    cfg = dataclasses.replace(
+        TINY, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    tokens = jax.random.randint(
+        jax.random.key(7), (1, 64), 0, cfg.vocab_size
+    )
+    params = Deepseek(cfg).init(jax.random.key(8), tokens)
+    ref = Deepseek(cfg).apply(params, tokens)
+    got = Deepseek(
+        dataclasses.replace(cfg, attention_backend="flash")
+    ).apply(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=2e-4, rtol=2e-4
     )

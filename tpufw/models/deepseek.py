@@ -82,8 +82,11 @@ class DeepseekConfig:
     rms_eps: float = 1e-6
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
-    # MLA needs asymmetric q/k vs v head dims; only the einsum backend
-    # handles that today (flash/ring assume one head_dim).
+    # "xla" (einsum, the correctness reference) or "flash" (Pallas
+    # kernel): MLA's v head dim is smaller than qk's, so the flash path
+    # zero-pads v up to qk_head_dim and slices the output back — exact
+    # (padded value columns contribute zeros) at ~dv/qk_dim extra v
+    # memory. Ring/ulysses SP are not plumbed for MLA yet.
     attention_backend: str = "xla"
     remat: bool = True
     remat_policy: str = "dots"
@@ -403,17 +406,42 @@ class MLAttention(nn.Module):
             v = nn.with_logical_constraint(
                 v, ("batch", "act_seq", "act_heads", "head_dim")
             )
-            if cfg.attention_backend != "xla":
-                raise NotImplementedError(
-                    "MLA's asymmetric head dims (qk "
-                    f"{cfg.qk_head_dim} vs v {dv}) need the einsum "
-                    f"backend; got {cfg.attention_backend!r}"
+            if cfg.attention_backend == "xla":
+                # Scale is qk_head_dim**-0.5 — xla_attention derives it
+                # from q's last dim, which IS qk_head_dim here.
+                out = xla_attention(
+                    q, k, v, causal=True, segment_ids=segment_ids
                 )
-            # Scale is qk_head_dim**-0.5 — xla_attention derives it
-            # from q's last dim, which IS qk_head_dim here.
-            out = xla_attention(
-                q, k, v, causal=True, segment_ids=segment_ids
-            )
+            elif cfg.attention_backend in ("flash", "ring"):
+                # Zero-pad v to the qk head dim: softmax(QK^T) @ [v|0]
+                # = [out|0], so slicing recovers the exact result; the
+                # kernels then see ONE head dim everywhere.
+                v_pad = jnp.pad(
+                    v, ((0, 0), (0, 0), (0, 0), (0, cfg.qk_head_dim - dv))
+                )
+                if cfg.attention_backend == "flash":
+                    from tpufw.ops.flash import flash_attention
+
+                    out = flash_attention(
+                        q, k, v_pad, causal=True, segment_ids=segment_ids
+                    )[..., :dv]
+                else:
+                    # Sequence-parallel ring over the `sequence` mesh
+                    # axis — MLA long-context training. The ring
+                    # rotates the (padded) k/v chunks; impl selection
+                    # (flash on TPU, einsum elsewhere) is ring's own.
+                    from tpufw.parallel.ring import ring_attention
+
+                    out = ring_attention(
+                        q, k, v_pad, causal=True,
+                        segment_ids=segment_ids,
+                    )[..., :dv]
+            else:
+                raise NotImplementedError(
+                    "MLA attention backends: 'xla', 'flash', or 'ring' "
+                    f"(ulysses not plumbed); got "
+                    f"{cfg.attention_backend!r}"
+                )
         return projection(
             cfg, out, cfg.d_model, (-2, -1),
             ("heads", "head_dim"), ("embed",), "o",
