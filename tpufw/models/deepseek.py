@@ -54,7 +54,7 @@ from tpufw.models.llama import (
     projection,
 )
 from tpufw.models.mixtral import MoEMLP
-from tpufw.ops.attention import xla_attention
+from tpufw.ops.attention import multi_head_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -82,11 +82,12 @@ class DeepseekConfig:
     rms_eps: float = 1e-6
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
-    # "xla" (einsum, the correctness reference) or "flash" (Pallas
-    # kernel): MLA's v head dim is smaller than qk's, so the flash path
-    # zero-pads v up to qk_head_dim and slices the output back — exact
+    # "xla" (einsum, the correctness reference), "flash" (Pallas
+    # kernel), or "ring" (sequence-parallel over the `sequence` mesh
+    # axis): MLA's v head dim is smaller than qk's, so flash/ring
+    # zero-pad v up to qk_head_dim and slice the output back — exact
     # (padded value columns contribute zeros) at ~dv/qk_dim extra v
-    # memory. Ring/ulysses SP are not plumbed for MLA yet.
+    # memory. Ulysses SP is not plumbed for MLA.
     attention_backend: str = "xla"
     remat: bool = True
     remat_policy: str = "dots"
@@ -406,36 +407,26 @@ class MLAttention(nn.Module):
             v = nn.with_logical_constraint(
                 v, ("batch", "act_seq", "act_heads", "head_dim")
             )
+            # Scale is qk_head_dim**-0.5 everywhere — the backends
+            # derive it from q's last dim, which IS qk_head_dim here.
             if cfg.attention_backend == "xla":
-                # Scale is qk_head_dim**-0.5 — xla_attention derives it
-                # from q's last dim, which IS qk_head_dim here.
-                out = xla_attention(
-                    q, k, v, causal=True, segment_ids=segment_ids
+                out = multi_head_attention(
+                    q, k, v, causal=True, segment_ids=segment_ids,
+                    backend="xla",
                 )
             elif cfg.attention_backend in ("flash", "ring"):
                 # Zero-pad v to the qk head dim: softmax(QK^T) @ [v|0]
                 # = [out|0], so slicing recovers the exact result; the
-                # kernels then see ONE head dim everywhere.
+                # kernels then see ONE head dim everywhere. Dispatch
+                # through the shared entry point (ops.attention) so
+                # backend plumbing can't drift per-model.
                 v_pad = jnp.pad(
                     v, ((0, 0), (0, 0), (0, 0), (0, cfg.qk_head_dim - dv))
                 )
-                if cfg.attention_backend == "flash":
-                    from tpufw.ops.flash import flash_attention
-
-                    out = flash_attention(
-                        q, k, v_pad, causal=True, segment_ids=segment_ids
-                    )[..., :dv]
-                else:
-                    # Sequence-parallel ring over the `sequence` mesh
-                    # axis — MLA long-context training. The ring
-                    # rotates the (padded) k/v chunks; impl selection
-                    # (flash on TPU, einsum elsewhere) is ring's own.
-                    from tpufw.parallel.ring import ring_attention
-
-                    out = ring_attention(
-                        q, k, v_pad, causal=True,
-                        segment_ids=segment_ids,
-                    )[..., :dv]
+                out = multi_head_attention(
+                    q, k, v_pad, causal=True, segment_ids=segment_ids,
+                    backend=cfg.attention_backend,
+                )[..., :dv]
             else:
                 raise NotImplementedError(
                     "MLA attention backends: 'xla', 'flash', or 'ring' "
