@@ -623,3 +623,49 @@ def test_flash_backend_matches_xla():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), atol=2e-4, rtol=2e-4
     )
+
+
+def test_export_hf_roundtrip_moe_yarn(tmp_path):
+    """The full loop: random tpufw MoE+yarn Deepseek -> export_hf ->
+    transformers from_pretrained -> logits match the tpufw model."""
+    import transformers
+
+    from tpufw.models import DEEPSEEK_CONFIGS
+    from tpufw.models.deepseek import YarnScaling
+    from tpufw.tools.import_hf import export_hf
+
+    cfg = dataclasses.replace(
+        DEEPSEEK_CONFIGS["deepseek_moe_tiny"],
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        first_k_dense=1,
+        n_layers=3,
+        scan_layers=False,
+        rope_scaling=YarnScaling(
+            factor=16.0, original_max_position_embeddings=16,
+            mscale=0.707, mscale_all_dim=0.707,
+        ),
+    )
+    from flax.core import meta
+
+    tokens = jax.random.randint(
+        jax.random.key(11), (2, 24), 0, cfg.vocab_size
+    )
+    params = meta.unbox(
+        Deepseek(cfg).init(jax.random.key(12), tokens)
+    )["params"]
+    want = Deepseek(cfg).apply(
+        {"params": params}, tokens, return_aux=False
+    )
+
+    out_dir = str(tmp_path / "hf")
+    export_hf(params, cfg, out_dir)
+    reloaded = transformers.DeepseekV2ForCausalLM.from_pretrained(out_dir)
+    reloaded.eval()
+    with torch.no_grad():
+        got = reloaded(
+            torch.from_numpy(np.asarray(tokens, np.int64))
+        ).logits.numpy()
+    np.testing.assert_allclose(
+        got, np.asarray(want), atol=3e-4, rtol=2e-3
+    )

@@ -204,3 +204,59 @@ def test_serve_mixtral_int8(clear_tpufw_env):
     assert expert_q, "expert stacks did not quantize"
     out = generate_text(decode_model, params, [[3, 4]], max_new_tokens=3)
     assert len(out) == 1 and len(out[0]) == 3
+
+
+def test_deepseek_quantized_forward_close():
+    """MLA int8: q/kv_a/o + MLP quantize; kv_b latent up-projection
+    stays fp. Covers both the dense and MoE (routed+shared) presets."""
+    from tpufw.models import DEEPSEEK_CONFIGS, Deepseek
+
+    for preset in (
+        "deepseek_tiny", "deepseek_tiny_qlora", "deepseek_moe_tiny"
+    ):
+        cfg = dataclasses.replace(
+            DEEPSEEK_CONFIGS[preset],
+            dtype=jnp.float32, param_dtype=jnp.float32,
+        )
+        params = _params(cfg, Deepseek)
+        tokens = jax.random.randint(jax.random.key(3), (2, 33), 0, 256)
+        ref = Deepseek(cfg).apply(
+            {"params": params}, tokens, return_aux=False
+        ) if cfg.moe else Deepseek(cfg).apply({"params": params}, tokens)
+        qp = quantize_params(params)
+        # kv_b stays a raw fp array; projections became q_kernel/scale.
+        layer = qp["layers"] if "layers" in qp else qp["layer_0"]
+        assert "q_kernel" in layer["attn"]["kv_a"]
+        assert not isinstance(layer["attn"]["kv_b_kernel"], dict)
+        if cfg.moe:
+            assert "q_kernel" in layer["moe"]["routed"]["w_gate"]
+            assert "q_kernel" in layer["moe"]["shared"]["gate"]
+            assert "kernel" in layer["moe"]["routed"]["router"]  # fp
+        qcfg = dataclasses.replace(cfg, quantized_weights=True)
+        out = Deepseek(qcfg).apply(
+            {"params": qp}, tokens, return_aux=False
+        ) if cfg.moe else Deepseek(qcfg).apply({"params": qp}, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref),
+            atol=0.05 * float(np.abs(np.asarray(ref)).max()), rtol=0,
+            err_msg=preset,
+        )
+
+
+def test_deepseek_quantized_generate():
+    """int8 weights through the absorbed latent-cache decode."""
+    from tpufw.infer import SamplingConfig, generate_text
+    from tpufw.models import DEEPSEEK_CONFIGS, Deepseek
+
+    cfg = dataclasses.replace(
+        DEEPSEEK_CONFIGS["deepseek_tiny"],
+        dtype=jnp.float32, param_dtype=jnp.float32, max_seq_len=64,
+    )
+    params = _params(cfg, Deepseek)
+    qp = quantize_params(params)
+    qcfg = dataclasses.replace(cfg, quantized_weights=True)
+    outs = generate_text(
+        Deepseek(qcfg.decode_config()), qp, [[5, 6, 7], [9]],
+        max_new_tokens=6, sampling=SamplingConfig(),
+    )
+    assert len(outs) == 2 and all(len(o) == 6 for o in outs)

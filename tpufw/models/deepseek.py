@@ -94,6 +94,9 @@ class DeepseekConfig:
     scan_layers: bool = True
     decode: bool = False
     tie_embeddings: bool = False
+    # int8 weight-only serving (tpufw.ops.quant): projections and
+    # routed/shared experts go int8; kv_b and routers stay fp.
+    quantized_weights: bool = False
     # --- DeepSeek MoE FFN (0 routed experts = dense everywhere) ---
     # Fine-grained routed experts per MoE layer.
     n_routed_experts: int = 0

@@ -7,10 +7,10 @@ weights as int8 + a per-output-channel fp scale halves the bytes moved
 matmul itself still runs in the activation dtype (the int8->bf16 cast
 and the scale multiply fuse into the surrounding ops under XLA).
 
-Scope: the seven projection kernels per block (attention q/k/v/o, MLP
-gate/up/down), the dedicated LM head, and Mixtral's raw expert stacks
-(w_gate/w_up/w_down under the ``moe`` scope; the router stays fp —
-it's tiny). Embeddings stay full precision (a gather, and for tied
+Scope: the projection kernels per block (attention q/k/v/o, MLA's
+q_a/q_b/kv_a, MLP gate/up/down), the dedicated LM head, and the raw
+expert stacks of Mixtral (``moe`` scope) and DeepSeek (``routed``
+scope) — routers and MLA's small kv_b latent up-projection stay fp. Embeddings stay full precision (a gather, and for tied
 heads the two uses want incompatible scale granularities).
 Per-OUTPUT-channel symmetric scales keep the quantization error
 independent per output unit, and scaling AFTER the contraction is
@@ -29,6 +29,11 @@ import jax.numpy as jnp
 #: dims (nn.scan layer stacks, Gemma pair stacks) are batch dims.
 _PROJ_IN_DIMS = {
     "q": 1, "k": 1, "v": 1, "o": 2,
+    # MLA (deepseek): compressed-q pair and the packed KV-latent
+    # down-projection; the latent up-projection (kv_b_kernel, a raw
+    # array) stays fp — it is tiny and the absorbed decode contracts
+    # its halves separately.
+    "q_a": 1, "q_b": 1, "kv_a": 1,
     "gate": 1, "up": 1, "down": 1,
     # The dedicated LM head ([D, V]) is the largest single matmul a
     # decode step streams; tied (Gemma) embeddings stay fp — the gather
@@ -38,6 +43,7 @@ _PROJ_IN_DIMS = {
 #: unstacked kernel rank per module (leading dims beyond this = stacks).
 _PROJ_RANK = {
     "q": 3, "k": 3, "v": 3, "o": 3,
+    "q_a": 2, "q_b": 3, "kv_a": 2,
     "gate": 2, "up": 2, "down": 2,
     "lm_head": 2,
 }
@@ -108,7 +114,7 @@ def quantize_params(params: Any) -> Any:
                 hit.append(key)
             elif (
                 key in _EXPERT_KEYS
-                and parent == "moe"
+                and parent in ("moe", "routed")
                 and not isinstance(val, dict)
                 and getattr(val, "ndim", 0) >= 3
             ):

@@ -695,12 +695,77 @@ def hf_config_dict(cfg: LlamaConfig) -> dict:
     from tpufw.models.mixtral import MixtralConfig
 
     if isinstance(cfg, DeepseekConfig):
-        # Falling through to the Llama branch would emit a config.json
-        # transformers happily loads as the WRONG architecture.
-        raise NotImplementedError(
-            "export_hf for the DeepSeek MLA family is not implemented "
-            "(import-only today); file layout: _deepseek_from_hf"
-        )
+        out = {
+            "model_type": "deepseek_v2",
+            "architectures": ["DeepseekV2ForCausalLM"],
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.d_model,
+            "num_hidden_layers": cfg.n_layers,
+            "num_attention_heads": cfg.n_heads,
+            "num_key_value_heads": cfg.n_heads,
+            "q_lora_rank": cfg.q_lora_rank,
+            "kv_lora_rank": cfg.kv_lora_rank,
+            "qk_nope_head_dim": cfg.qk_nope_head_dim,
+            "qk_rope_head_dim": cfg.qk_rope_head_dim,
+            # transformers' rotary sizes itself from head_dim, which
+            # for MLA is the ROPE slice.
+            "head_dim": cfg.qk_rope_head_dim,
+            "v_head_dim": cfg.v_head_dim,
+            "intermediate_size": cfg.d_ff,
+            "rope_theta": cfg.rope_theta,
+            "rms_norm_eps": cfg.rms_eps,
+            "max_position_embeddings": cfg.max_seq_len,
+            "tie_word_embeddings": cfg.tie_embeddings,
+            "attention_bias": False,
+            "hidden_act": "silu",
+            "torch_dtype": "float32",
+            # All layers below first_k_dense_replace are dense; a
+            # dense-FFN export pushes it past the last layer (the
+            # routed-expert fields then never construct).
+            "first_k_dense_replace": (
+                cfg.first_k_dense if cfg.moe else cfg.n_layers
+            ),
+        }
+        if cfg.moe:
+            out.update(
+                n_routed_experts=cfg.n_routed_experts,
+                num_experts_per_tok=cfg.experts_per_token,
+                moe_intermediate_size=cfg.moe_d_ff,
+                n_shared_experts=cfg.n_shared_experts or None,
+                routed_scaling_factor=cfg.routed_scaling_factor,
+                norm_topk_prob=False,
+                topk_method="greedy",
+                scoring_func="softmax",
+                moe_layer_freq=1,
+            )
+        ys = getattr(cfg, "rope_scaling", None)
+        if ys is not None:
+            out["rope_scaling"] = {
+                "rope_type": "yarn",
+                "factor": ys.factor,
+                "original_max_position_embeddings": (
+                    ys.original_max_position_embeddings
+                ),
+                "beta_fast": ys.beta_fast,
+                "beta_slow": ys.beta_slow,
+                **(
+                    {"mscale": ys.mscale} if ys.mscale else {}
+                ),
+                **(
+                    {"mscale_all_dim": ys.mscale_all_dim}
+                    if ys.mscale_all_dim
+                    else {}
+                ),
+                # Both read back by _compute_yarn_parameters; dropping
+                # them would silently change every cos/sin on reload.
+                **(
+                    {"attention_factor": ys.attention_factor}
+                    if ys.attention_factor is not None
+                    else {}
+                ),
+                **({} if ys.truncate else {"truncate": False}),
+            }
+        return out
 
     out = {
         "model_type": "llama",
@@ -822,10 +887,7 @@ def to_hf(params: dict, cfg: LlamaConfig) -> dict[str, np.ndarray]:
     from tpufw.models.mixtral import MixtralConfig
 
     if isinstance(cfg, DeepseekConfig):
-        raise NotImplementedError(
-            "to_hf for the DeepSeek MLA family is not implemented "
-            "(import-only today)"
-        )
+        return _deepseek_to_hf(params, cfg)
     if has_lora(params):
         # The emitters read only base kernels; exporting an un-merged
         # LoRA tree would silently ship the FROZEN base and drop the
@@ -920,6 +982,83 @@ def _emit_mlp(sd: dict, pre: str, lp: Mapping) -> None:
     sd[pre + "mlp.down_proj.weight"] = _np32(mlp["down"]["kernel"]).T
 
 
+def _deepseek_to_hf(params: dict, cfg) -> dict[str, np.ndarray]:
+    """Inverse of ``_deepseek_from_hf``: MLA (+ optional MoE) param
+    tree -> DeepseekV2-keyed state dict."""
+    d, h = cfg.d_model, cfg.n_heads
+    np32 = _np32
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np32(params["embed"]["embedding"]),
+        "model.norm.weight": np32(params["final_norm"]["scale"]),
+    }
+    if not cfg.tie_embeddings:
+        sd["lm_head.weight"] = np32(params["lm_head"]["kernel"]).T
+    for i in range(cfg.n_layers):
+        lp = _slice_stack(params, cfg.scan_layers, i)
+        pre = f"model.layers.{i}."
+        ap = pre + "self_attn."
+        attn = lp["attn"]
+        sd[pre + "input_layernorm.weight"] = np32(
+            lp["attn_norm"]["scale"]
+        )
+        if cfg.q_lora_rank is None:
+            sd[ap + "q_proj.weight"] = (
+                np32(attn["q"]["kernel"]).reshape(d, -1).T
+            )
+        else:
+            sd[ap + "q_a_proj.weight"] = np32(attn["q_a"]["kernel"]).T
+            sd[ap + "q_a_layernorm.weight"] = np32(
+                attn["q_a_norm"]["scale"]
+            )
+            sd[ap + "q_b_proj.weight"] = (
+                np32(attn["q_b"]["kernel"])
+                .reshape(cfg.q_lora_rank, -1)
+                .T
+            )
+        sd[ap + "kv_a_proj_with_mqa.weight"] = np32(
+            attn["kv_a"]["kernel"]
+        ).T
+        sd[ap + "kv_a_layernorm.weight"] = np32(
+            attn["kv_a_norm"]["scale"]
+        )
+        sd[ap + "kv_b_proj.weight"] = (
+            np32(attn["kv_b_kernel"]).reshape(cfg.kv_lora_rank, -1).T
+        )
+        sd[ap + "o_proj.weight"] = (
+            np32(attn["o"]["kernel"]).reshape(h * cfg.v_head_dim, d).T
+        )
+        sd[pre + "post_attention_layernorm.weight"] = np32(
+            lp["mlp_norm"]["scale"]
+        )
+        if cfg.moe and i >= cfg.first_k_dense:
+            mp = pre + "mlp."
+            moe = lp["moe"]
+            routed = moe["routed"]
+            sd[mp + "gate.weight"] = np32(routed["router"]["kernel"]).T
+            for e in range(cfg.n_routed_experts):
+                ep = mp + f"experts.{e}."
+                sd[ep + "gate_proj.weight"] = np32(
+                    routed["w_gate"][e]
+                ).T
+                sd[ep + "up_proj.weight"] = np32(routed["w_up"][e]).T
+                sd[ep + "down_proj.weight"] = np32(
+                    routed["w_down"][e]
+                ).T
+            if cfg.n_shared_experts:
+                sh = moe["shared"]
+                sp = mp + "shared_experts."
+                sd[sp + "gate_proj.weight"] = np32(
+                    sh["gate"]["kernel"]
+                ).T
+                sd[sp + "up_proj.weight"] = np32(sh["up"]["kernel"]).T
+                sd[sp + "down_proj.weight"] = np32(
+                    sh["down"]["kernel"]
+                ).T
+        else:
+            _emit_mlp(sd, pre, lp)
+    return sd
+
+
 def _gemma_to_hf(params: dict, cfg) -> dict[str, np.ndarray]:
     """Inverse of ``_gemma_from_hf``: pair p "local" -> HF layer 2p,
     "global" -> 2p+1; norm offsets copy directly (both sides store the
@@ -1010,7 +1149,7 @@ def main(argv=None) -> int:
         default=None,
         help="reverse direction: export the Orbax tree at SRC as an HF "
              "checkpoint; MODEL names the architecture preset "
-             "(LLAMA_CONFIGS / MIXTRAL_CONFIGS / GEMMA_CONFIGS)",
+             "(LLAMA_CONFIGS / MIXTRAL_CONFIGS / GEMMA_CONFIGS / DEEPSEEK_CONFIGS)",
     )
     args = ap.parse_args(argv)
 

@@ -160,11 +160,6 @@ def _maybe_quantize(model_cfg, params):
         raise ValueError(
             f"TPUFW_QUANTIZE={mode!r}: only 'int8' is implemented"
         )
-    if not hasattr(model_cfg, "quantized_weights"):
-        raise NotImplementedError(
-            f"TPUFW_QUANTIZE=int8: {type(model_cfg).__name__} does not "
-            "implement int8 serving (the MLA family serves bf16 today)"
-        )
     from tpufw.ops.quant import quantize_params
 
     return (
