@@ -66,3 +66,23 @@ def clear_tpufw_env(monkeypatch):
         if k.startswith("TPUFW_"):
             monkeypatch.delenv(k, raising=False)
     return monkeypatch
+
+
+# ----------------------------------------------------------------------
+# Memory hygiene: one process runs ~500 tests on a 1-core box, and JAX
+# keeps EVERY compiled executable alive for the process lifetime. The
+# suite's native crashes (segfaults in cache read/write, jit execute,
+# ctypes — always ~75% in, site varying run to run) track accumulated
+# native state, not any single test. Dropping JAX's in-memory caches at
+# each module boundary bounds live executables; the persistent disk
+# cache keeps the recompile cost near zero.
+import gc
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    yield
+    jax.clear_caches()
+    gc.collect()

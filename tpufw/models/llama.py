@@ -94,6 +94,10 @@ class LlamaConfig:
     # remat: smallest footprint, ~1 extra fwd of FLOPs) — the standard
     # memory/compute trade, selectable per run.
     remat_policy: str = "dots"
+    # False = BIDIRECTIONAL attention (LLM2Vec-style embedding
+    # fine-tuning, tpufw.train.contrastive); incompatible with decode
+    # (a KV cache is a causal construct).
+    causal: bool = True
     scan_layers: bool = True
     # Autoregressive KV-cache mode (tpufw.infer): attention reads/writes a
     # [B, max_seq_len] cache ("cache" flax collection) instead of attending
@@ -527,14 +531,21 @@ class Attention(nn.Module):
         v = nn.with_logical_constraint(
             v, ("batch", "act_seq", "act_heads", "head_dim")
         )
+        causal = getattr(cfg, "causal", True)
         if cfg.decode:
+            if not causal:
+                raise ValueError(
+                    "causal=False with decode=True: a KV cache is a "
+                    "causal construct — bidirectional models embed, "
+                    "they don't autoregress"
+                )
             out = self._cached_attention(q, k, v, segment_ids, positions)
         else:
             out = multi_head_attention(
                 q,
                 k,
                 v,
-                causal=True,
+                causal=causal,
                 segment_ids=segment_ids,
                 logits_soft_cap=getattr(cfg, "attn_logit_soft_cap", None),
                 sliding_window=self.window,

@@ -115,6 +115,15 @@ def _check_model_split(cfg, n_stages: int) -> None:
     ``init_pipeline_params`` (direct callers) so the two can't drift:
     an unchecked config silently builds a truncated or wrong-family
     model."""
+    if not getattr(cfg, "causal", True):
+        # Both schedules hardcode causal attention; silently training
+        # a causal model under a bidirectional config would be the
+        # quiet version of wrong.
+        raise NotImplementedError(
+            "pipeline schedules implement causal attention only; "
+            "bidirectional (causal=False) embedding fine-tuning uses "
+            "the plain Trainer (tpufw.train.contrastive)"
+        )
     if _is_moe(cfg) and getattr(cfg, "attention_qkv_bias", False):
         # The MoE stage stacks don't carry bias leaves; building this
         # config would silently drop the biases.

@@ -48,6 +48,12 @@ from tpufw.train.grpo import (  # noqa: F401
     group_advantages,
     grpo_train_step,
 )
+from tpufw.train.contrastive import (  # noqa: F401
+    ContrastiveConfig,
+    EmbeddingTrainer,
+    contrastive_train_step,
+    info_nce_loss,
+)
 from tpufw.train.vision import (  # noqa: F401
     VisionTrainer,
     VisionTrainerConfig,

@@ -1,0 +1,300 @@
+"""Contrastive embedding fine-tuning: decoder LM -> retrieval encoder.
+
+The reference ships no ML workloads at all (its "workload" is a
+diagnostic CLI, reference README.md:314); embeddings are the retrieval
+half real users build next to generation, and the modern recipe turns
+the SAME decoder checkpoints this framework trains/imports into
+encoders — E5-Mistral style (causal trunk, last-token pooling) or
+LLM2Vec style (``cfg.causal=False`` flips the trunk bidirectional,
+mean pooling). Both ride the existing substrate: the trunk's
+``return_hidden`` output is pooled, L2-normalized, and trained with a
+symmetric in-batch-negative InfoNCE.
+
+TPU-first shape discipline: batches are ``[2B, T]`` with pairs
+INTERLEAVED (row 2i = query, row 2i+1 = its positive document — the
+same multi-process-safe layout as tpufw.train.dpo), one forward covers
+queries and documents, and the similarity matrix is a single [B, B]
+matmul over the GLOBAL batch — under data parallelism every device's
+queries see every device's documents as negatives for free, because
+the batch axis is sharded but the program is global (no gather code).
+
+Anchor invariant (tests/test_contrastive.py): at random init the
+similarity matrix is ~uniform, so loss ~= ln(B); training on
+distinguishable pairs drives the diagonal accuracy to 1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Callable, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpufw.train.trainer import Trainer
+
+
+@dataclasses.dataclass(frozen=True)
+class ContrastiveConfig:
+    # Softmax temperature on cosine similarities (0.02-0.1 typical).
+    temperature: float = 0.05
+    # "mean" over real tokens (bidirectional/LLM2Vec convention) or
+    # "last" real token (causal/E5-Mistral convention).
+    pooling: str = "mean"
+
+
+def pool_embeddings(
+    hidden: jax.Array, segment_ids: jax.Array, mode: str = "mean"
+) -> jax.Array:
+    """[B, T, D] hidden + [B, T] segment ids (0 = padding) -> [B, D].
+
+    "mean": masked mean over real tokens. "last": the last REAL
+    token's hidden state (rows are right-padded, so that is index
+    n_real - 1)."""
+    real = (segment_ids > 0).astype(hidden.dtype)
+    if mode == "mean":
+        n = jnp.maximum(real.sum(axis=1, keepdims=True), 1.0)
+        return (hidden * real[..., None]).sum(axis=1) / n
+    if mode == "last":
+        idx = jnp.maximum(real.sum(axis=1).astype(jnp.int32) - 1, 0)
+        return jnp.take_along_axis(
+            hidden, idx[:, None, None], axis=1
+        )[:, 0]
+    raise ValueError(f"unknown pooling {mode!r}; 'mean' or 'last'")
+
+
+def info_nce_loss(
+    q: jax.Array, d: jax.Array, temperature: float = 0.05
+) -> tuple[jax.Array, dict]:
+    """Symmetric in-batch-negative InfoNCE over L2-normalized
+    embeddings. q/d: [B, D]; pair i is (q[i], d[i]), every other row is
+    a negative. Returns (loss, metrics)."""
+    q = q / jnp.linalg.norm(q, axis=-1, keepdims=True).clip(1e-6)
+    d = d / jnp.linalg.norm(d, axis=-1, keepdims=True).clip(1e-6)
+    sim = (q @ d.T).astype(jnp.float32) / temperature  # [B, B]
+    labels = jnp.arange(sim.shape[0])
+    # Both directions (query->doc and doc->query), the standard CLIP/
+    # retrieval symmetric objective.
+    loss = 0.5 * (
+        optax.softmax_cross_entropy_with_integer_labels(
+            sim, labels
+        ).mean()
+        + optax.softmax_cross_entropy_with_integer_labels(
+            sim.T, labels
+        ).mean()
+    )
+    acc = (sim.argmax(axis=-1) == labels).astype(jnp.float32).mean()
+    metrics = {
+        "accuracy": acc,
+        "sim_pos": jnp.diag(sim).mean() * temperature,
+        "sim_neg": (
+            (sim.sum() - jnp.diag(sim).sum())
+            / jnp.maximum(sim.size - sim.shape[0], 1)
+        )
+        * temperature,
+    }
+    return loss, metrics
+
+
+def read_pairs(path: str | pathlib.Path) -> Iterator[dict]:
+    """JSONL retrieval pairs: {"query": <text>, "positive": <text>}."""
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if not (
+                isinstance(obj, dict)
+                and isinstance(obj.get("query"), str)
+                and isinstance(obj.get("positive"), str)
+            ):
+                raise ValueError(
+                    f"{path}:{ln}: expected "
+                    '{"query": str, "positive": str}'
+                )
+            yield obj
+
+
+def _fit(toks: List[int], seq_len: int):
+    toks = toks[:seq_len]
+    out = np.zeros(seq_len, np.int32)
+    seg = np.zeros(seq_len, np.int32)
+    out[: len(toks)], seg[: len(toks)] = toks, 1
+    return out, seg
+
+
+def pair_batches(
+    path: str | pathlib.Path,
+    batch_pairs: int,
+    seq_len: int,
+    encode: Callable[[str], List[int]],
+    epochs: Optional[int] = None,
+    seed: int = 0,
+    shard_id: int = 0,
+    num_shards: int = 1,
+) -> Iterator[dict]:
+    """[2B, T] batches: row 2i = query i, row 2i+1 = its positive
+    (right-padded/truncated; interleaving keeps multi-process block
+    concatenation pair-aligned, the tpufw.train.dpo argument)."""
+    pairs = list(read_pairs(path))
+    if not pairs:
+        raise ValueError(f"{path}: no pairs")
+    pairs = pairs[shard_id::num_shards]
+    encoded = [
+        (encode(p["query"]), encode(p["positive"])) for p in pairs
+    ]
+    if len(encoded) < batch_pairs:
+        raise ValueError(
+            f"{path}: shard {shard_id}/{num_shards} holds "
+            f"{len(encoded)} pairs < batch_pairs={batch_pairs}"
+        )
+    rng = np.random.default_rng(seed)
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        order = rng.permutation(len(encoded))
+        for start in range(0, len(order) - batch_pairs + 1, batch_pairs):
+            toks = np.zeros((2 * batch_pairs, seq_len), np.int32)
+            seg = np.zeros((2 * batch_pairs, seq_len), np.int32)
+            for row, i in enumerate(order[start:start + batch_pairs]):
+                qt, dt = encoded[i]
+                toks[2 * row], seg[2 * row] = _fit(qt, seq_len)
+                toks[2 * row + 1], seg[2 * row + 1] = _fit(dt, seq_len)
+            yield {"tokens": toks, "segment_ids": seg}
+        epoch += 1
+
+
+def contrastive_train_step(
+    state,
+    batch: dict,
+    temperature: float = 0.05,
+    pooling: str = "mean",
+):
+    """One InfoNCE update on a [2B, T] interleaved query/doc batch."""
+    tokens = batch["tokens"]
+    seg = batch["segment_ids"]
+
+    def lf(params):
+        out = state.apply_fn(
+            {"params": params}, tokens, segment_ids=seg,
+            return_hidden=True,
+        )
+        aux = 0.0
+        if isinstance(out, tuple):
+            out, aux = out
+        emb = pool_embeddings(out.astype(jnp.float32), seg, pooling)
+        loss, metrics = info_nce_loss(
+            emb[0::2], emb[1::2], temperature
+        )
+        return loss + aux, metrics
+
+    (loss, metrics), grads = jax.value_and_grad(lf, has_aux=True)(
+        state.params
+    )
+    new_state = state.apply_gradients(grads)
+    return new_state, {
+        "loss": loss,
+        "grad_norm": optax.global_norm(grads),
+        **metrics,
+    }
+
+
+class EmbeddingTrainer(Trainer):
+    """Trainer specialized for contrastive embedding fine-tuning.
+    run()/checkpointing/preemption/metering are inherited;
+    ``TrainerConfig.batch_size`` is the ROW count 2B."""
+
+    def __init__(
+        self,
+        model,
+        trainer_cfg,
+        mesh_cfg=None,
+        mesh=None,
+        tx=None,
+        contrastive: ContrastiveConfig = ContrastiveConfig(),
+    ):
+        super().__init__(model, trainer_cfg, mesh_cfg, mesh, tx)
+        if trainer_cfg.batch_size % 2:
+            raise ValueError(
+                f"embedding batch_size is the ROW count 2B; got odd "
+                f"{trainer_cfg.batch_size}"
+            )
+        if trainer_cfg.grad_accum != 1:
+            raise NotImplementedError(
+                "contrastive training does not implement grad_accum: "
+                "in-batch negatives are the objective — microbatching "
+                "would shrink the negative pool, changing the loss"
+            )
+        if contrastive.pooling not in ("mean", "last"):
+            raise ValueError(
+                f"unknown pooling {contrastive.pooling!r}"
+            )
+        self.contrastive = contrastive
+
+    def evaluate(self, data, n_batches=None):
+        raise NotImplementedError(
+            "EmbeddingTrainer.evaluate would run the LM cross-entropy "
+            "on retrieval pairs — meaningless; measure retrieval "
+            "quality from embed() similarities instead"
+        )
+
+    def compiled_eval_step(self, batch: dict):
+        raise NotImplementedError(
+            "no LM eval step for contrastive training (see evaluate)"
+        )
+
+    def compiled_step(self, batch: dict | None = None):
+        from functools import partial
+
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        key = (
+            ("contrastive", "segment_ids", "tokens")
+            if batch is None
+            else ("contrastive", *sorted(batch.keys()))
+        )
+        if key not in self._compiled:
+            row = NamedSharding(self.mesh, P(("data", "fsdp")))
+            batch_sharding = {k: row for k in key[1:]}
+            self._compiled[key] = jax.jit(
+                partial(
+                    contrastive_train_step,
+                    temperature=self.contrastive.temperature,
+                    pooling=self.contrastive.pooling,
+                ),
+                in_shardings=(self.state_sharding, batch_sharding),
+                out_shardings=(self.state_sharding, None),
+                donate_argnums=(0,),
+            )
+        return self._compiled[key]
+
+    def embed(self, tokens: np.ndarray, segment_ids: np.ndarray):
+        """[N, T] -> [N, D] L2-normalized embeddings with the trainer's
+        pooling — the inference surface of the fine-tuned encoder."""
+        if self.state is None:
+            raise RuntimeError("embed() before init_state()/restore")
+        from tpufw.parallel.context import use_mesh
+
+        with use_mesh(self.mesh):
+            out = self.model.apply(
+                {"params": self.state.params},
+                jnp.asarray(tokens),
+                segment_ids=jnp.asarray(segment_ids),
+                return_hidden=True,
+            )
+            if isinstance(out, tuple):
+                out = out[0]
+            emb = pool_embeddings(
+                out.astype(jnp.float32),
+                jnp.asarray(segment_ids),
+                self.contrastive.pooling,
+            )
+            return np.asarray(
+                emb / jnp.linalg.norm(emb, axis=-1, keepdims=True)
+                .clip(1e-6)
+            )

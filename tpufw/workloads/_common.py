@@ -51,6 +51,23 @@ def metrics_printer(
     return on_metrics
 
 
+def resume_data_seed(base_seed: int, restored_step: int) -> int:
+    """Data seed for a (possibly) resumed run.
+
+    A restart resumes the OPTIMIZER at step N but a fresh data iterator
+    would replay batches 1..N — the resumed run re-trains on data it
+    already consumed and never sees the tail it skipped. Exact
+    fast-forward would cost O(N) host-side packing, so tpufw makes the
+    standard streaming-trainer trade instead: fold the restored step
+    into the shuffle seed, giving the resumed run a FRESH permutation
+    of the corpus. Not sample-exact resume, but no duplication bias,
+    O(1), and deterministic given (seed, step).
+    """
+    if restored_step <= 0:
+        return base_seed
+    return base_seed + 1_000_003 * restored_step
+
+
 def resolve_encode(tok_name: str):
     """Tokenizer selection shared by the SFT / DPO / RL data paths:
     "bytes" = the dependency-free byte tokenizer, anything else = a HF

@@ -245,6 +245,7 @@ def main() -> int:
         check_global_batch,
         metrics_printer,
         print_summary,
+        resume_data_seed,
     )
 
     from tpufw.train.distill import DistillTrainer as _DT
@@ -292,6 +293,13 @@ def main() -> int:
             )
 
     cfg = trainer.cfg
+    # Resumed runs get a FRESH data permutation (seed folded with the
+    # restored step) instead of replaying consumed batches — see
+    # resume_data_seed; the EVAL streams below keep the BASE seed so
+    # the held-out set's identity survives restarts.
+    data_seed = resume_data_seed(
+        env_int("data_seed", 0), int(trainer.state.step)
+    )
     flops_per_token = model_cfg.flops_per_token(cfg.seq_len - 1)
     if isinstance(trainer, _DT):
         # Teacher forward = 2N_t per token; flops_per_token is the 6N
@@ -329,7 +337,7 @@ def main() -> int:
                 cfg.seq_len,
                 resolve_encode(env_str("sft_tokenizer", "bytes")),
                 template=env_str("sft_template", "plain"),
-                seed=env_int("data_seed", 0),
+                seed=data_seed,
                 shard_id=cluster.process_id,
                 num_shards=n_proc,
             ),
@@ -353,7 +361,7 @@ def main() -> int:
                 cfg.seq_len,
                 encode,
                 template=env_str("sft_template", "plain"),
-                seed=env_int("data_seed", 0),
+                seed=data_seed,
                 # Disjoint per-process conversation shards (same
                 # contract as the TokenCorpus path below).
                 shard_id=cluster.process_id,
@@ -371,7 +379,7 @@ def main() -> int:
             iter(
                 TokenCorpus(
                     data_prefix, local_bs, cfg.seq_len,
-                    shuffle=True, seed=env_int("data_seed", 0),
+                    shuffle=True, seed=data_seed,
                     shard_id=cluster.process_id, num_shards=n_proc,
                 )
             ),
@@ -381,7 +389,7 @@ def main() -> int:
         data = synthetic_batches(
             local_bs, cfg.seq_len, model_cfg.vocab_size,
             # Even seed space; the synthetic eval stream uses odd.
-            seed=env_int("data_seed", 0) * 2000 + 2 * cluster.process_id,
+            seed=data_seed * 2000 + 2 * cluster.process_id,
         )
     # Held-out eval stream (TPUFW_EVAL_EVERY > 0 enables): a disjoint
     # corpus prefix when given, else synthetic batches from a disjoint

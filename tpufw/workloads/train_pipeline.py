@@ -130,11 +130,19 @@ def main() -> int:
         print(f"resumed from checkpoint at step {int(trainer.state.step)}")
     else:
         trainer.init_state(seed=env_int("seed", 0))
-
     from tpufw.workloads._common import (
         check_global_batch,
         metrics_printer,
         print_summary,
+        resume_data_seed,
+    )
+
+    # Fresh data permutation on resume (no replayed batches) — the
+    # same contract as train_llama; see resume_data_seed. The EVAL
+    # stream keeps the BASE seed: the held-out set must keep its
+    # identity across restarts or eval_loss jumps spuriously.
+    data_seed = resume_data_seed(
+        env_int("data_seed", 0), int(trainer.state.step)
     )
 
     cfg = trainer.cfg
@@ -147,7 +155,7 @@ def main() -> int:
         def eval_data():
             return synthetic_batches(
                 local_bs, cfg.seq_len, model_cfg.vocab_size,
-                seed=env_int("data_seed", 0) * 2000
+                seed=data_seed * 2000
                 + 2 * cluster.process_id + 1,
             )
 
@@ -156,7 +164,7 @@ def main() -> int:
             local_bs,
             cfg.seq_len,
             model_cfg.vocab_size,
-            seed=env_int("data_seed", 0) * 2000 + 2 * cluster.process_id,
+            seed=data_seed * 2000 + 2 * cluster.process_id,
         ),
         model_flops_per_token=model_cfg.flops_per_token(cfg.seq_len - 1),
         on_metrics=metrics_printer(_T0, cache),
