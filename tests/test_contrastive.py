@@ -198,3 +198,12 @@ def test_pipeline_rejects_bidirectional():
     cfg = dataclasses.replace(TINY, causal=False)
     with pytest.raises(NotImplementedError, match="causal"):
         PipelineConfig(n_stages=2, n_microbatches=2).validate(cfg, 8)
+
+
+def test_bidirectional_window_rejected():
+    """LLM2Vec-on-Mistral must disable the sliding window: a causal-
+    relative window under causal=False would cap the past but pass the
+    whole future."""
+    cfg = dataclasses.replace(TINY, causal=False, sliding_window=8)
+    with pytest.raises(ValueError, match="causal-relative"):
+        Llama(cfg).init(jax.random.key(0), jnp.zeros((1, 16), jnp.int32))

@@ -112,3 +112,25 @@ def test_bench_preset_is_estimable():
     hbm = 16 * 2**30
     assert b24.total() < 1.1 * hbm  # right at the edge, as measured
     assert b32.total() > 1.15 * hbm
+
+
+def test_mla_latent_cache_geometry():
+    """MLA decode caches the LATENT (kvr + rope dim) per token — far
+    smaller than the MHA 2*K*dh formula; train terms include the
+    latent + expanded projections."""
+    from tpufw.models import DEEPSEEK_CONFIGS, LLAMA_CONFIGS
+    from tpufw.tools.estimate_memory import (
+        _attn_geometry,
+        estimate_decode,
+    )
+
+    mla = DEEPSEEK_CONFIGS["deepseek_mla_bench"]
+    _, per_tok = _attn_geometry(mla)
+    assert per_tok == mla.kv_lora_rank + mla.qk_rope_head_dim  # 576
+    llama = LLAMA_CONFIGS["llama3_8b"]
+    _, mha_tok = _attn_geometry(llama)
+    assert mha_tok == 2 * llama.n_kv_heads * llama.head_dim  # 2048
+    # Per layer per token the latent is > 3.5x smaller — the family's
+    # headline figure (tpufw.models.deepseek docstring).
+    assert mha_tok / per_tok > 3.5
+    assert estimate_decode(mla, 8, 2048).kv_cache > 0

@@ -532,6 +532,17 @@ class Attention(nn.Module):
             v, ("batch", "act_seq", "act_heads", "head_dim")
         )
         causal = getattr(cfg, "causal", True)
+        if not causal and self.window is not None:
+            # The window mask is causal-relative (last-N PAST keys);
+            # under causal=False it would pass every FUTURE key while
+            # capping the past — an incoherent asymmetric mask, not
+            # bidirectional attention. LLM2Vec-on-Mistral must disable
+            # the window (sliding_window=None) explicitly.
+            raise ValueError(
+                "causal=False with sliding_window set: the window mask "
+                "is causal-relative; set sliding_window=None for "
+                "bidirectional embedding fine-tuning"
+            )
         if cfg.decode:
             if not causal:
                 raise ValueError(

@@ -35,6 +35,33 @@ def _bytes(dtype) -> int:
         return jnp.dtype(dtype).itemsize
 
 
+def _attn_geometry(cfg) -> tuple[float, float]:
+    """(per-token attention projection terms, cached floats per token).
+
+    MHA/GQA (Llama-family): q + o-input (H*dh each) + k + v (K*dh
+    each); cache = 2 * K * dh. MLA (DeepSeek): q [H*(dn+dr)], the
+    packed latent [kvr+dr], the expanded k/v [H*(dn+dv)], o-input
+    [H*dv]; cache = the LATENT kvr + dr — the 3.6x-smaller figure that
+    is the family's point (tpufw.models.deepseek)."""
+    if hasattr(cfg, "kv_lora_rank"):
+        h = cfg.n_heads
+        dn, dr, dv = (
+            cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+        )
+        terms = (
+            h * (dn + dr)          # q
+            + cfg.kv_lora_rank + dr  # packed latent
+            + h * (dn + dv)        # expanded k_nope + v
+            + h * dv               # o input
+        )
+        if getattr(cfg, "q_lora_rank", None):
+            terms += cfg.q_lora_rank
+        return float(terms), float(cfg.kv_lora_rank + dr)
+    h_dh = cfg.n_heads * cfg.head_dim
+    kv_dh = cfg.n_kv_heads * cfg.head_dim
+    return float(2 * h_dh + 2 * kv_dh), float(2 * kv_dh)
+
+
 @dataclasses.dataclass(frozen=True)
 class MemoryEstimate:
     """Per-device byte totals (floats are bytes; names say what)."""
@@ -98,8 +125,7 @@ def estimate_train(
     rows = batch_size / max(n_shards, 1)
     t = seq_len
     d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
-    h_dh = cfg.n_heads * cfg.head_dim
-    kv_dh = cfg.n_kv_heads * cfg.head_dim
+    attn_terms, _ = _attn_geometry(cfg)
     policy = remat_policy or getattr(cfg, "remat_policy", "dots")
 
     boundary = l * rows * t * d * a_bytes  # saved scan carries
@@ -116,10 +142,16 @@ def estimate_train(
         # configs shard the routing group hard).
         k = cfg.experts_per_token
         cf = cfg.capacity_factor
-        mlp_terms = cf * k * (d + 2 * f)
+        # DeepSeek's fine-grained experts are moe_d_ff wide (and its
+        # shared experts add a dense n_shared * moe_d_ff MLP).
+        f_e = getattr(cfg, "moe_d_ff", f)
+        mlp_terms = cf * k * (d + 2 * f_e)
+        n_shared = getattr(cfg, "n_shared_experts", 0)
+        if n_shared:
+            mlp_terms += 3 * n_shared * f_e
         moe_terms = 2 * cf * k * g_tokens  # dispatch+combine, per token
     per_layer_dots = g_tokens * (
-        2 * h_dh + 2 * kv_dh  # q, o-input, k, v
+        attn_terms            # projection outputs (arch-specific)
         + mlp_terms
         + moe_terms
         + 2 * d               # two norm outputs
@@ -176,10 +208,8 @@ def estimate_decode(
     w_bytes = _bytes(weights_dtype or cfg.param_dtype)
     a_bytes = _bytes(cfg.dtype)
     s = cache_len or cfg.max_seq_len
-    kv = (
-        cfg.n_layers * 2 * batch_size * s
-        * cfg.n_kv_heads * cfg.head_dim * a_bytes
-    )
+    _, kv_per_token = _attn_geometry(cfg)
+    kv = cfg.n_layers * batch_size * s * kv_per_token * a_bytes
     return MemoryEstimate(
         params=cfg.n_params() * w_bytes / n_shards,
         optimizer=0.0,
@@ -192,6 +222,7 @@ def estimate_decode(
 
 def main(argv=None) -> int:
     from tpufw.models import (
+        DEEPSEEK_CONFIGS,
         GEMMA_CONFIGS,
         LLAMA_CONFIGS,
         MIXTRAL_CONFIGS,
@@ -203,6 +234,7 @@ def main(argv=None) -> int:
         **LLAMA_CONFIGS,
         **MIXTRAL_CONFIGS,
         **GEMMA_CONFIGS,
+        **DEEPSEEK_CONFIGS,
         # The bench's own headline config — this tool's stated purpose
         # is picking its batch/remat point before the OOM ladder does.
         "llama3_600m_bench": bench_model_config(),

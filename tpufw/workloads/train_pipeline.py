@@ -155,7 +155,9 @@ def main() -> int:
         def eval_data():
             return synthetic_batches(
                 local_bs, cfg.seq_len, model_cfg.vocab_size,
-                seed=data_seed * 2000
+                # BASE seed: the held-out set keeps its identity
+                # across restarts (only the TRAIN stream re-seeds).
+                seed=env_int("data_seed", 0) * 2000
                 + 2 * cluster.process_id + 1,
             )
 
