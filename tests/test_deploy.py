@@ -53,13 +53,14 @@ def test_all_baseline_configs_covered():
     # SURVEY.md §7.3 / BASELINE.md: configs 1-5 each have a manifest, plus
     # smoke-TPU enablement proof, the shared checkpoint PVC, the
     # inference serving Job+Service (07, VERDICT r1 item 9), and the
-    # post-training Jobs (10 DPO, 11 GRPO).
+    # post-training Jobs (10 DPO, 11 GRPO, 12 embed).
     names = [p.name for p in MANIFESTS]
-    assert len(names) == 12
+    assert len(names) == 13
     kinds = [d["kind"] for p in MANIFESTS for d in load(p)]
     assert kinds.count("Pod") == 3
-    # 04 llama v5e-4, 07 infer, 09 gemma2 v5e-4, 10 dpo, 11 grpo.
-    assert kinds.count("Job") == 5
+    # 04 llama v5e-4, 07 infer, 09 gemma2 v5e-4, 10 dpo, 11 grpo,
+    # 12 embed.
+    assert kinds.count("Job") == 6
     # 05 v5e-16, 06 mixtral ep, 08 pipeline-parallel.
     assert kinds.count("JobSet") == 3
     assert kinds.count("PersistentVolumeClaim") == 1

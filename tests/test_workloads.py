@@ -187,3 +187,47 @@ def test_resume_data_seed_contract():
     assert a != 7 and b != 7 and a != b
     # Deterministic given (seed, step) — the gang must agree.
     assert resume_data_seed(7, 100) == a
+
+
+def test_embed_workload_main(capsys, monkeypatch, tmp_path):
+    """The embedding workload end-to-end: pairs in, InfoNCE telemetry
+    and a retrieval probe out."""
+    path = tmp_path / "pairs.jsonl"
+    with open(path, "w") as f:
+        for i in range(8):
+            f.write(json.dumps({
+                "query": f"what is topic {i}",
+                "positive": f"topic {i} is item {i} " * 2,
+            }) + "\n")
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_BATCH_SIZE", "8")
+    monkeypatch.setenv("TPUFW_SEQ_LEN", "48")
+    monkeypatch.setenv("TPUFW_TOTAL_STEPS", "3")
+    monkeypatch.setenv("TPUFW_LR", "3e-3")
+    monkeypatch.setenv("TPUFW_EMBED_DATA", str(path))
+    monkeypatch.setenv("TPUFW_BIDIRECTIONAL", "1")
+    from tpufw.workloads import embed
+
+    assert embed.main() == 0
+    out = capsys.readouterr().out
+    assert "EMBED OK: 3 steps" in out
+    assert "causal=False" in out
+    probes = [
+        json.loads(line) for line in out.splitlines()
+        if line.startswith("{") and "probe_sim_matched" in line
+    ]
+    assert len(probes) == 1
+    metrics = [
+        json.loads(line) for line in out.splitlines()
+        if line.startswith("{") and "loss" in line
+    ]
+    assert metrics and "mfu" in metrics[0]
+
+
+def test_embed_workload_requires_data(monkeypatch):
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_BATCH_SIZE", "8")
+    from tpufw.workloads import embed
+
+    with pytest.raises(ValueError, match="TPUFW_EMBED_DATA"):
+        embed.main()
