@@ -346,6 +346,30 @@ def _orchestrate() -> int:
 # ----------------------------------------------------------------------
 
 
+def _timed_decode(model, params, prompts, pads, n_new: int):
+    """(wall seconds for one full generate) after a compile+warm call.
+    ONE copy of the decode timing discipline: np.asarray value fetch,
+    NOT block_until_ready — through the tunneled backend the latter can
+    return while the program is still executing (measured r3), which
+    would fake the rate. Shared by the Llama and MLA decode tiers."""
+    import numpy as _np
+
+    import jax
+
+    from tpufw.infer import SamplingConfig, generate
+
+    def gen():
+        return generate(
+            model, params, prompts, pads, jax.random.key(2),
+            max_new_tokens=n_new, sampling=SamplingConfig(),
+        )
+
+    _np.asarray(gen())  # compile + warm
+    t0 = time.perf_counter()
+    _np.asarray(gen())
+    return time.perf_counter() - t0, gen
+
+
 def _is_oom(e: Exception) -> bool:
     """Memory-driven tier failures worth DEGRADING on (vs real bugs
     worth raising). Through the tunneled backend, a compile-time HBM
@@ -707,23 +731,9 @@ def _worker() -> int:
                 ]
             )
 
-            def _gen():
-                return generate(
-                    dmodel, d_params, prompts, pads, jax.random.key(2),
-                    max_new_tokens=d_new, sampling=SamplingConfig(),
-                )
-
-            import numpy as _np
-
-            # np.asarray, NOT block_until_ready: through the tunneled
-            # backend block_until_ready can return while the program is
-            # still executing (measured in r3), which would fake the
-            # decode rate. A value fetch of the [B, T] token array is
-            # the only trustworthy sync.
-            _np.asarray(_gen())  # compile + warm
-            t0 = time.perf_counter()
-            _np.asarray(_gen())
-            dt = time.perf_counter() - t0
+            dt, _ = _timed_decode(
+                dmodel, d_params, prompts, pads, d_new
+            )
             decode = {
                 "batch_size": d_b,
                 "prompt_len": d_prompt,
@@ -749,17 +759,9 @@ def _worker() -> int:
                         _dc.replace(dcfg, quantized_weights=True)
                     )
 
-                    def _qgen():
-                        return generate(
-                            q_model, q_params, prompts, pads,
-                            jax.random.key(2), max_new_tokens=d_new,
-                            sampling=SamplingConfig(),
-                        )
-
-                    _np.asarray(_qgen())  # compile + warm
-                    t0 = time.perf_counter()
-                    _np.asarray(_qgen())
-                    qdt = time.perf_counter() - t0
+                    qdt, _ = _timed_decode(
+                        q_model, q_params, prompts, pads, d_new
+                    )
                     decode["int8_tokens_per_sec_per_chip"] = round(
                         d_b * d_new / qdt, 1
                     )
@@ -772,6 +774,70 @@ def _worker() -> int:
             del d_params
         except Exception as e:  # noqa: BLE001
             decode = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    # MLA decode tier: the DeepSeek latent cache's serving throughput
+    # on the same chip — decode is HBM-bound, and the latent is the
+    # family's 3.6x-smaller cache story (tpufw.models.deepseek), so
+    # this is the end-to-end number behind that claim. Best-effort like
+    # every aux tier.
+    mla_decode = None
+    if on_tpu and os.environ.get("TPUFW_BENCH_MLA", "1") != "0":
+        mla_decode = _aux_skip(300)
+    if on_tpu and mla_decode is None and os.environ.get(
+        "TPUFW_BENCH_MLA", "1"
+    ) != "0":
+        try:
+            import dataclasses as _dcm
+            import gc
+
+            import jax.numpy as jnp
+            import numpy as _np
+
+            from tpufw.infer import (
+                SamplingConfig,
+                cast_decode_params,
+                generate,
+            )
+            from tpufw.models import DEEPSEEK_CONFIGS, Deepseek
+
+            gc.collect()
+            m_b, m_prompt, m_new = 8, 128, 128
+            mcfg = _dcm.replace(
+                DEEPSEEK_CONFIGS["deepseek_mla_bench"].decode_config(),
+                max_seq_len=m_prompt + m_new,
+            )
+            mmodel = Deepseek(mcfg)
+            m_prompts = jax.random.randint(
+                jax.random.key(0), (m_b, m_prompt), 0, mcfg.vocab_size
+            )
+            m_pads = jnp.zeros((m_b,), jnp.int32)
+            m_params = cast_decode_params(
+                jax.jit(mmodel.init)(jax.random.key(1), m_prompts)[
+                    "params"
+                ]
+            )
+
+            mdt, _ = _timed_decode(
+                mmodel, m_params, m_prompts, m_pads, m_new
+            )
+            mla_decode = {
+                "model": "deepseek_mla_bench",
+                "params": mcfg.n_params(),
+                "batch_size": m_b,
+                "prompt_len": m_prompt,
+                "new_tokens": m_new,
+                "decode_tokens_per_sec_per_chip": round(
+                    m_b * m_new / mdt, 1
+                ),
+                # Per LAYER per token; total cache multiplies by
+                # n_layers (tpufw.tools.estimate_memory does).
+                "latent_cache_floats_per_token_per_layer": (
+                    mcfg.kv_lora_rank + mcfg.qk_rope_head_dim
+                ),
+            }
+            del m_params
+        except Exception as e:  # noqa: BLE001
+            mla_decode = {"error": f"{type(e).__name__}: {e}"[:500]}
 
     # ResNet tier (BASELINE config 2: ResNet-50 on one v5e chip) —
     # images/s/chip through the vision trainer, best-effort like the
@@ -867,6 +933,8 @@ def _worker() -> int:
         payload["long_seq"] = long_seq
     if decode is not None:
         payload["decode"] = decode
+    if mla_decode is not None:
+        payload["mla_decode"] = mla_decode
     if resnet is not None:
         payload["resnet"] = resnet
     # Full line (the orchestrator keeps the LAST json line it sees).

@@ -669,3 +669,49 @@ def test_export_hf_roundtrip_moe_yarn(tmp_path):
     np.testing.assert_allclose(
         got, np.asarray(want), atol=3e-4, rtol=2e-3
     )
+
+
+def test_pipeline_rejects_deepseek():
+    """The pipeline schedules build Llama-family stage stacks; a
+    DeepseekConfig must be rejected loudly, not silently mis-built."""
+    from tpufw.parallel.pipeline import PipelineConfig
+
+    with pytest.raises(NotImplementedError, match="Llama-family"):
+        PipelineConfig(n_stages=2, n_microbatches=2).validate(TINY, 8)
+
+
+def test_speculative_decode_with_latent_cache():
+    """Speculative decoding is architecture-generic: a 1-layer MLA
+    draft speculating for the tiny MLA target must emit EXACTLY the
+    target's greedy continuation through both latent caches."""
+    from flax.core import meta
+
+    from tpufw.infer import SamplingConfig, generate_text
+    from tpufw.infer.speculative import speculative_generate_text
+
+    cfg = dataclasses.replace(
+        TINY, max_seq_len=64, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    target = Deepseek(cfg.decode_config())
+    params = meta.unbox(
+        jax.jit(Deepseek(cfg).init)(
+            jax.random.key(0), jnp.zeros((2, 8), jnp.int32)
+        )
+    )["params"]
+    dcfg = dataclasses.replace(cfg, n_layers=1)
+    draft = Deepseek(dcfg.decode_config())
+    dparams = meta.unbox(
+        jax.jit(Deepseek(dcfg).init)(
+            jax.random.key(1), jnp.zeros((2, 8), jnp.int32)
+        )
+    )["params"]
+    ref = generate_text(
+        target, params, [[5, 6, 7], [9]], max_new_tokens=8,
+        sampling=SamplingConfig(),
+    )
+    spec, stats = speculative_generate_text(
+        draft, dparams, target, params, [[5, 6, 7], [9]],
+        max_new_tokens=8, k=3,
+    )
+    assert spec == ref
+    assert stats["emitted"] == 8

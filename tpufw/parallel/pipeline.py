@@ -115,6 +115,15 @@ def _check_model_split(cfg, n_stages: int) -> None:
     ``init_pipeline_params`` (direct callers) so the two can't drift:
     an unchecked config silently builds a truncated or wrong-family
     model."""
+    if not (isinstance(cfg, LlamaConfig) or _is_gemma(cfg)):
+        # A foreign config (e.g. DeepseekConfig: MLA attention, no
+        # n_kv_heads/head_dim) would silently build Llama-shaped
+        # stages — wrong model, no error until (at best) a missing
+        # attribute deep in init.
+        raise NotImplementedError(
+            f"pipeline schedules implement Llama-family and Gemma "
+            f"blocks; got {type(cfg).__name__}"
+        )
     if not getattr(cfg, "causal", True):
         # Both schedules hardcode causal attention; silently training
         # a causal model under a bidirectional config would be the
