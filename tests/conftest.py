@@ -32,18 +32,16 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compile cache for the CPU tier: the suite is dominated by
 # 8-device XLA compiles (the second full run drops from ~35 min to ~8).
-# Keyed by HLO hash, so code changes invalidate naturally. The env var
-# is jax's own, so subprocess tests (test_distributed workers) inherit
-# the cache without any tpufw code in the worker.
-_cache_dir = os.path.abspath(
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(__file__), "..", ".xla-cache-tests"),
-    )
-)
-from tpufw.utils.profiling import enable_compile_cache  # noqa: E402
-
-enable_compile_cache(_cache_dir)
+# NO persistent compile cache for the suite (round-3 lesson): a run
+# killed or crashed MID-WRITE leaves a truncated entry, and loading it
+# later ABORTS inside native deserialization — deterministic, survives
+# process restarts, and the crash site masquerades as whatever test
+# hits the entry (observed three times: cache read, cache write, jit
+# execute). The warm-cache saving on this box measured ~5-7 min on a
+# ~40 min suite; a self-perpetuating poison cache is not worth it.
+# Production paths (bench.py, workloads) keep enable_compile_cache —
+# their writers aren't routinely killed by test timeouts.
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 
 import pytest  # noqa: E402
 

@@ -238,14 +238,58 @@ class EmbeddingTrainer(Trainer):
     def evaluate(self, data, n_batches=None):
         raise NotImplementedError(
             "EmbeddingTrainer.evaluate would run the LM cross-entropy "
-            "on retrieval pairs — meaningless; measure retrieval "
-            "quality from embed() similarities instead"
+            "on retrieval pairs — meaningless; use evaluate_retrieval "
+            "(recall@k over held-out pairs) instead"
         )
 
     def compiled_eval_step(self, batch: dict):
         raise NotImplementedError(
-            "no LM eval step for contrastive training (see evaluate)"
+            "no LM eval step for contrastive training "
+            "(see evaluate_retrieval)"
         )
+
+    def evaluate_retrieval(
+        self,
+        pairs,
+        encode: Callable[[str], List[int]],
+        seq_len: Optional[int] = None,
+        ks: tuple = (1, 5, 10),
+        batch_rows: int = 64,
+    ) -> dict:
+        """Held-out retrieval metrics: every query scored against EVERY
+        document in ``pairs`` (the full candidate pool, not in-batch).
+
+        ``pairs``: an iterable of {"query", "positive"} dicts or a
+        JSONL path. Returns {"recall@k": ..., "mrr": ..., "n": N}.
+        Embedding happens in ``batch_rows`` chunks so the pool size is
+        bounded by host memory, not HBM.
+        """
+        if isinstance(pairs, (str, pathlib.Path)):
+            pairs = list(read_pairs(pairs))
+        else:
+            pairs = list(pairs)
+        if not pairs:
+            raise ValueError("evaluate_retrieval: no pairs")
+        t = seq_len or self.cfg.seq_len
+        n = len(pairs)
+        toks = np.zeros((2 * n, t), np.int32)
+        seg = np.zeros_like(toks)
+        for i, p in enumerate(pairs):
+            toks[i], seg[i] = _fit(encode(p["query"]), t)
+            toks[n + i], seg[n + i] = _fit(encode(p["positive"]), t)
+        embs = np.concatenate([
+            self.embed(toks[s: s + batch_rows], seg[s: s + batch_rows])
+            for s in range(0, 2 * n, batch_rows)
+        ])
+        q, d = embs[:n], embs[n:]
+        sim = q @ d.T  # [N, N]
+        # Rank of the true document for each query (0 = top).
+        order = np.argsort(-sim, axis=1)
+        ranks = np.argmax(order == np.arange(n)[:, None], axis=1)
+        out = {f"recall@{k}": float((ranks < k).mean()) for k in ks}
+        out["mrr"] = float((1.0 / (ranks + 1)).mean())
+        out["n"] = n
+        return out
 
     def compiled_step(self, batch: dict | None = None):
         from functools import partial
