@@ -30,8 +30,6 @@ import jax  # noqa: E402
 # which wins as long as no backend has been initialized yet.
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compile cache for the CPU tier: the suite is dominated by
-# 8-device XLA compiles (the second full run drops from ~35 min to ~8).
 # NO persistent compile cache for the suite (round-3 lesson): a run
 # killed or crashed MID-WRITE leaves a truncated entry, and loading it
 # later ABORTS inside native deserialization — deterministic, survives
@@ -42,6 +40,9 @@ jax.config.update("jax_platforms", "cpu")
 # Production paths (bench.py, workloads) keep enable_compile_cache —
 # their writers aren't routinely killed by test timeouts.
 os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+# jax captured the env var as its config default at import time above —
+# the pop alone is not enough when the var was exported in the shell.
+jax.config.update("jax_compilation_cache_dir", None)
 
 import pytest  # noqa: E402
 

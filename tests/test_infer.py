@@ -308,3 +308,67 @@ def test_cache_bucket_ladder():
     assert _cache_bucket(257, 8192) == 512
     assert _cache_bucket(9000, 8192) == 8192  # capped at model max
     assert _cache_bucket(1, 64) == 64  # floor still capped
+
+
+from flax.core import meta  # noqa: E402
+
+
+@pytest.mark.parametrize("chunk", [4, 5, 64])
+def test_chunked_prefill_matches_one_shot(chunk):
+    """Chunked prefill writes the identical cache (slot-ordered
+    causality), so outputs must equal the one-shot path exactly —
+    including a chunk that doesn't divide the prompt (5) and one
+    larger than it (64, falls back to one-shot)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        TINY, max_seq_len=96, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    model = Llama(cfg.decode_config())
+    params = meta.unbox(
+        jax.jit(Llama(cfg).init)(
+            jax.random.key(0), jnp.zeros((2, 8), jnp.int32)
+        )
+    )["params"]
+    prompts = [[5, 6, 7, 8, 9, 10, 11], [20, 21, 22]]  # ragged
+    ref = generate_text(
+        model, params, prompts, max_new_tokens=6,
+        sampling=SamplingConfig(),
+    )
+    toks, pads = pad_prompts(prompts)
+    out = generate(
+        model, params, jnp.asarray(toks), jnp.asarray(pads),
+        jax.random.key(1), max_new_tokens=6,
+        sampling=SamplingConfig(), prefill_chunk_size=chunk,
+    )
+    assert [row.tolist() for row in np.asarray(out)] == ref
+
+
+def test_chunked_prefill_matches_one_shot_mla():
+    """Same invariant through the DeepSeek latent cache."""
+    import dataclasses
+
+    from tpufw.models import DEEPSEEK_CONFIGS, Deepseek
+
+    cfg = dataclasses.replace(
+        DEEPSEEK_CONFIGS["deepseek_tiny"], max_seq_len=64,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    model = Deepseek(cfg.decode_config())
+    params = meta.unbox(
+        jax.jit(Deepseek(cfg).init)(
+            jax.random.key(2), jnp.zeros((2, 8), jnp.int32)
+        )
+    )["params"]
+    prompts = [[5, 6, 7, 8, 9], [9, 10]]
+    ref = generate_text(
+        model, params, prompts, max_new_tokens=5,
+        sampling=SamplingConfig(),
+    )
+    toks, pads = pad_prompts(prompts)
+    out = generate(
+        model, params, jnp.asarray(toks), jnp.asarray(pads),
+        jax.random.key(3), max_new_tokens=5,
+        sampling=SamplingConfig(), prefill_chunk_size=3,
+    )
+    assert [row.tolist() for row in np.asarray(out)] == ref

@@ -68,7 +68,10 @@ def pad_prompts(
 
 @partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "sampling", "pad_id", "eos_id"),
+    static_argnames=(
+        "model", "max_new_tokens", "sampling", "pad_id", "eos_id",
+        "prefill_chunk_size",
+    ),
 )
 def generate(
     model,
@@ -81,6 +84,7 @@ def generate(
     sampling: SamplingConfig = SamplingConfig(),
     pad_id: int = 0,
     eos_id: Optional[int] = None,
+    prefill_chunk_size: Optional[int] = None,
 ) -> jax.Array:
     """Generate continuations. Returns [B, max_new_tokens] int32.
 
@@ -93,6 +97,15 @@ def generate(
       rng: sampling key (unused for greedy).
       max_new_tokens: static decode length; rows that hit ``eos_id`` emit
         ``pad_id`` from then on.
+      prefill_chunk_size: process the prompt through the cache in
+        chunks of this many positions instead of one [B, P] forward —
+        prefill's transient activations then scale with the CHUNK, not
+        the prompt (the long-prompt serving lever; attention still sees
+        every cached earlier chunk). Full chunks run under ONE
+        ``lax.scan`` program (O(1) trace cost regardless of prompt
+        length); an indivisible tail adds at most one remainder
+        program. No padding, no extra cache slots; a chunk >= the
+        prompt degrades to the one-shot path.
     """
     b, p = prompt_tokens.shape
     if max_new_tokens < 1:
@@ -120,11 +133,54 @@ def generate(
         logits = out[0] if isinstance(out, tuple) else out  # MoE aux dropped
         return logits, {"cache": vars_["cache"]}
 
-    # Prefill: one pass over the whole (padded) prompt. Left-padding makes
-    # the last column the final real token of every row.
-    logits, cache = apply(
-        {}, prompt_tokens, positions, seg
-    )
+    # Prefill: the whole (padded) prompt through the cache — one pass,
+    # or fixed-size chunks under ``prefill_chunk_size`` (the cache
+    # cursor advances per chunk; slot-ordered causality makes chunked
+    # and one-shot prefill write identical caches). Left-padding makes
+    # the last column the final real token of every row either way.
+    if prefill_chunk_size is not None and 1 <= prefill_chunk_size < p:
+        c = prefill_chunk_size
+        n_full = p // c
+        # Chunk 0 outside the scan: its apply CREATES the cache
+        # variables the scan then carries.
+        logits, cache = apply(
+            {}, prompt_tokens[:, :c], positions[:, :c], seg[:, :c]
+        )
+
+        def mid(a, n):  # [B, (n)*c] -> [n, B, c]
+            return (
+                a[:, c: (n + 1) * c]
+                .reshape(b, n, c)
+                .swapaxes(0, 1)
+            )
+
+        if n_full > 1:
+            def chunk_step(carry, xs):
+                cache, _ = carry
+                tok_c, pos_c, seg_c = xs
+                lg, cache = apply(cache, tok_c, pos_c, seg_c)
+                return (cache, lg), None
+
+            # Logits ride the CARRY (each chunk overwrites), so the
+            # scan never stacks a [n_chunks, B, c, V] output.
+            (cache, logits), _ = jax.lax.scan(
+                chunk_step,
+                (cache, logits),
+                (
+                    mid(prompt_tokens, n_full - 1),
+                    mid(positions, n_full - 1),
+                    mid(seg, n_full - 1),
+                ),
+            )
+        if p % c:
+            s = n_full * c
+            logits, cache = apply(
+                cache, prompt_tokens[:, s:], positions[:, s:], seg[:, s:]
+            )
+    else:
+        logits, cache = apply(
+            {}, prompt_tokens, positions, seg
+        )
     # Repetition penalty needs a [B, V] presence mask of every token the
     # model has seen (prompt + generated). Built only when enabled — it
     # costs B*V bools in the scan carry.
@@ -185,6 +241,7 @@ def generate_text(
     pad_id: int = 0,
     eos_id: Optional[int] = None,
     seed: int = 0,
+    prefill_chunk_size: Optional[int] = None,
 ) -> list[list[int]]:
     """Convenience wrapper: ragged python prompts in, ragged lists out."""
     tokens, pads = pad_prompts(prompts, pad_id)
@@ -198,6 +255,7 @@ def generate_text(
         sampling=sampling,
         pad_id=pad_id,
         eos_id=eos_id,
+        prefill_chunk_size=prefill_chunk_size,
     )
     result = []
     for row in np.asarray(out):

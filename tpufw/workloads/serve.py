@@ -363,6 +363,9 @@ def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
             max_new_tokens=max_new_tokens,
             sampling=sampling,
             eos_id=eos_from_env(),
+            # Long-prompt lever: prefill activations scale with the
+            # chunk, not the prompt (tpufw.infer.generate). 0 = off.
+            prefill_chunk_size=env_int("prefill_chunk", 0) or None,
         )[:real_n]
     return [
         {
@@ -597,6 +600,7 @@ class _Server:
             max_new_tokens=max_new,
             sampling=self._sampling,
             eos_id=self._eos_id,
+            prefill_chunk_size=env_int("prefill_chunk", 0) or None,
         )
         return outs[:real_n]
 
