@@ -305,3 +305,47 @@ def test_undersized_shard_raises(tmp_path):
             path, batch_pairs=2, seq_len=32, encode=byte_encode,
             shard_id=0, num_shards=8,
         ))
+
+
+def test_dpo_with_lora_trains_adapters_only(tmp_path):
+    """PEFT-DPO: adapters train, the frozen base stays bit-identical,
+    and the reference (snapshotted at init, adapters zero) equals the
+    step-0 policy — so the log-2 anchor still holds."""
+    import dataclasses
+
+    path = _pairs_file(tmp_path)
+    cfg = dataclasses.replace(TINY, lora_rank=4)
+    trainer = DPOTrainer(
+        Llama(cfg),
+        TrainerConfig(
+            batch_size=8, seq_len=48, total_steps=4, lr=5e-3,
+            warmup_steps=1, loss_chunk_size=16, log_every=1,
+        ),
+        MeshConfig(),
+        dpo=DPOConfig(beta=0.5, ref_dtype="float32"),
+    )
+    trainer.init_state()
+    base_before = np.asarray(
+        trainer.state.params["layers"]["attn"]["q"]["kernel"]
+    )
+    data = dpo_batches(
+        path, batch_pairs=4, seq_len=48, encode=byte_encode, seed=3
+    )
+    batch = trainer.globalize_batch(next(data))
+    step = trainer.compiled_step(batch)
+    first = None
+    for i in range(4):
+        trainer.state, m = step(trainer.state, batch)
+        if i == 0:
+            first = {k: float(v) for k, v in m.items()}
+    assert abs(first["loss"] - math.log(2.0)) < 1e-4  # anchor holds
+    # Base kernel untouched; adapters moved.
+    np.testing.assert_array_equal(
+        np.asarray(trainer.state.params["layers"]["attn"]["q"]["kernel"]),
+        base_before,
+    )
+    b_adapter = trainer.state.params["layers"]["attn"]["q_lora_b"][
+        "kernel"
+    ]
+    assert float(jnp.abs(b_adapter).max()) > 0  # trained away from 0
+    assert float(m["margin"]) > 0

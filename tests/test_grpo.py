@@ -241,3 +241,34 @@ def test_run_rl_checkpoints_and_resumes(tmp_path):
     h2 = t2.run_rl([[3, 4], [5, 6]], _low_token_reward, seed=7)
     # Global budget: only the REMAINING 2 steps run.
     assert len(h2) == 2 and h2[-1]["step"] == 4
+
+
+def test_grpo_with_lora_trains_adapters_only():
+    """PEFT-RL: GRPO on a LoRA config updates adapters only; rollouts
+    run through the adapted policy (base + zero-init B at step 0)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, lora_rank=4)
+    trainer = GRPOTrainer(
+        Llama(cfg),
+        TrainerConfig(
+            batch_size=8, seq_len=24, total_steps=2, lr=1e-2,
+            warmup_steps=1, loss_chunk_size=8, log_every=1,
+        ),
+        MeshConfig(),
+        grpo=GRPOConfig(group_size=4, max_new_tokens=6),
+    )
+    trainer.init_state()
+    base_before = np.asarray(
+        trainer.state.params["layers"]["attn"]["q"]["kernel"]
+    )
+    hist = trainer.run_rl([[3, 4], [5, 6]], _low_token_reward, seed=11)
+    assert len(hist) == 2
+    np.testing.assert_array_equal(
+        np.asarray(trainer.state.params["layers"]["attn"]["q"]["kernel"]),
+        base_before,
+    )
+    b_adapter = trainer.state.params["layers"]["attn"]["q_lora_b"][
+        "kernel"
+    ]
+    assert float(jnp.abs(b_adapter).max()) > 0
