@@ -239,3 +239,39 @@ def test_evaluate_retrieval(tmp_path):
     assert m["recall@1"] >= 0.5
     assert m["recall@5"] == 1.0
     assert m["mrr"] > 0.6
+
+
+def test_lora_bidirectional_embedding_trains_adapters_only(tmp_path):
+    """The actual LLM2Vec recipe: bidirectional trunk + LoRA adapters.
+    Contrastive training moves only the adapters; the frozen base stays
+    bit-identical."""
+    path = _pairs_file(tmp_path)
+    cfg = dataclasses.replace(TINY, causal=False, lora_rank=4)
+    trainer = EmbeddingTrainer(
+        Llama(cfg),
+        TrainerConfig(
+            batch_size=8, seq_len=48, total_steps=4, lr=5e-3,
+            warmup_steps=1, log_every=1,
+        ),
+        MeshConfig(),
+        contrastive=ContrastiveConfig(pooling="mean"),
+    )
+    trainer.init_state()
+    base_before = np.asarray(
+        trainer.state.params["layers"]["attn"]["q"]["kernel"]
+    )
+    data = pair_batches(
+        path, batch_pairs=4, seq_len=48, encode=byte_encode, seed=4
+    )
+    hist = trainer.run(
+        data, model_flops_per_token=TINY.flops_per_token(47)
+    )
+    assert len(hist) == 4 and np.isfinite(hist[-1].loss)
+    np.testing.assert_array_equal(
+        np.asarray(trainer.state.params["layers"]["attn"]["q"]["kernel"]),
+        base_before,
+    )
+    b_adapter = trainer.state.params["layers"]["attn"]["q_lora_b"][
+        "kernel"
+    ]
+    assert float(jnp.abs(np.asarray(b_adapter)).max()) > 0
