@@ -63,6 +63,23 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+def _persist(line: str) -> None:
+    """Write a measured TPU line to disk THE MOMENT it exists (round-3
+    lesson: a later hang/kill must not erase an already-won number).
+    Path: TPUFW_BENCH_SAVE, default ``.bench-last-tpu.json`` next to
+    this file. Best-effort — persistence must never kill the bench."""
+    path = os.environ.get("TPUFW_BENCH_SAVE") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".bench-last-tpu.json"
+    )
+    try:
+        with open(path, "a") as f:
+            f.write(
+                json.dumps({"t": time.time(), "line": line}) + "\n"
+            )
+    except OSError:
+        pass
+
+
 def _fail_line(err: str) -> None:
     """Terminal failure: still one JSON line, rc 0, so the driver records
     evidence instead of a bare traceback."""
@@ -83,16 +100,19 @@ def _fail_line(err: str) -> None:
 
 
 def _last_json_line(text: str) -> str | None:
-    """The last stdout line that looks like a JSON object — the one
-    emission contract every child stage shares."""
-    return next(
-        (
-            ln
-            for ln in reversed((text or "").strip().splitlines())
-            if ln.startswith("{")
-        ),
-        None,
-    )
+    """The last stdout line that PARSES as a JSON object — the one
+    emission contract every child stage shares. Parse-checked because a
+    SIGKILL after the grace window can land mid-print, and a truncated
+    fragment must not shadow the complete checkpoint lines above it."""
+    for ln in reversed((text or "").strip().splitlines()):
+        if not ln.startswith("{"):
+            continue
+        try:
+            json.loads(ln)
+        except ValueError:
+            continue
+        return ln
+    return None
 
 
 def _run_worker(extra_env: dict, timeout: int) -> tuple[str | None, str]:
@@ -103,6 +123,7 @@ def _run_worker(extra_env: dict, timeout: int) -> tuple[str | None, str]:
     ``timeout`` allocated here — so cold-start numbers and aux-tier
     time-boxing are per-attempt, never polluted by earlier failed
     attempts (VERDICT r2 weak #2)."""
+    import signal
     import subprocess
 
     env = dict(os.environ)
@@ -110,28 +131,49 @@ def _run_worker(extra_env: dict, timeout: int) -> tuple[str | None, str]:
     env["TPUFW_BENCH_STAGE"] = "worker"
     env["TPUFW_BENCH_T0"] = repr(time.time())
     env["TPUFW_BENCH_TIMEOUT"] = str(int(timeout))
+    # Compile-kill safety (round-3 postmortem: a client SIGKILLed
+    # mid-server-compile wedged the tunnel backend for 7+ hours): never
+    # hard-kill first. At the deadline send SIGTERM — the worker's
+    # handler exits cleanly between Python statements, and a worker
+    # stuck inside a server-side compile keeps the RPC alive through
+    # the grace window so the server isn't orphaned mid-compile — and
+    # only SIGKILL after TPUFW_BENCH_KILL_GRACE (default 120s).
+    grace = int(os.environ.get("TPUFW_BENCH_KILL_GRACE", "120"))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    killed_how = None
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-        )
-    except subprocess.TimeoutExpired as te:
-        # Salvage: the worker emits its headline line BEFORE the aux
-        # tiers, so a timeout mid-aux still yields the measured number.
-        out = te.stdout or ""
-        if isinstance(out, bytes):
-            out = out.decode(errors="replace")
-        line = _last_json_line(out)
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            stdout, stderr = proc.communicate(timeout=grace)
+            killed_how = "sigterm"
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            stdout, stderr = proc.communicate()
+            killed_how = "sigkill"
+    if killed_how is not None:
+        # Salvage: the worker re-emits its payload line after the
+        # headline AND after every aux tier, so a timeout at any point
+        # past the headline still yields everything measured so far.
+        line = _last_json_line(stdout or "")
         if line is not None:
             sys.stderr.write(
-                f"bench: worker hit {timeout}s watchdog after the "
-                "headline was measured; reporting the salvaged line\n"
+                f"bench: worker hit {timeout}s watchdog "
+                f"({killed_how}) after the headline was measured; "
+                "reporting the salvaged line\n"
             )
             return line, ""
-        return None, f"bench worker exceeded {timeout}s (hung; killed)"
+        return None, (
+            f"bench worker exceeded {timeout}s (hung; {killed_how})"
+        )
+    proc_stdout, proc_stderr, proc_rc = stdout, stderr, proc.returncode
     # Pass worker diagnostics (tier OOM notes, tracebacks) through —
     # minus XLA's cpu_aot_loader machine-feature spray: with the cache
     # keyed per-machine (tpufw.utils.profiling.machine_fingerprint) the
@@ -141,7 +183,7 @@ def _run_worker(extra_env: dict, timeout: int) -> tuple[str | None, str]:
     # false positive (the r2 bench executed fine through it), not a real
     # ISA mismatch.
     dropped = 0
-    for ln in proc.stderr.splitlines(keepends=True):
+    for ln in (proc_stderr or "").splitlines(keepends=True):
         if "cpu_aot_loader" in ln and "machine features" in ln.lower():
             dropped += 1
             continue
@@ -152,10 +194,10 @@ def _run_worker(extra_env: dict, timeout: int) -> tuple[str | None, str]:
             "lines (known same-host false positive: XLA prefer-no-* "
             "codegen preferences; cache is keyed per-machine)\n"
         )
-    line = _last_json_line(proc.stdout)
-    if proc.returncode == 0 and line:
+    line = _last_json_line(proc_stdout)
+    if proc_rc == 0 and line:
         return line, ""
-    tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+    tail = (proc_stderr or proc_stdout or "").strip().splitlines()
     return None, "worker failed: " + " | ".join(tail[-4:])
 
 
@@ -214,6 +256,10 @@ def _orchestrate() -> int:
     tpu_timeout = int(os.environ.get("TPUFW_BENCH_TIMEOUT", "1200"))
     cpu_timeout = int(os.environ.get("TPUFW_BENCH_CPU_TIMEOUT", "600"))
     probe_timeout = int(os.environ.get("TPUFW_BENCH_PROBE_TIMEOUT", "150"))
+    # A hung worker consumes its budget PLUS the TERM->KILL grace
+    # window; every budget handed to _run_worker below subtracts it so
+    # the orchestration never overshoots TPUFW_BENCH_TOTAL.
+    grace = int(os.environ.get("TPUFW_BENCH_KILL_GRACE", "120"))
 
     def left() -> float:
         return total - (time.time() - t_start)
@@ -244,7 +290,7 @@ def _orchestrate() -> int:
             )
     if probe == "tpu":
         # Keep headroom for a CPU fallback line if the worker dies.
-        budget = int(min(tpu_timeout, left() - 120))
+        budget = int(min(tpu_timeout, left() - 120 - grace))
         if budget > 120:
             t0 = time.time()
             line, err = _run_worker({}, budget)
@@ -256,10 +302,11 @@ def _orchestrate() -> int:
                 )
             else:
                 platform_used = "tpu"
+                _persist(line)
 
     # Phase 3: CPU path (fallback, or first line while the TPU is down).
     if line is None:
-        budget = int(min(cpu_timeout, max(60, left() - 30)))
+        budget = int(min(cpu_timeout, max(60, left() - 30 - grace)))
         line, err = _run_worker({"JAX_PLATFORMS": "cpu"}, budget)
         if line is not None:
             platform_used = "cpu"
@@ -274,11 +321,12 @@ def _orchestrate() -> int:
     # looping would stall every CPU-only environment by the whole
     # remaining budget. Each retry needs probe + a meaningful worker
     # budget.
+    late_worker_fails = 0
     while (
         want_tpu
         and platform_used == "cpu"
         and probe == "error"
-        and left() > probe_timeout + 420
+        and left() > probe_timeout + 420 + grace
     ):
         t0 = time.time()
         probe, info = _probe_tpu(probe_timeout)
@@ -286,13 +334,27 @@ def _orchestrate() -> int:
         tpu_time += dt
         if probe == "tpu":
             t0 = time.time()
-            tline, err = _run_worker({}, int(min(tpu_timeout, left() - 60)))
+            tline, err = _run_worker(
+                {}, int(min(tpu_timeout, left() - 60 - grace))
+            )
             tpu_time += time.time() - t0
             if tline is not None:
                 line, platform_used, tpu_errs = tline, "tpu", []
-            else:
-                tpu_errs.append(f"late tpu worker: {err}")
-            break
+                _persist(line)
+                break
+            # A failed worker after a good probe is NOT terminal
+            # (round-3 lesson: retry across the WHOLE window, not
+            # once) — but a worker that fails twice with the probe
+            # still answering is a deterministic bug, and hammering a
+            # responsive backend with doomed multi-minute compiles is
+            # the wedge-inducing behavior this file exists to avoid.
+            tpu_errs.append(f"late tpu worker: {err}")
+            late_worker_fails += 1
+            if late_worker_fails >= 2:
+                break
+            probe = "error"
+            time.sleep(30.0)
+            continue
         if not tpu_errs or tpu_errs[-1] != f"re-probe: {info}":
             tpu_errs.append(f"re-probe: {info}")
         # A hung probe already burned its timeout; a fast-fail needs a
@@ -309,7 +371,7 @@ def _orchestrate() -> int:
     # cache: the BASELINE metric-2 pair (cold vs warm first-contact).
     if payload.get("cold_start_to_first_step_s") is not None and left() > (
         300 if platform_used == "tpu" else 90
-    ):
+    ) + grace:
         tier = {
             k: payload.get(k)
             for k in (
@@ -319,7 +381,9 @@ def _orchestrate() -> int:
         extra = {"TPUFW_BENCH_WARM_TIER": json.dumps(tier)}
         if platform_used == "cpu":
             extra["JAX_PLATFORMS"] = "cpu"
-        wline, werr = _run_worker(extra, int(min(left() - 30, 600)))
+        wline, werr = _run_worker(
+            extra, int(min(left() - 30 - grace, 600))
+        )
         if wline is not None:
             try:
                 payload["warm_start_to_first_step_s"] = json.loads(
@@ -446,6 +510,16 @@ def _run_tier(
 
 
 def _worker() -> int:
+    import signal
+
+    # Compile-kill safety, worker half: the orchestrator TERMs before
+    # it KILLs — exit cleanly from Python context (SystemExit is a
+    # BaseException, so no aux-tier `except Exception` swallows it, and
+    # every already-measured tier was already emitted+flushed). A
+    # worker wedged inside a native call ignores this and eats the
+    # SIGKILL after the grace window, as before.
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
     # Persistent XLA compile cache: first bench run pays the (slow) TPU
     # compile once; reruns — including the driver's end-of-round run —
     # start in seconds. Same lever as the deploy manifests' cache PV.
@@ -536,6 +610,16 @@ def _worker() -> int:
     last_err: Exception | None = None
     first_step: dict = {}
     for batch_size, seq_len, chunk, policy in tiers:
+        # Each OOM fallback pays a FRESH server-side compile (2-10 min
+        # through the tunnel); starting one the budget can't cover
+        # means an external kill mid-compile — the exact event that
+        # wedges the backend. Stop cleanly instead.
+        if last_err is not None and _time_left() < 300:
+            last_err = RuntimeError(
+                f"{int(_time_left())}s left < 300s needed for another "
+                f"tier compile; stopping after: {last_err}"
+            )
+            break
         try:
             history = _run_tier(
                 model_cfg, batch_size, seq_len, warmup, measured, chunk,
@@ -599,6 +683,15 @@ def _worker() -> int:
     # the orchestrator salvages this line instead of losing the run.
     _emit(payload)
 
+    def _attach(key: str, val) -> None:
+        # Re-emit the FULL payload after every aux tier (round-3
+        # postmortem: a kill during the last tier erased every earlier
+        # aux result) — the orchestrator keeps the last line it sees,
+        # so each emission checkpoints everything measured so far.
+        if val is not None:
+            payload[key] = val
+            _emit(payload)
+
     # Packed-batch tier (VERDICT r1 item 2): the same config on PACKED
     # synthetic data — segment_ids + loss_mask through the segment-aware
     # flash kernel — so the measured number covers the production data
@@ -650,6 +743,7 @@ def _worker() -> int:
                 # The error is carried in the payload — visible, not
                 # masked.
                 packed = {"error": f"{type(e).__name__}: {e}"[:500]}
+    _attach("packed", packed)
 
     # Long-context tier (VERDICT r1 item 5's bench half): seq 8192 via the
     # flash kernel — the memory regime where materialized logits would
@@ -687,6 +781,7 @@ def _worker() -> int:
                     "seq_len": 8192,
                     "error": f"{type(e).__name__}: {e}"[:500],
                 }
+    _attach("long_seq", long_seq)
 
     # Decode tier: KV-cache autoregressive generation throughput on the
     # same architecture (the serving half, tpufw.infer). Fresh random
@@ -774,6 +869,7 @@ def _worker() -> int:
             del d_params
         except Exception as e:  # noqa: BLE001
             decode = {"error": f"{type(e).__name__}: {e}"[:500]}
+    _attach("decode", decode)
 
     # MLA decode tier: the DeepSeek latent cache's serving throughput
     # on the same chip — decode is HBM-bound, and the latent is the
@@ -838,6 +934,7 @@ def _worker() -> int:
             del m_params
         except Exception as e:  # noqa: BLE001
             mla_decode = {"error": f"{type(e).__name__}: {e}"[:500]}
+    _attach("mla_decode", mla_decode)
 
     # ResNet tier (BASELINE config 2: ResNet-50 on one v5e chip) —
     # images/s/chip through the vision trainer, best-effort like the
@@ -926,19 +1023,7 @@ def _worker() -> int:
                 raise RuntimeError(f"all resnet tiers OOM; last: {r_err}")
         except Exception as e:  # noqa: BLE001
             resnet = {"error": f"{type(e).__name__}: {e}"[:500]}
-
-    if packed is not None:
-        payload["packed"] = packed
-    if long_seq is not None:
-        payload["long_seq"] = long_seq
-    if decode is not None:
-        payload["decode"] = decode
-    if mla_decode is not None:
-        payload["mla_decode"] = mla_decode
-    if resnet is not None:
-        payload["resnet"] = resnet
-    # Full line (the orchestrator keeps the LAST json line it sees).
-    _emit(payload)
+    _attach("resnet", resnet)
     return 0
 
 

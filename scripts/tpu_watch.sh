@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# TPU tunnel watcher: probe until the wedged backend clears, then bank a
+# full bench run IMMEDIATELY (round-3 lesson, docs/PERF.md: tunnel
+# wedges last hours and numbers must be banked early — the driver's
+# end-of-round run has repeatedly landed inside a wedge window).
+#
+# Compile-kill safety: the probe child is init-only (jax.devices()
+# starts no server-side compile, so killing a hung probe cannot orphan
+# one); the bench run gets NO outer timeout — bench.py self-budgets
+# (TPUFW_BENCH_TOTAL), TERMs-then-KILLs its own workers with a grace
+# window, and always exits with one JSON line.
+#
+# Usage: scripts/tpu_watch.sh [interval_s] (default 540)
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${1:-540}"
+LOG=docs/evidence/tpu_watch_r4.log
+mkdir -p docs/evidence
+
+probe() {
+  timeout 90 python -c '
+import jax
+d = jax.devices()
+print("PROBE_OK", d[0].platform, d[0].device_kind, len(d))
+' 2>/dev/null
+}
+
+echo "$(date -u +%FT%TZ) watcher start (interval ${INTERVAL}s)" >> "$LOG"
+while true; do
+  out=$(probe)
+  if echo "$out" | grep -q "PROBE_OK.*tpu"; then
+    echo "$(date -u +%FT%TZ) probe ok: $out" >> "$LOG"
+    echo "$(date -u +%FT%TZ) bench starting" >> "$LOG"
+    TPUFW_BENCH_TOTAL="${TPUFW_BENCH_TOTAL:-3000}" \
+    TPUFW_BENCH_SAVE=docs/evidence/BENCH_r4_watch_tpu.jsonl \
+      python bench.py \
+      > docs/evidence/BENCH_r4_watch.json \
+      2> docs/evidence/BENCH_r4_watch.err
+    rc=$?
+    echo "$(date -u +%FT%TZ) bench done rc=$rc: $(cat docs/evidence/BENCH_r4_watch.json)" >> "$LOG"
+    break
+  fi
+  echo "$(date -u +%FT%TZ) probe failed/hung: ${out:-<none>}" >> "$LOG"
+  sleep "$INTERVAL"
+done
+echo "$(date -u +%FT%TZ) watcher exit" >> "$LOG"

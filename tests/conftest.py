@@ -77,8 +77,6 @@ def clear_tpufw_env(monkeypatch):
 # cache keeps the recompile cost near zero.
 import gc
 
-import pytest
-
 
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():

@@ -108,6 +108,68 @@ def test_train_llama_dpo_objective(capsys, monkeypatch, tmp_path):
     ) < 1e-4
 
 
+def test_train_llama_dpo_resume_after_checkpoint(
+    capsys, monkeypatch, tmp_path
+):
+    """ADVICE r3 (medium): a DPO pod restarting after its first
+    checkpoint must RESUME — the reference re-anchored to the ORIGINAL
+    base weights via TPUFW_INIT_FROM before restore — not crash-loop.
+    train_llama.main orders init_from_params BEFORE maybe_restore for
+    the DPO objective (deploy/manifests/10-dpo-v5e4.yaml's shape)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import LLAMA_CONFIGS, Llama
+    from tpufw.train import Trainer, TrainerConfig
+
+    # A bare-params checkpoint: the import_hf CLI's output shape.
+    base = Trainer(
+        Llama(LLAMA_CONFIGS["llama3_tiny"]),
+        TrainerConfig(batch_size=8, seq_len=32, total_steps=1),
+        MeshConfig(),
+    )
+    base.init_state(seed=3)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(
+            str(tmp_path / "base_params"),
+            jax.device_get(base.state.params),
+        )
+
+    pairs = tmp_path / "pairs.jsonl"
+    with open(pairs, "w") as f:
+        for i in range(4):
+            f.write(json.dumps({
+                "prompt": f"q {i}", "chosen": "good", "rejected": "bad",
+            }) + "\n")
+
+    for k, v in {
+        "TPUFW_MODEL": "llama3_tiny",
+        "TPUFW_BATCH_SIZE": "8",
+        "TPUFW_SEQ_LEN": "32",
+        "TPUFW_TOTAL_STEPS": "2",
+        "TPUFW_LOG_EVERY": "1",
+        "TPUFW_LOSS_CHUNK_SIZE": "16",
+        "TPUFW_DPO_DATA": str(pairs),
+        "TPUFW_INIT_FROM": str(tmp_path / "base_params"),
+        "TPUFW_CHECKPOINT_DIR": str(tmp_path / "ckpt"),
+        "TPUFW_CHECKPOINT_EVERY": "1",
+    }.items():
+        monkeypatch.setenv(k, v)
+    from tpufw.workloads import train_llama
+
+    assert train_llama.main() == 0
+    assert "initialized params from" in capsys.readouterr().out
+
+    # Pod restart, same env: pre-fix this raised RuntimeError ("resumed
+    # a DPO run mid-training without a reference snapshot").
+    monkeypatch.setenv("TPUFW_TOTAL_STEPS", "3")
+    assert train_llama.main() == 0
+    out = capsys.readouterr().out
+    assert "initialized params from" in out
+    assert "resumed from checkpoint at step 2" in out
+
+
 def test_train_llama_distill_objective(capsys, monkeypatch):
     """TPUFW_DISTILL_TEACHER switches to DistillTrainer (random teacher
     warns loudly; real deploys pass TPUFW_DISTILL_TEACHER_CKPT)."""

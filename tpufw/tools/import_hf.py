@@ -154,9 +154,12 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
 def _deepseek_config_from_hf(get):
     """tpufw DeepseekConfig from a transformers DeepseekV2Config.
 
-    Rejects, loudly, what tpufw's MLA blocks don't implement: routed
-    experts (DeepSeek MoE FFN), yarn rope scaling, attention bias —
-    importing them would produce silently wrong logits."""
+    Routed experts (DeepSeek MoE FFN) and yarn rope scaling import
+    directly. Rejects, loudly, what tpufw's MLA blocks don't implement:
+    group-limited routing (n_group/topk_group via non-greedy
+    topk_method), non-softmax scoring, sparse moe_layer_freq, and
+    attention bias — importing them would produce silently wrong
+    logits."""
     from tpufw.models.deepseek import DeepseekConfig
 
     bad = {}
@@ -215,8 +218,9 @@ def _deepseek_config_from_hf(get):
     if bad:
         raise NotImplementedError(
             f"DeepseekV2 import: unsupported features {bad}; tpufw's "
-            "MLA family implements greedy-softmax MoE and default rope "
-            "(yarn + group-limited routing are the known gaps)"
+            "MLA family implements greedy-softmax MoE and default+yarn "
+            "rope (group-limited routing, non-softmax scoring, sparse "
+            "moe_layer_freq, and attention bias are the known gaps)"
         )
     moe_kwargs = {}
     if has_moe:

@@ -228,11 +228,21 @@ def main() -> int:
         + (f" compile_cache={cache}" if cache else "")
     )
 
+    from tpufw.train import DPOTrainer as _DPOT
+
+    init_from = env_str("init_from", "")
+    if isinstance(trainer, _DPOT) and init_from:
+        # DPO resume safety (mirrors rl.py's ordering): anchor the
+        # reference snapshot to the ORIGINAL base weights BEFORE
+        # restoring — maybe_restore() overwrites only policy/optimizer
+        # state, so ref_params keeps the step-0 anchor and a pod
+        # restart after the first checkpoint no longer crash-loops.
+        trainer.init_from_params(init_from, seed=env_int("seed", 0))
+        print(f"initialized params from {init_from}")
     resumed = trainer.maybe_restore()
     if resumed:
         print(f"resumed from checkpoint at step {int(trainer.state.step)}")
-    else:
-        init_from = env_str("init_from", "")
+    elif trainer.state is None:
         if init_from:
             # Bare-params checkpoint (tpufw.tools.import_hf CLI output):
             # fine-tune from imported weights, fresh optimizer state.
