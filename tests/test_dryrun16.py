@@ -1,0 +1,36 @@
+"""16-device virtual-mesh shapes (VERDICT r3 item 6).
+
+BASELINE config 4 is a 4x4 v5e-16 and config 5 a v5p-32; before this
+test the largest pipe/tensor/expert factor the suite ever type-checked
+was 2. The worker subprocess (its own process: conftest pins THIS one
+to 8 devices) runs one train step each at pipe=4 x tensor=4 (MLA, 8
+layers) and expert=8 (Mixtral) on a 16-device CPU mesh.
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_16_device_4x4_shapes():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(ROOT, "tests", "dryrun16_worker.py"),
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=ROOT,
+    )
+    assert proc.returncode == 0, (
+        f"stdout: {proc.stdout[-2000:]}\nstderr: {proc.stderr[-2000:]}"
+    )
+    assert "PP4TP4_OK" in proc.stdout, proc.stdout
+    assert "EP8_OK" in proc.stdout, proc.stdout

@@ -1,0 +1,240 @@
+"""Pipelined DeepSeek-MLA blocks == the flax Deepseek model == the
+sequential oracle.
+
+Three-way parity: (1) ``reference_forward`` on a param tree CONVERTED
+from a flax ``Deepseek`` init must reproduce the flax logits (pins the
+functional ``_mla_block`` math to the model of record,
+tpufw/models/deepseek.py); (2) ``pipeline_forward`` on the pipe mesh
+must match ``reference_forward`` on the same params (pins the schedule);
+(3) gradients match the sequential oracle, including under pp x tp
+(pins the replicated-latent-kernel transpose). VERDICT r3 item 8.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.core import meta
+
+from tpufw.mesh import MeshConfig, build_mesh
+from tpufw.models import DEEPSEEK_CONFIGS, Deepseek
+from tpufw.parallel.pipeline import (
+    PipelineConfig,
+    init_pipeline_params,
+    pipeline_forward,
+    pipeline_loss,
+    pipeline_param_shardings,
+    reference_forward,
+)
+
+CFG = dataclasses.replace(
+    DEEPSEEK_CONFIGS["deepseek_tiny"],
+    dtype=jnp.float32,
+    param_dtype=jnp.float32,
+    n_layers=4,
+)
+QCFG = dataclasses.replace(
+    DEEPSEEK_CONFIGS["deepseek_tiny_qlora"],
+    dtype=jnp.float32,
+    param_dtype=jnp.float32,
+    n_layers=4,
+)
+
+
+def _flax_to_pipeline(flax_params: dict, cfg, n_stages: int) -> dict:
+    """Reshape a scanned flax Deepseek tree ([L, ...] leaves) into the
+    pipeline's [S, lps, ...] stage stacks — exact, no re-derivation, so
+    the parity test pins the MATH, not an init coincidence."""
+    p = meta.unbox(flax_params)
+    lps = cfg.n_layers // n_stages
+
+    def stack(leaf):
+        return leaf.reshape(n_stages, lps, *leaf.shape[1:])
+
+    layers, attn = p["layers"], p["layers"]["attn"]
+    stages = {
+        "attn_norm": stack(layers["attn_norm"]["scale"]),
+        "kv_a_norm": stack(attn["kv_a_norm"]["scale"]),
+        "wkv_a": stack(attn["kv_a"]["kernel"]),
+        "wkv_b": stack(attn["kv_b_kernel"]),
+        "wo": stack(attn["o"]["kernel"]),
+        "mlp_norm": stack(layers["mlp_norm"]["scale"]),
+        "w_gate": stack(layers["mlp"]["gate"]["kernel"]),
+        "w_up": stack(layers["mlp"]["up"]["kernel"]),
+        "w_down": stack(layers["mlp"]["down"]["kernel"]),
+    }
+    if cfg.q_lora_rank is None:
+        stages["wq"] = stack(attn["q"]["kernel"])
+    else:
+        stages["wq_a"] = stack(attn["q_a"]["kernel"])
+        stages["q_a_norm"] = stack(attn["q_a_norm"]["scale"])
+        stages["wq_b"] = stack(attn["q_b"]["kernel"])
+    return {
+        "embed": p["embed"]["embedding"],
+        "stages": stages,
+        "final_norm": p["final_norm"]["scale"],
+        "head": p["lm_head"]["kernel"],
+    }
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(MeshConfig(data=1, pipe=2, fsdp=4))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    pipe = PipelineConfig(n_stages=2, n_microbatches=4)
+    params = init_pipeline_params(jax.random.key(0), CFG, pipe)
+    tokens = jax.random.randint(
+        jax.random.key(1), (16, 17), 0, CFG.vocab_size
+    )
+    return params, tokens, pipe
+
+
+@pytest.mark.parametrize("cfg", [CFG, QCFG], ids=["full_q", "q_lora"])
+def test_sequential_oracle_matches_flax(cfg):
+    """_mla_block == the flax DeepseekBlock, both q paths."""
+    model = Deepseek(cfg)
+    tokens = jax.random.randint(
+        jax.random.key(2), (2, 13), 0, cfg.vocab_size
+    )
+    fparams = jax.jit(model.init)(
+        jax.random.key(3), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    want = model.apply({"params": fparams}, tokens)
+    got = reference_forward(
+        _flax_to_pipeline(fparams, cfg, n_stages=2), tokens, cfg
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_pipeline_matches_sequential(setup, mesh):
+    params, tokens, pipe = setup
+    params = jax.device_put(
+        params, pipeline_param_shardings(mesh, params)
+    )
+    got = jax.jit(
+        lambda p, t: pipeline_forward(p, t, CFG, pipe, mesh)
+    )(params, tokens)
+    want = reference_forward(params, tokens, CFG)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_grads_match_sequential(setup, mesh):
+    params, tokens, pipe = setup
+    params = jax.device_put(
+        params, pipeline_param_shardings(mesh, params)
+    )
+
+    def ref_loss(p, t):
+        from tpufw.train.trainer import cross_entropy_loss
+
+        logits = reference_forward(p, t[:, :-1], CFG)
+        return cross_entropy_loss(logits, t[:, 1:])[0]
+
+    l_pipe, g_pipe = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: pipeline_loss(p, t, CFG, pipe, mesh)
+        )
+    )(params, tokens)
+    l_ref, g_ref = jax.value_and_grad(ref_loss)(params, tokens)
+    np.testing.assert_allclose(float(l_pipe), float(l_ref), rtol=1e-5)
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pipe)
+    flat_r, _ = jax.tree_util.tree_flatten_with_path(g_ref)
+    for (path, a), (_, b) in zip(flat_p, flat_r):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_pptp_forward_and_grads(setup):
+    """pp x tp: heads split across tensor, latent kernels replicated —
+    forward AND grads must still match the sequential oracle (the
+    replicated wkv_a's gradient needs the tensor-psum on transpose)."""
+    mesh = build_mesh(MeshConfig(data=1, pipe=2, fsdp=2, tensor=2))
+    params, tokens, pipe = setup
+    params = jax.device_put(
+        params, pipeline_param_shardings(mesh, params)
+    )
+    assert "tensor" in str(params["stages"]["wkv_b"].sharding.spec)
+    assert "tensor" not in str(params["stages"]["wkv_a"].sharding.spec)
+    got = jax.jit(
+        lambda p, t: pipeline_forward(p, t, CFG, pipe, mesh)
+    )(params, tokens)
+    want = reference_forward(params, tokens, CFG)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+    )
+
+    def ref_loss(p, t):
+        from tpufw.train.trainer import cross_entropy_loss
+
+        logits = reference_forward(p, t[:, :-1], CFG)
+        return cross_entropy_loss(logits, t[:, 1:])[0]
+
+    _, g_pipe = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: pipeline_loss(p, t, CFG, pipe, mesh)
+        )
+    )(params, tokens)
+    _, g_ref = jax.value_and_grad(ref_loss)(params, tokens)
+    flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pipe)
+    flat_r, _ = jax.tree_util.tree_flatten_with_path(g_ref)
+    for (path, a), (_, b) in zip(flat_p, flat_r):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_1f1b_matches_gpipe(setup):
+    """The 1F1B manual-VJP schedule trains MLA blocks too (pp x tp):
+    loss and grads match GPipe's on the same params (both already
+    pinned to the oracle) — the f/g operators must transpose the
+    replicated latent kernels exactly."""
+    from tpufw.parallel.pipeline_1f1b import pipeline_1f1b_value_and_grad
+
+    mesh = build_mesh(MeshConfig(data=1, pipe=2, fsdp=2, tensor=2))
+    params, tokens, _ = setup
+    pipe_1 = PipelineConfig(
+        n_stages=2, n_microbatches=4, schedule="1f1b"
+    )
+    pipe_g = PipelineConfig(n_stages=2, n_microbatches=4)
+    params = jax.device_put(
+        params, pipeline_param_shardings(mesh, params)
+    )
+    l_g, g_g = jax.jit(
+        jax.value_and_grad(
+            lambda p, t: pipeline_loss(p, t, CFG, pipe_g, mesh)
+        )
+    )(params, tokens)
+    l_1, g_1 = jax.jit(
+        lambda p, t: pipeline_1f1b_value_and_grad(
+            p, t, CFG, pipe_1, mesh
+        )
+    )(params, tokens)
+    np.testing.assert_allclose(float(l_1), float(l_g), rtol=1e-5)
+    flat_1, _ = jax.tree_util.tree_flatten_with_path(g_1)
+    flat_g, _ = jax.tree_util.tree_flatten_with_path(g_g)
+    for (path, a), (_, b) in zip(flat_1, flat_g):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_moe_deepseek_rejected_loudly():
+    pipe = PipelineConfig(n_stages=2, n_microbatches=4)
+    moe_cfg = dataclasses.replace(
+        DEEPSEEK_CONFIGS["deepseek_moe_tiny"], n_layers=4
+    )
+    with pytest.raises(NotImplementedError, match="dense FFN only"):
+        init_pipeline_params(jax.random.key(0), moe_cfg, pipe)

@@ -1,11 +1,16 @@
-"""Greedy speculative decoding == the target model's plain greedy decode.
+"""Speculative decoding == the target model's own decode.
 
-The oracle is exact: whatever the draft proposes, acceptance compares
-against the target's own argmax, so `speculative_generate` must emit
-token-for-token what `generate` emits — across ragged prompts, draft
-quality (self-draft = always accept; unrelated draft = frequent
-rejects), eos freezing, and k sizes. Stats sanity-check the speedup
-mechanism (self-draft ≈ k+1 tokens/iteration).
+Greedy tier: the oracle is exact — whatever the draft proposes,
+acceptance compares against the target's own argmax, so
+`speculative_generate` must emit token-for-token what `generate` emits
+— across ragged prompts, draft quality (self-draft = always accept;
+unrelated draft = frequent rejects), eos freezing, and k sizes. Stats
+sanity-check the speedup mechanism (self-draft ≈ k+1 tokens/iteration).
+
+Stochastic tier (rejection-resample): self-draft is BIT-identical to
+`generate` under the same rng (the per-emission-index key coupling);
+an unrelated draft must still leave every token target-distributed
+(empirical TVD pin).
 """
 
 import dataclasses
@@ -132,8 +137,8 @@ def test_live_rows_mask_preserves_real_rows(target, draft):
 
 
 def test_serve_draft_rejects_repetition_penalty(monkeypatch):
-    """Repetition penalty changes the temp-0 argmax, so the exact-greedy
-    speculative contract requires rejecting it loudly."""
+    """The penalty's seen-token state is sequential; speculation
+    proposes blocks — serve must reject the combination loudly."""
     from tpufw.workloads.serve import (
         build_draft_generator,
         sampling_from_env,
@@ -142,5 +147,144 @@ def test_serve_draft_rejects_repetition_penalty(monkeypatch):
     monkeypatch.setenv("TPUFW_DRAFT_MODEL", "llama3_tiny")
     monkeypatch.setenv("TPUFW_TEMPERATURE", "0")
     monkeypatch.setenv("TPUFW_REPETITION_PENALTY", "1.3")
-    with pytest.raises(ValueError, match="greedy"):
+    with pytest.raises(ValueError, match="REPETITION_PENALTY"):
         build_draft_generator(sampling_from_env())
+
+
+# ----------------------------------------------------------------------
+# Stochastic speculative sampling (rejection-resample)
+# ----------------------------------------------------------------------
+
+
+def test_stochastic_self_draft_bit_matches_generate(target):
+    """Distributional-equivalence pin, exact form: with draft == target
+    every proposal is accepted (ratio p/q == 1), and the per-emission-
+    index RNG coupling makes the output BIT-IDENTICAL to generate()
+    under the same rng — sampling transforms included."""
+    from tpufw.infer.generate import generate, pad_prompts
+    from tpufw.infer.speculative import speculative_generate
+
+    model, params = target
+    cfg = SamplingConfig(temperature=0.7, top_p=0.9)
+    toks, pads = pad_prompts(PROMPTS, 0)
+    toks, pads = jnp.asarray(toks), jnp.asarray(pads)
+    rng = jax.random.key(42)
+    want = generate(
+        model, params, toks, pads, rng,
+        max_new_tokens=15, sampling=cfg,
+    )
+    got, stats = speculative_generate(
+        model, params, model, params, toks, pads,
+        max_new_tokens=15, k=4, sampling=cfg, rng=rng,
+    )
+    assert (np.asarray(got) == np.asarray(want)).all()
+    # Self-draft still accepts everything: k+1 tokens per iteration.
+    assert int(stats["iterations"]) == -(-15 // 5)
+
+
+def test_stochastic_unrelated_draft_matches_target_distribution(
+    target, draft
+):
+    """Rejection-resampling leaves each token target-distributed no
+    matter the draft. 256 identical prompts give 256 iid samples per
+    call; the first token bit-matches plain sampling (drawn pre-
+    speculation with the same key), and the first SPECULATED token's
+    empirical distribution must agree with plain sampling's within
+    sampling noise (both sides deterministic under the fixed key)."""
+    from tpufw.infer.generate import generate
+    from tpufw.infer.speculative import speculative_generate
+
+    model, params = target
+    d_model, d_params = draft
+    b = 256
+    cfg = SamplingConfig(temperature=1.0, top_k=8)
+    toks = jnp.tile(jnp.asarray([[5, 6, 7]]), (b, 1))
+    pads = jnp.zeros((b,), jnp.int32)
+    rng = jax.random.key(7)
+    plain = np.asarray(
+        generate(
+            model, params, toks, pads, rng,
+            max_new_tokens=4, sampling=cfg,
+        )
+    )
+    spec = np.asarray(
+        speculative_generate(
+            d_model, d_params, model, params, toks, pads,
+            max_new_tokens=4, k=3, sampling=cfg, rng=rng,
+        )[0]
+    )
+    # Token 0 is sampled from the target before any speculation, with
+    # the same per-index key: bit-identical.
+    assert (spec[:, 0] == plain[:, 0]).all()
+
+    # Token 1 is the first speculated emission. Compare empirical
+    # distributions (total variation) — same-distribution noise at
+    # b=256 over a top-8 support is well under this threshold.
+    def dist(col):
+        v = np.bincount(col, minlength=int(TINY.vocab_size))
+        return v / v.sum()
+
+    tvd = 0.5 * np.abs(dist(spec[:, 1]) - dist(plain[:, 1])).sum()
+    assert tvd < 0.25, f"TVD {tvd}"
+
+
+def test_stochastic_requires_rng(target):
+    from tpufw.infer.speculative import speculative_generate
+
+    model, params = target
+    with pytest.raises(ValueError, match="rng"):
+        speculative_generate(
+            model, params, model, params,
+            jnp.asarray([[1, 2]]), jnp.zeros((1,), jnp.int32),
+            max_new_tokens=4, sampling=SamplingConfig(temperature=0.5),
+        )
+
+
+def test_stochastic_rejects_repetition_penalty(target):
+    from tpufw.infer.speculative import speculative_generate
+
+    model, params = target
+    with pytest.raises(NotImplementedError, match="repetition_penalty"):
+        speculative_generate(
+            model, params, model, params,
+            jnp.asarray([[1, 2]]), jnp.zeros((1,), jnp.int32),
+            max_new_tokens=4,
+            sampling=SamplingConfig(
+                temperature=0.5, repetition_penalty=1.3
+            ),
+            rng=jax.random.key(0),
+        )
+
+
+def test_stochastic_eos_rows_freeze(target, draft):
+    """EOS discipline matches generate: rows truncate at eos and emit
+    pad after, under sampling."""
+    from tpufw.infer.generate import generate
+    from tpufw.infer.speculative import speculative_generate
+
+    model, params = target
+    cfg = SamplingConfig(temperature=0.7)
+    toks = jnp.asarray([[5, 6, 7], [9, 9, 9]])
+    pads = jnp.zeros((2,), jnp.int32)
+    rng = jax.random.key(3)
+    base = np.asarray(
+        generate(
+            model, params, toks, pads, rng,
+            max_new_tokens=8, sampling=cfg,
+        )
+    )
+    eos = int(base[0][2])
+    want = np.asarray(
+        generate(
+            model, params, toks, pads, rng,
+            max_new_tokens=8, sampling=cfg, eos_id=eos,
+        )
+    )
+    # Self-draft: bit-exact path also under eos.
+    got = np.asarray(
+        speculative_generate(
+            model, params, model, params, toks, pads,
+            max_new_tokens=8, k=3, sampling=cfg, rng=rng, eos_id=eos,
+        )[0]
+    )
+    assert (got == want).all()

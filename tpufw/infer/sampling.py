@@ -77,15 +77,18 @@ def apply_repetition_penalty(
     return jnp.where(seen, penalized, logits)
 
 
-def sample_token(
+def transform_logits(
     logits: jax.Array,
     cfg: SamplingConfig,
-    rng: jax.Array,
     seen: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """[B, V] float logits -> [B] int32 sampled tokens. ``seen`` is the
-    [B, V] bool presence mask the repetition penalty applies to (the
-    decode loop maintains it; None skips the penalty)."""
+    """Apply cfg's distribution transforms to [..., V] logits — the
+    exact distribution ``sample_token`` draws from, exposed separately
+    so speculative rejection-resampling can compare draft and target
+    distributions post-transform (the scheme's correctness requires the
+    ratio test on the distributions actually sampled, not the raw
+    logits). Greedy (temperature 0) returns after the penalty: argmax
+    consumers need no masks."""
     logits = logits.astype(jnp.float32)
     if (
         cfg.repetition_penalty is not None
@@ -96,7 +99,7 @@ def sample_token(
             logits, seen, cfg.repetition_penalty
         )
     if cfg.temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return logits
     logits = logits / cfg.temperature
     if cfg.top_k:
         logits = apply_top_k(logits, cfg.top_k)
@@ -104,4 +107,19 @@ def sample_token(
         logits = apply_top_p(logits, cfg.top_p)
     if cfg.min_p is not None and cfg.min_p > 0.0:
         logits = apply_min_p(logits, cfg.min_p)
+    return logits
+
+
+def sample_token(
+    logits: jax.Array,
+    cfg: SamplingConfig,
+    rng: jax.Array,
+    seen: Optional[jax.Array] = None,
+) -> jax.Array:
+    """[B, V] float logits -> [B] int32 sampled tokens. ``seen`` is the
+    [B, V] bool presence mask the repetition penalty applies to (the
+    decode loop maintains it; None skips the penalty)."""
+    logits = transform_logits(logits, cfg, seen)
+    if cfg.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

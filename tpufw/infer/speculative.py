@@ -1,20 +1,39 @@
-"""Greedy speculative decoding: draft proposes, target verifies in one pass.
+"""Speculative decoding: draft proposes, target verifies in one pass.
 
 Autoregressive decode is HBM-bandwidth-bound — every emitted token
 streams every target weight once. Speculative decoding spends a small
 draft model's tokens to buy back target bandwidth: the draft proposes
 ``k`` tokens autoregressively, the target scores ALL of them in ONE
 cached forward (k+1 tokens through the weights instead of k+1 separate
-full-weight streams), and the longest prefix agreeing with the target's
-own greedy choice is accepted plus one bonus token from the target's
-logits. Worst case one token per iteration (plain decode cost + draft
-overhead); best case k+1.
+full-weight streams), and the longest accepted prefix is kept plus one
+token from the target's own distribution. Worst case one token per
+iteration (plain decode cost + draft overhead); best case k+1.
 
-Greedy only: acceptance compares the draft token to the target argmax,
-which makes the output EXACTLY the target model's greedy continuation —
-pinned against ``tpufw.infer.generate`` in tests/test_speculative.py.
-(Stochastic speculative sampling needs the rejection-resample scheme;
-not implemented.)
+Two acceptance modes, selected by ``sampling.temperature``:
+
+- **Greedy** (temperature 0): accept while the draft token equals the
+  target argmax — the output is EXACTLY the target model's greedy
+  continuation, pinned against ``tpufw.infer.generate`` in
+  tests/test_speculative.py.
+- **Stochastic** (temperature > 0): the rejection-resample scheme.
+  Draft token ``x_j ~ q_j`` is accepted iff ``u_j < p_j(x_j)/q_j(x_j)``
+  (``u_j`` uniform); on first rejection the replacement is drawn from
+  the residual ``norm(max(p_j - q_j, 0))``, and when every draft
+  survives the bonus comes from ``p_k`` directly. Marginally, each
+  emitted token is distributed EXACTLY as target-only sampling — draft
+  quality changes speed, never the distribution. ``p``/``q`` are the
+  post-transform distributions (temperature/top-k/top-p/min-p applied
+  to both), so speculation composes with every serving sampler knob
+  except repetition_penalty (whose seen-token state is sequential by
+  construction; rejected loudly).
+
+RNG discipline: emission index ``n`` consumes the same key
+``generate()`` would use for that index (first = split(rng)[1], rest =
+split(split(rng)[0], ...)[n-1]), draft proposals draw with the RAW
+per-index key, and acceptance/residual draws use fold_in(key, 1)/
+fold_in(key, 2). Consequence: with draft == target every proposal is
+accepted and the output is BIT-IDENTICAL to ``generate`` under the same
+rng — the distributional-equivalence pin in tests/test_speculative.py.
 
 TPU-first shape discipline, mirroring ``generate``:
 - the whole loop is one jitted program: ``lax.while_loop`` over
@@ -41,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpufw.infer.generate import pad_prompts
+from tpufw.infer.sampling import SamplingConfig, sample_token, transform_logits
 
 
 def _rollback(cache: dict, new_cursor: jax.Array) -> dict:
@@ -75,6 +95,7 @@ def _cursor(cache: dict) -> jax.Array:
     jax.jit,
     static_argnames=(
         "draft_model", "model", "k", "max_new_tokens", "pad_id", "eos_id",
+        "sampling",
     ),
 )
 def speculative_generate(
@@ -90,15 +111,20 @@ def speculative_generate(
     pad_id: int = 0,
     eos_id: Optional[int] = None,
     live_rows: Optional[jax.Array] = None,
+    sampling: SamplingConfig = SamplingConfig(),
+    rng: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, dict]:
-    """Greedy-decode ``model`` with ``draft_model`` speculation.
+    """Decode ``model`` with ``draft_model`` speculation.
 
     Same contract as ``tpufw.infer.generate`` (left-padded prompts,
     [B, max_new_tokens] out, eos rows freeze to pad) plus a stats dict
     {"iterations", "emitted"} — mean tokens/iteration is the speedup
-    diagnostic (k+1 max). Both models must share the tokenizer/vocab;
-    the output is exactly ``model``'s greedy continuation regardless of
-    draft quality (only speed varies).
+    diagnostic (k+1 max). Both models must share the tokenizer/vocab.
+    With the default greedy ``sampling`` the output is exactly
+    ``model``'s greedy continuation regardless of draft quality (only
+    speed varies); with ``sampling.temperature > 0`` (``rng`` required)
+    each token is rejection-resampled to the target's post-transform
+    distribution — see the module docstring for the scheme.
 
     ``live_rows`` ([B] bool): rows whose acceptance should count toward
     the batch-min. Serving passes False for its shape-bucketing filler
@@ -114,6 +140,22 @@ def speculative_generate(
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if k < 1:
         raise ValueError(f"k must be >= 1, got {k}")
+    stochastic = sampling.temperature != 0.0
+    if stochastic and rng is None:
+        raise ValueError(
+            "sampling.temperature > 0 requires an rng key for the "
+            "rejection-resample draws"
+        )
+    if (
+        sampling.repetition_penalty is not None
+        and sampling.repetition_penalty != 1.0
+    ):
+        raise NotImplementedError(
+            "repetition_penalty with speculation: the seen-token mask "
+            "is sequential (each emission updates it) but the draft "
+            "proposes k tokens before any is accepted — use "
+            "tpufw.infer.generate for penalized sampling"
+        )
     for m, who in ((model, "model"), (draft_model, "draft_model")):
         max_seq = getattr(getattr(m, "cfg", None), "max_seq_len", None)
         # The verify block may overrun the accepted stream by up to k
@@ -144,7 +186,19 @@ def speculative_generate(
     _, d_cache = apply(
         draft_model, draft_params, {}, prompt_tokens, positions, seg
     )
-    first = jnp.argmax(t_logits[:, -1, :], axis=-1).astype(jnp.int32)
+    all_keys = None
+    if stochastic:
+        # Emission index n consumes the key generate() would use for
+        # that index — same split order (first = split(rng)[1], step i
+        # = split(split(rng)[0], ...)[i-1]; threefry splits are
+        # counter-mode, so index i is stable across the split count).
+        # k extra keys cover the block-overrun slack near the end.
+        next_rng, first_key = jax.random.split(rng)
+        step_keys = jax.random.split(next_rng, max_new_tokens - 1 + k)
+        all_keys = jnp.concatenate([first_key[None], step_keys])
+        first = sample_token(t_logits[:, -1, :], sampling, first_key)
+    else:
+        first = jnp.argmax(t_logits[:, -1, :], axis=-1).astype(jnp.int32)
     done0 = (
         jnp.zeros((b,), bool) if eos_id is None else first == eos_id
     )
@@ -157,26 +211,47 @@ def speculative_generate(
 
     ones = jnp.ones((b, 1), jnp.int32)
 
-    def draft_propose(d_cache, prev, pos):
+    def draft_propose(d_cache, prev, pos, keys_blk):
         """k proposals + one filler step so the draft cache holds every
-        proposed token (the a == k acceptance case needs d_k cached)."""
-        toks = []
+        proposed token (the a == k acceptance case needs d_k cached).
+        Stochastic proposals draw from the TRANSFORMED draft
+        distribution with the raw per-emission-index key (the coupling
+        that makes draft == target bit-match ``generate``); the
+        distributions are returned for the acceptance ratio test."""
+        toks, qs = [], []
         tok = prev
         for i in range(k + 1):
             logits, d_cache = apply(
                 draft_model, draft_params, d_cache,
                 tok[:, None], (pos + i)[:, None], ones,
             )
-            tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
             if i < k:
+                if stochastic:
+                    q_i = transform_logits(logits[:, -1, :], sampling)
+                    tok = jax.random.categorical(
+                        keys_blk[i], q_i, axis=-1
+                    ).astype(jnp.int32)
+                    qs.append(q_i)
+                else:
+                    tok = jnp.argmax(
+                        logits[:, -1, :], axis=-1
+                    ).astype(jnp.int32)
                 toks.append(tok)
-        return jnp.stack(toks, axis=1), d_cache  # [B, k]
+        q_trans = jnp.stack(qs, axis=1) if stochastic else None
+        return jnp.stack(toks, axis=1), q_trans, d_cache  # [B, k]
 
     def body(carry):
         t_cache, d_cache, prev, pos, done, n, buf, iters = carry
         t_cur0 = _cursor(t_cache)
         d_cur0 = _cursor(d_cache)
-        drafts, d_cache = draft_propose(d_cache, prev, pos)
+        keys_blk = (
+            jax.lax.dynamic_slice_in_dim(all_keys, n, k + 1)
+            if stochastic
+            else None
+        )
+        drafts, q_trans, d_cache = draft_propose(
+            d_cache, prev, pos, keys_blk
+        )
 
         # One target pass scores prev + all k drafts: logits[:, i] is
         # the target's next-token distribution after input i.
@@ -186,12 +261,36 @@ def speculative_generate(
             model, params, t_cache, verify_in, verify_pos,
             jnp.ones((b, k + 1), jnp.int32),
         )
-        greedy = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # [B,k+1]
+
+        if stochastic:
+            # Rejection test on the post-transform distributions:
+            # accept x_j iff u_j < p_j(x_j)/q_j(x_j).
+            p_trans = transform_logits(t_logits, sampling)  # [B,k+1,V]
+            logp = jax.nn.log_softmax(p_trans, axis=-1)
+            logq = jax.nn.log_softmax(q_trans, axis=-1)
+            lp = jnp.take_along_axis(
+                logp[:, :k], drafts[..., None], -1
+            )[..., 0]
+            lq = jnp.take_along_axis(logq, drafts[..., None], -1)[..., 0]
+            us = jnp.stack(
+                [
+                    jax.random.uniform(
+                        jax.random.fold_in(keys_blk[j], 1), (b,)
+                    )
+                    for j in range(k)
+                ],
+                axis=1,
+            )  # [B, k]
+            match = us < jnp.exp(lp - lq)
+        else:
+            greedy = jnp.argmax(
+                t_logits, axis=-1
+            ).astype(jnp.int32)  # [B, k+1]
+            match = drafts == greedy[:, :k]  # [B, k]
 
         # Per-row longest accepted prefix, then the batch-uniform min
         # (one scalar cache cursor). Rows that matched further lose
-        # nothing: their bonus token equals their draft token there.
-        match = drafts == greedy[:, :k]  # [B, k]
+        # nothing: their col-a token is their own ACCEPTED draft.
         row_accept = jnp.sum(jnp.cumprod(match.astype(jnp.int32), 1), 1)
         # Rows whose output no longer matters must not throttle the
         # batch min: filler rows (live_rows) never did, and eos-DONE
@@ -204,15 +303,62 @@ def speculative_generate(
             row_accept = jnp.where(live_rows, row_accept, k)
         a = jnp.min(row_accept)  # scalar in [0, k]
 
-        # Emitted block: drafts[0..a-1] then the bonus greedy[a].
         cols = jnp.arange(k + 1)[None, :]
-        block = jnp.where(
-            cols < a,
-            jnp.pad(drafts, ((0, 0), (0, 1))),
-            jnp.take_along_axis(
+        drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))
+        if stochastic:
+            # Col-a token per row: rows that accepted past a keep their
+            # own accepted draft x_a; rows rejected AT a draw from the
+            # residual norm(max(p_a - q_a, 0)). When a == k (everyone
+            # accepted everything) the zero-padded q col makes the
+            # "residual" exactly p_k — the bonus draw — and the RAW
+            # index key is used there so it matches generate()'s
+            # categorical for that emission index bit-for-bit; the
+            # a < k resample folds the key (the raw one was consumed by
+            # the draft proposal, and reusing its gumbel noise would
+            # correlate the resample with the rejection event).
+            # Only the col-a slice is ever drawn from: index FIRST,
+            # softmax one [B, V] row (not k+1 of them per iteration —
+            # V is the vocab in serving). p_a rides the existing logp;
+            # the a == k "zero q row" of the padded-q formulation is
+            # the where() below.
+            logp_a = jax.lax.dynamic_index_in_dim(
+                logp, a, axis=1, keepdims=False
+            )
+            p_a = jnp.exp(logp_a)
+            q_a = jax.nn.softmax(
+                jax.lax.dynamic_index_in_dim(
+                    q_trans, jnp.minimum(a, k - 1), axis=1,
+                    keepdims=False,
+                ),
+                axis=-1,
+            )
+            q_a = jnp.where(a == k, 0.0, q_a)
+            alt_logits = jnp.where(
+                a == k, logp_a, jnp.log(jnp.maximum(p_a - q_a, 0.0))
+            )
+            key_a = jax.lax.dynamic_index_in_dim(
+                keys_blk, a, keepdims=False
+            )
+            key_used = jax.lax.cond(
+                a == k,
+                lambda: key_a,
+                lambda: jax.random.fold_in(key_a, 2),
+            )
+            tok_alt = jax.random.categorical(
+                key_used, alt_logits, axis=-1
+            ).astype(jnp.int32)
+            x_a = jax.lax.dynamic_index_in_dim(
+                drafts_pad, a, axis=1, keepdims=False
+            )
+            col_a_tok = jnp.where(row_accept > a, x_a, tok_alt)  # [B]
+            block = jnp.where(cols < a, drafts_pad, col_a_tok[:, None])
+        else:
+            # Emitted block: drafts[0..a-1] then the bonus greedy[a].
+            greedy_a = jnp.take_along_axis(
                 greedy, jnp.broadcast_to(a[None, None], (b, 1)), 1
-            ),
-        )  # [B, k+1]; cols > a are dont-cares (masked below)
+            )
+            block = jnp.where(cols < a, drafts_pad, greedy_a)
+        # [B, k+1]; cols > a are dont-cares (masked below)
         n_block = jnp.minimum(a + 1, max_new_tokens - n)
 
         # EOS + emission masking: freeze rows after their eos, blank
@@ -283,9 +429,15 @@ def speculative_generate_text(
     pad_id: int = 0,
     eos_id: Optional[int] = None,
     live_rows: Optional[Sequence[bool]] = None,
+    sampling: SamplingConfig = SamplingConfig(),
+    seed: int = 0,
+    rng: Optional[jax.Array] = None,
 ) -> tuple[list[list[int]], dict]:
-    """Ragged-python convenience wrapper (mirrors ``generate_text``).
+    """Ragged-python convenience wrapper (mirrors ``generate_text``,
+    including its ``seed`` knob; an explicit ``rng`` wins over seed).
     Returns (outputs, stats) with stats as plain ints."""
+    if rng is None and sampling.temperature != 0.0:
+        rng = jax.random.key(seed)
     tokens, pads = pad_prompts(prompts, pad_id)
     out, stats = speculative_generate(
         draft_model,
@@ -301,6 +453,8 @@ def speculative_generate_text(
         live_rows=(
             None if live_rows is None else jnp.asarray(live_rows, bool)
         ),
+        sampling=sampling,
+        rng=rng,
     )
     result = []
     for row in np.asarray(out):

@@ -109,20 +109,40 @@ def _is_gemma(cfg) -> bool:
     return isinstance(cfg, GemmaConfig)
 
 
+def _is_mla(cfg) -> bool:
+    """DeepseekConfig: MLA attention (latent KV factorization), its own
+    dataclass — NOT a LlamaConfig subclass, so every dispatch must
+    branch here before touching n_kv_heads/head_dim (MLA has neither)."""
+    from tpufw.models.deepseek import DeepseekConfig
+
+    return isinstance(cfg, DeepseekConfig)
+
+
 def _check_model_split(cfg, n_stages: int) -> None:
     """Model-side pipelineability checks, shared by
     ``PipelineConfig.validate`` (trainer path) and
     ``init_pipeline_params`` (direct callers) so the two can't drift:
     an unchecked config silently builds a truncated or wrong-family
     model."""
-    if not (isinstance(cfg, LlamaConfig) or _is_gemma(cfg)):
-        # A foreign config (e.g. DeepseekConfig: MLA attention, no
-        # n_kv_heads/head_dim) would silently build Llama-shaped
-        # stages — wrong model, no error until (at best) a missing
-        # attribute deep in init.
+    if not (
+        isinstance(cfg, LlamaConfig) or _is_gemma(cfg) or _is_mla(cfg)
+    ):
+        # A foreign config would silently build Llama-shaped stages —
+        # wrong model, no error until (at best) a missing attribute
+        # deep in init.
         raise NotImplementedError(
-            f"pipeline schedules implement Llama-family and Gemma "
-            f"blocks; got {type(cfg).__name__}"
+            f"pipeline schedules implement Llama-family, Gemma, and "
+            f"DeepSeek-MLA blocks; got {type(cfg).__name__}"
+        )
+    if _is_mla(cfg) and cfg.moe:
+        # The DeepSeek MoE FFN mixes dense and routed layers
+        # (first_k_dense) and adds shared experts — neither fits the
+        # homogeneous per-stage stacks; building it would silently
+        # drop the shared/dense structure.
+        raise NotImplementedError(
+            "pipelined MLA stages implement the dense FFN only; the "
+            "DeepSeek MoE FFN (shared experts, first_k_dense layer "
+            "mixing) uses the flax trainer"
         )
     if not getattr(cfg, "causal", True):
         # Both schedules hardcode causal attention; silently training
@@ -162,9 +182,10 @@ def init_pipeline_params(
     s = pipe.n_stages
     _check_model_split(cfg, s)
     lps = cfg.n_layers // s
-    d, h, kh, dh, f = (
-        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff,
-    )
+    d, h, f = cfg.d_model, cfg.n_heads, cfg.d_ff
+    # MLA configs have no n_kv_heads/head_dim (factorized projections).
+    kh = getattr(cfg, "n_kv_heads", None)
+    dh = getattr(cfg, "head_dim", None)
     keys = jax.random.split(key, 9)
 
     def w(k, shape, fan_in):
@@ -172,6 +193,51 @@ def init_pipeline_params(
             jax.random.normal(k, shape, jnp.float32)
             / math.sqrt(fan_in)
         ).astype(cfg.param_dtype)
+
+    if _is_mla(cfg):
+        # MLA factorized stacks — the functional mirror of
+        # tpufw.models.deepseek.MLAttention's expanded/training form
+        # (deepseek.py:329): shared latent down-projections (wkv_a,
+        # plus wq_a for the compressed-q path) with their RMSNorms,
+        # head-expanding up-projections (wq/wq_b, wkv_b), and the
+        # dense SwiGLU MLP.
+        kvr, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+        stages = {
+            "attn_norm": jnp.ones((s, lps, d), jnp.float32),
+            "kv_a_norm": jnp.ones((s, lps, kvr), jnp.float32),
+            "wkv_a": w(keys[2], (s, lps, d, kvr + dr), d),
+            "wkv_b": w(
+                keys[3],
+                (s, lps, kvr, h, cfg.qk_nope_head_dim + cfg.v_head_dim),
+                kvr,
+            ),
+            "wo": w(
+                keys[4], (s, lps, h, cfg.v_head_dim, d),
+                h * cfg.v_head_dim,
+            ),
+            "mlp_norm": jnp.ones((s, lps, d), jnp.float32),
+            "w_gate": w(keys[5], (s, lps, d, f), d),
+            "w_up": w(keys[6], (s, lps, d, f), d),
+            "w_down": w(keys[7], (s, lps, f, d), f),
+        }
+        if cfg.q_lora_rank is None:
+            stages["wq"] = w(keys[1], (s, lps, d, h, cfg.qk_head_dim), d)
+        else:
+            qr = cfg.q_lora_rank
+            qkeys = jax.random.split(keys[1], 2)
+            stages["wq_a"] = w(qkeys[0], (s, lps, d, qr), d)
+            stages["q_a_norm"] = jnp.ones((s, lps, qr), jnp.float32)
+            stages["wq_b"] = w(
+                qkeys[1], (s, lps, qr, h, cfg.qk_head_dim), qr
+            )
+        return {
+            "embed": jax.random.normal(
+                keys[0], (cfg.vocab_size, d), jnp.float32
+            ).astype(cfg.param_dtype),
+            "stages": stages,
+            "final_norm": jnp.ones((d,), jnp.float32),
+            "head": w(keys[8], (d, cfg.vocab_size), d),
+        }
 
     if _is_gemma(cfg):
         # Pair layout (local sliding-window block + global block), the
@@ -281,6 +347,12 @@ _TENSOR_LEAF_AXIS = {
     "bq": -2, "bk": -2, "bv": -2,  # [..., H, dh] -> head axis (Qwen)
     "w_gate": -1, "w_up": -1,      # [..., d, f] -> ffn columns
     "w_down": -2,                  # [..., f, d] -> ffn rows
+    # MLA head-expanding kernels split their head axis too; the latent
+    # down-projections (wq_a, wkv_a) and latent norms stay REPLICATED —
+    # the latents are shared across heads, and splitting them would put
+    # an RMSNorm on a partial axis.
+    "wq_b": -2,                    # [..., qr, H, qk] -> head axis
+    "wkv_b": -2,                   # [..., kvr, H, dn+dv] -> head axis
 }
 
 #: Mixtral expert stacks are rank 5 ([S, lps, E, in, out]); their [E]
@@ -411,6 +483,93 @@ def _block(
         )
     )
     return x
+
+
+def _mla_block(
+    p: dict, x: jax.Array, cfg, backend: str, seg=None,
+    tp: bool = False, tp_ops=None,
+):
+    """One DeepSeek-MLA decoder block (dense FFN), numerically the
+    tpufw.models.deepseek.DeepseekBlock expanded/training form. Under
+    ``tp`` the head axes of wq/wq_b/wkv_b/wo are LOCAL shards; the
+    latent projections (wq_a, wkv_a) run replicated on every rank —
+    their outputs are identical across ``tensor``, so the decoupled
+    rope key and both latent RMSNorms agree globally, and the only
+    collectives are the block's two standard combines."""
+    from tpufw.models.deepseek import apply_rope_interleaved
+
+    enter, combine = tp_ops or (
+        (lambda h: h), (lambda y: _tp_psum(y, tp))
+    )
+    dt = cfg.dtype
+    dn, dr = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    dv, kvr = cfg.v_head_dim, cfg.kv_lora_rank
+    positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+
+    # Megatron-f (``enter``) placement: at each COLUMN-PARALLEL input —
+    # the operand of a head-sharded einsum — and NOT at the shared h.
+    # The latent kernels (wq_a/wkv_a) are replicated, so their inputs
+    # need no f; their OUTPUTS (cq, c_kv, k_pe) feed head-local math
+    # whose per-rank cotangents are partial sums, and the f's backward
+    # psum completes them exactly there. An f at h instead would leave
+    # the latent params' grads unreduced (the 1F1B parity test caught
+    # this) and double-count the latent path's h-contribution.
+    h = rms_norm(x, p["attn_norm"], cfg.rms_eps)
+    if "wq" in p:
+        q = jnp.einsum("btd,dhk->bthk", enter(h), p["wq"].astype(dt))
+    else:  # compressed-q path (V2-236B): q_a -> norm -> q_b
+        cq = jnp.einsum("btd,dr->btr", h, p["wq_a"].astype(dt))
+        cq = rms_norm(cq, p["q_a_norm"], cfg.rms_eps)
+        q = jnp.einsum(
+            "btr,rhk->bthk", enter(cq), p["wq_b"].astype(dt)
+        )
+    q_nope, q_pe = q[..., :dn], q[..., dn:]
+    q_pe = apply_rope_interleaved(
+        q_pe, positions, cfg.rope_theta, cfg.rope_scaling
+    )
+
+    # Shared KV latent + decoupled-rope key (one "head").
+    ckv_kr = jnp.einsum("btd,dr->btr", h, p["wkv_a"].astype(dt))
+    c_kv = rms_norm(ckv_kr[..., :kvr], p["kv_a_norm"], cfg.rms_eps)
+    k_pe = apply_rope_interleaved(
+        ckv_kr[..., kvr:][:, :, None, :],
+        positions, cfg.rope_theta, cfg.rope_scaling,
+    )  # [B, T, 1, dr]
+    k_pe = enter(k_pe)  # broadcast over LOCAL heads below
+    kv = jnp.einsum(
+        "btr,rhd->bthd", enter(c_kv).astype(dt), p["wkv_b"].astype(dt)
+    )
+    k_nope, v = kv[..., :dn], kv[..., dn:]
+    k = jnp.concatenate(
+        [k_nope, jnp.broadcast_to(k_pe, (*k_nope.shape[:3], dr))], axis=-1
+    )
+    q = jnp.concatenate([q_nope, q_pe], axis=-1)
+    if backend in ("flash", "ring"):
+        # v zero-padded to qk_head_dim, output sliced back — exact
+        # (padded value columns contribute zeros), same discipline as
+        # the flax MLAttention backend dispatch.
+        v_in = jnp.pad(
+            v, ((0, 0), (0, 0), (0, 0), (0, cfg.qk_head_dim - dv))
+        )
+    else:
+        v_in = v
+    att = multi_head_attention(
+        q, k, v_in, causal=True, segment_ids=seg, backend=backend
+    )
+    if backend in ("flash", "ring"):
+        att = att[..., :dv]
+    x = x + combine(
+        jnp.einsum("bthd,hdD->btD", att, p["wo"].astype(dt))
+    )
+
+    hm = enter(rms_norm(x, p["mlp_norm"], cfg.rms_eps))
+    g = jnp.einsum("btd,df->btf", hm, p["w_gate"].astype(dt))
+    u = jnp.einsum("btd,df->btf", hm, p["w_up"].astype(dt))
+    return x + combine(
+        jnp.einsum(
+            "btf,fd->btd", jax.nn.silu(g) * u, p["w_down"].astype(dt)
+        )
+    )
 
 
 def _moe_mlp(
@@ -562,8 +721,10 @@ def _stage(
         )
         return out, aux
 
+    blk = _mla_block if _is_mla(cfg) else _block
+
     def body(h, layer_p):
-        return _block(layer_p, h, cfg, backend, seg, tp), None
+        return blk(layer_p, h, cfg, backend, seg, tp), None
 
     out, _ = jax.lax.scan(body, x, stage_params)
     return out, jnp.zeros((), jnp.float32)
@@ -689,11 +850,11 @@ def pipeline_forward(
     if tp > 1:
         # Megatron split: heads over q/k/v/o, d_ff over gate/up/down.
         # Uneven splits would silently mis-shard the stacked weights.
-        for fname, v in (
-            ("n_heads", cfg.n_heads),
-            ("n_kv_heads", cfg.n_kv_heads),
-            ("d_ff", cfg.d_ff),
-        ):
+        # MLA has no kv heads (one shared latent, replicated kernels).
+        checks = [("n_heads", cfg.n_heads), ("d_ff", cfg.d_ff)]
+        if not _is_mla(cfg):
+            checks.append(("n_kv_heads", cfg.n_kv_heads))
+        for fname, v in checks:
             if v % tp:
                 raise ValueError(
                     f"mesh tensor={tp} must divide {fname}={v} "
@@ -870,9 +1031,10 @@ def reference_forward(
     if _is_gemma(cfg):
         body = _gemma_pair_body(cfg, backend, seg)
     else:
+        blk = _mla_block if _is_mla(cfg) else _block
 
         def body(h, layer_p):
-            return _block(layer_p, h, cfg, backend, seg), None
+            return blk(layer_p, h, cfg, backend, seg), None
 
     x, _ = jax.lax.scan(body, x, flat)
     return _logits_epilogue(params, x, cfg)

@@ -72,7 +72,9 @@ from tpufw.parallel.pipeline import (
     PipelineConfig,
     _block,
     _is_gemma,
+    _is_mla,
     _is_moe,
+    _mla_block,
     stage_partition_specs,
 )
 
@@ -128,24 +130,27 @@ _f_enter.defvjp(_f_fwd, _f_bwd)
 
 
 def _stage_1f1b(stage_params, x, cfg, backend, seg, tp: bool):
-    """The SAME Llama block as the GPipe schedule (pipeline._block),
-    with the tensor-parallel collectives routed through the f/g
-    operators above so in-region ``jax.vjp`` transposes them exactly.
-    tp=False inserts no collectives and is bit-identical to GPipe's."""
+    """The SAME Llama / DeepSeek-MLA block as the GPipe schedule
+    (pipeline._block / pipeline._mla_block), with the tensor-parallel
+    collectives routed through the f/g operators above so in-region
+    ``jax.vjp`` transposes them exactly. tp=False inserts no
+    collectives and is bit-identical to GPipe's."""
     tp_ops = (_f_enter, _g_combine) if tp else None
+    blk = _mla_block if _is_mla(cfg) else _block
 
     def body(h, layer_p):
-        return _block(layer_p, h, cfg, backend, seg, tp, tp_ops), None
+        return blk(layer_p, h, cfg, backend, seg, tp, tp_ops), None
 
     out, _ = jax.lax.scan(body, x, stage_params)
     return out
 
 
 def _check_1f1b(cfg, mesh: Mesh) -> None:
-    if _is_gemma(cfg) or _is_moe(cfg):
+    if _is_gemma(cfg) or _is_moe(cfg) or (_is_mla(cfg) and cfg.moe):
         raise NotImplementedError(
-            "schedule='1f1b' implements Llama-family blocks; use the "
-            "GPipe schedule for Gemma/Mixtral"
+            "schedule='1f1b' implements Llama-family and dense "
+            "DeepSeek-MLA blocks; use the GPipe schedule for "
+            "Gemma/Mixtral"
         )
     for ax in (AXIS_SEQUENCE, AXIS_EXPERT):
         if mesh.shape[ax] != 1:

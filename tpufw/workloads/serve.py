@@ -241,16 +241,18 @@ def eos_from_env() -> Optional[int]:
 
 
 def build_draft_generator(sampling):
-    """TPUFW_DRAFT_MODEL: enable greedy speculative decoding
-    (tpufw.infer.speculative) with this preset as the draft.
+    """TPUFW_DRAFT_MODEL: enable speculative decoding
+    (tpufw.infer.speculative) with this preset as the draft — greedy
+    acceptance at TPUFW_TEMPERATURE=0, rejection-resampling otherwise
+    (every sampler knob except the repetition penalty composes).
 
     Draft weights come from TPUFW_DRAFT_PARAMS_CHECKPOINT (bare Orbax
     params, e.g. an import_hf of the small family member) — without it
     the draft initializes randomly, which is only useful for wiring
     tests (proposals rarely match, throughput degrades to ~plain decode
-    plus draft overhead; outputs stay exactly the target's greedy
-    continuation either way). Returns (draft_model, draft_params, k) or
-    None when speculation is off."""
+    plus draft overhead; outputs stay exactly target-distributed either
+    way). Returns (draft_model, draft_params, k) or None when
+    speculation is off."""
     import dataclasses
 
     import jax
@@ -258,15 +260,17 @@ def build_draft_generator(sampling):
     name = env_str("draft_model", "")
     if not name:
         return None
-    if sampling.temperature != 0.0 or sampling.repetition_penalty:
-        # top_k/top_p/min_p are genuine no-ops at temperature 0, but a
-        # repetition penalty changes the temp-0 argmax — silently
-        # emitting UNpenalized tokens would break the exact-greedy
-        # contract.
+    if sampling.repetition_penalty is not None:
+        # `is not None`, not truthiness: TPUFW_REPETITION_PENALTY=0
+        # resolves to 0.0 (only 1.0 maps to None) and must fail HERE.
+        # The penalty's seen-token mask is sequential (each emission
+        # updates it) but the draft proposes k tokens before any is
+        # accepted — tpufw.infer.speculative rejects the combination
+        # at trace time; fail at config time with the env-var name.
         raise ValueError(
-            "TPUFW_DRAFT_MODEL requires plain greedy sampling "
-            "(TPUFW_TEMPERATURE=0, no TPUFW_REPETITION_PENALTY): "
-            "speculative acceptance compares against the target argmax"
+            "TPUFW_DRAFT_MODEL cannot combine with "
+            "TPUFW_REPETITION_PENALTY: the penalty's seen-token state "
+            "is sequential, speculation proposes tokens in blocks"
         )
     from tpufw.configs.loader import resolve_model_preset
     from tpufw.models import model_for_config
@@ -353,6 +357,7 @@ def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
             eos_id=eos_from_env(),
             k=k,
             live_rows=[i < real_n for i in range(len(padded))],
+            sampling=sampling,
         )
         outs = outs[:real_n]
     else:
@@ -591,6 +596,7 @@ class _Server:
                 # batch-min acceptance to zero; their outputs are
                 # sliced off below anyway.
                 live_rows=[i < real_n for i in range(len(padded))],
+                sampling=self._sampling,
             )
             return outs[:real_n]
         outs = self._generate_text(
