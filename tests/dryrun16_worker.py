@@ -28,38 +28,17 @@ def main() -> int:
     assert len(jax.devices()) == 16, jax.devices()
 
     from tpufw.mesh import MeshConfig
-    from tpufw.models import (
-        DEEPSEEK_CONFIGS,
-        MIXTRAL_CONFIGS,
-        Mixtral,
-    )
-    from tpufw.parallel.pipeline import PipelineConfig
-    from tpufw.train import (
-        PipelineTrainer,
-        Trainer,
-        TrainerConfig,
-        synthetic_batches,
-    )
+    from tpufw.models import MIXTRAL_CONFIGS, Mixtral
+    from tpufw.train import Trainer, TrainerConfig, synthetic_batches
 
     # (a) pipe=4 (8 layers, 2 per stage) x tensor=4: MLA heads split 4
     # ways, latent kernels replicated; the largest pipe/tensor factors
-    # the suite type-checks.
-    cfg = dataclasses.replace(
-        DEEPSEEK_CONFIGS["deepseek_tiny"], n_layers=8
-    )
-    tr = PipelineTrainer(
-        cfg,
-        PipelineConfig(n_stages=4, n_microbatches=4),
-        TrainerConfig(batch_size=16, seq_len=33, total_steps=1, lr=1e-3),
-        MeshConfig(data=1, pipe=4, tensor=4, fsdp=-1),
-    )
-    tr.init_state()
-    h = tr.run(
-        synthetic_batches(16, 33, cfg.vocab_size),
-        model_flops_per_token=cfg.flops_per_token(32),
-    )
-    assert len(h) == 1 and math.isfinite(h[0].loss)
-    print(f"PP4TP4_OK mesh={dict(tr.mesh.shape)} loss={h[0].loss:.3f}")
+    # the suite type-checks. ONE copy of the scenario, shared with
+    # dryrun case 11 (__graft_entry__.run_pp4tp4_mla_case).
+    from __graft_entry__ import run_pp4tp4_mla_case
+
+    mesh16, loss16 = run_pp4tp4_mla_case(16)
+    print(f"PP4TP4_OK mesh={dict(mesh16.shape)} loss={loss16:.3f}")
 
     # (b) expert=8: one expert per pair of devices' worth of routing —
     # the config-5 expert-parallel factor beyond 2.

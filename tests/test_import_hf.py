@@ -247,9 +247,9 @@ def test_cli_to_orbax_then_finetune_and_serve(hf_model, tmp_path, clear_tpufw_en
 
 
 def test_unsupported_arch_features_are_loud():
-    """Non-llama3 rope_scaling types (yarn/linear/...) must refuse to
-    import rather than silently produce wrong-position logits; the
-    llama3 transform (Llama-3.1+) imports."""
+    """Unimplemented rope_scaling types (yarn on Llama, dynamic,
+    longrope) must refuse to import rather than silently produce
+    wrong-position logits; llama3 (Llama-3.1+) and linear import."""
     cfg = {
         "model_type": "llama",
         "vocab_size": 256,
@@ -272,6 +272,15 @@ def test_unsupported_arch_features_are_loud():
     assert got.rope_scaling is not None
     assert got.rope_scaling.factor == 8.0
     assert got.rope_scaling.original_max_position_embeddings == 64
+    cfg["rope_scaling"] = {"rope_type": "linear", "factor": 4.0}
+    got = config_from_hf(cfg)
+    assert got.rope_scaling is not None
+    assert got.rope_scaling.rope_type == "linear"
+    assert got.rope_scaling.factor == 4.0
+    for rejected in ("dynamic", "longrope"):
+        cfg["rope_scaling"] = {"rope_type": rejected, "factor": 4.0}
+        with pytest.raises(NotImplementedError, match=rejected):
+            config_from_hf(cfg)
     cfg.pop("rope_scaling")
     assert config_from_hf(cfg).rope_scaling is None
     cfg["attention_bias"] = True
@@ -368,6 +377,93 @@ def test_rope_scaled_export_round_trip(hf_rope_scaled_model, tmp_path):
     reloaded.eval()
     assert reloaded.config.rope_scaling["factor"] == 8.0
     rng = np.random.default_rng(9)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 40), dtype=np.int64)
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens)).logits.numpy()
+        got = reloaded(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-3)
+
+
+@pytest.fixture(scope="module")
+def hf_linear_rope_model():
+    """A linear-scaled (position-interpolation) tiny config — the
+    long-context Llama-2 fine-tune shape (VERDICT r3 item 9)."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attention_bias=False,
+        mlp_bias=False,
+        rope_scaling={"rope_type": "linear", "factor": 4.0},
+    )
+    torch.manual_seed(11)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_linear_rope_logits_match_transformers(hf_linear_rope_model):
+    """Linear (position-interpolation) scaling must reproduce
+    transformers' _compute_linear_scaling_parameters to logits
+    tolerance — and actually change the logits at these positions."""
+    import dataclasses
+
+    hf_model = hf_linear_rope_model
+    cfg = dataclasses.replace(
+        config_from_hf(hf_model.config),
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        remat=False,
+    )
+    assert cfg.rope_scaling is not None
+    assert cfg.rope_scaling.rope_type == "linear"
+    params = from_hf_llama(hf_model, cfg)
+    rng = np.random.default_rng(12)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 40), dtype=np.int64)
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    got = Llama(cfg).apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), want, atol=2e-4, rtol=2e-3
+    )
+    base = Llama(
+        dataclasses.replace(cfg, rope_scaling=None)
+    ).apply({"params": params}, jnp.asarray(tokens, jnp.int32))
+    assert np.abs(np.asarray(base) - want).max() > 1e-3
+
+
+def test_linear_rope_export_round_trip(hf_linear_rope_model, tmp_path):
+    """Export writes {"rope_type": "linear", factor} back to
+    config.json and transformers reloads to the same logits."""
+    import dataclasses
+
+    from tpufw.tools.import_hf import export_hf
+
+    hf_model = hf_linear_rope_model
+    cfg = dataclasses.replace(
+        config_from_hf(hf_model.config),
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        remat=False,
+    )
+    params = from_hf_llama(hf_model, cfg)
+    out = tmp_path / "export"
+    export_hf(params, cfg, str(out))
+    reloaded = transformers.LlamaForCausalLM.from_pretrained(str(out))
+    reloaded.eval()
+    assert reloaded.config.rope_scaling["rope_type"] == "linear"
+    assert reloaded.config.rope_scaling["factor"] == 4.0
+    rng = np.random.default_rng(13)
     tokens = rng.integers(0, cfg.vocab_size, (2, 40), dtype=np.int64)
     with torch.no_grad():
         want = hf_model(torch.from_numpy(tokens)).logits.numpy()

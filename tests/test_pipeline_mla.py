@@ -79,6 +79,24 @@ def _flax_to_pipeline(flax_params: dict, cfg, n_stages: int) -> dict:
     }
 
 
+def _ref_loss(p, t):
+    from tpufw.train.trainer import cross_entropy_loss
+
+    logits = reference_forward(p, t[:, :-1], CFG)
+    return cross_entropy_loss(logits, t[:, 1:])[0]
+
+
+def _assert_grads_close(got, want):
+    flat_g, _ = jax.tree_util.tree_flatten_with_path(got)
+    flat_w, _ = jax.tree_util.tree_flatten_with_path(want)
+    assert len(flat_g) == len(flat_w)
+    for (path, a), (_, b) in zip(flat_g, flat_w):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
 @pytest.fixture(scope="module")
 def mesh():
     return build_mesh(MeshConfig(data=1, pipe=2, fsdp=4))
@@ -132,27 +150,14 @@ def test_grads_match_sequential(setup, mesh):
     params = jax.device_put(
         params, pipeline_param_shardings(mesh, params)
     )
-
-    def ref_loss(p, t):
-        from tpufw.train.trainer import cross_entropy_loss
-
-        logits = reference_forward(p, t[:, :-1], CFG)
-        return cross_entropy_loss(logits, t[:, 1:])[0]
-
     l_pipe, g_pipe = jax.jit(
         jax.value_and_grad(
             lambda p, t: pipeline_loss(p, t, CFG, pipe, mesh)
         )
     )(params, tokens)
-    l_ref, g_ref = jax.value_and_grad(ref_loss)(params, tokens)
+    l_ref, g_ref = jax.value_and_grad(_ref_loss)(params, tokens)
     np.testing.assert_allclose(float(l_pipe), float(l_ref), rtol=1e-5)
-    flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pipe)
-    flat_r, _ = jax.tree_util.tree_flatten_with_path(g_ref)
-    for (path, a), (_, b) in zip(flat_p, flat_r):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
-            err_msg=jax.tree_util.keystr(path),
-        )
+    _assert_grads_close(g_pipe, g_ref)
 
 
 def test_pptp_forward_and_grads(setup):
@@ -173,26 +178,13 @@ def test_pptp_forward_and_grads(setup):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
     )
-
-    def ref_loss(p, t):
-        from tpufw.train.trainer import cross_entropy_loss
-
-        logits = reference_forward(p, t[:, :-1], CFG)
-        return cross_entropy_loss(logits, t[:, 1:])[0]
-
     _, g_pipe = jax.jit(
         jax.value_and_grad(
             lambda p, t: pipeline_loss(p, t, CFG, pipe, mesh)
         )
     )(params, tokens)
-    _, g_ref = jax.value_and_grad(ref_loss)(params, tokens)
-    flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pipe)
-    flat_r, _ = jax.tree_util.tree_flatten_with_path(g_ref)
-    for (path, a), (_, b) in zip(flat_p, flat_r):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
-            err_msg=jax.tree_util.keystr(path),
-        )
+    _, g_ref = jax.value_and_grad(_ref_loss)(params, tokens)
+    _assert_grads_close(g_pipe, g_ref)
 
 
 def test_1f1b_matches_gpipe(setup):
@@ -222,13 +214,7 @@ def test_1f1b_matches_gpipe(setup):
         )
     )(params, tokens)
     np.testing.assert_allclose(float(l_1), float(l_g), rtol=1e-5)
-    flat_1, _ = jax.tree_util.tree_flatten_with_path(g_1)
-    flat_g, _ = jax.tree_util.tree_flatten_with_path(g_g)
-    for (path, a), (_, b) in zip(flat_1, flat_g):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
-            err_msg=jax.tree_util.keystr(path),
-        )
+    _assert_grads_close(g_1, g_g)
 
 
 def test_moe_deepseek_rejected_loudly():

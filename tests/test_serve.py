@@ -371,8 +371,20 @@ def test_http_server_speculative_draft(tiny_env, monkeypatch):
     assert got == want
 
     # Non-greedy + draft now composes (stochastic speculative
-    # sampling): config resolution must ACCEPT temperature > 0.
+    # sampling): a server with TPUFW_TEMPERATURE=0.7 and a draft must
+    # serve a real request end-to-end (the jit path with a non-greedy
+    # SamplingConfig static arg), not just resolve config.
     monkeypatch.setenv("TPUFW_TEMPERATURE", "0.7")
     from tpufw.workloads.serve import sampling_from_env
 
     assert build_draft_generator(sampling_from_env()) is not None
+    srv3 = _Server(port=0, max_new_tokens=6)
+    t3 = threading.Thread(target=srv3.serve_forever, daemon=True)
+    t3.start()
+    deadline = time.time() + 30
+    while not hasattr(srv3, "httpd") and time.time() < deadline:
+        time.sleep(0.05)
+    sampled = post(srv3.port, prompts)
+    srv3.httpd.shutdown()
+    assert len(sampled) == len(prompts)
+    assert all(len(o) == 6 for o in sampled)

@@ -318,9 +318,9 @@ def speculative_generate(
             # correlate the resample with the rejection event).
             # Only the col-a slice is ever drawn from: index FIRST,
             # softmax one [B, V] row (not k+1 of them per iteration —
-            # V is the vocab in serving). p_a rides the existing logp;
-            # the a == k "zero q row" of the padded-q formulation is
-            # the where() below.
+            # V is the vocab in serving). p_a rides the existing logp.
+            # The a == k clamp feeds a real-but-irrelevant q row to the
+            # residual branch; the where() below picks logp_a there.
             logp_a = jax.lax.dynamic_index_in_dim(
                 logp, a, axis=1, keepdims=False
             )
@@ -332,7 +332,6 @@ def speculative_generate(
                 ),
                 axis=-1,
             )
-            q_a = jnp.where(a == k, 0.0, q_a)
             alt_logits = jnp.where(
                 a == k, logp_a, jnp.log(jnp.maximum(p_a - q_a, 0.0))
             )

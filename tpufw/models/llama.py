@@ -53,19 +53,26 @@ _REMAT_POLICIES = {
 
 @dataclasses.dataclass(frozen=True)
 class RopeScaling:
-    """Llama-3.1 "llama3" rotary frequency transform (HF
-    ``rope_scaling.rope_type == "llama3"``): low-frequency components are
-    slowed by ``factor`` (extending the usable context), high-frequency
-    components are kept, and a smooth ramp interpolates between the two
-    wavelength bands. The only rope_type tpufw implements — yarn /
-    linear / dynamic are rejected at import (tools/import_hf.py) rather
-    than silently approximated.
+    """Rotary frequency transform, by ``rope_type``:
+
+    - ``"llama3"`` (HF ``_compute_llama3_parameters``, Llama-3.1/3.3):
+      low-frequency components are slowed by ``factor`` (extending the
+      usable context), high-frequency components are kept, and a smooth
+      ramp interpolates between the two wavelength bands.
+    - ``"linear"`` (HF ``_compute_linear_scaling_parameters``, common
+      on long-context Llama-2 fine-tunes): every frequency divided by
+      ``factor`` — position interpolation; only ``factor`` is read.
+
+    yarn lives on the DeepSeek family (tpufw.models.deepseek
+    YarnScaling); dynamic/longrope are rejected at import
+    (tools/import_hf.py) rather than silently approximated.
     """
 
     factor: float = 8.0
     low_freq_factor: float = 1.0
     high_freq_factor: float = 4.0
     original_max_position_embeddings: int = 8192
+    rope_type: str = "llama3"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -282,12 +289,20 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
 def _scale_rope_freqs(
     freqs: jax.Array, s: RopeScaling
 ) -> jax.Array:
-    """The "llama3" frequency transform (matches HF
-    ``_compute_llama3_parameters`` so imported Llama-3.1 checkpoints are
-    bit-comparable): components with wavelength beyond
+    """Frequency transforms matching HF's executed math so imported
+    checkpoints are bit-comparable. "linear": every frequency divided
+    by ``factor`` (position interpolation). "llama3"
+    (``_compute_llama3_parameters``): components with wavelength beyond
     ``original_max/low_freq_factor`` are slowed by ``factor``, those
     below ``original_max/high_freq_factor`` are kept, and the band
     between is linearly interpolated in smooth-factor space."""
+    if s.rope_type == "linear":
+        return freqs / s.factor
+    if s.rope_type != "llama3":
+        raise NotImplementedError(
+            f"rope_type={s.rope_type!r}: RopeScaling implements "
+            "'llama3' and 'linear'"
+        )
     old_len = float(s.original_max_position_embeddings)
     wavelen = 2.0 * math.pi / freqs
     scaled = jnp.where(

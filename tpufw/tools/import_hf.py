@@ -41,10 +41,15 @@ def _to_np(t: Any) -> np.ndarray:
 def _rope_scaling_from_hf(rs: Any):
     """HF ``rope_scaling`` dict -> tpufw RopeScaling (or None).
 
-    Only ``rope_type == "llama3"`` (Llama-3.1/3.3 family) is
-    implemented; anything else (yarn, linear, dynamic, longrope) is
-    rejected loudly — a silently-dropped transform would import a model
-    whose logits drift with position."""
+    ``rope_type == "llama3"`` (Llama-3.1/3.3) and ``"linear"``
+    (position interpolation, common on long-context Llama-2 fine-tunes)
+    import directly. Rejected loudly: "dynamic" (NTK-aware scaling is a
+    function of the RUNTIME sequence length, so the frequencies change
+    per call — tpufw's static-shape decode caches bake frequencies at
+    trace time) and "longrope" (per-dimension learned scaling vectors
+    with a short/long context switch; not implemented). A
+    silently-dropped transform would import a model whose logits drift
+    with position."""
     if not rs:
         return None
     from tpufw.models.llama import RopeScaling
@@ -54,10 +59,16 @@ def _rope_scaling_from_hf(rs: Any):
     )
     # transformers renamed "type" -> "rope_type"; accept both.
     rtype = get("rope_type") or get("type")
+    if rtype == "linear":
+        return RopeScaling(
+            factor=float(get("factor")), rope_type="linear"
+        )
     if rtype != "llama3":
         raise NotImplementedError(
             f"rope_scaling rope_type={rtype!r} is not implemented "
-            "(only 'llama3'); importing would silently change rotary "
+            "('llama3' and 'linear' are; 'dynamic' scales with runtime "
+            "sequence length, 'longrope' needs learned per-dim "
+            "vectors); importing would silently change rotary "
             "frequencies"
         )
     return RopeScaling(
@@ -785,15 +796,27 @@ def hf_config_dict(cfg: LlamaConfig) -> dict:
         "rms_norm_eps": cfg.rms_eps,
         **(
             {
-                "rope_scaling": {
-                    "rope_type": "llama3",
-                    "factor": cfg.rope_scaling.factor,
-                    "low_freq_factor": cfg.rope_scaling.low_freq_factor,
-                    "high_freq_factor": cfg.rope_scaling.high_freq_factor,
-                    "original_max_position_embeddings": (
-                        cfg.rope_scaling.original_max_position_embeddings
-                    ),
-                }
+                "rope_scaling": (
+                    {
+                        "rope_type": "linear",
+                        "factor": cfg.rope_scaling.factor,
+                    }
+                    if cfg.rope_scaling.rope_type == "linear"
+                    else {
+                        "rope_type": "llama3",
+                        "factor": cfg.rope_scaling.factor,
+                        "low_freq_factor": (
+                            cfg.rope_scaling.low_freq_factor
+                        ),
+                        "high_freq_factor": (
+                            cfg.rope_scaling.high_freq_factor
+                        ),
+                        "original_max_position_embeddings": (
+                            cfg.rope_scaling
+                            .original_max_position_embeddings
+                        ),
+                    }
+                )
             }
             if getattr(cfg, "rope_scaling", None) is not None
             else {}
