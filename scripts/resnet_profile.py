@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""ResNet-50 MFU component profile (VERDICT r3 item 3 / weak 3).
+
+Round-3 record: 15.8% MFU best-case (2524 img/s, b256, bf16 BN) with
+"conv input/filter gradients identified as the remaining slow path" —
+analysis done, optimization not. This script measures the pieces so the
+optimization is aimed, one JSON line per experiment:
+
+  1. train step    — the bench tier (b256, bf16 BN): the reference point
+  2. forward only  — inference pass: how much of the step is backward
+  3. batch sweep   — 128 / 512: does conv-backward efficiency scale
+  4. conv micro    — fwd / input-grad / filter-grad TFLOP/s for the
+                     three canonical ResNet conv shapes (7x7s2 stem,
+                     3x3 mid, 1x1 wide), bf16 vs f32: WHERE the
+                     backward cliff is, layout NHWC (XLA-native)
+
+Timing is value-fetch based (np.asarray). Run from /root/repo on a
+healthy TPU:  python scripts/resnet_profile.py   (--smoke for a tiny
+CPU wiring check). Results append to
+docs/evidence/RESNET_PROFILE_r4.jsonl as they complete.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "evidence", "RESNET_PROFILE_r4.jsonl",
+)
+SMOKE = "--smoke" in sys.argv
+
+
+def emit(row: dict) -> None:
+    row = {"t": round(time.time(), 1), **row}
+    print(json.dumps(row), flush=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def main() -> int:
+    if SMOKE:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tpufw.utils.profiling import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if SMOKE:
+        jax.config.update("jax_platforms", "cpu")
+
+    from tpufw.mesh import MeshConfig
+    from tpufw.models import ResNetConfig, resnet50
+    from tpufw.train import (
+        VisionTrainer,
+        VisionTrainerConfig,
+        synthetic_images,
+    )
+
+    devices = jax.devices()
+    emit({"event": "start", "platform": devices[0].platform,
+          "kind": devices[0].device_kind, "smoke": SMOKE})
+
+    img = 64 if SMOKE else 224
+    classes = 10 if SMOKE else 1000
+    flops_per_image = ResNetConfig().flops_per_image(img)
+    peak = 197e12 if not SMOKE else 1e12  # v5e bf16
+
+    # 1 + 3. Train step at batch sweep through the bench path.
+    for batch in ([8] if SMOKE else [128, 256, 512]):
+        try:
+            vt = VisionTrainer(
+                resnet50(classes, norm_dtype=jnp.bfloat16),
+                VisionTrainerConfig(
+                    batch_size=batch, image_size=img,
+                    total_steps=9, sync_every=4,
+                ),
+                MeshConfig(),
+            )
+            vt.init_state()
+            hist = vt.run(
+                synthetic_images(batch, img, classes, on_device=True),
+                flops_per_image=flops_per_image,
+            )
+            steady = [m for m in hist if m.step > 1]
+            import statistics
+
+            emit({
+                "case": f"train_b{batch}",
+                "img_per_s": round(statistics.median(
+                    m.tokens_per_sec_per_chip for m in steady
+                ), 1),
+                "mfu": round(statistics.median(
+                    m.mfu for m in steady
+                ), 4),
+            })
+            del vt
+        except Exception as e:  # noqa: BLE001
+            emit({"case": f"train_b{batch}",
+                  "error": f"{type(e).__name__}: {e}"[:300]})
+
+    # 2. Forward only (same model/batch as the b256 tier).
+    batch = 8 if SMOKE else 256
+    model = resnet50(classes, norm_dtype=jnp.bfloat16)
+    x = jnp.ones((batch, img, img, 3), jnp.bfloat16)
+    variables = jax.jit(
+        lambda k, x: model.init(k, x, train=False)
+    )(jax.random.key(0), x)
+
+    fwd = jax.jit(
+        lambda v, x: model.apply(v, x, train=False)
+    )
+    np.asarray(fwd(variables, x))  # compile+warm
+    t0 = time.perf_counter()
+    np.asarray(fwd(variables, x))
+    dt = time.perf_counter() - t0
+    emit({
+        "case": "forward_only", "batch": batch,
+        "img_per_s": round(batch / dt, 1),
+        # Forward is ~1/3 of train FLOPs.
+        "mfu_fwd": round(
+            (flops_per_image / 3.0) * batch / dt / peak, 4
+        ),
+    })
+
+    # 4. Conv microbench: canonical shapes, fwd + both grads.
+    from functools import partial
+
+    def conv(x, w, stride):
+        return jax.lax.conv_general_dilated(
+            x, w, (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    shapes = [
+        # (name, H, Cin, Cout, k, stride) at the profile batch
+        ("stem7x7s2", img, 3, 64, 7, 2),
+        ("mid3x3", img // 8, 128, 128, 3, 1),
+        ("wide1x1", img // 16, 1024, 256, 1, 1),
+    ]
+    for dt_name, dtype in (("bf16", jnp.bfloat16), ("f32", jnp.float32)):
+        for name, h, cin, cout, k, stride in shapes:
+            x = jnp.ones((batch, h, h, cin), dtype)
+            w = jnp.ones((k, k, cin, cout), dtype)
+            flops = (
+                2.0 * batch * (h / stride) ** 2 * cin * cout * k * k
+            )
+
+            def loss(x, w, stride=stride):
+                return jnp.sum(conv(x, w, stride).astype(jnp.float32))
+
+            cases = {
+                "fwd": jax.jit(partial(conv, stride=stride)),
+                "dx": jax.jit(jax.grad(loss, argnums=0)),
+                "dw": jax.jit(jax.grad(loss, argnums=1)),
+            }
+            for kind, fn in cases.items():
+                try:
+                    np.asarray(fn(x, w))  # compile+warm
+                    t0 = time.perf_counter()
+                    np.asarray(fn(x, w))
+                    d = time.perf_counter() - t0
+                    emit({
+                        "case": f"conv_{name}_{kind}_{dt_name}",
+                        "tflop_per_s": round(flops / d / 1e12, 2),
+                        "ms": round(d * 1e3, 2),
+                    })
+                except Exception as e:  # noqa: BLE001
+                    emit({
+                        "case": f"conv_{name}_{kind}_{dt_name}",
+                        "error": f"{type(e).__name__}: {e}"[:200],
+                    })
+    emit({"event": "done"})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

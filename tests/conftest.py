@@ -47,6 +47,21 @@ jax.config.update("jax_compilation_cache_dir", None)
 import pytest  # noqa: E402
 
 
+def assert_trees_close(got, want, rtol=2e-4, atol=2e-4):
+    """ONE copy of the pytree-compare loop every pipeline grad-parity
+    test uses: per-leaf allclose with the leaf path in the error."""
+    import numpy as np
+
+    flat_g, _ = jax.tree_util.tree_flatten_with_path(got)
+    flat_w = jax.tree_util.tree_leaves(want)
+    assert len(flat_g) == len(flat_w)
+    for (path, a), b in zip(flat_g, flat_w):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=rtol, atol=atol,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
 @pytest.fixture(scope="session")
 def devices8():
     devs = jax.devices()

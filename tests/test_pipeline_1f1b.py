@@ -49,14 +49,9 @@ def setup(mesh):
 
 
 def _assert_grads_match(g1, g2, atol=2e-4, rtol=2e-4):
-    flat1, _ = jax.tree_util.tree_flatten_with_path(g1)
-    flat2 = jax.tree.leaves(g2)
-    assert len(flat1) == len(flat2)
-    for (path, a), b in zip(flat1, flat2):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), atol=atol, rtol=rtol,
-            err_msg=jax.tree_util.keystr(path),
-        )
+    from tests.conftest import assert_trees_close
+
+    assert_trees_close(g1, g2, rtol=rtol, atol=atol)
 
 
 def test_1f1b_matches_gpipe_grads(setup, mesh):

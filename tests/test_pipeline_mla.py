@@ -87,14 +87,9 @@ def _ref_loss(p, t):
 
 
 def _assert_grads_close(got, want):
-    flat_g, _ = jax.tree_util.tree_flatten_with_path(got)
-    flat_w, _ = jax.tree_util.tree_flatten_with_path(want)
-    assert len(flat_g) == len(flat_w)
-    for (path, a), (_, b) in zip(flat_g, flat_w):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
-            err_msg=jax.tree_util.keystr(path),
-        )
+    from tests.conftest import assert_trees_close
+
+    assert_trees_close(got, want, rtol=2e-3, atol=2e-4)
 
 
 @pytest.fixture(scope="module")
