@@ -36,10 +36,13 @@ OUT = os.path.join(
     "docs", "evidence", "RESNET_PROFILE_r4.jsonl",
 )
 SMOKE = "--smoke" in sys.argv
+# Every row carries the platform so a --smoke wiring check appended to
+# the same evidence file can never be mistaken for hardware numbers.
+_TAGS: dict = {}
 
 
 def emit(row: dict) -> None:
-    row = {"t": round(time.time(), 1), **row}
+    row = {"t": round(time.time(), 1), **_TAGS, **row}
     print(json.dumps(row), flush=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(row) + "\n")
@@ -68,8 +71,8 @@ def main() -> int:
     )
 
     devices = jax.devices()
-    emit({"event": "start", "platform": devices[0].platform,
-          "kind": devices[0].device_kind, "smoke": SMOKE})
+    _TAGS.update(platform=devices[0].platform, smoke=SMOKE)
+    emit({"event": "start", "kind": devices[0].device_kind})
 
     img = 64 if SMOKE else 224
     classes = 10 if SMOKE else 1000

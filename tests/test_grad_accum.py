@@ -64,15 +64,9 @@ def test_accum_matches_one_shot(masked):
     np.testing.assert_allclose(
         float(m1["loss"]), float(m4["loss"]), rtol=1e-5
     )
-    import jax
+    from tests.conftest import assert_trees_close
 
-    flat1, _ = jax.tree_util.tree_flatten_with_path(s1.params)
-    flat4, _ = jax.tree_util.tree_flatten_with_path(s4.params)
-    for (path, a), (_, b) in zip(flat1, flat4):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
-            err_msg=jax.tree_util.keystr(path),
-        )
+    assert_trees_close(s1.params, s4.params, rtol=2e-4, atol=2e-5)
 
 
 def test_accum_trains(devices8):

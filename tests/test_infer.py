@@ -51,6 +51,53 @@ def test_cached_decode_matches_full_forward(llama_params):
     assert got == want
 
 
+def test_unrolled_decode_matches_scanned(llama_params):
+    """The serving unroll lever (tpufw.models.unstack_layer_params):
+    scanned-checkpoint params decoded by the UNSCANNED twin must emit
+    the exact same tokens — across families with different scanned
+    units (Llama plain layers, Gemma pairs)."""
+    import dataclasses
+
+    from tpufw.models import unstack_layer_params
+
+    prompts = [[5, 17, 101, 7, 42], [9, 3]]
+    scanned = generate_text(
+        Llama(TINY.decode_config()), llama_params, prompts,
+        max_new_tokens=6,
+    )
+    un_cfg = dataclasses.replace(TINY, scan_layers=False)
+    unrolled = generate_text(
+        Llama(un_cfg.decode_config()),
+        unstack_layer_params(llama_params),
+        prompts,
+        max_new_tokens=6,
+    )
+    assert unrolled == scanned
+    # Already-unstacked trees pass through unchanged.
+    flat = unstack_layer_params(unstack_layer_params(llama_params))
+    assert "layer_0" in flat and "layers" not in flat
+
+    from tpufw.models import GEMMA_CONFIGS, Gemma
+
+    gcfg = GEMMA_CONFIGS["gemma2_tiny"]
+    gparams = Gemma(gcfg).init(
+        jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    g_scanned = generate_text(
+        Gemma(gcfg.decode_config()), gparams, prompts,
+        max_new_tokens=5,
+    )
+    g_unrolled = generate_text(
+        Gemma(
+            dataclasses.replace(gcfg, scan_layers=False).decode_config()
+        ),
+        unstack_layer_params(gparams),
+        prompts,
+        max_new_tokens=5,
+    )
+    assert g_unrolled == g_scanned
+
+
 def test_ragged_batch_matches_per_example(llama_params):
     """Left-padded batch rows must decode exactly like solo runs."""
     prompts = [[5, 17, 101, 7, 42], [9, 3], [77, 12, 200]]

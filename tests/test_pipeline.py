@@ -80,13 +80,9 @@ def test_grads_match_sequential(setup, mesh):
     )(params, tokens)
     l_ref, g_ref = jax.value_and_grad(ref_loss)(params, tokens)
     np.testing.assert_allclose(float(l_pipe), float(l_ref), rtol=1e-5)
-    flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pipe)
-    flat_r, _ = jax.tree_util.tree_flatten_with_path(g_ref)
-    for (path, a), (_, b) in zip(flat_p, flat_r):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
-            err_msg=jax.tree_util.keystr(path),
-        )
+    from tests.conftest import assert_trees_close
+
+    assert_trees_close(g_pipe, g_ref, rtol=2e-3, atol=2e-4)
 
 
 def test_stage_params_are_sharded_on_pipe(setup):
@@ -507,13 +503,9 @@ def test_pptp_grads_match_sequential(pptp_setup, pptp_mesh):
     )(params, tokens)
     l_ref, g_ref = jax.value_and_grad(ref_loss)(params, tokens)
     np.testing.assert_allclose(float(l_pipe), float(l_ref), rtol=1e-5)
-    flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pipe)
-    flat_r, _ = jax.tree_util.tree_flatten_with_path(g_ref)
-    for (path, a), (_, b) in zip(flat_p, flat_r):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4,
-            err_msg=jax.tree_util.keystr(path),
-        )
+    from tests.conftest import assert_trees_close
+
+    assert_trees_close(g_pipe, g_ref, rtol=2e-3, atol=2e-4)
 
 
 def test_pptp_gemma_forward_matches_sequential(pptp_mesh):

@@ -111,13 +111,9 @@ def test_moe_grads_match_grouped_oracle(ep_setup, ep_mesh):
         )
     )(params, tokens)
     g_ref = jax.jit(jax.grad(ref_loss))(params, tokens)
-    flat_p, _ = jax.tree_util.tree_flatten_with_path(g_pipe)
-    flat_r = jax.tree.leaves(g_ref)
-    for (path, a), b in zip(flat_p, flat_r):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), atol=5e-4, rtol=5e-4,
-            err_msg=jax.tree_util.keystr(path),
-        )
+    from tests.conftest import assert_trees_close
+
+    assert_trees_close(g_pipe, g_ref, rtol=5e-4, atol=5e-4)
 
 
 def test_moe_pptp_ep_forward_matches_oracle():

@@ -72,6 +72,19 @@ def test_batch_generate_restores_checkpoint(tiny_env):
     assert results[0]["output"] == want
 
 
+def test_batch_generate_unrolled_matches_scanned(tiny_env, monkeypatch):
+    """TPUFW_DECODE_UNROLL=1 serves the unscanned twin from the SAME
+    scanned checkpoint with identical greedy outputs — the whole
+    env -> build_generator -> unstack -> generate path."""
+    from tpufw.workloads.serve import run_batch
+
+    prompts = [[1, 5, 9], [2]]
+    want = run_batch(prompts, max_new_tokens=4)
+    monkeypatch.setenv("TPUFW_DECODE_UNROLL", "1")
+    got = run_batch(prompts, max_new_tokens=4)
+    assert [r["output"] for r in got] == [r["output"] for r in want]
+
+
 def test_batch_generate_without_checkpoint(monkeypatch, tmp_path):
     from tpufw.workloads.serve import run_batch
 

@@ -13,6 +13,7 @@ from tpufw.models.llama import (  # noqa: F401
     LlamaConfig,
     LLAMA_CONFIGS,
     RopeScaling,
+    unstack_layer_params,
 )
 from tpufw.models.mixtral import (  # noqa: F401
     MIXTRAL_CONFIGS,

@@ -681,6 +681,30 @@ class LlamaBlock(nn.Module):
         return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
 
 
+def unstack_layer_params(params: dict) -> dict:
+    """Scanned-trunk param tree -> the unscanned twin's tree.
+
+    ``decoder_lm`` with ``scan_layers=True`` stores the block stack as
+    ONE submodule named "layers" whose leaves carry a leading [L] axis
+    (nn.scan variable_axes); with ``scan_layers=False`` the same
+    weights live under ``layer_0 .. layer_{L-1}``. This converts the
+    former to the latter — the serving "unroll" lever: a checkpoint
+    trained scanned can be decoded by the unscanned twin
+    (``dataclasses.replace(cfg, scan_layers=False)``), which skips the
+    per-step per-layer weight slicing of the decode scan. Works for
+    every decoder_lm family (Llama/Qwen/Mistral/Mixtral/Deepseek and
+    Gemma, whose scanned unit is a PAIR). A tree with no "layers" key
+    (already unscanned) is returned unchanged."""
+    if "layers" not in params:
+        return params
+    stacked = params["layers"]
+    n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    out = {k: v for k, v in params.items() if k != "layers"}
+    for i in range(n):
+        out[f"layer_{i}"] = jax.tree.map(lambda a: a[i], stacked)
+    return out
+
+
 def decoder_lm(
     cfg, block_base, tokens, positions, segment_ids, with_aux,
     return_hidden=False,

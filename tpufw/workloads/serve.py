@@ -81,6 +81,7 @@ def build_generator():
         from tpufw.models import model_for_config
 
         hf_cfg, params = _maybe_quantize(hf_cfg, params)
+        hf_cfg, params = _maybe_unroll(hf_cfg, params)
         return (
             model_for_config(hf_cfg.decode_config()),
             params,
@@ -119,6 +120,7 @@ def build_generator():
         # multi-chip models load split, not on device 0.
         params = _restore_bare_params(model_cfg, params_dir)
         model_cfg, params = _maybe_quantize(model_cfg, params)
+        model_cfg, params = _maybe_unroll(model_cfg, params)
         return model_cls(model_cfg.decode_config()), params, model_cfg, True
 
     # Reuse the trainer's restore machinery (abstract state + reshard-on-
@@ -141,9 +143,32 @@ def build_generator():
     del trainer.state  # drop optimizer moments; serving only needs params
 
     model_cfg, params = _maybe_quantize(model_cfg, params)
+    model_cfg, params = _maybe_unroll(model_cfg, params)
     decode_model = model_cls(model_cfg.decode_config())
     _ = jax  # backend initialized above via Trainer
     return decode_model, params, model_cfg, restored
+
+
+def _maybe_unroll(model_cfg, params):
+    """TPUFW_DECODE_UNROLL=1: decode with the UNSCANNED layer stack —
+    the scanned trunk's decode loop slices its stacked [L, ...] weights
+    per layer per step, which the unrolled twin avoids (measured 1.7x
+    on the CPU smoke profile; scripts/decode_profile.py carries the
+    hardware experiment). Checkpoints stay scanned on disk; the param
+    tree is unstacked in memory (tpufw.models.unstack_layer_params).
+    Trace/compile time grows with n_layers — a serving-startup cost.
+    Applied to EVERY build_generator source, after quantization (the
+    unstack is tree-generic, quantized leaves included)."""
+    import dataclasses as _dc
+
+    if not env_int("decode_unroll", 0):
+        return model_cfg, params
+    from tpufw.models import unstack_layer_params
+
+    return (
+        _dc.replace(model_cfg, scan_layers=False),
+        unstack_layer_params(params),
+    )
 
 
 def _maybe_quantize(model_cfg, params):
