@@ -119,8 +119,8 @@ def test_hf_config_mapping(hf_deepseek):
 
 
 def test_hf_unsupported_features_rejected():
-    """MoE imports now work; the remaining gaps must still fail loudly:
-    group-limited routing (V2-236B) and yarn rope scaling."""
+    """MoE, group-limited routing, and yarn now import; the remaining
+    gaps must still fail loudly (other topk_methods, non-yarn rope)."""
     from tpufw.tools.import_hf import config_from_hf
 
     base = {
@@ -139,8 +139,29 @@ def test_hf_unsupported_features_rejected():
         "v_head_dim": 16,
         "intermediate_size": 128,
     }
+    # The 236B group-limited selection imports with its group fields.
+    cfg = config_from_hf({
+        **base,
+        "topk_method": "group_limited_greedy",
+        "n_group": 8,
+        "topk_group": 3,
+    })
+    assert cfg.n_group == 8 and cfg.topk_group == 3
+    # Other topk methods (e.g. V3's noaux_tc) still reject.
     with pytest.raises(NotImplementedError, match="topk_method"):
+        config_from_hf({**base, "topk_method": "noaux_tc"})
+    # Malformed group specs fail AT IMPORT with the fields named, not
+    # deep inside the first jit trace: missing n_group, and an n_group
+    # that doesn't divide n_routed_experts.
+    with pytest.raises(NotImplementedError, match="group_limited"):
         config_from_hf({**base, "topk_method": "group_limited_greedy"})
+    with pytest.raises(NotImplementedError, match="group_limited"):
+        config_from_hf({
+            **base,
+            "topk_method": "group_limited_greedy",
+            "n_group": 3,
+            "topk_group": 1,
+        })
     # yarn is supported; OTHER scaling types still reject.
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         config_from_hf({
@@ -396,6 +417,99 @@ def test_hf_moe_logits_parity(hf_deepseek_moe):
     np.testing.assert_allclose(
         np.asarray(got), want, atol=3e-4, rtol=2e-3
     )
+
+
+@pytest.fixture(scope="module")
+def hf_deepseek_group_limited():
+    """236B-style routing at test scale: 8 fine-grained experts in 4
+    groups of 2, only the best 2 groups routable, top-3 within them —
+    the group limit genuinely bites (k=3 spans groups and excludes 2
+    whole groups every token)."""
+    import transformers
+
+    hf_cfg = transformers.DeepseekV2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        moe_intermediate_size=48,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=4,
+        q_lora_rank=None,
+        kv_lora_rank=32,
+        qk_nope_head_dim=16,
+        qk_rope_head_dim=8,
+        v_head_dim=16,
+        n_routed_experts=8,
+        num_experts_per_tok=3,
+        n_shared_experts=1,
+        first_k_dense_replace=0,
+        norm_topk_prob=False,
+        routed_scaling_factor=1.0,
+        topk_method="group_limited_greedy",
+        n_group=4,
+        topk_group=2,
+        scoring_func="softmax",
+        max_position_embeddings=128,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attention_bias=False,
+    )
+    torch.manual_seed(5)
+    model = transformers.DeepseekV2ForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_hf_group_limited_logits_parity(hf_deepseek_group_limited):
+    """Group-limited selection (tpufw.ops.moe route_topk_capacity
+    group_limit) vs HF's DeepseekV2MoEGate group_limited_greedy — and
+    the limit must actually matter at these weights (dropping it
+    changes the logits)."""
+    from tpufw.tools.import_hf import config_from_hf, from_hf
+
+    hf_model = hf_deepseek_group_limited
+    cfg = dataclasses.replace(
+        config_from_hf(hf_model.config),
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        remat=False,
+    )
+    assert cfg.n_group == 4 and cfg.topk_group == 2
+    params = from_hf(hf_model, cfg)
+    rng = np.random.default_rng(6)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 24), dtype=np.int64)
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    got = Deepseek(cfg).apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32),
+        return_aux=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), want, atol=3e-4, rtol=2e-3
+    )
+    # Greedy-over-all-experts on the same weights must DIFFER, or the
+    # parity above pinned nothing about the group limit.
+    free = Deepseek(
+        dataclasses.replace(cfg, n_group=0, topk_group=0)
+    ).apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32),
+        return_aux=False,
+    )
+    assert np.abs(np.asarray(free) - want).max() > 1e-3
+
+
+def test_group_limited_export_round_trip(hf_deepseek_group_limited):
+    """export_hf writes topk_method/n_group/topk_group back; the config
+    re-imports to the same routing."""
+    from tpufw.tools.import_hf import config_from_hf, hf_config_dict
+
+    cfg = config_from_hf(hf_deepseek_group_limited.config)
+    out = hf_config_dict(cfg)
+    assert out["topk_method"] == "group_limited_greedy"
+    assert out["n_group"] == 4 and out["topk_group"] == 2
+    cfg2 = config_from_hf(out)
+    assert cfg2.n_group == 4 and cfg2.topk_group == 2
 
 
 def test_moe_training_with_expert_parallelism():
@@ -671,13 +785,20 @@ def test_export_hf_roundtrip_moe_yarn(tmp_path):
     )
 
 
-def test_pipeline_rejects_deepseek():
-    """The pipeline schedules build Llama-family stage stacks; a
-    DeepseekConfig must be rejected loudly, not silently mis-built."""
+def test_pipeline_accepts_uniform_rejects_mixed_deepseek():
+    """Dense and uniform-MoE MLA pipelines are supported
+    (tests/test_pipeline_mla.py); first_k_dense layer mixing must still
+    be rejected loudly, not silently mis-built."""
+    import dataclasses as _dc
+
     from tpufw.parallel.pipeline import PipelineConfig
 
-    with pytest.raises(NotImplementedError, match="Llama-family"):
-        PipelineConfig(n_stages=2, n_microbatches=2).validate(TINY, 8)
+    pipe = PipelineConfig(n_stages=2, n_microbatches=2)
+    pipe.validate(TINY, 8)  # dense MLA: fine
+    pipe.validate(MOE_TINY, 8)  # uniform MoE: fine
+    mixed = _dc.replace(MOE_TINY, first_k_dense=1, scan_layers=False)
+    with pytest.raises(NotImplementedError, match="UNIFORM"):
+        pipe.validate(mixed, 8)
 
 
 def test_speculative_decode_with_latent_cache():

@@ -61,10 +61,27 @@ def _flax_to_pipeline(flax_params: dict, cfg, n_stages: int) -> dict:
         "wkv_b": stack(attn["kv_b_kernel"]),
         "wo": stack(attn["o"]["kernel"]),
         "mlp_norm": stack(layers["mlp_norm"]["scale"]),
-        "w_gate": stack(layers["mlp"]["gate"]["kernel"]),
-        "w_up": stack(layers["mlp"]["up"]["kernel"]),
-        "w_down": stack(layers["mlp"]["down"]["kernel"]),
     }
+    if cfg.moe:
+        moe = layers["moe"]
+        stages.update(
+            router=stack(moe["routed"]["router"]["kernel"]),
+            w_gate=stack(moe["routed"]["w_gate"]),
+            w_up=stack(moe["routed"]["w_up"]),
+            w_down=stack(moe["routed"]["w_down"]),
+        )
+        if cfg.n_shared_experts:
+            stages.update(
+                w_shared_gate=stack(moe["shared"]["gate"]["kernel"]),
+                w_shared_up=stack(moe["shared"]["up"]["kernel"]),
+                w_shared_down=stack(moe["shared"]["down"]["kernel"]),
+            )
+    else:
+        stages.update(
+            w_gate=stack(layers["mlp"]["gate"]["kernel"]),
+            w_up=stack(layers["mlp"]["up"]["kernel"]),
+            w_down=stack(layers["mlp"]["down"]["kernel"]),
+        )
     if cfg.q_lora_rank is None:
         stages["wq"] = stack(attn["q"]["kernel"])
     else:
@@ -212,10 +229,73 @@ def test_1f1b_matches_gpipe(setup):
     _assert_grads_close(g_1, g_g)
 
 
-def test_moe_deepseek_rejected_loudly():
-    pipe = PipelineConfig(n_stages=2, n_microbatches=4)
-    moe_cfg = dataclasses.replace(
-        DEEPSEEK_CONFIGS["deepseek_moe_tiny"], n_layers=4
+# ----------------------------------------------------------------------
+# MoE-FFN MLA pipelines (uniform stacks; first_k_dense = 0)
+# ----------------------------------------------------------------------
+
+MOE_CFG = dataclasses.replace(
+    DEEPSEEK_CONFIGS["deepseek_moe_tiny"],
+    dtype=jnp.float32,
+    param_dtype=jnp.float32,
+    n_layers=4,
+)
+
+
+def test_moe_sequential_matches_flax():
+    """_mla_moe_block (routed dispatch + shared expert + scaling) ==
+    the flax DeepseekBlock MoE form, group-limited variant included."""
+    for cfg in (
+        MOE_CFG,
+        dataclasses.replace(MOE_CFG, n_group=2, topk_group=1),
+    ):
+        model = Deepseek(cfg)
+        tokens = jax.random.randint(
+            jax.random.key(4), (2, 13), 0, cfg.vocab_size
+        )
+        fparams = jax.jit(model.init)(
+            jax.random.key(5), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        want = model.apply(
+            {"params": fparams}, tokens, return_aux=False
+        )
+        # ONE routing group of the full batch = the flax grouping.
+        got, _aux = reference_forward(
+            _flax_to_pipeline(fparams, cfg, n_stages=2), tokens, cfg
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=3e-4, rtol=2e-3,
+            err_msg=f"n_group={cfg.n_group}",
+        )
+
+
+def test_moe_pipeline_matches_grouped_oracle(mesh):
+    """pp x fsdp MoE-MLA: schedule == sequential oracle routed with the
+    schedule's (microbatch x data-shard) groups."""
+    pipe = PipelineConfig(n_stages=2, n_microbatches=2)
+    params = init_pipeline_params(jax.random.key(6), MOE_CFG, pipe)
+    params = jax.device_put(
+        params, pipeline_param_shardings(mesh, params)
     )
-    with pytest.raises(NotImplementedError, match="dense FFN only"):
-        init_pipeline_params(jax.random.key(0), moe_cfg, pipe)
+    tokens = jax.random.randint(
+        jax.random.key(7), (16, 17), 0, MOE_CFG.vocab_size
+    )
+    got, aux = jax.jit(
+        lambda p, t: pipeline_forward(p, t, MOE_CFG, pipe, mesh)
+    )(params, tokens)
+    dp = mesh.shape["data"] * mesh.shape["fsdp"]
+    want, ref_aux = reference_forward(
+        params, tokens, MOE_CFG, group_rows=(16 // 2) // dp
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+    )
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-4)
+
+
+def test_moe_mixed_dense_rejected_loudly():
+    pipe = PipelineConfig(n_stages=2, n_microbatches=4)
+    mixed = dataclasses.replace(
+        MOE_CFG, first_k_dense=2, scan_layers=False
+    )
+    with pytest.raises(NotImplementedError, match="UNIFORM"):
+        init_pipeline_params(jax.random.key(0), mixed, pipe)

@@ -32,8 +32,10 @@ The MoE FFN (DeepSeek's fine-grained routed experts + always-on shared
 experts) rides the Mixtral einsum dispatch (tpufw.models.mixtral
 MoEMLP) with the V2 gate conventions: raw softmax top-k mass (no
 renormalization — matching the HF reference's executed behavior) times
-``routed_scaling_factor``. Known gaps, rejected loudly at import:
-group-limited routing (V2-236B) and yarn rope scaling.
+``routed_scaling_factor``, plus group-limited selection (the 236B/Chat
+``topk_method="group_limited_greedy"`` — ``n_group``/``topk_group``)
+and yarn long-context rope scaling. Remaining import rejections:
+non-softmax scoring and sparse ``moe_layer_freq``.
 """
 
 from __future__ import annotations
@@ -114,6 +116,12 @@ class DeepseekConfig:
     routed_scaling_factor: float = 1.0
     # Renormalize top-k gate mass (False = V2 convention: raw softmax).
     norm_topk_prob: bool = False
+    # Group-limited selection (HF topk_method="group_limited_greedy",
+    # the 236B/Chat routing): experts partition into n_group groups,
+    # only the topk_group best groups (by max score) are routable.
+    # n_group=0 disables (plain greedy, the V2-Lite choice).
+    n_group: int = 0
+    topk_group: int = 0
     # GShard capacity discipline for the einsum dispatch; imports
     # default to dropless (n_routed_experts) like Mixtral's.
     capacity_factor: float = 1.25
@@ -537,6 +545,9 @@ class DeepseekMoE(nn.Module):
             cfg,
             d_ff=cfg.moe_d_ff,
             norm_topk=cfg.norm_topk_prob,
+            group_limit=(
+                (cfg.n_group, cfg.topk_group) if cfg.n_group else None
+            ),
             name="routed",
         )(x, valid=valid)
         y = routed * cfg.routed_scaling_factor

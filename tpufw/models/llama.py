@@ -681,7 +681,7 @@ class LlamaBlock(nn.Module):
         return nn.with_logical_constraint(x, ("batch", "act_seq", "act_embed"))
 
 
-def unstack_layer_params(params: dict) -> dict:
+def unstack_layer_params(params: dict, donate: bool = False) -> dict:
     """Scanned-trunk param tree -> the unscanned twin's tree.
 
     ``decoder_lm`` with ``scan_layers=True`` stores the block stack as
@@ -694,14 +694,33 @@ def unstack_layer_params(params: dict) -> dict:
     per-step per-layer weight slicing of the decode scan. Works for
     every decoder_lm family (Llama/Qwen/Mistral/Mixtral/Deepseek and
     Gemma, whose scanned unit is a PAIR). A tree with no "layers" key
-    (already unscanned) is returned unchanged."""
+    (already unscanned) is returned unchanged.
+
+    With ``donate=True`` each stacked leaf is DONATED to its slicing
+    jit, so peak device memory is the weights plus one stacked leaf —
+    not 2x the weights, which would OOM serving startup for any model
+    past half of HBM. Consequence: the input tree's "layers" leaves
+    are INVALID afterwards — only enable when the caller drops the old
+    tree immediately (the serve paths do); the default keeps the input
+    usable."""
     if "layers" not in params:
         return params
-    stacked = params["layers"]
-    n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    leaves, treedef = jax.tree_util.tree_flatten(params["layers"])
+    n = leaves[0].shape[0]
+    # CPU jit can't honor donation; skip it there to avoid warn spam.
+    donate_argnums = (
+        (0,) if donate and jax.default_backend() != "cpu" else ()
+    )
+    split = jax.jit(
+        lambda a: tuple(a[i] for i in range(n)),
+        donate_argnums=donate_argnums,
+    )
+    per_leaf = [split(leaf) for leaf in leaves]
     out = {k: v for k, v in params.items() if k != "layers"}
     for i in range(n):
-        out[f"layer_{i}"] = jax.tree.map(lambda a: a[i], stacked)
+        out[f"layer_{i}"] = jax.tree_util.tree_unflatten(
+            treedef, [pl[i] for pl in per_leaf]
+        )
     return out
 
 

@@ -154,6 +154,9 @@ class MoEMLP(nn.Module):
     cfg: MixtralConfig
     d_ff: Optional[int] = None
     norm_topk: bool = True
+    # (n_group, topk_group): DeepSeek-236B group-limited selection —
+    # passed straight to tpufw.ops.moe.route_topk_capacity.
+    group_limit: Optional[tuple] = None
 
     def _expert_matmul(
         self, name: str, xe: jax.Array, shape: tuple, names: tuple
@@ -242,6 +245,7 @@ class MoEMLP(nn.Module):
             valid=None if valid is None else valid.reshape(g),
             dtype=x.dtype,
             norm_topk=self.norm_topk,
+            group_limit=self.group_limit,
         )
 
         xf = x.reshape(g, d)

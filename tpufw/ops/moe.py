@@ -40,6 +40,7 @@ def route_topk_capacity(
     valid: Optional[jax.Array] = None,
     dtype=jnp.bfloat16,
     norm_topk: bool = True,
+    group_limit: Optional[tuple[int, int]] = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Route G tokens to top-``k`` of E experts under a per-expert
     ``capacity``.
@@ -57,6 +58,15 @@ def route_topk_capacity(
         1 (Mixtral convention). False keeps the RAW softmax mass
         (DeepSeek-V2 ``norm_topk_prob=false`` — combine weights then
         sum to < 1 and the residual stream carries the rest).
+      group_limit: optional ``(n_group, topk_group)`` — DeepSeek-V2
+        236B "group_limited_greedy": experts partition into n_group
+        contiguous groups, the topk_group groups with the highest
+        per-group max score survive, and the top-k selection runs over
+        the survivors only (HF modeling_deepseek_v2 DeepseekV2MoEGate).
+        Aux statistics stay on the UNmasked distribution, matching the
+        reference. Exact float ties between group maxima keep both
+        groups (HF's torch.topk breaks such ties arbitrarily;
+        measure-zero under real routers).
 
     Returns:
       (dispatch [G, E, C], combine [G, E, C], aux_lb, z):
@@ -69,7 +79,30 @@ def route_topk_capacity(
     g, e = router_logits.shape
     probs = jax.nn.softmax(router_logits, axis=-1)  # [G, E]
 
-    topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [G, k]
+    sel_probs = probs
+    if group_limit is not None:
+        n_group, topk_group = group_limit
+        if e % n_group:
+            raise ValueError(
+                f"group_limit: n_group={n_group} must divide E={e}"
+            )
+        per_group = e // n_group
+        if k > topk_group * per_group:
+            raise ValueError(
+                f"group_limit: k={k} exceeds the {topk_group} surviving "
+                f"groups' {topk_group * per_group} experts"
+            )
+        if topk_group < n_group:
+            group_max = probs.reshape(g, n_group, per_group).max(-1)
+            kth = jax.lax.top_k(group_max, topk_group)[0][..., -1:]
+            keep = jnp.repeat(
+                group_max >= kth, per_group, axis=-1
+            )  # [G, E]
+            # Masked-to-0 probs mirror HF's masked_fill(~mask, 0.0):
+            # survivors keep their raw softmax mass as combine weights.
+            sel_probs = jnp.where(keep, probs, 0.0)
+
+    topk_probs, topk_idx = jax.lax.top_k(sel_probs, k)  # [G, k]
     if norm_topk:
         topk_probs = topk_probs / jnp.sum(
             topk_probs, axis=-1, keepdims=True

@@ -118,6 +118,13 @@ def _is_mla(cfg) -> bool:
     return isinstance(cfg, DeepseekConfig)
 
 
+def _returns_aux(cfg) -> bool:
+    """Configs whose forward returns (logits, router aux): Mixtral and
+    MoE-FFN DeepSeek. Every aux-threading branch keys off this ONE
+    predicate so a new MoE family can't half-plumb."""
+    return _is_moe(cfg) or (_is_mla(cfg) and cfg.moe)
+
+
 def _check_model_split(cfg, n_stages: int) -> None:
     """Model-side pipelineability checks, shared by
     ``PipelineConfig.validate`` (trainer path) and
@@ -134,15 +141,15 @@ def _check_model_split(cfg, n_stages: int) -> None:
             f"pipeline schedules implement Llama-family, Gemma, and "
             f"DeepSeek-MLA blocks; got {type(cfg).__name__}"
         )
-    if _is_mla(cfg) and cfg.moe:
-        # The DeepSeek MoE FFN mixes dense and routed layers
-        # (first_k_dense) and adds shared experts — neither fits the
-        # homogeneous per-stage stacks; building it would silently
-        # drop the shared/dense structure.
+    if _is_mla(cfg) and cfg.moe and cfg.first_k_dense > 0:
+        # Uniform MoE stacks pipeline fine (_mla_moe_block); mixing
+        # dense and routed layers per first_k_dense does not fit the
+        # homogeneous per-stage stacks — building it would silently
+        # drop the dense/MoE structure.
         raise NotImplementedError(
-            "pipelined MLA stages implement the dense FFN only; the "
-            "DeepSeek MoE FFN (shared experts, first_k_dense layer "
-            "mixing) uses the flax trainer"
+            "pipelined MLA-MoE stages need UNIFORM layers "
+            f"(first_k_dense == 0, got {cfg.first_k_dense}); mixed "
+            "dense/MoE stacks use the flax trainer"
         )
     if not getattr(cfg, "causal", True):
         # Both schedules hardcode causal attention; silently training
@@ -216,10 +223,35 @@ def init_pipeline_params(
                 h * cfg.v_head_dim,
             ),
             "mlp_norm": jnp.ones((s, lps, d), jnp.float32),
-            "w_gate": w(keys[5], (s, lps, d, f), d),
-            "w_up": w(keys[6], (s, lps, d, f), d),
-            "w_down": w(keys[7], (s, lps, f, d), f),
         }
+        if cfg.moe:
+            # Routed stacks instead of the dense MLP ([E] axis after
+            # the layer axis, like Mixtral); the always-on shared
+            # experts are one fused SwiGLU of n_shared * moe_d_ff.
+            # Built INSTEAD of the dense leaves — materializing dense
+            # [S, lps, d, d_ff] stacks just to delete them would be a
+            # multi-GB transient at real shapes.
+            e, mf = cfg.n_routed_experts, cfg.moe_d_ff
+            mkeys = jax.random.split(keys[5], 7)
+            stages.update(
+                router=w(mkeys[0], (s, lps, d, e), d),
+                w_gate=w(mkeys[1], (s, lps, e, d, mf), d),
+                w_up=w(mkeys[2], (s, lps, e, d, mf), d),
+                w_down=w(mkeys[3], (s, lps, e, mf, d), mf),
+            )
+            if cfg.n_shared_experts:
+                sf = cfg.n_shared_experts * mf
+                stages.update(
+                    w_shared_gate=w(mkeys[4], (s, lps, d, sf), d),
+                    w_shared_up=w(mkeys[5], (s, lps, d, sf), d),
+                    w_shared_down=w(mkeys[6], (s, lps, sf, d), sf),
+                )
+        else:
+            stages.update(
+                w_gate=w(keys[5], (s, lps, d, f), d),
+                w_up=w(keys[6], (s, lps, d, f), d),
+                w_down=w(keys[7], (s, lps, f, d), f),
+            )
         if cfg.q_lora_rank is None:
             stages["wq"] = w(keys[1], (s, lps, d, h, cfg.qk_head_dim), d)
         else:
@@ -353,6 +385,10 @@ _TENSOR_LEAF_AXIS = {
     # an RMSNorm on a partial axis.
     "wq_b": -2,                    # [..., qr, H, qk] -> head axis
     "wkv_b": -2,                   # [..., kvr, H, dn+dv] -> head axis
+    # DeepSeek shared experts: one fused SwiGLU, Megatron-split like
+    # the dense MLP.
+    "w_shared_gate": -1, "w_shared_up": -1,
+    "w_shared_down": -2,
 }
 
 #: Mixtral expert stacks are rank 5 ([S, lps, E, in, out]); their [E]
@@ -485,17 +521,18 @@ def _block(
     return x
 
 
-def _mla_block(
+def _mla_attn_sublayer(
     p: dict, x: jax.Array, cfg, backend: str, seg=None,
     tp: bool = False, tp_ops=None,
 ):
-    """One DeepSeek-MLA decoder block (dense FFN), numerically the
-    tpufw.models.deepseek.DeepseekBlock expanded/training form. Under
-    ``tp`` the head axes of wq/wq_b/wkv_b/wo are LOCAL shards; the
-    latent projections (wq_a, wkv_a) run replicated on every rank —
+    """MLA attention + residual, numerically the
+    tpufw.models.deepseek.MLAttention expanded/training form — shared
+    by the dense (``_mla_block``) and MoE (``_mla_moe_block``) layouts.
+    Under ``tp`` the head axes of wq/wq_b/wkv_b/wo are LOCAL shards;
+    the latent projections (wq_a, wkv_a) run replicated on every rank —
     their outputs are identical across ``tensor``, so the decoupled
     rope key and both latent RMSNorms agree globally, and the only
-    collectives are the block's two standard combines."""
+    collective is the output projection's combine."""
     from tpufw.models.deepseek import apply_rope_interleaved
 
     enter, combine = tp_ops or (
@@ -558,10 +595,22 @@ def _mla_block(
     )
     if backend in ("flash", "ring"):
         att = att[..., :dv]
-    x = x + combine(
+    return x + combine(
         jnp.einsum("bthd,hdD->btD", att, p["wo"].astype(dt))
     )
 
+
+def _mla_block(
+    p: dict, x: jax.Array, cfg, backend: str, seg=None,
+    tp: bool = False, tp_ops=None,
+):
+    """One dense-FFN DeepSeek-MLA decoder block: the shared MLA
+    attention sublayer + the standard SwiGLU MLP."""
+    enter, combine = tp_ops or (
+        (lambda h: h), (lambda y: _tp_psum(y, tp))
+    )
+    dt = cfg.dtype
+    x = _mla_attn_sublayer(p, x, cfg, backend, seg, tp, tp_ops)
     hm = enter(rms_norm(x, p["mlp_norm"], cfg.rms_eps))
     g = jnp.einsum("btd,df->btf", hm, p["w_gate"].astype(dt))
     u = jnp.einsum("btd,df->btf", hm, p["w_up"].astype(dt))
@@ -570,6 +619,38 @@ def _mla_block(
             "btf,fd->btd", jax.nn.silu(g) * u, p["w_down"].astype(dt)
         )
     )
+
+
+def _mla_moe_block(
+    p: dict, x: jax.Array, cfg, backend: str, seg=None,
+    tp: bool = False, ep: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """One MoE-FFN DeepSeek-MLA decoder block (uniform stacks,
+    first_k_dense == 0): the shared MLA attention sublayer + the
+    DeepSeek MoE FFN — routed experts through the SAME ``_moe_mlp``
+    dispatch algebra as pipelined Mixtral (V2 gate conventions: raw
+    softmax mass, optional group-limited selection,
+    routed_scaling_factor) plus the always-on shared-expert SwiGLU.
+    Returns (x, router aux loss)."""
+    x = _mla_attn_sublayer(p, x, cfg, backend, seg, tp)
+    dt = cfg.dtype
+    h = rms_norm(x, p["mlp_norm"], cfg.rms_eps)
+    y, aux = _moe_mlp(
+        p, h, cfg, None if seg is None else seg > 0, tp, ep
+    )
+    y = y * cfg.routed_scaling_factor
+    if "w_shared_gate" in p:
+        g = jnp.einsum("btd,df->btf", h, p["w_shared_gate"].astype(dt))
+        u = jnp.einsum("btd,df->btf", h, p["w_shared_up"].astype(dt))
+        y = y + _tp_psum(
+            jnp.einsum(
+                "btf,fd->btd",
+                jax.nn.silu(g) * u,
+                p["w_shared_down"].astype(dt),
+            ),
+            tp,
+        )
+    return x + y, aux
 
 
 def _moe_mlp(
@@ -607,6 +688,15 @@ def _moe_mlp(
         logits, k, capacity,
         valid=None if valid is None else valid.reshape(g),
         dtype=cfg.dtype,
+        # Mixtral renormalizes top-k mass; DeepSeek keeps the raw
+        # softmax mass and may group-limit selection — both read off
+        # the config so the flax and pipelined paths can't drift.
+        norm_topk=getattr(cfg, "norm_topk_prob", True),
+        group_limit=(
+            (cfg.n_group, cfg.topk_group)
+            if getattr(cfg, "n_group", 0)
+            else None
+        ),
     )
 
     if ep:
@@ -709,11 +799,12 @@ def _stage(
         )
         return out, jnp.zeros((), jnp.float32)
 
-    if _is_moe(cfg):
+    if _returns_aux(cfg):
+        moe_blk = _mla_moe_block if _is_mla(cfg) else _mixtral_block
 
         def moe_body(carry, layer_p):
             h, aux = carry
-            h, a = _mixtral_block(layer_p, h, cfg, backend, seg, tp, ep)
+            h, a = moe_blk(layer_p, h, cfg, backend, seg, tp, ep)
             return (h, aux + a.astype(jnp.float32)), None
 
         (out, aux), _ = jax.lax.scan(
@@ -828,7 +919,7 @@ def pipeline_forward(
     ``segment_ids`` [B, T] masks cross-document attention for packed
     batches; ids ride the ring with their microbatch's activations.
     """
-    is_moe = _is_moe(cfg)
+    is_moe = _returns_aux(cfg)
     if mesh.shape["sequence"] != 1:
         raise NotImplementedError(
             "pipeline composes with data/fsdp/tensor/expert only for "
@@ -848,10 +939,22 @@ def pipeline_forward(
             )
     tp = mesh.shape[AXIS_TENSOR]
     if tp > 1:
-        # Megatron split: heads over q/k/v/o, d_ff over gate/up/down.
-        # Uneven splits would silently mis-shard the stacked weights.
-        # MLA has no kv heads (one shared latent, replicated kernels).
-        checks = [("n_heads", cfg.n_heads), ("d_ff", cfg.d_ff)]
+        # Megatron split: heads over q/k/v/o, ffn width over
+        # gate/up/down. Uneven splits would silently mis-shard the
+        # stacked weights. MLA has no kv heads (one shared latent,
+        # replicated kernels); MLA-MoE shards moe_d_ff (routed stacks)
+        # and the shared-expert width, never the dense d_ff (those
+        # leaves don't exist in its stacks).
+        checks = [("n_heads", cfg.n_heads)]
+        if _is_mla(cfg) and cfg.moe:
+            checks.append(("moe_d_ff", cfg.moe_d_ff))
+            if cfg.n_shared_experts:
+                checks.append((
+                    "n_shared_experts*moe_d_ff",
+                    cfg.n_shared_experts * cfg.moe_d_ff,
+                ))
+        else:
+            checks.append(("d_ff", cfg.d_ff))
         if not _is_mla(cfg):
             checks.append(("n_kv_heads", cfg.n_kv_heads))
         for fname, v in checks:
@@ -999,15 +1102,16 @@ def reference_forward(
         None if segment_ids is None else segment_ids.astype(jnp.int32)
     )
 
-    if _is_moe(cfg):
+    if _returns_aux(cfg):
         gr = group_rows or b
         if b % gr:
             raise ValueError(f"batch {b} not divisible by group_rows {gr}")
+        moe_blk = _mla_moe_block if _is_mla(cfg) else _mixtral_block
 
         def run_group(xg, sg):
             def body(carry, layer_p):
                 h, aux = carry
-                h, a = _mixtral_block(layer_p, h, cfg, backend, sg)
+                h, a = moe_blk(layer_p, h, cfg, backend, sg)
                 return (h, aux + a.astype(jnp.float32)), None
 
             (h, aux), _ = jax.lax.scan(
@@ -1087,7 +1191,7 @@ def pipeline_eval(
             params, inputs, cfg, pipe, mesh, segment_ids=seg_in,
             return_hidden=True,
         )
-        if _is_moe(cfg):
+        if _returns_aux(cfg):
             hidden, aux = hidden
         loss, n = chunked_cross_entropy(
             hidden, _head_kernel(params), targets, mask,
@@ -1099,7 +1203,7 @@ def pipeline_eval(
     logits = pipeline_forward(
         params, inputs, cfg, pipe, mesh, segment_ids=seg_in
     )
-    if _is_moe(cfg):
+    if _returns_aux(cfg):
         logits, aux = logits
     loss, n = cross_entropy_loss(logits, targets, mask)
     return {"loss": loss + aux, "n_tokens": n}

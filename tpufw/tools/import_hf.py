@@ -165,12 +165,12 @@ def config_from_hf(hf_config: Any) -> LlamaConfig:
 def _deepseek_config_from_hf(get):
     """tpufw DeepseekConfig from a transformers DeepseekV2Config.
 
-    Routed experts (DeepSeek MoE FFN) and yarn rope scaling import
-    directly. Rejects, loudly, what tpufw's MLA blocks don't implement:
-    group-limited routing (n_group/topk_group via non-greedy
-    topk_method), non-softmax scoring, sparse moe_layer_freq, and
-    attention bias — importing them would produce silently wrong
-    logits."""
+    Routed experts (DeepSeek MoE FFN), group-limited selection
+    (topk_method="group_limited_greedy", n_group/topk_group), and yarn
+    rope scaling import directly. Rejects, loudly, what tpufw's MLA
+    blocks don't implement: other topk_methods, non-softmax scoring,
+    sparse moe_layer_freq, and attention bias — importing them would
+    produce silently wrong logits."""
     from tpufw.models.deepseek import DeepseekConfig
 
     bad = {}
@@ -180,11 +180,32 @@ def _deepseek_config_from_hf(get):
     # checkpoints set it past the last layer.
     first_moe = get("first_k_dense_replace") or 0
     has_moe = bool(get("n_routed_experts")) and first_moe < n_layers
+    group_kwargs = {}
     if has_moe:
-        # V2-Lite routes plain greedy-softmax; the 236B model's
-        # group-limited routing (n_group/topk_group) is not implemented.
-        if (get("topk_method") or "greedy") != "greedy":
-            bad["topk_method"] = get("topk_method")
+        # V2-Lite routes plain greedy-softmax; the 236B/Chat models'
+        # group-limited selection imports via n_group/topk_group.
+        topk_method = get("topk_method") or "greedy"
+        if topk_method == "group_limited_greedy":
+            # Validate at the IMPORT boundary like every other gap —
+            # a malformed group spec must not surface as a ValueError
+            # deep inside the first jit trace.
+            ng, tg = get("n_group"), get("topk_group")
+            e, k = get("n_routed_experts"), get("num_experts_per_tok")
+            ok = (
+                ng and tg and e % ng == 0
+                and (tg >= ng or k <= tg * (e // ng))
+            )
+            if ok:
+                group_kwargs = dict(n_group=int(ng), topk_group=int(tg))
+            else:
+                bad["group_limited_greedy"] = {
+                    "n_group": ng,
+                    "topk_group": tg,
+                    "n_routed_experts": e,
+                    "num_experts_per_tok": k,
+                }
+        elif topk_method != "greedy":
+            bad["topk_method"] = topk_method
         if (get("scoring_func") or "softmax") != "softmax":
             bad["scoring_func"] = get("scoring_func")
         if (get("moe_layer_freq") or 1) != 1:
@@ -229,9 +250,10 @@ def _deepseek_config_from_hf(get):
     if bad:
         raise NotImplementedError(
             f"DeepseekV2 import: unsupported features {bad}; tpufw's "
-            "MLA family implements greedy-softmax MoE and default+yarn "
-            "rope (group-limited routing, non-softmax scoring, sparse "
-            "moe_layer_freq, and attention bias are the known gaps)"
+            "MLA family implements greedy and group-limited-greedy "
+            "softmax MoE and default+yarn rope (non-softmax scoring, "
+            "sparse moe_layer_freq, and attention bias are the known "
+            "gaps)"
         )
     moe_kwargs = {}
     if has_moe:
@@ -255,6 +277,7 @@ def _deepseek_config_from_hf(get):
             capacity_factor=float(get("n_routed_experts")),
             # Mixed dense/MoE stacks can't scan (homogeneity).
             scan_layers=first_moe == 0,
+            **group_kwargs,
         )
     return DeepseekConfig(
         vocab_size=get("vocab_size"),
@@ -749,9 +772,17 @@ def hf_config_dict(cfg: LlamaConfig) -> dict:
                 n_shared_experts=cfg.n_shared_experts or None,
                 routed_scaling_factor=cfg.routed_scaling_factor,
                 norm_topk_prob=False,
-                topk_method="greedy",
                 scoring_func="softmax",
                 moe_layer_freq=1,
+                **(
+                    {
+                        "topk_method": "group_limited_greedy",
+                        "n_group": cfg.n_group,
+                        "topk_group": cfg.topk_group,
+                    }
+                    if cfg.n_group
+                    else {"topk_method": "greedy"}
+                ),
             )
         ys = getattr(cfg, "rope_scaling", None)
         if ys is not None:

@@ -167,7 +167,10 @@ def _maybe_unroll(model_cfg, params):
 
     return (
         _dc.replace(model_cfg, scan_layers=False),
-        unstack_layer_params(params),
+        # donate: every caller rebinds params immediately, and the
+        # donation bounds startup peak memory at weights + one stacked
+        # leaf instead of 2x weights.
+        unstack_layer_params(params, donate=True),
     )
 
 
