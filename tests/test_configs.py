@@ -174,12 +174,21 @@ def test_rope_scaling_override_coerced(tmp_path):
         factor=4.0, original_max_position_embeddings=64
     )
 
+    # rope_type is a real field now (linear scaling); it passes through.
+    lin = tmp_path / "l.yaml"
+    lin.write_text(
+        "model: {preset: llama3_tiny, "
+        "overrides: {rope_scaling: {rope_type: linear, factor: 4.0}}}\n"
+    )
+    run = load_run_config(lin)
+    assert run.model_cfg.rope_scaling.rope_type == "linear"
+
     bad = tmp_path / "b.yaml"
     bad.write_text(
         "model: {preset: llama3_tiny, "
-        "overrides: {rope_scaling: {rope_type: llama3}}}\n"
+        "overrides: {rope_scaling: {bogus_knob: 1}}}\n"
     )
-    with pytest.raises(ValueError, match="unknown keys.*rope_type"):
+    with pytest.raises(ValueError, match="unknown keys.*bogus_knob"):
         load_run_config(bad)
 
 

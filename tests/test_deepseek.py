@@ -42,18 +42,21 @@ def test_latent_cache_is_smaller_than_mha():
 
 
 def test_unplumbed_backend_rejected():
-    """xla/flash/ring are the MLA backends; ulysses SP is not plumbed
-    and must fail loudly."""
-    cfg = dataclasses.replace(TINY, attention_backend="ulysses")
-    with pytest.raises(NotImplementedError, match="ulysses"):
+    """xla/flash/ring/ulysses are the MLA backends; anything else must
+    fail loudly."""
+    cfg = dataclasses.replace(TINY, attention_backend="splash")
+    with pytest.raises(NotImplementedError, match="splash"):
         Deepseek(cfg).init(
             jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
         )
 
 
-def test_ring_backend_matches_xla_on_sequence_mesh():
-    """MLA ring SP over sequence=2: logits match the single-chunk xla
-    reference (the long-context path for the latent family)."""
+@pytest.mark.parametrize("backend", ["ring", "ulysses"])
+def test_sp_backends_match_xla_on_sequence_mesh(backend):
+    """MLA sequence parallelism over sequence=2 — ring (neighbor
+    exchange) and ulysses (head/sequence all-to-all, exchanging the
+    PADDED v like flash) both match the single-chunk xla reference
+    (the long-context paths for the latent family)."""
     from tpufw.mesh import MeshConfig, build_mesh
     from tpufw.parallel.context import use_mesh
 
@@ -68,7 +71,7 @@ def test_ring_backend_matches_xla_on_sequence_mesh():
     mesh = build_mesh(MeshConfig(fsdp=-1, sequence=2))
     with use_mesh(mesh):
         got = Deepseek(
-            dataclasses.replace(cfg, attention_backend="ring")
+            dataclasses.replace(cfg, attention_backend=backend)
         ).apply(params, tokens)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), atol=2e-4, rtol=2e-4

@@ -96,6 +96,21 @@ def _flax_to_pipeline(flax_params: dict, cfg, n_stages: int) -> dict:
     }
 
 
+@pytest.fixture(autouse=True)
+def _clear_jax_caches_per_test():
+    """This module compiles more distinct multi-mesh programs than any
+    other (9 tests x pipeline+oracle+grads, three mesh shapes); in a
+    long suite run the accumulated native state lands exactly here as
+    a fatal abort (observed twice at test_1f1b_matches_gpipe). Per-TEST
+    cache drops bound it — the conftest's per-module drop is not
+    enough for this file."""
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
+
+
 def _ref_loss(p, t):
     from tpufw.train.trainer import cross_entropy_loss
 

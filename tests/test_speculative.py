@@ -228,6 +228,33 @@ def test_stochastic_unrelated_draft_matches_target_distribution(
     assert tvd < 0.25, f"TVD {tvd}"
 
 
+def test_chunked_prefill_matches_oneshot(target, draft):
+    """The long-prompt lever composes with speculation: chunked prefill
+    writes the identical caches, so outputs are token-for-token equal
+    to the one-shot prefill — greedy AND stochastic."""
+    long_prompts = [list(range(1, 30)), [7] * 11]
+    base, _ = speculative_generate_text(
+        draft[0], draft[1], target[0], target[1], long_prompts,
+        max_new_tokens=8, k=3,
+    )
+    chunked, _ = speculative_generate_text(
+        draft[0], draft[1], target[0], target[1], long_prompts,
+        max_new_tokens=8, k=3, prefill_chunk_size=8,
+    )
+    assert chunked == base
+    cfg = SamplingConfig(temperature=0.8, top_k=12)
+    s_base, _ = speculative_generate_text(
+        draft[0], draft[1], target[0], target[1], long_prompts,
+        max_new_tokens=8, k=3, sampling=cfg, seed=5,
+    )
+    s_chunked, _ = speculative_generate_text(
+        draft[0], draft[1], target[0], target[1], long_prompts,
+        max_new_tokens=8, k=3, sampling=cfg, seed=5,
+        prefill_chunk_size=8,
+    )
+    assert s_chunked == s_base
+
+
 def test_stochastic_requires_rng(target):
     from tpufw.infer.speculative import speculative_generate
 

@@ -66,6 +66,64 @@ def pad_prompts(
     return out, pads
 
 
+def prefill_cache(
+    apply,
+    prompt_tokens: jax.Array,
+    positions: jax.Array,
+    seg: jax.Array,
+    prefill_chunk_size: Optional[int],
+):
+    """Prefill: the whole (padded) prompt through the cache — one pass,
+    or fixed-size chunks under ``prefill_chunk_size`` (the cache cursor
+    advances per chunk; slot-ordered causality makes chunked and
+    one-shot prefill write identical caches). Left-padding makes the
+    last column the final real token of every row either way.
+    ``apply(cache, tokens, positions, seg) -> (logits, cache)``; ONE
+    copy shared by ``generate`` and ``speculative_generate`` so the
+    long-prompt lever can't drift between plain and speculative
+    serving. Full chunks run under ONE ``lax.scan`` program (O(1)
+    trace cost regardless of prompt length); an indivisible tail adds
+    at most one remainder program."""
+    b, p = prompt_tokens.shape
+    if not (prefill_chunk_size is not None and 1 <= prefill_chunk_size < p):
+        return apply({}, prompt_tokens, positions, seg)
+    c = prefill_chunk_size
+    n_full = p // c
+    # Chunk 0 outside the scan: its apply CREATES the cache
+    # variables the scan then carries.
+    logits, cache = apply(
+        {}, prompt_tokens[:, :c], positions[:, :c], seg[:, :c]
+    )
+
+    def mid(a, n):  # [B, (n)*c] -> [n, B, c]
+        return a[:, c: (n + 1) * c].reshape(b, n, c).swapaxes(0, 1)
+
+    if n_full > 1:
+        def chunk_step(carry, xs):
+            cache, _ = carry
+            tok_c, pos_c, seg_c = xs
+            lg, cache = apply(cache, tok_c, pos_c, seg_c)
+            return (cache, lg), None
+
+        # Logits ride the CARRY (each chunk overwrites), so the
+        # scan never stacks a [n_chunks, B, c, V] output.
+        (cache, logits), _ = jax.lax.scan(
+            chunk_step,
+            (cache, logits),
+            (
+                mid(prompt_tokens, n_full - 1),
+                mid(positions, n_full - 1),
+                mid(seg, n_full - 1),
+            ),
+        )
+    if p % c:
+        s = n_full * c
+        logits, cache = apply(
+            cache, prompt_tokens[:, s:], positions[:, s:], seg[:, s:]
+        )
+    return logits, cache
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -133,54 +191,9 @@ def generate(
         logits = out[0] if isinstance(out, tuple) else out  # MoE aux dropped
         return logits, {"cache": vars_["cache"]}
 
-    # Prefill: the whole (padded) prompt through the cache — one pass,
-    # or fixed-size chunks under ``prefill_chunk_size`` (the cache
-    # cursor advances per chunk; slot-ordered causality makes chunked
-    # and one-shot prefill write identical caches). Left-padding makes
-    # the last column the final real token of every row either way.
-    if prefill_chunk_size is not None and 1 <= prefill_chunk_size < p:
-        c = prefill_chunk_size
-        n_full = p // c
-        # Chunk 0 outside the scan: its apply CREATES the cache
-        # variables the scan then carries.
-        logits, cache = apply(
-            {}, prompt_tokens[:, :c], positions[:, :c], seg[:, :c]
-        )
-
-        def mid(a, n):  # [B, (n)*c] -> [n, B, c]
-            return (
-                a[:, c: (n + 1) * c]
-                .reshape(b, n, c)
-                .swapaxes(0, 1)
-            )
-
-        if n_full > 1:
-            def chunk_step(carry, xs):
-                cache, _ = carry
-                tok_c, pos_c, seg_c = xs
-                lg, cache = apply(cache, tok_c, pos_c, seg_c)
-                return (cache, lg), None
-
-            # Logits ride the CARRY (each chunk overwrites), so the
-            # scan never stacks a [n_chunks, B, c, V] output.
-            (cache, logits), _ = jax.lax.scan(
-                chunk_step,
-                (cache, logits),
-                (
-                    mid(prompt_tokens, n_full - 1),
-                    mid(positions, n_full - 1),
-                    mid(seg, n_full - 1),
-                ),
-            )
-        if p % c:
-            s = n_full * c
-            logits, cache = apply(
-                cache, prompt_tokens[:, s:], positions[:, s:], seg[:, s:]
-            )
-    else:
-        logits, cache = apply(
-            {}, prompt_tokens, positions, seg
-        )
+    logits, cache = prefill_cache(
+        apply, prompt_tokens, positions, seg, prefill_chunk_size
+    )
     # Repetition penalty needs a [B, V] presence mask of every token the
     # model has seen (prompt + generated). Built only when enabled — it
     # costs B*V bools in the scan carry.

@@ -59,7 +59,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpufw.infer.generate import pad_prompts
+from tpufw.infer.generate import pad_prompts, prefill_cache
 from tpufw.infer.sampling import SamplingConfig, sample_token, transform_logits
 
 
@@ -95,7 +95,7 @@ def _cursor(cache: dict) -> jax.Array:
     jax.jit,
     static_argnames=(
         "draft_model", "model", "k", "max_new_tokens", "pad_id", "eos_id",
-        "sampling",
+        "sampling", "prefill_chunk_size",
     ),
 )
 def speculative_generate(
@@ -113,6 +113,7 @@ def speculative_generate(
     live_rows: Optional[jax.Array] = None,
     sampling: SamplingConfig = SamplingConfig(),
     rng: Optional[jax.Array] = None,
+    prefill_chunk_size: Optional[int] = None,
 ) -> tuple[jax.Array, dict]:
     """Decode ``model`` with ``draft_model`` speculation.
 
@@ -181,10 +182,15 @@ def speculative_generate(
         logits = out[0] if isinstance(out, tuple) else out
         return logits, {"cache": vars_["cache"]}
 
-    # Prefill both models over the (padded) prompt.
-    t_logits, t_cache = apply(model, params, {}, prompt_tokens, positions, seg)
-    _, d_cache = apply(
-        draft_model, draft_params, {}, prompt_tokens, positions, seg
+    # Prefill both models over the (padded) prompt — chunked under
+    # prefill_chunk_size (the long-prompt lever, shared with generate).
+    t_logits, t_cache = prefill_cache(
+        partial(apply, model, params), prompt_tokens, positions, seg,
+        prefill_chunk_size,
+    )
+    _, d_cache = prefill_cache(
+        partial(apply, draft_model, draft_params), prompt_tokens,
+        positions, seg, prefill_chunk_size,
     )
     all_keys = None
     if stochastic:
@@ -431,6 +437,7 @@ def speculative_generate_text(
     sampling: SamplingConfig = SamplingConfig(),
     seed: int = 0,
     rng: Optional[jax.Array] = None,
+    prefill_chunk_size: Optional[int] = None,
 ) -> tuple[list[list[int]], dict]:
     """Ragged-python convenience wrapper (mirrors ``generate_text``,
     including its ``seed`` knob; an explicit ``rng`` wins over seed).
@@ -454,6 +461,7 @@ def speculative_generate_text(
         ),
         sampling=sampling,
         rng=rng,
+        prefill_chunk_size=prefill_chunk_size,
     )
     result = []
     for row in np.asarray(out):

@@ -34,8 +34,9 @@ MoEMLP) with the V2 gate conventions: raw softmax top-k mass (no
 renormalization — matching the HF reference's executed behavior) times
 ``routed_scaling_factor``, plus group-limited selection (the 236B/Chat
 ``topk_method="group_limited_greedy"`` — ``n_group``/``topk_group``)
-and yarn long-context rope scaling. Remaining import rejections:
-non-softmax scoring and sparse ``moe_layer_freq``.
+and yarn long-context rope scaling. Remaining import rejections
+(tools/import_hf.py): other topk_methods (e.g. V3's noaux_tc),
+non-softmax scoring, sparse ``moe_layer_freq``, and attention bias.
 """
 
 from __future__ import annotations
@@ -85,11 +86,12 @@ class DeepseekConfig:
     dtype: Dtype = jnp.bfloat16
     param_dtype: Dtype = jnp.float32
     # "xla" (einsum, the correctness reference), "flash" (Pallas
-    # kernel), or "ring" (sequence-parallel over the `sequence` mesh
-    # axis): MLA's v head dim is smaller than qk's, so flash/ring
-    # zero-pad v up to qk_head_dim and slice the output back — exact
-    # (padded value columns contribute zeros) at ~dv/qk_dim extra v
-    # memory. Ulysses SP is not plumbed for MLA.
+    # kernel), "ring" (sequence-parallel neighbor exchange), or
+    # "ulysses" (head/sequence all-to-all) over the `sequence` mesh
+    # axis: MLA's v head dim is smaller than qk's, so the non-xla
+    # backends zero-pad v up to qk_head_dim and slice the output back
+    # — exact (padded value columns contribute zeros) at ~dv/qk_dim
+    # extra v memory.
     attention_backend: str = "xla"
     remat: bool = True
     remat_policy: str = "dots"
@@ -425,10 +427,13 @@ class MLAttention(nn.Module):
                     q, k, v, causal=True, segment_ids=segment_ids,
                     backend="xla",
                 )
-            elif cfg.attention_backend in ("flash", "ring"):
+            elif cfg.attention_backend in ("flash", "ring", "ulysses"):
                 # Zero-pad v to the qk head dim: softmax(QK^T) @ [v|0]
                 # = [out|0], so slicing recovers the exact result; the
-                # kernels then see ONE head dim everywhere. Dispatch
+                # kernels then see ONE head dim everywhere (ulysses
+                # additionally all-to-alls the padded head axis — the
+                # decoupled-rope key is already broadcast per head, so
+                # the exchange sees plain [B,T,H,D] tensors). Dispatch
                 # through the shared entry point (ops.attention) so
                 # backend plumbing can't drift per-model.
                 v_pad = jnp.pad(
@@ -440,9 +445,8 @@ class MLAttention(nn.Module):
                 )[..., :dv]
             else:
                 raise NotImplementedError(
-                    "MLA attention backends: 'xla', 'flash', or 'ring' "
-                    f"(ulysses not plumbed); got "
-                    f"{cfg.attention_backend!r}"
+                    "MLA attention backends: 'xla', 'flash', 'ring', "
+                    f"or 'ulysses'; got {cfg.attention_backend!r}"
                 )
         return projection(
             cfg, out, cfg.d_model, (-2, -1),

@@ -696,26 +696,29 @@ def unstack_layer_params(params: dict, donate: bool = False) -> dict:
     Gemma, whose scanned unit is a PAIR). A tree with no "layers" key
     (already unscanned) is returned unchanged.
 
-    With ``donate=True`` each stacked leaf is DONATED to its slicing
-    jit, so peak device memory is the weights plus one stacked leaf —
-    not 2x the weights, which would OOM serving startup for any model
-    past half of HBM. Consequence: the input tree's "layers" leaves
-    are INVALID afterwards — only enable when the caller drops the old
-    tree immediately (the serve paths do); the default keeps the input
-    usable."""
+    With ``donate=True`` each stacked leaf is explicitly DELETED once
+    its per-layer slices exist, so peak device memory is the weights
+    plus one stacked leaf — not 2x the weights, which would OOM
+    serving startup for any model past half of HBM. (Explicit delete,
+    not jit donation: the stacked buffer can never alias the smaller
+    tuple-of-slices outputs, so donation would just warn and free —
+    this frees without the warning, on every backend.) Consequence:
+    the input tree's "layers" leaves are INVALID afterwards — only
+    enable when the caller drops the old tree immediately (the serve
+    paths do); the default keeps the input usable."""
     if "layers" not in params:
         return params
     leaves, treedef = jax.tree_util.tree_flatten(params["layers"])
     n = leaves[0].shape[0]
-    # CPU jit can't honor donation; skip it there to avoid warn spam.
-    donate_argnums = (
-        (0,) if donate and jax.default_backend() != "cpu" else ()
-    )
-    split = jax.jit(
-        lambda a: tuple(a[i] for i in range(n)),
-        donate_argnums=donate_argnums,
-    )
-    per_leaf = [split(leaf) for leaf in leaves]
+    split = jax.jit(lambda a: tuple(a[i] for i in range(n)))
+    per_leaf = []
+    for leaf in leaves:
+        out = split(leaf)
+        if donate and isinstance(leaf, jax.Array):
+            # The slices must exist on device before the source dies.
+            jax.block_until_ready(out)
+            leaf.delete()
+        per_leaf.append(out)
     out = {k: v for k, v in params.items() if k != "layers"}
     for i in range(n):
         out[f"layer_{i}"] = jax.tree_util.tree_unflatten(

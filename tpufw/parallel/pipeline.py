@@ -947,12 +947,9 @@ def pipeline_forward(
         # leaves don't exist in its stacks).
         checks = [("n_heads", cfg.n_heads)]
         if _is_mla(cfg) and cfg.moe:
+            # moe_d_ff % tp also covers the shared-expert width
+            # (n_shared * moe_d_ff) — no separate check needed.
             checks.append(("moe_d_ff", cfg.moe_d_ff))
-            if cfg.n_shared_experts:
-                checks.append((
-                    "n_shared_experts*moe_d_ff",
-                    cfg.n_shared_experts * cfg.moe_d_ff,
-                ))
         else:
             checks.append(("d_ff", cfg.d_ff))
         if not _is_mla(cfg):

@@ -386,6 +386,7 @@ def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
             k=k,
             live_rows=[i < real_n for i in range(len(padded))],
             sampling=sampling,
+            prefill_chunk_size=env_int("prefill_chunk", 0) or None,
         )
         outs = outs[:real_n]
     else:
@@ -625,6 +626,7 @@ class _Server:
                 # sliced off below anyway.
                 live_rows=[i < real_n for i in range(len(padded))],
                 sampling=self._sampling,
+                prefill_chunk_size=env_int("prefill_chunk", 0) or None,
             )
             return outs[:real_n]
         outs = self._generate_text(
