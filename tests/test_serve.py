@@ -250,6 +250,29 @@ def test_http_server_continuous_batching(tiny_env, monkeypatch):
     # 0.1s coalescing window is included; margin keeps CI honest but
     # not flaky.
     assert t_conc < t_seq * 0.9 + 0.2, (t_conc, t_seq)
+
+    # Prometheus /metrics (the serving analog of the device plugin's
+    # endpoint): counters reflect the traffic this test just drove.
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    metrics = {
+        ln.split()[0]: float(ln.split()[1])
+        for ln in text.splitlines()
+        if ln and not ln.startswith("#")
+    }
+    # 2 warmups + 4 sequential + 4 concurrent = 10 requests, 0 errors —
+    # and the zero-valued error counter is PRESENT (pre-initialized),
+    # so absent-series alerts can't misfire.
+    assert metrics["tpufw_serve_requests_total"] == 10
+    assert metrics["tpufw_serve_request_errors_total"] == 0
+    # Coalescing means fewer ticks than requests; every request's rows
+    # were served.
+    assert metrics["tpufw_serve_ticks_total"] < 10
+    assert metrics["tpufw_serve_tick_rows_total"] >= 10
+    assert metrics["tpufw_serve_tokens_generated_total"] > 0
+    assert metrics["tpufw_serve_request_seconds_total"] > 0
+    assert "tpufw_serve_queue_depth" in metrics
     srv.httpd.shutdown()
 
 

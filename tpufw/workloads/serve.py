@@ -10,8 +10,11 @@ lax.scan loop, tpufw.infer.generate), and either
   JSON line per prompt — `kubectl logs` is the result channel, the
   reference's verification pattern (reference README.md:331-335);
 - server mode (TPUFW_SERVE_PORT > 0): a stdlib ThreadingHTTPServer with
-  POST /generate {"prompts": [[ids]], "max_new_tokens": N} -> outputs and
-  GET /healthz. Prompt lengths are bucketed (multiples of 64) and batch
+  POST /generate {"prompts": [[ids]], "max_new_tokens": N} -> outputs,
+  GET /healthz, and GET /metrics (Prometheus text exposition: request/
+  error/tick/token counters + queue-depth gauge, the serving analog of
+  the device plugin's endpoint). Prompt lengths are bucketed (multiples
+  of 64) and batch
   rows padded to a power of two so repeat traffic reuses compiled programs
   instead of recompiling per ragged shape — the static-shape discipline
   XLA serving needs.
@@ -427,6 +430,53 @@ class _Pending:
         self.batched_with = 1
 
 
+class _Metrics:
+    """Thread-safe Prometheus counters for the serving loop — the
+    serving analog of the device plugin's /metrics endpoint
+    (deviceplugin/shim exposes the same text exposition format), no
+    client library needed. Counters only; point-in-time gauges are
+    rendered by the caller at scrape time."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # Pre-initialized to 0 (client-library convention): an alert on
+        # increase(...errors_total) must see a real 0-valued series
+        # before the first error, not an absent one.
+        self._c: dict[str, float] = {
+            name: 0.0
+            for name in (
+                "requests_total",
+                "request_errors_total",
+                "request_seconds_total",
+                "ticks_total",
+                "tick_rows_total",
+                "tokens_generated_total",
+            )
+        }
+
+    def inc(self, name: str, v: float = 1.0) -> None:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0.0) + v
+
+    @staticmethod
+    def _fmt(v: float) -> str:
+        # repr, not %g: %g rounds to 6 significant digits, which stalls
+        # large counters (rate() then reads 0 until a 10-unit jump).
+        return str(int(v)) if v == int(v) else repr(v)
+
+    def render(self, gauges: dict[str, float]) -> str:
+        with self._lock:
+            counters = dict(self._c)
+        lines = []
+        for name in sorted(counters):
+            lines.append(f"# TYPE tpufw_serve_{name} counter")
+            lines.append(f"tpufw_serve_{name} {self._fmt(counters[name])}")
+        for name in sorted(gauges):
+            lines.append(f"# TYPE tpufw_serve_{name} gauge")
+            lines.append(f"tpufw_serve_{name} {self._fmt(gauges[name])}")
+        return "\n".join(lines) + "\n"
+
+
 class _Batcher:
     """Continuous batching at request granularity (VERDICT r2 #7).
 
@@ -442,14 +492,20 @@ class _Batcher:
     (default 64) caps rows per tick, the rest stay queued.
     """
 
-    def __init__(self, run_tick):
+    def __init__(self, run_tick, metrics: Optional[_Metrics] = None):
         self._run_tick = run_tick
+        self._metrics = metrics
         self._queue: list[_Pending] = []
         self._cv = threading.Condition()
         self.max_rows = env_int("batch_max_rows", 64)
         self.wait_s = env_int("batch_wait_ms", 5) / 1000.0
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
 
     def submit(self, prompts: list[list[int]], max_new: int):
         p = _Pending(prompts, max_new)
@@ -500,6 +556,12 @@ class _Batcher:
     def _loop(self):
         while True:
             tick = self._take_tick()
+            if self._metrics is not None:
+                self._metrics.inc("ticks_total")
+                self._metrics.inc(
+                    "tick_rows_total",
+                    sum(len(p.prompts) for p in tick),
+                )
             try:
                 try:
                     self._run_group(tick)
@@ -521,6 +583,16 @@ class _Batcher:
                 for pend in tick:
                     pend.error = e
             finally:
+                if self._metrics is not None:
+                    self._metrics.inc(
+                        "tokens_generated_total",
+                        sum(
+                            len(r)
+                            for p in tick
+                            if p.outputs is not None
+                            for r in p.outputs
+                        ),
+                    )
                 for pend in tick:
                     pend.done.set()
 
@@ -554,7 +626,8 @@ class _Server:
             self._draft = (dm, _maybe_cast_decode(dp), k)
         self.port = port
         self._codec = None
-        self._batcher = _Batcher(self._run_tick)
+        self.metrics = _Metrics()
+        self._batcher = _Batcher(self._run_tick, self.metrics)
 
     def _model_for(self, longest: int, max_new: int):
         """KV cache sized to the request, not the model max: the
@@ -673,6 +746,23 @@ class _Server:
                             "uptime_s": round(time.time() - _T0, 1),
                         },
                     )
+                elif self.path == "/metrics":
+                    # Prometheus text exposition — same scrape contract
+                    # as the device plugin's shim endpoint.
+                    body = outer.metrics.render({
+                        "queue_depth": float(
+                            outer._batcher.queue_depth
+                        ),
+                        "uptime_seconds": time.time() - _T0,
+                    }).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._reply(404, {"error": "unknown path"})
 
@@ -680,6 +770,8 @@ class _Server:
                 if self.path != "/generate":
                     self._reply(404, {"error": "unknown path"})
                     return
+                outer.metrics.inc("requests_total")
+                t_req = time.time()
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -732,7 +824,12 @@ class _Server:
                         payload["texts"] = [decode(o) for o in outs]
                     self._reply(200, payload)
                 except Exception as e:  # noqa: BLE001 — serving loop
+                    outer.metrics.inc("request_errors_total")
                     self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                finally:
+                    outer.metrics.inc(
+                        "request_seconds_total", time.time() - t_req
+                    )
 
         httpd = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
         self.port = httpd.server_address[1]  # resolve port 0 -> actual
