@@ -276,6 +276,102 @@ def test_http_server_continuous_batching(tiny_env, monkeypatch):
     srv.httpd.shutdown()
 
 
+def test_http_server_per_request_sampling(tiny_env, monkeypatch):
+    """Requests may carry their own temperature/top-k/top-p: sampled
+    output differs from greedy, explicit-default requests still
+    coalesce with default traffic, and a mixed pair splits into
+    same-config ticks with both succeeding."""
+    import time
+
+    from tpufw.workloads.serve import _Server
+
+    monkeypatch.setenv("TPUFW_BATCH_WAIT_MS", "300")
+    srv = _Server(port=0, max_new_tokens=6)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    deadline = time.time() + 30
+    while not hasattr(srv, "httpd") and time.time() < deadline:
+        time.sleep(0.05)
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def post(body):
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return json.loads(resp.read())
+
+    prompt = [[1, 5, 9]]
+    greedy = post({"prompts": prompt, "max_new_tokens": 6})["outputs"]
+    # Near-uniform sampling: matching all 6 greedy tokens has
+    # probability ~V^-6 — and the server seed is fixed, so this is
+    # deterministic, not flaky.
+    sampled = post({
+        "prompts": prompt, "max_new_tokens": 6, "temperature": 100.0,
+    })["outputs"]
+    assert sampled != greedy
+    # Invalid values 400 with the field named, not garbage-200.
+    # (urllib.error is loaded by urllib.request's module-level import.)
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        post({
+            "prompts": prompt, "max_new_tokens": 6, "temperature": -1.0,
+        })
+    assert exc.value.code == 400
+
+    # Mixed concurrent trio: explicit-default must COALESCE with the
+    # default request (the collapse-to-None branch — batched_with >= 2
+    # for both), while the hot request splits into its own tick and
+    # everyone succeeds with their exact expected outputs.
+    results: dict[str, dict] = {}
+    gate = threading.Barrier(3)
+
+    def worker(name, body):
+        gate.wait()  # post simultaneously: one coalescing window
+        results[name] = post(body)
+
+    threads = [
+        threading.Thread(
+            target=worker,
+            args=("greedy", {"prompts": prompt, "max_new_tokens": 6}),
+        ),
+        threading.Thread(
+            target=worker,
+            args=(
+                "explicit",
+                {
+                    "prompts": prompt,
+                    "max_new_tokens": 6,
+                    "temperature": 0.0,
+                },
+            ),
+        ),
+        threading.Thread(
+            target=worker,
+            args=(
+                "hot",
+                {
+                    "prompts": prompt,
+                    "max_new_tokens": 6,
+                    "temperature": 100.0,
+                },
+            ),
+        ),
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert results["greedy"]["outputs"] == greedy
+    assert results["explicit"]["outputs"] == greedy
+    assert results["hot"]["outputs"] == sampled
+    assert results["greedy"]["batched_with"] >= 2
+    assert results["explicit"]["batched_with"] >= 2
+    srv.httpd.shutdown()
+
+
 def test_http_server_batching_failure_isolation(tiny_env, monkeypatch):
     """Coalescing must not create shared fate: a request that fails (or
     only fails when co-batched, via the combined length bucket) falls

@@ -245,21 +245,62 @@ def text_codec():
     return tok.encode, tok.decode
 
 
+def make_sampling(
+    temperature=0.0,
+    top_k=0,
+    top_p=1.0,
+    min_p=0.0,
+    repetition_penalty=1.0,
+):
+    """ONE copy of the sampling-knob coercion + validation rules,
+    shared by the env path (``sampling_from_env``) and the untrusted
+    per-request HTTP path — so explicit-default requests always compare
+    equal to the env config and keep coalescing.
+
+    Values are range-checked (clients can send anything) and floats
+    QUANTIZED (temperature to 0.01, top_p/min_p/penalty to 0.001):
+    sampling is a compiled-program parameter, and unquantized
+    client-chosen floats would compile unboundedly many variants."""
+    from tpufw.infer import SamplingConfig
+
+    t = round(float(temperature), 2)
+    if t < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    k = int(top_k or 0)
+    if k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    p = round(float(1.0 if top_p is None else top_p), 3)
+    if p <= 0:
+        raise ValueError(f"top_p must be > 0, got {top_p}")
+    m = round(float(min_p or 0.0), 3)
+    if not 0 <= m <= 1:
+        raise ValueError(f"min_p must be in [0, 1], got {min_p}")
+    r = round(
+        float(1.0 if repetition_penalty is None else repetition_penalty),
+        3,
+    )
+    if r <= 0:
+        raise ValueError(
+            f"repetition_penalty must be > 0, got {repetition_penalty}"
+        )
+    return SamplingConfig(
+        temperature=t,
+        top_k=k or None,
+        top_p=p if p < 1.0 else None,
+        min_p=m or None,
+        repetition_penalty=None if r == 1.0 else r,
+    )
+
+
 def sampling_from_env():
     """SamplingConfig from TPUFW_* env — ONE resolution for the batch
     and HTTP serving modes. Default stays greedy/deterministic."""
-    from tpufw.infer import SamplingConfig
-
-    return SamplingConfig(
+    return make_sampling(
         temperature=env_float("temperature", 0.0),
-        top_k=env_int("top_k", 0) or None,
-        top_p=(lambda v: v if v < 1.0 else None)(env_float("top_p", 1.0)),
-        min_p=env_float("min_p", 0.0) or None,
-        repetition_penalty=(
-            (lambda v: v if v != 1.0 else None)(
-                env_float("repetition_penalty", 1.0)
-            )
-        ),
+        top_k=env_int("top_k", 0),
+        top_p=env_float("top_p", 1.0),
+        min_p=env_float("min_p", 0.0),
+        repetition_penalty=env_float("repetition_penalty", 1.0),
     )
 
 
@@ -418,12 +459,18 @@ def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
 class _Pending:
     """One enqueued /generate request awaiting its tick."""
 
-    __slots__ = ("prompts", "max_new", "done", "outputs", "error",
-                 "batched_with")
+    __slots__ = ("prompts", "max_new", "sampling", "done", "outputs",
+                 "error", "batched_with")
 
-    def __init__(self, prompts: list[list[int]], max_new: int):
+    def __init__(
+        self, prompts: list[list[int]], max_new: int, sampling=None
+    ):
         self.prompts = prompts
         self.max_new = max_new
+        # None = the server's env-default SamplingConfig; a request
+        # override makes this tick-compatible only with same-config
+        # requests (the rng and transforms are shared per device call).
+        self.sampling = sampling
         self.done = threading.Event()
         self.outputs: list | None = None
         self.error: Exception | None = None
@@ -507,8 +554,8 @@ class _Batcher:
         with self._cv:
             return len(self._queue)
 
-    def submit(self, prompts: list[list[int]], max_new: int):
-        p = _Pending(prompts, max_new)
+    def submit(self, prompts: list[list[int]], max_new: int, sampling=None):
+        p = _Pending(prompts, max_new, sampling)
         with self._cv:
             self._queue.append(p)
             self._cv.notify()
@@ -525,12 +572,26 @@ class _Batcher:
         with self._cv:
             tick: list[_Pending] = []
             rows = 0
-            while self._queue:
-                nxt = self._queue[0]
-                if tick and rows + len(nxt.prompts) > self.max_rows:
-                    break  # stays queued for the next tick
-                tick.append(self._queue.pop(0))
-                rows += len(nxt.prompts)
+            rest: list[_Pending] = []
+            for nxt in self._queue:
+                # One device call = one SamplingConfig (it's a jit
+                # static arg and the rng transforms are shared):
+                # the head request defines the tick's config and every
+                # compatible request joins; mismatches keep their queue
+                # order for a later tick. No starvation — the head of
+                # the remainder defines the NEXT tick's config.
+                if (
+                    not tick
+                    or (
+                        rows + len(nxt.prompts) <= self.max_rows
+                        and nxt.sampling == tick[0].sampling
+                    )
+                ):
+                    tick.append(nxt)
+                    rows += len(nxt.prompts)
+                else:
+                    rest.append(nxt)
+            self._queue = rest
             return tick
 
     def _run_group(self, group: list[_Pending]) -> None:
@@ -545,7 +606,7 @@ class _Batcher:
         run_new = 1
         while run_new < want:
             run_new *= 2
-        outs = self._run_tick(all_prompts, run_new)
+        outs = self._run_tick(all_prompts, run_new, group[0].sampling)
         i = 0
         for pend in group:
             rows = outs[i: i + len(pend.prompts)]
@@ -628,6 +689,24 @@ class _Server:
         self._codec = None
         self.metrics = _Metrics()
         self._batcher = _Batcher(self._run_tick, self.metrics)
+        # Distinct per-request sampling configs admitted so far:
+        # sampling is a compiled-program parameter, so an unbounded
+        # variety would compile (and cache) unboundedly many programs.
+        self._sampling_seen: set = set()
+        self._sampling_cap = env_int("max_sampling_configs", 32)
+        self._sampling_lock = threading.Lock()
+
+    def admit_sampling(self, sampling) -> bool:
+        """True if this non-default config is within the server's
+        distinct-config budget (TPUFW_MAX_SAMPLING_CONFIGS, default
+        32); known configs are always admitted."""
+        with self._sampling_lock:
+            if sampling in self._sampling_seen:
+                return True
+            if len(self._sampling_seen) >= self._sampling_cap:
+                return False
+            self._sampling_seen.add(sampling)
+            return True
 
     def _model_for(self, longest: int, max_new: int):
         """KV cache sized to the request, not the model max: the
@@ -656,10 +735,14 @@ class _Server:
             self._codec = text_codec()
         return self._codec
 
-    def _run_tick(self, prompts: list[list[int]], max_new: int):
+    def _run_tick(
+        self, prompts: list[list[int]], max_new: int, sampling=None
+    ):
         """One device call for one coalesced tick — only the batcher
         thread runs this, so device work is serialized by construction
-        (the old per-request lock is gone).
+        (the old per-request lock is gone). ``sampling`` is a
+        per-request override (None = the env default); the batcher
+        guarantees every request in the tick shares it.
 
         Bucket prompt length and batch size so the jitted generate
         specializes on few shapes. The length bucket rides
@@ -668,6 +751,8 @@ class _Server:
         them, and the repetition penalty's seen-set never counts them
         (literal [0]*k prefixes would look like real tokens).
         """
+        if sampling is None:
+            sampling = self._sampling
         longest = _bucket(max(len(p) for p in prompts), 64)
         padded, real_n = _pad_batch(prompts)
         padded = padded + [[0] * longest]  # length-bucket filler row
@@ -698,7 +783,7 @@ class _Server:
                 # batch-min acceptance to zero; their outputs are
                 # sliced off below anyway.
                 live_rows=[i < real_n for i in range(len(padded))],
-                sampling=self._sampling,
+                sampling=sampling,
                 prefill_chunk_size=env_int("prefill_chunk", 0) or None,
             )
             return outs[:real_n]
@@ -707,17 +792,19 @@ class _Server:
             self.params,
             padded,
             max_new_tokens=max_new,
-            sampling=self._sampling,
+            sampling=sampling,
             eos_id=self._eos_id,
             prefill_chunk_size=env_int("prefill_chunk", 0) or None,
         )
         return outs[:real_n]
 
-    def generate(self, prompts: list[list[int]], max_new: int):
+    def generate(
+        self, prompts: list[list[int]], max_new: int, sampling=None
+    ):
         """Returns (outputs, batched_with): how many requests shared
         this device tick — surfaced in the response for observability
         (and the concurrency test pins coalescing actually happens)."""
-        return self._batcher.submit(prompts, max_new)
+        return self._batcher.submit(prompts, max_new, sampling)
 
     def serve_forever(self):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -813,8 +900,54 @@ class _Server:
                         # per-request slice would return
                         # batch-composition-dependent output.
                         raise ValueError("max_new_tokens must be >= 1")
+                    # Per-request sampling overrides layered on the env
+                    # defaults, through the SAME make_sampling rules
+                    # (validation + quantization); the batcher only
+                    # coalesces same-config requests.
+                    sampling = None
+                    knobs = (
+                        "temperature", "top_k", "top_p", "min_p",
+                        "repetition_penalty",
+                    )
+                    if any(kb in req for kb in knobs):
+                        base = outer._sampling
+                        sampling = make_sampling(
+                            temperature=req.get(
+                                "temperature", base.temperature
+                            ),
+                            top_k=req.get("top_k", base.top_k),
+                            top_p=req.get("top_p", base.top_p),
+                            min_p=req.get("min_p", base.min_p),
+                            repetition_penalty=req.get(
+                                "repetition_penalty",
+                                base.repetition_penalty,
+                            ),
+                        )
+                        if sampling == base:
+                            # Explicit values equal to the env defaults
+                            # coalesce with default-sampling traffic.
+                            sampling = None
+                        elif (
+                            outer._draft is not None
+                            and sampling.repetition_penalty is not None
+                        ):
+                            # Same contract the env path enforces at
+                            # startup — reject HERE with the request
+                            # field named, not deep in the speculative
+                            # trace.
+                            raise ValueError(
+                                "repetition_penalty cannot combine "
+                                "with speculative decoding "
+                                "(TPUFW_DRAFT_MODEL is set)"
+                            )
+                        elif not outer.admit_sampling(sampling):
+                            raise ValueError(
+                                "too many distinct sampling configs "
+                                "(each compiles a program); reuse an "
+                                "earlier configuration"
+                            )
                     outs, batched_with = outer.generate(
-                        prompts, max_new
+                        prompts, max_new, sampling
                     )
                     payload = {
                         "outputs": outs,
