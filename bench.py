@@ -866,6 +866,43 @@ def _worker() -> int:
                     decode["int8_error"] = (
                         f"{type(e).__name__}: {e}"[:300]
                     )
+            # Checkpoint the fp + int8 numbers BEFORE the unroll
+            # attempt: its unscanned-twin compile grows with n_layers
+            # and a watchdog kill mid-compile must not erase them.
+            _attach("decode", dict(decode))
+            # Unrolled-layers variant (TPUFW_DECODE_UNROLL's lever):
+            # the decode scan slices its stacked [L, ...] weights per
+            # layer per step; the CPU smoke profile measured the
+            # unrolled twin ~1.7x faster — this captures the on-chip
+            # number even if the tunnel only answers for the driver's
+            # end-of-round run. Own try: must not discard the fp
+            # baseline. donate: d_params has no later use, and keeping
+            # both trees resident would 2x the weight HBM on exactly
+            # the models where the lever matters.
+            if _time_left() > 240:
+                try:
+                    import dataclasses as _dcu
+
+                    from tpufw.models import unstack_layer_params
+
+                    u_model = _Llama(
+                        _dcu.replace(dcfg, scan_layers=False)
+                    )
+                    u_params = unstack_layer_params(
+                        d_params, donate=True
+                    )
+                    udt, _ = _timed_decode(
+                        u_model, u_params, prompts, pads, d_new
+                    )
+                    decode["unroll_tokens_per_sec_per_chip"] = round(
+                        d_b * d_new / udt, 1
+                    )
+                    decode["unroll_speedup"] = round(dt / udt, 3)
+                    del u_params
+                except Exception as e:  # noqa: BLE001
+                    decode["unroll_error"] = (
+                        f"{type(e).__name__}: {e}"[:300]
+                    )
             del d_params
         except Exception as e:  # noqa: BLE001
             decode = {"error": f"{type(e).__name__}: {e}"[:500]}
