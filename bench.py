@@ -968,6 +968,32 @@ def _worker() -> int:
                     mcfg.kv_lora_rank + mcfg.qk_rope_head_dim
                 ),
             }
+            # Checkpoint before the unroll compile, same discipline as
+            # the Llama decode tier (a watchdog kill mid-compile must
+            # not erase the measured latent-cache number).
+            _attach("mla_decode", dict(mla_decode))
+            if _time_left() > 240:
+                try:
+                    from tpufw.models import unstack_layer_params
+
+                    mu_model = Deepseek(
+                        _dcm.replace(mcfg, scan_layers=False)
+                    )
+                    mu_params = unstack_layer_params(
+                        m_params, donate=True
+                    )
+                    mudt, _ = _timed_decode(
+                        mu_model, mu_params, m_prompts, m_pads, m_new
+                    )
+                    mla_decode["unroll_tokens_per_sec_per_chip"] = (
+                        round(m_b * m_new / mudt, 1)
+                    )
+                    mla_decode["unroll_speedup"] = round(mdt / mudt, 3)
+                    del mu_params
+                except Exception as e:  # noqa: BLE001
+                    mla_decode["unroll_error"] = (
+                        f"{type(e).__name__}: {e}"[:300]
+                    )
             del m_params
         except Exception as e:  # noqa: BLE001
             mla_decode = {"error": f"{type(e).__name__}: {e}"[:500]}
