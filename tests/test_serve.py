@@ -499,6 +499,18 @@ def test_http_server_speculative_draft(tiny_env, monkeypatch):
     while not hasattr(srv2, "httpd") and time.time() < deadline:
         time.sleep(0.05)
     got = post(srv2.port, prompts)
+    # Draft-quality observability: emitted/iterations counters moved.
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{srv2.port}/metrics", timeout=30
+    ) as resp:
+        mtext = resp.read().decode()
+    mvals = {
+        ln.split()[0]: float(ln.split()[1])
+        for ln in mtext.splitlines()
+        if ln and not ln.startswith("#")
+    }
+    assert mvals["tpufw_serve_spec_iterations_total"] >= 1
+    assert mvals["tpufw_serve_spec_emitted_total"] >= 6
     srv2.httpd.shutdown()
     assert got == want
 

@@ -505,6 +505,14 @@ class _Metrics:
         with self._lock:
             self._c[name] = self._c.get(name, 0.0) + v
 
+    def register(self, *names: str) -> None:
+        """Expose counters at 0 before their first increment (same
+        absent-series rationale as the pre-initialized set) — for
+        feature-gated counters like the speculative pair."""
+        with self._lock:
+            for name in names:
+                self._c.setdefault(name, 0.0)
+
     @staticmethod
     def _fmt(v: float) -> str:
         # repr, not %g: %g rounds to 6 significant digits, which stalls
@@ -681,13 +689,16 @@ class _Server:
         self.params = _maybe_cast_decode(self.params)
         self.default_new = max_new_tokens
         self._eos_id = eos_from_env()
+        self.metrics = _Metrics()
         self._draft = build_draft_generator(self._sampling)
         if self._draft is not None:
             dm, dp, k = self._draft
             self._draft = (dm, _maybe_cast_decode(dp), k)
+            self.metrics.register(
+                "spec_iterations_total", "spec_emitted_total"
+            )
         self.port = port
         self._codec = None
-        self.metrics = _Metrics()
         self._batcher = _Batcher(self._run_tick, self.metrics)
         # Distinct per-request sampling configs admitted so far:
         # sampling is a compiled-program parameter, so an unbounded
@@ -770,7 +781,7 @@ class _Server:
                         max_seq_len=model.cfg.max_seq_len,
                     )
                 )
-            outs, _stats = speculative_generate_text(
+            outs, stats = speculative_generate_text(
                 draft_model,
                 draft_params,
                 model,
@@ -786,6 +797,15 @@ class _Server:
                 sampling=sampling,
                 prefill_chunk_size=env_int("prefill_chunk", 0) or None,
             )
+            # Draft-quality observability: emitted/iterations is the
+            # mean accepted tokens per verify pass (k+1 max) — THE
+            # number that says whether the draft is paying for itself.
+            # rate(spec_emitted)/rate(spec_iterations) gives the live
+            # acceptance from the same two counters.
+            self.metrics.inc(
+                "spec_iterations_total", stats["iterations"]
+            )
+            self.metrics.inc("spec_emitted_total", stats["emitted"])
             return outs[:real_n]
         outs = self._generate_text(
             model,
