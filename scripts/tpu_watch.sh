@@ -14,7 +14,7 @@
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${1:-540}"
-LOG=docs/evidence/tpu_watch_r4.log
+LOG=docs/evidence/tpu_watch_r5.log
 mkdir -p docs/evidence
 
 probe() {
@@ -32,12 +32,12 @@ while true; do
     echo "$(date -u +%FT%TZ) probe ok: $out" >> "$LOG"
     echo "$(date -u +%FT%TZ) bench starting" >> "$LOG"
     TPUFW_BENCH_TOTAL="${TPUFW_BENCH_TOTAL:-3000}" \
-    TPUFW_BENCH_SAVE=docs/evidence/BENCH_r4_watch_tpu.jsonl \
+    TPUFW_BENCH_SAVE=docs/evidence/BENCH_r5_watch_tpu.jsonl \
       python bench.py \
-      > docs/evidence/BENCH_r4_watch.json \
-      2> docs/evidence/BENCH_r4_watch.err
+      > docs/evidence/BENCH_r5_watch.json \
+      2> docs/evidence/BENCH_r5_watch.err
     rc=$?
-    echo "$(date -u +%FT%TZ) bench done rc=$rc: $(cat docs/evidence/BENCH_r4_watch.json)" >> "$LOG"
+    echo "$(date -u +%FT%TZ) bench done rc=$rc: $(cat docs/evidence/BENCH_r5_watch.json)" >> "$LOG"
     break
   fi
   echo "$(date -u +%FT%TZ) probe failed/hung: ${out:-<none>}" >> "$LOG"

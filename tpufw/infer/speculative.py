@@ -315,8 +315,9 @@ def speculative_generate(
             # Col-a token per row: rows that accepted past a keep their
             # own accepted draft x_a; rows rejected AT a draw from the
             # residual norm(max(p_a - q_a, 0)). When a == k (everyone
-            # accepted everything) the zero-padded q col makes the
-            # "residual" exactly p_k — the bonus draw — and the RAW
+            # accepted everything) the where() below bypasses the
+            # residual entirely and selects logp_a — the bonus draw
+            # straight from the target's p_k — and the RAW
             # index key is used there so it matches generate()'s
             # categorical for that emission index bit-for-bit; the
             # a < k resample folds the key (the raw one was consumed by

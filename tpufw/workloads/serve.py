@@ -266,7 +266,10 @@ def make_sampling(
     t = round(float(temperature), 2)
     if t < 0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
-    k = int(top_k or 0)
+    kf = float(top_k or 0)
+    if kf != int(kf):
+        raise ValueError(f"top_k must be an integer, got {top_k}")
+    k = int(kf)
     if k < 0:
         raise ValueError(f"top_k must be >= 0, got {top_k}")
     p = round(float(1.0 if top_p is None else top_p), 3)
@@ -581,24 +584,31 @@ class _Batcher:
             tick: list[_Pending] = []
             rows = 0
             rest: list[_Pending] = []
+            # One device call = one SamplingConfig (it's a jit static
+            # arg and the rng transforms are shared): the head request
+            # defines the tick's config and every compatible request
+            # joins; mismatches keep their queue order for a later
+            # tick. No starvation — the head of the remainder defines
+            # the NEXT tick's config. FIFO holds WITHIN a config: once
+            # a same-config request misses the row budget, no later
+            # same-config request may overtake it into this tick (only
+            # config mismatches are diverted past it).
+            budget_closed = False
             for nxt in self._queue:
-                # One device call = one SamplingConfig (it's a jit
-                # static arg and the rng transforms are shared):
-                # the head request defines the tick's config and every
-                # compatible request joins; mismatches keep their queue
-                # order for a later tick. No starvation — the head of
-                # the remainder defines the NEXT tick's config.
-                if (
-                    not tick
-                    or (
-                        rows + len(nxt.prompts) <= self.max_rows
-                        and nxt.sampling == tick[0].sampling
-                    )
-                ):
+                if not tick:
                     tick.append(nxt)
                     rows += len(nxt.prompts)
-                else:
+                elif nxt.sampling != tick[0].sampling:
                     rest.append(nxt)
+                elif (
+                    budget_closed
+                    or rows + len(nxt.prompts) > self.max_rows
+                ):
+                    budget_closed = True
+                    rest.append(nxt)
+                else:
+                    tick.append(nxt)
+                    rows += len(nxt.prompts)
             self._queue = rest
             return tick
 
@@ -706,6 +716,16 @@ class _Server:
         self._sampling_seen: set = set()
         self._sampling_cap = env_int("max_sampling_configs", 32)
         self._sampling_lock = threading.Lock()
+        # Sampled requests must be able to differ across ticks (best-of
+        # -n would otherwise return n identical copies): each tick's rng
+        # seed is TPUFW_SEED + a monotonic tick index. Within a tick the
+        # seed is shared — coalesced rows stay mutually deterministic —
+        # and the whole server replays exactly given the same request
+        # arrival order and TPUFW_SEED. Only the batcher thread runs
+        # _run_tick, so the counter needs no lock. Greedy decode ignores
+        # the rng entirely, so default traffic is unaffected.
+        self._seed_base = env_int("seed", 0)
+        self._tick_index = 0
 
     def admit_sampling(self, sampling) -> bool:
         """True if this non-default config is within the server's
@@ -764,6 +784,8 @@ class _Server:
         """
         if sampling is None:
             sampling = self._sampling
+        seed = self._seed_base + self._tick_index
+        self._tick_index += 1
         longest = _bucket(max(len(p) for p in prompts), 64)
         padded, real_n = _pad_batch(prompts)
         padded = padded + [[0] * longest]  # length-bucket filler row
@@ -795,6 +817,7 @@ class _Server:
                 # sliced off below anyway.
                 live_rows=[i < real_n for i in range(len(padded))],
                 sampling=sampling,
+                seed=seed,
                 prefill_chunk_size=env_int("prefill_chunk", 0) or None,
             )
             # Draft-quality observability: emitted/iterations is the
@@ -813,6 +836,7 @@ class _Server:
             padded,
             max_new_tokens=max_new,
             sampling=sampling,
+            seed=seed,
             eos_id=self._eos_id,
             prefill_chunk_size=env_int("prefill_chunk", 0) or None,
         )
