@@ -31,7 +31,8 @@ while true; do
   if echo "$out" | grep -q "PROBE_OK.*tpu"; then
     echo "$(date -u +%FT%TZ) probe ok: $out" >> "$LOG"
     echo "$(date -u +%FT%TZ) bench starting" >> "$LOG"
-    TPUFW_BENCH_TOTAL="${TPUFW_BENCH_TOTAL:-3000}" \
+    TPUFW_BENCH_TOTAL="${TPUFW_BENCH_TOTAL:-3600}" \
+    TPUFW_BENCH_TIMEOUT="${TPUFW_BENCH_TIMEOUT:-2600}" \
     TPUFW_BENCH_SAVE=docs/evidence/BENCH_r5_watch_tpu.jsonl \
       python bench.py \
       > docs/evidence/BENCH_r5_watch.json \
