@@ -539,6 +539,23 @@ def test_http_server_speculative_draft(tiny_env, monkeypatch):
     while not hasattr(srv3, "httpd") and time.time() < deadline:
         time.sleep(0.05)
     sampled = post(srv3.port, prompts)
+    # Per-request repetition_penalty composes with the draft end-to-end
+    # (the penalized speculative jit path, not just config resolution)
+    # — this used to 400.
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv3.port}/generate",
+        data=json.dumps({
+            "prompts": prompts,
+            "max_new_tokens": 6,
+            "repetition_penalty": 1.3,
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=300) as resp:
+        penalized = json.loads(resp.read())["outputs"]
     srv3.httpd.shutdown()
     assert len(sampled) == len(prompts)
     assert all(len(o) == 6 for o in sampled)
+    assert len(penalized) == len(prompts)
+    assert all(len(o) == 6 for o in penalized)

@@ -22,10 +22,16 @@ Two acceptance modes, selected by ``sampling.temperature``:
   survives the bonus comes from ``p_k`` directly. Marginally, each
   emitted token is distributed EXACTLY as target-only sampling — draft
   quality changes speed, never the distribution. ``p``/``q`` are the
-  post-transform distributions (temperature/top-k/top-p/min-p applied
-  to both), so speculation composes with every serving sampler knob
-  except repetition_penalty (whose seen-token state is sequential by
-  construction; rejected loudly).
+  post-transform distributions (temperature/top-k/top-p/min-p/
+  repetition_penalty applied to both), so speculation composes with
+  EVERY serving sampler knob. The repetition penalty's seen-token
+  state is sequential by construction, but sequential-in-k is cheap
+  when k is static: the draft updates its mask as it proposes, and
+  the verify pass rebuilds the k+1 per-position masks cumulatively
+  (seen_j = seen ∪ drafts[:, :j]) — each position's transformed
+  target distribution is exactly what ``generate`` would have used at
+  that emission index, so the acceptance test and residual stay
+  distribution-exact.
 
 RNG discipline: emission index ``n`` consumes the same key
 ``generate()`` would use for that index (first = split(rng)[1], rest =
@@ -147,16 +153,10 @@ def speculative_generate(
             "sampling.temperature > 0 requires an rng key for the "
             "rejection-resample draws"
         )
-    if (
+    track_seen = (
         sampling.repetition_penalty is not None
         and sampling.repetition_penalty != 1.0
-    ):
-        raise NotImplementedError(
-            "repetition_penalty with speculation: the seen-token mask "
-            "is sequential (each emission updates it) but the draft "
-            "proposes k tokens before any is accepted — use "
-            "tpufw.infer.generate for penalized sampling"
-        )
+    )
     for m, who in ((model, "model"), (draft_model, "draft_model")):
         max_seq = getattr(getattr(m, "cfg", None), "max_seq_len", None)
         # The verify block may overrun the accepted stream by up to k
@@ -192,6 +192,18 @@ def speculative_generate(
         partial(apply, draft_model, draft_params), prompt_tokens,
         positions, seg, prefill_chunk_size,
     )
+    # Repetition-penalty seen mask: prompt tokens (padding excluded via
+    # seg) — the exact construction generate() uses, so the two loops'
+    # transformed distributions match position for position.
+    seen0 = None
+    if track_seen:
+        vocab = t_logits.shape[-1]
+        real = seg > 0
+        seen0 = (
+            jnp.zeros((b, vocab), bool)
+            .at[jnp.arange(b)[:, None], prompt_tokens]
+            .max(real)
+        )
     all_keys = None
     if stochastic:
         # Emission index n consumes the key generate() would use for
@@ -202,9 +214,19 @@ def speculative_generate(
         next_rng, first_key = jax.random.split(rng)
         step_keys = jax.random.split(next_rng, max_new_tokens - 1 + k)
         all_keys = jnp.concatenate([first_key[None], step_keys])
-        first = sample_token(t_logits[:, -1, :], sampling, first_key)
+        first = sample_token(
+            t_logits[:, -1, :], sampling, first_key, seen0
+        )
     else:
-        first = jnp.argmax(t_logits[:, -1, :], axis=-1).astype(jnp.int32)
+        # transform_logits is an identity (up to f32 cast) for greedy
+        # without a penalty; with one it applies the seen-mask rule
+        # before the argmax, exactly like sample_token at temp 0.
+        first = jnp.argmax(
+            transform_logits(t_logits[:, -1, :], sampling, seen0),
+            axis=-1,
+        ).astype(jnp.int32)
+    if track_seen:
+        seen0 = seen0.at[jnp.arange(b), first].set(True)
     done0 = (
         jnp.zeros((b,), bool) if eos_id is None else first == eos_id
     )
@@ -217,13 +239,16 @@ def speculative_generate(
 
     ones = jnp.ones((b, 1), jnp.int32)
 
-    def draft_propose(d_cache, prev, pos, keys_blk):
+    def draft_propose(d_cache, prev, pos, keys_blk, seen):
         """k proposals + one filler step so the draft cache holds every
         proposed token (the a == k acceptance case needs d_k cached).
         Stochastic proposals draw from the TRANSFORMED draft
         distribution with the raw per-emission-index key (the coupling
         that makes draft == target bit-match ``generate``); the
-        distributions are returned for the acceptance ratio test."""
+        distributions are returned for the acceptance ratio test.
+        With a repetition penalty the seen mask advances over the
+        draft's OWN proposals — its proposal distribution q_j is
+        conditioned on the same prefix the target's p_j will be."""
         toks, qs = [], []
         tok = prev
         for i in range(k + 1):
@@ -233,21 +258,32 @@ def speculative_generate(
             )
             if i < k:
                 if stochastic:
-                    q_i = transform_logits(logits[:, -1, :], sampling)
+                    q_i = transform_logits(
+                        logits[:, -1, :], sampling, seen
+                    )
                     tok = jax.random.categorical(
                         keys_blk[i], q_i, axis=-1
                     ).astype(jnp.int32)
                     qs.append(q_i)
+                elif track_seen:
+                    tok = jnp.argmax(
+                        transform_logits(
+                            logits[:, -1, :], sampling, seen
+                        ),
+                        axis=-1,
+                    ).astype(jnp.int32)
                 else:
                     tok = jnp.argmax(
                         logits[:, -1, :], axis=-1
                     ).astype(jnp.int32)
+                if track_seen:
+                    seen = seen.at[jnp.arange(b), tok].set(True)
                 toks.append(tok)
         q_trans = jnp.stack(qs, axis=1) if stochastic else None
         return jnp.stack(toks, axis=1), q_trans, d_cache  # [B, k]
 
     def body(carry):
-        t_cache, d_cache, prev, pos, done, n, buf, iters = carry
+        t_cache, d_cache, prev, pos, done, n, buf, iters, seen = carry
         t_cur0 = _cursor(t_cache)
         d_cur0 = _cursor(d_cache)
         keys_blk = (
@@ -256,7 +292,7 @@ def speculative_generate(
             else None
         )
         drafts, q_trans, d_cache = draft_propose(
-            d_cache, prev, pos, keys_blk
+            d_cache, prev, pos, keys_blk, seen
         )
 
         # One target pass scores prev + all k drafts: logits[:, i] is
@@ -268,10 +304,29 @@ def speculative_generate(
             jnp.ones((b, k + 1), jnp.int32),
         )
 
+        def transform_positions(logits):
+            """Per-position transformed target distributions. Without a
+            penalty one vectorized transform covers all k+1 positions;
+            with one, position j's mask is seen ∪ drafts[:, :j] —
+            built cumulatively over the STATIC k (k+1 [B, V] transforms
+            instead of 1; k is small and this is the exactness
+            requirement: each position's distribution must equal the
+            one generate() would sample at that emission index)."""
+            if not track_seen:
+                return transform_logits(logits, sampling)
+            outs, s = [], seen
+            for j in range(k + 1):
+                outs.append(
+                    transform_logits(logits[:, j], sampling, s)
+                )
+                if j < k:
+                    s = s.at[jnp.arange(b), drafts[:, j]].set(True)
+            return jnp.stack(outs, axis=1)
+
         if stochastic:
             # Rejection test on the post-transform distributions:
             # accept x_j iff u_j < p_j(x_j)/q_j(x_j).
-            p_trans = transform_logits(t_logits, sampling)  # [B,k+1,V]
+            p_trans = transform_positions(t_logits)  # [B,k+1,V]
             logp = jax.nn.log_softmax(p_trans, axis=-1)
             logq = jax.nn.log_softmax(q_trans, axis=-1)
             lp = jnp.take_along_axis(
@@ -290,7 +345,7 @@ def speculative_generate(
             match = us < jnp.exp(lp - lq)
         else:
             greedy = jnp.argmax(
-                t_logits, axis=-1
+                transform_positions(t_logits), axis=-1
             ).astype(jnp.int32)  # [B, k+1]
             match = drafts == greedy[:, :k]  # [B, k]
 
@@ -398,9 +453,17 @@ def speculative_generate(
         nxt = jax.lax.dynamic_index_in_dim(
             block, a, axis=1, keepdims=False
         )
+        if track_seen:
+            # Mark this block's emissions (cols < n_block) — the same
+            # tokens generate() would have marked one step at a time.
+            # Done rows mark their (unvalidated) block values; their
+            # outputs are pad-frozen, so the divergence is unobservable.
+            seen = seen.at[jnp.arange(b)[:, None], block].max(
+                jnp.broadcast_to(live_col, (b, k + 1))
+            )
         return (
             t_cache, d_cache, nxt, pos + a + 1, new_done,
-            n + n_block, buf, iters + 1,
+            n + n_block, buf, iters + 1, seen,
         )
 
     def cond(carry):
@@ -415,8 +478,11 @@ def speculative_generate(
     init = (
         t_cache, d_cache, first, pos0, done0,
         jnp.asarray(1, jnp.int32), buf, jnp.asarray(0, jnp.int32),
+        # The seen mask rides the carry (placeholder scalar when the
+        # penalty is off, so the loop signature stays uniform).
+        seen0 if track_seen else jnp.zeros((), bool),
     )
-    *_, n_final, buf, iters = jax.lax.while_loop(cond, body, init)
+    *_, n_final, buf, iters, _seen = jax.lax.while_loop(cond, body, init)
     return buf[:, :max_new_tokens], {
         "iterations": iters,
         "emitted": jnp.minimum(n_final, max_new_tokens),

@@ -319,7 +319,9 @@ def build_draft_generator(sampling):
     """TPUFW_DRAFT_MODEL: enable speculative decoding
     (tpufw.infer.speculative) with this preset as the draft — greedy
     acceptance at TPUFW_TEMPERATURE=0, rejection-resampling otherwise
-    (every sampler knob except the repetition penalty composes).
+    (every sampler knob composes, including the repetition penalty —
+    tpufw.infer.speculative threads the seen-token mask through both
+    the draft proposals and the per-position verify distributions).
 
     Draft weights come from TPUFW_DRAFT_PARAMS_CHECKPOINT (bare Orbax
     params, e.g. an import_hf of the small family member) — without it
@@ -335,18 +337,6 @@ def build_draft_generator(sampling):
     name = env_str("draft_model", "")
     if not name:
         return None
-    if sampling.repetition_penalty is not None:
-        # `is not None`, not truthiness: TPUFW_REPETITION_PENALTY=0
-        # resolves to 0.0 (only 1.0 maps to None) and must fail HERE.
-        # The penalty's seen-token mask is sequential (each emission
-        # updates it) but the draft proposes k tokens before any is
-        # accepted — tpufw.infer.speculative rejects the combination
-        # at trace time; fail at config time with the env-var name.
-        raise ValueError(
-            "TPUFW_DRAFT_MODEL cannot combine with "
-            "TPUFW_REPETITION_PENALTY: the penalty's seen-token state "
-            "is sequential, speculation proposes tokens in blocks"
-        )
     from tpufw.configs.loader import resolve_model_preset
     from tpufw.models import model_for_config
 
@@ -971,19 +961,6 @@ class _Server:
                             # Explicit values equal to the env defaults
                             # coalesce with default-sampling traffic.
                             sampling = None
-                        elif (
-                            outer._draft is not None
-                            and sampling.repetition_penalty is not None
-                        ):
-                            # Same contract the env path enforces at
-                            # startup — reject HERE with the request
-                            # field named, not deep in the speculative
-                            # trace.
-                            raise ValueError(
-                                "repetition_penalty cannot combine "
-                                "with speculative decoding "
-                                "(TPUFW_DRAFT_MODEL is set)"
-                            )
                         elif not outer.admit_sampling(sampling):
                             raise ValueError(
                                 "too many distinct sampling configs "
