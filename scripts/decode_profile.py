@@ -22,7 +22,7 @@ the components, one JSON line per experiment:
 Timing is value-fetch based (np.asarray), never block_until_ready —
 the axon tunnel lies about the latter (docs/PERF.md). Run from
 /root/repo with the TPU healthy:  python scripts/decode_profile.py
-Results land in docs/evidence/DECODE_PROFILE_r4.jsonl as they complete
+Results land in docs/evidence/DECODE_PROFILE_r5.jsonl as they complete
 (a later wedge can't erase them).
 """
 
@@ -40,7 +40,7 @@ sys.path.insert(
 
 OUT = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "docs", "evidence", "DECODE_PROFILE_r4.jsonl",
+    "docs", "evidence", "DECODE_PROFILE_r5.jsonl",
 )
 # Every row carries the platform so a --smoke wiring check appended to
 # the same evidence file can never be mistaken for hardware numbers.

@@ -17,7 +17,7 @@ optimization is aimed, one JSON line per experiment:
 Timing is value-fetch based (np.asarray). Run from /root/repo on a
 healthy TPU:  python scripts/resnet_profile.py   (--smoke for a tiny
 CPU wiring check). Results append to
-docs/evidence/RESNET_PROFILE_r4.jsonl as they complete.
+docs/evidence/RESNET_PROFILE_r5.jsonl as they complete.
 """
 
 from __future__ import annotations
@@ -33,7 +33,7 @@ sys.path.insert(
 
 OUT = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "docs", "evidence", "RESNET_PROFILE_r4.jsonl",
+    "docs", "evidence", "RESNET_PROFILE_r5.jsonl",
 )
 SMOKE = "--smoke" in sys.argv
 # Every row carries the platform so a --smoke wiring check appended to

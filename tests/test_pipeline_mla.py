@@ -214,34 +214,42 @@ def test_pptp_forward_and_grads(setup):
     _assert_grads_close(g_pipe, g_ref)
 
 
-def test_1f1b_matches_gpipe(setup):
+def test_1f1b_matches_gpipe():
     """The 1F1B manual-VJP schedule trains MLA blocks too (pp x tp):
     loss and grads match GPipe's on the same params (both already
     pinned to the oracle) — the f/g operators must transpose the
-    replicated latent kernels exactly."""
-    from tpufw.parallel.pipeline_1f1b import pipeline_1f1b_value_and_grad
+    replicated latent kernels exactly.
 
-    mesh = build_mesh(MeshConfig(data=1, pipe=2, fsdp=2, tensor=2))
-    params, tokens, _ = setup
-    pipe_1 = PipelineConfig(
-        n_stages=2, n_microbatches=4, schedule="1f1b"
+    Runs OUT-OF-PROCESS (tests/pipeline_mla_1f1b_worker.py): all four
+    observed full-suite native aborts landed at exactly this case's
+    value fetch — the suite's most complex single program against
+    accumulated jaxlib state (passes solo every time; bisection in
+    docs/evidence/SUITE_r5.md found no module pair that reproduces,
+    only the full-suite total). A fresh process keeps the coverage and
+    removes the one deterministic crash site from long runs."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(root, "tests", "pipeline_mla_1f1b_worker.py"),
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=root,
     )
-    pipe_g = PipelineConfig(n_stages=2, n_microbatches=4)
-    params = jax.device_put(
-        params, pipeline_param_shardings(mesh, params)
+    assert proc.returncode == 0, (
+        f"stdout: {proc.stdout[-2000:]}\nstderr: {proc.stderr[-2000:]}"
     )
-    l_g, g_g = jax.jit(
-        jax.value_and_grad(
-            lambda p, t: pipeline_loss(p, t, CFG, pipe_g, mesh)
-        )
-    )(params, tokens)
-    l_1, g_1 = jax.jit(
-        lambda p, t: pipeline_1f1b_value_and_grad(
-            p, t, CFG, pipe_1, mesh
-        )
-    )(params, tokens)
-    np.testing.assert_allclose(float(l_1), float(l_g), rtol=1e-5)
-    _assert_grads_close(g_1, g_g)
+    assert "MLA_1F1B_OK" in proc.stdout, proc.stdout
 
 
 # ----------------------------------------------------------------------
