@@ -10,10 +10,14 @@
 # (TPUFW_BENCH_TOTAL), TERMs-then-KILLs its own workers with a grace
 # window, and always exits with one JSON line.
 #
-# Usage: scripts/tpu_watch.sh [interval_s] (default 540)
+# Usage: scripts/tpu_watch.sh [interval_s] [deadline_epoch] (default
+# 540 / now+9.5h). The deadline stops the probe loop before the
+# driver's end-of-round bench needs the backend (one TPU job at a
+# time) — insurance for a session that ends without a manual pkill.
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${1:-540}"
+DEADLINE="${2:-$(( $(date +%s) + 34200 ))}"
 LOG=docs/evidence/tpu_watch_r5.log
 mkdir -p docs/evidence
 
@@ -25,8 +29,16 @@ print("PROBE_OK", d[0].platform, d[0].device_kind, len(d))
 ' 2>/dev/null
 }
 
-echo "$(date -u +%FT%TZ) watcher start (interval ${INTERVAL}s)" >> "$LOG"
+echo "$(date -u +%FT%TZ) watcher start (interval ${INTERVAL}s, deadline $(date -u -d "@${DEADLINE}" +%FT%TZ))" >> "$LOG"
 while true; do
+  # Stop probing once a bench STARTED now could not finish before the
+  # deadline (a probe-then-bench just under the wire would hold the
+  # backend into the driver's window — the exact collision the
+  # deadline exists to prevent).
+  if [ "$(( $(date +%s) + ${TPUFW_BENCH_TOTAL:-3600} + 120 ))" -ge "$DEADLINE" ]; then
+    echo "$(date -u +%FT%TZ) deadline margin reached; stopping (no bench banked)" >> "$LOG"
+    break
+  fi
   out=$(probe)
   if echo "$out" | grep -q "PROBE_OK.*tpu"; then
     echo "$(date -u +%FT%TZ) probe ok: $out" >> "$LOG"
