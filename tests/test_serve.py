@@ -159,6 +159,89 @@ def test_http_server_generate(tiny_env):
     srv.httpd.shutdown()
 
 
+def test_http_server_streaming(tiny_env, monkeypatch):
+    """SSE streaming: chunk events carry per-row NEW token ids whose
+    concatenation equals the non-streamed greedy output exactly; the
+    final event carries done (and full texts for text requests); a
+    sampled stream also round-trips. Chunk size 2 forces multiple
+    events for a 6-token request."""
+    import time
+
+    from tpufw.workloads.serve import _Server
+
+    monkeypatch.setenv("TPUFW_STREAM_CHUNK", "2")
+    srv = _Server(port=0, max_new_tokens=8)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    deadline = time.time() + 30
+    while not hasattr(srv, "httpd") and time.time() < deadline:
+        time.sleep(0.05)
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def post(body):
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        return urllib.request.urlopen(req, timeout=300)
+
+    def read_events(resp):
+        events = []
+        for line in resp:
+            line = line.strip()
+            if line.startswith(b"data: "):
+                events.append(json.loads(line[len(b"data: "):]))
+        return events
+
+    prompts = [[1, 5, 9], [2, 7]]
+    with post({"prompts": prompts, "max_new_tokens": 6}) as resp:
+        want = json.loads(resp.read())["outputs"]
+    with post(
+        {"prompts": prompts, "max_new_tokens": 6, "stream": True}
+    ) as resp:
+        assert resp.headers["Content-Type"].startswith(
+            "text/event-stream"
+        )
+        events = read_events(resp)
+    chunks = [e["outputs"] for e in events if "outputs" in e]
+    assert len(chunks) >= 3  # 6 tokens / chunk 2: it actually streamed
+    got = [[] for _ in prompts]
+    for rows in chunks:
+        for acc, r in zip(got, rows):
+            acc.extend(r)
+    assert got == want
+    assert events[-1] == {"done": True}
+
+    # Text request: chunk events stream ids, the final event decodes.
+    with post(
+        {"texts": ["hi", "yo"], "max_new_tokens": 6, "stream": True}
+    ) as resp:
+        tevents = read_events(resp)
+    assert tevents[-1]["done"] is True
+    assert len(tevents[-1]["texts"]) == 2
+    assert all(isinstance(s, str) for s in tevents[-1]["texts"])
+
+    # Sampled stream serves end-to-end too (fresh tick seed per tick).
+    with post(
+        {
+            "prompts": prompts,
+            "max_new_tokens": 6,
+            "temperature": 100.0,
+            "stream": True,
+        }
+    ) as resp:
+        sevents = read_events(resp)
+    sgot = [[] for _ in prompts]
+    for rows in (e["outputs"] for e in sevents if "outputs" in e):
+        for acc, r in zip(sgot, rows):
+            acc.extend(r)
+    assert all(len(r) == 6 for r in sgot)
+    assert sgot != want  # near-uniform sampling differs from greedy
+    srv.httpd.shutdown()
+
+
 def test_sampling_env_resolution(clear_tpufw_env):
     clear_tpufw_env.setenv("TPUFW_TEMPERATURE", "0.7")
     clear_tpufw_env.setenv("TPUFW_TOP_K", "40")

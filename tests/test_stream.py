@@ -1,0 +1,138 @@
+"""Streaming decode == one-shot decode, chunk boundaries invisible.
+
+``generate_stream`` re-uses ``generate``'s exact key discipline and
+step body, so the concatenation of its chunks must be BIT-identical to
+the one-shot output under every sampler knob — greedy, sampled,
+penalized — for every chunk size (1, a divisor, a non-divisor, and one
+larger than max_new_tokens), with eos early-stop dropping only all-pad
+tails. The text wrapper's per-row truncation must match
+``generate_text`` row for row.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.infer import (
+    SamplingConfig,
+    generate,
+    generate_stream,
+    generate_text,
+    generate_text_stream,
+    pad_prompts,
+)
+from tpufw.models import LLAMA_CONFIGS, Llama
+
+TINY = dataclasses.replace(
+    LLAMA_CONFIGS["llama3_tiny"],
+    dtype=jnp.float32,
+    param_dtype=jnp.float32,
+    max_seq_len=128,
+)
+PROMPTS = [[5, 6, 7], [9], [1, 2, 3, 4, 5, 6]]
+
+
+@pytest.fixture(scope="module")
+def target():
+    model = Llama(TINY.decode_config())
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def _oneshot(target, max_new, sampling, eos_id=None, seed=0):
+    model, params = target
+    toks, pads = pad_prompts(PROMPTS, 0)
+    return np.asarray(
+        generate(
+            model, params, jnp.asarray(toks), jnp.asarray(pads),
+            jax.random.key(seed), max_new_tokens=max_new,
+            sampling=sampling, eos_id=eos_id,
+        )
+    )
+
+
+def _streamed(target, max_new, chunk, sampling, eos_id=None, seed=0):
+    model, params = target
+    chunks = list(
+        generate_stream(
+            model, params, PROMPTS, max_new_tokens=max_new,
+            chunk_size=chunk, sampling=sampling, eos_id=eos_id,
+            seed=seed,
+        )
+    )
+    return chunks, np.concatenate(chunks, axis=1)
+
+
+@pytest.mark.parametrize("chunk", [1, 4, 7, 64])
+def test_greedy_chunks_bit_match_oneshot(target, chunk):
+    want = _oneshot(target, 12, SamplingConfig())
+    chunks, got = _streamed(target, 12, chunk, SamplingConfig())
+    assert (got == want).all(), f"chunk={chunk}"
+    assert got.shape == want.shape
+    if chunk < 12:
+        assert len(chunks) > 1  # it actually streamed
+
+
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        SamplingConfig(temperature=0.8, top_p=0.9),
+        SamplingConfig(temperature=0.7, top_k=12, repetition_penalty=1.4),
+    ],
+    ids=["sampled", "penalized"],
+)
+def test_sampled_chunks_bit_match_oneshot(target, cfg):
+    want = _oneshot(target, 15, cfg, seed=3)
+    _, got = _streamed(target, 15, 4, cfg, seed=3)
+    assert (got == want).all()
+
+
+def test_eos_early_stop_drops_only_pad(target):
+    base = _oneshot(target, 10, SamplingConfig())
+    eos = int(base[0][2])
+    want = _oneshot(target, 10, SamplingConfig(), eos_id=eos)
+    chunks, got = _streamed(target, 10, 3, SamplingConfig(), eos_id=eos)
+    n = got.shape[1]
+    assert (got == want[:, :n]).all()
+    assert (want[:, n:] == 0).all()  # the dropped tail was all pad
+
+
+def test_text_stream_rows_match_generate_text(target):
+    model, params = target
+    base = generate_text(
+        model, params, PROMPTS, max_new_tokens=10,
+    )
+    eos = base[0][2]
+    want = generate_text(
+        model, params, PROMPTS, max_new_tokens=10, eos_id=eos,
+    )
+    rows = [[] for _ in PROMPTS]
+    for chunk in generate_text_stream(
+        model, params, PROMPTS, max_new_tokens=10, chunk_size=3,
+        eos_id=eos,
+    ):
+        for i, toks in enumerate(chunk):
+            rows[i].extend(toks)
+    assert rows == want
+
+
+def test_single_token(target):
+    want = _oneshot(target, 1, SamplingConfig())
+    chunks, got = _streamed(target, 1, 8, SamplingConfig())
+    assert len(chunks) == 1 and (got == want).all()
+
+
+def test_cache_budget_is_loud(target):
+    model, params = target
+    with pytest.raises(ValueError, match="KV cache"):
+        list(
+            generate_stream(
+                model, params, [list(range(1, 100))],
+                max_new_tokens=40, chunk_size=8,
+            )
+        )

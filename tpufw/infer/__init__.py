@@ -1,7 +1,9 @@
 from tpufw.infer.generate import (  # noqa: F401
     cast_decode_params,
     generate,
+    generate_stream,
     generate_text,
+    generate_text_stream,
     pad_prompts,
 )
 from tpufw.infer.speculative import (  # noqa: F401

@@ -177,8 +177,27 @@ def generate(
             f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"the KV cache (max_seq_len={max_seq})"
         )
-    seg = (jnp.arange(p)[None, :] >= pad_lens[:, None]).astype(jnp.int32)
-    positions = jnp.maximum(jnp.arange(p)[None, :] - pad_lens[:, None], 0)
+    cache, first, pos0, done, seen, step_rngs = _prefill_and_first(
+        model, params, prompt_tokens, pad_lens, rng,
+        n_step_keys=max_new_tokens - 1, sampling=sampling,
+        eos_id=eos_id, prefill_chunk_size=prefill_chunk_size,
+    )
+    if max_new_tokens == 1:
+        return first[:, None]
+    step = _decode_step(
+        _model_apply(model, params), b,
+        sampling=sampling, pad_id=pad_id, eos_id=eos_id,
+    )
+    (_, _, _, _, _), rest = jax.lax.scan(
+        step, (cache, first, pos0, done, seen), step_rngs
+    )
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+def _model_apply(model, params):
+    """The ONE cached-decode apply closure (mirrors the copy
+    ``speculative_generate`` binds): tokens through the model with the
+    cache collection mutable, MoE aux dropped."""
 
     def apply(cache, tokens, positions, seg):
         out, vars_ = model.apply(
@@ -188,43 +207,78 @@ def generate(
             segment_ids=seg,
             mutable=["cache"],
         )
-        logits = out[0] if isinstance(out, tuple) else out  # MoE aux dropped
+        logits = out[0] if isinstance(out, tuple) else out
         return logits, {"cache": vars_["cache"]}
 
+    return apply
+
+
+def _prefill_and_first(
+    model,
+    params,
+    prompt_tokens: jax.Array,
+    pad_lens: jax.Array,
+    rng: jax.Array,
+    *,
+    n_step_keys: int,
+    sampling: SamplingConfig,
+    eos_id: Optional[int],
+    prefill_chunk_size: Optional[int],
+):
+    """ONE copy of the prefill + first-token + key-split discipline,
+    shared by ``generate`` and the streaming path — streamed chunks are
+    bit-identical to the one-shot decode BY CONSTRUCTION, not by
+    hand-synced duplicates (same rule as ``prefill_cache``'s sharing
+    with the speculative path). Key order: first = split(rng)[1],
+    step i = split(split(rng)[0], n)[i-1]; threefry splits are
+    counter-mode, so key i is stable across the split count. Returns
+    (cache, first, pos0, done0, seen, step_keys); ``seen`` is None
+    unless the repetition penalty needs the [B, V] presence mask (it
+    costs B*V bools in the decode carry)."""
+    b, p = prompt_tokens.shape
+    seg = (jnp.arange(p)[None, :] >= pad_lens[:, None]).astype(jnp.int32)
+    positions = jnp.maximum(jnp.arange(p)[None, :] - pad_lens[:, None], 0)
+    apply = _model_apply(model, params)
     logits, cache = prefill_cache(
         apply, prompt_tokens, positions, seg, prefill_chunk_size
     )
-    # Repetition penalty needs a [B, V] presence mask of every token the
-    # model has seen (prompt + generated). Built only when enabled — it
-    # costs B*V bools in the scan carry.
     track_seen = (
         sampling.repetition_penalty is not None
         and sampling.repetition_penalty != 1.0
     )
-    vocab = logits.shape[-1]
     seen = None
     if track_seen:
+        vocab = logits.shape[-1]
         real = seg > 0  # seg is always built above; 0 marks padding
         seen = (
             jnp.zeros((b, vocab), bool)
             .at[jnp.arange(b)[:, None], prompt_tokens]
             .max(real)
         )
-    next_rng, rng = jax.random.split(rng)
-    first = sample_token(logits[:, -1, :], sampling, rng, seen)
+    next_rng, first_rng = jax.random.split(rng)
+    first = sample_token(logits[:, -1, :], sampling, first_rng, seen)
     if track_seen:
         seen = seen.at[jnp.arange(b), first].set(True)
     # The EOS token itself is emitted; only rows ALREADY done emit pad.
     done = jnp.zeros((b,), bool) if eos_id is None else first == eos_id
+    step_keys = jax.random.split(next_rng, max(n_step_keys, 1))
+    return cache, first, p - pad_lens, done, seen, step_keys
+
+
+def _decode_step(apply, b: int, *, sampling, pad_id, eos_id):
+    """ONE copy of the decode step body (sample → seen update → pad
+    frozen rows → eos), scanned over all keys by ``generate`` and over
+    per-chunk key slices by ``_stream_chunk`` — the other half of the
+    stream/one-shot bit-parity contract."""
+    track_seen = (
+        sampling.repetition_penalty is not None
+        and sampling.repetition_penalty != 1.0
+    )
+    ones = jnp.ones((b, 1), jnp.int32)
 
     def step(carry, rng_step):
         cache, token, pos, done, seen = carry
-        logits, cache = apply(
-            cache,
-            token[:, None],
-            pos[:, None],
-            jnp.ones((b, 1), jnp.int32),
-        )
+        logits, cache = apply(cache, token[:, None], pos[:, None], ones)
         nxt = sample_token(logits[:, -1, :], sampling, rng_step, seen)
         if track_seen:
             seen = seen.at[jnp.arange(b), nxt].set(True)
@@ -233,15 +287,201 @@ def generate(
             done = done | (nxt == eos_id)
         return (cache, emitted, pos + 1, done, seen), emitted
 
-    # Positions continue from each row's real length (p - pad_len).
-    pos0 = p - pad_lens
-    step_rngs = jax.random.split(next_rng, max(max_new_tokens - 1, 1))
-    if max_new_tokens == 1:
-        return first[:, None]
-    (_, _, _, _, _), rest = jax.lax.scan(
-        step, (cache, first, pos0, done, seen), step_rngs
+    return step
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "model", "n_step_keys", "sampling", "eos_id",
+        "prefill_chunk_size",
+    ),
+)
+def _stream_prefill(
+    model,
+    params,
+    prompt_tokens: jax.Array,
+    pad_lens: jax.Array,
+    rng: jax.Array,
+    *,
+    n_step_keys: int,
+    sampling: SamplingConfig,
+    eos_id: Optional[int],
+    prefill_chunk_size: Optional[int],
+):
+    """Streaming phase 1: jit boundary over the SHARED
+    ``_prefill_and_first`` (the bit-parity contract lives there)."""
+    return _prefill_and_first(
+        model, params, prompt_tokens, pad_lens, rng,
+        n_step_keys=n_step_keys, sampling=sampling, eos_id=eos_id,
+        prefill_chunk_size=prefill_chunk_size,
     )
-    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("model", "sampling", "pad_id", "eos_id"),
+    donate_argnames=("cache", "seen"),
+)
+def _stream_chunk(
+    model,
+    params,
+    cache,
+    token: jax.Array,
+    pos: jax.Array,
+    done: jax.Array,
+    seen: jax.Array,
+    keys: jax.Array,
+    *,
+    sampling: SamplingConfig,
+    pad_id: int,
+    eos_id: Optional[int],
+):
+    """Streaming phase 2: decode ``len(keys)`` tokens from the carried
+    cache — the SHARED ``_decode_step`` body ``generate`` scans
+    (including the emitted-token feedback: done rows feed pad back),
+    scanned over this chunk's key slice. One compiled program serves
+    every full chunk of a stream AND every later stream with the same
+    shapes; the cache/seen buffers are donated so chunks update in
+    place."""
+    step = _decode_step(
+        _model_apply(model, params), token.shape[0],
+        sampling=sampling, pad_id=pad_id, eos_id=eos_id,
+    )
+    (cache, token, pos, done, seen), out = jax.lax.scan(
+        step, (cache, token, pos, done, seen), keys
+    )
+    return cache, token, pos, done, seen, out.T  # [B, chunk]
+
+
+def generate_stream(
+    model,
+    params,
+    prompts: Sequence[Sequence[int]],
+    *,
+    max_new_tokens: int,
+    chunk_size: int = 16,
+    sampling: SamplingConfig = SamplingConfig(),
+    pad_id: int = 0,
+    eos_id: Optional[int] = None,
+    seed: int = 0,
+    rng: Optional[jax.Array] = None,
+    prefill_chunk_size: Optional[int] = None,
+):
+    """Streaming decode: yields ``[B, n]`` int32 numpy chunks whose
+    concatenation is BIT-identical to ``generate``'s output under the
+    same rng (greedy, sampled, penalized — every knob), truncated early
+    when every row has passed its eos (the dropped tail is all pad).
+
+    The stream pays one host round trip per chunk (the natural yield
+    point) instead of per token; every full chunk reuses ONE compiled
+    program, so time-to-first-token is prefill + one chunk and the
+    steady rate approaches plain decode as chunk_size grows. First
+    yield carries ``chunk_size`` tokens (the prefill-sampled token
+    plus chunk_size - 1 steps), later yields ``chunk_size``, the tail
+    whatever remains.
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if chunk_size < 1:
+        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+    max_seq = getattr(getattr(model, "cfg", None), "max_seq_len", None)
+    tokens, pads = pad_prompts(prompts, pad_id)
+    p = tokens.shape[1]
+    if max_seq is not None and p + max_new_tokens - 1 > max_seq:
+        raise ValueError(
+            f"prompt ({p}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"the KV cache (max_seq_len={max_seq})"
+        )
+    if rng is None:
+        rng = jax.random.key(seed)
+    cache, token, pos, done, seen, step_keys = _stream_prefill(
+        model,
+        params,
+        jnp.asarray(tokens),
+        jnp.asarray(pads),
+        rng,
+        n_step_keys=max_new_tokens - 1,
+        sampling=sampling,
+        eos_id=eos_id,
+        prefill_chunk_size=prefill_chunk_size,
+    )
+    first = np.asarray(token)[:, None]
+    if max_new_tokens == 1:
+        yield first
+        return
+    emitted = 1
+    head: Optional[np.ndarray] = first  # rides the first yield
+    if chunk_size == 1:
+        # A 1-token chunk can't carry the head plus a step: the
+        # prefill-sampled token IS the first chunk.
+        yield head
+        head = None
+        if eos_id is not None and bool(np.asarray(done).all()):
+            return
+    while emitted < max_new_tokens:
+        n = min(
+            chunk_size - 1 if head is not None else chunk_size,
+            max_new_tokens - emitted,
+        )
+        cache, token, pos, done, seen, out = _stream_chunk(
+            model,
+            params,
+            cache,
+            token,
+            pos,
+            done,
+            seen,
+            step_keys[emitted - 1: emitted - 1 + n],
+            sampling=sampling,
+            pad_id=pad_id,
+            eos_id=eos_id,
+        )
+        chunk = np.asarray(out)
+        if head is not None:
+            chunk = np.concatenate([head, chunk], axis=1)
+            head = None
+        emitted += n
+        yield chunk
+        # After-yield: once every row is past eos the remaining
+        # emissions are all pad — stop instead of decoding dead air.
+        if eos_id is not None and bool(np.asarray(done).all()):
+            return
+
+
+def generate_text_stream(
+    model,
+    params,
+    prompts: Sequence[Sequence[int]],
+    *,
+    max_new_tokens: int,
+    chunk_size: int = 16,
+    sampling: SamplingConfig = SamplingConfig(),
+    pad_id: int = 0,
+    eos_id: Optional[int] = None,
+    seed: int = 0,
+    prefill_chunk_size: Optional[int] = None,
+):
+    """Ragged streaming wrapper: yields, per chunk, one ``list[int]``
+    of NEW tokens per row — rows stop emitting after their eos (the
+    eos itself is included), mirroring ``generate_text``'s truncation
+    row by row. Concatenating a row's chunks equals the row
+    ``generate_text`` returns."""
+    row_done = [False] * len(prompts)
+    for chunk in generate_stream(
+        model, params, prompts,
+        max_new_tokens=max_new_tokens, chunk_size=chunk_size,
+        sampling=sampling, pad_id=pad_id, eos_id=eos_id, seed=seed,
+        prefill_chunk_size=prefill_chunk_size,
+    ):
+        out: list[list[int]] = []
+        for i, row in enumerate(chunk):
+            toks = [] if row_done[i] else row.tolist()
+            if eos_id is not None and not row_done[i] and eos_id in toks:
+                toks = toks[: toks.index(eos_id) + 1]
+                row_done[i] = True
+            out.append(toks)
+        yield out
 
 
 def generate_text(

@@ -453,10 +453,14 @@ class _Pending:
     """One enqueued /generate request awaiting its tick."""
 
     __slots__ = ("prompts", "max_new", "sampling", "done", "outputs",
-                 "error", "batched_with")
+                 "error", "batched_with", "stream_q")
 
     def __init__(
-        self, prompts: list[list[int]], max_new: int, sampling=None
+        self,
+        prompts: list[list[int]],
+        max_new: int,
+        sampling=None,
+        stream_q=None,
     ):
         self.prompts = prompts
         self.max_new = max_new
@@ -464,6 +468,11 @@ class _Pending:
         # override makes this tick-compatible only with same-config
         # requests (the rng and transforms are shared per device call).
         self.sampling = sampling
+        # Streaming request: per-chunk outputs go onto this queue
+        # (lists of per-row new tokens, then a ("done",)/("error", e)
+        # sentinel). Stream requests run as SOLO ticks — their device
+        # work is a chunk loop, not one coalescible call.
+        self.stream_q = stream_q
         self.done = threading.Event()
         self.outputs: list | None = None
         self.error: Exception | None = None
@@ -540,8 +549,14 @@ class _Batcher:
     (default 64) caps rows per tick, the rest stay queued.
     """
 
-    def __init__(self, run_tick, metrics: Optional[_Metrics] = None):
+    def __init__(
+        self,
+        run_tick,
+        metrics: Optional[_Metrics] = None,
+        run_stream=None,
+    ):
         self._run_tick = run_tick
+        self._run_stream = run_stream
         self._metrics = metrics
         self._queue: list[_Pending] = []
         self._cv = threading.Condition()
@@ -565,6 +580,18 @@ class _Batcher:
             raise p.error
         return p.outputs, p.batched_with
 
+    def submit_stream(
+        self, prompts: list[list[int]], max_new: int, sampling, q
+    ) -> None:
+        """Enqueue a streaming request and return immediately — the
+        caller consumes per-chunk row outputs from ``q`` until the
+        ("done",)/("error", e) sentinel. Device order is still the
+        batcher thread's: the stream runs as its own tick."""
+        p = _Pending(prompts, max_new, sampling, stream_q=q)
+        with self._cv:
+            self._queue.append(p)
+            self._cv.notify()
+
     def _take_tick(self) -> list[_Pending]:
         with self._cv:
             while not self._queue:
@@ -584,10 +611,16 @@ class _Batcher:
             # same-config request may overtake it into this tick (only
             # config mismatches are diverted past it).
             budget_closed = False
+            solo = False
             for nxt in self._queue:
                 if not tick:
                     tick.append(nxt)
                     rows += len(nxt.prompts)
+                    # A streaming head runs alone: its device work is a
+                    # chunk LOOP, not one coalescible call.
+                    solo = nxt.stream_q is not None
+                elif solo or nxt.stream_q is not None:
+                    rest.append(nxt)
                 elif nxt.sampling != tick[0].sampling:
                     rest.append(nxt)
                 elif (
@@ -606,6 +639,11 @@ class _Batcher:
         """Run one coalesced device call for ``group``; raises on
         failure without touching the pendings (the caller decides
         whether to isolate)."""
+        if len(group) == 1 and group[0].stream_q is not None:
+            pend = group[0]
+            self._run_stream(pend)
+            pend.batched_with = 1
+            return
         all_prompts = [p for pend in group for p in pend.prompts]
         # Bucket the group's max_new to a power of two: the scan
         # length is a compiled-shape dimension, so arbitrary
@@ -651,6 +689,10 @@ class _Batcher:
             except Exception as e:  # noqa: BLE001 — serving loop
                 for pend in tick:
                     pend.error = e
+                    if pend.stream_q is not None:
+                        # The SSE handler is blocked on the queue, not
+                        # the done event — it needs the sentinel.
+                        pend.stream_q.put(("error", e))
             finally:
                 if self._metrics is not None:
                     self._metrics.inc(
@@ -699,7 +741,9 @@ class _Server:
             )
         self.port = port
         self._codec = None
-        self._batcher = _Batcher(self._run_tick, self.metrics)
+        self._batcher = _Batcher(
+            self._run_tick, self.metrics, run_stream=self._run_stream
+        )
         # Distinct per-request sampling configs admitted so far:
         # sampling is a compiled-program parameter, so an unbounded
         # variety would compile (and cache) unboundedly many programs.
@@ -772,14 +816,9 @@ class _Server:
         them, and the repetition penalty's seen-set never counts them
         (literal [0]*k prefixes would look like real tokens).
         """
-        if sampling is None:
-            sampling = self._sampling
-        seed = self._seed_base + self._tick_index
-        self._tick_index += 1
-        longest = _bucket(max(len(p) for p in prompts), 64)
-        padded, real_n = _pad_batch(prompts)
-        padded = padded + [[0] * longest]  # length-bucket filler row
-        model = self._model_for(longest, max_new)
+        sampling, seed, padded, real_n, model = self._tick_prep(
+            prompts, max_new, sampling
+        )
         if self._draft is not None:
             import dataclasses
 
@@ -832,6 +871,65 @@ class _Server:
         )
         return outs[:real_n]
 
+    def _tick_prep(self, prompts, max_new, sampling):
+        """ONE copy of the per-tick preamble shared by the coalesced
+        and streaming paths: env-default sampling resolution, the
+        monotonic tick seed (batcher thread only — no lock), prompt
+        length bucketing with the filler row, and the request-sized
+        cache variant. Returns (sampling, seed, padded, real_n,
+        model)."""
+        if sampling is None:
+            sampling = self._sampling
+        seed = self._seed_base + self._tick_index
+        self._tick_index += 1
+        longest = _bucket(max(len(p) for p in prompts), 64)
+        padded, real_n = _pad_batch(prompts)
+        padded = padded + [[0] * longest]  # length-bucket filler row
+        model = self._model_for(longest, max_new)
+        return sampling, seed, padded, real_n, model
+
+    def _run_stream(self, pend) -> None:
+        """Streaming tick (batcher thread only): the ``_tick_prep``
+        preamble, then ``generate_text_stream``'s chunk loop — each
+        chunk's per-row new tokens go onto the pending's queue the
+        moment they exist. ``max_new`` runs at the same pow-2 bucket
+        the coalesced path compiles (arbitrary per-request values
+        would each compile fresh prefill/tail programs); emission is
+        truncated to the REQUESTED length on the way out. One compiled
+        chunk program serves every full chunk (and every later stream
+        with the same shapes), so time-to-first-token is prefill + one
+        chunk instead of the whole completion."""
+        from tpufw.infer import generate_text_stream
+
+        run_new = 1
+        while run_new < pend.max_new:
+            run_new *= 2
+        sampling, seed, padded, real_n, model = self._tick_prep(
+            pend.prompts, run_new, pend.sampling
+        )
+        emitted = 0  # live rows advance in lockstep; eos rows yield []
+        n_tokens = 0  # total across rows (the metric the batch path counts)
+        for chunk in generate_text_stream(
+            model,
+            self.params,
+            padded,
+            max_new_tokens=run_new,
+            chunk_size=env_int("stream_chunk", 16),
+            sampling=sampling,
+            seed=seed,
+            eos_id=self._eos_id,
+            prefill_chunk_size=env_int("prefill_chunk", 0) or None,
+        ):
+            budget = pend.max_new - emitted
+            rows = [r[:budget] for r in chunk[:real_n]]
+            emitted += max((len(r) for r in rows), default=0)
+            n_tokens += sum(len(r) for r in rows)
+            pend.stream_q.put(("chunk", rows))
+            if emitted >= pend.max_new:
+                break  # bucketed tail beyond the request: stop early
+        self.metrics.inc("tokens_generated_total", n_tokens)
+        pend.stream_q.put(("done", n_tokens))
+
     def generate(
         self, prompts: list[list[int]], max_new: int, sampling=None
     ):
@@ -839,6 +937,24 @@ class _Server:
         this device tick — surfaced in the response for observability
         (and the concurrency test pins coalescing actually happens)."""
         return self._batcher.submit(prompts, max_new, sampling)
+
+    def generate_stream(
+        self, prompts: list[list[int]], max_new: int, sampling=None
+    ):
+        """Queue-backed streaming: yields per-chunk row outputs as the
+        batcher produces them; raises the tick's error if it failed."""
+        import queue as _queue
+
+        q: _queue.Queue = _queue.Queue()
+        self._batcher.submit_stream(prompts, max_new, sampling, q)
+        while True:
+            kind, payload = q.get()
+            if kind == "chunk":
+                yield payload
+            elif kind == "done":
+                return
+            else:
+                raise payload
 
     def serve_forever(self):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -967,6 +1083,78 @@ class _Server:
                                 "(each compiles a program); reuse an "
                                 "earlier configuration"
                             )
+                    if bool(req.get("stream", False)):
+                        # SSE streaming: chunks of per-row NEW token
+                        # ids as the device produces them, then a done
+                        # event (with full texts for "texts" requests —
+                        # partial-sequence decodes can split multibyte
+                        # characters, so text rides the final event).
+                        # With a draft model configured the request
+                        # degrades gracefully: the speculative path has
+                        # no chunk loop, so the whole completion
+                        # arrives as ONE chunk event — same wire
+                        # format, no 400.
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "text/event-stream"
+                        )
+                        self.send_header("Cache-Control", "no-cache")
+                        self.end_headers()
+                        # Headers are OUT: from here every failure must
+                        # end as an SSE event (or a silent stop on a
+                        # dead socket) — a second HTTP status line would
+                        # corrupt the stream, so nothing below may
+                        # escape to the outer 400 handler.
+                        dead = False
+
+                        def event(obj) -> None:
+                            nonlocal dead
+                            if dead:
+                                return
+                            try:
+                                self.wfile.write(
+                                    b"data: "
+                                    + json.dumps(obj).encode()
+                                    + b"\n\n"
+                                )
+                                self.wfile.flush()
+                            except OSError:
+                                # Client left mid-stream — the normal
+                                # way SSE consumers disconnect. Stop
+                                # writing; the generator loop below
+                                # still drains the batcher's queue.
+                                dead = True
+
+                        rows_acc = [[] for _ in prompts]
+                        try:
+                            if outer._draft is not None:
+                                outs, _bw = outer.generate(
+                                    prompts, max_new, sampling
+                                )
+                                rows_acc = outs
+                                event({"outputs": outs})
+                            else:
+                                for rows in outer.generate_stream(
+                                    prompts, max_new, sampling
+                                ):
+                                    for acc, r in zip(rows_acc, rows):
+                                        acc.extend(r)
+                                    event({"outputs": rows})
+                            final = {"done": True}
+                            if as_text:
+                                # Inside the try: a decode failure must
+                                # surface as an error EVENT, not a 400
+                                # line spliced into the stream body.
+                                final["texts"] = [
+                                    decode(o) for o in rows_acc
+                                ]
+                            event(final)
+                        except Exception as e:  # noqa: BLE001
+                            outer.metrics.inc("request_errors_total")
+                            event(
+                                {"error": f"{type(e).__name__}: {e}"}
+                            )
+                        return
                     outs, batched_with = outer.generate(
                         prompts, max_new, sampling
                     )
