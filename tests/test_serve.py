@@ -242,6 +242,76 @@ def test_http_server_streaming(tiny_env, monkeypatch):
     srv.httpd.shutdown()
 
 
+def test_http_server_openai_compat(tiny_env):
+    """`/v1/completions` speaks the OpenAI completions shape: string /
+    token-list prompts, max_tokens, choices with text + finish_reason,
+    usage accounting; outputs equal the native endpoint's for the same
+    prompt; unsupported OpenAI knobs 400 with the alternative named."""
+    import time
+
+    from tpufw.workloads.serve import _Server
+
+    srv = _Server(port=0, max_new_tokens=8)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    deadline = time.time() + 30
+    while not hasattr(srv, "httpd") and time.time() < deadline:
+        time.sleep(0.05)
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def post(path, body):
+        req = urllib.request.Request(
+            base + path,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return json.loads(resp.read())
+
+    native = post(
+        "/generate", {"texts": ["hi"], "max_new_tokens": 4}
+    )
+    out = post(
+        "/v1/completions",
+        {"model": "tpufw-test", "prompt": "hi", "max_tokens": 4},
+    )
+    assert out["object"] == "text_completion"
+    assert out["model"] == "tpufw-test"
+    assert out["choices"][0]["text"] == native["texts"][0]
+    assert out["choices"][0]["finish_reason"] == "length"
+    assert out["usage"]["completion_tokens"] == 4
+    assert (
+        out["usage"]["total_tokens"]
+        == out["usage"]["prompt_tokens"] + 4
+    )
+
+    # Token-list prompt form; text still decoded in the response.
+    tok = post(
+        "/v1/completions", {"prompt": [1, 5, 9], "max_tokens": 4}
+    )
+    assert len(tok["choices"]) == 1
+    assert isinstance(tok["choices"][0]["text"], str)
+
+    # Unsupported knobs 400 loudly with the alternative named.
+    for bad in (
+        {"prompt": "hi", "stream": True},
+        {"prompt": "hi", "n": 2},
+        {"max_tokens": 4},  # no prompt
+    ):
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps(bad).encode(),
+            method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raise AssertionError(f"expected 400 for {bad}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    srv.httpd.shutdown()
+
+
 def test_sampling_env_resolution(clear_tpufw_env):
     clear_tpufw_env.setenv("TPUFW_TEMPERATURE", "0.7")
     clear_tpufw_env.setenv("TPUFW_TOP_K", "40")

@@ -449,6 +449,112 @@ def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
     ]
 
 
+def _oai_to_native(req: dict) -> dict:
+    """OpenAI `/v1/completions` request -> the native `/generate`
+    shape, so users switching stacks can point an existing client at
+    the server. Supported: `prompt` (string, list of strings, token
+    list, or list of token lists), `max_tokens`, `temperature`,
+    `top_p`, `seed`-free determinism per the tick-seed contract.
+    Unsupported knobs fail loudly with the native alternative named
+    (an OpenAI client silently getting different semantics is worse
+    than a 400)."""
+    if "prompt" not in req:
+        raise ValueError("prompt is required")
+    if req.get("stream"):
+        raise ValueError(
+            "stream is not supported on /v1/completions; use "
+            "/generate with \"stream\": true (SSE)"
+        )
+    # `n: 1` is the OpenAI default and many SDK wrappers send it
+    # explicitly — it requests exactly this server's behavior.
+    if req.get("n") not in (None, 1):
+        raise ValueError(
+            "n > 1 is not supported on /v1/completions; post the "
+            "prompt n times (ticks draw fresh seeds)"
+        )
+    # Semantics-changing knobs must fail LOUDLY — a client silently
+    # getting different semantics is worse than a 400 — but values
+    # that REQUEST the default behavior pass (SDK wrappers send
+    # explicit defaults: echo: false, zero penalties, best_of: 1,
+    # stop: null/[]). logprobs: 0 is meaningful (sampled-token
+    # logprobs, zero alternatives), so only None passes there.
+    defaults = {
+        "logprobs": (None,),
+        "echo": (None, False),
+        "best_of": (None, 1),
+        "presence_penalty": (None, 0, 0.0),
+        "frequency_penalty": (None, 0, 0.0),
+        "stop": (None, "", []),
+    }
+    alts = {
+        "logprobs": "not supported",
+        "echo": "prepend the prompt client-side",
+        "best_of": "post the prompt best_of times and rank",
+        "presence_penalty": "use repetition_penalty on /generate",
+        "frequency_penalty": "use repetition_penalty on /generate",
+        "stop": "set TPUFW_EOS_ID on the server",
+    }
+    for knob, ok_values in defaults.items():
+        if knob in req and req[knob] not in ok_values:
+            raise ValueError(
+                f"{knob} is not supported on /v1/completions; "
+                f"{alts[knob]}"
+            )
+    p = req["prompt"]
+    native: dict = {"_oai_model": req.get("model", "")}
+    if isinstance(p, str):
+        native["texts"] = [p]
+    elif isinstance(p, list) and p and all(
+        isinstance(x, str) for x in p
+    ):
+        native["texts"] = p
+    elif isinstance(p, list) and p and all(
+        isinstance(x, int) for x in p
+    ):
+        native["prompts"] = [p]
+    else:
+        native["prompts"] = p  # [[int]] — /generate validates
+    if "max_tokens" in req:
+        native["max_new_tokens"] = req["max_tokens"]
+    for knob in ("temperature", "top_p"):
+        if knob in req:
+            native[knob] = req[knob]
+    return native
+
+
+def _oai_response(
+    outs, texts, prompts, max_new: int, model: str
+) -> dict:
+    """OpenAI text_completion response shape. finish_reason: a row
+    shorter than max_new ended at the server's eos ("stop"), otherwise
+    it ran out of budget ("length")."""
+    import uuid
+
+    return {
+        "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model or "tpufw",
+        "choices": [
+            {
+                "text": texts[i],
+                "index": i,
+                "logprobs": None,
+                "finish_reason": (
+                    "stop" if len(outs[i]) < max_new else "length"
+                ),
+            }
+            for i in range(len(outs))
+        ],
+        "usage": {
+            "prompt_tokens": sum(len(p) for p in prompts),
+            "completion_tokens": sum(len(o) for o in outs),
+            "total_tokens": sum(len(p) for p in prompts)
+            + sum(len(o) for o in outs),
+        },
+    }
+
+
 class _Pending:
     """One enqueued /generate request awaiting its tick."""
 
@@ -1004,7 +1110,8 @@ class _Server:
                     self._reply(404, {"error": "unknown path"})
 
             def do_POST(self):
-                if self.path != "/generate":
+                oai = self.path == "/v1/completions"
+                if self.path != "/generate" and not oai:
                     self._reply(404, {"error": "unknown path"})
                     return
                 outer.metrics.inc("requests_total")
@@ -1012,6 +1119,8 @@ class _Server:
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     req = json.loads(self.rfile.read(n) or b"{}")
+                    if oai:
+                        req = _oai_to_native(req)
                     as_text = "texts" in req
                     if as_text:
                         texts = req["texts"]
@@ -1158,6 +1267,20 @@ class _Server:
                     outs, batched_with = outer.generate(
                         prompts, max_new, sampling
                     )
+                    if oai:
+                        # OpenAI responses carry text for token-id
+                        # prompts too — decode through the codec.
+                        self._reply(
+                            200,
+                            _oai_response(
+                                outs,
+                                [outer.codec()[1](o) for o in outs],
+                                prompts,
+                                max_new,
+                                model=str(req.get("_oai_model", "")),
+                            ),
+                        )
+                        return
                     payload = {
                         "outputs": outs,
                         "batched_with": batched_with,
