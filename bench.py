@@ -414,12 +414,19 @@ def _orchestrate() -> int:
 # ----------------------------------------------------------------------
 
 
-def _timed_decode(model, params, prompts, pads, n_new: int):
-    """(wall seconds for one full generate) after a compile+warm call.
+def _timed_decode(model, params, prompts, pads, n_new: int) -> float:
+    """Wall seconds for one full generate, after a compile+warm call.
     ONE copy of the decode timing discipline: np.asarray value fetch,
     NOT block_until_ready — through the tunneled backend the latter can
     return while the program is still executing (measured r3), which
-    would fake the rate. Shared by the Llama and MLA decode tiers."""
+    would fake the rate. Shared by the Llama and MLA decode tiers.
+
+    Returns ONLY the float: an earlier version also returned the gen
+    closure, and every caller's ``dt, _ = ...`` binding kept the
+    closure — and the params it captured — alive until ``_`` was next
+    rebound. Harmless at 596M (~1.2 GB bf16); fatal once the 8B tiers
+    entered the sequence (BENCH_r5_watch.json: every tier after
+    int8_8b's ~8.5 GB hit RESOURCE_EXHAUSTED against the dead tree)."""
     import numpy as _np
 
     import jax
@@ -435,7 +442,27 @@ def _timed_decode(model, params, prompts, pads, n_new: int):
     _np.asarray(gen())  # compile + warm
     t0 = time.perf_counter()
     _np.asarray(gen())
-    return time.perf_counter() - t0, gen
+    return time.perf_counter() - t0
+
+
+def _drop_caches(jax_mod) -> None:
+    """Free a finished tier's executables: the jit caches pin compiled
+    programs and their embedded device constants, and no tier's cache
+    serves a later one (every tier compiles a different program).
+    Measured necessity: BENCH_r5_watch.json, where ~8.5 GB retained
+    after the 8B tiers drove every later tier to RESOURCE_EXHAUSTED.
+    Never raises — tier cleanup runs outside the tiers' try/except, and
+    an exception here would escape _worker and discard every measured
+    result (the orchestrator only salvages stdout on the watchdog-kill
+    path)."""
+    import gc
+
+    try:
+        gc.collect()
+        jax_mod.clear_caches()
+        gc.collect()
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write(f"bench: cache drop failed (ignored): {e}\n")
 
 
 def _is_oom(e: Exception) -> bool:
@@ -815,6 +842,7 @@ def _worker() -> int:
                 block8b.update(err)
             else:
                 block8b = err
+        _drop_caches(jax)
     _attach("block8b", block8b)
 
     # int8 8B decode tier (VERDICT r4 item 2b): the FULL Llama-3-8B
@@ -861,7 +889,7 @@ def _worker() -> int:
                 ]
             )
             try:
-                edt, _ = _timed_decode(
+                edt = _timed_decode(
                     e_model, e_params, e_prompts, e_pads, e_new
                 )
             finally:
@@ -882,6 +910,7 @@ def _worker() -> int:
             }
         except Exception as e:  # noqa: BLE001
             int8_8b = {"error": f"{type(e).__name__}: {e}"[:500]}
+        _drop_caches(jax)
     _attach("int8_8b", int8_8b)
 
     packed = None
@@ -1001,7 +1030,7 @@ def _worker() -> int:
                 ]
             )
 
-            dt, _ = _timed_decode(
+            dt = _timed_decode(
                 dmodel, d_params, prompts, pads, d_new
             )
             decode = {
@@ -1029,7 +1058,7 @@ def _worker() -> int:
                         _dc.replace(dcfg, quantized_weights=True)
                     )
 
-                    qdt, _ = _timed_decode(
+                    qdt = _timed_decode(
                         q_model, q_params, prompts, pads, d_new
                     )
                     decode["int8_tokens_per_sec_per_chip"] = round(
@@ -1066,7 +1095,7 @@ def _worker() -> int:
                     u_params = unstack_layer_params(
                         d_params, donate=True
                     )
-                    udt, _ = _timed_decode(
+                    udt = _timed_decode(
                         u_model, u_params, prompts, pads, d_new
                     )
                     decode["unroll_tokens_per_sec_per_chip"] = round(
@@ -1081,6 +1110,7 @@ def _worker() -> int:
             del d_params
         except Exception as e:  # noqa: BLE001
             decode = {"error": f"{type(e).__name__}: {e}"[:500]}
+        _drop_caches(jax)
     _attach("decode", decode)
 
     # MLA decode tier: the DeepSeek latent cache's serving throughput
@@ -1125,7 +1155,7 @@ def _worker() -> int:
                 ]
             )
 
-            mdt, _ = _timed_decode(
+            mdt = _timed_decode(
                 mmodel, m_params, m_prompts, m_pads, m_new
             )
             mla_decode = {
@@ -1157,7 +1187,7 @@ def _worker() -> int:
                     mu_params = unstack_layer_params(
                         m_params, donate=True
                     )
-                    mudt, _ = _timed_decode(
+                    mudt = _timed_decode(
                         mu_model, mu_params, m_prompts, m_pads, m_new
                     )
                     mla_decode["unroll_tokens_per_sec_per_chip"] = (
@@ -1172,6 +1202,7 @@ def _worker() -> int:
             del m_params
         except Exception as e:  # noqa: BLE001
             mla_decode = {"error": f"{type(e).__name__}: {e}"[:500]}
+        _drop_caches(jax)
     _attach("mla_decode", mla_decode)
 
     # ResNet tier (BASELINE config 2: ResNet-50 on one v5e chip) —
