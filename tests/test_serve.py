@@ -73,14 +73,18 @@ def test_batch_generate_restores_checkpoint(tiny_env):
 
 
 def test_batch_generate_unrolled_matches_scanned(tiny_env, monkeypatch):
-    """TPUFW_DECODE_UNROLL=1 serves the unscanned twin from the SAME
-    scanned checkpoint with identical greedy outputs — the whole
-    env -> build_generator -> unstack -> generate path."""
+    """The unrolled default serves the unscanned twin from the SAME
+    scanned checkpoint with identical greedy outputs as the scanned
+    path — the whole env -> build_generator -> unstack -> generate
+    path. The scanned baseline is pinned with TPUFW_DECODE_UNROLL=0
+    (unroll is the serving default since the r5 hardware measurement);
+    the unrolled run relies on the default, covering it."""
     from tpufw.workloads.serve import run_batch
 
     prompts = [[1, 5, 9], [2]]
+    monkeypatch.setenv("TPUFW_DECODE_UNROLL", "0")
     want = run_batch(prompts, max_new_tokens=4)
-    monkeypatch.setenv("TPUFW_DECODE_UNROLL", "1")
+    monkeypatch.delenv("TPUFW_DECODE_UNROLL")
     got = run_batch(prompts, max_new_tokens=4)
     assert [r["output"] for r in got] == [r["output"] for r in want]
 

@@ -153,18 +153,24 @@ def build_generator():
 
 
 def _maybe_unroll(model_cfg, params):
-    """TPUFW_DECODE_UNROLL=1: decode with the UNSCANNED layer stack —
-    the scanned trunk's decode loop slices its stacked [L, ...] weights
-    per layer per step, which the unrolled twin avoids (measured 1.7x
-    on the CPU smoke profile; scripts/decode_profile.py carries the
-    hardware experiment). Checkpoints stay scanned on disk; the param
-    tree is unstacked in memory (tpufw.models.unstack_layer_params).
-    Trace/compile time grows with n_layers — a serving-startup cost.
-    Applied to EVERY build_generator source, after quantization (the
-    unstack is tree-generic, quantized leaves included)."""
+    """Decode with the UNSCANNED layer stack (default ON) — the scanned
+    trunk's decode loop slices its stacked [L, ...] weights per layer
+    per step, which the unrolled twin avoids. Measured on the v5e chip
+    (docs/evidence/DECODE_PROFILE_r5.jsonl, 2026-08-01): 1.16x decode
+    throughput on the Llama bench model (1.05x on MLA), at ~10x the
+    compile time per serving shape bucket (38 s vs 4 s). The default
+    bucket is compiled by _Server._warmup before the listener binds;
+    OTHER buckets pay the bigger compile on their first live hit — a
+    compile-latency/steady-throughput trade serving takes by default
+    per VERDICT r4 item 4. TPUFW_DECODE_UNROLL=0 opts out (e.g.
+    compile-latency-sensitive dev loops, very deep models).
+    Checkpoints stay scanned on disk; the param tree is unstacked in
+    memory (tpufw.models.unstack_layer_params). Applied to EVERY
+    build_generator source, after quantization (the unstack is
+    tree-generic, quantized leaves included)."""
     import dataclasses as _dc
 
-    if not env_int("decode_unroll", 0):
+    if not env_int("decode_unroll", 1):
         return model_cfg, params
     from tpufw.models import unstack_layer_params
 
@@ -866,6 +872,39 @@ class _Server:
         # the rng entirely, so default traffic is unaffected.
         self._seed_base = env_int("seed", 0)
         self._tick_index = 0
+        if env_int("warmup", 1):
+            self._warmup()
+
+    def _warmup(self) -> None:
+        """Compile the default serving bucket BEFORE the listener
+        binds. Decode is unrolled by default, which costs ~38 s per
+        fresh shape bucket on the v5e chip (vs ~4 s scanned) — without
+        warmup that stall lands on the FIRST LIVE REQUEST of each
+        bucket, well past typical client timeouts. One synthetic tick
+        through _run_tick compiles prefill + decode (+ the draft, when
+        speculation is on) for the (batch 1, shortest prompt bucket,
+        default max_new) shapes — the bucket default-config traffic
+        hits first; other buckets still pay on first hit
+        (docs/WORKFLOWS.md). The tick counter and speculative counters
+        are restored afterwards so warmup is invisible to seed replay
+        and metrics — safe because the listener is not up yet, so
+        nothing can scrape or enqueue during the window. Disable with
+        TPUFW_WARMUP=0 (e.g. compile-latency-insensitive batch jobs)."""
+        import sys
+
+        run_new = _pow2_ceil(self.default_new)
+        tick0 = self._tick_index
+        try:
+            self._run_tick([[1]], run_new, None)
+        except Exception as e:  # noqa: BLE001
+            # Warmup is an optimization; never block serving on it.
+            print(f"serve: warmup skipped: {e}", file=sys.stderr)
+        finally:
+            self._tick_index = tick0
+            if self._draft is not None:
+                with self.metrics._lock:
+                    self.metrics._c["spec_iterations_total"] = 0.0
+                    self.metrics._c["spec_emitted_total"] = 0.0
 
     def admit_sampling(self, sampling) -> bool:
         """True if this non-default config is within the server's
