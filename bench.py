@@ -783,6 +783,13 @@ def _worker() -> int:
                 n_layers=1,
                 max_seq_len=8192,
                 remat_policy="attn_out",
+                # The production training posture (bench_model_config
+                # and the headline tier train through the Pallas flash
+                # kernel). LLAMA_CONFIGS defaults to the naive xla
+                # path, whose f32 [H, T, T] score matrices are 8 GB
+                # EACH at seq 8192 — the r5 window's all-batches-OOM
+                # compile failure (docs/PERF.md, block8b section).
+                attention_backend="flash",
             )
             for tag, b_seq, b_ladder in (
                 ("seq_2048", 2048, (16, 8, 4)),
