@@ -1,0 +1,118 @@
+"""int8 full-8B decode: batch sweep + unrolled composition on the one
+v5e chip — the serving-default posture (int8 weights, unrolled layers)
+at the north-star model shape. Extends the bench int8_8b tier (batch 8
+scanned: 512 tok/s/chip, 66% of the weight-streaming floor) to the
+batch sizes continuous batching actually runs.
+
+One JSON line per case to docs/evidence/INT8_8B_SWEEP_r5.jsonl.
+"""
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+OUT = "/root/repo/docs/evidence/INT8_8B_SWEEP_r5.jsonl"
+_TAGS: dict = {}
+
+
+def emit(row):
+    row = {"t": round(time.time(), 1), **_TAGS, **row}
+    print(json.dumps(row), flush=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def main():
+    from tpufw.utils.profiling import enable_compile_cache
+
+    enable_compile_cache()
+
+    import gc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpufw.infer import SamplingConfig, cast_decode_params, generate
+    from tpufw.models import LLAMA_CONFIGS, Llama, unstack_layer_params
+
+    d = jax.devices()[0]
+    _TAGS.update(platform=d.platform)
+    emit({"event": "start", "kind": d.device_kind})
+
+    prompt, new = 128, 128
+    base = dataclasses.replace(
+        LLAMA_CONFIGS["llama3_8b"].decode_config(),
+        max_seq_len=prompt + new,
+        quantized_weights=True,
+    )
+
+    def timed(model, params, b):
+        prompts = jax.random.randint(
+            jax.random.key(0), (b, prompt), 0, base.vocab_size
+        )
+        pads = jnp.zeros((b,), jnp.int32)
+
+        def gen():
+            return generate(
+                model, params, prompts, pads, jax.random.key(2),
+                max_new_tokens=new, sampling=SamplingConfig(),
+            )
+
+        np.asarray(gen())  # compile+warm
+        t0 = time.perf_counter()
+        np.asarray(gen())
+        return time.perf_counter() - t0
+
+    model = Llama(base)
+    params = cast_decode_params(
+        jax.jit(model.init)(
+            jax.random.key(1),
+            jnp.zeros((1, prompt), jnp.int32),
+        )["params"]
+    )
+    u_params = None
+    try:
+        for b in (8, 16, 32, 64):
+            try:
+                dt = timed(model, params, b)
+                emit({
+                    "case": f"int8_scanned_b{b}",
+                    "batch": b,
+                    "tok_per_s": round(b * new / dt, 1),
+                })
+            except Exception as e:  # noqa: BLE001
+                emit({"case": f"int8_scanned_b{b}",
+                      "error": f"{type(e).__name__}: {e}"[:300]})
+        # Serving-default composition: int8 x unrolled (32 unscanned
+        # layers; compile grows with n_layers - measure it too).
+        u_model = Llama(dataclasses.replace(base, scan_layers=False))
+        u_params = unstack_layer_params(params, donate=True)
+        params = None
+        for b in (8, 32):
+            try:
+                c0 = time.perf_counter()
+                dt = timed(u_model, u_params, b)
+                emit({
+                    "case": f"int8_unrolled_b{b}",
+                    "batch": b,
+                    "tok_per_s": round(b * new / dt, 1),
+                    "compile_plus_2runs_s": round(
+                        time.perf_counter() - c0, 1
+                    ),
+                })
+            except Exception as e:  # noqa: BLE001
+                emit({"case": f"int8_unrolled_b{b}",
+                      "error": f"{type(e).__name__}: {e}"[:300]})
+    finally:
+        del params, u_params
+        gc.collect()
+    emit({"event": "done"})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
