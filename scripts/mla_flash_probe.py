@@ -1,0 +1,40 @@
+"""Validate the MLA flash path on real Mosaic at the deepseek_mla_bench
+shape (qk_head_dim 192 = 128 nope + 64 rope, v padded 128->192 inside
+the dispatch) - the one flipped preset with no banked flash hardware
+run (r5 review finding). Trains 3 steps; prints per-window MFU."""
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+from tpufw.utils.profiling import enable_compile_cache
+
+enable_compile_cache()
+
+from tpufw.mesh import MeshConfig
+from tpufw.models import DEEPSEEK_CONFIGS, Deepseek
+from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+import dataclasses
+cfg = DEEPSEEK_CONFIGS["deepseek_mla_bench"]
+import os
+if os.environ.get("MLA_PROBE_XLA") == "1":
+    cfg = dataclasses.replace(cfg, attention_backend="xla")
+if os.environ.get("MLA_PROBE_B8") == "1":
+    _B = 8
+else:
+    _B = 2
+
+trainer = Trainer(
+    Deepseek(cfg),
+    TrainerConfig(
+        batch_size=_B, seq_len=2048, total_steps=3, lr=1e-4,
+        warmup_steps=2, loss_chunk_size=512, log_every=1, sync_every=2,
+    ),
+    MeshConfig(),
+)
+trainer.init_state()
+hist = trainer.run(
+    synthetic_batches(_B, 2048, cfg.vocab_size),
+    model_flops_per_token=cfg.flops_per_token(2047),
+)
+print("MLA_PROBE_OK", cfg.attention_backend, _B, [round(m.mfu, 4) for m in hist])

@@ -152,8 +152,15 @@ class DeepseekConfig:
         return self.qk_nope_head_dim + self.qk_rope_head_dim
 
     def decode_config(self) -> "DeepseekConfig":
-        """Inference twin: latent KV cache on, remat off."""
-        return dataclasses.replace(self, decode=True, remat=False)
+        """Inference twin: latent KV cache on, remat off. The backend
+        resets to "xla" to honor the family-wide decode contract
+        (llama/gemma do the same): the absorbed-latent decode path
+        hand-rolls its attention and never reads the field today, but
+        a flash-defaulted train preset must not leak "flash" into a
+        decode config that future code may consult."""
+        return dataclasses.replace(
+            self, decode=True, remat=False, attention_backend="xla"
+        )
 
     def n_params(self, include_embed: bool = True) -> int:
         d, l, h = self.d_model, self.n_layers, self.n_heads
@@ -690,5 +697,6 @@ DEEPSEEK_CONFIGS: dict[str, DeepseekConfig] = {
         v_head_dim=128,
         d_ff=6144,
         max_seq_len=4096,
+        attention_backend="flash",
     ),
 }

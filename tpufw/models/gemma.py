@@ -207,13 +207,15 @@ class Gemma(nn.Module):
 
 
 GEMMA_CONFIGS: dict[str, GemmaConfig] = {
-    "gemma2_2b": GemmaConfig(),  # 2.6B: the HF google/gemma-2-2b shape
+    "gemma2_2b": GemmaConfig(attention_backend="flash"),
+    # 2.6B: the HF google/gemma-2-2b shape
     "gemma2_9b": GemmaConfig(
         d_model=3584,
         n_layers=42,
         n_heads=16,
         n_kv_heads=8,
         d_ff=14_336,
+        attention_backend="flash",
     ),
     "gemma2_tiny": GemmaConfig(
         vocab_size=256,

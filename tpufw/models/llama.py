@@ -200,14 +200,25 @@ class LlamaConfig:
 
 # Presets. 8B matches Meta's Llama-3-8B shape; the proxies are the same
 # architecture scaled to fit one v5e chip (16 GiB HBM) for bench/smoke runs.
+#
+# Backend policy: production-size presets (here and in the mixtral/
+# gemma/deepseek families) train through attention_backend="flash" —
+# the naive xla path materializes f32 [H, T, T] scores, which at
+# seq 8192 / 32 heads is 8 GB PER TENSOR (measured compile-OOM, r5;
+# docs/PERF.md block8b section) and cost 11 MFU points even where it
+# fit. Tiny test presets stay on "xla": the suite runs them on CPU,
+# where flash means the Pallas interpreter (slow), and the xla path is
+# the reference the flash kernel is parity-tested against.
+# decode_config() resets the backend for the KV-cache path.
 LLAMA_CONFIGS: dict[str, LlamaConfig] = {
-    "llama3_8b": LlamaConfig(),
+    "llama3_8b": LlamaConfig(attention_backend="flash"),
     # Llama-3.1-8B: same shape as 3.0, llama3 rope transform (Meta's
     # published scaling params are RopeScaling's defaults), 128k
     # context window.
     "llama31_8b": LlamaConfig(
         max_seq_len=131_072,
         rope_scaling=RopeScaling(),
+        attention_backend="flash",
     ),
     "llama3_1b_proxy": LlamaConfig(
         vocab_size=32_768,
@@ -218,6 +229,7 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
         head_dim=128,
         d_ff=8192,
         max_seq_len=4096,
+        attention_backend="flash",
     ),
     "llama3_tiny": LlamaConfig(
         vocab_size=256,
@@ -243,6 +255,7 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
         rope_theta=10_000.0,
         max_seq_len=32_768,
         sliding_window=4096,
+        attention_backend="flash",
     ),
     "mistral_tiny": LlamaConfig(
         vocab_size=256,
@@ -270,6 +283,7 @@ LLAMA_CONFIGS: dict[str, LlamaConfig] = {
         rms_eps=1e-6,
         max_seq_len=32_768,
         attention_qkv_bias=True,
+        attention_backend="flash",
     ),
     "qwen25_tiny": LlamaConfig(
         vocab_size=256,

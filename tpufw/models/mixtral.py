@@ -85,6 +85,7 @@ MIXTRAL_CONFIGS: dict[str, MixtralConfig] = {
         max_seq_len=32_768,
         n_experts=8,
         experts_per_token=2,
+        attention_backend="flash",
     ),
     "mixtral_tiny": MixtralConfig(
         vocab_size=256,
