@@ -716,3 +716,32 @@ def test_http_server_speculative_draft(tiny_env, monkeypatch):
     assert all(len(o) == 6 for o in sampled)
     assert len(penalized) == len(prompts)
     assert all(len(o) == 6 for o in penalized)
+
+
+def test_warmup_invisible_to_metrics_and_seed_replay(
+    tiny_env, monkeypatch
+):
+    """_Server warmup (default on) pre-compiles the default bucket but
+    must be invisible: tick counter back at 0 (seed replay unchanged)
+    and no counter movement — the warmup runs before the listener
+    binds, so nothing can observe the interim state. A spy on
+    _run_tick proves the warmup actually RAN (it swallows exceptions
+    and TPUFW_WARMUP=0 skips it, either of which would make the
+    post-state assertions vacuously true)."""
+    from tpufw.workloads import serve as serve_mod
+
+    calls = []
+    real = serve_mod._Server._run_tick
+
+    def spy(self, prompts, max_new, sampling):
+        calls.append((len(prompts), max_new))
+        return real(self, prompts, max_new, sampling)
+
+    monkeypatch.setattr(serve_mod._Server, "_run_tick", spy)
+    srv = serve_mod._Server(port=0, max_new_tokens=4)
+    assert calls, "warmup never invoked _run_tick"
+    assert srv._tick_index == 0
+    rendered = srv.metrics.render({})
+    for line in rendered.splitlines():
+        if line.startswith("tpufw_serve_") and not line.startswith("#"):
+            assert line.endswith(" 0"), line
