@@ -1,0 +1,133 @@
+"""Speculative decoding on the real chip: component costs + realized
+throughput with a random-weight draft.
+
+Random weights make ACCEPTANCE adversarial (draft/target argmax
+agreement over a 32k vocab is ~chance), so the realized tok/s here is
+the implementation's floor, not a speedup claim. What the probe
+actually pins on hardware:
+  - draft-step and verify-step costs (time/iteration = k*draft +
+    verify + host glue), measured through the REAL speculative path;
+  - measured acceptance (stats emitted/iterations);
+  - plain-decode tok/s on the same target for the break-even algebra:
+    speculation wins when E[accepted+1] / iter_time > 1 / plain_step.
+One JSON row per case to docs/evidence/SPEC_DECODE_r5.jsonl.
+"""
+import dataclasses
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+OUT = "/root/repo/docs/evidence/SPEC_DECODE_r5.jsonl"
+_TAGS: dict = {}
+
+
+def emit(row):
+    row = {"t": round(time.time(), 1), **_TAGS, **row}
+    print(json.dumps(row), flush=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def main():
+    from tpufw.utils.profiling import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+    import numpy as np
+
+    from tpufw.configs.presets import bench_model_config
+    from tpufw.infer import (
+        SamplingConfig,
+        cast_decode_params,
+        generate_text,
+        speculative_generate_text,
+    )
+    from tpufw.models import Llama
+
+    d = jax.devices()[0]
+    _TAGS.update(platform=d.platform)
+    emit({"event": "start", "kind": d.device_kind})
+
+    b, prompt_len, new = 8, 128, 128
+    tcfg = dataclasses.replace(
+        bench_model_config().decode_config(),
+        max_seq_len=prompt_len + new,
+    )
+    target = Llama(tcfg)
+    rng = np.random.default_rng(0)
+    prompts = [
+        [int(x) for x in rng.integers(1, tcfg.vocab_size, prompt_len)]
+        for _ in range(b)
+    ]
+    tparams = cast_decode_params(
+        jax.jit(target.init)(
+            jax.random.key(1),
+            jax.numpy.zeros((1, prompt_len), jax.numpy.int32),
+        )["params"]
+    )
+    # Small draft, same vocab/rope family: ~1/20 the target FLOPs.
+    dcfg = dataclasses.replace(
+        tcfg, d_model=512, n_layers=4, n_heads=8, n_kv_heads=4,
+        head_dim=64, d_ff=1536,
+    )
+    draft = Llama(dcfg)
+    dparams = cast_decode_params(
+        jax.jit(draft.init)(
+            jax.random.key(2),
+            jax.numpy.zeros((1, prompt_len), jax.numpy.int32),
+        )["params"]
+    )
+    sampling = SamplingConfig(temperature=0.0)
+
+    def timed(fn):
+        fn()  # compile+warm
+        t0 = time.perf_counter()
+        out = fn()
+        return time.perf_counter() - t0, out
+
+    dt, outs = timed(lambda: generate_text(
+        target, tparams, prompts, max_new_tokens=new,
+        sampling=sampling,
+    ))
+    plain_step_ms = dt / new * 1e3
+    emit({
+        "case": "plain_decode", "batch": b,
+        "tok_per_s": round(b * new / dt, 1),
+        "step_ms": round(plain_step_ms, 3),
+    })
+
+    for k in (2, 4, 8):
+        dt, (souts, stats) = timed(lambda k=k: speculative_generate_text(
+            draft, dparams, target, tparams, prompts,
+            max_new_tokens=new, k=k, sampling=sampling,
+        ))
+        iters = stats["iterations"]
+        emit({
+            "case": f"speculative_k{k}", "batch": b,
+            "tok_per_s": round(b * new / dt, 1),
+            "iterations": iters,
+            "emitted": stats["emitted"],
+            "accept_per_iter": round(
+                stats["emitted"] / max(iters, 1) / b, 3
+            ),
+            "iter_ms": round(dt / max(iters, 1) * 1e3, 3),
+            "iter_vs_plain_steps": round(
+                dt / max(iters, 1) * 1e3 / plain_step_ms, 2
+            ),
+        })
+        # Greedy parity on hardware: speculative output must equal the
+        # target's own greedy continuation row for row.
+        if k == 4:
+            emit({
+                "case": "greedy_parity_k4",
+                "match": bool(souts == outs),
+            })
+    emit({"event": "done"})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
