@@ -54,7 +54,7 @@ def main():
     b, prompt_len, new = 8, 128, 128
     tcfg = dataclasses.replace(
         bench_model_config().decode_config(),
-        max_seq_len=prompt_len + new,
+        max_seq_len=prompt_len + new + 9,  # + k headroom for the spec verify window
     )
     target = Llama(tcfg)
     rng = np.random.default_rng(0)
@@ -110,20 +110,37 @@ def main():
             "tok_per_s": round(b * new / dt, 1),
             "iterations": iters,
             "emitted": stats["emitted"],
-            "accept_per_iter": round(
-                stats["emitted"] / max(iters, 1) / b, 3
+            # stats["emitted"] counts PER-ROW new tokens; tokens per
+            # iteration = emitted/iters (1.0 = verify-only, i.e. zero
+            # draft acceptance; k+1 = all drafts accepted).
+            "tokens_per_iter": round(
+                stats["emitted"] / max(iters, 1), 3
             ),
             "iter_ms": round(dt / max(iters, 1) * 1e3, 3),
             "iter_vs_plain_steps": round(
                 dt / max(iters, 1) * 1e3 / plain_step_ms, 2
             ),
         })
-        # Greedy parity on hardware: speculative output must equal the
-        # target's own greedy continuation row for row.
+        # Greedy agreement on hardware, reported as a FRACTION: with
+        # random weights the logits are near-uniform, and in bf16 the
+        # k+1-token verify forward reduces in a different order than
+        # the 1-token decode step, so argmax ties flip tokens and the
+        # sequences diverge at the first flip. The suite pins exact
+        # parity in f32 (tests/test_speculative.py); this row records
+        # how far bf16 tie-flipping carries identical prefixes on
+        # near-uniform logits - a numerics observation, not a
+        # correctness gate.
         if k == 4:
+            agree = [
+                sum(1 for x, y in zip(a, c) if x == y) / len(a)
+                for a, c in zip(souts, outs)
+            ]
             emit({
-                "case": "greedy_parity_k4",
-                "match": bool(souts == outs),
+                "case": "greedy_agreement_k4",
+                "exact_rows": sum(a == c for a, c in zip(souts, outs)),
+                "mean_token_agreement": round(
+                    sum(agree) / len(agree), 3
+                ),
             })
     emit({"event": "done"})
     return 0
