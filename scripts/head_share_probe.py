@@ -1,0 +1,37 @@
+"""Is the 596M headline's 49%-vs-60% gap (vs the 8B block) the LM
+head/CE share or the smaller d_model? Run the bench model with the
+block8b-style shrunk vocab (2048): if MFU jumps toward 60, the head/CE
+is the gap; if it stays ~49, it's matmul width."""
+import dataclasses
+import sys
+
+sys.path.insert(0, "/root/repo")
+from tpufw.utils.profiling import enable_compile_cache
+
+enable_compile_cache()
+
+from tpufw.configs.presets import bench_model_config
+from tpufw.mesh import MeshConfig
+from tpufw.models import Llama
+from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+for vocab in (2048,):
+    cfg = dataclasses.replace(
+        bench_model_config(), vocab_size=vocab,
+        remat_policy="attn_out",
+    )
+    trainer = Trainer(
+        Llama(cfg),
+        TrainerConfig(
+            batch_size=16, seq_len=2048, total_steps=6, lr=1e-4,
+            warmup_steps=2, loss_chunk_size=512, log_every=1,
+            sync_every=4,
+        ),
+        MeshConfig(),
+    )
+    trainer.init_state()
+    hist = trainer.run(
+        synthetic_batches(16, 2048, cfg.vocab_size),
+        model_flops_per_token=cfg.flops_per_token(2047),
+    )
+    print("VOCAB", vocab, [round(m.mfu, 4) for m in hist])
