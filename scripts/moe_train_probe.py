@@ -1,0 +1,65 @@
+"""MoE training on the real chip - the one compute subsystem with no
+hardware number (Mixtral routing/dispatch ran only on CPU meshes and
+the virtual-device dryruns). Bench-scale Mixtral: 8 experts top-2,
+~470M params total (~117M active/token), flash attention, one v5e
+chip; expert axis stays size-1 so this measures the ROUTING + einsum
+DISPATCH cost, not cross-chip all-to-all."""
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from tpufw.utils.profiling import enable_compile_cache
+
+enable_compile_cache()
+
+import jax.numpy as jnp
+
+from tpufw.mesh import MeshConfig
+from tpufw.models import Mixtral, MixtralConfig
+from tpufw.train import Trainer, TrainerConfig, synthetic_batches
+
+cfg = MixtralConfig(
+    vocab_size=32_768,
+    d_model=1024,
+    n_layers=8,
+    n_heads=8,
+    n_kv_heads=4,
+    head_dim=128,
+    d_ff=2048,
+    max_seq_len=2048,
+    n_experts=8,
+    experts_per_token=2,
+    dtype=jnp.bfloat16,
+    param_dtype=jnp.float32,
+    attention_backend="flash",
+    remat_policy="nothing",
+)
+if os.environ.get("MOE_PROBE_SORTED") == "1":
+    import dataclasses as _dc
+    cfg = _dc.replace(cfg, moe_dispatch="sorted")
+print("dispatch:", cfg.moe_dispatch)
+print("params:", cfg.n_params())
+for batch in ((64,) if os.environ.get("MOE_PROBE_B64") else (32, 16, 8) if os.environ.get("MOE_PROBE_B32") else (16, 8)):
+    try:
+        trainer = Trainer(
+            Mixtral(cfg),
+            TrainerConfig(
+                batch_size=batch, seq_len=2048, total_steps=6,
+                lr=1e-4, warmup_steps=2, loss_chunk_size=512,
+                log_every=1, sync_every=4,
+            ),
+            MeshConfig(),
+        )
+        trainer.init_state()
+        hist = trainer.run(
+            synthetic_batches(batch, 2048, cfg.vocab_size),
+            model_flops_per_token=cfg.flops_per_token(2047),
+        )
+        print("MOE_PROBE b%d" % batch,
+              [round(m.tokens_per_sec_per_chip, 1) for m in hist],
+              [round(m.mfu, 4) for m in hist])
+        break
+    except Exception as e:
+        print("MOE_PROBE b%d failed: %s: %s" % (batch, type(e).__name__, str(e)[:200]))

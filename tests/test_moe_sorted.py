@@ -1,0 +1,205 @@
+"""Sorted (ragged_dot) MoE dispatch vs the einsum reference.
+
+The sorted path exists for throughput (the one-hot dispatch einsums
+cost 5x the expert matmuls at bench scale — docs/PERF.md r5), but its
+SEMANTICS are pinned here to be identical to route_topk_capacity:
+same expert selection, same slot-0-first/earlier-tokens-first capacity
+priority, same drops, same aux statistics, same gradients.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.models import Mixtral, MixtralConfig
+from tpufw.ops.moe import (
+    expert_capacity,
+    route_topk_capacity,
+    route_topk_sorted,
+)
+
+F32 = jnp.float32
+
+
+def _logits(g, e, seed=0):
+    return jax.random.normal(jax.random.key(seed), (g, e), F32) * 2.0
+
+
+def _einsum_out(logits, x, k, cap, valid=None, norm_topk=True,
+                group_limit=None):
+    dispatch, combine, aux, z = route_topk_capacity(
+        logits, k, cap, valid=valid, dtype=F32,
+        norm_topk=norm_topk, group_limit=group_limit,
+    )
+    # Identity "experts": expert i multiplies its tokens by (i+1), so
+    # routing/capacity/gate differences show up directly in y.
+    scale = jnp.arange(1.0, logits.shape[1] + 1.0)
+    xe = jnp.einsum("gec,gd->ecd", dispatch, x)
+    ye = xe * scale[:, None, None]
+    y = jnp.einsum("gec,ecd->gd", combine, ye)
+    return y, aux, z
+
+
+def _sorted_out(logits, x, k, cap, valid=None, norm_topk=True,
+                group_limit=None):
+    g, e = logits.shape
+    token, group_sizes, gates, aux, z = route_topk_sorted(
+        logits, k, cap, valid=valid, dtype=F32,
+        norm_topk=norm_topk, group_limit=group_limit,
+    )
+    xs = x[token]
+    scale = jnp.concatenate(
+        [jnp.arange(1.0, e + 1.0), jnp.zeros((1,))]
+    )
+    eid = jnp.searchsorted(
+        jnp.cumsum(group_sizes),
+        jnp.arange(token.shape[0]),
+        side="right",
+    )
+    ys = xs * scale[eid][:, None]
+    return (
+        jnp.zeros_like(x).at[token].add(ys * gates[:, None]),
+        aux,
+        z,
+    )
+
+
+@pytest.mark.parametrize("norm_topk", [True, False])
+@pytest.mark.parametrize(
+    "cap_factor", [4.0, 0.6]  # ample vs forcing real drops
+)
+def test_sorted_matches_einsum_routing(norm_topk, cap_factor):
+    g, e, k, d = 64, 8, 2, 16
+    logits = _logits(g, e)
+    x = jax.random.normal(jax.random.key(1), (g, d), F32)
+    cap = expert_capacity(g, k, e, cap_factor)
+    y0, aux0, z0 = _einsum_out(logits, x, k, cap, norm_topk=norm_topk)
+    y1, aux1, z1 = _sorted_out(logits, x, k, cap, norm_topk=norm_topk)
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(aux0, aux1, rtol=1e-6)
+    np.testing.assert_allclose(z0, z1, rtol=1e-6)
+
+
+def test_sorted_matches_einsum_with_valid_mask():
+    g, e, k, d = 48, 4, 2, 8
+    logits = _logits(g, e, seed=3)
+    x = jax.random.normal(jax.random.key(4), (g, d), F32)
+    valid = jax.random.bernoulli(jax.random.key(5), 0.7, (g,))
+    cap = expert_capacity(g, k, e, 1.0)
+    y0, aux0, z0 = _einsum_out(logits, x, k, cap, valid=valid)
+    y1, aux1, z1 = _sorted_out(logits, x, k, cap, valid=valid)
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(aux0, aux1, rtol=1e-6)
+    np.testing.assert_allclose(z0, z1, rtol=1e-6)
+    # Invalid tokens contribute nothing.
+    assert np.all(np.asarray(y1)[~np.asarray(valid)] == 0.0)
+
+
+def test_sorted_matches_einsum_group_limited():
+    g, e, k = 32, 8, 2
+    logits = _logits(g, e, seed=7)
+    x = jax.random.normal(jax.random.key(8), (g, 4), F32)
+    cap = expert_capacity(g, k, e, 2.0)
+    gl = (4, 2)  # 8 experts, 4 groups, top-2 groups survive
+    y0, aux0, _ = _einsum_out(
+        logits, x, k, cap, norm_topk=False, group_limit=gl
+    )
+    y1, aux1, _ = _sorted_out(
+        logits, x, k, cap, norm_topk=False, group_limit=gl
+    )
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(aux0, aux1, rtol=1e-6)
+
+
+def _tiny(moe_dispatch, capacity_factor=4.0):
+    return MixtralConfig(
+        vocab_size=128,
+        d_model=32,
+        n_layers=2,
+        n_heads=2,
+        n_kv_heads=1,
+        head_dim=16,
+        d_ff=64,
+        max_seq_len=32,
+        n_experts=4,
+        experts_per_token=2,
+        capacity_factor=capacity_factor,
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+        remat=False,
+        moe_dispatch=moe_dispatch,
+    )
+
+
+@pytest.mark.parametrize("capacity_factor", [4.0, 0.6])
+def test_mixtral_model_sorted_matches_einsum(capacity_factor):
+    """Full-model parity: SAME params (the two dispatch paths create
+    identical checkpoints), same batch -> same logits, same loss,
+    same grads."""
+    tokens = jax.random.randint(
+        jax.random.key(0), (2, 16), 0, 128
+    )
+    cfg0 = _tiny("einsum", capacity_factor)
+    cfg1 = _tiny("sorted", capacity_factor)
+    m0, m1 = Mixtral(cfg0), Mixtral(cfg1)
+    params = jax.jit(m0.init)(jax.random.key(1), tokens)["params"]
+
+    out0 = m0.apply({"params": params}, tokens)
+    out1 = m1.apply({"params": params}, tokens)
+    logits0, aux0 = out0
+    logits1, aux1 = out1
+    np.testing.assert_allclose(logits0, logits1, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(aux0, aux1, rtol=1e-5, atol=1e-6)
+
+    def loss(model):
+        def f(p):
+            lg, aux = model.apply({"params": p}, tokens)
+            return jnp.mean(jnp.square(lg)) + aux
+
+        return f
+
+    g0 = jax.grad(loss(m0))(params)
+    g1 = jax.grad(loss(m1))(params)
+    flat0 = jax.tree_util.tree_leaves_with_path(g0)
+    flat1 = dict(jax.tree_util.tree_leaves_with_path(g1))
+    for path, leaf in flat0:
+        np.testing.assert_allclose(
+            leaf, flat1[path], rtol=5e-4, atol=5e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_sorted_rejects_unknown_mode():
+    cfg = _tiny("nope")
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="moe_dispatch"):
+        jax.jit(Mixtral(cfg).init)(jax.random.key(0), tokens)
+
+
+def test_mixtral_model_sorted_matches_einsum_with_lora():
+    """The sorted path's grouped LoRA branch (ragged_dot over the
+    lora_a/lora_b stacks) must match the einsum LoRA path from the
+    SAME params — covers the one sorted-path branch the base parity
+    tests leave cold (lora_rank=0)."""
+    tokens = jax.random.randint(jax.random.key(0), (2, 16), 0, 128)
+    cfg0 = dataclasses.replace(_tiny("einsum"), lora_rank=4)
+    cfg1 = dataclasses.replace(_tiny("sorted"), lora_rank=4)
+    m0, m1 = Mixtral(cfg0), Mixtral(cfg1)
+    params = jax.jit(m0.init)(jax.random.key(1), tokens)["params"]
+    # lora_b zero-inits; perturb it so the LoRA term is actually live.
+    params = jax.tree_util.tree_map_with_path(
+        lambda p, leaf: (
+            jax.random.normal(jax.random.key(3), leaf.shape, leaf.dtype)
+            * 0.1
+            if "lora_b" in jax.tree_util.keystr(p)
+            else leaf
+        ),
+        params,
+    )
+    logits0, aux0 = m0.apply({"params": params}, tokens)
+    logits1, aux1 = m1.apply({"params": params}, tokens)
+    np.testing.assert_allclose(logits0, logits1, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(aux0, aux1, rtol=1e-5, atol=1e-6)

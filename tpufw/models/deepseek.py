@@ -93,6 +93,10 @@ class DeepseekConfig:
     # — exact (padded value columns contribute zeros) at ~dv/qk_dim
     # extra v memory.
     attention_backend: str = "xla"
+    # MoE dispatch implementation — see MixtralConfig.moe_dispatch
+    # ("einsum" shards over the expert axis; "sorted" runs grouped
+    # ragged_dot matmuls for single-device/data-sharded training).
+    moe_dispatch: str = "einsum"
     remat: bool = True
     remat_policy: str = "dots"
     scan_layers: bool = True

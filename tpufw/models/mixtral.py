@@ -27,7 +27,11 @@ from tpufw.models.llama import (
     decoder_lm,
     reject_quant_lora,
 )
-from tpufw.ops.moe import expert_capacity, route_topk_capacity
+from tpufw.ops.moe import (
+    expert_capacity,
+    route_topk_capacity,
+    route_topk_sorted,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,6 +42,13 @@ class MixtralConfig(LlamaConfig):
     capacity_factor: float = 1.25
     router_aux_weight: float = 0.02
     router_z_weight: float = 1e-3
+    # "einsum": one-hot dispatch/combine contractions — the tensors ARE
+    # the communication when the expert axis is sharded (EP). "sorted":
+    # token-sorted grouped matmuls via jax.lax.ragged_dot — O(k*G*d)
+    # gather/scatter instead of O(G*E*C*d) one-hot FLOPs (measured 5x
+    # the expert compute at bench scale, docs/PERF.md) — for
+    # single-device or data-sharded training where experts stay whole.
+    moe_dispatch: str = "einsum"
 
     def n_params(self, include_embed: bool = True) -> int:
         d, l = self.d_model, self.n_layers
@@ -175,13 +186,30 @@ class MoEMLP(nn.Module):
           (``cfg.quantized_weights``; shapes match ``quantize_params``).
         """
         cfg = self.cfg
-        e, d_in, d_out = shape
         if getattr(cfg, "quantized_weights", False):
             reject_quant_lora(cfg)
             sub = QuantExpertKernel(
                 shape=shape, names=names, dtype=cfg.dtype, name=name
             )
             return sub(xe)
+        w, a, bw = self._expert_weights(name, shape, names)
+        y = jnp.einsum("eci,eio->eco", xe, w.astype(cfg.dtype))
+        if a is not None:
+            lo = jnp.einsum("eci,eir->ecr", xe, a.astype(cfg.dtype))
+            y = y + jnp.einsum(
+                "ecr,ero->eco", lo, bw.astype(cfg.dtype)
+            ) * (
+                getattr(cfg, "lora_alpha", 16.0)
+                / getattr(cfg, "lora_rank", 0)
+            )
+        return y
+
+    def _expert_weights(self, name: str, shape: tuple, names: tuple):
+        """The fp expert weight stack (+ optional LoRA pair) — ONE
+        param-creation site shared by the einsum and sorted dispatch
+        paths, so both produce identical checkpoints."""
+        cfg = self.cfg
+        e, d_in, d_out = shape
         w = self.param(
             name,
             nn.with_logical_partitioning(
@@ -190,7 +218,7 @@ class MoEMLP(nn.Module):
             shape,
             cfg.param_dtype,
         )
-        y = jnp.einsum("eci,eio->eco", xe, w.astype(cfg.dtype))
+        a = bw = None
         r = getattr(cfg, "lora_rank", 0)
         if r:
             a = self.param(
@@ -211,11 +239,78 @@ class MoEMLP(nn.Module):
                 (e, r, d_out),
                 cfg.param_dtype,
             )
-            lo = jnp.einsum("eci,eir->ecr", xe, a.astype(cfg.dtype))
-            y = y + jnp.einsum(
-                "ecr,ero->eco", lo, bw.astype(cfg.dtype)
-            ) * (getattr(cfg, "lora_alpha", 16.0) / r)
-        return y
+        return w, a, bw
+
+    def _sorted_experts(self, x, router_logits, capacity, valid, d_ff):
+        """Sorted-dispatch expert compute: gather tokens into expert
+        order and run grouped matmuls (``jax.lax.ragged_dot``) instead
+        of contracting one-hot [G, E, C] dispatch tensors. The one-hot
+        einsums cost O(G*E*C*d) FLOPs — measured 5x the expert matmuls
+        themselves at bench scale, capping MoE training at 10% MFU on
+        the v5e chip (docs/PERF.md) — while this path's gather/scatter
+        moves O(k*G*d) bytes. Semantics (selection, capacity drops,
+        aux losses) are pinned identical to the einsum path by
+        ``tests/test_moe_sorted.py``.
+
+        Single-device / data-sharded only: the expert weight stacks
+        stay whole. Sharding the ``expert`` mesh axis needs the einsum
+        path, whose dispatch tensors ARE the all-to-all (module doc of
+        tpufw.ops.moe)."""
+        cfg = self.cfg
+        b, t, d = x.shape
+        e, k = cfg.n_experts, cfg.experts_per_token
+        g = b * t
+        token, group_sizes, gates, aux, z = route_topk_sorted(
+            router_logits, k, capacity,
+            valid=None if valid is None else valid.reshape(g),
+            dtype=x.dtype,
+            norm_topk=self.norm_topk,
+            group_limit=self.group_limit,
+        )
+        xs = x.reshape(g, d).astype(cfg.dtype)[token]  # [k*G, d]
+
+        def pad(stack):
+            # Sentinel group E (invalid-token assignments) multiplies
+            # against one zero expert; ragged_dot needs sum(group
+            # sizes) == rows, so the group must exist.
+            return jnp.concatenate(
+                [
+                    stack.astype(cfg.dtype),
+                    jnp.zeros((1, *stack.shape[1:]), cfg.dtype),
+                ]
+            )
+
+        def grouped(name, shape, names, inp):
+            w, a, bw = self._expert_weights(name, shape, names)
+            y = jax.lax.ragged_dot(inp, pad(w), group_sizes)
+            if a is not None:
+                lo = jax.lax.ragged_dot(inp, pad(a), group_sizes)
+                y = y + jax.lax.ragged_dot(
+                    lo, pad(bw), group_sizes
+                ) * (
+                    getattr(cfg, "lora_alpha", 16.0)
+                    / getattr(cfg, "lora_rank", 0)
+                )
+            return y
+
+        gate_out = grouped(
+            "w_gate", (e, d, d_ff),
+            ("expert", "embed", "expert_mlp"), xs,
+        )
+        up_out = grouped(
+            "w_up", (e, d, d_ff),
+            ("expert", "embed", "expert_mlp"), xs,
+        )
+        h = nn.silu(gate_out) * up_out
+        ys = grouped(
+            "w_down", (e, d_ff, d),
+            ("expert", "expert_mlp", "embed"), h,
+        )
+        yw = ys * gates[:, None].astype(cfg.dtype)
+        y = (
+            jnp.zeros((g, d), cfg.dtype).at[token].add(yw)
+        ).reshape(b, t, d)
+        return y, aux, z
 
     @nn.compact
     def __call__(self, x, valid=None):
@@ -240,6 +335,25 @@ class MoEMLP(nn.Module):
             name="router",
         )(x.astype(jnp.float32))
         router_logits = router_logits.reshape(g, e)
+
+        mode = getattr(cfg, "moe_dispatch", "einsum")
+        if mode == "sorted" and getattr(cfg, "quantized_weights", False):
+            # int8 expert stacks are einsum-shaped (QuantExpertKernel);
+            # serving keeps the einsum path.
+            mode = "einsum"
+        if mode == "sorted":
+            y, aux, z = self._sorted_experts(
+                x, router_logits, capacity, valid, d_ff
+            )
+            return y, (
+                cfg.router_aux_weight * aux + cfg.router_z_weight * z
+            )
+        if mode != "einsum":
+            raise ValueError(
+                f"moe_dispatch={mode!r}: choose 'einsum' (shardable "
+                "over the expert axis) or 'sorted' (grouped "
+                "ragged_dot, single-device/data-sharded)"
+            )
 
         dispatch, combine, aux, z = route_topk_capacity(
             router_logits, k, capacity,

@@ -33,6 +33,147 @@ def expert_capacity(g: int, k: int, e: int, capacity_factor: float) -> int:
     return max(int(capacity_factor * g * k / e), k)
 
 
+def _topk_select(
+    router_logits: jax.Array,
+    k: int,
+    norm_topk: bool,
+    group_limit: Optional[tuple[int, int]],
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared selection front half of both routing implementations:
+    softmax, optional DeepSeek group-limited masking, top-k, optional
+    top-k renormalization. Returns (probs [G,E], topk_probs [G,k],
+    topk_idx [G,k])."""
+    g, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [G, E]
+
+    sel_probs = probs
+    if group_limit is not None:
+        n_group, topk_group = group_limit
+        if e % n_group:
+            raise ValueError(
+                f"group_limit: n_group={n_group} must divide E={e}"
+            )
+        per_group = e // n_group
+        if k > topk_group * per_group:
+            raise ValueError(
+                f"group_limit: k={k} exceeds the {topk_group} surviving "
+                f"groups' {topk_group * per_group} experts"
+            )
+        if topk_group < n_group:
+            group_max = probs.reshape(g, n_group, per_group).max(-1)
+            kth = jax.lax.top_k(group_max, topk_group)[0][..., -1:]
+            keep = jnp.repeat(
+                group_max >= kth, per_group, axis=-1
+            )  # [G, E]
+            # Masked-to-0 probs mirror HF's masked_fill(~mask, 0.0):
+            # survivors keep their raw softmax mass as combine weights.
+            sel_probs = jnp.where(keep, probs, 0.0)
+
+    topk_probs, topk_idx = jax.lax.top_k(sel_probs, k)  # [G, k]
+    if norm_topk:
+        topk_probs = topk_probs / jnp.sum(
+            topk_probs, axis=-1, keepdims=True
+        )
+    return probs, topk_probs, topk_idx
+
+
+def route_topk_sorted(
+    router_logits: jax.Array,
+    k: int,
+    capacity: int,
+    valid: Optional[jax.Array] = None,
+    dtype=jnp.bfloat16,
+    norm_topk: bool = True,
+    group_limit: Optional[tuple[int, int]] = None,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sorted-dispatch twin of ``route_topk_capacity``: identical
+    selection, priority, capacity-drop, and aux-statistic semantics,
+    but instead of materializing [G, E, C] one-hot dispatch/combine
+    tensors it returns the k*G (token, expert) assignments SORTED by
+    expert, ready for grouped expert matmuls (``jax.lax.ragged_dot``).
+    The one-hot einsums cost O(G*E*C*d) FLOPs — measured 5x the expert
+    matmuls themselves at bench scale (docs/PERF.md, r5 MoE section) —
+    while the sorted path's gather/scatter is O(k*G*d) bytes.
+
+    Capacity semantics match exactly: assignments beyond an expert's
+    ``capacity`` (in the einsum path's priority order — expert slot 0
+    of every token before slot 1, earlier tokens first) keep their
+    sorted position but get a ZERO combine weight, so they contribute
+    nothing (the residual stream carries the token), at the cost of
+    computing the dropped rows. Invalid tokens (``valid`` False) route
+    to a sentinel group E with zero weight.
+
+    Returns (token [k*G], group_sizes [E+1], gates [k*G], aux_lb,
+    z): ``token[i]`` is the source token id of the
+    i-th SORTED assignment (gather ``x[token]`` to build the grouped
+    input), ``group_sizes`` counts sorted assignments per expert with
+    the sentinel group last (pad the expert weight stacks with one
+    zero expert for ragged_dot), ``gates`` is the combine weight per
+    sorted assignment.
+    """
+    g, e = router_logits.shape
+    probs, topk_probs, topk_idx = _topk_select(
+        router_logits, k, norm_topk, group_limit
+    )
+    validf = None if valid is None else valid.reshape(g).astype(jnp.float32)
+
+    # Slot-major flattening [k, G] reproduces the einsum path's
+    # priority order under a stable sort: slot 0 of every token, then
+    # slot 1, ties broken by token id.
+    eids = topk_idx.T.reshape(k * g)  # [k*G]
+    gates_flat = topk_probs.T.reshape(k * g)
+    token = jnp.tile(jnp.arange(g, dtype=jnp.int32), k)
+    if validf is not None:
+        invalid = validf < 0.5
+        eids = jnp.where(invalid[token], e, eids)
+        gates_flat = jnp.where(invalid[token], 0.0, gates_flat)
+
+    order = jnp.argsort(eids, stable=True)
+    sorted_eids = eids[order]
+    group_sizes = jnp.bincount(eids, length=e + 1).astype(jnp.int32)
+    starts = jnp.cumsum(group_sizes) - group_sizes  # [E+1]
+    rank = jnp.arange(k * g, dtype=jnp.int32) - starts[sorted_eids]
+    gates = jnp.where(
+        (rank < capacity) & (sorted_eids < e), gates_flat[order], 0.0
+    ).astype(dtype)
+
+    # Aux statistics: identical formulas to route_topk_capacity, on
+    # the same valid-masked top-1 assignment mask.
+    top1_mask = jax.nn.one_hot(topk_idx[:, 0], e, dtype=jnp.float32)
+    if validf is not None:
+        top1_mask = top1_mask * validf[:, None]
+    aux_lb, z = _router_stats(router_logits, probs, top1_mask, validf, g)
+    return token[order], group_sizes, gates, aux_lb, z
+
+
+def _router_stats(router_logits, probs, top1_mask, validf, g):
+    """Switch-style load-balance statistic + router z — ONE copy
+    shared by both routing implementations (a drift here would change
+    the training objective in only one path)."""
+    if validf is None:
+        n_valid = float(g)
+        frac_tokens = jnp.sum(top1_mask, axis=0) / n_valid
+        frac_probs = jnp.mean(probs, axis=0)
+        z = jnp.mean(
+            jnp.square(jax.scipy.special.logsumexp(router_logits, axis=-1))
+        )
+    else:
+        n_valid = jnp.maximum(jnp.sum(validf), 1.0)
+        frac_tokens = jnp.sum(top1_mask, axis=0) / n_valid
+        frac_probs = jnp.sum(probs * validf[:, None], axis=0) / n_valid
+        z = (
+            jnp.sum(
+                jnp.square(
+                    jax.scipy.special.logsumexp(router_logits, axis=-1)
+                )
+                * validf
+            )
+            / n_valid
+        )
+    aux_lb = probs.shape[-1] * jnp.sum(frac_tokens * frac_probs)
+    return aux_lb, z
+
+
 def route_topk_capacity(
     router_logits: jax.Array,
     k: int,
@@ -77,37 +218,9 @@ def route_topk_capacity(
       router logsumexp — both raw (callers apply their config weights).
     """
     g, e = router_logits.shape
-    probs = jax.nn.softmax(router_logits, axis=-1)  # [G, E]
-
-    sel_probs = probs
-    if group_limit is not None:
-        n_group, topk_group = group_limit
-        if e % n_group:
-            raise ValueError(
-                f"group_limit: n_group={n_group} must divide E={e}"
-            )
-        per_group = e // n_group
-        if k > topk_group * per_group:
-            raise ValueError(
-                f"group_limit: k={k} exceeds the {topk_group} surviving "
-                f"groups' {topk_group * per_group} experts"
-            )
-        if topk_group < n_group:
-            group_max = probs.reshape(g, n_group, per_group).max(-1)
-            kth = jax.lax.top_k(group_max, topk_group)[0][..., -1:]
-            keep = jnp.repeat(
-                group_max >= kth, per_group, axis=-1
-            )  # [G, E]
-            # Masked-to-0 probs mirror HF's masked_fill(~mask, 0.0):
-            # survivors keep their raw softmax mass as combine weights.
-            sel_probs = jnp.where(keep, probs, 0.0)
-
-    topk_probs, topk_idx = jax.lax.top_k(sel_probs, k)  # [G, k]
-    if norm_topk:
-        topk_probs = topk_probs / jnp.sum(
-            topk_probs, axis=-1, keepdims=True
-        )
-
+    probs, topk_probs, topk_idx = _topk_select(
+        router_logits, k, norm_topk, group_limit
+    )
     validf = None if valid is None else valid.reshape(g).astype(jnp.float32)
 
     # Priority order: expert slot 0 of every token beats slot 1, and
@@ -136,25 +249,5 @@ def route_topk_capacity(
     # Switch-transformer load-balance statistic over top-1 fractions,
     # computed over valid tokens only.
     top1_mask = mask[:, 0, :]  # [G, E] (already zeroed on invalid)
-    if validf is None:
-        n_valid = float(g)
-        frac_tokens = jnp.sum(top1_mask, axis=0) / n_valid
-        frac_probs = jnp.mean(probs, axis=0)
-        z = jnp.mean(
-            jnp.square(jax.scipy.special.logsumexp(router_logits, axis=-1))
-        )
-    else:
-        n_valid = jnp.maximum(jnp.sum(validf), 1.0)
-        frac_tokens = jnp.sum(top1_mask, axis=0) / n_valid
-        frac_probs = jnp.sum(probs * validf[:, None], axis=0) / n_valid
-        z = (
-            jnp.sum(
-                jnp.square(
-                    jax.scipy.special.logsumexp(router_logits, axis=-1)
-                )
-                * validf
-            )
-            / n_valid
-        )
-    aux_lb = e * jnp.sum(frac_tokens * frac_probs)
+    aux_lb, z = _router_stats(router_logits, probs, top1_mask, validf, g)
     return dispatch, combine, aux_lb, z
