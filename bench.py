@@ -483,7 +483,7 @@ def _is_oom(e: Exception) -> bool:
 
 def _run_tier(
     model_cfg, batch_size, seq_len, warmup, measured, chunk, first_step,
-    packed=False, remat_policy=None, sync_every=1,
+    packed=False, remat_policy=None, sync_every=1, model_cls=None,
 ):
     import dataclasses
 
@@ -501,7 +501,7 @@ def _run_tier(
             model_cfg, remat_policy=remat_policy
         )
     trainer = Trainer(
-        Llama(model_cfg),
+        (model_cls or Llama)(model_cfg),
         TrainerConfig(
             batch_size=batch_size,
             seq_len=seq_len,
@@ -1216,6 +1216,7 @@ def _worker() -> int:
     # images/s/chip through the vision trainer, best-effort like the
     # other aux tiers; OOM degrades the batch, an error is carried in
     # the payload rather than killing the measured headline.
+
     resnet = None
     if on_tpu and os.environ.get("TPUFW_BENCH_RESNET", "1") != "0":
         # Headroom for up to three fresh ResNet-50 compiles on the
@@ -1300,6 +1301,95 @@ def _worker() -> int:
         except Exception as e:  # noqa: BLE001
             resnet = {"error": f"{type(e).__name__}: {e}"[:500]}
     _attach("resnet", resnet)
+
+    # MoE tier (r5): bench-scale Mixtral (495M total / ~117M active
+    # per token, 8 experts top-2) through the sorted ragged_dot
+    # dispatch — the single-chip training posture; the einsum path's
+    # one-hot contractions cap this shape at 10% MFU (docs/PERF.md).
+    # MFU is over ACTIVE FLOPs (MixtralConfig.flops_per_token).
+    moe = None
+    if on_tpu and os.environ.get("TPUFW_BENCH_MOE", "1") != "0":
+        # Headroom for a fresh compile at the first ladder rung.
+        moe = _aux_skip(360)
+    if on_tpu and moe is None and os.environ.get(
+        "TPUFW_BENCH_MOE", "1"
+    ) != "0":
+        try:
+            import jax.numpy as _jnpm
+
+            from tpufw.models import MixtralConfig as _MC
+
+            m_cfg = _MC(
+                vocab_size=32_768,
+                d_model=1024,
+                n_layers=8,
+                n_heads=8,
+                n_kv_heads=4,
+                head_dim=128,
+                d_ff=2048,
+                max_seq_len=2048,
+                n_experts=8,
+                experts_per_token=2,
+                dtype=_jnpm.bfloat16,
+                param_dtype=_jnpm.float32,
+                attention_backend="flash",
+                remat_policy="nothing",
+                moe_dispatch="sorted",
+            )
+            from tpufw.models import Mixtral as _Mx
+
+            m_err: Exception | None = None
+            for m_batch in (64, 32, 16):
+                # Each OOM-ladder rung is a fresh server-side compile;
+                # starting one without budget risks a mid-compile kill
+                # (the backend-wedging event the headline loop guards
+                # against).
+                m_skip = _aux_skip(280)
+                if m_skip is not None:
+                    if m_err is None:
+                        moe = m_skip
+                    break
+                try:
+                    m_first: dict = {}
+                    m_hist = _run_tier(
+                        m_cfg, m_batch, 2048, 2, 4, 512, m_first,
+                        sync_every=4, model_cls=_Mx,
+                    )
+                    m_steady = [
+                        m for m in m_hist
+                        if m.step - m.window_steps + 1 > 1
+                    ] or m_hist[-1:]
+                    moe = {
+                        "model": "mixtral_bench_sorted",
+                        "params": m_cfg.n_params(),
+                        "batch_size": m_batch,
+                        "tokens_per_sec_per_chip": round(
+                            statistics.median(
+                                m.tokens_per_sec_per_chip
+                                for m in m_steady
+                            ),
+                            1,
+                        ),
+                        "mfu_active": round(
+                            statistics.median(
+                                m.mfu for m in m_steady
+                            ),
+                            4,
+                        ),
+                    }
+                    break
+                except Exception as e:  # noqa: BLE001
+                    if not _is_oom(e):
+                        raise
+                    m_err = RuntimeError(f"{type(e).__name__}: {e}")
+            if moe is None:
+                moe = {
+                    "error": f"all batches OOM; last: {m_err}"[:400]
+                }
+        except Exception as e:  # noqa: BLE001
+            moe = {"error": f"{type(e).__name__}: {e}"[:500]}
+        _drop_caches(jax)
+    _attach("moe", moe)
     return 0
 
 
