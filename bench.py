@@ -1346,8 +1346,17 @@ def _worker() -> int:
                 # against).
                 m_skip = _aux_skip(280)
                 if m_skip is not None:
-                    if m_err is None:
-                        moe = m_skip
+                    if m_err is not None:
+                        # An earlier rung OOMed and the budget ran out
+                        # before the smaller rungs: say exactly that —
+                        # "all batches OOM" would falsely claim the
+                        # shape can't fit.
+                        m_skip = {
+                            "error": f"batch {m_batch * 2} OOM "
+                            f"({m_err}), then "
+                            + m_skip["skipped"]
+                        }
+                    moe = m_skip
                     break
                 try:
                     m_first: dict = {}

@@ -96,6 +96,16 @@ def build_trainer():
     if backend:
         model_cfg = dataclasses.replace(model_cfg, attention_backend=backend)
         model = None if model is None else type(model)(model_cfg)
+    # TPUFW_MOE_DISPATCH=sorted: grouped ragged_dot expert matmuls
+    # (2.26x the einsum dispatch on one v5e chip, docs/PERF.md) for
+    # MoE configs training without expert-axis sharding; "einsum"
+    # (default) is the EP-shardable path. Ignored by dense configs.
+    moe_dispatch = env_str("moe_dispatch", "")
+    if moe_dispatch and hasattr(model_cfg, "moe_dispatch"):
+        model_cfg = dataclasses.replace(
+            model_cfg, moe_dispatch=moe_dispatch
+        )
+        model = None if model is None else type(model)(model_cfg)
     # LoRA fine-tune: TPUFW_LORA_RANK > 0 adds adapters and freezes the
     # base (pairs with TPUFW_INIT_FROM pointing at a bare-params
     # checkpoint, e.g. an import_hf conversion).
@@ -171,6 +181,19 @@ def build_trainer():
         # >1 = multi-slice: data parallelism across slices over DCN.
         dcn_data=env_int("mesh_dcn_data", base_m.dcn_data),
     )
+    if (
+        getattr(model_cfg, "moe_dispatch", "einsum") == "sorted"
+        and mesh_cfg.expert not in (0, 1)
+    ):
+        # Silently defeating EP would be worse than refusing: the
+        # sorted path's whole expert stacks would be all-gathered to
+        # every device each layer under an expert-sharded mesh.
+        raise ValueError(
+            "moe_dispatch='sorted' keeps expert weight stacks whole "
+            f"and cannot shard the expert mesh axis (got expert="
+            f"{mesh_cfg.expert}); use the default einsum dispatch for "
+            "expert parallelism"
+        )
     # Objective selection: TPUFW_DPO_DATA switches to preference pairs
     # (DPOTrainer), TPUFW_DISTILL_TEACHER to teacher-student KL
     # (DistillTrainer); default is the LM objective. Mutually exclusive
