@@ -1,0 +1,160 @@
+"""Two more first-hardware-numbers: ViT-B/16 training (the MXU-native
+vision path - does the vision stack escape ResNet's conv ceiling?) and
+bench-scale DeepSeek-MoE (fine-grained routed experts + shared expert,
+MLA attention) through BOTH dispatch paths. JSON rows to
+docs/evidence/VISION_MOE_r5.jsonl."""
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "evidence", "VISION_MOE_r5.jsonl",
+)
+_TAGS: dict = {}
+
+
+def emit(row):
+    row = {"t": round(time.time(), 1), **_TAGS, **row}
+    print(json.dumps(row), flush=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def main():
+    from tpufw.utils.profiling import enable_compile_cache
+
+    enable_compile_cache()
+
+    import gc
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpufw.mesh import MeshConfig
+    from tpufw.train import (
+        Trainer,
+        TrainerConfig,
+        VisionTrainer,
+        VisionTrainerConfig,
+        synthetic_batches,
+        synthetic_images,
+    )
+
+    d = jax.devices()[0]
+    _TAGS.update(platform=d.platform)
+    emit({"event": "start", "kind": d.device_kind})
+
+    # 1. ViT-B/16 at 224px, bf16, batch ladder.
+    from tpufw.models import VIT_CONFIGS, ViT
+
+    for batch in (256, 128):
+        try:
+            vcfg = VIT_CONFIGS["vit_b16"]
+            vt = VisionTrainer(
+                ViT(vcfg),
+                VisionTrainerConfig(
+                    batch_size=batch, image_size=224,
+                    total_steps=9, sync_every=4,
+                ),
+                MeshConfig(),
+            )
+            vt.init_state()
+            hist = vt.run(
+                synthetic_images(batch, 224, 1000, on_device=True),
+                flops_per_image=vcfg.flops_per_image(224),
+            )
+            steady = [m for m in hist if m.step > 1]
+            emit({
+                "case": f"vit_b16_b{batch}",
+                "img_per_s": round(statistics.median(
+                    m.tokens_per_sec_per_chip for m in steady
+                ), 1),
+                "mfu": round(statistics.median(
+                    m.mfu for m in steady
+                ), 4),
+            })
+            del vt
+            break
+        except Exception as e:  # noqa: BLE001
+            emit({"case": f"vit_b16_b{batch}",
+                  "error": f"{type(e).__name__}: {e}"[:300]})
+    gc.collect()
+    jax.clear_caches()
+
+    # 2. Bench-scale DeepSeek-MoE: MLA attention (flash), 32 routed
+    # fine-grained experts top-6 + 1 shared, ~60M/token active.
+    from tpufw.models import Deepseek, DeepseekConfig
+
+    for dispatch in ("sorted", "einsum"):
+        try:
+            dcfg = DeepseekConfig(
+                vocab_size=32_768,
+                d_model=1024,
+                n_layers=8,
+                n_heads=8,
+                kv_lora_rank=256,
+                qk_nope_head_dim=64,
+                qk_rope_head_dim=32,
+                v_head_dim=64,
+                d_ff=2048,
+                n_routed_experts=32,
+                experts_per_token=6,
+                moe_d_ff=256,
+                n_shared_experts=1,
+                capacity_factor=1.25,
+                max_seq_len=2048,
+                dtype=jnp.bfloat16,
+                param_dtype=jnp.float32,
+                attention_backend="flash",
+                remat_policy="nothing",
+                moe_dispatch=dispatch,
+            )
+            batch = 32 if dispatch == "sorted" else 8
+            tr = Trainer(
+                Deepseek(dcfg),
+                TrainerConfig(
+                    batch_size=batch, seq_len=2048, total_steps=6,
+                    lr=1e-4, warmup_steps=2, loss_chunk_size=512,
+                    log_every=1, sync_every=4,
+                ),
+                MeshConfig(),
+            )
+            tr.init_state()
+            hist = tr.run(
+                synthetic_batches(batch, 2048, dcfg.vocab_size),
+                model_flops_per_token=dcfg.flops_per_token(2047),
+            )
+            steady = [
+                m for m in hist if m.step - m.window_steps + 1 > 1
+            ] or hist[-1:]
+            emit({
+                "case": f"deepseek_moe_{dispatch}",
+                "batch": batch,
+                "params": dcfg.n_params(),
+                "tok_per_s": round(statistics.median(
+                    m.tokens_per_sec_per_chip for m in steady
+                ), 1),
+                "mfu_active": round(statistics.median(
+                    m.mfu for m in steady
+                ), 4),
+            })
+            del tr
+        except Exception as e:  # noqa: BLE001
+            emit({"case": f"deepseek_moe_{dispatch}",
+                  "error": f"{type(e).__name__}: {e}"[:300]})
+        gc.collect()
+        jax.clear_caches()
+    emit({"event": "done"})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

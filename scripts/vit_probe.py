@@ -1,0 +1,29 @@
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+from tpufw.utils.profiling import enable_compile_cache
+enable_compile_cache()
+from tpufw.mesh import MeshConfig
+from tpufw.models import VIT_CONFIGS, ViT
+from tpufw.train import VisionTrainer, VisionTrainerConfig, synthetic_images
+
+import dataclasses
+
+vcfg = dataclasses.replace(
+    VIT_CONFIGS["vit_b16"], remat=os.environ.get("VIT_REMAT", "1") == "1"
+)
+B = int(os.environ.get("VIT_BATCH", "128"))
+vt = VisionTrainer(
+    ViT(vcfg),
+    VisionTrainerConfig(batch_size=B, image_size=224, total_steps=4, sync_every=2),
+    MeshConfig(),
+)
+vt.init_state()
+h = vt.run(
+    synthetic_images(B, 224, 1000, on_device=True),
+    flops_per_image=vcfg.flops_per_image(224),
+)
+print("VIT_OK", [round(m.mfu, 4) for m in h])

@@ -237,16 +237,27 @@ class ViT(nn.Module):
         )(pooled)
 
 
+# Production presets remat: without it the layer scan saves every
+# block's f32 [B, H, T, T] attention probabilities for backward —
+# 3.5 GB at ViT-B batch 128 alone, a measured compile-OOM on one
+# 15.75G v5e chip; with remat, ViT-B trains at 34.7% MFU at batch 256
+# (docs/PERF.md, r5).
 VIT_CONFIGS: dict[str, ViTConfig] = {
-    "vit_b16": ViTConfig(),  # ViT-Base/16: 86M params
+    "vit_b16": ViTConfig(remat=True),  # ViT-Base/16: 86M params
     "vit_l16": ViTConfig(
-        d_model=1024, n_layers=24, n_heads=16, d_ff=4096
+        d_model=1024, n_layers=24, n_heads=16, d_ff=4096, remat=True
     ),  # ViT-Large/16: 304M
     "vit_s16": ViTConfig(
-        d_model=384, n_layers=12, n_heads=6, d_ff=1536
+        d_model=384, n_layers=12, n_heads=6, d_ff=1536, remat=True
     ),  # ViT-Small/16: 22M
 }
 
 
 def vit_b16(num_classes: int = 1000, **kw) -> ViT:
-    return ViT(ViTConfig(num_classes=num_classes, **kw))
+    # Delegates to the preset so the factory and VIT_CONFIGS["vit_b16"]
+    # cannot drift (both carry the production remat default).
+    return ViT(
+        dataclasses.replace(
+            VIT_CONFIGS["vit_b16"], num_classes=num_classes, **kw
+        )
+    )

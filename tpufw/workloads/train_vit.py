@@ -41,7 +41,11 @@ def main() -> int:
     mcfg = dataclasses.replace(
         VIT_CONFIGS[name],
         num_classes=env_int("num_classes", 1000),
-        remat=env_bool("remat", False),
+        # Default to the PRESET's remat (True for the production
+        # sizes: without it the layer scan saves every block's f32
+        # [B,H,T,T] attention tensor — measured compile-OOM at ViT-B
+        # batch 128 on one v5e chip). TPUFW_REMAT=0 overrides.
+        remat=env_bool("remat", VIT_CONFIGS[name].remat),
     )
     cfg = VisionTrainerConfig(
         batch_size=env_int("batch_size", 256),
