@@ -1,0 +1,162 @@
+"""The serving stack end-to-end on the real chip: _Server (warmup,
+continuous batching, unrolled decode default) + live HTTP requests.
+Records warmup time, single-request latency, coalesced-batch
+throughput, and a streamed request, to
+docs/evidence/SERVE_TPU_r5.jsonl."""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "evidence", "SERVE_TPU_r5.jsonl",
+)
+_TAGS: dict = {}
+
+
+def emit(row):
+    row = {"t": round(time.time(), 1), **_TAGS, **row}
+    print(json.dumps(row), flush=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def post(base, body, timeout=600):
+    req = urllib.request.Request(
+        base + "/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def main():
+    os.environ.setdefault("TPUFW_MODEL", "llama3_600m_bench")
+    os.environ.setdefault("TPUFW_MAX_NEW_TOKENS", "64")
+    os.environ.setdefault("TPUFW_DECODE_DTYPE", "bfloat16")
+
+    from tpufw.utils.profiling import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+
+    d = jax.devices()[0]
+    _TAGS.update(platform=d.platform)
+    emit({"event": "start", "kind": d.device_kind})
+
+    from tpufw.workloads.serve import _Server
+
+    t0 = time.perf_counter()
+    srv = _Server(port=0, max_new_tokens=64)
+    init_s = time.perf_counter() - t0
+    emit({
+        "case": "server_init_with_warmup",
+        "seconds": round(init_s, 1),
+        "model": "llama3_600m_bench (596M), bf16, unrolled default",
+    })
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    deadline = time.time() + 30
+    while not hasattr(srv, "httpd") and time.time() < deadline:
+        time.sleep(0.05)
+    base = f"http://127.0.0.1:{srv.port}"
+
+    # 1. Single request, the warmed default bucket.
+    prompt = list(range(1, 33))
+    t0 = time.perf_counter()
+    with post(base, {"prompts": [prompt], "max_new_tokens": 64}) as r:
+        out = json.loads(r.read())
+    dt = time.perf_counter() - t0
+    emit({
+        "case": "single_request_warm_bucket",
+        "latency_s": round(dt, 3),
+        "new_tokens": len(out["outputs"][0]),
+        "tok_per_s": round(64 / dt, 1),
+    })
+
+    # 2. 16 concurrent requests -> coalesced ticks.
+    results = []
+
+    def one(i):
+        t = time.perf_counter()
+        with post(
+            base,
+            {"prompts": [[i + 1] * 32], "max_new_tokens": 64},
+        ) as r:
+            out = json.loads(r.read())
+        results.append(
+            (time.perf_counter() - t, out["batched_with"][0]
+             if isinstance(out.get("batched_with"), list)
+             else out.get("batched_with", 1))
+        )
+
+    threads = [
+        threading.Thread(target=one, args=(i,)) for i in range(16)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    emit({
+        "case": "concurrent_16",
+        "wall_s": round(wall, 3),
+        "throughput_tok_per_s": round(16 * 64 / wall, 1),
+        "max_batched_with": max(b for _, b in results),
+        "p50_latency_s": round(
+            sorted(t for t, _ in results)[len(results) // 2], 3
+        ),
+    })
+
+    # 3. Streamed request: time-to-first-chunk vs total.
+    req = urllib.request.Request(
+        base + "/generate",
+        data=json.dumps({
+            "prompts": [prompt], "max_new_tokens": 64, "stream": True,
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    t0 = time.perf_counter()
+    first = None
+    n_events = 0
+    with urllib.request.urlopen(req, timeout=600) as r:
+        for line in r:
+            if line.strip().startswith(b"data: "):
+                n_events += 1
+                if first is None:
+                    first = time.perf_counter() - t0
+    total = time.perf_counter() - t0
+    emit({
+        "case": "stream_request",
+        "time_to_first_chunk_s": round(first, 3),
+        "total_s": round(total, 3),
+        "events": n_events,
+    })
+
+    # 4. Metrics surface sanity.
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    wanted = [
+        ln for ln in text.splitlines()
+        if ln.startswith("tpufw_serve_tokens_generated_total")
+        or ln.startswith("tpufw_serve_ticks_total")
+    ]
+    emit({"case": "metrics", "lines": wanted})
+    srv.httpd.shutdown()
+    emit({"event": "done"})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

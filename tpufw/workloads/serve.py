@@ -876,26 +876,44 @@ class _Server:
             self._warmup()
 
     def _warmup(self) -> None:
-        """Compile the default serving bucket BEFORE the listener
-        binds. Decode is unrolled by default, which costs ~38 s per
-        fresh shape bucket on the v5e chip (vs ~4 s scanned) — without
-        warmup that stall lands on the FIRST LIVE REQUEST of each
-        bucket, well past typical client timeouts. One synthetic tick
-        through _run_tick compiles prefill + decode (+ the draft, when
-        speculation is on) for the (batch 1, shortest prompt bucket,
-        default max_new) shapes — the bucket default-config traffic
-        hits first; other buckets still pay on first hit
-        (docs/WORKFLOWS.md). The tick counter and speculative counters
-        are restored afterwards so warmup is invisible to seed replay
-        and metrics — safe because the listener is not up yet, so
-        nothing can scrape or enqueue during the window. Disable with
-        TPUFW_WARMUP=0 (e.g. compile-latency-insensitive batch jobs)."""
+        """Compile serving shape buckets BEFORE the listener binds.
+        Decode is unrolled by default, which costs a fresh compile per
+        (batch bucket, prompt bucket, max_new bucket) program — ~38 s
+        cold on the v5e chip (vs ~4 s scanned) — and without warmup
+        that stall lands on the FIRST LIVE REQUEST of each bucket,
+        well past typical client timeouts. Each warmup tick runs
+        through _run_tick, compiling prefill + decode (+ the draft,
+        when speculation is on) at the shortest prompt bucket and the
+        default max_new.
+
+        TPUFW_WARMUP_BUCKETS (comma-separated row counts, default
+        "1") selects which BATCH buckets to pre-compile — e.g.
+        "1,4,16" for a server expecting coalesced concurrent traffic
+        (measured on the v5e chip: each un-warmed batch bucket costs
+        ~6-35 s on its first live tick; docs/evidence/
+        SERVE_TPU_r5.jsonl). Counts are pow2-bucketed like live
+        traffic, deduplicated, compiled smallest first. The tick
+        counter and speculative counters are restored afterwards so
+        warmup is invisible to seed replay and metrics — safe because
+        the listener is not up yet, so nothing can scrape or enqueue
+        during the window. Disable entirely with TPUFW_WARMUP=0."""
         import sys
 
         run_new = _pow2_ceil(self.default_new)
         tick0 = self._tick_index
         try:
-            self._run_tick([[1]], run_new, None)
+            # Parse inside the try: a malformed env value must degrade
+            # to a warning, not keep the server from binding its port.
+            # Buckets clamp to the batcher's row cap — a bigger program
+            # would compile but never be hit by live coalescing.
+            max_rows = env_int("batch_max_rows", 64)
+            buckets = sorted({
+                min(_pow2_ceil(int(b)), _pow2_ceil(max_rows))
+                for b in env_str("warmup_buckets", "1").split(",")
+                if b.strip()
+            })
+            for rows in buckets:
+                self._run_tick([[1]] * rows, run_new, None)
         except Exception as e:  # noqa: BLE001
             # Warmup is an optimization; never block serving on it.
             print(f"serve: warmup skipped: {e}", file=sys.stderr)
