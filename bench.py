@@ -440,9 +440,16 @@ def _timed_decode(model, params, prompts, pads, n_new: int) -> float:
         )
 
     _np.asarray(gen())  # compile + warm
-    t0 = time.perf_counter()
-    _np.asarray(gen())
-    return time.perf_counter() - t0
+    best = float("inf")
+    # Min of two timed runs: a single-shot timing is exposed to tunnel
+    # hiccups — BENCH_r5_final2.json recorded int8_speedup 0.516 from
+    # one stalled call where three sibling runs and an immediate rerun
+    # all measured 1.16-1.32x.
+    for _ in range(2):
+        t0 = time.perf_counter()
+        _np.asarray(gen())
+        best = min(best, time.perf_counter() - t0)
+    return best
 
 
 def _drop_caches(jax_mod) -> None:
