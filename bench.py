@@ -415,7 +415,9 @@ def _orchestrate() -> int:
 
 
 def _timed_decode(model, params, prompts, pads, n_new: int) -> float:
-    """Wall seconds for one full generate, after a compile+warm call.
+    """Wall seconds for one full generate — the MIN of two timed runs,
+    after a compile+warm call (single-run through r5's BENCH_r5_final2;
+    min-of-two after, see the loop comment).
     ONE copy of the decode timing discipline: np.asarray value fetch,
     NOT block_until_ready — through the tunneled backend the latter can
     return while the program is still executing (measured r3), which
@@ -445,10 +447,19 @@ def _timed_decode(model, params, prompts, pads, n_new: int) -> float:
     # hiccups — BENCH_r5_final2.json recorded int8_speedup 0.516 from
     # one stalled call where three sibling runs and an immediate rerun
     # all measured 1.16-1.32x.
-    for _ in range(2):
+    t0 = time.perf_counter()
+    _np.asarray(gen())
+    best = time.perf_counter() - t0
+    try:
         t0 = time.perf_counter()
         _np.asarray(gen())
         best = min(best, time.perf_counter() - t0)
+    except Exception as e:  # noqa: BLE001
+        # The second run exists only to shave off a hiccup; a transient
+        # failure there must not discard the valid first measurement.
+        sys.stderr.write(
+            f"bench: second timed decode run failed (ignored): {e}\n"
+        )
     return best
 
 
