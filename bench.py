@@ -1318,6 +1318,10 @@ def _worker() -> int:
                 raise RuntimeError(f"all resnet tiers OOM; last: {r_err}")
         except Exception as e:  # noqa: BLE001
             resnet = {"error": f"{type(e).__name__}: {e}"[:500]}
+        # The only heavyweight tier that lacked this: BENCH_r5_final3
+        # saw the following moe tier OOM at every batch with ResNet's
+        # executables still resident (final2, same order, squeaked by).
+        _drop_caches(jax)
     _attach("resnet", resnet)
 
     # MoE tier (r5): bench-scale Mixtral (495M total / ~117M active
