@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Lint gate: ruff over the library, workloads, and tests.
+#
+# Degrades gracefully where ruff isn't installed (the training
+# container bakes only the runtime deps): prints a skip notice and
+# exits 0 so local pre-commit hooks and container smoke runs don't
+# fail on tooling absence. CI installs ruff explicitly
+# (.github/workflows/ci.yml), so the gate is real where it matters.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if ! command -v ruff >/dev/null 2>&1; then
+    echo "lint: ruff not installed; skipping (pip install ruff to enable)" >&2
+    exit 0
+fi
+
+ruff check tpufw tests bench.py scripts "$@"

@@ -134,3 +134,166 @@ def _clear_jax_caches_per_module():
     yield
     jax.clear_caches()
     gc.collect()
+
+
+# ----------------------------------------------------------------------
+# Tier-1 time budget: ROADMAP.md's tier-1 command caps the CPU suite at
+# 870 s wall on this 1-core box, and the full suite now measures ~31 min
+# solo (calibrated 2026-08: per-test --durations on an idle box). The
+# heaviest integration tests — every one still green — are assigned to
+# the `slow` tier here, heaviest first, until the remainder fits the
+# budget with ~4 min of headroom. They run via `-m slow` (nightly /
+# hardware tier), not never. Node ids are relative to this directory;
+# the trailing comment on each line is the calibrated duration.
+_BUDGET_TIER_SLOW = frozenset(
+    line.split()[0]
+    for line in """
+    test_contrastive.py::test_evaluate_retrieval  # 9.2s
+    test_contrastive.py::test_lora_bidirectional_embedding_trains_adapters_only  # 8.4s
+    test_contrastive.py::test_training_separates_pairs[last-True]  # 6.0s
+    test_contrastive.py::test_training_separates_pairs[mean-False]  # 5.4s
+    test_deepseek.py::test_decode_matches_prefill[deepseek_tiny]  # 13.9s
+    test_deepseek.py::test_decode_matches_prefill[deepseek_tiny_qlora]  # 17.6s
+    test_deepseek.py::test_hf_group_limited_logits_parity  # 9.5s
+    test_deepseek.py::test_moe_decode_matches_prefill  # 16.7s
+    test_deepseek.py::test_moe_training_with_expert_parallelism  # 14.7s
+    test_deepseek.py::test_speculative_decode_with_latent_cache  # 8.0s
+    test_deepseek.py::test_training_on_sharded_mesh  # 16.0s
+    test_distill.py::test_run_loop_end_to_end  # 7.7s
+    test_dpo.py::test_dpo_with_lora_trains_adapters_only  # 9.9s
+    test_dpo.py::test_run_loop_end_to_end  # 7.8s
+    test_dryrun16.py::test_16_device_4x4_shapes  # 14.6s
+    test_eval.py::test_eval_hook_fires_on_schedule  # 6.4s
+    test_eval.py::test_eval_ppl_cli_from_trainstate  # 7.4s
+    test_gemma.py::test_chunked_ce_matches_full_logits  # 8.2s
+    test_gemma.py::test_final_logits_capped  # 6.9s
+    test_gemma.py::test_flash_backend_matches_xla  # 6.7s
+    test_gemma.py::test_generate_decodes  # 6.1s
+    test_gemma.py::test_sliding_window_changes_even_layers_only  # 6.4s
+    test_gemma.py::test_trains_with_chunked_ce  # 10.5s
+    test_grad_accum.py::test_accum_matches_one_shot[masked]  # 14.1s
+    test_grad_accum.py::test_accum_matches_one_shot[plain]  # 11.9s
+    test_grad_accum.py::test_accum_trains  # 7.6s
+    test_grad_accum.py::test_accum_with_bf16_params  # 7.2s
+    test_grad_accum.py::test_bf16_mu_halves_moment_and_trains  # 6.3s
+    test_grpo.py::test_clip_frac_counts_binding_clips  # 12.9s
+    test_grpo.py::test_first_step_ratio_anchor  # 6.8s
+    test_grpo.py::test_grpo_with_lora_trains_adapters_only  # 12.0s
+    test_grpo.py::test_kl_penalty_reported_and_anchor_zero  # 7.6s
+    test_grpo.py::test_reward_improves_over_training  # 8.4s
+    test_grpo.py::test_run_rl_checkpoints_and_resumes  # 15.8s
+    test_import_hf.py::test_cli_export_from_trainstate_checkpoint  # 6.0s
+    test_infer.py::test_cached_decode_matches_full_forward  # 7.3s
+    test_infer.py::test_chunked_prefill_matches_one_shot[4]  # 5.6s
+    test_infer.py::test_eos_freezes_row  # 5.4s
+    test_infer.py::test_generate_with_mesh_sharded_params  # 5.9s
+    test_infer.py::test_generate_with_repetition_penalty_differs  # 6.7s
+    test_infer.py::test_ragged_batch_matches_per_example  # 13.9s
+    test_infer.py::test_unrolled_decode_matches_scanned  # 11.4s
+    test_llama.py::test_attn_out_remat_policy_matches_nothing  # 7.6s
+    test_lora.py::test_full_interop_loop  # 6.4s
+    test_lora.py::test_init_equals_base  # 6.3s
+    test_lora.py::test_init_from_base_checkpoint  # 7.8s
+    test_lora.py::test_merge_cli_on_trainstate_checkpoint  # 9.2s
+    test_lora.py::test_merge_gemma_pairs  # 9.5s
+    test_lora.py::test_merge_reproduces_finetuned_forward  # 9.1s
+    test_lora.py::test_mixtral_expert_lora_merge  # 7.7s
+    test_lora.py::test_training_updates_only_adapters  # 7.2s
+    test_loss.py::test_trainer_chunked_loss_end_to_end  # 13.4s
+    test_mesh.py::test_dcn_multislice_trains  # 7.0s
+    test_mistral.py::test_mixtral_window_honored_and_exported  # 6.3s
+    test_mistral.py::test_window_changes_logits  # 5.2s
+    test_mixtral.py::test_mixtral_forward_returns_aux  # 6.3s
+    test_mixtral.py::test_mixtral_trains_on_expert_mesh  # 7.8s
+    test_moe_sorted.py::test_mixtral_model_sorted_matches_einsum[0.6]  # 6.9s
+    test_moe_sorted.py::test_mixtral_model_sorted_matches_einsum[4.0]  # 11.7s
+    test_moe_sorted.py::test_mixtral_model_sorted_matches_einsum_with_lora  # 6.4s
+    test_pipeline.py::test_gemma_pipeline_grads_and_chunked_ce  # 33.8s
+    test_pipeline.py::test_grads_match_sequential  # 7.1s
+    test_pipeline.py::test_pptp_grads_match_sequential  # 6.1s
+    test_pipeline.py::test_qwen_bias_1f1b_matches_gpipe  # 5.9s
+    test_pipeline.py::test_train_step_learns  # 6.6s
+    test_pipeline_1f1b.py::test_1f1b_chunked_ce_matches_full  # 5.7s
+    test_pipeline_1f1b.py::test_1f1b_four_stages  # 5.8s
+    test_pipeline_1f1b.py::test_1f1b_matches_gpipe_grads  # 6.2s
+    test_pipeline_1f1b.py::test_1f1b_packed_batch_matches_gpipe  # 6.0s
+    test_pipeline_1f1b.py::test_1f1b_pipeline_trainer_learns  # 5.3s
+    test_pipeline_1f1b.py::test_1f1b_pptp_matches_gpipe  # 5.9s
+    test_pipeline_mla.py::test_1f1b_matches_gpipe  # 10.4s
+    test_pipeline_mla.py::test_grads_match_sequential  # 7.5s
+    test_pipeline_mla.py::test_moe_pipeline_matches_grouped_oracle  # 6.0s
+    test_pipeline_mla.py::test_moe_sequential_matches_flax  # 11.7s
+    test_pipeline_mla.py::test_pptp_forward_and_grads  # 9.1s
+    test_pipeline_mla.py::test_sequential_oracle_matches_flax[q_lora]  # 5.9s
+    test_pipeline_moe.py::test_moe_grads_match_grouped_oracle  # 6.2s
+    test_pipeline_moe.py::test_moe_train_step_learns  # 7.0s
+    test_pipeline_trainer.py::test_checkpoint_resume  # 11.6s
+    test_pipeline_trainer.py::test_chunked_ce_matches_full_logits  # 5.9s
+    test_pipeline_trainer.py::test_eval_every_in_run  # 7.3s
+    test_pipeline_trainer.py::test_evaluate_token_weighted  # 7.2s
+    test_pipeline_trainer.py::test_packed_batches_train  # 7.2s
+    test_pipeline_trainer.py::test_trains_and_meters  # 6.6s
+    test_pipeline_trainer.py::test_trains_with_chunked_ce_and_profiler  # 6.2s
+    test_preemption.py::test_trainer_stops_and_checkpoints_on_preemption  # 6.1s
+    test_profiling.py::test_trainer_writes_trace  # 6.7s
+    test_quant.py::test_deepseek_quantized_forward_close  # 14.0s
+    test_quant.py::test_gemma_quantized_forward_close  # 6.8s
+    test_quant.py::test_llama_quantized_forward_close[True]  # 7.1s
+    test_quant.py::test_mixtral_expert_weights_quantized  # 5.9s
+    test_quant.py::test_serve_env_flag  # 5.5s
+    test_quant.py::test_serve_mixtral_int8  # 5.9s
+    test_resnet.py::test_vision_checkpoint_resume_and_preemption  # 8.0s
+    test_ring.py::test_ring_grads_flow  # 5.8s
+    test_ring.py::test_ring_grads_separate_args  # 5.8s
+    test_ring_flash.py::test_ring_flash_grads_match_xla  # 9.0s
+    test_ring_flash.py::test_ring_flash_segment_grads_match_xla  # 7.8s
+    test_ring_flash.py::test_ring_flash_window_grads_match_xla  # 14.0s
+    test_serve.py::test_eos_env_truncates_batch_outputs  # 9.7s
+    test_serve.py::test_http_server_continuous_batching  # 5.9s
+    test_serve.py::test_http_server_per_request_sampling  # 5.8s
+    test_serve.py::test_http_server_speculative_draft  # 47.8s
+    test_serve.py::test_http_server_streaming  # 12.4s
+    test_sft.py::test_sft_trains_the_masked_objective  # 8.7s
+    test_sp_features.py::test_gemma_sp_backend_matches_xla[ring]  # 10.8s
+    test_sp_features.py::test_gemma_sp_backend_matches_xla[ulysses]  # 7.5s
+    test_sp_features.py::test_ring_einsum_cap_window[96]  # 6.5s
+    test_sp_features.py::test_ring_einsum_cap_window[None]  # 9.7s
+    test_sp_features.py::test_ring_flash_cap  # 24.7s
+    test_sp_features.py::test_ring_window_on_both_impls  # 7.8s
+    test_speculative.py::test_chunked_prefill_matches_oneshot  # 16.9s
+    test_speculative.py::test_penalty_greedy_matches_generate  # 5.2s
+    test_speculative.py::test_penalty_stochastic_self_draft_bit_matches_generate  # 7.8s
+    test_speculative.py::test_self_draft_accepts_everything  # 6.2s
+    test_speculative.py::test_stochastic_eos_rows_freeze  # 7.4s
+    test_speculative.py::test_stochastic_self_draft_bit_matches_generate  # 8.2s
+    test_speculative.py::test_stochastic_unrelated_draft_matches_target_distribution  # 7.3s
+    test_sync_window.py::test_exhausted_iterator_flushes_open_window  # 6.9s
+    test_sync_window.py::test_pipeline_trainer_windowed_sync  # 9.0s
+    test_sync_window.py::test_trainer_default_sync_is_per_step  # 6.2s
+    test_sync_window.py::test_trainer_windowed_sync_cadence  # 6.6s
+    test_sync_window.py::test_vision_trainer_windowed_sync  # 5.5s
+    test_sync_window.py::test_window_data_wait_is_per_step_average  # 6.5s
+    test_train.py::test_data_wait_is_measured  # 7.1s
+    test_train.py::test_packed_data_through_flash_backend  # 15.4s
+    test_ulysses.py::test_model_backend_string  # 7.7s
+    test_vit.py::test_forward_shapes_and_pooling  # 6.8s
+    test_vit.py::test_vision_trainer_vit_end_to_end  # 5.2s
+    test_workloads.py::test_embed_workload_main  # 8.6s
+    test_workloads.py::test_rl_workload_main  # 12.2s
+    test_workloads.py::test_train_llama_distill_objective  # 9.6s
+    test_workloads.py::test_train_llama_dpo_objective  # 8.9s
+    test_workloads.py::test_train_llama_dpo_resume_after_checkpoint  # 13.3s
+    test_workloads.py::test_train_llama_main_env_config  # 6.9s
+    test_workloads.py::test_train_resnet_main  # 36.3s
+""".splitlines()
+    if line.strip() and not line.lstrip().startswith("#")
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        rel = item.nodeid
+        if rel.startswith("tests/"):
+            rel = rel[len("tests/") :]
+        if rel in _BUDGET_TIER_SLOW:
+            item.add_marker(pytest.mark.slow)

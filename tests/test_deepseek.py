@@ -269,6 +269,8 @@ def test_training_on_sharded_mesh():
     from tpufw.mesh import MeshConfig
     from tpufw.train import Trainer, TrainerConfig, synthetic_batches
 
+    import itertools
+
     cfg = TINY
     trainer = Trainer(
         Deepseek(cfg),
@@ -279,13 +281,16 @@ def test_training_on_sharded_mesh():
         MeshConfig(data=2, fsdp=2, tensor=2),
     )
     trainer.init_state()
+    # ONE batch repeated: the fall is overfitting signal (whole nats),
+    # not per-batch sampling noise, so the assert can demand a margin.
+    batch = next(synthetic_batches(8, 33, cfg.vocab_size, seed=0))
     hist = trainer.run(
-        synthetic_batches(8, 33, cfg.vocab_size, seed=0),
+        itertools.repeat(batch, 4),
         model_flops_per_token=cfg.flops_per_token(32),
     )
     assert len(hist) == 4
     assert np.isfinite(hist[-1].loss)
-    assert hist[-1].loss < hist[0].loss
+    assert hist[-1].loss < hist[0].loss - 1.0
 
 
 def test_generate_with_latent_cache():
@@ -517,7 +522,11 @@ def test_group_limited_export_round_trip(hf_deepseek_group_limited):
 
 def test_moe_training_with_expert_parallelism():
     """MoE DeepSeek over fsdp x expert: aux loss joins the objective,
-    loss falls."""
+    loss falls. ONE batch repeated so the fall is overfitting signal
+    (several whole nats), not per-batch sampling noise — fresh random
+    batches move the loss less per step than the noise floor."""
+    import itertools
+
     from tpufw.mesh import MeshConfig
     from tpufw.train import Trainer, TrainerConfig, synthetic_batches
 
@@ -530,11 +539,13 @@ def test_moe_training_with_expert_parallelism():
         MeshConfig(fsdp=-1, expert=2),
     )
     trainer.init_state()
+    batch = next(synthetic_batches(8, 33, MOE_TINY.vocab_size, seed=0))
     hist = trainer.run(
-        synthetic_batches(8, 33, MOE_TINY.vocab_size, seed=0),
+        itertools.repeat(batch, 4),
         model_flops_per_token=MOE_TINY.flops_per_token(32),
     )
-    assert np.isfinite(hist[-1].loss) and hist[-1].loss < hist[0].loss
+    assert np.isfinite(hist[-1].loss)
+    assert hist[-1].loss < hist[0].loss - 1.0
 
 
 def test_moe_decode_matches_prefill():

@@ -33,3 +33,67 @@ def test_flash_odd_lengths(t):
     np.testing.assert_allclose(
         np.asarray(g), np.asarray(g_ref), atol=5e-4, rtol=5e-4
     )
+
+
+def _qkv(t=256, b=1, h=2, kh=1, d=64):
+    ks = jax.random.split(jax.random.key(0), 3)
+    return (
+        jax.random.normal(ks[0], (b, t, h, d)),
+        jax.random.normal(ks[1], (b, t, kh, d)),
+        jax.random.normal(ks[2], (b, t, kh, d)),
+    )
+
+
+def test_block_size_override_matches_default():
+    """Explicit (bq, bkv) must only re-tile, never change the math —
+    fwd and bwd both, since the tuner threads them through each path."""
+    q, k, v = _qkv(t=256)
+    ref = flash_attention(q, k, v, causal=True, interpret=True)
+    out = flash_attention(
+        q, k, v, causal=True, interpret=True, block_sizes=(128, 128)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+    def loss(fn):
+        return jax.grad(lambda q: (fn(q) ** 2).sum())(q)
+
+    g_ref = loss(
+        lambda q: flash_attention(q, k, v, causal=True, interpret=True)
+    )
+    g = loss(
+        lambda q: flash_attention(
+            q, k, v, causal=True, interpret=True, block_sizes=(128, 128)
+        )
+    )
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(g_ref), atol=5e-4, rtol=5e-4
+    )
+
+
+def test_env_override_applies_and_validates(monkeypatch):
+    q, k, v = _qkv(t=256)
+    ref = flash_attention(q, k, v, causal=True, interpret=True)
+    monkeypatch.setenv("TPUFW_FLASH_BQ", "128")
+    monkeypatch.setenv("TPUFW_FLASH_BKV", "128")
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+    # A block that doesn't divide the padded length names its source.
+    monkeypatch.setenv("TPUFW_FLASH_BQ", "384")
+    with pytest.raises(ValueError, match="TPUFW_FLASH_BQ"):
+        flash_attention(q, k, v, causal=True, interpret=True)
+
+
+def test_bad_kwarg_blocks_rejected():
+    q, k, v = _qkv(t=256)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        flash_attention(
+            q, k, v, causal=True, interpret=True, block_sizes=(100, 128)
+        )
+    with pytest.raises(ValueError, match="divide the padded"):
+        flash_attention(
+            q, k, v, causal=True, interpret=True, block_sizes=(512, 128)
+        )

@@ -55,17 +55,22 @@ def test_unrolled_decode_matches_scanned(llama_params):
     """The serving unroll lever (tpufw.models.unstack_layer_params):
     scanned-checkpoint params decoded by the UNSCANNED twin must emit
     the exact same tokens — across families with different scanned
-    units (Llama plain layers, Gemma pairs)."""
+    units (Llama plain layers, Gemma pairs). Compute in fp32: the two
+    compile to different XLA programs whose bf16 rounding differs by
+    ~1e-2, enough to flip greedy argmax on near-tied logits — the
+    property under test is the unroll's structural parity, not bf16
+    fusion stability."""
     import dataclasses
 
     from tpufw.models import unstack_layer_params
 
+    f32 = dataclasses.replace(TINY, dtype=jnp.float32)
     prompts = [[5, 17, 101, 7, 42], [9, 3]]
     scanned = generate_text(
-        Llama(TINY.decode_config()), llama_params, prompts,
+        Llama(f32.decode_config()), llama_params, prompts,
         max_new_tokens=6,
     )
-    un_cfg = dataclasses.replace(TINY, scan_layers=False)
+    un_cfg = dataclasses.replace(f32, scan_layers=False)
     unrolled = generate_text(
         Llama(un_cfg.decode_config()),
         unstack_layer_params(llama_params),
@@ -79,7 +84,9 @@ def test_unrolled_decode_matches_scanned(llama_params):
 
     from tpufw.models import GEMMA_CONFIGS, Gemma
 
-    gcfg = GEMMA_CONFIGS["gemma2_tiny"]
+    gcfg = dataclasses.replace(
+        GEMMA_CONFIGS["gemma2_tiny"], dtype=jnp.float32
+    )
     gparams = Gemma(gcfg).init(
         jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
     )["params"]
@@ -233,7 +240,11 @@ def test_repetition_penalty_rule():
 def test_generate_with_repetition_penalty_differs():
     """The penalty must reach the decode loop: greedy decode with a huge
     penalty cannot emit any token twice (every emitted token joins the
-    seen set and gets crushed), so outputs differ from unpenalized."""
+    seen set and gets crushed). The discriminating check uses an
+    ATTRACTING penalty (<< 1 boosts seen logits): greedy must then pick
+    prompt tokens, which unpenalized greedy provably avoids here — a
+    crushed-only comparison is vacuous when plain decode happens not to
+    repeat within the horizon."""
     cfg = LLAMA_CONFIGS["llama3_tiny"]
     dcfg = cfg.decode_config()
     model = Llama(dcfg)
@@ -256,7 +267,19 @@ def test_generate_with_repetition_penalty_differs():
     for row in np.asarray(pen):
         # No repeats at all under an effectively-infinite penalty.
         assert len(set(row.tolist())) == len(row), row
-    assert (np.asarray(plain) != np.asarray(pen)).any()
+    attract = generate(
+        model, params, prompts, pads, jax.random.key(2),
+        max_new_tokens=4,
+        sampling=SamplingConfig(
+            temperature=0.0, repetition_penalty=1e-9
+        ),
+    )
+    for i, row in enumerate(np.asarray(attract)):
+        seen = set(np.asarray(prompts)[i].tolist())
+        assert set(row.tolist()) <= seen, (row, seen)
+    # Unpenalized greedy picks outside the prompt on this model, so an
+    # inert penalty cannot fake this.
+    assert (np.asarray(plain)[:, 0] != np.asarray(attract)[:, 0]).all()
 
 
 def test_generate_with_mesh_sharded_params(devices8):

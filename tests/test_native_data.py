@@ -31,6 +31,12 @@ DOCS = [
 @pytest.fixture(scope="session")
 def native_lib():
     if not os.path.exists(LIB):
+        import shutil
+
+        if not (shutil.which("cmake") and shutil.which("ninja")):
+            pytest.skip(
+                "no prebuilt libtpufwdata and no cmake+ninja toolchain"
+            )
         subprocess.run(
             ["cmake", "-S", os.path.join(ROOT, "native"), "-B", BUILD,
              "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],

@@ -1,0 +1,313 @@
+"""tpufw.tune: search-space validity, HBM pruning, quarantine, budget,
+cache round-trip, and the Trainer autotune integration on the 8-device
+CPU mesh."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tpufw.models import LLAMA_CONFIGS, Llama
+from tpufw.tune import (
+    Candidate,
+    SearchSpace,
+    cache,
+    enumerate_candidates,
+    search,
+)
+from tpufw.tune.runner import apply_autotune
+from tpufw.train import Trainer, TrainerConfig
+
+TINY = LLAMA_CONFIGS["llama3_tiny"]
+
+SMALL = SearchSpace(
+    remat_policies=("dots",),
+    grad_accums=(1,),
+    loss_chunk_sizes=(None, 64),
+    flash_blocks=(None,),
+    sync_everys=(1,),
+)
+
+
+@pytest.fixture
+def tune_cache_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUFW_TUNE_CACHE_DIR", str(tmp_path))
+    return tmp_path
+
+
+# ----------------------------------------------------------------------
+# space: validity + pruning
+# ----------------------------------------------------------------------
+
+
+def test_invalid_grad_accum_pruned():
+    valid, pruned = enumerate_candidates(
+        TINY, batch_size=8, seq_len=129,
+        space=SearchSpace(
+            remat_policies=("dots",), grad_accums=(1, 3, 16),
+            loss_chunk_sizes=(None,), flash_blocks=(None,),
+            sync_everys=(1,),
+        ),
+    )
+    assert [c.grad_accum for c in valid] == [1]
+    reasons = {c.grad_accum: r for c, r in pruned}
+    assert "does not divide batch" in reasons[3]
+    # 16 microbatches of batch 8: also indivisible.
+    assert 16 in reasons
+
+
+def test_grad_accum_must_divide_dp_shards():
+    valid, pruned = enumerate_candidates(
+        TINY, batch_size=8, seq_len=129, dp_shards=8,
+        space=SearchSpace(
+            remat_policies=("dots",), grad_accums=(1, 2),
+            loss_chunk_sizes=(None,), flash_blocks=(None,),
+            sync_everys=(1,),
+        ),
+    )
+    # batch 8 / accum 2 = 4 rows < 8 shards.
+    assert [c.grad_accum for c in valid] == [1]
+    assert any("data x fsdp" in r for _, r in pruned)
+
+
+def test_flash_blocks_validated_against_padded_seq():
+    fcfg = dataclasses.replace(TINY, attention_backend="flash")
+    valid, pruned = enumerate_candidates(
+        fcfg, batch_size=8, seq_len=129,  # model sees 128 tokens
+        space=SearchSpace(
+            remat_policies=("dots",), grad_accums=(1,),
+            loss_chunk_sizes=(None,),
+            flash_blocks=(None, (128, 128), (256, 256), (100, 128)),
+            sync_everys=(1,),
+        ),
+    )
+    assert {(c.flash_bq, c.flash_bkv) for c in valid} == {
+        (None, None), (128, 128),
+    }
+    assert len(pruned) == 2  # 256 doesn't divide 128; 100 not a 128-mult
+
+
+def test_flash_blocks_collapse_without_flash_backend():
+    valid, _ = enumerate_candidates(
+        TINY, batch_size=8, seq_len=129,  # xla backend
+        space=SearchSpace(
+            remat_policies=("dots",), grad_accums=(1,),
+            loss_chunk_sizes=(None,),
+            flash_blocks=(None, (128, 128)), sync_everys=(1,),
+        ),
+    )
+    assert all(c.flash_bq is None for c in valid)
+    assert len(valid) == 1
+
+
+def test_remat_policies_collapse_without_remat():
+    assert not TINY.remat
+    valid, _ = enumerate_candidates(
+        TINY, batch_size=8, seq_len=129,
+        space=SearchSpace(
+            remat_policies=("dots", "nothing", "attn_out"),
+            grad_accums=(1,), loss_chunk_sizes=(None,),
+            flash_blocks=(None,), sync_everys=(1,),
+        ),
+    )
+    assert len(valid) == 1
+
+
+def test_hbm_pruning_drops_predicted_oom():
+    space = SearchSpace(
+        remat_policies=("dots",), grad_accums=(1,),
+        loss_chunk_sizes=(None,), flash_blocks=(None,),
+        sync_everys=(1,),
+    )
+    roomy, _ = enumerate_candidates(
+        TINY, 8, 129, space=space, hbm_bytes=64 * 2**30
+    )
+    assert len(roomy) == 1
+    tight, pruned = enumerate_candidates(
+        TINY, 8, 129, space=space, hbm_bytes=1e4
+    )
+    assert tight == []
+    assert all("HBM" in r for _, r in pruned)
+
+
+# ----------------------------------------------------------------------
+# runner: selection, quarantine, budget (fake measure fn)
+# ----------------------------------------------------------------------
+
+
+def _cands(n):
+    return [Candidate(grad_accum=1, sync_every=i + 1) for i in range(n)]
+
+
+def test_best_of_selection():
+    times = {1: 3.0, 2: 1.0, 3: 2.0}
+    res = search(_cands(3), lambda c: times[c.sync_every], budget_s=60)
+    assert res.best.sync_every == 2
+    assert res.best_step_s == 1.0
+    assert all(t.status == "ok" for t in res.trials)
+
+
+def test_quarantine_never_aborts():
+    def measure(c):
+        if c.sync_every == 1:
+            raise RuntimeError("OOM: out of memory allocating")
+        return float(c.sync_every)
+
+    res = search(_cands(3), measure, budget_s=60)
+    assert res.best.sync_every == 2
+    by_status = {t.candidate.sync_every: t for t in res.trials}
+    assert by_status[1].status == "quarantined"
+    assert "OOM" in by_status[1].error
+    assert res.summary()["n_quarantined"] == 1
+
+
+def test_all_quarantined_yields_no_best():
+    def boom(_c):
+        raise RuntimeError("no")
+
+    res = search(_cands(2), boom, budget_s=60)
+    assert res.best is None
+    assert all(t.status == "quarantined" for t in res.trials)
+
+
+def test_budget_skips_but_first_always_measured():
+    res = search(_cands(4), lambda c: 0.1, budget_s=0.0)
+    assert res.trials[0].status == "ok"
+    assert all(t.status == "skipped_budget" for t in res.trials[1:])
+    assert res.best == res.trials[0].candidate
+
+
+# ----------------------------------------------------------------------
+# cache: key stability + round-trip
+# ----------------------------------------------------------------------
+
+
+def test_cache_key_stable_and_discriminating():
+    k1 = cache.cache_key(TINY, 8, 128, (1, 8), fingerprint="f")
+    assert k1 == cache.cache_key(TINY, 8, 128, (1, 8), fingerprint="f")
+    assert k1 != cache.cache_key(TINY, 16, 128, (1, 8), fingerprint="f")
+    assert k1 != cache.cache_key(TINY, 8, 256, (1, 8), fingerprint="f")
+    assert k1 != cache.cache_key(TINY, 8, 128, (2, 4), fingerprint="f")
+    assert k1 != cache.cache_key(TINY, 8, 128, (1, 8), fingerprint="g")
+    other = dataclasses.replace(TINY, d_model=128)
+    assert k1 != cache.cache_key(other, 8, 128, (1, 8), fingerprint="f")
+
+
+def test_cache_round_trip(tune_cache_dir):
+    cand = Candidate(
+        remat_policy="nothing", grad_accum=2, loss_chunk_size=64,
+        flash_bq=256, flash_bkv=128, sync_every=4,
+    )
+    path = cache.store("k1", cand, median_step_s=0.5, tune_s=12.0)
+    assert path.exists()
+    assert cache.load_candidate("k1") == cand
+    entry = cache.load("k1")
+    assert entry["median_step_s"] == 0.5
+
+
+def test_cache_miss_and_corrupt_entry(tune_cache_dir):
+    assert cache.load_candidate("nope") is None
+    (tune_cache_dir / "bad.json").write_text("{truncated")
+    assert cache.load("bad") is None
+
+
+# ----------------------------------------------------------------------
+# Trainer integration (CPU, 8 virtual devices)
+# ----------------------------------------------------------------------
+
+
+def _trainer(autotune="off", **kw):
+    cfg = TrainerConfig(
+        batch_size=8, seq_len=33, total_steps=2, lr=1e-3,
+        warmup_steps=1, autotune=autotune, handle_preemption=False,
+        **kw,
+    )
+    return Trainer(Llama(TINY), cfg)
+
+
+def _data(n=2):
+    rng = np.random.default_rng(0)
+    return iter(
+        {"tokens": rng.integers(0, 256, (8, 33), dtype=np.int32)}
+        for _ in range(n)
+    )
+
+
+def test_autotune_off_is_inert():
+    assert TrainerConfig().autotune == "off"
+    tr = _trainer()
+    tr.run(_data(), model_flops_per_token=1e3)
+    assert tr.last_tune is None
+
+
+def test_cached_mode_without_entry_is_noop(tune_cache_dir):
+    tr = _trainer(autotune="cached")
+    before = dataclasses.replace(tr.cfg)
+    res = apply_autotune(tr)
+    assert res.best is None and not res.cache_hit
+    assert tr.cfg == dataclasses.replace(
+        before
+    ), "cached-mode miss must not change the config"
+
+
+def test_search_persists_then_second_run_hits_cache(tune_cache_dir):
+    tr = _trainer(autotune="search", autotune_steps=1,
+                  autotune_budget_s=60.0)
+    res = apply_autotune(tr, space=SMALL)
+    assert res.best is not None and not res.cache_hit
+    assert res.tune_s > 0
+    assert sum(1 for t in res.trials if t.status == "ok") >= 1
+    assert list(tune_cache_dir.glob("*.json")), "winner not persisted"
+    # Winner applied to the live trainer, then training runs with it.
+    assert tr.cfg.loss_chunk_size == res.best.loss_chunk_size
+    assert tr.cfg.grad_accum == res.best.grad_accum
+    hist = tr.run(_data(), model_flops_per_token=1e3)
+    assert len(hist) >= 1
+
+    # Same shape, fresh trainer: cache hit, ZERO timed trials.
+    tr2 = _trainer(autotune="search")
+    res2 = apply_autotune(tr2, space=SMALL)
+    assert res2.cache_hit
+    assert res2.trials == []
+    assert res2.tune_s == 0.0
+    assert tr2.cfg.loss_chunk_size == res.best.loss_chunk_size
+
+
+def test_run_resolves_autotune_and_reports(tune_cache_dir):
+    # Through trainer.run() itself (the workload path), tight budget:
+    # the first candidate is always measured, the rest skip.
+    tr = _trainer(autotune="search", autotune_steps=1,
+                  autotune_budget_s=0.0)
+    hist = tr.run(_data(), model_flops_per_token=1e3)
+    assert len(hist) >= 1
+    assert tr.last_tune is not None
+    summary = tr.last_tune.summary()
+    assert summary["config"] is not None
+    assert summary["tune_s"] > 0
+    assert summary["n_measured"] == 1
+
+    # Second run() with the same shape: pure cache hit, no trials.
+    tr2 = _trainer(autotune="search")
+    tr2.run(_data(), model_flops_per_token=1e3)
+    assert tr2.last_tune.cache_hit
+    assert tr2.last_tune.trials == []
+
+
+def test_remat_winner_rebuilds_model(tune_cache_dir):
+    from tpufw.tune.runner import apply_candidate
+
+    rcfg = dataclasses.replace(TINY, remat=True, remat_policy="dots")
+    tr = Trainer(
+        Llama(rcfg),
+        TrainerConfig(batch_size=8, seq_len=33, total_steps=1,
+                      handle_preemption=False),
+    )
+    tr.init_state()
+    apply_candidate(
+        tr, Candidate(remat_policy="nothing", grad_accum=1, sync_every=1)
+    )
+    assert tr.model.cfg.remat_policy == "nothing"
+    # apply_fn must be re-pointed at the REBUILT module (bound methods
+    # are created per access, so compare the bound instance).
+    assert tr.state.apply_fn.__self__ is tr.model
+    assert tr._compiled == {}

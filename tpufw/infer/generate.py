@@ -230,8 +230,10 @@ def _prefill_and_first(
     bit-identical to the one-shot decode BY CONSTRUCTION, not by
     hand-synced duplicates (same rule as ``prefill_cache``'s sharing
     with the speculative path). Key order: first = split(rng)[1],
-    step i = split(split(rng)[0], n)[i-1]; threefry splits are
-    counter-mode, so key i is stable across the split count. Returns
+    step i = split(split(rng)[0], n)[i-1]; split(rng, n)[i] is NOT
+    stable across n on every jax version, so every bit-parity consumer
+    (streaming, speculative) must reproduce this exact split count,
+    n = max(max_new_tokens - 1, 1). Returns
     (cache, first, pos0, done0, seen, step_keys); ``seen`` is None
     unless the repetition penalty needs the [B, V] presence mask (it
     costs B*V bools in the decode carry)."""

@@ -208,12 +208,18 @@ def speculative_generate(
     if stochastic:
         # Emission index n consumes the key generate() would use for
         # that index — same split order (first = split(rng)[1], step i
-        # = split(split(rng)[0], ...)[i-1]; threefry splits are
-        # counter-mode, so index i is stable across the split count).
-        # k extra keys cover the block-overrun slack near the end.
+        # = split(split(rng)[0], ...)[i-1]) and, crucially, the SAME
+        # split count: split(rng, n)[i] is not stable across n on
+        # every jax version, so the shared indices must come from the
+        # exact split generate() performs. The k overrun-slack keys
+        # cover emission indices >= max_new_tokens, whose draws are
+        # sliced off at return — any deterministic stream works there.
         next_rng, first_key = jax.random.split(rng)
-        step_keys = jax.random.split(next_rng, max_new_tokens - 1 + k)
-        all_keys = jnp.concatenate([first_key[None], step_keys])
+        step_keys = jax.random.split(next_rng, max(max_new_tokens - 1, 1))
+        overrun_keys = jax.random.split(jax.random.fold_in(next_rng, 7), k)
+        all_keys = jnp.concatenate(
+            [first_key[None], step_keys, overrun_keys]
+        )
         first = sample_token(
             t_logits[:, -1, :], sampling, first_key, seen0
         )

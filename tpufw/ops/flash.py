@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -328,10 +329,41 @@ def _heads_layout(q, k, v):
     )
 
 
-def _block_sizes(t_pad, s_pad):
-    """Largest block sizes (<=512) that DIVIDE the padded lengths — the grid
-    and the in-kernel kv loop both assume exact tiling (inputs are padded to
-    128 multiples, so 128 always divides)."""
+def _env_block(name: str) -> int | None:
+    v = os.environ.get(name)
+    return int(v) if v else None
+
+
+def _check_block(b: int, n_pad: int, axis: str, source: str) -> int:
+    """Validate an explicit block-size override: the grid and the
+    in-kernel kv loop both assume EXACT tiling of the padded length, and
+    the lanes-broadcast segment masks assume 128 multiples."""
+    if b % 128 or b <= 0:
+        raise ValueError(
+            f"flash {axis} block {b} (from {source}) must be a positive "
+            "multiple of 128 (Mosaic lane tiling; segment masks "
+            "broadcast in 128-lane tiles)"
+        )
+    if n_pad % b:
+        raise ValueError(
+            f"flash {axis} block {b} (from {source}) must divide the "
+            f"padded sequence length {n_pad}; pick a 128-multiple "
+            f"divisor of {n_pad} (e.g. {math.gcd(b, n_pad)})"
+        )
+    return b
+
+
+def _block_sizes(t_pad, s_pad, override=None):
+    """Block sizes for the (q, kv) grid. Default: the largest sizes
+    (<=512) that DIVIDE the padded lengths — the grid and the in-kernel
+    kv loop both assume exact tiling (inputs are padded to 128
+    multiples, so 128 always divides).
+
+    ``override`` is an explicit (bq, bkv) pair (either element None =
+    heuristic); with no override the TPUFW_FLASH_BQ / TPUFW_FLASH_BKV
+    env vars apply — the autotuner's lever (tpufw.tune), also usable
+    standalone. Overrides are validated against the padded lengths with
+    a clear error rather than silently mistiling."""
 
     def pick(n):
         for b in (512, 256, 128):
@@ -339,22 +371,35 @@ def _block_sizes(t_pad, s_pad):
                 return b
         return n  # n < 128 can't happen post-padding; defensive.
 
-    return pick(t_pad), pick(s_pad)
+    bq, bkv = (override or (None, None))
+    src_q, src_kv = "block_sizes kwarg", "block_sizes kwarg"
+    if bq is None and (e := _env_block("TPUFW_FLASH_BQ")) is not None:
+        bq, src_q = e, "TPUFW_FLASH_BQ"
+    if bkv is None and (e := _env_block("TPUFW_FLASH_BKV")) is not None:
+        bkv, src_kv = e, "TPUFW_FLASH_BKV"
+    bq = pick(t_pad) if bq is None else _check_block(bq, t_pad, "q", src_q)
+    bkv = (
+        pick(s_pad) if bkv is None else _check_block(bkv, s_pad, "kv", src_kv)
+    )
+    return bq, bkv
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
 )
-def _flash(q, k, v, qseg, kseg, causal, interpret, soft_cap, window):
+def _flash(
+    q, k, v, qseg, kseg, causal, interpret, soft_cap, window, block_sizes
+):
     out, _ = _flash_fwd_impl(
-        q, k, v, qseg, kseg, causal, interpret, soft_cap, window
+        q, k, v, qseg, kseg, causal, interpret, soft_cap, window,
+        block_sizes=block_sizes,
     )
     return out
 
 
 def _flash_fwd_impl(
     q, k, v, qseg, kseg, causal, interpret, soft_cap, window=None,
-    offset=None,
+    offset=None, block_sizes=None,
 ):
     """``offset``: query i sits at absolute position offset+i relative
     to the keys. Default s - t (decode alignment); ring attention passes
@@ -374,7 +419,7 @@ def _flash_fwd_impl(
     kh_ = _pad_to(kh_, 2, t_pad_mult)
     vh = _pad_to(vh, 2, t_pad_mult)
     t_p, s_p = qh.shape[2], kh_.shape[2]
-    bq, bkv = _block_sizes(t_p, s_p)
+    bq, bkv = _block_sizes(t_p, s_p, block_sizes)
 
     grid = (b, h, t_p // bq)
     kernel = functools.partial(
@@ -436,7 +481,10 @@ def _flash_fwd_impl(
     return out_bthd, (q, k, v, qseg, kseg, out_bthd, lse)
 
 
-def _flash_bwd_impl(causal, interpret, soft_cap, window, res, g, offset=None):
+def _flash_bwd_impl(
+    causal, interpret, soft_cap, window, res, g, offset=None,
+    block_sizes=None,
+):
     q, k, v, qseg, kseg, out, lse = res
     b, t, h, d = q.shape
     _, s, kh, _ = k.shape
@@ -460,7 +508,7 @@ def _flash_bwd_impl(causal, interpret, soft_cap, window, res, g, offset=None):
     delta_p = _pad_to(delta, 3, 128)
     lse_p = lse  # stored padded in the residual
     t_p, s_p = qh.shape[2], kh_.shape[2]
-    bq, bkv = _block_sizes(t_p, s_p)
+    bq, bkv = _block_sizes(t_p, s_p, block_sizes)
     if has_seg:
         qseg_l = _qseg_lanes(_pad_to(qseg.astype(jnp.int32), 1, 128))
         kseg_s = _kseg_sublanes(_pad_to(kseg.astype(jnp.int32), 1, 128))
@@ -574,15 +622,25 @@ def _flash_bwd_impl(causal, interpret, soft_cap, window, res, g, offset=None):
 
 
 def _flash_fwd_rule(
-    q, k, v, qseg, kseg, causal, interpret, soft_cap, window
+    q, k, v, qseg, kseg, causal, interpret, soft_cap, window, block_sizes
 ):
     out, res = _flash_fwd_impl(
-        q, k, v, qseg, kseg, causal, interpret, soft_cap, window
+        q, k, v, qseg, kseg, causal, interpret, soft_cap, window,
+        block_sizes=block_sizes,
     )
     return out, res
 
 
-_flash.defvjp(_flash_fwd_rule, _flash_bwd_impl)
+def _flash_bwd_rule(
+    causal, interpret, soft_cap, window, block_sizes, res, g
+):
+    return _flash_bwd_impl(
+        causal, interpret, soft_cap, window, res, g,
+        block_sizes=block_sizes,
+    )
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(
@@ -596,6 +654,7 @@ def flash_attention(
     logits_soft_cap: float | None = None,
     sliding_window: int | None = None,
     interpret: bool | None = None,
+    block_sizes: tuple[int | None, int | None] | None = None,
 ) -> jax.Array:
     """Flash attention. q:[B,T,H,D], k/v:[B,S,K,D] -> [B,T,H,D].
 
@@ -608,6 +667,13 @@ def flash_attention(
 
     ``interpret=None`` auto-selects the Pallas interpreter on CPU backends
     (tests, dryruns); any accelerator backend gets the real Mosaic lowering.
+
+    ``block_sizes`` is an explicit (bq, bkv) grid-block override for the
+    fwd and both bwd pallas kernels (either element None keeps that
+    axis's heuristic); unset, the TPUFW_FLASH_BQ / TPUFW_FLASH_BKV env
+    vars apply. Values must be 128 multiples dividing the padded
+    lengths — validated with a clear error. Default behavior (no kwarg,
+    no env) is unchanged.
     """
     h, kh = q.shape[2], k.shape[2]
     if h % kh:
@@ -629,4 +695,5 @@ def flash_attention(
         interpret = jax.devices()[0].platform == "cpu"
     cap = None if logits_soft_cap is None else float(logits_soft_cap)
     win = None if sliding_window is None else int(sliding_window)
-    return _flash(q, k, v, qseg, kseg, causal, interpret, cap, win)
+    blocks = None if block_sizes is None else tuple(block_sizes)
+    return _flash(q, k, v, qseg, kseg, causal, interpret, cap, win, blocks)

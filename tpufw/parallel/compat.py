@@ -1,0 +1,39 @@
+"""shard_map and axis helpers across jax versions.
+
+Newer jax exports ``jax.shard_map`` with a ``check_vma`` kwarg; 0.4.x
+has ``jax.experimental.shard_map.shard_map`` with the same flag under
+its old name ``check_rep``. Newer jax also adds ``jax.lax.axis_size``;
+on 0.4.x the equivalent static lookup is ``psum(1, axis)``, which
+constant-folds to a Python int at trace time. Every user in tpufw
+imports from here so the version split lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+try:
+    from jax import shard_map  # jax >= 0.5
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, /, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, *args, **kwargs)
+
+
+try:
+    axis_size = jax.lax.axis_size  # jax >= 0.5
+except AttributeError:  # jax 0.4.x
+
+    def axis_size(axis_name):
+        # psum of a Python scalar over a named axis is evaluated
+        # statically, so this stays usable in range()/perm lists.
+        return jax.lax.psum(1, axis_name)
+
+
+__all__ = ["axis_size", "shard_map"]

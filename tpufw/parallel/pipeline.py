@@ -42,7 +42,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from tpufw.parallel.compat import axis_size, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpufw.mesh import (
@@ -833,13 +833,13 @@ def _gpipe_local(stage_params, x_mb, *seg_mb, cfg, backend):
     outs in x_mb's shape (valid data produced on the last stage, zeros
     elsewhere, psum-combined); aux the global-mean router loss scalar
     (0.0 for dense families), replicated on every device."""
-    s = jax.lax.axis_size(AXIS_PIPE)
+    s = axis_size(AXIS_PIPE)
     sidx = jax.lax.axis_index(AXIS_PIPE)
     # Static (trace-time) tensor/expert-parallel degrees: the stage
     # weights' head/ffn/expert axes arrive pre-sharded per
     # _TENSOR_LEAF_AXIS / _EXPERT_LEAVES.
-    tp = jax.lax.axis_size(AXIS_TENSOR) > 1
-    ep = jax.lax.axis_size(AXIS_EXPERT) > 1
+    tp = axis_size(AXIS_TENSOR) > 1
+    ep = axis_size(AXIS_EXPERT) > 1
     # Local leading stage dim is 1 after sharding: drop it.
     stage_params = jax.tree.map(lambda a: a[0], stage_params)
     m = x_mb.shape[0]
@@ -877,8 +877,12 @@ def _gpipe_local(stage_params, x_mb, *seg_mb, cfg, backend):
 
     zeros = jnp.zeros_like(x_mb[0])
     outs0 = jnp.zeros_like(x_mb)
+    # aux rides through the body as shape (1,), never (): jax 0.4.x
+    # shard_map autodiff gives residuals the {0: all_axes} out-spec,
+    # which is unsatisfiable for a rank-0 residual and raises
+    # _SpecError from the transpose. Callers take [0] outside.
     (_, outs, aux_sum), _ = jax.lax.scan(
-        tick, (zeros, outs0, jnp.zeros((), jnp.float32)),
+        tick, (zeros, outs0, jnp.zeros((1,), jnp.float32)),
         jnp.arange(m + s - 1),
     )
     # Non-last stages hold zeros; the psum replicates the real result
@@ -888,7 +892,7 @@ def _gpipe_local(stage_params, x_mb, *seg_mb, cfg, backend):
     # m x (data x fsdp shards) routing groups. tensor/expert ranks
     # compute identical copies (router is replicated), so they are NOT
     # psum axes — the result is already replicated across them.
-    dp = jax.lax.axis_size(AXIS_DATA) * jax.lax.axis_size(AXIS_FSDP)
+    dp = axis_size(AXIS_DATA) * axis_size(AXIS_FSDP)
     aux = jax.lax.psum(
         aux_sum, (AXIS_PIPE, AXIS_DATA, AXIS_FSDP)
     ) / float(m * dp)
@@ -1010,7 +1014,7 @@ def pipeline_forward(
         else _logits_epilogue(params, hidden, cfg)
     )
     if is_moe:
-        return out, aux / cfg.n_layers
+        return out, aux[0] / cfg.n_layers
     return out
 
 

@@ -55,7 +55,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from tpufw.parallel.compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpufw.mesh import (
@@ -217,9 +217,9 @@ def _1f1b_local(
     grads, embed grad, final-norm grad, head grad) — all unnormalized
     sums over this device's rows; caller psums/normalizes.
     """
-    s = jax.lax.axis_size(AXIS_PIPE)
+    s = axis_size(AXIS_PIPE)
     sidx = jax.lax.axis_index(AXIS_PIPE)
-    tp = jax.lax.axis_size(AXIS_TENSOR) > 1
+    tp = axis_size(AXIS_TENSOR) > 1
     stage_params = jax.tree.map(lambda a: a[0], stage_params)
     m = n_microbatches
     d_model = x_mb.shape[-1]

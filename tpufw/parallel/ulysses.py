@@ -30,7 +30,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from tpufw.parallel.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpufw.mesh.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQUENCE, AXIS_TENSOR

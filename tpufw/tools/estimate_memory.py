@@ -93,13 +93,20 @@ def estimate_train(
     remat_policy: Optional[str] = None,
     loss_chunk_size: Optional[int] = None,
     adam_mu_dtype: Optional[str] = None,
+    grad_accum: int = 1,
 ) -> MemoryEstimate:
-    """Training-step footprint per device.
+    """Training-step footprint per device — the programmatic entry point
+    (the autotuner's HBM pruning oracle, tpufw.tune.space); the CLI below
+    is a thin JSON printer over it.
 
     ``n_shards`` is the param/optimizer sharding degree (the ``fsdp``
     axis; ZeRO-3 layout — tpufw/mesh). The batch dim is assumed sharded
     over the same data x fsdp product, so activation rows divide by it
-    too. Mirrors the trainer's actual layout:
+    too. ``grad_accum`` > 1 further divides activation/logits rows by
+    the microbatch count: each microbatch's fwd+bwd completes inside the
+    accumulation scan, so only one microbatch's activations are live
+    (tpufw.train.trainer.train_step) — at the cost of one extra fp32
+    gradient accumulator tree. Mirrors the trainer's actual layout:
 
     - params in ``cfg.param_dtype``, sharded over fsdp;
     - AdamW mu (``adam_mu_dtype`` or fp32) + nu (fp32), sharded;
@@ -114,6 +121,8 @@ def estimate_train(
     - logits/CE: chunked CE holds [B, chunk, V] fp32 (+ bwd double);
       full logits hold [B, T-1, V].
     """
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     p_bytes = _bytes(cfg.param_dtype)
     a_bytes = _bytes(cfg.dtype)
     n_params = cfg.n_params()
@@ -121,8 +130,12 @@ def estimate_train(
     mu_bytes = _bytes(adam_mu_dtype or "float32")
     optimizer = n_params * (mu_bytes + 4) / n_shards
     gradients = n_params * p_bytes / n_shards
+    if grad_accum > 1:
+        # The accumulation scan carries a full fp32 gradient tree next
+        # to each microbatch's own gradients (train_step's zero_g).
+        gradients += n_params * 4 / n_shards
 
-    rows = batch_size / max(n_shards, 1)
+    rows = batch_size / max(n_shards, 1) / grad_accum
     t = seq_len
     d, f, l = cfg.d_model, cfg.d_ff, cfg.n_layers
     attn_terms, _ = _attn_geometry(cfg)
@@ -251,6 +264,7 @@ def main(argv=None) -> int:
         choices=["dots", "nothing", "everything"],
     )
     ap.add_argument("--ce-chunk", type=int, default=None)
+    ap.add_argument("--grad-accum", type=int, default=1)
     ap.add_argument("--adam-mu-dtype", default=None)
     ap.add_argument(
         "--decode", action="store_true",
@@ -292,6 +306,7 @@ def main(argv=None) -> int:
             remat_policy=args.remat,
             loss_chunk_size=args.ce_chunk,
             adam_mu_dtype=args.adam_mu_dtype,
+            grad_accum=args.grad_accum,
         )
     from tpufw.utils.hardware import detect_chip
 

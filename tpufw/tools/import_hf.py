@@ -1230,7 +1230,12 @@ def main(argv=None) -> int:
         if os.path.isdir(os.path.join(src, "default")):
             src = os.path.join(src, "default")  # CheckpointManager step
         with ocp.StandardCheckpointer() as ckptr:
-            meta_tree = ckptr.metadata(src).item_metadata.tree
+            meta = ckptr.metadata(src)
+            # orbax >= 0.11 wraps the tree in CheckpointMetadata
+            # (.item_metadata.tree); 0.x returns the metadata pytree
+            # (a dict of ArrayMetadata) directly.
+            item = getattr(meta, "item_metadata", None)
+            meta_tree = item.tree if item is not None else meta
         if isinstance(meta_tree, dict) and "params" in meta_tree:
             # TrainState checkpoint: restore ONLY the params item —
             # PLACEHOLDER leaves (step, Adam moments, ~2x params) are
@@ -1242,30 +1247,53 @@ def main(argv=None) -> int:
             def abstract(m):
                 return jax.ShapeDtypeStruct(tuple(m.shape), m.dtype)
 
-            target = {
-                k: jax.tree.map(
-                    abstract if k == "params"
-                    else (lambda _: ocp.PLACEHOLDER),
-                    v,
+            placeholder = getattr(ocp, "PLACEHOLDER", None)
+            if placeholder is not None:
+                target = {
+                    k: jax.tree.map(
+                        abstract if k == "params"
+                        else (lambda _: placeholder),
+                        v,
+                    )
+                    for k, v in meta_tree.items()
+                }
+
+                def rargs(x):
+                    if x is placeholder:
+                        return ocp.RestoreArgs()
+                    return ocp.ArrayRestoreArgs(restore_type=np.ndarray)
+
+                restore_args = jax.tree.map(
+                    rargs, target, is_leaf=lambda x: x is placeholder
                 )
-                for k, v in meta_tree.items()
-            }
-
-            def rargs(x):
-                if x is ocp.PLACEHOLDER:
-                    return ocp.RestoreArgs()
-                return ocp.ArrayRestoreArgs(restore_type=np.ndarray)
-
-            restore_args = jax.tree.map(
-                rargs, target, is_leaf=lambda x: x is ocp.PLACEHOLDER
-            )
-            with ocp.PyTreeCheckpointer() as ckptr:
-                params = ckptr.restore(
-                    src,
-                    ocp.args.PyTreeRestore(
-                        item=target, restore_args=restore_args
-                    ),
-                )["params"]
+                with ocp.PyTreeCheckpointer() as ckptr:
+                    params = ckptr.restore(
+                        src,
+                        ocp.args.PyTreeRestore(
+                            item=target, restore_args=restore_args
+                        ),
+                    )["params"]
+            else:
+                # orbax without PLACEHOLDER (< 0.11): partial restore
+                # via transforms — item names ONLY the params subtree
+                # and transforms={} drops every checkpoint key absent
+                # from it, so step/opt-state bytes never leave disk.
+                target = {
+                    "params": jax.tree.map(abstract, meta_tree["params"])
+                }
+                restore_args = jax.tree.map(
+                    lambda _: ocp.ArrayRestoreArgs(restore_type=np.ndarray),
+                    target,
+                )
+                with ocp.PyTreeCheckpointer() as ckptr:
+                    params = ckptr.restore(
+                        src,
+                        ocp.args.PyTreeRestore(
+                            item=target,
+                            restore_args=restore_args,
+                            transforms={},
+                        ),
+                    )["params"]
         else:
             with ocp.StandardCheckpointer() as ckptr:
                 params = ckptr.restore(src)

@@ -421,6 +421,17 @@ class TrainerConfig:
     # and preemption checks run at sync points only — align
     # checkpoint_every/eval_every to multiples of sync_every.
     sync_every: int = 1
+    # MFU autotuning (tpufw.tune): "off" = fully inert; "cached" = apply
+    # a persisted winner if one exists, never search; "search" = cache
+    # hit or run the budgeted compile-and-measure search before the
+    # first step and persist the winner. Resolved once at the top of
+    # run(); the winner overwrites grad_accum / loss_chunk_size /
+    # sync_every / remat policy / flash blocks on this trainer.
+    autotune: str = "off"
+    # Wall-clock budget for the "search" mode's measurement loop.
+    autotune_budget_s: float = 120.0
+    # Timed steps per candidate (median is the score).
+    autotune_steps: int = 3
 
 
 class Trainer:
@@ -462,6 +473,9 @@ class Trainer:
         self.state = None
         self.state_sharding = None
         self.preempted = False
+        # TuneResult of the last apply_autotune (tpufw.tune.runner);
+        # None until cfg.autotune resolves in run().
+        self.last_tune = None
 
     def _abstract_state(self, rng):
         tokens = jnp.zeros(
@@ -733,6 +747,12 @@ class Trainer:
         on_eval: Callable[[dict], None] | None = None,
         shutdown: "GracefulShutdown | None" = None,
     ) -> list[StepMetrics]:
+        if self.cfg.autotune != "off":
+            # Resolve BEFORE state init: a remat-policy winner rebuilds
+            # the model, and the jitted step bakes every tuned knob in.
+            from tpufw.tune.runner import apply_autotune
+
+            apply_autotune(self)
         if self.state is None:
             self.init_state()
         owns_shutdown = False

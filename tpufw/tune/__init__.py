@@ -1,0 +1,23 @@
+"""MFU autotuner: compile-and-measure search over the train-step knobs.
+
+- ``space``  — candidates, validity constraints, HBM pre-pruning
+- ``runner`` — budgeted measurement loop + Trainer integration
+- ``cache``  — per-(machine, model, batch/seq, mesh) persisted winners
+
+Enable via ``TrainerConfig.autotune`` ("off" | "cached" | "search") or
+``TPUFW_AUTOTUNE`` in the workloads. See docs/PERF.md "Autotuning".
+"""
+
+from tpufw.tune.space import (  # noqa: F401
+    Candidate,
+    SearchSpace,
+    enumerate_candidates,
+)
+from tpufw.tune.runner import (  # noqa: F401
+    TuneResult,
+    Trial,
+    apply_autotune,
+    make_measure_fn,
+    search,
+)
+from tpufw.tune import cache  # noqa: F401

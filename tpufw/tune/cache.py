@@ -1,0 +1,108 @@
+"""Persisted autotuner winners, keyed per machine + workload shape.
+
+A search costs real wall-clock (each surviving candidate compiles and
+runs a few steps), so winners are written to disk and subsequent runs
+with the same (machine, model config, batch/seq, mesh) skip the search
+entirely. One JSON file per key keeps entries independently writable
+from concurrent hosts sharing a cache volume.
+
+Layout: ``$TPUFW_TUNE_CACHE_DIR`` (default ``~/.cache/tpufw/tune``),
+one ``<key>.json`` per entry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pathlib
+from typing import Optional
+
+from tpufw.tune.space import Candidate
+from tpufw.utils.profiling import machine_fingerprint
+
+_ENV_DIR = "TPUFW_TUNE_CACHE_DIR"
+
+
+def cache_dir() -> pathlib.Path:
+    d = os.environ.get(_ENV_DIR)
+    if d:
+        return pathlib.Path(d)
+    return pathlib.Path.home() / ".cache" / "tpufw" / "tune"
+
+
+def model_config_hash(model_cfg) -> str:
+    """Stable hash of everything that changes the compiled step. Dtypes
+    and other non-JSON leaves are stringified so two configs differing
+    only in dtype get distinct keys."""
+    if dataclasses.is_dataclass(model_cfg):
+        d = dataclasses.asdict(model_cfg)
+    else:
+        d = dict(model_cfg)
+    blob = json.dumps(d, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def cache_key(
+    model_cfg,
+    batch_size: int,
+    seq_len: int,
+    mesh_shape: tuple,
+    fingerprint: Optional[str] = None,
+) -> str:
+    fp = fingerprint or machine_fingerprint()
+    mesh = "x".join(str(int(m)) for m in mesh_shape)
+    return (
+        f"{fp}-{model_config_hash(model_cfg)}"
+        f"-b{batch_size}-s{seq_len}-m{mesh}"
+    )
+
+
+def load(key: str) -> Optional[dict]:
+    """The cached entry for ``key``, or None. Corrupt files read as a
+    miss — the search just re-runs and overwrites them."""
+    path = cache_dir() / f"{key}.json"
+    try:
+        with open(path) as f:
+            entry = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(entry, dict) or "candidate" not in entry:
+        return None
+    return entry
+
+
+def store(
+    key: str,
+    candidate: Candidate,
+    median_step_s: Optional[float] = None,
+    tune_s: Optional[float] = None,
+    meta: Optional[dict] = None,
+) -> pathlib.Path:
+    d = cache_dir()
+    d.mkdir(parents=True, exist_ok=True)
+    path = d / f"{key}.json"
+    entry = {
+        "key": key,
+        "candidate": candidate.as_dict(),
+        "median_step_s": median_step_s,
+        "tune_s": tune_s,
+        **(meta or {}),
+    }
+    tmp = path.with_suffix(".json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(entry, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_candidate(key: str) -> Optional[Candidate]:
+    entry = load(key)
+    if entry is None:
+        return None
+    try:
+        return Candidate.from_dict(entry["candidate"])
+    except (TypeError, KeyError):
+        return None

@@ -171,7 +171,20 @@ def build_trainer():
         preemption_sync_every=env_int(
             "preemption_sync_every", base_t.preemption_sync_every
         ),
+        sync_every=env_int("sync_every", base_t.sync_every),
+        # MFU autotuning (tpufw.tune): "cached" applies a persisted
+        # winner, "search" measures candidates before the first step.
+        autotune=env_str("autotune", base_t.autotune),
+        autotune_budget_s=env_float(
+            "autotune_budget_s", base_t.autotune_budget_s
+        ),
+        autotune_steps=env_int("autotune_steps", base_t.autotune_steps),
     )
+    if trainer_cfg.autotune not in ("off", "cached", "search"):
+        raise ValueError(
+            f"TPUFW_AUTOTUNE={trainer_cfg.autotune!r}: expected "
+            "off | cached | search"
+        )
     mesh_cfg = MeshConfig(
         data=env_int("mesh_data", base_m.data),
         fsdp=env_int("mesh_fsdp", base_m.fsdp),
@@ -459,6 +472,13 @@ def main() -> int:
     )
     from tpufw.workloads._common import report_preemption
 
+    if trainer.last_tune is not None:
+        # One JSON line, same channel as step metrics: the chosen
+        # config and the tuning wall-clock, kubectl-logs greppable.
+        print(
+            json.dumps({"autotune": trainer.last_tune.summary()}),
+            flush=True,
+        )
     report_preemption(trainer)
     print_summary(history)
     return 0
