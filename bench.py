@@ -502,7 +502,7 @@ def _is_oom(e: Exception) -> bool:
 def _run_tier(
     model_cfg, batch_size, seq_len, warmup, measured, chunk, first_step,
     packed=False, remat_policy=None, sync_every=1, model_cls=None,
-    autotune="off", tune_out=None,
+    autotune="off", tune_out=None, telemetry_dir=None,
 ):
     import dataclasses
 
@@ -538,6 +538,9 @@ def _run_tier(
             # tune_out carries the TuneResult summary back so the
             # caller can subtract tune_s from the cold-start metric.
             autotune=autotune,
+            # tpufw.obs: events.jsonl + trace.json for the measured
+            # run land here (headline tier only; reported in payload).
+            telemetry_dir=telemetry_dir,
         ),
         MeshConfig(),  # all devices on fsdp
     )
@@ -679,6 +682,10 @@ def _worker() -> int:
     # tune_out reports the chosen config + wall time in the payload.
     autotune_mode = os.environ.get("TPUFW_AUTOTUNE", "off")
     tune_out: dict = {}
+    # Unified telemetry for the HEADLINE tier (tpufw.obs): the events/
+    # trace of the run behind the headline number, dir echoed in the
+    # payload so a regression hunt starts from the bench JSON itself.
+    telemetry_dir = os.environ.get("TPUFW_TELEMETRY_DIR") or None
     for batch_size, seq_len, chunk, policy in tiers:
         # Each OOM fallback pays a FRESH server-side compile (2-10 min
         # through the tunnel); starting one the budget can't cover
@@ -696,6 +703,7 @@ def _worker() -> int:
                 first_step, remat_policy=policy,
                 sync_every=4 if on_tpu else 1,
                 autotune=autotune_mode, tune_out=tune_out,
+                telemetry_dir=telemetry_dir,
             )
             break
         except Exception as e:  # noqa: BLE001
@@ -758,6 +766,8 @@ def _worker() -> int:
         else None,
         "init_backend_s": init_backend_s,
         "compile_cache_warm": cache_warm,
+        # Where this run's events.jsonl/trace.json landed (None = off).
+        "telemetry_dir": telemetry_dir,
     }
     if tune_out.get("autotune") is not None:
         payload["autotune"] = tune_out["autotune"]

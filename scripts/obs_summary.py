@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""One-screen digest of a tpufw telemetry dir (TPUFW_TELEMETRY_DIR).
+
+Reads the three artifacts the unified telemetry subsystem writes —
+events*.jsonl, trace*.json, metrics.prom — and prints the run at a
+glance: step/loss trajectory, event-kind counts, straggler incidents,
+where the wall-clock went by span, and the headline counters. CI runs
+it over the smoke run's artifact so a failed run is diagnosable from
+the job log alone.
+
+Usage:  python scripts/obs_summary.py <telemetry_dir>
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpufw.obs.events import read_events
+
+
+def _fmt_s(seconds: float) -> str:
+    if seconds >= 1.0:
+        return f"{seconds:.2f}s"
+    return f"{seconds * 1e3:.1f}ms"
+
+
+def summarize_events(paths: list[str]) -> None:
+    events = []
+    for p in paths:
+        events.extend(read_events(p))
+    if not events:
+        print("  (no events)")
+        return
+    kinds = collections.Counter(e["kind"] for e in events)
+    print("  kinds: " + ", ".join(f"{k}={n}" for k, n in sorted(kinds.items())))
+    steps = [e for e in events if e["kind"] == "step"]
+    if steps:
+        first, last = steps[0], steps[-1]
+        print(
+            f"  steps {first['step']}..{last['step']}: "
+            f"loss {first['loss']:.4f} -> {last['loss']:.4f}, "
+            f"last step_time {_fmt_s(last['step_time_s'])} "
+            f"(data_wait {_fmt_s(last['data_wait_s'])})"
+        )
+    for ev in events:
+        if ev["kind"] == "straggler_detected":
+            print(
+                f"  STRAGGLER step {ev['step']}: hosts "
+                f"{ev['straggler_hosts']} vs median "
+                f"{_fmt_s(ev['median_s'])} (factor {ev['factor']})"
+            )
+        elif ev["kind"] in ("preemption_signal", "preemption_stop"):
+            print(f"  PREEMPTION: {json.dumps(ev, sort_keys=True)}")
+    errors = [e for e in events if e.get("level") == "error"]
+    if errors:
+        print(f"  {len(errors)} error-level event(s):")
+        for ev in errors[:5]:
+            print(f"    {json.dumps(ev, sort_keys=True)}")
+
+
+def summarize_trace(paths: list[str]) -> None:
+    totals: collections.Counter = collections.Counter()
+    counts: collections.Counter = collections.Counter()
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") == "X":
+                totals[ev["name"]] += ev["dur"] / 1e6
+                counts[ev["name"]] += 1
+    if not totals:
+        print("  (no spans)")
+        return
+    wall = sum(totals.values())
+    for name, total in totals.most_common():
+        print(
+            f"  {name:<18} {_fmt_s(total):>9}  "
+            f"({total / wall:5.1%} of span time, n={counts[name]})"
+        )
+
+
+def summarize_metrics(path: str) -> None:
+    wanted = (
+        "tpufw_train_steps_total",
+        "tpufw_train_tokens_total",
+        "tpufw_train_mfu",
+        "tpufw_train_tokens_per_sec_per_chip",
+        "tpufw_train_stragglers_total",
+        "tpufw_serve_requests_total",
+        "tpufw_serve_request_errors_total",
+    )
+    with open(path) as f:
+        for line in f:
+            if line.startswith("#"):
+                continue
+            name = line.split("{")[0].split(" ")[0]
+            if name in wanted:
+                print(f"  {line.rstrip()}")
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 2:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    out = argv[1]
+    if not os.path.isdir(out):
+        print(f"obs_summary: no such dir {out!r}", file=sys.stderr)
+        return 2
+    print(f"== telemetry: {out} ==")
+    print("-- events --")
+    summarize_events(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
+    print("-- spans (total time) --")
+    summarize_trace(sorted(glob.glob(os.path.join(out, "trace*.json"))))
+    prom = os.path.join(out, "metrics.prom")
+    if os.path.exists(prom):
+        print("-- metrics snapshot --")
+        summarize_metrics(prom)
+    return 0
+
+
+if __name__ == "__main__":
+    # Default SIGPIPE so `obs_summary.py dir | head` exits quietly.
+    import signal
+
+    signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    raise SystemExit(main(sys.argv))

@@ -63,6 +63,36 @@ def test_step_profiler_inactive_is_free():
     prof.close()
 
 
+def test_null_tracer_span_is_allocation_free():
+    # The disabled tpufw.obs path mirrors StepProfiler's contract: the
+    # hot loop takes the instrumented shape unconditionally, so the
+    # no-op must not allocate a context manager per call.
+    from tpufw.obs import trace as trace_mod
+
+    t = trace_mod.NullTracer()
+    spans = {t.span("data_fetch"), t.span("step_dispatch", step=3)}
+    assert len(spans) == 1  # one shared no-op span instance
+    with t.span("host_sync"):
+        pass
+    t.complete("data_fetch", 0.01)
+    t.instant("marker")
+    t.close()  # idempotent, writes nothing
+
+
+def test_disabled_telemetry_keeps_trainer_shape():
+    # Trainer.__init__ installs the shared disabled Telemetry so every
+    # instrumented call site works before/without run().
+    from tpufw.obs import Telemetry
+
+    tel = Telemetry.disabled()
+    assert tel.bound_port is None
+    tel.events.emit(
+        "step", step=1, loss=0.0, step_time_s=0.1, data_wait_s=0.0
+    )
+    tel.snapshot_metrics()  # no out_dir: must be a no-op, not an error
+    tel.close()
+
+
 def test_trainer_writes_trace(tmp_path):
     from tpufw.mesh import MeshConfig
     from tpufw.models import Llama, LLAMA_CONFIGS

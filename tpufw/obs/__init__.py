@@ -1,0 +1,203 @@
+"""Unified telemetry: metrics registry + event log + span tracing +
+skew monitoring behind one handle.
+
+The trainer stack previously measured itself through three
+disconnected mechanisms — ``train/metrics.py``'s ``Meter`` (stdout
+JSON), ``serve.py``'s private Prometheus class, and the XProf wrapper
+— with no shared registry and no way to see WHY a headline number
+regressed. ``tpufw/obs`` is the shared layer:
+
+- :mod:`tpufw.obs.registry` — thread-safe counters/gauges/histograms,
+  Prometheus text exposition, stdlib HTTP endpoint
+  (``TPUFW_METRICS_PORT`` for trainers; ``serve.py``'s ``/metrics``
+  renders the same registry).
+- :mod:`tpufw.obs.events` — schema'd JSONL event log, per host.
+- :mod:`tpufw.obs.trace` — context-manager spans, Chrome trace-event
+  JSON (Perfetto-loadable).
+- :mod:`tpufw.obs.skew` — per-host window gauges + straggler events,
+  piggybacked on the sync window.
+
+``Telemetry.create(...)`` wires all four from TrainerConfig /
+``TPUFW_TELEMETRY_DIR`` / ``TPUFW_METRICS_PORT``;
+``Telemetry.disabled()`` hands back null components cheap enough to
+leave the instrumentation in the hot loop unconditionally (asserted
+<1% per-step in tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from tpufw.obs import events as events_mod
+from tpufw.obs import trace as trace_mod
+from tpufw.obs.registry import Registry, start_http_server
+from tpufw.obs.skew import SkewMonitor
+
+__all__ = [
+    "Registry",
+    "SkewMonitor",
+    "Telemetry",
+    "start_http_server",
+]
+
+
+def _jax_ids():
+    """(process_index, process_count) if jax is importable and
+    initialized enough to ask; (0, 1) otherwise. Lazy: obs must not
+    drag jax in for stdlib users (serve's HTTP thread, obs_summary)."""
+    try:
+        import jax
+
+        return jax.process_index(), jax.process_count()
+    except Exception:  # noqa: BLE001 — uninitialized backend etc.
+        return 0, 1
+
+
+class Telemetry:
+    """One handle bundling registry/events/tracer/skew. Components
+    degrade independently: a metrics port without a telemetry dir
+    serves scrapes but writes no files, and vice versa."""
+
+    def __init__(
+        self,
+        registry: Optional[Registry] = None,
+        events=None,
+        tracer=None,
+        skew: Optional[SkewMonitor] = None,
+        server=None,
+        out_dir: Optional[str] = None,
+    ):
+        self.registry = registry
+        self.events = events if events is not None else events_mod.NULL
+        self.tracer = tracer if tracer is not None else trace_mod.NULL
+        self.skew = skew
+        self.server = server
+        self.out_dir = out_dir
+        self._closed = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.registry is not None
+
+    @property
+    def bound_port(self) -> Optional[int]:
+        """Actual metrics port (resolves port 0 to the ephemeral bind)."""
+        return None if self.server is None else self.server.server_address[1]
+
+    @staticmethod
+    def disabled() -> "Telemetry":
+        return _NULL
+
+    @staticmethod
+    def create(
+        telemetry_dir: Optional[str] = None,
+        metrics_port: Optional[int] = None,
+        straggler_factor: float = 2.0,
+        role: str = "train",
+        gather=None,
+    ) -> "Telemetry":
+        """Build telemetry from config knobs. All-None knobs return
+        the shared disabled singleton. ``metrics_port=0`` binds an
+        ephemeral port (tests); None means no server. ``role``
+        prefixes the trace/process naming so multi-role hosts
+        (train + eval) stay distinguishable in Perfetto."""
+        if telemetry_dir is None and metrics_port is None:
+            return _NULL
+        proc, nprocs = _jax_ids()
+        registry = Registry()
+        events = events_mod.NULL
+        tracer = trace_mod.NULL
+        if telemetry_dir:
+            os.makedirs(telemetry_dir, exist_ok=True)
+            events = events_mod.EventLog(
+                events_mod.log_path(telemetry_dir, proc),
+                host=proc,
+                process=proc,
+            )
+            trace_name = (
+                "trace.json" if proc == 0 else f"trace-p{proc}.json"
+            )
+            tracer = trace_mod.Tracer(
+                os.path.join(telemetry_dir, trace_name),
+                pid=proc,
+                process_name=f"{role}:p{proc}/{nprocs}",
+            )
+        skew = SkewMonitor(
+            registry=registry,
+            events=events,
+            factor=straggler_factor,
+            gather=gather,
+        )
+        server = None
+        if metrics_port is not None:
+            server = start_http_server(registry, metrics_port)
+        tel = Telemetry(
+            registry=registry,
+            events=events,
+            tracer=tracer,
+            skew=skew,
+            server=server,
+            out_dir=telemetry_dir,
+        )
+        _emit_compile_cache_event(events)
+        return tel
+
+    def snapshot_metrics(self) -> Optional[str]:
+        """Dump the registry's current exposition text to
+        ``<out_dir>/metrics.prom`` (final flush for runs nothing ever
+        scraped — obs_summary reads counter totals from it)."""
+        if self.registry is None or not self.out_dir:
+            return None
+        path = os.path.join(self.out_dir, "metrics.prom")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(self.registry.render())
+        os.replace(tmp, path)
+        return path
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.snapshot_metrics()
+        finally:
+            self.tracer.close()
+            self.events.close()
+            if self.server is not None:
+                self.server.shutdown()
+                self.server.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _emit_compile_cache_event(events) -> None:
+    """Record whether this run starts against a warm persistent XLA
+    compile cache — the cold-start-to-first-step headline is mostly
+    this bit."""
+    try:
+        import jax
+
+        cache_dir = jax.config.jax_compilation_cache_dir
+    except Exception:  # noqa: BLE001
+        return
+    if not cache_dir:
+        return
+    try:
+        warm = bool(os.listdir(cache_dir))
+    except OSError:
+        warm = False
+    events.emit("compile_cache", dir=cache_dir, warm=warm)
+
+
+# Shared disabled singleton: null events/tracer, no registry. close()
+# is a no-op because _closed starts True — a workload closing the
+# shared instance must not poison later users.
+_NULL = Telemetry()
+_NULL._closed = True

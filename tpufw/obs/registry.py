@@ -1,0 +1,299 @@
+"""Thread-safe metrics registry with Prometheus text exposition.
+
+One registry per process, shared by every subsystem that wants a
+number scraped: the trainer loop (step time / MFU / data-wait), the
+serving stack (request/tick counters — ``serve.py`` renders its
+``/metrics`` endpoint from here), and the tune runner. Counters,
+gauges, and fixed-bucket histograms only — the subset Prometheus'
+text format can express without a client library, matching the
+device-plugin shim's hand-rolled exposition that the rest of the
+repo already mimics.
+
+Design points carried over from ``serve.py``'s retired ``_Metrics``:
+
+- values render via ``repr``, not ``%g`` — ``%g`` rounds to 6
+  significant digits, which stalls large counters (``rate()`` then
+  reads 0 until a 10-unit jump);
+- counters can be pre-registered at 0 so alerts on
+  ``increase(...)`` see a real 0-valued series before the first
+  increment, not an absent one.
+
+Gauges additionally accept a callback (``set_function``) evaluated
+at scrape time, for point-in-time values like queue depth that have
+one source of truth elsewhere.
+
+Stdlib only (``threading`` + ``http.server``): must import in every
+context the trainer runs in, including bare CI containers.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+# Prometheus text exposition content type (version pinned by spec).
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# Default buckets for time-in-seconds histograms: step times live in
+# the 10ms..minutes range, data waits in the sub-ms..seconds range;
+# the union covers both without a per-metric bucket debate.
+DEFAULT_TIME_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if v == int(v) else repr(v)
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _label_str(key: LabelKey, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    """Base: one named metric, possibly with labeled children."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: Dict[LabelKey, float] = {}
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def _header(self) -> list:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        return lines
+
+    def render(self) -> list:
+        with self._lock:
+            values = dict(self._values)
+        lines = self._header()
+        for key in sorted(values):
+            lines.append(
+                f"{self.name}{_label_str(key)} {_fmt(values[key])}"
+            )
+        return lines
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        # Pre-initialized unlabeled series (absent-series rationale
+        # above); labeled children appear on first inc.
+        self._values[()] = 0.0
+
+    def inc(self, v: float = 1.0, **labels) -> None:
+        if v < 0:
+            raise ValueError(f"counter {self.name}: negative inc {v}")
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + v
+
+    def reset(self, **labels) -> None:
+        """Zero a series — for code that must be invisible to
+        scrapes, e.g. serve warmup ticks that run before the
+        listener binds."""
+        with self._lock:
+            self._values[_label_key(labels)] = 0.0
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(v)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        """Evaluate ``fn`` at scrape time (point-in-time values with
+        one source of truth elsewhere, e.g. queue depth)."""
+        with self._lock:
+            self._fn = fn
+
+    def render(self) -> list:
+        with self._lock:
+            values = dict(self._values)
+            fn = self._fn
+        if fn is not None:
+            try:
+                values[()] = float(fn())
+            except Exception:  # noqa: BLE001 — scrape must not 500
+                pass
+        lines = self._header()
+        for key in sorted(values):
+            lines.append(
+                f"{self.name}{_label_str(key)} {_fmt(values[key])}"
+            )
+        return lines
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram (cumulative ``le`` buckets + ``_sum`` /
+    ``_count``), the exposition-format shape Prometheus' histogram_
+    quantile expects."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Sequence[float] = DEFAULT_TIME_BUCKETS,
+    ):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(buckets))
+        if not self.buckets:
+            raise ValueError(f"histogram {self.name}: empty buckets")
+        self._bucket_counts: Dict[LabelKey, list] = {}
+        self._sums: Dict[LabelKey, float] = {}
+        self._counts: Dict[LabelKey, int] = {}
+
+    def observe(self, v: float, n: int = 1, **labels) -> None:
+        """Record ``v``; ``n > 1`` records it n times in one locked
+        update — the sync-window case, where one host sync carries a
+        window of n per-step averages (sum and count then aggregate
+        exactly; only the bucket spread is collapsed to the mean)."""
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._bucket_counts.get(key)
+            if counts is None:
+                counts = [0] * (len(self.buckets) + 1)  # +Inf last
+                self._bucket_counts[key] = counts
+            # Linear scan: bucket lists are short (~17) and observe
+            # sits off the hot path (once per sync window).
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    counts[i] += n
+                    break
+            else:
+                counts[len(self.buckets)] += n
+            self._sums[key] = self._sums.get(key, 0.0) + v * n
+            self._counts[key] = self._counts.get(key, 0) + n
+
+    def value(self, **labels) -> float:
+        """Histogram 'value' is its observation count."""
+        with self._lock:
+            return float(self._counts.get(_label_key(labels), 0))
+
+    def render(self) -> list:
+        with self._lock:
+            bucket_counts = {
+                k: list(v) for k, v in self._bucket_counts.items()
+            }
+            sums = dict(self._sums)
+            counts = dict(self._counts)
+        lines = self._header()
+        for key in sorted(counts):
+            cum = 0
+            for i, ub in enumerate(self.buckets):
+                cum += bucket_counts[key][i]
+                le = _label_str(key, f'le="{_fmt(ub)}"')
+                lines.append(f"{self.name}_bucket{le} {cum}")
+            cum += bucket_counts[key][len(self.buckets)]
+            le = _label_str(key, 'le="+Inf"')
+            lines.append(f"{self.name}_bucket{le} {cum}")
+            lines.append(
+                f"{self.name}_sum{_label_str(key)} {_fmt(sums[key])}"
+            )
+            lines.append(f"{self.name}_count{_label_str(key)} {cum}")
+        return lines
+
+
+class Registry:
+    """Named metrics, one instance per kind; get-or-create accessors
+    so call sites never coordinate creation order."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, *args, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Sequence[float] = DEFAULT_TIME_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets)
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        lines = []
+        for _, m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    registry: Registry  # set on the server class by start_http_server
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        if self.path not in ("/metrics", "/metrics/"):
+            self.send_error(404)
+            return
+        body = self.server.registry.render().encode()  # type: ignore[attr-defined]
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrapes are not log events
+        pass
+
+
+def start_http_server(
+    registry: Registry, port: int, host: str = "0.0.0.0"
+) -> ThreadingHTTPServer:
+    """Serve ``registry`` at ``/metrics`` on ``port`` (0 = ephemeral;
+    bound port is ``server.server_address[1]``) from a daemon thread.
+    Caller owns shutdown()."""
+    httpd = ThreadingHTTPServer((host, port), _MetricsHandler)
+    httpd.registry = registry  # type: ignore[attr-defined]
+    threading.Thread(
+        target=httpd.serve_forever, daemon=True, name="obs-metrics"
+    ).start()
+    return httpd

@@ -28,6 +28,7 @@ class CheckpointManager:
         directory: str,
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
+        events=None,
     ):
         self.directory = os.path.abspath(directory)
         options = ocp.CheckpointManagerOptions(
@@ -36,16 +37,34 @@ class CheckpointManager:
             enable_async_checkpointing=True,
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        # tpufw.obs event log (or None): save/restore decisions become
+        # checkpoint_save / checkpoint_restore events, so a post-mortem
+        # can line the save cadence up against step times and stragglers.
+        if events is None:
+            from tpufw.obs import events as events_mod
+
+            events = events_mod.NULL
+        self.events = events
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         # force=True is the preemption path ("make sure THIS step is on
         # disk"); if the periodic schedule already saved it, that's
         # satisfied — not an error.
         if force and step in self._mgr.all_steps():
+            self.events.emit(
+                "checkpoint_save", step=step, forced=force, saved=False
+            )
             return False
-        return self._mgr.save(
+        saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
+        if saved or force:
+            # Periodic non-saves (off-interval steps) are not events;
+            # they would be one line per sync window of pure noise.
+            self.events.emit(
+                "checkpoint_save", step=step, forced=force, saved=bool(saved)
+            )
+        return saved
 
     def restore(self, abstract_state: Any, step: Optional[int] = None) -> Any:
         """Restore ``step`` (default: latest) sharded per ``abstract_state``
@@ -53,9 +72,11 @@ class CheckpointManager:
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
-        return self._mgr.restore(
+        restored = self._mgr.restore(
             step, args=ocp.args.StandardRestore(abstract_state)
         )
+        self.events.emit("checkpoint_restore", step=step)
+        return restored
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

@@ -50,12 +50,45 @@ class Meter:
         flops_per_token: float,
         n_chips: int,
         chip: ChipSpec | None = None,
+        registry=None,
     ):
         self.tokens_per_step = tokens_per_step
         self.flops_per_token = flops_per_token
         self.n_chips = max(n_chips, 1)
         self.chip = chip or detect_chip()
         self._t0: float | None = None
+        # Optional tpufw.obs.Registry: every stop() publishes the
+        # window into the shared scrape surface (histograms for the
+        # time distributions, gauges for the point-in-time headline).
+        self.registry = registry
+        if registry is not None:
+            self._c_steps = registry.counter(
+                "tpufw_train_steps_total", "optimizer steps completed"
+            )
+            self._c_tokens = registry.counter(
+                "tpufw_train_tokens_total", "target tokens trained on"
+            )
+            self._h_step = registry.histogram(
+                "tpufw_train_step_time_seconds",
+                "per-step wall time (window average when sync_every > 1)",
+            )
+            self._h_wait = registry.histogram(
+                "tpufw_train_data_wait_seconds",
+                "per-step host wait on the input pipeline",
+            )
+            self._g_step = registry.gauge(
+                "tpufw_train_step", "last synced optimizer step"
+            )
+            self._g_loss = registry.gauge(
+                "tpufw_train_loss", "loss at the last synced step"
+            )
+            self._g_mfu = registry.gauge(
+                "tpufw_train_mfu", "model FLOPs utilization (0..1)"
+            )
+            self._g_tps = registry.gauge(
+                "tpufw_train_tokens_per_sec_per_chip",
+                "throughput per chip",
+            )
 
     def start(self) -> None:
         self._t0 = time.perf_counter()
@@ -86,6 +119,17 @@ class Meter:
         self._t0 = None
         tps_chip = self.tokens_per_step / dt / self.n_chips
         mfu = tps_chip * self.flops_per_token / self.chip.peak_bf16_flops
+        if self.registry is not None:
+            self._c_steps.inc(n)
+            self._c_tokens.inc(self.tokens_per_step * n)
+            # Per-step averages observed n times: _sum/_count aggregate
+            # to the window's exact totals (see Histogram.observe).
+            self._h_step.observe(dt, n=n)
+            self._h_wait.observe(data_wait_s, n=n)
+            self._g_step.set(step)
+            self._g_loss.set(loss)
+            self._g_mfu.set(mfu)
+            self._g_tps.set(tps_chip)
         return StepMetrics(
             step=step,
             loss=loss,

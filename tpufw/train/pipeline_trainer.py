@@ -138,6 +138,9 @@ class PipelineTrainer:
         self._step_fn = None
         self._eval_fn = None
         self.preempted = False
+        from tpufw.obs import Telemetry
+
+        self.telemetry = Telemetry.disabled()
 
     # -- state ---------------------------------------------------------
 
@@ -309,10 +312,18 @@ class PipelineTrainer:
             self.init_state()
         owns_shutdown = False
         self.preempted = False
+        from tpufw.obs import Telemetry
+
+        tel = self.telemetry = Telemetry.create(
+            telemetry_dir=self.cfg.telemetry_dir,
+            metrics_port=self.cfg.metrics_port,
+            straggler_factor=self.cfg.straggler_factor,
+        )
         meter = Meter(
             tokens_per_step=self.cfg.batch_size * (self.cfg.seq_len - 1),
             flops_per_token=model_flops_per_token,
             n_chips=len(self.mesh.devices.flatten()),
+            registry=tel.registry,
         )
         ckpt = None
         if self.cfg.checkpoint_dir:
@@ -321,6 +332,7 @@ class PipelineTrainer:
             ckpt = CheckpointManager(
                 self.cfg.checkpoint_dir,
                 save_interval_steps=self.cfg.checkpoint_every,
+                events=tel.events,
             )
         from tpufw.train.trainer import globalize_batch
 
@@ -336,6 +348,7 @@ class PipelineTrainer:
             shutdown,
             self.cfg.handle_preemption,
             self.cfg.preemption_sync_every,
+            events=tel.events,
         )
         # Global step budget: a restored run finishes the remainder.
         start_step = int(self.state.step)
@@ -343,63 +356,107 @@ class PipelineTrainer:
         se = max(1, self.cfg.sync_every)
         window_n, window_wait = 0, 0.0
         history: list[StepMetrics] = []
-        try:
-            for i, (wait, batch) in enumerate(timed_batches(data)):
-                if i >= remaining:
-                    break
-                prof.maybe_start(i)
-                if window_n == 0:
-                    meter.start()
-                batch = globalize_batch(self.mesh, batch)
-                with prof.step(i):
-                    self.state, m = self._compiled_step(batch)(
-                        self.state, batch
-                    )
-                    window_n += 1
-                    window_wait += wait
-                    py_step = start_step + i + 1
-                    # Step 1, multiples of sync_every, and the last.
-                    sync = (
-                        i == 0
-                        or py_step % se == 0
-                        or i + 1 == remaining
-                    )
-                    if sync:
-                        loss = m["loss"]  # Meter.stop float()s it: the barrier
-                prof.maybe_stop(i)
-                if not sync:
-                    continue
+        tel.events.emit(
+            "run_start",
+            workload="train_pipeline",
+            start_step=start_step,
+            total_steps=self.cfg.total_steps,
+            batch_size=self.cfg.batch_size,
+            seq_len=self.cfg.seq_len,
+            sync_every=se,
+            n_chips=len(self.mesh.devices.flatten()),
+        )
+
+        def record_window(py_step, loss):
+            # Same shape as Trainer.run's: meter.stop (the float(loss)
+            # barrier) + step event + skew allgather, all on the one
+            # host sync per window.
+            with tel.tracer.span("host_sync"):
                 sm = meter.stop(
                     py_step, loss,
                     data_wait_s=window_wait, n_steps=window_n,
                 )
+                tel.events.emit(
+                    "step",
+                    step=sm.step,
+                    loss=round(sm.loss, 6),
+                    step_time_s=round(sm.step_time_s, 6),
+                    data_wait_s=round(sm.data_wait_s, 6),
+                    mfu=round(sm.mfu, 5),
+                    tokens_per_sec_per_chip=round(
+                        sm.tokens_per_sec_per_chip, 1
+                    ),
+                    window_steps=sm.window_steps,
+                )
+                if tel.skew is not None:
+                    tel.skew.record(
+                        sm.step,
+                        sm.step_time_s * sm.window_steps,
+                        sm.data_wait_s,
+                    )
+            return sm
+
+        try:
+            for i, (wait, batch) in enumerate(timed_batches(data)):
+                if i >= remaining:
+                    break
+                tel.tracer.complete("data_fetch", wait)
+                with tel.tracer.span("step_dispatch"):
+                    prof.maybe_start(i)
+                    if window_n == 0:
+                        meter.start()
+                    batch = globalize_batch(self.mesh, batch)
+                    with prof.step(i):
+                        self.state, m = self._compiled_step(batch)(
+                            self.state, batch
+                        )
+                        window_n += 1
+                        window_wait += wait
+                        py_step = start_step + i + 1
+                        # Step 1, multiples of sync_every, and the last.
+                        sync = (
+                            i == 0
+                            or py_step % se == 0
+                            or i + 1 == remaining
+                        )
+                        if sync:
+                            loss = m["loss"]  # Meter.stop float()s it: the barrier
+                    prof.maybe_stop(i)
+                if not sync:
+                    continue
+                sm = record_window(py_step, loss)
                 window_n, window_wait = 0, 0.0
                 history.append(sm)
                 if on_metrics and (
                     se > 1 or i % self.cfg.log_every == 0
                 ):
                     on_metrics(sm)
-                maybe_inloop_eval(self, py_step, eval_data, on_eval)
+                with tel.tracer.span("eval"):
+                    maybe_inloop_eval(self, py_step, eval_data, on_eval)
                 if ckpt is not None:
-                    ckpt.save(py_step, self.state)
+                    with tel.tracer.span("checkpoint"):
+                        ckpt.save(py_step, self.state)
                 # Gang-consistent preemption stop (tpufw.train.preemption).
-                if checkpoint_stop(
-                    shutdown, ckpt, py_step, self.state
-                ):
+                with tel.tracer.span("preemption_sync"):
+                    stop = checkpoint_stop(
+                        shutdown, ckpt, py_step, self.state
+                    )
+                if stop:
                     self.preempted = True
+                    tel.events.emit(
+                        "preemption_stop", level="warn", step=py_step
+                    )
                     break
             # Iterator exhausted mid-window: flush the open window.
             if window_n:
                 loss = m["loss"]  # Meter.stop float()s it: the barrier
-                sm = meter.stop(
-                    py_step, loss,
-                    data_wait_s=window_wait, n_steps=window_n,
-                )
+                sm = record_window(py_step, loss)
                 history.append(sm)
                 if on_metrics:
                     on_metrics(sm)
                 if ckpt is not None:
-                    ckpt.save(py_step, self.state)
+                    with tel.tracer.span("checkpoint"):
+                        ckpt.save(py_step, self.state)
         finally:
             prof.close()
             if ckpt is not None:
@@ -407,4 +464,11 @@ class PipelineTrainer:
                 ckpt.close()
             if owns_shutdown:
                 shutdown.uninstall()
+            tel.events.emit(
+                "run_end",
+                steps=len(history),
+                last_step=history[-1].step if history else start_step,
+                preempted=self.preempted,
+            )
+            tel.close()
         return history

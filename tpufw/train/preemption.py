@@ -46,6 +46,7 @@ class GracefulShutdown:
         self,
         signals: tuple = (signal.SIGTERM,),
         sync_every: int = 1,
+        events=None,
     ):
         self._flag = threading.Event()
         self._prev: dict = {}
@@ -55,6 +56,10 @@ class GracefulShutdown:
         self._sync_every = sync_every
         self._calls = 0
         self._stop_latched = False
+        # tpufw.obs event log (or None): the signal itself is logged,
+        # so the gap between SIGTERM and the gang's agreed stop step is
+        # measurable from the event stream.
+        self.events = events
         for sig in self._signals:
             try:
                 self._prev[sig] = signal.signal(sig, self._handle)
@@ -65,6 +70,13 @@ class GracefulShutdown:
 
     def _handle(self, signum, frame):
         self._flag.set()
+        if self.events is not None:
+            try:
+                self.events.emit(
+                    "preemption_signal", level="warn", signum=int(signum)
+                )
+            except Exception:  # noqa: BLE001 — never die in a handler
+                pass
         prev = self._prev.get(signum)
         if callable(prev):
             prev(signum, frame)
@@ -135,6 +147,7 @@ def owned_shutdown(
     shutdown: Optional[GracefulShutdown],
     enabled: bool,
     sync_every: int,
+    events=None,
 ) -> tuple[Optional[GracefulShutdown], bool]:
     """Trainer-side ownership helper: construct a GracefulShutdown iff the
     caller passed none and the config enables handling. Returns
@@ -144,7 +157,7 @@ def owned_shutdown(
     """
     if shutdown is not None or not enabled:
         return shutdown, False
-    return GracefulShutdown(sync_every=sync_every), True
+    return GracefulShutdown(sync_every=sync_every, events=events), True
 
 
 def checkpoint_stop(

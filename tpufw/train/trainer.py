@@ -350,6 +350,16 @@ def maybe_inloop_eval(trainer, step: int, eval_data, on_eval) -> None:
         return
     ev = trainer.evaluate(eval_data(), cfg.eval_batches)
     ev["step"] = step
+    tel = getattr(trainer, "telemetry", None)
+    if tel is not None:
+        tel.events.emit(
+            "eval",
+            **{
+                k: v if isinstance(v, int) else round(float(v), 6)
+                for k, v in ev.items()
+                if isinstance(v, (int, float))
+            },
+        )
     if on_eval:
         on_eval(ev)
 
@@ -432,6 +442,21 @@ class TrainerConfig:
     autotune_budget_s: float = 120.0
     # Timed steps per candidate (median is the score).
     autotune_steps: int = 3
+    # Unified telemetry (tpufw.obs). telemetry_dir: write the schema'd
+    # events.jsonl + Chrome-trace trace.json (Perfetto-loadable) per
+    # host under this dir, plus a final metrics.prom snapshot (None
+    # disables the files). metrics_port: serve the Prometheus registry
+    # at /metrics on this port from a daemon thread (None disables;
+    # 0 binds an ephemeral port — tests read Trainer.telemetry
+    # .bound_port). Set BOTH knobs uniformly across hosts: the skew
+    # monitor's per-window allgather is a collective. With both off
+    # the instrumentation degrades to shared no-ops (<1% per-step,
+    # asserted in tests/test_obs.py).
+    telemetry_dir: Optional[str] = None
+    metrics_port: Optional[int] = None
+    # A host is flagged (straggler_detected event, warn) when its sync
+    # window's wall time exceeds the fleet median by this factor.
+    straggler_factor: float = 2.0
 
 
 class Trainer:
@@ -476,6 +501,11 @@ class Trainer:
         # TuneResult of the last apply_autotune (tpufw.tune.runner);
         # None until cfg.autotune resolves in run().
         self.last_tune = None
+        # tpufw.obs.Telemetry, built per run() from the cfg knobs;
+        # the disabled singleton between runs so probes never branch.
+        from tpufw.obs import Telemetry
+
+        self.telemetry = Telemetry.disabled()
 
     def _abstract_state(self, rng):
         tokens = jnp.zeros(
@@ -747,12 +777,22 @@ class Trainer:
         on_eval: Callable[[dict], None] | None = None,
         shutdown: "GracefulShutdown | None" = None,
     ) -> list[StepMetrics]:
+        from tpufw.obs import Telemetry
+
+        # Telemetry FIRST: autotune trials and checkpoint restores in
+        # init_state are themselves events worth having.
+        tel = self.telemetry = Telemetry.create(
+            telemetry_dir=self.cfg.telemetry_dir,
+            metrics_port=self.cfg.metrics_port,
+            straggler_factor=self.cfg.straggler_factor,
+        )
         if self.cfg.autotune != "off":
             # Resolve BEFORE state init: a remat-policy winner rebuilds
             # the model, and the jitted step bakes every tuned knob in.
             from tpufw.tune.runner import apply_autotune
 
-            apply_autotune(self)
+            with tel.tracer.span("tune"):
+                apply_autotune(self, events=tel.events)
         if self.state is None:
             self.init_state()
         owns_shutdown = False
@@ -761,6 +801,7 @@ class Trainer:
             tokens_per_step=self.cfg.batch_size * (self.cfg.seq_len - 1),
             flops_per_token=model_flops_per_token,
             n_chips=len(self.mesh.devices.flatten()),
+            registry=tel.registry,
         )
         ckpt = None
         if self.cfg.checkpoint_dir:
@@ -769,6 +810,7 @@ class Trainer:
             ckpt = CheckpointManager(
                 self.cfg.checkpoint_dir,
                 save_interval_steps=self.cfg.checkpoint_every,
+                events=tel.events,
             )
         from tpufw.utils.profiling import StepProfiler
 
@@ -783,6 +825,7 @@ class Trainer:
             shutdown,
             self.cfg.handle_preemption,
             self.cfg.preemption_sync_every,
+            events=tel.events,
         )
         # total_steps is the GLOBAL optimizer-step budget (it sized the LR
         # schedule): a restored run finishes the remaining steps, it does
@@ -792,71 +835,120 @@ class Trainer:
         se = max(1, self.cfg.sync_every)
         window_n, window_wait = 0, 0.0
         history: list[StepMetrics] = []
+        tel.events.emit(
+            "run_start",
+            workload="train",
+            start_step=start_step,
+            total_steps=self.cfg.total_steps,
+            batch_size=self.cfg.batch_size,
+            seq_len=self.cfg.seq_len,
+            sync_every=se,
+            n_chips=len(self.mesh.devices.flatten()),
+        )
+
+        def record_window(py_step, loss):
+            # One host sync: meter.stop's float(loss) is the barrier.
+            # Everything published here describes the window just
+            # closed — StepMetrics to the caller, a step event to the
+            # log, per-host gauges + straggler check to the skew
+            # monitor (its allgather rides the sync the loop already
+            # pays for).
+            with tel.tracer.span("host_sync"):
+                sm = meter.stop(
+                    py_step, loss,
+                    data_wait_s=window_wait, n_steps=window_n,
+                )
+                tel.events.emit(
+                    "step",
+                    step=sm.step,
+                    loss=round(sm.loss, 6),
+                    step_time_s=round(sm.step_time_s, 6),
+                    data_wait_s=round(sm.data_wait_s, 6),
+                    mfu=round(sm.mfu, 5),
+                    tokens_per_sec_per_chip=round(
+                        sm.tokens_per_sec_per_chip, 1
+                    ),
+                    window_steps=sm.window_steps,
+                )
+                if tel.skew is not None:
+                    tel.skew.record(
+                        sm.step,
+                        sm.step_time_s * sm.window_steps,
+                        sm.data_wait_s,
+                    )
+            return sm
+
         try:
             with use_mesh(self.mesh):
                 for i, (wait, batch) in enumerate(timed_batches(data)):
                     if i >= remaining:
                         break
-                    batch = self.globalize_batch(batch)
-                    step_fn = self.compiled_step(batch)
-                    prof.maybe_start(i)
-                    if window_n == 0:
-                        meter.start()
-                    with prof.step(i):
-                        self.state, m = step_fn(self.state, batch)
-                        window_n += 1
-                        window_wait += wait
-                        # state.step advances by exactly 1 per step_fn:
-                        # tracking it host-side avoids a device fetch
-                        # (= a round trip on tunneled backends) per step.
-                        py_step = start_step + i + 1
-                        # Sync at step 1 (compile boundary), then at
-                        # steps that are MULTIPLES of sync_every — so
-                        # checkpoint_every/eval_every aligned to
-                        # sync_every actually fire — and at the last.
-                        sync = (
-                            i == 0
-                            or py_step % se == 0
-                            or i + 1 == remaining
-                        )
-                        if sync:
-                            loss = m["loss"]  # Meter.stop float()s it: the barrier
-                    prof.maybe_stop(i)
+                    tel.tracer.complete("data_fetch", wait)
+                    with tel.tracer.span("step_dispatch"):
+                        batch = self.globalize_batch(batch)
+                        step_fn = self.compiled_step(batch)
+                        prof.maybe_start(i)
+                        if window_n == 0:
+                            meter.start()
+                        with prof.step(i):
+                            self.state, m = step_fn(self.state, batch)
+                            window_n += 1
+                            window_wait += wait
+                            # state.step advances by exactly 1 per
+                            # step_fn: tracking it host-side avoids a
+                            # device fetch (= a round trip on tunneled
+                            # backends) per step.
+                            py_step = start_step + i + 1
+                            # Sync at step 1 (compile boundary), then
+                            # at steps that are MULTIPLES of sync_every
+                            # — so checkpoint_every/eval_every aligned
+                            # to sync_every actually fire — and at the
+                            # last.
+                            sync = (
+                                i == 0
+                                or py_step % se == 0
+                                or i + 1 == remaining
+                            )
+                            if sync:
+                                loss = m["loss"]  # Meter.stop float()s it: the barrier
+                        prof.maybe_stop(i)
                     if not sync:
                         continue
-                    sm = meter.stop(
-                        py_step, loss,
-                        data_wait_s=window_wait, n_steps=window_n,
-                    )
+                    sm = record_window(py_step, loss)
                     window_n, window_wait = 0, 0.0
                     history.append(sm)
                     if on_metrics and (
                         se > 1 or i % self.cfg.log_every == 0
                     ):
                         on_metrics(sm)
-                    maybe_inloop_eval(self, py_step, eval_data, on_eval)
+                    with tel.tracer.span("eval"):
+                        maybe_inloop_eval(self, py_step, eval_data, on_eval)
                     if ckpt is not None:
-                        ckpt.save(py_step, self.state)
+                        with tel.tracer.span("checkpoint"):
+                            ckpt.save(py_step, self.state)
                     # Collective decision (see preemption.py): the whole
                     # gang breaks at the same step or not at all.
-                    if checkpoint_stop(
-                        shutdown, ckpt, py_step, self.state
-                    ):
+                    with tel.tracer.span("preemption_sync"):
+                        stop = checkpoint_stop(
+                            shutdown, ckpt, py_step, self.state
+                        )
+                    if stop:
                         self.preempted = True
+                        tel.events.emit(
+                            "preemption_stop", level="warn", step=py_step
+                        )
                         break
                 # Iterator exhausted mid-window: flush the open window
                 # so every executed step is metered and checkpointable.
                 if window_n:
                     loss = m["loss"]  # Meter.stop float()s it: the barrier
-                    sm = meter.stop(
-                        py_step, loss,
-                        data_wait_s=window_wait, n_steps=window_n,
-                    )
+                    sm = record_window(py_step, loss)
                     history.append(sm)
                     if on_metrics:
                         on_metrics(sm)
                     if ckpt is not None:
-                        ckpt.save(py_step, self.state)
+                        with tel.tracer.span("checkpoint"):
+                            ckpt.save(py_step, self.state)
         finally:
             # Flush even on a mid-loop crash: the trace and the last
             # checkpoint are exactly what post-mortems need.
@@ -866,4 +958,11 @@ class Trainer:
                 ckpt.close()
             if owns_shutdown:
                 shutdown.uninstall()
+            tel.events.emit(
+                "run_end",
+                steps=len(history),
+                last_step=history[-1].step if history else start_step,
+                preempted=self.preempted,
+            )
+            tel.close()
         return history

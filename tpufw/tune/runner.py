@@ -77,6 +77,7 @@ def search(
     measure_fn: Callable[[Candidate], float],
     budget_s: float = 120.0,
     pruned: Optional[list] = None,
+    events=None,
 ) -> TuneResult:
     """Measure candidates under a wall-clock budget; best = min median.
 
@@ -84,22 +85,41 @@ def search(
     work (tests inject a fake); any exception it raises quarantines that
     candidate only. The first candidate is always measured even if the
     budget is already blown, so the result is never empty-by-budget.
+    ``events`` (tpufw.obs event log) gets one ``tune_trial`` line per
+    candidate as it resolves — a hung measure is then localizable to
+    the exact candidate from the event stream.
     """
+    if events is None:
+        from tpufw.obs import events as events_mod
+
+        events = events_mod.NULL
     t0 = time.perf_counter()
     trials: list[Trial] = []
     measured_any = False
+
+    def log_trial(t: Trial) -> None:
+        trials.append(t)
+        events.emit(
+            "tune_trial",
+            trial=len(trials) - 1,
+            status=t.status,
+            candidate=t.candidate.as_dict(),
+            median_step_s=t.median_step_s,
+            error=t.error,
+        )
+
     for cand in candidates:
         if measured_any and time.perf_counter() - t0 > budget_s:
-            trials.append(Trial(cand, "skipped_budget"))
+            log_trial(Trial(cand, "skipped_budget"))
             continue
         try:
             med = float(measure_fn(cand))
         except Exception as e:  # noqa: BLE001 — quarantine, never abort
-            trials.append(
+            log_trial(
                 Trial(cand, "quarantined", error=f"{type(e).__name__}: {e}")
             )
             continue
-        trials.append(Trial(cand, "ok", median_step_s=med))
+        log_trial(Trial(cand, "ok", median_step_s=med))
         measured_any = True
     ok = [t for t in trials if t.status == "ok"]
     best = min(ok, key=lambda t: t.median_step_s, default=None)
@@ -245,6 +265,7 @@ def apply_candidate(trainer, cand: Candidate) -> None:
 def apply_autotune(
     trainer,
     space: Optional[SearchSpace] = None,
+    events=None,
 ) -> Optional[TuneResult]:
     """The Trainer.run entry: resolve TrainerConfig.autotune.
 
@@ -253,8 +274,13 @@ def apply_autotune(
       compile-and-measure search, persists the winner, applies it.
 
     Returns the TuneResult (also stashed as ``trainer.last_tune``) or
-    None when mode is "off"/unknown.
+    None when mode is "off"/unknown. ``events`` (tpufw.obs event log)
+    gets per-candidate ``tune_trial`` lines and one ``tune_result``.
     """
+    if events is None:
+        from tpufw.obs import events as events_mod
+
+        events = events_mod.NULL
     mode = getattr(trainer.cfg, "autotune", "off")
     if mode not in ("cached", "search"):
         return None
@@ -267,6 +293,7 @@ def apply_autotune(
             tune_s=0.0, cache_hit=True, cache_key=key, mode=mode,
         )
         trainer.last_tune = result
+        events.emit("tune_result", **result.summary())
         return result
     if mode == "cached":
         result = TuneResult(
@@ -274,6 +301,7 @@ def apply_autotune(
             tune_s=0.0, cache_hit=False, cache_key=key, mode=mode,
         )
         trainer.last_tune = result
+        events.emit("tune_result", **result.summary())
         return result
 
     import jax
@@ -304,6 +332,7 @@ def apply_autotune(
         measure,
         budget_s=getattr(trainer.cfg, "autotune_budget_s", 120.0),
         pruned=pruned,
+        events=events,
     )
     result.cache_key = key
     result.mode = mode
@@ -316,4 +345,5 @@ def apply_autotune(
         )
         apply_candidate(trainer, result.best)
     trainer.last_tune = result
+    events.emit("tune_result", **result.summary())
     return result

@@ -99,6 +99,17 @@ def report_preemption(trainer) -> None:
         )
 
 
+def report_telemetry(trainer) -> None:
+    """One JSON line pointing at the run's telemetry artifacts
+    (events.jsonl + trace.json under TPUFW_TELEMETRY_DIR) so log
+    scrapers and CI can find them without knowing the env."""
+    tel = getattr(trainer, "telemetry", None)
+    if tel is not None and getattr(tel, "out_dir", None):
+        print(
+            json.dumps({"telemetry_dir": tel.out_dir}), flush=True
+        )
+
+
 def print_summary(history: list[StepMetrics]) -> None:
     if not history:
         return

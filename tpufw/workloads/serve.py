@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Optional
 
+from tpufw.obs.registry import Registry as ObsRegistry
 from tpufw.workloads.env import env_float, env_int, env_str
 
 _T0 = time.time()
@@ -592,58 +593,53 @@ class _Pending:
 
 
 class _Metrics:
-    """Thread-safe Prometheus counters for the serving loop — the
-    serving analog of the device plugin's /metrics endpoint
-    (deviceplugin/shim exposes the same text exposition format), no
-    client library needed. Counters only; point-in-time gauges are
-    rendered by the caller at scrape time."""
+    """Serving metrics on the shared ``tpufw.obs`` registry — the same
+    ``tpufw_serve_*`` names and text exposition as the original
+    hand-rolled class; the exposition code itself now lives in
+    ``tpufw.obs.registry`` (one implementation for this endpoint, the
+    trainer's ``TPUFW_METRICS_PORT``, and the device-plugin analog).
+    Call sites keep the short names ("requests_total"); the prefix is
+    applied here."""
 
-    def __init__(self):
-        self._lock = threading.Lock()
+    PREFIX = "tpufw_serve_"
+
+    def __init__(self, registry: Optional[ObsRegistry] = None):
+        self.registry = registry if registry is not None else ObsRegistry()
         # Pre-initialized to 0 (client-library convention): an alert on
         # increase(...errors_total) must see a real 0-valued series
         # before the first error, not an absent one.
-        self._c: dict[str, float] = {
-            name: 0.0
-            for name in (
-                "requests_total",
-                "request_errors_total",
-                "request_seconds_total",
-                "ticks_total",
-                "tick_rows_total",
-                "tokens_generated_total",
-            )
-        }
+        self.register(
+            "requests_total",
+            "request_errors_total",
+            "request_seconds_total",
+            "ticks_total",
+            "tick_rows_total",
+            "tokens_generated_total",
+        )
 
     def inc(self, name: str, v: float = 1.0) -> None:
-        with self._lock:
-            self._c[name] = self._c.get(name, 0.0) + v
+        self.registry.counter(self.PREFIX + name).inc(v)
 
     def register(self, *names: str) -> None:
         """Expose counters at 0 before their first increment (same
         absent-series rationale as the pre-initialized set) — for
         feature-gated counters like the speculative pair."""
-        with self._lock:
-            for name in names:
-                self._c.setdefault(name, 0.0)
+        for name in names:
+            self.registry.counter(self.PREFIX + name)
 
-    @staticmethod
-    def _fmt(v: float) -> str:
-        # repr, not %g: %g rounds to 6 significant digits, which stalls
-        # large counters (rate() then reads 0 until a 10-unit jump).
-        return str(int(v)) if v == int(v) else repr(v)
+    def reset(self, *names: str) -> None:
+        """Zero counters that moved during work that must stay
+        invisible to scrapes (warmup runs before the listener binds)."""
+        for name in names:
+            self.registry.counter(self.PREFIX + name).reset()
 
     def render(self, gauges: dict[str, float]) -> str:
-        with self._lock:
-            counters = dict(self._c)
-        lines = []
-        for name in sorted(counters):
-            lines.append(f"# TYPE tpufw_serve_{name} counter")
-            lines.append(f"tpufw_serve_{name} {self._fmt(counters[name])}")
-        for name in sorted(gauges):
-            lines.append(f"# TYPE tpufw_serve_{name} gauge")
-            lines.append(f"tpufw_serve_{name} {self._fmt(gauges[name])}")
-        return "\n".join(lines) + "\n"
+        """Prometheus text exposition; ``gauges`` are the caller's
+        point-in-time values, refreshed into the registry at scrape
+        time (they have one source of truth elsewhere)."""
+        for name, v in gauges.items():
+            self.registry.gauge(self.PREFIX + name).set(float(v))
+        return self.registry.render()
 
 
 class _Batcher:
@@ -920,9 +916,9 @@ class _Server:
         finally:
             self._tick_index = tick0
             if self._draft is not None:
-                with self.metrics._lock:
-                    self.metrics._c["spec_iterations_total"] = 0.0
-                    self.metrics._c["spec_emitted_total"] = 0.0
+                self.metrics.reset(
+                    "spec_iterations_total", "spec_emitted_total"
+                )
 
     def admit_sampling(self, sampling) -> bool:
         """True if this non-default config is within the server's

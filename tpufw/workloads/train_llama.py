@@ -14,7 +14,13 @@ from __future__ import annotations
 import json
 import time
 
-from tpufw.workloads.env import env_bool, env_float, env_int, env_str
+from tpufw.workloads.env import (
+    env_bool,
+    env_float,
+    env_int,
+    env_opt_int,
+    env_str,
+)
 
 # Import time ~= process start: the anchor for cold-start→first-step
 # (BASELINE.md metric 2 — the reference's analog is its unmeasured
@@ -179,6 +185,16 @@ def build_trainer():
             "autotune_budget_s", base_t.autotune_budget_s
         ),
         autotune_steps=env_int("autotune_steps", base_t.autotune_steps),
+        # Unified telemetry (tpufw.obs): TPUFW_TELEMETRY_DIR writes
+        # events.jsonl + trace.json per host; TPUFW_METRICS_PORT
+        # serves Prometheus /metrics (unset = off, 0 = ephemeral).
+        telemetry_dir=env_str(
+            "telemetry_dir", base_t.telemetry_dir or ""
+        ) or None,
+        metrics_port=env_opt_int("metrics_port", base_t.metrics_port),
+        straggler_factor=env_float(
+            "straggler_factor", base_t.straggler_factor
+        ),
     )
     if trainer_cfg.autotune not in ("off", "cached", "search"):
         raise ValueError(
@@ -470,7 +486,10 @@ def main() -> int:
         eval_data=eval_data,
         on_eval=lambda ev: print(json.dumps(ev), flush=True),
     )
-    from tpufw.workloads._common import report_preemption
+    from tpufw.workloads._common import (
+        report_preemption,
+        report_telemetry,
+    )
 
     if trainer.last_tune is not None:
         # One JSON line, same channel as step metrics: the chosen
@@ -480,6 +499,7 @@ def main() -> int:
             flush=True,
         )
     report_preemption(trainer)
+    report_telemetry(trainer)
     print_summary(history)
     return 0
 

@@ -24,7 +24,13 @@ from __future__ import annotations
 import json
 import time
 
-from tpufw.workloads.env import env_bool, env_float, env_int, env_str
+from tpufw.workloads.env import (
+    env_bool,
+    env_float,
+    env_int,
+    env_opt_int,
+    env_str,
+)
 
 _T0 = time.time()
 
@@ -91,6 +97,11 @@ def build_trainer():
         # Same SIGTERM-to-forced-checkpoint contract as train_llama.
         handle_preemption=env_bool("handle_preemption", True),
         preemption_sync_every=env_int("preemption_sync_every", 1),
+        sync_every=env_int("sync_every", 1),
+        # Unified telemetry (tpufw.obs) — same knobs as train_llama.
+        telemetry_dir=env_str("telemetry_dir", "") or None,
+        metrics_port=env_opt_int("metrics_port"),
+        straggler_factor=env_float("straggler_factor", 2.0),
     )
     mesh_cfg = MeshConfig(
         data=env_int("mesh_data", 1),
@@ -173,9 +184,13 @@ def main() -> int:
         eval_data=eval_data,
         on_eval=lambda ev: print(json.dumps(ev), flush=True),
     )
-    from tpufw.workloads._common import report_preemption
+    from tpufw.workloads._common import (
+        report_preemption,
+        report_telemetry,
+    )
 
     report_preemption(trainer)
+    report_telemetry(trainer)
     print_summary(history)
     return 0
 
