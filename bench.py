@@ -45,12 +45,20 @@ import statistics
 import sys
 import time
 
-_T0 = float(os.environ.get("TPUFW_BENCH_T0") or time.time())
-_STAGE = os.environ.get("TPUFW_BENCH_STAGE", "")
+from tpufw.workloads.env import (
+    env_bool,
+    env_float,
+    env_int,
+    env_opt_str,
+    env_str,
+)
+
+_T0 = env_float("bench_t0", 0.0) or time.time()
+_STAGE = env_str("bench_stage", "")
 _IS_WORKER = _STAGE == "worker"
 # The worker's share of its orchestrator-assigned watchdog budget
 # (it started ~at _T0).
-_BUDGET_S = int(os.environ.get("TPUFW_BENCH_TIMEOUT", "1200"))
+_BUDGET_S = env_int("bench_timeout", 1200)
 
 
 def _time_left() -> float:
@@ -68,7 +76,7 @@ def _persist(line: str) -> None:
     lesson: a later hang/kill must not erase an already-won number).
     Path: TPUFW_BENCH_SAVE, default ``.bench-last-tpu.json`` next to
     this file. Best-effort — persistence must never kill the bench."""
-    path = os.environ.get("TPUFW_BENCH_SAVE") or os.path.join(
+    path = env_opt_str("bench_save") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), ".bench-last-tpu.json"
     )
     try:
@@ -138,7 +146,7 @@ def _run_worker(extra_env: dict, timeout: int) -> tuple[str | None, str]:
     # stuck inside a server-side compile keeps the RPC alive through
     # the grace window so the server isn't orphaned mid-compile — and
     # only SIGKILL after TPUFW_BENCH_KILL_GRACE (default 120s).
-    grace = int(os.environ.get("TPUFW_BENCH_KILL_GRACE", "120"))
+    grace = env_int("bench_kill_grace", 120)
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
         env=env,
@@ -252,14 +260,14 @@ def _probe_tpu(timeout: int) -> tuple[str, str]:
 
 def _orchestrate() -> int:
     t_start = time.time()
-    total = int(os.environ.get("TPUFW_BENCH_TOTAL", "1800"))
-    tpu_timeout = int(os.environ.get("TPUFW_BENCH_TIMEOUT", "1200"))
-    cpu_timeout = int(os.environ.get("TPUFW_BENCH_CPU_TIMEOUT", "600"))
-    probe_timeout = int(os.environ.get("TPUFW_BENCH_PROBE_TIMEOUT", "150"))
+    total = env_int("bench_total", 1800)
+    tpu_timeout = env_int("bench_timeout", 1200)
+    cpu_timeout = env_int("bench_cpu_timeout", 600)
+    probe_timeout = env_int("bench_probe_timeout", 150)
     # A hung worker consumes its budget PLUS the TERM->KILL grace
     # window; every budget handed to _run_worker below subtracts it so
     # the orchestration never overshoots TPUFW_BENCH_TOTAL.
-    grace = int(os.environ.get("TPUFW_BENCH_KILL_GRACE", "120"))
+    grace = env_int("bench_kill_grace", 120)
 
     def left() -> float:
         return total - (time.time() - t_start)
@@ -591,8 +599,8 @@ def _worker() -> int:
     # can never serve this machine a wrong-ISA executable (BENCH_r02's
     # SIGILL warning spray).
     cache_dir = enable_compile_cache(
-        os.environ.get(
-            "TPUFW_COMPILE_CACHE_DIR",
+        env_str(
+            "compile_cache_dir",
             os.path.join(os.path.dirname(__file__), ".xla-cache"),
         )
     )
@@ -619,7 +627,7 @@ def _worker() -> int:
     from tpufw.models import LLAMA_CONFIGS
     from tpufw.utils import detect_chip
 
-    warm_tier = os.environ.get("TPUFW_BENCH_WARM_TIER")
+    warm_tier = env_opt_str("bench_warm_tier")
     if warm_tier:
         # Warm-restart mode: re-run ONLY the headline tier against the
         # now-warm compile cache and report this process's own
@@ -680,12 +688,12 @@ def _worker() -> int:
     # MFU autotuning on the HEADLINE tier only (aux tiers measure fixed
     # configs by design). "search"/"cached" resolve inside trainer.run;
     # tune_out reports the chosen config + wall time in the payload.
-    autotune_mode = os.environ.get("TPUFW_AUTOTUNE", "off")
+    autotune_mode = env_str("autotune", "off")
     tune_out: dict = {}
     # Unified telemetry for the HEADLINE tier (tpufw.obs): the events/
     # trace of the run behind the headline number, dir echoed in the
     # payload so a regression hunt starts from the bench JSON itself.
-    telemetry_dir = os.environ.get("TPUFW_TELEMETRY_DIR") or None
+    telemetry_dir = env_opt_str("telemetry_dir")
     for batch_size, seq_len, chunk, policy in tiers:
         # Each OOM fallback pays a FRESH server-side compile (2-10 min
         # through the tunnel); starting one the budget can't cover
@@ -814,11 +822,11 @@ def _worker() -> int:
     # tiers: unlike packed/long-seq/decode it has no banked number from
     # any earlier round.
     block8b = None
-    if on_tpu and os.environ.get("TPUFW_BENCH_BLOCK8B", "1") != "0":
+    if on_tpu and env_bool("bench_block8b", True):
         block8b = _aux_skip(300)
-    if on_tpu and block8b is None and os.environ.get(
-        "TPUFW_BENCH_BLOCK8B", "1"
-    ) != "0":
+    if on_tpu and block8b is None and env_bool(
+        "bench_block8b", True
+    ):
         # Aux-tier discipline: a tier failure degrades into an error
         # entry, never an exception out of _worker — a non-zero worker
         # exit discards the already-measured TPU headline (the
@@ -914,11 +922,11 @@ def _worker() -> int:
     # kernels measure the real serving rate. This is the north-star
     # model SHAPE producing tokens on real hardware.
     int8_8b = None
-    if on_tpu and os.environ.get("TPUFW_BENCH_INT8_8B", "1") != "0":
+    if on_tpu and env_bool("bench_int8_8b", True):
         int8_8b = _aux_skip(300)
-    if on_tpu and int8_8b is None and os.environ.get(
-        "TPUFW_BENCH_INT8_8B", "1"
-    ) != "0":
+    if on_tpu and int8_8b is None and env_bool(
+        "bench_int8_8b", True
+    ):
         try:
             import dataclasses as _dc8
             import gc as _gc8
@@ -974,7 +982,7 @@ def _worker() -> int:
     _attach("int8_8b", int8_8b)
 
     packed = None
-    if on_tpu and os.environ.get("TPUFW_BENCH_PACKED", "1") != "0":
+    if on_tpu and env_bool("bench_packed", True):
         packed = _aux_skip(240)
         if packed is None:
             try:
@@ -1013,7 +1021,7 @@ def _worker() -> int:
     # flash kernel — the memory regime where materialized logits would
     # OOM. Best-effort: an OOM here skips the tier, not the bench.
     long_seq = None
-    if on_tpu and os.environ.get("TPUFW_BENCH_LONGSEQ", "1") != "0":
+    if on_tpu and env_bool("bench_longseq", True):
         long_seq = _aux_skip(240)
         if long_seq is None:
             try:
@@ -1051,11 +1059,11 @@ def _worker() -> int:
     # same architecture (the serving half, tpufw.infer). Fresh random
     # params — decode speed is weight-value-independent.
     decode = None
-    if on_tpu and os.environ.get("TPUFW_BENCH_DECODE", "1") != "0":
+    if on_tpu and env_bool("bench_decode", True):
         decode = _aux_skip(240)
-    if on_tpu and decode is None and os.environ.get(
-        "TPUFW_BENCH_DECODE", "1"
-    ) != "0":
+    if on_tpu and decode is None and env_bool(
+        "bench_decode", True
+    ):
         try:
             import dataclasses as _dc0
             import gc
@@ -1179,11 +1187,11 @@ def _worker() -> int:
     # this is the end-to-end number behind that claim. Best-effort like
     # every aux tier.
     mla_decode = None
-    if on_tpu and os.environ.get("TPUFW_BENCH_MLA", "1") != "0":
+    if on_tpu and env_bool("bench_mla", True):
         mla_decode = _aux_skip(300)
-    if on_tpu and mla_decode is None and os.environ.get(
-        "TPUFW_BENCH_MLA", "1"
-    ) != "0":
+    if on_tpu and mla_decode is None and env_bool(
+        "bench_mla", True
+    ):
         try:
             import dataclasses as _dcm
             import gc
@@ -1271,13 +1279,13 @@ def _worker() -> int:
     # the payload rather than killing the measured headline.
 
     resnet = None
-    if on_tpu and os.environ.get("TPUFW_BENCH_RESNET", "1") != "0":
+    if on_tpu and env_bool("bench_resnet", True):
         # Headroom for up to three fresh ResNet-50 compiles on the
         # OOM-fallback ladder.
         resnet = _aux_skip(360)
-    if on_tpu and resnet is None and os.environ.get(
-        "TPUFW_BENCH_RESNET", "1"
-    ) != "0":
+    if on_tpu and resnet is None and env_bool(
+        "bench_resnet", True
+    ):
         try:
             import gc
 
@@ -1365,12 +1373,12 @@ def _worker() -> int:
     # one-hot contractions cap this shape at 10% MFU (docs/PERF.md).
     # MFU is over ACTIVE FLOPs (MixtralConfig.flops_per_token).
     moe = None
-    if on_tpu and os.environ.get("TPUFW_BENCH_MOE", "1") != "0":
+    if on_tpu and env_bool("bench_moe", True):
         # Headroom for a fresh compile at the first ladder rung.
         moe = _aux_skip(360)
-    if on_tpu and moe is None and os.environ.get(
-        "TPUFW_BENCH_MOE", "1"
-    ) != "0":
+    if on_tpu and moe is None and env_bool(
+        "bench_moe", True
+    ):
         try:
             import jax.numpy as _jnpm
 

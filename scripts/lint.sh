@@ -1,17 +1,25 @@
 #!/usr/bin/env bash
-# Lint gate: ruff over the library, workloads, and tests.
+# Lint gate, two stages:
 #
-# Degrades gracefully where ruff isn't installed (the training
-# container bakes only the runtime deps): prints a skip notice and
-# exits 0 so local pre-commit hooks and container smoke runs don't
-# fail on tooling absence. CI installs ruff explicitly
-# (.github/workflows/ci.yml), so the gate is real where it matters.
+#   1. ruff over the library, workloads, and tests. Degrades
+#      gracefully where ruff isn't installed (the training container
+#      bakes only the runtime deps): prints a skip notice so local
+#      pre-commit hooks and container smoke runs don't fail on
+#      tooling absence. CI installs ruff explicitly
+#      (.github/workflows/ci.yml), so that stage is real where it
+#      matters.
+#   2. tpulint (python -m tpufw.analysis) — the repo's own stdlib-ast
+#      JAX/TPU rules (docs/ANALYSIS.md): hot-loop purity, mesh-axis
+#      names, RNG discipline, env + observability registry hygiene.
+#      No dependencies, so it always runs; exits non-zero on any
+#      finding not absorbed by analysis_baseline.json.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-if ! command -v ruff >/dev/null 2>&1; then
+if command -v ruff >/dev/null 2>&1; then
+    ruff check tpufw tests bench.py scripts "$@"
+else
     echo "lint: ruff not installed; skipping (pip install ruff to enable)" >&2
-    exit 0
 fi
 
-ruff check tpufw tests bench.py scripts "$@"
+python -m tpufw.analysis

@@ -123,7 +123,9 @@ def main() -> int:
 
     # TPUFW_RESNET_MICRO_ONLY=1: skip the train/forward sections (e.g.
     # re-running only a fixed conv-micro methodology on banked tiers).
-    micro_only = os.environ.get("TPUFW_RESNET_MICRO_ONLY") == "1"
+    from tpufw.workloads.env import env_bool
+
+    micro_only = env_bool("resnet_micro_only", False)
 
     # 1 + 3. Train step at batch sweep through the bench path.
     for batch in ([] if micro_only else [8] if SMOKE else

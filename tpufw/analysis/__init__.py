@@ -1,0 +1,39 @@
+"""tpulint — JAX/TPU-aware static analysis for the tpufw tree.
+
+``python -m tpufw.analysis [paths...]`` runs five domain rules no
+generic linter can express (see docs/ANALYSIS.md for the catalog):
+
+- TPU001 hot-loop purity: no host syncs in traced code or step loops
+- TPU002 mesh/axis consistency: collective + PartitionSpec axis
+  literals must resolve to declared mesh axes
+- TPU003 RNG-key discipline: no reused / hot-returned PRNG keys
+- TPU004 env-var registry: TPUFW_* knobs round-trip through
+  tpufw.workloads.env and docs/ENV.md
+- TPU005 obs-name hygiene: event kinds and metric names match the
+  schema and the documented catalog
+
+Stdlib-only (``ast``); importing this package never imports jax, so
+the lint runs in bare CI containers and pre-commit hooks.
+"""
+
+from tpufw.analysis.core import (  # noqa: F401
+    Checker,
+    Finding,
+    Project,
+    all_checkers,
+    load_baseline,
+    run_analysis,
+    split_by_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "Checker",
+    "Finding",
+    "Project",
+    "all_checkers",
+    "load_baseline",
+    "run_analysis",
+    "split_by_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,144 @@
+"""CLI for tpulint: ``python -m tpufw.analysis [paths...]``.
+
+Exit codes: 0 = clean (or everything baselined), 1 = new findings,
+2 = usage error. With no paths the default scan set is the library,
+the scripts, and bench.py. ``analysis_baseline.json`` at the repo
+root is applied automatically when present (``--no-baseline`` for
+the raw view); the baseline may only shrink — regenerate it with
+``--write-baseline`` only to *remove* fixed entries.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from tpufw.analysis import core
+
+DEFAULT_BASELINE = "analysis_baseline.json"
+
+
+def _default_paths(root: str) -> List[str]:
+    out = []
+    for p in ("tpufw", "scripts", "bench.py"):
+        full = os.path.join(root, p)
+        if os.path.exists(full):
+            out.append(full)
+    return out
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpufw.analysis",
+        description="tpulint: JAX/TPU-aware static analysis",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to scan (default: tpufw scripts bench.py)",
+    )
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument(
+        "--rules",
+        help="comma-separated rule subset (e.g. TPU001,TPU004)",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument(
+        "--baseline",
+        default=None,
+        help=f"baseline file (default: <root>/{DEFAULT_BASELINE} if present)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore any baseline; report every finding",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        metavar="PATH",
+        help="write current findings as the new baseline and exit 0",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for c in core.all_checkers():
+            print(f"{c.rule}  {c.name}  [{c.severity}]")
+        return 0
+
+    root = core.find_repo_root(args.paths[0] if args.paths else ".")
+    paths = args.paths or _default_paths(root)
+    if not paths:
+        print("tpulint: nothing to scan", file=sys.stderr)
+        return 2
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules
+        else None
+    )
+    try:
+        findings = core.run_analysis(paths, root=root, rules=rules)
+    except ValueError as e:
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        core.write_baseline(args.write_baseline, findings)
+        print(
+            f"tpulint: wrote baseline with {len(findings)} finding(s) "
+            f"to {args.write_baseline}"
+        )
+        return 0
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    baseline = set()
+    if not args.no_baseline and os.path.exists(baseline_path):
+        try:
+            baseline = core.load_baseline(baseline_path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"tpulint: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+    new, old, stale = core.split_by_baseline(findings, baseline)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_dict() for f in new],
+                    "baselined": [f.as_dict() for f in old],
+                    "stale_baseline_keys": sorted(stale),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        if old:
+            print(
+                f"tpulint: {len(old)} pre-existing finding(s) tolerated "
+                f"by baseline {os.path.relpath(baseline_path, root)}"
+            )
+        if stale:
+            print(
+                f"tpulint: {len(stale)} baseline entr"
+                f"{'y is' if len(stale) == 1 else 'ies are'} no longer "
+                "observed — shrink the baseline "
+                "(python -m tpufw.analysis --write-baseline "
+                f"{os.path.relpath(baseline_path, root)}):"
+            )
+            for k in sorted(stale):
+                print(f"  stale: {k}")
+        if not new:
+            print(
+                f"tpulint: clean ({len(findings)} finding(s) total, "
+                f"{len(old)} baselined)"
+            )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

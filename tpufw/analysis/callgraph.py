@@ -1,0 +1,374 @@
+"""Shared AST infrastructure for the tpulint checkers: a project-wide
+function index, best-effort name resolution (imports, module-level
+string constants), jit/shard_map root discovery, and a conservative
+reachability walk.
+
+Resolution is deliberately heuristic — no type inference, no dynamic
+dispatch. Calls resolve by (a) same-module definitions, (b) explicit
+``from X import name`` / ``import X as y`` bindings, (c) ``self.m``
+to a method named ``m`` in the same file. Anything else is skipped,
+which biases the suite toward false negatives over false positives:
+a lint that cries wolf gets suppressed wholesale and then catches
+nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple, Union
+
+from tpufw.analysis.core import Project, SourceFile
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+
+def module_name(relpath: str) -> str:
+    """tpufw/train/trainer.py -> tpufw.train.trainer (best effort)."""
+    p = relpath.replace(os.sep, "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+def attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """``jax.lax.psum`` -> ["jax", "lax", "psum"]; None if the chain
+    bottoms out in anything but a Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Last segment of the callee (``jax.jit`` -> "jit")."""
+    chain = attr_chain(node.func)
+    return chain[-1] if chain else None
+
+
+class FunctionInfo:
+    def __init__(self, qname: str, node: FuncNode, file: SourceFile):
+        self.qname = qname
+        self.node = node
+        self.file = file
+        self.module = module_name(file.relpath)
+
+    @property
+    def name(self) -> str:
+        return self.qname.rsplit(".", 1)[-1]
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"<fn {self.module}:{self.qname}>"
+
+
+class ModuleIndex:
+    """Project-wide indexes: functions (incl. nested + methods),
+    per-module import maps, and module-level string constants."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.functions: List[FunctionInfo] = []
+        self.by_module_qname: Dict[Tuple[str, str], FunctionInfo] = {}
+        self.by_simple_name: Dict[str, List[FunctionInfo]] = {}
+        # module -> local binding -> (source_module, original_name|None)
+        self.imports: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
+        # module-level NAME = "literal" string constants
+        self.constants: Dict[Tuple[str, str], str] = {}
+        self.constants_by_name: Dict[str, Set[str]] = {}
+        for f in project.files:
+            if f.tree is None:
+                continue
+            self._index_file(f)
+
+    def _index_file(self, f: SourceFile) -> None:
+        mod = module_name(f.relpath)
+        imps: Dict[str, Tuple[str, Optional[str]]] = {}
+        self.imports[mod] = imps
+
+        def walk(node: ast.AST, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    q = f"{prefix}.{child.name}" if prefix else child.name
+                    info = FunctionInfo(q, child, f)
+                    self.functions.append(info)
+                    self.by_module_qname[(mod, q)] = info
+                    self.by_simple_name.setdefault(child.name, []).append(
+                        info
+                    )
+                    walk(child, q)
+                elif isinstance(child, ast.ClassDef):
+                    q = f"{prefix}.{child.name}" if prefix else child.name
+                    walk(child, q)
+                else:
+                    walk(child, prefix)
+
+        walk(f.tree, "")
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    imps[local] = (alias.name, None)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                src = node.module
+                if node.level:  # relative import: anchor at this package
+                    pkg = mod.rsplit(".", node.level)[0]
+                    src = f"{pkg}.{node.module}" if pkg else node.module
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    imps[local] = (src, alias.name)
+        for stmt in f.tree.body:
+            targets: List[ast.expr] = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+                value = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets = [stmt.target]
+                value = stmt.value
+            else:
+                continue
+            if not (
+                isinstance(value, ast.Constant)
+                and isinstance(value.value, str)
+            ):
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    self.constants[(mod, t.id)] = value.value
+                    self.constants_by_name.setdefault(t.id, set()).add(
+                        value.value
+                    )
+
+    # ------------------------------------------------------- resolution
+
+    def resolve_str(
+        self, node: ast.AST, module: Optional[str] = None
+    ) -> Optional[str]:
+        """Literal string, or a Name/Attribute resolving to a
+        module-level string constant (same module first, then a
+        project-wide unique name match)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        name: Optional[str] = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name is None:
+            return None
+        if module is not None:
+            v = self.constants.get((module, name))
+            if v is not None:
+                return v
+            imp = self.imports.get(module, {}).get(name)
+            if imp is not None and imp[1] is not None:
+                v = self.constants.get((imp[0], imp[1]))
+                if v is not None:
+                    return v
+        vals = self.constants_by_name.get(name, set())
+        if len(vals) == 1:
+            return next(iter(vals))
+        return None
+
+    def resolve_str_elements(
+        self, node: ast.AST, module: Optional[str] = None
+    ) -> List[Tuple[ast.AST, str]]:
+        """Every string resolvable inside ``node`` (flattening tuples,
+        lists, and ``+`` concatenations of tuples) with its AST node —
+        dynamic elements are silently skipped."""
+        out: List[Tuple[ast.AST, str]] = []
+        s = self.resolve_str(node, module)
+        if s is not None:
+            out.append((node, s))
+            return out
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for el in node.elts:
+                out.extend(self.resolve_str_elements(el, module))
+        elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            out.extend(self.resolve_str_elements(node.left, module))
+            out.extend(self.resolve_str_elements(node.right, module))
+        return out
+
+    def resolve_call(
+        self, call: ast.Call, module: str, within: Optional[str] = None
+    ) -> Optional[FunctionInfo]:
+        """Best-effort: the FunctionInfo a call lands in."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self._resolve_name(func.id, module, within)
+        if isinstance(func, ast.Attribute):
+            chain = attr_chain(func)
+            if chain is None:
+                return None
+            if chain[0] == "self":
+                # self.m() -> any method named m in the same file
+                # (single-class files dominate; ambiguity -> skip).
+                cands = [
+                    fi
+                    for fi in self.by_simple_name.get(chain[-1], [])
+                    if fi.module == module and "." in fi.qname
+                ]
+                return cands[0] if len(cands) == 1 else None
+            imp = self.imports.get(module, {}).get(chain[0])
+            if imp is not None and imp[1] is None:
+                # `import tpufw.ops.flash as fl; fl.attention(...)`
+                return self.by_module_qname.get((imp[0], chain[-1]))
+        return None
+
+    def _resolve_name(
+        self, name: str, module: str, within: Optional[str]
+    ) -> Optional[FunctionInfo]:
+        if within:
+            # Nested defs: inner-most enclosing scope wins.
+            parts = within.split(".")
+            for i in range(len(parts), 0, -1):
+                q = ".".join([*parts[:i], name])
+                fi = self.by_module_qname.get((module, q))
+                if fi is not None:
+                    return fi
+        fi = self.by_module_qname.get((module, name))
+        if fi is not None:
+            return fi
+        imp = self.imports.get(module, {}).get(name)
+        if imp is not None and imp[1] is not None:
+            return self.by_module_qname.get((imp[0], imp[1]))
+        return None
+
+
+# ------------------------------------------------------------ jit roots
+
+# Callables that trace their function argument on TPU.
+_TRACERS = {"jit", "pjit", "shard_map", "xmap", "checkpoint", "remat"}
+
+
+def _first_traced_arg(call: ast.Call) -> Optional[ast.AST]:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg in ("fun", "f"):
+            return kw.value
+    return None
+
+
+def _unwrap_partial(node: ast.AST) -> ast.AST:
+    while (
+        isinstance(node, ast.Call)
+        and call_name(node) in ("partial", "wraps")
+        and node.args
+    ):
+        node = node.args[0]
+    return node
+
+
+def find_traced_roots(
+    index: ModuleIndex, files: Sequence[SourceFile]
+) -> List[Tuple[FunctionInfo, str]]:
+    """(function, how) pairs for every function handed to
+    ``jax.jit``/``pjit``/``shard_map`` — via call or decorator —
+    in the given files. Lambdas traced inline are returned as
+    synthetic FunctionInfo objects."""
+    roots: List[Tuple[FunctionInfo, str]] = []
+    seen: Set[int] = set()
+
+    def add(fi: Optional[FunctionInfo], how: str) -> None:
+        if fi is not None and id(fi.node) not in seen:
+            seen.add(id(fi.node))
+            roots.append((fi, how))
+
+    for f in files:
+        if f.tree is None:
+            continue
+        mod = module_name(f.relpath)
+        # Decorators.
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    target = _unwrap_partial(target)
+                    if isinstance(dec, ast.Call) and call_name(dec) in (
+                        "partial",
+                    ):
+                        # @partial(jax.jit, ...) — tracer is partial's
+                        # first argument.
+                        inner = dec.args[0] if dec.args else None
+                        chain = attr_chain(inner) if inner else None
+                        if chain and chain[-1] in _TRACERS:
+                            add(_fi_for(index, mod, node, f), f"@{chain[-1]}")
+                        continue
+                    chain = attr_chain(target)
+                    if chain and chain[-1] in _TRACERS:
+                        add(_fi_for(index, mod, node, f), f"@{chain[-1]}")
+            if isinstance(node, ast.Call):
+                nm = call_name(node)
+                if nm not in _TRACERS:
+                    continue
+                arg = _first_traced_arg(node)
+                if arg is None:
+                    continue
+                arg = _unwrap_partial(arg)
+                if isinstance(arg, ast.Lambda):
+                    add(
+                        FunctionInfo("<lambda>", arg, f),
+                        f"{nm}(<lambda>)",
+                    )
+                elif isinstance(arg, (ast.Name, ast.Attribute)):
+                    fake = ast.Call(func=arg, args=[], keywords=[])
+                    ast.copy_location(fake, arg)
+                    add(index.resolve_call(fake, mod), f"{nm}()")
+    return roots
+
+
+def _fi_for(
+    index: ModuleIndex, mod: str, node: ast.AST, f: SourceFile
+) -> Optional[FunctionInfo]:
+    for fi in index.by_simple_name.get(getattr(node, "name", ""), []):
+        if fi.node is node:
+            return fi
+    return None
+
+
+def reachable_functions(
+    index: ModuleIndex,
+    roots: Sequence[Tuple[FunctionInfo, str]],
+    max_depth: int = 8,
+) -> Dict[int, Tuple[FunctionInfo, str]]:
+    """BFS the call graph from the traced roots. Returns
+    ``id(node) -> (FunctionInfo, chain-description)``. Expansion is
+    bounded by the scan set: ``resolve_call`` only knows functions
+    defined in scanned files, so jax/flax internals never enter."""
+    out: Dict[int, Tuple[FunctionInfo, str]] = {}
+    frontier: List[Tuple[FunctionInfo, str, int]] = [
+        (fi, how, 0) for fi, how in roots
+    ]
+    while frontier:
+        fi, how, depth = frontier.pop()
+        if id(fi.node) in out:
+            continue
+        out[id(fi.node)] = (fi, how)
+        if depth >= max_depth:
+            continue
+        for call in iter_calls(fi.node):
+            callee = index.resolve_call(
+                call, fi.module, within=fi.qname
+            )
+            if callee is None or id(callee.node) in out:
+                continue
+            frontier.append(
+                (callee, f"{how} -> {callee.name}", depth + 1)
+            )
+    return out
+
+
+def iter_calls(fn: FuncNode) -> Iterator[ast.Call]:
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                yield node

@@ -1,0 +1,346 @@
+"""tpulint core: checker plugin framework, file walker, suppression,
+baseline ratchet, and output formatting.
+
+The rules this suite enforces (hot-loop purity, mesh-axis consistency,
+RNG discipline, env/obs registry hygiene) are invariants a generic
+linter cannot see — they live in the relationship between *this*
+repo's subsystems (the jitted step, ``tpufw/mesh``, ``workloads/env``,
+``obs/events``), not in any one expression. Everything here is stdlib
+``ast``: the suite must run in the bare training container and in CI
+without installing anything.
+
+Vocabulary
+----------
+- A :class:`Checker` owns one rule ID (``TPU001``..) and yields
+  :class:`Finding` objects over a :class:`Project` (the parsed tree of
+  every scanned file), so cross-file rules are first-class.
+- Suppression is per-line: a trailing ``# tpulint: disable=TPU001``
+  comment (or one alone on the preceding line) silences that rule on
+  that line; ``# tpulint: disable-file=TPU004`` anywhere silences the
+  whole file. Suppressions are expected to carry a justification after
+  the rule list — they are reviewed as code.
+- The baseline (``analysis_baseline.json``) ratchets pre-existing
+  findings: runs fail only on findings whose stable key is *not* in
+  the baseline, and the baseline may only shrink. Keys deliberately
+  exclude line numbers so unrelated edits don't churn it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+SEVERITIES = ("error", "warning", "info")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
+)
+
+# Directories never worth parsing (caches, VCS, vendored assets).
+_SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache", "node_modules", ".venv"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one location.
+
+    ``symbol`` is the stable anchor used for baseline identity (an
+    env-var name, axis literal, function qname, ...): baselines keyed
+    on ``rule:path:symbol`` survive line drift from unrelated edits.
+    """
+
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+    symbol: str = ""
+
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol or self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule} [{self.severity}] {self.message}"
+        )
+
+
+class SourceFile:
+    """One parsed python file + its suppression table."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(text, filename=relpath)
+        except SyntaxError as e:
+            self.parse_error = e
+        self.file_suppressed: Set[str] = set()
+        # line number -> rules suppressed on that line
+        self.line_suppressed: Dict[int, Set[str]] = {}
+        self._scan_suppressions()
+
+    def _scan_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(2).split(",")}
+            if m.group(1) == "disable-file":
+                self.file_suppressed |= rules
+                continue
+            self.line_suppressed.setdefault(i, set()).update(rules)
+            # A comment alone on its line covers the rest of its
+            # comment block (the justification) and the first code
+            # line after it — for statements too long to carry a
+            # trailing comment.
+            if line.lstrip().startswith("#"):
+                j = i + 1
+                while j <= len(self.lines):
+                    self.line_suppressed.setdefault(j, set()).update(rules)
+                    stripped = self.lines[j - 1].lstrip()
+                    if stripped and not stripped.startswith("#"):
+                        break  # covered the first code line; stop
+                    j += 1
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressed:
+            return True
+        return rule in self.line_suppressed.get(line, set())
+
+
+class Project:
+    """Every scanned file, plus the repo root for out-of-scan lookups
+    (docs/, the env registry) that cross-file rules need."""
+
+    def __init__(self, files: Sequence[SourceFile], root: str):
+        self.files = list(files)
+        self.root = root
+        self._by_rel = {f.relpath: f for f in self.files}
+
+    def file(self, relpath: str) -> Optional[SourceFile]:
+        return self._by_rel.get(relpath.replace(os.sep, "/"))
+
+    def files_matching(self, prefix: str) -> List[SourceFile]:
+        prefix = prefix.replace(os.sep, "/")
+        return [f for f in self.files if f.relpath.startswith(prefix)]
+
+    def read_doc(self, relpath: str) -> Optional[str]:
+        """Text of a repo file outside the scan set (docs, README)."""
+        p = os.path.join(self.root, relpath)
+        try:
+            with open(p, encoding="utf-8") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+
+class Checker:
+    """Base class for one rule. Subclasses set ``rule``/``name`` and
+    implement :meth:`check`; suppression and baseline filtering happen
+    in the runner, so checkers yield every raw finding."""
+
+    rule = "TPU000"
+    name = "abstract"
+    severity = "error"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self,
+        file: SourceFile,
+        node: ast.AST,
+        message: str,
+        symbol: str = "",
+        severity: Optional[str] = None,
+    ) -> Finding:
+        return Finding(
+            rule=self.rule,
+            path=file.relpath,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            severity=severity or self.severity,
+            symbol=symbol,
+        )
+
+
+def find_repo_root(start: str) -> str:
+    """Nearest ancestor containing pyproject.toml (fallback: start)."""
+    start = os.path.abspath(start)
+    if os.path.isfile(start):
+        start = os.path.dirname(start)
+    d = start
+    while True:
+        if os.path.exists(os.path.join(d, "pyproject.toml")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return os.path.abspath(start)
+        d = parent
+
+
+def collect_files(paths: Sequence[str], root: str) -> List[SourceFile]:
+    out: List[SourceFile] = []
+    seen: Set[str] = set()
+
+    def add(path: str) -> None:
+        ap = os.path.abspath(path)
+        if ap in seen or not ap.endswith(".py"):
+            return
+        seen.add(ap)
+        rel = os.path.relpath(ap, root)
+        try:
+            with open(ap, encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError:
+            return
+        out.append(SourceFile(ap, rel, text))
+
+    for p in paths:
+        if os.path.isfile(p):
+            add(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in _SKIP_DIRS and not d.startswith(".")
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    add(os.path.join(dirpath, fn))
+    out.sort(key=lambda f: f.relpath)
+    return out
+
+
+def all_checkers() -> List[Checker]:
+    """The shipped rule set, TPU001..TPU005 (import here, not at
+    module top, so core stays importable from checker modules)."""
+    from tpufw.analysis.envreg import EnvRegistryChecker
+    from tpufw.analysis.hotloop import HotLoopPurityChecker
+    from tpufw.analysis.meshaxes import MeshAxisChecker
+    from tpufw.analysis.obsnames import ObsNameChecker
+    from tpufw.analysis.rng import RngDisciplineChecker
+
+    return [
+        HotLoopPurityChecker(),
+        MeshAxisChecker(),
+        RngDisciplineChecker(),
+        EnvRegistryChecker(),
+        ObsNameChecker(),
+    ]
+
+
+def run_analysis(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    rules: Optional[Iterable[str]] = None,
+    checkers: Optional[Sequence[Checker]] = None,
+) -> List[Finding]:
+    """Parse ``paths``, run the (optionally filtered) checker set, and
+    return suppression-filtered findings sorted by location. Parse
+    failures surface as TPU000 errors rather than crashing the run."""
+    root = root or find_repo_root(paths[0] if paths else ".")
+    files = collect_files(paths, root)
+    project = Project(files, root)
+    checkers = list(checkers if checkers is not None else all_checkers())
+    if rules is not None:
+        want = set(rules)
+        unknown = want - {c.rule for c in checkers}
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+        checkers = [c for c in checkers if c.rule in want]
+    findings: List[Finding] = []
+    for f in files:
+        if f.parse_error is not None:
+            findings.append(
+                Finding(
+                    rule="TPU000",
+                    path=f.relpath,
+                    line=f.parse_error.lineno or 1,
+                    col=(f.parse_error.offset or 0) + 1,
+                    message=f"syntax error: {f.parse_error.msg}",
+                    severity="error",
+                    symbol="syntax-error",
+                )
+            )
+    for checker in checkers:
+        for finding in checker.check(project):
+            src = project.file(finding.path)
+            if src is not None and src.is_suppressed(
+                finding.rule, finding.line
+            ):
+                continue
+            findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------- baseline
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> Set[str]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {data.get('version')!r}"
+        )
+    return set(data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    keys = sorted({f.key() for f in findings})
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    data = {
+        "version": BASELINE_VERSION,
+        "comment": (
+            "tpulint ratchet: findings listed here predate the rule and "
+            "are tolerated; new findings fail. This file may only "
+            "shrink — fix or inline-suppress (with justification) "
+            "instead of adding entries."
+        ),
+        "rule_counts": dict(sorted(counts.items())),
+        "findings": keys,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def split_by_baseline(
+    findings: Sequence[Finding], baseline: Set[str]
+) -> tuple[List[Finding], List[Finding], Set[str]]:
+    """(new, baselined, stale_keys): ``new`` fails the run, ``stale``
+    are baseline entries no longer observed (the ratchet should
+    shrink — rewrite the baseline to drop them)."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    seen: Set[str] = set()
+    for f in findings:
+        k = f.key()
+        if k in baseline:
+            old.append(f)
+            seen.add(k)
+        else:
+            new.append(f)
+    return new, old, baseline - seen

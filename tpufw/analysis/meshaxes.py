@@ -1,0 +1,185 @@
+"""TPU002 — mesh/axis-name consistency.
+
+The mesh is declared once (``tpufw/mesh/mesh.py``: the ``AXIS_*``
+constants / ``MESH_AXES`` tuple, with ``parallel/context.py`` holding
+the process-wide current mesh); every collective and every
+``PartitionSpec`` then names axes *by string*. A ``psum`` over an axis
+the mesh doesn't define is a shard_map/jit error only on the code path
+that executes it — on an MPMD pipeline ("Scaling Deep Learning
+Training with MPMD Pipeline Parallelism", PAPERS.md) that path may be
+one schedule variant nobody smoke-tested. This rule resolves every
+axis-name literal statically instead:
+
+- collectives (``psum``/``pmean``/``all_gather``/``ppermute``/...)
+  must name declared *mesh* axes;
+- ``PartitionSpec``/``P`` literals must name declared mesh axes or
+  declared flax *logical* axes (the ``logical_axis_rules`` table) —
+  logical names in a raw collective are still an error.
+
+Dynamic axis arguments (``axis_name`` parameters) are skipped: the
+rule is about literals, the callers of parametric helpers are where
+the literals live.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set, Tuple
+
+from tpufw.analysis import callgraph as cg
+from tpufw.analysis.core import Checker, Finding, Project, SourceFile
+
+# jax.lax collectives taking an axis name (or tuple of axis names).
+# Value = index of the positional axis argument.
+COLLECTIVES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "psum_scatter": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+}
+
+_SPEC_NAMES = {"PartitionSpec", "P"}
+
+
+def declared_axes(
+    project: Project, index: cg.ModuleIndex
+) -> Tuple[Set[str], Set[str], List[str]]:
+    """(mesh_axes, logical_axes, source_files).
+
+    Mesh axes come from ``AXIS_* = "..."`` constants and literal
+    ``Mesh(..., ("a", "b"))`` axis-name tuples under ``tpufw/mesh/``
+    and ``tpufw/parallel/``; logical axes from the first element of
+    every pair in ``logical_axis_rules``."""
+    mesh_axes: Set[str] = set()
+    logical: Set[str] = set()
+    sources: List[str] = []
+    decl_files = [
+        f
+        for f in project.files
+        if f.relpath.startswith(("tpufw/mesh/", "tpufw/parallel/"))
+    ]
+    for f in decl_files:
+        if f.tree is None:
+            continue
+        mod = cg.module_name(f.relpath)
+        found = False
+        for (m, name), val in index.constants.items():
+            if m == mod and name.startswith("AXIS_"):
+                mesh_axes.add(val)
+                found = True
+        for node in ast.walk(f.tree):
+            # Mesh(devices, ("data", ...)) / axis_names= kwarg.
+            if isinstance(node, ast.Call) and cg.call_name(node) == "Mesh":
+                cands = list(node.args[1:2]) + [
+                    kw.value
+                    for kw in node.keywords
+                    if kw.arg == "axis_names"
+                ]
+                for c in cands:
+                    for _, s in index.resolve_str_elements(c, mod):
+                        mesh_axes.add(s)
+                        found = True
+            # logical_axis_rules: (("batch", ("data", "fsdp")), ...)
+            if (
+                isinstance(node, ast.FunctionDef)
+                and node.name == "logical_axis_rules"
+            ):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Tuple) and len(sub.elts) == 2:
+                        first = sub.elts[0]
+                        if isinstance(
+                            first, ast.Constant
+                        ) and isinstance(first.value, str):
+                            logical.add(first.value)
+                            found = True
+        if found:
+            sources.append(f.relpath)
+    return mesh_axes, logical, sources
+
+
+class MeshAxisChecker(Checker):
+    rule = "TPU002"
+    name = "mesh-axis-consistency"
+    severity = "error"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        index = cg.ModuleIndex(project)
+        mesh_axes, logical, _src = declared_axes(project, index)
+        if not mesh_axes:
+            # No mesh declaration in the scanned tree (fixture subsets)
+            # -> nothing to resolve against; stay silent rather than
+            # flagging every axis in sight.
+            return
+        spec_ok = mesh_axes | logical
+        for f in project.files:
+            if f.tree is None:
+                continue
+            mod = cg.module_name(f.relpath)
+            for node in ast.walk(f.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = cg.call_name(node)
+                if name in COLLECTIVES:
+                    yield from self._check_collective(
+                        f, index, mod, node, name, mesh_axes
+                    )
+                elif name in _SPEC_NAMES:
+                    yield from self._check_spec(
+                        f, index, mod, node, spec_ok
+                    )
+
+    def _check_collective(
+        self,
+        f: SourceFile,
+        index: cg.ModuleIndex,
+        mod: str,
+        node: ast.Call,
+        name: str,
+        mesh_axes: Set[str],
+    ) -> Iterator[Finding]:
+        pos = COLLECTIVES[name]
+        axis_args: List[ast.AST] = []
+        if len(node.args) > pos:
+            axis_args.append(node.args[pos])
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis_names", "axes"):
+                axis_args.append(kw.value)
+        for arg in axis_args:
+            for anode, axis in index.resolve_str_elements(arg, mod):
+                if axis not in mesh_axes:
+                    yield self.finding(
+                        f,
+                        anode if hasattr(anode, "lineno") else node,
+                        f"{name}() over axis {axis!r}, which is not a "
+                        f"declared mesh axis "
+                        f"{tuple(sorted(mesh_axes))}",
+                        symbol=f"{name}:{axis}",
+                    )
+
+    def _check_spec(
+        self,
+        f: SourceFile,
+        index: cg.ModuleIndex,
+        mod: str,
+        node: ast.Call,
+        spec_ok: Set[str],
+    ) -> Iterator[Finding]:
+        exprs = list(node.args) + [kw.value for kw in node.keywords]
+        for arg in exprs:
+            for anode, axis in index.resolve_str_elements(arg, mod):
+                if axis not in spec_ok:
+                    yield self.finding(
+                        f,
+                        anode if hasattr(anode, "lineno") else node,
+                        f"PartitionSpec names axis {axis!r}, which is "
+                        "neither a declared mesh axis nor a logical "
+                        "axis from logical_axis_rules",
+                        symbol=f"PartitionSpec:{axis}",
+                    )

@@ -1,0 +1,314 @@
+"""TPU003 — PRNG key discipline.
+
+JAX keys are values, not stateful generators: sampling twice with the
+same key yields *identical* randomness, which silently correlates
+dropout masks, rollout noise, and init across uses — a bug no test
+asserting "loss goes down" catches. The rule tracks, per function
+scope, every variable bound from ``jax.random.key/PRNGKey/split/
+fold_in`` (plus parameters named like keys: ``key``, ``rng``,
+``*_key``, ``*_rng``) and flags:
+
+- a key consumed by two calls with no re-binding in between
+  (``split`` counts as the one blessed consumption — using the parent
+  key *after* splitting it is exactly the classic bug);
+- a key consumed inside a loop body that never re-binds it (every
+  iteration then reuses the same randomness);
+- a key returned after it has already been consumed (the caller
+  inherits a hot key with no way to know).
+
+Receivers it can't see through (attributes, subscripts, closures) are
+skipped — false negatives over false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tpufw.analysis import callgraph as cg
+from tpufw.analysis.core import Checker, Finding, Project, SourceFile
+
+_KEY_PARAM_RE = re.compile(r"^(key|rng|prng|prng_key)$|_(key|rng)$")
+
+# jax.random attrs that *transform* a key rather than sampling with it.
+_KEY_MAKERS = {"key", "PRNGKey", "split", "fold_in", "clone", "wrap_key_data"}
+
+
+def _is_random_attr(call: ast.Call) -> Optional[str]:
+    """'split' for jax.random.split(...) / jrandom.split / random.split."""
+    chain = cg.attr_chain(call.func)
+    if not chain:
+        return None
+    if len(chain) >= 2 and chain[-2] in ("random", "jrandom", "jr"):
+        return chain[-1]
+    # Bare names: only PRNGKey is unambiguous enough — a local called
+    # `split` (llama.py's jitted layer-splitter) is not jax.random.split.
+    if len(chain) == 1 and chain[0] == "PRNGKey":
+        return chain[0]
+    return None
+
+
+def _binds_key(value: ast.AST) -> bool:
+    """Does this RHS produce key material?"""
+    if isinstance(value, ast.Call):
+        attr = _is_random_attr(value)
+        if attr in _KEY_MAKERS:
+            return True
+    if isinstance(value, (ast.Tuple, ast.List)):
+        return any(_binds_key(e) for e in value.elts)
+    if isinstance(value, ast.Subscript):
+        return _binds_key(value.value)
+    return False
+
+
+class _Use:
+    __slots__ = ("node", "kind")
+
+    def __init__(self, node: ast.AST, kind: str):
+        self.node = node
+        self.kind = kind  # "consume" | "rebind" | "return"
+
+
+class RngDisciplineChecker(Checker):
+    rule = "TPU003"
+    name = "rng-key-discipline"
+    severity = "error"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        index = cg.ModuleIndex(project)
+        for fi in index.functions:
+            if fi.file.tree is None:
+                continue
+            yield from self._check_function(fi.file, fi)
+
+    # ------------------------------------------------------------------
+
+    def _check_function(
+        self, f: SourceFile, fi: cg.FunctionInfo
+    ) -> Iterator[Finding]:
+        fn = fi.node
+        key_vars: Set[str] = set()
+        for p in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+            if _KEY_PARAM_RE.search(p.arg):
+                key_vars.add(p.arg)
+        # First pass: every assignment that binds key material.
+        own_body = self._own_statements(fn)
+        for stmt in own_body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Assign) and _binds_key(node.value):
+                    for t in node.targets:
+                        key_vars.update(self._target_names(t))
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    if _binds_key(node.value) and isinstance(
+                        node.target, ast.Name
+                    ):
+                        key_vars.add(node.target.id)
+        if not key_vars:
+            return
+        uses = self._collect_uses(own_body, key_vars)
+        yield from self._linear_reuse(f, fi, uses)
+        yield from self._loop_reuse(f, fi, own_body, key_vars)
+
+    @staticmethod
+    def _own_statements(fn: cg.FuncNode) -> List[ast.stmt]:
+        """The function's statements, with nested def/lambda bodies
+        excluded (they are their own scopes, checked separately)."""
+        out: List[ast.stmt] = []
+        body = fn.body if isinstance(fn.body, list) else []
+
+        def visit(stmts: List[ast.stmt]) -> None:
+            for s in stmts:
+                if isinstance(
+                    s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    continue
+                out.append(s)
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(s, field, None)
+                    if isinstance(sub, list):
+                        visit([x for x in sub if x is not s])
+                for h in getattr(s, "handlers", []) or []:
+                    visit(h.body)
+
+        visit(body)
+        return out
+
+    @staticmethod
+    def _walk_no_defs(stmt: ast.stmt) -> Iterator[ast.AST]:
+        """Whole-subtree walk that skips nested def/class/lambda
+        bodies — those run at another time with their own scope."""
+        stack: List[ast.AST] = [stmt]
+        root = True
+        while stack:
+            node = stack.pop()
+            if not root and isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                 ast.Lambda),
+            ):
+                continue
+            root = False
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _walk_shallow(stmt: ast.stmt) -> Iterator[ast.AST]:
+        """Walk one statement's expression level only: nested
+        statements are in the flattened list and visited on their own
+        turn (walking them here too would double-count every call
+        inside a with/if/for body), and lambda bodies are a different
+        execution time entirely."""
+        stack: List[ast.AST] = [stmt]
+        root = True
+        while stack:
+            node = stack.pop()
+            if not root and isinstance(node, (ast.stmt, ast.Lambda)):
+                continue
+            root = False
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _target_names(t: ast.AST) -> Set[str]:
+        names: Set[str] = set()
+        if isinstance(t, ast.Name):
+            names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                if isinstance(e, ast.Name):
+                    names.add(e.id)
+                elif isinstance(e, ast.Starred) and isinstance(
+                    e.value, ast.Name
+                ):
+                    names.add(e.value.id)
+        return names
+
+    def _collect_uses(
+        self, stmts: List[ast.stmt], key_vars: Set[str]
+    ) -> Dict[str, List[_Use]]:
+        """Per key var, source-ordered consume/rebind/return events
+        over the function's own (non-nested) statements."""
+        uses: Dict[str, List[_Use]] = {v: [] for v in key_vars}
+        seen: Set[int] = set()
+        for stmt in stmts:
+            if id(stmt) in seen:
+                continue
+            seen.add(id(stmt))
+            rebound: Set[str] = set()
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for t in targets:
+                    rebound |= self._target_names(t) & key_vars
+            for node in self._walk_shallow(stmt):
+                if isinstance(node, ast.Call):
+                    for v in self._consumed_keys(node, key_vars):
+                        uses[v].append(_Use(node, "consume"))
+                elif isinstance(node, ast.Return) and node.value is not None:
+                    for sub in ast.walk(node.value):
+                        if (
+                            isinstance(sub, ast.Name)
+                            and sub.id in key_vars
+                        ):
+                            uses[sub.id].append(_Use(node, "return"))
+            for v in rebound:
+                uses[v].append(_Use(stmt, "rebind"))
+        for v in uses:
+            uses[v].sort(
+                key=lambda u: (
+                    getattr(u.node, "lineno", 0),
+                    getattr(u.node, "col_offset", 0),
+                    # On the same statement, the consume happens before
+                    # the rebind (k = split(k) uses then rebinds).
+                    {"consume": 0, "return": 1, "rebind": 2}[u.kind],
+                )
+            )
+        return uses
+
+    @staticmethod
+    def _consumed_keys(call: ast.Call, key_vars: Set[str]) -> Set[str]:
+        """Key vars passed (top-level) to this call. jax.random key
+        makers count too: split(key) is the key's one blessed use."""
+        out: Set[str] = set()
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in key_vars:
+                out.add(arg.id)
+        return out
+
+    def _linear_reuse(
+        self, f: SourceFile, fi: cg.FunctionInfo, uses: Dict[str, List[_Use]]
+    ) -> Iterator[Finding]:
+        for var, events in uses.items():
+            consumed_at: Optional[ast.AST] = None
+            for u in events:
+                if u.kind == "rebind":
+                    consumed_at = None
+                elif u.kind == "consume":
+                    if consumed_at is not None:
+                        yield self.finding(
+                            f,
+                            u.node,
+                            f"PRNG key {var!r} reused: already "
+                            "consumed at line "
+                            f"{getattr(consumed_at, 'lineno', '?')} "
+                            "with no split/fold_in re-binding in "
+                            "between — both ops see identical "
+                            "randomness",
+                            symbol=f"reuse:{fi.qname}:{var}",
+                        )
+                        break  # one finding per var per function
+                    consumed_at = u.node
+                elif u.kind == "return" and consumed_at is not None:
+                    yield self.finding(
+                        f,
+                        u.node,
+                        f"PRNG key {var!r} returned after being "
+                        "consumed — the caller inherits a hot key; "
+                        "return a fresh split instead",
+                        symbol=f"return-hot:{fi.qname}:{var}",
+                    )
+                    break
+
+    def _loop_reuse(
+        self,
+        f: SourceFile,
+        fi: cg.FunctionInfo,
+        stmts: List[ast.stmt],
+        key_vars: Set[str],
+    ) -> Iterator[Finding]:
+        flagged: Set[str] = set()
+        for stmt in stmts:
+            if not isinstance(stmt, (ast.For, ast.While)):
+                continue
+            body_nodes = list(self._walk_no_defs(stmt))
+            rebound: Set[str] = set()
+            loop_defined: Set[str] = set()
+            if isinstance(stmt, ast.For):
+                loop_defined |= self._target_names(stmt.target)
+            for node in body_nodes:
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        names = self._target_names(t)
+                        rebound |= names & key_vars
+                        loop_defined |= names
+            for node in body_nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                for v in self._consumed_keys(node, key_vars):
+                    if v in rebound or v in loop_defined or v in flagged:
+                        continue
+                    flagged.add(v)
+                    yield self.finding(
+                        f,
+                        node,
+                        f"PRNG key {v!r} consumed inside a loop that "
+                        "never re-binds it — every iteration reuses "
+                        "the same randomness; split per iteration "
+                        "(key, sub = jax.random.split(key))",
+                        symbol=f"loop-reuse:{fi.qname}:{v}",
+                    )
+        return

@@ -23,6 +23,13 @@ same process_id and the coordinator's barrier can release.
 
 from __future__ import annotations
 
+# tpulint: disable-file=TPU004 — this module reads through an
+# injectable ``env: Mapping`` (tests pass dicts), and its resolution
+# order deliberately mixes TPUFW_* escape hatches with JobSet/GKE
+# variables the typed helpers don't model. The knobs are cataloged in
+# docs/ENV.md; the helper round-trip requirement stops at this
+# process-bootstrap boundary.
+
 import dataclasses
 import os
 import time

@@ -216,6 +216,10 @@ def speculative_generate(
         # sliced off at return — any deterministic stream works there.
         next_rng, first_key = jax.random.split(rng)
         step_keys = jax.random.split(next_rng, max(max_new_tokens - 1, 1))
+        # tpulint: disable=TPU003 — fold_in(next_rng, 7) deliberately
+        # derives the overrun stream from the already-split parent: the
+        # shared prefix must replay generate()'s exact splits (comment
+        # above), and the fold_in constant keeps the slack keys disjoint.
         overrun_keys = jax.random.split(jax.random.fold_in(next_rng, 7), k)
         all_keys = jnp.concatenate(
             [first_key[None], step_keys, overrun_keys]

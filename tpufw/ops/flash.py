@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 
 import jax
 import jax.numpy as jnp
@@ -330,8 +329,9 @@ def _heads_layout(q, k, v):
 
 
 def _env_block(name: str) -> int | None:
-    v = os.environ.get(name)
-    return int(v) if v else None
+    from tpufw.workloads.env import env_opt_int
+
+    return env_opt_int(name)
 
 
 def _check_block(b: int, n_pad: int, axis: str, source: str) -> int:
@@ -373,9 +373,9 @@ def _block_sizes(t_pad, s_pad, override=None):
 
     bq, bkv = (override or (None, None))
     src_q, src_kv = "block_sizes kwarg", "block_sizes kwarg"
-    if bq is None and (e := _env_block("TPUFW_FLASH_BQ")) is not None:
+    if bq is None and (e := _env_block("flash_bq")) is not None:
         bq, src_q = e, "TPUFW_FLASH_BQ"
-    if bkv is None and (e := _env_block("TPUFW_FLASH_BKV")) is not None:
+    if bkv is None and (e := _env_block("flash_bkv")) is not None:
         bkv, src_kv = e, "TPUFW_FLASH_BKV"
     bq = pick(t_pad) if bq is None else _check_block(bq, t_pad, "q", src_q)
     bkv = (

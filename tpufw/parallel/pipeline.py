@@ -414,7 +414,7 @@ def stage_partition_specs(stages: dict) -> Any:
             ),
             "",
         )
-        axes: list = [AXIS_PIPE] + [None] * (leaf.ndim - 1)
+        axes: list = [AXIS_PIPE, *([None] * (leaf.ndim - 1))]
         t = _TENSOR_LEAF_AXIS.get(name)
         if t is not None:
             axes[leaf.ndim + t] = AXIS_TENSOR

@@ -342,7 +342,7 @@ def _1f1b_local(
     init = (
         zeros_mb,
         zeros_mb,
-        jnp.zeros((n_slots,) + mb_shape, x_mb.dtype),
+        jnp.zeros((n_slots, *mb_shape), x_mb.dtype),
         jnp.zeros((), jnp.float32),
         jax.tree.map(
             lambda a: jnp.zeros(a.shape, jnp.float32), stage_params
@@ -362,10 +362,10 @@ def _1f1b_local(
     #   the batch shards only; replicated stage leaves (norms) also
     #   need the tensor sum. d_model axes: no sum (sharded).
     batch_axes = (AXIS_DATA, AXIS_FSDP)
-    loss_sum = jax.lax.psum(loss_sum, (AXIS_PIPE,) + batch_axes)
-    g_embed = jax.lax.psum(g_embed, (AXIS_PIPE,) + batch_axes)
-    g_fnorm = jax.lax.psum(g_fnorm, (AXIS_PIPE,) + batch_axes)
-    g_head = jax.lax.psum(g_head, (AXIS_PIPE,) + batch_axes)
+    loss_sum = jax.lax.psum(loss_sum, (AXIS_PIPE, *batch_axes))
+    g_embed = jax.lax.psum(g_embed, (AXIS_PIPE, *batch_axes))
+    g_fnorm = jax.lax.psum(g_fnorm, (AXIS_PIPE, *batch_axes))
+    g_head = jax.lax.psum(g_head, (AXIS_PIPE, *batch_axes))
     # The f/g custom VJPs make replicated leaves' grads (norm scales)
     # FULL on every tensor rank already — only the batch-shard sum is
     # needed; sharded leaves' grads are their local shards as-is.

@@ -153,6 +153,9 @@ class VisionTrainer:
         init_fn, abstract = self._abstract_state(rng)
         self.state_sharding = state_shardings(abstract, self.mesh)
         with use_mesh(self.mesh):
+            # tpulint: disable=TPU003 — _abstract_state only
+            # eval_shape's rng (abstract, no randomness drawn); this
+            # jitted init is the key's one real use.
             self.state = jax.jit(
                 init_fn, out_shardings=self.state_sharding
             )(rng)

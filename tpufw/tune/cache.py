@@ -22,11 +22,10 @@ from typing import Optional
 from tpufw.tune.space import Candidate
 from tpufw.utils.profiling import machine_fingerprint
 
-_ENV_DIR = "TPUFW_TUNE_CACHE_DIR"
-
-
 def cache_dir() -> pathlib.Path:
-    d = os.environ.get(_ENV_DIR)
+    from tpufw.workloads.env import env_opt_str
+
+    d = env_opt_str("tune_cache_dir")
     if d:
         return pathlib.Path(d)
     return pathlib.Path.home() / ".cache" / "tpufw" / "tune"

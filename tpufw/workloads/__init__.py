@@ -6,4 +6,11 @@ variables so a Kubernetes manifest is the config-of-record (SURVEY.md §5
 "config/flag system": YAML manifest -> env -> dataclass, no flag DSL).
 """
 
-from tpufw.workloads.env import env_bool, env_float, env_int, env_str  # noqa: F401
+from tpufw.workloads.env import (  # noqa: F401
+    env_bool,
+    env_float,
+    env_int,
+    env_opt_int,
+    env_opt_str,
+    env_str,
+)

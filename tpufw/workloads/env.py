@@ -31,6 +31,18 @@ def env_opt_int(name: str, default: "int | None" = None) -> "int | None":
     return int(v)
 
 
+def env_opt_str(name: str, default: "str | None" = None) -> "str | None":
+    """Optional string knob where None means "feature off" (e.g.
+    TPUFW_TELEMETRY_DIR). Unset -> default; set to the empty string ->
+    None (a manifest's way to explicitly disable an inherited value)."""
+    v = _get(name)
+    if v is None:
+        return default
+    if v.strip() == "":
+        return None
+    return v
+
+
 def env_float(name: str, default: float) -> float:
     v = _get(name)
     return default if v is None else float(v)

@@ -63,7 +63,9 @@ def run_checks(require_jax_tpu: bool = True) -> list[tuple[str, bool]]:
 
 
 def main() -> int:
-    require_jax = os.environ.get("TPUFW_VALIDATE_REQUIRE_JAX", "1") != "0"
+    from tpufw.workloads.env import env_bool
+
+    require_jax = env_bool("validate_require_jax", True)
     results = run_checks(require_jax_tpu=require_jax)
     failed = [n for n, ok in results if not ok]
     if failed:
