@@ -1273,6 +1273,106 @@ def _worker() -> int:
         _drop_caches(jax)
     _attach("mla_decode", mla_decode)
 
+    # Serve tier: the slot scheduler's continuous-batching throughput
+    # under CONCURRENT traffic — the end-to-end number behind
+    # docs/PERF.md's serving section (the plain decode tier above
+    # measures one coalesced generate; this one measures the
+    # scheduler + persistent pool with requests joining and leaving
+    # mid-flight). Drives _SlotScheduler directly, no HTTP: sockets
+    # would add host noise to a device measurement.
+    serve = None
+    if on_tpu and env_bool("bench_serve", True):
+        serve = _aux_skip(300)
+    if on_tpu and serve is None and env_bool(
+        "bench_serve", True
+    ):
+        try:
+            import dataclasses as _dcv
+            import gc
+            import statistics as _stats
+            from concurrent.futures import ThreadPoolExecutor
+
+            from tpufw.infer import SamplingConfig, cast_decode_params
+            from tpufw.models import Llama as _VLlama
+            from tpufw.workloads.serve import _Metrics, _SlotScheduler
+
+            gc.collect()
+            v_prompt, v_new, v_reqs, v_conc = 96, 96, 24, 12
+            vcfg = _dcv.replace(
+                model_cfg.decode_config(), max_seq_len=256
+            )
+            vmodel = _VLlama(vcfg)
+            v_params = cast_decode_params(
+                jax.jit(vmodel.init)(
+                    jax.random.key(1),
+                    jax.numpy.zeros((1, 8), jax.numpy.int32),
+                )["params"]
+            )
+            v_metrics = _Metrics()
+            sched = _SlotScheduler(
+                vmodel,
+                v_params,
+                eos_id=None,  # fixed-length rows: stable token counts
+                default_sampling=SamplingConfig(temperature=0.0),
+                metrics=v_metrics,
+                seed_base=0,
+            )
+            import numpy as _vnp
+
+            v_rng = _vnp.random.default_rng(0)
+            prompts = [
+                v_rng.integers(
+                    1, vcfg.vocab_size, size=v_prompt
+                ).tolist()
+                for _ in range(v_reqs)
+            ]
+
+            def one(p):
+                t0 = time.perf_counter()
+                outs, _bw = sched.submit([p], v_new, None)
+                dt = time.perf_counter() - t0
+                return dt, sum(len(r) for r in outs)
+
+            one(prompts[0])  # compile prefill + pool + chunk ladder
+            w0 = v_metrics.registry.counter(
+                "tpufw_serve_wasted_slot_steps_total"
+            ).value()
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=v_conc) as pool:
+                results = list(pool.map(one, prompts))
+            wall = time.perf_counter() - t0
+            total = sum(n for _, n in results)
+            per_tok = sorted(dt / n for dt, n in results)
+            q = _stats.quantiles(per_tok, n=20)
+            wasted = v_metrics.registry.counter(
+                "tpufw_serve_wasted_slot_steps_total"
+            ).value() - w0
+            serve = {
+                "requests": v_reqs,
+                "concurrency": v_conc,
+                "prompt_len": v_prompt,
+                "new_tokens": v_new,
+                "slots": sched.n_slots,
+                "chunk": sched.chunk,
+                # submit() runs on the default device — single-chip by
+                # construction, same convention as the decode tier.
+                "serve_tokens_per_sec_per_chip": round(total / wall, 1),
+                "per_token_latency_p50_ms": round(
+                    _stats.median(per_tok) * 1e3, 3
+                ),
+                "per_token_latency_p95_ms": round(q[18] * 1e3, 3),
+                # Fraction of pool device-steps that produced no live
+                # token — the number to tune SERVE_SLOTS/_CHUNK down.
+                "wasted_slot_step_fraction": round(
+                    wasted / max(wasted + total, 1), 4
+                ),
+            }
+            del v_params
+        except Exception as e:  # noqa: BLE001
+            serve = {"error": f"{type(e).__name__}: {e}"[:500]}
+        _drop_caches(jax)
+    _attach("serve", serve)
+
     # ResNet tier (BASELINE config 2: ResNet-50 on one v5e chip) —
     # images/s/chip through the vision trainer, best-effort like the
     # other aux tiers; OOM degrades the batch, an error is carried in

@@ -718,30 +718,121 @@ def test_http_server_speculative_draft(tiny_env, monkeypatch):
     assert all(len(o) == 6 for o in penalized)
 
 
+@pytest.mark.parametrize("backend", ["slots", "tick"])
 def test_warmup_invisible_to_metrics_and_seed_replay(
-    tiny_env, monkeypatch
+    tiny_env, monkeypatch, backend
 ):
-    """_Server warmup (default on) pre-compiles the default bucket but
-    must be invisible: tick counter back at 0 (seed replay unchanged)
-    and no counter movement — the warmup runs before the listener
-    binds, so nothing can observe the interim state. A spy on
-    _run_tick proves the warmup actually RAN (it swallows exceptions
-    and TPUFW_WARMUP=0 skips it, either of which would make the
-    post-state assertions vacuously true)."""
+    """_Server warmup (default on) pre-compiles the serving path but
+    must be invisible: rng-stream indices back at 0 (seed replay
+    unchanged) and no counter movement — the warmup runs before the
+    listener binds, so nothing can observe the interim state. A spy
+    proves the warmup actually RAN (it swallows exceptions and
+    TPUFW_WARMUP=0 skips it, either of which would make the
+    post-state assertions vacuously true). Both scheduler backends:
+    the slot scheduler (default) and the legacy tick batcher."""
     from tpufw.workloads import serve as serve_mod
 
     calls = []
-    real = serve_mod._Server._run_tick
+    if backend == "tick":
+        monkeypatch.setenv("TPUFW_SERVE_SLOTS", "0")
+        real_tick = serve_mod._Server._run_tick
 
-    def spy(self, prompts, max_new, sampling):
-        calls.append((len(prompts), max_new))
-        return real(self, prompts, max_new, sampling)
+        def tick_spy(self, prompts, max_new, sampling):
+            calls.append((len(prompts), max_new))
+            return real_tick(self, prompts, max_new, sampling)
 
-    monkeypatch.setattr(serve_mod._Server, "_run_tick", spy)
+        monkeypatch.setattr(serve_mod._Server, "_run_tick", tick_spy)
+    else:
+        real_admit = serve_mod._SlotScheduler._admit_job
+
+        def admit_spy(self, req, job, slot):
+            calls.append(slot)
+            return real_admit(self, req, job, slot)
+
+        monkeypatch.setattr(
+            serve_mod._SlotScheduler, "_admit_job", admit_spy
+        )
     srv = serve_mod._Server(port=0, max_new_tokens=4)
-    assert calls, "warmup never invoked _run_tick"
-    assert srv._tick_index == 0
+    assert calls, "warmup never ran"
+    if backend == "tick":
+        assert isinstance(srv._batcher, serve_mod._Batcher)
+        assert srv._tick_index == 0
+    else:
+        assert isinstance(srv._batcher, serve_mod._SlotScheduler)
+        assert srv._batcher._job_index == 0
+        assert srv._batcher._chunk_index == 0
     rendered = srv.metrics.render({})
     for line in rendered.splitlines():
         if line.startswith("tpufw_serve_") and not line.startswith("#"):
             assert line.endswith(" 0"), line
+
+
+# ---- _Batcher._take_tick policy (no server, no device work) ----
+
+
+def _bare_batcher(max_rows=64):
+    """A _Batcher with no worker thread: _take_tick is pure queue
+    policy, so it is testable directly against a hand-built queue."""
+    from tpufw.workloads.serve import _Batcher
+
+    b = _Batcher.__new__(_Batcher)
+    b._queue = []
+    b._cv = threading.Condition()
+    b.max_rows = max_rows
+    b.wait_s = 0.0
+    b._metrics = None
+    return b
+
+
+def _pending(n_rows=1, sampling=None, stream=False):
+    from tpufw.workloads.serve import _Pending
+
+    return _Pending(
+        [[1]] * n_rows, 4, sampling,
+        stream_q=object() if stream else None,
+    )
+
+
+def test_take_tick_coalesces_compatible_requests():
+    b = _bare_batcher()
+    pends = [_pending(), _pending(2), _pending()]
+    b._queue = list(pends)
+    assert b._take_tick() == pends
+    assert b._queue == []
+
+
+def test_take_tick_budget_closes_fifo():
+    """Once a same-config request misses the row budget, no later
+    same-config request may overtake it into the tick — even one
+    small enough to fit."""
+    b = _bare_batcher(max_rows=3)
+    a, big, small = _pending(2), _pending(2), _pending(1)
+    b._queue = [a, big, small]
+    assert b._take_tick() == [a]
+    assert b._queue == [big, small]
+    assert b._take_tick() == [big, small]
+
+
+def test_take_tick_diverts_sampling_mismatch_keeping_order():
+    from tpufw.infer import SamplingConfig
+
+    hot = SamplingConfig(temperature=1.0)
+    b = _bare_batcher()
+    a, m, c = _pending(), _pending(sampling=hot), _pending()
+    b._queue = [a, m, c]
+    assert b._take_tick() == [a, c]
+    assert b._queue == [m]
+    assert b._take_tick() == [m]  # mismatch heads the next tick
+
+
+def test_take_tick_stream_runs_solo():
+    b = _bare_batcher()
+    s, a = _pending(stream=True), _pending()
+    b._queue = [s, a]
+    assert b._take_tick() == [s]  # stream head: solo tick
+    assert b._queue == [a]
+    b2 = _bare_batcher()
+    x, s2, y = _pending(), _pending(stream=True), _pending()
+    b2._queue = [x, s2, y]
+    assert b2._take_tick() == [x, y]  # stream never joins a batch
+    assert b2._queue == [s2]

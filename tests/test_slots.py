@@ -1,0 +1,197 @@
+"""Slot-pool continuous batching (tpufw.infer.slots + _SlotScheduler).
+
+Three contracts, all on CPU with the tiny model:
+
+- PARITY: a row decoded through the slot pool (insert -> chunked
+  decode_steps -> retire) emits exactly the one-shot ``generate``
+  path's greedy tokens — chunk partitioning and co-resident rows
+  must be invisible to the math (same per-step carry).
+- SHAPE STABILITY: occupancy is data, not shape. After the first
+  chunk ladder is traced, insert/retire churn and new requests add
+  ZERO jit traces (``slots_mod.TRACE_COUNTS`` is bumped inside the
+  jitted bodies, so it counts traces, not calls).
+- SCHEDULING: rows join and leave MID-FLIGHT — a short request
+  submitted while a long one is decoding completes first, and a
+  streaming request shares decode chunks with a non-streamed one
+  instead of serializing it.
+"""
+
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.infer import SamplingConfig, generate_text
+from tpufw.infer import slots as slots_mod
+from tpufw.models import LLAMA_CONFIGS, Llama
+
+GREEDY = SamplingConfig(temperature=0.0)
+
+
+@pytest.fixture(scope="module")
+def tiny_decode():
+    cfg = LLAMA_CONFIGS["llama3_tiny"].decode_config()
+    model = Llama(cfg)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def test_pool_matches_generate_and_is_shape_stable(tiny_decode):
+    model, params = tiny_decode
+    prompts = [[1, 5, 9], [2, 7], [3]]
+    max_new = 6
+    want = generate_text(
+        model, params, prompts, max_new_tokens=max_new, sampling=GREEDY
+    )
+
+    pool = slots_mod.SlotPool.create(
+        model, params, 4, sampling=GREEDY, eos_id=None
+    )
+    rows: dict[int, list] = {}
+    for i, p in enumerate(prompts):
+        rng = jax.random.fold_in(jax.random.key(0), i)
+        cache, _first, first_int, _done, seen = slots_mod.prefill_row(
+            model, params, p, rng, sampling=GREEDY, eos_id=None, pad_to=64
+        )
+        pool.insert(i, cache, first_int, len(p), max_new - 1, row_seen=seen)
+        rows[i] = [first_int]
+    chunk_i = 0
+    while any(len(t) < max_new for t in rows.values()):
+        key = jax.random.fold_in(jax.random.key(1), chunk_i)
+        chunk_i += 1
+        out = np.asarray(pool.decode_steps(jax.random.split(key, 2)))
+        for i in rows:
+            take = min(2, max_new - len(rows[i]))
+            rows[i].extend(out[i, :take].tolist())
+    assert [rows[i] for i in range(len(prompts))] == want
+
+    # Steady state reached: retire a row, insert a fresh one into a
+    # DIFFERENT slot, decode again — zero new traces (the slot index
+    # is traced data; shapes never change).
+    before = dict(slots_mod.TRACE_COUNTS)
+    pool.retire(1)
+    rng = jax.random.fold_in(jax.random.key(0), 99)
+    cache, _first, first_int, _done, seen = slots_mod.prefill_row(
+        model, params, [4, 4], rng, sampling=GREEDY, eos_id=None, pad_to=64
+    )
+    pool.insert(3, cache, first_int, 2, max_new - 1, row_seen=seen)
+    out = np.asarray(pool.decode_steps(jax.random.split(jax.random.key(7), 2)))
+    solo = generate_text(
+        model, params, [[4, 4]], max_new_tokens=3, sampling=GREEDY
+    )[0]
+    assert [first_int] + out[3].tolist() == solo
+    after = dict(slots_mod.TRACE_COUNTS)
+    assert after["insert"] == before["insert"]
+    assert after["decode_steps"] == before["decode_steps"]
+
+
+def _make_scheduler(model, params):
+    from tpufw.workloads.serve import _SlotScheduler
+
+    return _SlotScheduler(
+        model, params, eos_id=None, default_sampling=GREEDY, seed_base=0
+    )
+
+
+def test_scheduler_mid_flight_join_and_leave(tiny_decode, monkeypatch):
+    """A short request submitted while a long one is decoding joins a
+    free slot at a chunk boundary and COMPLETES while the long one is
+    still running — the defining behavior the tick batcher could not
+    produce. Outputs stay bit-equal to the one-shot generate path,
+    and once the chunk ladder is traced, further requests add zero
+    traces."""
+    monkeypatch.setenv("TPUFW_SERVE_CHUNK", "2")
+    model, params = tiny_decode
+    sched = _make_scheduler(model, params)
+    long_new, short_new = 24, 4
+    done: dict = {}
+
+    def run(name, prompt, max_new):
+        outs, bw = sched.submit([prompt], max_new, None)
+        done[name] = (time.monotonic(), outs, bw)
+
+    long_t = threading.Thread(target=run, args=("long", [1, 2, 3], long_new))
+    long_t.start()
+    deadline = time.monotonic() + 120
+    while sched.slots_occupied == 0 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert sched.slots_occupied, "long request never occupied a slot"
+    short_t = threading.Thread(target=run, args=("short", [4, 5], short_new))
+    short_t.start()
+    long_t.join(timeout=300)
+    short_t.join(timeout=300)
+    t_long, long_out, long_bw = done["long"]
+    t_short, short_out, short_bw = done["short"]
+    assert len(long_out[0]) == long_new
+    assert len(short_out[0]) == short_new
+    # The short row retired mid-flight; the long one kept decoding.
+    assert t_short < t_long
+    # Both saw the other in the pool.
+    assert long_bw >= 2 and short_bw >= 2
+    # Greedy parity with the one-shot path: joins, leaves, and chunk
+    # partitioning are invisible to the per-step math.
+    assert long_out == generate_text(
+        model, params, [[1, 2, 3]], max_new_tokens=long_new, sampling=GREEDY
+    )
+    assert short_out == generate_text(
+        model, params, [[4, 5]], max_new_tokens=short_new, sampling=GREEDY
+    )
+
+    # Steady state: another request through the warm scheduler — same
+    # prompt bucket, same chunk ladder — must trace NOTHING new.
+    before = dict(slots_mod.TRACE_COUNTS)
+    outs, _ = sched.submit([[9, 8, 7]], short_new, None)
+    assert len(outs[0]) == short_new
+    after = dict(slots_mod.TRACE_COUNTS)
+    assert after["insert"] == before["insert"]
+    assert after["decode_steps"] == before["decode_steps"]
+
+
+def test_scheduler_stream_shares_chunks(tiny_decode, monkeypatch):
+    """A streaming request is an ordinary slot occupant: it decodes
+    in the same chunks as a concurrent non-streamed request (the tick
+    batcher ran streams as SOLO ticks), flushing at most chunk-size
+    tokens per row per event, and its concatenation equals the
+    one-shot greedy output."""
+    monkeypatch.setenv("TPUFW_SERVE_CHUNK", "2")
+    model, params = tiny_decode
+    sched = _make_scheduler(model, params)
+    stream_new = 8
+    done: dict = {}
+
+    def run(name, prompt, max_new):
+        outs, bw = sched.submit([prompt], max_new, None)
+        done[name] = (outs, bw)
+
+    long_t = threading.Thread(target=run, args=("long", [1, 2, 3], 24))
+    long_t.start()
+    deadline = time.monotonic() + 120
+    while sched.slots_occupied == 0 and time.monotonic() < deadline:
+        time.sleep(0.002)
+    q: queue.Queue = queue.Queue()
+    sched.submit_stream([[6, 7]], stream_new, None, q)
+    events = []
+    while True:
+        kind, payload = q.get(timeout=120)
+        events.append((kind, payload))
+        if kind in ("done", "error"):
+            break
+    long_t.join(timeout=300)
+    assert events[-1][0] == "done", events[-1]
+    chunks = [rows for kind, rows in events[:-1] if kind == "chunk"]
+    assert len(chunks) >= 2  # it actually streamed
+    # Every flush carries at most chunk-size tokens per row (the
+    # admission flush carries exactly the prefill token).
+    assert all(len(rows[0]) <= 2 for rows in chunks)
+    got = [t for rows in chunks for t in rows[0]]
+    assert got == generate_text(
+        model, params, [[6, 7]], max_new_tokens=stream_new, sampling=GREEDY
+    )[0]
+    # The non-streamed request shared the pool with the stream.
+    assert done["long"][1] >= 2

@@ -6,6 +6,11 @@ from tpufw.infer.generate import (  # noqa: F401
     generate_text_stream,
     pad_prompts,
 )
+from tpufw.infer.slots import (  # noqa: F401
+    SlotPool,
+    pool_cache,
+    prefill_row,
+)
 from tpufw.infer.speculative import (  # noqa: F401
     speculative_generate,
     speculative_generate_text,

@@ -143,6 +143,7 @@ def generate(
     pad_id: int = 0,
     eos_id: Optional[int] = None,
     prefill_chunk_size: Optional[int] = None,
+    live_rows: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Generate continuations. Returns [B, max_new_tokens] int32.
 
@@ -164,6 +165,10 @@ def generate(
         length); an indivisible tail adds at most one remainder
         program. No padding, no extra cache slots; a chunk >= the
         prompt degrades to the one-shot path.
+      live_rows: optional [B] bool mask; False rows (batch fillers —
+        pow-2 padding, length-bucket sentinels) start done, so they
+        emit ``pad_id`` from step 1 instead of decoding garbage and,
+        in the streaming path, never hold up the all-done early exit.
     """
     b, p = prompt_tokens.shape
     if max_new_tokens < 1:
@@ -181,6 +186,7 @@ def generate(
         model, params, prompt_tokens, pad_lens, rng,
         n_step_keys=max_new_tokens - 1, sampling=sampling,
         eos_id=eos_id, prefill_chunk_size=prefill_chunk_size,
+        live_rows=live_rows,
     )
     if max_new_tokens == 1:
         return first[:, None]
@@ -224,6 +230,7 @@ def _prefill_and_first(
     sampling: SamplingConfig,
     eos_id: Optional[int],
     prefill_chunk_size: Optional[int],
+    live_rows: Optional[jax.Array] = None,
 ):
     """ONE copy of the prefill + first-token + key-split discipline,
     shared by ``generate`` and the streaming path — streamed chunks are
@@ -263,6 +270,10 @@ def _prefill_and_first(
         seen = seen.at[jnp.arange(b), first].set(True)
     # The EOS token itself is emitted; only rows ALREADY done emit pad.
     done = jnp.zeros((b,), bool) if eos_id is None else first == eos_id
+    if live_rows is not None:
+        # Filler rows are born done: they emit pad from step 1 and never
+        # gate the streaming all-done early exit.
+        done = done | ~live_rows
     step_keys = jax.random.split(next_rng, max(n_step_keys, 1))
     return cache, first, p - pad_lens, done, seen, step_keys
 
@@ -310,13 +321,14 @@ def _stream_prefill(
     sampling: SamplingConfig,
     eos_id: Optional[int],
     prefill_chunk_size: Optional[int],
+    live_rows: Optional[jax.Array] = None,
 ):
     """Streaming phase 1: jit boundary over the SHARED
     ``_prefill_and_first`` (the bit-parity contract lives there)."""
     return _prefill_and_first(
         model, params, prompt_tokens, pad_lens, rng,
         n_step_keys=n_step_keys, sampling=sampling, eos_id=eos_id,
-        prefill_chunk_size=prefill_chunk_size,
+        prefill_chunk_size=prefill_chunk_size, live_rows=live_rows,
     )
 
 
@@ -369,6 +381,7 @@ def generate_stream(
     seed: int = 0,
     rng: Optional[jax.Array] = None,
     prefill_chunk_size: Optional[int] = None,
+    live_rows: Optional[Sequence[bool]] = None,
 ):
     """Streaming decode: yields ``[B, n]`` int32 numpy chunks whose
     concatenation is BIT-identical to ``generate``'s output under the
@@ -407,6 +420,10 @@ def generate_stream(
         sampling=sampling,
         eos_id=eos_id,
         prefill_chunk_size=prefill_chunk_size,
+        live_rows=(
+            None if live_rows is None
+            else jnp.asarray(np.asarray(live_rows, bool))
+        ),
     )
     first = np.asarray(token)[:, None]
     if max_new_tokens == 1:
@@ -463,6 +480,7 @@ def generate_text_stream(
     eos_id: Optional[int] = None,
     seed: int = 0,
     prefill_chunk_size: Optional[int] = None,
+    live_rows: Optional[Sequence[bool]] = None,
 ):
     """Ragged streaming wrapper: yields, per chunk, one ``list[int]``
     of NEW tokens per row — rows stop emitting after their eos (the
@@ -474,7 +492,7 @@ def generate_text_stream(
         model, params, prompts,
         max_new_tokens=max_new_tokens, chunk_size=chunk_size,
         sampling=sampling, pad_id=pad_id, eos_id=eos_id, seed=seed,
-        prefill_chunk_size=prefill_chunk_size,
+        prefill_chunk_size=prefill_chunk_size, live_rows=live_rows,
     ):
         out: list[list[int]] = []
         for i, row in enumerate(chunk):
@@ -497,6 +515,7 @@ def generate_text(
     eos_id: Optional[int] = None,
     seed: int = 0,
     prefill_chunk_size: Optional[int] = None,
+    live_rows: Optional[Sequence[bool]] = None,
 ) -> list[list[int]]:
     """Convenience wrapper: ragged python prompts in, ragged lists out."""
     tokens, pads = pad_prompts(prompts, pad_id)
@@ -511,6 +530,10 @@ def generate_text(
         pad_id=pad_id,
         eos_id=eos_id,
         prefill_chunk_size=prefill_chunk_size,
+        live_rows=(
+            None if live_rows is None
+            else jnp.asarray(np.asarray(live_rows, bool))
+        ),
     )
     result = []
     for row in np.asarray(out):

@@ -497,17 +497,30 @@ class MLAttention(nn.Module):
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
         cur = cursor.value
-        cc.value = jax.lax.dynamic_update_slice(
-            cc.value, c_kv.astype(cfg.dtype), (0, cur, 0)
-        )
-        cp.value = jax.lax.dynamic_update_slice(
-            cp.value, k_pe.astype(cfg.dtype), (0, cur, 0)
-        )
         seg = (
             jnp.ones((b, t), jnp.int32) if segment_ids is None
             else segment_ids.astype(jnp.int32)
         )
-        cseg.value = jax.lax.dynamic_update_slice(cseg.value, seg, (0, cur))
+        if cur.ndim == 0:
+            cc.value = jax.lax.dynamic_update_slice(
+                cc.value, c_kv.astype(cfg.dtype), (0, cur, 0)
+            )
+            cp.value = jax.lax.dynamic_update_slice(
+                cp.value, k_pe.astype(cfg.dtype), (0, cur, 0)
+            )
+            cseg.value = jax.lax.dynamic_update_slice(
+                cseg.value, seg, (0, cur)
+            )
+            cur_w = cur
+        else:
+            # Per-row cursors [B] (tpufw.infer.slots pool decode) — see
+            # llama Attention._cached_attention for the clamp rationale.
+            cur_w = jnp.minimum(cur, cfg.max_seq_len - t)
+            rows = jnp.arange(b)[:, None]
+            cols = cur_w[:, None] + jnp.arange(t)[None, :]
+            cc.value = cc.value.at[rows, cols].set(c_kv.astype(cfg.dtype))
+            cp.value = cp.value.at[rows, cols].set(k_pe.astype(cfg.dtype))
+            cseg.value = cseg.value.at[rows, cols].set(seg)
         cursor.value = cur + t
 
         w_uk, w_uv = kv_b[..., :dn], kv_b[..., dn:]  # [kvr, H, dn/dv]
@@ -530,9 +543,12 @@ class MLAttention(nn.Module):
             )
         ) * (float(cfg.qk_head_dim) ** -0.5)
         # Causality over cache SLOTS (RoPE positions lag slots under
-        # left-padding); never-written slots keep segment 0.
-        slot_pos = (cur + jnp.arange(t))[None, :, None]  # [1,T,1]
-        mask = slot_pos >= jnp.arange(s)[None, None, :]  # [1,T,S]
+        # left-padding); never-written slots keep segment 0. With
+        # per-row cursors this is [B,T,1] instead of [1,T,1].
+        slot_pos = (cur_w[..., None] + jnp.arange(t))[..., None]
+        mask = slot_pos >= jnp.arange(s)  # [.,T,S]
+        if mask.ndim == 2:
+            mask = mask[None]
         seg_mask = seg[:, :, None] == cseg.value[:, None, :]  # [B,T,S]
         logits = jnp.where(
             (mask & seg_mask)[:, None, :, :], logits, -1e30

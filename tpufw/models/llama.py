@@ -618,22 +618,38 @@ class Attention(nn.Module):
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
         cur = cursor.value
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(cfg.dtype), (0, cur, 0, 0)
-        )
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(cfg.dtype), (0, cur, 0, 0)
-        )
         seg = (
             jnp.ones((b, t), jnp.int32) if segment_ids is None
             else segment_ids.astype(jnp.int32)
         )
-        cseg.value = jax.lax.dynamic_update_slice(cseg.value, seg, (0, cur))
+        if cur.ndim == 0:
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, cur, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, cur, 0, 0)
+            )
+            cseg.value = jax.lax.dynamic_update_slice(
+                cseg.value, seg, (0, cur)
+            )
+            # Causality is over cache SLOTS, not RoPE positions — under
+            # left-padding a token's RoPE position lags its slot by pad_len
+            # and would wrongly mask valid recent slots.
+            slot_positions = jnp.broadcast_to(cur + jnp.arange(t), (b, t))
+        else:
+            # Per-row cursors [B] (tpufw.infer.slots pool decode): each
+            # slot writes at its own offset. Clamp the write window so a
+            # retired-but-still-stepped row scatters in bounds; its output
+            # is masked host-side, and the clamped slot is overwritten by
+            # the next insert's full-cache copy.
+            cur_w = jnp.minimum(cur, cfg.max_seq_len - t)
+            rows = jnp.arange(b)[:, None]
+            cols = cur_w[:, None] + jnp.arange(t)[None, :]
+            ck.value = ck.value.at[rows, cols].set(k.astype(cfg.dtype))
+            cv.value = cv.value.at[rows, cols].set(v.astype(cfg.dtype))
+            cseg.value = cseg.value.at[rows, cols].set(seg)
+            slot_positions = cur_w[:, None] + jnp.arange(t)[None, :]
         cursor.value = cur + t
-        # Causality is over cache SLOTS, not RoPE positions — under
-        # left-padding a token's RoPE position lags its slot by pad_len and
-        # would wrongly mask valid recent slots.
-        slot_positions = jnp.broadcast_to(cur + jnp.arange(t), (b, t))
         return multi_head_attention(
             q,
             ck.value,

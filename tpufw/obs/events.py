@@ -42,6 +42,8 @@ SCHEMA: Dict[str, FrozenSet[str]] = {
     "straggler_detected": frozenset(
         {"step", "straggler_hosts", "median_s", "factor"}
     ),
+    "serve_request": frozenset({"rows", "new_tokens", "latency_s"}),
+    "serve_pool_switch": frozenset({"cache_len", "slots"}),
 }
 
 

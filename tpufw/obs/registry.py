@@ -200,6 +200,16 @@ class Histogram(_Metric):
         with self._lock:
             return float(self._counts.get(_label_key(labels), 0))
 
+    def reset(self, **labels) -> None:
+        """Drop a series — the histogram counterpart of
+        ``Counter.reset`` (serve warmup must be invisible to
+        scrapes; buckets/sum/count all return to zero)."""
+        key = _label_key(labels)
+        with self._lock:
+            self._bucket_counts.pop(key, None)
+            self._sums.pop(key, None)
+            self._counts.pop(key, None)
+
     def render(self) -> list:
         with self._lock:
             bucket_counts = {

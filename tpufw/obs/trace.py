@@ -64,7 +64,13 @@ class Tracer:
     wall-clock anchor is recorded in ``otherData`` for cross-host
     alignment."""
 
-    def __init__(self, path: str, pid: int = 0, process_name: str = ""):
+    def __init__(
+        self,
+        path: str,
+        pid: int = 0,
+        process_name: str = "",
+        max_events: Optional[int] = None,
+    ):
         self.path = path
         self.pid = pid
         self._name = process_name
@@ -73,6 +79,13 @@ class Tracer:
         self._t0 = time.perf_counter()
         self._wall0 = time.time()
         self._closed = False
+        # Long-running processes (the serving scheduler) trace hot
+        # per-chunk spans forever: cap the buffer so memory stays
+        # bounded — the trace keeps the RUN'S HEAD (startup + first
+        # traffic, where compile stalls and admission bugs live) and
+        # counts what it dropped.
+        self._max = max_events
+        self._dropped = 0
 
     enabled = True
 
@@ -93,8 +106,12 @@ class Tracer:
         if args:
             ev["args"] = args
         with self._lock:
-            if not self._closed:
-                self._events.append(ev)
+            if self._closed:
+                return
+            if self._max is not None and len(self._events) >= self._max:
+                self._dropped += 1
+                return
+            self._events.append(ev)
 
     def span(self, name: str, **args) -> _Span:
         return _Span(self, name, args)
@@ -119,8 +136,12 @@ class Tracer:
         if args:
             ev["args"] = args
         with self._lock:
-            if not self._closed:
-                self._events.append(ev)
+            if self._closed:
+                return
+            if self._max is not None and len(self._events) >= self._max:
+                self._dropped += 1
+                return
+            self._events.append(ev)
 
     def close(self) -> None:
         with self._lock:
@@ -140,7 +161,10 @@ class Tracer:
         doc = {
             "traceEvents": events,
             "displayTimeUnit": "ms",
-            "otherData": {"wall_epoch_s": self._wall0},
+            "otherData": {
+                "wall_epoch_s": self._wall0,
+                "dropped_events": self._dropped,
+            },
         }
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         tmp = self.path + ".tmp"

@@ -24,14 +24,20 @@ def prefetch_to_device(
     batches: Iterator[dict],
     mesh: Mesh,
     spec: Optional[P] = None,
-    buffer_size: int = 2,
+    buffer_size: Optional[int] = None,
 ) -> Iterator[dict]:
     """Yield device-resident batches one transfer ahead of consumption.
 
     ``spec`` defaults to row-sharding over ("data", "fsdp") — the trainer's
-    batch layout. Exceptions in the source iterator propagate to the
-    consumer at the point of the failed batch.
+    batch layout. ``buffer_size`` defaults to ``TPUFW_PREFETCH_DEPTH``
+    (2): depth 1 can stall the step on a slow host read, deeper buffers
+    pin more batches in HBM. Exceptions in the source iterator propagate
+    to the consumer at the point of the failed batch.
     """
+    if buffer_size is None:
+        from tpufw.workloads.env import env_int
+
+        buffer_size = max(1, env_int("prefetch_depth", 2))
     sharding = NamedSharding(
         mesh, spec if spec is not None else P(("data", "fsdp"))
     )

@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Optional
 
+from tpufw.obs import events as obs_events
+from tpufw.obs import trace as obs_trace
 from tpufw.obs.registry import Registry as ObsRegistry
 from tpufw.workloads.env import env_float, env_int, env_str
 
@@ -401,11 +403,19 @@ def _maybe_cast_decode(params):
     return cast_decode_params(params, jnp.dtype(cast))
 
 
-def _pad_batch(prompts: list[list[int]]) -> tuple[list[list[int]], int]:
-    """Pad the batch to a power of two (filler rows = [0]) so the jitted
-    generate specializes on few batch shapes. Returns (padded, real_n)."""
+def _pad_batch(
+    prompts: list[list[int]], fill_id: int = 0
+) -> tuple[list[list[int]], int]:
+    """Pad the batch to a power of two so the jitted generate
+    specializes on few batch shapes. Returns (padded, real_n).
+
+    Filler rows are seeded with ``fill_id`` — callers pass the EOS id
+    when one is configured, and thread the matching ``live_rows`` mask
+    into generate so the done-mask kills fillers at step 1 instead of
+    decoding max_new tokens of garbage (and, in the streaming path,
+    holding the all-done early exit hostage)."""
     n = len(prompts)
-    return prompts + [[0]] * (_pow2_ceil(n) - n), n
+    return prompts + [[fill_id]] * (_pow2_ceil(n) - n), n
 
 
 def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
@@ -415,7 +425,8 @@ def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
     params = _maybe_cast_decode(params)
     sampling = sampling_from_env()  # default greedy: deterministic
     draft = build_draft_generator(sampling)
-    padded, real_n = _pad_batch(prompts)
+    eos = eos_from_env()
+    padded, real_n = _pad_batch(prompts, eos if eos is not None else 0)
     if draft is not None:
         draft_model, draft_params, k = draft
         draft_params = _maybe_cast_decode(draft_params)
@@ -426,7 +437,7 @@ def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
             params,
             padded,
             max_new_tokens=max_new_tokens,
-            eos_id=eos_from_env(),
+            eos_id=eos,
             k=k,
             live_rows=[i < real_n for i in range(len(padded))],
             sampling=sampling,
@@ -440,7 +451,8 @@ def run_batch(prompts: list[list[int]], max_new_tokens: int) -> list[dict]:
             padded,
             max_new_tokens=max_new_tokens,
             sampling=sampling,
-            eos_id=eos_from_env(),
+            eos_id=eos,
+            live_rows=[i < real_n for i in range(len(padded))],
             # Long-prompt lever: prefill activations scale with the
             # chunk, not the prompt (tpufw.infer.generate). 0 = off.
             prefill_chunk_size=env_int("prefill_chunk", 0) or None,
@@ -816,6 +828,565 @@ class _Batcher:
                     pend.done.set()
 
 
+class _SlotJob:
+    """One prompt ROW moving through the slot pool. Rows are the
+    schedulable unit: a request's rows may join across chunk
+    boundaries as slots free up, and each retires independently at
+    its own EOS/max_new."""
+
+    __slots__ = ("req", "prompt", "p_bucket", "max_new", "cache_len",
+                 "tokens", "unflushed")
+
+    def __init__(self, req, prompt, p_bucket, max_new, cache_len):
+        self.req = req
+        self.prompt = prompt
+        self.p_bucket = p_bucket
+        self.max_new = max_new
+        self.cache_len = cache_len
+        self.tokens: list[int] = []
+        self.unflushed: list[int] = []
+
+
+class _SlotReq:
+    """Request-level bookkeeping around a _Pending: the per-row jobs,
+    the admission cursor (``next_job``), and completion accounting."""
+
+    __slots__ = ("pend", "sampling", "jobs", "next_job", "rows_left",
+                 "cache_len", "t_submit", "started", "error",
+                 "batched_with", "overtaken")
+
+    def __init__(self, pend, sampling, jobs):
+        self.pend = pend
+        self.sampling = sampling  # resolved (never None)
+        self.jobs = jobs
+        self.next_job = 0  # first not-yet-admitted job
+        self.rows_left = len(jobs)
+        # _make_req constructs the req first (jobs reference it), then
+        # fills jobs and recomputes this.
+        self.cache_len = max((j.cache_len for j in jobs), default=0)
+        self.t_submit = time.time()
+        self.started = False  # first row admitted (join latency mark)
+        self.error: Exception | None = None
+        self.batched_with = 1
+        self.overtaken = 0  # admission rounds later arrivals ran ahead
+
+
+class _SlotScheduler:
+    """Continuous batching at decode-STEP granularity — the tick
+    batcher's successor (``tpufw.infer.slots`` holds the device side).
+
+    Requests enqueue as per-row jobs; ONE worker thread admits rows
+    into a persistent S-slot KV pool and advances ALL occupied slots k
+    tokens per device call. Rows join whenever a slot frees at a chunk
+    boundary and retire at their own EOS/max_new — a short request
+    admitted next to a long one completes mid-flight instead of
+    waiting out the long tail, and streaming requests are ordinary
+    slot occupants sharing decode chunks with everyone else (the tick
+    batcher ran them as solo ticks).
+
+    Static-shape discipline: occupancy is DATA, so joins/leaves never
+    recompile. The pool is keyed (cache_len, sampling) — cache_len
+    from the serving ``_cache_bucket`` ladder, sampling because it is
+    a compiled-program parameter — and REKEYS only when it drains
+    empty. Chunk length k is itself pow-2-laddered against the
+    largest remaining budget, so at most log2(chunk) decode programs
+    exist per pool key; greedy outputs are invariant to how the run
+    is chunked (the per-step carry is identical).
+
+    Fairness: FIFO holds within a pool key — once a compatible
+    request misses the free-slot budget, no later compatible request
+    overtakes it. Incompatible requests are diverted past, but each
+    diversion is counted and admission CLOSES after ``n_slots``
+    overtakes, so a mismatched head request drains the pool instead
+    of starving behind a steady compatible stream.
+
+    Knobs: TPUFW_SERVE_SLOTS (pool size; 0 restores the tick
+    batcher), TPUFW_SERVE_CHUNK (tokens per device call, default
+    TPUFW_STREAM_CHUNK), TPUFW_SERVE_CACHE_FLOOR (smallest cache
+    rung), TPUFW_BATCH_WAIT_MS (idle coalescing window, shared with
+    the tick batcher).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        eos_id: Optional[int] = None,
+        default_sampling=None,
+        metrics: Optional[_Metrics] = None,
+        seed_base: int = 0,
+        events=None,
+        tracer=None,
+    ):
+        import jax
+        import numpy as np
+
+        from tpufw.infer import slots as slots_mod
+
+        self._jax = jax
+        self._np = np
+        self._slots_mod = slots_mod
+        self.model = model
+        self.params = params
+        self._eos = eos_id
+        self._default_sampling = (
+            default_sampling
+            if default_sampling is not None
+            else sampling_from_env()
+        )
+        self._metrics = metrics
+        self._seed_base = seed_base
+        self._events = events if events is not None else obs_events.NULL
+        self._tracer = tracer if tracer is not None else obs_trace.NULL
+        self.n_slots = max(1, env_int("serve_slots", 8))
+        self.chunk = max(
+            1, env_int("serve_chunk", 0) or env_int("stream_chunk", 16)
+        )
+        self.cache_floor = env_int("serve_cache_floor", 128)
+        self.wait_s = env_int("batch_wait_ms", 5) / 1000.0
+        self.prefill_chunk = env_int("prefill_chunk", 0) or None
+        if metrics is not None:
+            metrics.register(
+                "retired_rows_total",
+                "wasted_slot_steps_total",
+                "pool_switches_total",
+            )
+            metrics.registry.histogram(
+                "tpufw_serve_join_latency_seconds",
+                "Request submit-to-first-slot-insert latency",
+            )
+        self._pool = None  # tpufw.infer.slots.SlotPool (lazy, keyed)
+        self._pool_key: Optional[tuple] = None
+        self._slots: list[Optional[_SlotJob]] = [None] * self.n_slots
+        self._n_active = 0
+        # Monotonic indices namespacing the rng streams (fold_in of
+        # two DIFFERENT base seeds, so prefill and chunk draws never
+        # collide); both restored by reset_after_warmup so warmup is
+        # invisible to seed replay.
+        self._job_index = 0
+        self._chunk_index = 0
+        self._queue: list[_SlotReq] = []
+        self._cv = threading.Condition()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ---- client-facing interface (mirrors _Batcher) ----
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    @property
+    def slots_total(self) -> int:
+        return self.n_slots
+
+    @property
+    def slots_occupied(self) -> int:
+        return self._n_active
+
+    def submit(self, prompts: list[list[int]], max_new: int, sampling=None):
+        pend = _Pending(prompts, max_new, sampling)
+        self._enqueue(pend)
+        pend.done.wait()
+        if pend.error is not None:
+            raise pend.error
+        return pend.outputs, pend.batched_with
+
+    def submit_stream(
+        self, prompts: list[list[int]], max_new: int, sampling, q
+    ) -> None:
+        """Enqueue a streaming request and return immediately — the
+        caller consumes per-chunk row outputs from ``q`` until the
+        ("done", n)/("error", e) sentinel. Stream rows occupy slots
+        like any other; their unflushed tokens are put once per decode
+        chunk."""
+        pend = _Pending(prompts, max_new, sampling, stream_q=q)
+        self._enqueue(pend)
+
+    def reset_after_warmup(self) -> None:
+        """Restore the rng-stream indices so warmup prefills/chunks
+        are invisible to seed replay (the compiled programs and the
+        warm pool itself stay)."""
+        self._job_index = 0
+        self._chunk_index = 0
+
+    def _enqueue(self, pend: _Pending) -> None:
+        req = self._make_req(pend)  # raises ValueError -> HTTP 400
+        with self._cv:
+            self._queue.append(req)
+            self._cv.notify()
+
+    def _make_req(self, pend: _Pending) -> _SlotReq:
+        cap = self.model.cfg.max_seq_len
+        sampling = (
+            pend.sampling
+            if pend.sampling is not None
+            else self._default_sampling
+        )
+        jobs = []
+        req = _SlotReq(pend, sampling, [])
+        for prompt in pend.prompts:
+            pb = _bucket(len(prompt), 64)
+            # Validate at submit (not mid-pool): prefill writes pb
+            # slots, decode writes max_new - 1 more (the first token
+            # comes out of prefill).
+            if pb + pend.max_new - 1 > cap:
+                raise ValueError(
+                    f"prompt ({len(prompt)}, bucketed to {pb}) + "
+                    f"max_new_tokens ({pend.max_new}) exceeds the KV "
+                    f"cache (max_seq_len={cap})"
+                )
+            jobs.append(_SlotJob(
+                req,
+                prompt,
+                pb,
+                pend.max_new,
+                _cache_bucket(
+                    pb + pend.max_new - 1, cap, self.cache_floor
+                ),
+            ))
+        req.jobs = jobs
+        req.rows_left = len(jobs)
+        req.cache_len = max(j.cache_len for j in jobs)
+        return req
+
+    # ---- worker loop ----
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._n_active:
+                    self._cv.wait()
+                idle = self._n_active == 0
+            if idle and self.wait_s > 0:
+                # Coalescing window: near-simultaneous arrivals land
+                # in the same first admission round. Never slept while
+                # the pool is running — joins happen at chunk
+                # boundaries, which are the natural cadence.
+                time.sleep(self.wait_s)
+            try:
+                self._admit()
+                if self._n_active:
+                    self._run_chunk()
+            except Exception as e:  # noqa: BLE001 — serving loop
+                self._fail_active(e)
+
+    def _pool_model(self, cache_len: int):
+        """Model variant with the pool's KV budget — built inline;
+        flax modules hash structurally, so equal configs hit the jit
+        caches without memoization (same trick as _Server._model_for)."""
+        import dataclasses
+
+        if cache_len == self.model.cfg.max_seq_len:
+            return self.model
+        return type(self.model)(
+            dataclasses.replace(self.model.cfg, max_seq_len=cache_len)
+        )
+
+    def _build_pool(self, key) -> None:
+        cache_len, sampling = key
+        with self._tracer.span(
+            "serve_pool_build", cache_len=cache_len, slots=self.n_slots
+        ):
+            self._pool = self._slots_mod.SlotPool.create(
+                self._pool_model(cache_len),
+                self.params,
+                self.n_slots,
+                sampling=sampling,
+                pad_id=0,
+                eos_id=self._eos,
+            )
+        self._pool_key = key
+        self._slots = [None] * self.n_slots
+        self._n_active = 0
+        if self._metrics is not None:
+            self._metrics.inc("pool_switches_total")
+        self._events.emit(
+            "serve_pool_switch", cache_len=cache_len, slots=self.n_slots
+        )
+
+    def _admit(self) -> None:
+        with self._cv:
+            queue = list(self._queue)
+        if not queue:
+            return
+        # The pool rekeys ONLY when empty: the head request defines
+        # the (cache_len, sampling) every later admission must match.
+        if self._n_active == 0:
+            head = queue[0]
+            key = (head.cache_len, head.sampling)
+            if self._pool is None or self._pool_key != key:
+                try:
+                    self._build_pool(key)
+                except Exception as e:  # noqa: BLE001 — serving loop
+                    self._fail_req(head, e)
+                    return
+        if self._pool is None:
+            return
+        cache_cap = self._pool.cache_len
+        pool_sampling = self._pool.sampling
+        free = [i for i, j in enumerate(self._slots) if j is None]
+        budget_closed = False
+        blocked: Optional[_SlotReq] = None
+        with self._tracer.span("serve_admit", queued=len(queue)):
+            for req in queue:
+                if req.error is not None:
+                    continue
+                if (
+                    req.sampling != pool_sampling
+                    or req.cache_len > cache_cap
+                ):
+                    if blocked is None:
+                        blocked = req
+                        if req.overtaken >= self.n_slots:
+                            # Fairness valve: this head has been
+                            # diverted past enough times — stop
+                            # feeding the pool and let it drain so
+                            # the head can rekey it.
+                            break
+                    continue
+                if budget_closed:
+                    continue  # FIFO within a pool key: no overtaking
+                if not free:
+                    budget_closed = True
+                    continue
+                if self._admit_req(req, free) and blocked is not None:
+                    blocked.overtaken += 1
+                if req.next_job < len(req.jobs) and req.error is None:
+                    budget_closed = True
+            with self._cv:
+                self._queue = [
+                    r
+                    for r in self._queue
+                    if r.error is None and r.next_job < len(r.jobs)
+                ]
+        # batched_with: how many distinct requests share the pool now.
+        reqs = {
+            id(j.req): j.req for j in self._slots if j is not None
+        }
+        for req in reqs.values():
+            req.batched_with = max(req.batched_with, len(reqs))
+
+    def _admit_req(self, req: _SlotReq, free: list[int]) -> bool:
+        """Admit as many of ``req``'s remaining rows as fit; returns
+        True if at least one row ran (prefilled), slot-consuming or
+        not."""
+        admitted = False
+        while free and req.next_job < len(req.jobs):
+            job = req.jobs[req.next_job]
+            try:
+                used_slot = self._admit_job(req, job, free[0])
+            except Exception as e:  # noqa: BLE001 — isolate request
+                self._fail_req(req, e)
+                return admitted
+            req.next_job += 1
+            admitted = True
+            if used_slot:
+                free.pop(0)
+        if admitted and not req.started:
+            req.started = True
+            if self._metrics is not None:
+                self._metrics.registry.histogram(
+                    "tpufw_serve_join_latency_seconds"
+                ).observe(time.time() - req.t_submit)
+        if admitted and req.pend.stream_q is not None:
+            # First tokens reach the stream at admission, not a chunk
+            # later — and every flush stays <= chunk-size tokens/row.
+            self._flush_stream(req)
+        if req.rows_left == 0 and req.next_job == len(req.jobs):
+            self._finish(req)
+        return admitted
+
+    def _admit_job(self, req: _SlotReq, job: _SlotJob, slot: int) -> bool:
+        """Prefill one row and (unless it finishes at its first
+        token) insert it into ``slot``. Returns True iff the slot was
+        consumed."""
+        jax = self._jax
+        # Namespaced, replayable prefill stream: a fresh base key per
+        # call, folded with the monotonic job index.
+        rng = jax.random.fold_in(
+            jax.random.key(self._seed_base), self._job_index
+        )
+        self._job_index += 1
+        with self._tracer.span(
+            "serve_prefill", prompt=len(job.prompt), width=job.p_bucket
+        ):
+            cache, _first, first_int, _done, seen = (
+                self._slots_mod.prefill_row(
+                    self._pool.model,
+                    self.params,
+                    job.prompt,
+                    rng,
+                    sampling=self._pool.sampling,
+                    eos_id=self._eos,
+                    pad_to=job.p_bucket,
+                    prefill_chunk_size=self.prefill_chunk,
+                )
+            )
+        job.tokens.append(first_int)
+        job.unflushed.append(first_int)
+        if self._metrics is not None:
+            self._metrics.inc("tokens_generated_total")
+        if job.max_new == 1 or (
+            self._eos is not None and first_int == self._eos
+        ):
+            # Finished at its first token: the row never occupies a
+            # slot (the prefilled cache is dropped).
+            if self._metrics is not None:
+                self._metrics.inc("retired_rows_total")
+            req.rows_left -= 1
+            return False
+        self._pool.insert(
+            slot,
+            cache,
+            first_int,
+            len(job.prompt),
+            job.max_new - 1,
+            row_seen=seen,
+        )
+        self._slots[slot] = job
+        self._n_active += 1
+        return True
+
+    def _run_chunk(self) -> None:
+        active = [
+            (i, j) for i, j in enumerate(self._slots) if j is not None
+        ]
+        # Pow-2 ladder on the chunk length: the scan length is a
+        # compiled-shape dimension, so the tail of a nearly-done pool
+        # shrinks k in big steps (at most log2(chunk) programs), never
+        # per-value.
+        max_left = max(j.max_new - len(j.tokens) for _, j in active)
+        k = min(self.chunk, _pow2_ceil(max_left))
+        key = self._jax.random.fold_in(
+            self._jax.random.key(self._seed_base + 1), self._chunk_index
+        )
+        self._chunk_index += 1
+        keys = self._jax.random.split(key, k)
+        with self._tracer.span(
+            "serve_decode_chunk", k=k, rows=len(active)
+        ):
+            out = self._np.asarray(self._pool.decode_steps(keys))
+        if self._metrics is not None:
+            self._metrics.inc("ticks_total")
+            self._metrics.inc("tick_rows_total", len(active))
+        live_tokens = 0
+        flush: list[_SlotReq] = []
+        finished: list[_SlotReq] = []
+        for slot, job in active:
+            req = job.req
+            take = min(k, job.max_new - len(job.tokens))
+            row = out[slot, :take].tolist()
+            if self._eos is not None and self._eos in row:
+                row = row[: row.index(self._eos) + 1]
+            job.tokens.extend(row)
+            job.unflushed.extend(row)
+            live_tokens += len(row)
+            if req.pend.stream_q is not None and req not in flush:
+                flush.append(req)
+            if len(job.tokens) >= job.max_new or (
+                self._eos is not None and row and row[-1] == self._eos
+            ):
+                # Retire: host-side only — the device row froze
+                # itself via the done/remaining masks.
+                self._slots[slot] = None
+                self._n_active -= 1
+                if self._metrics is not None:
+                    self._metrics.inc("retired_rows_total")
+                req.rows_left -= 1
+                if req.rows_left == 0 and req.next_job == len(req.jobs):
+                    finished.append(req)
+        if self._metrics is not None:
+            self._metrics.inc("tokens_generated_total", live_tokens)
+            # Capacity accounting: S * k device-steps ran; everything
+            # not delivering a live token (empty slots, done rows
+            # inside the chunk) is the batching overhead to tune
+            # TPUFW_SERVE_SLOTS / _CHUNK against.
+            self._metrics.inc(
+                "wasted_slot_steps_total",
+                self.n_slots * k - live_tokens,
+            )
+        for req in flush:
+            if req not in finished:
+                self._flush_stream(req)
+        for req in finished:
+            self._finish(req)
+
+    # ---- completion / failure ----
+
+    def _flush_stream(self, req: _SlotReq) -> None:
+        rows = [list(j.unflushed) for j in req.jobs]
+        if not any(rows):
+            return
+        for j in req.jobs:
+            j.unflushed = []
+        req.pend.stream_q.put(("chunk", rows))
+
+    def _finish(self, req: _SlotReq) -> None:
+        with self._cv:
+            if req in self._queue:
+                self._queue.remove(req)
+        pend = req.pend
+        outs = [list(j.tokens[: j.max_new]) for j in req.jobs]
+        n_tokens = sum(len(o) for o in outs)
+        self._events.emit(
+            "serve_request",
+            rows=len(req.jobs),
+            new_tokens=n_tokens,
+            latency_s=round(time.time() - req.t_submit, 6),
+        )
+        if pend.stream_q is not None:
+            self._flush_stream(req)
+            pend.stream_q.put(("done", n_tokens))
+        else:
+            pend.outputs = outs
+        pend.batched_with = req.batched_with
+        pend.done.set()
+
+    def _fail_req(self, req: _SlotReq, e: Exception) -> None:
+        """Fail ONE request (admission-time errors): its active slots
+        retire, everything else keeps running."""
+        req.error = e
+        with self._cv:
+            if req in self._queue:
+                self._queue.remove(req)
+        for i, job in enumerate(self._slots):
+            if job is not None and job.req is req:
+                self._pool.retire(i)
+                self._slots[i] = None
+                self._n_active -= 1
+        pend = req.pend
+        pend.error = e
+        if pend.stream_q is not None:
+            pend.stream_q.put(("error", e))
+        pend.done.set()
+
+    def _fail_active(self, e: Exception) -> None:
+        """A decode chunk failed: every ACTIVE request shares that
+        fate (their pool state is gone — the jit donated it), but
+        queued requests survive and the pool rebuilds on the next
+        admission."""
+        reqs = {
+            id(j.req): j.req for j in self._slots if j is not None
+        }
+        self._slots = [None] * self.n_slots
+        self._n_active = 0
+        self._pool = None  # donated buffers are suspect after a failure
+        self._pool_key = None
+        for req in reqs.values():
+            req.error = e
+            with self._cv:
+                if req in self._queue:
+                    self._queue.remove(req)
+            pend = req.pend
+            pend.error = e
+            if pend.stream_q is not None:
+                pend.stream_q.put(("error", e))
+            pend.done.set()
+
+
 class _Server:
     """Minimal HTTP serving loop over the jitted generator."""
 
@@ -849,9 +1420,6 @@ class _Server:
             )
         self.port = port
         self._codec = None
-        self._batcher = _Batcher(
-            self._run_tick, self.metrics, run_stream=self._run_stream
-        )
         # Distinct per-request sampling configs admitted so far:
         # sampling is a compiled-program parameter, so an unbounded
         # variety would compile (and cache) unboundedly many programs.
@@ -865,9 +1433,48 @@ class _Server:
         # and the whole server replays exactly given the same request
         # arrival order and TPUFW_SEED. Only the batcher thread runs
         # _run_tick, so the counter needs no lock. Greedy decode ignores
-        # the rng entirely, so default traffic is unaffected.
+        # the rng entirely, so default traffic is unaffected. (The slot
+        # scheduler keeps the same replay contract with its own pair of
+        # namespaced monotonic streams.)
         self._seed_base = env_int("seed", 0)
         self._tick_index = 0
+        # Optional serving telemetry (TPUFW_TELEMETRY_DIR): the shared
+        # event log plus a scheduler span trace. The trace buffer is
+        # capped — a server runs indefinitely and the interesting spans
+        # (compiles, first admissions) are at the head.
+        self._events = obs_events.NULL
+        self._tracer: object = obs_trace.NULL
+        tdir = env_str("telemetry_dir", "")
+        if tdir:
+            import atexit
+
+            self._events = obs_events.EventLog(obs_events.log_path(tdir))
+            self._tracer = obs_trace.Tracer(
+                os.path.join(tdir, "trace-serve.json"),
+                process_name="serve",
+                max_events=100_000,
+            )
+            atexit.register(self._tracer.close)
+            atexit.register(self._events.close)
+        # Scheduler backend: the slot scheduler (decode-step-granular
+        # continuous batching) is the default; TPUFW_SERVE_SLOTS=0 opts
+        # back into the tick batcher, and the speculative path still
+        # ticks (its verify loop has no per-row chunk form yet).
+        if env_int("serve_slots", 8) > 0 and self._draft is None:
+            self._batcher = _SlotScheduler(
+                self.model,
+                self.params,
+                eos_id=self._eos_id,
+                default_sampling=self._sampling,
+                metrics=self.metrics,
+                seed_base=self._seed_base,
+                events=self._events,
+                tracer=self._tracer,
+            )
+        else:
+            self._batcher = _Batcher(
+                self._run_tick, self.metrics, run_stream=self._run_stream
+            )
         if env_int("warmup", 1):
             self._warmup()
 
@@ -896,6 +1503,32 @@ class _Server:
         import sys
 
         run_new = _pow2_ceil(self.default_new)
+        if isinstance(self._batcher, _SlotScheduler):
+            # Slot mode: the pool batch is ALWAYS n_slots, so there is
+            # no batch-bucket ladder to walk — one tiny request
+            # compiles the whole serving path (prefill + insert +
+            # decode chunks, including the shrinking tail-k programs)
+            # and leaves the default pool warm. The counters it moved
+            # and the rng-stream indices are restored so warmup stays
+            # invisible to scrapes and to seed replay.
+            try:
+                self._batcher.submit([[1]], self.default_new, None)
+            except Exception as e:  # noqa: BLE001
+                print(f"serve: warmup skipped: {e}", file=sys.stderr)
+            finally:
+                self._batcher.reset_after_warmup()
+                self.metrics.reset(
+                    "ticks_total",
+                    "tick_rows_total",
+                    "tokens_generated_total",
+                    "retired_rows_total",
+                    "wasted_slot_steps_total",
+                    "pool_switches_total",
+                )
+                self.metrics.registry.histogram(
+                    "tpufw_serve_join_latency_seconds"
+                ).reset()
+            return
         tick0 = self._tick_index
         try:
             # Parse inside the try: a malformed env value must degrade
@@ -959,6 +1592,20 @@ class _Server:
             self._codec = text_codec()
         return self._codec
 
+    def _gauge_values(self) -> dict:
+        """Point-in-time gauges for /metrics — one source of truth in
+        the scheduler, refreshed at scrape time. Slot mode adds the
+        occupancy pair (occupied/total IS the continuous-batching
+        utilization a dashboard divides)."""
+        g = {
+            "queue_depth": float(self._batcher.queue_depth),
+            "uptime_seconds": time.time() - _T0,
+        }
+        if isinstance(self._batcher, _SlotScheduler):
+            g["slots_occupied"] = float(self._batcher.slots_occupied)
+            g["slots_total"] = float(self._batcher.slots_total)
+        return g
+
     def _run_tick(
         self, prompts: list[list[int]], max_new: int, sampling=None
     ):
@@ -975,7 +1622,7 @@ class _Server:
         them, and the repetition penalty's seen-set never counts them
         (literal [0]*k prefixes would look like real tokens).
         """
-        sampling, seed, padded, real_n, model = self._tick_prep(
+        sampling, seed, padded, real_n, live, model = self._tick_prep(
             prompts, max_new, sampling
         )
         if self._draft is not None:
@@ -1003,7 +1650,7 @@ class _Server:
                 # Filler rows (pow-2 + length bucket) must not drag the
                 # batch-min acceptance to zero; their outputs are
                 # sliced off below anyway.
-                live_rows=[i < real_n for i in range(len(padded))],
+                live_rows=live,
                 sampling=sampling,
                 seed=seed,
                 prefill_chunk_size=env_int("prefill_chunk", 0) or None,
@@ -1026,6 +1673,7 @@ class _Server:
             sampling=sampling,
             seed=seed,
             eos_id=self._eos_id,
+            live_rows=live,
             prefill_chunk_size=env_int("prefill_chunk", 0) or None,
         )
         return outs[:real_n]
@@ -1035,17 +1683,20 @@ class _Server:
         and streaming paths: env-default sampling resolution, the
         monotonic tick seed (batcher thread only — no lock), prompt
         length bucketing with the filler row, and the request-sized
-        cache variant. Returns (sampling, seed, padded, real_n,
-        model)."""
+        cache variant. Returns (sampling, seed, padded, real_n, live,
+        model) — ``live`` masks the pow-2 fillers AND the length-bucket
+        row so generate's done-mask freezes them at step 1."""
         if sampling is None:
             sampling = self._sampling
         seed = self._seed_base + self._tick_index
         self._tick_index += 1
         longest = _bucket(max(len(p) for p in prompts), 64)
-        padded, real_n = _pad_batch(prompts)
-        padded = padded + [[0] * longest]  # length-bucket filler row
+        fill = self._eos_id if self._eos_id is not None else 0
+        padded, real_n = _pad_batch(prompts, fill)
+        padded = padded + [[fill] * longest]  # length-bucket filler row
+        live = [i < real_n for i in range(len(padded))]
         model = self._model_for(longest, max_new)
-        return sampling, seed, padded, real_n, model
+        return sampling, seed, padded, real_n, live, model
 
     def _run_stream(self, pend) -> None:
         """Streaming tick (batcher thread only): the ``_tick_prep``
@@ -1063,7 +1714,7 @@ class _Server:
         run_new = 1
         while run_new < pend.max_new:
             run_new *= 2
-        sampling, seed, padded, real_n, model = self._tick_prep(
+        sampling, seed, padded, real_n, live, model = self._tick_prep(
             pend.prompts, run_new, pend.sampling
         )
         emitted = 0  # live rows advance in lockstep; eos rows yield []
@@ -1077,6 +1728,7 @@ class _Server:
             sampling=sampling,
             seed=seed,
             eos_id=self._eos_id,
+            live_rows=live,
             prefill_chunk_size=env_int("prefill_chunk", 0) or None,
         ):
             budget = pend.max_new - emitted
@@ -1145,12 +1797,9 @@ class _Server:
                 elif self.path == "/metrics":
                     # Prometheus text exposition — same scrape contract
                     # as the device plugin's shim endpoint.
-                    body = outer.metrics.render({
-                        "queue_depth": float(
-                            outer._batcher.queue_depth
-                        ),
-                        "uptime_seconds": time.time() - _T0,
-                    }).encode()
+                    body = outer.metrics.render(
+                        outer._gauge_values()
+                    ).encode()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type",
