@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Crash-bundle smoke test: SIGTERM a live training run mid-step and
+assert the flight recorder leaves a complete, parseable crash bundle.
+
+Launches ``tpufw.workloads.train_llama`` as a subprocess with full
+telemetry on, waits until the events log proves the loop is actually
+stepping, sends SIGTERM, and then checks the telemetry dir for:
+
+- ``crash-bundle-p0/manifest.json`` that parses, lists ``sigterm``
+  among its reasons, and names only files that actually exist
+  (the manifest is written last via rename, so parseable == complete);
+- ``ring.jsonl`` inside the bundle that the torn-tail-tolerant event
+  reader can digest;
+- a ``goodput.json`` rollup whose categories sum to its wall-clock
+  (the graceful-preemption path still closes telemetry cleanly).
+
+Exit 0 on success, 1 with a diagnostic on any miss — CI runs this
+after the plain obs-smoke pass and uploads the dir either way.
+
+Usage: python scripts/crash_smoke.py [telemetry_dir]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpufw.obs.events import read_events
+from tpufw.workloads.env import env_str
+
+STEP_WAIT_S = 300.0  # compile on a cold CI box dominates this
+EXIT_WAIT_S = 120.0
+
+
+def fail(msg: str) -> int:
+    print(f"crash_smoke: FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def wait_for_step(events_path: str, proc) -> bool:
+    """Poll until the run emits its first step event (the loop is
+    live, so the SIGTERM lands genuinely mid-run)."""
+    deadline = time.time() + STEP_WAIT_S
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            return False
+        if os.path.exists(events_path):
+            try:
+                if any(
+                    e.get("kind") == "step"
+                    for e in read_events(events_path)
+                ):
+                    return True
+            except OSError:
+                pass
+        time.sleep(0.5)
+    return False
+
+
+def main() -> int:
+    tdir = (
+        sys.argv[1]
+        if len(sys.argv) > 1
+        else env_str("telemetry_dir", "/tmp/telemetry-crash")
+    )
+    env = dict(os.environ)
+    env["TPUFW_TELEMETRY_DIR"] = tdir
+    # Force a long run (overriding any ambient smoke config): the
+    # whole point is interrupting it mid-flight.
+    env["TPUFW_TOTAL_STEPS"] = "500"
+    env["TPUFW_SYNC_EVERY"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpufw.workloads.train_llama"], env=env
+    )
+    events_path = os.path.join(tdir, "events.jsonl")
+    try:
+        if not wait_for_step(events_path, proc):
+            return fail(
+                f"no step event within {STEP_WAIT_S}s "
+                f"(exit={proc.poll()})"
+            )
+        print(f"crash_smoke: run is stepping (pid {proc.pid}); SIGTERM")
+        proc.send_signal(signal.SIGTERM)
+        try:
+            code = proc.wait(timeout=EXIT_WAIT_S)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            return fail(f"run did not exit within {EXIT_WAIT_S}s of SIGTERM")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    print(f"crash_smoke: run exited with code {code}")
+
+    bundle = os.path.join(tdir, "crash-bundle-p0")
+    manifest_path = os.path.join(bundle, "manifest.json")
+    try:
+        with open(manifest_path, encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return fail(f"no parseable manifest at {manifest_path}: {e}")
+    if "sigterm" not in manifest.get("reasons", []):
+        return fail(f"manifest reasons lack 'sigterm': {manifest}")
+    missing = [
+        name
+        for name in manifest.get("files", [])
+        if not os.path.exists(os.path.join(bundle, name))
+    ]
+    if missing:
+        return fail(f"manifest names missing files: {missing}")
+    for required in ("ring.jsonl", "stacks.txt", "env.json"):
+        if required not in manifest.get("files", []):
+            return fail(f"bundle lacks {required}: {manifest['files']}")
+    ring = read_events(os.path.join(bundle, "ring.jsonl"))
+    if not ring:
+        return fail("bundle ring.jsonl parsed to zero events")
+
+    gp_path = os.path.join(tdir, "goodput.json")
+    try:
+        with open(gp_path, encoding="utf-8") as f:
+            gp = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return fail(f"no parseable goodput rollup at {gp_path}: {e}")
+    wall = gp.get("wall_s", 0.0)
+    total = sum(gp.get("categories", {}).values())
+    if wall <= 0 or abs(total - wall) > 0.02 * wall:
+        return fail(
+            f"goodput categories sum {total:.3f}s vs wall {wall:.3f}s "
+            "(beyond 2%)"
+        )
+    print(
+        f"crash_smoke: OK — bundle complete ({len(manifest['files'])} "
+        f"files, {len(ring)} ring events), goodput sums to wall "
+        f"({total:.2f}s / {wall:.2f}s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,12 +1,18 @@
 #!/usr/bin/env python
 """One-screen digest of a tpufw telemetry dir (TPUFW_TELEMETRY_DIR).
 
-Reads the three artifacts the unified telemetry subsystem writes —
-events*.jsonl, trace*.json, metrics.prom — and prints the run at a
-glance: step/loss trajectory, event-kind counts, straggler incidents,
-where the wall-clock went by span, and the headline counters. CI runs
-it over the smoke run's artifact so a failed run is diagnosable from
-the job log alone.
+Reads the artifacts the unified telemetry subsystem writes —
+events*.jsonl, trace*.json, metrics.prom, goodput*.json, crash
+bundles, hang dumps — and prints the run at a glance: step/loss
+trajectory, event-kind counts, straggler incidents, where the
+wall-clock went by span and by goodput category, headline counters,
+and whatever evidence an abnormal exit left behind. CI runs it over
+the smoke run's artifact so a failed run is diagnosable from the job
+log alone.
+
+Crashed runs are exactly when this script gets used, so every reader
+degrades gracefully: a missing, torn, or half-written file prints a
+one-line note instead of a traceback.
 
 Usage:  python scripts/obs_summary.py <telemetry_dir>
 """
@@ -30,33 +36,56 @@ def _fmt_s(seconds: float) -> str:
     return f"{seconds * 1e3:.1f}ms"
 
 
+def _load_json(path: str):
+    """Parse a JSON file, or None on any miss/tear — a SIGKILLed
+    writer leaves half a trace.json and this script must still run."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
+
+
 def summarize_events(paths: list[str]) -> None:
     events = []
     for p in paths:
-        events.extend(read_events(p))
+        try:
+            events.extend(read_events(p))
+        except OSError:
+            print(f"  (unreadable: {os.path.basename(p)})")
     if not events:
         print("  (no events)")
         return
-    kinds = collections.Counter(e["kind"] for e in events)
+    kinds = collections.Counter(e.get("kind", "?") for e in events)
     print("  kinds: " + ", ".join(f"{k}={n}" for k, n in sorted(kinds.items())))
-    steps = [e for e in events if e["kind"] == "step"]
+    steps = [e for e in events if e.get("kind") == "step"]
     if steps:
         first, last = steps[0], steps[-1]
-        print(
-            f"  steps {first['step']}..{last['step']}: "
-            f"loss {first['loss']:.4f} -> {last['loss']:.4f}, "
-            f"last step_time {_fmt_s(last['step_time_s'])} "
-            f"(data_wait {_fmt_s(last['data_wait_s'])})"
-        )
-    for ev in events:
-        if ev["kind"] == "straggler_detected":
+        try:
             print(
-                f"  STRAGGLER step {ev['step']}: hosts "
-                f"{ev['straggler_hosts']} vs median "
-                f"{_fmt_s(ev['median_s'])} (factor {ev['factor']})"
+                f"  steps {first['step']}..{last['step']}: "
+                f"loss {first['loss']:.4f} -> {last['loss']:.4f}, "
+                f"last step_time {_fmt_s(last['step_time_s'])} "
+                f"(data_wait {_fmt_s(last['data_wait_s'])})"
             )
-        elif ev["kind"] in ("preemption_signal", "preemption_stop"):
+        except (KeyError, TypeError, ValueError):
+            print(f"  {len(steps)} step event(s) (malformed fields)")
+    for ev in events:
+        if ev.get("kind") == "straggler_detected":
+            print(
+                f"  STRAGGLER step {ev.get('step')}: hosts "
+                f"{ev.get('straggler_hosts')} vs median "
+                f"{_fmt_s(ev.get('median_s', 0.0))} "
+                f"(factor {ev.get('factor')})"
+            )
+        elif ev.get("kind") in ("preemption_signal", "preemption_stop"):
             print(f"  PREEMPTION: {json.dumps(ev, sort_keys=True)}")
+        elif ev.get("kind") == "hang":
+            print(
+                f"  HANG: armed {_fmt_s(ev.get('armed_for_s', 0.0))} "
+                f"past a {_fmt_s(ev.get('timeout_s', 0.0))} timeout "
+                f"-> {ev.get('dump')}"
+            )
     errors = [e for e in events if e.get("level") == "error"]
     if errors:
         print(f"  {len(errors)} error-level event(s):")
@@ -68,16 +97,18 @@ def summarize_trace(paths: list[str]) -> None:
     totals: collections.Counter = collections.Counter()
     counts: collections.Counter = collections.Counter()
     for p in paths:
-        with open(p) as f:
-            doc = json.load(f)
+        doc = _load_json(p)
+        if doc is None:
+            print(f"  (torn/unreadable: {os.path.basename(p)})")
+            continue
         for ev in doc.get("traceEvents", []):
             if ev.get("ph") == "X":
-                totals[ev["name"]] += ev["dur"] / 1e6
+                totals[ev["name"]] += ev.get("dur", 0.0) / 1e6
                 counts[ev["name"]] += 1
     if not totals:
         print("  (no spans)")
         return
-    wall = sum(totals.values())
+    wall = sum(totals.values()) or 1.0
     for name, total in totals.most_common():
         print(
             f"  {name:<18} {_fmt_s(total):>9}  "
@@ -94,14 +125,104 @@ def summarize_metrics(path: str) -> None:
         "tpufw_train_stragglers_total",
         "tpufw_serve_requests_total",
         "tpufw_serve_request_errors_total",
+        "tpufw_goodput_ratio",
+        "tpufw_run_info",
     )
-    with open(path) as f:
-        for line in f:
-            if line.startswith("#"):
-                continue
-            name = line.split("{")[0].split(" ")[0]
-            if name in wanted:
-                print(f"  {line.rstrip()}")
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        print(f"  (unreadable: {os.path.basename(path)})")
+        return
+    for line in lines:
+        if line.startswith("#"):
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        if name in wanted:
+            print(f"  {line.rstrip()}")
+
+
+def summarize_goodput(paths: list[str]) -> None:
+    """Per-process goodput/badput breakdown from goodput*.json."""
+    any_printed = False
+    for p in paths:
+        doc = _load_json(p)
+        if doc is None:
+            print(f"  (torn/unreadable: {os.path.basename(p)})")
+            continue
+        wall = doc.get("wall_s", 0.0) or 0.0
+        cats = doc.get("categories", {})
+        print(
+            f"  {os.path.basename(p)}: wall {_fmt_s(wall)}, "
+            f"goodput {doc.get('goodput_ratio', 0.0):.1%}"
+        )
+        denom = wall or 1.0
+        for cat, secs in sorted(
+            cats.items(), key=lambda kv: -kv[1]
+        ):
+            print(f"    {cat:<12} {_fmt_s(secs):>9}  ({secs / denom:5.1%})")
+        if doc.get("replay_until_step"):
+            print(
+                f"    (restart replayed steps up to "
+                f"{doc['replay_until_step']})"
+            )
+        any_printed = True
+    if not any_printed and not paths:
+        print("  (no goodput rollup)")
+
+
+def summarize_crash_bundles(out: str) -> None:
+    """Crash-bundle + hang-dump evidence, if any. The manifest is
+    written last (atomic rename), so a parseable manifest means a
+    complete bundle."""
+    bundles = sorted(glob.glob(os.path.join(out, "crash-bundle-p*")))
+    hangs = sorted(glob.glob(os.path.join(out, "hang-p*.json")))
+    faults = [
+        p
+        for p in sorted(glob.glob(os.path.join(out, "fault-p*.log")))
+        if os.path.getsize(p) > 0
+    ]
+    if not bundles and not hangs and not faults:
+        return
+    print("-- run-health evidence --")
+    for b in bundles:
+        manifest = _load_json(os.path.join(b, "manifest.json"))
+        if manifest is None:
+            print(
+                f"  {os.path.basename(b)}: INCOMPLETE "
+                "(no parseable manifest — writer died mid-flush)"
+            )
+            continue
+        print(
+            f"  {os.path.basename(b)}: reasons="
+            f"{','.join(manifest.get('reasons', []))} "
+            f"files={len(manifest.get('files', []))} "
+            f"pid={manifest.get('pid')}"
+        )
+        ring = os.path.join(b, "ring.jsonl")
+        if os.path.exists(ring):
+            try:
+                tail = read_events(ring)[-3:]
+            except OSError:
+                tail = []
+            for ev in tail:
+                print(f"    last: {json.dumps(ev, sort_keys=True)[:120]}")
+    for h in hangs:
+        doc = _load_json(h)
+        if doc is None:
+            print(f"  {os.path.basename(h)}: (torn)")
+            continue
+        print(
+            f"  {os.path.basename(h)}: armed "
+            f"{_fmt_s(doc.get('armed_for_s', 0.0))} past "
+            f"{_fmt_s(doc.get('timeout_s', 0.0))} timeout "
+            f"({len(doc.get('recent_events', []))} ring events attached)"
+        )
+    for p in faults:
+        print(
+            f"  {os.path.basename(p)}: non-empty faulthandler log "
+            "(C-level fault — SIGSEGV/SIGBUS evidence)"
+        )
 
 
 def main(argv: list[str]) -> int:
@@ -117,10 +238,15 @@ def main(argv: list[str]) -> int:
     summarize_events(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
     print("-- spans (total time) --")
     summarize_trace(sorted(glob.glob(os.path.join(out, "trace*.json"))))
+    gp = sorted(glob.glob(os.path.join(out, "goodput*.json")))
+    if gp:
+        print("-- goodput/badput --")
+        summarize_goodput(gp)
     prom = os.path.join(out, "metrics.prom")
     if os.path.exists(prom):
         print("-- metrics snapshot --")
         summarize_metrics(prom)
+    summarize_crash_bundles(out)
     return 0
 
 

@@ -1,0 +1,212 @@
+"""Goodput/badput ledger (tpufw.obs.goodput): span->category
+attribution, idle-as-remainder rollup, restart-replay reclassification,
+metric publication with forward-only counter deltas, and tolerance of
+a torn prior events file."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpufw.obs import events as events_mod
+from tpufw.obs import goodput as goodput_mod
+from tpufw.obs import trace as trace_mod
+from tpufw.obs.goodput import GoodputLedger
+from tpufw.obs.registry import Registry
+
+
+def test_span_listener_maps_to_categories(tmp_path):
+    """Spans completed on a real Tracer land in the ledger via the
+    listener hook, through the TRAIN name->category table."""
+    ledger = GoodputLedger()
+    tracer = trace_mod.Tracer(str(tmp_path / "trace.json"))
+    tracer.listeners.append(ledger.on_span)
+    with tracer.span("tune"):
+        time.sleep(0.01)
+    with tracer.span("step_dispatch"):
+        time.sleep(0.01)
+    with tracer.span("host_sync"):
+        pass
+    with tracer.span("not_a_loop_span"):  # unmapped: ignored
+        pass
+    tracer.close()
+    roll = ledger.rollup()
+    cats = roll["categories"]
+    assert cats["compile"] > 0
+    assert cats["productive"] > 0
+    assert "not_a_loop_span" not in cats
+    assert roll["goodput_ratio"] > 0
+
+
+def test_rollup_categories_sum_to_wall_exactly():
+    """idle absorbs the unattributed remainder, so the categories sum
+    to wall_s by construction — the invariant the CI smoke's 2% check
+    rides on."""
+    ledger = GoodputLedger()
+    time.sleep(0.03)  # attribution must stay below real elapsed wall
+    ledger.add("productive", 0.01)
+    ledger.add("checkpoint", 0.005)
+    roll = ledger.rollup()
+    # abs tolerance: rollup rounds each category to 6 decimals.
+    assert sum(roll["categories"].values()) == (
+        pytest.approx(roll["wall_s"], abs=1e-4)
+    )
+    assert roll["categories"]["idle"] > 0
+
+
+def test_over_attribution_floors_idle_at_zero():
+    ledger = GoodputLedger()
+    ledger.add("productive", 1e6)  # absurd: more than wall
+    roll = ledger.rollup()
+    assert roll["categories"]["idle"] == 0.0
+
+
+def test_replay_reclassifies_productive_until_high_water(tmp_path):
+    """A restart that resumes behind the previous run's max step books
+    productive time as replay until it passes the high-water mark."""
+    prior = tmp_path / "events.jsonl"
+    log = events_mod.EventLog(str(prior))
+    for s in (1, 2, 3, 10):
+        log.emit("step", step=s, loss=1.0, step_time_s=0.1, data_wait_s=0.0)
+    log.close()
+    ledger = GoodputLedger(prior_events_path=str(prior))
+    # Resumed from the step-4 checkpoint: everything to step 10 is
+    # re-paid work.
+    ledger.on_event({"kind": "run_start", "start_step": 4})
+    ledger.on_span("step_dispatch", 0.5)
+    ledger.on_event(
+        {"kind": "step", "step": 9, "loss": 1.0}
+    )
+    ledger.on_span("step_dispatch", 0.5)  # still behind: replay
+    ledger.on_event({"kind": "step", "step": 10, "loss": 1.0})
+    ledger.on_span("step_dispatch", 0.25)  # caught up: productive
+    roll = ledger.rollup()
+    assert roll["categories"]["replay"] == 1.0
+    assert roll["categories"]["productive"] == 0.25
+    assert roll["replay_until_step"] == 10
+
+
+def test_fresh_run_in_reused_dir_replays_nothing(tmp_path):
+    """start_step == 0 means a NEW run reusing the telemetry dir, not
+    a restart — its steps are first-time work even though an older
+    run's events show a higher step."""
+    prior = tmp_path / "events.jsonl"
+    log = events_mod.EventLog(str(prior))
+    log.emit("step", step=50, loss=1.0, step_time_s=0.1, data_wait_s=0.0)
+    log.close()
+    ledger = GoodputLedger(prior_events_path=str(prior))
+    ledger.on_event({"kind": "run_start", "start_step": 0})
+    ledger.on_span("step_dispatch", 0.5)
+    assert ledger.rollup()["categories"]["productive"] == 0.5
+    assert ledger.rollup()["replay_until_step"] == 0
+
+
+def test_graceful_resume_at_high_water_replays_nothing(tmp_path):
+    prior = tmp_path / "events.jsonl"
+    log = events_mod.EventLog(str(prior))
+    log.emit("step", step=7, loss=1.0, step_time_s=0.1, data_wait_s=0.0)
+    log.close()
+    ledger = GoodputLedger(prior_events_path=str(prior))
+    # Preemption checkpointed at the stop step: resume == high water.
+    ledger.on_event({"kind": "run_start", "start_step": 7})
+    ledger.on_span("step_dispatch", 0.5)
+    assert ledger.rollup()["categories"]["productive"] == 0.5
+
+
+def test_torn_prior_events_file_tolerated(tmp_path):
+    prior = tmp_path / "events.jsonl"
+    prior.write_text(
+        '{"kind": "step", "step": 5, "loss": 1.0}\n{"kind": "st'
+    )
+    ledger = GoodputLedger(prior_events_path=str(prior))
+    assert ledger._prior_max == 5  # the parseable line still counts
+    ledger2 = GoodputLedger(
+        prior_events_path=str(tmp_path / "does-not-exist.jsonl")
+    )
+    assert ledger2._prior_max == 0
+
+
+def test_publish_sets_gauge_and_badput_counters():
+    reg = Registry()
+    ledger = GoodputLedger(registry=reg)
+    ledger.add("productive", 3.0)
+    ledger.add("checkpoint", 1.0)
+    ledger.publish()
+    text = reg.render()
+    assert "tpufw_goodput_ratio " in text
+    assert 'tpufw_badput_seconds_total{category="checkpoint"} 1' in text
+    # Productive categories are goodput, not badput.
+    assert 'category="productive"' not in text
+
+
+def test_publish_deltas_never_decrease_counters():
+    """Counters only move forward: idle shrinks retroactively when a
+    long span closes, so its per-publish delta clamps at 0."""
+    reg = Registry()
+    ledger = GoodputLedger(registry=reg)
+    time.sleep(0.05)
+    ledger.publish()  # everything so far is idle
+    idle1 = reg.counter("tpufw_badput_seconds_total").value(category="idle")
+    assert idle1 > 0
+    # A span covering (more than) the whole run closes: idle collapses.
+    ledger.add("productive", 10.0)
+    ledger.publish()
+    idle2 = reg.counter("tpufw_badput_seconds_total").value(category="idle")
+    assert idle2 == idle1  # clamped, not decremented
+
+
+def test_close_writes_rollup_and_emits_schema_valid_event(tmp_path):
+    out = tmp_path / "goodput.json"
+    elog_path = str(tmp_path / "events.jsonl")
+    log = events_mod.EventLog(elog_path)
+    ledger = GoodputLedger(events=log, out_path=str(out))
+    time.sleep(0.02)  # keep attribution below real elapsed wall
+    ledger.add("productive", 0.01)
+    roll = ledger.close()
+    log.close()
+    doc = json.loads(out.read_text())
+    assert doc["categories"] == roll["categories"]
+    assert sum(doc["categories"].values()) == (
+        pytest.approx(doc["wall_s"], abs=1e-4)
+    )
+    events = events_mod.read_events(elog_path)
+    assert [e["kind"] for e in events] == ["goodput"]
+    events_mod.validate(events[0])
+    assert events[0]["goodput_ratio"] == roll["goodput_ratio"]
+    # Idempotent: a second close neither re-emits nor re-books.
+    ledger.close()
+    ledger.add("productive", 99.0)
+    assert ledger.rollup()["categories"].get("productive") == 0.01
+
+
+def test_serve_tables_split_busy_from_wasted():
+    ledger = GoodputLedger(
+        span_categories=goodput_mod.SERVE_SPAN_CATEGORIES,
+        productive=goodput_mod.SERVE_PRODUCTIVE,
+    )
+    ledger.on_span("serve_prefill", 0.2)
+    ledger.on_span("serve_admit", 5.0)  # unmapped: would double-count
+    ledger.add("busy", 0.3)
+    ledger.add("wasted_slot", 0.1)
+    cats = ledger.rollup()["categories"]
+    assert cats["busy"] == pytest.approx(0.5)
+    assert cats["wasted_slot"] == pytest.approx(0.1)
+
+
+def test_ledger_threadsafe_under_concurrent_attribution():
+    ledger = GoodputLedger()
+
+    def work():
+        for _ in range(500):
+            ledger.add("productive", 0.001)
+            ledger.on_event({"kind": "step", "step": 1, "loss": 1.0})
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ledger.rollup()["categories"]["productive"] == pytest.approx(
+        2.0, rel=1e-6
+    )

@@ -1,0 +1,285 @@
+"""Run-health primitives (tpufw.obs.health): hang-watchdog firing,
+heartbeat suppression on slow-but-progressing work, flight-recorder
+ring bounds, crash-bundle completeness, and hook chain semantics."""
+
+import json
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from tpufw.obs import events as events_mod
+from tpufw.obs import trace as trace_mod
+from tpufw.obs.health import (
+    FlightRecorder,
+    HangWatchdog,
+    NullHangWatchdog,
+    env_snapshot,
+    format_thread_stacks,
+)
+from tpufw.obs.registry import Registry
+
+
+def _wait_until(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# ---------------------------------------------------------------- watchdog
+
+
+def test_watchdog_fires_once_per_stall_with_dump_and_event(tmp_path):
+    log = events_mod.EventLog(str(tmp_path / "events.jsonl"))
+    recorder = FlightRecorder(str(tmp_path))
+    log.listeners.append(recorder.on_event)
+    wd = HangWatchdog(
+        0.1, str(tmp_path), tracer=trace_mod.Tracer(
+            str(tmp_path / "trace.json")
+        ), events=log, recorder=recorder,
+    )
+    try:
+        wd.arm()
+        assert _wait_until(lambda: wd.fired == 1)
+        # One dump per stall: stays disarmed until the next arm().
+        time.sleep(0.25)
+        assert wd.fired == 1
+    finally:
+        wd.stop()
+        log.close()
+    dump_path = tmp_path / "hang-p0-1.json"
+    doc = json.loads(dump_path.read_text())
+    assert doc["timeout_s"] == 0.1
+    assert doc["armed_for_s"] >= 0.1
+    # The dump names every thread, including the watchdog itself.
+    assert "tpufw-watchdog" in doc["stacks"]
+    events = events_mod.read_events(str(tmp_path / "events.jsonl"))
+    hangs = [e for e in events if e["kind"] == "hang"]
+    assert len(hangs) == 1
+    events_mod.validate(hangs[0])
+    assert hangs[0]["level"] == "error"
+    assert hangs[0]["dump"] == str(dump_path)
+    # The hang event itself reached the recorder's ring via the
+    # listener — the bundle would carry its own diagnosis.
+    assert any(e["kind"] == "hang" for e in recorder.ring_tail())
+
+
+def test_watchdog_beat_suppresses_slow_but_progressing_step(tmp_path):
+    """The false-positive criterion: a phase that is slower than the
+    timeout in TOTAL but heartbeats within it must never fire."""
+    wd = HangWatchdog(0.15, str(tmp_path))
+    try:
+        wd.arm()
+        for _ in range(6):  # 0.3s total: 2x the timeout, but alive
+            time.sleep(0.05)
+            wd.beat()
+        wd.disarm()
+        time.sleep(0.2)
+        assert wd.fired == 0
+    finally:
+        wd.stop()
+    assert not list(tmp_path.glob("hang-*.json"))
+
+
+def test_watchdog_disarm_prevents_firing(tmp_path):
+    wd = HangWatchdog(0.1, str(tmp_path))
+    try:
+        wd.arm()
+        wd.disarm()
+        time.sleep(0.25)
+        assert wd.fired == 0
+    finally:
+        wd.stop()
+
+
+def test_watchdog_rearm_after_fire_reprotects(tmp_path):
+    wd = HangWatchdog(0.08, str(tmp_path))
+    try:
+        wd.arm()
+        assert _wait_until(lambda: wd.fired == 1)
+        wd.arm()  # recovery: the next stall must dump again
+        assert _wait_until(lambda: wd.fired == 2)
+    finally:
+        wd.stop()
+    assert (tmp_path / "hang-p0-1.json").exists()
+    assert (tmp_path / "hang-p0-2.json").exists()
+
+
+def test_watchdog_beat_while_disarmed_is_noop(tmp_path):
+    wd = HangWatchdog(0.05, str(tmp_path))
+    try:
+        wd.beat()  # must NOT arm
+        time.sleep(0.15)
+        assert wd.fired == 0
+    finally:
+        wd.stop()
+
+
+def test_watchdog_rejects_nonpositive_timeout(tmp_path):
+    with pytest.raises(ValueError):
+        HangWatchdog(0.0, str(tmp_path))
+    null = NullHangWatchdog()
+    null.arm()
+    null.beat()
+    null.disarm()
+    null.stop()
+    assert null.fired == 0 and not null.enabled
+
+
+# ---------------------------------------------------------------- recorder
+
+
+def test_recorder_ring_is_bounded():
+    rec = FlightRecorder("/tmp/unused", ring_size=4)
+    for i in range(10):
+        rec.on_event({"kind": "step", "step": i})
+    tail = rec.ring_tail()
+    assert [e["step"] for e in tail] == [6, 7, 8, 9]
+    assert [e["step"] for e in rec.ring_tail(2)] == [8, 9]
+
+
+def test_flush_writes_complete_bundle_manifest_last(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUFW_HANG_TIMEOUT_S", "7")
+    reg = Registry()
+    reg.counter("tpufw_train_steps_total").inc(3)
+    rec = FlightRecorder(str(tmp_path), ring_size=8, registry=reg)
+    rec.on_event({"kind": "step", "step": 1})
+    rec.record_config({"trainer": {"batch_size": 8}})
+    bundle = rec.flush("test")
+    assert bundle == str(tmp_path / "crash-bundle-p0")
+    manifest = json.loads(
+        (tmp_path / "crash-bundle-p0" / "manifest.json").read_text()
+    )
+    assert manifest["reasons"] == ["test"]
+    assert manifest["pid"] == os.getpid()
+    for name in ("ring.jsonl", "stacks.txt", "config.json", "env.json",
+                 "metrics.prom"):
+        assert name in manifest["files"]
+        assert (tmp_path / "crash-bundle-p0" / name).exists()
+    ring = events_mod.read_events(
+        str(tmp_path / "crash-bundle-p0" / "ring.jsonl")
+    )
+    assert [e["step"] for e in ring] == [1]
+    config = json.loads(
+        (tmp_path / "crash-bundle-p0" / "config.json").read_text()
+    )
+    assert config["trainer"]["batch_size"] == 8
+    env = json.loads(
+        (tmp_path / "crash-bundle-p0" / "env.json").read_text()
+    )
+    assert env["TPUFW_HANG_TIMEOUT_S"] == "7"
+    prom = (tmp_path / "crash-bundle-p0" / "metrics.prom").read_text()
+    assert "tpufw_train_steps_total 3" in prom
+    # A second trigger rewrites in place and appends the reason.
+    rec.flush("again")
+    manifest = json.loads(
+        (tmp_path / "crash-bundle-p0" / "manifest.json").read_text()
+    )
+    assert manifest["reasons"] == ["test", "again"]
+
+
+def test_excepthook_flushes_bundle_and_chains(tmp_path):
+    rec = FlightRecorder(str(tmp_path))
+    seen = {}
+    orig = sys.excepthook
+
+    def stub(*a):
+        seen.setdefault("args", a)
+
+    sys.excepthook = stub
+    try:
+        rec.install()
+        try:
+            raise RuntimeError("boom for the recorder")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+        assert seen["args"][0] is RuntimeError  # chained to ours
+    finally:
+        rec.uninstall()
+        assert sys.excepthook is stub  # uninstall restored the chain
+        sys.excepthook = orig
+    exc = (tmp_path / "crash-bundle-p0" / "exception.txt").read_text()
+    assert "boom for the recorder" in exc
+    manifest = json.loads(
+        (tmp_path / "crash-bundle-p0" / "manifest.json").read_text()
+    )
+    assert manifest["reasons"] == ["exception"]
+    assert "exception.txt" in manifest["files"]
+
+
+def test_sigterm_handler_flushes_then_chains_to_callable(tmp_path):
+    """Trainer policy: GracefulShutdown installed a callable before the
+    recorder's slot was taken over — the handler must flush the bundle
+    AND hand the signal on (the grace-window checkpoint depends on it),
+    never terminate."""
+    rec = FlightRecorder(str(tmp_path), terminate_on_sigterm=False)
+    chained = []
+    rec._prev_sigterm = lambda signum, frame: chained.append(signum)
+    rec._on_sigterm(signal.SIGTERM, None)
+    assert chained == [signal.SIGTERM]
+    manifest = json.loads(
+        (tmp_path / "crash-bundle-p0" / "manifest.json").read_text()
+    )
+    assert manifest["reasons"] == ["sigterm"]
+
+
+def test_sigterm_handler_without_terminate_policy_survives(tmp_path):
+    """With no prior handler and terminate_on_sigterm=False the flush
+    happens and the process lives — the caller owns the exit."""
+    rec = FlightRecorder(str(tmp_path), terminate_on_sigterm=False)
+    rec._prev_sigterm = signal.SIG_DFL
+    rec._on_sigterm(signal.SIGTERM, None)  # must not os.kill us
+    assert (tmp_path / "crash-bundle-p0" / "manifest.json").exists()
+
+
+def test_install_uninstall_restores_sigterm_disposition(tmp_path):
+    prev = signal.getsignal(signal.SIGTERM)
+    rec = FlightRecorder(str(tmp_path))
+    rec.install()
+    try:
+        # == not is: a bound-method attribute access builds a fresh
+        # object each time (the very bug this test regression-guards).
+        assert signal.getsignal(signal.SIGTERM) == rec._on_sigterm
+    finally:
+        rec.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is prev
+    # Clean uninstall leaves no empty fault log behind.
+    assert not list(tmp_path.glob("fault-*.log"))
+
+
+def test_format_thread_stacks_names_threads_and_open_spans(tmp_path):
+    tracer = trace_mod.Tracer(str(tmp_path / "trace.json"))
+    with tracer.span("step_dispatch"):
+        text = format_thread_stacks(tracer)
+        assert "MainThread" in text
+        assert "step_dispatch" in text  # open span attributed
+    tracer.close()
+
+
+def test_env_snapshot_filters_to_relevant_keys(monkeypatch):
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("HOME_UNRELATED_SECRET", "nope")
+    snap = env_snapshot()
+    assert snap["TPUFW_MODEL"] == "llama3_tiny"
+    assert snap["JAX_PLATFORMS"] == "cpu"
+    assert "HOME_UNRELATED_SECRET" not in snap
+
+
+def test_hang_dump_attaches_recorder_ring(tmp_path):
+    rec = FlightRecorder(str(tmp_path), ring_size=4)
+    for i in range(6):
+        rec.on_event({"kind": "step", "step": i})
+    wd = HangWatchdog(0.05, str(tmp_path), recorder=rec)
+    try:
+        wd.arm()
+        assert _wait_until(lambda: wd.fired == 1)
+    finally:
+        wd.stop()
+    doc = json.loads((tmp_path / "hang-p0-1.json").read_text())
+    assert [e["step"] for e in doc["recent_events"]] == [2, 3, 4, 5]

@@ -204,6 +204,62 @@ def test_read_events_tolerates_torn_tail(tmp_path):
     assert len(events_mod.read_events(str(p))) == 1
 
 
+def test_read_events_during_concurrent_writer(tmp_path):
+    """The reader is used on LIVE files (obs_summary mid-run, the
+    goodput ledger's prior-run scan, crash_smoke's step poll), so it
+    must digest a file other threads are appending to — every event it
+    returns is well-formed, even with a writer mid-line."""
+    path = str(tmp_path / "e.jsonl")
+    log = events_mod.EventLog(path)
+    # Count-bounded writers: they must finish even when the reader
+    # never keeps up (3 writers outpace 1 reader under the GIL, so a
+    # reader-controlled stop flag would livelock).
+    n_per_writer = 400
+
+    def writer(tid):
+        for i in range(n_per_writer):
+            log.emit(
+                "step", step=i, loss=1.0, step_time_s=0.1,
+                data_wait_s=0.0, writer=tid,
+            )
+
+    threads = [
+        threading.Thread(target=writer, args=(t,)) for t in range(3)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        while True:
+            busy = any(t.is_alive() for t in threads)
+            for ev in events_mod.read_events(path):
+                events_mod.validate(ev)  # no half-parsed garbage
+            if not busy:
+                break
+    finally:
+        for t in threads:
+            t.join()
+    log.close()
+    total = len(events_mod.read_events(path))
+    assert total == 3 * n_per_writer  # every line intact
+    # Mid-line kill on top of the concurrent history: the reader
+    # still yields every complete line.
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"kind": "step", "st')
+    assert len(events_mod.read_events(path)) == total
+
+
+def test_event_listeners_observe_writes_and_never_raise(tmp_path):
+    path = str(tmp_path / "e.jsonl")
+    log = events_mod.EventLog(path)
+    seen = []
+    log.listeners.append(seen.append)
+    log.listeners.append(lambda ev: 1 / 0)  # must be swallowed
+    log.emit("run_start", workload="train")
+    log.close()
+    assert [e["kind"] for e in seen] == ["run_start"]
+    assert seen[0]["workload"] == "train"
+
+
 # ------------------------------------------------------------------- trace
 
 
@@ -372,7 +428,8 @@ def test_disabled_telemetry_per_step_overhead_below_1pct():
 
     One loop iteration's worth of disabled-telemetry calls (the
     data_fetch complete + step_dispatch/host_sync-shaped spans + a step
-    event + the skew guard) must cost well under 1% of a step. The
+    event + the skew guard + the watchdog arm/disarm pair + a goodput
+    add) must cost well under 1% of a step. The
     repo's smallest real steps are ~25 ms (llama3_tiny on the CPU
     mesh); 1% of that is 250 us. Budget 100 us per step — an order of
     magnitude above the measured no-op cost (~2-5 us), two orders
@@ -382,6 +439,7 @@ def test_disabled_telemetry_per_step_overhead_below_1pct():
     t0 = time.perf_counter()
     for _ in range(n):
         tel.tracer.complete("data_fetch", 0.001)
+        tel.watchdog.arm()
         with tel.tracer.span("step_dispatch"):
             pass
         with tel.tracer.span("host_sync"):
@@ -390,6 +448,8 @@ def test_disabled_telemetry_per_step_overhead_below_1pct():
             )
             if tel.skew is not None:
                 tel.skew.record(1, 0.1, 0.0)
+        tel.watchdog.disarm()
+        tel.goodput.add("productive", 0.001)
         with tel.tracer.span("eval"):
             pass
         with tel.tracer.span("checkpoint"):
@@ -461,6 +521,17 @@ def test_live_scrape_has_step_mfu_data_wait(telemetry_run):
     text = scraped["text"]
     assert "# TYPE tpufw_train_steps_total counter" in text
     assert "tpufw_train_mfu " in text
+    # Run identity published at startup: every scrape is joinable to a
+    # build/backend/mesh/model, not just the final snapshot.
+    info_lines = [
+        ln for ln in text.splitlines()
+        if ln.startswith("tpufw_run_info{")
+    ]
+    assert len(info_lines) == 1
+    assert 'backend="cpu"' in info_lines[0]
+    assert 'model="Llama"' in info_lines[0]
+    assert "jax_version=" in info_lines[0]
+    assert info_lines[0].endswith(" 1")
     assert "tpufw_train_data_wait_seconds_bucket" in text
     assert "tpufw_train_step_time_seconds_count" in text
     # At least the first sync window (step 1) had published.
@@ -479,7 +550,9 @@ def test_events_jsonl_schema_valid(telemetry_run):
         events_mod.validate(ev)
     kinds = [e["kind"] for e in events]
     assert kinds[0] == "run_start"
-    assert kinds[-1] == "run_end"
+    # run_end closes the run; the goodput rollup rides the telemetry
+    # close after it, as the final line.
+    assert kinds[-2:] == ["run_end", "goodput"]
     steps = [e for e in events if e["kind"] == "step"]
     assert len(steps) == len(history)
     assert steps[-1]["step"] == history[-1].step
@@ -490,6 +563,40 @@ def test_metrics_prom_snapshot_written(telemetry_run):
     _, _, out, _ = telemetry_run
     text = (out / "metrics.prom").read_text()
     assert "tpufw_train_steps_total 6" in text
+
+
+def test_goodput_rollup_accounts_for_wallclock(telemetry_run):
+    """Acceptance: the per-run goodput.json's categories sum to the
+    run's wall-clock within 2%, with real productive time booked from
+    the step spans, and the headline metrics land in the final
+    snapshot."""
+    _, _, out, _ = telemetry_run
+    gp = json.loads((out / "goodput.json").read_text())
+    wall = gp["wall_s"]
+    total = sum(gp["categories"].values())
+    assert wall > 0
+    assert abs(total - wall) <= 0.02 * wall
+    assert gp["categories"]["productive"] > 0
+    assert 0 < gp["goodput_ratio"] <= 1
+    assert gp["replay_until_step"] == 0  # fresh run: nothing replayed
+    text = (out / "metrics.prom").read_text()
+    assert "tpufw_goodput_ratio " in text
+    assert 'tpufw_badput_seconds_total{category="idle"}' in text
+    # The goodput event closed out the event log, schema-valid.
+    events = events_mod.read_events(str(out / "events.jsonl"))
+    goodputs = [e for e in events if e["kind"] == "goodput"]
+    assert len(goodputs) == 1
+    events_mod.validate(goodputs[0])
+    assert goodputs[0]["goodput_ratio"] == gp["goodput_ratio"]
+
+
+def test_crash_bundle_absent_on_clean_run(telemetry_run):
+    """A clean exit must not cry wolf: no bundle, no hang dumps, no
+    leftover empty fault log."""
+    _, _, out, _ = telemetry_run
+    assert not list(out.glob("crash-bundle-*"))
+    assert not list(out.glob("hang-*.json"))
+    assert not list(out.glob("fault-*.log"))
 
 
 def test_trace_spans_cover_step_loop_wallclock(telemetry_run):

@@ -1,0 +1,170 @@
+"""Direct unit tests for scripts/obs_summary.py's digest output.
+
+The script exists for post-mortems, so the tests center on degraded
+inputs: missing dirs, torn trace.json, absent metrics.prom, and
+half-written crash bundles must each yield a one-line note, never a
+traceback."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def summary():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+        "obs_summary.py",
+    )
+    spec = importlib.util.spec_from_file_location("obs_summary", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_events(path, events):
+    with open(path, "w", encoding="utf-8") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def test_main_full_healthy_dir(summary, tmp_path, capsys):
+    _write_events(
+        tmp_path / "events.jsonl",
+        [
+            {"kind": "run_start", "workload": "train"},
+            {
+                "kind": "step", "step": 1, "loss": 4.0,
+                "step_time_s": 0.5, "data_wait_s": 0.01,
+            },
+            {
+                "kind": "step", "step": 6, "loss": 2.0,
+                "step_time_s": 0.4, "data_wait_s": 0.02,
+            },
+            {"kind": "run_end", "steps": 6},
+        ],
+    )
+    (tmp_path / "trace.json").write_text(json.dumps({
+        "traceEvents": [
+            {"ph": "X", "name": "step_dispatch", "ts": 0, "dur": 2e6},
+            {"ph": "X", "name": "data_fetch", "ts": 0, "dur": 1e6},
+        ]
+    }))
+    (tmp_path / "metrics.prom").write_text(
+        "tpufw_train_steps_total 6\n"
+        'tpufw_run_info{backend="cpu"} 1\n'
+        "tpufw_goodput_ratio 0.91\n"
+        "tpufw_unrelated 1\n"
+    )
+    (tmp_path / "goodput.json").write_text(json.dumps({
+        "wall_s": 10.0,
+        "goodput_ratio": 0.8,
+        "categories": {"productive": 8.0, "compile": 1.5, "idle": 0.5},
+    }))
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "steps 1..6: loss 4.0000 -> 2.0000" in out
+    assert "step_dispatch" in out
+    assert "tpufw_goodput_ratio 0.91" in out
+    assert "tpufw_run_info" in out
+    assert "tpufw_unrelated" not in out
+    assert "-- goodput/badput --" in out
+    assert "goodput 80.0%" in out
+    assert "productive" in out and "compile" in out
+    # No crash evidence in a healthy dir.
+    assert "run-health evidence" not in out
+
+
+def test_missing_dir_is_an_error_not_a_traceback(summary, capsys):
+    assert summary.main(["obs_summary", "/no/such/dir"]) == 2
+    assert "no such dir" in capsys.readouterr().err
+
+
+def test_torn_trace_and_missing_metrics_degrade(summary, tmp_path, capsys):
+    _write_events(
+        tmp_path / "events.jsonl", [{"kind": "run_start", "workload": "t"}]
+    )
+    # SIGKILL mid-write: trace.json is half a JSON document.
+    (tmp_path / "trace.json").write_text('{"traceEvents": [{"ph": "X",')
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "(torn/unreadable: trace.json)" in out
+    assert "(no spans)" in out
+    assert "metrics snapshot" not in out  # absent file: section skipped
+
+
+def test_empty_dir_prints_placeholders(summary, tmp_path, capsys):
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "(no events)" in out
+    assert "(no spans)" in out
+
+
+def test_malformed_step_fields_noted_not_fatal(summary, tmp_path, capsys):
+    _write_events(
+        tmp_path / "events.jsonl",
+        [{"kind": "step", "step": 3, "loss": "NaN-ish"}],
+    )
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    assert "1 step event(s) (malformed fields)" in capsys.readouterr().out
+
+
+def test_hang_and_error_events_surface(summary, tmp_path, capsys):
+    _write_events(
+        tmp_path / "events.jsonl",
+        [
+            {
+                "kind": "hang", "level": "error", "timeout_s": 5.0,
+                "armed_for_s": 6.2, "dump": "hang-p0-1.json",
+            },
+        ],
+    )
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "HANG: armed 6.20s past a 5.00s timeout" in out
+    assert "1 error-level event(s)" in out
+
+
+def test_goodput_torn_rollup_noted(summary, tmp_path, capsys):
+    (tmp_path / "goodput.json").write_text('{"wall_s": 1.0, "categ')
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    assert "(torn/unreadable: goodput.json)" in capsys.readouterr().out
+
+
+def test_crash_bundle_summarized(summary, tmp_path, capsys):
+    bundle = tmp_path / "crash-bundle-p0"
+    bundle.mkdir()
+    _write_events(
+        bundle / "ring.jsonl",
+        [{"kind": "step", "step": i} for i in range(5)],
+    )
+    (bundle / "manifest.json").write_text(json.dumps({
+        "ts": 1.0, "pid": 1234, "process": 0,
+        "reasons": ["sigterm"], "files": ["ring.jsonl"],
+    }))
+    (tmp_path / "hang-p0-1.json").write_text(json.dumps({
+        "timeout_s": 5.0, "armed_for_s": 7.5,
+        "recent_events": [{"kind": "step", "step": 1}],
+    }))
+    (tmp_path / "fault-p0.log").write_text("Fatal Python error: Segfault\n")
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- run-health evidence --" in out
+    assert "crash-bundle-p0: reasons=sigterm files=1 pid=1234" in out
+    assert '"step": 4' in out  # ring tail shown
+    assert "hang-p0-1.json: armed 7.50s past 5.00s timeout" in out
+    assert "(1 ring events attached)" in out
+    assert "fault-p0.log: non-empty faulthandler log" in out
+
+
+def test_torn_manifest_marked_incomplete(summary, tmp_path, capsys):
+    bundle = tmp_path / "crash-bundle-p0"
+    bundle.mkdir()
+    (bundle / "manifest.json").write_text('{"reasons": ["sig')
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "crash-bundle-p0: INCOMPLETE" in out
+    assert "no parseable manifest" in out

@@ -30,11 +30,15 @@ import os
 from typing import Optional
 
 from tpufw.obs import events as events_mod
+from tpufw.obs import goodput as goodput_mod
 from tpufw.obs import trace as trace_mod
+from tpufw.obs.health import NULL_WATCHDOG, FlightRecorder, HangWatchdog
 from tpufw.obs.registry import Registry, start_http_server
 from tpufw.obs.skew import SkewMonitor
 
 __all__ = [
+    "FlightRecorder",
+    "HangWatchdog",
     "Registry",
     "SkewMonitor",
     "Telemetry",
@@ -67,6 +71,9 @@ class Telemetry:
         skew: Optional[SkewMonitor] = None,
         server=None,
         out_dir: Optional[str] = None,
+        goodput=None,
+        watchdog=None,
+        recorder: Optional[FlightRecorder] = None,
     ):
         self.registry = registry
         self.events = events if events is not None else events_mod.NULL
@@ -74,6 +81,9 @@ class Telemetry:
         self.skew = skew
         self.server = server
         self.out_dir = out_dir
+        self.goodput = goodput if goodput is not None else goodput_mod.NULL
+        self.watchdog = watchdog if watchdog is not None else NULL_WATCHDOG
+        self.recorder = recorder
         self._closed = False
 
     @property
@@ -96,33 +106,105 @@ class Telemetry:
         straggler_factor: float = 2.0,
         role: str = "train",
         gather=None,
+        registry: Optional[Registry] = None,
+        trace_name: Optional[str] = None,
+        trace_max_events: Optional[int] = None,
     ) -> "Telemetry":
         """Build telemetry from config knobs. All-None knobs return
         the shared disabled singleton. ``metrics_port=0`` binds an
         ephemeral port (tests); None means no server. ``role``
         prefixes the trace/process naming so multi-role hosts
-        (train + eval) stay distinguishable in Perfetto."""
+        (train + eval) stay distinguishable in Perfetto, selects the
+        span->goodput-category table, and decides whether the flight
+        recorder's SIGTERM hook terminates (serve: yes — nothing
+        above it handles the signal; train: no — GracefulShutdown
+        owns the grace-window exit). Pass ``registry`` to mount the
+        telemetry on an existing registry (serve's ``/metrics``
+        renders its own); ``trace_name``/``trace_max_events``
+        override the per-process defaults.
+
+        The run-health layer rides along when a telemetry dir is
+        given: a goodput ledger (always), a flight recorder
+        (``TPUFW_CRASH_BUNDLE``, default on), and a hang watchdog
+        (``TPUFW_HANG_TIMEOUT_S`` > 0)."""
         if telemetry_dir is None and metrics_port is None:
             return _NULL
+        from tpufw.workloads.env import (
+            env_bool,
+            env_float,
+            env_int,
+        )
+
         proc, nprocs = _jax_ids()
-        registry = Registry()
+        if registry is None:
+            registry = Registry()
         events = events_mod.NULL
         tracer = trace_mod.NULL
+        ledger = None
+        watchdog = None
+        recorder = None
         if telemetry_dir:
             os.makedirs(telemetry_dir, exist_ok=True)
+            events_path = events_mod.log_path(telemetry_dir, proc)
+            # Ledger first, so it can read the PREVIOUS run's step
+            # high-water mark out of the append-mode events file
+            # (replay detection) — order relative to EventLog does
+            # not actually matter (append never truncates), but
+            # scanning before this run writes anything is clearest.
+            serve = role == "serve"
+            ledger = goodput_mod.GoodputLedger(
+                registry=registry,
+                span_categories=(
+                    goodput_mod.SERVE_SPAN_CATEGORIES
+                    if serve
+                    else goodput_mod.TRAIN_SPAN_CATEGORIES
+                ),
+                productive=(
+                    goodput_mod.SERVE_PRODUCTIVE
+                    if serve
+                    else goodput_mod.TRAIN_PRODUCTIVE
+                ),
+                out_path=goodput_mod.rollup_path(telemetry_dir, proc),
+                prior_events_path=events_path,
+            )
             events = events_mod.EventLog(
-                events_mod.log_path(telemetry_dir, proc),
-                host=proc,
-                process=proc,
+                events_path, host=proc, process=proc
             )
-            trace_name = (
-                "trace.json" if proc == 0 else f"trace-p{proc}.json"
-            )
+            ledger._events = events
+            if trace_name is None:
+                trace_name = (
+                    "trace.json" if proc == 0 else f"trace-p{proc}.json"
+                )
             tracer = trace_mod.Tracer(
                 os.path.join(telemetry_dir, trace_name),
                 pid=proc,
                 process_name=f"{role}:p{proc}/{nprocs}",
+                max_events=trace_max_events,
             )
+            tracer.listeners.append(ledger.on_span)
+            events.listeners.append(ledger.on_event)
+            if env_bool("crash_bundle", True):
+                recorder = FlightRecorder(
+                    telemetry_dir,
+                    proc=proc,
+                    ring_size=max(1, env_int("flight_ring", 256)),
+                    registry=registry,
+                    tracer=tracer,
+                    terminate_on_sigterm=serve,
+                )
+                events.listeners.append(recorder.on_event)
+                recorder.install()
+            hang_timeout = env_float("hang_timeout_s", 0.0)
+            if hang_timeout > 0:
+                watchdog = HangWatchdog(
+                    hang_timeout,
+                    telemetry_dir,
+                    proc=proc,
+                    tracer=tracer,
+                    events=events,
+                    recorder=recorder,
+                    abort=env_bool("hang_abort", False),
+                )
         skew = SkewMonitor(
             registry=registry,
             events=events,
@@ -139,9 +221,47 @@ class Telemetry:
             skew=skew,
             server=server,
             out_dir=telemetry_dir,
+            goodput=ledger,
+            watchdog=watchdog,
+            recorder=recorder,
         )
         _emit_compile_cache_event(events)
         return tel
+
+    def set_run_info(self, **labels) -> None:
+        """Publish the ``tpufw_run_info`` identity gauge (value always
+        1; the information is in the labels) so every scrape is
+        joinable to a build: tpufw/jax versions are added here,
+        callers pass backend/mesh/model. Also lands in the crash
+        bundle's config.json."""
+        if self.registry is None:
+            return
+        info = {}
+        try:
+            import tpufw
+
+            info["tpufw_version"] = str(tpufw.__version__)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            import jax
+
+            info["jax_version"] = str(jax.__version__)
+        except Exception:  # noqa: BLE001
+            pass
+        info.update({k: str(v) for k, v in labels.items()})
+        self.registry.gauge(
+            "tpufw_run_info",
+            "run identity (value is always 1; labels carry the info)",
+        ).set(1, **info)
+        if self.recorder is not None:
+            self.recorder.record_config({"run_info": info})
+
+    def record_config(self, config: dict) -> None:
+        """Stash run configuration into the flight recorder so a
+        crash bundle is self-describing. No-op when disabled."""
+        if self.recorder is not None:
+            self.recorder.record_config(config)
 
     def snapshot_metrics(self) -> Optional[str]:
         """Dump the registry's current exposition text to
@@ -160,14 +280,26 @@ class Telemetry:
         if self._closed:
             return
         self._closed = True
+        # Order: watchdog first (a clean shutdown must not fire it),
+        # then the goodput rollup (it emits an event + publishes
+        # metrics, so it must precede the metrics snapshot and the
+        # event-log close), then the files, then the hooks (the
+        # recorder stays armed until the very end — an exception
+        # inside close itself still gets a bundle).
+        self.watchdog.stop()
         try:
-            self.snapshot_metrics()
+            self.goodput.close()
         finally:
-            self.tracer.close()
-            self.events.close()
-            if self.server is not None:
-                self.server.shutdown()
-                self.server.server_close()
+            try:
+                self.snapshot_metrics()
+            finally:
+                self.tracer.close()
+                self.events.close()
+                if self.server is not None:
+                    self.server.shutdown()
+                    self.server.server_close()
+                if self.recorder is not None:
+                    self.recorder.uninstall()
 
     def __enter__(self):
         return self

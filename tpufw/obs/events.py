@@ -44,6 +44,8 @@ SCHEMA: Dict[str, FrozenSet[str]] = {
     ),
     "serve_request": frozenset({"rows", "new_tokens", "latency_s"}),
     "serve_pool_switch": frozenset({"cache_len", "slots"}),
+    "goodput": frozenset({"wall_s", "goodput_ratio"}),
+    "hang": frozenset({"timeout_s", "armed_for_s"}),
 }
 
 
@@ -89,6 +91,11 @@ class EventLog:
         self.host = host
         self.process = process
         self._min = LEVELS.index(min_level)
+        # Observers called with the full event dict after each write
+        # (goodput ledger, flight-recorder ring). List mutation is
+        # wiring-time only; iteration takes a snapshot so a listener
+        # can never see a half-registered peer.
+        self.listeners: List = []
         self._lock = threading.Lock()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f: Optional[io.TextIOWrapper] = open(  # noqa: SIM115
@@ -113,6 +120,14 @@ class EventLog:
                 return
             self._f.write(line + "\n")
             self._f.flush()
+        # Outside the write lock: listeners may be invoked from signal
+        # handlers (preemption_signal) and must not be able to deadlock
+        # the log; they take their own (reentrant) locks.
+        for fn in tuple(self.listeners):
+            try:
+                fn(event)
+            except Exception:
+                pass  # observability must never take down the run
 
     def close(self) -> None:
         with self._lock:

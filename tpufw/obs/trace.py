@@ -37,9 +37,11 @@ class _Span:
 
     def __enter__(self):
         self._t0 = time.perf_counter()
+        self._tracer._push(self.name)
         return self
 
     def __exit__(self, *exc):
+        self._tracer._pop(self.name)
         self._tracer._record(self.name, self._t0, time.perf_counter(), self.args)
         return False
 
@@ -86,11 +88,45 @@ class Tracer:
         # counts what it dropped.
         self._max = max_events
         self._dropped = 0
+        # Observers called (name, dur_s, args) after each complete
+        # span — the goodput ledger rides these instead of re-timing
+        # the loop. Wiring-time mutation only.
+        self.listeners: List = []
+        # Open spans per thread, for the hang watchdog's "where was
+        # the run wedged" dump. perf_counter start kept so the dump
+        # can say how long each frame has been open.
+        self._live: dict = {}
 
     enabled = True
 
     def _ts(self, t: float) -> float:
         return round((t - self._t0) * 1e6, 3)
+
+    def _push(self, name: str) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            self._live.setdefault(tid, []).append((name, time.perf_counter()))
+
+    def _pop(self, name: str) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            stack = self._live.get(tid)
+            if stack and stack[-1][0] == name:
+                stack.pop()
+            if not stack:
+                self._live.pop(tid, None)
+
+    def live_spans(self) -> dict:
+        """Snapshot of currently-open spans: thread ident ->
+        [(name, open_for_s), ...] innermost last. The watchdog dumps
+        this so a hang report names the wedged phase, not just the
+        wedged line."""
+        now = time.perf_counter()
+        with self._lock:
+            return {
+                tid: [(name, round(now - t0, 3)) for name, t0 in stack]
+                for tid, stack in self._live.items()
+            }
 
     def _record(
         self, name: str, t0: float, t1: float, args: Optional[dict]
@@ -110,8 +146,15 @@ class Tracer:
                 return
             if self._max is not None and len(self._events) >= self._max:
                 self._dropped += 1
-                return
-            self._events.append(ev)
+            else:
+                self._events.append(ev)
+        # Listeners fire even past the buffer cap (ledger accounting
+        # must not stop when the trace fills) and outside the lock.
+        for fn in tuple(self.listeners):
+            try:
+                fn(name, t1 - t0, args)
+            except Exception:
+                pass  # observability must never take down the run
 
     def span(self, name: str, **args) -> _Span:
         return _Span(self, name, args)
@@ -196,6 +239,9 @@ class NullTracer:
 
     def instant(self, name: str, **args) -> None:
         pass
+
+    def live_spans(self) -> dict:
+        return {}
 
     def close(self) -> None:
         pass

@@ -29,6 +29,7 @@ class CheckpointManager:
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
         events=None,
+        tracer=None,
     ):
         self.directory = os.path.abspath(directory)
         options = ocp.CheckpointManagerOptions(
@@ -45,6 +46,16 @@ class CheckpointManager:
 
             events = events_mod.NULL
         self.events = events
+        # tpufw.obs tracer (or the shared null): restore and the
+        # async-save drain get their own spans — they happen OUTSIDE
+        # the loop's ``checkpoint`` span (restore precedes the loop,
+        # wait() runs in its finally), so without these the goodput
+        # ledger would book them as idle.
+        if tracer is None:
+            from tpufw.obs import trace as trace_mod
+
+            tracer = trace_mod.NULL
+        self.tracer = tracer
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         # force=True is the preemption path ("make sure THIS step is on
@@ -72,9 +83,10 @@ class CheckpointManager:
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
-        restored = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state)
-        )
+        with self.tracer.span("checkpoint_restore", step=step):
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract_state)
+            )
         self.events.emit("checkpoint_restore", step=step)
         return restored
 
@@ -82,7 +94,8 @@ class CheckpointManager:
         return self._mgr.latest_step()
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        with self.tracer.span("checkpoint_wait"):
+            self._mgr.wait_until_finished()
 
     def close(self) -> None:
         self._mgr.close()

@@ -319,6 +319,19 @@ class PipelineTrainer:
             metrics_port=self.cfg.metrics_port,
             straggler_factor=self.cfg.straggler_factor,
         )
+        from tpufw.train.trainer import _mesh_label
+
+        tel.set_run_info(
+            backend=jax.default_backend(),
+            mesh=_mesh_label(self.mesh),
+            model=f"pipeline:{type(self.model_cfg).__name__}",
+        )
+        tel.record_config(
+            {
+                "trainer": dataclasses.asdict(self.cfg),
+                "pipeline": dataclasses.asdict(self.pipe),
+            }
+        )
         meter = Meter(
             tokens_per_step=self.cfg.batch_size * (self.cfg.seq_len - 1),
             flops_per_token=model_flops_per_token,
@@ -333,6 +346,7 @@ class PipelineTrainer:
                 self.cfg.checkpoint_dir,
                 save_interval_steps=self.cfg.checkpoint_every,
                 events=tel.events,
+                tracer=tel.tracer,
             )
         from tpufw.train.trainer import globalize_batch
 
@@ -401,6 +415,9 @@ class PipelineTrainer:
                 if i >= remaining:
                     break
                 tel.tracer.complete("data_fetch", wait)
+                # Watchdog window: dispatch through host sync (same
+                # contract as Trainer.run — see the comment there).
+                tel.watchdog.arm()
                 with tel.tracer.span("step_dispatch"):
                     prof.maybe_start(i)
                     if window_n == 0:
@@ -423,8 +440,10 @@ class PipelineTrainer:
                             loss = m["loss"]  # Meter.stop float()s it: the barrier
                     prof.maybe_stop(i)
                 if not sync:
+                    tel.watchdog.disarm()
                     continue
                 sm = record_window(py_step, loss)
+                tel.watchdog.disarm()
                 window_n, window_wait = 0, 0.0
                 history.append(sm)
                 if on_metrics and (
@@ -439,7 +458,8 @@ class PipelineTrainer:
                 # Gang-consistent preemption stop (tpufw.train.preemption).
                 with tel.tracer.span("preemption_sync"):
                     stop = checkpoint_stop(
-                        shutdown, ckpt, py_step, self.state
+                        shutdown, ckpt, py_step, self.state,
+                        watchdog=tel.watchdog,
                     )
                 if stop:
                     self.preempted = True
@@ -450,7 +470,9 @@ class PipelineTrainer:
             # Iterator exhausted mid-window: flush the open window.
             if window_n:
                 loss = m["loss"]  # Meter.stop float()s it: the barrier
+                tel.watchdog.arm()
                 sm = record_window(py_step, loss)
+                tel.watchdog.disarm()
                 history.append(sm)
                 if on_metrics:
                     on_metrics(sm)

@@ -161,14 +161,21 @@ def owned_shutdown(
 
 
 def checkpoint_stop(
-    shutdown: Optional[GracefulShutdown], ckpt, step: int, state
+    shutdown: Optional[GracefulShutdown], ckpt, step: int, state,
+    watchdog=None,
 ) -> bool:
     """The per-step stop block shared by every trainer loop: gang-consistent
     stop check (call exactly once per step — it is a collective), and on
     stop a forced checkpoint of ``step`` so the restart resumes here.
-    Returns True when the loop should break."""
+    Returns True when the loop should break. ``watchdog`` (a
+    ``tpufw.obs.health.HangWatchdog``) is disarmed before the forced
+    save: the final checkpoint races the SIGKILL grace window and has
+    no bounded duration, so it must not read as a hang (let alone
+    trigger an abort that forfeits the save)."""
     if shutdown is None or not shutdown.should_stop():
         return False
+    if watchdog is not None:
+        watchdog.disarm()
     if ckpt is not None:
         ckpt.save(step, state, force=True)
     return True

@@ -82,7 +82,11 @@ def prefetch_to_device(
             return
         put((_END, None))
 
-    t = threading.Thread(target=worker, daemon=True)
+    # Named so hang-watchdog stack dumps identify it (an unnamed
+    # "Thread-3" wedged in device_put is unattributable).
+    t = threading.Thread(
+        target=worker, daemon=True, name="tpufw-prefetch"
+    )
     t.start()
     try:
         while True:

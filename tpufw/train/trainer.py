@@ -305,6 +305,17 @@ def globalize_batch(mesh: Mesh, batch: dict) -> dict:
     }
 
 
+def _mesh_label(mesh: Mesh) -> str:
+    """Compact mesh-shape label for the run_info gauge: ``data=8`` /
+    ``data=4,fsdp=2`` (size-1 axes elided — they carry no sharding)."""
+    parts = [
+        f"{name}={size}"
+        for name, size in mesh.shape.items()
+        if size > 1
+    ]
+    return ",".join(parts) or "single"
+
+
 def run_evaluation(
     data, n_batches, eval_batch_fn, globalize
 ) -> dict:
@@ -789,6 +800,12 @@ class Trainer:
             metrics_port=self.cfg.metrics_port,
             straggler_factor=self.cfg.straggler_factor,
         )
+        tel.set_run_info(
+            backend=jax.default_backend(),
+            mesh=_mesh_label(self.mesh),
+            model=type(self.model).__name__,
+        )
+        tel.record_config({"trainer": dataclasses.asdict(self.cfg)})
         if self.cfg.autotune != "off":
             # Resolve BEFORE state init: a remat-policy winner rebuilds
             # the model, and the jitted step bakes every tuned knob in.
@@ -814,6 +831,7 @@ class Trainer:
                 self.cfg.checkpoint_dir,
                 save_interval_steps=self.cfg.checkpoint_every,
                 events=tel.events,
+                tracer=tel.tracer,
             )
         from tpufw.utils.profiling import StepProfiler
 
@@ -887,6 +905,11 @@ class Trainer:
                     if i >= remaining:
                         break
                     tel.tracer.complete("data_fetch", wait)
+                    # Watchdog window: dispatch through host sync.
+                    # Data fetch / eval / checkpoint are excluded —
+                    # they have no progress guarantee, and the point
+                    # is catching wedged collectives, not slow I/O.
+                    tel.watchdog.arm()
                     with tel.tracer.span("step_dispatch"):
                         batch = self.globalize_batch(batch)
                         step_fn = self.compiled_step(batch)
@@ -916,8 +939,10 @@ class Trainer:
                                 loss = m["loss"]  # Meter.stop float()s it: the barrier
                         prof.maybe_stop(i)
                     if not sync:
+                        tel.watchdog.disarm()
                         continue
                     sm = record_window(py_step, loss)
+                    tel.watchdog.disarm()
                     window_n, window_wait = 0, 0.0
                     history.append(sm)
                     if on_metrics and (
@@ -933,7 +958,8 @@ class Trainer:
                     # gang breaks at the same step or not at all.
                     with tel.tracer.span("preemption_sync"):
                         stop = checkpoint_stop(
-                            shutdown, ckpt, py_step, self.state
+                            shutdown, ckpt, py_step, self.state,
+                            watchdog=tel.watchdog,
                         )
                     if stop:
                         self.preempted = True
@@ -945,7 +971,9 @@ class Trainer:
                 # so every executed step is metered and checkpointable.
                 if window_n:
                     loss = m["loss"]  # Meter.stop float()s it: the barrier
+                    tel.watchdog.arm()
                     sm = record_window(py_step, loss)
+                    tel.watchdog.disarm()
                     history.append(sm)
                     if on_metrics:
                         on_metrics(sm)

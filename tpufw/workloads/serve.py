@@ -32,11 +32,24 @@ import time
 from typing import Optional
 
 from tpufw.obs import events as obs_events
+from tpufw.obs import goodput as obs_goodput
 from tpufw.obs import trace as obs_trace
+from tpufw.obs.health import NULL_WATCHDOG
 from tpufw.obs.registry import Registry as ObsRegistry
 from tpufw.workloads.env import env_float, env_int, env_str
 
 _T0 = time.time()
+
+
+def _backend_name() -> str:
+    """jax backend for the run_info gauge; 'unknown' when jax is not
+    initialized enough to ask (run_info must never crash serving)."""
+    try:
+        import jax
+
+        return str(jax.default_backend())
+    except Exception:  # noqa: BLE001
+        return "unknown"
 
 DEMO_PROMPTS = [[1, 42, 7, 99], [1, 5], [1, 1000, 2000, 3000, 17]]
 
@@ -918,6 +931,8 @@ class _SlotScheduler:
         seed_base: int = 0,
         events=None,
         tracer=None,
+        goodput=None,
+        watchdog=None,
     ):
         import jax
         import numpy as np
@@ -939,6 +954,8 @@ class _SlotScheduler:
         self._seed_base = seed_base
         self._events = events if events is not None else obs_events.NULL
         self._tracer = tracer if tracer is not None else obs_trace.NULL
+        self._goodput = goodput if goodput is not None else obs_goodput.NULL
+        self._watchdog = watchdog if watchdog is not None else NULL_WATCHDOG
         self.n_slots = max(1, env_int("serve_slots", 8))
         self.chunk = max(
             1, env_int("serve_chunk", 0) or env_int("stream_chunk", 16)
@@ -968,7 +985,9 @@ class _SlotScheduler:
         self._chunk_index = 0
         self._queue: list[_SlotReq] = []
         self._cv = threading.Condition()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="tpufw-serve-sched"
+        )
         self._thread.start()
 
     # ---- client-facing interface (mirrors _Batcher) ----
@@ -1066,12 +1085,19 @@ class _SlotScheduler:
                 # the pool is running — joins happen at chunk
                 # boundaries, which are the natural cadence.
                 time.sleep(self.wait_s)
+            # Watchdog window: one admit + one chunk. Both are a
+            # bounded amount of device work (prefill / k decode
+            # steps); if either wedges past TPUFW_HANG_TIMEOUT_S the
+            # dump shows which. Idle waiting above stays disarmed.
+            self._watchdog.arm()
             try:
                 self._admit()
                 if self._n_active:
                     self._run_chunk()
             except Exception as e:  # noqa: BLE001 — serving loop
                 self._fail_active(e)
+            finally:
+                self._watchdog.disarm()
 
     def _pool_model(self, cache_len: int):
         """Model variant with the pool's KV budget — built inline;
@@ -1265,10 +1291,12 @@ class _SlotScheduler:
         )
         self._chunk_index += 1
         keys = self._jax.random.split(key, k)
+        chunk_t0 = time.perf_counter()
         with self._tracer.span(
             "serve_decode_chunk", k=k, rows=len(active)
         ):
             out = self._np.asarray(self._pool.decode_steps(keys))
+        chunk_s = time.perf_counter() - chunk_t0
         if self._metrics is not None:
             self._metrics.inc("ticks_total")
             self._metrics.inc("tick_rows_total", len(active))
@@ -1308,6 +1336,13 @@ class _SlotScheduler:
                 "wasted_slot_steps_total",
                 self.n_slots * k - live_tokens,
             )
+        # Goodput: the chunk's wall-clock split by the same capacity
+        # accounting — the live-token fraction was busy, the rest was
+        # wasted slot-steps (time the gap between them and true idle
+        # is what TPUFW_SERVE_SLOTS / _CHUNK tuning reclaims).
+        live_frac = live_tokens / (self.n_slots * k)
+        self._goodput.add("busy", chunk_s * live_frac)
+        self._goodput.add("wasted_slot", chunk_s * (1.0 - live_frac))
         for req in flush:
             if req not in finished:
                 self._flush_stream(req)
@@ -1438,24 +1473,48 @@ class _Server:
         # namespaced monotonic streams.)
         self._seed_base = env_int("seed", 0)
         self._tick_index = 0
-        # Optional serving telemetry (TPUFW_TELEMETRY_DIR): the shared
-        # event log plus a scheduler span trace. The trace buffer is
-        # capped — a server runs indefinitely and the interesting spans
-        # (compiles, first admissions) are at the head.
-        self._events = obs_events.NULL
-        self._tracer: object = obs_trace.NULL
+        # Optional serving telemetry (TPUFW_TELEMETRY_DIR): the full
+        # Telemetry handle mounted on the server's own registry (so
+        # /metrics and the telemetry snapshot render one truth) —
+        # event log, capped scheduler span trace (a server runs
+        # indefinitely; the interesting spans are at the head), plus
+        # the run-health layer: goodput ledger (busy vs. wasted-slot
+        # vs. idle), crash flight recorder (role="serve" terminates
+        # on SIGTERM after flushing — no GracefulShutdown above us),
+        # and the TPUFW_HANG_TIMEOUT_S watchdog around each chunk.
+        from tpufw.obs import Telemetry
+
+        self._tel = Telemetry.disabled()
         tdir = env_str("telemetry_dir", "")
         if tdir:
             import atexit
 
-            self._events = obs_events.EventLog(obs_events.log_path(tdir))
-            self._tracer = obs_trace.Tracer(
-                os.path.join(tdir, "trace-serve.json"),
-                process_name="serve",
-                max_events=100_000,
+            self._tel = Telemetry.create(
+                telemetry_dir=tdir,
+                role="serve",
+                registry=self.metrics.registry,
+                trace_name="trace-serve.json",
+                trace_max_events=100_000,
             )
-            atexit.register(self._tracer.close)
-            atexit.register(self._events.close)
+            self._tel.set_run_info(
+                backend=_backend_name(),
+                model=type(self.model).__name__,
+                mesh="serve",
+            )
+            self._tel.record_config(
+                {
+                    "serve": {
+                        "port": port,
+                        "max_new_tokens": max_new_tokens,
+                        "slots": env_int("serve_slots", 8),
+                        "chunk": env_int("serve_chunk", 0)
+                        or env_int("stream_chunk", 16),
+                    }
+                }
+            )
+            atexit.register(self._tel.close)
+        self._events = self._tel.events
+        self._tracer: object = self._tel.tracer
         # Scheduler backend: the slot scheduler (decode-step-granular
         # continuous batching) is the default; TPUFW_SERVE_SLOTS=0 opts
         # back into the tick batcher, and the speculative path still
@@ -1470,6 +1529,8 @@ class _Server:
                 seed_base=self._seed_base,
                 events=self._events,
                 tracer=self._tracer,
+                goodput=self._tel.goodput,
+                watchdog=self._tel.watchdog,
             )
         else:
             self._batcher = _Batcher(
@@ -1601,6 +1662,9 @@ class _Server:
             "queue_depth": float(self._batcher.queue_depth),
             "uptime_seconds": time.time() - _T0,
         }
+        # Refresh goodput at scrape time too (the ledger otherwise
+        # publishes only at close, and a server rarely closes).
+        self._tel.goodput.publish()
         if isinstance(self._batcher, _SlotScheduler):
             g["slots_occupied"] = float(self._batcher.slots_occupied)
             g["slots_total"] = float(self._batcher.slots_total)
