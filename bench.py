@@ -1327,12 +1327,16 @@ def _worker() -> int:
                 for _ in range(v_reqs)
             ]
 
-            def one(p):
-                t0 = time.perf_counter()
-                outs, _bw = sched.submit([p], v_new, None)
-                dt = time.perf_counter() - t0
-                return dt, sum(len(r) for r in outs)
+            def one_on(s):
+                def one(p):
+                    t0 = time.perf_counter()
+                    outs, _bw = s.submit([p], v_new, None)
+                    dt = time.perf_counter() - t0
+                    return dt, sum(len(r) for r in outs)
 
+                return one
+
+            one = one_on(sched)
             one(prompts[0])  # compile prefill + pool + chunk ladder
             w0 = v_metrics.registry.counter(
                 "tpufw_serve_wasted_slot_steps_total"
@@ -1366,6 +1370,87 @@ def _worker() -> int:
                 "wasted_slot_step_fraction": round(
                     wasted / max(wasted + total, 1), 4
                 ),
+            }
+
+            # Paged-KV sub-tiers: the same traffic against the paged
+            # pool (bf16 KV, then int8 KV) with a prefix-heavy request
+            # mix — half the prompts open with a shared 64-token
+            # prefix, the realistic serving shape paging exists for.
+            # Modes switch via ctor kwargs, never os.environ (TPU004).
+            v_page = 16
+            pfx = v_rng.integers(
+                1, vcfg.vocab_size, size=64
+            ).tolist()
+            p_prompts = [
+                pfx
+                + v_rng.integers(
+                    1, vcfg.vocab_size, size=v_prompt - 64
+                ).tolist()
+                if i % 2 == 0
+                else v_rng.integers(
+                    1, vcfg.vocab_size, size=v_prompt
+                ).tolist()
+                for i in range(v_reqs)
+            ]
+            for v_quant, v_key in (
+                ("", "paged_bf16_kv"),
+                ("int8", "paged_int8_kv"),
+            ):
+                pm = _Metrics()
+                psched = _SlotScheduler(
+                    vmodel,
+                    v_params,
+                    eos_id=None,
+                    default_sampling=SamplingConfig(temperature=0.0),
+                    metrics=pm,
+                    seed_base=0,
+                    page=v_page,
+                    kv_quant=v_quant,
+                )
+                p_one = one_on(psched)
+                p_one(p_prompts[0])  # warm; also seeds the prefix trie
+                t0 = time.perf_counter()
+                with ThreadPoolExecutor(max_workers=v_conc) as pool:
+                    p_results = list(pool.map(p_one, p_prompts))
+                p_wall = time.perf_counter() - t0
+                p_total = sum(n for _, n in p_results)
+                hits = pm.registry.counter(
+                    "tpufw_serve_prefix_hits_total"
+                ).value()
+                misses = pm.registry.counter(
+                    "tpufw_serve_prefix_misses_total"
+                ).value()
+                serve[v_key] = {
+                    "serve_tokens_per_sec_per_chip": round(
+                        p_total / p_wall, 1
+                    ),
+                    "prefix_hit_rate": round(
+                        hits / max(hits + misses, 1), 4
+                    ),
+                    "pages_freed_total": int(
+                        pm.registry.counter(
+                            "tpufw_serve_pages_freed_total"
+                        ).value()
+                    ),
+                    "pages_in_use": psched.pages_in_use,
+                    "pages_total": psched.pages_total,
+                }
+            # Concurrent rows at a FIXED HBM budget (the contiguous
+            # pool's arena): contiguous rows always pay cache_len
+            # tokens; paged rows pay only their occupied pages; int8
+            # KV pays 1 byte/feat + a 4-byte scale/token. This is the
+            # capacity row the int8 mode exists for — strictly more
+            # rows than bf16 at the same HBM.
+            kv_feat = 2 * vcfg.n_kv_heads * vcfg.head_dim  # k and v
+            bpt_bf16 = vcfg.n_layers * kv_feat * 2
+            bpt_int8 = vcfg.n_layers * (kv_feat * 1 + 2 * 4)
+            row_tokens = -(-(v_prompt + v_new - 1) // v_page) * v_page
+            hbm_budget = sched.n_slots * vcfg.max_seq_len * bpt_bf16
+            serve["concurrent_rows_at_fixed_hbm"] = {
+                "hbm_budget_mib": round(hbm_budget / 2**20, 2),
+                "contiguous_bf16": sched.n_slots,
+                "paged_bf16": hbm_budget // (row_tokens * bpt_bf16),
+                "paged_int8": hbm_budget // (row_tokens * bpt_int8),
             }
             del v_params
         except Exception as e:  # noqa: BLE001

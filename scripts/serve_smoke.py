@@ -15,6 +15,12 @@ scheduler the short row joins mid-flight and retires at its own
 max_new. TPUFW_SERVE_CHUNK=2 keeps chunk boundaries (= join/retire
 opportunities) frequent on a tiny model.
 
+The run uses the PAGED KV pool (TPUFW_SERVE_PAGE=16): after the
+overlap test, two sequential requests share a 36-token prefix — the
+second must hit the prefix cache (tpufw_serve_prefix_hits_total >= 1
+on /metrics), and by the end retired rows must have returned pages
+to the arena (pages_freed_total > 0, pages_in_use < pages_total).
+
 Exit 0 on success; any assertion or HTTP failure exits nonzero.
 """
 
@@ -33,6 +39,7 @@ os.environ.setdefault(
 )
 os.environ.setdefault("TPUFW_MODEL", "llama3_tiny")
 os.environ.setdefault("TPUFW_SERVE_CHUNK", "2")
+os.environ.setdefault("TPUFW_SERVE_PAGE", "16")
 
 LONG_NEW, SHORT_NEW, STREAM_NEW = 60, 4, 16
 
@@ -131,6 +138,45 @@ def main() -> int:
         )
         return 1
     print("serve-smoke OK: short joined and retired mid-flight")
+
+    # ---- paged KV: prefix sharing + page reclamation ----
+    from tpufw.workloads.env import env_int
+
+    if not env_int("serve_page", 0):
+        print("serve-smoke: paged-KV section skipped (TPUFW_SERVE_PAGE=0)")
+        srv.httpd.shutdown()
+        return 0
+    # Sequential on purpose: the second request must be admitted after
+    # the first registered its prompt pages in the trie.
+    shared = list(range(40, 76))  # 36 tokens = 2 full 16-token pages
+    post("prefix_a", {"prompts": [shared + [7, 9]], "max_new_tokens": 8})
+    post("prefix_b", {"prompts": [shared + [11, 3]], "max_new_tokens": 8})
+    if errors:
+        print("serve-smoke FAILED:\n  " + "\n  ".join(errors))
+        return 1
+    with urllib.request.urlopen(base + "/metrics", timeout=60) as resp:
+        metrics = {}
+        for line in resp.read().decode().splitlines():
+            if line and not line.startswith("#"):
+                name, _, val = line.partition(" ")
+                metrics[name] = float(val)
+    hits = metrics.get("tpufw_serve_prefix_hits_total", 0.0)
+    freed = metrics.get("tpufw_serve_pages_freed_total", 0.0)
+    in_use = metrics.get("tpufw_serve_pages_in_use", -1.0)
+    total = metrics.get("tpufw_serve_pages_total", 0.0)
+    print(
+        f"paged KV: prefix_hits={hits:.0f} pages_freed={freed:.0f} "
+        f"pages_in_use={in_use:.0f}/{total:.0f}"
+    )
+    if hits < 1:
+        print("serve-smoke FAILED: no prefix cache hit on the shared "
+              "36-token prefix")
+        return 1
+    if freed <= 0 or not (0 <= in_use < total):
+        print("serve-smoke FAILED: retired rows did not return pages "
+              "to the arena")
+        return 1
+    print("serve-smoke OK: prefix shared and pages reclaimed")
     srv.httpd.shutdown()
     return 0
 

@@ -1,0 +1,320 @@
+"""Paged, prefix-shared, int8 KV cache (tpufw.infer.pages / .prefix).
+
+Contracts, all on CPU with the tiny model:
+
+- PARITY: rows decoded through the PAGED pool (page arena + per-slot
+  page table, gather/scatter reads) emit exactly the one-shot
+  ``generate`` path's greedy tokens at matching precision — the
+  physical layout must be invisible to the math (the gather
+  reconstructs logical rows in slot order, so even the summation
+  order matches).
+- SHAPE STABILITY: occupancy, page-table contents, and cursors are
+  DATA. After the first chunk ladder is traced, page churn (release +
+  re-admit at a NEW prompt length) adds ZERO decode or insert traces.
+- PREFIX SHARING: a second request whose prompt shares full pages
+  attaches them by reference (refcount 2, same physical ids) and
+  still emits the cold path's exact tokens; divergence after the
+  shared point is structural copy-on-write (private pages), never a
+  device copy.
+- INT8: per-token symmetric quantization bounds the roundtrip error,
+  and the int8 pool decodes the tiny model to the fp greedy tokens.
+- PRESSURE: the allocator is all-or-nothing with refcount/hold
+  lifetime rules; the trie evicts refcount-0 leaves LRU-first; the
+  scheduler defers admissions that don't fit the arena and rejects
+  rows that never could.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.infer import SamplingConfig, generate_text
+from tpufw.infer import pages as pages_mod
+from tpufw.infer import slots as slots_mod
+from tpufw.infer.prefix import PrefixCache
+from tpufw.models import LLAMA_CONFIGS, Llama
+
+GREEDY = SamplingConfig(temperature=0.0)
+MAX_NEW = 6
+PAGE = 16
+N_SLOTS = 4
+
+
+@pytest.fixture(scope="module")
+def tiny_paged():
+    base = LLAMA_CONFIGS["llama3_tiny"].decode_config()
+    cfg = dataclasses.replace(base, max_seq_len=64)
+    row_model = Llama(cfg)
+    params = jax.jit(row_model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, row_model, params
+
+
+def _paged_pool(cfg, row_model, params, kv_quant="", n_pages=None):
+    pcfg = dataclasses.replace(
+        cfg,
+        kv_page=PAGE,
+        kv_pages=(
+            n_pages
+            if n_pages is not None
+            else N_SLOTS * (cfg.max_seq_len // PAGE) + 1
+        ),
+        kv_quant=kv_quant,
+    )
+    return pages_mod.PagedSlotPool.create_paged(
+        Llama(pcfg),
+        row_model,
+        params,
+        N_SLOTS,
+        sampling=GREEDY,
+        eos_id=None,
+    )
+
+
+def _admit(pool, slot, prompt, i, max_new=MAX_NEW):
+    """The scheduler's paged admission flow: acquire -> (shared or
+    cold) prefill -> scatter-insert -> register in the trie."""
+    rng = jax.random.fold_in(jax.random.key(0), i)
+    grant = pool.acquire_pages(prompt, len(prompt) + max_new - 1)
+    assert grant is not None
+    ids, shared_n = grant
+    if shared_n:
+        cache, _f, first_int, _d, seen = pool.prefill_shared(
+            prompt, ids[:shared_n], rng
+        )
+    else:
+        cache, _f, first_int, _d, seen = slots_mod.prefill_row(
+            pool.row_model,
+            pool.params,
+            prompt,
+            rng,
+            sampling=GREEDY,
+            eos_id=None,
+            pad_to=len(prompt),
+        )
+    pool.insert_paged(
+        slot, cache, first_int, len(prompt), max_new - 1,
+        ids, shared_n, row_seen=seen,
+    )
+    pool.register_prefix(prompt, ids)
+    return first_int, shared_n
+
+
+def _decode_all(pool, firsts, max_new=MAX_NEW, chunk=2):
+    rows = {i: [fi] for i, fi in firsts.items()}
+    ci = 0
+    while any(len(t) < max_new for t in rows.values()):
+        key = jax.random.fold_in(jax.random.key(1), ci)
+        ci += 1
+        out = np.asarray(pool.decode_steps(jax.random.split(key, chunk)))
+        for i in rows:
+            take = min(chunk, max_new - len(rows[i]))
+            rows[i].extend(out[i, :take].tolist())
+    return rows
+
+
+def test_paged_decode_bit_equal_contiguous(tiny_paged):
+    cfg, row_model, params = tiny_paged
+    prompts = [[1, 5, 9], [2, 7], list(range(3, 37))]
+    want = generate_text(
+        row_model, params, prompts, max_new_tokens=MAX_NEW,
+        sampling=GREEDY,
+    )
+    pool = _paged_pool(cfg, row_model, params)
+    firsts = {}
+    for i, p in enumerate(prompts):
+        firsts[i], _ = _admit(pool, i, p, i)
+    rows = _decode_all(pool, firsts)
+    assert [rows[i] for i in range(len(prompts))] == want
+    # Contiguous insert is a guard-railed dead end on the paged pool.
+    with pytest.raises(TypeError):
+        pool.insert(0, None, 0, 1, 1)
+
+
+def test_zero_retrace_across_page_churn(tiny_paged):
+    cfg, row_model, params = tiny_paged
+    pool = _paged_pool(cfg, row_model, params)
+    firsts = {}
+    for i, p in enumerate([[1, 5, 9], [2, 7]]):
+        firsts[i], _ = _admit(pool, i, p, i)
+    _decode_all(pool, firsts)
+    t0 = dict(slots_mod.TRACE_COUNTS), dict(pages_mod.TRACE_COUNTS)
+    # Churn: free a slot, admit a NEW prompt length into it, decode.
+    freed = pool.release_slot(1)
+    assert freed > 0
+    fi, _ = _admit(pool, 1, [4, 4, 4, 4], 9)
+    _decode_all(pool, {1: fi})
+    t1 = dict(slots_mod.TRACE_COUNTS), dict(pages_mod.TRACE_COUNTS)
+    assert t1[0]["decode_steps"] == t0[0]["decode_steps"], (t0, t1)
+    assert t1[1]["paged_insert"] == t0[1]["paged_insert"], (t0, t1)
+
+
+def test_prefix_share_matches_cold_and_cow(tiny_paged):
+    cfg, row_model, params = tiny_paged
+    shared = list(range(40, 76))  # 36 tokens = 2 full pages + 4
+    pa = shared + [7, 9]
+    pb = shared + [11, 3, 5]
+    want = generate_text(
+        row_model, params, [pa, pb], max_new_tokens=MAX_NEW,
+        sampling=GREEDY,
+    )
+    pool = _paged_pool(cfg, row_model, params)
+    fa, sn_a = _admit(pool, 0, pa, 0)
+    fb, sn_b = _admit(pool, 1, pb, 1)
+    assert sn_a == 0 and sn_b == 2  # second admission attached 2 pages
+    # Shared pages are the SAME physical ids, refcounted per row.
+    assert pool.slot_pages[1][:2] == pool.slot_pages[0][:2]
+    assert all(
+        pool.allocator.refs[pid] == 2 for pid in pool.slot_pages[0][:2]
+    )
+    # Copy-on-write: past the shared point the rows' pages are private.
+    assert set(pool.slot_pages[0][2:]).isdisjoint(pool.slot_pages[1][2:])
+    rows = _decode_all(pool, {0: fa, 1: fb})
+    assert rows[0] == want[0]  # donor row unperturbed by the share
+    assert rows[1] == want[1]  # shared tokens == cold prefill tokens
+    # Retiring the donor must NOT free the trie-held shared pages.
+    held = list(pool.slot_pages[0][:2])
+    pool.release_slot(0)
+    assert all(pid in pool.allocator.refs or pid in pool.allocator.held
+               for pid in held)
+    rows_b = _decode_all(pool, {1: [rows[1][-1]]}, max_new=2)
+    assert isinstance(rows_b[1][-1], int)
+
+
+def test_int8_kv_quant_roundtrip_tolerance():
+    from tpufw.ops.quant import dequantize_kv, quantize_kv
+
+    x = jax.random.normal(jax.random.key(3), (3, 5, 4, 8), jnp.float32)
+    q, scale = quantize_kv(x, n_feat=2)
+    assert q.dtype == jnp.int8 and scale.shape == (3, 5)
+    back = np.asarray(dequantize_kv(q, scale, jnp.float32))
+    amax = np.max(np.abs(np.asarray(x)), axis=(2, 3), keepdims=True)
+    # Symmetric per-token int8: error bounded by half a quant step.
+    assert np.all(np.abs(back - np.asarray(x)) <= amax / 127.0)
+
+
+def test_int8_pool_decodes_to_fp_greedy(tiny_paged):
+    cfg, row_model, params = tiny_paged
+    prompts = [[1, 5, 9], list(range(3, 37))]
+    want = generate_text(
+        row_model, params, prompts, max_new_tokens=MAX_NEW,
+        sampling=GREEDY,
+    )
+    pool = _paged_pool(cfg, row_model, params, kv_quant="int8")
+    # The arena really is int8 with per-page fp32 scales.
+    flat = jax.tree_util.tree_flatten_with_path(pool.cache)[0]
+    names = [str(p[-1]) for p, _ in flat]
+    arenas = [
+        leaf for p, leaf in flat if "cached_key" in str(p[-1])
+        and "scale" not in str(p[-1])
+    ]
+    assert arenas and all(a.dtype == jnp.int8 for a in arenas)
+    assert any("scale" in n for n in names)
+    firsts = {}
+    for i, p in enumerate(prompts):
+        firsts[i], _ = _admit(pool, i, p, i)
+    rows = _decode_all(pool, firsts)
+    # Tiny-model logits have wide argmax margins; int8 KV (max relative
+    # error 1/254 per token) must not flip the greedy path here.
+    assert [rows[i] for i in range(len(prompts))] == want
+
+
+def test_page_allocator_refcount_hold_lifetime():
+    a = pages_mod.PageAllocator(5)  # page 0 reserved -> 4 usable
+    assert a.capacity == 4 and a.n_free == 4
+    ids = a.alloc(3)
+    assert ids is not None and len(ids) == 3 and 0 not in ids
+    assert a.alloc(2) is None  # all-or-nothing: only 1 free
+    assert a.in_use == 3
+    a.ref(ids[:1])  # second row references the first page
+    assert a.release(ids[:1]) == 0  # refcount 2 -> 1: stays resident
+    assert a.release(ids) == 3  # last refs drop: all freed
+    assert a.n_free == 4 and a.freed_total == 3
+    ids = a.alloc(2)
+    a.hold(ids[:1])  # trie adoption
+    assert a.release(ids) == 1  # held page survives its row
+    assert a.in_use == 1
+    assert a.drop(ids[:1]) == 1  # trie eviction frees it
+    assert a.in_use == 0
+    with pytest.raises(ValueError):
+        pages_mod.PageAllocator(1)  # junk sink alone is not an arena
+
+
+def test_prefix_trie_eviction_under_pressure():
+    a = pages_mod.PageAllocator(5)  # 4 usable
+    trie = PrefixCache(2)
+    ids1 = a.alloc(2)
+    a.hold(trie.insert([1, 2, 3, 4], ids1))
+    assert a.release(ids1) == 0  # both pages trie-held
+    ids2 = a.alloc(2)
+    # Shares chunk (1,2) -> keeps the EXISTING page; adopts only (9,9).
+    adopted = trie.insert([1, 2, 9, 9], ids2)
+    assert adopted == [ids2[1]]
+    a.hold(adopted)
+    assert a.release(ids2) == 1  # duplicate (1,2) copy dies with row
+    assert len(trie) == 3 and a.in_use == 3 and a.n_free == 1
+    # Pressure: evicting 2 refcount-0 leaves frees real pages.
+    dropped = trie.evict(2, a)
+    assert len(dropped) == 2 and a.n_free == 3 and len(trie) == 1
+
+
+def test_scheduler_page_budget_admission(tiny_paged):
+    from tpufw.workloads.serve import _Metrics, _SlotScheduler
+
+    _cfg, _row_model, params = tiny_paged
+    model = Llama(LLAMA_CONFIGS["llama3_tiny"].decode_config())
+    metrics = _Metrics()
+    # 6-usable-page arena; three rows of 3 pages each cannot be
+    # co-resident — the third defers until a retire frees pages.
+    sched = _SlotScheduler(
+        model, params,
+        eos_id=None, default_sampling=GREEDY, seed_base=0,
+        metrics=metrics, page=16, arena_pages=7,
+    )
+    prompts = [list(range(10 + i, 40 + i)) for i in range(3)]
+    want = generate_text(
+        model, params, prompts, max_new_tokens=MAX_NEW, sampling=GREEDY
+    )
+    outs, _bw = sched.submit(prompts, MAX_NEW, None)
+    assert outs == want
+    freed = metrics.registry.counter(
+        "tpufw_serve_pages_freed_total"
+    ).value()
+    assert freed > 0
+    assert sched.pages_in_use < sched.pages_total == 6
+    # A row that can NEVER fit the arena is rejected at submit.
+    with pytest.raises(ValueError):
+        sched.submit([list(range(100))], 29, None)
+
+
+def test_deepseek_paged_parity():
+    from tpufw.models.deepseek import DEEPSEEK_CONFIGS, Deepseek
+
+    base = DEEPSEEK_CONFIGS["deepseek_tiny"].decode_config()
+    cfg = dataclasses.replace(base, max_seq_len=64)
+    row_model = Deepseek(cfg)
+    params = jax.jit(row_model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompts = [[1, 5, 9], [2, 7]]
+    max_new = 4
+    want = generate_text(
+        row_model, params, prompts, max_new_tokens=max_new,
+        sampling=GREEDY,
+    )
+    pcfg = dataclasses.replace(
+        cfg, kv_page=PAGE, kv_pages=2 * (64 // PAGE) + 1
+    )
+    pool = pages_mod.PagedSlotPool.create_paged(
+        Deepseek(pcfg), row_model, params, 2,
+        sampling=GREEDY, eos_id=None,
+    )
+    firsts = {}
+    for i, p in enumerate(prompts):
+        firsts[i], _ = _admit(pool, i, p, i, max_new=max_new)
+    rows = _decode_all(pool, firsts, max_new=max_new)
+    assert [rows[i] for i in range(len(prompts))] == want

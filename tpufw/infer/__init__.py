@@ -6,6 +6,12 @@ from tpufw.infer.generate import (  # noqa: F401
     generate_text_stream,
     pad_prompts,
 )
+from tpufw.infer.pages import (  # noqa: F401
+    PageAllocator,
+    PagedSlotPool,
+    paged_pool_cache,
+)
+from tpufw.infer.prefix import PrefixCache  # noqa: F401
 from tpufw.infer.slots import (  # noqa: F401
     SlotPool,
     pool_cache,

@@ -219,6 +219,18 @@ def _model_apply(model, params):
     return apply
 
 
+def split_prefill_keys(rng: jax.Array, n_step_keys: int):
+    """THE key-split contract, extracted so every prefill flavor
+    (``_prefill_and_first`` here, the prefix-shared suffix prefill in
+    tpufw.infer.pages) derives identical keys from the same ``rng``:
+    first = split(rng)[1], step i = split(split(rng)[0], n)[i-1] with
+    n = max(n_step_keys, 1) — split(rng, n)[i] is NOT stable across n
+    on every jax version, so parity consumers must reproduce this
+    exact split count. Returns (first_rng, step_keys)."""
+    next_rng, first_rng = jax.random.split(rng)
+    return first_rng, jax.random.split(next_rng, max(n_step_keys, 1))
+
+
 def _prefill_and_first(
     model,
     params,
@@ -264,7 +276,7 @@ def _prefill_and_first(
             .at[jnp.arange(b)[:, None], prompt_tokens]
             .max(real)
         )
-    next_rng, first_rng = jax.random.split(rng)
+    first_rng, step_keys = split_prefill_keys(rng, n_step_keys)
     first = sample_token(logits[:, -1, :], sampling, first_rng, seen)
     if track_seen:
         seen = seen.at[jnp.arange(b), first].set(True)
@@ -274,7 +286,6 @@ def _prefill_and_first(
         # Filler rows are born done: they emit pad from step 1 and never
         # gate the streaming all-done early exit.
         done = done | ~live_rows
-    step_keys = jax.random.split(next_rng, max(n_step_keys, 1))
     return cache, first, p - pad_lens, done, seen, step_keys
 
 

@@ -1,0 +1,622 @@
+"""Paged KV pool: fixed-size page arena + per-slot page tables.
+
+The contiguous ``SlotPool`` (tpufw.infer.slots) charges every occupied
+slot a full ``[cache_len]`` KV row, so HBM — not compute — caps
+concurrent rows per chip, and identical prompt prefixes are prefilled
+and stored once PER ROW. This module keeps the slot scheduler's whole
+zero-recompile contract (occupancy, cursors, and now page-table churn
+are all DATA) while storing KV in a global arena of ``kv_pages`` pages
+of ``kv_page`` slots each:
+
+- the MODEL owns the arena + table + gather/scatter reads
+  (``Attention._paged_cached_attention`` in llama/deepseek — the cache
+  leaves just have a different shape, so ``_decode_steps_jit`` is
+  reused verbatim);
+- this module owns moving rows in and out: ``_paged_insert_jit``
+  scatters a B=1 contiguous prefilled row into the slot's pages,
+  ``PagedSlotPool.release_slot`` zeroes the table row (stale writes
+  from a done-but-stepped row then land in reserved page 0, never in a
+  reused page) and returns the pages to the host-side
+  ``PageAllocator``;
+- prefix sharing rides on top: ``PrefixCache`` (tpufw.infer.prefix)
+  maps full-page token chunks to resident pages, ``prefill_shared``
+  gathers the shared pages into a fresh row cache and prefills ONLY
+  the suffix. Only full pages strictly before the row's first write
+  slot are shared, so copy-on-write is structural — divergence lands
+  in private pages, never needs a device copy.
+
+Static-shape discipline and retrace budget: ``decode_steps`` stays ONE
+program forever. Insert/attach/suffix-prefill programs are keyed by
+(prompt-length, shared-page-count) — bounded by the traffic's distinct
+prompt shapes, paid at admission (the same place the contiguous path
+pays its prefill-bucket programs), never per decode step.
+
+int8 KV (``cfg.kv_quant == "int8"``): arenas are int8 with per-token
+fp32 scales stored page-structured ``[kv_pages, kv_page]``. Decode
+tokens are quantized inside the model at append; prompt tokens are
+quantized HERE at insert (prefill itself runs full-precision through
+the contiguous row cache).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpufw.infer.generate import _model_apply, split_prefill_keys
+from tpufw.infer.prefix import PrefixCache
+from tpufw.infer.sampling import sample_token
+from tpufw.infer.slots import SlotPool, _retire_jit, _track_seen
+from tpufw.ops.quant import dequantize_kv, quantize_kv
+
+# Trace-time counters, same contract as tpufw.infer.slots.TRACE_COUNTS:
+# bumped once per (re)trace so tests can pin the retrace budget.
+TRACE_COUNTS: Dict[str, int] = {
+    "paged_insert": 0, "clear_table": 0, "prefix_attach": 0,
+    "suffix_prefill": 0,
+}
+
+#: unstacked rank of each KV arena leaf — (n_pages, page, *feat); the
+#: trailing ``rank - 2`` dims are the per-token feature block a single
+#: int8 scale covers. Matching row-cache leaves are (1, W, *feat) at
+#: the same rank.
+_ARENA_RANK = {
+    "cached_key": 4, "cached_value": 4,  # llama-family K/V heads
+    "cached_ckv": 3, "cached_kpe": 3,    # deepseek MLA latents
+}
+
+
+def _leaf_name(path) -> str:
+    last = path[-1]
+    return str(getattr(last, "key", last))
+
+
+def _flatten_with_names(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths = tuple(jax.tree_util.keystr(p) for p, _ in flat)
+    names = tuple(_leaf_name(p) for p, _ in flat)
+    leaves = [leaf for _, leaf in flat]
+    return paths, names, leaves, treedef
+
+
+def _collapse_arena(leaf, rank):
+    """[*stack, n_pages, page, *feat] -> [stacks, n_pages, page, *feat]
+    (stacks = nn.scan layer axes collapsed; 1 when unscanned)."""
+    return leaf.reshape((-1,) + leaf.shape[leaf.ndim - rank:])
+
+
+def _collapse_row(row, rank):
+    """[*stack, 1, W, *feat] -> [stacks, W, *feat] (B=1 absorbed)."""
+    return row.reshape((-1,) + row.shape[row.ndim - rank + 1:])
+
+
+def paged_pool_cache(model, params, n_slots: int):
+    """Zeroed paged cache for ``model`` (cfg.kv_page > 0) at B=n_slots.
+
+    The paged branch creates its per-row cursor/table as [B] vectors
+    directly, so — unlike the contiguous ``pool_cache`` — no axis
+    probing or trailing-slot-axis surgery is needed: the model's own
+    init shapes ARE the pool shapes. Zeros are safe initial state
+    (page 0 reserved, segment 0 everywhere)."""
+
+    def init(p):
+        toks = jnp.zeros((n_slots, 1), jnp.int32)
+        pos = jnp.zeros((n_slots, 1), jnp.int32)
+        seg = jnp.ones((n_slots, 1), jnp.int32)
+        _, vars_ = model.apply(
+            {"params": p}, toks, positions=pos, segment_ids=seg,
+            mutable=["cache"],
+        )
+        return vars_["cache"]
+
+    shapes = jax.eval_shape(init, params)
+    tree = jax.tree_util.tree_map(
+        lambda l: jnp.zeros(l.shape, l.dtype), shapes
+    )
+    return {"cache": tree}
+
+
+def _row_zeros_tree(row_model, params):
+    """Zeroed B=1 CONTIGUOUS row cache for ``row_model`` (the paged
+    model's contiguous twin) — the shape ``prefill_row`` hands back,
+    used as the canvas ``prefill_shared`` gathers shared pages into."""
+
+    def init(p):
+        toks = jnp.zeros((1, 1), jnp.int32)
+        pos = jnp.zeros((1, 1), jnp.int32)
+        seg = jnp.ones((1, 1), jnp.int32)
+        _, vars_ = row_model.apply(
+            {"params": p}, toks, positions=pos, segment_ids=seg,
+            mutable=["cache"],
+        )
+        return vars_["cache"]
+
+    shapes = jax.eval_shape(init, params)
+    # Wrapped in the same {"cache": ...} form prefill_row returns, so
+    # path alignment against the pool tree lines up leaf-for-leaf.
+    return {
+        "cache": jax.tree_util.tree_map(
+            lambda l: jnp.zeros(l.shape, l.dtype), shapes
+        )
+    }
+
+
+class PageAllocator:
+    """Host-side free-list + refcounts over the device page arena.
+
+    Page 0 is reserved (the causally-masked junk sink unmapped table
+    entries point at) and never enters the free list. A page is free
+    iff its row refcount is 0 AND the prefix trie does not hold it."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError(
+                f"kv_pages={n_pages}: need >= 2 (page 0 is reserved)"
+            )
+        self.n_pages = int(n_pages)
+        # LIFO free list: recently-freed pages are re-used first (their
+        # arena lines are warm).
+        self.free: List[int] = list(range(n_pages - 1, 0, -1))
+        self.refs: Dict[int, int] = {}
+        self.held: set = set()
+        self.freed_total = 0
+
+    @property
+    def n_free(self) -> int:
+        return len(self.free)
+
+    @property
+    def capacity(self) -> int:
+        return self.n_pages - 1
+
+    @property
+    def in_use(self) -> int:
+        return self.capacity - len(self.free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop ``n`` free pages with refcount 1, or None (all-or-
+        nothing — a partial grab would deadlock two part-admitted
+        rows)."""
+        if n > len(self.free):
+            return None
+        ids = [self.free.pop() for _ in range(n)]
+        for i in ids:
+            self.refs[i] = 1
+        return ids
+
+    def ref(self, ids: Sequence[int]) -> None:
+        for i in ids:
+            self.refs[i] = self.refs.get(i, 0) + 1
+
+    def release(self, ids: Sequence[int]) -> int:
+        """Drop one row reference per id; free those that hit 0 and are
+        not trie-held. Returns the number actually freed."""
+        freed = 0
+        for i in ids:
+            r = self.refs.get(i, 0) - 1
+            if r > 0:
+                self.refs[i] = r
+            else:
+                self.refs.pop(i, None)
+                if i not in self.held:
+                    self.free.append(i)
+                    freed += 1
+        self.freed_total += freed
+        return freed
+
+    def hold(self, ids: Sequence[int]) -> None:
+        self.held.update(int(i) for i in ids)
+
+    def drop(self, ids: Sequence[int]) -> int:
+        """Trie eviction path: drop the hold; free ids no row uses."""
+        freed = 0
+        for i in ids:
+            self.held.discard(i)
+            if self.refs.get(i, 0) == 0:
+                self.free.append(i)
+                freed += 1
+        self.freed_total += freed
+        return freed
+
+
+@partial(
+    jax.jit,
+    static_argnames=("names", "scale_src", "page", "quant"),
+    donate_argnames=("leaves", "token", "pos", "done", "remaining", "seen"),
+)
+def _paged_insert_jit(
+    leaves, row_leaves, table_row, slot, start, first, pos0, budget,
+    token, pos, done, remaining, seen, row_seen,
+    *, names, scale_src, page, quant,
+):
+    """Scatter a B=1 contiguous prefilled row into slot ``slot``'s
+    pages. ``table_row`` [per_row] holds the slot's physical page ids
+    (0-padded past the row's need); ``start`` (TRACED — shared vs cold
+    never retraces) is the first logical slot this row owns: slots
+    below it belong to shared prefix pages and are redirected into
+    reserved page 0 (harmless duplicate junk) instead of overwriting
+    shared content."""
+    TRACE_COUNTS["paged_insert"] += 1
+    per_row = table_row.shape[0]
+    w = per_row * page
+    idx = jnp.arange(w)
+    off = idx % page
+    phys = jnp.where(idx >= start, table_row[idx // page], 0)
+
+    quantized = {}
+    if quant:
+        for i, name in enumerate(names):
+            if name in _ARENA_RANK:
+                rank = _ARENA_RANK[name]
+                rr = _collapse_row(row_leaves[i], rank)
+                quantized[i] = quantize_kv(rr, n_feat=rank - 2)
+
+    out = []
+    for i, (name, leaf) in enumerate(zip(names, leaves)):
+        if name == "page_table":
+            out.append(leaf.at[..., slot, :].set(table_row))
+        elif name == "cache_index":
+            out.append(leaf.at[..., slot].set(row_leaves[i]))
+        elif name.endswith("_scale"):
+            scales = quantized[scale_src[i]][1]  # [stacks, W] fp32
+            a = _collapse_arena(leaf, 2)
+            out.append(a.at[:, phys, off].set(scales).reshape(leaf.shape))
+        elif name in _ARENA_RANK:
+            rank = _ARENA_RANK[name]
+            if quant:
+                vals = quantized[i][0]
+            else:
+                vals = _collapse_row(row_leaves[i], rank).astype(leaf.dtype)
+            a = _collapse_arena(leaf, rank)
+            out.append(a.at[:, phys, off].set(vals).reshape(leaf.shape))
+        elif name == "cached_segment_ids":
+            vals = _collapse_row(row_leaves[i], 2).astype(leaf.dtype)
+            a = _collapse_arena(leaf, 2)
+            out.append(a.at[:, phys, off].set(vals).reshape(leaf.shape))
+        else:
+            raise ValueError(
+                f"unknown paged cache leaf {name!r}: the paged insert "
+                "must know every leaf's role (an untouched leaf would "
+                "leak the previous occupant's state)"
+            )
+    token = token.at[slot].set(first)
+    pos = pos.at[slot].set(pos0)
+    done = done.at[slot].set(False)
+    remaining = remaining.at[slot].set(budget)
+    if seen is not None:
+        seen = seen.at[slot].set(row_seen[0])
+    return tuple(out), token, pos, done, remaining, seen
+
+
+@partial(jax.jit, donate_argnames=("tables",))
+def _clear_tables_jit(tables, slot):
+    """Zero slot ``slot``'s page-table row in every layer: a retired
+    row's residual writes (done rows keep stepping under static shapes)
+    then land in reserved page 0 instead of a page someone else may
+    have been handed."""
+    TRACE_COUNTS["clear_table"] += 1
+    return tuple(t.at[..., slot, :].set(0) for t in tables)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("names", "scale_of", "page", "quant"),
+)
+def _attach_shared_jit(
+    row_leaves, pool_leaves, ids, *, names, scale_of, page, quant,
+):
+    """Gather ``ids``' pages out of the arena into logical slots
+    [0, len(ids)*page) of a zeroed B=1 contiguous row cache (dequantized
+    in int8 mode — suffix prefill attends full-precision), segment 1,
+    cursor = shared length. Programs are keyed by the shared-page
+    count. Inputs/outputs ride in POOL leaf order; entries with no row
+    counterpart (page_table, scales) pass None through."""
+    TRACE_COUNTS["prefix_attach"] += 1
+    n = ids.shape[0]
+    length = n * page
+    out = []
+    for i, name in enumerate(names):
+        row = row_leaves[i]
+        if row is None:
+            out.append(None)
+        elif name == "cache_index":
+            out.append(jnp.full(row.shape, length, row.dtype))
+        elif name == "cached_segment_ids":
+            rr = _collapse_row(row, 2)
+            a = _collapse_arena(pool_leaves[i], 2)
+            g = a[:, ids].reshape(a.shape[0], length)
+            out.append(
+                rr.at[:, :length].set(g.astype(rr.dtype)).reshape(row.shape)
+            )
+        elif name in _ARENA_RANK:
+            rank = _ARENA_RANK[name]
+            a = _collapse_arena(pool_leaves[i], rank)
+            g = a[:, ids]  # [stacks, n, page, *feat]
+            if quant:
+                sa = _collapse_arena(pool_leaves[scale_of[i]], 2)
+                g = dequantize_kv(g, sa[:, ids], row.dtype)
+            g = g.reshape((g.shape[0], length) + g.shape[3:])
+            rr = _collapse_row(row, rank)
+            out.append(
+                rr.at[:, :length].set(g.astype(rr.dtype)).reshape(row.shape)
+            )
+        else:
+            raise ValueError(f"unknown row cache leaf {name!r}")
+    return tuple(out)
+
+
+@partial(jax.jit, static_argnames=("model", "sampling", "eos_id"))
+def _suffix_prefill_jit(
+    model, params, cache, suffix, prompt_full, start_pos, rng,
+    *, sampling, eos_id,
+):
+    """Prefill ONLY the unshared suffix over an attached row cache and
+    sample the first token with ``split_prefill_keys``' first key — the
+    exact key a cold ``prefill_row`` of the full prompt would use, so
+    shared and cold admissions draw identical sample streams."""
+    TRACE_COUNTS["suffix_prefill"] += 1
+    b, t = suffix.shape
+    seg = jnp.ones((b, t), jnp.int32)
+    positions = start_pos + jnp.arange(t)[None, :]
+    apply = _model_apply(model, params)
+    logits, cache = apply(cache, suffix, positions, seg)
+    seen = None
+    if _track_seen(sampling):
+        # Repetition-penalty presence mask over the FULL prompt (the
+        # shared tokens count even though they were never re-run).
+        vocab = logits.shape[-1]
+        seen = (
+            jnp.zeros((b, vocab), bool)
+            .at[jnp.arange(b)[:, None], prompt_full]
+            .set(True)
+        )
+    first_rng, _ = split_prefill_keys(rng, 1)
+    first = sample_token(logits[:, -1, :], sampling, first_rng, seen)
+    if seen is not None:
+        seen = seen.at[jnp.arange(b), first].set(True)
+    done = jnp.zeros((b,), bool) if eos_id is None else first == eos_id
+    return cache, first, done, seen
+
+
+@dataclasses.dataclass
+class PagedSlotPool(SlotPool):
+    """SlotPool whose KV lives in a shared page arena.
+
+    ``decode_steps`` is INHERITED unchanged — paging is internal to the
+    model's cache leaves. Insert/retire are replaced by page-aware
+    versions, and two host-side owners ride along: ``allocator``
+    (free list + refcounts) and ``prefix`` (radix trie; None when
+    prefix caching is off). ``row_model`` is the contiguous twin
+    (kv_page=0, same max_seq_len) prefill runs through."""
+
+    row_model: Any = None
+    page: int = 0
+    allocator: Any = None
+    prefix: Any = None
+    slot_pages: Any = None  # per-slot page ids this row references
+    _row_shapes: Any = dataclasses.field(default=None, repr=False)
+
+    @classmethod
+    def create_paged(
+        cls,
+        model,
+        row_model,
+        params,
+        n_slots: int,
+        *,
+        sampling,
+        pad_id: int = 0,
+        eos_id: Optional[int] = None,
+        prefix_cache: bool = True,
+    ) -> "PagedSlotPool":
+        cfg = model.cfg
+        cache = paged_pool_cache(model, params, n_slots)
+        seen = None
+        if _track_seen(sampling):
+            seen = jnp.zeros((n_slots, cfg.vocab_size), bool)
+        return cls(
+            model=model,
+            params=params,
+            n_slots=n_slots,
+            sampling=sampling,
+            pad_id=pad_id,
+            eos_id=eos_id,
+            cache=cache,
+            axes=(),
+            token=jnp.zeros((n_slots,), jnp.int32),
+            pos=jnp.zeros((n_slots,), jnp.int32),
+            done=jnp.ones((n_slots,), bool),
+            remaining=jnp.zeros((n_slots,), jnp.int32),
+            seen=seen,
+            row_model=row_model,
+            page=int(cfg.kv_page),
+            allocator=PageAllocator(int(cfg.kv_pages)),
+            prefix=PrefixCache(int(cfg.kv_page)) if prefix_cache else None,
+            slot_pages=[[] for _ in range(n_slots)],
+        )
+
+    # ---- host-side page bookkeeping -------------------------------
+
+    @property
+    def per_row(self) -> int:
+        return self.cache_len // self.page
+
+    def n_pages_for(self, need: int) -> int:
+        """Pages covering ``need`` logical slots (= prompt_len +
+        max_new - 1: a live row's cursor never passes its budget)."""
+        return -(-need // self.page)
+
+    def acquire_pages(
+        self, prompt: Sequence[int], need: int
+    ) -> Optional[Tuple[List[int], int]]:
+        """Reserve pages for a row: match the prompt against the prefix
+        trie, then allocate the rest — evicting refcount-0 trie leaves
+        under pressure. Returns (page_ids, shared_n) with row refs
+        taken on every id, or None if the arena can't fit the row right
+        now (the scheduler treats that like a closed KV budget and
+        retries after the next retire)."""
+        p = len(prompt)
+        n_total = self.n_pages_for(need)
+        shared: List[int] = []
+        if self.prefix is not None and p > 1:
+            # Cap so >= 1 suffix token always remains: the first output
+            # token's logits need a real forward pass.
+            shared = self.prefix.match(prompt)[: (p - 1) // self.page]
+        # Reference the shared pages FIRST so eviction below can't free
+        # them out from under us (match() alone leaves refcount at 0
+        # for pages only the trie holds).
+        self.allocator.ref(shared)
+        n_new = n_total - len(shared)
+        ids = self.allocator.alloc(n_new)
+        if ids is None and self.prefix is not None:
+            self.prefix.evict(
+                n_new - self.allocator.n_free, self.allocator
+            )
+            ids = self.allocator.alloc(n_new)
+        if ids is None:
+            self.allocator.release(shared)
+            return None
+        return shared + ids, len(shared)
+
+    def release_pages(self, ids: Sequence[int]) -> int:
+        return self.allocator.release(ids)
+
+    def register_prefix(
+        self, prompt: Sequence[int], page_ids: Sequence[int]
+    ) -> None:
+        """Adopt the row's FULL prompt pages into the trie (partial
+        trailing page and decode pages stay private — they're the
+        copy-on-write divergence zone)."""
+        if self.prefix is None:
+            return
+        n_full = len(prompt) // self.page
+        adopted = self.prefix.insert(prompt, list(page_ids)[:n_full])
+        self.allocator.hold(adopted)
+
+    # ---- device ops -----------------------------------------------
+
+    def _pool_flat(self):
+        paths, names, leaves, treedef = _flatten_with_names(self.cache)
+        return paths, names, leaves, treedef
+
+    def _aligned_row(self, paths, row_cache):
+        row_paths, _, row_leaves, _ = _flatten_with_names(row_cache)
+        row_map = dict(zip(row_paths, row_leaves))
+        return [row_map.get(p) for p in paths]
+
+    @staticmethod
+    def _scale_src(paths, names) -> Tuple[int, ...]:
+        """scale-leaf index -> its KV leaf's index (same path, name
+        minus the "_scale" suffix); -1 elsewhere."""
+        by_path = {p: i for i, p in enumerate(paths)}
+        src = []
+        for p, name in zip(paths, names):
+            if name.endswith("_scale"):
+                src.append(by_path[p.replace(name, name[: -len("_scale")])])
+            else:
+                src.append(-1)
+        return tuple(src)
+
+    def insert_paged(
+        self,
+        slot: int,
+        row_cache,
+        first,
+        pos0: int,
+        budget: int,
+        page_ids: Sequence[int],
+        shared_n: int,
+        row_seen=None,
+    ) -> None:
+        """Occupy ``slot`` with a prefilled contiguous row scattered
+        into ``page_ids`` (row refs already taken by
+        ``acquire_pages``); the first ``shared_n`` ids are prefix pages
+        attached by reference, never written."""
+        paths, names, leaves, treedef = self._pool_flat()
+        row_leaves = self._aligned_row(paths, row_cache)
+        table_row = np.zeros((self.per_row,), np.int32)
+        table_row[: len(page_ids)] = page_ids
+        quant = self.model.cfg.kv_quant == "int8"
+        leaves, self.token, self.pos, self.done, self.remaining, \
+            self.seen = _paged_insert_jit(
+                tuple(leaves), tuple(row_leaves), jnp.asarray(table_row),
+                slot, shared_n * self.page, first, pos0, budget,
+                self.token, self.pos, self.done, self.remaining,
+                self.seen, row_seen,
+                names=names, scale_src=self._scale_src(paths, names),
+                page=self.page, quant=quant,
+            )
+        self.cache = jax.tree_util.tree_unflatten(treedef, list(leaves))
+        self.slot_pages[slot] = list(page_ids)
+
+    def prefill_shared(self, prompt: Sequence[int], shared_ids, rng):
+        """Prefix-hit admission: attach ``shared_ids``' pages to a
+        fresh row cache, prefill only the suffix. Same return contract
+        as ``tpufw.infer.slots.prefill_row`` — (row_cache, first_arr,
+        first_int, done0, seen)."""
+        if self._row_shapes is None:
+            self._row_shapes = _row_zeros_tree(self.row_model, self.params)
+        row_tree = self._row_shapes
+        paths, names, leaves, _ = self._pool_flat()
+        row_paths, _, row_leaves, row_treedef = _flatten_with_names(
+            row_tree
+        )
+        row_map = dict(zip(row_paths, row_leaves))
+        aligned = [row_map.get(p) for p in paths]
+        quant = self.model.cfg.kv_quant == "int8"
+        src = self._scale_src(paths, names)
+        scale_of = tuple(
+            src.index(i) if i in src else -1 for i in range(len(paths))
+        )
+        attached = _attach_shared_jit(
+            tuple(aligned), tuple(leaves),
+            jnp.asarray(np.asarray(shared_ids, np.int32)),
+            names=names, scale_of=scale_of, page=self.page, quant=quant,
+        )
+        row_cache = jax.tree_util.tree_unflatten(
+            row_treedef, [a for a in attached if a is not None]
+        )
+        length = len(shared_ids) * self.page
+        suffix = jnp.asarray(
+            np.asarray(prompt[length:], np.int32)[None, :]
+        )
+        full = jnp.asarray(np.asarray(prompt, np.int32)[None, :])
+        cache, first, done, seen = _suffix_prefill_jit(
+            self.row_model, self.params, row_cache, suffix, full,
+            length, rng, sampling=self.sampling, eos_id=self.eos_id,
+        )
+        return cache, first, int(np.asarray(first)[0]), done, seen
+
+    def release_slot(self, slot: int) -> int:
+        """Free ``slot``: freeze its masks, zero its page-table row,
+        return its pages to the allocator. Returns pages actually freed
+        (shared/held pages may stay resident)."""
+        self.done, self.remaining = _retire_jit(
+            self.done, self.remaining, slot
+        )
+        paths, names, leaves, treedef = self._pool_flat()
+        t_idx = [i for i, n in enumerate(names) if n == "page_table"]
+        cleared = _clear_tables_jit(
+            tuple(leaves[i] for i in t_idx), slot
+        )
+        for i, t in zip(t_idx, cleared):
+            leaves[i] = t
+        self.cache = jax.tree_util.tree_unflatten(treedef, leaves)
+        freed = self.allocator.release(self.slot_pages[slot])
+        self.slot_pages[slot] = []
+        return freed
+
+    def retire(self, slot: int) -> None:
+        """Error-path retire — page-aware (frees the row's pages)."""
+        self.release_slot(slot)
+
+    def insert(self, *a, **k):  # pragma: no cover - guard rail
+        raise TypeError(
+            "PagedSlotPool: use insert_paged (pages must be acquired "
+            "through the allocator first)"
+        )

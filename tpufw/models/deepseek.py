@@ -58,6 +58,7 @@ from tpufw.models.llama import (
 )
 from tpufw.models.mixtral import MoEMLP
 from tpufw.ops.attention import multi_head_attention
+from tpufw.ops.quant import dequantize_kv, quantize_kv
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,6 +106,12 @@ class DeepseekConfig:
     # int8 weight-only serving (tpufw.ops.quant): projections and
     # routed/shared experts go int8; kv_b and routers stay fp.
     quantized_weights: bool = False
+    # Paged latent-KV cache — same contract as tpufw.models.llama
+    # LlamaConfig.kv_page/kv_pages/kv_quant, applied to the c_kv/k_pe
+    # latent arenas (tpufw.infer.pages).
+    kv_page: int = 0
+    kv_pages: int = 0
+    kv_quant: str = ""
     # --- DeepSeek MoE FFN (0 routed experts = dense everywhere) ---
     # Fine-grained routed experts per MoE layer.
     n_routed_experts: int = 0
@@ -481,47 +488,134 @@ class MLAttention(nn.Module):
         kvr, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
         dn = cfg.qk_nope_head_dim
 
-        cc = self.variable(
-            "cache", "cached_ckv",
-            jnp.zeros, (b, cfg.max_seq_len, kvr), cfg.dtype,
-        )
-        cp = self.variable(
-            "cache", "cached_kpe",
-            jnp.zeros, (b, cfg.max_seq_len, dr), cfg.dtype,
-        )
-        cseg = self.variable(
-            "cache", "cached_segment_ids",
-            jnp.zeros, (b, cfg.max_seq_len), jnp.int32,
-        )
-        cursor = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
-        )
-        cur = cursor.value
         seg = (
             jnp.ones((b, t), jnp.int32) if segment_ids is None
             else segment_ids.astype(jnp.int32)
         )
-        if cur.ndim == 0:
-            cc.value = jax.lax.dynamic_update_slice(
-                cc.value, c_kv.astype(cfg.dtype), (0, cur, 0)
+        if getattr(cfg, "kv_page", 0):
+            # Paged latent arenas — layout/masking contract mirrors
+            # llama Attention._paged_cached_attention (page 0 reserved,
+            # gather reconstructs the logical row in slot order, junk
+            # beyond the cursor dies in the causal fill below).
+            if t != 1:
+                raise ValueError(
+                    "paged KV cache is decode-only (t == 1): prefill "
+                    "runs contiguous and is paged at insert "
+                    "(tpufw.infer.pages)"
+                )
+            page, n_pages = cfg.kv_page, cfg.kv_pages
+            if cfg.max_seq_len % page:
+                raise ValueError(
+                    f"kv_page={page} must divide "
+                    f"max_seq_len={cfg.max_seq_len}"
+                )
+            per_row = cfg.max_seq_len // page
+            quant = cfg.kv_quant == "int8"
+            kv_dtype = jnp.int8 if quant else cfg.dtype
+            cc = self.variable(
+                "cache", "cached_ckv",
+                jnp.zeros, (n_pages, page, kvr), kv_dtype,
             )
-            cp.value = jax.lax.dynamic_update_slice(
-                cp.value, k_pe.astype(cfg.dtype), (0, cur, 0)
+            cp = self.variable(
+                "cache", "cached_kpe",
+                jnp.zeros, (n_pages, page, dr), kv_dtype,
             )
-            cseg.value = jax.lax.dynamic_update_slice(
-                cseg.value, seg, (0, cur)
+            cseg = self.variable(
+                "cache", "cached_segment_ids",
+                jnp.zeros, (n_pages, page), jnp.int32,
             )
-            cur_w = cur
+            table = self.variable(
+                "cache", "page_table", jnp.zeros, (b, per_row), jnp.int32
+            )
+            cursor = self.variable(
+                "cache", "cache_index", jnp.zeros, (b,), jnp.int32
+            )
+            if quant:
+                ccs = self.variable(
+                    "cache", "cached_ckv_scale",
+                    jnp.zeros, (n_pages, page), jnp.float32,
+                )
+                cps = self.variable(
+                    "cache", "cached_kpe_scale",
+                    jnp.zeros, (n_pages, page), jnp.float32,
+                )
+            cur = cursor.value
+            cur_w = jnp.minimum(cur, cfg.max_seq_len - 1)
+            phys = table.value[jnp.arange(b), cur_w // page]
+            off = cur_w % page
+            if quant:
+                qc, sc = quantize_kv(c_kv[:, 0], n_feat=1)
+                qp, sp = quantize_kv(k_pe[:, 0], n_feat=1)
+                cc.value = cc.value.at[phys, off].set(qc)
+                cp.value = cp.value.at[phys, off].set(qp)
+                ccs.value = ccs.value.at[phys, off].set(sc)
+                cps.value = cps.value.at[phys, off].set(sp)
+            else:
+                cc.value = cc.value.at[phys, off].set(
+                    c_kv[:, 0].astype(cfg.dtype)
+                )
+                cp.value = cp.value.at[phys, off].set(
+                    k_pe[:, 0].astype(cfg.dtype)
+                )
+            cseg.value = cseg.value.at[phys, off].set(seg[:, 0])
+            cursor.value = cur + t
+            idx = table.value
+            s = cfg.max_seq_len
+            if quant:
+                ckv_all = dequantize_kv(
+                    cc.value[idx], ccs.value[idx], cfg.dtype
+                ).reshape(b, s, kvr)
+                kpe_all = dequantize_kv(
+                    cp.value[idx], cps.value[idx], cfg.dtype
+                ).reshape(b, s, dr)
+            else:
+                ckv_all = cc.value[idx].reshape(b, s, kvr)
+                kpe_all = cp.value[idx].reshape(b, s, dr)
+            cseg_all = cseg.value[idx].reshape(b, s)
         else:
-            # Per-row cursors [B] (tpufw.infer.slots pool decode) — see
-            # llama Attention._cached_attention for the clamp rationale.
-            cur_w = jnp.minimum(cur, cfg.max_seq_len - t)
-            rows = jnp.arange(b)[:, None]
-            cols = cur_w[:, None] + jnp.arange(t)[None, :]
-            cc.value = cc.value.at[rows, cols].set(c_kv.astype(cfg.dtype))
-            cp.value = cp.value.at[rows, cols].set(k_pe.astype(cfg.dtype))
-            cseg.value = cseg.value.at[rows, cols].set(seg)
-        cursor.value = cur + t
+            cc = self.variable(
+                "cache", "cached_ckv",
+                jnp.zeros, (b, cfg.max_seq_len, kvr), cfg.dtype,
+            )
+            cp = self.variable(
+                "cache", "cached_kpe",
+                jnp.zeros, (b, cfg.max_seq_len, dr), cfg.dtype,
+            )
+            cseg = self.variable(
+                "cache", "cached_segment_ids",
+                jnp.zeros, (b, cfg.max_seq_len), jnp.int32,
+            )
+            cursor = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            cur = cursor.value
+            if cur.ndim == 0:
+                cc.value = jax.lax.dynamic_update_slice(
+                    cc.value, c_kv.astype(cfg.dtype), (0, cur, 0)
+                )
+                cp.value = jax.lax.dynamic_update_slice(
+                    cp.value, k_pe.astype(cfg.dtype), (0, cur, 0)
+                )
+                cseg.value = jax.lax.dynamic_update_slice(
+                    cseg.value, seg, (0, cur)
+                )
+                cur_w = cur
+            else:
+                # Per-row cursors [B] (tpufw.infer.slots pool decode) —
+                # see llama Attention._cached_attention for the clamp
+                # rationale.
+                cur_w = jnp.minimum(cur, cfg.max_seq_len - t)
+                rows = jnp.arange(b)[:, None]
+                cols = cur_w[:, None] + jnp.arange(t)[None, :]
+                cc.value = cc.value.at[rows, cols].set(
+                    c_kv.astype(cfg.dtype)
+                )
+                cp.value = cp.value.at[rows, cols].set(
+                    k_pe.astype(cfg.dtype)
+                )
+                cseg.value = cseg.value.at[rows, cols].set(seg)
+            cursor.value = cur + t
+            ckv_all, kpe_all, cseg_all = cc.value, cp.value, cseg.value
 
         w_uk, w_uv = kv_b[..., :dn], kv_b[..., dn:]  # [kvr, H, dn/dv]
         # Absorb W_uk into the query: [B,T,H,dn] x [kvr,H,dn] -> latent
@@ -534,11 +628,11 @@ class MLAttention(nn.Module):
         s = cfg.max_seq_len
         logits = (
             jnp.einsum(
-                "bthr,bsr->bhts", q_lat, cc.value,
+                "bthr,bsr->bhts", q_lat, ckv_all,
                 preferred_element_type=jnp.float32,
             )
             + jnp.einsum(
-                "bthd,bsd->bhts", q_pe.astype(cfg.dtype), cp.value,
+                "bthd,bsd->bhts", q_pe.astype(cfg.dtype), kpe_all,
                 preferred_element_type=jnp.float32,
             )
         ) * (float(cfg.qk_head_dim) ** -0.5)
@@ -549,13 +643,13 @@ class MLAttention(nn.Module):
         mask = slot_pos >= jnp.arange(s)  # [.,T,S]
         if mask.ndim == 2:
             mask = mask[None]
-        seg_mask = seg[:, :, None] == cseg.value[:, None, :]  # [B,T,S]
+        seg_mask = seg[:, :, None] == cseg_all[:, None, :]  # [B,T,S]
         logits = jnp.where(
             (mask & seg_mask)[:, None, :, :], logits, -1e30
         )
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
         # Attention-weighted latents, then ONE W_uv application.
-        ctx_lat = jnp.einsum("bhts,bsr->bthr", probs, cc.value)
+        ctx_lat = jnp.einsum("bhts,bsr->bthr", probs, ckv_all)
         return jnp.einsum(
             "bthr,rhd->bthd", ctx_lat, w_uv.astype(cfg.dtype)
         )

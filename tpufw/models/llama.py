@@ -30,6 +30,7 @@ from jax import ad_checkpoint
 from flax import linen as nn
 
 from tpufw.ops import multi_head_attention, rms_norm
+from tpufw.ops.quant import dequantize_kv, quantize_kv
 
 Dtype = Any
 
@@ -131,6 +132,18 @@ class LlamaConfig:
     # tree; this flag makes the modules DECLARE the quantized form.
     # Serving-only — there is no gradient through the rounded weights.
     quantized_weights: bool = False
+    # Paged KV cache (tpufw.infer.pages): kv_page > 0 replaces the
+    # contiguous per-row [B, max_seq_len] KV cache with a global page
+    # arena of ``kv_pages`` fixed-size pages (``kv_page`` slots each)
+    # plus a per-row page table, so HBM holds pages proportional to
+    # TOKENS IN FLIGHT rather than rows x max_seq_len, and matching
+    # prompt prefixes share pages across rows. Decode-only (t == 1);
+    # page 0 is reserved as a causally-masked junk sink. kv_quant
+    # "int8" stores the paged K/V as int8 + per-token fp32 scales
+    # (quantized at append, dequantized on read), halving KV bytes.
+    kv_page: int = 0
+    kv_pages: int = 0
+    kv_quant: str = ""
 
     def decode_config(self) -> "LlamaConfig":
         """This architecture re-dressed for inference: KV-cache on, remat
@@ -604,6 +617,8 @@ class Attention(nn.Module):
         (prompt pad slots stay 0 too, handled by the same mechanism).
         """
         cfg = self.cfg
+        if getattr(cfg, "kv_page", 0):
+            return self._paged_cached_attention(q, k, v, segment_ids)
         b, t = q.shape[:2]
         shape = (b, cfg.max_seq_len, cfg.n_kv_heads, cfg.head_dim)
         ck = self.variable("cache", "cached_key", jnp.zeros, shape, cfg.dtype)
@@ -658,6 +673,116 @@ class Attention(nn.Module):
             segment_ids=seg,
             kv_segment_ids=cseg.value,
             q_positions=slot_positions,
+            logits_soft_cap=getattr(cfg, "attn_logit_soft_cap", None),
+            sliding_window=self.window,
+            backend="xla",
+        )
+
+    def _paged_cached_attention(self, q, k, v, segment_ids):
+        """Paged KV-cache decode step (cfg.kv_page > 0, t == 1 only).
+
+        Storage is a global arena of ``kv_pages`` pages x ``kv_page``
+        slots shared by every row; ``page_table`` [B, S/page] maps each
+        row's logical slot j to physical page table[j // page], offset
+        j % page. The gather read reconstructs the logical [B, S] row
+        IN LOGICAL SLOT ORDER, so attention sees exactly what the
+        contiguous branch sees at every written slot and the output is
+        bit-equal at matching precision: unmapped table entries point at
+        reserved page 0, whose junk only ever surfaces at logical slots
+        strictly beyond the row's cursor, where the causal mask fills
+        the logit before softmax (exp underflows to exact 0.0, and
+        0.0 * finite-junk-V == 0.0). Occupancy, table churn, and cursor
+        motion are all DATA — one jitted program forever.
+        """
+        cfg = self.cfg
+        b, t = q.shape[:2]
+        if t != 1:
+            raise ValueError(
+                "paged KV cache is decode-only (t == 1): prefill runs "
+                "through a contiguous row cache and is scattered into "
+                "pages at insert (tpufw.infer.pages)"
+            )
+        page, n_pages = cfg.kv_page, cfg.kv_pages
+        if cfg.max_seq_len % page:
+            raise ValueError(
+                f"kv_page={page} must divide max_seq_len={cfg.max_seq_len}"
+            )
+        per_row = cfg.max_seq_len // page
+        quant = cfg.kv_quant == "int8"
+        kv_dtype = jnp.int8 if quant else cfg.dtype
+        shape = (n_pages, page, cfg.n_kv_heads, cfg.head_dim)
+        ck = self.variable("cache", "cached_key", jnp.zeros, shape, kv_dtype)
+        cv = self.variable(
+            "cache", "cached_value", jnp.zeros, shape, kv_dtype
+        )
+        cseg = self.variable(
+            "cache", "cached_segment_ids",
+            jnp.zeros, (n_pages, page), jnp.int32,
+        )
+        table = self.variable(
+            "cache", "page_table", jnp.zeros, (b, per_row), jnp.int32
+        )
+        # Per-row cursor from birth (the paged pool always decodes with
+        # one token per row) — no scalar branch to diverge on.
+        cursor = self.variable(
+            "cache", "cache_index", jnp.zeros, (b,), jnp.int32
+        )
+        if quant:
+            cks = self.variable(
+                "cache", "cached_key_scale",
+                jnp.zeros, (n_pages, page), jnp.float32,
+            )
+            cvs = self.variable(
+                "cache", "cached_value_scale",
+                jnp.zeros, (n_pages, page), jnp.float32,
+            )
+        cur = cursor.value
+        seg = (
+            jnp.ones((b, t), jnp.int32) if segment_ids is None
+            else segment_ids.astype(jnp.int32)
+        )
+        # Same write-window clamp as the contiguous per-row branch: a
+        # done-but-still-stepped row keeps scattering in bounds. Its
+        # writes land either in its own private last page (the
+        # allocator never shares a row's final page) or, once retired
+        # (table zeroed), in reserved page 0.
+        wslot = jnp.minimum(cur, cfg.max_seq_len - 1)
+        phys = table.value[jnp.arange(b), wslot // page]
+        off = wslot % page
+        if quant:
+            qk, sk = quantize_kv(k[:, 0], n_feat=2)
+            qv, sv = quantize_kv(v[:, 0], n_feat=2)
+            ck.value = ck.value.at[phys, off].set(qk)
+            cv.value = cv.value.at[phys, off].set(qv)
+            cks.value = cks.value.at[phys, off].set(sk)
+            cvs.value = cvs.value.at[phys, off].set(sv)
+        else:
+            ck.value = ck.value.at[phys, off].set(k[:, 0].astype(cfg.dtype))
+            cv.value = cv.value.at[phys, off].set(v[:, 0].astype(cfg.dtype))
+        cseg.value = cseg.value.at[phys, off].set(seg[:, 0])
+        cursor.value = cur + t
+        # Gather the logical view: [B, per_row] table -> [B, S, ...].
+        idx = table.value
+        s = cfg.max_seq_len
+        feat = (cfg.n_kv_heads, cfg.head_dim)
+        if quant:
+            k_all = dequantize_kv(
+                ck.value[idx], cks.value[idx], cfg.dtype
+            ).reshape(b, s, *feat)
+            v_all = dequantize_kv(
+                cv.value[idx], cvs.value[idx], cfg.dtype
+            ).reshape(b, s, *feat)
+        else:
+            k_all = ck.value[idx].reshape(b, s, *feat)
+            v_all = cv.value[idx].reshape(b, s, *feat)
+        return multi_head_attention(
+            q,
+            k_all,
+            v_all,
+            causal=True,
+            segment_ids=seg,
+            kv_segment_ids=cseg.value[idx].reshape(b, s),
+            q_positions=wslot[:, None],
             logits_soft_cap=getattr(cfg, "attn_logit_soft_cap", None),
             sliding_window=self.window,
             backend="xla",

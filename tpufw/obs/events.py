@@ -44,6 +44,7 @@ SCHEMA: Dict[str, FrozenSet[str]] = {
     ),
     "serve_request": frozenset({"rows", "new_tokens", "latency_s"}),
     "serve_pool_switch": frozenset({"cache_len", "slots"}),
+    "serve_prefix": frozenset({"hit", "shared_pages", "prompt_tokens"}),
     "goodput": frozenset({"wall_s", "goodput_ratio"}),
     "hang": frozenset({"timeout_s", "armed_for_s"}),
 }

@@ -139,6 +139,38 @@ def quantize_params(params: Any) -> Any:
     return quantized
 
 
+def quantize_kv(kv: jax.Array, n_feat: int = 1) -> tuple:
+    """Per-token symmetric int8 quantization for KV-cache appends.
+
+    ``kv`` is [..., *feat]: the trailing ``n_feat`` dims are the feature
+    block quantized together (llama K/V: (heads, head_dim) -> n_feat=2;
+    MLA latents: (rank,) -> n_feat=1); every leading dim keeps its own
+    scale. Returns (q int8, scale fp32) with ``scale`` shaped like the
+    leading dims — the paged pool stores scales page-structured
+    ([n_pages, page_size]), one scale per token slot per page, so
+    appends are pure scatters (no running-amax requantization of
+    already-resident tokens)."""
+    axes = tuple(range(kv.ndim - n_feat, kv.ndim))
+    amax = jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=axes)
+    scale = (amax / 127.0 + 1e-12).astype(jnp.float32)
+    bshape = scale.shape + (1,) * n_feat
+    q = jnp.clip(
+        jnp.round(kv.astype(jnp.float32) / scale.reshape(bshape)),
+        -127, 127,
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Inverse of ``quantize_kv``: int8 codes x broadcast fp32 scales,
+    accumulated in fp32 and cast to the activation ``dtype`` at the end
+    (the cast and multiply fuse into the attention reads under XLA —
+    HBM only ever streams the int8 bytes plus one fp32 per token)."""
+    n_feat = q.ndim - scale.ndim
+    bshape = scale.shape + (1,) * n_feat
+    return (q.astype(jnp.float32) * scale.reshape(bshape)).astype(dtype)
+
+
 def quant_contract(
     x: jax.Array, q_kernel: jax.Array, scale: jax.Array, n_in: int
 ) -> jax.Array:

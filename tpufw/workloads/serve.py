@@ -36,7 +36,7 @@ from tpufw.obs import goodput as obs_goodput
 from tpufw.obs import trace as obs_trace
 from tpufw.obs.health import NULL_WATCHDOG
 from tpufw.obs.registry import Registry as ObsRegistry
-from tpufw.workloads.env import env_float, env_int, env_str
+from tpufw.workloads.env import env_bool, env_float, env_int, env_str
 
 _T0 = time.time()
 
@@ -933,6 +933,10 @@ class _SlotScheduler:
         tracer=None,
         goodput=None,
         watchdog=None,
+        page: Optional[int] = None,
+        kv_quant: Optional[str] = None,
+        prefix_cache: Optional[bool] = None,
+        arena_pages: Optional[int] = None,
     ):
         import jax
         import numpy as np
@@ -963,12 +967,66 @@ class _SlotScheduler:
         self.cache_floor = env_int("serve_cache_floor", 128)
         self.wait_s = env_int("batch_wait_ms", 5) / 1000.0
         self.prefill_chunk = env_int("prefill_chunk", 0) or None
+        # Paged-KV knobs: ctor kwargs win over the env so bench can
+        # run both modes in one process without mutating os.environ.
+        # page=0 keeps the legacy contiguous SlotPool bit-for-bit.
+        self.page = (
+            env_int("serve_page", 0) if page is None else int(page)
+        )
+        self.kv_quant = (
+            env_str("serve_kv_quant", "")
+            if kv_quant is None
+            else str(kv_quant)
+        )
+        self.prefix_enabled = (
+            env_bool("serve_prefix_cache", True)
+            if prefix_cache is None
+            else bool(prefix_cache)
+        )
+        self.arena_pages = arena_pages
+        if self.page:
+            cap = model.cfg.max_seq_len
+            # Every cache-ladder rung is a pow2 >= cache_floor or the
+            # model cap, so "page is pow2 and page <= floor and page
+            # divides cap" guarantees page | cache_len at every rung.
+            if self.page & (self.page - 1) or self.page < 1:
+                raise ValueError(
+                    f"TPUFW_SERVE_PAGE={self.page}: page size must be "
+                    "a power of two"
+                )
+            if self.page > self.cache_floor:
+                raise ValueError(
+                    f"TPUFW_SERVE_PAGE={self.page} exceeds the cache "
+                    f"floor ({self.cache_floor}); pages must divide "
+                    "every cache-ladder rung"
+                )
+            if cap % self.page:
+                raise ValueError(
+                    f"TPUFW_SERVE_PAGE={self.page} does not divide "
+                    f"max_seq_len={cap}"
+                )
+            if self.kv_quant not in ("", "int8"):
+                raise ValueError(
+                    f"TPUFW_SERVE_KV_QUANT={self.kv_quant!r}: "
+                    "expected '' or 'int8'"
+                )
+            from tpufw.infer import pages as pages_mod
+
+            self._pages_mod = pages_mod
         if metrics is not None:
             metrics.register(
                 "retired_rows_total",
                 "wasted_slot_steps_total",
                 "pool_switches_total",
             )
+            if self.page:
+                # Feature-gated (register = expose at 0): legacy-mode
+                # /metrics stays byte-identical with paging off.
+                metrics.register(
+                    "prefix_hits_total",
+                    "prefix_misses_total",
+                    "pages_freed_total",
+                )
             metrics.registry.histogram(
                 "tpufw_serve_join_latency_seconds",
                 "Request submit-to-first-slot-insert latency",
@@ -1004,6 +1062,21 @@ class _SlotScheduler:
     @property
     def slots_occupied(self) -> int:
         return self._n_active
+
+    @property
+    def pages_total(self) -> int:
+        """Arena capacity of the CURRENT pool (0 before first build /
+        in contiguous mode) — page 0 is the reserved junk sink and
+        never allocatable, so it is excluded."""
+        if not self.page or self._pool is None:
+            return 0
+        return self._pool.allocator.capacity
+
+    @property
+    def pages_in_use(self) -> int:
+        if not self.page or self._pool is None:
+            return 0
+        return self._pool.allocator.in_use
 
     def submit(self, prompts: list[list[int]], max_new: int, sampling=None):
         pend = _Pending(prompts, max_new, sampling)
@@ -1047,7 +1120,13 @@ class _SlotScheduler:
         jobs = []
         req = _SlotReq(pend, sampling, [])
         for prompt in pend.prompts:
-            pb = _bucket(len(prompt), 64)
+            if self.page:
+                # Paged rows prefill at their EXACT width (no 64-token
+                # bucket): padding would burn whole pages per row and
+                # misalign the prompt's page-granular prefix chunks.
+                pb = max(len(prompt), 1)
+            else:
+                pb = _bucket(len(prompt), 64)
             # Validate at submit (not mid-pool): prefill writes pb
             # slots, decode writes max_new - 1 more (the first token
             # comes out of prefill).
@@ -1057,6 +1136,16 @@ class _SlotScheduler:
                     f"max_new_tokens ({pend.max_new}) exceeds the KV "
                     f"cache (max_seq_len={cap})"
                 )
+            if self.page and self.arena_pages is not None:
+                need = -(-(pb + pend.max_new - 1) // self.page)
+                if need > self.arena_pages - 1:
+                    # Reject now, not in the admission loop: a row
+                    # that can NEVER fit the arena would deadlock the
+                    # FIFO forever (page 0 is reserved).
+                    raise ValueError(
+                        f"row needs {need} KV pages but the arena "
+                        f"holds {self.arena_pages - 1}"
+                    )
             jobs.append(_SlotJob(
                 req,
                 prompt,
@@ -1099,10 +1188,12 @@ class _SlotScheduler:
             finally:
                 self._watchdog.disarm()
 
-    def _pool_model(self, cache_len: int):
-        """Model variant with the pool's KV budget — built inline;
-        flax modules hash structurally, so equal configs hit the jit
-        caches without memoization (same trick as _Server._model_for)."""
+    def _row_model(self, cache_len: int):
+        """CONTIGUOUS model variant with the pool's KV budget — built
+        inline; flax modules hash structurally, so equal configs hit
+        the jit caches without memoization (same trick as
+        _Server._model_for). In paged mode this is the B=1 prefill
+        model (prefill stays dense; paging starts at row insert)."""
         import dataclasses
 
         if cache_len == self.model.cfg.max_seq_len:
@@ -1111,19 +1202,59 @@ class _SlotScheduler:
             dataclasses.replace(self.model.cfg, max_seq_len=cache_len)
         )
 
+    def _pool_model(self, cache_len: int):
+        """Model variant the POOL decodes with: contiguous rows by
+        default; with TPUFW_SERVE_PAGE set, the paged-arena variant
+        (kv_page/kv_pages/kv_quant route the models' cached-attention
+        through the page table)."""
+        import dataclasses
+
+        if not self.page:
+            return self._row_model(cache_len)
+        per_row = cache_len // self.page
+        n_pages = (
+            self.arena_pages
+            if self.arena_pages is not None
+            # +1 for the reserved junk-sink page 0: the default arena
+            # holds exactly n_slots full rows, same HBM working set
+            # as the contiguous pool it replaces.
+            else self.n_slots * per_row + 1
+        )
+        return type(self.model)(
+            dataclasses.replace(
+                self.model.cfg,
+                max_seq_len=cache_len,
+                kv_page=self.page,
+                kv_pages=n_pages,
+                kv_quant=self.kv_quant,
+            )
+        )
+
     def _build_pool(self, key) -> None:
         cache_len, sampling = key
         with self._tracer.span(
             "serve_pool_build", cache_len=cache_len, slots=self.n_slots
         ):
-            self._pool = self._slots_mod.SlotPool.create(
-                self._pool_model(cache_len),
-                self.params,
-                self.n_slots,
-                sampling=sampling,
-                pad_id=0,
-                eos_id=self._eos,
-            )
+            if self.page:
+                self._pool = self._pages_mod.PagedSlotPool.create_paged(
+                    self._pool_model(cache_len),
+                    self._row_model(cache_len),
+                    self.params,
+                    self.n_slots,
+                    sampling=sampling,
+                    pad_id=0,
+                    eos_id=self._eos,
+                    prefix_cache=self.prefix_enabled,
+                )
+            else:
+                self._pool = self._slots_mod.SlotPool.create(
+                    self._pool_model(cache_len),
+                    self.params,
+                    self.n_slots,
+                    sampling=sampling,
+                    pad_id=0,
+                    eos_id=self._eos,
+                )
         self._pool_key = key
         self._slots = [None] * self.n_slots
         self._n_active = 0
@@ -1202,9 +1333,29 @@ class _SlotScheduler:
         admitted = False
         while free and req.next_job < len(req.jobs):
             job = req.jobs[req.next_job]
+            grant = None
+            if self.page:
+                # Page-budget admission: the row needs every page of
+                # its prompt+budget up front (writes may land anywhere
+                # in that window). None = arena full even after trie
+                # eviction — stop admitting and let retires free pages
+                # (FIFO holds: nothing overtakes within the pool key).
+                grant = self._pool.acquire_pages(
+                    job.prompt, len(job.prompt) + job.max_new - 1
+                )
+                if grant is None:
+                    break
             try:
-                used_slot = self._admit_job(req, job, free[0])
+                # Legacy mode keeps the historical 3-arg call (tests
+                # spy on _admit_job with that arity).
+                used_slot = (
+                    self._admit_job(req, job, free[0], grant)
+                    if grant is not None
+                    else self._admit_job(req, job, free[0])
+                )
             except Exception as e:  # noqa: BLE001 — isolate request
+                if grant is not None:
+                    self._free_pages(self._pool.release_pages(grant[0]))
                 self._fail_req(req, e)
                 return admitted
             req.next_job += 1
@@ -1225,32 +1376,66 @@ class _SlotScheduler:
             self._finish(req)
         return admitted
 
-    def _admit_job(self, req: _SlotReq, job: _SlotJob, slot: int) -> bool:
+    def _admit_job(
+        self, req: _SlotReq, job: _SlotJob, slot: int, grant=None
+    ) -> bool:
         """Prefill one row and (unless it finishes at its first
         token) insert it into ``slot``. Returns True iff the slot was
-        consumed."""
+        consumed. ``grant`` is the paged mode's (page_ids, shared_n)
+        from acquire_pages — this method owns releasing it on the
+        early-finish path (the caller releases on exceptions)."""
         jax = self._jax
         # Namespaced, replayable prefill stream: a fresh base key per
-        # call, folded with the monotonic job index.
+        # call, folded with the monotonic job index. The paged shared
+        # path draws the SAME per-token streams (split_prefill_keys),
+        # so a prefix hit never perturbs sampled outputs.
         rng = jax.random.fold_in(
             jax.random.key(self._seed_base), self._job_index
         )
         self._job_index += 1
+        if grant is not None:
+            page_ids, shared_n = grant
+            if self.prefix_enabled:
+                hit = shared_n > 0
+                if self._metrics is not None:
+                    self._metrics.inc(
+                        "prefix_hits_total"
+                        if hit
+                        else "prefix_misses_total"
+                    )
+                self._events.emit(
+                    "serve_prefix",
+                    hit=hit,
+                    shared_pages=shared_n,
+                    prompt_tokens=len(job.prompt),
+                )
         with self._tracer.span(
             "serve_prefill", prompt=len(job.prompt), width=job.p_bucket
         ):
-            cache, _first, first_int, _done, seen = (
-                self._slots_mod.prefill_row(
-                    self._pool.model,
-                    self.params,
-                    job.prompt,
-                    rng,
-                    sampling=self._pool.sampling,
-                    eos_id=self._eos,
-                    pad_to=job.p_bucket,
-                    prefill_chunk_size=self.prefill_chunk,
+            if grant is not None and shared_n > 0:
+                cache, _first, first_int, _done, seen = (
+                    self._pool.prefill_shared(
+                        job.prompt, page_ids[:shared_n], rng
+                    )
                 )
-            )
+            else:
+                cache, _first, first_int, _done, seen = (
+                    # tpulint: disable=TPU003 — exclusive if/else arms:
+                    # exactly ONE of prefill_shared/prefill_row consumes
+                    # this job's rng.
+                    self._slots_mod.prefill_row(
+                        getattr(
+                            self._pool, "row_model", self._pool.model
+                        ),
+                        self.params,
+                        job.prompt,
+                        rng,
+                        sampling=self._pool.sampling,
+                        eos_id=self._eos,
+                        pad_to=job.p_bucket,
+                        prefill_chunk_size=self.prefill_chunk,
+                    )
+                )
         job.tokens.append(first_int)
         job.unflushed.append(first_int)
         if self._metrics is not None:
@@ -1260,21 +1445,57 @@ class _SlotScheduler:
         ):
             # Finished at its first token: the row never occupies a
             # slot (the prefilled cache is dropped).
+            if grant is not None:
+                self._free_pages(self._pool.release_pages(page_ids))
             if self._metrics is not None:
                 self._metrics.inc("retired_rows_total")
             req.rows_left -= 1
             return False
-        self._pool.insert(
-            slot,
-            cache,
-            first_int,
-            len(job.prompt),
-            job.max_new - 1,
-            row_seen=seen,
-        )
+        if grant is not None:
+            self._pool.insert_paged(
+                slot,
+                cache,
+                first_int,
+                len(job.prompt),
+                job.max_new - 1,
+                page_ids,
+                shared_n,
+                row_seen=seen,
+            )
+            if self.prefix_enabled:
+                # Register AFTER insert: the pages now hold the full
+                # prompt's K/V. The trie holds its adopted ids so they
+                # outlive this row.
+                self._pool.register_prefix(job.prompt, page_ids)
+        else:
+            self._pool.insert(
+                slot,
+                cache,
+                first_int,
+                len(job.prompt),
+                job.max_new - 1,
+                row_seen=seen,
+            )
         self._slots[slot] = job
         self._n_active += 1
         return True
+
+    def _free_pages(self, freed: int) -> None:
+        if freed and self._metrics is not None:
+            self._metrics.inc("pages_freed_total", freed)
+
+    def _retire_slot(self, slot: int, *, device: bool) -> None:
+        """Vacate ``slot``. ``device=True`` also freezes the row's
+        done/remaining masks (error paths); natural completions
+        already froze themselves inside the decode step. Paged pools
+        always take the device path — it zeroes the slot's page-table
+        row before the pages go back on the free list."""
+        if self.page:
+            self._free_pages(self._pool.release_slot(slot))
+        elif device:
+            self._pool.retire(slot)
+        self._slots[slot] = None
+        self._n_active -= 1
 
     def _run_chunk(self) -> None:
         active = [
@@ -1317,10 +1538,10 @@ class _SlotScheduler:
             if len(job.tokens) >= job.max_new or (
                 self._eos is not None and row and row[-1] == self._eos
             ):
-                # Retire: host-side only — the device row froze
-                # itself via the done/remaining masks.
-                self._slots[slot] = None
-                self._n_active -= 1
+                # Retire: host-side in contiguous mode — the device
+                # row froze itself via the done/remaining masks. Paged
+                # mode also clears the page table and frees the pages.
+                self._retire_slot(slot, device=False)
                 if self._metrics is not None:
                     self._metrics.inc("retired_rows_total")
                 req.rows_left -= 1
@@ -1389,9 +1610,7 @@ class _SlotScheduler:
                 self._queue.remove(req)
         for i, job in enumerate(self._slots):
             if job is not None and job.req is req:
-                self._pool.retire(i)
-                self._slots[i] = None
-                self._n_active -= 1
+                self._retire_slot(i, device=True)
         pend = req.pend
         pend.error = e
         if pend.stream_q is not None:
@@ -1509,6 +1728,8 @@ class _Server:
                         "slots": env_int("serve_slots", 8),
                         "chunk": env_int("serve_chunk", 0)
                         or env_int("stream_chunk", 16),
+                        "page": env_int("serve_page", 0),
+                        "kv_quant": env_str("serve_kv_quant", ""),
                     }
                 }
             )
@@ -1586,6 +1807,15 @@ class _Server:
                     "wasted_slot_steps_total",
                     "pool_switches_total",
                 )
+                if self._batcher.page:
+                    # Paged-only names: resetting in contiguous mode
+                    # would CREATE them (reset = zero the counter),
+                    # leaking paged series into legacy /metrics.
+                    self.metrics.reset(
+                        "prefix_hits_total",
+                        "prefix_misses_total",
+                        "pages_freed_total",
+                    )
                 self.metrics.registry.histogram(
                     "tpufw_serve_join_latency_seconds"
                 ).reset()
@@ -1668,6 +1898,9 @@ class _Server:
         if isinstance(self._batcher, _SlotScheduler):
             g["slots_occupied"] = float(self._batcher.slots_occupied)
             g["slots_total"] = float(self._batcher.slots_total)
+            if self._batcher.page:
+                g["pages_in_use"] = float(self._batcher.pages_in_use)
+                g["pages_total"] = float(self._batcher.pages_total)
         return g
 
     def _run_tick(
