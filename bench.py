@@ -1649,6 +1649,127 @@ def _worker() -> int:
             moe = {"error": f"{type(e).__name__}: {e}"[:500]}
         _drop_caches(jax)
     _attach("moe", moe)
+
+    # Pipeline-schedule tier: the same transformer stack driven through
+    # each pipeline schedule at equal (S, M) so the schedule-selection
+    # table in docs/PERF.md is backed by measured step walls, not just
+    # the bubble arithmetic. S=4 deliberately: at S=2 the interleaved
+    # schedule's per-step lockstep win over 1F1B is analytically ZERO
+    # (docs/PERF.md), so a 2-stage measurement could not show the
+    # separation this tier exists to prove. Measured bubble via the
+    # two-point slope method: the per-microbatch marginal cost
+    # u = (T(2M) - T(M)) / M cancels the constant ramp overhead, and
+    # 1 - u*M/T(M) is the idle fraction of the step.
+    pipeline = None
+    if on_tpu and env_bool("bench_pipeline", True):
+        pipeline = _aux_skip(360)
+    if on_tpu and pipeline is None and env_bool(
+        "bench_pipeline", True
+    ):
+        try:
+            import dataclasses as _dcp
+
+            from tpufw.configs import bench_model_config as _bmc
+            from tpufw.mesh import MeshConfig as _MCfg
+            from tpufw.parallel.pipeline import PipelineConfig as _PC
+            from tpufw.train import TrainerConfig as _TCp
+            from tpufw.tune.runner import (
+                make_pipeline_measure_fn as _mk_pl,
+            )
+            from tpufw.tune.space import Candidate as _Cand
+
+            pl_s, pl_v = 4, 2
+            n_dev = len(jax.devices())
+            if n_dev < pl_s:
+                pipeline = {
+                    "skipped": f"{n_dev} devices < {pl_s} pipeline "
+                    "stages (single-chip pods run the other tiers)"
+                }
+            else:
+                # 8 layers: divisible into the v*S = 8 interleaved
+                # chunks AND the 4 canonical stages.
+                pl_cfg = _dcp.replace(
+                    _bmc(), n_layers=8, max_seq_len=512
+                )
+                dxf = n_dev // pl_s
+                pl_mesh = _MCfg(pipe=pl_s, fsdp=dxf)
+                pl_m1, pl_m2 = 8, 16
+                # >= 1 batch row per microbatch per data x fsdp shard
+                # at the larger microbatch count.
+                pl_batch, pl_seq = pl_m2 * dxf, 512
+                pl_tc = _TCp(
+                    batch_size=pl_batch, seq_len=pl_seq,
+                    total_steps=4, warmup_steps=1,
+                )
+                pipeline = {
+                    "stages": pl_s,
+                    "n_virtual": pl_v,
+                    "microbatches": pl_m1,
+                    "batch_size": pl_batch,
+                    "seq_len": pl_seq,
+                    "schedules": {},
+                }
+                for pl_name in ("gpipe", "1f1b", "interleaved", "zb1"):
+                    pl_skip = _aux_skip(240)
+                    if pl_skip is not None:
+                        pipeline["schedules"][pl_name] = pl_skip
+                        continue
+                    try:
+                        pl_vv = pl_v if pl_name == "interleaved" else 1
+                        cand = _Cand(
+                            pipeline_schedule=pl_name,
+                            pipeline_vstages=pl_vv,
+                        )
+                        walls = {}
+                        for pl_m in (pl_m1, pl_m2):
+                            walls[pl_m] = _mk_pl(
+                                pl_cfg,
+                                _PC(
+                                    n_stages=pl_s,
+                                    n_microbatches=pl_m,
+                                ),
+                                pl_tc, pl_mesh, n_steps=3,
+                            )(cand)
+                        t1, t2 = walls[pl_m1], walls[pl_m2]
+                        u = (t2 - t1) / (pl_m2 - pl_m1)
+                        sched_pipe = _PC(
+                            n_stages=pl_s, n_microbatches=pl_m1,
+                            schedule=pl_name, n_virtual=pl_vv,
+                        )
+                        pipeline["schedules"][pl_name] = {
+                            "step_s": round(t1, 5),
+                            "step_s_2x_microbatches": round(t2, 5),
+                            "tokens_per_sec_per_chip": round(
+                                pl_batch * (pl_seq - 1) / t1 / n_dev,
+                                1,
+                            ),
+                            "bubble_analytic": round(
+                                sched_pipe.bubble_fraction(), 4
+                            ),
+                            "bubble_measured": round(
+                                max(0.0, 1.0 - u * pl_m1 / t1), 4
+                            ),
+                        }
+                    except Exception as e:  # noqa: BLE001
+                        pipeline["schedules"][pl_name] = {
+                            "error": f"{type(e).__name__}: {e}"[:400]
+                        }
+                    # Checkpoint per schedule: a watchdog kill during
+                    # zb1's compile must not erase the 1f1b number.
+                    _attach("pipeline", dict(pipeline))
+                il = pipeline["schedules"].get("interleaved", {})
+                fb = pipeline["schedules"].get("1f1b", {})
+                if "bubble_measured" in il and "bubble_measured" in fb:
+                    # The tier's acceptance bit: interleaving v=2
+                    # virtual stages must shrink the measured bubble
+                    # at equal (S, M).
+                    pipeline["interleaved_beats_1f1b"] = bool(
+                        il["bubble_measured"] < fb["bubble_measured"]
+                    )
+        except Exception as e:  # noqa: BLE001
+            pipeline = {"error": f"{type(e).__name__}: {e}"[:500]}
+        _drop_caches(jax)
+    _attach("pipeline", pipeline)
     return 0
 
 

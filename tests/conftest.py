@@ -219,6 +219,13 @@ _BUDGET_TIER_SLOW = frozenset(
     test_pipeline_1f1b.py::test_1f1b_packed_batch_matches_gpipe  # 6.0s
     test_pipeline_1f1b.py::test_1f1b_pipeline_trainer_learns  # 5.3s
     test_pipeline_1f1b.py::test_1f1b_pptp_matches_gpipe  # 5.9s
+    test_pipeline_interleaved.py::test_interleaved_four_stages  # 9.0s
+    test_pipeline_interleaved.py::test_interleaved_matches_gpipe_grads  # 11.0s
+    test_pipeline_interleaved.py::test_interleaved_pptp_matches_gpipe  # 6.1s
+    test_pipeline_interleaved.py::test_interleaved_qwen_bias_matches_gpipe  # 8.0s
+    test_pipeline_interleaved.py::test_zb1_four_stages  # 8.8s
+    test_pipeline_interleaved.py::test_zb1_matches_gpipe_grads  # 9.0s
+    test_pipeline_interleaved.py::test_zb1_qwen_bias_matches_gpipe  # 8.6s
     test_pipeline_mla.py::test_1f1b_matches_gpipe  # 10.4s
     test_pipeline_mla.py::test_grads_match_sequential  # 7.5s
     test_pipeline_mla.py::test_moe_pipeline_matches_grouped_oracle  # 6.0s

@@ -191,7 +191,7 @@ def test_1f1b_pipeline_trainer_learns(mesh):
 def test_unknown_schedule_is_loud():
     with pytest.raises(ValueError, match="unknown pipeline schedule"):
         PipelineConfig(
-            n_stages=2, n_microbatches=2, schedule="interleaved"
+            n_stages=2, n_microbatches=2, schedule="wavefront"
         ).validate(CFG, 4)
 
 

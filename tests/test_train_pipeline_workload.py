@@ -119,8 +119,32 @@ def test_schedule_and_moe_mesh_from_env(monkeypatch, devices8):
 
     _clear(monkeypatch)
     monkeypatch.setenv("TPUFW_PIPE_STAGES", "2")
-    monkeypatch.setenv("TPUFW_PIPE_SCHEDULE", "interleaved")
+    monkeypatch.setenv("TPUFW_PIPE_SCHEDULE", "wavefront")
     monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
     monkeypatch.setenv("TPUFW_BATCH_SIZE", "16")
     with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        build_trainer()
+
+    # New-style knob wins over the old spelling.
+    _clear(monkeypatch)
+    monkeypatch.setenv("TPUFW_PIPE_STAGES", "2")
+    monkeypatch.setenv("TPUFW_PIPE_SCHEDULE", "gpipe")
+    monkeypatch.setenv("TPUFW_PIPELINE_SCHEDULE", "1f1b")
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_BATCH_SIZE", "16")
+    monkeypatch.setenv("TPUFW_SEQ_LEN", "33")
+    itrainer, _ = build_trainer()
+    assert itrainer.pipe.schedule == "1f1b"
+
+    # Interleaved knobs reach PipelineConfig.validate intact: the tiny
+    # model's 2 layers can't split into v*S = 4 chunks, and the loud
+    # error proves both TPUFW_PIPELINE_* values got through.
+    _clear(monkeypatch)
+    monkeypatch.setenv("TPUFW_PIPE_STAGES", "2")
+    monkeypatch.setenv("TPUFW_PIPELINE_SCHEDULE", "interleaved")
+    monkeypatch.setenv("TPUFW_PIPELINE_VSTAGES", "2")
+    monkeypatch.setenv("TPUFW_MODEL", "llama3_tiny")
+    monkeypatch.setenv("TPUFW_BATCH_SIZE", "16")
+    monkeypatch.setenv("TPUFW_SEQ_LEN", "33")
+    with pytest.raises(ValueError, match="n_virtual"):
         build_trainer()

@@ -63,19 +63,37 @@ class PipelineConfig:
 
     ``schedule``: "gpipe" (autodiff through the microbatch stream;
     activation memory grows with n_microbatches; supports Llama, Gemma,
-    Mixtral incl. expert parallelism) or "1f1b" (manual-VJP
+    Mixtral incl. expert parallelism), "1f1b" (manual-VJP
     one-forward-one-backward, O(n_stages) activation memory — see
-    tpufw.parallel.pipeline_1f1b; Llama-family, data/fsdp/tensor)."""
+    tpufw.parallel.pipeline_1f1b; Llama-family, data/fsdp/tensor),
+    "interleaved" (1F1B over ``n_virtual`` non-contiguous model chunks
+    per device — bubble shrinks by the virtual-stage factor, see
+    tpufw.parallel.pipeline_interleaved), or "zb1" (ZB-H1-style
+    zero-bubble 1F1B: backward split into input-grad and weight-grad
+    phases, weight grads scheduled into former drain-bubble ticks —
+    see tpufw.parallel.pipeline_zb1).
+
+    ``n_virtual`` is the interleaved schedule's virtual-stage count v:
+    each device owns v chunks of n_layers/(v*n_stages) layers, stacked
+    ``[v, S, layers_per_chunk, ...]`` (the leading [v] axis replicated,
+    [S] sharded over ``pipe``). Other schedules keep v == 1 and the
+    canonical ``[S, layers_per_stage, ...]`` stacks."""
 
     n_stages: int
     n_microbatches: int
     schedule: str = "gpipe"
+    n_virtual: int = 1
+
+    @property
+    def virtual_layout(self) -> bool:
+        """True when stage stacks carry the leading [n_virtual] axis."""
+        return self.schedule == "interleaved"
 
     def validate(self, model: LlamaConfig, batch_size: int) -> None:
-        if self.schedule not in ("gpipe", "1f1b"):
+        if self.schedule not in ("gpipe", "1f1b", "interleaved", "zb1"):
             raise ValueError(
                 f"unknown pipeline schedule {self.schedule!r}; "
-                "expected 'gpipe' or '1f1b'"
+                "expected 'gpipe', '1f1b', 'interleaved', or 'zb1'"
             )
         _check_model_split(model, self.n_stages)
         if batch_size % self.n_microbatches:
@@ -83,10 +101,61 @@ class PipelineConfig:
                 f"batch {batch_size} not divisible by "
                 f"{self.n_microbatches} microbatches"
             )
+        if self.schedule == "interleaved":
+            v, s = self.n_virtual, self.n_stages
+            if v < 2:
+                raise ValueError(
+                    "schedule='interleaved' needs n_virtual >= 2 "
+                    "(v == 1 is exactly the '1f1b' schedule)"
+                )
+            if model.n_layers % (v * s):
+                raise ValueError(
+                    f"n_layers={model.n_layers} not divisible by "
+                    f"n_virtual*n_stages={v * s} model chunks"
+                )
+            if self.n_microbatches % s:
+                raise ValueError(
+                    f"interleaved schedule groups microbatches by "
+                    f"stage count: n_microbatches="
+                    f"{self.n_microbatches} % n_stages={s} != 0"
+                )
+        elif self.n_virtual != 1:
+            raise ValueError(
+                f"n_virtual={self.n_virtual} only applies to "
+                "schedule='interleaved'"
+            )
 
     def bubble_fraction(self) -> float:
+        """Analytic bubble fraction in the classic accounting (idle
+        time / schedule time with fwd+bwd counted per microbatch):
+        GPipe/1F1B (S-1)/(M+S-1); interleaved divides the fill by the
+        virtual-stage factor, (S-1)/(vM+S-1); ZB-H1 splits the
+        backward into thirds (F = B = W) and refills the bubble with
+        deferred W, (S-1)/(3M+S-1). zb1 <= interleaved for v <= 3."""
         s, m = self.n_stages, self.n_microbatches
+        if self.schedule == "interleaved":
+            return (s - 1) / (self.n_virtual * m + s - 1)
+        if self.schedule == "zb1":
+            return (s - 1) / (3 * m + s - 1)
         return (s - 1) / (m + s - 1)
+
+    def n_ticks(self) -> int:
+        """Scan ticks per train step — each one fwd and/or bwd slot on
+        every device plus the ring handoffs. GPipe runs separate fwd
+        and bwd sweeps of M+S-1; 1F1B fuses them into M+2(S-1)
+        fwd/bwd tick-pairs; interleaved stretches by the chunk factor
+        to vM+(v+1)S-2; ZB-H1's three phases drain in M+3(S-1). The
+        host-side ``pipeline_tick`` span divides the step wall by this
+        (docs/OBSERVABILITY.md)."""
+        s, m = self.n_stages, self.n_microbatches
+        if self.schedule == "gpipe":
+            return 2 * (m + s - 1)
+        if self.schedule == "interleaved":
+            v = self.n_virtual
+            return v * m + (v + 1) * s - 2
+        if self.schedule == "zb1":
+            return m + 3 * (s - 1)
+        return m + 2 * (s - 1)
 
 
 # ----------------------------------------------------------------------
@@ -178,14 +247,67 @@ def _check_model_split(cfg, n_stages: int) -> None:
         )
 
 
+def to_virtual_stages(stages: dict, n_virtual: int, n_stages: int):
+    """Regroup stage stacks into the interleaved ``[v, S, lpc, ...]``
+    layout. Accepts the canonical ``[S, lps, ...]`` stacks (or any
+    ``[a, b, ...]`` leading pair with a*b == n_layers-per-leaf): the
+    leading two axes flatten to layer order, then regroup so chunk
+    c = k*S + d lands at ``[k, d]`` — device d (pipe rank) owns the
+    round-robin chunks d, S+d, 2S+d, ... A pure reshape: on replicated
+    arrays it is free; on pipe-sharded arrays XLA inserts the
+    re-layout collective once (param conversion, not a per-step op)."""
+
+    def conv(a):
+        n_layers = a.shape[0] * a.shape[1]
+        lpc = n_layers // (n_virtual * n_stages)
+        return a.reshape(n_virtual, n_stages, lpc, *a.shape[2:])
+
+    return jax.tree.map(conv, stages)
+
+
+def to_canonical_stages(stages: dict, n_stages: int):
+    """Inverse of :func:`to_virtual_stages`: ``[v, S, lpc, ...]`` back
+    to contiguous ``[n_stages, lps, ...]`` stacks (layer order is the
+    flattened [v, S, lpc] index order by construction)."""
+    return jax.tree.map(
+        lambda a: a.reshape(n_stages, -1, *a.shape[3:]), stages
+    )
+
+
 def init_pipeline_params(
     key: jax.Array, cfg: LlamaConfig, pipe: PipelineConfig
 ) -> dict:
     """Explicit param pytree; stage weights stacked on a leading [S] axis.
 
     Initializers match the flax trunk (normal embed, lecun-style fan-in
-    scaling elsewhere); stored in ``cfg.param_dtype``.
+    scaling elsewhere); stored in ``cfg.param_dtype``. The interleaved
+    schedule builds the same layer sequence, regrouped into its
+    ``[n_virtual, S, layers_per_chunk, ...]`` stacks.
     """
+    flat = pipe
+    if pipe.virtual_layout:
+        # Same layer sequence as a v*S-stage flat pipeline with the
+        # same key — the regroup below is a pure reshape, so flat and
+        # virtual inits are bit-identical per layer.
+        flat = dataclasses.replace(
+            pipe,
+            n_stages=pipe.n_stages * pipe.n_virtual,
+            schedule="1f1b",
+            n_virtual=1,
+        )
+    params = _init_flat_pipeline_params(key, cfg, flat)
+    if pipe.virtual_layout:
+        params["stages"] = to_virtual_stages(
+            params["stages"], pipe.n_virtual, pipe.n_stages
+        )
+    return params
+
+
+def _init_flat_pipeline_params(
+    key: jax.Array, cfg: LlamaConfig, pipe: PipelineConfig
+) -> dict:
+    """Canonical [S, lps, ...] init body (every schedule but the
+    virtual-layout one; the interleaved wrapper above regroups it)."""
     s = pipe.n_stages
     _check_model_split(cfg, s)
     lps = cfg.n_layers // s
@@ -397,13 +519,21 @@ _TENSOR_LEAF_AXIS = {
 _EXPERT_LEAVES = ("w_gate", "w_up", "w_down")
 
 
-def stage_partition_specs(stages: dict) -> Any:
+def stage_partition_specs(stages: dict, virtual: bool = False) -> Any:
     """Per-leaf PartitionSpecs for a stage-stack pytree: leading [S]
     axis over ``pipe``, the Megatron tensor split per
     ``_TENSOR_LEAF_AXIS``, and the expert split for rank-5 MoE stacks.
     Used both as ``shard_map`` in_specs and (via
     ``pipeline_param_shardings``) as the physical param layout, so the
-    two can't disagree."""
+    two can't disagree.
+
+    ``virtual=True`` covers the interleaved ``[v, S, lpc, ...]`` layout:
+    the pipe axis moves to position 1 (v chunks per device stay local,
+    so axis 0 is unsharded). The tensor offsets still work — they count
+    from the tail. Expert stacks never reach here (the interleaved
+    schedule is dense-only), and the rank-5 expert test is skipped
+    because a rank-5 *dense* leaf under the virtual layout would
+    misfire on it."""
 
     def spec(path, leaf):
         name = next(
@@ -414,26 +544,32 @@ def stage_partition_specs(stages: dict) -> Any:
             ),
             "",
         )
-        axes: list = [AXIS_PIPE, *([None] * (leaf.ndim - 1))]
+        if virtual:
+            axes: list = [None, AXIS_PIPE, *([None] * (leaf.ndim - 2))]
+        else:
+            axes = [AXIS_PIPE, *([None] * (leaf.ndim - 1))]
         t = _TENSOR_LEAF_AXIS.get(name)
         if t is not None:
             axes[leaf.ndim + t] = AXIS_TENSOR
-        if name in _EXPERT_LEAVES and leaf.ndim == 5:
+        if not virtual and name in _EXPERT_LEAVES and leaf.ndim == 5:
             axes[2] = AXIS_EXPERT
         return P(*axes)
 
     return jax.tree_util.tree_map_with_path(spec, stages)
 
 
-def pipeline_param_shardings(mesh: Mesh, params: dict) -> dict:
+def pipeline_param_shardings(
+    mesh: Mesh, params: dict, virtual: bool = False
+) -> dict:
     """NamedShardings: stage stacks split over ``pipe`` (+ ``tensor``
-    on head/ffn axes), rest replicated."""
+    on head/ffn axes), rest replicated. ``virtual=True`` for the
+    interleaved ``[v, S, ...]`` stacks (pipe on axis 1)."""
     rep = NamedSharding(mesh, P())
     out = {
         "embed": rep,
         "stages": jax.tree.map(
             lambda s: NamedSharding(mesh, s),
-            stage_partition_specs(params["stages"]),
+            stage_partition_specs(params["stages"], virtual=virtual),
         ),
         "final_norm": rep,
     }

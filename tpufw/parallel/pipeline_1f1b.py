@@ -23,7 +23,13 @@ Schedule algebra (S stages, M microbatches, ticks t = 0 .. M+2S-3):
     INSIDE the shard_map region) feeds straight into the backward ring.
   - both handoffs are produced at tick t-1 and consumed at t: one
     forward ``ppermute`` (s -> s+1) and one cotangent ``ppermute``
-    (s -> s-1) per tick.
+    (s -> s-1) per tick. Both are ISSUED so they overlap compute: the
+    forward send right after the stage forward (before the epilogue
+    and backward math), and the cotangent send deferred — the raw dx
+    rides the carry and is permuted at the TOP of the next tick, ahead
+    of that tick's forward — so the compiler can hide each transfer
+    behind roughly half a tick of block math instead of serializing it
+    at the scan-body boundary.
   - a stash written at tick j + s is read at tick j + 2(S-1) - s:
     lifetime <= 2(S-1) ticks, so ``j mod 2S`` slots never collide.
 
@@ -36,11 +42,16 @@ the two schedules must produce the SAME gradients (both are exact).
 
 Memory accounting: "O(stages)" is the ACTIVATION claim. The embed and
 head gradient accumulators are full fp32 [V, D]/[D, V] buffers per
-device — the same layout as the GPipe path, whose
-``pipeline_param_shardings`` keeps embed/head (and therefore their
-grads) replicated. Vocab-sharding both params and accumulators (with a
-psum_scatter epilogue) is the next step if those buffers ever dominate;
-it applies to the two schedules equally.
+device while the scan runs — the scatter-add into the embed grad needs
+the full vocab axis, so the carry can't shard it. What CAN shard is
+the epilogue: when the vocab divides the pipe x data x fsdp shard
+count, the final cross-device reduction is a ``psum_scatter`` instead
+of a ``psum``, so the grads LEAVE the region vocab-sharded — 1/(P*D*F)
+of the buffer per device from the region boundary onward (same wire
+bytes as the psum's reduce-scatter phase, minus its all-gather). The
+optimizer update then runs on the sharded grads; XLA re-replicates
+only at the param write. Non-divisible vocabs fall back to the plain
+replicated psum, decided statically at trace time.
 
 Scope: Llama-family blocks incl. Qwen qkv biases (the shared _block
 carries them), composed with data/fsdp batch sharding and Megatron
@@ -164,6 +175,34 @@ def _embed_fwd(embed: jax.Array, tokens: jax.Array, dtype) -> jax.Array:
     return embed.astype(dtype)[tokens]
 
 
+#: Axes the embed/head grad reduction sums over (all ranks hold
+#: masked partial sums; ``tensor`` is excluded — the f/g VJP algebra
+#: already leaves those grads full on every tensor rank).
+_VOCAB_REDUCE_AXES = (AXIS_PIPE, AXIS_DATA, AXIS_FSDP)
+
+
+def vocab_scatter_plan(vocab: int, mesh: Mesh):
+    """Static decision for the embed/head grad epilogue: returns
+    ``(scatter, embed_spec, head_spec)``. ``scatter=True`` means the
+    in-region reduction is a ``psum_scatter`` over the pipe x data x
+    fsdp product and the grads leave the region sharded on their vocab
+    axis (embed [V, D] on dim 0, head [D, V] on dim 1); ``False``
+    falls back to the replicated psum (vocab not divisible, or a
+    single shard where scatter is pointless)."""
+    n = (
+        mesh.shape[AXIS_PIPE]
+        * mesh.shape[AXIS_DATA]
+        * mesh.shape[AXIS_FSDP]
+    )
+    if n > 1 and vocab % n == 0:
+        return (
+            True,
+            P(_VOCAB_REDUCE_AXES, None),
+            P(None, _VOCAB_REDUCE_AXES),
+        )
+    return False, P(), P()
+
+
 def _epilogue_loss(
     head_leaves: dict,
     hidden: jax.Array,
@@ -208,6 +247,7 @@ def _1f1b_local(
     n_microbatches,
     loss_chunk_size,
     loss_chunk_dtype,
+    vocab_scatter=False,
 ):
     """Per-device schedule body (inside shard_map).
 
@@ -248,7 +288,7 @@ def _1f1b_local(
 
     def tick(carry, t):
         (
-            f_recv, b_recv, stash, loss_sum,
+            f_recv, dx_prev, stash, loss_sum,
             g_stage, g_embed, g_fnorm, g_head,
         ) = carry
         jf = t - sidx                   # forward microbatch index
@@ -258,10 +298,18 @@ def _1f1b_local(
         jf_c = jnp.clip(jf, 0, m - 1)
         jb_c = jnp.clip(jb, 0, m - 1)
 
+        # Cotangent handoff for THIS tick, issued first: the transfer
+        # overlaps the forward sub-tick's block math below (the value
+        # was computed last tick; only the wire time remains).
+        b_recv = jax.lax.ppermute(dx_prev, AXIS_PIPE, bwd_perm)
+
         # ---- forward sub-tick -------------------------------------
         x_in = jnp.where(sidx == 0, x_mb[jf_c], f_recv)
         seg_f = seg_all[jf_c] if has_seg else None
         y = stage_fwd(stage_params, x_in, seg_f)
+        # Forward handoff issued as soon as y exists — it overlaps the
+        # epilogue + backward math of the rest of this tick.
+        f_send = jax.lax.ppermute(y, AXIS_PIPE, fwd_perm)
         # Write-guard: inactive sub-ticks clip jf to 0 / m-1, whose
         # slots may hold a LIVE stash (e.g. mb m-1 awaits its backward
         # while drain ticks keep clipping to it) — keep the old value.
@@ -330,11 +378,12 @@ def _1f1b_local(
             )
         )
 
-        # ---- handoffs (consumed next tick) ------------------------
-        f_send = jax.lax.ppermute(y, AXIS_PIPE, fwd_perm)
-        b_send = jax.lax.ppermute(dx_j, AXIS_PIPE, bwd_perm)
+        # f_send is in flight since the forward sub-tick; the raw dx
+        # rides the carry and is permuted at the top of the NEXT tick
+        # (same value the old tail-of-tick ppermute delivered, but the
+        # send no longer serializes against this tick's compute).
         return (
-            f_send, b_send, stash, loss_sum,
+            f_send, dx_j, stash, loss_sum,
             g_stage, g_embed, g_fnorm, g_head,
         ), None
 
@@ -363,9 +412,23 @@ def _1f1b_local(
     #   need the tensor sum. d_model axes: no sum (sharded).
     batch_axes = (AXIS_DATA, AXIS_FSDP)
     loss_sum = jax.lax.psum(loss_sum, (AXIS_PIPE, *batch_axes))
-    g_embed = jax.lax.psum(g_embed, (AXIS_PIPE, *batch_axes))
     g_fnorm = jax.lax.psum(g_fnorm, (AXIS_PIPE, *batch_axes))
-    g_head = jax.lax.psum(g_head, (AXIS_PIPE, *batch_axes))
+    # Embed/head grads: reduce-scatter onto the vocab axis when the
+    # plan allows (see ``vocab_scatter_plan``) so the [V, D]/[D, V]
+    # fp32 buffers leave the region sharded; otherwise the replicated
+    # psum. ``vocab_scatter`` is static — one branch traces.
+    if vocab_scatter:
+        g_embed = jax.lax.psum_scatter(
+            g_embed, _VOCAB_REDUCE_AXES, scatter_dimension=0,
+            tiled=True,
+        )
+        g_head = jax.lax.psum_scatter(
+            g_head, _VOCAB_REDUCE_AXES, scatter_dimension=1,
+            tiled=True,
+        )
+    else:
+        g_embed = jax.lax.psum(g_embed, _VOCAB_REDUCE_AXES)
+        g_head = jax.lax.psum(g_head, _VOCAB_REDUCE_AXES)
     # The f/g custom VJPs make replicated leaves' grads (norm scales)
     # FULL on every tensor rank already — only the batch-shard sum is
     # needed; sharded leaves' grads are their local shards as-is.
@@ -431,6 +494,9 @@ def pipeline_1f1b_value_and_grad(
     mb3 = P(None, row, None)
     stage_specs = stage_partition_specs(params["stages"])
     hl_specs = {"final_norm": P(), "head": P()}
+    scatter, embed_spec, head_spec = vocab_scatter_plan(
+        params["head"].shape[-1], mesh
+    )
     local = partial(
         _1f1b_local,
         cfg=cfg,
@@ -438,6 +504,7 @@ def pipeline_1f1b_value_and_grad(
         n_microbatches=m,
         loss_chunk_size=loss_chunk_size,
         loss_chunk_dtype=loss_chunk_dtype,
+        vocab_scatter=scatter,
     )
     args = [
         params["stages"], head_leaves, mbd(x), mbd(inputs),
@@ -451,7 +518,7 @@ def pipeline_1f1b_value_and_grad(
         local,
         mesh=mesh,
         in_specs=tuple(in_specs),
-        out_specs=(P(), stage_specs, P(), P(), P()),
+        out_specs=(P(), stage_specs, embed_spec, P(), head_spec),
         check_vma=False,
     )(*args)
 

@@ -76,6 +76,26 @@ def _pipe_state_step(
             loss_chunk_size=loss_chunk_size,
             loss_chunk_dtype=loss_chunk_dtype,
         )
+    elif pipe.schedule == "interleaved":
+        from tpufw.parallel.pipeline_interleaved import (
+            pipeline_interleaved_value_and_grad,
+        )
+
+        loss, grads = pipeline_interleaved_value_and_grad(
+            state.params, batch, model_cfg, pipe, mesh,
+            loss_chunk_size=loss_chunk_size,
+            loss_chunk_dtype=loss_chunk_dtype,
+        )
+    elif pipe.schedule == "zb1":
+        from tpufw.parallel.pipeline_zb1 import (
+            pipeline_zb1_value_and_grad,
+        )
+
+        loss, grads = pipeline_zb1_value_and_grad(
+            state.params, batch, model_cfg, pipe, mesh,
+            loss_chunk_size=loss_chunk_size,
+            loss_chunk_dtype=loss_chunk_dtype,
+        )
     else:
         loss, grads = jax.value_and_grad(pipeline_loss)(
             state.params, batch, model_cfg, pipe, mesh,
@@ -103,6 +123,19 @@ class PipelineTrainer:
         mesh_cfg: MeshConfig | None = None,
         tx: optax.GradientTransformation | None = None,
     ):
+        # TrainerConfig schedule knob overrides the PipelineConfig —
+        # one source of truth for workloads/manifests/autotuner, and
+        # the replace keeps validate() as the single gatekeeper.
+        if trainer_cfg.pipeline_schedule:
+            pipe = dataclasses.replace(
+                pipe,
+                schedule=trainer_cfg.pipeline_schedule,
+                n_virtual=(
+                    trainer_cfg.pipeline_vstages
+                    if trainer_cfg.pipeline_schedule == "interleaved"
+                    else 1
+                ),
+            )
         if mesh_cfg is None:
             mesh_cfg = MeshConfig(pipe=pipe.n_stages, fsdp=-1)
         if mesh_cfg.pipe != pipe.n_stages:
@@ -138,6 +171,9 @@ class PipelineTrainer:
         self._step_fn = None
         self._eval_fn = None
         self.preempted = False
+        # TuneResult of the last apply_autotune (tpufw.tune.runner);
+        # None until cfg.autotune resolves in run().
+        self.last_tune = None
         from tpufw.obs import Telemetry
 
         self.telemetry = Telemetry.disabled()
@@ -158,7 +194,10 @@ class PipelineTrainer:
         return jax.eval_shape(self._init_fn, jax.random.key(0))
 
     def _state_shardings(self, abstract: PipeTrainState) -> PipeTrainState:
-        p_sh = pipeline_param_shardings(self.mesh, abstract.params)
+        p_sh = pipeline_param_shardings(
+            self.mesh, abstract.params,
+            virtual=self.pipe.virtual_layout,
+        )
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         rep = NamedSharding(self.mesh, P())
@@ -268,11 +307,30 @@ class PipelineTrainer:
             self._eval_fn = {}
         if key not in self._eval_fn:
             batch_sh = self._batch_shardings(key)
+            eval_pipe, eval_fn = self.pipe, pipeline_eval
+            if self.pipe.virtual_layout:
+                # The forward-only eval path speaks the canonical
+                # [S, lps] layout; regroup INSIDE the jit (a reshape +
+                # one resharding collective, amortized per eval batch)
+                # and run the vanilla schedule.
+                from tpufw.parallel.pipeline import to_canonical_stages
+
+                eval_pipe = dataclasses.replace(
+                    self.pipe, schedule="gpipe", n_virtual=1
+                )
+
+                def eval_fn(params, batch, **kw):
+                    params = dict(params)
+                    params["stages"] = to_canonical_stages(
+                        params["stages"], self.pipe.n_stages
+                    )
+                    return pipeline_eval(params, batch, **kw)
+
             self._eval_fn[key] = jax.jit(
                 partial(
-                    pipeline_eval,
+                    eval_fn,
                     cfg=self.model_cfg,
-                    pipe=self.pipe,
+                    pipe=eval_pipe,
                     mesh=self.mesh,
                     loss_chunk_size=self.cfg.loss_chunk_size,
                     loss_chunk_dtype=self._chunk_dtype(),
@@ -308,8 +366,6 @@ class PipelineTrainer:
         on_eval: Callable[[dict], None] | None = None,
         shutdown: "GracefulShutdown | None" = None,
     ) -> list[StepMetrics]:
-        if self.state is None:
-            self.init_state()
         owns_shutdown = False
         self.preempted = False
         from tpufw.obs import Telemetry
@@ -326,6 +382,16 @@ class PipelineTrainer:
             mesh=_mesh_label(self.mesh),
             model=f"pipeline:{type(self.model_cfg).__name__}",
         )
+        if self.cfg.autotune != "off":
+            # Resolve BEFORE state init: a schedule winner changes the
+            # stage layout ([S,...] vs [v,S,...]) the state is built in,
+            # so tuning first skips the re-layout path entirely.
+            from tpufw.tune.runner import apply_autotune
+
+            with tel.tracer.span("tune"):
+                apply_autotune(self, events=tel.events)
+        if self.state is None:
+            self.init_state()
         tel.record_config(
             {
                 "trainer": dataclasses.asdict(self.cfg),
@@ -338,6 +404,14 @@ class PipelineTrainer:
             n_chips=len(self.mesh.devices.flatten()),
             registry=tel.registry,
         )
+        # Analytic schedule bubble for THIS run's (schedule, S, v, M)
+        # — a constant, so one set at run start; the bench tier pairs
+        # it with the measured value (docs/OBSERVABILITY.md).
+        if tel.registry is not None:
+            tel.registry.gauge(
+                "tpufw_pipeline_bubble_fraction",
+                "Analytic pipeline bubble fraction of the active schedule",
+            ).set(self.pipe.bubble_fraction())
         ckpt = None
         if self.cfg.checkpoint_dir:
             from tpufw.train.checkpoint import CheckpointManager
@@ -408,6 +482,15 @@ class PipelineTrainer:
                         sm.step_time_s * sm.window_steps,
                         sm.data_wait_s,
                     )
+                # Average per-tick wall of this window, derived
+                # host-side (the scan's ticks run inside the jit where
+                # the host tracer cannot see them). Against the chip
+                # profile this localizes schedule stalls to a tick
+                # budget without an XProf round trip.
+                tel.tracer.complete(
+                    "pipeline_tick",
+                    sm.step_time_s / max(1, self.pipe.n_ticks()),
+                )
             return sm
 
         try:

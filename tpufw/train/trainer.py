@@ -468,6 +468,14 @@ class TrainerConfig:
     # A host is flagged (straggler_detected event, warn) when its sync
     # window's wall time exceeds the fleet median by this factor.
     straggler_factor: float = 2.0
+    # Pipeline schedule override (PipelineTrainer only; the flax
+    # Trainer ignores both). None keeps the PipelineConfig's own
+    # schedule; "gpipe" | "1f1b" | "interleaved" | "zb1" replaces it.
+    # pipeline_vstages is the interleaved schedule's virtual-stage
+    # count v (bubble (S-1)/(v*M+S-1)); it must satisfy
+    # PipelineConfig.validate's divisibility rules.
+    pipeline_schedule: Optional[str] = None
+    pipeline_vstages: int = 1
 
 
 class Trainer:

@@ -49,12 +49,18 @@ def cache_key(
     seq_len: int,
     mesh_shape: tuple,
     fingerprint: Optional[str] = None,
+    extra: Optional[str] = None,
 ) -> str:
+    """``extra`` extends the key with workload shape beyond the model/
+    batch/mesh tuple — e.g. the pipeline trainer's ``ppSxM`` (stage and
+    microbatch counts), which change the step being tuned without
+    changing the model config."""
     fp = fingerprint or machine_fingerprint()
     mesh = "x".join(str(int(m)) for m in mesh_shape)
     return (
         f"{fp}-{model_config_hash(model_cfg)}"
         f"-b{batch_size}-s{seq_len}-m{mesh}"
+        + (f"-{extra}" if extra else "")
     )
 
 

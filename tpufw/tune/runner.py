@@ -234,22 +234,214 @@ def make_measure_fn(
     return measure
 
 
+def make_pipeline_measure_fn(
+    model_cfg,
+    pipe,
+    trainer_cfg,
+    mesh_cfg,
+    tx=None,
+    n_steps: int = 3,
+    warmup_steps: int = 1,
+    seed: int = 0,
+) -> Callable[[Candidate], float]:
+    """make_measure_fn's PipelineTrainer twin: a fresh trainer per
+    candidate so each schedule's shard_map step compiles against its
+    own stage layout. The candidate's schedule rides in via the
+    TrainerConfig knob (the ctor's single override point), so the
+    measured step is exactly the one apply_candidate would install."""
+    import jax
+    import numpy as np
+
+    from tpufw.train.pipeline_trainer import PipelineTrainer
+
+    vocab = getattr(model_cfg, "vocab_size", 32000)
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(
+        0, vocab, (trainer_cfg.batch_size, trainer_cfg.seq_len),
+        dtype=np.int32,
+    )
+
+    def measure(cand: Candidate) -> float:
+        sched = {}
+        if cand.pipeline_schedule:
+            sched = dict(
+                pipeline_schedule=cand.pipeline_schedule,
+                pipeline_vstages=cand.pipeline_vstages,
+            )
+        cfg = dataclasses.replace(
+            trainer_cfg,
+            # n_microbatches IS the accumulation on this trainer (the
+            # ctor rejects grad_accum != 1), so that axis is pinned.
+            grad_accum=1,
+            loss_chunk_size=cand.loss_chunk_size,
+            sync_every=1,
+            checkpoint_dir=None,
+            profile_dir=None,
+            eval_every=0,
+            handle_preemption=False,
+            autotune="off",
+            **sched,
+        )
+        mc = model_cfg
+        if (
+            getattr(model_cfg, "remat", False)
+            and getattr(model_cfg, "remat_policy", None)
+            != cand.remat_policy
+        ):
+            mc = dataclasses.replace(
+                model_cfg, remat_policy=cand.remat_policy
+            )
+        prev = _set_flash_env(cand.flash_bq, cand.flash_bkv)
+        try:
+            trainer = PipelineTrainer(mc, pipe, cfg, mesh_cfg, tx=tx)
+            trainer.init_state(seed=seed)
+            batch = {"tokens": tokens}
+            step = trainer._compiled_step(batch)
+            state = trainer.state
+            for _ in range(max(warmup_steps, 1)):
+                state, m = step(state, batch)
+                jax.block_until_ready(m["loss"])
+            times = []
+            for _ in range(max(n_steps, 1)):
+                t0 = time.perf_counter()
+                state, m = step(state, batch)
+                jax.block_until_ready(m["loss"])
+                times.append(time.perf_counter() - t0)
+            return statistics.median(times)
+        finally:
+            _restore_env(prev)
+
+    return measure
+
+
+def _trainer_model_cfg(trainer):
+    """The model config for either trainer kind: the flax Trainer
+    wraps a module (``trainer.model.cfg``), the PipelineTrainer holds
+    the config directly (``trainer.model_cfg``)."""
+    model = getattr(trainer, "model", None)
+    mcfg = getattr(model, "cfg", None)
+    if mcfg is None:
+        mcfg = getattr(trainer, "model_cfg", None)
+    return mcfg
+
+
 def _trainer_cache_key(trainer) -> str:
-    mcfg = getattr(trainer.model, "cfg", None)
+    mcfg = _trainer_model_cfg(trainer)
     mesh_shape = tuple(trainer.mesh.shape.values())
+    pipe = getattr(trainer, "pipe", None)
     return tune_cache.cache_key(
-        mcfg if mcfg is not None else {"model": type(trainer.model).__name__},
+        mcfg
+        if mcfg is not None
+        else {"model": type(trainer.model).__name__},
         trainer.cfg.batch_size,
         trainer.cfg.seq_len,
         mesh_shape,
+        # Stage/microbatch counts change the step being tuned (and
+        # which schedules are valid) without changing the model config
+        # — a pp2xM4 winner must not apply to pp4xM8. The SCHEDULE is
+        # deliberately not in the key: it is the searched dimension.
+        extra=(
+            f"pp{pipe.n_stages}x{pipe.n_microbatches}"
+            if pipe is not None
+            else None
+        ),
     )
+
+
+def _relayout_pipe_state(state, old_pipe, new_pipe):
+    """Convert a live PipeTrainState between the canonical [S, ...]
+    and interleaved [v, S, ...] stage layouts — pure reshapes, applied
+    to the stage stacks and (by shape match, the same trick
+    PipelineTrainer._state_shardings uses) their optimizer moments."""
+    import jax
+
+    from tpufw.parallel.pipeline import (
+        to_canonical_stages,
+        to_virtual_stages,
+    )
+
+    if new_pipe.virtual_layout:
+        conv = lambda t: to_virtual_stages(  # noqa: E731
+            t, new_pipe.n_virtual, new_pipe.n_stages
+        )
+    else:
+        conv = lambda t: to_canonical_stages(  # noqa: E731
+            t, new_pipe.n_stages
+        )
+    old_shapes = {
+        tuple(x.shape) for x in jax.tree.leaves(state.params["stages"])
+    }
+
+    def conv_if_stage(leaf):
+        if (
+            hasattr(leaf, "shape")
+            and tuple(leaf.shape) in old_shapes
+        ):
+            return conv(leaf)
+        return leaf
+
+    params = dict(state.params)
+    params["stages"] = conv(state.params["stages"])
+    return state.replace(
+        params=params,
+        opt_state=jax.tree.map(conv_if_stage, state.opt_state),
+    )
+
+
+def _apply_pipeline_candidate(trainer, cand: Candidate) -> None:
+    """Install a winner on a live PipelineTrainer. Schedule changes
+    re-layout the state in place (reshapes + a re-shard) so a tuned
+    run keeps its step counter and optimizer moments; grad_accum is
+    not a pipeline knob (n_microbatches IS the accumulation) and is
+    left alone."""
+    import dataclasses as _dc
+
+    import jax
+
+    trainer.cfg.loss_chunk_size = cand.loss_chunk_size
+    trainer.cfg.sync_every = cand.sync_every
+    _set_flash_env(cand.flash_bq, cand.flash_bkv)
+    if cand.pipeline_schedule:
+        old = trainer.pipe
+        new = _dc.replace(
+            old,
+            schedule=cand.pipeline_schedule,
+            n_virtual=(
+                cand.pipeline_vstages
+                if cand.pipeline_schedule == "interleaved"
+                else 1
+            ),
+        )
+        if new != old:
+            new.validate(trainer.model_cfg, trainer.cfg.batch_size)
+            trainer.pipe = new
+            if (
+                trainer.state is not None
+                and new.virtual_layout != old.virtual_layout
+            ):
+                trainer.state = _relayout_pipe_state(
+                    trainer.state, old, new
+                )
+            trainer._shardings = trainer._state_shardings(
+                trainer._abstract_state()
+            )
+            if trainer.state is not None:
+                trainer.state = jax.device_put(
+                    trainer.state, trainer._shardings
+                )
+    trainer._step_fn = None
+    trainer._eval_fn = None
 
 
 def apply_candidate(trainer, cand: Candidate) -> None:
     """Install a winner on a live Trainer: config knobs, a rebuilt model
     when the remat policy changed (re-pointing state.apply_fn if state
     already exists), and the flash env override. Compiled steps are
-    dropped — they baked in the old knobs."""
+    dropped — they baked in the old knobs. PipelineTrainers take the
+    pipeline branch (schedule swap + state re-layout)."""
+    if hasattr(trainer, "pipe"):
+        _apply_pipeline_candidate(trainer, cand)
+        return
     trainer.cfg.grad_accum = cand.grad_accum
     trainer.cfg.loss_chunk_size = cand.loss_chunk_size
     trainer.cfg.sync_every = cand.sync_every
@@ -312,8 +504,20 @@ def apply_autotune(
     # HBM pruning only means something against a real chip's HBM; the
     # CPU table entry is a placeholder and would mis-prune.
     hbm = detect_chip().hbm_bytes if on_tpu else None
-    mcfg = getattr(trainer.model, "cfg", None)
+    mcfg = _trainer_model_cfg(trainer)
     dp = trainer.mesh.shape["data"] * trainer.mesh.shape["fsdp"]
+    pipe = getattr(trainer, "pipe", None)
+    if pipe is not None and space is None:
+        # Default pipeline space: the schedule axis IS the search (the
+        # flax knobs that don't exist here — grad_accum, remat swaps —
+        # are pinned), interleaved at the cheapest valid v.
+        space = SearchSpace(
+            grad_accums=(1,),
+            remat_policies=(getattr(mcfg, "remat_policy", "dots"),),
+            pipeline_schedules=(
+                None, ("1f1b", 1), ("interleaved", 2), ("zb1", 1),
+            ),
+        )
     candidates, pruned = enumerate_candidates(
         mcfg,
         trainer.cfg.batch_size,
@@ -322,11 +526,34 @@ def apply_autotune(
         dp_shards=dp,
         n_shards=dp,
         hbm_bytes=hbm,
+        pipe_stages=pipe.n_stages if pipe is not None else 0,
+        pipe_microbatches=(
+            pipe.n_microbatches if pipe is not None else 0
+        ),
     )
-    measure = make_measure_fn(
-        trainer.model, trainer.cfg, trainer.mesh, tx=trainer.tx,
-        n_steps=getattr(trainer.cfg, "autotune_steps", 3),
-    )
+    if pipe is not None:
+        from tpufw.mesh import MeshConfig
+
+        shape = dict(trainer.mesh.shape)
+        measure = make_pipeline_measure_fn(
+            trainer.model_cfg,
+            pipe,
+            trainer.cfg,
+            MeshConfig(
+                data=shape.get("data", 1),
+                pipe=shape.get("pipe", 1),
+                fsdp=shape.get("fsdp", 1),
+                tensor=shape.get("tensor", 1),
+                expert=shape.get("expert", 1),
+            ),
+            tx=trainer.tx,
+            n_steps=getattr(trainer.cfg, "autotune_steps", 3),
+        )
+    else:
+        measure = make_measure_fn(
+            trainer.model, trainer.cfg, trainer.mesh, tx=trainer.tx,
+            n_steps=getattr(trainer.cfg, "autotune_steps", 3),
+        )
     result = search(
         candidates,
         measure,

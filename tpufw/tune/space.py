@@ -42,6 +42,14 @@ class Candidate:
     flash_bq: Optional[int] = None
     flash_bkv: Optional[int] = None
     sync_every: int = 1
+    # Pipeline schedule dimension (PipelineTrainer workloads only).
+    # None = not searched / keep the trainer's own schedule — the
+    # default old cache entries deserialize to, so pre-existing
+    # winners stay valid. pipeline_vstages is the interleaved
+    # schedule's v and meaningful only with
+    # pipeline_schedule="interleaved".
+    pipeline_schedule: Optional[str] = None
+    pipeline_vstages: int = 1
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -64,6 +72,10 @@ class SearchSpace:
     # (bq, bkv) pairs; None = the kernel's divisor heuristic.
     flash_blocks: tuple = (None, (256, 256), (512, 512))
     sync_everys: tuple = (1, 4)
+    # (schedule, vstages) pairs; the lone None default keeps the axis
+    # inert for non-pipeline workloads. Pipeline searches pass e.g.
+    # (None, ("1f1b", 1), ("interleaved", 2), ("zb1", 1)).
+    pipeline_schedules: tuple = (None,)
 
 
 DEFAULT_SPACE = SearchSpace()
@@ -89,6 +101,8 @@ def candidate_order(c: Candidate) -> tuple:
         c.flash_bkv or 0,
         c.remat_policy,
         c.loss_chunk_size or 0,
+        c.pipeline_schedule or "",
+        c.pipeline_vstages,
     )
 
 
@@ -101,6 +115,8 @@ def enumerate_candidates(
     n_shards: int = 1,
     hbm_bytes: Optional[float] = None,
     hbm_fraction: float = HBM_FRACTION,
+    pipe_stages: int = 0,
+    pipe_microbatches: int = 0,
 ) -> tuple[list[Candidate], list[tuple[Candidate, str]]]:
     """The space, filtered. Returns (valid, pruned-with-reason).
 
@@ -109,6 +125,11 @@ def enumerate_candidates(
     param sharding degree fed to the HBM estimate. ``hbm_bytes`` of
     None disables HBM pruning (pure-validity mode, used by tests and
     CPU runs where the static chip table is meaningless).
+    ``pipe_stages``/``pipe_microbatches`` describe the pipeline
+    workload shape (0 = not a pipeline trainer — every non-None
+    ``pipeline_schedules`` entry then prunes); they gate the schedule
+    axis with the same divisibility rules PipelineConfig.validate
+    enforces, so invalid schedules never reach a compile.
     """
     space = space or DEFAULT_SPACE
     # The trainer feeds tokens[:, :-1] to the model, padded to 128
@@ -124,11 +145,13 @@ def enumerate_candidates(
     valid: list[Candidate] = []
     pruned: list[tuple[Candidate, str]] = []
     seen: set = set()
-    for policy, accum, chunk, blk, sync in itertools.product(
+    n_layers = getattr(model_cfg, "n_layers", 0)
+    for policy, accum, chunk, blk, sync, sched in itertools.product(
         policies, space.grad_accums, space.loss_chunk_sizes, blocks,
-        space.sync_everys,
+        space.sync_everys, space.pipeline_schedules,
     ):
         bq, bkv = blk if blk is not None else (None, None)
+        ps, pv = sched if sched is not None else (None, 1)
         cand = Candidate(
             remat_policy=policy,
             grad_accum=accum,
@@ -136,10 +159,44 @@ def enumerate_candidates(
             flash_bq=bq,
             flash_bkv=bkv,
             sync_every=sync,
+            pipeline_schedule=ps,
+            pipeline_vstages=pv,
         )
         if cand in seen:
             continue
         seen.add(cand)
+        if ps is not None:
+            if pipe_stages < 2:
+                pruned.append(
+                    (cand, f"pipeline schedule {ps!r} needs a pipeline "
+                     "trainer (pipe_stages >= 2)")
+                )
+                continue
+            if ps == "interleaved":
+                if pv < 2:
+                    pruned.append(
+                        (cand, "interleaved needs pipeline_vstages "
+                         ">= 2")
+                    )
+                    continue
+                if n_layers % (pv * pipe_stages):
+                    pruned.append(
+                        (cand, f"n_layers={n_layers} not divisible "
+                         f"into {pv}x{pipe_stages} virtual chunks")
+                    )
+                    continue
+                if pipe_microbatches % pipe_stages:
+                    pruned.append(
+                        (cand, f"microbatches {pipe_microbatches} not "
+                         f"divisible by {pipe_stages} stages")
+                    )
+                    continue
+            elif pv != 1:
+                pruned.append(
+                    (cand, f"pipeline_vstages={pv} only applies to "
+                     "the interleaved schedule")
+                )
+                continue
         if accum < 1 or batch_size % accum:
             pruned.append(
                 (cand, f"grad_accum {accum} does not divide batch "

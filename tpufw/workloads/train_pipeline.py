@@ -7,7 +7,12 @@ reference README.md:331-335), driven by TPUFW_* env:
 
   TPUFW_PIPE_STAGES (required, >1)   pipeline stages == mesh pipe size
   TPUFW_PIPE_MICROBATCHES (default 2*stages)
-  TPUFW_PIPE_SCHEDULE                gpipe (default) | 1f1b
+  TPUFW_PIPELINE_SCHEDULE            gpipe (default) | 1f1b |
+                                     interleaved | zb1
+  TPUFW_PIPELINE_VSTAGES             virtual stages v for interleaved
+  TPUFW_PIPE_SCHEDULE                older spelling of the schedule
+                                     knob (gpipe | 1f1b); the
+                                     TPUFW_PIPELINE_* form wins
   TPUFW_MODEL / TPUFW_BATCH_SIZE / TPUFW_SEQ_LEN / ... (as train_llama)
   TPUFW_MESH_DATA / TPUFW_MESH_FSDP  data-parallel axes alongside pipe
   TPUFW_MESH_TENSOR / TPUFW_MESH_EXPERT  in-stage Megatron split /
@@ -70,8 +75,13 @@ def build_trainer():
     pipe = PipelineConfig(
         n_stages=stages,
         n_microbatches=env_int("pipe_microbatches", 2 * stages),
-        # "gpipe" (default) or "1f1b" (O(stages) activation memory).
-        schedule=env_str("pipe_schedule", "gpipe"),
+        # TPUFW_PIPELINE_SCHEDULE (full set: gpipe | 1f1b |
+        # interleaved | zb1) wins over the older TPUFW_PIPE_SCHEDULE
+        # spelling, which stays honored so existing manifests keep
+        # working.
+        schedule=env_str("pipeline_schedule", "")
+        or env_str("pipe_schedule", "gpipe"),
+        n_virtual=env_int("pipeline_vstages", 1),
     )
     trainer_cfg = TrainerConfig(
         batch_size=env_int("batch_size", 8),
