@@ -10,16 +10,37 @@
 #      matters.
 #   2. tpulint (python -m tpufw.analysis) — the repo's own stdlib-ast
 #      JAX/TPU rules (docs/ANALYSIS.md): hot-loop purity, mesh-axis
-#      names, RNG discipline, env + observability registry hygiene.
+#      names, RNG discipline, env + observability registry hygiene,
+#      jit donation, recompile churn, dtype drift, lock discipline.
 #      No dependencies, so it always runs; exits non-zero on any
 #      finding not absorbed by analysis_baseline.json.
+#
+# Fast path (pre-commit): `scripts/lint.sh --fast` runs tpulint with
+# the replay cache (an unchanged tree replays the previous result in
+# milliseconds) and gates only on findings in files you changed since
+# HEAD — see docs/ANALYSIS.md "Incremental mode". Extra args other
+# than --fast are forwarded to ruff.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+FAST=0
+RUFF_ARGS=()
+for arg in "$@"; do
+    if [ "$arg" = "--fast" ]; then
+        FAST=1
+    else
+        RUFF_ARGS+=("$arg")
+    fi
+done
+
 if command -v ruff >/dev/null 2>&1; then
-    ruff check tpufw tests bench.py scripts "$@"
+    ruff check tpufw tests bench.py scripts "${RUFF_ARGS[@]+"${RUFF_ARGS[@]}"}"
 else
     echo "lint: ruff not installed; skipping (pip install ruff to enable)" >&2
 fi
 
-python -m tpufw.analysis
+if [ "$FAST" = "1" ]; then
+    python -m tpufw.analysis --cache --since HEAD
+else
+    python -m tpufw.analysis
+fi

@@ -588,6 +588,534 @@ def test_tpu005_wrapper_short_names(tmp_path):
     assert keys(out) == ["metric:tpufw_serve_requestz_total"], keys(out)
 
 
+# ---------------------------------------------------------------- TPU006
+
+
+def test_tpu006_tree_map_update_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "@jax.jit\n"
+                "def step(params, deltas):\n"
+                "    params = jax.tree_util.tree_map("
+                "lambda p, d: p - d, params, deltas)\n"
+                "    return params\n"
+            )
+        },
+        rules=["TPU006"],
+    )
+    assert keys(out) == ["donate:step:params"], keys(out)
+
+
+def test_tpu006_at_set_call_form_positive(tmp_path):
+    # jit applied as a call (`jax.jit(write)`), not a decorator.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "def write(cache, x, i):\n"
+                "    cache = cache.at[i].set(x)\n"
+                "    return cache\n"
+                "write_jit = jax.jit(write)\n"
+            )
+        },
+        rules=["TPU006"],
+    )
+    assert keys(out) == ["donate:write:cache"], keys(out)
+
+
+def test_tpu006_dynamic_update_slice_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "from functools import partial\n"
+                "import jax\n"
+                "@partial(jax.jit, static_argnames=('axis',))\n"
+                "def insert_kv(kv, x, axis):\n"
+                "    return jax.lax.dynamic_update_slice(kv, x, (0, 0))\n"
+            )
+        },
+        rules=["TPU006"],
+    )
+    assert keys(out) == ["donate:insert_kv:kv"], keys(out)
+
+
+def test_tpu006_donated_negative(tmp_path):
+    # The required negative: same update shape, input donated.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "from functools import partial\n"
+                "import jax\n"
+                "@partial(jax.jit, donate_argnums=(0,))\n"
+                "def step(params, deltas):\n"
+                "    params = jax.tree_util.tree_map("
+                "lambda p, d: p - d, params, deltas)\n"
+                "    return params\n"
+                "@partial(jax.jit, donate_argnames=('cache',))\n"
+                "def write(cache, x, i):\n"
+                "    return cache.at[i].set(x)\n"
+            )
+        },
+        rules=["TPU006"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu006_aliased_read_negative(tmp_path):
+    # Gather-only jits alias the input but never replace it.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "@jax.jit\n"
+                "def lookup(params, idx):\n"
+                "    return params['emb'][idx]\n"
+                "@jax.jit\n"
+                "def stats(state):\n"
+                "    return state.mean()\n"
+            )
+        },
+        rules=["TPU006"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu006_scan_carry_positive_and_fresh_negative(tmp_path):
+    # The carry seeded directly with `cache` is a rebound version of
+    # it (positive); `params` only read through the step's closure
+    # stays an aliased read (negative) — both in one function.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "@jax.jit\n"
+                "def decode(params, cache, xs):\n"
+                "    def body(c, x):\n"
+                "        return c.at[0].set(x * params['w']), x\n"
+                "    cache, ys = jax.lax.scan(body, cache, xs)\n"
+                "    return cache, ys\n"
+            )
+        },
+        rules=["TPU006"],
+    )
+    assert keys(out) == ["donate:decode:cache"], keys(out)
+
+
+# ---------------------------------------------------------------- TPU007
+
+
+def test_tpu007_static_churn_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "from functools import partial\n"
+                "import jax\n"
+                "@partial(jax.jit, static_argnums=(1,))\n"
+                "def run(x, n):\n"
+                "    return x * n\n"
+                "def driver(xs):\n"
+                "    out = []\n"
+                "    for x in xs:\n"
+                "        n = len(x)\n"
+                "        out.append(run(x, n))\n"
+                "    return out\n"
+            )
+        },
+        rules=["TPU007"],
+    )
+    assert keys(out) == ["static-churn:run:n"], keys(out)
+
+
+def test_tpu007_shape_churn_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "import jax.numpy as jnp\n"
+                "@jax.jit\n"
+                "def score(batch):\n"
+                "    return batch.sum()\n"
+                "def driver(items):\n"
+                "    for item in items:\n"
+                "        n = len(item)\n"
+                "        buf = jnp.zeros((n, 4), dtype=jnp.float32)\n"
+                "        score(buf)\n"
+            )
+        },
+        rules=["TPU007"],
+    )
+    assert keys(out) == ["shape-churn:score:batch"], keys(out)
+
+
+def test_tpu007_while_augassign_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "from functools import partial\n"
+                "import jax\n"
+                "@partial(jax.jit, static_argnames=('k',))\n"
+                "def gen(x, k):\n"
+                "    return x[:k]\n"
+                "def loop(x):\n"
+                "    k = 1\n"
+                "    while k < 64:\n"
+                "        gen(x, k=k)\n"
+                "        k += 3\n"
+            )
+        },
+        rules=["TPU007"],
+    )
+    assert keys(out) == ["static-churn:gen:k"], keys(out)
+
+
+def test_tpu007_pow2_ladder_negative(tmp_path):
+    # The required negative: the varying size is pinned through a
+    # pow2 ladder before reaching the static slot.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "from functools import partial\n"
+                "import jax\n"
+                "def _pow2_ceil(n):\n"
+                "    p = 1\n"
+                "    while p < n:\n"
+                "        p *= 2\n"
+                "    return p\n"
+                "@partial(jax.jit, static_argnames=('k',))\n"
+                "def gen(x, k):\n"
+                "    return x[:k]\n"
+                "def loop(x, items):\n"
+                "    for item in items:\n"
+                "        k = _pow2_ceil(len(item))\n"
+                "        gen(x, k=k)\n"
+            )
+        },
+        rules=["TPU007"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu007_owner_params_negative(tmp_path):
+    # A caller's own parameters are not varying: one call site cannot
+    # see its callers, and the bias is false negatives over noise.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "from functools import partial\n"
+                "import jax\n"
+                "@partial(jax.jit, static_argnums=(1,))\n"
+                "def run(x, n):\n"
+                "    return x * n\n"
+                "def driver(x, n):\n"
+                "    return run(x, n)\n"
+            )
+        },
+        rules=["TPU007"],
+    )
+    assert out == [], keys(out)
+
+
+# ---------------------------------------------------------------- TPU008
+
+
+def test_tpu008_dtypeless_ctor_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "import jax.numpy as jnp\n"
+                "@jax.jit\n"
+                "def step(x):\n"
+                "    acc = jnp.zeros((4,))\n"
+                "    return acc + x\n"
+            )
+        },
+        rules=["TPU008"],
+    )
+    assert any(s.startswith("dtypeless:step:") for s in keys(out)), keys(out)
+
+
+def test_tpu008_upcast_mix_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "import jax.numpy as jnp\n"
+                "@jax.jit\n"
+                "def mix(x):\n"
+                "    a = x.astype(jnp.bfloat16)\n"
+                "    b = jnp.ones((4,), dtype=jnp.float32)\n"
+                "    return a * b\n"
+            )
+        },
+        rules=["TPU008"],
+    )
+    assert any(s.startswith("upcast:mix:") for s in keys(out)), keys(out)
+
+
+def test_tpu008_bf16_accum_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "import jax.numpy as jnp\n"
+                "@jax.jit\n"
+                "def loss_fn(logits):\n"
+                "    z = logits.astype(jnp.bfloat16)\n"
+                "    return jnp.sum(z)\n"
+            )
+        },
+        rules=["TPU008"],
+    )
+    assert keys(out) == ["accum:loss_fn:sum"], keys(out)
+    assert out[0].severity == "warning"
+
+
+def test_tpu008_fp32_accumulator_negative(tmp_path):
+    # The required negative: same reduction, explicit fp32 upcast.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "import jax.numpy as jnp\n"
+                "@jax.jit\n"
+                "def loss_fn(logits):\n"
+                "    z = logits.astype(jnp.bfloat16)\n"
+                "    return jnp.sum(z.astype(jnp.float32))\n"
+            )
+        },
+        rules=["TPU008"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu008_dtype_given_and_untraced_negative(tmp_path):
+    # Explicit dtypes never fire; neither does anything outside the
+    # traced callgraph.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "import jax.numpy as jnp\n"
+                "@jax.jit\n"
+                "def step(x):\n"
+                "    acc = jnp.zeros((4,), dtype=jnp.bfloat16)\n"
+                "    return acc + x\n"
+                "def host_helper():\n"
+                "    return jnp.zeros((8,))\n"
+            )
+        },
+        rules=["TPU008"],
+    )
+    assert out == [], keys(out)
+
+
+# ---------------------------------------------------------------- TPU009
+
+_THREADED_HEADER = (
+    "import threading\n"
+)
+
+
+def test_tpu009_caller_side_unguarded_read_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                _THREADED_HEADER
+                + "class Pool:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._count = 0\n"
+                "        self._t = threading.Thread(target=self._loop)\n"
+                "    def _loop(self):\n"
+                "        with self._lock:\n"
+                "            self._count += 1\n"
+                "    def count(self):\n"
+                "        return self._count\n"
+            )
+        },
+        rules=["TPU009"],
+    )
+    assert keys(out) == ["unguarded:Pool._count"], keys(out)
+
+
+def test_tpu009_dual_writer_positive(tmp_path):
+    # Written from both sides: every access needs the lock, including
+    # the thread's own increment.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                _THREADED_HEADER
+                + "class Sched:\n"
+                "    def __init__(self):\n"
+                "        self._cv = threading.Condition()\n"
+                "        self._idx = 0\n"
+                "        self._t = threading.Thread(target=self._run)\n"
+                "    def _run(self):\n"
+                "        self._idx += 1\n"
+                "    def reset(self):\n"
+                "        with self._cv:\n"
+                "            self._idx = 0\n"
+            )
+        },
+        rules=["TPU009"],
+    )
+    assert keys(out) == ["unguarded:Sched._idx"], keys(out)
+
+
+def test_tpu009_lock_order_inversion_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                _THREADED_HEADER
+                + "class Two:\n"
+                "    def __init__(self):\n"
+                "        self._a = threading.Lock()\n"
+                "        self._b = threading.Lock()\n"
+                "        self._t = threading.Thread(target=self._loop)\n"
+                "    def _loop(self):\n"
+                "        with self._a:\n"
+                "            with self._b:\n"
+                "                pass\n"
+                "    def poke(self):\n"
+                "        with self._b:\n"
+                "            with self._a:\n"
+                "                pass\n"
+            )
+        },
+        rules=["TPU009"],
+    )
+    assert keys(out) == ["lock-order:Two:_a,_b"], keys(out)
+    assert out[0].severity == "warning"
+
+
+def test_tpu009_lock_held_via_with_negative(tmp_path):
+    # The required negative: every access is inside `with self._lock:`
+    # — including container mutators, which count as writes.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                _THREADED_HEADER
+                + "class Safe:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._items = []\n"
+                "        self._t = threading.Thread(target=self._loop)\n"
+                "    def _loop(self):\n"
+                "        with self._lock:\n"
+                "            self._items.append(1)\n"
+                "    def drain(self):\n"
+                "        with self._lock:\n"
+                "            out = list(self._items)\n"
+                "            self._items.clear()\n"
+                "            return out\n"
+            )
+        },
+        rules=["TPU009"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu009_single_writer_owner_negative(tmp_path):
+    # serve.py's discipline: the scheduler thread owns the attribute
+    # (all writes), touches it lock-free; callers read under the lock.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                _THREADED_HEADER
+                + "class Owner:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._n = 0\n"
+                "        self._t = threading.Thread(target=self._loop)\n"
+                "    def _loop(self):\n"
+                "        self._n += 1\n"
+                "        if self._n > 3:\n"
+                "            self._n = 0\n"
+                "    def peek(self):\n"
+                "        with self._lock:\n"
+                "            return self._n\n"
+            )
+        },
+        rules=["TPU009"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu009_threadsafe_container_negative(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                _THREADED_HEADER
+                + "import queue\n"
+                "class Q:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._q = queue.Queue()\n"
+                "        self._t = threading.Thread(target=self._loop)\n"
+                "    def _loop(self):\n"
+                "        self._q.put(1)\n"
+                "    def pop(self):\n"
+                "        return self._q.get()\n"
+            )
+        },
+        rules=["TPU009"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu009_guarded_helper_negative(tmp_path):
+    # A private helper whose every internal call site holds the lock
+    # inherits the guard — no re-acquire needed inside.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                _THREADED_HEADER
+                + "class H:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._state = {}\n"
+                "        self._t = threading.Thread(target=self._loop)\n"
+                "    def _loop(self):\n"
+                "        with self._lock:\n"
+                "            self._bump()\n"
+                "    def _bump(self):\n"
+                "        self._state['n'] = 1\n"
+                "    def read(self):\n"
+                "        with self._lock:\n"
+                "            return dict(self._state)\n"
+            )
+        },
+        rules=["TPU009"],
+    )
+    assert out == [], keys(out)
+
+
 # ------------------------------------------------------------- framework
 
 
@@ -684,21 +1212,214 @@ def test_all_rules_fire_on_fixtures(tmp_path):
             "mod.py": (
                 "import os\n"
                 "import jax\n"
+                "import jax.numpy as jnp\n"
                 "@jax.jit\n"
                 "def step(x):\n"
                 "    print('x')\n"
-                "    return jax.lax.psum(x, 'dataa')\n"
+                "    acc = jnp.zeros((4,))\n"
+                "    return jax.lax.psum(x + acc, 'dataa')\n"
                 "def f(key, shape):\n"
                 "    a = jax.random.normal(key, shape)\n"
                 "    return a + jax.random.normal(key, shape)\n"
                 "BAD = os.environ.get('TPUFW_TYPO')\n"
                 "def g(tel):\n"
                 "    tel.events.emit('stepp')\n"
+                "@jax.jit\n"
+                "def update(params, deltas):\n"
+                "    params = jax.tree_util.tree_map("
+                "lambda p, d: p - d, params, deltas)\n"
+                "    return params\n"
+                "from functools import partial\n"
+                "@partial(jax.jit, static_argnums=(1,))\n"
+                "def run(x, n):\n"
+                "    return x * n\n"
+                "def driver(xs):\n"
+                "    for x in xs:\n"
+                "        run(x, len(x))\n"
+            ),
+            "locked.py": (
+                "import threading\n"
+                "class Pool:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "        self._count = 0\n"
+                "        self._t = threading.Thread(target=self._loop)\n"
+                "    def _loop(self):\n"
+                "        with self._lock:\n"
+                "            self._count += 1\n"
+                "    def count(self):\n"
+                "        return self._count\n"
             ),
         },
     )
     rules = {f.rule for f in out}
-    assert {"TPU001", "TPU002", "TPU003", "TPU004", "TPU005"} <= rules, (
-        sorted(rules),
-        keys(out),
+    want = {
+        "TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
+        "TPU006", "TPU007", "TPU008", "TPU009",
+    }
+    assert want <= rules, (sorted(rules), keys(out))
+
+
+# ----------------------------------------------------------------- SARIF
+
+
+def _reuse_fixture_findings(tmp_path):
+    return run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "def f(key, shape):\n"
+                "    a = jax.random.normal(key, shape)\n"
+                "    b = jax.random.normal(key, shape)\n"
+                "    return a + b\n"
+            )
+        },
     )
+
+
+def test_sarif_validates_against_schema(tmp_path):
+    import jsonschema
+
+    from tpufw.analysis import sarif
+
+    findings = _reuse_fixture_findings(tmp_path)
+    assert findings, "fixture must produce findings"
+    doc = sarif.to_sarif(findings)
+    schema_path = os.path.join(
+        ROOT, "tests", "data", "sarif-2.1.0-core.schema.json"
+    )
+    with open(schema_path, encoding="utf-8") as fh:
+        schema = json.load(fh)
+    jsonschema.Draft7Validator.check_schema(schema)
+    jsonschema.validate(doc, schema)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tpulint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {f"TPU00{i}" for i in range(10)} <= rule_ids
+    res = run["results"][0]
+    src = findings[0]
+    assert res["ruleId"] == src.rule
+    assert res["partialFingerprints"]["tpulintKey/v1"] == src.key()
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == src.path
+    assert loc["region"]["startLine"] == src.line
+
+
+def test_sarif_level_mapping(tmp_path):
+    from tpufw.analysis import sarif
+
+    findings = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "import jax.numpy as jnp\n"
+                "@jax.jit\n"
+                "def loss_fn(logits):\n"
+                "    z = logits.astype(jnp.bfloat16)\n"
+                "    return jnp.sum(z)\n"
+            )
+        },
+        rules=["TPU008"],
+    )
+    assert findings and findings[0].severity == "warning"
+    doc = sarif.to_sarif(findings)
+    assert doc["runs"][0]["results"][0]["level"] == "warning"
+
+
+def test_sarif_cli_flag(tmp_path):
+    from tpufw.analysis.__main__ import main
+
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import jax\n"
+        "def f(key, shape):\n"
+        "    a = jax.random.normal(key, shape)\n"
+        "    b = jax.random.normal(key, shape)\n"
+        "    return a + b\n"
+    )
+    out = tmp_path / "out.sarif"
+    assert main([str(mod), "--no-baseline", "--sarif", str(out)]) == 1
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    assert len(doc["runs"][0]["results"]) == 1
+
+
+# ----------------------------------------------------------- incremental
+
+
+def test_incremental_cache_roundtrip(tmp_path):
+    from tpufw.analysis import incremental
+
+    mod = tmp_path / "mod.py"
+    mod.write_text("x = 1\n")
+    py_files = core.iter_py_files([str(tmp_path)], str(tmp_path))
+    sig = incremental.scan_signature(str(tmp_path), py_files, None)
+    findings = _reuse_fixture_findings(tmp_path / "fx")
+    cache = tmp_path / "cache.json"
+    incremental.save_cache(str(cache), sig, findings)
+    replayed = incremental.load_cached(str(cache), sig)
+    assert replayed == findings
+    # Any content drift invalidates the signature.
+    mod.write_text("x = 2\n")
+    py_files = core.iter_py_files([str(tmp_path)], str(tmp_path))
+    sig2 = incremental.scan_signature(str(tmp_path), py_files, None)
+    assert sig2 != sig
+    assert incremental.load_cached(str(cache), sig2) is None
+    # A rule-subset change also invalidates.
+    sig3 = incremental.scan_signature(
+        str(tmp_path), py_files, ["TPU001"]
+    )
+    assert sig3 != sig2
+
+
+def test_incremental_cli_cache_replay(tmp_path, capsys):
+    from tpufw.analysis.__main__ import main
+
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import jax\n"
+        "def f(key, shape):\n"
+        "    a = jax.random.normal(key, shape)\n"
+        "    b = jax.random.normal(key, shape)\n"
+        "    return a + b\n"
+    )
+    cache = tmp_path / ".tpulint_cache.json"
+    argv = [str(mod), "--no-baseline", "--cache", str(cache)]
+    assert main(argv) == 1
+    assert cache.exists()
+    capsys.readouterr()
+    assert main(argv) == 1  # replay: same exit code
+    assert "replayed" in capsys.readouterr().err
+
+
+def test_since_filter_and_git_gating(tmp_path):
+    import subprocess
+
+    from tpufw.analysis import incremental
+    from tpufw.analysis.core import Finding
+
+    f1 = Finding("TPU001", "a.py", 1, 1, "m")
+    f2 = Finding("TPU001", "b.py", 1, 1, "m")
+    assert incremental.filter_since([f1, f2], {"b.py"}) == [f2]
+    # Not a git checkout -> None (gate on everything).
+    assert incremental.changed_files(str(tmp_path), "HEAD") is None
+    git = ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+    subprocess.run(
+        ["git", "init", "-q", str(tmp_path)], check=True
+    )
+    (tmp_path / "a.py").write_text("x = 1\n")
+    subprocess.run(
+        git + ["add", "a.py"], cwd=str(tmp_path), check=True
+    )
+    subprocess.run(
+        git + ["commit", "-qm", "seed"], cwd=str(tmp_path), check=True
+    )
+    (tmp_path / "a.py").write_text("x = 2\n")  # unstaged edit
+    (tmp_path / "b.py").write_text("y = 1\n")  # untracked
+    changed = incremental.changed_files(str(tmp_path), "HEAD")
+    assert changed == {"a.py", "b.py"}, changed

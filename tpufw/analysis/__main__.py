@@ -6,6 +6,12 @@ the scripts, and bench.py. ``analysis_baseline.json`` at the repo
 root is applied automatically when present (``--no-baseline`` for
 the raw view); the baseline may only shrink — regenerate it with
 ``--write-baseline`` only to *remove* fixed entries.
+
+``--sarif out.sarif`` additionally writes the gating findings as
+SARIF 2.1.0 for GitHub code scanning. ``--cache`` enables the
+whole-scan replay cache (see :mod:`tpufw.analysis.incremental`), and
+``--since <ref>`` gates the exit code on findings in files changed
+since ``ref`` — the pre-commit fast path.
 """
 
 from __future__ import annotations
@@ -16,7 +22,7 @@ import os
 import sys
 from typing import List
 
-from tpufw.analysis import core
+from tpufw.analysis import core, incremental
 
 DEFAULT_BASELINE = "analysis_baseline.json"
 
@@ -61,6 +67,32 @@ def main(argv: List[str] | None = None) -> int:
         metavar="PATH",
         help="write current findings as the new baseline and exit 0",
     )
+    ap.add_argument(
+        "--sarif",
+        metavar="PATH",
+        help="also write gating findings as SARIF 2.1.0",
+    )
+    ap.add_argument(
+        "--cache",
+        nargs="?",
+        const=incremental.DEFAULT_CACHE,
+        default=None,
+        metavar="PATH",
+        help=(
+            "replay cache file (default "
+            f"<root>/{incremental.DEFAULT_CACHE}); an exact "
+            "signature hit skips the scan entirely"
+        ),
+    )
+    ap.add_argument(
+        "--since",
+        metavar="REF",
+        help=(
+            "gate the exit code only on findings in files changed "
+            "since REF (committed or not); the full tree is still "
+            "analyzed so cross-file rules stay sound"
+        ),
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -78,11 +110,35 @@ def main(argv: List[str] | None = None) -> int:
         if args.rules
         else None
     )
-    try:
-        findings = core.run_analysis(paths, root=root, rules=rules)
-    except ValueError as e:
-        print(f"tpulint: {e}", file=sys.stderr)
-        return 2
+    cache_path = None
+    if args.cache is not None:
+        cache_path = (
+            os.path.join(root, args.cache)
+            if args.cache == incremental.DEFAULT_CACHE
+            else args.cache
+        )
+
+    findings = None
+    signature = None
+    if cache_path is not None:
+        signature = incremental.scan_signature(
+            root, core.iter_py_files(paths, root), rules
+        )
+        findings = incremental.load_cached(cache_path, signature)
+        if findings is not None:
+            print(
+                f"tpulint: replayed {len(findings)} finding(s) from "
+                f"cache {os.path.relpath(cache_path, root)}",
+                file=sys.stderr,
+            )
+    if findings is None:
+        try:
+            findings = core.run_analysis(paths, root=root, rules=rules)
+        except ValueError as e:
+            print(f"tpulint: {e}", file=sys.stderr)
+            return 2
+        if cache_path is not None and signature is not None:
+            incremental.save_cache(cache_path, signature, findings)
 
     if args.write_baseline:
         core.write_baseline(args.write_baseline, findings)
@@ -102,6 +158,25 @@ def main(argv: List[str] | None = None) -> int:
                   file=sys.stderr)
             return 2
     new, old, stale = core.split_by_baseline(findings, baseline)
+
+    since_excluded = 0
+    if args.since:
+        changed = incremental.changed_files(root, args.since)
+        if changed is None:
+            print(
+                f"tpulint: --since {args.since}: git could not "
+                "resolve the ref; gating on all findings",
+                file=sys.stderr,
+            )
+        else:
+            kept = incremental.filter_since(new, changed)
+            since_excluded = len(new) - len(kept)
+            new = kept
+
+    if args.sarif:
+        from tpufw.analysis import sarif
+
+        sarif.write_sarif(args.sarif, new)
 
     if args.json:
         print(
@@ -132,6 +207,11 @@ def main(argv: List[str] | None = None) -> int:
             )
             for k in sorted(stale):
                 print(f"  stale: {k}")
+        if since_excluded:
+            print(
+                f"tpulint: {since_excluded} finding(s) outside "
+                f"--since {args.since} not gating this run"
+            )
         if not new:
             print(
                 f"tpulint: clean ({len(findings)} finding(s) total, "

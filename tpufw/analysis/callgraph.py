@@ -81,6 +81,10 @@ class ModuleIndex:
         # module-level NAME = "literal" string constants
         self.constants: Dict[Tuple[str, str], str] = {}
         self.constants_by_name: Dict[str, Set[str]] = {}
+        # (module, local name) -> `name = partial(f, ...)` call node,
+        # module- or function-level; lets resolve_call see through the
+        # `step = partial(train_step, ...); jax.jit(step)` idiom.
+        self.partial_bindings: Dict[Tuple[str, str], ast.Call] = {}
         for f in project.files:
             if f.tree is None:
                 continue
@@ -111,6 +115,18 @@ class ModuleIndex:
                     walk(child, prefix)
 
         walk(f.tree, "")
+        for node in ast.walk(f.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and call_name(node.value) == "partial"
+                and node.value.args
+            ):
+                self.partial_bindings.setdefault(
+                    (mod, node.targets[0].id), node.value
+                )
         for node in ast.walk(f.tree):
             if isinstance(node, ast.Import):
                 for alias in node.names:
@@ -239,6 +255,29 @@ class ModuleIndex:
         imp = self.imports.get(module, {}).get(name)
         if imp is not None and imp[1] is not None:
             return self.by_module_qname.get((imp[0], imp[1]))
+        return None
+
+    def resolve_partial_binding(
+        self, name: str, module: str
+    ) -> Optional[FunctionInfo]:
+        """The function behind ``name = partial(f, ...)``, if any.
+
+        Deliberately NOT folded into resolve_call: the jit-boundary
+        rules (TPU006-TPU008) need to see through ``jax.jit(step)``
+        where ``step = partial(train_step, ...)``, but widening every
+        rule's reachability the same way would re-litigate TPU001's
+        calibration (partial-bound config scalars look like array
+        params to the hot-loop sync heuristics)."""
+        pc = self.partial_bindings.get((module, name))
+        if pc is None or not pc.args:
+            return None
+        inner = pc.args[0]
+        if isinstance(inner, ast.Name) and inner.id != name:
+            return self._resolve_name(inner.id, module, None)
+        if isinstance(inner, ast.Attribute):
+            fake = ast.Call(func=inner, args=[], keywords=[])
+            ast.copy_location(fake, inner)
+            return self.resolve_call(fake, module)
         return None
 
 

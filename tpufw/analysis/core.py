@@ -195,8 +195,13 @@ def find_repo_root(start: str) -> str:
         d = parent
 
 
-def collect_files(paths: Sequence[str], root: str) -> List[SourceFile]:
-    out: List[SourceFile] = []
+def iter_py_files(
+    paths: Sequence[str], root: str
+) -> List[tuple[str, str]]:
+    """(abspath, relpath) for every .py under ``paths``, deduped and
+    sorted. Split from :func:`collect_files` so the incremental cache
+    can hash contents without paying for a parse."""
+    out: List[tuple[str, str]] = []
     seen: Set[str] = set()
 
     def add(path: str) -> None:
@@ -204,13 +209,7 @@ def collect_files(paths: Sequence[str], root: str) -> List[SourceFile]:
         if ap in seen or not ap.endswith(".py"):
             return
         seen.add(ap)
-        rel = os.path.relpath(ap, root)
-        try:
-            with open(ap, encoding="utf-8") as fh:
-                text = fh.read()
-        except OSError:
-            return
-        out.append(SourceFile(ap, rel, text))
+        out.append((ap, os.path.relpath(ap, root)))
 
     for p in paths:
         if os.path.isfile(p):
@@ -224,17 +223,33 @@ def collect_files(paths: Sequence[str], root: str) -> List[SourceFile]:
             for fn in sorted(filenames):
                 if fn.endswith(".py"):
                     add(os.path.join(dirpath, fn))
-    out.sort(key=lambda f: f.relpath)
+    out.sort(key=lambda pair: pair[1])
+    return out
+
+
+def collect_files(paths: Sequence[str], root: str) -> List[SourceFile]:
+    out: List[SourceFile] = []
+    for ap, rel in iter_py_files(paths, root):
+        try:
+            with open(ap, encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError:
+            continue
+        out.append(SourceFile(ap, rel, text))
     return out
 
 
 def all_checkers() -> List[Checker]:
-    """The shipped rule set, TPU001..TPU005 (import here, not at
+    """The shipped rule set, TPU001..TPU009 (import here, not at
     module top, so core stays importable from checker modules)."""
+    from tpufw.analysis.donation import DonationChecker
+    from tpufw.analysis.dtypes import DtypeDriftChecker
     from tpufw.analysis.envreg import EnvRegistryChecker
     from tpufw.analysis.hotloop import HotLoopPurityChecker
+    from tpufw.analysis.locks import LockDisciplineChecker
     from tpufw.analysis.meshaxes import MeshAxisChecker
     from tpufw.analysis.obsnames import ObsNameChecker
+    from tpufw.analysis.retrace import RetraceChurnChecker
     from tpufw.analysis.rng import RngDisciplineChecker
 
     return [
@@ -243,6 +258,10 @@ def all_checkers() -> List[Checker]:
         RngDisciplineChecker(),
         EnvRegistryChecker(),
         ObsNameChecker(),
+        DonationChecker(),
+        RetraceChurnChecker(),
+        DtypeDriftChecker(),
+        LockDisciplineChecker(),
     ]
 
 

@@ -1,0 +1,785 @@
+"""jit-boundary dataflow substrate for tpulint's TPU006-TPU008 rules.
+
+PR 3's ``callgraph`` answers "which functions run under trace". The
+rules added here need more: for every ``jax.jit``/``pjit`` *site* —
+decorator or call form — which signature slots are donated or static,
+where the resulting compiled callable is invoked, and what dtypes flow
+through the traced body. This module resolves all three, statically
+and conservatively:
+
+- :class:`JitSite`: one jit wrapping, with parsed
+  ``donate_argnums``/``donate_argnames``/``static_argnums``/
+  ``static_argnames`` (literal specs only; a dynamic spec sets the
+  ``*_unparsed`` flag and downstream rules stay silent — false
+  negatives over false positives, same bias as ``callgraph``).
+- :func:`find_jit_sites` + :func:`find_call_sites`: sites and the call
+  expressions that invoke them, found through the binding idioms this
+  tree actually uses (``@jax.jit``, ``@partial(jax.jit, ...)``,
+  ``step = jax.jit(f, ...)``, ``self._step = jax.jit(...)``).
+- :class:`DtypeEnv`: a tiny abstract interpreter over one function
+  body with the lattice ``bf16 / fp16 / fp32 / int / int8 / bool /
+  weak-float / weak-int / unknown``. Only *strong* evidence (an
+  ``astype``, a ``dtype=`` kwarg, a dtype-defaulting constructor)
+  produces a non-unknown value; joins with ``unknown`` stay unknown,
+  so the dtype rules only ever fire on locally-proven facts.
+
+Everything is stdlib ``ast`` — the analysis package must keep running
+in the bare container and in CI with no installs.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tpufw.analysis import callgraph as cg
+from tpufw.analysis.core import SourceFile
+
+# Parameter names that, by this repo's conventions, carry large device
+# arrays: model/optimizer state, KV caches and their leaf tuples, page
+# tables, gradient/moment trees. Shape information is not available
+# statically, so names are the heuristic — matching callgraph's bias,
+# a miss is a false negative, never a false positive.
+LARGE_ARRAY_RE = re.compile(
+    r"(^|_)(params?|state|opt_state|cache|kv|leaves|grads?|moments?"
+    r"|pool|tables?|buffers?|weights?|carry)(_|$)|leaves$"
+)
+
+# Call names that pin a varying host value onto a bounded ladder of
+# compiled programs (serve's ``_pow2_ceil`` chunk/cache ladders, batch
+# bucketing). A value routed through one of these is not churn.
+PIN_CALL_RE = re.compile(
+    r"pow2|pow_?two|bucket|ladder|round_up|next_power|align|pad_to"
+)
+
+_JITTERS = {"jit", "pjit"}
+
+
+def is_large_param(name: str) -> bool:
+    return bool(LARGE_ARRAY_RE.search(name))
+
+
+def _int_elements(node: ast.AST) -> Optional[Set[int]]:
+    """Literal int / tuple-list of ints, else None (unparsable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for el in node.elts:
+            if not (
+                isinstance(el, ast.Constant) and isinstance(el.value, int)
+            ):
+                return None
+            out.add(el.value)
+        return out
+    return None
+
+
+def _str_elements(node: ast.AST) -> Optional[Set[str]]:
+    """Literal str / tuple-list of strs, else None (unparsable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for el in node.elts:
+            if not (
+                isinstance(el, ast.Constant) and isinstance(el.value, str)
+            ):
+                return None
+            out.add(el.value)
+        return out
+    return None
+
+
+class JitSite:
+    """One jax.jit/pjit wrapping and its parsed signature policy."""
+
+    def __init__(
+        self,
+        file: SourceFile,
+        node: ast.AST,
+        fn: Optional[cg.FunctionInfo],
+        how: str,
+    ):
+        self.file = file
+        self.module = cg.module_name(file.relpath)
+        self.node = node  # the jit call / decorator, for location
+        self.fn = fn  # traced function, when resolvable
+        self.lam: Optional[ast.Lambda] = None  # inline lambda form
+        self.how = how  # "@jit" | "jit()" | "@partial(jit)"
+        self.bound_name: Optional[str] = None  # step = jax.jit(f)
+        self.bound_attr: Optional[str] = None  # self._step = jax.jit(f)
+        self.donate_argnums: Set[int] = set()
+        self.donate_argnames: Set[str] = set()
+        self.static_argnums: Set[int] = set()
+        self.static_argnames: Set[str] = set()
+        self.donate_unparsed = False
+        self.static_unparsed = False
+        # jit(partial(f, *bound, kw=...)): params consumed by the
+        # partial are not jit arguments — positional indices shift and
+        # bound keywords can be neither donated nor churned.
+        self.partial_nargs = 0
+        self.partial_kwargs: Set[str] = set()
+
+    # ------------------------------------------------------- keywords
+
+    def absorb_keywords(self, keywords: Sequence[ast.keyword]) -> None:
+        for kw in keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                vals = (
+                    _int_elements(kw.value)
+                    if kw.arg == "donate_argnums"
+                    else _str_elements(kw.value)
+                )
+                if vals is None:
+                    self.donate_unparsed = True
+                elif kw.arg == "donate_argnums":
+                    self.donate_argnums |= vals  # type: ignore[arg-type]
+                else:
+                    self.donate_argnames |= vals  # type: ignore[arg-type]
+            elif kw.arg in ("static_argnums", "static_argnames"):
+                vals = (
+                    _int_elements(kw.value)
+                    if kw.arg == "static_argnums"
+                    else _str_elements(kw.value)
+                )
+                if vals is None:
+                    self.static_unparsed = True
+                elif kw.arg == "static_argnums":
+                    self.static_argnums |= vals  # type: ignore[arg-type]
+                else:
+                    self.static_argnames |= vals  # type: ignore[arg-type]
+
+    # ------------------------------------------------------ signature
+
+    def positional_params(self) -> List[str]:
+        """Names of the jit-visible positional parameters, in argnums
+        order: the traced function's positional params minus anything
+        consumed by a wrapping ``partial`` (kw-only params are
+        addressable by name only)."""
+        node = self.fn.node if self.fn is not None else self.lam
+        if node is None:
+            return []
+        a = node.args
+        out = [p.arg for p in a.posonlyargs + a.args]
+        out = out[self.partial_nargs:]
+        return [p for p in out if p not in self.partial_kwargs]
+
+    def kwonly_params(self) -> List[str]:
+        node = self.fn.node if self.fn is not None else self.lam
+        if node is None:
+            return []
+        return [
+            p.arg
+            for p in node.args.kwonlyargs
+            if p.arg not in self.partial_kwargs
+        ]
+
+    def is_donated(self, param: str) -> bool:
+        if param in self.donate_argnames:
+            return True
+        pos = self.positional_params()
+        return param in pos and pos.index(param) in self.donate_argnums
+
+    def is_static(self, param: str) -> bool:
+        if param in self.static_argnames:
+            return True
+        pos = self.positional_params()
+        return param in pos and pos.index(param) in self.static_argnums
+
+    def display_name(self) -> str:
+        if self.fn is not None:
+            return self.fn.qname
+        if self.bound_attr is not None:
+            return f"self.{self.bound_attr}"
+        return self.bound_name or "<lambda>"
+
+    def __repr__(self) -> str:  # debugging aid only
+        return f"<JitSite {self.module}:{self.display_name()} {self.how}>"
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """``node`` as a jax.jit/pjit Call, unwrapping nothing."""
+    if isinstance(node, ast.Call) and cg.call_name(node) in _JITTERS:
+        return node
+    return None
+
+
+def _unwrap_partials(node: ast.AST) -> Tuple[int, Set[str]]:
+    """(positional count, keyword names) consumed by nested
+    ``partial(...)`` wrappers around a traced function."""
+    nargs = 0
+    kwargs: Set[str] = set()
+    while (
+        isinstance(node, ast.Call)
+        and cg.call_name(node) == "partial"
+        and node.args
+    ):
+        nargs += len(node.args) - 1
+        kwargs |= {kw.arg for kw in node.keywords if kw.arg}
+        node = node.args[0]
+    return nargs, kwargs
+
+
+def find_jit_sites(
+    index: cg.ModuleIndex, files: Sequence[SourceFile]
+) -> List[JitSite]:
+    """Every jit/pjit wrapping in ``files``, with parsed policy and
+    (for the call form) the name/attribute the callable is bound to."""
+    sites: List[JitSite] = []
+    seen: Set[int] = set()
+
+    def add(site: JitSite) -> None:
+        if id(site.node) not in seen:
+            seen.add(id(site.node))
+            sites.append(site)
+
+    for f in files:
+        if f.tree is None:
+            continue
+        mod = cg.module_name(f.relpath)
+        for node in ast.walk(f.tree):
+            # ---- decorator forms -------------------------------------
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = _fn_info(index, mod, node)
+                for dec in node.decorator_list:
+                    site: Optional[JitSite] = None
+                    if isinstance(dec, (ast.Name, ast.Attribute)):
+                        chain = cg.attr_chain(dec)
+                        if chain and chain[-1] in _JITTERS:
+                            site = JitSite(f, dec, fi, f"@{chain[-1]}")
+                    elif isinstance(dec, ast.Call):
+                        nm = cg.call_name(dec)
+                        if nm in _JITTERS:
+                            site = JitSite(f, dec, fi, f"@{nm}(...)")
+                            site.absorb_keywords(dec.keywords)
+                        elif nm == "partial" and dec.args:
+                            chain = cg.attr_chain(dec.args[0])
+                            if chain and chain[-1] in _JITTERS:
+                                site = JitSite(
+                                    f, dec, fi, f"@partial({chain[-1]})"
+                                )
+                                site.absorb_keywords(dec.keywords)
+                    if site is not None:
+                        site.bound_name = node.name
+                        add(site)
+            # ---- call form, possibly bound ---------------------------
+            call = _jit_call(node)
+            if call is None:
+                continue
+            arg = cg._first_traced_arg(call)
+            if arg is None:
+                continue
+            partial_nargs, partial_kwargs = _unwrap_partials(arg)
+            arg = cg._unwrap_partial(arg)
+            fi = None
+            lam = None
+            if isinstance(arg, ast.Lambda):
+                lam = arg
+            elif isinstance(arg, (ast.Name, ast.Attribute)):
+                if isinstance(arg, ast.Name):
+                    # `step = partial(f, ...); jax.jit(step)`: the
+                    # binding carries the partial's consumed params.
+                    pc = index.partial_bindings.get((mod, arg.id))
+                    if pc is not None:
+                        n, kws = _unwrap_partials(pc)
+                        partial_nargs += n
+                        partial_kwargs |= kws
+                fake = ast.Call(func=arg, args=[], keywords=[])
+                ast.copy_location(fake, arg)
+                fi = index.resolve_call(fake, mod)
+                if fi is None and isinstance(arg, ast.Name):
+                    fi = index.resolve_partial_binding(arg.id, mod)
+            site = JitSite(f, call, fi, f"{cg.call_name(call)}()")
+            site.lam = lam
+            site.partial_nargs = partial_nargs
+            site.partial_kwargs = partial_kwargs
+            site.absorb_keywords(call.keywords)
+            add(site)
+        # Bindings: step = jax.jit(f, ...) / self._step = jax.jit(...).
+        for stmt in ast.walk(f.tree):
+            if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                continue
+            call = _jit_call(stmt.value)
+            if call is None:
+                continue
+            target = stmt.targets[0]
+            for site in sites:
+                if site.node is call:
+                    if isinstance(target, ast.Name):
+                        site.bound_name = target.id
+                    elif isinstance(target, ast.Attribute) and isinstance(
+                        target.value, ast.Name
+                    ):
+                        site.bound_attr = target.attr
+    return sites
+
+
+def _fn_info(
+    index: cg.ModuleIndex, mod: str, node: ast.AST
+) -> Optional[cg.FunctionInfo]:
+    for fi in index.by_simple_name.get(getattr(node, "name", ""), []):
+        if fi.node is node:
+            return fi
+    return None
+
+
+class CallSite:
+    """One invocation of a jitted callable, with argument binding."""
+
+    def __init__(
+        self,
+        site: JitSite,
+        file: SourceFile,
+        call: ast.Call,
+        owner: Optional[cg.FunctionInfo],
+    ):
+        self.site = site
+        self.file = file
+        self.call = call
+        self.owner = owner  # enclosing function, when known
+
+    def bound_args(self) -> List[Tuple[str, ast.AST]]:
+        """(param_name, arg_expr) pairs, positionally matched against
+        the traced signature; keywords by name. Starred/dynamic forms
+        are skipped."""
+        pos = self.site.positional_params()
+        out: List[Tuple[str, ast.AST]] = []
+        for i, a in enumerate(self.call.args):
+            if isinstance(a, ast.Starred):
+                break
+            if i < len(pos):
+                out.append((pos[i], a))
+        for kw in self.call.keywords:
+            if kw.arg is not None:
+                out.append((kw.arg, kw.value))
+        return out
+
+
+def find_call_sites(
+    index: cg.ModuleIndex,
+    files: Sequence[SourceFile],
+    sites: Sequence[JitSite],
+) -> Dict[int, List[CallSite]]:
+    """id(site) -> invocations. Decorated functions are matched through
+    ``resolve_call`` (cross-file, import-aware); ``name = jax.jit(f)``
+    bindings by name within the defining file; ``self._x = jax.jit(f)``
+    by ``self._x(...)`` / ``obj._x(...)`` attribute calls in the same
+    file. The jit wrapping itself is never its own call site."""
+    out: Dict[int, List[CallSite]] = {id(s): [] for s in sites}
+    by_fn_node: Dict[int, JitSite] = {}
+    for s in sites:
+        if s.fn is not None and s.how.startswith("@"):
+            by_fn_node[id(s.fn.node)] = s
+    call_bound: Dict[Tuple[str, str], List[JitSite]] = {}
+    attr_bound: Dict[Tuple[str, str], List[JitSite]] = {}
+    for s in sites:
+        if s.how.startswith("@"):
+            continue
+        if s.bound_name:
+            call_bound.setdefault(
+                (s.file.relpath, s.bound_name), []
+            ).append(s)
+        if s.bound_attr:
+            attr_bound.setdefault(
+                (s.file.relpath, s.bound_attr), []
+            ).append(s)
+    # Also: plain `@jit`-less functions called THROUGH a jit call form,
+    # e.g. step = jax.jit(train_step); later step(...) — covered by
+    # bound_name above. Direct calls to the decorated name:
+    for f in files:
+        if f.tree is None:
+            continue
+        mod = cg.module_name(f.relpath)
+        owner_of = _owner_map(index, f)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            owner = owner_of.get(id(node))
+            # Decorated functions, resolved cross-file.
+            fi = index.resolve_call(
+                node, mod, within=owner.qname if owner else None
+            )
+            if fi is not None and id(fi.node) in by_fn_node:
+                s = by_fn_node[id(fi.node)]
+                out[id(s)].append(CallSite(s, f, node, owner))
+                continue
+            # name(...) / self.attr(...) bindings (same file only).
+            func = node.func
+            if isinstance(func, ast.Name):
+                for s in call_bound.get((f.relpath, func.id), []):
+                    out[id(s)].append(CallSite(s, f, node, owner))
+            elif isinstance(func, ast.Attribute):
+                for s in attr_bound.get((f.relpath, func.attr), []):
+                    out[id(s)].append(CallSite(s, f, node, owner))
+    return out
+
+
+def _owner_map(
+    index: cg.ModuleIndex, f: SourceFile
+) -> Dict[int, cg.FunctionInfo]:
+    """id(call node) -> innermost enclosing FunctionInfo."""
+    out: Dict[int, cg.FunctionInfo] = {}
+    for fi in index.functions:
+        if fi.file is not f:
+            continue
+        for call in cg.iter_calls(fi.node):
+            out[id(call)] = fi  # later (inner) definitions overwrite
+    return out
+
+
+# ---------------------------------------------------------------- dtypes
+
+BF16 = "bf16"
+FP16 = "fp16"
+FP32 = "fp32"
+INT = "int"
+INT8 = "int8"
+BOOL = "bool"
+WEAK_FLOAT = "weak-float"  # Python float literal: inherits neighbor dtype
+WEAK_INT = "weak-int"
+UNKNOWN = "unknown"
+
+_DTYPE_NAMES = {
+    "bfloat16": BF16,
+    "bf16": BF16,
+    "float16": FP16,
+    "half": FP16,
+    "float32": FP32,
+    "float_": FP32,
+    "float64": FP32,  # CPU-double; still a "wide float" for drift purposes
+    "int8": INT8,
+    "int16": INT,
+    "int32": INT,
+    "int64": INT,
+    "uint8": INT8,
+    "uint32": INT,
+    "bool_": BOOL,
+    "bool": BOOL,
+}
+
+_FLOAT_STRONG = {BF16, FP16, FP32}
+
+# jnp constructors whose no-dtype default is fp32 (float family).
+FLOAT_DEFAULT_CTORS = {"zeros", "ones", "empty", "full", "linspace"}
+INT_DEFAULT_CTORS = {"arange"}
+_LIKE_CTORS = {"zeros_like", "ones_like", "empty_like", "full_like"}
+
+_JNP_ALIASES = {"jnp", "np", "numpy", "onp"}
+
+
+def dtype_of_node(node: ast.AST) -> str:
+    """Dtype named by an expression like ``jnp.bfloat16`` / the string
+    literal "bfloat16" — UNKNOWN when it isn't a recognizable name."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return _DTYPE_NAMES.get(node.value, UNKNOWN)
+    chain = cg.attr_chain(node)
+    if chain:
+        return _DTYPE_NAMES.get(chain[-1], UNKNOWN)
+    return UNKNOWN
+
+
+def _ctor_dtype_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The dtype expression of a jnp constructor call, positional or
+    keyword, or None when the call leaves the dtype to the default."""
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    name = cg.call_name(call)
+    # zeros(shape, dtype) / ones / empty; full(shape, fill, dtype);
+    # arange(...,[dtype]) is keyword-only in practice here.
+    if name in ("zeros", "ones", "empty") and len(call.args) >= 2:
+        return call.args[1]
+    if name == "full" and len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+def join(a: str, b: str) -> str:
+    """Lattice join mirroring jax type promotion closely enough for
+    drift detection: weak values inherit the strong side, mixed strong
+    floats widen to the widest, anything touching UNKNOWN is UNKNOWN."""
+    if a == b:
+        return a
+    if a == UNKNOWN or b == UNKNOWN:
+        return UNKNOWN
+    for weak, strongs in (
+        (WEAK_FLOAT, _FLOAT_STRONG | {WEAK_INT}),
+        (WEAK_INT, _FLOAT_STRONG | {INT, INT8, WEAK_FLOAT}),
+    ):
+        if a == weak and b in strongs:
+            return b if b != WEAK_INT else WEAK_FLOAT
+        if b == weak and a in strongs:
+            return a if a != WEAK_INT else WEAK_FLOAT
+    if a in _FLOAT_STRONG and b in _FLOAT_STRONG:
+        return FP32 if FP32 in (a, b) else FP16
+    if a in (INT, INT8) and b in (INT, INT8):
+        return INT
+    if a in _FLOAT_STRONG and b in (INT, INT8, BOOL):
+        return a
+    if b in _FLOAT_STRONG and a in (INT, INT8, BOOL):
+        return b
+    return UNKNOWN
+
+
+class DtypeEnv:
+    """One-pass abstract interpretation of a function body: a map from
+    local names to lattice dtypes, built in statement order (loop
+    bodies are visited once — enough for drift detection, which only
+    acts on stable local evidence)."""
+
+    # jnp reductions/elementwise that preserve their argument's dtype.
+    _PRESERVING = {
+        "sum", "mean", "max", "min", "abs", "exp", "log", "sqrt",
+        "square", "tanh", "reshape", "transpose", "swapaxes",
+        "broadcast_to", "where", "concatenate", "stack", "pad",
+        "dynamic_update_slice", "dynamic_slice", "take_along_axis",
+        "maximum", "minimum", "negative", "clip", "roll",
+    }
+
+    def __init__(self, fn: cg.FuncNode):
+        self.env: Dict[str, str] = {}
+        body = fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+        # Parameter annotations are the only pre-body evidence.
+        for p in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+            if p.annotation is not None:
+                d = dtype_of_node(p.annotation)
+                if d != UNKNOWN:
+                    self.env[p.arg] = d
+        for stmt in body:
+            self._visit_stmt(stmt)
+
+    def _visit_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # separate scope; analyzed on its own
+        if isinstance(stmt, ast.Assign):
+            d = self.infer(stmt.value)
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    prev = self.env.get(t.id)
+                    # A re-bind to a different proven dtype makes the
+                    # name unstable — drop to UNKNOWN rather than pick.
+                    if prev is not None and prev != d:
+                        self.env[t.id] = UNKNOWN
+                    else:
+                        self.env[t.id] = d
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            d = dtype_of_node(stmt.annotation)
+            if d == UNKNOWN and stmt.value is not None:
+                d = self.infer(stmt.value)
+            self.env[stmt.target.id] = d
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if isinstance(sub, list):
+                for s in sub:
+                    if isinstance(s, ast.stmt):
+                        self._visit_stmt(s)
+        for h in getattr(stmt, "handlers", []) or []:
+            for s in h.body:
+                self._visit_stmt(s)
+
+    # ---------------------------------------------------------- infer
+
+    def infer(self, node: ast.AST) -> str:
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return BOOL
+            if isinstance(node.value, int):
+                return WEAK_INT
+            if isinstance(node.value, float):
+                return WEAK_FLOAT
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Subscript):
+            return self.infer(node.value)
+        if isinstance(node, ast.UnaryOp):
+            return self.infer(node.operand)
+        if isinstance(node, ast.Compare):
+            return BOOL
+        if isinstance(node, ast.BinOp):
+            ld, rd = self.infer(node.left), self.infer(node.right)
+            if isinstance(node.op, ast.Div) and ld in (
+                INT, WEAK_INT
+            ) and rd in (INT, WEAK_INT):
+                return FP32  # true division of ints promotes to f32
+            return join(ld, rd)
+        if isinstance(node, ast.IfExp):
+            return join(self.infer(node.body), self.infer(node.orelse))
+        if isinstance(node, ast.Call):
+            return self._infer_call(node)
+        if isinstance(node, ast.Attribute):
+            # x.T / x.real keep dtype; a bare dtype name IS a dtype.
+            d = dtype_of_node(node)
+            if d != UNKNOWN:
+                return d
+            if node.attr in ("T", "mT", "real"):
+                return self.infer(node.value)
+            return UNKNOWN
+        return UNKNOWN
+
+    def _infer_call(self, call: ast.Call) -> str:
+        name = cg.call_name(call)
+        chain = cg.attr_chain(call.func)
+        if name == "astype" and call.args:
+            return dtype_of_node(call.args[0])
+        if name is None:
+            return UNKNOWN
+        # jnp.float32(x)-style casts and dtype constructors.
+        if name in _DTYPE_NAMES:
+            return _DTYPE_NAMES[name]
+        is_jnp = bool(chain) and len(chain) >= 2 and chain[0] in _JNP_ALIASES
+        if is_jnp or len(chain or []) == 1:
+            if name in FLOAT_DEFAULT_CTORS:
+                dt = _ctor_dtype_arg(call)
+                if dt is None:
+                    if name == "full" and len(call.args) >= 2:
+                        return self.infer(call.args[1])
+                    return FP32
+                return dtype_of_node(dt)
+            if name in INT_DEFAULT_CTORS:
+                dt = _ctor_dtype_arg(call)
+                return INT if dt is None else dtype_of_node(dt)
+            if name in _LIKE_CTORS:
+                dt = _ctor_dtype_arg(call)
+                if dt is not None:
+                    return dtype_of_node(dt)
+                return self.infer(call.args[0]) if call.args else UNKNOWN
+        if name in self._PRESERVING:
+            # where(c, a, b): dtype joins the branches, not the mask.
+            args = call.args[1:] if name == "where" else call.args
+            d = UNKNOWN
+            for i, a in enumerate(args):
+                ad = self.infer(a)
+                d = ad if i == 0 else join(d, ad)
+            # Attribute form x.sum(): dtype of the receiver.
+            if not args and isinstance(call.func, ast.Attribute):
+                return self.infer(call.func.value)
+            return d
+        if name in ("einsum", "dot", "matmul", "dot_general", "tensordot"):
+            for kw in call.keywords:
+                if kw.arg == "preferred_element_type":
+                    return dtype_of_node(kw.value)
+            d = UNKNOWN
+            operands = [
+                a for a in call.args
+                if not (
+                    isinstance(a, ast.Constant)
+                    and isinstance(a.value, str)
+                )
+            ]
+            for i, a in enumerate(operands):
+                ad = self.infer(a)
+                d = ad if i == 0 else join(d, ad)
+            return d
+        return UNKNOWN
+
+
+def iter_binops(fn: cg.FuncNode) -> Iterator[ast.BinOp]:
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.BinOp):
+                yield node
+
+
+# ------------------------------------------------------- varying values
+
+class VaryingEnv:
+    """Host-side per-function classification of names whose VALUE or
+    whose SHAPE varies across iterations/calls — the trace-cache keys
+    TPU007 cares about. A name is value-varying when it is a loop
+    target or assigned from ``len(...)``/another varying name;
+    shape-varying when assigned from a size-constructing call or a
+    slice whose bounds are value-varying. Routing through a
+    ``PIN_CALL_RE`` call (pow2 ladders, bucketing) clears both."""
+
+    _SIZED_CTORS = {
+        "zeros", "ones", "full", "empty", "arange", "tile", "repeat",
+        "split",
+    }
+
+    def __init__(self, fn: cg.FuncNode):
+        self.value_varying: Set[str] = set()
+        self.shape_varying: Set[str] = set()
+        body = fn.body if isinstance(fn.body, list) else []
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.For):
+                    self.value_varying |= _target_names(node.target)
+                elif isinstance(node, ast.While):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.AugAssign) and isinstance(
+                            sub.target, ast.Name
+                        ):
+                            self.value_varying.add(sub.target.id)
+        # Forward propagation, two passes to catch simple chains.
+        for _ in range(2):
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    if self.expr_value_varying(node.value):
+                        for t in node.targets:
+                            self.value_varying |= _target_names(t)
+                    if self.expr_shape_varying(node.value):
+                        for t in node.targets:
+                            self.shape_varying |= _target_names(t)
+
+    def expr_value_varying(self, node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                nm = cg.call_name(sub)
+                if nm and PIN_CALL_RE.search(nm):
+                    return False  # pinned — stop looking deeper
+            if isinstance(sub, ast.Name) and sub.id in self.value_varying:
+                return True
+        return False
+
+    def expr_shape_varying(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.shape_varying
+        if isinstance(node, ast.Subscript):
+            sl = node.slice
+            slices = list(sl.elts) if isinstance(sl, ast.Tuple) else [sl]
+            for s in slices:
+                if isinstance(s, ast.Slice):
+                    for bound in (s.lower, s.upper):
+                        if bound is not None and self.expr_value_varying(
+                            bound
+                        ):
+                            return True
+            return self.expr_shape_varying(node.value)
+        if isinstance(node, ast.Call):
+            nm = cg.call_name(node)
+            if nm and PIN_CALL_RE.search(nm):
+                return False
+            if nm in self._SIZED_CTORS:
+                for a in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    if self.expr_value_varying(a):
+                        return True
+            # asarray(x)/astype(x)-style wrappers keep x's shape.
+            if nm in ("asarray", "array", "astype") and node.args:
+                return self.expr_shape_varying(node.args[0])
+        if isinstance(node, ast.BinOp):
+            return self.expr_shape_varying(
+                node.left
+            ) or self.expr_shape_varying(node.right)
+        return False
+
+
+def _target_names(t: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    if isinstance(t, ast.Name):
+        names.add(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            names |= _target_names(e)
+    elif isinstance(t, ast.Starred):
+        names |= _target_names(t.value)
+    return names

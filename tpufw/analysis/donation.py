@@ -1,0 +1,305 @@
+"""TPU006: a jit whose output structurally replaces a large array
+input must donate that input.
+
+Without ``donate_argnums``/``donate_argnames``, XLA must keep the
+input buffer alive while materializing the output, so every
+update-and-return step — optimizer updates, KV-cache inserts, page
+table rewrites — transiently holds TWO copies of its largest
+arrays. On an HBM-bound TPU footprint (ISSUE 8 / the concurrency
+paper in PAPERS.md) that doubling IS the capacity ceiling: the
+difference between fitting 8B params + opt state on a v5e-16 and
+OOMing at startup.
+
+Detection: for every jit/pjit site whose traced function we can see,
+run a forward taint pass over the body distinguishing *aliasing*
+(the value merely derives from a parameter — a read, a slice, a
+pass-through) from *updating* (functional replacement: ``.at[].set``,
+``dynamic_update_slice``, ``optax.apply_updates``, ``.replace(...)``,
+``tree_map`` over the param, a ``lax.scan`` carry seeded with it, or
+rebinding the parameter's own name from a call that consumes it).
+Returning an *updated* value whose source parameter matches the
+large-array name heuristic and is not donated is the finding.
+Pure aliased reads never fire — that asymmetry is what keeps
+gather-only jits (lookups, metric reductions) clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Sequence, Set, Tuple
+
+from tpufw.analysis import callgraph as cg
+from tpufw.analysis import dataflow as df
+from tpufw.analysis.core import Checker, Finding, Project
+
+# (function qname, param) pairs where returning a non-donated large
+# input is deliberate — genuinely aliased reads the heuristic cannot
+# distinguish. Prefer inline `# tpulint: disable=TPU006` with a
+# justification next to the jit; this list exists for cases where the
+# decorator line is generated or shared.
+_ALLOWED_ALIASED: Set[Tuple[str, str]] = set()
+
+# .at[...].<op>(...) functional-update methods.
+_AT_OPS = {
+    "set", "add", "multiply", "mul", "divide", "div", "power",
+    "min", "max", "apply", "get",
+}
+
+_UPDATE_CALLS = {"dynamic_update_slice", "apply_updates"}
+_TREE_MAPS = {"tree_map", "tree_multimap"}
+
+# x.shape / x.dtype reads are scalar metadata, not the buffer: a value
+# built from them (an index, a zeros() of the same shape) does NOT
+# alias x's memory.
+_METADATA_ATTRS = {"shape", "dtype", "ndim", "size", "sharding",
+                   "itemsize", "weak_type"}
+
+# lax control-flow ops whose result is the (rebound) carry: the index
+# of the carry-init argument.
+_CARRY_ARG = {"scan": 1, "while_loop": 2, "fori_loop": 3}
+
+
+class _Taint:
+    """Forward alias/update taint over one traced function body."""
+
+    def __init__(self, params: Sequence[str]):
+        self.params = set(params)
+        # var name -> source params it derives from (any dataflow)
+        self.alias: Dict[str, Set[str]] = {p: {p} for p in params}
+        # var name -> source params it is an UPDATED version of
+        self.updated: Dict[str, Set[str]] = {}
+
+    # -------------------------------------------------- expressions
+
+    def aliases(self, node: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        stack: List[ast.AST] = [node]
+        while stack:
+            sub = stack.pop()
+            if (
+                isinstance(sub, ast.Attribute)
+                and sub.attr in _METADATA_ATTRS
+            ):
+                continue  # vocab = logits.shape[-1] aliases nothing
+            if isinstance(sub, ast.Name):
+                out |= self.alias.get(sub.id, set())
+            stack.extend(ast.iter_child_nodes(sub))
+        return out
+
+    def direct_updates(self, node: ast.AST) -> Set[str]:
+        """Params functionally updated by an expression itself."""
+        out: Set[str] = set()
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            name = cg.call_name(sub)
+            # x.at[idx].set(v) — receiver is Subscript(Attribute .at)
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _AT_OPS
+                and isinstance(func.value, ast.Subscript)
+                and isinstance(func.value.value, ast.Attribute)
+                and func.value.value.attr == "at"
+            ):
+                if func.attr != "get":
+                    out |= self.aliases(func.value.value.value)
+            elif name in _UPDATE_CALLS and sub.args:
+                out |= self.aliases(sub.args[0])
+                if name == "apply_updates" and len(sub.args) > 1:
+                    out |= self.aliases(sub.args[1])
+            elif name in _TREE_MAPS and len(sub.args) > 1:
+                for a in sub.args[1:]:
+                    out |= self.aliases(a)
+            elif name == "apply_gradients" and isinstance(
+                func, ast.Attribute
+            ):
+                out |= self.aliases(func.value)
+            elif name == "replace" and isinstance(func, ast.Attribute):
+                out |= self.aliases(func.value)
+        return out
+
+    def updated_sources(self, node: ast.AST) -> Set[str]:
+        out = self.direct_updates(node)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                out |= self.updated.get(sub.id, set())
+        return out
+
+    # --------------------------------------------------- statements
+
+    def visit_body(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self._visit(stmt)
+
+    def _visit(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs (scan steps) analyzed via their scan
+        if isinstance(stmt, ast.Assign):
+            self._assign(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AugAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            t = stmt.target.id
+            self.alias[t] = self.alias.get(t, set()) | self.aliases(
+                stmt.value
+            )
+        elif isinstance(stmt, ast.Expr) and isinstance(
+            stmt.value, ast.Call
+        ):
+            # out.append(updated_row): the list inherits the taint.
+            call = stmt.value
+            if (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("append", "extend", "insert")
+                and isinstance(call.func.value, ast.Name)
+                and call.args
+            ):
+                t = call.func.value.id
+                for a in call.args:
+                    self.alias[t] = self.alias.get(t, set()) | (
+                        self.aliases(a)
+                    )
+                    self.updated[t] = self.updated.get(t, set()) | (
+                        self.updated_sources(a)
+                    )
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if isinstance(sub, list):
+                self.visit_body([s for s in sub if isinstance(s, ast.stmt)])
+        for h in getattr(stmt, "handlers", []) or []:
+            self.visit_body(h.body)
+
+    def _assign(
+        self, targets: Sequence[ast.expr], value: ast.AST
+    ) -> None:
+        als = self.aliases(value)
+        upd = self.updated_sources(value)
+        # Rebinding a parameter's own name from a call that consumes it
+        # is the `cache = apply(cache, ...)` idiom — the new value
+        # structurally replaces the old buffer.
+        rebind: Set[str] = set()
+        if isinstance(value, ast.Call):
+            consumed = self.aliases(value)
+            for t in targets:
+                for nm in _names_in(t):
+                    if nm in self.params and nm in consumed:
+                        rebind.add(nm)
+        carry_idx = (
+            _CARRY_ARG.get(cg.call_name(value) or "")
+            if isinstance(value, ast.Call)
+            else None
+        )
+        for t in targets:
+            if carry_idx is not None and len(value.args) > carry_idx:
+                # lax.scan/while/fori: the result carry is a rebound
+                # version of the INIT argument's buffers — the step
+                # function (args before the init) merely reads params
+                # through its closure and must not taint the carry.
+                init = value.args[carry_idx]
+                als_c = self.aliases(init)
+                # Only a parameter passed DIRECTLY as (part of) the
+                # init is rebound by the carry; a local merely derived
+                # from a param (a prefilled cache computed FROM the
+                # weights) is fresh memory, not a replacement.
+                upd_c = self.updated_sources(init) | (
+                    _direct_names(init) & self.params
+                )
+                if (
+                    cg.call_name(value) == "scan"
+                    and isinstance(t, ast.Tuple)
+                    and t.elts
+                ):
+                    # (carry...), ys = lax.scan(...): ys is fresh.
+                    carry_names = _names_in(t.elts[0])
+                    other_names: Set[str] = set()
+                    for e in t.elts[1:]:
+                        other_names |= _names_in(e)
+                else:
+                    carry_names = _names_in(t)
+                    other_names = set()
+                for nm in carry_names:
+                    self.alias[nm] = set(als_c)
+                    self.updated[nm] = set(upd_c)
+                for nm in other_names:
+                    self.alias[nm] = set()
+                    self.updated[nm] = set()
+                continue
+            for nm in _names_in(t):
+                self.alias[nm] = set(als)
+                self.updated[nm] = set(upd) | (
+                    {nm} if nm in rebind else set()
+                )
+
+
+def _direct_names(node: ast.AST) -> Set[str]:
+    """Bare names at the top level of a (possibly nested) tuple/list
+    expression — NOT names buried inside calls or subscripts."""
+    if isinstance(node, ast.Name):
+        return {node.id}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for e in node.elts:
+            out |= _direct_names(e)
+        return out
+    return set()
+
+
+def _names_in(t: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(t):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+    return out
+
+
+class DonationChecker(Checker):
+    rule = "TPU006"
+    name = "jit-donation"
+    severity = "error"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        index = cg.ModuleIndex(project)
+        sites = df.find_jit_sites(index, project.files)
+        for site in sites:
+            if site.donate_unparsed:
+                continue  # dynamic donate spec: assume the author knew
+            node = site.fn.node if site.fn is not None else site.lam
+            if node is None:
+                continue
+            params = site.positional_params() + site.kwonly_params()
+            large = [
+                p for p in params
+                if df.is_large_param(p) and not site.is_static(p)
+            ]
+            if not large:
+                continue
+            taint = _Taint(params)
+            if isinstance(node, ast.Lambda):
+                returned = [node.body]
+            else:
+                taint.visit_body(node.body)
+                returned = [
+                    r.value
+                    for r in ast.walk(node)
+                    if isinstance(r, ast.Return) and r.value is not None
+                ]
+            flagged: Set[str] = set()
+            for expr in returned:
+                flagged |= taint.updated_sources(expr)
+                flagged |= taint.direct_updates(expr)
+            qname = site.display_name()
+            for p in sorted(flagged):
+                if p not in large or site.is_donated(p):
+                    continue
+                if (qname, p) in _ALLOWED_ALIASED:
+                    continue
+                yield self.finding(
+                    site.file,
+                    site.node,
+                    f"jit of {qname!r} returns an updated version of "
+                    f"large input {p!r} without donating it "
+                    f"(donate_argnames=({p!r},)); the un-donated input "
+                    "doubles peak HBM for the step",
+                    symbol=f"donate:{qname}:{p}",
+                )

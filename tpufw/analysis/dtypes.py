@@ -1,0 +1,231 @@
+"""TPU008: dtype drift across the jit boundary.
+
+Three failure modes, all silent at runtime on TPU:
+
+- ``dtypeless``: ``jnp.zeros``/``ones``/``empty`` with no dtype inside
+  traced code defaults to fp32. In a bf16 hot loop the fp32 value
+  poisons downstream arithmetic (jax promotes bf16+fp32 -> fp32), so
+  one forgotten dtype doubles the flop and memory cost of everything
+  it touches. ``jnp.arange`` defaults to int — legitimate for
+  indexing, so it is flagged only when the result feeds float
+  arithmetic directly.
+- ``upcast``: an expression that provably mixes strong-bf16 and
+  strong-fp32 operands. jax will widen to fp32 without a word; if the
+  widening is intended (an accumulator), it should be written as an
+  explicit ``astype``/``preferred_element_type`` so the reader — and
+  this rule — can see it.
+- ``accum``: a loss/accumulation-shaped traced function that reduces
+  a provably-bf16 value with no fp32 evidence anywhere in the
+  function (no ``astype(float32)``, no
+  ``preferred_element_type=float32``). PR 7's pipeline work showed
+  bf16 loss/grad-accum sums lose ulps at scale; ops/loss.py is the
+  canonical fp32-epilogue idiom this warns toward.
+
+All three act only on *proven* local dtypes from
+:class:`tpufw.analysis.dataflow.DtypeEnv` — an ``unknown`` operand
+never fires a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Set
+
+from tpufw.analysis import callgraph as cg
+from tpufw.analysis import dataflow as df
+from tpufw.analysis.core import Checker, Finding, Project, SourceFile
+
+_ACCUM_FN_RE = re.compile(
+    r"loss|xent|cross_entropy|accum|epilogue|logit|vocab|softmax|nll"
+)
+_REDUCERS = {"sum", "mean"}
+
+
+def _walk_no_defs(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``node`` without descending into nested function scopes
+    (including when ``node`` itself is a def statement)."""
+    stack: List[ast.AST] = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue  # a nested scope, analyzed on its own
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _scopes_in(fn: cg.FuncNode) -> Iterator[cg.FuncNode]:
+    """``fn`` and every function scope nested inside it (scan steps,
+    grad closures) — each analyzed with its own local dtype env."""
+    yield fn
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                yield sub
+
+
+def _has_fp32_evidence(fn: cg.FuncNode) -> bool:
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call):
+                nm = cg.call_name(sub)
+                if nm == "astype" and sub.args:
+                    if df.dtype_of_node(sub.args[0]) == df.FP32:
+                        return True
+                for kw in sub.keywords:
+                    if kw.arg == "preferred_element_type":
+                        if df.dtype_of_node(kw.value) == df.FP32:
+                            return True
+            chain = cg.attr_chain(sub)
+            if chain and chain[-1] in ("float32", "float64"):
+                return True
+    return False
+
+
+class DtypeDriftChecker(Checker):
+    rule = "TPU008"
+    name = "dtype-drift"
+    severity = "error"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        index = cg.ModuleIndex(project)
+        roots = cg.find_traced_roots(index, project.files)
+        # find_jit_sites additionally sees through partial bindings
+        # (`step = partial(f, ...); jax.jit(step)`), which the plain
+        # root walk cannot — fold those functions in as roots.
+        root_ids = {id(fi.node) for fi, _how in roots}
+        for site in df.find_jit_sites(index, project.files):
+            if site.fn is not None and id(site.fn.node) not in root_ids:
+                roots.append((site.fn, site.how))
+                root_ids.add(id(site.fn.node))
+        reachable = cg.reachable_functions(index, roots)
+        seen_nodes: Set[int] = set()
+        for fi, _how in reachable.values():
+            for scope in _scopes_in(fi.node):
+                if id(scope) in seen_nodes:
+                    continue
+                seen_nodes.add(id(scope))
+                yield from self._check_scope(fi, scope)
+
+    # ------------------------------------------------------ one scope
+
+    def _check_scope(
+        self, fi: cg.FunctionInfo, scope: cg.FuncNode
+    ) -> Iterator[Finding]:
+        file: SourceFile = fi.file
+        env = df.DtypeEnv(scope)
+        qname = fi.qname if scope is fi.node else (
+            f"{fi.qname}.{getattr(scope, 'name', '<lambda>')}"
+        )
+        body = scope.body if isinstance(scope.body, list) else [scope.body]
+        for stmt in body:
+            for node in _walk_no_defs(stmt):
+                if isinstance(node, ast.Call):
+                    yield from self._check_ctor(file, qname, node)
+                elif isinstance(node, ast.BinOp):
+                    yield from self._check_upcast(file, env, qname, node)
+        yield from self._check_accum(file, env, qname, scope)
+
+    def _check_ctor(
+        self, file: SourceFile, qname: str, call: ast.Call
+    ) -> Iterator[Finding]:
+        name = cg.call_name(call)
+        chain = cg.attr_chain(call.func) or []
+        is_jnp = len(chain) >= 2 and chain[0] in df._JNP_ALIASES
+        if not is_jnp:
+            return
+        if name in ("zeros", "ones", "empty"):
+            if df._ctor_dtype_arg(call) is None:
+                src = ast.unparse(call)[:48]
+                yield self.finding(
+                    file,
+                    call,
+                    f"dtype-less jnp.{name} in traced {qname!r} "
+                    "defaults to fp32 and silently upcasts bf16 "
+                    "arithmetic it meets; write the dtype you mean "
+                    "(fp32 for accumulators, the compute dtype for "
+                    "activations)",
+                    symbol=f"dtypeless:{qname}:{src}",
+                )
+
+    def _check_upcast(
+        self, file: SourceFile, env: df.DtypeEnv, qname: str,
+        node: ast.BinOp,
+    ) -> Iterator[Finding]:
+        ld, rd = env.infer(node.left), env.infer(node.right)
+        pair = {ld, rd}
+        if pair == {df.BF16, df.FP32}:
+            src = ast.unparse(node)[:48]
+            yield self.finding(
+                file,
+                node,
+                f"expression in traced {qname!r} mixes strong bf16 "
+                "and strong fp32 operands — jax widens to fp32 "
+                "silently; make the intent explicit with .astype()",
+                symbol=f"upcast:{qname}:{src}",
+            )
+        # int arange feeding float math: the int default was probably
+        # not what the author meant.
+        for side, d in ((node.left, ld), (node.right, rd)):
+            if (
+                isinstance(side, ast.Call)
+                and cg.call_name(side) == "arange"
+                and df._ctor_dtype_arg(side) is None
+                and isinstance(node.op, (ast.Div, ast.Mult))
+                and {ld, rd} & {df.BF16, df.FP16, df.FP32, df.WEAK_FLOAT}
+                and d == df.INT
+            ):
+                src = ast.unparse(side)[:48]
+                yield self.finding(
+                    file,
+                    side,
+                    f"dtype-less jnp.arange in traced {qname!r} feeds "
+                    "float arithmetic: int->float promotion here is "
+                    "implicit fp32; pass the intended float dtype",
+                    symbol=f"dtypeless:{qname}:{src}",
+                )
+
+    def _check_accum(
+        self, file: SourceFile, env: df.DtypeEnv, qname: str,
+        scope: cg.FuncNode,
+    ) -> Iterator[Finding]:
+        simple = qname.rsplit(".", 1)[-1]
+        if not _ACCUM_FN_RE.search(simple):
+            return
+        if _has_fp32_evidence(scope):
+            return
+        body = scope.body if isinstance(scope.body, list) else [scope.body]
+        for stmt in body:
+            for node in _walk_no_defs(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = cg.call_name(node)
+                if name not in _REDUCERS:
+                    continue
+                operand: ast.AST
+                if node.args:
+                    operand = node.args[0]
+                elif isinstance(node.func, ast.Attribute):
+                    operand = node.func.value
+                else:
+                    continue
+                if env.infer(operand) == df.BF16:
+                    yield self.finding(
+                        file,
+                        node,
+                        f"loss/accum-shaped traced {qname!r} reduces a "
+                        "bf16 value with no fp32 accumulator in sight "
+                        "(no astype(float32) / "
+                        "preferred_element_type): bf16 sums lose "
+                        "precision at scale — accumulate in fp32 as "
+                        "ops/loss.py does",
+                        symbol=f"accum:{qname}:{name}",
+                        severity="warning",
+                    )
+                    return  # one per function is signal enough

@@ -1,0 +1,86 @@
+"""TPU007: call sites that feed a jit unbounded host-varying values.
+
+jax caches one compiled program per (static-arg values, dynamic-arg
+shapes/dtypes) key. A call site that passes a *varying* Python value
+into a static slot — or a *varying-shape* array into a dynamic slot —
+recompiles on every distinct value, and each recompile is host-side
+serialization of exactly the kind the concurrency paper (PAPERS.md)
+identifies as the real TPU throughput ceiling. This repo defends the
+invariant at runtime with TRACE_COUNTS assertions and bounds program
+counts with pow2 chunk/cache ladders (``_pow2_ceil``,
+``_cache_bucket`` in workloads/serve.py); TPU007 is the same contract
+checked statically, before a run is burned discovering it.
+
+A host value is "varying" when it is a loop target, or flows from
+``len(...)`` / another varying name; it is "pinned" (not churn) the
+moment it routes through a ladder/bucket call
+(:data:`tpufw.analysis.dataflow.PIN_CALL_RE`). Shapes vary when an
+array is built by a size-taking constructor or slice whose bound is a
+varying value. Owner-function parameters and attributes are treated
+as non-varying — one call site cannot see its callers, and the bias
+throughout tpulint is false negatives over false positives.
+
+Call sites already under trace (a jitted helper invoked from a jitted
+step) are skipped: inner jits inline into the outer trace, so there
+is no per-call recompile key to protect.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tpufw.analysis import callgraph as cg
+from tpufw.analysis import dataflow as df
+from tpufw.analysis.core import Checker, Finding, Project
+
+
+class RetraceChurnChecker(Checker):
+    rule = "TPU007"
+    name = "recompile-churn"
+    severity = "warning"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        index = cg.ModuleIndex(project)
+        sites = df.find_jit_sites(index, project.files)
+        calls = df.find_call_sites(index, project.files, sites)
+        roots = cg.find_traced_roots(index, project.files)
+        traced = cg.reachable_functions(index, roots)
+        envs: dict = {}
+        for site in sites:
+            if site.static_unparsed:
+                continue
+            for cs in calls.get(id(site), []):
+                if cs.owner is None:
+                    continue  # module top level runs once: no churn
+                if id(cs.owner.node) in traced:
+                    continue  # inner jit: inlined into the outer trace
+                env = envs.get(id(cs.owner.node))
+                if env is None:
+                    env = df.VaryingEnv(cs.owner.node)
+                    envs[id(cs.owner.node)] = env
+                qname = site.display_name()
+                for param, arg in cs.bound_args():
+                    if site.is_static(param):
+                        if env.expr_value_varying(arg):
+                            yield self.finding(
+                                cs.file,
+                                cs.call,
+                                f"call to jitted {qname!r} passes a "
+                                f"host-varying value for static arg "
+                                f"{param!r}: every distinct value "
+                                "recompiles; pin it through a pow2 "
+                                "ladder/bucket or drop it from "
+                                "static_argnums",
+                                symbol=f"static-churn:{qname}:{param}",
+                            )
+                    elif env.expr_shape_varying(arg):
+                        yield self.finding(
+                            cs.file,
+                            cs.call,
+                            f"call to jitted {qname!r} passes arg "
+                            f"{param!r} whose shape varies per call "
+                            "(unpinned size flows into its "
+                            "constructor/slice): each new shape is a "
+                            "fresh compile; bucket the size first",
+                            symbol=f"shape-churn:{qname}:{param}",
+                        )

@@ -306,6 +306,7 @@ def _clear_tables_jit(tables, slot):
 @partial(
     jax.jit,
     static_argnames=("names", "scale_of", "page", "quant"),
+    donate_argnames=("row_leaves",),
 )
 def _attach_shared_jit(
     row_leaves, pool_leaves, ids, *, names, scale_of, page, quant,
@@ -350,7 +351,11 @@ def _attach_shared_jit(
     return tuple(out)
 
 
-@partial(jax.jit, static_argnames=("model", "sampling", "eos_id"))
+@partial(
+    jax.jit,
+    static_argnames=("model", "sampling", "eos_id"),
+    donate_argnames=("cache",),
+)
 def _suffix_prefill_jit(
     model, params, cache, suffix, prompt_full, start_pos, rng,
     *, sampling, eos_id,
@@ -399,7 +404,6 @@ class PagedSlotPool(SlotPool):
     allocator: Any = None
     prefix: Any = None
     slot_pages: Any = None  # per-slot page ids this row references
-    _row_shapes: Any = dataclasses.field(default=None, repr=False)
 
     @classmethod
     def create_paged(
@@ -559,9 +563,11 @@ class PagedSlotPool(SlotPool):
         fresh row cache, prefill only the suffix. Same return contract
         as ``tpufw.infer.slots.prefill_row`` — (row_cache, first_arr,
         first_int, done0, seen)."""
-        if self._row_shapes is None:
-            self._row_shapes = _row_zeros_tree(self.row_model, self.params)
-        row_tree = self._row_shapes
+        # Fresh template every admission: the attach jit DONATES the
+        # row leaves (their memory becomes the attached cache), so a
+        # cached tree would hand already-deleted buffers to the second
+        # prefix hit. The zeros alloc is trivia next to the prefill.
+        row_tree = _row_zeros_tree(self.row_model, self.params)
         paths, names, leaves, _ = self._pool_flat()
         row_paths, _, row_leaves, row_treedef = _flatten_with_names(
             row_tree

@@ -157,7 +157,7 @@ def grpo_train_step(
             obj = obj - kl_beta * kl
             kl_mean = (kl * mask).sum() / n
         else:
-            kl_mean = jnp.zeros(())
+            kl_mean = jnp.zeros((), jnp.float32)
         loss = -(obj * mask).sum() / n
         # Fraction of tokens where the clip BINDS (the clipped term is
         # the smaller one the min() picks).

@@ -1061,22 +1061,25 @@ class _SlotScheduler:
 
     @property
     def slots_occupied(self) -> int:
-        return self._n_active
+        with self._cv:
+            return self._n_active
 
     @property
     def pages_total(self) -> int:
         """Arena capacity of the CURRENT pool (0 before first build /
         in contiguous mode) — page 0 is the reserved junk sink and
         never allocatable, so it is excluded."""
-        if not self.page or self._pool is None:
-            return 0
-        return self._pool.allocator.capacity
+        with self._cv:
+            if not self.page or self._pool is None:
+                return 0
+            return self._pool.allocator.capacity
 
     @property
     def pages_in_use(self) -> int:
-        if not self.page or self._pool is None:
-            return 0
-        return self._pool.allocator.in_use
+        with self._cv:
+            if not self.page or self._pool is None:
+                return 0
+            return self._pool.allocator.in_use
 
     def submit(self, prompts: list[list[int]], max_new: int, sampling=None):
         pend = _Pending(prompts, max_new, sampling)
@@ -1101,8 +1104,9 @@ class _SlotScheduler:
         """Restore the rng-stream indices so warmup prefills/chunks
         are invisible to seed replay (the compiled programs and the
         warm pool itself stay)."""
-        self._job_index = 0
-        self._chunk_index = 0
+        with self._cv:
+            self._job_index = 0
+            self._chunk_index = 0
 
     def _enqueue(self, pend: _Pending) -> None:
         req = self._make_req(pend)  # raises ValueError -> HTTP 400
@@ -1389,10 +1393,14 @@ class _SlotScheduler:
         # call, folded with the monotonic job index. The paged shared
         # path draws the SAME per-token streams (split_prefill_keys),
         # so a prefix hit never perturbs sampled outputs.
+        with self._cv:
+            # _job_index is also reset from the caller side
+            # (reset_after_warmup), so the bump must hold the monitor.
+            job_index = self._job_index
+            self._job_index += 1
         rng = jax.random.fold_in(
-            jax.random.key(self._seed_base), self._job_index
+            jax.random.key(self._seed_base), job_index
         )
-        self._job_index += 1
         if grant is not None:
             page_ids, shared_n = grant
             if self.prefix_enabled:
@@ -1507,10 +1515,14 @@ class _SlotScheduler:
         # per-value.
         max_left = max(j.max_new - len(j.tokens) for _, j in active)
         k = min(self.chunk, _pow2_ceil(max_left))
+        with self._cv:
+            # Reset from the caller side in reset_after_warmup; bump
+            # under the monitor so neither side loses an update.
+            chunk_index = self._chunk_index
+            self._chunk_index += 1
         key = self._jax.random.fold_in(
-            self._jax.random.key(self._seed_base + 1), self._chunk_index
+            self._jax.random.key(self._seed_base + 1), chunk_index
         )
-        self._chunk_index += 1
         keys = self._jax.random.split(key, k)
         chunk_t0 = time.perf_counter()
         with self._tracer.span(
