@@ -578,6 +578,45 @@ def _run_tier(
     return history
 
 
+def _roofline_from_programs(telemetry_dir, prefix: str = ""):
+    """measured_mfu / roofline_bound / hbm_headroom_bytes for the
+    highest-FLOP program matching ``prefix`` in the run's
+    programs.json (the perf observatory's cost harvest). None when
+    telemetry was off, the observatory was disabled, or nothing
+    matched — the tier dicts simply omit the keys then."""
+    if not telemetry_dir:
+        return None
+    from tpufw.obs import perf as perf_mod
+
+    doc = perf_mod.load_programs(telemetry_dir)
+    if not doc:
+        return None
+    programs = doc.get("programs") or {}
+    matched = [
+        (n, p)
+        for n, p in programs.items()
+        if n.startswith(prefix) and p.get("flops")
+    ]
+    if not matched:
+        return None
+    name, p = max(matched, key=lambda np: np[1]["flops"])
+    out = {"program": name}
+    if p.get("mfu") is not None:
+        out["measured_mfu"] = round(p["mfu"], 4)
+    if p.get("bound") is not None:
+        out["roofline_bound"] = p["bound"]
+    hbm_peaks = [
+        q["peak_hbm_bytes"]
+        for q in programs.values()
+        if q.get("peak_hbm_bytes")
+    ]
+    if hbm_peaks and doc.get("hbm_bytes_per_chip"):
+        out["hbm_headroom_bytes"] = int(
+            doc["hbm_bytes_per_chip"] - max(hbm_peaks)
+        )
+    return out
+
+
 def _worker() -> int:
     import signal
 
@@ -779,6 +818,21 @@ def _worker() -> int:
     }
     if tune_out.get("autotune") is not None:
         payload["autotune"] = tune_out["autotune"]
+    # Roofline attribution from the headline run's cost harvest
+    # (tpufw.obs.perf writes programs.json at telemetry close): the
+    # XLA-FLOPs-derived MFU cross-checks the meter's model-FLOPs MFU,
+    # and bound/headroom say WHY the number is what it is.
+    roofline = _roofline_from_programs(telemetry_dir, "train_step")
+    if roofline is not None:
+        payload["measured_mfu"] = roofline.get("measured_mfu", round(mfu, 4))
+        if "roofline_bound" in roofline:
+            payload["roofline_bound"] = roofline["roofline_bound"]
+        if "hbm_headroom_bytes" in roofline:
+            payload["hbm_headroom_bytes"] = roofline["hbm_headroom_bytes"]
+    else:
+        # Meter fallback: the key is always present on the headline so
+        # dashboards need no schema fork when the observatory is off.
+        payload["measured_mfu"] = round(mfu, 4)
     # Headline-first emission: if an aux tier below blows the watchdog,
     # the orchestrator salvages this line instead of losing the run.
     _emit(payload)
@@ -1294,6 +1348,7 @@ def _worker() -> int:
 
             from tpufw.infer import SamplingConfig, cast_decode_params
             from tpufw.models import Llama as _VLlama
+            from tpufw.obs.perf import PerfObservatory as _PerfObs
             from tpufw.workloads.serve import _Metrics, _SlotScheduler
 
             gc.collect()
@@ -1309,6 +1364,9 @@ def _worker() -> int:
                 )["params"]
             )
             v_metrics = _Metrics()
+            # Standalone cost observatory for the tier (no telemetry
+            # dir — the costs surface through the payload, not a file).
+            v_perf = _PerfObs(registry=v_metrics.registry)
             sched = _SlotScheduler(
                 vmodel,
                 v_params,
@@ -1316,6 +1374,7 @@ def _worker() -> int:
                 default_sampling=SamplingConfig(temperature=0.0),
                 metrics=v_metrics,
                 seed_base=0,
+                perf=v_perf,
             )
             import numpy as _vnp
 
@@ -1371,6 +1430,21 @@ def _worker() -> int:
                     wasted / max(wasted + total, 1), 4
                 ),
             }
+            # Roofline attribution for the decode-chunk programs (the
+            # tier's dominant cost): serving decode should classify
+            # memory-bound — a compute-bound verdict here means the
+            # batch geometry changed character.
+            v_roof = v_perf.attrib("serve_decode")
+            if v_roof:
+                serve["decode_program"] = v_roof.get("program")
+                if "measured_mfu" in v_roof:
+                    serve["measured_mfu"] = v_roof["measured_mfu"]
+                if "roofline_bound" in v_roof:
+                    serve["roofline_bound"] = v_roof["roofline_bound"]
+                if "hbm_headroom_bytes" in v_roof:
+                    serve["hbm_headroom_bytes"] = v_roof[
+                        "hbm_headroom_bytes"
+                    ]
 
             # Paged-KV sub-tiers: the same traffic against the paged
             # pool (bf16 KV, then int8 KV) with a prefix-heavy request
@@ -1673,6 +1747,10 @@ def _worker() -> int:
             from tpufw.mesh import MeshConfig as _MCfg
             from tpufw.parallel.pipeline import PipelineConfig as _PC
             from tpufw.train import TrainerConfig as _TCp
+            from tpufw.obs.perf import PerfObservatory as _PerfObsP
+            from tpufw.tune.runner import (
+                candidate_program_name as _cand_name,
+            )
             from tpufw.tune.runner import (
                 make_pipeline_measure_fn as _mk_pl,
             )
@@ -1709,6 +1787,10 @@ def _worker() -> int:
                     "seq_len": pl_seq,
                     "schedules": {},
                 }
+                # One observatory across all schedules: each candidate
+                # harvests under its own program name, so per-schedule
+                # attribution stays separable.
+                pl_perf = _PerfObsP()
                 for pl_name in ("gpipe", "1f1b", "interleaved", "zb1"):
                     pl_skip = _aux_skip(240)
                     if pl_skip is not None:
@@ -1729,6 +1811,7 @@ def _worker() -> int:
                                     n_microbatches=pl_m,
                                 ),
                                 pl_tc, pl_mesh, n_steps=3,
+                                perf=pl_perf,
                             )(cand)
                         t1, t2 = walls[pl_m1], walls[pl_m2]
                         u = (t2 - t1) / (pl_m2 - pl_m1)
@@ -1750,6 +1833,16 @@ def _worker() -> int:
                                 max(0.0, 1.0 - u * pl_m1 / t1), 4
                             ),
                         }
+                        pl_roof = pl_perf.attrib(_cand_name(cand))
+                        for rk in (
+                            "measured_mfu",
+                            "roofline_bound",
+                            "hbm_headroom_bytes",
+                        ):
+                            if rk in pl_roof:
+                                pipeline["schedules"][pl_name][rk] = (
+                                    pl_roof[rk]
+                                )
                     except Exception as e:  # noqa: BLE001
                         pipeline["schedules"][pl_name] = {
                             "error": f"{type(e).__name__}: {e}"[:400]

@@ -116,11 +116,61 @@ def summarize_trace(paths: list[str]) -> None:
         )
 
 
+def _fmt_count(x: float) -> str:
+    """1.23e9-style engineering shorthand for FLOPs/bytes columns."""
+    for unit, div in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(x) >= div:
+            return f"{x / div:.2f}{unit}"
+    return f"{x:.0f}"
+
+
+def summarize_programs(path: str) -> None:
+    """Per-program roofline table from programs.json (the perf
+    observatory's cost harvest): FLOPs, bytes, arithmetic intensity,
+    measured MFU, and which roof the program sits under."""
+    doc = _load_json(path)
+    if doc is None:
+        print(f"  (torn/unreadable: {os.path.basename(path)})")
+        return
+    programs = doc.get("programs") or {}
+    if not programs:
+        print("  (no programs harvested)")
+        return
+    chip = doc.get("chip", "?")
+    balance = doc.get("balance_flops_per_byte")
+    if isinstance(balance, (int, float)):
+        print(f"  chip={chip} balance={balance:.1f} FLOPs/byte")
+    print(
+        f"  {'program':<24} {'FLOPs':>9} {'bytes':>9} "
+        f"{'AI':>7} {'MFU':>6}  bound"
+    )
+    rows = sorted(
+        programs.items(),
+        key=lambda kv: -(kv[1].get("flops") or 0.0),
+    )
+    for name, p in rows:
+        if p.get("error"):
+            print(f"  {name:<24} (harvest failed: {p['error']})")
+            continue
+        ai = p.get("ai_flops_per_byte")
+        mfu = p.get("mfu")
+        ai_s = f"{ai:.1f}" if isinstance(ai, (int, float)) else "-"
+        mfu_s = f"{mfu:.1%}" if isinstance(mfu, (int, float)) else "-"
+        print(
+            f"  {name:<24} "
+            f"{_fmt_count(p.get('flops') or 0.0):>9} "
+            f"{_fmt_count(p.get('bytes_accessed') or 0.0):>9} "
+            f"{ai_s:>7} {mfu_s:>6}  {p.get('bound') or '-'}"
+        )
+
+
 def summarize_metrics(path: str) -> None:
     wanted = (
         "tpufw_train_steps_total",
         "tpufw_train_tokens_total",
         "tpufw_train_mfu",
+        "tpufw_program_mfu",
+        "tpufw_hbm_headroom_bytes",
         "tpufw_train_tokens_per_sec_per_chip",
         "tpufw_train_stragglers_total",
         "tpufw_serve_requests_total",
@@ -242,6 +292,10 @@ def main(argv: list[str]) -> int:
     if gp:
         print("-- goodput/badput --")
         summarize_goodput(gp)
+    progs = os.path.join(out, "programs.json")
+    if os.path.exists(progs):
+        print("-- compiled programs (roofline) --")
+        summarize_programs(progs)
     prom = os.path.join(out, "metrics.prom")
     if os.path.exists(prom):
         print("-- metrics snapshot --")

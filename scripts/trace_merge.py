@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Merge per-host Chrome trace files into one Perfetto timeline.
+
+Every tpufw process writes its own span trace (``trace.json`` from the
+trainer, ``trace-p{N}.json`` from pipeline stages, ``trace-serve.json``
+from the serving loop) with timestamps on its process-local
+``perf_counter`` clock — epoch-arbitrary, so side-by-side loading in
+Perfetto shows unrelated time axes. Each file also records its
+run-start wall clock (``otherData.wall_epoch_s``, stamped when the
+tracer was created). This script uses that anchor to shift every
+file's events onto one shared axis (the earliest host is t=0), remaps
+pids so hosts get separate tracks, and writes a single merged
+Perfetto-loadable document.
+
+Alignment is wall-clock quality, not PTP: good to NTP skew (typically
+low milliseconds on a cluster), which is enough to see cross-host
+stalls, stragglers, and lock-step barriers at step granularity.
+
+Usage:
+    python scripts/trace_merge.py <telemetry_dir>            # glob trace*.json
+    python scripts/trace_merge.py a.json b.json -o out.json  # explicit files
+
+Torn or unparsable files (a host died mid-write) are skipped with a
+warning; the merge proceeds with whatever loads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+MERGED_BASENAME = "trace-merged.json"
+
+
+def discover(path: str) -> List[str]:
+    """Trace files in a telemetry dir: trace.json, trace-p*.json,
+    trace-serve.json — everything matching trace*.json except a
+    previous merge output."""
+    hits = sorted(glob.glob(os.path.join(path, "trace*.json")))
+    return [h for h in hits if os.path.basename(h) != MERGED_BASENAME]
+
+
+def load_trace(path: str) -> Optional[dict]:
+    """One trace document, or None (with a stderr warning) when the
+    file is torn, truncated, or not a trace."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        print(f"trace_merge: skipping {path}: {e}", file=sys.stderr)
+        return None
+    if not isinstance(doc, dict) or not isinstance(
+        doc.get("traceEvents"), list
+    ):
+        print(
+            f"trace_merge: skipping {path}: no traceEvents list",
+            file=sys.stderr,
+        )
+        return None
+    return doc
+
+
+def _anchor(doc: dict) -> Optional[float]:
+    other = doc.get("otherData")
+    if isinstance(other, dict):
+        w = other.get("wall_epoch_s")
+        if isinstance(w, (int, float)):
+            return float(w)
+    return None
+
+
+def merge(
+    docs: List[Tuple[str, dict]],
+) -> dict:
+    """Clock-align and combine trace documents.
+
+    ``docs`` is [(source_path, doc), ...]. The earliest
+    ``wall_epoch_s`` across inputs becomes the merged t=0; each file's
+    events shift by (its anchor - earliest) in microseconds. Files
+    missing the anchor (pre-PR-9 traces) merge unshifted at t=0 with a
+    warning. Each file gets its own pid so hosts land on separate
+    Perfetto tracks regardless of what pid they recorded."""
+    anchors = [_anchor(doc) for _, doc in docs]
+    known = [a for a in anchors if a is not None]
+    base = min(known) if known else 0.0
+    events: List[dict] = []
+    dropped_total = 0
+    for idx, ((path, doc), anchor) in enumerate(zip(docs, anchors)):
+        if anchor is None:
+            print(
+                f"trace_merge: {path} has no wall_epoch_s anchor; "
+                "merging unshifted",
+                file=sys.stderr,
+            )
+        shift_us = ((anchor - base) * 1e6) if anchor is not None else 0.0
+        name = os.path.splitext(os.path.basename(path))[0]
+        for ev in doc["traceEvents"]:
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            ev["pid"] = idx
+            if ev.get("ph") == "M":
+                # Keep one process_name row per source file; qualify
+                # it so "trainer" from two hosts stays tellable-apart.
+                if ev.get("name") == "process_name":
+                    orig = (ev.get("args") or {}).get("name", "")
+                    label = f"{name}:{orig}" if orig else name
+                    ev["args"] = {"name": label}
+            elif "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) + shift_us, 3)
+            events.append(ev)
+        other = doc.get("otherData")
+        if isinstance(other, dict):
+            dropped_total += int(other.get("dropped_events", 0) or 0)
+    # Metadata first, then by aligned timestamp: Perfetto tolerates any
+    # order, but a sorted merge makes the cross-host interleaving
+    # checkable by eye (and by the tests).
+    events.sort(
+        key=lambda e: (0, 0.0) if e.get("ph") == "M" else (1, e.get("ts", 0.0))
+    )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "wall_epoch_s": base,
+            "merged_from": [os.path.basename(p) for p, _ in docs],
+            "dropped_events": dropped_total,
+        },
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument(
+        "inputs",
+        nargs="+",
+        help="telemetry dir (globbed for trace*.json) or trace files",
+    )
+    ap.add_argument(
+        "-o",
+        "--out",
+        default="",
+        help=f"output path (default: <dir>/{MERGED_BASENAME})",
+    )
+    args = ap.parse_args(argv)
+
+    files: List[str] = []
+    out_default = MERGED_BASENAME
+    for inp in args.inputs:
+        if os.path.isdir(inp):
+            files.extend(discover(inp))
+            out_default = os.path.join(inp, MERGED_BASENAME)
+        else:
+            files.append(inp)
+    if not files:
+        print("trace_merge: no trace files found", file=sys.stderr)
+        return 1
+    docs = [(p, d) for p in files for d in [load_trace(p)] if d is not None]
+    if not docs:
+        print("trace_merge: no loadable trace files", file=sys.stderr)
+        return 1
+    merged = merge(docs)
+    out = args.out or out_default
+    tmp = out + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(merged, f)
+    os.replace(tmp, out)
+    n_ev = len(merged["traceEvents"])
+    print(f"trace_merge: {len(docs)} file(s), {n_ev} events -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -546,6 +546,23 @@ class PagedSlotPool(SlotPool):
         table_row = np.zeros((self.per_row,), np.int32)
         table_row[: len(page_ids)] = page_ids
         quant = self.model.cfg.kv_quant == "int8"
+        perf = getattr(self, "perf", None)
+        if perf is not None:
+            # Cost harvest (tpufw.obs.perf; once per program).
+            perf.observe_jit(
+                "serve_paged_insert",
+                _paged_insert_jit,
+                (
+                    tuple(leaves), tuple(row_leaves),
+                    jnp.asarray(table_row), slot, shared_n * self.page,
+                    first, pos0, budget, self.token, self.pos,
+                    self.done, self.remaining, self.seen, row_seen,
+                ),
+                kwargs=dict(
+                    names=names, scale_src=self._scale_src(paths, names),
+                    page=self.page, quant=quant,
+                ),
+            )
         leaves, self.token, self.pos, self.done, self.remaining, \
             self.seen = _paged_insert_jit(
                 tuple(leaves), tuple(row_leaves), jnp.asarray(table_row),

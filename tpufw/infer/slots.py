@@ -299,6 +299,20 @@ class SlotPool:
         first token is already out)."""
         leaves, treedef = jax.tree_util.tree_flatten(self.cache)
         row_leaves = jax.tree_util.tree_leaves(row_cache)
+        perf = getattr(self, "perf", None)
+        if perf is not None:
+            # Cost harvest (tpufw.obs.perf; once per program): the
+            # scheduler mounts ``pool.perf`` after _build_pool.
+            perf.observe_jit(
+                "serve_insert",
+                _insert_jit,
+                (
+                    tuple(leaves), tuple(row_leaves), slot, first, pos0,
+                    budget, self.token, self.pos, self.done,
+                    self.remaining, self.seen, row_seen,
+                ),
+                kwargs=dict(axes=self.axes),
+            )
         leaves, self.token, self.pos, self.done, self.remaining, \
             self.seen = _insert_jit(
                 tuple(leaves), tuple(row_leaves), slot, first, pos0,
@@ -309,6 +323,21 @@ class SlotPool:
 
     def decode_steps(self, keys) -> jax.Array:
         """Advance all slots ``len(keys)`` tokens; returns [S, k]."""
+        perf = getattr(self, "perf", None)
+        if perf is not None:
+            # One program per chunk-ladder rung (k is a shape).
+            perf.observe_jit(
+                f"serve_decode_k{len(keys)}",
+                _decode_steps_jit,
+                (
+                    self.model, self.params, self.cache, self.token,
+                    self.pos, self.done, self.remaining, self.seen, keys,
+                ),
+                kwargs=dict(
+                    sampling=self.sampling, pad_id=self.pad_id,
+                    eos_id=self.eos_id,
+                ),
+            )
         (
             self.cache, self.token, self.pos, self.done, self.remaining,
             self.seen, out,

@@ -31,6 +31,7 @@ from typing import Optional
 
 from tpufw.obs import events as events_mod
 from tpufw.obs import goodput as goodput_mod
+from tpufw.obs import perf as perf_mod
 from tpufw.obs import trace as trace_mod
 from tpufw.obs.health import NULL_WATCHDOG, FlightRecorder, HangWatchdog
 from tpufw.obs.registry import Registry, start_http_server
@@ -74,6 +75,8 @@ class Telemetry:
         goodput=None,
         watchdog=None,
         recorder: Optional[FlightRecorder] = None,
+        perf=None,
+        profiler=None,
     ):
         self.registry = registry
         self.events = events if events is not None else events_mod.NULL
@@ -84,6 +87,8 @@ class Telemetry:
         self.goodput = goodput if goodput is not None else goodput_mod.NULL
         self.watchdog = watchdog if watchdog is not None else NULL_WATCHDOG
         self.recorder = recorder
+        self.perf = perf if perf is not None else perf_mod.NULL
+        self.profiler = profiler
         self._closed = False
 
     @property
@@ -211,9 +216,25 @@ class Telemetry:
             factor=straggler_factor,
             gather=gather,
         )
+        # Perf observatory (TPUFW_PERF_OBS, default on): compiled-
+        # program cost harvest + roofline gauges. Gated on a telemetry
+        # dir — without one there is nowhere for programs.json or the
+        # profiler traces to land, and dir-less runs (most unit tests)
+        # should not pay the AOT lower/compile harvest.
+        perf = None
+        profiler = None
+        if telemetry_dir and env_bool("perf_obs", True):
+            perf = perf_mod.PerfObservatory(
+                registry=registry, out_dir=telemetry_dir
+            )
+            profiler = perf_mod.ProfileTrigger(
+                os.path.join(telemetry_dir, "xprof")
+            )
         server = None
         if metrics_port is not None:
-            server = start_http_server(registry, metrics_port)
+            server = start_http_server(
+                registry, metrics_port, profiler=profiler
+            )
         tel = Telemetry(
             registry=registry,
             events=events,
@@ -224,6 +245,8 @@ class Telemetry:
             goodput=ledger,
             watchdog=watchdog,
             recorder=recorder,
+            perf=perf,
+            profiler=profiler,
         )
         _emit_compile_cache_event(events)
         return tel
@@ -276,6 +299,36 @@ class Telemetry:
         os.replace(tmp, path)
         return path
 
+    def _goodput_extra(self) -> dict:
+        """End-of-run utilization merged into the goodput closing
+        event/JSON: the perf observatory's headline-program MFU and
+        roofline attribution when harvested, else the Meter's last
+        published ``tpufw_train_mfu`` gauge."""
+        extra: dict = {}
+        try:
+            a = self.perf.attrib()
+            if "measured_mfu" in a:
+                extra["mfu"] = a["measured_mfu"]
+                extra["mfu_program"] = a["program"]
+            if "roofline_bound" in a:
+                extra["roofline_bound"] = a["roofline_bound"]
+            if "hbm_headroom_bytes" in a:
+                extra["hbm_headroom_bytes"] = a["hbm_headroom_bytes"]
+            # Peek, don't get-or-create: the fallback must not mint an
+            # empty train gauge on a serve registry.
+            meter_mfu = (
+                self.registry._metrics.get("tpufw_train_mfu")
+                if self.registry is not None
+                else None
+            )
+            if "mfu" not in extra and meter_mfu is not None:
+                mfu = meter_mfu.value()
+                if mfu > 0:
+                    extra["mfu"] = round(mfu, 4)
+        except Exception:  # noqa: BLE001 — close must stay best-effort
+            pass
+        return extra
+
     def close(self) -> None:
         if self._closed:
             return
@@ -288,9 +341,10 @@ class Telemetry:
         # inside close itself still gets a bundle).
         self.watchdog.stop()
         try:
-            self.goodput.close()
+            self.goodput.close(extra=self._goodput_extra())
         finally:
             try:
+                self.perf.close()
                 self.snapshot_metrics()
             finally:
                 self.tracer.close()

@@ -232,13 +232,19 @@ class GoodputLedger:
                         self._published[cat] = secs
         return roll
 
-    def close(self) -> dict:
+    def close(self, extra: Optional[dict] = None) -> dict:
         """Final publish + ``goodput`` event + ``goodput.json``.
-        Idempotent; returns the final rollup."""
+        Idempotent; returns the final rollup. ``extra`` (e.g. the perf
+        observatory's end-of-run MFU attribution) is merged into both
+        the event and the JSON rollup — utilization next to the
+        goodput ratio is the one-line answer to "was the run slow
+        because of badput or because of the program"."""
         with self._lock:
             if self._closed:
                 return self.rollup()
         roll = self.publish()
+        if extra:
+            roll.update(extra)
         with self._lock:
             self._closed = True
         if self._events is not None:
@@ -248,6 +254,7 @@ class GoodputLedger:
                     wall_s=roll["wall_s"],
                     goodput_ratio=roll["goodput_ratio"],
                     categories=roll["categories"],
+                    **(extra or {}),
                 )
             except Exception:
                 pass  # closing telemetry must not mask the run's exit
@@ -284,7 +291,7 @@ class NullGoodputLedger:
     def publish(self) -> dict:
         return {}
 
-    def close(self) -> dict:
+    def close(self, extra=None) -> dict:
         return {}
 
 

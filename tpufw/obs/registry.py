@@ -281,7 +281,11 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     registry: Registry  # set on the server class by start_http_server
 
     def do_GET(self):  # noqa: N802 — http.server API
-        if self.path not in ("/metrics", "/metrics/"):
+        path, _, query = self.path.partition("?")
+        if path.rstrip("/") == "/debug/profile":
+            self._handle_profile(query)
+            return
+        if path not in ("/metrics", "/metrics/"):
             self.send_error(404)
             return
         body = self.server.registry.render().encode()  # type: ignore[attr-defined]
@@ -291,18 +295,47 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _handle_profile(self, query: str) -> None:
+        """``GET /debug/profile?seconds=N`` — kick a time-bounded
+        jax.profiler capture via the mounted ProfileTrigger
+        (tpufw.obs.perf); 404 when no trigger is mounted (no
+        telemetry dir to drop the trace into), 409 while one is
+        already running."""
+        import json
+        from urllib.parse import parse_qs
+
+        trigger = getattr(self.server, "profiler", None)
+        if trigger is None:
+            self.send_error(404)
+            return
+        try:
+            seconds = float(
+                parse_qs(query).get("seconds", ["2.0"])[0]
+            )
+        except ValueError:
+            seconds = 2.0
+        result = trigger.trigger(seconds)
+        body = json.dumps(result).encode()
+        self.send_response(409 if "error" in result else 200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def log_message(self, *args):  # scrapes are not log events
         pass
 
 
 def start_http_server(
-    registry: Registry, port: int, host: str = "0.0.0.0"
+    registry: Registry, port: int, host: str = "0.0.0.0", profiler=None
 ) -> ThreadingHTTPServer:
     """Serve ``registry`` at ``/metrics`` on ``port`` (0 = ephemeral;
     bound port is ``server.server_address[1]``) from a daemon thread.
-    Caller owns shutdown()."""
+    Caller owns shutdown(). ``profiler`` (a tpufw.obs.perf
+    ProfileTrigger) additionally mounts ``/debug/profile``."""
     httpd = ThreadingHTTPServer((host, port), _MetricsHandler)
     httpd.registry = registry  # type: ignore[attr-defined]
+    httpd.profiler = profiler  # type: ignore[attr-defined]
     threading.Thread(
         target=httpd.serve_forever, daemon=True, name="obs-metrics"
     ).start()

@@ -389,9 +389,15 @@ class PipelineTrainer:
             from tpufw.tune.runner import apply_autotune
 
             with tel.tracer.span("tune"):
-                apply_autotune(self, events=tel.events)
+                apply_autotune(self, events=tel.events, perf=tel.perf)
         if self.state is None:
             self.init_state()
+        if tel.perf.enabled:
+            # programs.json keyed like the tune winner cache (same
+            # discipline as Trainer.run).
+            from tpufw.tune.runner import _trainer_cache_key
+
+            tel.perf.set_key(_trainer_cache_key(self))
         tel.record_config(
             {
                 "trainer": dataclasses.asdict(self.cfg),
@@ -424,13 +430,19 @@ class PipelineTrainer:
             )
         from tpufw.train.trainer import globalize_batch
 
+        from tpufw.obs.perf import resolve_profile_window
         from tpufw.train.preemption import checkpoint_stop, owned_shutdown
         from tpufw.utils.profiling import StepProfiler
 
+        # TPUFW_PROFILE_STEPS=a:b overrides the config window (see
+        # Trainer.run).
         prof = StepProfiler(
-            self.cfg.profile_dir,
-            self.cfg.profile_start,
-            self.cfg.profile_stop,
+            *resolve_profile_window(
+                self.cfg.profile_dir,
+                self.cfg.profile_start,
+                self.cfg.profile_stop,
+                telemetry_dir=self.cfg.telemetry_dir,
+            )
         )
         shutdown, owns_shutdown = owned_shutdown(
             shutdown,
@@ -491,6 +503,9 @@ class PipelineTrainer:
                     "pipeline_tick",
                     sm.step_time_s / max(1, self.pipe.n_ticks()),
                 )
+                # Static FLOPs x measured wall -> per-program MFU
+                # (tpufw_program_mfu) and roofline attribution.
+                tel.perf.record_wall("pipeline_step", sm.step_time_s)
             return sm
 
         try:
@@ -506,10 +521,14 @@ class PipelineTrainer:
                     if window_n == 0:
                         meter.start()
                     batch = globalize_batch(self.mesh, batch)
+                    step_fn = self._compiled_step(batch)
+                    # Cost harvest (first time per program only):
+                    # abstract lower, so donation is untouched.
+                    tel.perf.observe_jit(
+                        "pipeline_step", step_fn, (self.state, batch)
+                    )
                     with prof.step(i):
-                        self.state, m = self._compiled_step(batch)(
-                            self.state, batch
-                        )
+                        self.state, m = step_fn(self.state, batch)
                         window_n += 1
                         window_wait += wait
                         py_step = start_step + i + 1

@@ -553,12 +553,15 @@ class Trainer:
         init_fn, abstract = self._abstract_state(rng)
         self.state_sharding = state_shardings(abstract, self.mesh)
         with use_mesh(self.mesh):
-            # tpulint: disable=TPU003 — _abstract_state only
-            # eval_shape's rng (abstract, no randomness drawn); this
-            # jitted init is the key's one real use.
-            self.state = jax.jit(
-                init_fn, out_shardings=self.state_sharding
-            )(rng)
+            jit_init = jax.jit(init_fn, out_shardings=self.state_sharding)
+            # _abstract_state only eval_shape's rng (abstract, no
+            # randomness drawn); this jitted init is the key's one
+            # real use.
+            self.state = jit_init(rng)  # tpulint: disable=TPU003
+        # Same jit object kept for the perf observatory (run() harvests
+        # its cost_analysis once telemetry exists): the AOT lower hits
+        # the executable this call just built.
+        self._init_harvest = (jit_init, rng)
         # Unbox flax Partitioned wrappers: downstream code wants raw arrays.
         self.state = meta.unbox(self.state)
         self.state_sharding = meta.unbox(self.state_sharding)
@@ -782,12 +785,17 @@ class Trainer:
         comparable to the train curve; ppl = exp(eval_loss)."""
         if self.state is None:
             raise RuntimeError("evaluate() before init_state()/restore")
+
+        def eval_one(b):
+            fn = self.compiled_eval_step(b)
+            self.telemetry.perf.observe_jit(
+                "eval_step", fn, (self.state, b)
+            )
+            return fn(self.state, b)
+
         with use_mesh(self.mesh):
             return run_evaluation(
-                data,
-                n_batches,
-                lambda b: self.compiled_eval_step(b)(self.state, b),
-                self.globalize_batch,
+                data, n_batches, eval_one, self.globalize_batch
             )
 
     def run(
@@ -820,9 +828,22 @@ class Trainer:
             from tpufw.tune.runner import apply_autotune
 
             with tel.tracer.span("tune"):
-                apply_autotune(self, events=tel.events)
+                apply_autotune(self, events=tel.events, perf=tel.perf)
         if self.state is None:
             self.init_state()
+        if tel.perf.enabled:
+            # programs.json keyed like the tune winner cache, so a
+            # cost table and a tune winner for the same (model, batch,
+            # seq, mesh) point line up by construction.
+            from tpufw.tune.runner import _trainer_cache_key
+
+            tel.perf.set_key(_trainer_cache_key(self))
+            init_harvest = getattr(self, "_init_harvest", None)
+            if init_harvest is not None:
+                with use_mesh(self.mesh):
+                    tel.perf.observe_jit(
+                        "state_init", init_harvest[0], (init_harvest[1],)
+                    )
         owns_shutdown = False
         self.preempted = False
         meter = Meter(
@@ -841,12 +862,19 @@ class Trainer:
                 events=tel.events,
                 tracer=tel.tracer,
             )
+        from tpufw.obs.perf import resolve_profile_window
         from tpufw.utils.profiling import StepProfiler
 
+        # TPUFW_PROFILE_STEPS=a:b overrides the config window; without
+        # a configured profile dir the capture lands under the
+        # telemetry dir so the trace is linkable from the run artifact.
         prof = StepProfiler(
-            self.cfg.profile_dir,
-            self.cfg.profile_start,
-            self.cfg.profile_stop,
+            *resolve_profile_window(
+                self.cfg.profile_dir,
+                self.cfg.profile_start,
+                self.cfg.profile_stop,
+                telemetry_dir=self.cfg.telemetry_dir,
+            )
         )
         from tpufw.train.preemption import checkpoint_stop, owned_shutdown
 
@@ -905,6 +933,9 @@ class Trainer:
                         sm.step_time_s * sm.window_steps,
                         sm.data_wait_s,
                     )
+                # Static FLOPs x measured wall -> per-program MFU
+                # (tpufw_program_mfu) and roofline attribution.
+                tel.perf.record_wall("train_step", sm.step_time_s)
             return sm
 
         try:
@@ -921,6 +952,11 @@ class Trainer:
                     with tel.tracer.span("step_dispatch"):
                         batch = self.globalize_batch(batch)
                         step_fn = self.compiled_step(batch)
+                        # Cost harvest (first time per program only):
+                        # abstract lower, so donation is untouched.
+                        tel.perf.observe_jit(
+                            "train_step", step_fn, (self.state, batch)
+                        )
                         prof.maybe_start(i)
                         if window_n == 0:
                             meter.start()

@@ -169,6 +169,25 @@ def _candidate_model(model, cand: Candidate):
     )
 
 
+def candidate_program_name(cand: Candidate) -> str:
+    """Stable perf-observatory program name for one tune candidate —
+    the key the measured trial's cost/MFU lands under in
+    ``programs.json`` (tpufw.obs.perf), so "did the autotuner win"
+    reads as a utilization comparison, not just step wall."""
+    parts = [
+        f"tune:{cand.remat_policy}",
+        f"ga{cand.grad_accum}",
+        f"lc{cand.loss_chunk_size}",
+    ]
+    if cand.flash_bq or cand.flash_bkv:
+        parts.append(f"fb{cand.flash_bq}x{cand.flash_bkv}")
+    if cand.pipeline_schedule:
+        parts.append(
+            f"{cand.pipeline_schedule}v{cand.pipeline_vstages}"
+        )
+    return "-".join(parts)
+
+
 def make_measure_fn(
     model,
     trainer_cfg,
@@ -177,6 +196,7 @@ def make_measure_fn(
     n_steps: int = 3,
     warmup_steps: int = 1,
     seed: int = 0,
+    perf=None,
 ) -> Callable[[Candidate], float]:
     """A measure_fn that builds a REAL Trainer per candidate and times
     the REAL jitted step on synthetic tokens. Each candidate gets a
@@ -218,6 +238,10 @@ def make_measure_fn(
             with use_mesh(mesh):
                 step = trainer.compiled_step(batch)
                 state = trainer.state
+                if perf is not None:
+                    perf.observe_jit(
+                        candidate_program_name(cand), step, (state, batch)
+                    )
                 for _ in range(max(warmup_steps, 1)):
                     state, m = step(state, batch)
                     jax.block_until_ready(m["loss"])
@@ -227,7 +251,10 @@ def make_measure_fn(
                     state, m = step(state, batch)
                     jax.block_until_ready(m["loss"])
                     times.append(time.perf_counter() - t0)
-            return statistics.median(times)
+            med = statistics.median(times)
+            if perf is not None:
+                perf.record_wall(candidate_program_name(cand), med)
+            return med
         finally:
             _restore_env(prev)
 
@@ -243,6 +270,7 @@ def make_pipeline_measure_fn(
     n_steps: int = 3,
     warmup_steps: int = 1,
     seed: int = 0,
+    perf=None,
 ) -> Callable[[Candidate], float]:
     """make_measure_fn's PipelineTrainer twin: a fresh trainer per
     candidate so each schedule's shard_map step compiles against its
@@ -298,6 +326,10 @@ def make_pipeline_measure_fn(
             batch = {"tokens": tokens}
             step = trainer._compiled_step(batch)
             state = trainer.state
+            if perf is not None:
+                perf.observe_jit(
+                    candidate_program_name(cand), step, (state, batch)
+                )
             for _ in range(max(warmup_steps, 1)):
                 state, m = step(state, batch)
                 jax.block_until_ready(m["loss"])
@@ -307,7 +339,10 @@ def make_pipeline_measure_fn(
                 state, m = step(state, batch)
                 jax.block_until_ready(m["loss"])
                 times.append(time.perf_counter() - t0)
-            return statistics.median(times)
+            med = statistics.median(times)
+            if perf is not None:
+                perf.record_wall(candidate_program_name(cand), med)
+            return med
         finally:
             _restore_env(prev)
 
@@ -458,6 +493,7 @@ def apply_autotune(
     trainer,
     space: Optional[SearchSpace] = None,
     events=None,
+    perf=None,
 ) -> Optional[TuneResult]:
     """The Trainer.run entry: resolve TrainerConfig.autotune.
 
@@ -467,7 +503,9 @@ def apply_autotune(
 
     Returns the TuneResult (also stashed as ``trainer.last_tune``) or
     None when mode is "off"/unknown. ``events`` (tpufw.obs event log)
-    gets per-candidate ``tune_trial`` lines and one ``tune_result``.
+    gets per-candidate ``tune_trial`` lines and one ``tune_result``;
+    ``perf`` (tpufw.obs.perf observatory) gets each measured trial's
+    compiled cost + MFU under its ``candidate_program_name``.
     """
     if events is None:
         from tpufw.obs import events as events_mod
@@ -548,11 +586,13 @@ def apply_autotune(
             ),
             tx=trainer.tx,
             n_steps=getattr(trainer.cfg, "autotune_steps", 3),
+            perf=perf if perf is not None and perf.enabled else None,
         )
     else:
         measure = make_measure_fn(
             trainer.model, trainer.cfg, trainer.mesh, tx=trainer.tx,
             n_steps=getattr(trainer.cfg, "autotune_steps", 3),
+            perf=perf if perf is not None and perf.enabled else None,
         )
     result = search(
         candidates,

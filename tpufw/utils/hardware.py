@@ -26,23 +26,30 @@ class ChipSpec:
     hbm_bytes: int
     # ICI links per chip — used by the mesh layer to sanity-check topologies.
     ici_links: int = 4
+    # Peak HBM bandwidth in bytes/s — the roofline's second axis
+    # (tpufw.obs.roofline). 0 = unknown; consumers must degrade.
+    hbm_bw_bytes_per_s: float = 0.0
 
     @property
     def hbm_gib(self) -> float:
         return self.hbm_bytes / 2**30
 
 
-# Peak bf16 FLOP/s per chip. v5e: 197 TFLOP/s bf16, 16 GiB HBM.
-# v5p: 459 TFLOP/s bf16, 95 GiB HBM. v4: 275 TFLOP/s, 32 GiB.
-# v6e (Trillium): 918 TFLOP/s bf16, 32 GiB.
+# Peak bf16 FLOP/s per chip. v5e: 197 TFLOP/s bf16, 16 GiB HBM at
+# 819 GB/s. v5p: 459 TFLOP/s bf16, 95 GiB at 2765 GB/s. v4: 275
+# TFLOP/s, 32 GiB at 1228 GB/s. v6e (Trillium): 918 TFLOP/s bf16,
+# 32 GiB at 1640 GB/s.
 CHIP_SPECS: dict[str, ChipSpec] = {
-    "v4": ChipSpec("v4", 275e12, 32 * 2**30),
-    "v5e": ChipSpec("v5e", 197e12, 16 * 2**30),
-    "v5p": ChipSpec("v5p", 459e12, 95 * 2**30),
-    "v6e": ChipSpec("v6e", 918e12, 32 * 2**30),
+    "v4": ChipSpec("v4", 275e12, 32 * 2**30, hbm_bw_bytes_per_s=1.228e12),
+    "v5e": ChipSpec("v5e", 197e12, 16 * 2**30, hbm_bw_bytes_per_s=8.19e11),
+    "v5p": ChipSpec("v5p", 459e12, 95 * 2**30, hbm_bw_bytes_per_s=2.765e12),
+    "v6e": ChipSpec("v6e", 918e12, 32 * 2**30, hbm_bw_bytes_per_s=1.64e12),
     # CPU fallback so MFU accounting degrades gracefully in tests / dryruns.
-    # ~100 GFLOP/s is a nominal single-socket figure; tests never assert on it.
-    "cpu": ChipSpec("cpu", 100e9, 16 * 2**30, ici_links=0),
+    # ~100 GFLOP/s and ~50 GB/s are nominal single-socket figures; tests
+    # never assert on them.
+    "cpu": ChipSpec(
+        "cpu", 100e9, 16 * 2**30, ici_links=0, hbm_bw_bytes_per_s=5e10
+    ),
 }
 
 _KIND_PATTERNS: list[tuple[str, str]] = [

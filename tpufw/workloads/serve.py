@@ -33,6 +33,7 @@ from typing import Optional
 
 from tpufw.obs import events as obs_events
 from tpufw.obs import goodput as obs_goodput
+from tpufw.obs import perf as obs_perf
 from tpufw.obs import trace as obs_trace
 from tpufw.obs.health import NULL_WATCHDOG
 from tpufw.obs.registry import Registry as ObsRegistry
@@ -937,6 +938,7 @@ class _SlotScheduler:
         kv_quant: Optional[str] = None,
         prefix_cache: Optional[bool] = None,
         arena_pages: Optional[int] = None,
+        perf=None,
     ):
         import jax
         import numpy as np
@@ -960,6 +962,12 @@ class _SlotScheduler:
         self._tracer = tracer if tracer is not None else obs_trace.NULL
         self._goodput = goodput if goodput is not None else obs_goodput.NULL
         self._watchdog = watchdog if watchdog is not None else NULL_WATCHDOG
+        self._perf = perf if perf is not None else obs_perf.NULL
+        # Join-latency component split (queue_wait + prefill). Gated
+        # OFF by default: registering the histograms adds scrape lines,
+        # and the legacy exposition must stay byte-identical unless the
+        # operator opts in.
+        self.latency_breakdown = env_bool("serve_latency_breakdown", False)
         self.n_slots = max(1, env_int("serve_slots", 8))
         self.chunk = max(
             1, env_int("serve_chunk", 0) or env_int("stream_chunk", 16)
@@ -1031,6 +1039,18 @@ class _SlotScheduler:
                 "tpufw_serve_join_latency_seconds",
                 "Request submit-to-first-slot-insert latency",
             )
+            if self.latency_breakdown:
+                # Component split of the join latency: time queued
+                # behind other requests vs. time inside the prefill
+                # program itself.
+                metrics.registry.histogram(
+                    "tpufw_serve_queue_wait_seconds",
+                    "Request submit-to-admission-start latency",
+                )
+                metrics.registry.histogram(
+                    "tpufw_serve_prefill_seconds",
+                    "Per-row prefill wall-clock",
+                )
         self._pool = None  # tpufw.infer.slots.SlotPool (lazy, keyed)
         self._pool_key: Optional[tuple] = None
         self._slots: list[Optional[_SlotJob]] = [None] * self.n_slots
@@ -1259,6 +1279,11 @@ class _SlotScheduler:
                     pad_id=0,
                     eos_id=self._eos,
                 )
+        if self._perf.enabled:
+            # Mount the cost observatory on the pool (dynamic attr:
+            # SlotPool/PagedSlotPool read it via getattr) so insert /
+            # decode programs harvest their XLA cost analysis.
+            self._pool.perf = self._perf
         self._pool_key = key
         self._slots = [None] * self.n_slots
         self._n_active = 0
@@ -1334,6 +1359,7 @@ class _SlotScheduler:
         """Admit as many of ``req``'s remaining rows as fit; returns
         True if at least one row ran (prefilled), slot-consuming or
         not."""
+        t_admit0 = time.time()
         admitted = False
         while free and req.next_job < len(req.jobs):
             job = req.jobs[req.next_job]
@@ -1372,6 +1398,10 @@ class _SlotScheduler:
                 self._metrics.registry.histogram(
                     "tpufw_serve_join_latency_seconds"
                 ).observe(time.time() - req.t_submit)
+                if self.latency_breakdown:
+                    self._metrics.registry.histogram(
+                        "tpufw_serve_queue_wait_seconds"
+                    ).observe(max(0.0, t_admit0 - req.t_submit))
         if admitted and req.pend.stream_q is not None:
             # First tokens reach the stream at admission, not a chunk
             # later — and every flush stays <= chunk-size tokens/row.
@@ -1417,6 +1447,7 @@ class _SlotScheduler:
                     shared_pages=shared_n,
                     prompt_tokens=len(job.prompt),
                 )
+        prefill_t0 = time.perf_counter()
         with self._tracer.span(
             "serve_prefill", prompt=len(job.prompt), width=job.p_bucket
         ):
@@ -1444,6 +1475,10 @@ class _SlotScheduler:
                         prefill_chunk_size=self.prefill_chunk,
                     )
                 )
+        if self.latency_breakdown and self._metrics is not None:
+            self._metrics.registry.histogram(
+                "tpufw_serve_prefill_seconds"
+            ).observe(time.perf_counter() - prefill_t0)
         job.tokens.append(first_int)
         job.unflushed.append(first_int)
         if self._metrics is not None:
@@ -1530,6 +1565,10 @@ class _SlotScheduler:
         ):
             out = self._np.asarray(self._pool.decode_steps(keys))
         chunk_s = time.perf_counter() - chunk_t0
+        # Publishes tpufw_program_mfu{program="serve_decode_k<k>"}
+        # from the chunk's wall-clock + harvested FLOPs (no-op on the
+        # null observatory / before the program's cost harvest).
+        self._perf.record_wall(f"serve_decode_k{k}", chunk_s)
         if self._metrics is not None:
             self._metrics.inc("ticks_total")
             self._metrics.inc("tick_rows_total", len(active))
@@ -1764,6 +1803,7 @@ class _Server:
                 tracer=self._tracer,
                 goodput=self._tel.goodput,
                 watchdog=self._tel.watchdog,
+                perf=self._tel.perf,
             )
         else:
             self._batcher = _Batcher(
@@ -1831,6 +1871,16 @@ class _Server:
                 self.metrics.registry.histogram(
                     "tpufw_serve_join_latency_seconds"
                 ).reset()
+                if self._batcher.latency_breakdown:
+                    # Gated like the registration: reset() would CREATE
+                    # the histograms, leaking the breakdown series into
+                    # the legacy scrape when the gate is off.
+                    self.metrics.registry.histogram(
+                        "tpufw_serve_queue_wait_seconds"
+                    ).reset()
+                    self.metrics.registry.histogram(
+                        "tpufw_serve_prefill_seconds"
+                    ).reset()
             return
         tick0 = self._tick_index
         try:
@@ -2117,6 +2167,25 @@ class _Server:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path.split("?", 1)[0] == "/debug/profile":
+                    # On-demand jax.profiler capture (same contract as
+                    # the training metrics server's endpoint).
+                    profiler = getattr(outer._tel, "profiler", None)
+                    if profiler is None:
+                        self._reply(
+                            404, {"error": "profiler not configured"}
+                        )
+                        return
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    try:
+                        seconds = float(q.get("seconds", ["2.0"])[0])
+                    except ValueError:
+                        seconds = 2.0
+                    result = profiler.trigger(seconds)
+                    code = 409 if "error" in result else 200
+                    self._reply(code, result)
                 else:
                     self._reply(404, {"error": "unknown path"})
 
