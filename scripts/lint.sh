@@ -18,16 +18,29 @@
 # Fast path (pre-commit): `scripts/lint.sh --fast` runs tpulint with
 # the replay cache (an unchanged tree replays the previous result in
 # milliseconds) and gates only on findings in files you changed since
-# HEAD — see docs/ANALYSIS.md "Incremental mode". Extra args other
-# than --fast are forwarded to ruff.
+# HEAD — see docs/ANALYSIS.md "Incremental mode".
+#
+# `--layer {python,deploy,all}` is forwarded to tpulint (deploy runs
+# the cross-layer manifest rules TPU010-014, needs pyyaml). Any other
+# extra args are forwarded to ruff.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 FAST=0
+LAYER_ARGS=()
 RUFF_ARGS=()
+EXPECT_LAYER=0
 for arg in "$@"; do
-    if [ "$arg" = "--fast" ]; then
+    if [ "$EXPECT_LAYER" = "1" ]; then
+        LAYER_ARGS+=("$arg")
+        EXPECT_LAYER=0
+    elif [ "$arg" = "--fast" ]; then
         FAST=1
+    elif [ "$arg" = "--layer" ]; then
+        LAYER_ARGS+=("$arg")
+        EXPECT_LAYER=1
+    elif [[ "$arg" == --layer=* ]]; then
+        LAYER_ARGS+=("--layer" "${arg#--layer=}")
     else
         RUFF_ARGS+=("$arg")
     fi
@@ -40,7 +53,8 @@ else
 fi
 
 if [ "$FAST" = "1" ]; then
-    python -m tpufw.analysis --cache --since HEAD
+    python -m tpufw.analysis --cache --since HEAD \
+        "${LAYER_ARGS[@]+"${LAYER_ARGS[@]}"}"
 else
-    python -m tpufw.analysis
+    python -m tpufw.analysis "${LAYER_ARGS[@]+"${LAYER_ARGS[@]}"}"
 fi

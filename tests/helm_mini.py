@@ -1,204 +1,9 @@
-"""Minimal helm-template renderer for chart-rot tests.
+"""Compatibility shim: the mini helm renderer moved into the library
+(tpufw/utils/helm.py) so tpulint's deploy layer (TPU014) and the chart
+tests render through the same code. Import sites keep working."""
 
-`helm` isn't installed in the CI/dev image (the round-1 suite skipped its
-one chart test, leaving the templates unexercised — VERDICT r1 weak #7).
-This implements exactly the template subset deploy/charts/tpu-stack uses:
-
-  {{ .Values.a.b }} / {{ .Release.X }} / {{ .Chart.X }} / {{ . }}
-  {{ include "name" . }}    (defines parsed from templates/_helpers.tpl)
-  {{- if EXPR }} ... {{- end }}
-  {{- with EXPR }} ... {{- end }}          (rebinds .)
-  filters: quote, nindent N, toYaml, ternary A B
-
-It is NOT a general helm implementation; unknown constructs raise, so a
-template drifting outside the supported subset fails the test loudly
-instead of rendering garbage. When a real `helm` binary exists, the test
-additionally compares this renderer's output against `helm template`.
-"""
-
-from __future__ import annotations
-
-import json
-import os
-import re
-from typing import Any
-
-import yaml
-
-_TAG = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
-_DEFINE = re.compile(
-    r'\{\{-\s*define\s+"([^"]+)"\s*-\}\}(.*?)\{\{-\s*end\s*\}\}', re.S
+from tpufw.utils.helm import (  # noqa: F401
+    Context,
+    render_chart,
+    render_str,
 )
-
-
-class Context:
-    def __init__(self, chart_dir: str, release_name: str, namespace: str,
-                 values_overrides: dict | None = None):
-        with open(os.path.join(chart_dir, "Chart.yaml")) as f:
-            chart = yaml.safe_load(f)
-        with open(os.path.join(chart_dir, "values.yaml")) as f:
-            values = yaml.safe_load(f)
-        if values_overrides:
-            values = _deep_merge(values, values_overrides)
-        self.root = {
-            "Values": values,
-            "Chart": {
-                "Name": chart["name"],
-                "AppVersion": str(chart.get("appVersion", "")),
-                "Version": str(chart.get("version", "")),
-            },
-            "Release": {
-                "Name": release_name,
-                "Namespace": namespace,
-                "Service": "Helm",
-            },
-        }
-        self.defines: dict[str, str] = {}
-        helpers = os.path.join(chart_dir, "templates", "_helpers.tpl")
-        if os.path.exists(helpers):
-            with open(helpers) as f:
-                for name, body in _DEFINE.findall(f.read()):
-                    self.defines[name] = body.strip("\n")
-
-
-def _deep_merge(base: dict, over: dict) -> dict:
-    out = dict(base)
-    for k, v in over.items():
-        if isinstance(v, dict) and isinstance(out.get(k), dict):
-            out[k] = _deep_merge(out[k], v)
-        else:
-            out[k] = v
-    return out
-
-
-def _lookup(path: str, ctx: Context, dot: Any) -> Any:
-    if path == ".":
-        return dot
-    cur: Any = ctx.root
-    for part in path.lstrip(".").split("."):
-        if not isinstance(cur, dict) or part not in cur:
-            return None
-        cur = cur[part]
-    return cur
-
-
-def _eval_term(term: str, ctx: Context, dot: Any) -> Any:
-    term = term.strip()
-    if term.startswith('"') and term.endswith('"'):
-        return term[1:-1]
-    if re.fullmatch(r"-?\d+", term):
-        return int(term)
-    m = re.fullmatch(r'include\s+"([^"]+)"\s+(\.[\w.]*|\.)', term)
-    if m:
-        name, dot_expr = m.groups()
-        if name not in ctx.defines:
-            raise ValueError(f"helm_mini: unknown define {name!r}")
-        return render_str(
-            ctx.defines[name], ctx, _lookup(dot_expr, ctx, dot)
-        )
-    if term.startswith("."):
-        return _lookup(term, ctx, dot)
-    raise ValueError(f"helm_mini: unsupported term {term!r}")
-
-
-def _eval_expr(expr: str, ctx: Context, dot: Any) -> Any:
-    """Evaluate `term | filter | filter ...`."""
-    parts = [p.strip() for p in expr.split("|")]
-    head = parts[0]
-    m = re.fullmatch(r"toYaml\s+(.+)", head)
-    if m:
-        val: Any = _to_yaml(_eval_term(m.group(1), ctx, dot))
-    else:
-        val = _eval_term(head, ctx, dot)
-    for filt in parts[1:]:
-        toks = filt.split(None, 2)
-        name = toks[0]
-        if name == "quote":
-            val = json.dumps("" if val is None else str(val))
-        elif name == "nindent":
-            n = int(toks[1])
-            pad = " " * n
-            text = val if isinstance(val, str) else _to_yaml(val)
-            val = "\n".join(pad + ln if ln else ln
-                            for ln in text.splitlines())
-        elif name == "toYaml":
-            val = _to_yaml(val)
-        elif name == "ternary":
-            a = _eval_term(toks[1], ctx, dot)
-            b = _eval_term(toks[2], ctx, dot)
-            val = a if val else b
-        else:
-            raise ValueError(f"helm_mini: unsupported filter {name!r}")
-    return val
-
-
-def _to_yaml(val: Any) -> str:
-    return yaml.safe_dump(val, default_flow_style=False).strip("\n")
-
-
-_CTRL = re.compile(r"^(\s*)\{\{-?\s*(if|with|end)\b\s*(.*?)\s*-?\}\}\s*$")
-_NINDENT_LINE = re.compile(r"^\s*\{\{-\s*(.*?\|\s*nindent\s+\d+)\s*\}\}\s*$")
-
-
-def render_str(template: str, ctx: Context, dot: Any) -> str:
-    """Render a template body (helper defines use this with their own dot)."""
-    out_lines: list[str] = []
-    # Stack of (kind, emitting, saved_dot). Lines inside a false block are
-    # dropped; `with` rebinds dot.
-    stack: list[tuple[str, bool, Any]] = []
-
-    def emitting() -> bool:
-        return all(e for _, e, _ in stack)
-
-    for raw in template.splitlines():
-        m = _CTRL.match(raw)
-        if m:
-            _, kw, arg = m.groups()
-            if kw == "end":
-                if not stack:
-                    raise ValueError("helm_mini: unmatched end")
-                _, _, saved = stack.pop()
-                dot = saved
-            else:
-                val = _eval_expr(arg, ctx, dot) if emitting() else None
-                truthy = bool(val)
-                saved = dot
-                if kw == "with" and truthy:
-                    dot = val
-                stack.append((kw, truthy, saved))
-            continue
-        if not emitting():
-            continue
-        m = _NINDENT_LINE.match(raw)
-        if m:
-            # `  {{- expr | nindent N }}`: the `{{-` eats the line's own
-            # leading whitespace+newline; nindent re-adds newline+indent.
-            out_lines.append(_eval_expr(m.group(1), ctx, dot))
-            continue
-        line = _TAG.sub(
-            lambda mm: str(_eval_expr(mm.group(1), ctx, dot)), raw
-        )
-        out_lines.append(line)
-    if stack:
-        raise ValueError("helm_mini: unclosed block")
-    return "\n".join(out_lines)
-
-
-def render_chart(
-    chart_dir: str,
-    release_name: str = "tpu-stack",
-    namespace: str = "tpu-system",
-    values_overrides: dict | None = None,
-) -> dict[str, list[dict]]:
-    """Render every template; returns {template_filename: [yaml docs]}."""
-    ctx = Context(chart_dir, release_name, namespace, values_overrides)
-    tdir = os.path.join(chart_dir, "templates")
-    out: dict[str, list[dict]] = {}
-    for fname in sorted(os.listdir(tdir)):
-        if fname.startswith("_") or not fname.endswith((".yaml", ".yml")):
-            continue
-        with open(os.path.join(tdir, fname)) as f:
-            rendered = render_str(f.read(), ctx, ctx.root)
-        docs = [d for d in yaml.safe_load_all(rendered) if d]
-        out[fname] = docs
-    return out

@@ -1201,13 +1201,66 @@ def test_live_tree_clean_against_baseline():
 
 
 def test_all_rules_fire_on_fixtures(tmp_path):
-    """ISSUE acceptance: every shipped rule demonstrably fires."""
+    """ISSUE acceptance: every shipped rule demonstrably fires.
+
+    With pyyaml available the fixture also grows a deploy/ tree and the
+    assertion extends to the cross-layer rules (run_fixture scans with
+    the default layer="all", so one call exercises both halves).
+    """
+    deploy_files = {}
+    try:
+        import yaml  # noqa: F401
+
+        deploy_files = {
+            # TPU010: 2 workers x 4 chips != 4x4 topology product.
+            # TPU011: multi-host JobSet with no bootstrap wiring.
+            # TPU012: TPUFW_BATCH_SIZ is not in the catalog below.
+            "deploy/manifests/drift-jobset.yaml": (
+                "apiVersion: jobset.x-k8s.io/v1alpha2\n"
+                "kind: JobSet\n"
+                "metadata:\n"
+                "  name: drift\n"
+                "spec:\n"
+                "  replicatedJobs:\n"
+                "    - name: worker\n"
+                "      replicas: 1\n"
+                "      template:\n"
+                "        spec:\n"
+                "          parallelism: 2\n"
+                "          completions: 2\n"
+                "          completionMode: Indexed\n"
+                "          template:\n"
+                "            spec:\n"
+                "              nodeSelector:\n"
+                "                cloud.google.com/gke-tpu-accelerator:"
+                " tpu-v5-lite-podslice\n"
+                "                cloud.google.com/gke-tpu-topology:"
+                " 4x4\n"
+                "              containers:\n"
+                "                - name: t\n"
+                "                  resources:\n"
+                "                    limits:\n"
+                '                      google.com/tpu: "4"\n'
+                "                  env:\n"
+                "                    - name: TPUFW_BATCH_SIZ\n"
+                '                      value: "8"\n'
+            ),
+            # TPU013: no 'optimizer' section in the run-config schema.
+            "deploy/configs/drift.yaml": (
+                "name: drift\noptimizer:\n  lr: 1\n"
+            ),
+            # TPU014: unparseable manifest.
+            "deploy/manifests/broken.yaml": "a: [unclosed\n  b: {\n",
+        }
+    except ImportError:
+        pass
     out = run_fixture(
         tmp_path,
         {
             "tpufw/mesh/mesh.py": MESH_DECL,
             "tpufw/obs/events.py": EVENTS,
-            "docs/ENV.md": "",
+            **deploy_files,
+            "docs/ENV.md": MINI_ENV_MD if deploy_files else "",
             "docs/OBSERVABILITY.md": OBS_DOC,
             "mod.py": (
                 "import os\n"
@@ -1257,6 +1310,8 @@ def test_all_rules_fire_on_fixtures(tmp_path):
         "TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
         "TPU006", "TPU007", "TPU008", "TPU009",
     }
+    if deploy_files:
+        want |= {"TPU010", "TPU011", "TPU012", "TPU013", "TPU014"}
     assert want <= rules, (sorted(rules), keys(out))
 
 
@@ -1423,3 +1478,891 @@ def test_since_filter_and_git_gating(tmp_path):
     (tmp_path / "b.py").write_text("y = 1\n")  # untracked
     changed = incremental.changed_files(str(tmp_path), "HEAD")
     assert changed == {"a.py", "b.py"}, changed
+
+
+# ======================================================== deploy layer
+# tpulint v3 (TPU010-014): fixtures build a miniature deploy/ tree —
+# and, where a rule cross-checks the python side, miniature contract
+# modules (TrainerConfig, docs/ENV.md) — under tmp_path.
+
+try:
+    import yaml as _yaml  # noqa: F401
+
+    HAVE_YAML = True
+except ImportError:
+    HAVE_YAML = False
+
+import pytest
+
+needs_yaml = pytest.mark.skipif(
+    not HAVE_YAML, reason="deploy layer needs pyyaml"
+)
+
+
+def run_deploy_fixture(tmp_path, files, rules=None, layer="deploy"):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return run_analysis([], root=str(tmp_path), rules=rules, layer=layer)
+
+
+def jobset(
+    name="train",
+    parallelism=2,
+    completions=None,
+    replicas=1,
+    tpu=4,
+    accelerator="tpu-v5-lite-podslice",
+    topology="2x4",
+    completion_mode="Indexed",
+    dns=True,
+    env_extra="",
+    wire=True,
+    workers_env=None,
+):
+    """A JobSet manifest string; defaults are fully wired and
+    arithmetically consistent (2 workers x 4 chips = 2x4 topology)."""
+    completions = parallelism if completions is None else completions
+    selector = ""
+    if accelerator is not None:
+        selector = (
+            "              nodeSelector:\n"
+            "                cloud.google.com/gke-tpu-accelerator: "
+            f"{accelerator}\n"
+            "                cloud.google.com/gke-tpu-topology: "
+            f"{topology}\n"
+        )
+    wiring = ""
+    if wire:
+        workers = parallelism if workers_env is None else workers_env
+        wiring = f"""\
+                    - name: JOBSET_NAME
+                      valueFrom:
+                        fieldRef:
+                          fieldPath: metadata.annotations['jobset.sigs.k8s.io/jobset-name']
+                    - name: REPLICATED_JOB_NAME
+                      valueFrom:
+                        fieldRef:
+                          fieldPath: metadata.annotations['jobset.sigs.k8s.io/replicatedjob-name']
+                    - name: JOB_COMPLETION_INDEX
+                      valueFrom:
+                        fieldRef:
+                          fieldPath: metadata.annotations['batch.kubernetes.io/job-completion-index']
+                    - name: TPUFW_WORKERS_PER_SLICE
+                      value: "{workers}"
+"""
+    network = (
+        "  network:\n    enableDNSHostnames: true\n" if dns else ""
+    )
+    return f"""\
+apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata:
+  name: {name}
+spec:
+{network}  replicatedJobs:
+    - name: worker
+      replicas: {replicas}
+      template:
+        spec:
+          parallelism: {parallelism}
+          completions: {completions}
+          completionMode: {completion_mode}
+          template:
+            spec:
+{selector}              containers:
+                - name: train
+                  ports:
+                    - containerPort: 8476
+                  resources:
+                    limits:
+                      google.com/tpu: "{tpu}"
+                  env:
+{wiring}{env_extra}"""
+
+
+MINI_ENV_MD = """\
+# knobs
+| Variable | Type | Default | Meaning |
+|---|---|---|---|
+| `TPUFW_BATCH_SIZE` | int | 256 | global batch rows |
+| `TPUFW_DEBUG` | bool | false | debug logging |
+| `TPUFW_LR` | float | 3e-4 | learning rate |
+| `TPUFW_MODEL` | str | resnet | model preset |
+| `TPUFW_WORKERS_PER_SLICE` | int | 1 | hosts per slice |
+"""
+
+MINI_TRAINER = (
+    "from dataclasses import dataclass\n"
+    "@dataclass\n"
+    "class TrainerConfig:\n"
+    "    batch_size: int = 8\n"
+    "    seq_len: int = 128\n"
+    "    total_steps: int = 10\n"
+)
+
+MINI_MESH = (
+    "from dataclasses import dataclass\n"
+    "@dataclass\n"
+    "class MeshConfig:\n"
+    "    data: int = 1\n"
+    "    fsdp: int = -1\n"
+)
+
+
+# ---------------------------------------------------------------- TPU010
+
+
+@needs_yaml
+def test_tpu010_topology_product_mismatch(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {"deploy/manifests/a.yaml": jobset(topology="4x4")},
+        rules=["TPU010"],
+    )
+    assert any(f.symbol == "topology:train" for f in out), keys(out)
+
+
+@needs_yaml
+def test_tpu010_chips_per_host_exceeded(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/manifests/a.yaml": jobset(
+                tpu=8,
+                accelerator="tpu-v5p-slice",
+                topology="4x4",
+                parallelism=2,
+            )
+        },
+        rules=["TPU010"],
+    )
+    # v5p hosts are 4-chip; 8/pod can never schedule.
+    assert any(
+        f.symbol == "chips-per-host:train" for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu010_mesh_env_product_mismatch(tmp_path):
+    env = (
+        '                    - name: TPUFW_MESH_FSDP\n'
+        '                      value: "4"\n'
+    )
+    out = run_deploy_fixture(
+        tmp_path,
+        {"deploy/manifests/a.yaml": jobset(env_extra=env)},
+        rules=["TPU010"],
+    )
+    # 8 chips provided, mesh factorizes to 4.
+    assert any(f.symbol == "mesh-product:train" for f in out), keys(out)
+
+
+@needs_yaml
+def test_tpu010_completions_drift(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/manifests/a.yaml": jobset(
+                completions=1, topology="2x2"
+            )
+        },
+        rules=["TPU010"],
+    )
+    assert any(f.symbol == "completions:train" for f in out), keys(out)
+
+
+@needs_yaml
+def test_tpu010_config_slice_arithmetic(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/configs/a.yaml": (
+                "name: a\n"
+                "hardware:\n"
+                "  slice: v5e-8\n"
+                "  hosts: 1\n"
+                "  chips_per_host: 4\n"
+            )
+        },
+        rules=["TPU010"],
+    )
+    assert any(f.symbol == "slice-chips:a" for f in out), keys(out)
+
+
+@needs_yaml
+def test_tpu010_config_manifest_pair_drift(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/manifests/05-run-jobset.yaml": jobset(
+                topology="2x4"
+            ),
+            "deploy/configs/05-run.yaml": (
+                "name: run\n"
+                "hardware:\n"
+                "  slice: v5e-8\n"
+                "  topology: 4x2\n"
+                "  hosts: 2\n"
+                "  chips_per_host: 4\n"
+            ),
+        },
+        rules=["TPU010"],
+    )
+    assert any(
+        f.symbol == "pair-topology:05-run" for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu010_single_chip_needs_no_selector(tmp_path):
+    """FP guard: 1-chip single-pod workloads (the chart's validator
+    job) may omit the TPU nodeSelector."""
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/manifests/v.yaml": (
+                "apiVersion: batch/v1\n"
+                "kind: Job\n"
+                "metadata:\n"
+                "  name: validate\n"
+                "spec:\n"
+                "  template:\n"
+                "    spec:\n"
+                "      containers:\n"
+                "        - name: v\n"
+                "          resources:\n"
+                "            limits:\n"
+                '              google.com/tpu: "1"\n'
+            )
+        },
+        rules=["TPU010"],
+    )
+    assert out == [], keys(out)
+
+
+@needs_yaml
+def test_tpu010_fill_axis_skips_mesh_product(tmp_path):
+    """FP guard: a -1 (fill) mesh axis absorbs the remainder — no
+    product to check."""
+    env = (
+        '                    - name: TPUFW_MESH_FSDP\n'
+        '                      value: "-1"\n'
+    )
+    out = run_deploy_fixture(
+        tmp_path,
+        {"deploy/manifests/a.yaml": jobset(env_extra=env)},
+        rules=["TPU010"],
+    )
+    assert out == [], keys(out)
+
+
+@needs_yaml
+def test_tpu010_consistent_jobset_clean(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {"deploy/manifests/a.yaml": jobset()},
+        rules=["TPU010"],
+    )
+    assert out == [], keys(out)
+
+
+@needs_yaml
+def test_tpu010_yaml_suppression(tmp_path):
+    text = jobset(topology="4x4").replace(
+        "cloud.google.com/gke-tpu-topology: 4x4",
+        "cloud.google.com/gke-tpu-topology: 4x4"
+        "  # tpulint: disable=TPU010 — fixture",
+    )
+    out = run_deploy_fixture(
+        tmp_path, {"deploy/manifests/a.yaml": text}, rules=["TPU010"]
+    )
+    assert out == [], keys(out)
+
+
+# ---------------------------------------------------------------- TPU011
+
+
+@needs_yaml
+def test_tpu011_missing_workers_per_slice(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {"deploy/manifests/a.yaml": jobset(wire=False)},
+        rules=["TPU011"],
+    )
+    assert any(
+        f.symbol == "missing-env:train:TPUFW_WORKERS_PER_SLICE"
+        for f in out
+    ), keys(out)
+    assert any(
+        f.symbol == "missing-env:train:JOBSET_NAME" for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu011_not_indexed(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/manifests/a.yaml": jobset(
+                completion_mode="NonIndexed"
+            )
+        },
+        rules=["TPU011"],
+    )
+    assert any(
+        f.symbol == "completion-mode:train" for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu011_workers_vs_parallelism(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {"deploy/manifests/a.yaml": jobset(workers_env=4)},
+        rules=["TPU011"],
+    )
+    assert any(
+        f.symbol == "workers-per-slice:train" for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu011_no_dns_no_svc(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {"deploy/manifests/a.yaml": jobset(dns=False)},
+        rules=["TPU011"],
+    )
+    assert any(
+        f.symbol == "dns-hostnames:train" for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu011_explicit_tier_needs_num_processes(tmp_path):
+    env = (
+        "                    - name: TPUFW_COORDINATOR\n"
+        "                      value: coord:8476\n"
+    )
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/manifests/a.yaml": jobset(
+                wire=False, env_extra=env
+            )
+        },
+        rules=["TPU011"],
+    )
+    assert keys(out) == ["explicit-num-processes:train"], keys(out)
+
+
+@needs_yaml
+def test_tpu011_single_host_jobset_exempt(tmp_path):
+    """FP guard: a 1-worker JobSet bootstraps as single-process."""
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/manifests/a.yaml": jobset(
+                parallelism=1, tpu=4, topology="2x2", wire=False
+            )
+        },
+        rules=["TPU011"],
+    )
+    assert out == [], keys(out)
+
+
+@needs_yaml
+def test_tpu011_fully_wired_clean(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {"deploy/manifests/a.yaml": jobset()},
+        rules=["TPU011"],
+    )
+    assert out == [], keys(out)
+
+
+@needs_yaml
+def test_tpu011_coordinator_svc_resolves(tmp_path):
+    """FP guard: an explicit TPUFW_COORDINATOR_SVC matching a Service
+    in the deploy set needs no DNS hostnames."""
+    env = (
+        "                    - name: TPUFW_COORDINATOR_SVC\n"
+        "                      value: coord-svc\n"
+    )
+    svc = (
+        "apiVersion: v1\n"
+        "kind: Service\n"
+        "metadata:\n"
+        "  name: coord-svc\n"
+        "spec: {}\n"
+    )
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/manifests/a.yaml": jobset(
+                dns=False, env_extra=env
+            ),
+            "deploy/manifests/svc.yaml": svc,
+        },
+        rules=["TPU011"],
+    )
+    assert out == [], keys(out)
+
+
+@needs_yaml
+def test_tpu011_contract_drift(tmp_path):
+    """bootstrap.py present but missing a marker -> drift warning."""
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/manifests/a.yaml": jobset(),
+            "tpufw/cluster/bootstrap.py": (
+                "# coordinator moved elsewhere\n"
+            ),
+        },
+        rules=["TPU011"],
+    )
+    drift = [f for f in out if f.symbol.startswith("contract-drift:")]
+    assert drift and all(f.severity == "warning" for f in drift), keys(
+        out
+    )
+
+
+# ---------------------------------------------------------------- TPU012
+
+
+@needs_yaml
+def test_tpu012_unknown_knob_with_suggestion(tmp_path):
+    env = (
+        "                    - name: TPUFW_BATCH_SIZ\n"
+        '                      value: "8"\n'
+    )
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "docs/ENV.md": MINI_ENV_MD,
+            "deploy/manifests/a.yaml": jobset(env_extra=env),
+        },
+        rules=["TPU012"],
+    )
+    assert any(
+        f.symbol == "unknown:TPUFW_BATCH_SIZ"
+        and "TPUFW_BATCH_SIZE" in f.message
+        for f in out
+    ), [(f.symbol, f.message) for f in out]
+
+
+@needs_yaml
+def test_tpu012_type_mismatch(tmp_path):
+    env = (
+        "                    - name: TPUFW_BATCH_SIZE\n"
+        '                      value: "lots"\n'
+    )
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "docs/ENV.md": MINI_ENV_MD,
+            "deploy/manifests/a.yaml": jobset(env_extra=env),
+        },
+        rules=["TPU012"],
+    )
+    assert any(
+        f.symbol == "type:TPUFW_BATCH_SIZE" for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu012_unquoted_scalar(tmp_path):
+    env = (
+        "                    - name: TPUFW_BATCH_SIZE\n"
+        "                      value: 32\n"
+    )
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "docs/ENV.md": MINI_ENV_MD,
+            "deploy/manifests/a.yaml": jobset(env_extra=env),
+        },
+        rules=["TPU012"],
+    )
+    assert any(
+        f.symbol == "unquoted:TPUFW_BATCH_SIZE" for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu012_dockerfile_env(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "docs/ENV.md": MINI_ENV_MD,
+            "deploy/docker/Dockerfile": (
+                "FROM python:3.11\n"
+                "ENV TPUFW_DEBUGG=1\n"
+            ),
+        },
+        rules=["TPU012"],
+    )
+    assert any(
+        f.symbol == "unknown:TPUFW_DEBUGG"
+        and f.path == "deploy/docker/Dockerfile"
+        and f.line == 2
+        for f in out
+    ), [(f.symbol, f.path, f.line) for f in out]
+
+
+@needs_yaml
+def test_tpu012_valid_knobs_clean(tmp_path):
+    env = (
+        "                    - name: TPUFW_BATCH_SIZE\n"
+        '                      value: "32"\n'
+        "                    - name: TPUFW_DEBUG\n"
+        '                      value: "true"\n'
+        "                    - name: TPUFW_LR\n"
+        '                      value: "1e-3"\n'
+    )
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "docs/ENV.md": MINI_ENV_MD,
+            "deploy/manifests/a.yaml": jobset(env_extra=env),
+        },
+        rules=["TPU012"],
+    )
+    assert out == [], keys(out)
+
+
+@needs_yaml
+def test_tpu012_downward_api_skipped(tmp_path):
+    """FP guard: valueFrom entries have no literal to type-check, and
+    the bootstrap wiring vars are not catalog knobs anyway."""
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "docs/ENV.md": MINI_ENV_MD,
+            "deploy/manifests/a.yaml": jobset(),
+        },
+        rules=["TPU012"],
+    )
+    assert [
+        f for f in out if "WORKERS_PER_SLICE" in f.symbol
+    ] == [], keys(out)
+
+
+# ---------------------------------------------------------------- TPU013
+
+
+@needs_yaml
+def test_tpu013_unknown_top_level_key(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/configs/a.yaml": (
+                "name: a\n"
+                "optimizer:\n"
+                "  lr: 1\n"
+            )
+        },
+        rules=["TPU013"],
+    )
+    assert any(f.symbol == "key:optimizer" for f in out), keys(out)
+
+
+@needs_yaml
+def test_tpu013_unknown_trainer_field(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "tpufw/train/trainer.py": MINI_TRAINER,
+            "deploy/configs/a.yaml": (
+                "name: a\n"
+                "trainer:\n"
+                "  batch_size: 8\n"
+                "  learning_rate: 1e-3\n"
+            ),
+        },
+        rules=["TPU013"],
+    )
+    assert any(
+        f.symbol == "trainer-key:learning_rate" for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu013_unknown_mesh_field(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "tpufw/mesh/mesh.py": MINI_MESH,
+            "deploy/configs/a.yaml": (
+                "name: a\n"
+                "mesh:\n"
+                "  fsdp: 4\n"
+                "  shards: 2\n"
+            ),
+        },
+        rules=["TPU013"],
+    )
+    assert any(f.symbol == "mesh-key:shards" for f in out), keys(out)
+
+
+@needs_yaml
+def test_tpu013_unknown_model_key(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/configs/a.yaml": (
+                "name: a\n"
+                "model:\n"
+                "  preset: llama3_8b\n"
+                "  checkpoint: /x\n"
+            )
+        },
+        rules=["TPU013"],
+    )
+    assert any(
+        f.symbol == "model-key:checkpoint" for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu013_valid_config_clean(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "tpufw/train/trainer.py": MINI_TRAINER,
+            "tpufw/mesh/mesh.py": MINI_MESH,
+            "deploy/configs/a.yaml": (
+                "name: a\n"
+                "trainer:\n"
+                "  batch_size: 8\n"
+                "  seq_len: 128\n"
+                "mesh:\n"
+                "  fsdp: 4\n"
+            ),
+        },
+        rules=["TPU013"],
+    )
+    assert out == [], keys(out)
+
+
+@needs_yaml
+def test_tpu013_missing_contract_module_skips(tmp_path):
+    """FP guard: no trainer module in the tree -> field check skipped
+    rather than everything flagged."""
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/configs/a.yaml": (
+                "name: a\n"
+                "trainer:\n"
+                "  anything_goes: 1\n"
+            )
+        },
+        rules=["TPU013"],
+    )
+    assert out == [], keys(out)
+
+
+def _jax_available():
+    try:
+        import jax  # noqa: F401
+        import numpy  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+@needs_yaml
+@pytest.mark.skipif(
+    not _jax_available(), reason="HBM pre-check needs jax/numpy"
+)
+def test_tpu013_hbm_overflow_fires_on_real_preset(tmp_path):
+    """An 8B model on one v5e chip cannot fit — the analytic pre-check
+    (real loader + estimator against the installed tree) must fire."""
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/configs/big.yaml": (
+                "name: big\n"
+                "hardware:\n"
+                "  slice: v5e-1\n"
+                "  hosts: 1\n"
+                "  chips_per_host: 1\n"
+                "model:\n"
+                "  preset: llama3_8b\n"
+                "trainer:\n"
+                "  batch_size: 8\n"
+                "  seq_len: 2048\n"
+            )
+        },
+        rules=["TPU013"],
+    )
+    assert any(f.symbol == "hbm:big" for f in out), keys(out)
+
+
+# ---------------------------------------------------------------- TPU014
+
+
+@needs_yaml
+def test_tpu014_manifest_parse_error(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {"deploy/manifests/bad.yaml": "a: [unclosed\n  b: {\n"},
+        rules=["TPU014"],
+    )
+    assert any(
+        f.symbol == "parse:deploy/manifests/bad.yaml" for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu014_chart_render_error(tmp_path):
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/charts/tpu-stack/Chart.yaml": (
+                "name: tpu-stack\nversion: 0.1.0\n"
+            ),
+            "deploy/charts/tpu-stack/values.yaml": "foo: bar\n",
+            "deploy/charts/tpu-stack/templates/cm.yaml": (
+                "apiVersion: v1\n"
+                "kind: ConfigMap\n"
+                "metadata:\n"
+                "  name: {{ mystery .Values.foo }}\n"
+            ),
+        },
+        rules=["TPU014"],
+    )
+    assert any(
+        f.symbol
+        == "render:deploy/charts/tpu-stack/templates/cm.yaml"
+        for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu014_broken_chart_load(tmp_path):
+    """templates/ exists but Chart.yaml is missing -> chart load
+    failure is reported, not swallowed."""
+    out = run_deploy_fixture(
+        tmp_path,
+        {
+            "deploy/charts/tpu-stack/templates/cm.yaml": (
+                "apiVersion: v1\nkind: ConfigMap\n"
+            ),
+        },
+        rules=["TPU014"],
+    )
+    assert any(
+        f.symbol.startswith("render:") for f in out
+    ), keys(out)
+
+
+@needs_yaml
+def test_tpu014_valid_tree_clean_and_chart_feeds_tpu012(tmp_path):
+    """FP guard for TPU014 + the parity contract: a rendering chart
+    yields no TPU014, and its rendered docs are checked by TPU012
+    exactly like a raw manifest (finding anchored at the template)."""
+    files = {
+        "docs/ENV.md": MINI_ENV_MD,
+        "deploy/charts/tpu-stack/Chart.yaml": (
+            "name: tpu-stack\nversion: 0.1.0\n"
+        ),
+        "deploy/charts/tpu-stack/values.yaml": "batch: abc\n",
+        "deploy/charts/tpu-stack/templates/pod.yaml": (
+            "apiVersion: v1\n"
+            "kind: Pod\n"
+            "metadata:\n"
+            "  name: demo\n"
+            "spec:\n"
+            "  containers:\n"
+            "    - name: c\n"
+            "      env:\n"
+            "        - name: TPUFW_BATCH_SIZE\n"
+            "          value: {{ .Values.batch | quote }}\n"
+        ),
+    }
+    out14 = run_deploy_fixture(tmp_path, files, rules=["TPU014"])
+    assert out14 == [], keys(out14)
+    out12 = run_analysis(
+        [], root=str(tmp_path), rules=["TPU012"], layer="deploy"
+    )
+    assert any(
+        f.symbol == "type:TPUFW_BATCH_SIZE"
+        and f.path == "deploy/charts/tpu-stack/templates/pod.yaml"
+        for f in out12
+    ), [(f.symbol, f.path) for f in out12]
+
+
+# ------------------------------------------------------- layer plumbing
+
+
+@needs_yaml
+def test_layer_filtering(tmp_path):
+    """One tree with a python violation and a deploy violation: each
+    layer sees only its own rules; all sees both."""
+    files = {
+        "mod.py": (
+            "import jax\n"
+            "def f(key, shape):\n"
+            "    a = jax.random.normal(key, shape)\n"
+            "    return a + jax.random.normal(key, shape)\n"
+        ),
+        "deploy/manifests/a.yaml": jobset(topology="4x4"),
+    }
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    py = run_analysis(
+        [str(tmp_path)], root=str(tmp_path), layer="python"
+    )
+    dp = run_analysis([], root=str(tmp_path), layer="deploy")
+    both = run_analysis([str(tmp_path)], root=str(tmp_path), layer="all")
+    assert {f.rule for f in py} and all(
+        f.rule < "TPU010" for f in py
+    ), keys(py)
+    assert {f.rule for f in dp} and all(
+        f.rule >= "TPU010" for f in dp
+    ), keys(dp)
+    assert {f.rule for f in both} >= {
+        f.rule for f in py
+    } | {f.rule for f in dp}
+
+
+def test_layer_validation():
+    with pytest.raises(ValueError):
+        run_analysis([], root=".", layer="helm")
+
+
+@needs_yaml
+def test_scan_signature_covers_deploy(tmp_path):
+    from tpufw.analysis import incremental
+
+    (tmp_path / "deploy" / "manifests").mkdir(parents=True)
+    mpath = tmp_path / "deploy" / "manifests" / "a.yaml"
+    mpath.write_text("kind: Pod\n")
+    sig_a = incremental.scan_signature(str(tmp_path), [], None)
+    sig_py = incremental.scan_signature(
+        str(tmp_path), [], None, layer="python"
+    )
+    mpath.write_text("kind: Job\n")
+    sig_b = incremental.scan_signature(str(tmp_path), [], None)
+    assert sig_a != sig_b, "deploy edit must invalidate the cache"
+    assert "deploy" not in sig_py, "python layer must not hash deploy/"
+
+
+def test_env_catalog_single_source(tmp_path):
+    """core.load_env_catalog parses typed rows once for TPU004+TPU012."""
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "ENV.md").write_text(MINI_ENV_MD)
+    project = core.Project([], str(tmp_path))
+    cat = project.env_catalog()
+    assert cat.entries["TPUFW_BATCH_SIZE"].type == "int"
+    assert cat.entries["TPUFW_DEBUG"].default == "false"
+    assert "TPUFW_LR" in cat.catalog_names
+    assert project.env_catalog() is cat  # cached

@@ -12,6 +12,12 @@ SARIF 2.1.0 for GitHub code scanning. ``--cache`` enables the
 whole-scan replay cache (see :mod:`tpufw.analysis.incremental`), and
 ``--since <ref>`` gates the exit code on findings in files changed
 since ``ref`` — the pre-commit fast path.
+
+``--layer {python,deploy,all}`` (default ``all``) selects the scan
+set: ``python`` is the stdlib-only ast rules (TPU001-009), ``deploy``
+parses ``deploy/`` and runs the cross-layer rules (TPU010-014,
+requires pyyaml), ``all`` runs both — degrading to python-only with a
+stderr notice when pyyaml is missing.
 """
 
 from __future__ import annotations
@@ -52,6 +58,17 @@ def main(argv: List[str] | None = None) -> int:
         help="comma-separated rule subset (e.g. TPU001,TPU004)",
     )
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument(
+        "--layer",
+        choices=core.LAYERS,
+        default="all",
+        help=(
+            "scan layer: python = ast rules over .py files, deploy = "
+            "TPU010-014 over deploy/ (needs pyyaml), all = both "
+            "(default; deploy half skipped with a notice if pyyaml "
+            "is missing)"
+        ),
+    )
     ap.add_argument(
         "--baseline",
         default=None,
@@ -97,7 +114,7 @@ def main(argv: List[str] | None = None) -> int:
 
     if args.list_rules:
         for c in core.all_checkers():
-            print(f"{c.rule}  {c.name}  [{c.severity}]")
+            print(f"{c.rule}  {c.name}  [{c.severity}]  layer={c.layer}")
         return 0
 
     root = core.find_repo_root(args.paths[0] if args.paths else ".")
@@ -118,11 +135,22 @@ def main(argv: List[str] | None = None) -> int:
             else args.cache
         )
 
+    from tpufw.analysis import manifests
+
+    if args.layer == "all" and not manifests.yaml_available():
+        print(
+            "tpulint: pyyaml not importable — deploy layer "
+            "(TPU010-014) skipped; pip install pyyaml or use "
+            "--layer python to silence this",
+            file=sys.stderr,
+        )
+
     findings = None
     signature = None
     if cache_path is not None:
         signature = incremental.scan_signature(
-            root, core.iter_py_files(paths, root), rules
+            root, core.iter_py_files(paths, root), rules,
+            layer=args.layer,
         )
         findings = incremental.load_cached(cache_path, signature)
         if findings is not None:
@@ -133,7 +161,9 @@ def main(argv: List[str] | None = None) -> int:
             )
     if findings is None:
         try:
-            findings = core.run_analysis(paths, root=root, rules=rules)
+            findings = core.run_analysis(
+                paths, root=root, rules=rules, layer=args.layer
+            )
         except ValueError as e:
             print(f"tpulint: {e}", file=sys.stderr)
             return 2

@@ -36,6 +36,8 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
 
 SEVERITIES = ("error", "warning", "info")
 
+LAYERS = ("python", "deploy", "all")
+
 _SUPPRESS_RE = re.compile(
     r"#\s*tpulint:\s*(disable|disable-file)\s*=\s*"
     r"([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
@@ -43,6 +45,42 @@ _SUPPRESS_RE = re.compile(
 
 # Directories never worth parsing (caches, VCS, vendored assets).
 _SKIP_DIRS = {"__pycache__", ".git", ".ruff_cache", "node_modules", ".venv"}
+
+
+def scan_suppression_lines(
+    lines: Sequence[str],
+) -> tuple[Set[str], Dict[int, Set[str]]]:
+    """(file_suppressed, line -> rules) from ``# tpulint:`` comments.
+
+    Works on any ``#``-comment syntax (python, YAML, Dockerfile), so
+    the python scan set and the deploy layer share one suppression
+    grammar: a trailing comment covers its line, a standalone comment
+    covers its block plus the first non-comment line after it, and
+    ``disable-file`` covers the whole file.
+    """
+    file_suppressed: Set[str] = set()
+    line_suppressed: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(2).split(",")}
+        if m.group(1) == "disable-file":
+            file_suppressed |= rules
+            continue
+        line_suppressed.setdefault(i, set()).update(rules)
+        # A comment alone on its line covers the rest of its comment
+        # block (the justification) and the first code line after it —
+        # for statements too long to carry a trailing comment.
+        if line.lstrip().startswith("#"):
+            j = i + 1
+            while j <= len(lines):
+                line_suppressed.setdefault(j, set()).update(rules)
+                stripped = lines[j - 1].lstrip()
+                if stripped and not stripped.startswith("#"):
+                    break  # covered the first code line; stop
+                j += 1
+    return file_suppressed, line_suppressed
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,33 +127,9 @@ class SourceFile:
             self.tree = ast.parse(text, filename=relpath)
         except SyntaxError as e:
             self.parse_error = e
-        self.file_suppressed: Set[str] = set()
-        # line number -> rules suppressed on that line
-        self.line_suppressed: Dict[int, Set[str]] = {}
-        self._scan_suppressions()
-
-    def _scan_suppressions(self) -> None:
-        for i, line in enumerate(self.lines, start=1):
-            m = _SUPPRESS_RE.search(line)
-            if not m:
-                continue
-            rules = {r.strip() for r in m.group(2).split(",")}
-            if m.group(1) == "disable-file":
-                self.file_suppressed |= rules
-                continue
-            self.line_suppressed.setdefault(i, set()).update(rules)
-            # A comment alone on its line covers the rest of its
-            # comment block (the justification) and the first code
-            # line after it — for statements too long to carry a
-            # trailing comment.
-            if line.lstrip().startswith("#"):
-                j = i + 1
-                while j <= len(self.lines):
-                    self.line_suppressed.setdefault(j, set()).update(rules)
-                    stripped = self.lines[j - 1].lstrip()
-                    if stripped and not stripped.startswith("#"):
-                        break  # covered the first code line; stop
-                    j += 1
+        self.file_suppressed, self.line_suppressed = scan_suppression_lines(
+            self.lines
+        )
 
     def is_suppressed(self, rule: str, line: int) -> bool:
         if rule in self.file_suppressed:
@@ -125,12 +139,23 @@ class SourceFile:
 
 class Project:
     """Every scanned file, plus the repo root for out-of-scan lookups
-    (docs/, the env registry) that cross-file rules need."""
+    (docs/, the env registry) that cross-file rules need. Since v3 it
+    also carries the deploy layer: parsed manifests/configs/rendered
+    chart templates (``deploy_files``, see
+    :mod:`tpufw.analysis.manifests`)."""
 
-    def __init__(self, files: Sequence[SourceFile], root: str):
+    def __init__(
+        self,
+        files: Sequence[SourceFile],
+        root: str,
+        deploy_files: Sequence = (),
+    ):
         self.files = list(files)
         self.root = root
+        self.deploy_files = list(deploy_files)
         self._by_rel = {f.relpath: f for f in self.files}
+        self._doc_trees: Dict[str, Optional[ast.Module]] = {}
+        self._env_catalog: Optional["EnvCatalog"] = None
 
     def file(self, relpath: str) -> Optional[SourceFile]:
         return self._by_rel.get(relpath.replace(os.sep, "/"))
@@ -138,6 +163,12 @@ class Project:
     def files_matching(self, prefix: str) -> List[SourceFile]:
         prefix = prefix.replace(os.sep, "/")
         return [f for f in self.files if f.relpath.startswith(prefix)]
+
+    def deploy_matching(self, prefix: str) -> List:
+        prefix = prefix.replace(os.sep, "/")
+        return [
+            f for f in self.deploy_files if f.relpath.startswith(prefix)
+        ]
 
     def read_doc(self, relpath: str) -> Optional[str]:
         """Text of a repo file outside the scan set (docs, README)."""
@@ -148,6 +179,133 @@ class Project:
         except OSError:
             return None
 
+    def parse_doc(self, relpath: str) -> Optional[ast.Module]:
+        """AST of a python file resolved against the repo root even
+        when it is outside the scan set — how deploy-layer rules read
+        contract modules (``TrainerConfig`` fields, the bootstrap env
+        names) under ``--layer deploy`` where no python is scanned."""
+        relpath = relpath.replace(os.sep, "/")
+        if relpath not in self._doc_trees:
+            src = self.file(relpath)
+            if src is not None:
+                self._doc_trees[relpath] = src.tree
+            else:
+                text = self.read_doc(relpath)
+                try:
+                    tree = (
+                        None if text is None
+                        else ast.parse(text, filename=relpath)
+                    )
+                except SyntaxError:
+                    tree = None
+                self._doc_trees[relpath] = tree
+        return self._doc_trees[relpath]
+
+    def env_catalog(self) -> "EnvCatalog":
+        if self._env_catalog is None:
+            self._env_catalog = load_env_catalog(self)
+        return self._env_catalog
+
+    def is_suppressed(self, rule: str, path: str, line: int) -> bool:
+        """Suppression lookup across both layers. Rendered chart
+        variants share a relpath; a suppression in any variant wins
+        (the comments come from the same template either way)."""
+        src = self.file(path)
+        if src is not None and src.is_suppressed(rule, line):
+            return True
+        path = path.replace(os.sep, "/")
+        for df in self.deploy_files:
+            if df.relpath == path and df.is_suppressed(rule, line):
+                return True
+        return False
+
+
+# ----------------------------------------------------------- env catalog
+
+#: Doc pages where a TPUFW_* mention counts as "documented"; the first
+#: entry is the authoritative catalog with typed table rows.
+ENV_CATALOG_DOC = "docs/ENV.md"
+ENV_DOC_PAGES = (
+    "docs/ENV.md",
+    "docs/OBSERVABILITY.md",
+    "docs/PERF.md",
+    "docs/WORKFLOWS.md",
+    "docs/PARITY.md",
+    "README.md",
+)
+
+_ENV_NAME_RE = re.compile(r"TPUFW_[A-Z0-9_]+")
+# A catalog table row: | `TPUFW_X` | type | default | meaning |
+_ENV_ROW_RE = re.compile(
+    r"^\|\s*`(TPUFW_[A-Z0-9_]+)`\s*\|\s*([^|]+?)\s*\|\s*([^|]*?)\s*\|"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvKnob:
+    """One typed row of the docs/ENV.md catalog table."""
+
+    name: str
+    type: str  # "int" | "float" | "str" | "bool" | "opt int" | ...
+    default: str
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvCatalog:
+    """Single-sourced docs/ENV.md parse shared by TPU004 and TPU012."""
+
+    entries: Dict[str, EnvKnob]  # typed catalog table rows
+    catalog_names: Set[str]  # every TPUFW_* mention in docs/ENV.md
+    doc_names: Set[str]  # every TPUFW_* mention in any doc page
+
+
+def load_env_catalog(project: Project) -> EnvCatalog:
+    entries: Dict[str, EnvKnob] = {}
+    catalog_names: Set[str] = set()
+    doc_names: Set[str] = set()
+    for page in ENV_DOC_PAGES:
+        text = project.read_doc(page)
+        if text is None:
+            continue
+        found = set(_ENV_NAME_RE.findall(text))
+        doc_names |= found
+        if page != ENV_CATALOG_DOC:
+            continue
+        catalog_names |= found
+        for line in text.splitlines():
+            m = _ENV_ROW_RE.match(line)
+            if m:
+                name, type_str, default = m.groups()
+                entries.setdefault(
+                    name, EnvKnob(name, type_str.strip(), default.strip())
+                )
+    return EnvCatalog(
+        entries=entries, catalog_names=catalog_names, doc_names=doc_names
+    )
+
+
+def deploy_text_env_names(root: str) -> Set[str]:
+    """Every TPUFW_* name textually present under ``deploy/`` — the
+    raw-text (no yaml needed) mention source the stale-catalog check
+    uses so chart-only knobs don't read as stale under
+    ``--layer python``."""
+    out: Set[str] = set()
+    base = os.path.join(root, "deploy")
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d not in _SKIP_DIRS and not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            try:
+                with open(
+                    os.path.join(dirpath, fn), encoding="utf-8"
+                ) as fh:
+                    out |= set(_ENV_NAME_RE.findall(fh.read()))
+            except (OSError, UnicodeDecodeError):
+                continue
+    return out
+
 
 class Checker:
     """Base class for one rule. Subclasses set ``rule``/``name`` and
@@ -157,6 +315,11 @@ class Checker:
     rule = "TPU000"
     name = "abstract"
     severity = "error"
+    # Which scan layer feeds the rule: "python" rules read the parsed
+    # .py scan set, "deploy" rules read project.deploy_files (plus
+    # contract modules via parse_doc). run_analysis(layer=...) filters
+    # on this so CI's python-lint and deploy-lint jobs stay disjoint.
+    layer = "python"
 
     def check(self, project: Project) -> Iterator[Finding]:
         raise NotImplementedError
@@ -240,8 +403,15 @@ def collect_files(paths: Sequence[str], root: str) -> List[SourceFile]:
 
 
 def all_checkers() -> List[Checker]:
-    """The shipped rule set, TPU001..TPU009 (import here, not at
+    """The shipped rule set, TPU001..TPU014 (import here, not at
     module top, so core stays importable from checker modules)."""
+    from tpufw.analysis.deploy import (
+        BootstrapWiringChecker,
+        ChartParityChecker,
+        ConfigSchemaChecker,
+        EnvKnobValidityChecker,
+        TopologyMathChecker,
+    )
     from tpufw.analysis.donation import DonationChecker
     from tpufw.analysis.dtypes import DtypeDriftChecker
     from tpufw.analysis.envreg import EnvRegistryChecker
@@ -262,6 +432,11 @@ def all_checkers() -> List[Checker]:
         RetraceChurnChecker(),
         DtypeDriftChecker(),
         LockDisciplineChecker(),
+        TopologyMathChecker(),
+        BootstrapWiringChecker(),
+        EnvKnobValidityChecker(),
+        ConfigSchemaChecker(),
+        ChartParityChecker(),
     ]
 
 
@@ -270,13 +445,34 @@ def run_analysis(
     root: Optional[str] = None,
     rules: Optional[Iterable[str]] = None,
     checkers: Optional[Sequence[Checker]] = None,
+    layer: str = "all",
 ) -> List[Finding]:
     """Parse ``paths``, run the (optionally filtered) checker set, and
     return suppression-filtered findings sorted by location. Parse
-    failures surface as TPU000 errors rather than crashing the run."""
+    failures surface as TPU000 errors rather than crashing the run.
+
+    ``layer`` selects the scan set: "python" parses ``paths`` and runs
+    the ast rules, "deploy" parses ``deploy/`` under the root and runs
+    TPU010-014, "all" (default) does both. The deploy layer degrades
+    to nothing (with no error) when pyyaml is absent and layer="all";
+    requesting layer="deploy" without pyyaml raises ValueError.
+    """
+    if layer not in LAYERS:
+        raise ValueError(f"unknown layer {layer!r}; choose from {LAYERS}")
     root = root or find_repo_root(paths[0] if paths else ".")
-    files = collect_files(paths, root)
-    project = Project(files, root)
+    files = collect_files(paths, root) if layer != "deploy" else []
+    deploy_files: List = []
+    if layer != "python":
+        from tpufw.analysis import manifests
+
+        if manifests.yaml_available():
+            deploy_files = manifests.collect_deploy_files(root)
+        elif layer == "deploy":
+            raise ValueError(
+                "--layer deploy needs pyyaml to parse manifests "
+                "(pip install pyyaml)"
+            )
+    project = Project(files, root, deploy_files=deploy_files)
     checkers = list(checkers if checkers is not None else all_checkers())
     if rules is not None:
         want = set(rules)
@@ -284,6 +480,8 @@ def run_analysis(
         if unknown:
             raise ValueError(f"unknown rule(s): {sorted(unknown)}")
         checkers = [c for c in checkers if c.rule in want]
+    if layer != "all":
+        checkers = [c for c in checkers if c.layer == layer]
     findings: List[Finding] = []
     for f in files:
         if f.parse_error is not None:
@@ -300,9 +498,8 @@ def run_analysis(
             )
     for checker in checkers:
         for finding in checker.check(project):
-            src = project.file(finding.path)
-            if src is not None and src.is_suppressed(
-                finding.rule, finding.line
+            if project.is_suppressed(
+                finding.rule, finding.path, finding.line
             ):
                 continue
             findings.append(finding)

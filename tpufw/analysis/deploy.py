@@ -1,0 +1,919 @@
+"""TPU010-TPU014 — cross-layer deployment rules.
+
+These rules statically verify the ``kubectl apply`` path against the
+python tree: the YAML a cluster operator applies encodes arithmetic
+(topology products, chip counts, mesh factorizations), wiring (the env
+vars ``tpufw.cluster.bootstrap`` keys its tier detection on), and
+schema (``TrainerConfig`` field names, the docs/ENV.md knob catalog)
+that nothing checks until a multi-hour reservation is already burning.
+Every contract checked here is read from the live python tree via
+``Project.parse_doc``/``read_doc`` — not duplicated into the linter —
+so the rules drift with the code, and fire loudly (contract-drift
+warnings) when a contract module stops looking like itself.
+
+- TPU010 topology math: ``google.com/tpu`` limits x workers vs the
+  ``gke-tpu-topology`` product vs the generation's chips-per-host
+  ceiling (tpufw/utils/hardware.py), TPUFW_MESH_* products vs chip
+  counts, and config-vs-manifest pairing drift.
+- TPU011 bootstrap wiring: multi-host JobSets must supply exactly the
+  inputs one of bootstrap.py's tiers needs (downward-API fields,
+  TPUFW_WORKERS_PER_SLICE, a resolvable coordinator address).
+- TPU012 env-knob validity: every literal TPUFW_* in manifests, the
+  rendered chart, and the Dockerfile must exist in the docs/ENV.md
+  catalog and type-check against its declared type.
+- TPU013 config schema: deploy/configs fields vs the real dataclasses,
+  plus an analytic HBM-fit pre-check (tpufw.tools.estimate_memory)
+  when jax/numpy are importable.
+- TPU014 chart/manifest parity: a template or manifest that fails to
+  render/parse is itself a finding — and rendered chart docs flow
+  through TPU010-012 like any manifest, so chart and raw manifests are
+  held to the same rules.
+"""
+# tpulint: disable-file=TPU004 — like cluster/bootstrap.py, this module
+# IS the contract checker: the TPUFW_* literals below are rule data
+# (mesh-axis names, bootstrap markers, enum tables) quoted to verify
+# manifests, not env reads, and the dict lookups TPU004's envish
+# heuristic flags here operate on parsed YAML env blocks, not
+# os.environ.
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set
+
+from tpufw.analysis import manifests as mf
+from tpufw.analysis.core import Checker, Finding, Project
+from tpufw.analysis.envreg import _edit_distance_1
+from tpufw.utils.hardware import CHIP_SPECS
+
+#: GKE accelerator nodeSelector value -> chip generation key.
+ACCELERATOR_GENERATIONS = {
+    "tpu-v5-lite-podslice": "v5e",
+    "tpu-v5-lite-device": "v5e",
+    "tpu-v5p-slice": "v5p",
+    "tpu-v4-podslice": "v4",
+    "tpu-v6e-slice": "v6e",
+}
+
+SELECTOR_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+SELECTOR_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+TPU_RESOURCE = "google.com/tpu"
+
+#: Mesh-axis env names (tpufw/configs/loader.py _MESH_ENV) whose
+#: product — x TPUFW_PIPE_STAGES — must equal the workload chip count.
+MESH_ENV_NAMES = (
+    "TPUFW_MESH_DATA",
+    "TPUFW_MESH_PIPE",
+    "TPUFW_MESH_FSDP",
+    "TPUFW_MESH_EXPERT",
+    "TPUFW_MESH_SEQUENCE",
+    "TPUFW_MESH_TENSOR",
+    "TPUFW_MESH_DCN_DATA",
+)
+
+BOOTSTRAP_MODULE = "tpufw/cluster/bootstrap.py"
+LOADER_MODULE = "tpufw/configs/loader.py"
+
+#: HBM-fit slack: estimate_train is a first-order model; the bench
+#: config measures 46% MFU at an estimated 1.015x HBM, so only flag
+#: configs whose estimate exceeds capacity by more than 10%.
+HBM_SLACK = 1.1
+
+
+def _topology_product(topo: Any) -> Optional[int]:
+    """'4x4' / '2x2x8' -> product; None when not that shape."""
+    if not isinstance(topo, str):
+        return None
+    parts = topo.lower().split("x")
+    try:
+        dims = [int(p) for p in parts]
+    except ValueError:
+        return None
+    if not dims or any(d < 1 for d in dims):
+        return None
+    out = 1
+    for d in dims:
+        out *= d
+    return out
+
+
+def _dfinding(
+    checker: Checker,
+    df: "mf.DeployFile",
+    line: int,
+    message: str,
+    symbol: str,
+    severity: Optional[str] = None,
+) -> Finding:
+    return Finding(
+        rule=checker.rule,
+        path=df.relpath,
+        line=line,
+        col=1,
+        message=message,
+        severity=severity or checker.severity,
+        symbol=symbol,
+    )
+
+
+def _dedupe(findings: Iterator[Finding]) -> Iterator[Finding]:
+    """Drop key-duplicates — the two chart render passes revisit the
+    same template, and baseline keys must stay unique anyway."""
+    seen: Set[str] = set()
+    for f in findings:
+        k = f.key()
+        if k not in seen:
+            seen.add(k)
+            yield f
+
+
+def _stem(relpath: str) -> str:
+    base = relpath.rsplit("/", 1)[-1]
+    return base.rsplit(".", 1)[0]
+
+
+def _workload_files(project: Project) -> List["mf.DeployFile"]:
+    return [
+        df for df in project.deploy_files
+        if df.kind in ("manifest", "rendered")
+    ]
+
+
+# ------------------------------------------------------------- TPU010
+
+class TopologyMathChecker(Checker):
+    """Chip arithmetic across manifests, chart, and configs."""
+
+    rule = "TPU010"
+    name = "topology-math"
+    severity = "error"
+    layer = "deploy"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        yield from _dedupe(self._check(project))
+
+    def _check(self, project: Project) -> Iterator[Finding]:
+        # (stem -> (chips, topology)) per side, for pairing drift.
+        manifest_shapes: Dict[str, tuple] = {}
+        config_shapes: Dict[str, tuple] = {}
+
+        for df in _workload_files(project):
+            for doc in df.docs:
+                for w in mf.iter_workloads(doc):
+                    yield from self._check_workload(df, w)
+                    topo = w.node_selector().get(SELECTOR_TOPOLOGY)
+                    chips = w.tpu_limit(TPU_RESOURCE) * w.workers
+                    if chips and df.kind == "manifest":
+                        manifest_shapes.setdefault(
+                            _stem(df.relpath), (chips, topo, df, w.name)
+                        )
+
+        for df in project.deploy_matching(mf.CONFIG_DIR):
+            doc = df.docs[0] if df.docs else None
+            if isinstance(doc, dict):
+                yield from self._check_config(df, doc)
+                hw = doc.get("hardware") or {}
+                if isinstance(hw, dict):
+                    hosts = mf._as_int(hw.get("hosts", 1)) or 1
+                    cph = mf._as_int(hw.get("chips_per_host", 1)) or 1
+                    config_shapes[_stem(df.relpath)] = (
+                        hosts * cph, hw.get("topology"), df
+                    )
+
+        yield from self._check_pairs(manifest_shapes, config_shapes)
+
+    # ---- one pod workload (manifest or rendered chart doc)
+
+    def _check_workload(
+        self, df: "mf.DeployFile", w: "mf.PodWorkload"
+    ) -> Iterator[Finding]:
+        tpu = w.tpu_limit(TPU_RESOURCE)
+        sel = w.node_selector()
+        accel = sel.get(SELECTOR_ACCELERATOR)
+        topo = sel.get(SELECTOR_TOPOLOGY)
+
+        if tpu == 0 and topo is None:
+            return  # not a TPU workload
+
+        if (tpu > 1 or w.workers > 1) and (accel is None or topo is None):
+            yield _dfinding(
+                self, df, df.find_line(w.name),
+                f"{w.kind} {w.name!r} requests {tpu} {TPU_RESOURCE} chip(s)"
+                f" x {w.workers} worker(s) but its pod template lacks a "
+                f"{SELECTOR_ACCELERATOR}/{SELECTOR_TOPOLOGY} nodeSelector "
+                "— the scheduler cannot place it on a matching slice",
+                symbol=f"selector:{w.name}",
+            )
+
+        gen = None
+        if accel is not None:
+            gen = ACCELERATOR_GENERATIONS.get(str(accel))
+            if gen is None:
+                yield _dfinding(
+                    self, df, df.find_line(str(accel)),
+                    f"unknown accelerator label {accel!r} on {w.name!r} — "
+                    f"known: {sorted(ACCELERATOR_GENERATIONS)}",
+                    symbol=f"accelerator:{w.name}",
+                )
+            else:
+                spec = CHIP_SPECS[gen]
+                if tpu > spec.chips_per_host:
+                    yield _dfinding(
+                        self, df, df.find_line(TPU_RESOURCE),
+                        f"{w.kind} {w.name!r} requests {tpu} "
+                        f"{TPU_RESOURCE} per pod but {gen} hosts top out "
+                        f"at {spec.chips_per_host} chips — the pod can "
+                        "never schedule",
+                        symbol=f"chips-per-host:{w.name}",
+                    )
+
+        if topo is not None:
+            prod = _topology_product(topo)
+            if prod is None:
+                yield _dfinding(
+                    self, df, df.find_line(str(topo)),
+                    f"unparseable {SELECTOR_TOPOLOGY} {topo!r} on "
+                    f"{w.name!r} (want AxB or AxBxC)",
+                    symbol=f"topology-syntax:{w.name}",
+                )
+            elif tpu and prod != tpu * w.workers:
+                yield _dfinding(
+                    self, df, df.find_line(str(topo)),
+                    f"{w.kind} {w.name!r}: topology {topo} = {prod} chips"
+                    f" but the workload covers {tpu} {TPU_RESOURCE} x "
+                    f"{w.workers} worker(s) = {tpu * w.workers} — slice "
+                    "shape and chip math disagree",
+                    symbol=f"topology:{w.name}",
+                )
+
+        if (
+            w.kind == "JobSet"
+            and w.completions is not None
+            and w.completions != w.parallelism
+        ):
+            yield _dfinding(
+                self, df, df.find_line("completions"),
+                f"JobSet {w.name!r}: completions={w.completions} != "
+                f"parallelism={w.parallelism} — a TPU slice job needs "
+                "every worker pod, one per host",
+                symbol=f"completions:{w.name}",
+            )
+
+        yield from self._check_mesh_env(df, w, tpu * w.workers)
+
+    def _check_mesh_env(
+        self, df: "mf.DeployFile", w: "mf.PodWorkload", chips: int
+    ) -> Iterator[Finding]:
+        if not chips:
+            return
+        env = w.env_map()
+        product = 1
+        saw_any = False
+        for name in MESH_ENV_NAMES:
+            val = env.get(name)
+            if not isinstance(val, str):
+                continue
+            iv = mf._as_int(val)
+            if iv is None:
+                continue  # TPU012's problem, not arithmetic
+            if iv == -1:
+                return  # a fill axis absorbs the remainder; no product
+            saw_any = True
+            product *= max(1, iv)
+        stages = env.get("TPUFW_PIPE_STAGES")
+        if isinstance(stages, str) and (mf._as_int(stages) or 0) > 1:
+            saw_any = True
+            product *= mf._as_int(stages)
+        # Unset axes default to 1 except fsdp (-1, fill) — so an env
+        # block that never pins fsdp can still absorb the remainder.
+        if not saw_any or "TPUFW_MESH_FSDP" not in env:
+            return
+        if product != chips:
+            yield _dfinding(
+                self, df, df.find_line("TPUFW_MESH_FSDP"),
+                f"{w.kind} {w.name!r}: TPUFW_MESH_* x pipe stages "
+                f"factorize to {product} devices but the workload "
+                f"provides {chips} chips — jax.make_mesh will raise at "
+                "startup",
+                symbol=f"mesh-product:{w.name}",
+            )
+
+    # ---- one run config (deploy/configs/*.yaml)
+
+    def _check_config(
+        self, df: "mf.DeployFile", doc: dict
+    ) -> Iterator[Finding]:
+        hw = doc.get("hardware")
+        if not isinstance(hw, dict):
+            return
+        slice_name = str(hw.get("slice", ""))
+        hosts = mf._as_int(hw.get("hosts", 1)) or 1
+        cph = mf._as_int(hw.get("chips_per_host", 1)) or 1
+        n_chips = hosts * cph
+        stem = _stem(df.relpath)
+
+        gen, _, suffix = slice_name.partition("-")
+        spec = CHIP_SPECS.get(gen)
+        if spec is None:
+            yield _dfinding(
+                self, df, df.find_line("slice"),
+                f"hardware.slice {slice_name!r}: unknown generation "
+                f"{gen!r} (known: {sorted(CHIP_SPECS)})",
+                symbol=f"slice-generation:{stem}",
+            )
+        else:
+            declared = mf._as_int(suffix)
+            if declared is not None and declared != n_chips:
+                yield _dfinding(
+                    self, df, df.find_line("slice"),
+                    f"hardware.slice {slice_name!r} names {declared} "
+                    f"chips but hosts x chips_per_host = "
+                    f"{hosts} x {cph} = {n_chips}",
+                    symbol=f"slice-chips:{stem}",
+                )
+            if cph > spec.chips_per_host:
+                yield _dfinding(
+                    self, df, df.find_line("chips_per_host"),
+                    f"hardware.chips_per_host={cph} exceeds the largest "
+                    f"{gen} host ({spec.chips_per_host} chips)",
+                    symbol=f"chips-per-host:{stem}",
+                )
+
+        topo = hw.get("topology")
+        if topo is not None:
+            prod = _topology_product(topo)
+            if prod is not None and prod != n_chips:
+                yield _dfinding(
+                    self, df, df.find_line("topology"),
+                    f"hardware.topology {topo} = {prod} chips but the "
+                    f"slice has {n_chips}",
+                    symbol=f"topology:{stem}",
+                )
+
+        mesh = doc.get("mesh")
+        if isinstance(mesh, dict):
+            vals = [mf._as_int(v) for v in mesh.values()]
+            if all(v is not None for v in vals) and -1 not in vals:
+                product = 1
+                for v in vals:
+                    product *= max(1, v)
+                pipeline = doc.get("pipeline")
+                if (
+                    isinstance(pipeline, dict)
+                    and "pipe" not in mesh
+                    and (mf._as_int(pipeline.get("n_stages")) or 0) > 1
+                ):
+                    product *= mf._as_int(pipeline.get("n_stages"))
+                if product != n_chips:
+                    yield _dfinding(
+                        self, df, df.find_line("mesh"),
+                        f"mesh axes factorize to {product} devices but "
+                        f"hardware declares {n_chips} chips "
+                        f"({slice_name}) — the loader will reject this "
+                        "at run start",
+                        symbol=f"mesh-product:{stem}",
+                    )
+
+    # ---- config <-> manifest pairing (NN-name stems of record)
+
+    def _check_pairs(
+        self,
+        manifest_shapes: Dict[str, tuple],
+        config_shapes: Dict[str, tuple],
+    ) -> Iterator[Finding]:
+        for mstem, (mchips, mtopo, mdf, wname) in sorted(
+            manifest_shapes.items()
+        ):
+            cstem = mstem[: -len("-jobset")] if mstem.endswith(
+                "-jobset"
+            ) else mstem
+            got = config_shapes.get(cstem) or config_shapes.get(mstem)
+            if got is None:
+                continue
+            cchips, ctopo, cdf = got
+            if mchips != cchips:
+                yield _dfinding(
+                    self, mdf, mdf.find_line(TPU_RESOURCE),
+                    f"manifest workload {wname!r} covers {mchips} chips "
+                    f"but its config of record ({cdf.relpath}) declares "
+                    f"{cchips} — the two halves of the recipe drifted",
+                    symbol=f"pair-chips:{cstem}",
+                )
+            if (
+                mtopo is not None
+                and ctopo is not None
+                and str(mtopo) != str(ctopo)
+            ):
+                yield _dfinding(
+                    self, mdf, mdf.find_line(str(mtopo)),
+                    f"manifest workload {wname!r} pins topology {mtopo} "
+                    f"but its config of record ({cdf.relpath}) says "
+                    f"{ctopo}",
+                    symbol=f"pair-topology:{cstem}",
+                )
+
+
+# ------------------------------------------------------------- TPU011
+
+#: Markers whose disappearance from bootstrap.py means the tier
+#: contract this rule encodes has drifted — warn rather than guess.
+BOOTSTRAP_MARKERS = (
+    "TPUFW_COORDINATOR",
+    "TPUFW_NUM_PROCESSES",
+    "JOBSET_NAME",
+    "JOB_COMPLETION_INDEX",
+    "TPUFW_WORKERS_PER_SLICE",
+    "TPUFW_COORDINATOR_SVC",
+    "TPUFW_COORDINATOR_PORT",
+    "REPLICATED_JOB_NAME",
+)
+
+
+class BootstrapWiringChecker(Checker):
+    """Multi-host JobSets must feed one of bootstrap.py's tiers."""
+
+    rule = "TPU011"
+    name = "bootstrap-wiring"
+    severity = "error"
+    layer = "deploy"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        yield from _dedupe(self._check(project))
+
+    def _check(self, project: Project) -> Iterator[Finding]:
+        saw_multihost = False
+        services = mf.service_names(project.deploy_files)
+        for df in _workload_files(project):
+            for doc in df.docs:
+                for w in mf.iter_workloads(doc):
+                    if w.kind != "JobSet" or not w.is_multihost:
+                        continue
+                    saw_multihost = True
+                    yield from self._check_jobset(df, w, services)
+        if saw_multihost:
+            yield from self._check_contract(project)
+
+    def _check_jobset(
+        self,
+        df: "mf.DeployFile",
+        w: "mf.PodWorkload",
+        services: Set[str],
+    ) -> Iterator[Finding]:
+        env = w.env_map()
+        line = df.find_line(w.name)
+
+        if "TPUFW_COORDINATOR" in env:
+            # Explicit tier: address given, process count mandatory.
+            if "TPUFW_NUM_PROCESSES" not in env:
+                yield _dfinding(
+                    self, df, line,
+                    f"JobSet {w.name!r} sets TPUFW_COORDINATOR without "
+                    "TPUFW_NUM_PROCESSES — bootstrap's explicit tier "
+                    "raises ValueError on that combination",
+                    symbol=f"explicit-num-processes:{w.name}",
+                )
+            return
+
+        # JobSet tier: downward-API + per-slice worker count.
+        if str(w.completion_mode) != "Indexed":
+            yield _dfinding(
+                self, df, line,
+                f"JobSet {w.name!r} runs {w.workers} workers without "
+                "completionMode: Indexed — JOB_COMPLETION_INDEX is only "
+                "injected for indexed jobs, so process ids collapse",
+                symbol=f"completion-mode:{w.name}",
+            )
+
+        for name, annotation in (
+            ("JOBSET_NAME", "jobset-name"),
+            ("JOB_COMPLETION_INDEX", "job-completion-index"),
+        ):
+            got = env.get(name)
+            if got is None:
+                yield _dfinding(
+                    self, df, line,
+                    f"JobSet {w.name!r} never injects {name} — "
+                    "bootstrap's jobset tier cannot trigger and the "
+                    "workers fall through to single-process",
+                    symbol=f"missing-env:{w.name}:{name}",
+                )
+            elif isinstance(got, dict) and annotation not in str(got):
+                yield _dfinding(
+                    self, df, df.find_line(name),
+                    f"JobSet {w.name!r}: {name} comes from a downward-"
+                    f"API field that does not reference {annotation!r} "
+                    "— wrong fieldPath",
+                    symbol=f"fieldpath:{w.name}:{name}",
+                    severity="warning",
+                )
+
+        wps = env.get("TPUFW_WORKERS_PER_SLICE")
+        if wps is None:
+            yield _dfinding(
+                self, df, line,
+                f"JobSet {w.name!r} omits TPUFW_WORKERS_PER_SLICE — "
+                "bootstrap's jobset tier raises ValueError without it",
+                symbol=f"missing-env:{w.name}:TPUFW_WORKERS_PER_SLICE",
+            )
+        elif isinstance(wps, str):
+            ival = mf._as_int(wps)
+            if ival is not None and ival != w.parallelism:
+                yield _dfinding(
+                    self, df, df.find_line("TPUFW_WORKERS_PER_SLICE"),
+                    f"JobSet {w.name!r}: TPUFW_WORKERS_PER_SLICE={ival} "
+                    f"but parallelism={w.parallelism} — process counts "
+                    "will disagree with pod counts",
+                    symbol=f"workers-per-slice:{w.name}",
+                )
+
+        if "REPLICATED_JOB_NAME" not in env:
+            # bootstrap falls back to 'worker' when unset; only safe if
+            # that is actually the replicated job's name.
+            matches = w.replicated_job_name == "worker"
+            yield _dfinding(
+                self, df, line,
+                f"JobSet {w.name!r} does not inject REPLICATED_JOB_NAME;"
+                f" bootstrap assumes 'worker' but the replicated job is "
+                f"named {w.replicated_job_name!r}"
+                + (" (matches — informational)" if matches else
+                   " — the coordinator DNS name will not resolve"),
+                symbol=f"replicated-job-name:{w.name}",
+                severity="warning" if matches else "error",
+            )
+
+        svc = env.get("TPUFW_COORDINATOR_SVC")
+        if isinstance(svc, str) and svc:
+            if services and svc not in services:
+                yield _dfinding(
+                    self, df, df.find_line("TPUFW_COORDINATOR_SVC"),
+                    f"JobSet {w.name!r}: TPUFW_COORDINATOR_SVC={svc!r} "
+                    "matches no Service in the deploy set",
+                    symbol=f"coordinator-svc:{w.name}",
+                )
+        else:
+            net = (w.jobset or {}).get("spec", {}).get("network") or {}
+            if not net.get("enableDNSHostnames"):
+                yield _dfinding(
+                    self, df, line,
+                    f"JobSet {w.name!r} relies on per-pod DNS for the "
+                    "coordinator address but does not set "
+                    "spec.network.enableDNSHostnames: true",
+                    symbol=f"dns-hostnames:{w.name}",
+                )
+
+        port = 8476
+        port_env = env.get("TPUFW_COORDINATOR_PORT")
+        if isinstance(port_env, str) and mf._as_int(port_env) is not None:
+            port = mf._as_int(port_env)
+        ports = w.container_ports()
+        if ports and port not in ports:
+            yield _dfinding(
+                self, df, df.find_line("containerPort"),
+                f"JobSet {w.name!r}: coordinator port {port} is not "
+                f"among the declared containerPorts {sorted(ports)}",
+                symbol=f"coordinator-port:{w.name}",
+                severity="warning",
+            )
+
+    def _check_contract(self, project: Project) -> Iterator[Finding]:
+        text = project.read_doc(BOOTSTRAP_MODULE)
+        if text is None:
+            return  # fixture trees without the module: nothing to drift
+        missing = [m for m in BOOTSTRAP_MARKERS if m not in text]
+        for marker in missing:
+            yield Finding(
+                rule=self.rule,
+                path=BOOTSTRAP_MODULE,
+                line=1,
+                col=1,
+                message=(
+                    f"{BOOTSTRAP_MODULE} no longer mentions {marker!r} "
+                    "— the bootstrap tier contract TPU011 encodes has "
+                    "drifted; update the rule or the module"
+                ),
+                severity="warning",
+                symbol=f"contract-drift:{marker}",
+            )
+
+
+# ------------------------------------------------------------- TPU012
+
+#: Knobs whose legal values are a closed set the type column cannot
+#: express. Empty string = knob off where the reader treats it so.
+ENV_ENUMS: Dict[str, Set[str]] = {
+    "TPUFW_ATTENTION": {"flash", "ring", "reference", ""},
+    "TPUFW_PIPE_SCHEDULE": {"gpipe", "1f1b", "interleaved", "zb1"},
+    "TPUFW_PIPELINE_SCHEDULE": {"gpipe", "1f1b", "interleaved", "zb1"},
+    "TPUFW_QUANTIZE": {"", "int8"},
+    "TPUFW_SERVE_KV_QUANT": {"", "int8"},
+    "TPUFW_POOLING": {"mean", "last", "cls"},
+}
+
+_BOOL_WORDS = {"1", "true", "yes", "on", "0", "false", "no", "off", ""}
+
+
+def _value_ok(type_str: str, value: str) -> bool:
+    t = type_str.strip().lower()
+    if t == "int":
+        return mf._as_int(value) is not None
+    if t == "float":
+        try:
+            float(value)
+            return True
+        except ValueError:
+            return False
+    if t == "bool":
+        return value.lower() in _BOOL_WORDS
+    if t == "bool/int":
+        return (
+            value.lower() in _BOOL_WORDS or mf._as_int(value) is not None
+        )
+    if t == "opt int":
+        return value == "" or mf._as_int(value) is not None
+    # str / opt str / anything exotic: any string is legal.
+    return True
+
+
+class EnvKnobValidityChecker(Checker):
+    """Literal TPUFW_* env assignments must be real, typed knobs."""
+
+    rule = "TPU012"
+    name = "env-knob-validity"
+    severity = "error"
+    layer = "deploy"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        yield from _dedupe(self._check(project))
+
+    def _check(self, project: Project) -> Iterator[Finding]:
+        catalog = project.env_catalog()
+        known = catalog.catalog_names | set(catalog.entries)
+        if not known:
+            return  # no catalog (fixture tree) — nothing to validate
+        for df in project.deploy_files:
+            if df.kind in ("manifest", "rendered"):
+                for doc in df.docs:
+                    for w in mf.iter_workloads(doc):
+                        for e in w.env_entries():
+                            name = e.get("name")
+                            if not (
+                                isinstance(name, str)
+                                and name.startswith("TPUFW_")
+                            ):
+                                continue
+                            if "value" not in e:
+                                continue  # downward API: no literal
+                            yield from self._check_one(
+                                df, name, e["value"], catalog, known
+                            )
+            elif df.kind == "dockerfile":
+                for name, value, line in mf.dockerfile_env(df):
+                    if name.startswith("TPUFW_"):
+                        yield from self._check_one(
+                            df, name, value, catalog, known, line=line
+                        )
+
+    def _check_one(
+        self,
+        df: "mf.DeployFile",
+        name: str,
+        value: Any,
+        catalog,
+        known: Set[str],
+        line: Optional[int] = None,
+    ) -> Iterator[Finding]:
+        line = line if line is not None else df.find_line(name)
+        if name not in known:
+            near = sorted(
+                k for k in known if _edit_distance_1(name, k)
+            )
+            hint = f" — did you mean {near[0]}?" if near else ""
+            yield _dfinding(
+                self, df, line,
+                f"{name} is not in the docs/ENV.md catalog; the reader "
+                f"will silently ignore it{hint}",
+                symbol=f"unknown:{name}",
+            )
+            return
+        if not isinstance(value, str):
+            yield _dfinding(
+                self, df, line,
+                f"{name}: env value {value!r} is a YAML "
+                f"{type(value).__name__}, not a string — kubectl apply "
+                "rejects non-string env values; quote it",
+                symbol=f"unquoted:{name}",
+            )
+            value = str(value)
+        knob = catalog.entries.get(name)
+        if knob is not None and not _value_ok(knob.type, value):
+            yield _dfinding(
+                self, df, line,
+                f"{name}={value!r} does not parse as the catalog type "
+                f"{knob.type!r} — the typed env reader will raise at "
+                "startup",
+                symbol=f"type:{name}",
+            )
+            return
+        allowed = ENV_ENUMS.get(name)
+        if allowed is not None and isinstance(value, str):
+            if value not in allowed:
+                yield _dfinding(
+                    self, df, line,
+                    f"{name}={value!r} is not a legal value "
+                    f"({sorted(v for v in allowed if v)})",
+                    symbol=f"enum:{name}",
+                )
+
+
+# ------------------------------------------------------------- TPU013
+
+#: Config section -> (module, dataclass) whose field names bound the
+#: legal keys. Read from the live tree at check time via parse_doc.
+SECTION_CONTRACTS = {
+    "trainer": ("tpufw/train/trainer.py", "TrainerConfig"),
+    "trainer/vision": ("tpufw/train/vision.py", "VisionTrainerConfig"),
+    "mesh": ("tpufw/mesh/mesh.py", "MeshConfig"),
+    "pipeline": ("tpufw/parallel/pipeline.py", "PipelineConfig"),
+    "hardware": ("tpufw/configs/loader.py", "HardwareConfig"),
+}
+
+TOP_LEVEL_KEYS = {"name", "hardware", "model", "trainer", "mesh",
+                  "pipeline"}
+MODEL_KEYS = {"preset", "overrides"}
+
+
+def _dataclass_fields(
+    project: Project, relpath: str, classname: str
+) -> Optional[Set[str]]:
+    """Annotated field names of a (data)class, by ast — None when the
+    module/class is absent (fixture trees: skip the check)."""
+    tree = project.parse_doc(relpath)
+    if tree is None:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == classname:
+            out: Set[str] = set()
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    out.add(stmt.target.id)
+            return out or None
+    return None
+
+
+class ConfigSchemaChecker(Checker):
+    """deploy/configs fields vs the real dataclasses + HBM pre-check."""
+
+    rule = "TPU013"
+    name = "config-schema"
+    severity = "error"
+    layer = "deploy"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        yield from _dedupe(self._check(project))
+
+    def _check(self, project: Project) -> Iterator[Finding]:
+        for df in project.deploy_matching(mf.CONFIG_DIR):
+            doc = df.docs[0] if df.docs else None
+            if not isinstance(doc, dict):
+                continue
+            stem = _stem(df.relpath)
+            yield from self._check_schema(project, df, doc, stem)
+            yield from self._check_hbm(project, df, doc, stem)
+
+    def _check_schema(
+        self, project: Project, df: "mf.DeployFile", doc: dict, stem: str
+    ) -> Iterator[Finding]:
+        for key in sorted(set(doc) - TOP_LEVEL_KEYS):
+            yield _dfinding(
+                self, df, df.find_line(f"{key}:"),
+                f"unknown top-level key {key!r} (allowed: "
+                f"{sorted(TOP_LEVEL_KEYS)}) — load_run_config rejects "
+                "the file",
+                symbol=f"key:{key}",
+            )
+        model = doc.get("model")
+        preset = ""
+        if isinstance(model, dict):
+            preset = str(model.get("preset", ""))
+            for key in sorted(set(model) - MODEL_KEYS):
+                yield _dfinding(
+                    self, df, df.find_line(f"{key}:"),
+                    f"unknown model key {key!r} (allowed: "
+                    f"{sorted(MODEL_KEYS)})",
+                    symbol=f"model-key:{key}",
+                )
+        for section in ("hardware", "mesh", "pipeline", "trainer"):
+            given = doc.get(section)
+            if not isinstance(given, dict):
+                continue
+            contract = section
+            if section == "trainer" and preset == "resnet50":
+                contract = "trainer/vision"
+            relpath, classname = SECTION_CONTRACTS[contract]
+            fields = _dataclass_fields(project, relpath, classname)
+            if fields is None:
+                continue  # contract module unavailable: skip silently
+            for key in sorted(set(given) - fields):
+                yield _dfinding(
+                    self, df, df.find_line(f"{key}:"),
+                    f"{section}.{key} is not a field of "
+                    f"{classname} ({relpath}) — load_run_config "
+                    "rejects the file",
+                    symbol=f"{section}-key:{key}",
+                )
+
+    def _check_hbm(
+        self, project: Project, df: "mf.DeployFile", doc: dict, stem: str
+    ) -> Iterator[Finding]:
+        """Analytic fit pre-check. Pipeline runs are skipped (the
+        estimator has no stage model) and so is resnet50 (vision
+        trainer, different activation shape) — documented limitation.
+        Needs numpy/jax importable; degrades to nothing without them,
+        so the deploy-lint CI job (pyyaml only) runs the schema half
+        and a dev box runs both."""
+        model = doc.get("model")
+        if not isinstance(model, dict):
+            return
+        if str(model.get("preset", "")) == "resnet50":
+            return
+        if isinstance(doc.get("pipeline"), dict):
+            return
+        hw = doc.get("hardware")
+        if not isinstance(hw, dict):
+            return
+        gen = str(hw.get("slice", "")).partition("-")[0]
+        spec = CHIP_SPECS.get(gen)
+        if spec is None:
+            return
+        try:
+            import os as _os
+
+            from tpufw.configs.loader import load_run_config
+            from tpufw.tools.estimate_memory import estimate_train
+
+            run = load_run_config(_os.path.join(project.root, df.relpath))
+            n_chips = run.hardware.n_chips
+            per_slice = max(1, n_chips // max(1, run.mesh.dcn_data))
+            sizes = run.mesh.sizes(per_slice)
+            # Shard degree = everything that is not pure data
+            # parallelism (fsdp x expert x sequence x tensor): MoE
+            # params shard over the expert axis too, so fsdp alone
+            # wildly overstates the per-chip footprint.
+            n_shards = max(1, per_slice // max(1, sizes.get("data", 1)))
+            est = estimate_train(
+                run.model_cfg,
+                run.trainer.batch_size,
+                run.trainer.seq_len,
+                n_shards=n_shards,
+                remat_policy=getattr(run.model_cfg, "remat_policy", None),
+                loss_chunk_size=getattr(
+                    run.trainer, "loss_chunk_size", None
+                ),
+                adam_mu_dtype=getattr(run.trainer, "adam_mu_dtype", None),
+                grad_accum=getattr(run.trainer, "grad_accum", 1) or 1,
+            )
+            total = est.total()
+        except Exception:
+            return  # no jax/numpy (deploy-lint CI), or loader rejected
+            # the file — the schema checks above own that failure.
+        if total > HBM_SLACK * spec.hbm_bytes:
+            gib = total / 2**30
+            cap = spec.hbm_bytes / 2**30
+            yield _dfinding(
+                self, df, df.find_line("batch_size"),
+                f"estimated training footprint {gib:.1f} GiB/chip "
+                f"exceeds {gen} HBM {cap:.0f} GiB by more than "
+                f"{HBM_SLACK:.0%} — this run OOMs at startup; shrink "
+                "batch/seq, raise sharding, or set remat/loss-chunk "
+                "knobs (see tpufw.tools.estimate_memory)",
+                symbol=f"hbm:{stem}",
+            )
+
+
+# ------------------------------------------------------------- TPU014
+
+class ChartParityChecker(Checker):
+    """Render/parse failures are findings; parity with raw manifests
+    comes from rendered docs flowing through TPU010-012."""
+
+    rule = "TPU014"
+    name = "chart-parity"
+    severity = "error"
+    layer = "deploy"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        yield from _dedupe(self._check(project))
+
+    def _check(self, project: Project) -> Iterator[Finding]:
+        for df in project.deploy_files:
+            if not df.parse_error:
+                continue
+            kind = "render" if df.kind == "rendered" else "parse"
+            yield _dfinding(
+                self, df, 1,
+                f"{df.relpath} failed to {kind}: {df.parse_error} — "
+                "nothing downstream of this file was checked",
+                symbol=f"{kind}:{df.relpath}",
+            )

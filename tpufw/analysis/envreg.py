@@ -25,10 +25,16 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Dict, Iterator, List, Set, Tuple
+from typing import Dict, Iterator, List, Tuple
 
 from tpufw.analysis import callgraph as cg
-from tpufw.analysis.core import Checker, Finding, Project, SourceFile
+from tpufw.analysis.core import (
+    Checker,
+    Finding,
+    Project,
+    SourceFile,
+    deploy_text_env_names,
+)
 
 ENV_HELPERS = {
     "env_str",
@@ -39,18 +45,11 @@ ENV_HELPERS = {
     "env_opt_str",
 }
 ENV_MODULE = "tpufw/workloads/env.py"
+# Doc-page parsing is single-sourced in core.load_env_catalog (shared
+# with TPU012); CATALOG_DOC stays as the name findings point at.
 CATALOG_DOC = "docs/ENV.md"
-DOC_PAGES = (
-    "docs/ENV.md",
-    "docs/OBSERVABILITY.md",
-    "docs/PERF.md",
-    "docs/WORKFLOWS.md",
-    "docs/PARITY.md",
-    "README.md",
-)
 
 _NAME_RE = re.compile(r"^TPUFW_[A-Z0-9_]+$")
-_DOC_NAME_RE = re.compile(r"TPUFW_[A-Z0-9_]+")
 
 # Receiver names that look like an environment mapping.
 _ENVISH = {"environ", "env", "_env"}
@@ -164,9 +163,9 @@ class EnvRegistryChecker(Checker):
                 symbol=f"direct-read:{lit}",
             )
 
-        doc_names, catalog_names = self._doc_names(project)
+        catalog = project.env_catalog()
         for name in sorted(mentioned):
-            if name not in doc_names:
+            if name not in catalog.doc_names:
                 f, node = mentioned[name]
                 yield self.finding(
                     f,
@@ -176,7 +175,11 @@ class EnvRegistryChecker(Checker):
                     "discoverable by a manifest author",
                     symbol=f"undocumented:{name}",
                 )
-        for name in sorted(catalog_names - set(mentioned)):
+        # "Stale" = cataloged but used neither in python code nor in
+        # any deploy artifact (raw-text scan: works without pyyaml, so
+        # chart-only knobs don't read as stale under --layer python).
+        used = set(mentioned) | deploy_text_env_names(project.root)
+        for name in sorted(catalog.catalog_names - used):
             yield Finding(
                 rule=self.rule,
                 path=CATALOG_DOC,
@@ -212,17 +215,3 @@ class EnvRegistryChecker(Checker):
             if _NAME_RE.match(node.value):
                 return node.value
         return None
-
-    @staticmethod
-    def _doc_names(project: Project) -> Tuple[Set[str], Set[str]]:
-        doc_names: Set[str] = set()
-        catalog: Set[str] = set()
-        for page in DOC_PAGES:
-            text = project.read_doc(page)
-            if text is None:
-                continue
-            found = set(_DOC_NAME_RE.findall(text))
-            doc_names |= found
-            if page == CATALOG_DOC:
-                catalog |= found
-        return doc_names, catalog

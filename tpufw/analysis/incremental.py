@@ -74,23 +74,49 @@ def analyzer_signature() -> str:
     return h.hexdigest()[:20]
 
 
+def _deploy_hashes(root: str) -> dict:
+    """Per-file hashes of everything under deploy/ — the deploy layer's
+    scan set (manifests, configs, chart sources, Dockerfile). Hashing
+    chart *sources* rather than rendered output keeps the signature
+    cheap and still over-invalidates, never under."""
+    out: dict = {}
+    base = os.path.join(root, "deploy")
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = sorted(
+            d for d in dirnames if not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            ap = os.path.join(dirpath, fn)
+            rel = os.path.relpath(ap, root).replace(os.sep, "/")
+            out[rel] = _file_sha(ap)
+    return out
+
+
 def scan_signature(
     root: str,
     py_files: Sequence[tuple],
     rules: Optional[Sequence[str]],
+    layer: str = "all",
 ) -> dict:
     """Signature over everything the analysis observes. ``py_files``
     is :func:`core.iter_py_files` output — hashing raw bytes here is
-    what lets a cache hit skip parsing entirely."""
-    return {
+    what lets a cache hit skip parsing entirely. When the deploy layer
+    is in play the signature also covers every file under deploy/
+    (TPU013 additionally reads contract modules, but those live in the
+    python scan set / analysis package already hashed above)."""
+    sig = {
         "version": CACHE_VERSION,
         "analyzer": analyzer_signature(),
         "rules": sorted(rules) if rules is not None else "all",
+        "layer": layer,
         "docs": {
             d: _file_sha(os.path.join(root, d)) for d in _CONTEXT_DOCS
         },
         "files": {rel: _file_sha(ap) for ap, rel in py_files},
     }
+    if layer != "python":
+        sig["deploy"] = _deploy_hashes(root)
+    return sig
 
 
 def load_cached(path: str, signature: dict) -> Optional[List[Finding]]:

@@ -1,0 +1,401 @@
+"""Deploy-layer scan substrate for tpulint v3 (TPU010-TPU014).
+
+Parses everything the ``kubectl apply`` path consumes —
+``deploy/manifests/*.yaml``, ``deploy/configs/*.yaml``, the Helm chart
+``deploy/charts/tpu-stack`` (rendered through the same mini-renderer
+the chart tests use, :mod:`tpufw.utils.helm`), and
+``deploy/docker/Dockerfile`` — into :class:`DeployFile` objects the
+deploy checkers walk. Suppression reuses the core ``# tpulint:``
+comment grammar, which works as-is on YAML/Dockerfile comments.
+
+pyyaml is the one non-stdlib dependency of the deploy layer; it is
+imported lazily so the python layer keeps its zero-dependency
+guarantee. :func:`yaml_available` gates callers.
+
+The chart is rendered twice: once with default values, once with an
+overlay that flips every boolean branch the templates carry
+(``fakeDevices`` on, metrics/libtpu/validator off) so env vars inside
+``{{- if }}`` blocks are still seen. Conditionals beyond that overlay
+are a documented limitation (docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set
+
+from tpufw.analysis.core import scan_suppression_lines
+
+MANIFEST_DIR = "deploy/manifests"
+CONFIG_DIR = "deploy/configs"
+CHART_DIR = "deploy/charts/tpu-stack"
+DOCKERFILE = "deploy/docker/Dockerfile"
+
+#: The branch-flipping values overlay for the second chart render pass.
+CHART_ALT_VALUES = {
+    "fakeDevices": 2,
+    "metrics": {"enabled": False},
+    "libtpu": {"hostInstalled": False},
+    "validator": {"enabled": False},
+}
+
+_ENV_NAME_RE = re.compile(r"TPUFW_[A-Z0-9_]+")
+# Dockerfile ENV forms: `ENV A=1 B=2` and the legacy `ENV A 1`.
+_DOCKER_ENV_RE = re.compile(r"^\s*ENV\s+(.*)$", re.I)
+_DOCKER_PAIR_RE = re.compile(r"([A-Za-z_][A-Za-z0-9_]*)=(\"[^\"]*\"|\S+)")
+
+
+def yaml_available() -> bool:
+    try:
+        import yaml  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+class DeployFile:
+    """One parsed deploy artifact + its suppression table.
+
+    ``kind`` is one of "manifest", "config", "rendered" (a chart
+    template's rendered output), "dockerfile". ``variant`` tags the
+    chart render pass ("default"/"alt"); both variants share the
+    template's relpath so findings and suppressions anchor to the
+    source file a human would edit.
+    """
+
+    def __init__(
+        self,
+        relpath: str,
+        text: str,
+        kind: str,
+        variant: str = "",
+        parse_error: Optional[str] = None,
+        docs: Optional[List[Any]] = None,
+    ):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.kind = kind
+        self.variant = variant
+        self.parse_error = parse_error
+        self.docs: List[Any] = docs if docs is not None else []
+        self.file_suppressed, self.line_suppressed = scan_suppression_lines(
+            self.lines
+        )
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressed:
+            return True
+        return rule in self.line_suppressed.get(line, set())
+
+    def find_line(self, *needles: str) -> int:
+        """First 1-based line containing every needle — good-enough
+        anchoring for findings over parsed YAML (which drops line
+        info). Falls back to line 1."""
+        for i, line in enumerate(self.lines, start=1):
+            if all(n in line for n in needles):
+                return i
+        return 1
+
+    def env_names(self) -> Set[str]:
+        return set(_ENV_NAME_RE.findall(self.text))
+
+
+def _load_yaml_file(
+    root: str, relpath: str, kind: str
+) -> Optional[DeployFile]:
+    import yaml
+
+    path = os.path.join(root, relpath)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return None
+    try:
+        docs = [d for d in yaml.safe_load_all(text) if d is not None]
+        err = None
+    except yaml.YAMLError as e:
+        docs = []
+        err = f"yaml parse error: {e}"
+    return DeployFile(relpath, text, kind, parse_error=err, docs=docs)
+
+
+def _load_dockerfile(root: str) -> Optional[DeployFile]:
+    path = os.path.join(root, DOCKERFILE)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return None
+    return DeployFile(DOCKERFILE, text, "dockerfile")
+
+
+def dockerfile_env(df: DeployFile) -> Iterator[tuple[str, str, int]]:
+    """(name, value, line) for every Dockerfile ENV assignment."""
+    for i, line in enumerate(df.lines, start=1):
+        m = _DOCKER_ENV_RE.match(line)
+        if not m:
+            continue
+        rest = m.group(1)
+        pairs = _DOCKER_PAIR_RE.findall(rest)
+        if pairs:
+            for name, value in pairs:
+                yield name, value.strip('"'), i
+        else:
+            toks = rest.split(None, 1)
+            if len(toks) == 2:
+                yield toks[0], toks[1].strip(), i
+
+
+def _render_chart(root: str) -> List[DeployFile]:
+    """Both render passes of the chart, one DeployFile per template per
+    pass; a render/parse failure becomes a DeployFile carrying
+    ``parse_error`` (TPU014 reports it)."""
+    import yaml
+
+    chart_abs = os.path.join(root, CHART_DIR)
+    if not os.path.isdir(os.path.join(chart_abs, "templates")):
+        return []
+    from tpufw.utils import helm
+
+    out: List[DeployFile] = []
+    for variant, overrides in (
+        ("default", None),
+        ("alt", CHART_ALT_VALUES),
+    ):
+        try:
+            ctx = helm.Context(
+                chart_abs, "tpu-stack", "tpu-system", overrides
+            )
+        except Exception as e:  # bad Chart.yaml/values.yaml
+            out.append(
+                DeployFile(
+                    f"{CHART_DIR}/values.yaml", "", "rendered",
+                    variant=variant,
+                    parse_error=f"chart load failed: {e}",
+                )
+            )
+            return out
+        tdir = os.path.join(chart_abs, "templates")
+        for fname in sorted(os.listdir(tdir)):
+            if fname.startswith("_") or not fname.endswith(
+                (".yaml", ".yml")
+            ):
+                continue
+            rel = f"{CHART_DIR}/templates/{fname}"
+            try:
+                with open(
+                    os.path.join(tdir, fname), encoding="utf-8"
+                ) as fh:
+                    template = fh.read()
+            except OSError:
+                continue
+            try:
+                rendered = helm.render_str(template, ctx, ctx.root)
+                docs = [
+                    d for d in yaml.safe_load_all(rendered)
+                    if d is not None
+                ]
+                err = None
+            except Exception as e:
+                rendered = template  # anchor suppressions to something
+                docs = []
+                err = f"chart render failed ({variant} values): {e}"
+            out.append(
+                DeployFile(
+                    rel, rendered, "rendered", variant=variant,
+                    parse_error=err, docs=docs,
+                )
+            )
+    return out
+
+
+def collect_deploy_files(root: str) -> List[DeployFile]:
+    """Every deploy artifact under ``root``, parsed. Missing
+    directories simply contribute nothing (fixture trees)."""
+    out: List[DeployFile] = []
+    for sub, kind in ((MANIFEST_DIR, "manifest"), (CONFIG_DIR, "config")):
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for fn in sorted(os.listdir(base)):
+            if not fn.endswith((".yaml", ".yml")):
+                continue
+            df = _load_yaml_file(root, f"{sub}/{fn}", kind)
+            if df is not None:
+                out.append(df)
+    out.extend(_render_chart(root))
+    dockerfile = _load_dockerfile(root)
+    if dockerfile is not None:
+        out.append(dockerfile)
+    return out
+
+
+# ------------------------------------------------- k8s object walking
+
+def _as_int(val: Any) -> Optional[int]:
+    try:
+        return int(str(val))
+    except (TypeError, ValueError):
+        return None
+
+
+class PodWorkload:
+    """One pod template plus its controller context, flattened from a
+    Pod / Job / JobSet document."""
+
+    def __init__(
+        self,
+        doc: dict,
+        pod_spec: dict,
+        kind: str,
+        name: str,
+        parallelism: int = 1,
+        completions: Optional[int] = None,
+        replicas: int = 1,
+        completion_mode: Optional[str] = None,
+        jobset: Optional[dict] = None,
+        replicated_job_name: Optional[str] = None,
+    ):
+        self.doc = doc
+        self.pod_spec = pod_spec
+        self.kind = kind
+        self.name = name
+        self.parallelism = parallelism
+        self.completions = completions
+        self.replicas = replicas
+        self.completion_mode = completion_mode
+        self.jobset = jobset  # the owning JobSet doc, if any
+        self.replicated_job_name = replicated_job_name
+
+    @property
+    def workers(self) -> int:
+        return max(1, self.parallelism) * max(1, self.replicas)
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.workers > 1
+
+    def containers(self) -> List[dict]:
+        out = []
+        for key in ("initContainers", "containers"):
+            got = self.pod_spec.get(key)
+            if isinstance(got, list):
+                out.extend(c for c in got if isinstance(c, dict))
+        return out
+
+    def tpu_limit(self, resource_name: str = "google.com/tpu") -> int:
+        total = 0
+        for c in self.containers():
+            resources = c.get("resources") or {}
+            for section in ("limits", "requests"):
+                val = _as_int((resources.get(section) or {}).get(
+                    resource_name
+                ))
+                if val:
+                    total += val
+                    break
+        return total
+
+    def node_selector(self) -> dict:
+        sel = self.pod_spec.get("nodeSelector")
+        return sel if isinstance(sel, dict) else {}
+
+    def env_entries(self) -> List[dict]:
+        out = []
+        for c in self.containers():
+            env = c.get("env")
+            if isinstance(env, list):
+                out.extend(e for e in env if isinstance(e, dict))
+        return out
+
+    def env_map(self) -> Dict[str, Any]:
+        """name -> literal value (str) or the entry dict for valueFrom."""
+        out: Dict[str, Any] = {}
+        for e in self.env_entries():
+            name = e.get("name")
+            if not isinstance(name, str):
+                continue
+            if "value" in e:
+                out.setdefault(name, e["value"])
+            else:
+                out.setdefault(name, e)
+        return out
+
+    def container_ports(self) -> Set[int]:
+        out: Set[int] = set()
+        for c in self.containers():
+            for p in c.get("ports") or []:
+                if isinstance(p, dict):
+                    val = _as_int(p.get("containerPort"))
+                    if val is not None:
+                        out.add(val)
+        return out
+
+
+def iter_workloads(doc: Any) -> Iterator[PodWorkload]:
+    """Flatten one parsed YAML document into pod workloads."""
+    if not isinstance(doc, dict):
+        return
+    kind = doc.get("kind")
+    meta = doc.get("metadata") or {}
+    name = str(meta.get("name", "?"))
+    spec = doc.get("spec") or {}
+    if kind == "Pod":
+        yield PodWorkload(doc, spec, "Pod", name)
+    elif kind == "Job":
+        pod_spec = ((spec.get("template") or {}).get("spec")) or {}
+        yield PodWorkload(
+            doc,
+            pod_spec,
+            "Job",
+            name,
+            parallelism=_as_int(spec.get("parallelism")) or 1,
+            completions=_as_int(spec.get("completions")),
+            completion_mode=spec.get("completionMode"),
+        )
+    elif kind in ("DaemonSet", "Deployment", "StatefulSet"):
+        pod_spec = ((spec.get("template") or {}).get("spec")) or {}
+        yield PodWorkload(
+            doc,
+            pod_spec,
+            str(kind),
+            name,
+            replicas=_as_int(spec.get("replicas")) or 1,
+        )
+    elif kind == "JobSet":
+        for rj in spec.get("replicatedJobs") or []:
+            if not isinstance(rj, dict):
+                continue
+            job_spec = ((rj.get("template") or {}).get("spec")) or {}
+            pod_spec = (
+                (job_spec.get("template") or {}).get("spec")
+            ) or {}
+            yield PodWorkload(
+                doc,
+                pod_spec,
+                "JobSet",
+                name,
+                parallelism=_as_int(job_spec.get("parallelism")) or 1,
+                completions=_as_int(job_spec.get("completions")),
+                replicas=_as_int(rj.get("replicas")) or 1,
+                completion_mode=job_spec.get("completionMode"),
+                jobset=doc,
+                replicated_job_name=str(rj.get("name", "worker")),
+            )
+
+
+def service_names(files: Sequence[DeployFile]) -> Set[str]:
+    """metadata.name of every Service across the deploy set — what a
+    TPUFW_COORDINATOR_SVC value must resolve against."""
+    out: Set[str] = set()
+    for df in files:
+        for doc in df.docs:
+            if isinstance(doc, dict) and doc.get("kind") == "Service":
+                name = (doc.get("metadata") or {}).get("name")
+                if isinstance(name, str):
+                    out.add(name)
+    return out

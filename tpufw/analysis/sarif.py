@@ -45,6 +45,17 @@ _RULE_HELP = {
               "constructors, silent bf16/fp32 mixing, bf16 accums)",
     "TPU009": "shared mutable attribute accessed across the thread "
               "boundary without the owning lock",
+    "TPU010": "deploy topology math broken: chip limits x workers vs "
+              "gke-tpu-topology product vs chips-per-host vs mesh "
+              "factorization disagree",
+    "TPU011": "multi-host JobSet missing the env/downward-API inputs "
+              "cluster bootstrap's tier detection needs",
+    "TPU012": "TPUFW_* env assignment names an uncataloged knob or "
+              "fails its docs/ENV.md type",
+    "TPU013": "deploy config field unknown to the run-config "
+              "dataclasses, or estimated footprint exceeds HBM",
+    "TPU014": "chart template or manifest failed to render/parse — "
+              "unverifiable deploy artifact",
 }
 
 
@@ -116,7 +127,7 @@ def to_sarif(findings: Sequence[Finding]) -> dict:
                     "driver": {
                         "name": "tpulint",
                         "organization": "tpufw",
-                        "semanticVersion": "2.0.0",
+                        "semanticVersion": "3.0.0",
                         "rules": rules,
                     }
                 },

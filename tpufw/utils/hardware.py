@@ -29,6 +29,11 @@ class ChipSpec:
     # Peak HBM bandwidth in bytes/s — the roofline's second axis
     # (tpufw.obs.roofline). 0 = unknown; consumers must degrade.
     hbm_bw_bytes_per_s: float = 0.0
+    # Largest host (VM) chip count offered for the generation — the
+    # upper bound on a pod's google.com/tpu limit, cross-checked by
+    # tpulint TPU010 against the deploy manifests. v5e/v6e offer 1/4/8
+    # chip hosts; v4/v5p hosts are fixed at 4.
+    chips_per_host: int = 4
 
     @property
     def hbm_gib(self) -> float:
@@ -41,14 +46,21 @@ class ChipSpec:
 # 32 GiB at 1640 GB/s.
 CHIP_SPECS: dict[str, ChipSpec] = {
     "v4": ChipSpec("v4", 275e12, 32 * 2**30, hbm_bw_bytes_per_s=1.228e12),
-    "v5e": ChipSpec("v5e", 197e12, 16 * 2**30, hbm_bw_bytes_per_s=8.19e11),
+    "v5e": ChipSpec(
+        "v5e", 197e12, 16 * 2**30,
+        hbm_bw_bytes_per_s=8.19e11, chips_per_host=8,
+    ),
     "v5p": ChipSpec("v5p", 459e12, 95 * 2**30, hbm_bw_bytes_per_s=2.765e12),
-    "v6e": ChipSpec("v6e", 918e12, 32 * 2**30, hbm_bw_bytes_per_s=1.64e12),
+    "v6e": ChipSpec(
+        "v6e", 918e12, 32 * 2**30,
+        hbm_bw_bytes_per_s=1.64e12, chips_per_host=8,
+    ),
     # CPU fallback so MFU accounting degrades gracefully in tests / dryruns.
     # ~100 GFLOP/s and ~50 GB/s are nominal single-socket figures; tests
     # never assert on them.
     "cpu": ChipSpec(
-        "cpu", 100e9, 16 * 2**30, ici_links=0, hbm_bw_bytes_per_s=5e10
+        "cpu", 100e9, 16 * 2**30,
+        ici_links=0, hbm_bw_bytes_per_s=5e10, chips_per_host=1,
     ),
 }
 
