@@ -617,6 +617,156 @@ def _roofline_from_programs(telemetry_dir, prefix: str = ""):
     return out
 
 
+def _measure_disagg(
+    model,
+    params,
+    *,
+    page: int,
+    kv_quant: str,
+    prompts: list,
+    max_new: int,
+    prefill_slots: int = 2,
+    decode_slots: int = 8,
+    chunk: int = 8,
+    concurrency: int = 6,
+) -> dict:
+    """The disaggregated serving measurement: every request prefills
+    on a PrefillEngine, ships a page bundle, and splices into a
+    separate DecodeEngine (tpufw.serve.roles) — so TTFT here pays the
+    real export + wire + splice hop, not just prefill compute, and the
+    bundle size IS the per-request migration traffic. Shared by the
+    on-TPU serve tier's `disagg` sub-tier and the standalone
+    `python bench.py serve-disagg` artifact writer."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from tpufw.infer import SamplingConfig
+    from tpufw.serve.roles import DecodeEngine, PrefillEngine
+
+    greedy = SamplingConfig(temperature=0.0)
+    pe = PrefillEngine(
+        model, params, sampling=greedy, page=page,
+        kv_quant=kv_quant, n_slots=prefill_slots,
+    )
+    de = DecodeEngine(
+        model, params, sampling=greedy, page=page,
+        kv_quant=kv_quant, n_slots=decode_slots, chunk=chunk,
+    )
+
+    def one(p):
+        t0 = time.perf_counter()
+        bundle = pe.prefill(p, max_new)
+        t1 = time.perf_counter()
+        slot = de.submit(bundle)
+        t2 = time.perf_counter()  # first token now usable on decode
+        tokens = de.collect(slot)
+        t3 = time.perf_counter()
+        return {
+            "ttft_s": t2 - t0,
+            "migration_wall_s": t2 - t1,
+            "migration_bytes": len(bundle),
+            "tokens": len(tokens),
+            "per_token_s": (t3 - t0) / max(1, len(tokens)),
+        }
+
+    one(prompts[0])  # compile both replicas + the decode chunk
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=concurrency) as pool:
+        rows = list(pool.map(one, prompts))
+    wall = time.perf_counter() - t0
+
+    def pct(key, q):
+        vals = sorted(r[key] for r in rows)
+        return vals[min(len(vals) - 1, round(q * (len(vals) - 1)))]
+
+    total = sum(r["tokens"] for r in rows)
+    return {
+        "requests": len(prompts),
+        "concurrency": concurrency,
+        "prompt_len": len(prompts[0]),
+        "new_tokens": max_new,
+        "page": page,
+        "kv_quant": kv_quant or "bf16",
+        "prefill_slots": prefill_slots,
+        "decode_slots": decode_slots,
+        "chunk": chunk,
+        "serve_tokens_per_sec_per_chip": round(total / wall, 1),
+        "ttft_p50_ms": round(pct("ttft_s", 0.5) * 1e3, 3),
+        "ttft_p95_ms": round(pct("ttft_s", 0.95) * 1e3, 3),
+        "per_token_latency_p50_ms": round(
+            pct("per_token_s", 0.5) * 1e3, 3
+        ),
+        "per_token_latency_p95_ms": round(
+            pct("per_token_s", 0.95) * 1e3, 3
+        ),
+        "migration_bytes_per_request": int(
+            sum(r["migration_bytes"] for r in rows) / len(rows)
+        ),
+        "migration_wall_p50_ms": round(
+            pct("migration_wall_s", 0.5) * 1e3, 3
+        ),
+        "migration_wall_p95_ms": round(
+            pct("migration_wall_s", 0.95) * 1e3, 3
+        ),
+    }
+
+
+def _serve_disagg_main(argv: list) -> int:
+    """``python bench.py serve-disagg [out.json]`` — the disagg
+    sub-tier standalone on whatever backend jax finds (CPU included:
+    llama3_tiny, random init — the numbers calibrate the MIGRATION
+    overhead shape, not model speed). Writes the BENCH_serve.json
+    artifact so the wire/splice cost is tracked like any other bench
+    number."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from tpufw.models import LLAMA_CONFIGS, Llama
+
+    cfg = _dc.replace(
+        LLAMA_CONFIGS["llama3_tiny"].decode_config(), max_seq_len=256
+    )
+    model = Llama(cfg)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    rng = _np.random.default_rng(0)
+    prompt_len, max_new, n_reqs = 96, 32, 12
+    # Prefix-heavy mix, same shape as the serve tier: half the
+    # requests open with a shared 64-token (4-page) prefix.
+    pfx = rng.integers(1, cfg.vocab_size, size=64).tolist()
+    prompts = [
+        pfx + rng.integers(
+            1, cfg.vocab_size, size=prompt_len - 64
+        ).tolist()
+        if i % 2 == 0
+        else rng.integers(1, cfg.vocab_size, size=prompt_len).tolist()
+        for i in range(n_reqs)
+    ]
+    payload = {
+        "bench": "serve_disagg",
+        "model": "llama3_tiny",
+        "platform": jax.default_backend(),
+        "disagg": {
+            key: _measure_disagg(
+                model, params, page=16, kv_quant=quant,
+                prompts=prompts, max_new=max_new,
+            )
+            for quant, key in (("", "bf16_kv"), ("int8", "int8_kv"))
+        },
+    }
+    out_path = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_serve.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    _emit(payload)
+    return 0
+
+
 def _worker() -> int:
     import signal
 
@@ -1526,6 +1676,18 @@ def _worker() -> int:
                 "paged_bf16": hbm_budget // (row_tokens * bpt_bf16),
                 "paged_int8": hbm_budget // (row_tokens * bpt_int8),
             }
+            # Disaggregated sub-tier: the SAME prefix-heavy traffic,
+            # but every request crosses the prefill→decode page-bundle
+            # hop (int8 KV, the deployment config) — the delta against
+            # paged_int8_kv above is what disaggregation costs when
+            # both roles share one chip. TTFT here includes the
+            # export + wire + splice migration.
+            serve["disagg"] = _measure_disagg(
+                vmodel, v_params, page=v_page, kv_quant="int8",
+                prompts=p_prompts, max_new=v_new,
+                decode_slots=sched.n_slots, chunk=sched.chunk,
+                concurrency=v_conc,
+            )
             del v_params
         except Exception as e:  # noqa: BLE001
             serve = {"error": f"{type(e).__name__}: {e}"[:500]}
@@ -1867,4 +2029,6 @@ def _worker() -> int:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "serve-disagg":
+        sys.exit(_serve_disagg_main(sys.argv[2:]))
     sys.exit(_worker() if _IS_WORKER else _orchestrate())

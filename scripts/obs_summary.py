@@ -93,6 +93,81 @@ def summarize_events(paths: list[str]) -> None:
             print(f"    {json.dumps(ev, sort_keys=True)}")
 
 
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+def summarize_router(paths: list[str]) -> None:
+    """Front-door digest: who asked, where requests landed, why any
+    were turned away, and what the page migrations cost on the wire.
+    Prints nothing when the run had no router/migration events."""
+    events = []
+    for p in paths:
+        try:
+            events.extend(read_events(p))
+        except OSError:
+            continue
+    requests = [e for e in events if e.get("kind") == "router_request"]
+    rejects = [e for e in events if e.get("kind") == "router_reject"]
+    migrations = [e for e in events if e.get("kind") == "serve_migration"]
+    if not requests and not rejects and not migrations:
+        return
+    print("-- router / migration --")
+    if requests:
+        tenants = collections.Counter(
+            e.get("tenant", "?") for e in requests
+        )
+        replicas = collections.Counter(
+            e.get("replica", "?") for e in requests
+        )
+        lat = sorted(
+            e["latency_s"]
+            for e in requests
+            if isinstance(e.get("latency_s"), (int, float))
+        )
+        print(
+            f"  {len(requests)} routed: tenants "
+            + ", ".join(f"{t}={n}" for t, n in sorted(tenants.items()))
+            + " | replicas "
+            + ", ".join(f"{r}={n}" for r, n in sorted(replicas.items()))
+        )
+        if lat:
+            print(
+                f"  latency p50 {_fmt_s(_percentile(lat, 0.5))}, "
+                f"p95 {_fmt_s(_percentile(lat, 0.95))}"
+            )
+    if rejects:
+        reasons = collections.Counter(
+            (e.get("tenant", "?"), e.get("reason", "?")) for e in rejects
+        )
+        print(
+            f"  {len(rejects)} rejected: "
+            + ", ".join(
+                f"{t}/{r}={n}" for (t, r), n in sorted(reasons.items())
+            )
+        )
+    if migrations:
+        total_b = sum(e.get("bytes", 0) or 0 for e in migrations)
+        total_p = sum(e.get("pages", 0) or 0 for e in migrations)
+        walls = sorted(
+            e["wall_s"]
+            for e in migrations
+            if isinstance(e.get("wall_s"), (int, float))
+        )
+        dirs = collections.Counter(
+            e.get("direction", "?") for e in migrations
+        )
+        print(
+            f"  {len(migrations)} page migration(s) "
+            f"({', '.join(f'{d}={n}' for d, n in sorted(dirs.items()))}): "
+            f"{total_p} pages, {_fmt_count(total_b)}B on the wire, "
+            f"p95 wall {_fmt_s(_percentile(walls, 0.95))}"
+        )
+
+
 def summarize_trace(paths: list[str]) -> None:
     totals: collections.Counter = collections.Counter()
     counts: collections.Counter = collections.Counter()
@@ -175,6 +250,9 @@ def summarize_metrics(path: str) -> None:
         "tpufw_train_stragglers_total",
         "tpufw_serve_requests_total",
         "tpufw_serve_request_errors_total",
+        "tpufw_router_requests_total",
+        "tpufw_router_rejects_total",
+        "tpufw_router_decode_pages_free",
         "tpufw_goodput_ratio",
         "tpufw_run_info",
     )
@@ -286,6 +364,7 @@ def main(argv: list[str]) -> int:
     print(f"== telemetry: {out} ==")
     print("-- events --")
     summarize_events(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
+    summarize_router(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
     print("-- spans (total time) --")
     summarize_trace(sorted(glob.glob(os.path.join(out, "trace*.json"))))
     gp = sorted(glob.glob(os.path.join(out, "goodput*.json")))

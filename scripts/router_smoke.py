@@ -1,0 +1,191 @@
+"""CI smoke for disaggregated serving: prefill replica + decode
+replica + front-door router ganged in ONE process on CPU.
+
+The replicas are real engines (tpufw.serve.roles, llama3_tiny random
+init, int8 KV so the quantized codes + scales travel the bundle) —
+only the wire is elided: the router talks to them through
+``LocalReplica``, the same client interface TcpReplica gives it in a
+cluster. What must hold:
+
+- a prefix-shared prompt pair completes THROUGH migration: both
+  requests prefill on the prefill replica (the second attaching the
+  first's pages from the prefix trie), export page bundles, splice
+  into the decode replica, and emit exactly ``max_new`` tokens;
+- a router fronting an artificially page-capped decode replica
+  answers an oversized request with 429 + Retry-After (admission
+  control, not a stall), while a small request still lands;
+- the router ledger (events-router.jsonl) digests cleanly through
+  scripts/obs_summary.py, and /metrics exposes the router counters.
+
+Exit 0 on success; any assertion or HTTP failure exits nonzero.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+)
+
+MAX_NEW = 6
+PAGE = 16
+
+
+def _post(base: str, body: dict):
+    """(status, parsed-body, headers) — 4xx/5xx included, not raised."""
+    req = urllib.request.Request(
+        base + "/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from tpufw.infer import SamplingConfig
+    from tpufw.models import LLAMA_CONFIGS, Llama
+    from tpufw.obs.events import EventLog, read_events
+    from tpufw.serve.roles import DecodeEngine, PrefillEngine
+    from tpufw.serve.router import LocalReplica, RouterServer
+
+    greedy = SamplingConfig(temperature=0.0)
+    cfg = dataclasses.replace(
+        LLAMA_CONFIGS["llama3_tiny"].decode_config(), max_seq_len=64
+    )
+    model = Llama(cfg)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    tdir = tempfile.mkdtemp(prefix="tpufw-router-smoke-")
+    events = EventLog(os.path.join(tdir, "events-router.jsonl"))
+    failures: list[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        print(("ok: " if ok else "FAILED: ") + what)
+        if not ok:
+            failures.append(what)
+
+    common = dict(sampling=greedy, page=PAGE, kv_quant="int8",
+                  events=events)
+    pe = PrefillEngine(model, params, n_slots=2, **common)
+    de = DecodeEngine(model, params, n_slots=4, chunk=2, **common)
+    router = RouterServer(
+        [LocalReplica("prefill-0", pe)],
+        [LocalReplica("decode-0", de)],
+        port=0, page=PAGE, events=events,
+    )
+    base = f"http://127.0.0.1:{router.port}"
+
+    # ---- prefix-shared pair, completed through migration ----
+    shared = list(range(40, 72))  # 32 tokens = 2 full pages in the trie
+    for i, tail in enumerate(([7, 9], [11, 3])):
+        status, body, _h = _post(base, {
+            "prompt": shared + tail, "max_new": MAX_NEW,
+            "tenant": "smoke", "session": f"s{i}",
+        })
+        check(status == 200, f"request {i} routed (got {status}: {body})")
+        if status == 200:
+            check(
+                len(body["tokens"]) == MAX_NEW,
+                f"request {i} decoded {MAX_NEW} tokens through migration "
+                f"(pages={body['migration_pages']}, "
+                f"replica={body['replica']})",
+            )
+    check(
+        pe.migrations == 2 and de.migrations == 2,
+        f"both requests migrated (exported={pe.migrations}, "
+        f"imported={de.migrations})",
+    )
+    shared_exports = [
+        e for e in read_events(os.path.join(tdir, "events-router.jsonl"))
+        if e.get("kind") == "serve_migration"
+        and e.get("direction") == "export"
+        and (e.get("shared_pages") or 0) > 0
+    ]
+    check(
+        len(shared_exports) >= 1,
+        "second prefill attached the shared prefix from the trie "
+        f"({len(shared_exports)} shared-page export(s))",
+    )
+
+    # ---- admission control against a page-capped decode arena ----
+    de_cap = DecodeEngine(
+        model, params, n_slots=2, chunk=2, arena_pages=4,  # 3 usable
+        sampling=greedy, page=PAGE, kv_quant="int8",
+    )
+    capped = RouterServer(
+        [LocalReplica("prefill-0", pe)],
+        [LocalReplica("decode-cap", de_cap)],
+        port=0, page=PAGE, events=events,
+    )
+    cbase = f"http://127.0.0.1:{capped.port}"
+    status, body, headers = _post(cbase, {
+        "prompt": list(range(1, 57)), "max_new": MAX_NEW,  # 4 pages
+        "tenant": "smoke",
+    })
+    check(
+        status == 429 and headers.get("Retry-After") is not None,
+        f"oversized request 429s with Retry-After="
+        f"{headers.get('Retry-After')} (got {status}: {body})",
+    )
+    status, body, _h = _post(cbase, {
+        "prompt": [1, 2, 3], "max_new": 4, "tenant": "smoke",
+    })
+    check(
+        status == 200 and len(body.get("tokens", [])) == 4,
+        f"small request still fits the capped arena (got {status})",
+    )
+
+    # ---- ledger digests + router counters on /metrics ----
+    with urllib.request.urlopen(base + "/metrics", timeout=60) as resp:
+        metrics = resp.read().decode()
+    check(
+        "tpufw_router_requests_total 2" in metrics,
+        "router counted its 2 routed requests on /metrics",
+    )
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "obs_summary.py"),
+         tdir],
+        capture_output=True, text=True, timeout=120,
+    )
+    print(proc.stdout, end="")
+    check(
+        proc.returncode == 0 and "router / migration" in proc.stdout
+        and "rejected" in proc.stdout,
+        "obs_summary digests the router ledger",
+    )
+
+    router.close()
+    capped.close()
+    if failures:
+        print(f"router-smoke FAILED ({len(failures)} check(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("router-smoke OK: migration served end-to-end, saturation "
+          "admission held the door")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

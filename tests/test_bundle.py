@@ -1,0 +1,162 @@
+"""Page-bundle wire format + replica transport (tpufw.serve.bundle /
+.transport). No jax, no model: bundles here are synthetic
+``export_slot``-shaped states, because the wire format's contract is
+byte fidelity and clean rejection, not model math (tests/
+test_migrate.py covers the arena round trip end to end).
+"""
+
+import json
+import socket
+import struct
+import threading
+import zlib
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from tpufw.serve import transport
+from tpufw.serve.bundle import (
+    BundleError,
+    MAGIC,
+    decode_bundle,
+    encode_bundle,
+)
+
+
+def _state(dtype, *, kv_quant="", seen=None):
+    """A two-page, two-path synthetic export: one KV arena gather and
+    its fp32 page-structured scales."""
+    rng = np.random.default_rng(7)
+    kv = rng.standard_normal((2, 16, 4, 8)).astype(dtype)
+    scale = rng.standard_normal((2, 16)).astype(np.float32)
+    return {
+        "page": 16,
+        "kv_quant": kv_quant,
+        "n_pages": 2,
+        "paths": ["layers_0/cached_key", "layers_0/cached_key_scale"],
+        "arrays": [kv, scale],
+        "token": 42,
+        "pos": 19,
+        "remaining": 5,
+        "done": False,
+        "cache_index": 1,
+        "seen": seen,
+    }
+
+
+@pytest.mark.parametrize(
+    "dtype,kv_quant",
+    [(ml_dtypes.bfloat16, ""), (np.int8, "int8")],
+    ids=["bf16", "int8"],
+)
+def test_bundle_roundtrip_bit_exact(dtype, kv_quant):
+    state = _state(dtype, kv_quant=kv_quant)
+    back = decode_bundle(encode_bundle(state))
+    for k in ("page", "kv_quant", "n_pages", "token", "pos",
+              "remaining", "done", "cache_index"):
+        assert back[k] == state[k], k
+    assert back["paths"] == state["paths"]
+    assert back["seen"] is None
+    for a, b in zip(state["arrays"], back["arrays"]):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        # Bit fidelity, not closeness: the splice must reproduce the
+        # exporting arena's storage exactly (int8 codes AND scales).
+        assert a.tobytes() == b.tobytes()
+    # The scales path really travels as fp32 alongside the codes.
+    assert back["arrays"][1].dtype == np.float32
+
+
+def test_bundle_seen_row_roundtrip():
+    seen = np.zeros((1, 97), np.bool_)
+    seen[0, [3, 11, 42]] = True
+    back = decode_bundle(encode_bundle(_state(np.float32, seen=seen)))
+    assert back["seen"] is not None
+    assert np.array_equal(back["seen"], seen)
+    # "seen" is a reserved path, not a KV array path.
+    assert back["paths"][-1] != "seen"
+
+
+def test_bundle_checksum_tamper_rejected():
+    data = bytearray(encode_bundle(_state(np.float32)))
+    data[len(data) // 2] ^= 0x40  # flip one payload bit in flight
+    with pytest.raises(BundleError, match="checksum"):
+        decode_bundle(bytes(data))
+
+
+def test_bundle_truncation_and_magic_rejected():
+    data = encode_bundle(_state(np.float32))
+    with pytest.raises(BundleError, match="truncated"):
+        decode_bundle(data[:8])
+    with pytest.raises(BundleError, match="magic"):
+        decode_bundle(b"NOPE" + data[4:])
+    assert data[:4] == MAGIC
+
+
+def test_bundle_version_and_trailing_rejected():
+    data = encode_bundle(_state(np.float32))
+    # Future version, checksum recomputed so THAT check passes.
+    body = bytearray(data[:-4])
+    body[4:6] = struct.pack(">H", 99)
+    vers = bytes(body) + struct.pack(
+        ">I", zlib.crc32(bytes(body)) & 0xFFFFFFFF
+    )
+    with pytest.raises(BundleError, match="version"):
+        decode_bundle(vers)
+    # Extra payload bytes after the last manifest array.
+    body = data[:-4] + b"\x00"
+    trail = body + struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF)
+    with pytest.raises(BundleError, match="trailing"):
+        decode_bundle(trail)
+
+
+# ------------------------------------------------------------ framing
+
+def test_loopback_roundtrips_frames_both_ways():
+    lt = transport.LoopbackTransport()
+    payload = encode_bundle(_state(np.int8, kv_quant="int8"))
+    lt.a.send(payload)
+    assert lt.b.recv(timeout=1.0) == payload
+    lt.b.send(b"ack")
+    assert lt.a.recv(timeout=1.0) == b"ack"
+    with pytest.raises(transport.TransportError, match="timeout"):
+        lt.a.recv(timeout=0.01)
+
+
+def test_frame_size_cap(monkeypatch):
+    monkeypatch.setattr(transport, "MAX_FRAME", 8)
+    with pytest.raises(transport.TransportError, match="too large"):
+        transport.pack_frame(b"x" * 9)
+
+
+def test_tcp_transport_frames_and_error_replies():
+    def handler(frame: bytes) -> bytes:
+        if frame == b"boom":
+            raise RuntimeError("handler exploded")
+        return b"echo:" + frame
+
+    srv, port = transport.serve_frames(0, host="127.0.0.1")
+    t = threading.Thread(
+        target=transport.accept_loop, args=(srv, handler), daemon=True
+    )
+    t.start()
+    try:
+        with transport.TcpTransport("127.0.0.1", port, timeout=5.0) as c:
+            c.send(b"hello")
+            assert c.recv() == b"echo:hello"
+            c.send(b"boom")  # handler errors become JSON replies
+            err = json.loads(c.recv().decode())
+            assert "handler exploded" in err["error"]
+    finally:
+        srv.close()
+
+
+def test_read_exact_detects_midframe_close():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack(">I", 100) + b"short")
+        a.close()
+        with pytest.raises(transport.TransportError, match="mid-frame"):
+            transport.recv_frame(b)
+    finally:
+        b.close()

@@ -33,38 +33,47 @@ def test_manifest_parses_and_is_k8s_object(path):
         assert {"apiVersion", "kind", "metadata"} <= doc.keys(), path.name
 
 
-def _pod_spec(doc: dict) -> dict:
+def _pod_specs(doc: dict) -> list[dict]:
     kind = doc["kind"]
     if kind == "Pod":
-        return doc["spec"]
-    if kind == "Job":
-        return doc["spec"]["template"]["spec"]
+        return [doc["spec"]]
+    if kind in ("Job", "Deployment"):
+        return [doc["spec"]["template"]["spec"]]
     if kind == "JobSet":
-        [rj] = doc["spec"]["replicatedJobs"]
-        return rj["template"]["spec"]["template"]["spec"]
+        # A JobSet may pool several replicated jobs (13-serve-disagg runs
+        # prefill and decode side by side); every pod template counts.
+        return [
+            rj["template"]["spec"]["template"]["spec"]
+            for rj in doc["spec"]["replicatedJobs"]
+        ]
     raise AssertionError(f"unhandled kind {kind}")
 
 
 def _containers(doc: dict) -> list[dict]:
-    return _pod_spec(doc)["containers"]
+    return [c for spec in _pod_specs(doc) for c in spec["containers"]]
 
 
 def test_all_baseline_configs_covered():
     # SURVEY.md §7.3 / BASELINE.md: configs 1-5 each have a manifest, plus
     # smoke-TPU enablement proof, the shared checkpoint PVC, the
-    # inference serving Job+Service (07, VERDICT r1 item 9), and the
-    # post-training Jobs (10 DPO, 11 GRPO, 12 embed).
+    # inference serving Job+Service (07, VERDICT r1 item 9), the
+    # post-training Jobs (10 DPO, 11 GRPO, 12 embed), and the
+    # disaggregated serving stack (13: prefill/decode JobSet + router
+    # Deployment + router Service).
     names = [p.name for p in MANIFESTS]
-    assert len(names) == 13
+    assert len(names) == 14
     kinds = [d["kind"] for p in MANIFESTS for d in load(p)]
     assert kinds.count("Pod") == 3
     # 04 llama v5e-4, 07 infer, 09 gemma2 v5e-4, 10 dpo, 11 grpo,
     # 12 embed.
     assert kinds.count("Job") == 6
-    # 05 v5e-16, 06 mixtral ep, 08 pipeline-parallel.
-    assert kinds.count("JobSet") == 3
+    # 05 v5e-16, 06 mixtral ep, 08 pipeline-parallel, 13 serve-disagg.
+    assert kinds.count("JobSet") == 4
     assert kinds.count("PersistentVolumeClaim") == 1
-    assert kinds.count("Service") == 1
+    # 07 infer, 13 router front door.
+    assert kinds.count("Service") == 2
+    # 13 router (CPU-only front door).
+    assert kinds.count("Deployment") == 1
 
 
 def test_tpu_workloads_request_the_extended_resource():
@@ -76,7 +85,9 @@ def test_tpu_workloads_request_the_extended_resource():
                 continue
             for c in _containers(doc):
                 limits = c.get("resources", {}).get("limits", {})
-                if "smoke-cpu" in path.name:
+                if "smoke-cpu" in path.name or doc["kind"] == "Deployment":
+                    # The serve router holds no model state and never
+                    # loads jax — a TPU limit there would strand a slice.
                     assert "google.com/tpu" not in limits
                 else:
                     assert int(limits["google.com/tpu"]) >= 1, path.name
@@ -103,8 +114,15 @@ def _env_as_kubelet_would(doc: dict, completion_index: int) -> dict:
     return env
 
 
+# 13-serve-disagg is excluded: its replicated jobs are single-worker
+# serving replicas (parallelism=1, no mesh env, no jax.distributed
+# gang), so the multihost bootstrap contract does not apply — its own
+# cross-layer contract (router <-> JobSet DNS wiring) is pinned by
+# test_disagg_router_wiring below.
 @pytest.mark.parametrize(
-    "path", [p for p in MANIFESTS if "jobset" in p.name], ids=lambda p: p.name
+    "path",
+    [p for p in MANIFESTS if "jobset" in p.name and "disagg" not in p.name],
+    ids=lambda p: p.name,
 )
 def test_jobset_env_satisfies_bootstrap_contract(path):
     [doc] = load(path)
@@ -135,6 +153,49 @@ def test_jobset_env_satisfies_bootstrap_contract(path):
     # Gang restart needs checkpoint-resume to be meaningful (SURVEY.md §5).
     assert doc["spec"]["failurePolicy"]["maxRestarts"] >= 1
     assert env.get("TPUFW_CHECKPOINT_DIR")
+
+
+def test_disagg_router_wiring():
+    """Manifest 13's failure mode is not a gang split but a dead front
+    door: the router's TPUFW_ROUTER_* replica lists are hand-written
+    DNS names, so verify each one is exactly the pod hostname the
+    JobSet will publish (<jobset>-<job>-<replica>-0.<jobset>) at the
+    peer port that replica's container actually binds."""
+    [path] = [p for p in MANIFESTS if "disagg" in p.name]
+    docs = load(path)
+    jobset = next(d for d in docs if d["kind"] == "JobSet")
+    deploy = next(d for d in docs if d["kind"] == "Deployment")
+    svc = next(d for d in docs if d["kind"] == "Service")
+
+    # Without hostnames the router's address lists resolve to nothing.
+    assert jobset["spec"]["network"]["enableDNSHostnames"] is True
+    jobs = {rj["name"]: rj for rj in jobset["spec"]["replicatedJobs"]}
+    assert set(jobs) == {"prefill", "decode"}
+
+    [router] = deploy["spec"]["template"]["spec"]["containers"]
+    renv = {e["name"]: e["value"] for e in router["env"]}
+    assert renv["TPUFW_SERVE_ROLE"] == "router"
+
+    name = jobset["metadata"]["name"]
+    for job_name, knob in (("prefill", "TPUFW_ROUTER_PREFILL"),
+                           ("decode", "TPUFW_ROUTER_DECODE")):
+        rj = jobs[job_name]
+        [c] = rj["template"]["spec"]["template"]["spec"]["containers"]
+        cenv = {e["name"]: e["value"] for e in c["env"]}
+        assert cenv["TPUFW_SERVE_ROLE"] == job_name
+        port = int(cenv["TPUFW_SERVE_PEER_PORT"])
+        assert port in [p["containerPort"] for p in c["ports"]]
+        want = ",".join(
+            f"{name}-{job_name}-{i}-0.{name}:{port}"
+            for i in range(rj["replicas"])
+        )
+        assert renv[knob] == want, (knob, renv[knob], want)
+
+    # The Service fronts the router's HTTP port, not the peer port.
+    http_port = int(renv["TPUFW_ROUTER_PORT"])
+    assert http_port in [p["containerPort"] for p in router["ports"]]
+    assert [p["targetPort"] for p in svc["spec"]["ports"]] == [http_port]
+    assert svc["spec"]["selector"] == deploy["spec"]["selector"]["matchLabels"]
 
 
 def test_jobset_models_exist():

@@ -1,0 +1,201 @@
+"""Front-door router policy (tpufw.serve.router) and replica
+discovery (tpufw.cluster.discovery).
+
+Pure-policy tests: RouterPolicy / WeightedFairQueue take snapshots
+and return decisions — no sockets, no model, no jax. The live proxy
+path (HTTP front end over real engines) runs in
+scripts/router_smoke.py; parity of the migrated KV itself is
+tests/test_migrate.py.
+"""
+
+import pytest
+
+from tpufw.cluster.discovery import discover_replicas
+from tpufw.serve.router import (
+    ReplicaState,
+    RouterPolicy,
+    WeightedFairQueue,
+    _parse_weights,
+)
+
+
+def _decode(name, *, total=40, used=0, slots=4, active=0, healthy=True):
+    return ReplicaState(
+        name, "decode", pages_total=total, pages_in_use=used,
+        slots_total=slots, slots_active=active, healthy=healthy,
+    )
+
+
+# ------------------------------------------------------------ WFQ
+
+def test_wfq_weighted_service_under_contention():
+    # Two backlogged tenants, equal-cost requests, weights 2:1 — the
+    # drain order must serve tenant a twice per b.
+    q = WeightedFairQueue({"a": 2.0, "b": 1.0})
+    for i in range(6):
+        q.push("a", 10, ("a", i))
+        q.push("b", 10, ("b", i))
+    order = [q.pop() for _ in range(len(q))]
+    # First 9 pops: all 6 of a's plus 3 of b's (2:1 service rate).
+    assert [t for t, _ in order[:9]].count("a") == 6
+    # FIFO within a tenant (virtual finish strictly increases).
+    assert [i for t, i in order if t == "a"] == list(range(6))
+    assert [i for t, i in order if t == "b"] == list(range(6))
+
+
+def test_wfq_idle_tenant_does_not_bank_credit():
+    q = WeightedFairQueue({})
+    # Tenant a drains alone for a while, advancing virtual time.
+    for i in range(4):
+        q.push("a", 10, ("a", i))
+    for _ in range(4):
+        q.pop()
+    # b was idle the whole time; on arrival it enters at CURRENT
+    # virtual time — it must not get 4 requests' worth of back-credit
+    # and monopolize the queue.
+    q.push("b", 10, ("b", 0))
+    q.push("a", 10, ("a", 4))
+    first = q.pop()
+    second = q.pop()
+    assert {first[0], second[0]} == {"a", "b"}  # interleaved, not b-burst
+    q.push("b", 10, ("b", 1))
+    q.push("b", 10, ("b", 2))
+    q.push("a", 10, ("a", 5))
+    drained = [q.pop()[0] for _ in range(len(q))]
+    assert drained.count("b") == 2 and drained.count("a") == 1
+
+
+def test_wfq_unknown_tenant_defaults_to_weight_one():
+    q = WeightedFairQueue({"vip": 3.0})
+    for i in range(3):
+        q.push("vip", 6, ("vip", i))
+        q.push("anon", 6, ("anon", i))
+    order = [q.pop()[0] for _ in range(6)]
+    assert order[:4].count("vip") == 3
+
+
+def test_parse_weights_skips_malformed_entries():
+    assert _parse_weights("a:2, b:1.5") == {"a": 2.0, "b": 1.5}
+    assert _parse_weights("a:2,junk,x:,:3,") == {"a": 2.0, "": 3.0}
+    assert _parse_weights("") == {}
+
+
+# ------------------------------------------------------- admission
+
+def test_admission_rejects_when_all_arenas_saturated():
+    p = RouterPolicy(saturation=0.95, retry_after_s=7)
+    replicas = [
+        _decode("d0", used=39),           # 1 free page < 3 needed
+        _decode("d1", used=10, active=4),  # no free slot
+    ]
+    name, reason = p.pick_decode("", replicas, n_pages=3)
+    assert name is None and reason == "saturated"
+    assert p.retry_after_s == 7  # rides into the 429 Retry-After
+
+
+def test_admission_respects_saturation_waterline():
+    # 38/40 pages after the splice is ABOVE a 0.9 waterline even
+    # though the pages physically fit — headroom for in-flight rows'
+    # decode growth is the point of the knob.
+    p = RouterPolicy(saturation=0.9)
+    r = _decode("d0", used=35)
+    assert not p.decode_fits(r, n_pages=3)
+    assert p.decode_fits(r, n_pages=1)  # 36/40 = 0.9 exactly: allowed
+    loose = RouterPolicy(saturation=1.0)
+    assert loose.decode_fits(r, n_pages=3)
+
+
+def test_admission_skips_unhealthy_and_full_slots():
+    p = RouterPolicy()
+    assert not p.decode_fits(_decode("d0", healthy=False), 1)
+    assert not p.decode_fits(_decode("d1", slots=2, active=2), 1)
+    assert p.decode_fits(_decode("d2", slots=2, active=1), 1)
+
+
+# -------------------------------------------------------- affinity
+
+def test_sticky_session_reuses_replica_while_it_fits():
+    p = RouterPolicy()
+    replicas = [_decode("d0", used=30), _decode("d1", used=0)]
+    # First pick goes least-loaded...
+    name, _ = p.pick_decode("sess", replicas, 2)
+    assert name == "d1"
+    # ...and sticks there even when the OTHER replica becomes
+    # emptier (its pages for this session live on d1).
+    replicas = [_decode("d0", used=0), _decode("d1", used=30)]
+    again, _ = p.pick_decode("sess", replicas, 2)
+    assert again == "d1"
+    # Sessionless requests have no pin: they go least-loaded.
+    anon, _ = p.pick_decode("", replicas, 2)
+    assert anon == "d0"
+
+
+def test_sticky_session_rehomes_when_replica_full_or_gone():
+    p = RouterPolicy()
+    name, _ = p.pick_decode("s", [_decode("d0"), _decode("d1")], 2)
+    # Pinned replica saturates: the session re-homes instead of 429ing.
+    replicas = [
+        _decode("d0", used=40 if name == "d0" else 0,
+                active=4 if name == "d0" else 0),
+        _decode("d1", used=40 if name == "d1" else 0,
+                active=4 if name == "d1" else 0),
+    ]
+    moved, reason = p.pick_decode("s", replicas, 2)
+    assert moved is not None and moved != name and reason == ""
+    # Pinned replica disappears entirely: same re-home.
+    gone, _ = p.pick_decode("s", [_decode("d2")], 2)
+    assert gone == "d2"
+    p.forget_session("s")
+    fresh, _ = p.pick_decode("s", [_decode("d2", used=9)], 2)
+    assert fresh == "d2"
+
+
+def test_prefill_pick_least_loaded_and_healthy():
+    p = RouterPolicy()
+    replicas = [
+        ReplicaState("p0", "prefill", pages_total=9, pages_in_use=8),
+        ReplicaState("p1", "prefill", pages_total=9, pages_in_use=1),
+        ReplicaState("p2", "prefill", pages_total=9, pages_in_use=0,
+                     healthy=False),
+    ]
+    assert p.pick_prefill(replicas) == "p1"
+    assert p.pick_prefill([r for r in replicas if not r.healthy]) is None
+
+
+# ------------------------------------------------------- discovery
+
+def test_discovery_explicit_lists_win():
+    env = {
+        "TPUFW_ROUTER_PREFILL": "p0:9001, p1:9002",
+        "TPUFW_ROUTER_DECODE": "d0",  # portless -> peer-port default
+        "TPUFW_SERVE_PEER_PORT": "8123",
+        "JOBSET_NAME": "ignored-when-explicit",
+    }
+    prefill, decode = discover_replicas(env)
+    assert prefill == [("p0", 9001), ("p1", 9002)]
+    assert decode == [("d0", 8123)]
+
+
+def test_discovery_jobset_dns_from_replica_counts():
+    env = {
+        "JOBSET_NAME": "tpufw-serve-disagg",
+        "TPUFW_ROUTER_PREFILL_REPLICAS": "2",
+        "TPUFW_ROUTER_DECODE_REPLICAS": "1",
+    }
+    prefill, decode = discover_replicas(env)
+    assert prefill == [
+        ("tpufw-serve-disagg-prefill-0-0.tpufw-serve-disagg", 8477),
+        ("tpufw-serve-disagg-prefill-1-0.tpufw-serve-disagg", 8477),
+    ]
+    assert decode == [
+        ("tpufw-serve-disagg-decode-0-0.tpufw-serve-disagg", 8477),
+    ]
+
+
+def test_discovery_fails_loudly_without_a_source():
+    with pytest.raises(ValueError, match="discovery"):
+        discover_replicas({})
+    with pytest.raises(ValueError, match="REPLICAS"):
+        discover_replicas({"JOBSET_NAME": "x"})
+    with pytest.raises(ValueError, match="BOTH"):
+        discover_replicas({"TPUFW_ROUTER_PREFILL": "p0:1"})

@@ -606,6 +606,7 @@ ENV_ENUMS: Dict[str, Set[str]] = {
     "TPUFW_PIPELINE_SCHEDULE": {"gpipe", "1f1b", "interleaved", "zb1"},
     "TPUFW_QUANTIZE": {"", "int8"},
     "TPUFW_SERVE_KV_QUANT": {"", "int8"},
+    "TPUFW_SERVE_ROLE": {"", "prefill", "decode", "router"},
     "TPUFW_POOLING": {"mean", "last", "cls"},
 }
 

@@ -3,3 +3,4 @@ from tpufw.cluster.bootstrap import (  # noqa: F401
     initialize_cluster,
     resolve_cluster_env,
 )
+from tpufw.cluster.discovery import discover_replicas  # noqa: F401

@@ -58,7 +58,7 @@ from tpufw.ops.quant import dequantize_kv, quantize_kv
 # bumped once per (re)trace so tests can pin the retrace budget.
 TRACE_COUNTS: Dict[str, int] = {
     "paged_insert": 0, "clear_table": 0, "prefix_attach": 0,
-    "suffix_prefill": 0,
+    "suffix_prefill": 0, "page_export": 0, "page_splice": 0,
 }
 
 #: unstacked rank of each KV arena leaf — (n_pages, page, *feat); the
@@ -69,6 +69,17 @@ _ARENA_RANK = {
     "cached_key": 4, "cached_value": 4,  # llama-family K/V heads
     "cached_ckv": 3, "cached_kpe": 3,    # deepseek MLA latents
 }
+
+
+def _export_rank(name: str) -> Optional[int]:
+    """Collapse rank of a leaf that travels in a page bundle (arena KV,
+    page-structured scales, segment ids); None for per-slot leaves
+    (page_table, cache_index) the importer rebuilds locally."""
+    if name in _ARENA_RANK:
+        return _ARENA_RANK[name]
+    if name.endswith("_scale") or name == "cached_segment_ids":
+        return 2
+    return None
 
 
 def _leaf_name(path) -> str:
@@ -349,6 +360,75 @@ def _attach_shared_jit(
         else:
             raise ValueError(f"unknown row cache leaf {name!r}")
     return tuple(out)
+
+
+@partial(jax.jit, static_argnames=("names",))
+def _export_pages_jit(leaves, ids, *, names):
+    """Gather pages ``ids`` out of every bundle-traveling arena leaf,
+    RAW (int8 codes + their scales ship as stored — no dequantize, so
+    a splice on the receiving arena is bit-identical storage and the
+    wire stays ~4x cheaper in int8 mode). NOT donating: the arena
+    stays live — export observes, it never consumes. Programs are
+    keyed by the page count, same budget class as prefix attach."""
+    TRACE_COUNTS["page_export"] += 1
+    out = []
+    for name, leaf in zip(names, leaves):
+        rank = _export_rank(name)
+        if rank is None:
+            continue
+        a = _collapse_arena(leaf, rank)
+        out.append(a[:, ids])  # [stacks, n, page, *feat]
+    return tuple(out)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("names",),
+    donate_argnames=(
+        "leaves", "token", "pos", "done", "remaining", "seen",
+    ),
+)
+def _splice_pages_jit(
+    leaves, page_arrays, ids, table_row, slot, cache_idx,
+    first, pos0, budget, done0,
+    token, pos, done, remaining, seen, row_seen,
+    *, names,
+):
+    """Scatter a migrated bundle's pages into freshly allocated arena
+    pages ``ids`` and point slot ``slot``'s table row at them. The
+    page-table indirection is what makes migration invisible to the
+    math: physical ids differ per replica, but the gather reconstructs
+    the same logical row, so greedy decode after a splice is bit-equal
+    to the never-migrated run — and the cache shapes are untouched, so
+    ``decode_steps`` stays the one program it always was."""
+    TRACE_COUNTS["page_splice"] += 1
+    k = 0
+    out = []
+    for name, leaf in zip(names, leaves):
+        if name == "page_table":
+            out.append(leaf.at[..., slot, :].set(table_row))
+            continue
+        if name == "cache_index":
+            out.append(leaf.at[..., slot].set(cache_idx))
+            continue
+        rank = _export_rank(name)
+        if rank is None:
+            raise ValueError(
+                f"unknown paged cache leaf {name!r}: the page splice "
+                "must know every leaf's role (an untouched leaf would "
+                "leak the previous occupant's state)"
+            )
+        a = _collapse_arena(leaf, rank)
+        vals = page_arrays[k].astype(leaf.dtype)
+        out.append(a.at[:, ids].set(vals).reshape(leaf.shape))
+        k += 1
+    token = token.at[slot].set(first)
+    pos = pos.at[slot].set(pos0)
+    done = done.at[slot].set(done0)
+    remaining = remaining.at[slot].set(budget)
+    if seen is not None:
+        seen = seen.at[slot].set(row_seen)
+    return tuple(out), token, pos, done, remaining, seen
 
 
 @partial(
@@ -633,6 +713,134 @@ class PagedSlotPool(SlotPool):
         freed = self.allocator.release(self.slot_pages[slot])
         self.slot_pages[slot] = []
         return freed
+
+    # ---- page migration (disaggregated serving) -------------------
+
+    def exported_paths(self) -> List[str]:
+        """Leaf paths that travel in a page bundle, in pool-flat order
+        — the layout contract both ends of a migration must agree on."""
+        paths, names, _, _ = self._pool_flat()
+        return [
+            p for p, n in zip(paths, names)
+            if _export_rank(n) is not None
+        ]
+
+    def export_slot(
+        self, slot: int, page_ids: Optional[Sequence[int]] = None
+    ) -> Dict[str, Any]:
+        """Snapshot slot ``slot``'s KV pages + cursors as a host-side
+        migration state dict (tpufw.serve.bundle serializes it).
+
+        MUST run before ``release_slot``: after release the device
+        table row is zeroed (reads would gather reserved page 0's
+        junk) and the pages may already belong to a new admission.
+        ``page_ids`` lets the caller pass the page-table snapshot it
+        took at the chunk boundary — the scheduler's retire path does,
+        so a row finishing mid-chunk exports the pages it owned when
+        the chunk was launched, not whatever the list mutated to."""
+        ids = list(
+            self.slot_pages[slot] if page_ids is None else page_ids
+        )
+        paths, names, leaves, _ = self._pool_flat()
+        arrays = _export_pages_jit(
+            tuple(leaves),
+            jnp.asarray(np.asarray(ids, np.int32)),
+            names=names,
+        )
+        cache_index = 0
+        for n, leaf in zip(names, leaves):
+            if n == "cache_index":
+                # Every layer carries the same per-slot value.
+                cache_index = int(
+                    np.asarray(leaf).reshape(-1, self.n_slots)[0, slot]
+                )
+                break
+        seen_row = None
+        if self.seen is not None:
+            seen_row = np.asarray(self.seen[slot])
+        return {
+            "page": self.page,
+            "kv_quant": self.model.cfg.kv_quant or "",
+            "n_pages": len(ids),
+            "paths": [
+                p for p, n in zip(paths, names)
+                if _export_rank(n) is not None
+            ],
+            "arrays": [np.asarray(a) for a in arrays],
+            "token": int(np.asarray(self.token)[slot]),
+            "pos": int(np.asarray(self.pos)[slot]),
+            "remaining": int(np.asarray(self.remaining)[slot]),
+            "done": bool(np.asarray(self.done)[slot]),
+            "cache_index": cache_index,
+            "seen": seen_row,
+        }
+
+    def splice_slot(
+        self, slot: int, state: Dict[str, Any],
+        page_ids: Sequence[int],
+    ) -> None:
+        """Occupy ``slot`` with a migrated bundle: scatter its page
+        payload into ``page_ids`` (already allocated, row refs taken)
+        and restore the cursors. Raises ValueError on any layout
+        mismatch — a bundle from a differently-shaped pool must be
+        rejected before it scribbles on the arena."""
+        if int(state["page"]) != self.page:
+            raise ValueError(
+                f"bundle page size {state['page']} != pool page "
+                f"{self.page}"
+            )
+        if (state.get("kv_quant") or "") != (
+            self.model.cfg.kv_quant or ""
+        ):
+            raise ValueError(
+                f"bundle kv_quant {state.get('kv_quant')!r} != pool "
+                f"kv_quant {self.model.cfg.kv_quant!r}"
+            )
+        if len(page_ids) != int(state["n_pages"]):
+            raise ValueError(
+                f"bundle carries {state['n_pages']} pages but "
+                f"{len(page_ids)} were allocated"
+            )
+        paths, names, leaves, treedef = self._pool_flat()
+        want = [
+            p for p, n in zip(paths, names)
+            if _export_rank(n) is not None
+        ]
+        if list(state["paths"]) != want:
+            raise ValueError(
+                "bundle leaf layout does not match this pool "
+                f"(got {list(state['paths'])!r}, want {want!r}) — "
+                "model config / cache structure drift between replicas"
+            )
+        seen_row = state.get("seen")
+        if (seen_row is None) != (self.seen is None):
+            raise ValueError(
+                "bundle and pool disagree on repetition-penalty "
+                "tracking (seen mask present on one side only)"
+            )
+        table_row = np.zeros((self.per_row,), np.int32)
+        table_row[: len(page_ids)] = page_ids
+        leaves_out, self.token, self.pos, self.done, self.remaining, \
+            self.seen = _splice_pages_jit(
+                tuple(leaves),
+                tuple(jnp.asarray(a) for a in state["arrays"]),
+                jnp.asarray(np.asarray(page_ids, np.int32)),
+                jnp.asarray(table_row),
+                slot,
+                np.int32(state["cache_index"]),
+                np.int32(state["token"]),
+                np.int32(state["pos"]),
+                np.int32(state["remaining"]),
+                np.bool_(state["done"]),
+                self.token, self.pos, self.done, self.remaining,
+                self.seen,
+                None if seen_row is None else jnp.asarray(seen_row),
+                names=names,
+            )
+        self.cache = jax.tree_util.tree_unflatten(
+            treedef, list(leaves_out)
+        )
+        self.slot_pages[slot] = list(page_ids)
 
     def retire(self, slot: int) -> None:
         """Error-path retire — page-aware (frees the row's pages)."""

@@ -45,6 +45,9 @@ SCHEMA: Dict[str, FrozenSet[str]] = {
     "serve_request": frozenset({"rows", "new_tokens", "latency_s"}),
     "serve_pool_switch": frozenset({"cache_len", "slots"}),
     "serve_prefix": frozenset({"hit", "shared_pages", "prompt_tokens"}),
+    "serve_migration": frozenset({"pages", "bytes", "wall_s"}),
+    "router_request": frozenset({"tenant", "replica", "latency_s"}),
+    "router_reject": frozenset({"tenant", "reason"}),
     "goodput": frozenset({"wall_s", "goodput_ratio"}),
     "hang": frozenset({"timeout_s", "armed_for_s"}),
 }

@@ -1,0 +1,20 @@
+"""Disaggregated serving subsystem: prefill/decode replica roles, the
+page-bundle wire format that migrates KV between them, and the
+front-door router that load-balances sessions across replica pools.
+
+The in-process engine (tpufw.workloads.serve) is one replica role
+inside this package; ``TPUFW_SERVE_ROLE`` selects which role a
+container runs (see tpufw.serve.roles / docs/WORKFLOWS.md).
+"""
+
+from tpufw.serve.bundle import (  # noqa: F401
+    BundleError,
+    decode_bundle,
+    encode_bundle,
+)
+from tpufw.serve.roles import DecodeEngine, PrefillEngine  # noqa: F401
+from tpufw.serve.router import RouterPolicy  # noqa: F401
+from tpufw.serve.transport import (  # noqa: F401
+    LoopbackTransport,
+    TcpTransport,
+)

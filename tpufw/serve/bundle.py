@@ -1,0 +1,141 @@
+"""Page-bundle wire format: the serialized form of one slot's KV
+pages + cursors (``PagedSlotPool.export_slot``'s state dict).
+
+Layout (all integers big-endian):
+
+    MAGIC(4) VERSION(u16) HEADER_LEN(u32) HEADER(json, utf-8)
+    BODY (concatenated C-order array bytes, header-manifest order)
+    CRC32(u4)  — zlib.crc32 over MAGIC..BODY
+
+The header carries everything needed to reject a bundle cleanly
+BEFORE touching an arena: format version, page geometry, kv_quant,
+and a per-array manifest (path, shape, dtype). int8 arenas ship their
+int8 codes + fp32 page-structured scales raw — the splice is
+bit-identical storage and the wire stays ~4x cheaper than bf16.
+
+bfloat16 has no stdlib numpy name; dtypes are stored by name and
+resolved through ml_dtypes (a jax dependency) when numpy alone can't.
+
+Stdlib + numpy only — importable by the router, which never loads
+jax.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Any, Dict
+
+import numpy as np
+
+MAGIC = b"TPFB"
+VERSION = 1
+
+#: Non-array metadata fields that ride in the header verbatim.
+_META_FIELDS = (
+    "page", "kv_quant", "n_pages", "token", "pos", "remaining",
+    "done", "cache_index",
+)
+
+
+class BundleError(ValueError):
+    """A malformed/mismatched bundle, rejected before any arena
+    write."""
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency; covers bfloat16 et al.
+
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except AttributeError:
+            raise BundleError(f"unknown array dtype {name!r}") from None
+
+
+def encode_bundle(state: Dict[str, Any]) -> bytes:
+    """Serialize an ``export_slot`` state dict. The optional ``seen``
+    row (repetition-penalty mask) travels as one more manifest entry
+    under the reserved path ``"seen"``."""
+    arrays = [np.ascontiguousarray(a) for a in state["arrays"]]
+    paths = [str(p) for p in state["paths"]]
+    if state.get("seen") is not None:
+        arrays.append(np.ascontiguousarray(state["seen"]))
+        paths.append("seen")
+    manifest = [
+        {
+            "path": p,
+            "shape": list(a.shape),
+            "dtype": a.dtype.name,
+        }
+        for p, a in zip(paths, arrays)
+    ]
+    header = {
+        "version": VERSION,
+        "arrays": manifest,
+        **{k: state[k] for k in _META_FIELDS},
+    }
+    hjson = json.dumps(header, sort_keys=True).encode("utf-8")
+    parts = [MAGIC, struct.pack(">HI", VERSION, len(hjson)), hjson]
+    parts.extend(a.tobytes() for a in arrays)
+    payload = b"".join(parts)
+    return payload + struct.pack(">I", zlib.crc32(payload) & 0xFFFFFFFF)
+
+
+def decode_bundle(data: bytes) -> Dict[str, Any]:
+    """Parse bundle bytes back into an ``export_slot``-shaped state
+    dict; raises BundleError on any magic/version/manifest/checksum
+    mismatch — a tampered or truncated bundle must never reach the
+    arena."""
+    if len(data) < 14:
+        raise BundleError(f"bundle truncated ({len(data)} bytes)")
+    if data[:4] != MAGIC:
+        raise BundleError(f"bad magic {data[:4]!r} (want {MAGIC!r})")
+    body, (crc,) = data[:-4], struct.unpack(">I", data[-4:])
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise BundleError("checksum mismatch — bundle corrupt in flight")
+    version, hlen = struct.unpack(">HI", data[4:10])
+    if version != VERSION:
+        raise BundleError(
+            f"bundle version {version} != supported {VERSION}"
+        )
+    if 10 + hlen > len(body):
+        raise BundleError("header overruns bundle body")
+    try:
+        header = json.loads(body[10:10 + hlen].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise BundleError(f"unparseable header: {e}") from None
+    offset = 10 + hlen
+    arrays = []
+    for entry in header.get("arrays", []):
+        dtype = _np_dtype(str(entry["dtype"]))
+        shape = tuple(int(d) for d in entry["shape"])
+        nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+        if offset + nbytes > len(body):
+            raise BundleError(
+                f"array {entry.get('path')!r} overruns bundle body"
+            )
+        arrays.append(
+            np.frombuffer(
+                body, dtype=dtype, count=int(np.prod(shape, dtype=np.int64)),
+                offset=offset,
+            ).reshape(shape)
+        )
+        offset += nbytes
+    if offset != len(body):
+        raise BundleError(
+            f"{len(body) - offset} trailing bytes after last array"
+        )
+    paths = [str(e["path"]) for e in header.get("arrays", [])]
+    seen = None
+    if paths and paths[-1] == "seen":
+        seen = arrays.pop()
+        paths.pop()
+    state: Dict[str, Any] = {k: header[k] for k in _META_FIELDS}
+    state["paths"] = paths
+    state["arrays"] = arrays
+    state["seen"] = seen
+    return state

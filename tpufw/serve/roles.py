@@ -1,0 +1,461 @@
+"""Prefill / decode replica roles for disaggregated serving.
+
+The split follows the workload physics (ROADMAP item 1 / Podracer's
+decomposed-slice template): prefill is compute-bound and bursty,
+decode is memory-bound and steady, so each gets its own mesh and its
+own page arena. The handoff is PR 6's page arena made literal —
+
+- :class:`PrefillEngine` runs admission (prefix-cache attach +
+  ``_suffix_prefill_jit`` or cold ``prefill_row``) on its replica,
+  scatters the row into its arena, then EXPORTS the slot's pages
+  (int8 codes + page-structured scales raw) as a page bundle and
+  releases the slot. Its prefix trie persists across requests, so
+  shared prompts still prefill once per replica.
+- :class:`DecodeEngine` imports bundles by allocating pages from its
+  own arena and splicing them into its ``PagedSlotPool`` table. The
+  cache shapes never change, so ``decode_steps`` stays the single
+  jitted program it always was — migrations cost zero retraces, and
+  greedy decode is bit-equal to a never-migrated run (the page table
+  hides the physical ids).
+
+RNG discipline mirrors the slot scheduler exactly: prefill stream
+``fold_in(key(seed_base), job_index)``, chunk stream
+``fold_in(key(seed_base + 1), chunk_index)`` — so a migrated request
+draws the same sample stream the single-process path would.
+
+``main_role`` is the container entrypoint behind
+``TPUFW_SERVE_ROLE`` (deploy/manifests/13-serve-disagg-v5e8-jobset
+.yaml): a framed-TCP server per engine, the router's HTTP front end
+for the router role.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from tpufw.obs import events as obs_events
+from tpufw.serve import transport
+from tpufw.serve.bundle import (
+    BundleError,
+    decode_bundle,
+    encode_bundle,
+)
+from tpufw.workloads.env import env_int, env_str
+
+DEFAULT_PEER_PORT = 8477
+
+
+def _paged_models(model, page: int, kv_quant: str, arena_pages: int):
+    """(pool_model, row_model) pair for a paged pool at the base
+    model's full sequence budget — same construction the slot
+    scheduler's ``_pool_model`` uses."""
+    from tpufw.models import model_for_config
+
+    cfg = model.cfg
+    cache_len = int(cfg.max_seq_len)
+    if page <= 0 or cache_len % page:
+        raise ValueError(
+            f"page={page} must be > 0 and divide max_seq_len={cache_len}"
+        )
+    pool_cfg = dataclasses.replace(
+        cfg, kv_page=page, kv_pages=arena_pages, kv_quant=kv_quant
+    )
+    row_cfg = dataclasses.replace(cfg, kv_page=0, kv_quant="")
+    return model_for_config(pool_cfg), model_for_config(row_cfg)
+
+
+class PrefillEngine:
+    """One prefill replica: admission + prefix cache + page export.
+
+    Slots are transient here — a slot lives exactly from insert to
+    export+release — so the arena is sized for in-flight admissions
+    plus whatever the prefix trie holds, not for decode residency."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        sampling,
+        page: int,
+        kv_quant: str = "",
+        n_slots: int = 2,
+        arena_pages: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        seed_base: int = 0,
+        prefix_cache: bool = True,
+        events=None,
+    ):
+        from tpufw.infer.pages import PagedSlotPool
+
+        cache_len = int(model.cfg.max_seq_len)
+        per_row = cache_len // page
+        pages = arena_pages or n_slots * per_row + 1
+        pool_model, row_model = _paged_models(model, page, kv_quant, pages)
+        self.pool = PagedSlotPool.create_paged(
+            pool_model, row_model, params, n_slots,
+            sampling=sampling, eos_id=eos_id,
+            prefix_cache=prefix_cache,
+        )
+        self.page = page
+        self.n_slots = n_slots
+        self._eos = eos_id
+        self._seed_base = seed_base
+        self._job_index = 0
+        self._events = events if events is not None else obs_events.NULL
+        self._lock = threading.Lock()
+        self.migrations = 0
+        self.migration_bytes = 0
+
+    def signals(self) -> Dict[str, Any]:
+        a = self.pool.allocator
+        return {
+            "role": "prefill",
+            "pages_total": a.capacity,
+            "pages_in_use": a.in_use,
+            "migrations": self.migrations,
+        }
+
+    def prefill(
+        self, prompt: Sequence[int], max_new: int
+    ) -> bytes:
+        """Admit one request, export its slot as a page bundle, free
+        the slot. Returns the serialized bundle (the first sampled
+        token rides inside it as the ``token`` cursor). Raises
+        ValueError when the row can never fit this arena."""
+        from tpufw.infer import slots as slots_mod
+
+        import jax
+
+        prompt = list(prompt)
+        need = len(prompt) + max_new - 1
+        if self.pool.n_pages_for(need) > self.pool.allocator.capacity:
+            raise ValueError(
+                f"prompt+budget needs {self.pool.n_pages_for(need)} "
+                f"pages; arena capacity is {self.pool.allocator.capacity}"
+            )
+        with self._lock:
+            job_index = self._job_index
+            self._job_index += 1
+            rng = jax.random.fold_in(
+                jax.random.key(self._seed_base), job_index
+            )
+            t0 = time.monotonic()
+            grant = self.pool.acquire_pages(prompt, need)
+            if grant is None:
+                raise RuntimeError(
+                    "prefill arena exhausted — in-flight admissions "
+                    "plus trie-held pages left no room"
+                )
+            ids, shared_n = grant
+            if shared_n:
+                cache, _f, first, _d, seen = self.pool.prefill_shared(
+                    prompt, ids[:shared_n], rng
+                )
+            else:
+                cache, _f, first, _d, seen = (
+                    # tpulint: disable=TPU003 — exclusive if/else arms:
+                    # exactly ONE of prefill_shared/prefill_row consumes
+                    # this request's rng.
+                    slots_mod.prefill_row(
+                        self.pool.row_model, self.pool.params, prompt,
+                        rng, sampling=self.pool.sampling,
+                        eos_id=self._eos, pad_to=len(prompt),
+                    )
+                )
+            slot = 0  # transient occupancy: insert -> export -> release
+            self.pool.insert_paged(
+                slot, cache, first, len(prompt), max_new - 1,
+                ids, shared_n, row_seen=seen,
+            )
+            self.pool.register_prefix(prompt, ids)
+            state = self.pool.export_slot(slot)
+            self.pool.release_slot(slot)
+            data = encode_bundle(state)
+            self.migrations += 1
+            self.migration_bytes += len(data)
+            self._events.emit(
+                "serve_migration",
+                pages=state["n_pages"], bytes=len(data),
+                wall_s=round(time.monotonic() - t0, 6),
+                direction="export", shared_pages=shared_n,
+            )
+            return data
+
+
+class DecodeEngine:
+    """One decode replica: bundle import + continuous chunked decode.
+
+    ``submit`` splices a bundle into a free slot; ``collect`` drives
+    shared decode chunks (all active slots advance together — the
+    same continuous-batching math as the slot scheduler) until that
+    slot's budget is spent, then frees its pages."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        sampling,
+        page: int,
+        kv_quant: str = "",
+        n_slots: int = 4,
+        arena_pages: Optional[int] = None,
+        eos_id: Optional[int] = None,
+        seed_base: int = 0,
+        chunk: int = 4,
+        events=None,
+    ):
+        from tpufw.infer.pages import PagedSlotPool
+
+        cache_len = int(model.cfg.max_seq_len)
+        per_row = cache_len // page
+        pages = arena_pages or n_slots * per_row + 1
+        pool_model, row_model = _paged_models(model, page, kv_quant, pages)
+        # No prefix trie on the decode side: bundles arrive prefilled,
+        # and a trie hold would pin migrated pages past their row.
+        self.pool = PagedSlotPool.create_paged(
+            pool_model, row_model, params, n_slots,
+            sampling=sampling, eos_id=eos_id, prefix_cache=False,
+        )
+        self.page = page
+        self.n_slots = n_slots
+        self.chunk = max(1, chunk)
+        self._eos = eos_id
+        self._seed_base = seed_base
+        self._chunk_index = 0
+        self._events = events if events is not None else obs_events.NULL
+        self._cv = threading.Condition()
+        #: slot -> {"tokens": [...], "budget": int, "done": bool}
+        self._jobs: Dict[int, Dict[str, Any]] = {}
+        self.migrations = 0
+        self.migration_bytes = 0
+
+    # ---- router signals -------------------------------------------
+
+    def signals(self) -> Dict[str, Any]:
+        a = self.pool.allocator
+        with self._cv:
+            active = len(self._jobs)
+        return {
+            "role": "decode",
+            "pages_total": a.capacity,
+            "pages_in_use": a.in_use,
+            "slots_total": self.n_slots,
+            "slots_active": active,
+            "migrations": self.migrations,
+        }
+
+    def can_accept(self, n_pages: int) -> bool:
+        with self._cv:
+            if len(self._jobs) >= self.n_slots:
+                return False
+        return n_pages <= self.pool.allocator.n_free
+
+    # ---- bundle import --------------------------------------------
+
+    def submit(self, data: bytes) -> int:
+        """Import a serialized bundle; returns the slot handle for
+        ``collect``. BundleError/ValueError mean the bundle was
+        rejected with the arena untouched."""
+        t0 = time.monotonic()
+        state = decode_bundle(data)
+        with self._cv:
+            free = [
+                s for s in range(self.n_slots) if s not in self._jobs
+            ]
+            if not free:
+                raise RuntimeError("decode replica: no free slot")
+            slot = free[0]
+            ids = self.pool.allocator.alloc(int(state["n_pages"]))
+            if ids is None:
+                raise RuntimeError(
+                    "decode replica: arena cannot fit the bundle "
+                    f"({state['n_pages']} pages, "
+                    f"{self.pool.allocator.n_free} free)"
+                )
+            try:
+                self.pool.splice_slot(slot, state, ids)
+            except Exception:
+                self.pool.allocator.release(ids)
+                raise
+            self._jobs[slot] = {
+                "tokens": [int(state["token"])],
+                "budget": int(state["remaining"]),
+                "done": bool(state["done"])
+                or int(state["remaining"]) <= 0,
+            }
+            self.migrations += 1
+            self.migration_bytes += len(data)
+            self._cv.notify_all()
+        self._events.emit(
+            "serve_migration",
+            pages=int(state["n_pages"]), bytes=len(data),
+            wall_s=round(time.monotonic() - t0, 6),
+            direction="import",
+        )
+        return slot
+
+    # ---- decode loop ----------------------------------------------
+
+    def _run_chunk_locked(self) -> None:
+        """One shared decode chunk (caller holds ``_cv``). Every
+        active slot advances; retired slots free their pages."""
+        import jax
+        import numpy as np
+
+        live = {
+            s: j for s, j in self._jobs.items() if not j["done"]
+        }
+        if not live:
+            return
+        k = self.chunk
+        key = jax.random.fold_in(
+            jax.random.key(self._seed_base + 1), self._chunk_index
+        )
+        self._chunk_index += 1
+        out = np.asarray(
+            self.pool.decode_steps(jax.random.split(key, k))
+        )
+        for slot, job in live.items():
+            row = out[slot].tolist()
+            take = min(k, job["budget"] - (len(job["tokens"]) - 1))
+            row = row[:take]
+            if self._eos is not None and self._eos in row:
+                row = row[: row.index(self._eos) + 1]
+            job["tokens"].extend(row)
+            if (
+                len(job["tokens"]) - 1 >= job["budget"]
+                or (self._eos is not None and row
+                    and row[-1] == self._eos)
+            ):
+                job["done"] = True
+                self.pool.release_slot(slot)
+        self._cv.notify_all()
+
+    def collect(self, slot: int, timeout: float = 600.0) -> List[int]:
+        """Block until ``slot``'s request completes; returns its full
+        token list (first token included). Exactly one caller drives
+        chunks at a time; other waiters sleep on the condition."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                job = self._jobs.get(slot)
+                if job is None:
+                    raise KeyError(f"no active job in slot {slot}")
+                if job["done"]:
+                    del self._jobs[slot]
+                    return job["tokens"]
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"slot {slot} did not finish in {timeout}s"
+                    )
+                self._run_chunk_locked()
+
+
+# -------------------------------------------------- role entrypoints
+
+def _build_engine(role: str):
+    """Construct the engine a replica container runs, from the same
+    TPUFW_* contract the monolithic server reads."""
+    from tpufw.infer import SamplingConfig
+    from tpufw.workloads.serve import build_generator
+
+    model, params, _cfg, restored = build_generator()
+    page = env_int("serve_page", 16)
+    kv_quant = env_str("serve_kv_quant", "")
+    n_slots = max(1, env_int("serve_slots", 8))
+    sampling = SamplingConfig(temperature=0.0)
+    common = dict(
+        sampling=sampling, page=page, kv_quant=kv_quant,
+        n_slots=n_slots, seed_base=env_int("seed", 0),
+    )
+    if role == "prefill":
+        return PrefillEngine(model, params, **common), restored
+    return (
+        DecodeEngine(
+            model, params,
+            chunk=max(1, env_int("serve_chunk", 0)
+                      or env_int("stream_chunk", 16)),
+            **common,
+        ),
+        restored,
+    )
+
+
+def serve_prefill(engine: PrefillEngine, port: int):
+    """Framed-TCP prefill server: JSON request in, bundle out."""
+
+    def handle(frame: bytes) -> bytes:
+        req = json.loads(frame.decode("utf-8"))
+        if req.get("signals"):
+            return json.dumps(engine.signals()).encode()
+        return engine.prefill(
+            [int(t) for t in req["prompt"]], int(req["max_new"])
+        )
+
+    srv, bound = transport.serve_frames(port)
+    threading.Thread(
+        target=transport.accept_loop, args=(srv, handle), daemon=True
+    ).start()
+    return srv, bound
+
+
+def serve_decode(engine: DecodeEngine, port: int):
+    """Framed-TCP decode server: bundle in, JSON token list out."""
+
+    def handle(frame: bytes) -> bytes:
+        if frame[:1] == b"{":  # JSON control frame (bundles open TPFB)
+            req = json.loads(frame.decode("utf-8"))
+            if req.get("signals"):
+                return json.dumps(engine.signals()).encode()
+            return json.dumps({"error": "expected a page bundle"}).encode()
+        try:
+            slot = engine.submit(frame)
+        except (BundleError, ValueError, RuntimeError) as e:
+            return json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}
+            ).encode()
+        tokens = engine.collect(slot)
+        return json.dumps(
+            {"tokens": tokens, **engine.signals()}
+        ).encode()
+
+    srv, bound = transport.serve_frames(port)
+    threading.Thread(
+        target=transport.accept_loop, args=(srv, handle), daemon=True
+    ).start()
+    return srv, bound
+
+
+def main_role(role: str) -> int:
+    """Container entrypoint for TPUFW_SERVE_ROLE != "". Blocks
+    forever (the pod's lifetime IS the replica's lifetime)."""
+    if role == "router":
+        from tpufw.serve.router import main_router
+
+        return main_router()
+    engine, restored = _build_engine(role)
+    port = env_int("serve_peer_port", DEFAULT_PEER_PORT)
+    if role == "prefill":
+        srv, bound = serve_prefill(engine, port)
+    elif role == "decode":
+        srv, bound = serve_decode(engine, port)
+    else:
+        raise ValueError(
+            f"unknown TPUFW_SERVE_ROLE={role!r} "
+            "(want prefill|decode|router or empty)"
+        )
+    print(json.dumps(
+        {"serving_role": role, "port": bound, "restored": restored}
+    ), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.close()
+    return 0

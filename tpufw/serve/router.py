@@ -1,0 +1,635 @@
+"""Front-door router for disaggregated serving: one HTTP endpoint in
+front of replicated prefill/decode pools.
+
+The router holds no model state — it never imports jax. Per request it
+
+1. stamps the request into a per-tenant weighted-fair queue (virtual
+   finish times: a tenant with weight 2 drains twice as fast as a
+   weight-1 tenant under contention, and an idle tenant's backlog
+   never starves others),
+2. runs admission control against the DECODE pools' page arenas — the
+   scarce resource in disaggregated serving is decode residency, so a
+   request whose page footprint fits no replica is rejected up front
+   with 429 + Retry-After instead of queueing into a stall,
+3. picks replicas: sticky session→decode-replica affinity (a session's
+   later turns land where its prefix pages already live), least-loaded
+   otherwise, and forwards prompt → prefill → page bundle → decode.
+
+Replica load signals are the ones the replicas already export —
+pages_in_use / pages_total and slots_active / slots_total from the
+arena, plus whatever goodput/MFU/HBM-headroom gauges ride in the
+signals dict (``ReplicaState.score`` folds them in when present).
+Snapshots refresh from every decode response and from explicit signal
+probes, so the policy always ranks against recent truth without a
+polling thread.
+
+``RouterPolicy`` and ``WeightedFairQueue`` are pure (no sockets, no
+clocks) — tests/test_router.py drives them directly.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import threading
+import time
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from tpufw.obs import events as obs_events
+from tpufw.obs.registry import Registry as ObsRegistry
+from tpufw.serve import transport
+from tpufw.serve.bundle import MAGIC
+from tpufw.workloads.env import env_float, env_int, env_str
+
+DEFAULT_ROUTER_PORT = 8478
+
+#: Signal-dict keys copied verbatim into a ReplicaState snapshot.
+_SIGNAL_KEYS = (
+    "pages_total", "pages_in_use", "slots_total", "slots_active",
+    "migrations", "goodput_ratio", "mfu", "hbm_headroom_bytes",
+)
+
+
+@dataclass
+class ReplicaState:
+    """Point-in-time load snapshot of one replica, as the policy sees
+    it. Page/slot occupancy is the primary signal; the optional
+    goodput/MFU fields (PR 9's exports) break ties when present."""
+
+    name: str
+    role: str
+    pages_total: int = 0
+    pages_in_use: int = 0
+    slots_total: int = 0
+    slots_active: int = 0
+    migrations: int = 0
+    goodput_ratio: Optional[float] = None
+    mfu: Optional[float] = None
+    hbm_headroom_bytes: Optional[float] = None
+    healthy: bool = True
+    last_seen: float = 0.0
+
+    @property
+    def free_pages(self) -> int:
+        return max(0, self.pages_total - self.pages_in_use)
+
+    @property
+    def load(self) -> float:
+        return self.pages_in_use / max(1, self.pages_total)
+
+    def score(self) -> float:
+        """Lower is better. Page occupancy dominates; a replica
+        burning slots on wasted work (low goodput) or out of HBM
+        headroom ranks behind an equally-occupied healthy one."""
+        s = self.load + 0.1 * (self.slots_active / max(1, self.slots_total))
+        if self.goodput_ratio is not None:
+            s += 0.05 * (1.0 - min(1.0, max(0.0, self.goodput_ratio)))
+        if self.hbm_headroom_bytes is not None and self.hbm_headroom_bytes <= 0:
+            s += 1.0
+        return s
+
+    def update(self, signals: Dict[str, Any], now: float = 0.0) -> None:
+        for k in _SIGNAL_KEYS:
+            if k in signals and signals[k] is not None:
+                setattr(self, k, signals[k])
+        self.healthy = True
+        self.last_seen = now
+
+
+class WeightedFairQueue:
+    """Virtual-time weighted fair queueing over tenants.
+
+    ``push`` stamps an item with a virtual finish time
+    ``max(global_vt, tenant_last_finish) + cost / weight``; ``pop``
+    returns the earliest finish and advances global virtual time to
+    it. Equal-cost streams from tenants with weights 2:1 therefore
+    drain 2:1 under contention, and a tenant that went idle re-enters
+    at the current virtual time instead of burning its saved-up
+    backlog ahead of everyone."""
+
+    def __init__(
+        self,
+        weights: Optional[Dict[str, float]] = None,
+        default_weight: float = 1.0,
+    ):
+        self._weights = dict(weights or {})
+        self._default = float(default_weight)
+        self._vt = 0.0
+        self._finish: Dict[str, float] = {}
+        self._heap: List[Tuple[float, int, Any]] = []
+        self._seq = 0
+
+    def weight(self, tenant: str) -> float:
+        return max(1e-9, float(self._weights.get(tenant, self._default)))
+
+    def push(self, tenant: str, cost: float, item: Any) -> float:
+        start = max(self._vt, self._finish.get(tenant, 0.0))
+        fin = start + float(cost) / self.weight(tenant)
+        self._finish[tenant] = fin
+        heapq.heappush(self._heap, (fin, self._seq, item))
+        self._seq += 1
+        return fin
+
+    def pop(self) -> Any:
+        fin, _, item = heapq.heappop(self._heap)
+        self._vt = max(self._vt, fin)
+        return item
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+
+class RouterPolicy:
+    """Pure routing decisions: WFQ ordering, replica choice, and
+    admission. Holds the session→decode-replica affinity map but no
+    I/O — the server layer feeds it snapshots and forwards bytes."""
+
+    def __init__(
+        self,
+        *,
+        tenant_weights: Optional[Dict[str, float]] = None,
+        saturation: float = 0.95,
+        retry_after_s: int = 5,
+    ):
+        self.queue = WeightedFairQueue(tenant_weights)
+        self.saturation = float(saturation)
+        self.retry_after_s = int(retry_after_s)
+        self._affinity: Dict[str, str] = {}
+
+    # ---- replica choice -------------------------------------------
+
+    def pick_prefill(
+        self, replicas: Sequence[ReplicaState]
+    ) -> Optional[str]:
+        ok = [r for r in replicas if r.healthy]
+        if not ok:
+            return None
+        return min(ok, key=lambda r: (r.score(), r.name)).name
+
+    def decode_fits(self, r: ReplicaState, n_pages: int) -> bool:
+        """Can this decode replica take a bundle of ``n_pages`` now —
+        a free slot, the pages themselves, and room under the
+        saturation waterline (the headroom that keeps in-flight rows'
+        decode growth from hitting a full arena)."""
+        if not r.healthy or r.slots_active >= max(1, r.slots_total):
+            return False
+        if n_pages > r.free_pages:
+            return False
+        return (r.pages_in_use + n_pages) <= self.saturation * max(
+            1, r.pages_total
+        )
+
+    def pick_decode(
+        self,
+        session: str,
+        replicas: Sequence[ReplicaState],
+        n_pages: int,
+    ) -> Tuple[Optional[str], str]:
+        """(replica_name, "") or (None, reject_reason). A session
+        sticks to its previous decode replica while that replica can
+        still take it — its earlier turns' pages (and any prefix
+        reuse downstream) live there — and is re-homed, not failed,
+        when the replica is gone or full."""
+        by_name = {r.name: r for r in replicas}
+        if session:
+            pinned = self._affinity.get(session)
+            if pinned is not None:
+                r = by_name.get(pinned)
+                if r is not None and self.decode_fits(r, n_pages):
+                    return pinned, ""
+        fits = [r for r in replicas if self.decode_fits(r, n_pages)]
+        if not fits:
+            return None, "saturated"
+        name = min(fits, key=lambda r: (r.score(), r.name)).name
+        if session:
+            self._affinity[session] = name
+        return name, ""
+
+    def forget_session(self, session: str) -> None:
+        self._affinity.pop(session, None)
+
+
+class _Metrics:
+    """Router metrics on the shared ``tpufw.obs`` registry — same
+    wrapper shape as the serving endpoint's (short names at call
+    sites, prefix applied here, counters pre-initialized to 0 so
+    increase() alerts see a real zero series)."""
+
+    PREFIX = "tpufw_router_"
+
+    def __init__(self, registry: Optional[ObsRegistry] = None):
+        self.registry = registry if registry is not None else ObsRegistry()
+        self.register(
+            "requests_total",
+            "rejects_total",
+            "proxy_errors_total",
+            "request_seconds_total",
+        )
+
+    def inc(self, name: str, v: float = 1.0) -> None:
+        self.registry.counter(self.PREFIX + name).inc(v)
+
+    def register(self, *names: str) -> None:
+        for name in names:
+            self.registry.counter(self.PREFIX + name)
+
+    def render(self, gauges: Dict[str, float]) -> str:
+        for name, v in gauges.items():
+            self.registry.gauge(self.PREFIX + name).set(float(v))
+        return self.registry.render()
+
+
+# ---------------------------------------------------- replica clients
+
+class LocalReplica:
+    """In-process replica client wrapping an engine directly — CI
+    gangs one prefill + one decode + the router in a single process
+    through these (scripts/router_smoke.py)."""
+
+    def __init__(self, name: str, engine):
+        self.name = name
+        self._engine = engine
+
+    def signals(self) -> Dict[str, Any]:
+        return self._engine.signals()
+
+    def prefill(self, prompt: Sequence[int], max_new: int) -> bytes:
+        return self._engine.prefill(prompt, max_new)
+
+    def decode(self, bundle: bytes) -> Dict[str, Any]:
+        slot = self._engine.submit(bundle)
+        tokens = self._engine.collect(slot)
+        return {"tokens": tokens, **self._engine.signals()}
+
+
+class TcpReplica:
+    """Framed-TCP replica client (one connection per call — replica
+    RPCs are one-in-one-out and rare relative to their cost)."""
+
+    def __init__(self, name: str, host: str, port: int, role: str):
+        self.name = name
+        self.role = role
+        self._addr = (host, int(port))
+
+    def _call(self, payload: bytes) -> bytes:
+        with transport.TcpTransport(*self._addr) as t:
+            t.send(payload)
+            return t.recv()
+
+    def signals(self) -> Dict[str, Any]:
+        reply = self._call(json.dumps({"signals": True}).encode())
+        return json.loads(reply.decode("utf-8"))
+
+    def prefill(self, prompt: Sequence[int], max_new: int) -> bytes:
+        reply = self._call(json.dumps(
+            {"prompt": list(prompt), "max_new": int(max_new)}
+        ).encode())
+        if reply[:4] != MAGIC:
+            err = json.loads(reply.decode("utf-8"))
+            raise RuntimeError(f"prefill {self.name}: {err.get('error')}")
+        return reply
+
+    def decode(self, bundle: bytes) -> Dict[str, Any]:
+        out = json.loads(self._call(bundle).decode("utf-8"))
+        if "error" in out:
+            raise RuntimeError(f"decode {self.name}: {out['error']}")
+        return out
+
+
+# ------------------------------------------------------- HTTP server
+
+class RouterServer:
+    """The front door: POST /generate, GET /healthz, GET /metrics.
+
+    Dispatch order is the WFQ's; ``max_inflight`` requests proxy
+    concurrently and completions pump the queue. Decode snapshots
+    refresh from every decode response, so saturation decisions track
+    the arenas without a polling loop."""
+
+    def __init__(
+        self,
+        prefill: Sequence[Any],
+        decode: Sequence[Any],
+        *,
+        policy: Optional[RouterPolicy] = None,
+        port: int = 0,
+        page: int = 16,
+        max_inflight: int = 4,
+        events=None,
+        registry: Optional[ObsRegistry] = None,
+    ):
+        self._prefill = list(prefill)
+        self._decode = list(decode)
+        self.policy = policy if policy is not None else RouterPolicy()
+        self.page = max(1, int(page))
+        self.max_inflight = max(1, int(max_inflight))
+        self._metrics = _Metrics(registry)
+        self._events = events if events is not None else obs_events.NULL
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._states: Dict[str, ReplicaState] = {}
+        for client in self._prefill:
+            self._states[client.name] = ReplicaState(client.name, "prefill")
+        for client in self._decode:
+            self._states[client.name] = ReplicaState(client.name, "decode")
+        self._refresh_all()
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet access log
+                pass
+
+            def _reply(self, code: int, obj: dict, headers=()):
+                body = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, server.health())
+                elif self.path == "/metrics":
+                    text = server.render_metrics().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(text)))
+                    self.end_headers()
+                    self.wfile.write(text)
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n).decode("utf-8"))
+                except (ValueError, UnicodeDecodeError) as e:
+                    self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                code, obj, headers = server.generate(req)
+                self._reply(code, obj, headers)
+
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", int(port)), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    # ---- state ----------------------------------------------------
+
+    def _refresh_all(self) -> None:
+        for client in self._prefill + self._decode:
+            try:
+                sig = client.signals()
+            except Exception:  # noqa: BLE001 — probe failure = unhealthy
+                self._states[client.name].healthy = False
+                continue
+            self._states[client.name].update(sig, now=time.monotonic())
+
+    def _snapshot(self, role: str) -> List[ReplicaState]:
+        with self._lock:
+            return [
+                ReplicaState(**vars(r))
+                for r in self._states.values()
+                if r.role == role
+            ]
+
+    def n_pages_for(self, prompt_len: int, max_new: int) -> int:
+        need = max(1, prompt_len + max_new - 1)
+        return -(-need // self.page)
+
+    def health(self) -> dict:
+        with self._lock:
+            return {
+                "ok": True,
+                "queue_depth": len(self.policy.queue),
+                "replicas": {
+                    name: {
+                        "role": r.role,
+                        "healthy": r.healthy,
+                        "pages_in_use": r.pages_in_use,
+                        "pages_total": r.pages_total,
+                        "slots_active": r.slots_active,
+                        "slots_total": r.slots_total,
+                    }
+                    for name, r in self._states.items()
+                },
+            }
+
+    def render_metrics(self) -> str:
+        with self._lock:
+            depth = len(self.policy.queue)
+            decode_free = sum(
+                r.free_pages
+                for r in self._states.values()
+                if r.role == "decode" and r.healthy
+            )
+        return self._metrics.render(
+            {
+                "queue_depth": depth,
+                "inflight": self._inflight,
+                "decode_pages_free": decode_free,
+            }
+        )
+
+    # ---- WFQ dispatch ---------------------------------------------
+
+    def _pump_locked(self) -> None:
+        while self._inflight < self.max_inflight and len(self.policy.queue):
+            ev = self.policy.queue.pop()
+            self._inflight += 1
+            ev.set()
+
+    def _admit(self, tenant: str, cost: float, timeout: float) -> bool:
+        ev = threading.Event()
+        with self._lock:
+            self.policy.queue.push(tenant, cost, ev)
+            self._pump_locked()
+        return ev.wait(timeout)
+
+    def _release(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+            self._pump_locked()
+
+    # ---- the proxy path -------------------------------------------
+
+    def generate(self, req: dict) -> Tuple[int, dict, tuple]:
+        """One request through WFQ → admission → prefill → migrate →
+        decode. Returns (status, body, extra_headers)."""
+        t0 = time.monotonic()
+        prompt = req.get("prompt")
+        if not (
+            isinstance(prompt, list)
+            and prompt
+            and all(isinstance(t, int) for t in prompt)
+        ):
+            return 400, {"error": "prompt must be a non-empty [int]"}, ()
+        max_new = int(req.get("max_new", 16))
+        tenant = str(req.get("tenant", "") or "default")
+        session = str(req.get("session", "") or "")
+        n_pages = self.n_pages_for(len(prompt), max_new)
+        cost = len(prompt) + max_new
+        if not self._admit(tenant, cost, timeout=600.0):
+            return 503, {"error": "queue wait timed out"}, ()
+        try:
+            with self._lock:
+                decode_states = [
+                    r for r in self._states.values() if r.role == "decode"
+                ]
+                name, reason = self.policy.pick_decode(
+                    session, decode_states, n_pages
+                )
+                pname = self.policy.pick_prefill(
+                    [r for r in self._states.values()
+                     if r.role == "prefill"]
+                )
+            if name is None:
+                self._metrics.inc("rejects_total")
+                self._events.emit(
+                    "router_reject", tenant=tenant, reason=reason
+                )
+                return (
+                    429,
+                    {"error": f"decode pools {reason}; retry later"},
+                    (("Retry-After", str(self.policy.retry_after_s)),),
+                )
+            if pname is None:
+                self._metrics.inc("rejects_total")
+                self._events.emit(
+                    "router_reject", tenant=tenant, reason="no_prefill"
+                )
+                return 503, {"error": "no healthy prefill replica"}, ()
+            pclient = next(c for c in self._prefill if c.name == pname)
+            dclient = next(c for c in self._decode if c.name == name)
+            try:
+                bundle = pclient.prefill(prompt, max_new)
+                out = dclient.decode(bundle)
+            except Exception as e:  # noqa: BLE001 — proxy boundary
+                self._metrics.inc("proxy_errors_total")
+                with self._lock:
+                    self._states[name].healthy = False
+                self.policy.forget_session(session)
+                return 502, {"error": f"{type(e).__name__}: {e}"}, ()
+            with self._lock:
+                self._states[name].update(out, now=time.monotonic())
+            latency = time.monotonic() - t0
+            self._metrics.inc("requests_total")
+            self._metrics.inc("request_seconds_total", latency)
+            self._events.emit(
+                "router_request", tenant=tenant, replica=name,
+                latency_s=round(latency, 6),
+                prefill_replica=pname, pages=n_pages,
+            )
+            return (
+                200,
+                {
+                    "tokens": out["tokens"],
+                    "replica": name,
+                    "prefill_replica": pname,
+                    "migration_pages": n_pages,
+                },
+                (),
+            )
+        finally:
+            self._release()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+# --------------------------------------------------- role entrypoint
+
+def _parse_weights(spec: str) -> Dict[str, float]:
+    """"tenant:weight,tenant:weight" → dict; malformed entries are
+    skipped (a bad knob must not take the front door down)."""
+    out: Dict[str, float] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        name, _, w = part.rpartition(":")
+        try:
+            out[name.strip()] = float(w)
+        except ValueError:
+            continue
+    return out
+
+
+def _parse_addrs(spec: str) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        out.append((host, int(port)))
+    return out
+
+
+def main_router() -> int:
+    """Container entrypoint for TPUFW_SERVE_ROLE=router. Replica
+    addresses come from the discovery contract (explicit env lists or
+    JobSet DNS — tpufw.cluster.discovery)."""
+    import os
+
+    from tpufw.cluster.discovery import discover_replicas
+
+    prefill_addrs, decode_addrs = discover_replicas()
+    prefill = [
+        TcpReplica(f"prefill-{i}", h, p, "prefill")
+        for i, (h, p) in enumerate(prefill_addrs)
+    ]
+    decode = [
+        TcpReplica(f"decode-{i}", h, p, "decode")
+        for i, (h, p) in enumerate(decode_addrs)
+    ]
+    policy = RouterPolicy(
+        tenant_weights=_parse_weights(
+            env_str("router_tenant_weights", "")
+        ),
+        saturation=env_float("router_saturation", 0.95),
+        retry_after_s=env_int("router_retry_after_s", 5),
+    )
+    events = obs_events.NULL
+    tdir = env_str("telemetry_dir", "")
+    if tdir:
+        os.makedirs(tdir, exist_ok=True)
+        events = obs_events.EventLog(
+            os.path.join(tdir, "events-router.jsonl")
+        )
+    server = RouterServer(
+        prefill,
+        decode,
+        policy=policy,
+        port=env_int("router_port", DEFAULT_ROUTER_PORT),
+        page=env_int("serve_page", 16),
+        max_inflight=env_int("router_inflight", 4),
+        events=events,
+    )
+    print(json.dumps(
+        {
+            "serving_role": "router",
+            "port": server.port,
+            "prefill": len(prefill),
+            "decode": len(decode),
+        }
+    ), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.close()
+    return 0

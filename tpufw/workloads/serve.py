@@ -939,6 +939,7 @@ class _SlotScheduler:
         prefix_cache: Optional[bool] = None,
         arena_pages: Optional[int] = None,
         perf=None,
+        page_export=None,
     ):
         import jax
         import numpy as np
@@ -963,6 +964,10 @@ class _SlotScheduler:
         self._goodput = goodput if goodput is not None else obs_goodput.NULL
         self._watchdog = watchdog if watchdog is not None else NULL_WATCHDOG
         self._perf = perf if perf is not None else obs_perf.NULL
+        # Disaggregated handoff hook: called with (job, state) for
+        # every naturally-completing paged row, where ``state`` is the
+        # slot's export_slot() dict taken BEFORE the slot is retired.
+        self._page_export = page_export
         # Join-latency component split (queue_wait + prefill). Gated
         # OFF by default: registering the histograms adds scrape lines,
         # and the legacy exposition must stay byte-identical unless the
@@ -1559,6 +1564,19 @@ class _SlotScheduler:
             self._jax.random.key(self._seed_base + 1), chunk_index
         )
         keys = self._jax.random.split(key, k)
+        # Chunk-boundary page-table snapshot for the export hook: a
+        # row that finishes mid-chunk keeps absorbing the junk-sink
+        # (page 0) writes for the chunk's remaining steps, and once it
+        # retires its freed pages can be re-granted to a queued
+        # admission within this same scheduler pass. Exports therefore
+        # read THIS snapshot — the ids the row actually owned when the
+        # chunk launched — never the post-retire allocator state.
+        page_snap: dict[int, list[int]] = {}
+        if self.page and self._page_export is not None:
+            page_snap = {
+                slot: list(self._pool.slot_pages[slot])
+                for slot, _ in active
+            }
         chunk_t0 = time.perf_counter()
         with self._tracer.span(
             "serve_decode_chunk", k=k, rows=len(active)
@@ -1592,6 +1610,13 @@ class _SlotScheduler:
                 # Retire: host-side in contiguous mode — the device
                 # row froze itself via the done/remaining masks. Paged
                 # mode also clears the page table and frees the pages.
+                if self.page and self._page_export is not None:
+                    self._page_export(
+                        job,
+                        self._pool.export_slot(
+                            slot, page_ids=page_snap[slot]
+                        ),
+                    )
                 self._retire_slot(slot, device=False)
                 if self._metrics is not None:
                     self._metrics.inc("retired_rows_total")
@@ -2397,6 +2422,14 @@ class _Server:
 def main() -> int:
     from tpufw.utils.profiling import enable_compile_cache
 
+    role = env_str("serve_role", "")
+    if role:
+        # Disaggregated serving: this container is one replica role
+        # (prefill/decode page-bundle server, or the front-door
+        # router) instead of the monolithic endpoint below.
+        from tpufw.serve.roles import main_role
+
+        return main_role(role)
     enable_compile_cache()
     max_new = env_int("max_new_tokens", 16)
     port = env_int("serve_port", 0)
