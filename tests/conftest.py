@@ -292,6 +292,35 @@ _BUDGET_TIER_SLOW = frozenset(
     test_workloads.py::test_train_llama_dpo_resume_after_checkpoint  # 13.3s
     test_workloads.py::test_train_llama_main_env_config  # 6.9s
     test_workloads.py::test_train_resnet_main  # 36.3s
+    # -- 2026-08-05 recalibration: the budget run crept past 870 s as
+    # tests accumulated; heaviest remaining calls moved here, keeping
+    # the disagg-migration parity tests and the analysis live-tree
+    # ratchet in the budget tier.
+    test_contrastive.py::test_bidirectional_flag_changes_forward  # 5.4s
+    test_deepseek.py::test_sp_backends_match_xla_on_sequence_mesh[ring]  # 6.2s
+    test_eval.py::test_eval_ppl_cli  # 7.2s
+    test_flash.py::test_flash_sliding_window_matches_xla[100]  # 5.6s
+    test_grpo.py::test_rollout_rows_are_right_padded_and_masked  # 6.7s
+    test_infer.py::test_chunked_prefill_matches_one_shot_mla  # 6.4s
+    test_infer.py::test_mixtral_cached_decode_runs  # 6.7s
+    test_mistral.py::test_generate_decodes  # 5.3s
+    test_pages.py::test_deepseek_paged_parity  # 7.9s
+    test_pipeline_interleaved.py::test_interleaved_trainer_learns  # 6.6s
+    test_pipeline_interleaved.py::test_zb1_trainer_learns  # 8.7s
+    test_quant.py::test_llama_quantized_forward_close[False]  # 6.6s
+    test_quant.py::test_lm_head_quantized_when_untied  # 6.3s
+    test_quant.py::test_quantized_generate  # 6.3s
+    test_qwen.py::test_quantized_forward_keeps_biases  # 5.2s
+    test_resnet.py::test_vision_trainer_end_to_end  # 5.2s
+    test_serve.py::test_http_server_generate  # 7.4s
+    test_sp_features.py::test_ulysses_cap_window[None]  # 9.3s
+    test_speculative.py::test_eos_rows_freeze  # 7.0s
+    test_stream.py::test_eos_early_stop_drops_only_pad  # 6.0s
+    test_stream.py::test_sampled_chunks_bit_match_oneshot[sampled]  # 6.9s
+    test_tune.py::test_autotune_off_is_inert  # 7.4s
+    test_tune.py::test_run_resolves_autotune_and_reports  # 23.9s
+    test_tune.py::test_search_persists_then_second_run_hits_cache  # 22.2s
+    test_ulysses.py::test_grads_match_reference  # 5.2s
 """.splitlines()
     if line.strip() and not line.lstrip().startswith("#")
 )

@@ -93,6 +93,35 @@ def test_bundle_truncation_and_magic_rejected():
     assert data[:4] == MAGIC
 
 
+def _rewrite_header(data, mutate):
+    """Re-encode a bundle with its JSON header mutated and the
+    checksum recomputed — so only the header validation can fire."""
+    version, hlen = struct.unpack(">HI", data[4:10])
+    header = json.loads(data[10:10 + hlen].decode("utf-8"))
+    mutate(header)
+    hjson = json.dumps(header, sort_keys=True).encode("utf-8")
+    body = (
+        data[:4] + struct.pack(">HI", version, len(hjson)) + hjson
+        + data[10 + hlen:-4]
+    )
+    return body + struct.pack(">I", zlib.crc32(body) & 0xFFFFFFFF)
+
+
+def test_bundle_missing_or_mistyped_meta_rejected():
+    # A structurally valid bundle with a meta field absent (or of the
+    # wrong type) must be a clean BundleError, not a KeyError that
+    # escapes DecodeEngine.submit's rejection path.
+    data = encode_bundle(_state(np.float32))
+    with pytest.raises(BundleError, match="missing meta field"):
+        decode_bundle(
+            _rewrite_header(data, lambda h: h.pop("remaining"))
+        )
+    with pytest.raises(BundleError, match="must be an integer"):
+        decode_bundle(
+            _rewrite_header(data, lambda h: h.update(n_pages="two"))
+        )
+
+
 def test_bundle_version_and_trailing_rejected():
     data = encode_bundle(_state(np.float32))
     # Future version, checksum recomputed so THAT check passes.

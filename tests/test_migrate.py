@@ -126,6 +126,27 @@ def test_migration_parity_deepseek_mla(tiny):
     assert [de.collect(s) for s in slots] == want
 
 
+def test_submit_time_done_job_releases_its_pages(tiny):
+    """A bundle that arrives already done (max_new=1: the budget is
+    spent by prefill's first sampled token) never passes through a
+    decode chunk — so its pages must be released at submit time, not
+    leaked until the arena saturates and the replica rejects all
+    traffic."""
+    model, params = tiny
+    pe, de = _engines(model, params)
+    lt = LoopbackTransport()
+    baseline = de.pool.allocator.in_use
+    want = generate_text(
+        model, params, [[1, 5, 9]], max_new_tokens=1, sampling=GREEDY
+    )
+    slot = _migrate(pe, de, lt, [1, 5, 9], max_new=1)
+    assert de.pool.allocator.in_use == baseline, (
+        "submit-time-done job leaked its arena pages"
+    )
+    assert de.collect(slot) == want[0]
+    assert de.signals()["slots_active"] == 0
+
+
 def test_migration_adds_zero_decode_retraces(tiny):
     model, params = tiny
     pe, de = _engines(model, params)

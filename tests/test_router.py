@@ -14,6 +14,7 @@ from tpufw.cluster.discovery import discover_replicas
 from tpufw.serve.router import (
     ReplicaState,
     RouterPolicy,
+    RouterServer,
     WeightedFairQueue,
     _parse_weights,
 )
@@ -160,6 +161,107 @@ def test_prefill_pick_least_loaded_and_healthy():
     ]
     assert p.pick_prefill(replicas) == "p1"
     assert p.pick_prefill([r for r in replicas if not r.healthy]) is None
+
+
+# --------------------------------------------- server regressions
+#
+# RouterServer with stub replica clients — still no model and no jax;
+# the HTTP socket binds an ephemeral port but generate()/_admit() are
+# driven directly.
+
+class _StubPrefill:
+    def __init__(self, name, fail=False):
+        self.name = name
+        self.fail = fail
+        self.calls = 0
+
+    def signals(self):
+        return {
+            "role": "prefill", "pages_total": 8, "pages_in_use": 0,
+            "migrations": 0,
+        }
+
+    def prefill(self, prompt, max_new):
+        self.calls += 1
+        if self.fail:
+            raise RuntimeError("prefill replica down")
+        return b"TPFBstub"
+
+
+class _StubDecode:
+    def __init__(self, name, fail_decode=0):
+        self.name = name
+        self.fail_decode = fail_decode  # fail this many decode calls
+        self.calls = 0
+
+    def signals(self):
+        return {
+            "role": "decode", "pages_total": 40, "pages_in_use": 0,
+            "slots_total": 4, "slots_active": 0, "migrations": 0,
+        }
+
+    def decode(self, bundle):
+        self.calls += 1
+        if self.fail_decode > 0:
+            self.fail_decode -= 1
+            raise RuntimeError("decode replica down")
+        return {"tokens": [7, 8], **self.signals()}
+
+
+def test_proxy_error_blames_the_replica_that_failed():
+    # A prefill failure must take the PREFILL replica out of rotation
+    # — not the decode replica the request never reached.
+    pf, dc = _StubPrefill("p0", fail=True), _StubDecode("d0")
+    srv = RouterServer([pf], [dc], port=0)
+    try:
+        code, _body, _h = srv.generate({"prompt": [1, 2, 3], "max_new": 4})
+        assert code == 502
+        with srv._lock:
+            assert not srv._states["p0"].healthy
+            assert srv._states["d0"].healthy
+        assert dc.calls == 0
+    finally:
+        srv.close()
+
+
+def test_unhealthy_replica_recovers_after_reprobe():
+    # One transient decode failure must not remove the replica forever:
+    # with no pickable decode replica left, the router re-probes
+    # signals() and the next request completes.
+    pf, dc = _StubPrefill("p0"), _StubDecode("d0", fail_decode=1)
+    srv = RouterServer([pf], [dc], port=0)
+    try:
+        code, _body, _h = srv.generate({"prompt": [1], "max_new": 2})
+        assert code == 502
+        with srv._lock:
+            assert not srv._states["d0"].healthy
+        code, body, _h = srv.generate({"prompt": [1], "max_new": 2})
+        assert code == 200 and body["tokens"] == [7, 8]
+        with srv._lock:
+            assert srv._states["d0"].healthy
+    finally:
+        srv.close()
+
+
+def test_queue_timeout_does_not_leak_inflight_slots():
+    srv = RouterServer(
+        [_StubPrefill("p0")], [_StubDecode("d0")],
+        port=0, max_inflight=1,
+    )
+    try:
+        with srv._lock:
+            srv._inflight = 1  # a long-running request holds the slot
+        assert not srv._admit("t", 1.0, timeout=0.05)  # queue-wait timeout
+        srv._release()  # the long request completes
+        # The abandoned waiter's event is skipped by the pump: the
+        # slot stays free and a fresh request is admitted immediately.
+        with srv._lock:
+            assert srv._inflight == 0
+        assert srv._admit("t", 1.0, timeout=1.0)
+        with srv._lock:
+            assert srv._inflight == 1
+    finally:
+        srv.close()
 
 
 # ------------------------------------------------------- discovery

@@ -134,7 +134,18 @@ def decode_bundle(data: bytes) -> Dict[str, Any]:
     if paths and paths[-1] == "seen":
         seen = arrays.pop()
         paths.pop()
-    state: Dict[str, Any] = {k: header[k] for k in _META_FIELDS}
+    state: Dict[str, Any] = {}
+    for k in _META_FIELDS:
+        if k not in header:
+            raise BundleError(f"header missing meta field {k!r}")
+        state[k] = header[k]
+    for k in ("page", "n_pages", "token", "pos", "remaining",
+              "cache_index"):
+        if isinstance(state[k], bool) or not isinstance(state[k], int):
+            raise BundleError(
+                f"meta field {k!r} must be an integer, got "
+                f"{type(state[k]).__name__}"
+            )
     state["paths"] = paths
     state["arrays"] = arrays
     state["seen"] = seen

@@ -283,12 +283,19 @@ class DecodeEngine:
             except Exception:
                 self.pool.allocator.release(ids)
                 raise
-            self._jobs[slot] = {
+            job = {
                 "tokens": [int(state["token"])],
                 "budget": int(state["remaining"]),
                 "done": bool(state["done"])
                 or int(state["remaining"]) <= 0,
             }
+            self._jobs[slot] = job
+            if job["done"]:
+                # Prefill already finished this request (EOS as the
+                # first sampled token, or a zero budget): no decode
+                # chunk will ever retire the slot, so free its pages
+                # here or they leak until the arena saturates.
+                self.pool.release_slot(slot)
             self.migrations += 1
             self.migration_bytes += len(data)
             self._cv.notify_all()

@@ -329,6 +329,7 @@ class RouterServer:
         self._events = events if events is not None else obs_events.NULL
         self._lock = threading.Lock()
         self._inflight = 0
+        self._last_reprobe = time.monotonic()
         self._states: Dict[str, ReplicaState] = {}
         for client in self._prefill:
             self._states[client.name] = ReplicaState(client.name, "prefill")
@@ -397,6 +398,32 @@ class RouterServer:
                 continue
             self._states[client.name].update(sig, now=time.monotonic())
 
+    #: Seconds between opportunistic re-probes of unhealthy replicas.
+    REPROBE_INTERVAL_S = 2.0
+
+    def _reprobe_unhealthy(self, force: bool = False) -> None:
+        """Second chance for replicas a failed call took out of
+        rotation: a live ``signals()`` probe puts them back. Without
+        this, one transient error removes a replica forever. Runs at
+        most once per interval unless forced (no pickable replica
+        left, so a probe is cheaper than a spurious 429/503)."""
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_reprobe < self.REPROBE_INTERVAL_S:
+                return
+            self._last_reprobe = now
+            down = [
+                c for c in self._prefill + self._decode
+                if not self._states[c.name].healthy
+            ]
+        for client in down:
+            try:
+                sig = client.signals()
+            except Exception:  # noqa: BLE001 — still down
+                continue
+            with self._lock:
+                self._states[client.name].update(sig, now=time.monotonic())
+
     def _snapshot(self, role: str) -> List[ReplicaState]:
         with self._lock:
             return [
@@ -448,15 +475,28 @@ class RouterServer:
     def _pump_locked(self) -> None:
         while self._inflight < self.max_inflight and len(self.policy.queue):
             ev = self.policy.queue.pop()
+            if getattr(ev, "abandoned", False):
+                # The waiter timed out and left; granting its slot
+                # would leak it (nobody would _release). Skip.
+                continue
             self._inflight += 1
             ev.set()
 
     def _admit(self, tenant: str, cost: float, timeout: float) -> bool:
         ev = threading.Event()
+        ev.abandoned = False
         with self._lock:
             self.policy.queue.push(tenant, cost, ev)
             self._pump_locked()
-        return ev.wait(timeout)
+        if ev.wait(timeout):
+            return True
+        with self._lock:
+            if ev.is_set():
+                # A pump granted the slot between the wait timing out
+                # and us taking the lock — the slot is ours after all.
+                return True
+            ev.abandoned = True
+        return False
 
     def _release(self) -> None:
         with self._lock:
@@ -464,6 +504,21 @@ class RouterServer:
             self._pump_locked()
 
     # ---- the proxy path -------------------------------------------
+
+    def _pick(
+        self, session: str, n_pages: int
+    ) -> Tuple[Optional[str], Optional[str], str]:
+        """(decode_name, prefill_name, reject_reason) under the lock."""
+        with self._lock:
+            name, reason = self.policy.pick_decode(
+                session,
+                [r for r in self._states.values() if r.role == "decode"],
+                n_pages,
+            )
+            pname = self.policy.pick_prefill(
+                [r for r in self._states.values() if r.role == "prefill"]
+            )
+        return name, pname, reason
 
     def generate(self, req: dict) -> Tuple[int, dict, tuple]:
         """One request through WFQ → admission → prefill → migrate →
@@ -484,17 +539,14 @@ class RouterServer:
         if not self._admit(tenant, cost, timeout=600.0):
             return 503, {"error": "queue wait timed out"}, ()
         try:
-            with self._lock:
-                decode_states = [
-                    r for r in self._states.values() if r.role == "decode"
-                ]
-                name, reason = self.policy.pick_decode(
-                    session, decode_states, n_pages
-                )
-                pname = self.policy.pick_prefill(
-                    [r for r in self._states.values()
-                     if r.role == "prefill"]
-                )
+            self._reprobe_unhealthy()
+            name, pname, reason = self._pick(session, n_pages)
+            if name is None or pname is None:
+                # Everything pickable may just be marked unhealthy
+                # from a transient failure — force a probe and retry
+                # once before turning traffic away.
+                self._reprobe_unhealthy(force=True)
+                name, pname, reason = self._pick(session, n_pages)
             if name is None:
                 self._metrics.inc("rejects_total")
                 self._events.emit(
@@ -513,8 +565,18 @@ class RouterServer:
                 return 503, {"error": "no healthy prefill replica"}, ()
             pclient = next(c for c in self._prefill if c.name == pname)
             dclient = next(c for c in self._decode if c.name == name)
+            # Mark the replica whose call actually raised — blaming
+            # the decode replica for a prefill failure takes a healthy
+            # replica out of rotation while the broken one keeps
+            # receiving traffic.
             try:
                 bundle = pclient.prefill(prompt, max_new)
+            except Exception as e:  # noqa: BLE001 — proxy boundary
+                self._metrics.inc("proxy_errors_total")
+                with self._lock:
+                    self._states[pname].healthy = False
+                return 502, {"error": f"{type(e).__name__}: {e}"}, ()
+            try:
                 out = dclient.decode(bundle)
             except Exception as e:  # noqa: BLE001 — proxy boundary
                 self._metrics.inc("proxy_errors_total")
