@@ -168,6 +168,77 @@ def summarize_router(paths: list[str]) -> None:
         )
 
 
+def summarize_slo(paths: list[str]) -> None:
+    """Per-tenant SLO attainment table plus a slowest-requests digest
+    with the per-stage TTFT breakdown (both from router events —
+    router_request carries ttft_s/stages, slo_violation carries the
+    missed targets). Prints nothing for runs without routed
+    requests."""
+    events = []
+    for p in paths:
+        try:
+            events.extend(read_events(p))
+        except OSError:
+            continue
+    requests = [e for e in events if e.get("kind") == "router_request"]
+    violations = [e for e in events if e.get("kind") == "slo_violation"]
+    if not requests and not violations:
+        return
+    print("-- SLO attainment --")
+    viol_by = collections.Counter(
+        (e.get("tenant", "?"), e.get("metric", "?")) for e in violations
+    )
+    tenants = sorted(
+        {e.get("tenant", "?") for e in requests}
+        | {t for t, _m in viol_by}
+    )
+    print(
+        f"  {'tenant':<12} {'req':>5} {'ttft_p50':>9} {'ttft_p95':>9} "
+        f"{'viol ttft':>9} {'viol tok':>8} {'attain':>7}"
+    )
+    for tenant in tenants:
+        rows = [e for e in requests if e.get("tenant", "?") == tenant]
+        ttfts = sorted(
+            e["ttft_s"]
+            for e in rows
+            if isinstance(e.get("ttft_s"), (int, float))
+        )
+        n = len(rows)
+        v_ttft = viol_by.get((tenant, "ttft"), 0)
+        v_tok = viol_by.get((tenant, "tok"), 0)
+        attain = (n - v_ttft) / n if n else 0.0
+        print(
+            f"  {tenant:<12} {n:>5} "
+            f"{_fmt_s(_percentile(ttfts, 0.5)):>9} "
+            f"{_fmt_s(_percentile(ttfts, 0.95)):>9} "
+            f"{v_ttft:>9} {v_tok:>8} {attain:>6.1%}"
+        )
+    timed = [
+        e for e in requests
+        if isinstance(e.get("latency_s"), (int, float))
+    ]
+    if timed:
+        print("-- slowest requests --")
+        timed.sort(key=lambda e: -e["latency_s"])
+        for e in timed[:3]:
+            trace = str(e.get("trace", ""))[:8] or "-"
+            ttft = e.get("ttft_s")
+            ttft_s = _fmt_s(ttft) if isinstance(ttft, (int, float)) else "-"
+            line = (
+                f"  trace={trace} tenant={e.get('tenant', '?')} "
+                f"total {_fmt_s(e['latency_s'])} ttft {ttft_s}"
+            )
+            stages = e.get("stages")
+            if isinstance(stages, dict) and stages:
+                parts = [
+                    f"{k} {_fmt_s(float(v))}"
+                    for k, v in stages.items()
+                    if isinstance(v, (int, float))
+                ]
+                line += " | " + " · ".join(parts)
+            print(line)
+
+
 def summarize_trace(paths: list[str]) -> None:
     totals: collections.Counter = collections.Counter()
     counts: collections.Counter = collections.Counter()
@@ -253,6 +324,10 @@ def summarize_metrics(path: str) -> None:
         "tpufw_router_requests_total",
         "tpufw_router_rejects_total",
         "tpufw_router_decode_pages_free",
+        "tpufw_slo_ttft_attainment",
+        "tpufw_slo_tok_attainment",
+        "tpufw_slo_requests_total",
+        "tpufw_slo_violations_total",
         "tpufw_goodput_ratio",
         "tpufw_run_info",
     )
@@ -365,6 +440,7 @@ def main(argv: list[str]) -> int:
     print("-- events --")
     summarize_events(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
     summarize_router(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
+    summarize_slo(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
     print("-- spans (total time) --")
     summarize_trace(sorted(glob.glob(os.path.join(out, "trace*.json"))))
     gp = sorted(glob.glob(os.path.join(out, "goodput*.json")))

@@ -14,10 +14,19 @@ cluster. What must hold:
 - a router fronting an artificially page-capped decode replica
   answers an oversized request with 429 + Retry-After (admission
   control, not a stall), while a small request still lands;
+- request tracing stitches: the three per-role trace files merge
+  (scripts/trace_merge.py) into per-request flame rows where one
+  request's spans cross router, prefill, AND decode under one
+  trace_id with monotone aligned timestamps, and the per-stage
+  durations sum (within slack) to the router-observed TTFT;
+- the SLO layer scores the run: /metrics exposes
+  tpufw_slo_ttft_attainment with a per-tenant label, and
+  obs_summary prints the SLO attainment table;
 - the router ledger (events-router.jsonl) digests cleanly through
   scripts/obs_summary.py, and /metrics exposes the router counters.
 
 Exit 0 on success; any assertion or HTTP failure exits nonzero.
+Honors TPUFW_TELEMETRY_DIR so CI can upload the artifacts.
 """
 
 import dataclasses
@@ -62,6 +71,7 @@ def main() -> int:
     from tpufw.infer import SamplingConfig
     from tpufw.models import LLAMA_CONFIGS, Llama
     from tpufw.obs.events import EventLog, read_events
+    from tpufw.obs.trace import Tracer
     from tpufw.serve.roles import DecodeEngine, PrefillEngine
     from tpufw.serve.router import LocalReplica, RouterServer
 
@@ -74,8 +84,21 @@ def main() -> int:
         jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
 
-    tdir = tempfile.mkdtemp(prefix="tpufw-router-smoke-")
+    from tpufw.workloads.env import env_opt_str
+
+    tdir = env_opt_str("telemetry_dir") or tempfile.mkdtemp(
+        prefix="tpufw-router-smoke-"
+    )
+    os.makedirs(tdir, exist_ok=True)
     events = EventLog(os.path.join(tdir, "events-router.jsonl"))
+    # One tracer per role, exactly as the three pods would write them;
+    # trace_merge stitches these by trace_id below.
+    tracers = {
+        role: Tracer(
+            os.path.join(tdir, f"trace-{role}.json"), process_name=role
+        )
+        for role in ("router", "prefill", "decode")
+    }
     failures: list[str] = []
 
     def check(ok: bool, what: str) -> None:
@@ -85,16 +108,19 @@ def main() -> int:
 
     common = dict(sampling=greedy, page=PAGE, kv_quant="int8",
                   events=events)
-    pe = PrefillEngine(model, params, n_slots=2, **common)
-    de = DecodeEngine(model, params, n_slots=4, chunk=2, **common)
+    pe = PrefillEngine(model, params, n_slots=2,
+                       tracer=tracers["prefill"], **common)
+    de = DecodeEngine(model, params, n_slots=4, chunk=2,
+                      tracer=tracers["decode"], **common)
     router = RouterServer(
         [LocalReplica("prefill-0", pe)],
         [LocalReplica("decode-0", de)],
-        port=0, page=PAGE, events=events,
+        port=0, page=PAGE, events=events, tracer=tracers["router"],
     )
     base = f"http://127.0.0.1:{router.port}"
 
     # ---- prefix-shared pair, completed through migration ----
+    first_body: dict = {}
     shared = list(range(40, 72))  # 32 tokens = 2 full pages in the trie
     for i, tail in enumerate(([7, 9], [11, 3])):
         status, body, _h = _post(base, {
@@ -103,6 +129,8 @@ def main() -> int:
         })
         check(status == 200, f"request {i} routed (got {status}: {body})")
         if status == 200:
+            if not first_body:
+                first_body = body
             check(
                 len(body["tokens"]) == MAX_NEW,
                 f"request {i} decoded {MAX_NEW} tokens through migration "
@@ -154,12 +182,85 @@ def main() -> int:
         f"small request still fits the capped arena (got {status})",
     )
 
-    # ---- ledger digests + router counters on /metrics ----
+    # ---- request tracing: merge per-role traces, check the stitch ----
+    for tr in tracers.values():
+        tr.close()
+    scripts_dir = os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(scripts_dir, "trace_merge.py"),
+         tdir],
+        capture_output=True, text=True, timeout=120,
+    )
+    print(proc.stdout, end="")
+    reqs_path = os.path.join(tdir, "trace-requests.json")
+    check(
+        proc.returncode == 0 and os.path.exists(reqs_path),
+        "trace_merge produced the per-request flame rows",
+    )
+    tid = str(first_body.get("trace", ""))
+    spans_by_name: dict = {}
+    roles_hit: set = set()
+    if os.path.exists(reqs_path):
+        with open(reqs_path, encoding="utf-8") as f:
+            reqdoc = json.load(f)
+        summary = reqdoc.get("otherData", {}).get("requests", {})
+        entry = summary.get(tid, {})
+        check(
+            len(entry.get("roles", [])) >= 3,
+            f"request {tid[:8]} has spans from all three roles "
+            f"under one trace_id (roles={entry.get('roles')}, "
+            f"spans={entry.get('spans')})",
+        )
+        with open(os.path.join(tdir, "trace-merged.json"),
+                  encoding="utf-8") as f:
+            merged = json.load(f)
+        for ev in merged.get("traceEvents", []):
+            if (
+                ev.get("ph") == "X"
+                and (ev.get("args") or {}).get("trace") == tid
+            ):
+                spans_by_name.setdefault(ev["name"], []).append(ev)
+                roles_hit.add(ev.get("pid"))
+        causal = [
+            "req_queue_wait", "req_prefill_compute",
+            "req_splice", "req_first_token",
+        ]
+        check(
+            all(n in spans_by_name for n in causal),
+            f"per-stage spans present for {tid[:8]} "
+            f"({sorted(spans_by_name)})",
+        )
+        starts = [
+            min(e["ts"] for e in spans_by_name[n])
+            for n in causal if n in spans_by_name
+        ]
+        # Aligned clocks are wall-quality: allow 1ms of jitter, the
+        # stages themselves are orders of magnitude longer on CPU.
+        check(
+            all(b >= a - 1000.0 for a, b in zip(starts, starts[1:])),
+            f"aligned stage timestamps are monotone ({starts})",
+        )
+    stages = first_body.get("stages", {})
+    ttft = float(first_body.get("ttft_s", 0.0))
+    stage_sum = sum(
+        float(v) for k, v in stages.items() if k != "first_decode"
+    )
+    check(
+        ttft > 0.0 and abs(stage_sum - ttft) <= max(0.05, 0.25 * ttft),
+        f"per-stage durations sum to the router TTFT "
+        f"(sum={stage_sum:.4f}s vs ttft={ttft:.4f}s, stages={stages})",
+    )
+
+    # ---- ledger digests + router/SLO series on /metrics ----
     with urllib.request.urlopen(base + "/metrics", timeout=60) as resp:
         metrics = resp.read().decode()
     check(
         "tpufw_router_requests_total 2" in metrics,
         "router counted its 2 routed requests on /metrics",
+    )
+    check(
+        'tpufw_slo_ttft_attainment{tenant="smoke"}' in metrics,
+        "SLO attainment gauge scrapes with the per-tenant label",
     )
     proc = subprocess.run(
         [sys.executable,
@@ -171,8 +272,9 @@ def main() -> int:
     print(proc.stdout, end="")
     check(
         proc.returncode == 0 and "router / migration" in proc.stdout
-        and "rejected" in proc.stdout,
-        "obs_summary digests the router ledger",
+        and "rejected" in proc.stdout
+        and "SLO attainment" in proc.stdout,
+        "obs_summary digests the router ledger + SLO table",
     )
 
     router.close()

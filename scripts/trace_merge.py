@@ -34,14 +34,16 @@ import sys
 from typing import List, Optional, Tuple
 
 MERGED_BASENAME = "trace-merged.json"
+REQUESTS_BASENAME = "trace-requests.json"
 
 
 def discover(path: str) -> List[str]:
     """Trace files in a telemetry dir: trace.json, trace-p*.json,
-    trace-serve.json — everything matching trace*.json except a
-    previous merge output."""
+    trace-serve.json, trace-{router,prefill,decode}.json — everything
+    matching trace*.json except previous merge outputs."""
     hits = sorted(glob.glob(os.path.join(path, "trace*.json")))
-    return [h for h in hits if os.path.basename(h) != MERGED_BASENAME]
+    skip = {MERGED_BASENAME, REQUESTS_BASENAME}
+    return [h for h in hits if os.path.basename(h) not in skip]
 
 
 def load_trace(path: str) -> Optional[dict]:
@@ -133,6 +135,96 @@ def merge(
     }
 
 
+def request_rows(merged: dict) -> dict:
+    """Regroup an already-aligned merged document into per-request
+    flame rows: every complete span carrying a reqtrace correlation
+    (``args.trace``) lands on a track named for its trace_id, with one
+    sub-row (tid) per source role. Loading the result in Perfetto
+    shows each request as one left-to-right cascade — queue_wait →
+    admit → prefill stages → wire → splice → decode chunks — instead
+    of three disjoint per-process timelines.
+
+    Returns a Perfetto-loadable doc; its ``otherData.requests`` maps
+    trace_id -> {"spans": N, "roles": [source pids], "tenant": ...}
+    (the CI smoke asserts one request's spans cross all three
+    roles)."""
+    # Source-file labels from the merged metadata: pid -> name.
+    src_names = {}
+    for ev in merged.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            src_names[ev.get("pid")] = (ev.get("args") or {}).get(
+                "name", str(ev.get("pid"))
+            )
+    by_trace: dict = {}
+    for ev in merged.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args")
+        if not isinstance(args, dict) or "trace" not in args:
+            continue
+        by_trace.setdefault(str(args["trace"]), []).append(ev)
+    events: List[dict] = []
+    summary: dict = {}
+    ordered = sorted(
+        by_trace.items(),
+        key=lambda kv: min(e.get("ts", 0.0) for e in kv[1]),
+    )
+    for pid, (trace_id, evs) in enumerate(ordered):
+        evs = sorted(evs, key=lambda e: e.get("ts", 0.0))
+        tenant = next(
+            (
+                e["args"].get("tenant")
+                for e in evs
+                if e["args"].get("tenant")
+            ),
+            "",
+        )
+        label = f"req {trace_id[:8]}"
+        if tenant:
+            label += f" [{tenant}]"
+        events.append(
+            {
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": label},
+            }
+        )
+        roles = sorted({e.get("pid", 0) for e in evs})
+        for src_pid in roles:
+            events.append(
+                {
+                    "name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": src_pid,
+                    "args": {
+                        "name": src_names.get(src_pid, str(src_pid))
+                    },
+                }
+            )
+        for ev in evs:
+            out = dict(ev)
+            out["tid"] = ev.get("pid", 0)  # sub-row = source role
+            out["pid"] = pid
+            events.append(out)
+        summary[trace_id] = {
+            "spans": len(evs),
+            "roles": roles,
+            "tenant": tenant,
+            "start_ts": evs[0].get("ts", 0.0),
+            "end_ts": max(
+                e.get("ts", 0.0) + e.get("dur", 0.0) for e in evs
+            ),
+        }
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "wall_epoch_s": (merged.get("otherData") or {}).get(
+                "wall_epoch_s", 0.0
+            ),
+            "requests": summary,
+        },
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument(
@@ -171,6 +263,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     os.replace(tmp, out)
     n_ev = len(merged["traceEvents"])
     print(f"trace_merge: {len(docs)} file(s), {n_ev} events -> {out}")
+    reqdoc = request_rows(merged)
+    n_req = len(reqdoc["otherData"]["requests"])
+    if n_req:
+        req_out = os.path.join(
+            os.path.dirname(out) or ".", REQUESTS_BASENAME
+        )
+        tmp = req_out + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(reqdoc, f)
+        os.replace(tmp, req_out)
+        print(
+            f"trace_merge: {n_req} traced request(s) -> {req_out}"
+        )
     return 0
 
 

@@ -160,6 +160,51 @@ def test_crash_bundle_summarized(summary, tmp_path, capsys):
     assert "fault-p0.log: non-empty faulthandler log" in out
 
 
+def test_slo_table_and_slowest_requests(summary, tmp_path, capsys):
+    stages = {
+        "queue_wait": 0.001, "admit": 0.0, "prefill_queue": 0.0,
+        "prefill_admit": 0.002, "prefill_compute": 0.5,
+        "page_export": 0.05, "wire": 0.01, "splice": 0.04,
+        "first_decode": 0.1,
+    }
+    _write_events(
+        tmp_path / "events-router.jsonl",
+        [
+            {
+                "kind": "router_request", "tenant": "vip",
+                "replica": "d0", "latency_s": 1.2, "trace": "a" * 16,
+                "ttft_s": 0.603, "n_tokens": 8, "stages": stages,
+            },
+            {
+                "kind": "router_request", "tenant": "vip",
+                "replica": "d0", "latency_s": 0.3, "trace": "b" * 16,
+                "ttft_s": 0.1, "n_tokens": 8, "stages": stages,
+            },
+            {
+                "kind": "slo_violation", "tenant": "vip",
+                "metric": "ttft", "value_ms": 603.0,
+                "target_ms": 500.0, "trace": "a" * 16,
+            },
+        ],
+    )
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- SLO attainment --" in out
+    assert "vip" in out and "50.0%" in out
+    assert "-- slowest requests --" in out
+    # Worst request first, trace id + stage breakdown inline.
+    assert "trace=aaaaaaaa" in out
+    assert "prefill_compute 500.0ms" in out
+
+
+def test_no_router_events_prints_no_slo_section(summary, tmp_path, capsys):
+    _write_events(
+        tmp_path / "events.jsonl", [{"kind": "run_start", "workload": "t"}]
+    )
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    assert "SLO attainment" not in capsys.readouterr().out
+
+
 def test_torn_manifest_marked_incomplete(summary, tmp_path, capsys):
     bundle = tmp_path / "crash-bundle-p0"
     bundle.mkdir()

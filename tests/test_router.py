@@ -181,8 +181,9 @@ class _StubPrefill:
             "migrations": 0,
         }
 
-    def prefill(self, prompt, max_new):
+    def prefill(self, prompt, max_new, trace=None):
         self.calls += 1
+        self.last_trace = trace
         if self.fail:
             raise RuntimeError("prefill replica down")
         return b"TPFBstub"
@@ -260,6 +261,87 @@ def test_queue_timeout_does_not_leak_inflight_slots():
         assert srv._admit("t", 1.0, timeout=1.0)
         with srv._lock:
             assert srv._inflight == 1
+    finally:
+        srv.close()
+
+
+def test_healthz_reports_per_replica_detail():
+    srv = RouterServer([_StubPrefill("p0")], [_StubDecode("d0")], port=0)
+    try:
+        h = srv.health()
+        assert h["ok"] is True and h["inflight"] == 0
+        assert set(h["replicas"]) == {"p0", "d0"}
+        d0 = h["replicas"]["d0"]
+        assert d0["role"] == "decode" and d0["healthy"] is True
+        # Probed at startup: the staleness clock is running.
+        assert d0["last_probe_age_s"] is not None
+        assert d0["last_probe_age_s"] >= 0.0
+        assert isinstance(d0["score"], float)
+        assert d0["pages_total"] == 40 and d0["slots_total"] == 4
+        assert h["replicas"]["p0"]["role"] == "prefill"
+        # A failed replica shows up by name, unhealthy.
+        with srv._lock:
+            srv._states["d0"].healthy = False
+        h = srv.health()
+        assert h["ok"] is False  # decode coverage gone
+        assert h["replicas"]["d0"]["healthy"] is False
+        assert h["replicas"]["p0"]["healthy"] is True
+    finally:
+        srv.close()
+
+
+def test_generate_reports_trace_ttft_and_stage_breakdown():
+    srv = RouterServer([_StubPrefill("p0")], [_StubDecode("d0")], port=0)
+    try:
+        code, body, headers = srv.generate(
+            {"prompt": [1, 2, 3], "max_new": 4, "tenant": "vip"}
+        )
+        assert code == 200
+        # Correlation identity on the response, body and header both.
+        assert len(body["trace"]) == 16
+        hdr = dict(headers)["X-TPUFW-Trace"]
+        assert hdr.startswith(body["trace"] + "-")
+        assert hdr.endswith("-vip")
+        # The stage map sums to the reported TTFT by construction
+        # (first_decode is decode-side and excluded from the sum).
+        stages = body["stages"]
+        ssum = sum(v for k, v in stages.items() if k != "first_decode")
+        assert body["ttft_s"] == pytest.approx(ssum, abs=1e-3)
+        assert body["ttft_s"] > 0.0
+        # Stub bundles carry no engine stages: the whole prefill RTT
+        # falls back into prefill_compute, never silently dropped.
+        assert stages["prefill_compute"] > 0.0
+        assert stages["wire"] == 0.0
+        # The request was judged against the SLO, labeled by tenant.
+        text = srv.render_metrics()
+        assert 'tpufw_slo_requests_total{tenant="vip"} 1' in text
+        assert 'tpufw_slo_ttft_attainment{tenant="vip"} 1' in text
+    finally:
+        srv.close()
+
+
+def test_inbound_trace_header_is_adopted_not_reminted():
+    from tpufw.obs import reqtrace
+
+    pf = _StubPrefill("p0")
+    srv = RouterServer([pf], [_StubDecode("d0")], port=0)
+    try:
+        ctx = reqtrace.mint("vip")
+        code, body, headers = srv.generate(
+            {"prompt": [1], "max_new": 2, "tenant": "vip"},
+            trace_header=ctx.wire(),
+        )
+        assert code == 200
+        # The upstream trace id survives into the body, the echoed
+        # header, and the control frame the prefill replica saw.
+        assert body["trace"] == ctx.trace_id
+        assert dict(headers)["X-TPUFW-Trace"].startswith(ctx.trace_id)
+        assert pf.last_trace.startswith(ctx.trace_id + "-")
+        # A garbage header mints fresh instead of failing the request.
+        code, body, _h = srv.generate(
+            {"prompt": [1], "max_new": 2}, trace_header="not a trace"
+        )
+        assert code == 200 and body["trace"] != ctx.trace_id
     finally:
         srv.close()
 

@@ -1,0 +1,190 @@
+"""Per-tenant SLO tracking (tpufw.obs.slo): attainment math over
+sliding windows, multi-window burn rates, per-tenant target
+overrides, and the schema'd violation events. A fake clock drives the
+windows — no sleeps, no jax.
+"""
+
+import pytest
+
+from tpufw.obs.events import EventLog, read_events
+from tpufw.obs.registry import Registry
+from tpufw.obs.slo import (
+    DEFAULT_WINDOWS,
+    SloTracker,
+    parse_tenant_targets,
+)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _tracker(**kw):
+    clock = _Clock()
+    reg = Registry()
+    kw.setdefault("ttft_ms", 100.0)
+    kw.setdefault("tok_ms", 10.0)
+    kw.setdefault("goal", 0.9)
+    tr = SloTracker(reg, clock=clock, **kw)
+    return tr, reg, clock
+
+
+# ------------------------------------------------------------ parsing
+
+def test_parse_tenant_targets_skips_malformed():
+    assert parse_tenant_targets("vip:500:50, batch:10000:1000") == {
+        "vip": (500.0, 50.0), "batch": (10000.0, 1000.0),
+    }
+    # Wrong arity, non-numeric, empty — all dropped, none fatal.
+    assert parse_tenant_targets("a:1, b:x:2, c:3:4:5, :6:7,") == {
+        "": (6.0, 7.0),
+    }
+    assert parse_tenant_targets("") == {}
+
+
+def test_bad_config_rejected():
+    reg = Registry()
+    with pytest.raises(ValueError, match="goal"):
+        SloTracker(reg, goal=1.0)
+    with pytest.raises(ValueError, match="windows"):
+        SloTracker(Registry(), windows=())
+
+
+# --------------------------------------------------------- attainment
+
+def test_attainment_counts_good_over_total():
+    tr, _reg, _clock = _tracker()
+    for ttft in (0.05, 0.05, 0.05, 0.2):  # 3 good, 1 over 100ms
+        tr.observe("t", ttft, tok_s=0.005)
+    assert tr.attainment("t", "ttft") == pytest.approx(0.75)
+    assert tr.attainment("t", "tok") == pytest.approx(1.0)
+    # Empty window = full attainment: no traffic has burned no budget.
+    assert tr.attainment("idle-tenant", "ttft") == 1.0
+
+
+def test_single_token_requests_skip_tok_judgment():
+    tr, _reg, _clock = _tracker()
+    tr.observe("t", 0.05, tok_s=None)  # 1 token: no decode tail
+    tr.observe("t", 0.05, tok_s=0.5)   # 50x over the 10ms target
+    assert tr.attainment("t", "ttft") == 1.0
+    # Only the judged request counts in the tok denominator.
+    assert tr.attainment("t", "tok") == pytest.approx(0.0)
+
+
+def test_per_tenant_targets_override_defaults():
+    tr, _reg, _clock = _tracker(tenants={"vip": (10.0, 1.0)})
+    assert tr.targets_for("vip") == (10.0, 1.0)
+    assert tr.targets_for("anyone") == (100.0, 10.0)
+    tr.observe("vip", 0.05)     # misses vip's 10ms, within default
+    tr.observe("anyone", 0.05)  # same latency, different verdict
+    assert tr.attainment("vip", "ttft") == 0.0
+    assert tr.attainment("anyone", "ttft") == 1.0
+
+
+# ------------------------------------------------- windows + burn rate
+
+def test_violations_age_out_of_the_window():
+    tr, _reg, clock = _tracker(windows=(10.0, 100.0))
+    tr.observe("t", 5.0)  # violation at t=1000
+    clock.t += 50.0
+    for _ in range(3):
+        tr.observe("t", 0.01)
+    # Short window no longer sees the violation; long window does.
+    assert tr.attainment("t", "ttft", window=10.0) == 1.0
+    assert tr.attainment("t", "ttft", window=100.0) == pytest.approx(0.75)
+    # Past the longest window the observation is pruned entirely.
+    clock.t += 100.0
+    tr.observe("t", 0.01)
+    assert tr.attainment("t", "ttft", window=100.0) == 1.0
+
+
+def test_multi_window_burn_rates():
+    tr, reg, clock = _tracker(windows=(10.0, 100.0), goal=0.9)
+    # Old traffic: 8 good requests, 60s ago.
+    for _ in range(8):
+        tr.observe("t", 0.01)
+    clock.t += 60.0
+    # Fresh blip: 2 violations inside the 10s window.
+    tr.observe("t", 5.0)
+    tr.observe("t", 5.0)
+    # 10s window: 0/2 good -> burn = (1-0)/(1-0.9) = 10x.
+    assert tr.burn_rate("t", "ttft", window=10.0) == pytest.approx(10.0)
+    # 100s window: 8/10 good -> burn = 0.2/0.1 = 2x.
+    assert tr.burn_rate("t", "ttft", window=100.0) == pytest.approx(2.0)
+    text = reg.render()
+    assert (
+        'tpufw_slo_burn_rate{metric="ttft",tenant="t",window="10s"} 10'
+        in text
+    )
+    assert (
+        'tpufw_slo_burn_rate{metric="ttft",tenant="t",window="100s"} 2'
+        in text
+    )
+
+
+# ------------------------------------------------ metrics + events out
+
+def test_gauges_and_counters_render_with_tenant_labels():
+    tr, reg, _clock = _tracker()
+    tr.observe("vip", 0.05, tok_s=0.005)
+    tr.observe("vip", 0.2, tok_s=0.05)  # misses both targets
+    text = reg.render()
+    assert 'tpufw_slo_requests_total{tenant="vip"} 2' in text
+    assert (
+        'tpufw_slo_violations_total{metric="ttft",tenant="vip"} 1'
+        in text
+    )
+    assert (
+        'tpufw_slo_violations_total{metric="tok",tenant="vip"} 1'
+        in text
+    )
+    assert 'tpufw_slo_ttft_attainment{tenant="vip"} 0.5' in text
+    assert 'tpufw_slo_tok_attainment{tenant="vip"} 0.5' in text
+    # Histograms carry the raw latency distribution per tenant.
+    assert 'tpufw_slo_ttft_seconds_count{tenant="vip"} 2' in text
+    assert 'tpufw_slo_tok_seconds_count{tenant="vip"} 2' in text
+    # The empty tenant buckets into "default".
+    tr.observe("", 0.01)
+    assert 'tpufw_slo_ttft_attainment{tenant="default"} 1' in reg.render()
+
+
+def test_violation_events_pass_schema_and_carry_trace(tmp_path):
+    # Through a real EventLog, so the slo_violation SCHEMA entry is
+    # what's actually validated at emit time.
+    path = tmp_path / "events.jsonl"
+    log = EventLog(str(path))
+    tr, _reg, _clock = _tracker(events=log)
+    tr.observe("vip", 0.05)          # good: no event
+    tr.observe("vip", 0.25, trace="deadbeefdeadbeef")
+    log.close()
+    evs = [e for e in read_events(str(path))
+           if e["kind"] == "slo_violation"]
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["level"] == "warn" and ev["tenant"] == "vip"
+    assert ev["metric"] == "ttft"
+    assert ev["value_ms"] == pytest.approx(250.0)
+    assert ev["target_ms"] == 100.0
+    assert ev["trace"] == "deadbeefdeadbeef"
+
+
+def test_from_env_reads_knobs(monkeypatch):
+    monkeypatch.setenv("TPUFW_SLO_TTFT_MS", "500")
+    monkeypatch.setenv("TPUFW_SLO_TOK_MS", "50")
+    monkeypatch.setenv("TPUFW_SLO_GOAL", "0.95")
+    monkeypatch.setenv("TPUFW_SLO_WINDOWS_S", "30,600")
+    monkeypatch.setenv("TPUFW_SLO_TENANTS", "vip:100:10")
+    tr = SloTracker.from_env(Registry())
+    assert tr.ttft_ms == 500.0 and tr.tok_ms == 50.0
+    assert tr.goal == 0.95 and tr.windows == (30.0, 600.0)
+    assert tr.targets_for("vip") == (100.0, 10.0)
+    for var in ("TPUFW_SLO_TTFT_MS", "TPUFW_SLO_TOK_MS",
+                "TPUFW_SLO_GOAL", "TPUFW_SLO_WINDOWS_S",
+                "TPUFW_SLO_TENANTS"):
+        monkeypatch.delenv(var)
+    tr = SloTracker.from_env(Registry())
+    assert tr.ttft_ms == 2000.0 and tr.windows == DEFAULT_WINDOWS

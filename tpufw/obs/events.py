@@ -48,6 +48,9 @@ SCHEMA: Dict[str, FrozenSet[str]] = {
     "serve_migration": frozenset({"pages", "bytes", "wall_s"}),
     "router_request": frozenset({"tenant", "replica", "latency_s"}),
     "router_reject": frozenset({"tenant", "reason"}),
+    "slo_violation": frozenset(
+        {"tenant", "metric", "value_ms", "target_ms"}
+    ),
     "goodput": frozenset({"wall_s", "goodput_ratio"}),
     "hang": frozenset({"timeout_s", "armed_for_s"}),
 }

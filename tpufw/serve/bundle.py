@@ -59,7 +59,10 @@ def _np_dtype(name: str) -> np.dtype:
 def encode_bundle(state: Dict[str, Any]) -> bytes:
     """Serialize an ``export_slot`` state dict. The optional ``seen``
     row (repetition-penalty mask) travels as one more manifest entry
-    under the reserved path ``"seen"``."""
+    under the reserved path ``"seen"``. An optional ``trace`` dict
+    (request-trace meta + per-stage timings, tpufw.obs.reqtrace)
+    rides in the header; decoders that predate it ignore unknown
+    header keys, so VERSION stays 1."""
     arrays = [np.ascontiguousarray(a) for a in state["arrays"]]
     paths = [str(p) for p in state["paths"]]
     if state.get("seen") is not None:
@@ -78,6 +81,8 @@ def encode_bundle(state: Dict[str, Any]) -> bytes:
         "arrays": manifest,
         **{k: state[k] for k in _META_FIELDS},
     }
+    if isinstance(state.get("trace"), dict):
+        header["trace"] = state["trace"]
     hjson = json.dumps(header, sort_keys=True).encode("utf-8")
     parts = [MAGIC, struct.pack(">HI", VERSION, len(hjson)), hjson]
     parts.extend(a.tobytes() for a in arrays)
@@ -149,4 +154,25 @@ def decode_bundle(data: bytes) -> Dict[str, Any]:
     state["paths"] = paths
     state["arrays"] = arrays
     state["seen"] = seen
+    # Absent on bundles from pre-trace producers — still a valid
+    # bundle, the request just has no cross-role correlation.
+    trace = header.get("trace")
+    state["trace"] = trace if isinstance(trace, dict) else None
     return state
+
+
+def peek_trace(data: bytes) -> "Dict[str, Any] | None":
+    """Header-only read of the trace meta — no array parsing, no CRC
+    walk over the (multi-MB) body, never raises. The router uses this
+    to pull engine-reported stage timings out of a bundle it otherwise
+    treats as opaque bytes, including bundles that would fail full
+    decode (so a request that dies in flight still gets attributed)."""
+    try:
+        if data[:4] != MAGIC:
+            return None
+        _version, hlen = struct.unpack(">HI", data[4:10])
+        header = json.loads(data[10:10 + hlen].decode("utf-8"))
+        trace = header.get("trace")
+        return trace if isinstance(trace, dict) else None
+    except Exception:
+        return None

@@ -33,18 +33,21 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from tpufw.obs import events as obs_events
+from tpufw.obs import reqtrace
+from tpufw.obs import trace as obs_trace
 from tpufw.serve import transport
 from tpufw.serve.bundle import (
     BundleError,
     decode_bundle,
     encode_bundle,
 )
-from tpufw.workloads.env import env_int, env_str
+from tpufw.workloads.env import env_int, env_opt_str, env_str
 
 DEFAULT_PEER_PORT = 8477
 
@@ -89,6 +92,7 @@ class PrefillEngine:
         seed_base: int = 0,
         prefix_cache: bool = True,
         events=None,
+        tracer=None,
     ):
         from tpufw.infer.pages import PagedSlotPool
 
@@ -107,6 +111,7 @@ class PrefillEngine:
         self._seed_base = seed_base
         self._job_index = 0
         self._events = events if events is not None else obs_events.NULL
+        self._tracer = tracer if tracer is not None else obs_trace.NULL
         self._lock = threading.Lock()
         self.migrations = 0
         self.migration_bytes = 0
@@ -121,16 +126,24 @@ class PrefillEngine:
         }
 
     def prefill(
-        self, prompt: Sequence[int], max_new: int
+        self, prompt: Sequence[int], max_new: int, trace=None
     ) -> bytes:
         """Admit one request, export its slot as a page bundle, free
         the slot. Returns the serialized bundle (the first sampled
         token rides inside it as the ``token`` cursor). Raises
-        ValueError when the row can never fit this arena."""
+        ValueError when the row can never fit this arena.
+
+        ``trace`` is an optional request-trace context (wire string or
+        TraceContext); stage timings — queue (engine lock wait), admit
+        (page grant + trie attach), compute, export — always ride in
+        the bundle header, so the router can decompose its observed
+        round trip even for untraced traffic."""
         from tpufw.infer import slots as slots_mod
 
         import jax
 
+        ctx = reqtrace.parse(trace)
+        ctx = ctx.child() if ctx is not None else None
         prompt = list(prompt)
         need = len(prompt) + max_new - 1
         if self.pool.n_pages_for(need) > self.pool.allocator.capacity:
@@ -138,7 +151,10 @@ class PrefillEngine:
                 f"prompt+budget needs {self.pool.n_pages_for(need)} "
                 f"pages; arena capacity is {self.pool.allocator.capacity}"
             )
+        t_req = time.perf_counter()
         with self._lock:
+            t_lock = time.perf_counter()
+            queue_s = t_lock - t_req
             job_index = self._job_index
             self._job_index += 1
             rng = jax.random.fold_in(
@@ -151,6 +167,8 @@ class PrefillEngine:
                     "prefill arena exhausted — in-flight admissions "
                     "plus trie-held pages left no room"
                 )
+            t_admit = time.perf_counter()
+            admit_s = t_admit - t_lock
             ids, shared_n = grant
             if shared_n:
                 cache, _f, first, _d, seen = self.pool.prefill_shared(
@@ -173,17 +191,56 @@ class PrefillEngine:
                 ids, shared_n, row_seen=seen,
             )
             self.pool.register_prefix(prompt, ids)
+            t_compute = time.perf_counter()
+            compute_s = t_compute - t_admit
             state = self.pool.export_slot(slot)
             self.pool.release_slot(slot)
+            export_s = time.perf_counter() - t_compute
+            # Stage timings seal into the header BEFORE encode: the
+            # encode+framing remainder shows up as the router-side
+            # "wire" stage (rpc wall minus wall_s), by construction.
+            stages = {
+                "queue": round(queue_s, 6),
+                "admit": round(admit_s, 6),
+                "compute": round(compute_s, 6),
+                "export": round(export_s, 6),
+            }
+            tmeta: Dict[str, Any] = {
+                "stages": stages,
+                "wall_s": round(
+                    queue_s + admit_s + compute_s + export_s, 6
+                ),
+            }
+            if ctx is not None:
+                tmeta.update(ctx.meta())
+            state["trace"] = tmeta
             data = encode_bundle(state)
             self.migrations += 1
             self.migration_bytes += len(data)
-            self._events.emit(
-                "serve_migration",
+            reqtrace.stage(
+                self._tracer, ctx, "req_queue_wait", queue_s,
+                role="prefill",
+            )
+            reqtrace.stage(
+                self._tracer, ctx, "req_admit", admit_s,
+                role="prefill", shared_pages=shared_n,
+            )
+            reqtrace.stage(
+                self._tracer, ctx, "req_prefill_compute", compute_s,
+                prompt_tokens=len(prompt),
+            )
+            reqtrace.stage(
+                self._tracer, ctx, "req_page_export", export_s,
+                pages=state["n_pages"],
+            )
+            fields = dict(
                 pages=state["n_pages"], bytes=len(data),
                 wall_s=round(time.monotonic() - t0, 6),
                 direction="export", shared_pages=shared_n,
             )
+            if ctx is not None:
+                fields["trace"] = ctx.trace_id
+            self._events.emit("serve_migration", **fields)
             return data
 
 
@@ -209,6 +266,7 @@ class DecodeEngine:
         seed_base: int = 0,
         chunk: int = 4,
         events=None,
+        tracer=None,
     ):
         from tpufw.infer.pages import PagedSlotPool
 
@@ -229,8 +287,11 @@ class DecodeEngine:
         self._seed_base = seed_base
         self._chunk_index = 0
         self._events = events if events is not None else obs_events.NULL
+        self._tracer = tracer if tracer is not None else obs_trace.NULL
         self._cv = threading.Condition()
-        #: slot -> {"tokens": [...], "budget": int, "done": bool}
+        #: slot -> {"tokens": [...], "budget": int, "done": bool} plus
+        #: the reqtrace bookkeeping collect_ex reports (splice_s,
+        #: first_flush_s, n_chunks, ctx).
         self._jobs: Dict[int, Dict[str, Any]] = {}
         self.migrations = 0
         self.migration_bytes = 0
@@ -263,7 +324,10 @@ class DecodeEngine:
         ``collect``. BundleError/ValueError mean the bundle was
         rejected with the arena untouched."""
         t0 = time.monotonic()
+        t0p = time.perf_counter()
         state = decode_bundle(data)
+        ctx = reqtrace.parse(state.get("trace"))
+        ctx = ctx.child() if ctx is not None else None
         with self._cv:
             free = [
                 s for s in range(self.n_slots) if s not in self._jobs
@@ -283,11 +347,19 @@ class DecodeEngine:
             except Exception:
                 self.pool.allocator.release(ids)
                 raise
+            splice_s = time.perf_counter() - t0p
             job = {
                 "tokens": [int(state["token"])],
                 "budget": int(state["remaining"]),
                 "done": bool(state["done"])
                 or int(state["remaining"]) <= 0,
+                "ctx": ctx,
+                "splice_s": splice_s,
+                # perf_counter at splice end: first_flush measures
+                # from here to the first decode-chunk extension.
+                "t_ready": time.perf_counter(),
+                "first_flush_s": None,
+                "n_chunks": 0,
             }
             self._jobs[slot] = job
             if job["done"]:
@@ -296,15 +368,24 @@ class DecodeEngine:
                 # chunk will ever retire the slot, so free its pages
                 # here or they leak until the arena saturates.
                 self.pool.release_slot(slot)
+                # The first (and only) token arrived inside the
+                # bundle — it is flushed the moment the splice lands.
+                job["first_flush_s"] = 0.0
             self.migrations += 1
             self.migration_bytes += len(data)
             self._cv.notify_all()
-        self._events.emit(
-            "serve_migration",
+        reqtrace.stage(
+            self._tracer, ctx, "req_splice", splice_s,
+            pages=int(state["n_pages"]), slot=slot,
+        )
+        fields = dict(
             pages=int(state["n_pages"]), bytes=len(data),
             wall_s=round(time.monotonic() - t0, 6),
             direction="import",
         )
+        if ctx is not None:
+            fields["trace"] = ctx.trace_id
+        self._events.emit("serve_migration", **fields)
         return slot
 
     # ---- decode loop ----------------------------------------------
@@ -321,13 +402,17 @@ class DecodeEngine:
         if not live:
             return
         k = self.chunk
+        t0 = time.perf_counter()
         key = jax.random.fold_in(
             jax.random.key(self._seed_base + 1), self._chunk_index
         )
+        chunk_index = self._chunk_index
         self._chunk_index += 1
         out = np.asarray(
             self.pool.decode_steps(jax.random.split(key, k))
         )
+        t1 = time.perf_counter()
+        chunk_s = t1 - t0
         for slot, job in live.items():
             row = out[slot].tolist()
             take = min(k, job["budget"] - (len(job["tokens"]) - 1))
@@ -335,6 +420,22 @@ class DecodeEngine:
             if self._eos is not None and self._eos in row:
                 row = row[: row.index(self._eos) + 1]
             job["tokens"].extend(row)
+            job["n_chunks"] += 1
+            if row and job["first_flush_s"] is None:
+                # First decode tokens for this request just became
+                # host-visible: the splice->flush gap is the decode
+                # side's contribution to TTFT beyond the first
+                # (bundled) token.
+                job["first_flush_s"] = t1 - job["t_ready"]
+                reqtrace.stage(
+                    self._tracer, job["ctx"], "req_first_token",
+                    job["first_flush_s"], slot=slot,
+                )
+            reqtrace.stage(
+                self._tracer, job["ctx"], "req_decode_chunk", chunk_s,
+                slot=slot, chunk_index=chunk_index,
+                new_tokens=len(row),
+            )
             if (
                 len(job["tokens"]) - 1 >= job["budget"]
                 or (self._eos is not None and row
@@ -348,6 +449,16 @@ class DecodeEngine:
         """Block until ``slot``'s request completes; returns its full
         token list (first token included). Exactly one caller drives
         chunks at a time; other waiters sleep on the condition."""
+        return self.collect_ex(slot, timeout)["tokens"]
+
+    def collect_ex(
+        self, slot: int, timeout: float = 600.0
+    ) -> Dict[str, Any]:
+        """``collect`` plus the decode-side stage timings the router
+        folds into the request's TTFT decomposition: ``splice_s``
+        (bundle parse + page alloc + splice), ``first_flush_s``
+        (splice end -> first decode-chunk flush; 0.0 when the bundled
+        token already finished the request), ``n_chunks``."""
         deadline = time.monotonic() + timeout
         with self._cv:
             while True:
@@ -356,7 +467,14 @@ class DecodeEngine:
                     raise KeyError(f"no active job in slot {slot}")
                 if job["done"]:
                     del self._jobs[slot]
-                    return job["tokens"]
+                    return {
+                        "tokens": job["tokens"],
+                        "splice_s": round(job["splice_s"], 6),
+                        "first_flush_s": round(
+                            job["first_flush_s"] or 0.0, 6
+                        ),
+                        "n_chunks": job["n_chunks"],
+                    }
                 if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"slot {slot} did not finish in {timeout}s"
@@ -365,6 +483,25 @@ class DecodeEngine:
 
 
 # -------------------------------------------------- role entrypoints
+
+def role_telemetry(role: str):
+    """(events, tracer) for a replica role from TPUFW_TELEMETRY_DIR —
+    per-role files (``events-<role>.jsonl`` / ``trace-<role>.json``)
+    so the fleet's artifacts land side by side for trace_merge to
+    stitch by trace_id. Null implementations when the dir is unset."""
+    tdir = env_opt_str("telemetry_dir")
+    if not tdir:
+        return obs_events.NULL, obs_trace.NULL
+    os.makedirs(tdir, exist_ok=True)
+    events = obs_events.EventLog(
+        os.path.join(tdir, f"events-{role}.jsonl")
+    )
+    tracer = obs_trace.Tracer(
+        os.path.join(tdir, f"trace-{role}.json"),
+        process_name=role, max_events=200_000,
+    )
+    return events, tracer
+
 
 def _build_engine(role: str):
     """Construct the engine a replica container runs, from the same
@@ -377,9 +514,11 @@ def _build_engine(role: str):
     kv_quant = env_str("serve_kv_quant", "")
     n_slots = max(1, env_int("serve_slots", 8))
     sampling = SamplingConfig(temperature=0.0)
+    events, tracer = role_telemetry(role)
     common = dict(
         sampling=sampling, page=page, kv_quant=kv_quant,
         n_slots=n_slots, seed_base=env_int("seed", 0),
+        events=events, tracer=tracer,
     )
     if role == "prefill":
         return PrefillEngine(model, params, **common), restored
@@ -395,14 +534,17 @@ def _build_engine(role: str):
 
 
 def serve_prefill(engine: PrefillEngine, port: int):
-    """Framed-TCP prefill server: JSON request in, bundle out."""
+    """Framed-TCP prefill server: JSON request in, bundle out. The
+    request's optional ``trace`` field (X-TPUFW-Trace wire form)
+    flows into the engine so its stage spans correlate."""
 
     def handle(frame: bytes) -> bytes:
         req = json.loads(frame.decode("utf-8"))
         if req.get("signals"):
             return json.dumps(engine.signals()).encode()
         return engine.prefill(
-            [int(t) for t in req["prompt"]], int(req["max_new"])
+            [int(t) for t in req["prompt"]], int(req["max_new"]),
+            trace=req.get("trace"),
         )
 
     srv, bound = transport.serve_frames(port)
@@ -413,7 +555,9 @@ def serve_prefill(engine: PrefillEngine, port: int):
 
 
 def serve_decode(engine: DecodeEngine, port: int):
-    """Framed-TCP decode server: bundle in, JSON token list out."""
+    """Framed-TCP decode server: bundle in, JSON token list out (plus
+    the decode-side stage timings — splice_s / first_flush_s /
+    n_chunks — the router folds into its TTFT decomposition)."""
 
     def handle(frame: bytes) -> bytes:
         if frame[:1] == b"{":  # JSON control frame (bundles open TPFB)
@@ -427,10 +571,8 @@ def serve_decode(engine: DecodeEngine, port: int):
             return json.dumps(
                 {"error": f"{type(e).__name__}: {e}"}
             ).encode()
-        tokens = engine.collect(slot)
-        return json.dumps(
-            {"tokens": tokens, **engine.signals()}
-        ).encode()
+        out = engine.collect_ex(slot)
+        return json.dumps({**out, **engine.signals()}).encode()
 
     srv, bound = transport.serve_frames(port)
     threading.Thread(
@@ -465,4 +607,6 @@ def main_role(role: str) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         srv.close()
+        engine._tracer.close()
+        engine._events.close()
     return 0

@@ -12,7 +12,8 @@ from __future__ import annotations
 import queue
 import socket
 import struct
-from typing import Optional
+import time
+from typing import Optional, Tuple
 
 #: Frames above this are refused on read — a corrupt length prefix
 #: must not allocate unbounded memory (1 GiB covers any real arena's
@@ -115,6 +116,21 @@ class TcpTransport:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+def rpc(
+    host: str, port: int, payload: bytes, timeout: float = 600.0
+) -> Tuple[bytes, float]:
+    """One framed request/response round trip on a fresh connection;
+    returns ``(reply, rtt_s)``. The measured wall (connect + send +
+    remote work + recv) is what request tracing calls the prefill /
+    decode rpc stage — the remote subtracts its own engine wall from
+    it to expose pure wire time."""
+    t0 = time.perf_counter()
+    with TcpTransport(host, port, timeout=timeout) as t:
+        t.send(payload)
+        reply = t.recv()
+    return reply, time.perf_counter() - t0
 
 
 def serve_frames(port: int = 0, host: str = "0.0.0.0"):
