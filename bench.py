@@ -654,6 +654,8 @@ def _measure_disagg(
     )
 
     def one(p):
+        # wire: consumes decode-reply via out
+        # wire: consumes trace-meta via tmeta, eng
         t0 = time.perf_counter()
         bundle = pe.prefill(p, max_new)
         t1 = time.perf_counter()
@@ -683,6 +685,7 @@ def _measure_disagg(
             "stage_first_decode_s": float(
                 out.get("first_flush_s") or 0.0
             ),
+            "chunks": int(out.get("n_chunks", 0)),
         }
 
     one(prompts[0])  # compile both replicas + the decode chunk
@@ -738,6 +741,11 @@ def _measure_disagg(
                 ("first_decode", "stage_first_decode_s"),
             )
         },
+        # How chunked the decode side ran: chunk-size tuning shows up
+        # here before it shows up in per-token latency.
+        "decode_chunks_per_request": round(
+            sum(r["chunks"] for r in rows) / len(rows), 2
+        ),
     }
 
 

@@ -11,8 +11,10 @@
 #   2. tpulint (python -m tpufw.analysis) — the repo's own stdlib-ast
 #      JAX/TPU rules (docs/ANALYSIS.md): hot-loop purity, mesh-axis
 #      names, RNG discipline, env + observability registry hygiene,
-#      jit donation, recompile churn, dtype drift, lock discipline.
-#      No dependencies, so it always runs; exits non-zero on any
+#      jit donation, recompile churn, dtype drift, lock discipline,
+#      and the distributed-protocol layer (wire contracts, SPMD
+#      divergence, HTTP surface, metric cardinality). No
+#      dependencies, so it always runs; exits non-zero on any
 #      finding not absorbed by analysis_baseline.json.
 #
 # Fast path (pre-commit): `scripts/lint.sh --fast` runs tpulint with
@@ -20,9 +22,12 @@
 # milliseconds) and gates only on findings in files you changed since
 # HEAD — see docs/ANALYSIS.md "Incremental mode".
 #
-# `--layer {python,deploy,all}` is forwarded to tpulint (deploy runs
-# the cross-layer manifest rules TPU010-014, needs pyyaml). Any other
-# extra args are forwarded to ruff.
+# `--layer {python,deploy,protocol,all}` is forwarded to tpulint
+# (deploy runs the cross-layer manifest rules TPU010-014, needs
+# pyyaml; protocol runs the distributed-protocol rules TPU015-018).
+# Without --layer, tpulint also honors TPUFW_LINT_LAYERS (comma
+# list) — see docs/ENV.md. Any other extra args are forwarded to
+# ruff.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -48,6 +48,8 @@ os.environ.setdefault(
 MAX_NEW = 6
 PAGE = 16
 
+# http: claims
+
 
 def _post(base: str, body: dict):
     """(status, parsed-body, headers) — 4xx/5xx included, not raised."""
@@ -65,6 +67,8 @@ def _post(base: str, body: dict):
 
 
 def main() -> int:
+    # wire: produces router-request
+    # wire: consumes router-response via body, first_body
     import jax
     import jax.numpy as jnp
 
@@ -137,6 +141,11 @@ def main() -> int:
                 f"(pages={body['migration_pages']}, "
                 f"replica={body['replica']})",
             )
+            check(
+                bool(body.get("prefill_replica")),
+                f"request {i} names the prefill replica it rode "
+                f"(prefill_replica={body.get('prefill_replica')})",
+            )
     check(
         pe.migrations == 2 and de.migrations == 2,
         f"both requests migrated (exported={pe.migrations}, "
@@ -174,12 +183,25 @@ def main() -> int:
         f"oversized request 429s with Retry-After="
         f"{headers.get('Retry-After')} (got {status}: {body})",
     )
+    check(
+        bool(body.get("error")),
+        f"429 body says why it was turned away (error={body.get('error')})",
+    )
+    # Client-supplied trace in the request body (the no-header path a
+    # curl user takes): the router must join it, not mint a new one.
+    client_trace = "deadbeefdeadbeef-cafe0123-smoke"
     status, body, _h = _post(cbase, {
         "prompt": [1, 2, 3], "max_new": 4, "tenant": "smoke",
+        "trace": client_trace,
     })
     check(
         status == 200 and len(body.get("tokens", [])) == 4,
         f"small request still fits the capped arena (got {status})",
+    )
+    check(
+        body.get("trace") == client_trace.split("-")[0],
+        f"router joined the client-supplied trace id "
+        f"(got trace={body.get('trace')})",
     )
 
     # ---- request tracing: merge per-role traces, check the stitch ----

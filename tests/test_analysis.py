@@ -1303,12 +1303,47 @@ def test_all_rules_fire_on_fixtures(tmp_path):
                 "    def count(self):\n"
                 "        return self._count\n"
             ),
+            # TPU015: 'debug_blob' written, never read.
+            # TPU016: process_index branch dominating a psum.
+            # TPU018: trace-id metric label.
+            "wire.py": (
+                "import json\n"
+                "import jax\n"
+                "def send():\n"
+                "    # wire: produces frame\n"
+                "    out = {'step': 1, 'debug_blob': 'x'}\n"
+                "    return json.dumps(out)\n"
+                "def recv(msg):\n"
+                "    # wire: consumes frame via msg\n"
+                "    return msg['step']\n"
+                "def sync(x):\n"
+                "    if jax.process_index() == 0:\n"
+                "        return jax.lax.psum(x, 'dataa')\n"
+                "    return x\n"
+                "def rec(h_latency, trace_id, secs):\n"
+                "    h_latency.observe(secs, trace=trace_id)\n"
+            ),
+            # TPU017: the harness claims /metricz; nothing serves it.
+            "server.py": (
+                "# http: serves\n"
+                "def handle(self):\n"
+                "    if self.path == '/pingz':\n"
+                "        self._reply(200, b'ok')\n"
+            ),
+            "smoke.py": (
+                "# http: claims\n"
+                "def smoke(fetch, base):\n"
+                "    r = fetch(base + '/pingz')\n"
+                "    assert r.status == 200\n"
+                "    q = fetch(base + '/metricz')\n"
+            ),
         },
     )
     rules = {f.rule for f in out}
     want = {
         "TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
         "TPU006", "TPU007", "TPU008", "TPU009",
+        "TPU015", "TPU016", "TPU017", "TPU018",
     }
     if deploy_files:
         want |= {"TPU010", "TPU011", "TPU012", "TPU013", "TPU014"}
@@ -2366,3 +2401,661 @@ def test_env_catalog_single_source(tmp_path):
     assert cat.entries["TPUFW_DEBUG"].default == "false"
     assert "TPUFW_LR" in cat.catalog_names
     assert project.env_catalog() is cat  # cached
+
+
+# ---------------------------------------------------------------- TPU015
+
+
+def test_tpu015_written_never_read(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "send.py": (
+                "import json\n"
+                "def send():\n"
+                "    # wire: produces telemetry-frame\n"
+                "    out = {'step': 1, 'loss': 0.5, 'debug_blob': 'x'}\n"
+                "    return json.dumps(out)\n"
+            ),
+            "recv.py": (
+                "def recv(msg):\n"
+                "    # wire: consumes telemetry-frame via msg\n"
+                "    return msg['step'] + msg['loss']\n"
+            ),
+        },
+        rules=["TPU015"],
+    )
+    assert keys(out) == [
+        "telemetry-frame:debug_blob:written-never-read"
+    ], keys(out)
+
+
+def test_tpu015_read_never_written(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "send.py": (
+                "import json\n"
+                "def send():\n"
+                "    # wire: produces telemetry-frame\n"
+                "    out = {'step': 1}\n"
+                "    return json.dumps(out)\n"
+            ),
+            "recv.py": (
+                "def recv(msg):\n"
+                "    # wire: consumes telemetry-frame via msg\n"
+                "    return msg['step'], msg['epoch']\n"
+            ),
+        },
+        rules=["TPU015"],
+    )
+    hit = [
+        f for f in out
+        if f.symbol == "telemetry-frame:epoch:read-never-written"
+    ]
+    assert hit and hit[0].severity == "error", keys(out)
+
+
+def test_tpu015_unguarded_optional_conditional_write(tmp_path):
+    """A key only SOME paths write is optional; a bare subscript read
+    of it is the KeyError waiting for the other path."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "send.py": (
+                "import json\n"
+                "def send(fast):\n"
+                "    # wire: produces telemetry-frame\n"
+                "    out = {'step': 1}\n"
+                "    if fast:\n"
+                "        out['hint'] = 'skip'\n"
+                "    return json.dumps(out)\n"
+            ),
+            "recv.py": (
+                "def recv(msg):\n"
+                "    # wire: consumes telemetry-frame via msg\n"
+                "    return msg['hint']\n"
+            ),
+        },
+        rules=["TPU015"],
+    )
+    assert any(
+        f.symbol == "telemetry-frame:hint:unguarded-optional"
+        for f in out
+    ), keys(out)
+
+
+WIRE_SCHEMA = (
+    "# wire: schema bundle-hdr\n"
+    "SCHEMA = {\n"
+    "    'version': ('int', 1, True),\n"
+    "    'n_pages': ('int', 1, True),\n"
+    "    'kv_quant': ('str', 2, False),\n"
+    "}\n"
+)
+
+
+def test_tpu015_schema_type_mismatch(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "proto.py": WIRE_SCHEMA + (
+                "def encode():\n"
+                "    # wire: produces bundle-hdr via hdr\n"
+                "    hdr = {'version': 1, 'n_pages': 'four'}\n"
+                "    return hdr\n"
+            ),
+        },
+        rules=["TPU015"],
+    )
+    assert keys(out) == ["bundle-hdr:n_pages:type-mismatch"], keys(out)
+
+
+def test_tpu015_schema_unknown_key(tmp_path):
+    """Both sides of the drift: a producer inventing a key and a
+    consumer reading one the schema never declared."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "proto.py": WIRE_SCHEMA + (
+                "def encode():\n"
+                "    # wire: produces bundle-hdr via hdr\n"
+                "    hdr = {'version': 1, 'n_pages': 4, 'pages_n': 4}\n"
+                "    return hdr\n"
+                "def decode(msg):\n"
+                "    # wire: consumes bundle-hdr via msg\n"
+                "    return msg['num_pages']\n"
+            ),
+        },
+        rules=["TPU015"],
+    )
+    syms = set(keys(out))
+    assert "bundle-hdr:pages_n:not-in-schema" in syms, keys(out)
+    assert "bundle-hdr:num_pages:not-in-schema" in syms, keys(out)
+
+
+def test_tpu015_get_reads_optional_negative(tmp_path):
+    """FP guard: .get() on a schema-optional key is exactly the guard
+    the rule asks for — no finding."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "proto.py": WIRE_SCHEMA + (
+                "def decode(msg):\n"
+                "    # wire: consumes bundle-hdr via msg\n"
+                "    q = msg.get('kv_quant')\n"
+                "    return msg['version'], q\n"
+            ),
+        },
+        rules=["TPU015"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu015_version_gated_read_negative(tmp_path):
+    """FP guard: a subscript read inside ``if msg['version'] >= 2:``
+    is version-gated, not unguarded."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "proto.py": WIRE_SCHEMA + (
+                "def decode(msg):\n"
+                "    # wire: consumes bundle-hdr via msg\n"
+                "    if msg['version'] >= 2:\n"
+                "        return msg['kv_quant']\n"
+                "    return None\n"
+            ),
+        },
+        rules=["TPU015"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu015_schema_loop_covers_all_keys_negative(tmp_path):
+    """FP guard: a schema-driven encode loop writes every schema key;
+    the consumer's reads are all covered."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "proto.py": WIRE_SCHEMA + (
+                "def encode(vals):\n"
+                "    # wire: produces bundle-hdr via hdr\n"
+                "    hdr = {}\n"
+                "    for key, spec in SCHEMA.items():\n"
+                "        hdr[key] = vals[key]\n"
+                "    return hdr\n"
+                "def decode(msg):\n"
+                "    # wire: consumes bundle-hdr via msg\n"
+                "    return msg['n_pages']\n"
+            ),
+        },
+        rules=["TPU015"],
+    )
+    assert out == [], keys(out)
+
+
+# ---------------------------------------------------------------- TPU016
+
+
+def test_tpu016_process_index_branch_psum(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "def sync(x):\n"
+                "    if jax.process_index() == 0:\n"
+                "        return jax.lax.psum(x, 'data')\n"
+                "    return x\n"
+            ),
+        },
+        rules=["TPU016"],
+    )
+    assert keys(out) == ["divergence:sync:process_index"], keys(out)
+    assert "collective psum" in out[0].message
+
+
+def test_tpu016_time_bounded_while_jit_dispatch(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import time\n"
+                "import jax\n"
+                "def _step(x):\n"
+                "    return x\n"
+                "step = jax.jit(_step)\n"
+                "def run(x):\n"
+                "    deadline = time.monotonic() + 5\n"
+                "    while time.monotonic() < deadline:\n"
+                "        x = step(x)\n"
+                "    return x\n"
+            ),
+        },
+        rules=["TPU016"],
+    )
+    assert any(
+        f.symbol == "divergence:run:time" and "loop bound" in f.message
+        for f in out
+    ), keys(out)
+
+
+def test_tpu016_env_loop_reaches_collective(tmp_path):
+    """Env-tainted loop bound; the collective is two calls down, so
+    the callgraph fixpoint has to carry it."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import os\n"
+                "import jax\n"
+                "def _reduce(xs):\n"
+                "    return jax.lax.all_gather(xs, 'data')\n"
+                "def gather(xs):\n"
+                "    n = int(os.environ.get('NUM_ROUNDS', '2'))\n"
+                "    for _ in range(n):\n"
+                "        xs = _reduce(xs)\n"
+                "    return xs\n"
+            ),
+        },
+        rules=["TPU016"],
+    )
+    assert any(
+        f.symbol == "divergence:gather:env" for f in out
+    ), keys(out)
+
+
+def test_tpu016_random_branch_distributed(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import random\n"
+                "import jax\n"
+                "def maybe_init():\n"
+                "    if random.random() < 0.5:\n"
+                "        jax.distributed.initialize()\n"
+            ),
+        },
+        rules=["TPU016"],
+    )
+    assert keys(out) == ["divergence:maybe_init:random"], keys(out)
+
+
+def test_tpu016_rank0_logging_negative(tmp_path):
+    """FP guard: the canonical rank-0 print has no collective in the
+    branch and nothing to early-exit past."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import jax\n"
+                "def log_once(msg):\n"
+                "    if jax.process_index() == 0:\n"
+                "        print(msg)\n"
+            ),
+        },
+        rules=["TPU016"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu016_broadcast_uniformized_negative(tmp_path):
+    """FP guard: a value routed through broadcast_one_to_all is
+    uniform across hosts by construction — branching on it is safe."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import time\n"
+                "import jax\n"
+                "from jax.experimental import multihost_utils\n"
+                "def seeded(x):\n"
+                "    t = multihost_utils.broadcast_one_to_all("
+                "time.time_ns())\n"
+                "    if t % 2:\n"
+                "        return jax.lax.psum(x, 'data')\n"
+                "    return x\n"
+            ),
+        },
+        rules=["TPU016"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu016_env_branch_no_sink_negative(tmp_path):
+    """FP guard: host-varying branches are fine in functions with no
+    collective anywhere — pure host-side config divergence."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import os\n"
+                "def configure():\n"
+                "    if os.environ.get('DEBUG'):\n"
+                "        return {}\n"
+                "    return {'mode': 'prod'}\n"
+            ),
+        },
+        rules=["TPU016"],
+    )
+    assert out == [], keys(out)
+
+
+# ---------------------------------------------------------------- TPU017
+
+
+SERVES_PINGZ = (
+    "# http: serves\n"
+    "def handle(self):\n"
+    "    if self.path == '/pingz':\n"
+    "        self._reply(200, b'ok')\n"
+)
+
+
+def test_tpu017_claimed_endpoint_unserved(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "server.py": SERVES_PINGZ,
+            "smoke.py": (
+                "# http: claims\n"
+                "def smoke(fetch, base):\n"
+                "    r = fetch(base + '/pingz')\n"
+                "    assert r.status == 200\n"
+                "    q = fetch(base + '/metricz')\n"
+            ),
+        },
+        rules=["TPU017"],
+    )
+    assert keys(out) == ["endpoint:/metricz:unserved"], keys(out)
+
+
+def test_tpu017_claimed_status_unserved(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "server.py": SERVES_PINGZ,
+            "smoke.py": (
+                "# http: claims\n"
+                "def smoke(fetch, base):\n"
+                "    r = fetch(base + '/pingz')\n"
+                "    assert r.status == 200\n"
+                "    q = fetch(base + '/pingz')\n"
+                "    assert q.status == 429\n"
+            ),
+        },
+        rules=["TPU017"],
+    )
+    assert keys(out) == ["status:429:unserved"], keys(out)
+
+
+def test_tpu017_claimed_header_unserved(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "server.py": SERVES_PINGZ,
+            "smoke.py": (
+                "# http: claims\n"
+                "def smoke(fetch, base):\n"
+                "    r = fetch(base + '/pingz')\n"
+                "    assert r.status == 200\n"
+                "    assert r.headers.get('X-Missing-Header')\n"
+            ),
+        },
+        rules=["TPU017"],
+    )
+    assert keys(out) == ["header:X-Missing-Header:unserved"], keys(out)
+
+
+def test_tpu017_served_unclaimed_warning(tmp_path):
+    """An endpoint nothing tests or documents is a warning, not an
+    error — it works, but nothing would notice it breaking."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "server.py": (
+                "# http: serves\n"
+                "def handle(self):\n"
+                "    if self.path == '/pingz':\n"
+                "        self._reply(200, b'ok')\n"
+                "    elif self.path == '/debugz':\n"
+                "        self._reply(200, b'dump')\n"
+            ),
+            "smoke.py": (
+                "# http: claims\n"
+                "def smoke(fetch, base):\n"
+                "    r = fetch(base + '/pingz')\n"
+                "    assert r.status == 200\n"
+            ),
+        },
+        rules=["TPU017"],
+    )
+    hit = [f for f in out if f.symbol == "endpoint:/debugz:unclaimed"]
+    assert hit and hit[0].severity == "warning", keys(out)
+
+
+def test_tpu017_matched_surface_negative(tmp_path):
+    """FP guard: every claimed endpoint/code/header is served (and
+    Content-Type never needs claiming)."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "server.py": (
+                "# http: serves\n"
+                "def handle(self):\n"
+                "    if self.path == '/pingz':\n"
+                "        self.send_response(200)\n"
+                "        self.send_header('X-TPUFW-Trace', 'x')\n"
+            ),
+            "smoke.py": (
+                "# http: claims\n"
+                "def smoke(fetch, base):\n"
+                "    r = fetch(base + '/pingz')\n"
+                "    assert r.status == 200\n"
+                "    assert r.headers.get('X-TPUFW-Trace')\n"
+                "    assert r.headers.get('Content-Type')\n"
+            ),
+        },
+        rules=["TPU017"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu017_doc_claims_count_negative(tmp_path):
+    """FP guard: docs/OBSERVABILITY.md claims absorb served-unclaimed
+    warnings — a documented surface is an owned surface."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "server.py": (
+                "# http: serves\n"
+                "def handle(self):\n"
+                "    if self.path == '/pingz':\n"
+                "        self._reply(200, b'ok')\n"
+                "    elif self.path == '/statz':\n"
+                "        self._reply(203, b'{}')\n"
+            ),
+            "smoke.py": (
+                "# http: claims\n"
+                "def smoke(fetch, base):\n"
+                "    r = fetch(base + '/pingz')\n"
+                "    assert r.status == 200\n"
+            ),
+            "docs/OBSERVABILITY.md": (
+                "# HTTP surface\n\n"
+                "| endpoint | code |\n"
+                "| --- | --- |\n"
+                "| `/statz` | 203 |\n"
+            ),
+        },
+        rules=["TPU017"],
+    )
+    assert out == [], keys(out)
+
+
+# ---------------------------------------------------------------- TPU018
+
+
+def test_tpu018_trace_label_value(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "class Obs:\n"
+                "    def __init__(self, m):\n"
+                "        self.h_latency = m\n"
+                "    def rec(self, trace_id, secs):\n"
+                "        self.h_latency.observe(secs, trace=trace_id)\n"
+            ),
+        },
+        rules=["TPU018"],
+    )
+    assert keys(out) == ["label:trace"], keys(out)
+
+
+def test_tpu018_id_shaped_label_name(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "def track(g_inflight, sid, n):\n"
+                "    g_inflight.set(n, session_id=sid)\n"
+            ),
+        },
+        rules=["TPU018"],
+    )
+    assert keys(out) == ["label:session_id"], keys(out)
+
+
+def test_tpu018_minted_id_label(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "import uuid\n"
+                "def count(metrics):\n"
+                "    metrics.c_requests.inc(1, shard=uuid.uuid4())\n"
+            ),
+        },
+        rules=["TPU018"],
+    )
+    assert keys(out) == ["label:shard"], keys(out)
+    assert "mints a fresh id" in out[0].message
+
+
+def test_tpu018_tenant_allowlisted_negative(tmp_path):
+    """FP guard: tenant is the one id-ish label the SLO layer keys on
+    — bounded by the tenant set, not per-request."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "def rec(h_slo, tenant, secs):\n"
+                "    h_slo.observe(secs, tenant=tenant)\n"
+                "def rec2(h_slo, req, secs):\n"
+                "    h_slo.observe(secs, who=req.tenant)\n"
+            ),
+        },
+        rules=["TPU018"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu018_non_metric_receiver_negative(tmp_path):
+    """FP guard: .set/.get on a plain cache is not a metric write,
+    id-shaped kwargs or not."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "def stash(cache, trace_id, value):\n"
+                "    cache.set(value, request_id=trace_id)\n"
+                "def bound(g_util, role):\n"
+                "    g_util.set(1.0, role=role)\n"
+            ),
+        },
+        rules=["TPU018"],
+    )
+    assert out == [], keys(out)
+
+
+# ----------------------------------------------- protocol layer plumbing
+
+
+def test_live_tree_protocol_layer_clean():
+    """The protocol layer on its own must exit clean on the repo — the
+    gate the protocol-lint CI job enforces."""
+    paths = [
+        os.path.join(ROOT, p)
+        for p in ("tpufw", "scripts", "bench.py")
+        if os.path.exists(os.path.join(ROOT, p))
+    ]
+    findings = run_analysis(paths, root=ROOT, layer="protocol")
+    bl_path = os.path.join(ROOT, "analysis_baseline.json")
+    baseline = (
+        core.load_baseline(bl_path) if os.path.exists(bl_path) else set()
+    )
+    new, _old, _stale = core.split_by_baseline(findings, baseline)
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+def test_protocol_layer_selected_rules_only(tmp_path):
+    """layer='protocol' runs TPU015-018 (plus TPU000) and nothing
+    below; the python layer conversely never fires them."""
+    files = {
+        "mod.py": (
+            "import jax\n"
+            "def f(key, shape):\n"
+            "    a = jax.random.normal(key, shape)\n"
+            "    return a + jax.random.normal(key, shape)\n"
+            "def sync(x):\n"
+            "    if jax.process_index() == 0:\n"
+            "        return jax.lax.psum(x, 'd')\n"
+            "    return x\n"
+        ),
+    }
+    proto = run_fixture(tmp_path, files)
+    # run_fixture scans layer-agnostically ("all"); redo split by layer
+    py = run_analysis(
+        [str(tmp_path)], root=str(tmp_path), layer="python"
+    )
+    pr = run_analysis(
+        [str(tmp_path)], root=str(tmp_path), layer="protocol"
+    )
+    assert {f.rule for f in py} == {"TPU003"}, keys(py)
+    assert {f.rule for f in pr} == {"TPU016"}, keys(pr)
+    assert {f.rule for f in proto} >= {"TPU003", "TPU016"}
+
+
+def test_scan_signature_layer_comma_list(tmp_path):
+    """TPUFW_LINT_LAYERS hands scan_signature a comma list; deploy/
+    is hashed iff a deploy-reading layer is in it."""
+    from tpufw.analysis import incremental
+
+    (tmp_path / "deploy").mkdir()
+    (tmp_path / "deploy" / "a.yaml").write_text("kind: Pod\n")
+    sig = incremental.scan_signature(
+        str(tmp_path), [], None, layer="python,protocol"
+    )
+    assert "deploy" not in sig
+    sig2 = incremental.scan_signature(
+        str(tmp_path), [], None, layer="protocol,all"
+    )
+    assert "deploy" in sig2
+
+
+def test_cli_env_layer_default(tmp_path, monkeypatch):
+    """Without --layer, TPUFW_LINT_LAYERS picks the layers; a typo in
+    it is a usage error (exit 2), not a silent full scan."""
+    from tpufw.analysis.__main__ import main
+
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    mod = tmp_path / "mod.py"
+    mod.write_text("x = 1\n")
+    monkeypatch.setenv("TPUFW_LINT_LAYERS", "python,protocol")
+    assert main([str(mod), "--no-baseline"]) == 0
+    monkeypatch.setenv("TPUFW_LINT_LAYERS", "helm")
+    assert main([str(mod), "--no-baseline"]) == 2
+    monkeypatch.delenv("TPUFW_LINT_LAYERS")
+    assert main([str(mod), "--no-baseline"]) == 0

@@ -112,13 +112,43 @@ def test_bundle_missing_or_mistyped_meta_rejected():
     # wrong type) must be a clean BundleError, not a KeyError that
     # escapes DecodeEngine.submit's rejection path.
     data = encode_bundle(_state(np.float32))
-    with pytest.raises(BundleError, match="missing meta field"):
+    with pytest.raises(BundleError, match="missing required field"):
         decode_bundle(
             _rewrite_header(data, lambda h: h.pop("remaining"))
         )
-    with pytest.raises(BundleError, match="must be an integer"):
+    with pytest.raises(BundleError, match="must be int"):
         decode_bundle(
             _rewrite_header(data, lambda h: h.update(n_pages="two"))
+        )
+
+
+def test_bundle_schema_types_enforced_for_every_field():
+    # Pre-schema decode only type-checked the six int fields; a
+    # mistyped kv_quant or done slipped through to the arena splice.
+    # HEADER_SCHEMA now validates every row, including bool-vs-int.
+    data = encode_bundle(_state(np.float32))
+    with pytest.raises(BundleError, match="kv_quant.*must be str"):
+        decode_bundle(
+            _rewrite_header(data, lambda h: h.update(kv_quant=7))
+        )
+    with pytest.raises(BundleError, match="done.*must be bool"):
+        decode_bundle(
+            _rewrite_header(data, lambda h: h.update(done=1))
+        )
+    with pytest.raises(BundleError, match="must be an integer, got bool"):
+        decode_bundle(
+            _rewrite_header(data, lambda h: h.update(token=True))
+        )
+
+
+def test_bundle_header_version_cross_checked():
+    # The header's own "version" key used to be written and never
+    # read; a producer could drift it silently. It must now agree
+    # with the frame-prefix version.
+    data = encode_bundle(_state(np.float32))
+    with pytest.raises(BundleError, match="producer drift"):
+        decode_bundle(
+            _rewrite_header(data, lambda h: h.update(version=2))
         )
 
 

@@ -13,11 +13,16 @@ whole-scan replay cache (see :mod:`tpufw.analysis.incremental`), and
 ``--since <ref>`` gates the exit code on findings in files changed
 since ``ref`` — the pre-commit fast path.
 
-``--layer {python,deploy,all}`` (default ``all``) selects the scan
-set: ``python`` is the stdlib-only ast rules (TPU001-009), ``deploy``
-parses ``deploy/`` and runs the cross-layer rules (TPU010-014,
-requires pyyaml), ``all`` runs both — degrading to python-only with a
-stderr notice when pyyaml is missing.
+``--layer {python,deploy,protocol,all}`` (default ``all``) selects
+the scan set: ``python`` is the stdlib-only ast rules (TPU001-009),
+``deploy`` parses ``deploy/`` and runs the cross-layer rules
+(TPU010-014, requires pyyaml), ``protocol`` runs the
+distributed-protocol rules (TPU015-018) over the python scan set,
+``all`` runs everything — degrading past the deploy half with a
+stderr notice when pyyaml is missing. When ``--layer`` is not given,
+``TPUFW_LINT_LAYERS`` (a comma list, e.g. ``python,protocol``) picks
+the default instead — findings from the listed layers are merged and
+deduplicated.
 """
 
 from __future__ import annotations
@@ -61,12 +66,14 @@ def main(argv: List[str] | None = None) -> int:
     ap.add_argument(
         "--layer",
         choices=core.LAYERS,
-        default="all",
+        default=None,
         help=(
             "scan layer: python = ast rules over .py files, deploy = "
-            "TPU010-014 over deploy/ (needs pyyaml), all = both "
-            "(default; deploy half skipped with a notice if pyyaml "
-            "is missing)"
+            "TPU010-014 over deploy/ (needs pyyaml), protocol = "
+            "TPU015-018 wire/SPMD contracts over .py files, all = "
+            "everything (default; deploy half skipped with a notice "
+            "if pyyaml is missing). Unset, TPUFW_LINT_LAYERS (comma "
+            "list) picks the default"
         ),
     )
     ap.add_argument(
@@ -122,6 +129,25 @@ def main(argv: List[str] | None = None) -> int:
     if not paths:
         print("tpulint: nothing to scan", file=sys.stderr)
         return 2
+    if args.layer is not None:
+        layers = [args.layer]
+    else:
+        from tpufw.workloads.env import env_str
+
+        layers = [
+            part.strip()
+            for part in env_str("lint_layers", "all").split(",")
+            if part.strip()
+        ] or ["all"]
+        for part in layers:
+            if part not in core.LAYERS:
+                print(
+                    f"tpulint: TPUFW_LINT_LAYERS: unknown layer "
+                    f"{part!r} (choices: {', '.join(core.LAYERS)})",
+                    file=sys.stderr,
+                )
+                return 2
+    layer_spec = ",".join(layers)
     rules = (
         [r.strip() for r in args.rules.split(",") if r.strip()]
         if args.rules
@@ -137,7 +163,7 @@ def main(argv: List[str] | None = None) -> int:
 
     from tpufw.analysis import manifests
 
-    if args.layer == "all" and not manifests.yaml_available():
+    if "all" in layers and not manifests.yaml_available():
         print(
             "tpulint: pyyaml not importable — deploy layer "
             "(TPU010-014) skipped; pip install pyyaml or use "
@@ -150,7 +176,7 @@ def main(argv: List[str] | None = None) -> int:
     if cache_path is not None:
         signature = incremental.scan_signature(
             root, core.iter_py_files(paths, root), rules,
-            layer=args.layer,
+            layer=layer_spec,
         )
         findings = incremental.load_cached(cache_path, signature)
         if findings is not None:
@@ -161,9 +187,21 @@ def main(argv: List[str] | None = None) -> int:
             )
     if findings is None:
         try:
-            findings = core.run_analysis(
-                paths, root=root, rules=rules, layer=args.layer
-            )
+            findings = []
+            seen = set()
+            for layer in layers:
+                for f in core.run_analysis(
+                    paths, root=root, rules=rules, layer=layer
+                ):
+                    # Layers overlap (TPU000 parse errors fire in
+                    # every layer; "all" subsumes the rest) — one
+                    # finding, one report.
+                    k = (f.key(), f.line)
+                    if k not in seen:
+                        seen.add(k)
+                        findings.append(f)
+            if len(layers) > 1:
+                findings.sort(key=lambda f: (f.path, f.line, f.rule))
         except ValueError as e:
             print(f"tpulint: {e}", file=sys.stderr)
             return 2

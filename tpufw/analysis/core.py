@@ -36,7 +36,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
 
 SEVERITIES = ("error", "warning", "info")
 
-LAYERS = ("python", "deploy", "all")
+LAYERS = ("python", "deploy", "protocol", "all")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*tpulint:\s*(disable|disable-file)\s*=\s*"
@@ -403,7 +403,7 @@ def collect_files(paths: Sequence[str], root: str) -> List[SourceFile]:
 
 
 def all_checkers() -> List[Checker]:
-    """The shipped rule set, TPU001..TPU014 (import here, not at
+    """The shipped rule set, TPU001..TPU018 (import here, not at
     module top, so core stays importable from checker modules)."""
     from tpufw.analysis.deploy import (
         BootstrapWiringChecker,
@@ -419,6 +419,12 @@ def all_checkers() -> List[Checker]:
     from tpufw.analysis.locks import LockDisciplineChecker
     from tpufw.analysis.meshaxes import MeshAxisChecker
     from tpufw.analysis.obsnames import ObsNameChecker
+    from tpufw.analysis.protocol import (
+        HttpSurfaceChecker,
+        MetricLabelChecker,
+        SpmdDivergenceChecker,
+        WireContractChecker,
+    )
     from tpufw.analysis.retrace import RetraceChurnChecker
     from tpufw.analysis.rng import RngDisciplineChecker
 
@@ -437,6 +443,10 @@ def all_checkers() -> List[Checker]:
         EnvKnobValidityChecker(),
         ConfigSchemaChecker(),
         ChartParityChecker(),
+        WireContractChecker(),
+        SpmdDivergenceChecker(),
+        HttpSurfaceChecker(),
+        MetricLabelChecker(),
     ]
 
 
@@ -452,17 +462,20 @@ def run_analysis(
     failures surface as TPU000 errors rather than crashing the run.
 
     ``layer`` selects the scan set: "python" parses ``paths`` and runs
-    the ast rules, "deploy" parses ``deploy/`` under the root and runs
-    TPU010-014, "all" (default) does both. The deploy layer degrades
-    to nothing (with no error) when pyyaml is absent and layer="all";
-    requesting layer="deploy" without pyyaml raises ValueError.
+    the single-process ast rules, "deploy" parses ``deploy/`` under
+    the root and runs TPU010-014, "protocol" parses ``paths`` and runs
+    the distributed-protocol rules TPU015-018 (same python scan set,
+    no manifests), "all" (default) does everything. The deploy layer
+    degrades to nothing (with no error) when pyyaml is absent and
+    layer="all"; requesting layer="deploy" without pyyaml raises
+    ValueError.
     """
     if layer not in LAYERS:
         raise ValueError(f"unknown layer {layer!r}; choose from {LAYERS}")
     root = root or find_repo_root(paths[0] if paths else ".")
     files = collect_files(paths, root) if layer != "deploy" else []
     deploy_files: List = []
-    if layer != "python":
+    if layer in ("deploy", "all"):
         from tpufw.analysis import manifests
 
         if manifests.yaml_available():

@@ -114,7 +114,11 @@ def scan_signature(
         },
         "files": {rel: _file_sha(ap) for ap, rel in py_files},
     }
-    if layer != "python":
+    # ``layer`` may be a comma list (TPUFW_LINT_LAYERS). Only the
+    # deploy layer reads manifests; the protocol layer's inputs
+    # (serve/, obs/reqtrace.py, the wire markers) are .py files
+    # already hashed under "files" above.
+    if any(part in ("deploy", "all") for part in layer.split(",")):
         sig["deploy"] = _deploy_hashes(root)
     return sig
 

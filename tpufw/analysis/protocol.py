@@ -1,0 +1,1187 @@
+"""tpulint layer 4 — distributed-protocol rules (TPU015-TPU018).
+
+PRs 11-12 made the repo a multi-process system: prefill, decode, and
+router roles speak hand-rolled wire formats (TPFB page bundles, framed
+JSON control frames, the ``X-TPUFW-Trace`` header, router HTTP JSON).
+None of the single-process layers can see a producer writing
+``"n_pages"`` while the consumer reads ``"num_pages"`` — the classic
+cross-program drift MPMD decompositions die of. This layer checks the
+contracts themselves:
+
+TPU015  wire-contract drift. Producer/consumer functions declare the
+        channel they speak with a structured comment::
+
+            # wire: produces bundle-header via header
+            # wire: consumes bundle-header via header
+
+        (``via`` names the payload dict variable(s); producers without
+        ``via`` contribute dict literals in return statements,
+        ``json.dumps(...)`` arguments, and call arguments). A
+        module-level dict constant tagged ``# wire: schema <channel>``
+        (key -> (type, since-version, required)) becomes the channel's
+        single source of truth. Flags: written-but-never-read,
+        read-but-never-written, producer/consumer type mismatches, and
+        unguarded reads of optional keys (no ``.get``/default and no
+        enclosing if/try — version-gated reads are thereby exempt).
+
+TPU016  SPMD divergence. Host-varying taint (process_index, env reads,
+        time, randomness, file I/O — see spmd.py) steering a branch or
+        loop bound whose body issues a collective, a
+        ``jax.distributed`` call, or a jit dispatch: some hosts enter
+        the collective, the rest never arrive, every participant
+        blocks forever.
+
+TPU017  HTTP surface drift. Endpoints, status codes, and headers the
+        router actually serves (files tagged ``# http: serves``) vs.
+        what the smoke harness claims (``# http: claims``) and what
+        docs/OBSERVABILITY.md documents. A claimed-but-unserved
+        surface is an error (the harness would fail against the real
+        server); a served-but-unclaimed one is a warning (untested,
+        undocumented surface).
+
+TPU018  metric-label cardinality. Trace/span/request/session-id-shaped
+        values used as metric label values explode Prometheus series
+        cardinality; ``tenant`` is the one allowlisted id-ish label
+        (bounded by the tenant set, and the SLO layer keys on it).
+
+All extraction is syntactic (stdlib ast only). Dynamically-built keys
+(``d[prefix + name]``), payloads forwarded through untagged helpers,
+and cross-process framing are out of scope — see docs/ANALYSIS.md for
+the limitation list.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from . import callgraph as cg
+from . import spmd
+from .core import Checker, Finding, Project, SourceFile
+
+_WIRE_RE = re.compile(
+    r"#\s*wire:\s*(produces|consumes)\s+([A-Za-z0-9_-]+)"
+    r"(?:\s+via\s+([A-Za-z0-9_,\s]+?))?\s*$"
+)
+_SCHEMA_RE = re.compile(r"#\s*wire:\s*schema\s+([A-Za-z0-9_-]+)\s*$")
+_HTTP_RE = re.compile(r"#\s*http:\s*(serves|claims)\s*$")
+
+_JSON_TYPES = {"int", "str", "float", "bool", "list", "dict", "NoneType"}
+
+
+# ------------------------------------------------------------ ast utils
+
+
+def _parent_map(root: ast.AST) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _ancestors(
+    node: ast.AST, parents: Dict[int, ast.AST]
+) -> Iterator[ast.AST]:
+    cur = parents.get(id(node))
+    while cur is not None:
+        yield cur
+        cur = parents.get(id(cur))
+
+
+def _is_conditional(node: ast.AST, parents: Dict[int, ast.AST]) -> bool:
+    """Write reached only on some executions: under an if/elif/else
+    arm, a ternary, or an except handler. try: bodies and loop bodies
+    count as unconditional — the happy path runs them."""
+    return any(
+        isinstance(a, (ast.If, ast.IfExp, ast.ExceptHandler))
+        for a in _ancestors(node, parents)
+    )
+
+
+def _is_guarded(node: ast.AST, parents: Dict[int, ast.AST]) -> bool:
+    """Read protected by SOME conditional context (if/ternary/try) —
+    including version gates like ``if hdr["version"] >= 2:``."""
+    return any(
+        isinstance(a, (ast.If, ast.IfExp, ast.Try, ast.ExceptHandler))
+        for a in _ancestors(node, parents)
+    )
+
+
+def _literal_type(node: ast.AST) -> Optional[str]:
+    """Best-effort JSON-ish type of a written value."""
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return "NoneType"
+        if isinstance(node.value, bool):
+            return "bool"
+        if isinstance(node.value, int):
+            return "int"
+        if isinstance(node.value, float):
+            return "float"
+        if isinstance(node.value, str):
+            return "str"
+        return None
+    if isinstance(node, ast.JoinedStr):
+        return "str"
+    if isinstance(node, ast.Dict):
+        return "dict"
+    if isinstance(node, (ast.List, ast.ListComp, ast.Tuple)):
+        return "list"
+    if isinstance(node, ast.Call):
+        nm = cg.call_name(node)
+        if nm in ("int", "len", "ord"):
+            return "int"
+        if nm in ("str", "repr", "format"):
+            return "str"
+        if nm == "float":
+            return "float"
+        if nm == "bool":
+            return "bool"
+        if nm in ("list", "sorted", "tuple"):
+            return "list"
+        if nm == "dict":
+            return "dict"
+        if nm == "round":
+            return "float" if len(node.args) > 1 else "int"
+    return None
+
+
+def _type_compatible(a: str, b: str) -> bool:
+    if a == b:
+        return True
+    nums = {"int", "float"}
+    return a in nums and b in nums and "bool" not in (a, b)
+
+
+def _outer_dicts(expr: ast.AST) -> Iterator[ast.Dict]:
+    """Outermost dict literals in ``expr`` (payload bodies); nested
+    dicts are their own sub-payloads and stay out of the key set."""
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Dict):
+            yield node
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ------------------------------------------------------------- markers
+
+
+class _FnCtx:
+    """One marker-bearing function: its node, location, and role."""
+
+    def __init__(self, file: SourceFile, node: ast.AST, qname: str):
+        self.file = file
+        self.node = node
+        self.qname = qname
+        self.parents = _parent_map(node)
+
+
+def _function_spans(
+    f: SourceFile,
+) -> List[Tuple[int, int, ast.AST, str]]:
+    out: List[Tuple[int, int, ast.AST, str]] = []
+    if f.tree is None:
+        return out
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out.append(
+                    (child.lineno, child.end_lineno or child.lineno,
+                     child, q)
+                )
+                walk(child, q)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}.{child.name}" if prefix else
+                     child.name)
+            else:
+                walk(child, prefix)
+
+    walk(f.tree, "")
+    return out
+
+
+def _enclosing_fn(
+    spans: Sequence[Tuple[int, int, ast.AST, str]], line: int
+) -> Optional[Tuple[ast.AST, str]]:
+    best: Optional[Tuple[int, int, ast.AST, str]] = None
+    for lo, hi, node, q in spans:
+        if lo <= line <= hi and (best is None or lo > best[0]):
+            best = (lo, hi, node, q)
+    return (best[2], best[3]) if best else None
+
+
+class _Role:
+    def __init__(self, ctx: _FnCtx, via: Optional[Set[str]]):
+        self.ctx = ctx
+        self.via = via  # None = unscoped
+
+
+class _Schema:
+    def __init__(
+        self,
+        file: SourceFile,
+        node: ast.Dict,
+        const_name: str,
+        rows: Dict[str, Tuple[str, int, bool]],
+    ):
+        self.file = file
+        self.node = node
+        self.const_name = const_name
+        self.rows = rows
+        self.base_version = min(
+            (since for _t, since, _r in rows.values()), default=1
+        )
+
+    def gated(self, key: str) -> bool:
+        row = self.rows.get(key)
+        return row is not None and row[1] > self.base_version
+
+
+class _Channel:
+    def __init__(self, name: str):
+        self.name = name
+        self.producers: List[_Role] = []
+        self.consumers: List[_Role] = []
+        self.schema: Optional[_Schema] = None
+
+
+def _parse_schema(
+    f: SourceFile, line: int, channel: str
+) -> Optional[_Schema]:
+    """The module-level dict constant the ``# wire: schema`` comment
+    annotates (comment inside or up to 3 lines above the assign)."""
+    if f.tree is None:
+        return None
+    for stmt in f.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target, value = stmt.targets[0], stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            target, value = stmt.target, stmt.value
+        else:
+            continue
+        if not (
+            isinstance(target, ast.Name) and isinstance(value, ast.Dict)
+        ):
+            continue
+        if not (stmt.lineno - 4 <= line <= (stmt.end_lineno or 0)):
+            continue
+        rows: Dict[str, Tuple[str, int, bool]] = {}
+        for k, v in zip(value.keys, value.values):
+            if not (
+                isinstance(k, ast.Constant) and isinstance(k.value, str)
+            ):
+                continue
+            tname, since, required = None, 1, True
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else []
+            if elts:
+                if isinstance(elts[0], ast.Name):
+                    tname = elts[0].id
+                elif isinstance(elts[0], ast.Constant) and isinstance(
+                    elts[0].value, str
+                ):
+                    tname = elts[0].value
+            if len(elts) > 1 and isinstance(elts[1], ast.Constant):
+                since = int(elts[1].value)
+            if len(elts) > 2 and isinstance(elts[2], ast.Constant):
+                required = bool(elts[2].value)
+            rows[k.value] = (tname or "?", since, required)
+        if rows:
+            return _Schema(f, value, target.id, rows)
+    return None
+
+
+def _collect_channels(
+    project: Project, index: cg.ModuleIndex
+) -> Dict[str, _Channel]:
+    channels: Dict[str, _Channel] = {}
+
+    def chan(name: str) -> _Channel:
+        return channels.setdefault(name, _Channel(name))
+
+    for f in project.files:
+        if f.tree is None:
+            continue
+        spans = _function_spans(f)
+        ctx_cache: Dict[int, _FnCtx] = {}
+        for i, text in enumerate(f.lines, start=1):
+            if "# wire:" not in text and "#wire:" not in text:
+                continue
+            m = _SCHEMA_RE.search(text)
+            if m:
+                schema = _parse_schema(f, i, m.group(1))
+                if schema is not None:
+                    chan(m.group(1)).schema = schema
+                continue
+            m = _WIRE_RE.search(text)
+            if not m:
+                continue
+            hit = _enclosing_fn(spans, i)
+            if hit is None:
+                continue
+            node, qname = hit
+            ctx = ctx_cache.get(id(node))
+            if ctx is None:
+                ctx = _FnCtx(f, node, qname)
+                ctx_cache[id(node)] = ctx
+            via: Optional[Set[str]] = None
+            if m.group(3):
+                via = {
+                    v.strip() for v in m.group(3).split(",") if v.strip()
+                }
+            role = _Role(ctx, via)
+            if m.group(1) == "produces":
+                chan(m.group(2)).producers.append(role)
+            else:
+                chan(m.group(2)).consumers.append(role)
+    return channels
+
+
+# --------------------------------------------------- producer extraction
+
+
+class _Write:
+    def __init__(
+        self, key: str, node: ast.AST, conditional: bool,
+        typename: Optional[str],
+    ):
+        self.key = key
+        self.node = node
+        self.conditional = conditional
+        self.typename = typename
+
+
+def _payload_names(ctx: _FnCtx, via: Optional[Set[str]]) -> Set[str]:
+    if via is not None:
+        return set(via)
+    # Unscoped: names assigned a dict literal that are later returned
+    # or handed to json.dumps as a bare name.
+    assigned: Set[str] = set()
+    used: Set[str] = set()
+    for node in spmd.walk_own(ctx.node):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Dict
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    assigned.add(t.id)
+        if (
+            isinstance(node, ast.AnnAssign)
+            and isinstance(node.value, ast.Dict)
+            and isinstance(node.target, ast.Name)
+        ):
+            assigned.add(node.target.id)
+        if isinstance(node, ast.Return) and node.value is not None:
+            # Only the returned value itself (or tuple elements of
+            # it): a name nested deeper — a dict VALUE like
+            # ``{"stages": stages}`` — is a sub-payload, not this
+            # channel's body.
+            tops = (
+                node.value.elts
+                if isinstance(node.value, ast.Tuple)
+                else [node.value]
+            )
+            for sub in tops:
+                if isinstance(sub, ast.Name):
+                    used.add(sub.id)
+        if isinstance(node, ast.Call) and cg.call_name(node) in (
+            "dumps", "dump"
+        ):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    used.add(arg.id)
+    return assigned & used
+
+
+def _producer_writes(ctx: _FnCtx, via: Optional[Set[str]]) -> List[_Write]:
+    writes: List[_Write] = []
+    names = _payload_names(ctx, via)
+
+    def dict_writes(d: ast.Dict) -> None:
+        cond = _is_conditional(d, ctx.parents)
+        for k, v in zip(d.keys, d.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                writes.append(
+                    _Write(k.value, k, cond, _literal_type(v))
+                )
+
+    ret_maps: List[Dict[str, Tuple[ast.AST, Optional[str]]]] = []
+    for node in spmd.walk_own(ctx.node):
+        # dict literals assigned to a payload name
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Dict
+        ):
+            if any(
+                isinstance(t, ast.Name) and t.id in names
+                for t in node.targets
+            ):
+                dict_writes(node.value)
+        if (
+            isinstance(node, ast.AnnAssign)
+            and isinstance(node.value, ast.Dict)
+            and isinstance(node.target, ast.Name)
+            and node.target.id in names
+        ):
+            dict_writes(node.value)
+        # payload["k"] = v stores
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in names
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)
+                ):
+                    writes.append(
+                        _Write(
+                            t.slice.value, t,
+                            _is_conditional(t, ctx.parents),
+                            _literal_type(node.value),
+                        )
+                    )
+        # payload.setdefault("k", v) / payload.update({...})
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            recv = node.func.value
+            if isinstance(recv, ast.Name) and recv.id in names:
+                if (
+                    node.func.attr == "setdefault"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    writes.append(
+                        _Write(
+                            node.args[0].value, node.args[0],
+                            _is_conditional(node, ctx.parents),
+                            _literal_type(node.args[1])
+                            if len(node.args) > 1 else None,
+                        )
+                    )
+                elif node.func.attr == "update" and node.args:
+                    for d in _outer_dicts(node.args[0]):
+                        dict_writes(d)
+        # Anonymous dict literals in return statements are payload
+        # bodies whether or not the marker scopes with `via` (bodies
+        # like `return 200, {...}, headers` have no name to scope to).
+        if isinstance(node, ast.Return) and node.value is not None:
+            keys: Dict[str, Tuple[ast.AST, Optional[str]]] = {}
+            for d in _outer_dicts(node.value):
+                for k, v in zip(d.keys, d.values):
+                    if isinstance(k, ast.Constant) and isinstance(
+                        k.value, str
+                    ):
+                        keys[k.value] = (k, _literal_type(v))
+            if keys:
+                ret_maps.append(keys)
+        elif via is None and isinstance(node, ast.Call) and not any(
+            isinstance(a, ast.Return)
+            for a in _ancestors(node, ctx.parents)
+        ):
+            # Unscoped only: dict literals handed straight to calls
+            # (json.dumps({...}), _post(base, {...})); dicts inside
+            # return expressions are the Return branch's, not ours.
+            for arg in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                if isinstance(arg, ast.Dict):
+                    dict_writes(arg)
+    # Return-body conditionality is about exits, not nesting: a key
+    # every dict-bearing return carries (e.g. the sole return inside a
+    # retry loop's ``if done:``) is unconditionally produced; a key
+    # only SOME returns carry (an error body vs the 200 body) is not.
+    every = (
+        set.intersection(*(set(m) for m in ret_maps))
+        if ret_maps else set()
+    )
+    for m in ret_maps:
+        for key, (knode, typ) in m.items():
+            writes.append(_Write(key, knode, key not in every, typ))
+    # schema-driven encode loop: `for key, spec in SCHEMA.items():`
+    # marks every schema key written (handled by the caller, which
+    # knows the schema const name).
+    return writes
+
+
+def _schema_loop_targets(
+    ctx: _FnCtx, schema: _Schema
+) -> List[Tuple[str, ast.For]]:
+    """Loop variables bound to the schema's keys:
+    ``for k in SCHEMA:`` / ``for k, spec in SCHEMA.items():``."""
+    out: List[Tuple[str, ast.For]] = []
+    for node in spmd.walk_own(ctx.node):
+        if not isinstance(node, ast.For):
+            continue
+        it = node.iter
+        if isinstance(it, ast.Call) and isinstance(
+            it.func, ast.Attribute
+        ) and it.func.attr in ("items", "keys"):
+            it = it.func.value
+        if not (
+            isinstance(it, ast.Name) and it.id == schema.const_name
+        ):
+            continue
+        tgt = node.target
+        if isinstance(tgt, ast.Tuple) and tgt.elts:
+            tgt = tgt.elts[0]
+        if isinstance(tgt, ast.Name):
+            out.append((tgt.id, node))
+    return out
+
+
+# -------------------------------------------------- consumer extraction
+
+
+class _Read:
+    def __init__(self, key: str, node: ast.AST, guarded: bool):
+        self.key = key
+        self.node = node
+        self.guarded = guarded
+
+
+def _recv_matches(node: ast.AST, via: Optional[Set[str]]) -> bool:
+    if via is None:
+        return True
+    if isinstance(node, ast.BoolOp):
+        # ``(tmeta or {}).get(...)`` — the defaulting operand doesn't
+        # change which payload is being read.
+        return any(_recv_matches(v, via) for v in node.values)
+    chain = cg.attr_chain(node)
+    if chain:
+        return chain[-1] in via or ".".join(chain) in via
+    return False
+
+
+def _file_str_tuples(f: SourceFile) -> Dict[str, Set[str]]:
+    """Module-level ``NAME = ("a", "b", ...)`` all-string tuple/list
+    constants — ModuleIndex only indexes scalar string constants, but
+    key lists like router._SIGNAL_KEYS live in tuples."""
+    out: Dict[str, Set[str]] = {}
+    if f.tree is None:
+        return out
+    for stmt in f.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            tgt, value = stmt.targets[0], stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            tgt, value = stmt.target, stmt.value
+        else:
+            continue
+        if not (
+            isinstance(tgt, ast.Name)
+            and isinstance(value, (ast.Tuple, ast.List))
+            and value.elts
+        ):
+            continue
+        vals = {
+            el.value
+            for el in value.elts
+            if isinstance(el, ast.Constant) and isinstance(el.value, str)
+        }
+        if len(vals) == len(value.elts):
+            out[tgt.id] = vals
+    return out
+
+
+def _for_bindings(
+    ctx: _FnCtx, index: cg.ModuleIndex, module: str,
+    schema: Optional[_Schema],
+) -> Dict[str, Tuple[Set[str], bool]]:
+    """Loop-var name -> (possible string keys, is-schema-loop).
+
+    Handles ``for k in _KEYS:`` over a resolvable constant tuple,
+    positional unpacking over a literal tuple-of-tuples
+    (``for src, dst in (("a", "b"), ...):``), and iteration over the
+    channel's schema table."""
+    out: Dict[str, Tuple[Set[str], bool]] = {}
+    str_tuples = _file_str_tuples(ctx.file)
+    for node in spmd.walk_own(ctx.node):
+        if not isinstance(node, ast.For):
+            continue
+        it = node.iter
+        unwrapped = it
+        if isinstance(it, ast.Call) and isinstance(
+            it.func, ast.Attribute
+        ) and it.func.attr in ("items", "keys"):
+            unwrapped = it.func.value
+        if (
+            schema is not None
+            and isinstance(unwrapped, ast.Name)
+            and unwrapped.id == schema.const_name
+        ):
+            tgt = node.target
+            if isinstance(tgt, ast.Tuple) and tgt.elts:
+                tgt = tgt.elts[0]
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = (set(schema.rows), True)
+            continue
+        tgt = node.target
+        if isinstance(tgt, ast.Name):
+            vals = {
+                s for _n, s in index.resolve_str_elements(it, module)
+            }
+            if not vals and isinstance(it, ast.Name):
+                vals = str_tuples.get(it.id, set())
+            if vals:
+                out[tgt.id] = (vals, False)
+        elif isinstance(tgt, ast.Tuple) and isinstance(
+            it, (ast.Tuple, ast.List)
+        ):
+            # positional binding over a literal tuple-of-tuples
+            for pos, name_node in enumerate(tgt.elts):
+                if not isinstance(name_node, ast.Name):
+                    continue
+                vals = set()
+                for row in it.elts:
+                    if (
+                        isinstance(row, (ast.Tuple, ast.List))
+                        and pos < len(row.elts)
+                        and isinstance(row.elts[pos], ast.Constant)
+                        and isinstance(row.elts[pos].value, str)
+                    ):
+                        vals.add(row.elts[pos].value)
+                if vals:
+                    out[name_node.id] = (vals, False)
+    return out
+
+
+def _consumer_reads(
+    ctx: _FnCtx, via: Optional[Set[str]], index: cg.ModuleIndex,
+    module: str, schema: Optional[_Schema],
+) -> List[_Read]:
+    reads: List[_Read] = []
+    bindings = _for_bindings(ctx, index, module, schema)
+    for node in spmd.walk_own(ctx.node):
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, ast.Load
+        ):
+            if not _recv_matches(node.value, via):
+                continue
+            guarded = _is_guarded(node, ctx.parents)
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(
+                sl.value, str
+            ):
+                reads.append(_Read(sl.value, node, guarded))
+            elif isinstance(sl, ast.Name) and sl.id in bindings:
+                keys, is_schema = bindings[sl.id]
+                for key in keys:
+                    # The schema loop validates presence itself.
+                    reads.append(
+                        _Read(key, node, guarded or is_schema)
+                    )
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("get", "pop")
+            and node.args
+        ):
+            if not _recv_matches(node.func.value, via):
+                continue
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(
+                a0.value, str
+            ):
+                reads.append(_Read(a0.value, a0, True))
+            elif isinstance(a0, ast.Name) and a0.id in bindings:
+                for key in bindings[a0.id][0]:
+                    reads.append(_Read(key, a0, True))
+    return reads
+
+
+# --------------------------------------------------------------- TPU015
+
+
+class WireContractChecker(Checker):
+    rule = "TPU015"
+    name = "wire-contract-drift"
+    severity = "error"
+    layer = "protocol"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        index = cg.ModuleIndex(project)
+        channels = _collect_channels(project, index)
+        for ch in channels.values():
+            yield from self._check_channel(ch, index)
+
+    def _check_channel(
+        self, ch: _Channel, index: cg.ModuleIndex
+    ) -> Iterator[Finding]:
+        schema = ch.schema
+        writes: Dict[str, List[Tuple[_Role, _Write]]] = {}
+        schema_written_by: List[_Role] = []
+        for role in ch.producers:
+            fn_writes = _producer_writes(role.ctx, role.via)
+            if schema is not None and _schema_loop_targets(
+                role.ctx, schema
+            ):
+                schema_written_by.append(role)
+            for w in fn_writes:
+                writes.setdefault(w.key, []).append((role, w))
+        reads: Dict[str, List[Tuple[_Role, _Read]]] = {}
+        for role in ch.consumers:
+            module = cg.module_name(role.ctx.file.relpath)
+            for r in _consumer_reads(
+                role.ctx, role.via, index, module, schema
+            ):
+                reads.setdefault(r.key, []).append((role, r))
+
+        written_keys = set(writes)
+        if schema is not None and schema_written_by:
+            written_keys |= set(schema.rows)
+
+        # -- schema membership + type agreement --------------------
+        if schema is not None:
+            for key, sites in writes.items():
+                if key not in schema.rows:
+                    role, w = sites[0]
+                    yield self.finding(
+                        role.ctx.file, w.node,
+                        f"channel '{ch.name}': producer "
+                        f"{role.ctx.qname} writes key '{key}' that is "
+                        f"not in the {schema.const_name} schema",
+                        symbol=f"{ch.name}:{key}:not-in-schema",
+                    )
+                    continue
+                want = schema.rows[key][0]
+                for role, w in sites:
+                    if w.typename and want in _JSON_TYPES and not (
+                        _type_compatible(w.typename, want)
+                    ):
+                        yield self.finding(
+                            role.ctx.file, w.node,
+                            f"channel '{ch.name}': key '{key}' is "
+                            f"declared {want} in {schema.const_name} "
+                            f"but written as {w.typename}",
+                            symbol=f"{ch.name}:{key}:type-mismatch",
+                        )
+            for key, sites in reads.items():
+                if key not in schema.rows:
+                    role, r = sites[0]
+                    yield self.finding(
+                        role.ctx.file, r.node,
+                        f"channel '{ch.name}': consumer "
+                        f"{role.ctx.qname} reads key '{key}' that is "
+                        f"not in the {schema.const_name} schema",
+                        symbol=f"{ch.name}:{key}:not-in-schema",
+                    )
+
+        # -- producer-side type disagreement (schema-less) ----------
+        if schema is None:
+            for key, sites in writes.items():
+                typed = [
+                    (role, w) for role, w in sites if w.typename
+                    and w.typename != "NoneType"
+                ]
+                for (r1, w1), (r2, w2) in zip(typed, typed[1:]):
+                    if not _type_compatible(w1.typename, w2.typename):
+                        yield self.finding(
+                            r2.ctx.file, w2.node,
+                            f"channel '{ch.name}': key '{key}' is "
+                            f"written as {w1.typename} by "
+                            f"{r1.ctx.qname} but as {w2.typename} by "
+                            f"{r2.ctx.qname}",
+                            symbol=f"{ch.name}:{key}:type-mismatch",
+                        )
+
+        # -- written-but-never-read ---------------------------------
+        if ch.consumers:
+            for key in sorted(set(writes) - set(reads)):
+                role, w = writes[key][0]
+                yield self.finding(
+                    role.ctx.file, w.node,
+                    f"channel '{ch.name}': key '{key}' is written by "
+                    f"{role.ctx.qname} but never read by any declared "
+                    f"consumer",
+                    symbol=f"{ch.name}:{key}:written-never-read",
+                )
+
+        # -- read-but-never-written + optional-guard ----------------
+        if not ch.producers and schema is None:
+            return
+        for key, sites in sorted(reads.items()):
+            in_schema = schema is not None and key in schema.rows
+            if key not in written_keys and not in_schema:
+                for role, r in sites[:1]:
+                    yield self.finding(
+                        role.ctx.file, r.node,
+                        f"channel '{ch.name}': key '{key}' is read by "
+                        f"{role.ctx.qname} but no declared producer "
+                        f"writes it",
+                        symbol=f"{ch.name}:{key}:read-never-written",
+                        severity="warning" if r.guarded else "error",
+                    )
+                continue
+            optional = self._optional(ch, key, writes, schema)
+            if not optional:
+                continue
+            for role, r in sites:
+                if r.guarded:
+                    continue
+                why = (
+                    f"optional in {schema.const_name}"
+                    if in_schema and not schema.rows[key][2]
+                    else f"gated on version > {schema.base_version}"
+                    if in_schema and schema.gated(key)
+                    else "not written by every producer on every path"
+                )
+                yield self.finding(
+                    role.ctx.file, r.node,
+                    f"channel '{ch.name}': key '{key}' is {why} but "
+                    f"{role.ctx.qname} reads it without a "
+                    f".get/default guard",
+                    symbol=f"{ch.name}:{key}:unguarded-optional",
+                )
+
+    @staticmethod
+    def _optional(
+        ch: _Channel,
+        field: str,
+        writes: Dict[str, List[Tuple[_Role, _Write]]],
+        schema: Optional[_Schema],
+    ) -> bool:
+        if schema is not None and field in schema.rows:
+            _t, _since, required = schema.rows[field]
+            return (not required) or schema.gated(field)
+        sites = writes.get(field, [])
+        if not sites:
+            return False
+        writers = {id(role.ctx.node) for role, _w in sites}
+        all_producers = {
+            id(role.ctx.node) for role in ch.producers
+        }
+        if writers != all_producers:
+            return True  # some producer never sends this key
+        return all(w.conditional for _role, w in sites)
+
+
+# --------------------------------------------------------------- TPU016
+
+
+class SpmdDivergenceChecker(Checker):
+    rule = "TPU016"
+    name = "spmd-divergence"
+    severity = "error"
+    layer = "protocol"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        seen: Set[Tuple[str, int]] = set()
+        for div in spmd.find_divergence(project):
+            f = div.fi.file
+            key = (f.relpath, div.node.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            shape = (
+                "a loop bound" if isinstance(div.node, (ast.For,
+                                                        ast.While))
+                else "a branch"
+            )
+            tail = (
+                f"early-exits past {div.sink} later in the function"
+                if div.early_exit
+                else f"dominates {div.sink}"
+            )
+            yield self.finding(
+                f, div.node,
+                f"host-varying value ({div.kind}) steers {shape} in "
+                f"{div.fi.qname} that {tail}; hosts that skip it "
+                f"never join the collective and every participant "
+                f"hangs",
+                symbol=f"divergence:{div.fi.qname}:{div.kind}",
+            )
+
+
+# --------------------------------------------------------------- TPU017
+
+_ENDPOINT_RE = re.compile(r"^/[a-z][a-z0-9_]*$")
+_DOC_ENDPOINT_RE = re.compile(r"`(/[a-z][a-z0-9_]*)`")
+_DOC_CODE_RE = re.compile(r"\b([1-5]\d\d)\b")
+_DOC_HEADER_RE = re.compile(r"`([A-Z][A-Za-z]*(?:-[A-Za-z]+)+)`")
+_PATHISH = {"path", "url", "endpoint", "base", "route"}
+
+
+class _Surface:
+    def __init__(self) -> None:
+        self.endpoints: Dict[str, Tuple[SourceFile, ast.AST]] = {}
+        self.codes: Dict[int, Tuple[SourceFile, ast.AST]] = {}
+        self.headers: Dict[str, Tuple[SourceFile, ast.AST]] = {}
+
+
+class HttpSurfaceChecker(Checker):
+    rule = "TPU017"
+    name = "http-surface-drift"
+    severity = "error"
+    layer = "protocol"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        index = cg.ModuleIndex(project)
+        served, claimed = _Surface(), _Surface()
+        any_server = any_client = False
+        for f in project.files:
+            if f.tree is None:
+                continue
+            mode = None
+            for text in f.lines:
+                m = _HTTP_RE.search(text)
+                if m:
+                    mode = m.group(1)
+                    break
+            if mode == "serves":
+                any_server = True
+                self._extract_served(f, index, served)
+            elif mode == "claims":
+                any_client = True
+                self._extract_claimed(f, index, claimed)
+        doc_claims = self._doc_claims(project)
+        if not any_server:
+            return
+        # claimed but not served: the harness/doc describes a surface
+        # the server does not have — hard drift.
+        for path, (cf, node) in sorted(claimed.endpoints.items()):
+            if path not in served.endpoints:
+                yield self.finding(
+                    cf, node,
+                    f"endpoint {path} is claimed by {cf.relpath} but "
+                    f"no tagged server serves it",
+                    symbol=f"endpoint:{path}:unserved",
+                )
+        for code, (cf, node) in sorted(claimed.codes.items()):
+            if code not in served.codes:
+                yield self.finding(
+                    cf, node,
+                    f"status code {code} is asserted by {cf.relpath} "
+                    f"but no tagged server sends it",
+                    symbol=f"status:{code}:unserved",
+                )
+        for hdr, (cf, node) in sorted(claimed.headers.items()):
+            if hdr.lower() not in {
+                h.lower() for h in served.headers
+            } and hdr.lower() not in ("content-type", "content-length"):
+                yield self.finding(
+                    cf, node,
+                    f"header {hdr} is expected by {cf.relpath} but no "
+                    f"tagged server sends it",
+                    symbol=f"header:{hdr}:unserved",
+                )
+        # served but claimed nowhere (code or docs): untested,
+        # undocumented surface. Warning — it works, nothing checks it.
+        if not any_client and not doc_claims[0]:
+            return
+        all_claimed_eps = set(claimed.endpoints) | doc_claims[0]
+        all_claimed_codes = set(claimed.codes) | doc_claims[1]
+        for path, (sf, node) in sorted(served.endpoints.items()):
+            if path not in all_claimed_eps:
+                yield self.finding(
+                    sf, node,
+                    f"endpoint {path} is served but neither the smoke "
+                    f"harness nor docs/OBSERVABILITY.md claims it",
+                    symbol=f"endpoint:{path}:unclaimed",
+                    severity="warning",
+                )
+        for code, (sf, node) in sorted(served.codes.items()):
+            if code not in all_claimed_codes:
+                yield self.finding(
+                    sf, node,
+                    f"status code {code} is served but neither the "
+                    f"smoke harness nor docs/OBSERVABILITY.md claims "
+                    f"it",
+                    symbol=f"status:{code}:unclaimed",
+                    severity="warning",
+                )
+
+    @staticmethod
+    def _extract_served(
+        f: SourceFile, index: cg.ModuleIndex, out: _Surface
+    ) -> None:
+        module = cg.module_name(f.relpath)
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Compare):
+                # self.path == "/healthz"
+                sides = [node.left] + list(node.comparators)
+                chains = [cg.attr_chain(s) for s in sides]
+                if any(c and c[-1] in _PATHISH for c in chains):
+                    for s in sides:
+                        if isinstance(s, ast.Constant) and isinstance(
+                            s.value, str
+                        ) and _ENDPOINT_RE.match(s.value):
+                            out.endpoints.setdefault(s.value, (f, s))
+            if isinstance(node, ast.Call):
+                nm = cg.call_name(node)
+                if nm in ("_reply", "reply", "send_response") and \
+                        node.args:
+                    a0 = node.args[0]
+                    if isinstance(a0, ast.Constant) and isinstance(
+                        a0.value, int
+                    ) and 100 <= a0.value <= 599:
+                        out.codes.setdefault(a0.value, (f, a0))
+                if nm == "send_header" and node.args:
+                    h = index.resolve_str(node.args[0], module)
+                    if h:
+                        out.headers.setdefault(h, (f, node.args[0]))
+            if isinstance(node, ast.Return) and node.value is not None:
+                v = node.value
+                if isinstance(v, ast.Tuple) and v.elts:
+                    first = v.elts[0]
+                    if isinstance(first, ast.Constant) and isinstance(
+                        first.value, int
+                    ) and 100 <= first.value <= 599:
+                        out.codes.setdefault(first.value, (f, first))
+                    # header tuples riding in the same return
+                    for sub in ast.walk(v):
+                        if (
+                            isinstance(sub, ast.Tuple)
+                            and len(sub.elts) == 2
+                        ):
+                            h = index.resolve_str(sub.elts[0], module)
+                            if h and _DOC_HEADER_RE.match(f"`{h}`"):
+                                out.headers.setdefault(
+                                    h, (f, sub.elts[0])
+                                )
+
+    @staticmethod
+    def _extract_claimed(
+        f: SourceFile, index: cg.ModuleIndex, out: _Surface
+    ) -> None:
+        for node in ast.walk(f.tree):
+            # base + "/generate" — endpoint concatenated onto a host
+            if isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.Add
+            ):
+                for side in (node.left, node.right):
+                    if isinstance(side, ast.Constant) and isinstance(
+                        side.value, str
+                    ) and _ENDPOINT_RE.match(side.value):
+                        out.endpoints.setdefault(side.value, (f, side))
+            if isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                statusish = False
+                for s in sides:
+                    chain = cg.attr_chain(s)
+                    name = chain[-1] if chain else None
+                    if name in ("status", "code", "status_code"):
+                        statusish = True
+                if statusish:
+                    for s in sides:
+                        if isinstance(s, ast.Constant) and isinstance(
+                            s.value, int
+                        ) and 100 <= s.value <= 599:
+                            out.codes.setdefault(s.value, (f, s))
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and node.args
+            ):
+                chain = cg.attr_chain(node.func.value)
+                if chain and "headers" in chain[-1]:
+                    a0 = node.args[0]
+                    if isinstance(a0, ast.Constant) and isinstance(
+                        a0.value, str
+                    ):
+                        out.headers.setdefault(a0.value, (f, a0))
+
+    @staticmethod
+    def _doc_claims(project: Project) -> Tuple[Set[str], Set[int]]:
+        text = project.read_doc("docs/OBSERVABILITY.md") or ""
+        endpoints = set(_DOC_ENDPOINT_RE.findall(text))
+        codes: Set[int] = set()
+        for line in text.splitlines():
+            if "|" in line and _DOC_ENDPOINT_RE.search(line):
+                codes |= {
+                    int(c) for c in _DOC_CODE_RE.findall(line)
+                }
+        return endpoints, codes
+
+
+# --------------------------------------------------------------- TPU018
+
+_ID_SHAPED_RE = re.compile(
+    r"(?:^|_)(?:trace|span|session|request|req|correlation|uuid|guid)"
+    r"(?:_?id)?$|(?:^|_)id$",
+    re.IGNORECASE,
+)
+_ID_MINTING = {"uuid1", "uuid4", "token_hex", "token_bytes", "urandom",
+               "hex", "mint", "mint_id"}
+_METRIC_METHODS = {"inc", "observe", "set", "labels"}
+
+
+def _metric_receiver(chain: Sequence[str]) -> bool:
+    for seg in chain[:-1]:
+        s = seg.lstrip("_").lower()
+        if s.startswith(("h_", "g_", "c_")) or "metric" in s:
+            return True
+    return False
+
+
+def _id_shaped(node: ast.AST) -> Optional[str]:
+    """Why the expression looks like an unbounded id, or None."""
+    chain = cg.attr_chain(node)
+    if chain and _ID_SHAPED_RE.search(chain[-1]):
+        return f"'{'.'.join(chain)}' is id-shaped"
+    if isinstance(node, ast.Call):
+        nm = cg.call_name(node)
+        if nm in _ID_MINTING:
+            return f"{nm}() mints a fresh id per call"
+    if isinstance(node, ast.JoinedStr):
+        for v in node.values:
+            if isinstance(v, ast.FormattedValue):
+                why = _id_shaped(v.value)
+                if why:
+                    return why
+    return None
+
+
+class MetricLabelChecker(Checker):
+    rule = "TPU018"
+    name = "metric-label-cardinality"
+    severity = "error"
+    layer = "protocol"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for f in project.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS
+                ):
+                    continue
+                recv = node.func.value
+                chain = cg.attr_chain(node.func) or []
+                is_metric = _metric_receiver(chain)
+                if not is_metric and isinstance(recv, ast.Call):
+                    inner = cg.call_name(recv)
+                    is_metric = inner in (
+                        "counter", "gauge", "histogram", "summary"
+                    )
+                if not is_metric:
+                    continue
+                for kw in node.keywords:
+                    if kw.arg is None or kw.arg == "tenant":
+                        continue  # tenant is the allowlisted label
+                    value_chain = cg.attr_chain(kw.value)
+                    if value_chain and value_chain[-1] == "tenant":
+                        continue
+                    why = _id_shaped(kw.value)
+                    if why is None and kw.arg is not None and \
+                            _ID_SHAPED_RE.search(kw.arg):
+                        why = f"label name '{kw.arg}' is id-shaped"
+                    if why is None:
+                        continue
+                    yield self.finding(
+                        f, kw.value,
+                        f"metric label '{kw.arg}' gets an "
+                        f"unbounded-cardinality value ({why}); each "
+                        f"distinct value is a new Prometheus series — "
+                        f"put ids in events/traces, not labels",
+                        symbol=f"label:{kw.arg}",
+                    )

@@ -56,6 +56,17 @@ _RULE_HELP = {
               "dataclasses, or estimated footprint exceeds HBM",
     "TPU014": "chart template or manifest failed to render/parse — "
               "unverifiable deploy artifact",
+    "TPU015": "wire-contract drift on a marked channel: key written "
+              "never read, read never written, type mismatch, or an "
+              "optional field read without a guard",
+    "TPU016": "host-varying value (process_index, env, time, random, "
+              "io) steers control flow that dominates a collective / "
+              "jax.distributed call / jit dispatch — SPMD divergence",
+    "TPU017": "HTTP surface drift: endpoint/status/header claimed by "
+              "the smoke harness or docs but not served, or served "
+              "but never claimed",
+    "TPU018": "metric label carries an id-shaped value (trace/request/"
+              "uuid): unbounded time-series cardinality",
 }
 
 
@@ -127,7 +138,7 @@ def to_sarif(findings: Sequence[Finding]) -> dict:
                     "driver": {
                         "name": "tpulint",
                         "organization": "tpufw",
-                        "semanticVersion": "3.0.0",
+                        "semanticVersion": "4.0.0",
                         "rules": rules,
                     }
                 },

@@ -1,0 +1,390 @@
+"""Host-varying taint substrate for the protocol layer (TPU016).
+
+Multi-host SPMD programs hang, not crash, when control flow diverges:
+if host 0 takes a branch that issues a collective (``jax.lax.psum``,
+``jax.distributed.initialize``, a jit dispatch that lowers to one) and
+host 3 does not, every participant blocks forever waiting for the
+missing peer. The values that diverge between hosts are boringly
+predictable — ``jax.process_index()``, environment reads, wall-clock
+time, host randomness, file/socket I/O — so the check is a taint
+problem, not a semantics problem.
+
+This module mirrors the shape of ``dataflow.VaryingEnv`` (PR 8): a
+per-function forward propagation seeds names assigned from host-varying
+sources and runs two passes so later-defined helpers still converge.
+``jax.random`` is deliberately NOT a source: it is functional, and with
+a replicated key every host draws the same numbers. Conversely a value
+routed through ``multihost_utils.broadcast_one_to_all`` /
+``process_allgather`` is uniform by construction and clears the taint.
+
+Sinks come in three flavours:
+
+- direct collectives / ``jax.distributed`` / multihost sync calls;
+- calls to names bound from a tracer (``step = jax.jit(f); step(x)``);
+- calls into project functions from which a collective is reachable
+  (callgraph fixpoint — the classic "helper three frames down does the
+  psum" hang).
+
+``find_divergence`` flags If/While tests and For loop bounds that carry
+taint AND whose body contains a sink — or that early-exit
+(return/raise) past a sink later in the same function, which diverges
+just as hard: the exiting hosts never reach the collective the rest
+are blocked on.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from . import callgraph as cg
+from .core import Project, SourceFile
+
+FuncNode = cg.FuncNode
+
+# ------------------------------------------------------- source kinds
+
+# Names unambiguous enough to count even when imported bare.
+_TIME_BARE = {"monotonic", "perf_counter", "process_time", "time_ns",
+              "monotonic_ns", "perf_counter_ns"}
+_TIME_QUALIFIED = {"time", "now", "utcnow", "today"}
+_RANDOM_BARE = {"urandom", "uuid1", "uuid4", "token_hex", "token_bytes",
+                "getrandbits", "randbytes", "randint", "randrange",
+                "shuffle", "sample", "default_rng"}
+_ENV_HELPERS = {"env_str", "env_int", "env_float", "env_bool",
+                "env_opt_int", "env_opt_str"}
+_IO_BARE = {"gethostname", "getpid"}
+
+# Values made uniform across hosts on purpose; routing through one of
+# these clears the taint (and calling one *inside a diverged branch*
+# is itself a sink — see _MULTIHOST below).
+_UNIFORMIZERS = {"broadcast_one_to_all", "process_allgather"}
+
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                "all_to_all", "ppermute", "pshuffle", "psum_scatter",
+                "all_gather_invariant"}
+_MULTIHOST = {"broadcast_one_to_all", "process_allgather",
+              "sync_global_devices", "assert_equal"}
+
+
+def source_kind(call: ast.Call) -> Optional[str]:
+    """Classify a call as a host-varying source, or None."""
+    chain = cg.attr_chain(call.func)
+    if chain is None:
+        if isinstance(call.func, ast.Name):
+            chain = [call.func.id]
+        else:
+            return None
+    last = chain[-1]
+    if last in ("process_index", "host_id"):
+        return "process_index"
+    if "jax" in chain or "jnp" in chain:
+        return None  # jax.random & friends are functional / replicated
+    if last == "getenv" or last in _ENV_HELPERS:
+        return "env"
+    if last == "get" and "environ" in chain:
+        return "env"
+    if last in _TIME_BARE:
+        return "time"
+    if last in _TIME_QUALIFIED and len(chain) > 1 and chain[0] in (
+        "time", "datetime", "date"
+    ):
+        return "time"
+    if last in _RANDOM_BARE:
+        return "random"
+    if "random" in chain[:-1]:
+        return "random"  # random.x / np.random.x
+    if last == "open" and len(chain) == 1:
+        return "io"
+    if last in _IO_BARE or last in ("recv", "read_text", "read_bytes"):
+        return "io"
+    return None
+
+
+def _is_uniformizer(call: ast.Call) -> bool:
+    chain = cg.attr_chain(call.func)
+    name = chain[-1] if chain else (
+        call.func.id if isinstance(call.func, ast.Name) else None
+    )
+    return name in _UNIFORMIZERS
+
+
+def _target_names(targets: Sequence[ast.AST]) -> Iterator[str]:
+    for t in targets:
+        for node in ast.walk(t):
+            if isinstance(node, ast.Name):
+                yield node.id
+
+
+def walk_own(fn: FuncNode) -> Iterator[ast.AST]:
+    """Every node in ``fn``'s body, not descending into nested
+    function/class definitions (they execute later, if at all)."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                 ast.Lambda),
+            ):
+                continue
+            stack.append(child)
+
+
+class HostTaintEnv:
+    """Which local names carry a host-varying value, and from what
+    kind of source. Two forward passes, VaryingEnv-style."""
+
+    def __init__(self, fn: FuncNode):
+        self.fn = fn
+        self.tainted: Dict[str, str] = {}
+        for _ in range(2):
+            for node in walk_own(fn):
+                self._visit(node)
+
+    def _visit(self, node: ast.AST) -> None:
+        targets: Optional[Sequence[ast.AST]] = None
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AugAssign):
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.NamedExpr):
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.withitem):
+            if node.optional_vars is None:
+                return
+            targets, value = [node.optional_vars], node.context_expr
+        if targets is None or value is None:
+            return
+        kind = self.expr_taint(value)
+        if kind is not None:
+            for name in _target_names(targets):
+                self.tainted[name] = kind
+
+    def expr_taint(self, expr: ast.AST) -> Optional[str]:
+        """First host-varying source kind found in ``expr``, skipping
+        subtrees routed through a uniformizer."""
+        stack: List[ast.AST] = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Call):
+                if _is_uniformizer(node):
+                    continue  # result is uniform; don't look inside
+                kind = source_kind(node)
+                if kind is not None:
+                    return kind
+            elif isinstance(node, ast.Name):
+                if node.id in self.tainted:
+                    return self.tainted[node.id]
+            elif isinstance(node, ast.Subscript):
+                chain = cg.attr_chain(node.value)
+                if chain and chain[-1] == "environ":
+                    return "env"
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        return None
+
+
+# --------------------------------------------------------------- sinks
+
+
+def direct_sink(call: ast.Call) -> Optional[str]:
+    chain = cg.attr_chain(call.func)
+    if chain is None:
+        if isinstance(call.func, ast.Name):
+            chain = [call.func.id]
+        else:
+            return None
+    last = chain[-1]
+    if last in _COLLECTIVES:
+        return f"collective {last}"
+    if "distributed" in chain[:-1]:
+        return f"jax.distributed.{last}"
+    if last in _MULTIHOST:
+        return f"multihost sync {last}"
+    return None
+
+
+class SinkIndex:
+    """Project-wide: which calls dispatch into traced code or reach a
+    collective through the call graph."""
+
+    def __init__(self, project: Project):
+        self.index = cg.ModuleIndex(project)
+        roots = cg.find_traced_roots(self.index, project.files)
+        self.traced_ids: Set[int] = {id(fi.node) for fi, _ in roots}
+        # name/attr-chain handles bound from a tracer call, per file:
+        # ``step = jax.jit(f)`` then ``step(x)`` is a dispatch.
+        self.jit_handles: Dict[str, Set[str]] = {}
+        for f in project.files:
+            if f.tree is None:
+                continue
+            handles: Set[str] = set()
+            for node in ast.walk(f.tree):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = node.value
+                if not isinstance(value, ast.Call):
+                    continue
+                nm = cg.call_name(value)
+                if nm not in cg._TRACERS:
+                    continue
+                tgts = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in tgts:
+                    chain = cg.attr_chain(t)
+                    if chain:
+                        handles.add(".".join(chain))
+                    elif isinstance(t, ast.Name):
+                        handles.add(t.id)
+            self.jit_handles[f.relpath] = handles
+        # Fixpoint: function-node ids from which a collective call is
+        # reachable (including indirectly through project calls).
+        contains: Set[int] = set()
+        edges: Dict[int, Set[int]] = {}
+        self._fn_of: Dict[int, cg.FunctionInfo] = {}
+        for fi in self.index.functions:
+            self._fn_of[id(fi.node)] = fi
+            callees: Set[int] = set()
+            for call in cg.iter_calls(fi.node):
+                if direct_sink(call) is not None:
+                    contains.add(id(fi.node))
+                callee = self.index.resolve_call(
+                    call, fi.module, within=fi.qname
+                )
+                if callee is not None:
+                    callees.add(id(callee.node))
+            edges[id(fi.node)] = callees
+        self.reaches_collective: Set[int] = set(contains)
+        changed = True
+        while changed:
+            changed = False
+            for fid, callees in edges.items():
+                if fid in self.reaches_collective:
+                    continue
+                if callees & self.reaches_collective:
+                    self.reaches_collective.add(fid)
+                    changed = True
+
+    def call_sink(
+        self, call: ast.Call, f: SourceFile, module: str, within: str
+    ) -> Optional[str]:
+        """Sink description for ``call``, or None."""
+        d = direct_sink(call)
+        if d is not None:
+            return d
+        chain = cg.attr_chain(call.func)
+        handle = (
+            ".".join(chain)
+            if chain
+            else (call.func.id if isinstance(call.func, ast.Name) else None)
+        )
+        if handle and handle in self.jit_handles.get(f.relpath, set()):
+            return f"jit dispatch via {handle}"
+        callee = self.index.resolve_call(call, module, within=within)
+        if callee is not None:
+            if id(callee.node) in self.traced_ids:
+                return f"jit dispatch of {callee.name}"
+            if id(callee.node) in self.reaches_collective:
+                return f"call to {callee.name} (reaches a collective)"
+        return None
+
+
+def _stmts_sink(
+    stmts: Sequence[ast.stmt],
+    sinks: SinkIndex,
+    f: SourceFile,
+    module: str,
+    within: str,
+) -> Optional[Tuple[ast.AST, str]]:
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Call):
+            desc = sinks.call_sink(node, f, module, within)
+            if desc is not None:
+                return node, desc
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+             ast.Lambda),
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return None
+
+
+def _has_early_exit(stmts: Sequence[ast.stmt]) -> bool:
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Return, ast.Raise)):
+                return True
+    return False
+
+
+class Divergence:
+    def __init__(
+        self,
+        fi: cg.FunctionInfo,
+        node: ast.AST,
+        kind: str,
+        sink: str,
+        early_exit: bool,
+    ):
+        self.fi = fi
+        self.node = node
+        self.kind = kind
+        self.sink = sink
+        self.early_exit = early_exit
+
+
+def find_divergence(project: Project) -> List[Divergence]:
+    """Tainted branches/loop bounds dominating a collective sink."""
+    sinks = SinkIndex(project)
+    out: List[Divergence] = []
+    for fi in sinks.index.functions:
+        env = HostTaintEnv(fi.node)
+        fn_sink = _stmts_sink(
+            fi.node.body, sinks, fi.file, fi.module, fi.qname
+        )
+        for node in walk_own(fi.node):
+            branch_body: Optional[List[ast.stmt]] = None
+            test: Optional[ast.AST] = None
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+                branch_body = list(node.body) + list(
+                    getattr(node, "orelse", [])
+                )
+            elif isinstance(node, ast.For):
+                test = node.iter
+                branch_body = list(node.body)
+            if test is None or branch_body is None:
+                continue
+            kind = env.expr_taint(test)
+            if kind is None:
+                continue
+            hit = _stmts_sink(
+                branch_body, sinks, fi.file, fi.module, fi.qname
+            )
+            if hit is not None:
+                out.append(Divergence(fi, node, kind, hit[1], False))
+            elif (
+                isinstance(node, ast.If)
+                and _has_early_exit(branch_body)
+                and fn_sink is not None
+            ):
+                out.append(
+                    Divergence(fi, node, kind, fn_sink[1], True)
+                )
+    return out

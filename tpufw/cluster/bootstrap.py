@@ -153,6 +153,9 @@ def initialize_cluster(
     last_err: Exception | None = None
     # Retry: during gang (re)starts the coordinator pod may come up last;
     # failing hard here would turn one slow pod into a crash loop.
+    # tpulint: disable=TPU016 — intentional: every host loops on the SAME
+    # rendezvous until it succeeds; initialize() carries its own timeout,
+    # so a host whose clock runs out raises instead of silently diverging.
     while time.monotonic() < deadline:
         try:
             jax.distributed.initialize(

@@ -86,6 +86,7 @@ class TraceContext:
     def meta(self) -> dict:
         """Bundle-header form (rides the page bundle's JSON header
         next to the page geometry)."""
+        # wire: produces trace-meta via out
         out = {"id": self.trace_id, "span": self.span_id}
         if self.tenant:
             out["tenant"] = self.tenant
@@ -116,6 +117,7 @@ def parse(value) -> Optional[TraceContext]:
     """Wire/meta form back into a context; tolerant — a malformed or
     absent value returns None (a bad header must never 500 the front
     door, and an old peer that sends nothing is fine)."""
+    # wire: consumes trace-meta via value
     if isinstance(value, TraceContext):
         return value
     if isinstance(value, dict):  # bundle-header meta form

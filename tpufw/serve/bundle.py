@@ -32,10 +32,35 @@ import numpy as np
 MAGIC = b"TPFB"
 VERSION = 1
 
-#: Non-array metadata fields that ride in the header verbatim.
-_META_FIELDS = (
-    "page", "kv_quant", "n_pages", "token", "pos", "remaining",
-    "done", "cache_index",
+#: The single source of truth for the bundle header: key -> (python
+#: type, since-version, required). encode_bundle builds the header
+#: from this table, decode_bundle validates presence + type against
+#: it (required keys are rejected uniformly when missing), and
+#: peek_trace takes its type check from the same row — so producer,
+#: consumer, and tpulint TPU015 all read one schema. Unknown header
+#: keys are ignored on decode (forward compatibility: a newer
+#: producer may add optional keys without a version bump); a key only
+#: becomes load-bearing by gaining a row here.
+# wire: schema bundle-header
+HEADER_SCHEMA: Dict[str, tuple] = {
+    "version": (int, 1, True),
+    "arrays": (list, 1, True),
+    "page": (int, 1, True),
+    "kv_quant": (str, 1, True),
+    "n_pages": (int, 1, True),
+    "token": (int, 1, True),
+    "pos": (int, 1, True),
+    "remaining": (int, 1, True),
+    "done": (bool, 1, True),
+    "cache_index": (int, 1, True),
+    "trace": (dict, 1, False),
+}
+
+#: Non-array metadata fields copied between state dict and header
+#: verbatim — derived from the schema, not a second hand-maintained
+#: list ("trace" is optional and handled separately).
+_META_FIELDS = tuple(
+    k for k in HEADER_SCHEMA if k not in ("version", "arrays", "trace")
 )
 
 
@@ -63,6 +88,7 @@ def encode_bundle(state: Dict[str, Any]) -> bytes:
     (request-trace meta + per-stage timings, tpufw.obs.reqtrace)
     rides in the header; decoders that predate it ignore unknown
     header keys, so VERSION stays 1."""
+    # wire: produces bundle-header via header
     arrays = [np.ascontiguousarray(a) for a in state["arrays"]]
     paths = [str(p) for p in state["paths"]]
     if state.get("seen") is not None:
@@ -76,13 +102,14 @@ def encode_bundle(state: Dict[str, Any]) -> bytes:
         }
         for p, a in zip(paths, arrays)
     ]
-    header = {
-        "version": VERSION,
-        "arrays": manifest,
-        **{k: state[k] for k in _META_FIELDS},
-    }
-    if isinstance(state.get("trace"), dict):
-        header["trace"] = state["trace"]
+    header = {"version": VERSION, "arrays": manifest}
+    for key, (typ, _since, required) in HEADER_SCHEMA.items():
+        if key in header:
+            continue  # built above
+        if required:
+            header[key] = state[key]
+        elif isinstance(state.get(key), typ):
+            header[key] = state[key]
     hjson = json.dumps(header, sort_keys=True).encode("utf-8")
     parts = [MAGIC, struct.pack(">HI", VERSION, len(hjson)), hjson]
     parts.extend(a.tobytes() for a in arrays)
@@ -94,7 +121,9 @@ def decode_bundle(data: bytes) -> Dict[str, Any]:
     """Parse bundle bytes back into an ``export_slot``-shaped state
     dict; raises BundleError on any magic/version/manifest/checksum
     mismatch — a tampered or truncated bundle must never reach the
-    arena."""
+    arena. Header fields are validated (presence AND type) against
+    HEADER_SCHEMA, the same table encode_bundle writes from."""
+    # wire: consumes bundle-header via header
     if len(data) < 14:
         raise BundleError(f"bundle truncated ({len(data)} bytes)")
     if data[:4] != MAGIC:
@@ -139,25 +168,39 @@ def decode_bundle(data: bytes) -> Dict[str, Any]:
     if paths and paths[-1] == "seen":
         seen = arrays.pop()
         paths.pop()
+    for key, (typ, _since, required) in HEADER_SCHEMA.items():
+        if key not in header:
+            if required:
+                raise BundleError(
+                    f"header missing required field {key!r}"
+                )
+            continue
+        value = header[key]
+        # bool is an int subclass; "done" must be the only bool field.
+        if typ is int and isinstance(value, bool):
+            raise BundleError(
+                f"header field {key!r} must be an integer, got bool"
+            )
+        if not isinstance(value, typ):
+            raise BundleError(
+                f"header field {key!r} must be {typ.__name__}, got "
+                f"{type(value).__name__}"
+            )
+    if header["version"] != version:
+        raise BundleError(
+            f"header version {header['version']} disagrees with frame "
+            f"prefix {version} — producer drift"
+        )
     state: Dict[str, Any] = {}
     for k in _META_FIELDS:
-        if k not in header:
-            raise BundleError(f"header missing meta field {k!r}")
         state[k] = header[k]
-    for k in ("page", "n_pages", "token", "pos", "remaining",
-              "cache_index"):
-        if isinstance(state[k], bool) or not isinstance(state[k], int):
-            raise BundleError(
-                f"meta field {k!r} must be an integer, got "
-                f"{type(state[k]).__name__}"
-            )
     state["paths"] = paths
     state["arrays"] = arrays
     state["seen"] = seen
     # Absent on bundles from pre-trace producers — still a valid
-    # bundle, the request just has no cross-role correlation.
-    trace = header.get("trace")
-    state["trace"] = trace if isinstance(trace, dict) else None
+    # bundle, the request just has no cross-role correlation. When
+    # present the schema pass above already proved it a dict.
+    state["trace"] = header.get("trace")
     return state
 
 
@@ -167,12 +210,17 @@ def peek_trace(data: bytes) -> "Dict[str, Any] | None":
     to pull engine-reported stage timings out of a bundle it otherwise
     treats as opaque bytes, including bundles that would fail full
     decode (so a request that dies in flight still gets attributed)."""
+    # wire: consumes bundle-header via header
     try:
         if data[:4] != MAGIC:
             return None
         _version, hlen = struct.unpack(">HI", data[4:10])
         header = json.loads(data[10:10 + hlen].decode("utf-8"))
         trace = header.get("trace")
-        return trace if isinstance(trace, dict) else None
+        # Same type row decode_bundle enforces — one schema, two
+        # consumers.
+        if isinstance(trace, HEADER_SCHEMA["trace"][0]):
+            return trace
+        return None
     except Exception:
         return None

@@ -117,6 +117,7 @@ class PrefillEngine:
         self.migration_bytes = 0
 
     def signals(self) -> Dict[str, Any]:
+        # wire: produces role-signals
         a = self.pool.allocator
         return {
             "role": "prefill",
@@ -138,6 +139,7 @@ class PrefillEngine:
         (page grant + trie attach), compute, export — always ride in
         the bundle header, so the router can decompose its observed
         round trip even for untraced traffic."""
+        # wire: produces trace-meta via tmeta, stages
         from tpufw.infer import slots as slots_mod
 
         import jax
@@ -299,6 +301,7 @@ class DecodeEngine:
     # ---- router signals -------------------------------------------
 
     def signals(self) -> Dict[str, Any]:
+        # wire: produces role-signals
         a = self.pool.allocator
         with self._cv:
             active = len(self._jobs)
@@ -323,6 +326,7 @@ class DecodeEngine:
         """Import a serialized bundle; returns the slot handle for
         ``collect``. BundleError/ValueError mean the bundle was
         rejected with the arena untouched."""
+        # wire: consumes bundle-header via state
         t0 = time.monotonic()
         t0p = time.perf_counter()
         state = decode_bundle(data)
@@ -459,6 +463,7 @@ class DecodeEngine:
         (bundle parse + page alloc + splice), ``first_flush_s``
         (splice end -> first decode-chunk flush; 0.0 when the bundled
         token already finished the request), ``n_chunks``."""
+        # wire: produces decode-reply
         deadline = time.monotonic() + timeout
         with self._cv:
             while True:
@@ -539,11 +544,21 @@ def serve_prefill(engine: PrefillEngine, port: int):
     flows into the engine so its stage spans correlate."""
 
     def handle(frame: bytes) -> bytes:
+        # wire: consumes control-frame via req
         req = json.loads(frame.decode("utf-8"))
         if req.get("signals"):
             return json.dumps(engine.signals()).encode()
+        prompt = req.get("prompt")
+        max_new = req.get("max_new")
+        if prompt is None or max_new is None:
+            # A signals-shaped (or otherwise field-less) frame must
+            # get a structured error reply, not a KeyError traceback
+            # laundered through the accept loop.
+            return json.dumps(
+                {"error": "bad prefill frame: need prompt and max_new"}
+            ).encode()
         return engine.prefill(
-            [int(t) for t in req["prompt"]], int(req["max_new"]),
+            [int(t) for t in prompt], int(max_new),
             trace=req.get("trace"),
         )
 
@@ -560,6 +575,7 @@ def serve_decode(engine: DecodeEngine, port: int):
     n_chunks — the router folds into its TTFT decomposition)."""
 
     def handle(frame: bytes) -> bytes:
+        # wire: consumes control-frame via req
         if frame[:1] == b"{":  # JSON control frame (bundles open TPFB)
             req = json.loads(frame.decode("utf-8"))
             if req.get("signals"):

@@ -48,6 +48,8 @@ from tpufw.workloads.env import env_float, env_int, env_str
 
 DEFAULT_ROUTER_PORT = 8478
 
+# http: serves
+
 #: Signal-dict keys copied verbatim into a ReplicaState snapshot.
 _SIGNAL_KEYS = (
     "pages_total", "pages_in_use", "slots_total", "slots_active",
@@ -94,9 +96,25 @@ class ReplicaState:
         return s
 
     def update(self, signals: Dict[str, Any], now: float = 0.0) -> None:
+        # wire: consumes role-signals via signals
+        role = signals.get("role")
+        if role is not None and role != self.role:
+            # A replica answering with the wrong role means this
+            # address points at the wrong pool (mis-wired discovery
+            # or a swapped port): routing to it would splice bundles
+            # into the wrong arena. Take it out of rotation instead
+            # of folding its numbers into the policy.
+            self.healthy = False
+            self.last_seen = now
+            return
         for k in _SIGNAL_KEYS:
-            if k in signals and signals[k] is not None:
-                setattr(self, k, signals[k])
+            # tpulint: disable=TPU015 — goodput_ratio / mfu /
+            # hbm_headroom_bytes are ROADMAP item 5's forward
+            # contract: no replica exports them yet, but the policy
+            # folds them in the moment one does (score() above).
+            v = signals.get(k)
+            if v is not None:
+                setattr(self, k, v)
         self.healthy = True
         self.last_seen = now
 
@@ -287,12 +305,14 @@ class TcpReplica:
         return reply
 
     def signals(self) -> Dict[str, Any]:
+        # wire: produces control-frame
         reply = self._call(json.dumps({"signals": True}).encode())
         return json.loads(reply.decode("utf-8"))
 
     def prefill(
         self, prompt: Sequence[int], max_new: int, trace=None
     ) -> bytes:
+        # wire: produces control-frame via req
         req = {"prompt": list(prompt), "max_new": int(max_new)}
         if trace:
             req["trace"] = str(trace)
@@ -592,6 +612,10 @@ class RouterServer:
         where ``wire`` is defined as the rpc wall minus the engine's
         self-reported wall (serialization + transport, by
         construction)."""
+        # wire: consumes router-request via req
+        # wire: consumes decode-reply via out
+        # wire: consumes trace-meta via tmeta, engine_stages
+        # wire: produces router-response
         t0 = time.monotonic()
         prompt = req.get("prompt")
         if not (
