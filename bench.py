@@ -749,6 +749,98 @@ def _measure_disagg(
     }
 
 
+def _measure_spec_paged(
+    model,
+    params,
+    *,
+    page: int,
+    max_new: int,
+    n_reqs: int,
+    prompt_len: int = 96,
+    spec_k: int = 4,
+    seed: int = 0,
+) -> dict:
+    """Speculative-decoding sub-tier: the SAME paged-int8 scheduler
+    with and without n-gram self-drafting (spec knobs via ctor kwargs,
+    never os.environ), on an accept-heavy mix — each prompt's tail is
+    the model's OWN greedy continuation, so decode re-enters the same
+    attractor cycle and the n-gram draft mines it from history. Self-
+    drafting allocates zero draft pages, so the two runs occupy
+    identical HBM by construction (equal page arena, equal pool).
+    Shared by the on-TPU serve tier and `python bench.py
+    serve-disagg`."""
+    import time as _time
+
+    import numpy as _np
+
+    from tpufw.infer import SamplingConfig, generate_text
+    from tpufw.workloads.serve import _Metrics, _SlotScheduler
+
+    greedy = SamplingConfig(temperature=0.0)
+    rng = _np.random.default_rng(seed)
+    seeds = [
+        rng.integers(1, model.cfg.vocab_size, size=8).tolist()
+        for _ in range(n_reqs)
+    ]
+    conts = generate_text(
+        model, params, seeds, max_new_tokens=prompt_len - 8,
+        sampling=greedy,
+    )
+    prompts = [s + c for s, c in zip(seeds, conts)]
+
+    def run(spec, reps=3):
+        m = _Metrics()
+        sched = _SlotScheduler(
+            model, params, eos_id=None, default_sampling=greedy,
+            metrics=m, seed_base=0, page=page, kv_quant="int8",
+            spec_k=spec_k if spec else 0, spec_draft="",
+            spec_min_accept=0.25,
+        )
+        sched.submit([prompts[0]], max_new, None)  # compile programs
+        # ONE batched submit, best of `reps`: the wall stays compute-
+        # dominated (chunk/verify device calls), not coalescing-window
+        # noise — both modes are measured through the identical path.
+        best = 0.0
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            outs, _bw = sched.submit(prompts, max_new, None)
+            wall = _time.perf_counter() - t0
+            best = max(
+                best, sum(len(r) for r in outs) / wall
+            )
+        return best, m.registry, sched
+
+    base_tps, _base_reg, _bs = run(False)
+    spec_tps, reg, sched = run(True)
+    return {
+        "spec_k": spec_k,
+        "draft": "ngram",  # self-draft: zero extra params, zero pages
+        "requests": n_reqs,
+        "vocab_size": int(model.cfg.vocab_size),
+        "prompt_len": prompt_len,
+        "new_tokens": max_new,
+        "kv_quant": "int8",
+        "page": page,
+        # Equal-HBM comparison: same arena geometry, and self-drafting
+        # adds no draft pages — spec HBM == baseline HBM exactly.
+        "pages_total": sched.pages_total,
+        "serve_tokens_per_sec_per_chip": round(spec_tps, 1),
+        "baseline_paged_int8_tokens_per_sec_per_chip": round(
+            base_tps, 1
+        ),
+        "speedup_vs_paged_int8": round(spec_tps / base_tps, 3),
+        "accept_rate": round(
+            reg.gauge("tpufw_spec_accept_rate").value(), 4
+        ),
+        "wasted_draft_flops_total": reg.counter(
+            "tpufw_spec_wasted_draft_flops_total"
+        ).value(),
+        "fallback_slots": reg.gauge(
+            "tpufw_spec_fallback_slots"
+        ).value(),
+    }
+
+
 def _serve_disagg_main(argv: list) -> int:
     """``python bench.py serve-disagg [out.json]`` — the disagg
     sub-tier standalone on whatever backend jax finds (CPU included:
@@ -769,6 +861,11 @@ def _serve_disagg_main(argv: list) -> int:
     )
     model = Llama(cfg)
     params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    spec_cfg = _dc.replace(cfg, vocab_size=64)
+    spec_model = Llama(spec_cfg)
+    spec_params = jax.jit(spec_model.init)(
         jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
     rng = _np.random.default_rng(0)
@@ -795,6 +892,17 @@ def _serve_disagg_main(argv: list) -> int:
             )
             for quant, key in (("", "bf16_kv"), ("int8", "int8_kv"))
         },
+        # Speculative sub-tier: n-gram self-draft vs the identical
+        # paged-int8 scheduler at equal HBM, accept-heavy mix. A
+        # 64-token vocab makes the tiny random-init model's greedy
+        # decode genuinely repetitive (dense attractor cycles), so the
+        # n-gram draft earns its acceptance instead of guessing into
+        # a 256-way space — the CPU analog of real text's self-
+        # similarity.
+        "spec_paged": _measure_spec_paged(
+            spec_model, spec_params, page=16, max_new=48,
+            n_reqs=n_reqs,
+        ),
     }
     out_path = argv[0] if argv else os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_serve.json"
@@ -1726,6 +1834,15 @@ def _worker() -> int:
                 prompts=p_prompts, max_new=v_new,
                 decode_slots=sched.n_slots, chunk=sched.chunk,
                 concurrency=v_conc,
+            )
+            # Speculative sub-tier: n-gram self-draft against the
+            # identical paged-int8 pool at equal HBM. Its baseline is
+            # re-measured on the accept-heavy mix (prompt tails = the
+            # model's own greedy continuations), NOT reused from
+            # paged_int8_kv above — that row ran a different mix.
+            serve["spec_paged"] = _measure_spec_paged(
+                vmodel, v_params, page=v_page, max_new=v_new,
+                n_reqs=v_reqs, prompt_len=v_prompt,
             )
             del v_params
         except Exception as e:  # noqa: BLE001

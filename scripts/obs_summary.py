@@ -168,6 +168,52 @@ def summarize_router(paths: list[str]) -> None:
         )
 
 
+def summarize_spec(paths: list[str]) -> None:
+    """Speculative-decoding digest from serve_spec events: how many
+    verify passes ran, what fraction of drafted tokens the target
+    accepted, and every time speculation degraded (penalty pools,
+    draft-page starvation, legacy tick fallback). Prints nothing for
+    runs that never speculated."""
+    events = []
+    for p in paths:
+        try:
+            events.extend(read_events(p))
+        except OSError:
+            continue
+    spec = [e for e in events if e.get("kind") == "serve_spec"]
+    if not spec:
+        return
+    print("-- speculative decoding --")
+    passes = [e for e in spec if e.get("mode") == "pass"]
+    if passes:
+        rates = [
+            e["accept_rate"]
+            for e in passes
+            if isinstance(e.get("accept_rate"), (int, float))
+        ]
+        ks = collections.Counter(e.get("k", "?") for e in passes)
+        mean = sum(rates) / len(rates) if rates else 0.0
+        print(
+            f"  {len(passes)} spec pass(es) "
+            f"(k: {', '.join(f'{k}x{n}' for k, n in sorted(ks.items()))}), "
+            f"accept rate mean {mean:.1%}"
+            + (f", last {rates[-1]:.1%}" if rates else "")
+        )
+    degrades = collections.Counter(
+        (e.get("mode", "?"), e.get("reason", "-"))
+        for e in spec
+        if e.get("mode") != "pass"
+    )
+    if degrades:
+        print(
+            "  degraded: "
+            + ", ".join(
+                f"{m}({r})={n}" if r != "-" else f"{m}={n}"
+                for (m, r), n in sorted(degrades.items())
+            )
+        )
+
+
 def summarize_slo(paths: list[str]) -> None:
     """Per-tenant SLO attainment table plus a slowest-requests digest
     with the per-stage TTFT breakdown (both from router events —
@@ -321,6 +367,9 @@ def summarize_metrics(path: str) -> None:
         "tpufw_train_stragglers_total",
         "tpufw_serve_requests_total",
         "tpufw_serve_request_errors_total",
+        "tpufw_spec_accept_rate",
+        "tpufw_spec_fallback_slots",
+        "tpufw_spec_wasted_draft_flops_total",
         "tpufw_router_requests_total",
         "tpufw_router_rejects_total",
         "tpufw_router_decode_pages_free",
@@ -440,6 +489,7 @@ def main(argv: list[str]) -> int:
     print("-- events --")
     summarize_events(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
     summarize_router(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
+    summarize_spec(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
     summarize_slo(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
     print("-- spans (total time) --")
     summarize_trace(sorted(glob.glob(os.path.join(out, "trace*.json"))))

@@ -14,6 +14,11 @@ cluster. What must hold:
 - a router fronting an artificially page-capped decode replica
   answers an oversized request with 429 + Retry-After (admission
   control, not a stall), while a small request still lands;
+- a speculative decode replica (TPUFW_SERVE_SPEC_K semantics via the
+  spec_k ctor kwarg: n-gram self-draft, accept-masked verify) serves
+  the same migrated request BIT-EQUAL to the plain replica — greedy
+  verify is exact, so disagg migration parity holds with speculation
+  on, and the serve_spec events digest through obs_summary;
 - request tracing stitches: the three per-role trace files merge
   (scripts/trace_merge.py) into per-request flame rows where one
   request's spans cross router, prefill, AND decode under one
@@ -203,6 +208,41 @@ def main() -> int:
         f"router joined the client-supplied trace id "
         f"(got trace={body.get('trace')})",
     )
+
+    # ---- speculation on the decode replica: migration parity ----
+    # Fresh prefill replica on purpose: ``pe``'s trie already holds the
+    # shared prefix, and an int8 trie hit recomputes the suffix over
+    # DEQUANTIZED prefix KV — approximate by design, so shared-vs-cold
+    # bit-parity doesn't hold under int8. A cold export keeps this
+    # check about what it claims: spec verify vs plain decode.
+    pe_spec = PrefillEngine(model, params, n_slots=2, **common)
+    de_spec = DecodeEngine(
+        model, params, n_slots=4, chunk=2, spec_k=4,
+        sampling=greedy, page=PAGE, kv_quant="int8", events=events,
+    )
+    spec_router = RouterServer(
+        [LocalReplica("prefill-spec", pe_spec)],
+        [LocalReplica("decode-spec", de_spec)],
+        port=0, page=PAGE, events=events,
+    )
+    sbase = f"http://127.0.0.1:{spec_router.port}"
+    status, body, _h = _post(sbase, {
+        "prompt": shared + [7, 9], "max_new": MAX_NEW,
+        "tenant": "smoke",
+    })
+    check(
+        status == 200
+        and body.get("tokens") == first_body.get("tokens"),
+        "spec-enabled decode replica is bit-equal to the plain one "
+        f"through migration (spec_passes={de_spec.spec_passes}, "
+        f"got {body.get('tokens')} vs {first_body.get('tokens')})",
+    )
+    check(
+        de_spec.pool.allocator.in_use == 0,
+        "spec replica returned every page after retire "
+        f"(in_use={de_spec.pool.allocator.in_use})",
+    )
+    spec_router.close()
 
     # ---- request tracing: merge per-role traces, check the stitch ----
     for tr in tracers.values():

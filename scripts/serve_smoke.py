@@ -21,6 +21,12 @@ second must hit the prefix cache (tpufw_serve_prefix_hits_total >= 1
 on /metrics), and by the end retired rows must have returned pages
 to the arena (pages_freed_total > 0, pages_in_use < pages_total).
 
+Speculation rides the whole smoke: TPUFW_SERVE_SPEC_K=4 turns on
+n-gram self-drafting for every request above (greedy verify is
+bit-exact, so the length/ordering assertions double as a parity
+check), and a final section runs one more request end-to-end and
+asserts the spec metrics are exposed on /metrics.
+
 Exit 0 on success; any assertion or HTTP failure exits nonzero.
 """
 
@@ -40,6 +46,7 @@ os.environ.setdefault(
 os.environ.setdefault("TPUFW_MODEL", "llama3_tiny")
 os.environ.setdefault("TPUFW_SERVE_CHUNK", "2")
 os.environ.setdefault("TPUFW_SERVE_PAGE", "16")
+os.environ.setdefault("TPUFW_SERVE_SPEC_K", "4")
 
 LONG_NEW, SHORT_NEW, STREAM_NEW = 60, 4, 16
 
@@ -177,6 +184,48 @@ def main() -> int:
               "to the arena")
         return 1
     print("serve-smoke OK: prefix shared and pages reclaimed")
+
+    # ---- speculative decoding: one request end-to-end + metrics ----
+    from tpufw.workloads.env import env_int as _env_int
+
+    if not _env_int("serve_spec_k", 0):
+        print("serve-smoke: spec section skipped (TPUFW_SERVE_SPEC_K=0)")
+        srv.httpd.shutdown()
+        return 0
+    # A self-similar prompt gives the n-gram draft something to mine;
+    # whatever it accepts, greedy verify keeps the output exact.
+    post(
+        "spec",
+        {"prompts": [[5, 9, 5, 9, 5, 9, 5, 9, 5, 9]],
+         "max_new_tokens": 12},
+    )
+    if errors:
+        print("serve-smoke FAILED:\n  " + "\n  ".join(errors))
+        return 1
+    with urllib.request.urlopen(base + "/metrics", timeout=60) as resp:
+        metrics = {}
+        for line in resp.read().decode().splitlines():
+            if line and not line.startswith("#"):
+                name, _, val = line.partition(" ")
+                metrics[name] = float(val)
+    missing = [
+        n for n in (
+            "tpufw_spec_accept_rate",
+            "tpufw_spec_fallback_slots",
+            "tpufw_spec_wasted_draft_flops_total",
+        ) if n not in metrics
+    ]
+    if missing:
+        print(f"serve-smoke FAILED: spec metrics absent: {missing}")
+        return 1
+    print(
+        "spec: accept_rate="
+        f"{metrics['tpufw_spec_accept_rate']:.3f} "
+        f"fallback_slots={metrics['tpufw_spec_fallback_slots']:.0f} "
+        "wasted_draft_flops="
+        f"{metrics['tpufw_spec_wasted_draft_flops_total']:.0f}"
+    )
+    print("serve-smoke OK: speculative request served end-to-end")
     srv.httpd.shutdown()
     return 0
 

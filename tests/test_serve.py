@@ -626,10 +626,13 @@ def test_eos_env_truncates_batch_outputs(monkeypatch, tmp_path):
 
 
 def test_http_server_speculative_draft(tiny_env, monkeypatch):
-    """TPUFW_DRAFT_MODEL turns the tick into speculative decode;
-    greedy outputs are EXACTLY the plain server's greedy outputs (the
-    draft only changes speed), and non-greedy sampling composes (the
-    rejection-resample path) rather than being rejected."""
+    """TPUFW_DRAFT_MODEL composes with the slot scheduler (the default
+    backend): the draft seeds the chunked verify path instead of
+    rerouting all traffic through the legacy tick loop. Greedy outputs
+    are EXACTLY the plain server's greedy outputs (the draft only
+    changes speed), non-greedy sampling composes (the
+    rejection-resample path), and TPUFW_SERVE_SLOTS=0 still opts back
+    into the tick batcher."""
     import time
 
     from tpufw.workloads.serve import _Server, build_draft_generator
@@ -660,13 +663,22 @@ def test_http_server_speculative_draft(tiny_env, monkeypatch):
     monkeypatch.setenv("TPUFW_DRAFT_MODEL", "llama3_tiny")
     srv2 = _Server(port=0, max_new_tokens=6)
     assert srv2._draft is not None
+    # The dispatch fix: draft + default slots = the slot scheduler
+    # with speculation wired in, NOT the legacy tick fallback.
+    from tpufw.workloads.serve import _SlotScheduler
+
+    assert isinstance(srv2._batcher, _SlotScheduler)
+    assert srv2._batcher.spec_k == srv2._draft[2]
     t2 = threading.Thread(target=srv2.serve_forever, daemon=True)
     t2.start()
     deadline = time.time() + 30
     while not hasattr(srv2, "httpd") and time.time() < deadline:
         time.sleep(0.05)
     got = post(srv2.port, prompts)
-    # Draft-quality observability: emitted/iterations counters moved.
+    # Speculation observability: the accept-rate gauge and the
+    # wasted-draft-FLOPs counter are exposed (a random-init draft
+    # proposes junk, so the rate may be 0 — presence and the FLOPs
+    # movement are the contract).
     with urllib.request.urlopen(
         f"http://127.0.0.1:{srv2.port}/metrics", timeout=30
     ) as resp:
@@ -676,10 +688,22 @@ def test_http_server_speculative_draft(tiny_env, monkeypatch):
         for ln in mtext.splitlines()
         if ln and not ln.startswith("#")
     }
-    assert mvals["tpufw_serve_spec_iterations_total"] >= 1
-    assert mvals["tpufw_serve_spec_emitted_total"] >= 6
+    assert "tpufw_spec_accept_rate" in mvals
+    assert "tpufw_spec_fallback_slots" in mvals
+    assert mvals["tpufw_spec_wasted_draft_flops_total"] >= 0.0
+    assert mvals["tpufw_serve_ticks_total"] >= 1
     srv2.httpd.shutdown()
     assert got == want
+
+    # Explicit TPUFW_SERVE_SLOTS=0 restores the legacy speculative
+    # tick batcher (construction-only: dispatch is decided in
+    # __init__, no request needed).
+    monkeypatch.setenv("TPUFW_SERVE_SLOTS", "0")
+    monkeypatch.setenv("TPUFW_WARMUP", "0")
+    srv_tick = _Server(port=0, max_new_tokens=6)
+    assert not isinstance(srv_tick._batcher, _SlotScheduler)
+    monkeypatch.delenv("TPUFW_SERVE_SLOTS")
+    monkeypatch.setenv("TPUFW_WARMUP", "1")
 
     # Non-greedy + draft now composes (stochastic speculative
     # sampling): a server with TPUFW_TEMPERATURE=0.7 and a draft must

@@ -497,12 +497,21 @@ class PagedSlotPool(SlotPool):
         pad_id: int = 0,
         eos_id: Optional[int] = None,
         prefix_cache: bool = True,
+        allocator: Optional[PageAllocator] = None,
     ) -> "PagedSlotPool":
         cfg = model.cfg
         cache = paged_pool_cache(model, params, n_slots)
         seen = None
         if _track_seen(sampling):
             seen = jnp.zeros((n_slots, cfg.vocab_size), bool)
+        if allocator is not None and allocator.n_pages != int(cfg.kv_pages):
+            # Shared-allocator mode (speculative draft pool riding the
+            # target's arena budget): one page-id space over the two
+            # physically separate arenas, so both must be sized alike.
+            raise ValueError(
+                f"shared allocator covers {allocator.n_pages} pages but "
+                f"cfg.kv_pages={cfg.kv_pages}"
+            )
         return cls(
             model=model,
             params=params,
@@ -519,7 +528,10 @@ class PagedSlotPool(SlotPool):
             seen=seen,
             row_model=row_model,
             page=int(cfg.kv_page),
-            allocator=PageAllocator(int(cfg.kv_pages)),
+            allocator=(
+                PageAllocator(int(cfg.kv_pages))
+                if allocator is None else allocator
+            ),
             prefix=PrefixCache(int(cfg.kv_page)) if prefix_cache else None,
             slot_pages=[[] for _ in range(n_slots)],
         )

@@ -349,6 +349,23 @@ class SlotPool:
         )
         return out
 
+    def spec_steps(self, proposals, key):
+        """One self-draft speculative pass: verify host proposals
+        [S, k] in a single t=k+1 target call and advance every slot by
+        its per-slot accept count (tpufw.infer.speculative chunked
+        path). Returns (out [S, k+1], n_emit [S], accept [S])."""
+        from tpufw.infer import speculative as _spec
+
+        return _spec.spec_verify_steps(self, proposals, key)
+
+    def spec_draft_steps(self, draft_pool, key, k: int):
+        """One fused draft+verify speculative pass against
+        ``draft_pool`` (same slot count, cursors in lockstep).
+        Returns (out [S, k+1], n_emit [S], accept [S])."""
+        from tpufw.infer import speculative as _spec
+
+        return _spec.spec_draft_steps(self, draft_pool, key, k)
+
     def retire(self, slot: int) -> None:
         """Freeze ``slot`` (error paths — natural completions are
         already frozen by the step body's done/remaining masks)."""

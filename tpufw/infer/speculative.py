@@ -59,14 +59,24 @@ TPU-first shape discipline, mirroring ``generate``:
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpufw.infer.generate import pad_prompts, prefill_cache
+from tpufw.infer.generate import (
+    _model_apply,
+    pad_prompts,
+    prefill_cache,
+)
 from tpufw.infer.sampling import SamplingConfig, sample_token, transform_logits
+
+# Trace-time counters for the CHUNKED slot-pool speculation below —
+# same contract as tpufw.infer.slots.TRACE_COUNTS: bumped once per
+# (re)trace inside the jitted bodies, so tests can pin "varying accept
+# counts and page churn never recompile the verify program".
+TRACE_COUNTS: Dict[str, int] = {"spec_verify": 0, "spec_draft_verify": 0}
 
 
 def _rollback(cache: dict, new_cursor: jax.Array) -> dict:
@@ -547,3 +557,425 @@ def speculative_generate_text(
             toks = toks[: toks.index(eos_id) + 1]
         result.append(toks)
     return result, {k_: int(v) for k_, v in stats.items()}
+
+
+# ---------------------------------------------------------------------------
+# Chunked slot-pool speculation
+# ---------------------------------------------------------------------------
+# Everything below makes speculation a first-class citizen of the
+# tpufw.infer.slots / tpufw.infer.pages slot pool, replacing the
+# whole-batch tick path above for continuous-batching serving:
+#
+# - ONE verify program per (pool, k): draft k tokens, feed the
+#   [token, p_1..p_k] block through the target in a single t=k+1 pass
+#   (the models' paged/contiguous decode branches scatter the block
+#   then gather it back, so intra-block causality is the same
+#   slot-ordered mask), and fold PER-SLOT acceptance into the program
+#   as data — accept counts become dynamic cursor advances under the
+#   existing done/remaining masks. Occupancy, page tables, accept
+#   counts: all DATA, never shapes, so page churn and varying accept
+#   counts never retrace (TRACE_COUNTS-pinned, like decode_steps).
+# - Rollback is per-slot cursor rewind ONLY: stale segment-1 entries
+#   beyond the rewound cursor sit at slots > any future query slot
+#   until overwritten in slot order, so the causal mask already hides
+#   them (no segment zeroing — that would be a [S, cache_len] write
+#   per pass for bookkeeping the mask does for free).
+# - Greedy (temperature 0) emissions are argmax of the same float32
+#   logits decode_steps takes, so spec-on-slots is BIT-EQUAL to plain
+#   decode_steps regardless of accept counts. Stochastic uses per-slot
+#   rejection-resampling (distributionally exact, not bit-equal).
+# - Self-drafting (ngram_propose) needs no draft model: proposals are
+#   host-side prompt-lookup, q is a one-hot, and the accept test
+#   degrades to u < p(x_j).
+#
+# Callers with a repetition penalty are rejected: the penalty makes
+# each position's distribution depend on acceptance of every previous
+# one, which breaks the one-pass verify factorization. Those pools
+# stay on plain chunked decode.
+
+
+def _pool_cursor(cache: dict, n_slots: int) -> jax.Array:
+    """Per-slot cursor vector [S] from a slot-pool cache (any
+    cache_index leaf: [S] or nn.scan-stacked [L, S] — rows identical
+    by construction)."""
+    for path, leaf in jax.tree_util.tree_leaves_with_path(cache):
+        if getattr(path[-1], "key", None) == "cache_index":
+            return leaf.reshape(-1, n_slots)[0]
+    raise ValueError("no cache_index in cache pytree")
+
+
+def _set_pool_cursor(cache: dict, new: jax.Array) -> dict:
+    """Write per-slot cursors ``new`` [S] into every cache_index leaf
+    (broadcast over the stacked layer axis when present). Cursor-only:
+    see the module comment on mask-covered stale entries."""
+
+    def fix(path, leaf):
+        if getattr(path[-1], "key", None) == "cache_index":
+            return jnp.broadcast_to(new.astype(leaf.dtype), leaf.shape)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def _spec_advance(
+    logits, proposals, q_trans, key, token, pos, done, remaining,
+    *, sampling, pad_id, eos_id,
+):
+    """Shared verify tail: target logits [S, k+1, V] for the block
+    [token, p_1..p_k] -> per-slot emissions + advanced slot state.
+
+    Emission j is the successor of block position j (so col 0 is the
+    token after ``token``, col k the bonus after a full accept). The
+    valid mask composes acceptance (col <= accept), the per-slot
+    budget, first-EOS-inclusive truncation, and entry done — the same
+    masking discipline as _decode_steps_jit, vectorized over the
+    block. ``q_trans`` is the draft's transformed logits [S, k, V], or
+    None for deterministic proposals (greedy and self-draft: q is a
+    one-hot at the proposal).
+
+    Returns (out [S, k+1] pad-masked, n_emit [S], accept [S], token,
+    pos, done, remaining).
+    """
+    s, kp1 = logits.shape[:2]
+    k = kp1 - 1
+    cols = jnp.arange(kp1)[None, :]
+    p_trans = transform_logits(logits, sampling)
+    if sampling.temperature == 0.0:
+        # Greedy: the target's choice at every block position in one
+        # argmax — acceptance only decides how MANY columns are real.
+        block = jnp.argmax(p_trans, axis=-1).astype(jnp.int32)
+        match = proposals == block[:, :k]
+        accept = jnp.sum(jnp.cumprod(match.astype(jnp.int32), 1), 1)
+    else:
+        logp = jax.nn.log_softmax(p_trans, axis=-1)
+        lp = jnp.take_along_axis(
+            logp[:, :k], proposals[..., None], axis=-1
+        )[..., 0]
+        if q_trans is None:
+            lq = jnp.zeros_like(lp)  # one-hot q: accept iff u < p(x_j)
+        else:
+            lq = jnp.take_along_axis(
+                jax.nn.log_softmax(q_trans, axis=-1),
+                proposals[..., None], axis=-1,
+            )[..., 0]
+        us = jax.random.uniform(jax.random.fold_in(key, 1), (s, k))
+        match = jnp.log(us) < (lp - lq)
+        accept = jnp.sum(jnp.cumprod(match.astype(jnp.int32), 1), 1)
+        # Column `accept` resamples: from p on a full accept, else from
+        # the residual norm(max(p - q, 0)) at the first rejection (for
+        # one-hot q the residual is p with the proposal masked out).
+        logp_a = jnp.take_along_axis(
+            logp, accept[:, None, None], axis=1
+        )[:, 0]
+        if q_trans is None:
+            x_a = jnp.take_along_axis(
+                proposals, jnp.minimum(accept, k - 1)[:, None], axis=1
+            )[:, 0]
+            residual = logp_a.at[jnp.arange(s), x_a].set(-1e30)
+        else:
+            q_a = jax.nn.softmax(
+                jnp.take_along_axis(
+                    q_trans, jnp.minimum(accept, k - 1)[:, None, None],
+                    axis=1,
+                )[:, 0],
+                axis=-1,
+            )
+            residual = jnp.log(
+                jnp.maximum(jnp.exp(logp_a) - q_a, 1e-30)
+            )
+        alt_logits = jnp.where((accept == k)[:, None], logp_a, residual)
+        alt = jax.random.categorical(
+            # tpulint: disable=TPU003 — fold_in(key, 2) is a distinct
+            # stream from the fold_in(key, 1) acceptance uniforms.
+            jax.random.fold_in(key, 2), alt_logits, axis=-1
+        ).astype(jnp.int32)
+        props_pad = jnp.concatenate(
+            [proposals, jnp.zeros((s, 1), jnp.int32)], axis=1
+        )
+        block = jnp.where(cols < accept[:, None], props_pad, alt[:, None])
+    valid = (cols <= accept[:, None]) & (cols < remaining[:, None])
+    hits = None
+    if eos_id is not None:
+        hits = (block == eos_id) & valid
+        ih = hits.astype(jnp.int32)
+        # Inclusive first-EOS truncation: the EOS itself is delivered,
+        # everything after it in the block is masked.
+        valid = valid & ((jnp.cumsum(ih, axis=1) - ih) == 0)
+    emit = valid & ~done[:, None]
+    out = jnp.where(emit, block, pad_id).astype(jnp.int32)
+    n_emit = emit.sum(axis=1).astype(jnp.int32)
+    accept = jnp.where(done, 0, accept).astype(jnp.int32)
+    remaining = jnp.where(done, remaining, remaining - n_emit)
+    newly = remaining <= 0
+    if eos_id is not None:
+        newly = newly | jnp.any(hits & emit, axis=1)
+    # Next feed = last emitted token; a live row always emits >= 1
+    # (col 0 is acceptance-free and budget >= 1 while live).
+    last = jnp.maximum(n_emit - 1, 0)
+    nxt = jnp.take_along_axis(block, last[:, None], axis=1)[:, 0]
+    token = jnp.where(done, pad_id, nxt).astype(jnp.int32)
+    pos = jnp.where(done, pos, pos + n_emit)
+    return out, n_emit, accept, token, pos, done | newly, remaining
+
+
+@partial(
+    jax.jit,
+    static_argnames=("model", "sampling", "pad_id", "eos_id"),
+    donate_argnames=("cache", "token", "pos", "done", "remaining"),
+)
+def _spec_verify_jit(
+    model, params, cache, token, pos, done, remaining, proposals, key,
+    *, sampling, pad_id, eos_id,
+):
+    """Verify host-supplied proposals [S, k] in ONE t=k+1 target pass
+    and advance the pool. Self-drafting path (n-gram / prompt-lookup):
+    q is a one-hot at the proposal."""
+    TRACE_COUNTS["spec_verify"] += 1
+    apply = _model_apply(model, params)
+    s, k = proposals.shape
+    cur0 = _pool_cursor(cache, s)
+    block_in = jnp.concatenate([token[:, None], proposals], axis=1)
+    positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+    logits, cache = apply(
+        cache, block_in, positions, jnp.ones((s, k + 1), jnp.int32)
+    )
+    out, n_emit, accept, token, pos, done_new, remaining = _spec_advance(
+        logits, proposals, None, key, token, pos, done, remaining,
+        sampling=sampling, pad_id=pad_id, eos_id=eos_id,
+    )
+    # Rollback = cursor rewind (done rows pinned at entry cursor).
+    cache = _set_pool_cursor(cache, jnp.where(done, cur0, cur0 + n_emit))
+    return cache, token, pos, done_new, remaining, out, n_emit, accept
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "model", "draft_model", "k", "sampling", "pad_id", "eos_id",
+    ),
+    donate_argnames=(
+        "cache", "d_cache", "token", "pos", "done", "remaining",
+    ),
+)
+def _spec_draft_verify_jit(
+    model, params, draft_model, draft_params, cache, d_cache,
+    token, pos, done, remaining, key,
+    *, k, sampling, pad_id, eos_id,
+):
+    """Fused draft+verify: k single-token draft passes propose, one
+    t=k+1 target pass verifies, and BOTH pools' cursors advance in
+    lockstep by the per-slot emit count. The draft cache ingests
+    [token, p_1..p_{k-1}] — exactly the entries that are correct for
+    any accepted prefix — so rewinding its cursor by the same n_emit
+    keeps it one-entry behind the target (the next pass feeds the
+    corrected last token to both), and no draft entry ever needs
+    patching."""
+    TRACE_COUNTS["spec_draft_verify"] += 1
+    apply = _model_apply(model, params)
+    d_apply = _model_apply(draft_model, draft_params)
+    s = token.shape[0]
+    cur0 = _pool_cursor(cache, s)
+    d_cur0 = _pool_cursor(d_cache, s)
+    stochastic = sampling.temperature != 0.0
+    ones = jnp.ones((s, 1), jnp.int32)
+    draft_keys = (
+        jax.random.split(jax.random.fold_in(key, 3), k)
+        if stochastic else None
+    )
+    toks, qs = [], []
+    tok = token
+    for i in range(k):
+        d_logits, d_cache = d_apply(
+            d_cache, tok[:, None], (pos + i)[:, None], ones
+        )
+        if stochastic:
+            q_i = transform_logits(d_logits[:, -1, :], sampling)
+            tok = jax.random.categorical(
+                draft_keys[i], q_i, axis=-1
+            ).astype(jnp.int32)
+            qs.append(q_i)
+        else:
+            tok = jnp.argmax(
+                d_logits[:, -1, :].astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)
+        toks.append(tok)
+    proposals = jnp.stack(toks, axis=1)  # [S, k]
+    q_trans = jnp.stack(qs, axis=1) if stochastic else None
+    block_in = jnp.concatenate([token[:, None], proposals], axis=1)
+    positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+    logits, cache = apply(
+        cache, block_in, positions, jnp.ones((s, k + 1), jnp.int32)
+    )
+    # tpulint: disable=TPU003 — _spec_advance folds key with constants
+    # 1/2, disjoint from the fold_in(key, 3) draft split above.
+    out, n_emit, accept, token, pos, done_new, remaining = _spec_advance(
+        logits, proposals, q_trans, key, token, pos, done, remaining,
+        sampling=sampling, pad_id=pad_id, eos_id=eos_id,
+    )
+    cache = _set_pool_cursor(cache, jnp.where(done, cur0, cur0 + n_emit))
+    d_cache = _set_pool_cursor(
+        d_cache, jnp.where(done, d_cur0, d_cur0 + n_emit)
+    )
+    return (
+        cache, d_cache, token, pos, done_new, remaining, out, n_emit,
+        accept,
+    )
+
+
+def _reject_penalty(sampling: SamplingConfig) -> None:
+    if (
+        sampling.repetition_penalty is not None
+        and sampling.repetition_penalty != 1.0
+    ):
+        raise ValueError(
+            "speculative slot-pool decode does not compose with a "
+            "repetition penalty (acceptance at position j would change "
+            "the penalized distribution at j+1, breaking the one-pass "
+            "verify) — use plain decode_steps for penalty pools"
+        )
+
+
+def spec_verify_steps(pool, proposals, key):
+    """One self-draft speculative pass over ``pool`` (a SlotPool /
+    PagedSlotPool): verify host proposals [S, k], advance the pool,
+    return (out [S, k+1], n_emit [S], accept [S]) as device arrays."""
+    _reject_penalty(pool.sampling)
+    proposals = jnp.asarray(proposals, jnp.int32)
+    perf = getattr(pool, "perf", None)
+    if perf is not None:
+        perf.observe_jit(
+            f"serve_spec_k{proposals.shape[1]}",
+            _spec_verify_jit,
+            (
+                pool.model, pool.params, pool.cache, pool.token,
+                pool.pos, pool.done, pool.remaining, proposals, key,
+            ),
+            kwargs=dict(
+                sampling=pool.sampling, pad_id=pool.pad_id,
+                eos_id=pool.eos_id,
+            ),
+        )
+    (
+        pool.cache, pool.token, pool.pos, pool.done, pool.remaining,
+        out, n_emit, accept,
+    ) = _spec_verify_jit(
+        pool.model, pool.params, pool.cache, pool.token, pool.pos,
+        pool.done, pool.remaining, proposals, key,
+        sampling=pool.sampling, pad_id=pool.pad_id, eos_id=pool.eos_id,
+    )
+    return out, n_emit, accept
+
+
+def spec_draft_steps(pool, draft_pool, key, k: int):
+    """One fused draft+verify pass: ``draft_pool`` (same n_slots,
+    cursors in lockstep with ``pool``) proposes k tokens, the target
+    verifies. Returns (out [S, k+1], n_emit [S], accept [S])."""
+    _reject_penalty(pool.sampling)
+    perf = getattr(pool, "perf", None)
+    if perf is not None:
+        perf.observe_jit(
+            f"serve_spec_draft_k{k}",
+            _spec_draft_verify_jit,
+            (
+                pool.model, pool.params, draft_pool.model,
+                draft_pool.params, pool.cache, draft_pool.cache,
+                pool.token, pool.pos, pool.done, pool.remaining, key,
+            ),
+            kwargs=dict(
+                k=k, sampling=pool.sampling, pad_id=pool.pad_id,
+                eos_id=pool.eos_id,
+            ),
+        )
+    (
+        pool.cache, draft_pool.cache, pool.token, pool.pos, pool.done,
+        pool.remaining, out, n_emit, accept,
+    ) = _spec_draft_verify_jit(
+        pool.model, pool.params, draft_pool.model, draft_pool.params,
+        pool.cache, draft_pool.cache, pool.token, pool.pos, pool.done,
+        pool.remaining, key,
+        k=k, sampling=pool.sampling, pad_id=pool.pad_id,
+        eos_id=pool.eos_id,
+    )
+    return out, n_emit, accept
+
+
+def ngram_propose(
+    history: Sequence[int], k: int, *, max_n: int = 3, pad_id: int = 0
+) -> List[int]:
+    """Prompt-lookup self-drafting (host-side, O(len * n) per call):
+    match the longest trailing n-gram (n = max_n..1) of ``history``
+    against its earlier occurrences and propose the k tokens that
+    followed the MOST RECENT match. A cold miss returns pad fill — the
+    verify pass then accepts 0 columns and the pass degrades to plain
+    single-token yield, never to a wrong emission."""
+    h = list(history)
+    length = len(h)
+    for n in range(min(max_n, length - 1), 0, -1):
+        tail = h[length - n:]
+        for i in range(length - n - 1, -1, -1):
+            if h[i:i + n] == tail:
+                cont = h[i + n:i + n + k]
+                if cont:
+                    return (cont + [pad_id] * (k - len(cont)))[:k]
+    return [pad_id] * k
+
+
+class AcceptEMA:
+    """Per-slot EMA of the accepted-draft fraction (accept / k) — the
+    host-side signal behind acceptance-aware scheduling. Slots start
+    OPTIMISTIC (EMA 1.0 on occupy) so every request gets at least one
+    speculative pass; the pool runs spec while the mean EMA over
+    active slots clears ``min_accept``, and otherwise falls back to
+    plain chunked decode, re-probing with one spec pass every
+    ``probe_every`` fallback chunks (0 disables probing — draft-model
+    pools set this, because plain chunks leave the draft KV stale and
+    a probe would measure the stale-context draft)."""
+
+    def __init__(
+        self,
+        n_slots: int,
+        *,
+        alpha: float = 0.25,
+        min_accept: float = 0.25,
+        probe_every: int = 8,
+    ) -> None:
+        self.alpha = float(alpha)
+        self.min_accept = float(min_accept)
+        self.probe_every = int(probe_every)
+        self.ema: List[Optional[float]] = [None] * n_slots
+        self._since_spec = 0
+
+    def occupy(self, slot: int) -> None:
+        self.ema[slot] = 1.0
+
+    def vacate(self, slot: int) -> None:
+        self.ema[slot] = None
+
+    def update(self, slot: int, frac: float) -> None:
+        prev = self.ema[slot]
+        if prev is None:
+            prev = 1.0
+        self.ema[slot] = (1.0 - self.alpha) * prev + self.alpha * float(
+            frac
+        )
+
+    def fallback_slots(self, slots: Sequence[int]) -> int:
+        """Active slots currently below the acceptance threshold."""
+        return sum(
+            1
+            for s in slots
+            if self.ema[s] is not None and self.ema[s] < self.min_accept
+        )
+
+    def use_spec(self, slots: Sequence[int]) -> bool:
+        vals = [self.ema[s] for s in slots if self.ema[s] is not None]
+        if not vals:
+            return False
+        if sum(vals) / len(vals) >= self.min_accept:
+            self._since_spec = 0
+            return True
+        self._since_spec += 1
+        if self.probe_every and self._since_spec >= self.probe_every:
+            self._since_spec = 0
+            return True
+        return False

@@ -496,13 +496,10 @@ class MLAttention(nn.Module):
             # Paged latent arenas — layout/masking contract mirrors
             # llama Attention._paged_cached_attention (page 0 reserved,
             # gather reconstructs the logical row in slot order, junk
-            # beyond the cursor dies in the causal fill below).
-            if t != 1:
-                raise ValueError(
-                    "paged KV cache is decode-only (t == 1): prefill "
-                    "runs contiguous and is paged at insert "
-                    "(tpufw.infer.pages)"
-                )
+            # beyond the cursor dies in the causal fill below; t > 1
+            # is the speculative verify block, same slot-ordered
+            # causality over the just-scattered tokens). Prefill runs
+            # contiguous and is paged at insert (tpufw.infer.pages).
             page, n_pages = cfg.kv_page, cfg.kv_pages
             if cfg.max_seq_len % page:
                 raise ValueError(
@@ -540,24 +537,25 @@ class MLAttention(nn.Module):
                     jnp.zeros, (n_pages, page), jnp.float32,
                 )
             cur = cursor.value
-            cur_w = jnp.minimum(cur, cfg.max_seq_len - 1)
-            phys = table.value[jnp.arange(b), cur_w // page]
-            off = cur_w % page
+            cur_w = jnp.minimum(cur, cfg.max_seq_len - t)
+            wslot = cur_w[:, None] + jnp.arange(t)[None, :]  # [B, t]
+            phys = table.value[jnp.arange(b)[:, None], wslot // page]
+            off = wslot % page
             if quant:
-                qc, sc = quantize_kv(c_kv[:, 0], n_feat=1)
-                qp, sp = quantize_kv(k_pe[:, 0], n_feat=1)
+                qc, sc = quantize_kv(c_kv, n_feat=1)
+                qp, sp = quantize_kv(k_pe, n_feat=1)
                 cc.value = cc.value.at[phys, off].set(qc)
                 cp.value = cp.value.at[phys, off].set(qp)
                 ccs.value = ccs.value.at[phys, off].set(sc)
                 cps.value = cps.value.at[phys, off].set(sp)
             else:
                 cc.value = cc.value.at[phys, off].set(
-                    c_kv[:, 0].astype(cfg.dtype)
+                    c_kv.astype(cfg.dtype)
                 )
                 cp.value = cp.value.at[phys, off].set(
-                    k_pe[:, 0].astype(cfg.dtype)
+                    k_pe.astype(cfg.dtype)
                 )
-            cseg.value = cseg.value.at[phys, off].set(seg[:, 0])
+            cseg.value = cseg.value.at[phys, off].set(seg)
             cursor.value = cur + t
             idx = table.value
             s = cfg.max_seq_len

@@ -679,7 +679,7 @@ class Attention(nn.Module):
         )
 
     def _paged_cached_attention(self, q, k, v, segment_ids):
-        """Paged KV-cache decode step (cfg.kv_page > 0, t == 1 only).
+        """Paged KV-cache decode step (cfg.kv_page > 0).
 
         Storage is a global arena of ``kv_pages`` pages x ``kv_page``
         slots shared by every row; ``page_table`` [B, S/page] maps each
@@ -693,15 +693,17 @@ class Attention(nn.Module):
         the logit before softmax (exp underflows to exact 0.0, and
         0.0 * finite-junk-V == 0.0). Occupancy, table churn, and cursor
         motion are all DATA — one jitted program forever.
+
+        t == 1 is the plain decode step; t > 1 is the speculative
+        verify block (tpufw.infer.speculative chunked path): all t
+        tokens scatter into consecutive logical slots first, then the
+        gather reconstructs the row INCLUDING the block, so intra-block
+        causality falls out of the same slot-ordered mask. Prefill
+        still runs through a contiguous row cache and is scattered into
+        pages at insert (tpufw.infer.pages).
         """
         cfg = self.cfg
         b, t = q.shape[:2]
-        if t != 1:
-            raise ValueError(
-                "paged KV cache is decode-only (t == 1): prefill runs "
-                "through a contiguous row cache and is scattered into "
-                "pages at insert (tpufw.infer.pages)"
-            )
         page, n_pages = cfg.kv_page, cfg.kv_pages
         if cfg.max_seq_len % page:
             raise ValueError(
@@ -744,22 +746,26 @@ class Attention(nn.Module):
         # Same write-window clamp as the contiguous per-row branch: a
         # done-but-still-stepped row keeps scattering in bounds. Its
         # writes land either in its own private last page (the
-        # allocator never shares a row's final page) or, once retired
-        # (table zeroed), in reserved page 0.
-        wslot = jnp.minimum(cur, cfg.max_seq_len - 1)
-        phys = table.value[jnp.arange(b), wslot // page]
+        # allocator never shares a row's final page; speculative
+        # callers keep t <= page so the clamped window never leaves
+        # it) or, once retired (table zeroed), in reserved page 0.
+        wslot = (
+            jnp.minimum(cur, cfg.max_seq_len - t)[:, None]
+            + jnp.arange(t)[None, :]
+        )  # [B, t] logical write slots
+        phys = table.value[jnp.arange(b)[:, None], wslot // page]
         off = wslot % page
         if quant:
-            qk, sk = quantize_kv(k[:, 0], n_feat=2)
-            qv, sv = quantize_kv(v[:, 0], n_feat=2)
+            qk, sk = quantize_kv(k, n_feat=2)
+            qv, sv = quantize_kv(v, n_feat=2)
             ck.value = ck.value.at[phys, off].set(qk)
             cv.value = cv.value.at[phys, off].set(qv)
             cks.value = cks.value.at[phys, off].set(sk)
             cvs.value = cvs.value.at[phys, off].set(sv)
         else:
-            ck.value = ck.value.at[phys, off].set(k[:, 0].astype(cfg.dtype))
-            cv.value = cv.value.at[phys, off].set(v[:, 0].astype(cfg.dtype))
-        cseg.value = cseg.value.at[phys, off].set(seg[:, 0])
+            ck.value = ck.value.at[phys, off].set(k.astype(cfg.dtype))
+            cv.value = cv.value.at[phys, off].set(v.astype(cfg.dtype))
+        cseg.value = cseg.value.at[phys, off].set(seg)
         cursor.value = cur + t
         # Gather the logical view: [B, per_row] table -> [B, S, ...].
         idx = table.value
@@ -782,7 +788,7 @@ class Attention(nn.Module):
             causal=True,
             segment_ids=seg,
             kv_segment_ids=cseg.value[idx].reshape(b, s),
-            q_positions=wslot[:, None],
+            q_positions=wslot,
             logits_soft_cap=getattr(cfg, "attn_logit_soft_cap", None),
             sliding_window=self.window,
             backend="xla",

@@ -46,6 +46,7 @@ SCHEMA: Dict[str, FrozenSet[str]] = {
     "serve_pool_switch": frozenset({"cache_len", "slots"}),
     "serve_prefix": frozenset({"hit", "shared_pages", "prompt_tokens"}),
     "serve_migration": frozenset({"pages", "bytes", "wall_s"}),
+    "serve_spec": frozenset({"k", "mode"}),
     "router_request": frozenset({"tenant", "replica", "latency_s"}),
     "router_reject": frozenset({"tenant", "reason"}),
     "slo_violation": frozenset(

@@ -16,7 +16,7 @@ sliding window is good/total; the **burn rate** for error budget
 1.0 means the budget burns exactly at the sustainable rate, 14.4 on
 the short window is the classic page-now threshold. Multi-window
 evaluation (default 60s/300s/3600s) lets alerting distinguish a blip
-from a sustained regression, and ROADMAP item 5's autoscaler will
+from a sustained regression, and ROADMAP item 4's autoscaler will
 read the same gauges.
 
 Targets come from ``TPUFW_SLO_TTFT_MS`` / ``TPUFW_SLO_TOK_MS`` with

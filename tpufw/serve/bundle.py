@@ -54,6 +54,12 @@ HEADER_SCHEMA: Dict[str, tuple] = {
     "done": (bool, 1, True),
     "cache_index": (int, 1, True),
     "trace": (dict, 1, False),
+    # Prompt token ids, optional: a decode replica running
+    # speculative self-drafting (TPUFW_SERVE_SPEC_K) needs the
+    # request's history to mine n-gram proposals from; bundles from
+    # producers that predate the field still splice fine — the slot
+    # just drafts from its generated tokens alone.
+    "prompt": (list, 1, False),
 }
 
 #: Non-array metadata fields copied between state dict and header
@@ -193,7 +199,10 @@ def decode_bundle(data: bytes) -> Dict[str, Any]:
         )
     state: Dict[str, Any] = {}
     for k in _META_FIELDS:
-        state[k] = header[k]
+        # Optional fields (schema required=False) decode to None when
+        # the producer predates them; required ones were proven
+        # present by the schema pass above.
+        state[k] = header.get(k)
     state["paths"] = paths
     state["arrays"] = arrays
     state["seen"] = seen

@@ -47,7 +47,7 @@ from tpufw.serve.bundle import (
     decode_bundle,
     encode_bundle,
 )
-from tpufw.workloads.env import env_int, env_opt_str, env_str
+from tpufw.workloads.env import env_float, env_int, env_opt_str, env_str
 
 DEFAULT_PEER_PORT = 8477
 
@@ -216,6 +216,10 @@ class PrefillEngine:
             if ctx is not None:
                 tmeta.update(ctx.meta())
             state["trace"] = tmeta
+            # Ride the prompt ids in the header: a spec-enabled decode
+            # replica mines its n-gram proposals from them. Optional,
+            # so old decoders splice the bundle unchanged.
+            state["prompt"] = [int(t) for t in prompt]
             data = encode_bundle(state)
             self.migrations += 1
             self.migration_bytes += len(data)
@@ -267,6 +271,8 @@ class DecodeEngine:
         eos_id: Optional[int] = None,
         seed_base: int = 0,
         chunk: int = 4,
+        spec_k: int = 0,
+        spec_min_accept: float = 0.25,
         events=None,
         tracer=None,
     ):
@@ -290,6 +296,36 @@ class DecodeEngine:
         self._chunk_index = 0
         self._events = events if events is not None else obs_events.NULL
         self._tracer = tracer if tracer is not None else obs_trace.NULL
+        # Speculative self-drafting (n-gram proposals against the
+        # request's own history, verified by spec_steps' single
+        # jitted pass). No draft model on a replica — the monolithic
+        # scheduler owns that path; here speculation must cost zero
+        # extra HBM so migration parity stays trivial.
+        self.spec_k = max(0, int(spec_k))
+        self._ema = None
+        self.spec_passes = 0
+        if self.spec_k:
+            from tpufw.infer.speculative import AcceptEMA
+
+            if self.spec_k + 1 > page:
+                raise ValueError(
+                    f"spec_k={self.spec_k} needs spec_k+1 <= page="
+                    f"{page} (verify writes one block per pass)"
+                )
+            rp = getattr(sampling, "repetition_penalty", None)
+            if rp is not None and rp != 1.0:
+                # Acceptance at position j changes the penalized
+                # distribution at j+1 — speculation can't honour the
+                # penalty, so this replica runs plain chunks.
+                self._events.emit(
+                    "serve_spec", level="warn", k=self.spec_k,
+                    mode="plain_fallback", reason="repetition_penalty",
+                )
+                self.spec_k = 0
+            else:
+                self._ema = AcceptEMA(
+                    n_slots, min_accept=spec_min_accept,
+                )
         self._cv = threading.Condition()
         #: slot -> {"tokens": [...], "budget": int, "done": bool} plus
         #: the reqtrace bookkeeping collect_ex reports (splice_s,
@@ -305,7 +341,7 @@ class DecodeEngine:
         a = self.pool.allocator
         with self._cv:
             active = len(self._jobs)
-        return {
+        sig = {
             "role": "decode",
             "pages_total": a.capacity,
             "pages_in_use": a.in_use,
@@ -313,6 +349,10 @@ class DecodeEngine:
             "slots_active": active,
             "migrations": self.migrations,
         }
+        if self.spec_k:
+            sig["spec_k"] = self.spec_k
+            sig["spec_passes"] = self.spec_passes
+        return sig
 
     def can_accept(self, n_pages: int) -> bool:
         with self._cv:
@@ -357,6 +397,12 @@ class DecodeEngine:
                 "budget": int(state["remaining"]),
                 "done": bool(state["done"])
                 or int(state["remaining"]) <= 0,
+                # Prompt ids when the producer shipped them (optional
+                # header field): the n-gram self-draft mines proposals
+                # from prompt + generated history.
+                "history": [
+                    int(t) for t in (state.get("prompt") or [])
+                ],
                 "ctx": ctx,
                 "splice_s": splice_s,
                 # perf_counter at splice end: first_flush measures
@@ -366,6 +412,8 @@ class DecodeEngine:
                 "n_chunks": 0,
             }
             self._jobs[slot] = job
+            if self._ema is not None and not job["done"]:
+                self._ema.occupy(slot)
             if job["done"]:
                 # Prefill already finished this request (EOS as the
                 # first sampled token, or a zero budget): no decode
@@ -396,7 +444,15 @@ class DecodeEngine:
 
     def _run_chunk_locked(self) -> None:
         """One shared decode chunk (caller holds ``_cv``). Every
-        active slot advances; retired slots free their pages."""
+        active slot advances; retired slots free their pages.
+
+        With ``spec_k`` set the pass may run speculatively: n-gram
+        proposals from each slot's history, verified in ONE target
+        call, per-slot advance = its own accept count (+1 bonus).
+        The acceptance EMA decides spec-vs-plain per pass, so
+        low-yield traffic degrades to plain chunks and periodically
+        re-probes — a migrated request decodes bit-equal either way
+        (greedy verify is exact)."""
         import jax
         import numpy as np
 
@@ -405,22 +461,48 @@ class DecodeEngine:
         }
         if not live:
             return
-        k = self.chunk
+        use_spec = self._ema is not None and self._ema.use_spec(
+            sorted(live)
+        )
+        k = self.spec_k if use_spec else self.chunk
         t0 = time.perf_counter()
         key = jax.random.fold_in(
             jax.random.key(self._seed_base + 1), self._chunk_index
         )
         chunk_index = self._chunk_index
         self._chunk_index += 1
-        out = np.asarray(
-            self.pool.decode_steps(jax.random.split(key, k))
-        )
+        n_emit = accept = None
+        if use_spec:
+            from tpufw.infer import speculative as spec_mod
+
+            props = np.zeros((self.n_slots, k), np.int32)
+            for slot, job in live.items():
+                props[slot] = spec_mod.ngram_propose(
+                    job["history"] + job["tokens"], k
+                )
+            out, n_emit, accept = self.pool.spec_steps(props, key)
+            out = np.asarray(out)
+            n_emit = np.asarray(n_emit)
+            accept = np.asarray(accept)
+        else:
+            out = np.asarray(
+                # tpulint: disable=TPU003 — exclusive if/else arms:
+                # exactly ONE of spec_steps/decode_steps consumes this
+                # chunk's key.
+                self.pool.decode_steps(jax.random.split(key, k))
+            )
         t1 = time.perf_counter()
         chunk_s = t1 - t0
+        accept_frac = 0.0
         for slot, job in live.items():
-            row = out[slot].tolist()
-            take = min(k, job["budget"] - (len(job["tokens"]) - 1))
-            row = row[:take]
+            budget_left = job["budget"] - (len(job["tokens"]) - 1)
+            if use_spec:
+                take = min(int(n_emit[slot]), budget_left)
+                row = out[slot, :take].tolist()
+                self._ema.update(slot, int(accept[slot]) / k)
+                accept_frac += int(accept[slot]) / k
+            else:
+                row = out[slot].tolist()[: min(k, budget_left)]
             if self._eos is not None and self._eos in row:
                 row = row[: row.index(self._eos) + 1]
             job["tokens"].extend(row)
@@ -447,6 +529,14 @@ class DecodeEngine:
             ):
                 job["done"] = True
                 self.pool.release_slot(slot)
+                if self._ema is not None:
+                    self._ema.vacate(slot)
+        if use_spec:
+            self.spec_passes += 1
+            self._events.emit(
+                "serve_spec", k=k, mode="pass", rows=len(live),
+                accept_rate=round(accept_frac / len(live), 4),
+            )
         self._cv.notify_all()
 
     def collect(self, slot: int, timeout: float = 600.0) -> List[int]:
@@ -532,6 +622,8 @@ def _build_engine(role: str):
             model, params,
             chunk=max(1, env_int("serve_chunk", 0)
                       or env_int("stream_chunk", 16)),
+            spec_k=env_int("serve_spec_k", 0),
+            spec_min_accept=env_float("serve_spec_min_accept", 0.25),
             **common,
         ),
         restored,

@@ -54,6 +54,7 @@ DEFAULT_ROUTER_PORT = 8478
 _SIGNAL_KEYS = (
     "pages_total", "pages_in_use", "slots_total", "slots_active",
     "migrations", "goodput_ratio", "mfu", "hbm_headroom_bytes",
+    "spec_k", "spec_passes",
 )
 
 
@@ -73,6 +74,11 @@ class ReplicaState:
     goodput_ratio: Optional[float] = None
     mfu: Optional[float] = None
     hbm_headroom_bytes: Optional[float] = None
+    # Speculative decode replicas advertise their draft depth and pass
+    # count; health() surfaces both so an operator can see which pool
+    # is speculating (and that its verify passes are advancing).
+    spec_k: int = 0
+    spec_passes: int = 0
     healthy: bool = True
     last_seen: float = 0.0
 
@@ -109,7 +115,7 @@ class ReplicaState:
             return
         for k in _SIGNAL_KEYS:
             # tpulint: disable=TPU015 — goodput_ratio / mfu /
-            # hbm_headroom_bytes are ROADMAP item 5's forward
+            # hbm_headroom_bytes are ROADMAP item 4's forward
             # contract: no replica exports them yet, but the policy
             # folds them in the moment one does (score() above).
             v = signals.get(k)
@@ -505,6 +511,11 @@ class RouterServer:
                     "pages_total": r.pages_total,
                     "slots_active": r.slots_active,
                     "slots_total": r.slots_total,
+                    **(
+                        {"spec_k": r.spec_k,
+                         "spec_passes": r.spec_passes}
+                        if r.spec_k else {}
+                    ),
                 }
                 for name, r in self._states.items()
             }

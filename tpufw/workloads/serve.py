@@ -940,6 +940,10 @@ class _SlotScheduler:
         arena_pages: Optional[int] = None,
         perf=None,
         page_export=None,
+        spec_k: Optional[int] = None,
+        spec_draft: Optional[str] = None,
+        spec_min_accept: Optional[float] = None,
+        spec_draft_built=None,
     ):
         import jax
         import numpy as np
@@ -1026,6 +1030,69 @@ class _SlotScheduler:
             from tpufw.infer import pages as pages_mod
 
             self._pages_mod = pages_mod
+        # Speculative decoding on the slot pool: TPUFW_SERVE_SPEC_K > 0
+        # drafts spec_k tokens per pass and verifies them in ONE target
+        # call (tpufw.infer.speculative chunked path). Ctor kwargs win
+        # over env (bench runs both modes in one process). spec_draft
+        # "" = self-drafting (n-gram prompt lookup, no extra HBM); a
+        # model preset name builds a draft pool sharing the target's
+        # page arena budget. spec_draft_built short-circuits the preset
+        # resolution with a pre-built (decode_cfg, params) pair — the
+        # server passes its TPUFW_DRAFT_MODEL build through this.
+        self.spec_k = (
+            env_int("serve_spec_k", 0) if spec_k is None else int(spec_k)
+        )
+        self.spec_draft = (
+            env_str("serve_spec_draft", "")
+            if spec_draft is None
+            else str(spec_draft)
+        )
+        self.spec_min_accept = (
+            env_float("serve_spec_min_accept", 0.25)
+            if spec_min_accept is None
+            else float(spec_min_accept)
+        )
+        self._draft_cfg = None
+        self._draft_params = None
+        self._draft_n_params = 0
+        self._draft_pool = None
+        self._ema = None
+        # Cumulative accept bookkeeping behind tpufw_spec_accept_rate.
+        self._spec_accept_sum = 0.0
+        self._spec_accept_rows = 0
+        if self.spec_k:
+            if self.spec_k < 1:
+                raise ValueError(
+                    f"TPUFW_SERVE_SPEC_K={self.spec_k}: need >= 1"
+                )
+            if self.page and self.spec_k + 1 > self.page:
+                # Clamp safety: a done row's junk verify block must fit
+                # inside the row's own last page (writes clamp to
+                # max_seq_len - (k+1)), so the block can never spill
+                # into a neighbour's page.
+                raise ValueError(
+                    f"TPUFW_SERVE_SPEC_K={self.spec_k}: the k+1 verify "
+                    f"block must fit one KV page (page={self.page})"
+                )
+            from tpufw.infer import speculative as spec_mod
+
+            self._spec_mod = spec_mod
+            if spec_draft_built is not None:
+                self._draft_cfg, self._draft_params = spec_draft_built
+            elif self.spec_draft and self.spec_draft != "ngram":
+                self._draft_cfg, self._draft_params = (
+                    self._build_spec_draft(self.spec_draft)
+                )
+            if self._draft_params is not None:
+                # Wasted-draft-FLOPs accounting (~2 * params per drafted
+                # token, decode-side); 0 for self-drafting — n-gram
+                # lookup costs no device FLOPs.
+                self._draft_n_params = sum(
+                    int(np.prod(leaf.shape))
+                    for leaf in jax.tree_util.tree_leaves(
+                        self._draft_params
+                    )
+                )
         if metrics is not None:
             metrics.register(
                 "retired_rows_total",
@@ -1040,6 +1107,16 @@ class _SlotScheduler:
                     "prefix_misses_total",
                     "pages_freed_total",
                 )
+            if self.spec_k:
+                # Speculation metrics live OUTSIDE the tpufw_serve_
+                # prefix (they also serve the disagg DecodeEngine);
+                # registered at 0/absent-series like the rest, gated so
+                # non-spec servers keep a byte-identical exposition.
+                metrics.registry.counter(
+                    "tpufw_spec_wasted_draft_flops_total"
+                )
+                metrics.registry.gauge("tpufw_spec_accept_rate")
+                metrics.registry.gauge("tpufw_spec_fallback_slots")
             metrics.registry.histogram(
                 "tpufw_serve_join_latency_seconds",
                 "Request submit-to-first-slot-insert latency",
@@ -1148,6 +1225,10 @@ class _SlotScheduler:
         )
         jobs = []
         req = _SlotReq(pend, sampling, [])
+        # Speculative slack: a live row's verify block writes up to
+        # spec_k slots past its final cursor before rolling back, so
+        # spec rows size their cache rung / page grant for it.
+        slack = self._spec_slack(sampling)
         for prompt in pend.prompts:
             if self.page:
                 # Paged rows prefill at their EXACT width (no 64-token
@@ -1159,14 +1240,15 @@ class _SlotScheduler:
             # Validate at submit (not mid-pool): prefill writes pb
             # slots, decode writes max_new - 1 more (the first token
             # comes out of prefill).
-            if pb + pend.max_new - 1 > cap:
+            if pb + pend.max_new - 1 + slack > cap:
                 raise ValueError(
                     f"prompt ({len(prompt)}, bucketed to {pb}) + "
-                    f"max_new_tokens ({pend.max_new}) exceeds the KV "
-                    f"cache (max_seq_len={cap})"
+                    f"max_new_tokens ({pend.max_new})"
+                    + (f" + spec slack ({slack})" if slack else "")
+                    + f" exceeds the KV cache (max_seq_len={cap})"
                 )
             if self.page and self.arena_pages is not None:
-                need = -(-(pb + pend.max_new - 1) // self.page)
+                need = -(-(pb + pend.max_new - 1 + slack) // self.page)
                 if need > self.arena_pages - 1:
                     # Reject now, not in the admission loop: a row
                     # that can NEVER fit the arena would deadlock the
@@ -1181,7 +1263,7 @@ class _SlotScheduler:
                 pb,
                 pend.max_new,
                 _cache_bucket(
-                    pb + pend.max_new - 1, cap, self.cache_floor
+                    pb + pend.max_new - 1 + slack, cap, self.cache_floor
                 ),
             ))
         req.jobs = jobs
@@ -1259,6 +1341,72 @@ class _SlotScheduler:
             )
         )
 
+    def _spec_slack(self, sampling) -> int:
+        """Extra KV slots a speculative row needs past max_new - 1 (0
+        when speculation is off or ineligible for this sampling)."""
+        if not self.spec_k:
+            return 0
+        if self._slots_mod._track_seen(sampling):
+            return 0
+        return self.spec_k
+
+    def _build_spec_draft(self, name: str):
+        """Resolve TPUFW_SERVE_SPEC_DRAFT as a model preset: weights
+        from TPUFW_DRAFT_PARAMS_CHECKPOINT, else random init (wiring
+        tests only — proposals rarely match, acceptance collapses and
+        the EMA falls the pool back to plain decode). Returns the
+        (decode_cfg, params) pair the per-pool variants derive from."""
+        import dataclasses
+
+        jax = self._jax
+        from tpufw.configs.loader import resolve_model_preset
+        from tpufw.models import model_for_config
+
+        base = resolve_model_preset(name)
+        cfg = dataclasses.replace(
+            base, max_seq_len=env_int("max_seq_len", base.max_seq_len)
+        )
+        ckpt = env_str("draft_params_checkpoint", "")
+        if ckpt:
+            params = _restore_bare_params(cfg, ckpt)
+        else:
+            model = model_for_config(cfg)
+            params = jax.jit(model.init)(
+                jax.random.key(self._seed_base + 1),
+                self._jax.numpy.zeros(
+                    (1, min(8, cfg.max_seq_len)), self._jax.numpy.int32
+                ),
+            )["params"]
+        return cfg.decode_config(), params
+
+    def _draft_pool_models(self, cache_len: int):
+        """Per-pool draft model variants (pool + contiguous prefill
+        twin) mirroring _pool_model/_row_model's replace() trick, with
+        the SAME page/arena geometry as the target so one shared
+        PageAllocator id space covers both physical arenas."""
+        import dataclasses
+
+        from tpufw.models import model_for_config
+
+        row_cfg = dataclasses.replace(
+            self._draft_cfg, max_seq_len=cache_len
+        )
+        if not self.page:
+            return model_for_config(row_cfg), model_for_config(row_cfg)
+        per_row = cache_len // self.page
+        n_pages = (
+            self.arena_pages
+            if self.arena_pages is not None
+            else self.n_slots * per_row + 1
+        )
+        pool_cfg = dataclasses.replace(
+            row_cfg,
+            kv_page=self.page,
+            kv_pages=n_pages,
+            kv_quant=self.kv_quant,
+        )
+        return model_for_config(pool_cfg), model_for_config(row_cfg)
+
     def _build_pool(self, key) -> None:
         cache_len, sampling = key
         with self._tracer.span(
@@ -1289,6 +1437,57 @@ class _SlotScheduler:
             # SlotPool/PagedSlotPool read it via getattr) so insert /
             # decode programs harvest their XLA cost analysis.
             self._pool.perf = self._perf
+        self._draft_pool = None
+        self._ema = None
+        if self.spec_k:
+            track = self._slots_mod._track_seen(sampling)
+            if track:
+                # Acceptance at position j would change the penalized
+                # distribution at j+1 — the one-pass verify cannot
+                # compose with a repetition penalty, so this pool stays
+                # on plain chunked decode.
+                self._events.emit(
+                    "serve_spec",
+                    level="warn",
+                    k=self.spec_k,
+                    mode="plain_fallback",
+                    reason="repetition_penalty",
+                )
+            else:
+                if self._draft_cfg is not None:
+                    d_pool, d_row = self._draft_pool_models(cache_len)
+                    if self.page:
+                        self._draft_pool = (
+                            self._pages_mod.PagedSlotPool.create_paged(
+                                d_pool,
+                                d_row,
+                                self._draft_params,
+                                self.n_slots,
+                                sampling=sampling,
+                                pad_id=0,
+                                eos_id=None,
+                                prefix_cache=False,
+                                allocator=self._pool.allocator,
+                            )
+                        )
+                    else:
+                        self._draft_pool = self._slots_mod.SlotPool.create(
+                            d_pool,
+                            self._draft_params,
+                            self.n_slots,
+                            sampling=sampling,
+                            pad_id=0,
+                            eos_id=None,
+                        )
+                self._ema = self._spec_mod.AcceptEMA(
+                    self.n_slots,
+                    min_accept=self.spec_min_accept,
+                    # Plain chunks leave a draft pool's KV stale (only
+                    # the target advances), so a probe there would
+                    # measure a stale-context draft: draft-pool
+                    # fallback is sticky until the pool drains.
+                    probe_every=0 if self._draft_pool is not None else 8,
+                )
         self._pool_key = key
         self._slots = [None] * self.n_slots
         self._n_active = 0
@@ -1376,7 +1575,9 @@ class _SlotScheduler:
                 # eviction — stop admitting and let retires free pages
                 # (FIFO holds: nothing overtakes within the pool key).
                 grant = self._pool.acquire_pages(
-                    job.prompt, len(job.prompt) + job.max_new - 1
+                    job.prompt,
+                    len(job.prompt) + job.max_new - 1
+                    + self._spec_slack(self._pool.sampling),
                 )
                 if grant is None:
                     break
@@ -1524,9 +1725,88 @@ class _SlotScheduler:
                 job.max_new - 1,
                 row_seen=seen,
             )
+        if self._draft_pool is not None:
+            self._admit_draft(job, slot, rng)
+        if self._ema is not None:
+            self._ema.occupy(slot)
         self._slots[slot] = job
         self._n_active += 1
         return True
+
+    def _admit_draft(self, job: _SlotJob, slot: int, rng) -> None:
+        """Prefill ``job``'s prompt through the draft model into the
+        draft pool's matching slot. Draft pages come from the SHARED
+        allocator but are granted strictly AFTER the target's, and a
+        failed draft grant degrades the slot (its proposals verify as
+        junk, acceptance collapses, the EMA routes the pool to plain
+        decode) instead of blocking admission — speculation never
+        starves target-page admission."""
+        d_grant = None
+        if self.page:
+            d_grant = self._draft_pool.acquire_pages(
+                job.prompt,
+                len(job.prompt) + job.max_new - 1 + self.spec_k,
+            )
+            if d_grant is None:
+                self._events.emit(
+                    "serve_spec",
+                    level="warn",
+                    k=self.spec_k,
+                    mode="draft_starved",
+                    slot=slot,
+                )
+                return
+        try:
+            d_cache, _f, d_first, _d, d_seen = self._slots_mod.prefill_row(
+                getattr(
+                    self._draft_pool, "row_model", self._draft_pool.model
+                ),
+                self._draft_params,
+                job.prompt,
+                # Disjoint from the job's sampling stream (the drawn
+                # first token is discarded; drafting re-proposes from
+                # the target's actual last token each pass).
+                self._jax.random.fold_in(rng, 11),
+                sampling=self._draft_pool.sampling,
+                eos_id=None,
+                pad_to=(
+                    len(job.prompt) if self.page else job.p_bucket
+                ),
+                prefill_chunk_size=self.prefill_chunk,
+            )
+            if d_grant is not None:
+                self._draft_pool.insert_paged(
+                    slot,
+                    d_cache,
+                    d_first,
+                    len(job.prompt),
+                    job.max_new - 1 + self.spec_k,
+                    d_grant[0],
+                    0,
+                    row_seen=d_seen,
+                )
+            else:
+                self._draft_pool.insert(
+                    slot,
+                    d_cache,
+                    d_first,
+                    len(job.prompt),
+                    job.max_new - 1 + self.spec_k,
+                    row_seen=d_seen,
+                )
+        except Exception as e:  # noqa: BLE001 — degrade, don't fail
+            if d_grant is not None:
+                self._free_pages(
+                    self._draft_pool.release_pages(d_grant[0])
+                )
+            self._events.emit(
+                "serve_spec",
+                level="warn",
+                k=self.spec_k,
+                mode="draft_starved",
+                slot=slot,
+                reason=str(e),
+            )
 
     def _free_pages(self, freed: int) -> None:
         if freed and self._metrics is not None:
@@ -1542,13 +1822,164 @@ class _SlotScheduler:
             self._free_pages(self._pool.release_slot(slot))
         elif device:
             self._pool.retire(slot)
+        if self._draft_pool is not None:
+            # Draft KV pages retire through the same allocator/refcount
+            # path as the target's (a slot that never got a draft grant
+            # releases an empty list — no-op).
+            if self.page:
+                self._free_pages(self._draft_pool.release_slot(slot))
+            elif device:
+                self._draft_pool.retire(slot)
+        if self._ema is not None:
+            self._ema.vacate(slot)
         self._slots[slot] = None
         self._n_active -= 1
+
+    def _use_spec(self, active) -> bool:
+        """Acceptance-aware scheduling: spec while the active slots'
+        mean accept-EMA clears the threshold (None = spec off or this
+        pool is penalty-ineligible)."""
+        if self._ema is None:
+            return False
+        return self._ema.use_spec([slot for slot, _ in active])
+
+    def _run_spec_chunk(self, active) -> None:
+        """One speculative pass over every occupied slot: draft
+        spec_k tokens (n-gram self-draft or the draft pool), verify
+        them in ONE target call, advance each slot by its own accept
+        count. Mirrors _run_chunk's retire/flush/accounting with the
+        chunk length replaced by the per-slot emit counts."""
+        k = self.spec_k
+        with self._cv:
+            chunk_index = self._chunk_index
+            self._chunk_index += 1
+        key = self._jax.random.fold_in(
+            self._jax.random.key(self._seed_base + 1), chunk_index
+        )
+        page_snap: dict[int, list[int]] = {}
+        if self.page and self._page_export is not None:
+            page_snap = {
+                slot: list(self._pool.slot_pages[slot])
+                for slot, _ in active
+            }
+        chunk_t0 = time.perf_counter()
+        with self._tracer.span(
+            "serve_spec_chunk", k=k, rows=len(active)
+        ):
+            if self._draft_pool is not None:
+                out, n_emit, accept = self._pool.spec_draft_steps(
+                    self._draft_pool, key, k
+                )
+            else:
+                props = self._np.zeros(
+                    (self.n_slots, k), self._np.int32
+                )
+                for slot, job in active:
+                    props[slot] = self._spec_mod.ngram_propose(
+                        list(job.prompt) + job.tokens, k
+                    )
+                # tpulint: disable=TPU003 — exclusive if/else arms:
+                # exactly ONE of spec_draft_steps/spec_steps consumes
+                # this chunk's key.
+                out, n_emit, accept = self._pool.spec_steps(props, key)
+            out = self._np.asarray(out)
+            n_emit = self._np.asarray(n_emit)
+            accept = self._np.asarray(accept)
+        chunk_s = time.perf_counter() - chunk_t0
+        self._perf.record_wall(
+            f"serve_spec_draft_k{k}"
+            if self._draft_pool is not None
+            else f"serve_spec_k{k}",
+            chunk_s,
+        )
+        live_tokens = 0
+        flush: list[_SlotReq] = []
+        finished: list[_SlotReq] = []
+        accept_frac = 0.0
+        for slot, job in active:
+            req = job.req
+            take = min(int(n_emit[slot]), job.max_new - len(job.tokens))
+            row = out[slot, :take].tolist()
+            # The program already masks past the first EOS; this trim
+            # is the same belt-and-braces as the plain path.
+            if self._eos is not None and self._eos in row:
+                row = row[: row.index(self._eos) + 1]
+            job.tokens.extend(row)
+            job.unflushed.extend(row)
+            live_tokens += len(row)
+            self._ema.update(slot, int(accept[slot]) / k)
+            accept_frac += int(accept[slot]) / k
+            if req.pend.stream_q is not None and req not in flush:
+                flush.append(req)
+            if len(job.tokens) >= job.max_new or (
+                self._eos is not None and row and row[-1] == self._eos
+            ):
+                if self.page and self._page_export is not None:
+                    self._page_export(
+                        job,
+                        self._pool.export_slot(
+                            slot, page_ids=page_snap[slot]
+                        ),
+                    )
+                self._retire_slot(slot, device=False)
+                if self._metrics is not None:
+                    self._metrics.inc("retired_rows_total")
+                req.rows_left -= 1
+                if req.rows_left == 0 and req.next_job == len(req.jobs):
+                    finished.append(req)
+        rate = accept_frac / max(len(active), 1)
+        self._spec_accept_sum += accept_frac
+        self._spec_accept_rows += len(active)
+        if self._metrics is not None:
+            self._metrics.inc("ticks_total")
+            self._metrics.inc("tick_rows_total", len(active))
+            self._metrics.inc("tokens_generated_total", live_tokens)
+            # Device work this pass = S * (k+1) verify token-positions
+            # (the capacity denominator goodput splits below); rejected
+            # draft work is tracked separately as wasted draft FLOPs.
+            self._metrics.inc(
+                "wasted_slot_steps_total",
+                self.n_slots * (k + 1) - live_tokens,
+            )
+            reg = self._metrics.registry
+            # Cumulative mean, not last-pass: a scrape after traffic
+            # drains must still report what the server accepted.
+            reg.gauge("tpufw_spec_accept_rate").set(
+                self._spec_accept_sum / max(self._spec_accept_rows, 1)
+            )
+            reg.gauge("tpufw_spec_fallback_slots").set(
+                float(
+                    self._ema.fallback_slots([s for s, _ in active])
+                )
+            )
+            reg.counter("tpufw_spec_wasted_draft_flops_total").inc(
+                sum(k - int(accept[s]) for s, _ in active)
+                * 2.0
+                * self._draft_n_params
+            )
+        self._events.emit(
+            "serve_spec",
+            k=k,
+            mode="pass",
+            rows=len(active),
+            accept_rate=round(rate, 4),
+        )
+        live_frac = live_tokens / (self.n_slots * (k + 1))
+        self._goodput.add("busy", chunk_s * live_frac)
+        self._goodput.add("wasted_slot", chunk_s * (1.0 - live_frac))
+        for req in flush:
+            if req not in finished:
+                self._flush_stream(req)
+        for req in finished:
+            self._finish(req)
 
     def _run_chunk(self) -> None:
         active = [
             (i, j) for i, j in enumerate(self._slots) if j is not None
         ]
+        if self._use_spec(active):
+            self._run_spec_chunk(active)
+            return
         # Pow-2 ladder on the chunk length: the scan length is a
         # compiled-shape dimension, so the tail of a nearly-done pool
         # shrinks k in big steps (at most log2(chunk) programs), never
@@ -1705,6 +2136,8 @@ class _SlotScheduler:
         self._n_active = 0
         self._pool = None  # donated buffers are suspect after a failure
         self._pool_key = None
+        self._draft_pool = None  # rides the pool's allocator — same fate
+        self._ema = None
         for req in reqs.values():
             req.error = e
             with self._cv:
@@ -1814,9 +2247,22 @@ class _Server:
         self._tracer: object = self._tel.tracer
         # Scheduler backend: the slot scheduler (decode-step-granular
         # continuous batching) is the default; TPUFW_SERVE_SLOTS=0 opts
-        # back into the tick batcher, and the speculative path still
-        # ticks (its verify loop has no per-row chunk form yet).
-        if env_int("serve_slots", 8) > 0 and self._draft is None:
+        # back into the tick batcher. Speculation COMPOSES with slots
+        # now — a TPUFW_DRAFT_MODEL build seeds the scheduler's chunked
+        # verify path (unless the TPUFW_SERVE_SPEC_* knobs claim it),
+        # instead of silently rerouting all traffic through the tick
+        # path as it used to.
+        if env_int("serve_slots", 8) > 0:
+            spec_kw = {}
+            if (
+                self._draft is not None
+                and env_int("serve_spec_k", 0) == 0
+                and not env_str("serve_spec_draft", "")
+            ):
+                dm, dp, dk = self._draft
+                spec_kw = dict(
+                    spec_k=dk, spec_draft_built=(dm.cfg, dp)
+                )
             self._batcher = _SlotScheduler(
                 self.model,
                 self.params,
@@ -1829,8 +2275,20 @@ class _Server:
                 goodput=self._tel.goodput,
                 watchdog=self._tel.watchdog,
                 perf=self._tel.perf,
+                **spec_kw,
             )
         else:
+            if self._draft is not None:
+                # Legacy whole-batch speculative ticking: only reachable
+                # by explicit TPUFW_SERVE_SLOTS=0 opt-out now. Schema'd
+                # warn so operators notice the downgrade.
+                self._events.emit(
+                    "serve_spec",
+                    level="warn",
+                    k=self._draft[2],
+                    mode="tick_fallback",
+                    reason="TPUFW_SERVE_SLOTS=0 legacy tick batcher",
+                )
             self._batcher = _Batcher(
                 self._run_tick, self.metrics, run_stream=self._run_stream
             )
@@ -1893,6 +2351,17 @@ class _Server:
                         "prefix_misses_total",
                         "pages_freed_total",
                     )
+                if self._batcher.spec_k:
+                    # Gated like the registration: the warmup request's
+                    # speculative passes must stay invisible to scrapes.
+                    reg = self.metrics.registry
+                    reg.counter(
+                        "tpufw_spec_wasted_draft_flops_total"
+                    ).reset()
+                    self._batcher._spec_accept_sum = 0.0
+                    self._batcher._spec_accept_rows = 0
+                    reg.gauge("tpufw_spec_accept_rate").set(0.0)
+                    reg.gauge("tpufw_spec_fallback_slots").set(0.0)
                 self.metrics.registry.histogram(
                     "tpufw_serve_join_latency_seconds"
                 ).reset()
@@ -2341,7 +2810,9 @@ class _Server:
 
                         rows_acc = [[] for _ in prompts]
                         try:
-                            if outer._draft is not None:
+                            if outer._draft is not None and not isinstance(
+                                outer._batcher, _SlotScheduler
+                            ):
                                 outs, _bw = outer.generate(
                                     prompts, max_new, sampling
                                 )
