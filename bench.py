@@ -629,6 +629,7 @@ def _measure_disagg(
     decode_slots: int = 8,
     chunk: int = 8,
     concurrency: int = 6,
+    prefill_chunk_pages: int = 0,
 ) -> dict:
     """The disaggregated serving measurement: every request prefills
     on a PrefillEngine, ships a page bundle, and splices into a
@@ -647,6 +648,7 @@ def _measure_disagg(
     pe = PrefillEngine(
         model, params, sampling=greedy, page=page,
         kv_quant=kv_quant, n_slots=prefill_slots,
+        prefill_chunk_pages=prefill_chunk_pages,
     )
     de = DecodeEngine(
         model, params, sampling=greedy, page=page,
@@ -662,7 +664,7 @@ def _measure_disagg(
         slot = de.submit(bundle)
         t2 = time.perf_counter()  # first token now usable on decode
         out = de.collect_ex(slot)
-        tokens = out["tokens"]
+        tokens = out.get("tokens") or []
         t3 = time.perf_counter()
         # Per-stage TTFT decomposition: the bundle header carries the
         # prefill engine's own stage clocks (queue/admit/compute/
@@ -676,8 +678,18 @@ def _measure_disagg(
             "migration_bytes": len(bundle),
             "tokens": len(tokens),
             "per_token_s": (t3 - t0) / max(1, len(tokens)),
+            # Decode-side cadence only (splice -> last token): the
+            # fungibility guardrail. Chunked prefill reshapes TTFT on
+            # purpose; what it must NOT do is slow the decode
+            # replica's token pace.
+            "decode_per_token_s": (t3 - t2) / max(1, len(tokens)),
             "stage_queue_s": float(eng.get("queue", 0.0))
             + float(eng.get("admit", 0.0)),
+            # Chunked mode only: lock re-acquire + arena-stall waits
+            # BETWEEN chunks. This wait interleaves with other
+            # requests' chunks instead of head-of-line blocking them,
+            # which is why it is not part of `queue`.
+            "stage_queue_chunks_s": float(eng.get("queue_chunks", 0.0)),
             "stage_prefill_s": float(eng.get("compute", 0.0)),
             "stage_export_wire_s": float(eng.get("export", 0.0))
             + max(0.0, (t1 - t0) - wall),
@@ -709,6 +721,7 @@ def _measure_disagg(
         "prefill_slots": prefill_slots,
         "decode_slots": decode_slots,
         "chunk": chunk,
+        "prefill_chunk_pages": prefill_chunk_pages,
         "serve_tokens_per_sec_per_chip": round(total / wall, 1),
         "ttft_p50_ms": round(pct("ttft_s", 0.5) * 1e3, 3),
         "ttft_p95_ms": round(pct("ttft_s", 0.95) * 1e3, 3),
@@ -717,6 +730,12 @@ def _measure_disagg(
         ),
         "per_token_latency_p95_ms": round(
             pct("per_token_s", 0.95) * 1e3, 3
+        ),
+        "decode_per_token_p50_ms": round(
+            pct("decode_per_token_s", 0.5) * 1e3, 3
+        ),
+        "decode_per_token_p95_ms": round(
+            pct("decode_per_token_s", 0.95) * 1e3, 3
         ),
         "migration_bytes_per_request": int(
             sum(r["migration_bytes"] for r in rows) / len(rows)
@@ -735,6 +754,7 @@ def _measure_disagg(
             name: round(pct(key, 0.5) * 1e3, 3)
             for name, key in (
                 ("queue", "stage_queue_s"),
+                ("queue_chunks", "stage_queue_chunks_s"),
                 ("prefill", "stage_prefill_s"),
                 ("export_wire", "stage_export_wire_s"),
                 ("splice", "stage_splice_s"),
@@ -745,6 +765,161 @@ def _measure_disagg(
         # here before it shows up in per-token latency.
         "decode_chunks_per_request": round(
             sum(r["chunks"] for r in rows) / len(rows), 2
+        ),
+    }
+
+
+def _measure_chunked_prefill(
+    model,
+    params,
+    *,
+    page: int,
+    long_len: int = 160,
+    short_len: int = 16,
+    n_pairs: int = 6,
+    max_new: int = 16,
+    concurrency: int = 6,
+    chunk_pages: int = 2,
+    piggyback: float = 0.5,
+) -> dict:
+    """Chunked-prefill sub-tier: an adversarial long/short mix through
+    the ROUTER, monolithic vs chunked+piggyback at identical hardware.
+    Long prompts hog the prefill replica; under monolithic admission
+    every short prompt behind them eats the whole long prefill as
+    queue time (head-of-line blocking). With chunking the short's
+    first chunk interleaves between the long's chunks, and with the
+    piggyback waterline the router can skip the prefill replica
+    entirely and admit the raw prompt on a decode replica's spare
+    chunk capacity. Reports the short-request TTFT collapse, the
+    piggyback fraction, and the decode per-token tax."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as _np
+
+    from tpufw.infer import SamplingConfig
+    from tpufw.serve.roles import DecodeEngine, PrefillEngine
+    from tpufw.serve.router import (
+        LocalReplica,
+        RouterPolicy,
+        RouterServer,
+    )
+
+    greedy = SamplingConfig(temperature=0.0)
+    rng = _np.random.default_rng(0)
+    vocab = int(model.cfg.vocab_size)
+    reqs = []
+    for _ in range(n_pairs):
+        reqs.append(rng.integers(1, vocab, size=long_len).tolist())
+        reqs.append(rng.integers(1, vocab, size=short_len).tolist())
+
+    def run_arm(chunked: bool) -> dict:
+        pe = PrefillEngine(
+            model, params, sampling=greedy, page=page, n_slots=2,
+            prefill_chunk_pages=chunk_pages if chunked else 0,
+        )
+        de = DecodeEngine(
+            model, params, sampling=greedy, page=page, n_slots=8,
+            chunk=8,
+            prefill_chunk_pages=chunk_pages if chunked else 0,
+            piggyback=piggyback if chunked else 0.0,
+        )
+        srv = RouterServer(
+            [LocalReplica("prefill-0", pe)],
+            [LocalReplica("decode-0", de)],
+            policy=RouterPolicy(), port=0, page=page,
+        )
+
+        def one(p):
+            t0 = time.perf_counter()
+            code, body, _ = srv.generate(
+                {"prompt": list(p), "max_new": max_new}
+            )
+            wall = time.perf_counter() - t0
+            if code != 200:
+                raise RuntimeError(f"router {code}: {body}")
+            return {
+                "short": len(p) == short_len,
+                "ttft_s": float(body["ttft_s"]),
+                "per_token_s": wall / max(1, len(body["tokens"])),
+                # Post-first-token pace: on the piggyback path the
+                # decode pool runs prefill chunks between decode
+                # chunks, and THIS is where that would show up.
+                "decode_per_token_s": max(
+                    0.0, wall - float(body["ttft_s"])
+                ) / max(1, len(body["tokens"])),
+                "piggyback": bool(body.get("piggyback")),
+                "tokens": len(body["tokens"]),
+            }
+
+        # Compile every program the arm can hit outside the timed
+        # window: the dedicated-prefill hop for both lengths, and (in
+        # the chunked arm) the decode pool's piggyback chunk widths.
+        one(reqs[0])
+        one(reqs[1])
+        if chunked:
+            s = de.submit_raw(reqs[1], max_new)
+            de.collect_ex(s)
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=concurrency) as pool:
+            rows = list(pool.map(one, reqs))
+        wall = time.perf_counter() - t0
+        srv.close()
+
+        def pct(vals, q):
+            vals = sorted(vals)
+            return vals[min(len(vals) - 1, round(q * (len(vals) - 1)))]
+
+        shorts = [r for r in rows if r["short"]]
+        longs = [r for r in rows if not r["short"]]
+        total = sum(r["tokens"] for r in rows)
+        return {
+            "short_ttft_p50_ms": round(
+                pct([r["ttft_s"] for r in shorts], 0.5) * 1e3, 3
+            ),
+            "short_ttft_p95_ms": round(
+                pct([r["ttft_s"] for r in shorts], 0.95) * 1e3, 3
+            ),
+            "long_ttft_p50_ms": round(
+                pct([r["ttft_s"] for r in longs], 0.5) * 1e3, 3
+            ),
+            "per_token_latency_p50_ms": round(
+                pct([r["per_token_s"] for r in rows], 0.5) * 1e3, 3
+            ),
+            "per_token_latency_p95_ms": round(
+                pct([r["per_token_s"] for r in rows], 0.95) * 1e3, 3
+            ),
+            "decode_per_token_p50_ms": round(
+                pct(
+                    [r["decode_per_token_s"] for r in rows], 0.5
+                ) * 1e3, 3
+            ),
+            "decode_per_token_p95_ms": round(
+                pct(
+                    [r["decode_per_token_s"] for r in rows], 0.95
+                ) * 1e3, 3
+            ),
+            "piggyback_fraction": round(
+                sum(1 for r in rows if r["piggyback"]) / len(rows), 3
+            ),
+            "serve_tokens_per_sec_per_chip": round(total / wall, 1),
+        }
+
+    mono = run_arm(False)
+    ck = run_arm(True)
+    return {
+        "requests": 2 * n_pairs,
+        "concurrency": concurrency,
+        "long_prompt_len": long_len,
+        "short_prompt_len": short_len,
+        "new_tokens": max_new,
+        "page": page,
+        "chunk_pages": chunk_pages,
+        "piggyback_waterline": piggyback,
+        "monolithic": mono,
+        "chunked": ck,
+        "short_ttft_p50_speedup": round(
+            mono["short_ttft_p50_ms"]
+            / max(1e-9, ck["short_ttft_p50_ms"]), 2
         ),
     }
 
@@ -889,9 +1064,22 @@ def _serve_disagg_main(argv: list) -> int:
             key: _measure_disagg(
                 model, params, page=16, kv_quant=quant,
                 prompts=prompts, max_new=max_new,
+                prefill_chunk_pages=ck,
             )
-            for quant, key in (("", "bf16_kv"), ("int8", "int8_kv"))
+            for quant, key, ck in (
+                ("", "bf16_kv", 0),
+                ("int8", "int8_kv", 0),
+                # Same traffic, chunked admission: the queue share of
+                # the TTFT breakdown is the before/after headline.
+                ("", "bf16_kv_chunked", 2),
+                ("int8", "int8_kv_chunked", 2),
+            )
         },
+        # Adversarial long/short mix through the router: short-request
+        # TTFT with and without chunked prefill + piggyback admission.
+        "chunked_prefill": _measure_chunked_prefill(
+            model, params, page=16,
+        ),
         # Speculative sub-tier: n-gram self-draft vs the identical
         # paged-int8 scheduler at equal HBM, accept-heavy mix. A
         # 64-token vocab makes the tiny random-init model's greedy

@@ -27,6 +27,12 @@ cluster. What must hold:
 - the SLO layer scores the run: /metrics exposes
   tpufw_slo_ttft_attainment with a per-tenant label, and
   obs_summary prints the SLO attainment table;
+- prefill/decode fungibility: a chunked prefill replica serves the
+  same request bit-equal (stages gain prefill_queue_chunks), a
+  router with NO prefill replica steers the raw prompt onto a
+  piggyback-enabled decode replica (response carries
+  ``piggyback: true``, zero migration pages), and /healthz surfaces
+  the chunk-occupancy signals the policy steers on;
 - the router ledger (events-router.jsonl) digests cleanly through
   scripts/obs_summary.py, and /metrics exposes the router counters.
 
@@ -243,6 +249,83 @@ def main() -> int:
         f"(in_use={de_spec.pool.allocator.in_use})",
     )
     spec_router.close()
+
+    # ---- chunked prefill + raw-prompt piggyback fungibility ----
+    # Separate RouterServers on purpose: the main router's /metrics
+    # assertion below counts exactly its own 2 requests.
+    pe_ck = PrefillEngine(
+        model, params, n_slots=2, prefill_chunk_pages=1, **common
+    )
+    de_pig = DecodeEngine(
+        model, params, n_slots=4, chunk=2,
+        prefill_chunk_pages=1, piggyback=0.05,
+        sampling=greedy, page=PAGE, kv_quant="int8", events=events,
+    )
+    ck_router = RouterServer(
+        [LocalReplica("prefill-ck", pe_ck)],
+        [LocalReplica("decode-pig", de_pig)],
+        port=0, page=PAGE, events=events,
+    )
+    kbase = f"http://127.0.0.1:{ck_router.port}"
+    status, body, _h = _post(kbase, {
+        "prompt": shared + [7, 9], "max_new": MAX_NEW, "tenant": "smoke",
+    })
+    check(
+        status == 200
+        and body.get("tokens") == first_body.get("tokens"),
+        "chunked prefill replica is bit-equal to the monolithic one "
+        f"through migration (got {body.get('tokens')})",
+    )
+    check(
+        "prefill_queue_chunks" in body.get("stages", {}),
+        "TTFT decomposition gained the prefill_queue_chunks stage "
+        f"(stages={sorted(body.get('stages', {}))})",
+    )
+    ck_router.close()
+    # No prefill replica at all: the router must steer the raw prompt
+    # straight onto the piggyback-enabled decode replica.
+    pig_router = RouterServer(
+        [], [LocalReplica("decode-pig", de_pig)],
+        port=0, page=PAGE, events=events,
+    )
+    gbase = f"http://127.0.0.1:{pig_router.port}"
+    status, body, _h = _post(gbase, {
+        "prompt": shared + [7, 9], "max_new": MAX_NEW, "tenant": "smoke",
+    })
+    check(
+        status == 200 and body.get("piggyback") is True
+        and body.get("migration_pages") == 0,
+        "raw prompt piggybacked onto the decode replica — no prefill "
+        f"hop, no migration (got {status}, "
+        f"piggyback={body.get('piggyback')})",
+    )
+    check(
+        body.get("tokens") == first_body.get("tokens"),
+        "piggybacked request is bit-equal to the migrated one "
+        f"(got {body.get('tokens')})",
+    )
+    with urllib.request.urlopen(gbase + "/healthz", timeout=60) as resp:
+        health = json.loads(resp.read())
+    rep = health.get("replicas", {}).get("decode-pig", {})
+    chunk_sig = {
+        k: rep.get(k)
+        for k in ("prefill_chunk_pages", "piggyback_waterline",
+                  "prefill_inflight")
+    }
+    check(
+        rep.get("prefill_chunk_pages") == 1
+        and "piggyback_waterline" in rep
+        and "prefill_inflight" in rep,
+        "/healthz surfaces the chunk-occupancy signals the policy "
+        f"steers on ({chunk_sig})",
+    )
+    with urllib.request.urlopen(gbase + "/metrics", timeout=60) as resp:
+        pig_metrics = resp.read().decode()
+    check(
+        "tpufw_router_piggyback_total 1" in pig_metrics,
+        "router counted the piggyback admission on /metrics",
+    )
+    pig_router.close()
 
     # ---- request tracing: merge per-role traces, check the stitch ----
     for tr in tracers.values():

@@ -27,6 +27,14 @@ bit-exact, so the length/ordering assertions double as a parity
 check), and a final section runs one more request end-to-end and
 asserts the spec metrics are exposed on /metrics.
 
+Chunked prefill rides the whole smoke too (TPUFW_SERVE_PREFILL_CHUNK
+=1: every admission drains page-by-page through the shared passes —
+chunked-vs-monolithic is bit-equal under greedy, so every assertion
+above doubles as a parity check), and a final section submits a
+1-page prompt AFTER a 6-page prompt and asserts the short request's
+first streamed token lands BEFORE the long one's — a long prompt no
+longer head-of-line-blocks admission.
+
 Exit 0 on success; any assertion or HTTP failure exits nonzero.
 """
 
@@ -47,6 +55,7 @@ os.environ.setdefault("TPUFW_MODEL", "llama3_tiny")
 os.environ.setdefault("TPUFW_SERVE_CHUNK", "2")
 os.environ.setdefault("TPUFW_SERVE_PAGE", "16")
 os.environ.setdefault("TPUFW_SERVE_SPEC_K", "4")
+os.environ.setdefault("TPUFW_SERVE_PREFILL_CHUNK", "1")
 
 LONG_NEW, SHORT_NEW, STREAM_NEW = 60, 4, 16
 
@@ -226,6 +235,92 @@ def main() -> int:
         f"{metrics['tpufw_spec_wasted_draft_flops_total']:.0f}"
     )
     print("serve-smoke OK: speculative request served end-to-end")
+
+    # ---- chunked prefill: no head-of-line blocking on admission ----
+    if not env_int("serve_prefill_chunk", 0):
+        print("serve-smoke: chunked-prefill section skipped "
+              "(TPUFW_SERVE_PREFILL_CHUNK=0)")
+        srv.httpd.shutdown()
+        return 0
+    # A 6-page prompt submitted FIRST, a 1-page prompt AFTER it: with
+    # chunked admission the short prompt's single prefill chunk
+    # interleaves between the long one's six, so its first streamed
+    # token must land before the long prompt even finishes prefilling
+    # (and therefore before the long one's first token).
+    first_chunk_at: dict[str, float] = {}
+
+    def post_stream_timed(name: str, body: dict) -> None:
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                for line in resp:
+                    line = line.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    ev = json.loads(line[len(b"data: "):])
+                    if (
+                        name not in first_chunk_at
+                        and any(ev.get("outputs") or [])
+                    ):
+                        first_chunk_at[name] = time.time()
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"{name}: {type(e).__name__}: {e}")
+
+    long_prompt = list(range(2, 98))  # 96 tokens = 6 pages
+    hol_long = threading.Thread(
+        target=post_stream_timed,
+        args=("hol_long", {
+            "prompts": [long_prompt], "max_new_tokens": 12,
+            "stream": True,
+        }),
+    )
+    hol_long.start()
+    time.sleep(0.05)  # long admission grabs its slot first
+    hol_short = threading.Thread(
+        target=post_stream_timed,
+        args=("hol_short", {
+            "prompts": [[9, 8, 7, 6, 5, 4, 3, 2]], "max_new_tokens": 4,
+            "stream": True,
+        }),
+    )
+    hol_short.start()
+    hol_long.join(timeout=600)
+    hol_short.join(timeout=600)
+    if errors:
+        print("serve-smoke FAILED:\n  " + "\n  ".join(errors))
+        return 1
+    if not ("hol_long" in first_chunk_at and "hol_short" in first_chunk_at):
+        print(f"serve-smoke FAILED: missing first tokens "
+              f"({sorted(first_chunk_at)})")
+        return 1
+    gap = first_chunk_at["hol_long"] - first_chunk_at["hol_short"]
+    print(f"chunked prefill: short first token {gap:.3f}s before long's")
+    if gap <= 0:
+        print("serve-smoke FAILED: 1-page prompt head-of-line blocked "
+              "behind the 6-page prompt's prefill")
+        return 1
+    with urllib.request.urlopen(base + "/metrics", timeout=60) as resp:
+        metrics = {}
+        for line in resp.read().decode().splitlines():
+            if line and not line.startswith("#"):
+                name, _, val = line.partition(" ")
+                metrics[name] = float(val)
+    chunks = metrics.get("tpufw_prefill_chunks_total", 0.0)
+    inflight = metrics.get("tpufw_prefill_inflight", -1.0)
+    if chunks < 7 or "tpufw_prefill_resumes_total" not in metrics \
+            or inflight != 0:
+        print(f"serve-smoke FAILED: chunked-prefill series wrong "
+              f"(chunks={chunks}, inflight={inflight}, "
+              f"resumes_present="
+              f"{'tpufw_prefill_resumes_total' in metrics})")
+        return 1
+    print(f"serve-smoke OK: chunked prefill interleaved "
+          f"({chunks:.0f} chunks, no HOL blocking)")
     srv.httpd.shutdown()
     return 0
 

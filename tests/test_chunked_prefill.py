@@ -1,0 +1,299 @@
+"""Chunked prefill (tpufw.infer.pages ``_prefill_chunk_jit`` family +
+the slot scheduler's mixed prefill+decode pools).
+
+Contracts, all on CPU with the tiny model:
+
+- PARITY: a prompt prefilled one page-aligned chunk at a time — any
+  chunk size, bf16 or int8 pool — samples the exact first token and
+  decodes the exact greedy continuation of the monolithic
+  ``prefill_row`` path, and its row cache is bit-equal over the
+  prompt span (right-padded tail positions are masked to segment 0,
+  so their logits exp-underflow to exactly 0.0).
+- RESUME: abandoning a chunked prefill mid-flight leaves its
+  completed full pages checkpointed in the prefix trie; a
+  re-admission of the same prompt resumes from the last full page
+  (``shared_n`` > 0, fewer chunks run) with ZERO token divergence.
+- SHAPE STABILITY: chunk programs key on (width, pool, quant) only —
+  chunk-COUNT variation and page churn add zero retraces
+  (TRACE_COUNTS["prefill_chunk"] is pinned).
+- FUNGIBILITY: a scheduler admitting prompts chunk-by-chunk inside
+  the same passes that advance decoding slots (mixed pools, no
+  separate tick) emits byte-identical outputs to the monolithic
+  scheduler, including under concurrent submission.
+- NO HOL: a 1-page prompt submitted AFTER a 10-page prompt streams
+  its first token before the long prompt finishes prefilling.
+"""
+
+import dataclasses
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpufw.infer import SamplingConfig
+from tpufw.infer import pages as pages_mod
+from tpufw.infer import slots as slots_mod
+from tpufw.models import LLAMA_CONFIGS, Llama
+
+GREEDY = SamplingConfig(temperature=0.0)
+MAX_NEW = 6
+PAGE = 16
+N_SLOTS = 4
+
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3, 2, 3, 8, 4,
+          6, 2, 6, 4, 3, 3, 8, 3, 2, 7, 9, 5, 0, 2, 8, 8]  # 36 tokens
+
+
+@pytest.fixture(scope="module")
+def tiny_paged():
+    base = LLAMA_CONFIGS["llama3_tiny"].decode_config()
+    cfg = dataclasses.replace(base, max_seq_len=64)
+    row_model = Llama(cfg)
+    params = jax.jit(row_model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return cfg, row_model, params
+
+
+def _paged_pool(cfg, row_model, params, kv_quant=""):
+    pcfg = dataclasses.replace(
+        cfg,
+        kv_page=PAGE,
+        kv_pages=N_SLOTS * (cfg.max_seq_len // PAGE) + 1,
+        kv_quant=kv_quant,
+    )
+    return pages_mod.PagedSlotPool.create_paged(
+        Llama(pcfg), row_model, params, N_SLOTS,
+        sampling=GREEDY, eos_id=None,
+    )
+
+
+def _decode_all(pool, firsts, max_new=MAX_NEW, chunk=2):
+    rows = {i: [fi] for i, fi in firsts.items()}
+    ci = 0
+    while any(len(t) < max_new for t in rows.values()):
+        key = jax.random.fold_in(jax.random.key(1), ci)
+        ci += 1
+        out = np.asarray(pool.decode_steps(jax.random.split(key, chunk)))
+        for i in rows:
+            take = min(chunk, max_new - len(rows[i]))
+            rows[i].extend(out[i, :take].tolist())
+    return rows
+
+
+def _monolithic(pool, prompt, rng):
+    """Reference admission: acquire + prefill_row + insert. Returns
+    (row_cache, first_int) with slot 0 occupied."""
+    ids, shared = pool.acquire_pages(prompt, len(prompt) + MAX_NEW - 1)
+    assert shared == 0
+    cache, _f, first, _d, seen = slots_mod.prefill_row(
+        pool.row_model, pool.params, prompt, rng,
+        sampling=GREEDY, eos_id=None, pad_to=len(prompt),
+    )
+    pool.insert_paged(
+        0, cache, first, len(prompt), MAX_NEW - 1, ids, 0, row_seen=seen
+    )
+    return cache, first
+
+
+def _chunked(pool, prompt, rng, chunk_pages):
+    """Chunked admission to completion. Returns the ChunkedPrefill
+    with slot 0 occupied (finalized)."""
+    cp = pool.start_chunked(
+        prompt, len(prompt) + MAX_NEW - 1, rng, chunk_pages
+    )
+    while True:
+        status = pool.chunk_step(cp)
+        assert status != "stalled"
+        if status == "done":
+            break
+    pool.finalize_chunked(0, cp, MAX_NEW - 1)
+    return cp
+
+
+# ---------------------------------------------------------- parity
+
+@pytest.mark.parametrize("kv_quant", ["", "int8"])
+@pytest.mark.parametrize("chunk_pages", [1, 2])
+def test_chunked_bit_equal_monolithic(tiny_paged, kv_quant, chunk_pages):
+    cfg, row_model, params = tiny_paged
+    rng = jax.random.fold_in(jax.random.key(0), 0)
+
+    pool_a = _paged_pool(cfg, row_model, params, kv_quant)
+    _cache, first_a = _monolithic(pool_a, PROMPT, rng)
+    ref = _decode_all(pool_a, {0: first_a})[0]
+
+    pool_b = _paged_pool(cfg, row_model, params, kv_quant)
+    cp = _chunked(pool_b, PROMPT, rng, chunk_pages)
+    assert cp.first_int == first_a
+    got = _decode_all(pool_b, {0: cp.first_int})[0]
+    assert got == ref
+
+
+def test_chunked_row_cache_bit_equal(tiny_paged):
+    """Contiguous-level assertion: the chunk-built row cache matches
+    ``prefill_row``'s bit-for-bit over the prompt span (and exactly
+    on the cursor), not merely in its sampled tokens."""
+    cfg, row_model, params = tiny_paged
+    rng = jax.random.fold_in(jax.random.key(0), 0)
+    pool = _paged_pool(cfg, row_model, params)
+    cp = pool.start_chunked(PROMPT, len(PROMPT) + MAX_NEW - 1, rng, 2)
+    while pool.chunk_step(cp) != "done":
+        pass
+    ref_cache, _f, first, _d, _s = slots_mod.prefill_row(
+        pool.row_model, pool.params, PROMPT, rng,
+        sampling=GREEDY, eos_id=None, pad_to=len(PROMPT),
+    )
+    assert cp.first_int == int(np.asarray(first).reshape(-1)[0])
+    rp, rnames, rleaves, _ = pages_mod._flatten_with_names(cp.row_cache)
+    mp, _mn, mleaves, _ = pages_mod._flatten_with_names(ref_cache)
+    assert rp == mp
+    p = len(PROMPT)
+    for name, a, b in zip(rnames, rleaves, mleaves):
+        a, b = np.asarray(a), np.asarray(b)
+        if name == "cache_index":
+            assert (a == b).all(), name
+        elif name == "cached_segment_ids":
+            assert (a[..., :p] == b[..., :p]).all(), name
+        else:
+            ca = pages_mod._collapse_row(a, a.ndim - 1)
+            cb = pages_mod._collapse_row(b, b.ndim - 1)
+            assert (ca[:, :p] == cb[:, :p]).all(), name
+
+
+# ---------------------------------------------------------- resume
+
+def test_resume_from_trie_checkpoint(tiny_paged):
+    cfg, row_model, params = tiny_paged
+    rng = jax.random.fold_in(jax.random.key(0), 0)
+
+    pool_a = _paged_pool(cfg, row_model, params)
+    _cache, first_a = _monolithic(pool_a, PROMPT, rng)
+    ref = _decode_all(pool_a, {0: first_a})[0]
+
+    pool = _paged_pool(cfg, row_model, params)
+    cp = pool.start_chunked(PROMPT, len(PROMPT) + MAX_NEW - 1, rng, 1)
+    assert pool.chunk_step(cp) == "ran"
+    assert pool.chunk_step(cp) == "ran"  # 2 full pages committed
+    pool.abandon_chunked(cp)
+    # The two completed pages survive the abandon as trie checkpoints.
+    cp2 = pool.start_chunked(PROMPT, len(PROMPT) + MAX_NEW - 1, rng, 1)
+    assert cp2.resumed and cp2.shared_n == 2
+    n_chunks = 0
+    while pool.chunk_step(cp2) != "done":
+        n_chunks += 1
+    # 36 tokens = 3 pages total; 2 resumed, so a single final chunk.
+    assert n_chunks == 0
+    assert cp2.first_int == first_a
+    pool.finalize_chunked(0, cp2, MAX_NEW - 1)
+    got = _decode_all(pool, {0: cp2.first_int})[0]
+    assert got == ref  # zero token divergence after resume
+
+
+# ------------------------------------------------- shape stability
+
+def test_zero_retrace_across_chunk_count(tiny_paged):
+    cfg, row_model, params = tiny_paged
+    rng = jax.random.fold_in(jax.random.key(0), 0)
+    pool = _paged_pool(cfg, row_model, params)
+    _chunked(pool, PROMPT, rng, 1)  # 36 tokens -> 3 chunk calls
+    pool.release_slot(0)
+    before = pages_mod.TRACE_COUNTS["prefill_chunk"]
+    # Different prompt length, different chunk count, page churn from
+    # the release above — same (width, pool, quant) program keys.
+    _chunked(pool, [7, 5] * 10, rng, 1)  # 20 tokens -> 2 chunk calls
+    assert pages_mod.TRACE_COUNTS["prefill_chunk"] == before
+
+
+# ---------------------------------------------- scheduler fungibility
+
+def _scheduler(model, params, prefill_chunk_pages):
+    from tpufw.workloads import serve as serve_mod
+
+    return serve_mod._SlotScheduler(
+        model, params, eos_id=None, default_sampling=GREEDY,
+        seed_base=0, page=PAGE, arena_pages=None, prefix_cache=True,
+        prefill_chunk_pages=prefill_chunk_pages,
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_sched_model():
+    base = LLAMA_CONFIGS["llama3_tiny"].decode_config()
+    cfg = dataclasses.replace(base, max_seq_len=256)
+    model = Llama(cfg)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def test_mixed_pool_pass_parity(tiny_sched_model):
+    """Concurrent chunked admissions interleave with decoding slots
+    inside the same passes — outputs must match the monolithic
+    scheduler's exactly (same rng streams, greedy)."""
+    model, params = tiny_sched_model
+    prompts = [[i + 1, 5, 9, 2, 6] * 8 for i in range(3)]  # 40 tokens
+
+    s_mono = _scheduler(model, params, prefill_chunk_pages=0)
+    ref = [s_mono.submit([p], 8)[0][0] for p in prompts]
+
+    s_seq = _scheduler(model, params, prefill_chunk_pages=1)
+    assert [s_seq.submit([p], 8)[0][0] for p in prompts] == ref
+
+    s_conc = _scheduler(model, params, prefill_chunk_pages=1)
+    results = {}
+
+    def run(i, p):
+        results[i] = s_conc.submit([p], 8)[0][0]
+
+    threads = [
+        threading.Thread(target=run, args=(i, p))
+        for i, p in enumerate(prompts)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert [results[i] for i in range(3)] == ref
+
+
+def test_long_prompt_no_hol(tiny_sched_model):
+    """Regression: a 1-page prompt submitted after a 10-page prompt
+    must stream its first token before the long prompt's — under
+    monolithic admission it is head-of-line blocked behind the whole
+    long prefill."""
+    model, params = tiny_sched_model
+    s = _scheduler(model, params, prefill_chunk_pages=1)
+    long_p = [7, 3] * 80  # 160 tokens = 10 chunk passes
+    short_p = [1, 2, 3, 4, 5, 6, 7, 8]
+    ql: "queue.Queue" = queue.Queue()
+    qs: "queue.Queue" = queue.Queue()
+    s.submit_stream([long_p], 8, GREEDY, ql)
+    time.sleep(0.01)
+    s.submit_stream([short_p], 8, GREEDY, qs)
+
+    def drain(q):
+        first = None
+        while True:
+            kind, payload = q.get(timeout=120)
+            if kind == "chunk" and first is None and any(payload):
+                first = time.perf_counter()
+            if kind in ("done", "error"):
+                return first, kind
+
+    out = {}
+    tl = threading.Thread(target=lambda: out.setdefault("l", drain(ql)))
+    ts = threading.Thread(target=lambda: out.setdefault("s", drain(qs)))
+    tl.start()
+    ts.start()
+    tl.join()
+    ts.join()
+    (long_first, long_kind) = out["l"]
+    (short_first, short_kind) = out["s"]
+    assert long_kind == "done" and short_kind == "done"
+    assert short_first < long_first

@@ -40,6 +40,7 @@ the contiguous row cache).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -59,6 +60,7 @@ from tpufw.ops.quant import dequantize_kv, quantize_kv
 TRACE_COUNTS: Dict[str, int] = {
     "paged_insert": 0, "clear_table": 0, "prefix_attach": 0,
     "suffix_prefill": 0, "page_export": 0, "page_splice": 0,
+    "prefill_chunk": 0,
 }
 
 #: unstacked rank of each KV arena leaf — (n_pages, page, *feat); the
@@ -468,6 +470,161 @@ def _suffix_prefill_jit(
     return cache, first, done, seen
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "row_model", "sampling", "eos_id", "paths", "names",
+        "scale_src", "page", "quant",
+    ),
+    donate_argnames=("leaves", "row_cache", "seen_row"),
+)
+def _prefill_chunk_jit(
+    leaves, row_cache, params, tokens, chunk_ids, start, n_real,
+    is_final, rng, seen_row,
+    *, row_model, sampling, eos_id, paths, names, scale_src, page,
+    quant,
+):
+    """Advance one in-flight chunked prefill by ONE page-aligned chunk:
+    run ``tokens`` (right-padded to a whole number of pages) through
+    the contiguous row cache at logical offset ``start``, then scatter
+    the freshly written window straight into the chunk's arena pages
+    ``chunk_ids``. Programs are keyed by (chunk width, quant) — mid
+    chunks all share the ``chunk_pages`` program and tails reuse one
+    program per page-granular width, so chunk-COUNT variation and page
+    churn never retrace (``start``/``n_real``/``is_final``/``rng`` are
+    all traced).
+
+    Bit-parity with monolithic prefill holds per query: every apply
+    attends the full row cache under the causal + segment mask, padded
+    tail slots carry segment 0 (their logits weights underflow to an
+    exact 0.0), and the window scatter quantizes per token — identical
+    values to a whole-row insert. Sampling runs every chunk (one
+    program), but only the final chunk's draw is kept by the host; the
+    key is ``split_prefill_keys``' first key, the exact key a cold
+    ``prefill_row`` of the full prompt would use.
+
+    The model leaves cursor = start + width after a padded tail; the
+    row's cache_index leaves are rewritten to ``start + n_real`` here
+    so finalize (``_paged_insert_jit`` reading the row leaf) sees the
+    true prompt length."""
+    TRACE_COUNTS["prefill_chunk"] += 1
+    b, width = tokens.shape
+    in_win = jnp.arange(width)
+    valid = in_win < n_real
+    seg = valid.astype(jnp.int32)[None, :]
+    positions = start + in_win[None, :]
+    apply = _model_apply(row_model, params)
+    logits, row_cache = apply(row_cache, tokens, positions, seg)
+    row_paths, row_names, row_leaves, row_treedef = _flatten_with_names(
+        row_cache
+    )
+    row_leaves = [
+        jnp.full(l.shape, start + n_real, l.dtype)
+        if n == "cache_index" else l
+        for n, l in zip(row_names, row_leaves)
+    ]
+    if seen_row is not None:
+        # Prompt tokens enter the presence mask BEFORE the (possibly
+        # final) sample, matching _suffix_prefill_jit's ordering.
+        seen_row = seen_row.at[0, tokens[0]].max(valid)
+    last = jax.lax.dynamic_slice_in_dim(logits, n_real - 1, 1, axis=1)
+    first_rng, _ = split_prefill_keys(rng, 1)
+    first = sample_token(last[:, 0, :], sampling, first_rng, seen_row)
+    if seen_row is not None:
+        # Only the kept (final-chunk) draw marks the mask.
+        seen_row = seen_row.at[jnp.arange(b), first].max(is_final)
+    done0 = (
+        jnp.zeros((b,), bool) if eos_id is None else first == eos_id
+    )
+    # Pool and row trees flatten to identical path strings (same
+    # module tree, different leaf shapes); the row simply lacks
+    # page_table/scale leaves, so .get() -> None for those.
+    row_map = dict(zip(row_paths, row_leaves))
+    aligned = [row_map.get(p) for p in paths]
+    off = in_win % page
+    # Padded tail slots scatter into reserved page 0 — the same junk
+    # sink unmapped table entries read through.
+    phys = jnp.where(valid, chunk_ids[in_win // page], 0)
+    quantized = {}
+    if quant:
+        for i, name in enumerate(names):
+            if name in _ARENA_RANK:
+                rank = _ARENA_RANK[name]
+                rr = _collapse_row(aligned[i], rank)
+                win = jax.lax.dynamic_slice_in_dim(rr, start, width, axis=1)
+                quantized[i] = quantize_kv(win, n_feat=rank - 2)
+    out = []
+    for i, (name, leaf) in enumerate(zip(names, leaves)):
+        if name in ("page_table", "cache_index"):
+            out.append(leaf)  # finalize owns the pool-side cursors
+        elif name.endswith("_scale"):
+            scales = quantized[scale_src[i]][1]
+            a = _collapse_arena(leaf, 2)
+            out.append(a.at[:, phys, off].set(scales).reshape(leaf.shape))
+        elif name in _ARENA_RANK:
+            rank = _ARENA_RANK[name]
+            if quant:
+                vals = quantized[i][0]
+            else:
+                rr = _collapse_row(aligned[i], rank)
+                vals = jax.lax.dynamic_slice_in_dim(
+                    rr, start, width, axis=1
+                ).astype(leaf.dtype)
+            a = _collapse_arena(leaf, rank)
+            out.append(a.at[:, phys, off].set(vals).reshape(leaf.shape))
+        elif name == "cached_segment_ids":
+            rr = _collapse_row(aligned[i], 2)
+            vals = jax.lax.dynamic_slice_in_dim(
+                rr, start, width, axis=1
+            ).astype(leaf.dtype)
+            a = _collapse_arena(leaf, 2)
+            out.append(a.at[:, phys, off].set(vals).reshape(leaf.shape))
+        else:
+            raise ValueError(
+                f"unknown paged cache leaf {name!r}: the chunk scatter "
+                "must know every leaf's role (an untouched leaf would "
+                "leak the previous occupant's state)"
+            )
+    row_out = jax.tree_util.tree_unflatten(row_treedef, row_leaves)
+    return tuple(out), row_out, first, done0, seen_row
+
+
+@dataclasses.dataclass
+class ChunkedPrefill:
+    """Host-side cursor of one in-flight chunked prefill: the prompt,
+    its contiguous row cache mid-flight, the pages committed so far,
+    and the rng the final chunk samples with. Created by
+    ``PagedSlotPool.start_chunked``, advanced by ``chunk_step``,
+    consumed by ``finalize_chunked`` (or ``abandon_chunked`` on
+    preemption — the trie checkpoint keeps every completed full page,
+    so a re-admission resumes instead of restarting)."""
+
+    prompt: List[int]
+    rng: Any
+    chunk_pages: int
+    n_total: int  # pages the finished row owns (incl. decode budget)
+    row_cache: Any  # None until the first chunk_step attaches it
+    seen_row: Any
+    cursor: int  # logical slots committed so far (page-aligned)
+    page_ids: List[int]
+    shared_n: int  # trie-shared pages attached at start
+    n_chunks: int = 0
+    first: Any = None
+    first_int: int = -1
+    done0: bool = False
+
+    @property
+    def resumed(self) -> bool:
+        return self.shared_n > 0
+
+    @property
+    def deficit(self) -> int:
+        """Pages still to acquire before this prefill can finish —
+        admission guards sum this across in-flight chunked prefills so
+        two part-admitted rows can never deadlock on the arena."""
+        return self.n_total - len(self.page_ids)
+
+
 @dataclasses.dataclass
 class PagedSlotPool(SlotPool):
     """SlotPool whose KV lives in a shared page arena.
@@ -667,16 +824,18 @@ class PagedSlotPool(SlotPool):
         self.cache = jax.tree_util.tree_unflatten(treedef, list(leaves))
         self.slot_pages[slot] = list(page_ids)
 
-    def prefill_shared(self, prompt: Sequence[int], shared_ids, rng):
-        """Prefix-hit admission: attach ``shared_ids``' pages to a
-        fresh row cache, prefill only the suffix. Same return contract
-        as ``tpufw.infer.slots.prefill_row`` — (row_cache, first_arr,
-        first_int, done0, seen)."""
-        # Fresh template every admission: the attach jit DONATES the
-        # row leaves (their memory becomes the attached cache), so a
-        # cached tree would hand already-deleted buffers to the second
-        # prefix hit. The zeros alloc is trivia next to the prefill.
+    def _attach_row(self, shared_ids):
+        """Fresh B=1 contiguous row cache with ``shared_ids``' pages
+        gathered into its first ``len(shared_ids) * page`` slots
+        (cursor set accordingly); plain zeros when nothing is shared.
+
+        A fresh template every call: the attach jit DONATES the row
+        leaves (their memory becomes the attached cache), so a cached
+        tree would hand already-deleted buffers to the second prefix
+        hit. The zeros alloc is trivia next to the prefill."""
         row_tree = _row_zeros_tree(self.row_model, self.params)
+        if not len(shared_ids):
+            return row_tree
         paths, names, leaves, _ = self._pool_flat()
         row_paths, _, row_leaves, row_treedef = _flatten_with_names(
             row_tree
@@ -693,9 +852,16 @@ class PagedSlotPool(SlotPool):
             jnp.asarray(np.asarray(shared_ids, np.int32)),
             names=names, scale_of=scale_of, page=self.page, quant=quant,
         )
-        row_cache = jax.tree_util.tree_unflatten(
+        return jax.tree_util.tree_unflatten(
             row_treedef, [a for a in attached if a is not None]
         )
+
+    def prefill_shared(self, prompt: Sequence[int], shared_ids, rng):
+        """Prefix-hit admission: attach ``shared_ids``' pages to a
+        fresh row cache, prefill only the suffix. Same return contract
+        as ``tpufw.infer.slots.prefill_row`` — (row_cache, first_arr,
+        first_int, done0, seen)."""
+        row_cache = self._attach_row(shared_ids)
         length = len(shared_ids) * self.page
         suffix = jnp.asarray(
             np.asarray(prompt[length:], np.int32)[None, :]
@@ -706,6 +872,184 @@ class PagedSlotPool(SlotPool):
             length, rng, sampling=self.sampling, eos_id=self.eos_id,
         )
         return cache, first, int(np.asarray(first)[0]), done, seen
+
+    # ---- chunked prefill ------------------------------------------
+
+    def start_chunked(
+        self, prompt: Sequence[int], need: int, rng,
+        chunk_pages: int,
+    ) -> ChunkedPrefill:
+        """Open a chunked prefill: match the prompt against the prefix
+        trie (a checkpoint from a preempted admission resumes here for
+        free), reference whatever is shared, and return the cursor
+        object ``chunk_step`` advances. Acquires NO new pages — every
+        page grab happens page-aligned inside ``chunk_step`` — and
+        reads NO pool leaves: the shared-prefix attach (the one
+        admission-time device read) is deferred into the first
+        ``chunk_step``, whose caller already guarantees leaf
+        exclusivity, so an engine may admit mid-chunk even while a
+        donated chunk jit is in flight. ``need`` is
+        the slot count the FINISHED row must own pages for (prompt +
+        decode budget for an in-place admission; just the prompt for a
+        prefill engine exporting prompt-only bundles)."""
+        prompt = [int(t) for t in prompt]
+        p = len(prompt)
+        shared: List[int] = []
+        if self.prefix is not None and p > 1:
+            # Same cap as acquire_pages: >= 1 suffix token must remain
+            # so the first output token's logits get a real forward.
+            shared = self.prefix.match(prompt)[: (p - 1) // self.page]
+        # ref() pins the shared pages host-side right now (eviction
+        # can't reclaim them); their KV is gathered lazily by the
+        # first chunk_step. refcounts make the deferral safe: pinned
+        # pages are never reallocated, so their content is stable.
+        self.allocator.ref(shared)
+        seen = None
+        if _track_seen(self.sampling):
+            m = np.zeros((1, self.model.cfg.vocab_size), bool)
+            if shared:
+                m[0, np.asarray(
+                    prompt[: len(shared) * self.page], np.int64
+                )] = True
+            seen = jnp.asarray(m)
+        return ChunkedPrefill(
+            prompt=prompt,
+            rng=rng,
+            chunk_pages=max(1, int(chunk_pages)),
+            n_total=self.n_pages_for(max(need, p)),
+            row_cache=None,  # first chunk_step attaches (leaf read)
+            seen_row=seen,
+            cursor=len(shared) * self.page,
+            page_ids=list(shared),
+            shared_n=len(shared),
+        )
+
+    def chunk_step(
+        self, cp: ChunkedPrefill, unlocked=None
+    ) -> str:
+        """Advance ``cp`` by one page-aligned chunk. Returns "ran"
+        (progress, more chunks to go), "done" (first token sampled,
+        ready for ``finalize_chunked``), or "stalled" (the arena could
+        not supply this chunk's pages right now — safe to retry after
+        the next release; nothing was consumed).
+
+        Completed full pages are checkpointed into the prefix trie
+        after EVERY chunk, so an abandon at any point leaves a resume
+        point behind — and concurrent identical prompts start sharing
+        pages before this prefill even finishes.
+
+        ``unlocked``, if given, is a context-manager FACTORY that
+        releases the caller's pool mutex around the pure-compute jit
+        call: every shared-state mutation (allocator, trie, pool
+        leaves) happens outside it, so admissions and abandons can
+        interleave with a chunk's device time — but the CALLER must
+        still guarantee only one chunk_step is in flight per pool
+        (concurrent calls would fork the arena leaves)."""
+        p = len(cp.prompt)
+        start = cp.cursor
+        left = p - start
+        width = min(cp.chunk_pages, -(-left // self.page)) * self.page
+        n_real = min(left, width)
+        is_final = left <= width
+        # The final chunk acquires the full remaining page need —
+        # including the decode-budget tail — BEFORE compute, so a
+        # finished prefill can always finalize.
+        target = cp.n_total if is_final else (start + width) // self.page
+        n_new = target - len(cp.page_ids)
+        if n_new > 0:
+            ids = self.allocator.alloc(n_new)
+            if ids is None and self.prefix is not None:
+                self.prefix.evict(
+                    n_new - self.allocator.n_free, self.allocator
+                )
+                ids = self.allocator.alloc(n_new)
+            if ids is None:
+                return "stalled"
+            cp.page_ids.extend(ids)
+        tokens = np.zeros((1, width), np.int32)
+        tokens[0, :n_real] = np.asarray(
+            cp.prompt[start:start + n_real], np.int32
+        )
+        first_pg = start // self.page
+        chunk_ids = np.asarray(
+            cp.page_ids[first_pg:first_pg + width // self.page],
+            np.int32,
+        )
+        paths, names, leaves, treedef = self._pool_flat()
+        quant = self.model.cfg.kv_quant == "int8"
+        with (unlocked() if unlocked is not None
+              else contextlib.nullcontext()):
+            if cp.row_cache is None:
+                # Deferred shared-prefix attach: the one pool-leaf
+                # read of a chunked admission, pulled out of
+                # start_chunked and into this busy window so
+                # admissions never race a donated in-flight chunk.
+                # Safe here — the single-flight contract means no
+                # other chunk can donate these leaves mid-read.
+                cp.row_cache = self._attach_row(
+                    cp.page_ids[: cp.shared_n]
+                )
+            out_leaves, cp.row_cache, first, done0, cp.seen_row = (
+                _prefill_chunk_jit(
+                    tuple(leaves), cp.row_cache, self.params,
+                    jnp.asarray(tokens), jnp.asarray(chunk_ids),
+                    np.int32(start), np.int32(n_real),
+                    np.bool_(is_final), cp.rng, cp.seen_row,
+                    row_model=self.row_model, sampling=self.sampling,
+                    eos_id=self.eos_id, paths=paths, names=names,
+                    scale_src=self._scale_src(paths, names),
+                    page=self.page, quant=quant,
+                )
+            )
+            if unlocked is not None:
+                # Dispatch is async — pin the device wall inside the
+                # lock-released window, not under some later holder.
+                jax.block_until_ready(
+                    (out_leaves, cp.row_cache, first, done0)
+                )
+        self.cache = jax.tree_util.tree_unflatten(
+            treedef, list(out_leaves)
+        )
+        cp.cursor = start + n_real
+        cp.n_chunks += 1
+        if self.prefix is not None:
+            # Per-chunk trie checkpoint: the committed prefix's full
+            # pages become shareable (and survive an abandon).
+            n_full = cp.cursor // self.page
+            adopted = self.prefix.insert(
+                cp.prompt[:cp.cursor], cp.page_ids[:n_full]
+            )
+            self.allocator.hold(adopted)
+        if is_final:
+            cp.first = first
+            cp.first_int = int(np.asarray(first)[0])
+            cp.done0 = bool(np.asarray(done0)[0])
+            return "done"
+        return "ran"
+
+    def finalize_chunked(
+        self, slot: int, cp: ChunkedPrefill, budget: int
+    ) -> None:
+        """Occupy ``slot`` with a completed chunked prefill. The arena
+        already holds every prompt page (chunk_step scattered them), so
+        ``insert_paged`` is reused with ``shared_n = per_row``: its
+        window scatter redirects entirely into reserved page 0 and the
+        call just installs the table row + cursors — zero new program
+        keys. The row cache's cache_index (fixed to the prompt length
+        inside the chunk jit) supplies the slot cursor."""
+        self.insert_paged(
+            slot, cp.row_cache, cp.first_int, len(cp.prompt), budget,
+            cp.page_ids, self.per_row, row_seen=cp.seen_row,
+        )
+
+    def abandon_chunked(self, cp: ChunkedPrefill) -> int:
+        """Preempt/fail path: drop the row's page references. Trie-
+        checkpointed full pages stay resident (held) — that IS the
+        resume point a re-admission's ``start_chunked`` picks up —
+        while unheld pages free immediately. Returns pages freed."""
+        freed = self.allocator.release(cp.page_ids)
+        cp.page_ids = []
+        return freed
 
     def release_slot(self, slot: int) -> int:
         """Free ``slot``: freeze its masks, zero its page-table row,
@@ -808,9 +1152,9 @@ class PagedSlotPool(SlotPool):
                 f"bundle kv_quant {state.get('kv_quant')!r} != pool "
                 f"kv_quant {self.model.cfg.kv_quant!r}"
             )
-        if len(page_ids) != int(state["n_pages"]):
+        if len(page_ids) < int(state["n_pages"]):
             raise ValueError(
-                f"bundle carries {state['n_pages']} pages but "
+                f"bundle carries {state['n_pages']} pages but only "
                 f"{len(page_ids)} were allocated"
             )
         paths, names, leaves, treedef = self._pool_flat()
@@ -830,13 +1174,21 @@ class PagedSlotPool(SlotPool):
                 "bundle and pool disagree on repetition-penalty "
                 "tracking (seen mask present on one side only)"
             )
+        # The table row maps EVERY allocated page (a prompt-only bundle
+        # from a chunked prefill ships fewer pages than the row's full
+        # prompt+budget need — the extra tail pages hold junk until
+        # decode's append writes them, and slots past the cursor are
+        # causally masked until then); the payload scatter only touches
+        # the pages the bundle actually carries.
         table_row = np.zeros((self.per_row,), np.int32)
         table_row[: len(page_ids)] = page_ids
         leaves_out, self.token, self.pos, self.done, self.remaining, \
             self.seen = _splice_pages_jit(
                 tuple(leaves),
                 tuple(jnp.asarray(a) for a in state["arrays"]),
-                jnp.asarray(np.asarray(page_ids, np.int32)),
+                jnp.asarray(np.asarray(
+                    page_ids[: int(state["n_pages"])], np.int32
+                )),
                 jnp.asarray(table_row),
                 slot,
                 np.int32(state["cache_index"]),

@@ -47,6 +47,9 @@ SCHEMA: Dict[str, FrozenSet[str]] = {
     "serve_prefix": frozenset({"hit", "shared_pages", "prompt_tokens"}),
     "serve_migration": frozenset({"pages", "bytes", "wall_s"}),
     "serve_spec": frozenset({"k", "mode"}),
+    "serve_prefill_chunk": frozenset(
+        {"prompt_tokens", "cursor", "final"}
+    ),
     "router_request": frozenset({"tenant", "replica", "latency_s"}),
     "router_reject": frozenset({"tenant", "reason"}),
     "slo_violation": frozenset(

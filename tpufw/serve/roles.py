@@ -31,6 +31,7 @@ for the router role.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
@@ -71,6 +72,21 @@ def _paged_models(model, page: int, kv_quant: str, arena_pages: int):
     return model_for_config(pool_cfg), model_for_config(row_cfg)
 
 
+class _ChunkTicket:
+    """One in-flight chunked prefill's place in the turn queue.
+    Identity-compared on purpose (no ``__eq__``): two prompts with
+    equal remaining work are still distinct tickets."""
+
+    __slots__ = ("remaining", "seq", "blocked")
+
+    def __init__(self, remaining: int, seq: int):
+        self.remaining = remaining
+        self.seq = seq
+        #: set while this prefill is arena-stalled, so peers that CAN
+        #: make progress aren't held behind it.
+        self.blocked = False
+
+
 class PrefillEngine:
     """One prefill replica: admission + prefix cache + page export.
 
@@ -91,6 +107,7 @@ class PrefillEngine:
         eos_id: Optional[int] = None,
         seed_base: int = 0,
         prefix_cache: bool = True,
+        prefill_chunk_pages: int = 0,
         events=None,
         tracer=None,
     ):
@@ -113,18 +130,51 @@ class PrefillEngine:
         self._events = events if events is not None else obs_events.NULL
         self._tracer = tracer if tracer is not None else obs_trace.NULL
         self._lock = threading.Lock()
+        # Chunked mode: the engine lock is RELEASED between chunks, so
+        # concurrent admissions interleave at chunk granularity instead
+        # of serializing whole prompts (the lock wait that used to be
+        # the "queue" stage collapses to one chunk's latency). The
+        # condition variable wakes stalled chunk loops when a finalize
+        # or an abandon returns pages.
+        self.prefill_chunk_pages = max(0, int(prefill_chunk_pages))
+        self._cv = threading.Condition(self._lock)
+        #: pages promised to in-flight chunked admissions; admission
+        #: blocks (rather than deadlocks) while the sum would pass the
+        #: arena, so every admitted prefill can always finish.
+        self._reserved = 0
+        #: Chunk-turn tickets, scheduled SRPT (shortest remaining
+        #: prompt first, admission order on ties): equal-length
+        #: prompts drain in strict FIFO — identical completion order
+        #: to monolithic prefill — while a short prompt preempts a
+        #: long one at the next chunk boundary instead of eating its
+        #: whole remaining prefill as queue time. A bare lock gives
+        #: neither property: the thread that just ran a chunk
+        #: re-acquires before any waiter wakes.
+        self._rr: List[_ChunkTicket] = []
+        #: True while a chunk_step is in flight with the mutex
+        #: RELEASED around its device call — exactly one chunk may
+        #: compute at a time or the arena leaves would fork.
+        self._chunk_busy = False
+        self.prefill_inflight = 0
+        self.prefill_chunks = 0
+        self.prefill_resumes = 0
         self.migrations = 0
         self.migration_bytes = 0
 
     def signals(self) -> Dict[str, Any]:
         # wire: produces role-signals
         a = self.pool.allocator
-        return {
+        sig = {
             "role": "prefill",
             "pages_total": a.capacity,
             "pages_in_use": a.in_use,
             "migrations": self.migrations,
         }
+        if self.prefill_chunk_pages:
+            sig["prefill_chunk_pages"] = self.prefill_chunk_pages
+            sig["prefill_inflight"] = self.prefill_inflight
+            sig["prefill_chunks"] = self.prefill_chunks
+        return sig
 
     def prefill(
         self, prompt: Sequence[int], max_new: int, trace=None
@@ -144,6 +194,8 @@ class PrefillEngine:
 
         import jax
 
+        if self.prefill_chunk_pages:
+            return self._prefill_chunked(prompt, max_new, trace)
         ctx = reqtrace.parse(trace)
         ctx = ctx.child() if ctx is not None else None
         prompt = list(prompt)
@@ -249,6 +301,247 @@ class PrefillEngine:
             self._events.emit("serve_migration", **fields)
             return data
 
+    def _turn(self) -> Optional[_ChunkTicket]:
+        """The ticket whose chunk runs next: fewest pages left, then
+        admission order. Arena-stalled tickets are skipped so a prompt
+        whose next chunk fits isn't held behind one whose doesn't."""
+        live = [t for t in self._rr if not t.blocked]
+        if not live:
+            return None
+        return min(live, key=lambda t: (t.remaining, t.seq))
+
+    @contextlib.contextmanager
+    def _unlocked(self):
+        """Release the engine mutex around a chunk's device call so
+        admissions/abandons (host-only bookkeeping) never wait behind
+        compute; ``_chunk_busy`` keeps the compute itself exclusive."""
+        self._cv.release()
+        try:
+            yield
+        finally:
+            self._cv.acquire()
+
+    def _prefill_chunked(
+        self, prompt: Sequence[int], max_new: int, trace=None
+    ) -> bytes:
+        """Chunked admission: advance the prompt one page-aligned
+        chunk per SRPT turn, with the engine mutex released both
+        between chunks AND during each chunk's device call — so
+        admission is immediate (host-only bookkeeping), concurrent
+        prompts interleave at chunk granularity, and a short prompt
+        preempts a long one at the next chunk boundary instead of
+        head-of-line blocking behind it. The exported bundle carries prompt-only
+        pages (``n_pages`` covers the prompt, not the decode budget —
+        the decode replica allocates the tail from ``cache_index +
+        remaining``), so the admission bound here is the prompt's page
+        need alone: long prompts that used to 400 on prompt+budget now
+        queue and drain chunk by chunk.
+
+        Stage accounting stays additive: ``queue`` is the FIRST lock
+        wait only, every later wait (lock re-acquires, arena stalls)
+        lands in ``queue_chunks``, and ``wall_s`` is the literal sum —
+        so the router's TTFT decomposition gains a
+        ``prefill_queue_chunks`` term without losing additivity."""
+        # wire: produces trace-meta via tmeta, stages
+        import jax
+
+        ctx = reqtrace.parse(trace)
+        ctx = ctx.child() if ctx is not None else None
+        prompt = list(prompt)
+        n_prompt_pages = self.pool.n_pages_for(len(prompt))
+        if n_prompt_pages > self.pool.allocator.capacity:
+            raise ValueError(
+                f"prompt needs {n_prompt_pages} pages; arena capacity "
+                f"is {self.pool.allocator.capacity} (chunked bundles "
+                "are prompt-only, so the decode budget no longer "
+                "counts against this arena)"
+            )
+        t_req = time.perf_counter()
+        deadline = time.monotonic() + 600.0
+        with self._cv:
+            t_lock = time.perf_counter()
+            queue_s = t_lock - t_req
+            # Admission-ordering guard: never promise more pages than
+            # the arena holds, so every admitted prefill can finish
+            # once its peers export. Blocks instead of deadlocking.
+            # Deliberately does NOT wait out an in-flight chunk's
+            # device call: start_chunked is host-only bookkeeping
+            # (even the shared-prefix attach is deferred into the
+            # first chunk_step's busy window), so admission slips in
+            # mid-chunk — the door wait is lock + capacity, never
+            # someone else's compute.
+            while (
+                self._reserved + n_prompt_pages
+                > self.pool.allocator.capacity
+            ):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "prefill arena oversubscribed — in-flight "
+                        "chunked admissions never drained"
+                    )
+                self._cv.wait(0.25)
+            self._reserved += n_prompt_pages
+            self.prefill_inflight += 1
+            job_index = self._job_index
+            self._job_index += 1
+            rng = jax.random.fold_in(
+                jax.random.key(self._seed_base), job_index
+            )
+            t0 = time.monotonic()
+            cp = self.pool.start_chunked(
+                prompt, len(prompt), rng, self.prefill_chunk_pages
+            )
+            if cp.resumed:
+                self.prefill_resumes += 1
+            admit_s = time.perf_counter() - t_lock
+        chunk_w = max(1, self.prefill_chunk_pages) * self.pool.page
+        token = _ChunkTicket(
+            remaining=-(-(len(prompt) - cp.cursor) // chunk_w),
+            seq=job_index,
+        )
+        try:
+            queue_chunks_s = 0.0
+            compute_s = 0.0
+            t_mark = time.perf_counter()
+            with self._cv:
+                self._rr.append(token)
+                self._cv.notify_all()
+            while True:
+                with self._cv:
+                    token.blocked = False
+                    while self._chunk_busy or self._turn() is not token:
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(
+                                "prefill chunk turn starved — peers "
+                                "never yielded the engine"
+                            )
+                        self._cv.wait(0.25)
+                        token.blocked = False
+                    t_got = time.perf_counter()
+                    queue_chunks_s += t_got - t_mark
+                    # The device call runs with the mutex RELEASED
+                    # (see _unlocked); _chunk_busy keeps it exclusive
+                    # while admissions slip in between.
+                    self._chunk_busy = True
+                    try:
+                        status = self.pool.chunk_step(
+                            cp, unlocked=self._unlocked
+                        )
+                    finally:
+                        self._chunk_busy = False
+                    token.remaining = -(
+                        -(len(prompt) - cp.cursor) // chunk_w
+                    )
+                    if status == "stalled":
+                        # Trie-held pages from peers' checkpoints own
+                        # the arena right now; stand aside and wait
+                        # for an export or an abandon to free some.
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(
+                                "prefill arena exhausted mid-chunk — "
+                                "no peer freed pages in time"
+                            )
+                        token.blocked = True
+                        self._cv.notify_all()
+                        self._cv.wait(0.25)
+                        t_mark = time.perf_counter()
+                        continue
+                    t_chunk = time.perf_counter()
+                    compute_s += t_chunk - t_got
+                    self.prefill_chunks += 1
+                    self._events.emit(
+                        "serve_prefill_chunk",
+                        prompt_tokens=len(prompt), cursor=cp.cursor,
+                        final=status == "done",
+                        chunk_s=round(t_chunk - t_got, 6),
+                    )
+                    if status == "done":
+                        slot = 0  # transient: finalize->export->release
+                        self.pool.finalize_chunked(slot, cp, max_new - 1)
+                        t_compute = time.perf_counter()
+                        compute_s += t_compute - t_chunk
+                        state = self.pool.export_slot(
+                            slot, page_ids=cp.page_ids
+                        )
+                        self.pool.release_slot(slot)
+                        # The slot owned (and just released) the pages;
+                        # empty the cursor so a late failure's abandon
+                        # can't double-release them.
+                        cp.page_ids = []
+                        export_s = time.perf_counter() - t_compute
+                        # Done with chunk turns — free the head slot
+                        # now so peers don't idle through the bundle
+                        # encode below.
+                        self._rr.remove(token)
+                        self._cv.notify_all()
+                        break
+                    self._cv.notify_all()
+                t_mark = time.perf_counter()
+            stages = {
+                "queue": round(queue_s, 6),
+                "admit": round(admit_s, 6),
+                "queue_chunks": round(queue_chunks_s, 6),
+                "compute": round(compute_s, 6),
+                "export": round(export_s, 6),
+            }
+            tmeta: Dict[str, Any] = {
+                "stages": stages,
+                "wall_s": round(
+                    queue_s + admit_s + queue_chunks_s + compute_s
+                    + export_s, 6
+                ),
+            }
+            if ctx is not None:
+                tmeta.update(ctx.meta())
+            state["trace"] = tmeta
+            state["prompt"] = [int(t) for t in prompt]
+            data = encode_bundle(state)
+            self.migrations += 1
+            self.migration_bytes += len(data)
+            reqtrace.stage(
+                self._tracer, ctx, "req_queue_wait", queue_s,
+                role="prefill",
+            )
+            reqtrace.stage(
+                self._tracer, ctx, "req_admit", admit_s,
+                role="prefill", shared_pages=cp.shared_n,
+            )
+            reqtrace.stage(
+                self._tracer, ctx, "req_queue_chunks", queue_chunks_s,
+                role="prefill", chunks=cp.n_chunks,
+            )
+            reqtrace.stage(
+                self._tracer, ctx, "req_prefill_compute", compute_s,
+                prompt_tokens=len(prompt),
+            )
+            reqtrace.stage(
+                self._tracer, ctx, "req_page_export", export_s,
+                pages=state["n_pages"],
+            )
+            fields = dict(
+                pages=state["n_pages"], bytes=len(data),
+                wall_s=round(time.monotonic() - t0, 6),
+                direction="export", shared_pages=cp.shared_n,
+            )
+            if ctx is not None:
+                fields["trace"] = ctx.trace_id
+            self._events.emit("serve_migration", **fields)
+            return data
+        except BaseException:
+            with self._cv:
+                # Abandon keeps trie-checkpointed full pages held:
+                # a re-submitted identical prompt resumes from the
+                # last completed page instead of restarting.
+                self.pool.abandon_chunked(cp)
+            raise
+        finally:
+            with self._cv:
+                if token in self._rr:  # failure paths still hold one
+                    self._rr.remove(token)
+                self._reserved -= n_prompt_pages
+                self.prefill_inflight -= 1
+                self._cv.notify_all()
+
 
 class DecodeEngine:
     """One decode replica: bundle import + continuous chunked decode.
@@ -273,6 +566,8 @@ class DecodeEngine:
         chunk: int = 4,
         spec_k: int = 0,
         spec_min_accept: float = 0.25,
+        prefill_chunk_pages: int = 0,
+        piggyback: float = 0.0,
         events=None,
         tracer=None,
     ):
@@ -294,6 +589,14 @@ class DecodeEngine:
         self._eos = eos_id
         self._seed_base = seed_base
         self._chunk_index = 0
+        self._job_index = 0
+        # Prefill/decode fungibility: with a chunk size and a spare-
+        # capacity waterline set, this replica accepts RAW prompts
+        # (no prefill hop, no bundle) and prefills them chunk-by-chunk
+        # inside the same passes that advance its decode slots — the
+        # router's piggyback path under prefill-side load skew.
+        self.prefill_chunk_pages = max(0, int(prefill_chunk_pages))
+        self.piggyback = max(0.0, float(piggyback))
         self._events = events if events is not None else obs_events.NULL
         self._tracer = tracer if tracer is not None else obs_trace.NULL
         # Speculative self-drafting (n-gram proposals against the
@@ -341,6 +644,10 @@ class DecodeEngine:
         a = self.pool.allocator
         with self._cv:
             active = len(self._jobs)
+            inflight = sum(
+                1 for j in self._jobs.values()
+                if j.get("cp") is not None
+            )
         sig = {
             "role": "decode",
             "pages_total": a.capacity,
@@ -352,13 +659,51 @@ class DecodeEngine:
         if self.spec_k:
             sig["spec_k"] = self.spec_k
             sig["spec_passes"] = self.spec_passes
+        if self.prefill_chunk_pages and self.piggyback:
+            sig["prefill_chunk_pages"] = self.prefill_chunk_pages
+            sig["piggyback_waterline"] = self.piggyback
+            sig["prefill_inflight"] = inflight
         return sig
 
     def can_accept(self, n_pages: int) -> bool:
         with self._cv:
             if len(self._jobs) >= self.n_slots:
                 return False
-        return n_pages <= self.pool.allocator.n_free
+            deficit = self._cp_deficit_locked()
+        return n_pages + deficit <= self.pool.allocator.n_free
+
+    def _cp_deficit_locked(self) -> int:
+        """Pages still owed to in-flight piggyback prefills (caller
+        holds ``_cv``). Admissions that would eat into this sum are
+        refused — the chunked rows must always be able to finish."""
+        return sum(
+            j["cp"].deficit for j in self._jobs.values()
+            if j.get("cp") is not None
+        )
+
+    def can_piggyback(self, n_pages: int) -> bool:
+        """Would ``submit_raw`` accept a raw prompt needing
+        ``n_pages`` right now? Mirrors its admission test: pages must
+        FIT (hard feasibility — this row plus every in-flight chunked
+        deficit inside the arena), and the pool's idle-slot fraction
+        must clear the ``piggyback`` waterline. Slots, not pages, are
+        the waterline currency: a decode pass computes every slot row
+        whether occupied or not, so "spare chunk capacity" IS idle
+        slots — a mostly-empty arena on a fully-busy pool has no spare
+        compute to scavenge."""
+        if not (self.prefill_chunk_pages and self.piggyback):
+            return False
+        a = self.pool.allocator
+        with self._cv:
+            n_jobs = len(self._jobs)
+            if n_jobs >= self.n_slots:
+                return False
+            deficit = self._cp_deficit_locked()
+        return (
+            a.n_free - deficit - n_pages >= 0
+            and self.n_slots - n_jobs
+            >= self.piggyback * self.n_slots
+        )
 
     # ---- bundle import --------------------------------------------
 
@@ -379,11 +724,29 @@ class DecodeEngine:
             if not free:
                 raise RuntimeError("decode replica: no free slot")
             slot = free[0]
-            ids = self.pool.allocator.alloc(int(state["n_pages"]))
+            # Chunked prefill engines export prompt-only bundles
+            # (n_pages covers the prompt, not the decode budget): the
+            # decode side owns the residency decision, so size the
+            # grant for the row's full life. Monolithic bundles
+            # already carry their budget pages — the max is a no-op.
+            n_alloc = max(
+                int(state["n_pages"]),
+                self.pool.n_pages_for(
+                    int(state["cache_index"]) + int(state["remaining"])
+                ),
+            )
+            deficit = self._cp_deficit_locked()
+            if deficit and self.pool.allocator.n_free - n_alloc < deficit:
+                raise RuntimeError(
+                    "decode replica: bundle would starve an in-flight "
+                    f"piggyback prefill ({n_alloc} pages wanted, "
+                    f"{deficit} owed, {self.pool.allocator.n_free} free)"
+                )
+            ids = self.pool.allocator.alloc(n_alloc)
             if ids is None:
                 raise RuntimeError(
                     "decode replica: arena cannot fit the bundle "
-                    f"({state['n_pages']} pages, "
+                    f"({n_alloc} pages, "
                     f"{self.pool.allocator.n_free} free)"
                 )
             try:
@@ -440,7 +803,145 @@ class DecodeEngine:
         self._events.emit("serve_migration", **fields)
         return slot
 
+    def submit_raw(
+        self, prompt: Sequence[int], max_new: int, trace=None
+    ) -> int:
+        """Piggyback admission: accept a RAW prompt — no prefill hop,
+        no bundle migration — and prefill it chunk-by-chunk inside the
+        same passes that advance the resident decode slots. Admission
+        requires the pool's idle-slot fraction to clear the
+        ``piggyback`` waterline AND the arena to fit this row's full
+        page need on top of every in-flight piggyback deficit, so
+        resident decodes keep headroom and chunked rows can always
+        finish. Raises RuntimeError when the waterline (or a free
+        slot, or the pages) is missing; the router falls back to the
+        dedicated-prefill path."""
+        # wire: consumes control-frame via prompt
+        import jax
+
+        if not (self.prefill_chunk_pages and self.piggyback):
+            raise RuntimeError(
+                "piggyback admission disabled — needs both "
+                "TPUFW_SERVE_PREFILL_CHUNK and TPUFW_SERVE_PIGGYBACK"
+            )
+        ctx = reqtrace.parse(trace)
+        ctx = ctx.child() if ctx is not None else None
+        prompt = [int(t) for t in prompt]
+        need = len(prompt) + max_new - 1
+        n_total = self.pool.n_pages_for(need)
+        a = self.pool.allocator
+        if n_total > a.capacity:
+            raise ValueError(
+                f"prompt+budget needs {n_total} pages; arena "
+                f"capacity is {a.capacity}"
+            )
+        with self._cv:
+            free = [
+                s for s in range(self.n_slots) if s not in self._jobs
+            ]
+            if not free:
+                raise RuntimeError("decode replica: no free slot")
+            deficit = self._cp_deficit_locked()
+            if a.n_free - deficit - n_total < 0:
+                raise RuntimeError(
+                    "decode replica: arena cannot seat the row — "
+                    f"{a.n_free} free minus {deficit} owed leaves "
+                    f"less than the {n_total} pages wanted"
+                )
+            if (
+                self.n_slots - len(self._jobs)
+                < self.piggyback * self.n_slots
+            ):
+                raise RuntimeError(
+                    "decode replica: piggyback waterline — "
+                    f"{self.n_slots - len(self._jobs)} idle of "
+                    f"{self.n_slots} slots clears less than "
+                    f"{self.piggyback:.0%}"
+                )
+            slot = free[0]
+            job_index = self._job_index
+            self._job_index += 1
+            # Same stream a dedicated prefill replica would draw, so a
+            # piggybacked request samples identically to a migrated one.
+            rng = jax.random.fold_in(
+                jax.random.key(self._seed_base), job_index
+            )
+            cp = self.pool.start_chunked(
+                prompt, need, rng, self.prefill_chunk_pages
+            )
+            self._jobs[slot] = {
+                "tokens": [],
+                "budget": max_new - 1,
+                "done": False,
+                "history": list(prompt),
+                "ctx": ctx,
+                "splice_s": 0.0,
+                "t_ready": time.perf_counter(),
+                "first_flush_s": None,
+                "n_chunks": 0,
+                "cp": cp,
+                "prefill_s": 0.0,
+                "prefill_queue_s": 0.0,
+                "prefill_chunks": 0,
+            }
+            self._cv.notify_all()
+        reqtrace.stage(
+            self._tracer, ctx, "req_piggyback_admit", 0.0,
+            slot=slot, pages=n_total,
+        )
+        return slot
+
     # ---- decode loop ----------------------------------------------
+
+    def _run_prefill_chunks_locked(self) -> bool:
+        """Advance every piggybacked prefill by one page-aligned chunk
+        (caller holds ``_cv``). A finished prefill finalizes into its
+        slot and joins the next decode pass — mixed prefill+decode
+        pools, no separate tick. Returns whether any chunk ran."""
+        progressed = False
+        for slot, job in list(self._jobs.items()):
+            cp = job.get("cp")
+            if cp is None or job["done"]:
+                continue
+            t0 = time.perf_counter()
+            status = self.pool.chunk_step(cp)
+            if status == "stalled":
+                continue  # retry after a peer frees pages
+            dt = time.perf_counter() - t0
+            progressed = True
+            job["prefill_s"] += dt
+            job["prefill_chunks"] += 1
+            self._events.emit(
+                "serve_prefill_chunk",
+                prompt_tokens=len(cp.prompt), cursor=cp.cursor,
+                final=status == "done", chunk_s=round(dt, 6),
+                slot=slot,
+            )
+            if status != "done":
+                continue
+            job["cp"] = None
+            job["tokens"] = [cp.first_int]
+            t1 = time.perf_counter()
+            job["prefill_queue_s"] = max(
+                0.0, (t1 - job["t_ready"]) - job["prefill_s"]
+            )
+            job["first_flush_s"] = t1 - job["t_ready"]
+            reqtrace.stage(
+                self._tracer, job["ctx"], "req_first_token",
+                job["first_flush_s"], slot=slot,
+            )
+            if cp.done0 or job["budget"] <= 0:
+                # EOS as the first sampled token (or a zero budget):
+                # complete before ever owning a pool slot, so the
+                # pages go straight back — no trie here, abandon
+                # frees everything.
+                job["done"] = True
+                self.pool.abandon_chunked(cp)
+            else:
+                self.pool.finalize_chunked(slot, cp, job["budget"])
+                if self._ema is not None:
+                    self._ema.occupy(slot)
+        return progressed
 
     def _run_chunk_locked(self) -> None:
         """One shared decode chunk (caller holds ``_cv``). Every
@@ -456,10 +957,19 @@ class DecodeEngine:
         import jax
         import numpy as np
 
+        progressed = self._run_prefill_chunks_locked()
         live = {
-            s: j for s, j in self._jobs.items() if not j["done"]
+            s: j for s, j in self._jobs.items()
+            if not j["done"] and j.get("cp") is None
         }
         if not live:
+            if not progressed and any(
+                j.get("cp") is not None for j in self._jobs.values()
+            ):
+                # Every piggyback prefill is stalled on pages and no
+                # decode slot is live to free any: sleep on the
+                # condition instead of spinning until a release lands.
+                self._cv.wait(0.001)
             return
         use_spec = self._ema is not None and self._ema.use_spec(
             sorted(live)
@@ -562,7 +1072,7 @@ class DecodeEngine:
                     raise KeyError(f"no active job in slot {slot}")
                 if job["done"]:
                     del self._jobs[slot]
-                    return {
+                    out = {
                         "tokens": job["tokens"],
                         "splice_s": round(job["splice_s"], 6),
                         "first_flush_s": round(
@@ -570,6 +1080,17 @@ class DecodeEngine:
                         ),
                         "n_chunks": job["n_chunks"],
                     }
+                    if "prefill_chunks" in job:
+                        # Piggybacked request: the replica did its
+                        # prefill too — stage timings for the
+                        # router's TTFT decomposition.
+                        out["piggyback"] = True
+                        out["prefill_s"] = round(job["prefill_s"], 6)
+                        out["prefill_queue_s"] = round(
+                            job["prefill_queue_s"], 6
+                        )
+                        out["prefill_chunks"] = job["prefill_chunks"]
+                    return out
                 if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"slot {slot} did not finish in {timeout}s"
@@ -613,6 +1134,7 @@ def _build_engine(role: str):
     common = dict(
         sampling=sampling, page=page, kv_quant=kv_quant,
         n_slots=n_slots, seed_base=env_int("seed", 0),
+        prefill_chunk_pages=max(0, env_int("serve_prefill_chunk", 0)),
         events=events, tracer=tracer,
     )
     if role == "prefill":
@@ -624,6 +1146,7 @@ def _build_engine(role: str):
                       or env_int("stream_chunk", 16)),
             spec_k=env_int("serve_spec_k", 0),
             spec_min_accept=env_float("serve_spec_min_accept", 0.25),
+            piggyback=max(0.0, env_float("serve_piggyback", 0.0)),
             **common,
         ),
         restored,
@@ -672,6 +1195,21 @@ def serve_decode(engine: DecodeEngine, port: int):
             req = json.loads(frame.decode("utf-8"))
             if req.get("signals"):
                 return json.dumps(engine.signals()).encode()
+            if req.get("prompt") is not None:
+                # Raw-prompt piggyback admission: the router steers
+                # here when spare chunk capacity clears the waterline.
+                try:
+                    slot = engine.submit_raw(
+                        [int(t) for t in req["prompt"]],
+                        int(req.get("max_new", 1)),
+                        trace=req.get("trace"),
+                    )
+                except (ValueError, RuntimeError) as e:
+                    return json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}
+                    ).encode()
+                out = engine.collect_ex(slot)
+                return json.dumps({**out, **engine.signals()}).encode()
             return json.dumps({"error": "expected a page bundle"}).encode()
         try:
             slot = engine.submit(frame)

@@ -55,6 +55,8 @@ _SIGNAL_KEYS = (
     "pages_total", "pages_in_use", "slots_total", "slots_active",
     "migrations", "goodput_ratio", "mfu", "hbm_headroom_bytes",
     "spec_k", "spec_passes",
+    "prefill_chunk_pages", "prefill_inflight", "prefill_chunks",
+    "piggyback_waterline",
 )
 
 
@@ -79,6 +81,15 @@ class ReplicaState:
     # is speculating (and that its verify passes are advancing).
     spec_k: int = 0
     spec_passes: int = 0
+    # Chunked-prefill replicas advertise their chunk size and in-
+    # flight chunked admissions; piggyback-capable decode replicas
+    # additionally advertise their spare-capacity waterline. The
+    # policy steers between the dedicated-prefill and piggyback paths
+    # on these (score() and piggyback_fits()).
+    prefill_chunk_pages: int = 0
+    prefill_inflight: int = 0
+    prefill_chunks: int = 0
+    piggyback_waterline: float = 0.0
     healthy: bool = True
     last_seen: float = 0.0
 
@@ -95,6 +106,10 @@ class ReplicaState:
         burning slots on wasted work (low goodput) or out of HBM
         headroom ranks behind an equally-occupied healthy one."""
         s = self.load + 0.1 * (self.slots_active / max(1, self.slots_total))
+        # Prefill-chunk occupancy: each in-flight chunked prefill is a
+        # whole prompt's worth of pending compute that page occupancy
+        # does not yet show (chunked admission grabs pages lazily).
+        s += 0.02 * self.prefill_inflight
         if self.goodput_ratio is not None:
             s += 0.05 * (1.0 - min(1.0, max(0.0, self.goodput_ratio)))
         if self.hbm_headroom_bytes is not None and self.hbm_headroom_bytes <= 0:
@@ -234,6 +249,60 @@ class RouterPolicy:
             self._affinity[session] = name
         return name, ""
 
+    def piggyback_fits(self, r: ReplicaState, n_pages: int) -> bool:
+        """Can this decode replica take a RAW prompt of ``n_pages``
+        (prompt + budget) chunk-by-chunk right now — chunked prefill
+        enabled, a free slot, and spare pages still clearing its
+        advertised waterline AFTER this row's full need. Mirrors the
+        replica's own ``submit_raw`` admission test (minus the
+        in-flight piggyback deficits only the replica can see — it
+        re-checks and refuses, and the router falls back)."""
+        if not r.healthy or r.role != "decode":
+            return False
+        if not (r.prefill_chunk_pages and r.piggyback_waterline > 0):
+            return False
+        if r.slots_active >= max(1, r.slots_total):
+            return False
+        return (
+            r.free_pages - n_pages
+            >= r.piggyback_waterline * max(1, r.pages_total)
+        )
+
+    def pick_piggyback(
+        self,
+        replicas: Sequence[ReplicaState],
+        n_pages: int,
+        max_chunks: Optional[int] = None,
+    ) -> Optional[str]:
+        """Least-loaded decode replica with piggyback headroom, or
+        None when no replica clears its waterline.
+
+        ``max_chunks`` bounds how much prefill work piggybacking may
+        divert: with a healthy dedicated prefill pool the router only
+        piggybacks prompts a decode replica can absorb in that many
+        spare-capacity chunk passes (long prompts would turn the
+        decode replica into a worse prefill replica and starve its
+        decode slots). With NO dedicated path (``None``) any size
+        that clears the waterline goes — fungibility is then the only
+        way to serve at all."""
+        fits = [
+            r for r in replicas
+            if self.piggyback_fits(r, n_pages)
+            and (
+                max_chunks is None
+                or n_pages <= r.prefill_chunk_pages * max_chunks
+            )
+        ]
+        if not fits:
+            return None
+        return min(fits, key=lambda r: (r.score(), r.name)).name
+
+    def pin_session(self, session: str, name: str) -> None:
+        """Record decode affinity for a replica chosen outside
+        ``pick_decode`` (the piggyback path)."""
+        if session:
+            self._affinity[session] = name
+
     def forget_session(self, session: str) -> None:
         self._affinity.pop(session, None)
 
@@ -253,6 +322,7 @@ class _Metrics:
             "rejects_total",
             "proxy_errors_total",
             "request_seconds_total",
+            "piggyback_total",
         )
 
     def inc(self, name: str, v: float = 1.0) -> None:
@@ -289,6 +359,13 @@ class LocalReplica:
 
     def decode(self, bundle: bytes) -> Dict[str, Any]:
         slot = self._engine.submit(bundle)
+        out = self._engine.collect_ex(slot)
+        return {**out, **self._engine.signals()}
+
+    def decode_raw(
+        self, prompt: Sequence[int], max_new: int, trace=None
+    ) -> Dict[str, Any]:
+        slot = self._engine.submit_raw(prompt, max_new, trace=trace)
         out = self._engine.collect_ex(slot)
         return {**out, **self._engine.signals()}
 
@@ -330,6 +407,20 @@ class TcpReplica:
 
     def decode(self, bundle: bytes) -> Dict[str, Any]:
         out = json.loads(self._call(bundle).decode("utf-8"))
+        if "error" in out:
+            raise RuntimeError(f"decode {self.name}: {out['error']}")
+        return out
+
+    def decode_raw(
+        self, prompt: Sequence[int], max_new: int, trace=None
+    ) -> Dict[str, Any]:
+        # wire: produces control-frame via req
+        req = {"prompt": list(prompt), "max_new": int(max_new)}
+        if trace:
+            req["trace"] = str(trace)
+        out = json.loads(
+            self._call(json.dumps(req).encode()).decode("utf-8")
+        )
         if "error" in out:
             raise RuntimeError(f"decode {self.name}: {out['error']}")
         return out
@@ -516,6 +607,16 @@ class RouterServer:
                          "spec_passes": r.spec_passes}
                         if r.spec_k else {}
                     ),
+                    **(
+                        {"prefill_chunk_pages": r.prefill_chunk_pages,
+                         "prefill_inflight": r.prefill_inflight,
+                         "prefill_chunks": r.prefill_chunks}
+                        if r.prefill_chunk_pages else {}
+                    ),
+                    **(
+                        {"piggyback_waterline": r.piggyback_waterline}
+                        if r.piggyback_waterline else {}
+                    ),
                 }
                 for name, r in self._states.items()
             }
@@ -604,6 +705,94 @@ class RouterServer:
             )
         return name, pname, reason
 
+    def _piggyback(
+        self,
+        pig: str,
+        prompt: List[int],
+        max_new: int,
+        ctx,
+        tenant: str,
+        session: str,
+        queue_s: float,
+        admit_s: float,
+        n_pages: int,
+        trace_hdr: tuple,
+        t0: float,
+    ) -> Tuple[int, dict, tuple]:
+        """Forward a RAW prompt to decode replica ``pig`` (one RPC
+        does prefill-by-chunks + decode in place). TTFT decomposes
+        additively from the replica's self-reported chunk timings:
+        ``first_flush_s = prefill_queue_s + prefill_s`` by
+        construction, so
+
+            ttft = queue_wait + admit + prefill_queue_chunks
+                 + prefill_compute
+        """
+        # wire: consumes decode-reply via out
+        # wire: produces router-response
+        dclient = next(c for c in self._decode if c.name == pig)
+        tp0 = time.perf_counter()
+        try:
+            out = dclient.decode_raw(prompt, max_new, trace=ctx.wire())
+        except Exception as e:  # noqa: BLE001 — proxy boundary
+            self._metrics.inc("proxy_errors_total")
+            with self._lock:
+                self._states[pig].healthy = False
+            self.policy.forget_session(session)
+            return 502, {"error": f"{type(e).__name__}: {e}"}, trace_hdr
+        rpc_s = time.perf_counter() - tp0
+        reqtrace.stage(
+            self._tracer, ctx, "req_piggyback_rpc", rpc_s, replica=pig,
+        )
+        with self._lock:
+            self._states[pig].update(out, now=time.monotonic())
+            self.policy.pin_session(session, pig)
+        pq_s = float(out.get("prefill_queue_s", 0.0))
+        pf_s = float(out.get("prefill_s", 0.0))
+        stages = {
+            "queue_wait": round(queue_s, 6),
+            "admit": round(admit_s, 6),
+            "prefill_queue_chunks": round(pq_s, 6),
+            "prefill_compute": round(pf_s, 6),
+            # No migration happened: no splice, and the first token
+            # is host-visible the moment the final chunk samples it.
+            "splice": 0.0,
+            "first_decode": round(float(out.get("first_flush_s", 0.0)), 6),
+        }
+        ttft = queue_s + admit_s + pq_s + pf_s
+        latency = time.monotonic() - t0
+        tokens = out.get("tokens") or []
+        tok_s = (
+            (latency - ttft) / (len(tokens) - 1)
+            if len(tokens) > 1 else None
+        )
+        self.slo.observe(tenant, ttft, tok_s=tok_s, trace=ctx.trace_id)
+        self._metrics.inc("requests_total")
+        self._metrics.inc("piggyback_total")
+        self._metrics.inc("request_seconds_total", latency)
+        self._events.emit(
+            "router_request", tenant=tenant, replica=pig,
+            latency_s=round(latency, 6),
+            prefill_replica=pig, pages=n_pages, piggyback=True,
+            prefill_chunks=int(out.get("prefill_chunks", 0)),
+            trace=ctx.trace_id, ttft_s=round(ttft, 6),
+            n_tokens=len(tokens), stages=stages,
+        )
+        return (
+            200,
+            {
+                "tokens": tokens,
+                "replica": pig,
+                "prefill_replica": pig,
+                "piggyback": bool(out.get("piggyback", True)),
+                "migration_pages": 0,
+                "trace": ctx.trace_id,
+                "ttft_s": round(ttft, 6),
+                "stages": stages,
+            },
+            trace_hdr,
+        )
+
     def generate(
         self, req: dict, trace_header: str = ""
     ) -> Tuple[int, dict, tuple]:
@@ -678,6 +867,31 @@ class RouterServer:
                     (("Retry-After", str(self.policy.retry_after_s)),)
                     + trace_hdr,
                 )
+            # Prefill/decode fungibility: when no prefill replica is
+            # healthy, or the best one is already busy chunking other
+            # prompts (load skew), steer the raw prompt straight at a
+            # decode replica with spare chunk capacity — it prefills
+            # chunk-by-chunk inside its own decode passes, skipping
+            # the migration hop entirely.
+            pig = None
+            with self._lock:
+                pstate = self._states.get(pname) if pname else None
+                if pname is None or (
+                    pstate is not None and pstate.prefill_inflight > 0
+                ):
+                    pig = self.policy.pick_piggyback(
+                        [
+                            r for r in self._states.values()
+                            if r.role == "decode"
+                        ],
+                        n_pages,
+                        max_chunks=None if pname is None else 1,
+                    )
+            if pig is not None:
+                return self._piggyback(
+                    pig, prompt, max_new, ctx, tenant, session,
+                    queue_s, admit_s, n_pages, trace_hdr, t0,
+                )
             if pname is None:
                 self._metrics.inc("rejects_total")
                 self._events.emit(
@@ -699,6 +913,15 @@ class RouterServer:
             # replica out of rotation while the broken one keeps
             # receiving traffic.
             tp0 = time.perf_counter()
+            # Router-observed prefill occupancy: prefill replies are
+            # raw bundles (no signals piggyback like decode replies),
+            # so a healthy replica's advertised prefill_inflight is
+            # the startup-probe snapshot forever. The router counts
+            # its own outstanding RPCs instead — that is exactly the
+            # "busy chunking other prompts" signal the piggyback
+            # steering and score() need, and it is live.
+            with self._lock:
+                self._states[pname].prefill_inflight += 1
             try:
                 bundle = pclient.prefill(prompt, max_new, trace=ctx.wire())
             except Exception as e:  # noqa: BLE001 — proxy boundary
@@ -706,6 +929,13 @@ class RouterServer:
                 with self._lock:
                     self._states[pname].healthy = False
                 return 502, {"error": f"{type(e).__name__}: {e}"}, trace_hdr
+            finally:
+                with self._lock:
+                    pst = self._states.get(pname)
+                    if pst is not None:
+                        pst.prefill_inflight = max(
+                            0, pst.prefill_inflight - 1
+                        )
             prefill_rtt = time.perf_counter() - tp0
             reqtrace.stage(
                 self._tracer, ctx, "req_prefill_rpc", prefill_rtt,
@@ -725,6 +955,15 @@ class RouterServer:
                     ("export", "page_export"),
                 ):
                     stages[dst] = round(float(engine_stages.get(src, 0.0)), 6)
+                if "queue_chunks" in engine_stages:
+                    # Chunked prefill engine: time spent BETWEEN
+                    # chunks (lock re-acquires + arena stalls) is its
+                    # own TTFT term, so prefill_queue keeps meaning
+                    # the FIRST lock wait. Additivity holds — the
+                    # engine's wall_s is the literal five-stage sum.
+                    stages["prefill_queue_chunks"] = round(
+                        float(engine_stages["queue_chunks"]), 6
+                    )
                 wire_s = max(
                     0.0, prefill_rtt - float((tmeta or {}).get("wall_s", 0.0))
                 )

@@ -849,7 +849,7 @@ class _SlotJob:
     its own EOS/max_new."""
 
     __slots__ = ("req", "prompt", "p_bucket", "max_new", "cache_len",
-                 "tokens", "unflushed")
+                 "tokens", "unflushed", "cp")
 
     def __init__(self, req, prompt, p_bucket, max_new, cache_len):
         self.req = req
@@ -859,6 +859,10 @@ class _SlotJob:
         self.cache_len = cache_len
         self.tokens: list[int] = []
         self.unflushed: list[int] = []
+        # In-flight chunked prefill (pages.ChunkedPrefill) while this
+        # row occupies a slot as a PREFILLING citizen; None once the
+        # first token lands (or always, in monolithic admission mode).
+        self.cp = None
 
 
 class _SlotReq:
@@ -944,6 +948,7 @@ class _SlotScheduler:
         spec_draft: Optional[str] = None,
         spec_min_accept: Optional[float] = None,
         spec_draft_built=None,
+        prefill_chunk_pages: Optional[int] = None,
     ):
         import jax
         import numpy as np
@@ -1001,6 +1006,22 @@ class _SlotScheduler:
             else bool(prefix_cache)
         )
         self.arena_pages = arena_pages
+        # Page-aligned chunked prefill: admission acquires only the
+        # first chunk's pages and the row prefills one chunk per
+        # scheduler pass, interleaved with decoding slots — a long
+        # prompt no longer head-of-line-blocks the queue. 0 keeps the
+        # legacy monolithic admission byte-identical.
+        self.prefill_chunk_pages = (
+            env_int("serve_prefill_chunk", 0)
+            if prefill_chunk_pages is None
+            else int(prefill_chunk_pages)
+        )
+        if self.prefill_chunk_pages and not self.page:
+            raise ValueError(
+                f"TPUFW_SERVE_PREFILL_CHUNK="
+                f"{self.prefill_chunk_pages}: chunked prefill is "
+                "page-granular and needs TPUFW_SERVE_PAGE > 0"
+            )
         if self.page:
             cap = model.cfg.max_seq_len
             # Every cache-ladder rung is a pow2 >= cache_floor or the
@@ -1107,6 +1128,14 @@ class _SlotScheduler:
                     "prefix_misses_total",
                     "pages_freed_total",
                 )
+            if self.prefill_chunk_pages:
+                # Chunked-prefill series live OUTSIDE the tpufw_serve_
+                # prefix (the disagg PrefillEngine reports the same
+                # names through its signals); gated so a monolithic
+                # server's exposition stays byte-identical.
+                metrics.registry.counter("tpufw_prefill_chunks_total")
+                metrics.registry.counter("tpufw_prefill_resumes_total")
+                metrics.registry.gauge("tpufw_prefill_inflight")
             if self.spec_k:
                 # Speculation metrics live OUTSIDE the tpufw_serve_
                 # prefix (they also serve the disagg DecodeEngine);
@@ -1252,7 +1281,12 @@ class _SlotScheduler:
                 if need > self.arena_pages - 1:
                     # Reject now, not in the admission loop: a row
                     # that can NEVER fit the arena would deadlock the
-                    # FIFO forever (page 0 is reserved).
+                    # FIFO forever (page 0 is reserved). This bound is
+                    # already max-resident: an in-place row must hold
+                    # its whole prompt+budget page set at finalize
+                    # even under chunked admission, so chunking only
+                    # relaxes it on the disagg PrefillEngine (which
+                    # exports prompt-only bundles — see serve/roles).
                     raise ValueError(
                         f"row needs {need} KV pages but the arena "
                         f"holds {self.arena_pages - 1}"
@@ -1567,6 +1601,24 @@ class _SlotScheduler:
         admitted = False
         while free and req.next_job < len(req.jobs):
             job = req.jobs[req.next_job]
+            if self.page and self.prefill_chunk_pages:
+                # Chunked admission: the row takes a slot immediately
+                # as a PREFILLING citizen and acquires pages chunk by
+                # chunk inside the pool passes — no whole-prompt page
+                # grant, no monolithic prefill blocking this loop. The
+                # reservation guard keeps part-admitted rows deadlock-
+                # free (their summed outstanding need always fits).
+                if not self._can_admit_chunked(job):
+                    break
+                try:
+                    self._admit_chunked(req, job, free[0])
+                except Exception as e:  # noqa: BLE001 — isolate req
+                    self._fail_req(req, e)
+                    return admitted
+                req.next_job += 1
+                admitted = True
+                free.pop(0)
+                continue
             grant = None
             if self.page:
                 # Page-budget admission: the row needs every page of
@@ -1615,6 +1667,86 @@ class _SlotScheduler:
         if req.rows_left == 0 and req.next_job == len(req.jobs):
             self._finish(req)
         return admitted
+
+    def _cp_deficit(self) -> int:
+        """Pages still owed to in-flight chunked prefills — the gap
+        between what they will hold at finalize and what they hold
+        now. Admission and draft grants reserve around this sum so
+        two part-admitted rows can never deadlock on the arena."""
+        return sum(
+            j.cp.deficit
+            for j in self._slots
+            if j is not None and j.cp is not None
+        )
+
+    def _can_admit_chunked(self, job: _SlotJob) -> bool:
+        """Deadlock-free reservation: admit a new chunked prefill only
+        when free + trie-evictable pages cover every in-flight
+        prefill's remaining need PLUS this row's whole need. Chunk
+        grabs are all-or-nothing per chunk, so under this invariant
+        every admitted prefill eventually reaches its full grant."""
+        a = self._pool.allocator
+        evictable = sum(1 for i in a.held if not a.refs.get(i, 0))
+        n_total = self._pool.n_pages_for(
+            len(job.prompt) + job.max_new - 1
+            + self._spec_slack(self._pool.sampling)
+        )
+        return self._cp_deficit() + n_total <= a.n_free + evictable
+
+    def _admit_chunked(
+        self, req: _SlotReq, job: _SlotJob, slot: int
+    ) -> None:
+        """Open a chunked prefill and seat it in ``slot`` WITHOUT any
+        device call: the slot's pool state stays born-done (its junk
+        decode writes land in reserved page 0), so the occupied slot
+        pins the pool key while ``_run_prefill_chunks`` advances the
+        row one page-aligned chunk per pass."""
+        jax = self._jax
+        with self._cv:
+            job_index = self._job_index
+            self._job_index += 1
+        rng = jax.random.fold_in(
+            jax.random.key(self._seed_base), job_index
+        )
+        need = (
+            len(job.prompt) + job.max_new - 1
+            + self._spec_slack(self._pool.sampling)
+        )
+        cp = self._pool.start_chunked(
+            job.prompt, need, rng, self.prefill_chunk_pages
+        )
+        if self.prefix_enabled:
+            hit = cp.shared_n > 0
+            if self._metrics is not None:
+                self._metrics.inc(
+                    "prefix_hits_total" if hit else "prefix_misses_total"
+                )
+                if hit:
+                    # Trie hits ARE the resume path: a preempted
+                    # prefill's checkpointed pages come back here.
+                    self._metrics.registry.counter(
+                        "tpufw_prefill_resumes_total"
+                    ).inc()
+            self._events.emit(
+                "serve_prefix",
+                hit=hit,
+                shared_pages=cp.shared_n,
+                prompt_tokens=len(job.prompt),
+            )
+        job.cp = cp
+        self._slots[slot] = job
+        self._n_active += 1
+        self._set_prefill_inflight()
+
+    def _set_prefill_inflight(self) -> None:
+        if self._metrics is None or not self.prefill_chunk_pages:
+            return
+        self._metrics.registry.gauge("tpufw_prefill_inflight").set(
+            float(sum(
+                1 for j in self._slots
+                if j is not None and j.cp is not None
+            ))
+        )
 
     def _admit_job(
         self, req: _SlotReq, job: _SlotJob, slot: int, grant=None
@@ -1743,6 +1875,25 @@ class _SlotScheduler:
         starves target-page admission."""
         d_grant = None
         if self.page:
+            d_need = self._draft_pool.n_pages_for(
+                len(job.prompt) + job.max_new - 1 + self.spec_k
+            )
+            if (
+                self._cp_deficit()
+                and self._draft_pool.allocator.n_free
+                < self._cp_deficit() + d_need
+            ):
+                # Draft pages would eat into the reservation in-flight
+                # chunked prefills count on — degrade this slot rather
+                # than stall prefill progress.
+                self._events.emit(
+                    "serve_spec",
+                    level="warn",
+                    k=self.spec_k,
+                    mode="draft_starved",
+                    slot=slot,
+                )
+                return
             d_grant = self._draft_pool.acquire_pages(
                 job.prompt,
                 len(job.prompt) + job.max_new - 1 + self.spec_k,
@@ -1818,6 +1969,14 @@ class _SlotScheduler:
         already froze themselves inside the decode step. Paged pools
         always take the device path — it zeroes the slot's page-table
         row before the pages go back on the free list."""
+        job = self._slots[slot]
+        if job is not None and job.cp is not None:
+            # Preempted chunked prefill: drop its page refs. The trie
+            # keeps every checkpointed full page, so a re-submission
+            # resumes from the last committed page, never restarts.
+            self._free_pages(self._pool.abandon_chunked(job.cp))
+            job.cp = None
+            self._set_prefill_inflight()
         if self.page:
             self._free_pages(self._pool.release_slot(slot))
         elif device:
@@ -1973,10 +2132,100 @@ class _SlotScheduler:
         for req in finished:
             self._finish(req)
 
+    def _run_prefill_chunks(self) -> bool:
+        """Advance every PREFILLING slot by one page-aligned chunk —
+        the prefill citizens of the same scheduler pass the decoding
+        slots share (no separate tick). A row whose final chunk lands
+        here is finalized immediately, so it decodes in THIS pass's
+        chunk ladder. Returns True iff any chunk ran."""
+        if not self.prefill_chunk_pages:
+            return False
+        progressed = False
+        for slot, job in [
+            (i, j)
+            for i, j in enumerate(self._slots)
+            if j is not None and j.cp is not None
+        ]:
+            cp = job.cp
+            t0 = time.perf_counter()
+            with self._tracer.span(
+                "serve_prefill_chunk",
+                slot=slot,
+                cursor=cp.cursor,
+                prompt=len(job.prompt),
+            ):
+                status = self._pool.chunk_step(cp)
+            if status == "stalled":
+                # Arena momentarily full: the row keeps its slot and
+                # retries next pass (retires/evictions free pages; the
+                # admission reservation guarantees eventual progress).
+                continue
+            progressed = True
+            if self._metrics is not None:
+                self._metrics.registry.counter(
+                    "tpufw_prefill_chunks_total"
+                ).inc()
+            self._events.emit(
+                "serve_prefill_chunk",
+                prompt_tokens=len(job.prompt),
+                cursor=cp.cursor,
+                chunk_s=round(time.perf_counter() - t0, 6),
+                final=status == "done",
+                slot=slot,
+            )
+            if status == "done":
+                self._finalize_chunked(slot, job)
+        self._set_prefill_inflight()
+        return progressed
+
+    def _finalize_chunked(self, slot: int, job: _SlotJob) -> None:
+        """A chunked prefill sampled its first token: either finish
+        the row outright (max_new == 1 / EOS-first — checkpointed
+        pages stay trie-held, the rest free; the slot never saw a
+        device call) or install it as a decoding citizen of its
+        slot."""
+        cp = job.cp
+        req = job.req
+        job.cp = None
+        first_int = cp.first_int
+        job.tokens.append(first_int)
+        job.unflushed.append(first_int)
+        if self._metrics is not None:
+            self._metrics.inc("tokens_generated_total")
+        if job.max_new == 1 or (
+            self._eos is not None and first_int == self._eos
+        ):
+            self._free_pages(self._pool.abandon_chunked(cp))
+            self._slots[slot] = None
+            self._n_active -= 1
+            if self._metrics is not None:
+                self._metrics.inc("retired_rows_total")
+            req.rows_left -= 1
+        else:
+            self._pool.finalize_chunked(slot, cp, job.max_new - 1)
+            if self._draft_pool is not None:
+                self._admit_draft(job, slot, cp.rng)
+            if self._ema is not None:
+                self._ema.occupy(slot)
+        if req.pend.stream_q is not None:
+            self._flush_stream(req)
+        if req.rows_left == 0 and req.next_job == len(req.jobs):
+            self._finish(req)
+
     def _run_chunk(self) -> None:
+        progressed = self._run_prefill_chunks()
         active = [
-            (i, j) for i, j in enumerate(self._slots) if j is not None
+            (i, j)
+            for i, j in enumerate(self._slots)
+            if j is not None and j.cp is None
         ]
+        if not active:
+            if self._n_active and not progressed:
+                # Every occupied slot is a prefill stalled on pages
+                # and nothing is decoding: yield briefly so the loop
+                # doesn't spin hot waiting for a release/eviction.
+                time.sleep(0.001)
+            return
         if self._use_spec(active):
             self._run_spec_chunk(active)
             return
