@@ -1,0 +1,311 @@
+"""CI smoke for the fleet observatory: the full causal chain —
+scrape -> bounded series -> derived fleet signals -> burn-rate alert
+-> deploy-lint-clean scaling recommendation -> retrospective query —
+proven end-to-end in ONE process on CPU.
+
+A real 3-role serving gang (tpufw.serve.roles engines behind
+LocalReplica + RouterServer, llama3_tiny random init) runs under
+scripted load with impossibly tight SLO targets, so every request
+violates TTFT and per-token latency and the multi-window burn rate
+pegs at 1/(1-goal). The FleetCollector scrapes the gang exactly as it
+would a cluster — router /metrics exposition through the tolerant
+parser, replica framed-signal dicts, /healthz backfill — with
+``scrape_once()`` driven manually so every assertion is deterministic.
+What must hold:
+
+- sweep 1 (pre-traffic) records all three replicas live, no alerts;
+- under load, the re-aggregated ``tpufw_fleet_slo_burn_rate`` series
+  cross the fast+slow thresholds and BOTH burn-rate pairs fire,
+  landing schema'd ``fleet_alert`` events in events-fleet.jsonl;
+- the ScalingRecommender turns the sustained alerts into ONE
+  decision artifact (prefill +1, decode +1 — independent pools) whose
+  manifest-shaped YAML passes ``tpulint --layer deploy --manifest``
+  with an empty baseline, via subprocess like an operator would run it;
+- the query CLI (``python -m tpufw.obs.fleet query``) reconstructs the
+  PRE-alert instant (alerts_firing empty, all replicas present) and
+  the post-alert instant (burn alerts firing) from the series dir
+  alone — and still does after the series file gains a torn tail;
+- the collector's own registry re-exports the derived series, and
+  scripts/obs_summary.py digests the fleet dir.
+
+Exit 0 on success; any failed check exits nonzero. Honors
+TPUFW_FLEET_DIR so CI can upload the series dir.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+)
+
+MAX_NEW = 6
+PAGE = 16
+N_REQUESTS = 4
+
+
+def _post(base: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        base + "/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=600) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from tpufw.infer import SamplingConfig
+    from tpufw.models import LLAMA_CONFIGS, Llama
+    from tpufw.obs import fleet
+    from tpufw.obs.events import EventLog, read_events
+    from tpufw.obs.registry import Registry
+    from tpufw.obs.slo import SloTracker
+    from tpufw.serve.roles import DecodeEngine, PrefillEngine
+    from tpufw.serve.router import LocalReplica, RouterServer
+    from tpufw.workloads.env import env_opt_str
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    manifest = os.path.join(
+        repo, "deploy", "manifests", "13-serve-disagg-v5e8-jobset.yaml"
+    )
+    fdir = env_opt_str("fleet_dir") or tempfile.mkdtemp(
+        prefix="tpufw-fleet-smoke-"
+    )
+    os.makedirs(fdir, exist_ok=True)
+
+    failures: list = []
+
+    def check(ok: bool, what: str) -> None:
+        print(("ok: " if ok else "FAILED: ") + what)
+        if not ok:
+            failures.append(what)
+
+    # ---- the gang: real engines, tight SLO so every request burns ----
+    greedy = SamplingConfig(temperature=0.0)
+    cfg = dataclasses.replace(
+        LLAMA_CONFIGS["llama3_tiny"].decode_config(), max_seq_len=64
+    )
+    model = Llama(cfg)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    events = EventLog(os.path.join(fdir, fleet.EVENTS_FILENAME))
+    common = dict(sampling=greedy, page=PAGE, kv_quant="int8")
+    pe = PrefillEngine(model, params, n_slots=2, **common)
+    de = DecodeEngine(model, params, n_slots=4, chunk=2, **common)
+    pe_client = LocalReplica("prefill-0", pe)
+    de_client = LocalReplica("decode-0", de)
+    reg = Registry()
+    # 1 microsecond targets: unattainable by construction, so the burn
+    # rate pegs at 1/(1-goal) = 100 on every window — far past the
+    # 14.4/6.0 fast/slow pair.
+    slo = SloTracker(reg, events, ttft_ms=0.001, tok_ms=0.001, goal=0.99)
+    router = RouterServer(
+        [pe_client], [de_client],
+        port=0, page=PAGE, events=events, registry=reg, slo=slo,
+    )
+    base = f"http://127.0.0.1:{router.port}"
+
+    # ---- the observatory, wired exactly like main_router wires it ----
+    store = fleet.SeriesStore(
+        os.path.join(fdir, fleet.SERIES_FILENAME), max_records=4096
+    )
+    recommender = fleet.ScalingRecommender(
+        fdir, manifest, cooldown_s=60.0, events=events
+    )
+    collector = fleet.FleetCollector(
+        [
+            fleet.Target("router", "router", router.render_metrics),
+            fleet.Target("prefill-0", "prefill", pe_client.signals),
+            fleet.Target("decode-0", "decode", de_client.signals),
+        ],
+        store,
+        events=events,
+        recommender=recommender,
+        health_fn=router.health,
+    )
+
+    # ---- sweep 1: pre-traffic baseline (the instant queries revisit)
+    derived0 = collector.scrape_once()
+    t_quiet = store.read()[-1]["ts"]
+    check(
+        derived0.get('tpufw_fleet_replicas{role="router"}') == 1.0
+        and derived0.get('tpufw_fleet_replicas{role="prefill"}') == 1.0
+        and derived0.get('tpufw_fleet_replicas{role="decode"}') == 1.0,
+        "sweep 1 sees all three roles live "
+        f"(replicas={ {k: v for k, v in derived0.items() if 'replicas' in k} })",
+    )
+    check(
+        not collector.alerts.evaluate(derived0),
+        "no alerts firing before traffic",
+    )
+
+    # ---- scripted load: every request misses both targets ----
+    shared = list(range(40, 72))
+    for i in range(N_REQUESTS):
+        body = _post(base, {
+            "prompt": shared + [7, 9 + i], "max_new": MAX_NEW,
+            "tenant": "smoke", "session": f"s{i}",
+        })
+        check(
+            len(body.get("tokens", [])) == MAX_NEW,
+            f"request {i} served through migration",
+        )
+    time.sleep(0.25)  # strict ts ordering: quiet record < alert event
+
+    # ---- sweep 2: burn crosses the pair, alerts fire, one decision
+    derived1 = collector.scrape_once()
+    fast = derived1.get(
+        'tpufw_fleet_slo_burn_rate{metric="ttft",tenant="smoke",window="60s"}'
+    )
+    slow = derived1.get(
+        'tpufw_fleet_slo_burn_rate{metric="ttft",tenant="smoke",window="300s"}'
+    )
+    check(
+        fast is not None and fast > 14.4 and slow is not None and slow > 6.0,
+        f"re-aggregated burn rate crossed the fast/slow pair "
+        f"(60s={fast}, 300s={slow})",
+    )
+    check(
+        derived1.get("tpufw_fleet_tokens_per_s", 0.0) > 0.0
+        and derived1.get("tpufw_fleet_requests_per_s", 0.0) > 0.0,
+        "counter-rate series derived from the sweep-over-sweep delta "
+        f"(tokens/s={derived1.get('tpufw_fleet_tokens_per_s'):.1f})",
+    )
+    time.sleep(0.25)
+    collector.scrape_once()  # sweep 3: last record ts > firing event ts
+
+    alert_events = [
+        e for e in read_events(os.path.join(fdir, fleet.EVENTS_FILENAME))
+        if e.get("kind") == "fleet_alert" and e.get("state") == "firing"
+    ]
+    fired_rules = sorted({e.get("rule") for e in alert_events})
+    check(
+        "fleet_ttft_burn" in fired_rules and "fleet_tok_burn" in fired_rules,
+        f"both burn-rate pairs fired as fleet_alert events ({fired_rules})",
+    )
+    check(
+        "tpufw_fleet_page_occupancy" in collector.registry.render(),
+        "collector registry re-exports the derived series as gauges",
+    )
+
+    # ---- the recommendation artifact, verified the operator's way ----
+    artifacts = sorted(
+        f for f in os.listdir(fdir) if f.startswith("fleet-rec-")
+        and f.endswith(".yaml")
+    )
+    check(
+        len(artifacts) == 1,
+        f"one sustained-alert sweep -> one decision artifact "
+        f"(cooldown held sweep 3 back; got {artifacts})",
+    )
+    if artifacts:
+        art = os.path.join(fdir, artifacts[0])
+        counts = fleet.read_manifest_replicas(
+            open(art, encoding="utf-8").read()
+        )
+        check(
+            counts.get("prefill") == 2 and counts.get("decode") == 2,
+            f"independent pools each stepped +1 (replicas={counts})",
+        )
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpufw.analysis", "--layer", "deploy",
+             "--manifest", art, "--no-baseline"],
+            capture_output=True, text=True, timeout=300, cwd=repo,
+        )
+        check(
+            proc.returncode == 0,
+            "recommendation artifact passes tpulint --layer deploy "
+            f"(rc={proc.returncode}: {proc.stdout.strip() or proc.stderr.strip()})",
+        )
+
+    # ---- retrospective queries from the series dir alone ----
+    def query(*extra: str) -> dict:
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpufw.obs.fleet", "query",
+             "--dir", fdir, "--json", *extra],
+            capture_output=True, text=True, timeout=120, cwd=repo,
+        )
+        if proc.returncode != 0:
+            return {"_rc": proc.returncode, "_err": proc.stderr}
+        return json.loads(proc.stdout)
+
+    pre = query("--at", str(t_quiet))
+    check(
+        pre.get("alerts_firing") == []
+        and sorted(pre.get("replicas", {})) == [
+            "decode-0", "prefill-0", "router",
+        ],
+        "query CLI reconstructs the pre-alert instant: three replicas, "
+        f"no alerts (replicas={sorted(pre.get('replicas', {}))}, "
+        f"firing={pre.get('alerts_firing')})",
+    )
+    post = query("--window", "60")
+    post_rules = sorted(
+        {e.get("rule") for e in post.get("alerts_firing", [])}
+    )
+    check(
+        "fleet_ttft_burn" in post_rules,
+        f"query CLI sees the burn alert firing at the latest instant "
+        f"({post_rules})",
+    )
+    check(
+        "tpufw_fleet_page_occupancy" in post.get("window", {}),
+        "trailing-window aggregation covers the derived series",
+    )
+
+    # ---- torn tail: a collector killed mid-write must not take the
+    # queries with it ----
+    with open(os.path.join(fdir, fleet.SERIES_FILENAME), "a",
+              encoding="utf-8") as f:
+        f.write('{"ts": 999999999.0, "replica": "torn", "ser')
+    torn = query("--at", str(t_quiet))
+    check(
+        sorted(torn.get("replicas", {})) == [
+            "decode-0", "prefill-0", "router",
+        ],
+        "query survives a torn series tail",
+    )
+
+    # ---- the digest ----
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "obs_summary.py"),
+         fdir],
+        capture_output=True, text=True, timeout=120,
+    )
+    print(proc.stdout, end="")
+    check(
+        proc.returncode == 0 and "fleet observatory" in proc.stdout
+        and "fleet_ttft_burn" in proc.stdout,
+        "obs_summary digests the fleet dir (series + alert history)",
+    )
+
+    store.close()
+    events.close()
+    router.close()
+    if failures:
+        print(f"fleet-smoke FAILED ({len(failures)} check(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("fleet-smoke OK: scrape -> series -> burn -> alert -> "
+          "lint-clean recommendation -> retrospective query, end to end")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -477,6 +477,71 @@ def summarize_crash_bundles(out: str) -> None:
         )
 
 
+def summarize_fleet(out: str, window_s: float = 300.0) -> None:
+    """Fleet observatory digest: last-window derived series table,
+    fired alerts, and the recommendation log. Prints nothing when the
+    dir has no fleet series; torn tails degrade to whatever parses
+    (read_series/read_events both drop unparseable lines)."""
+    from tpufw.obs import fleet as obs_fleet
+
+    series_path = os.path.join(out, obs_fleet.SERIES_FILENAME)
+    if not os.path.exists(series_path):
+        return
+    records = obs_fleet.read_series(series_path)
+    print("-- fleet observatory --")
+    if not records:
+        print("  (series file present but nothing parseable)")
+        return
+    last_ts = records[-1]["ts"]
+    replicas = sorted(
+        {
+            (r["replica"], r.get("role", "?"))
+            for r in records
+            if r["replica"] != "fleet"
+        }
+    )
+    stale_now = {
+        r["replica"]
+        for r in records
+        if r["ts"] == last_ts and r.get("stale")
+    }
+    print(
+        f"  {len(records)} records, {len(replicas)} replica(s), "
+        f"last sweep @ {last_ts:.3f}"
+        + (f", stale now: {sorted(stale_now)}" if stale_now else "")
+    )
+    stats = obs_fleet.window_stats(records, last_ts - window_s, last_ts)
+    if stats:
+        print(f"  last {window_s:.0f}s derived series (min/mean/max):")
+        for skey, st in stats.items():
+            print(
+                f"    {skey:<58} {st['min']:>9.4g} {st['mean']:>9.4g} "
+                f"{st['max']:>9.4g}"
+            )
+    history = obs_fleet.load_alert_history(
+        os.path.join(out, obs_fleet.EVENTS_FILENAME)
+    )
+    alerts = [e for e in history if e.get("kind") == "fleet_alert"]
+    if alerts:
+        print("  alerts:")
+        for ev in alerts[-10:]:
+            print(
+                f"    {ev.get('ts', 0):.3f} {ev.get('state'):<9} "
+                f"{ev.get('rule')} [{ev.get('severity', '?')}] "
+                f"{ev.get('series')} = {ev.get('value')}"
+            )
+    recs = [e for e in history if e.get("kind") == "fleet_recommendation"]
+    if recs:
+        print("  recommendations:")
+        for ev in recs[-5:]:
+            print(
+                f"    {ev.get('ts', 0):.3f} pools="
+                f"{json.dumps(ev.get('pools'), sort_keys=True)} "
+                f"reason={','.join(ev.get('reason', []))} -> "
+                f"{ev.get('artifact')}"
+            )
+
+
 def main(argv: list[str]) -> int:
     if len(argv) != 2:
         print(__doc__.strip(), file=sys.stderr)
@@ -505,6 +570,7 @@ def main(argv: list[str]) -> int:
     if os.path.exists(prom):
         print("-- metrics snapshot --")
         summarize_metrics(prom)
+    summarize_fleet(out)
     summarize_crash_bundles(out)
     return 0
 

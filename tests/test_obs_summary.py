@@ -213,3 +213,77 @@ def test_torn_manifest_marked_incomplete(summary, tmp_path, capsys):
     out = capsys.readouterr().out
     assert "crash-bundle-p0: INCOMPLETE" in out
     assert "no parseable manifest" in out
+
+
+# --------------------------------------------- fleet observatory digest
+
+def _seed_fleet_dir(tmp_path):
+    from tpufw.obs import events as obs_events
+    from tpufw.obs import fleet
+
+    store = fleet.SeriesStore(str(tmp_path / fleet.SERIES_FILENAME))
+    for t in (10.0, 20.0, 30.0):
+        store.append(
+            "router", "router",
+            {"tpufw_router_queue_depth": t / 10}, ts=t,
+        )
+        store.append(
+            "fleet", "fleet", {"tpufw_fleet_queue_depth": t / 10}, ts=t
+        )
+    store.close()
+    log = obs_events.EventLog(str(tmp_path / fleet.EVENTS_FILENAME))
+    log.emit(
+        "fleet_alert", level="warn", rule="fleet_queue_backlog",
+        state="firing", series="tpufw_fleet_queue_depth", value=3.0,
+        severity="warn",
+    )
+    log.emit(
+        "fleet_recommendation",
+        pools={"prefill": {"from": 1, "to": 2}},
+        reason=["fleet_queue_backlog"],
+        artifact=str(tmp_path / "fleet-rec-0001.yaml"),
+    )
+    log.close()
+
+
+def test_fleet_digest_series_alerts_and_recommendations(
+    summary, tmp_path, capsys
+):
+    _seed_fleet_dir(tmp_path)
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- fleet observatory --" in out
+    assert "tpufw_fleet_queue_depth" in out  # derived series table
+    assert "firing" in out and "fleet_queue_backlog" in out
+    assert "fleet-rec-0001.yaml" in out
+
+
+def test_fleet_digest_absent_without_series_file(
+    summary, tmp_path, capsys
+):
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    assert "fleet observatory" not in capsys.readouterr().out
+
+
+def test_fleet_digest_torn_series_degrades(summary, tmp_path, capsys):
+    from tpufw.obs import fleet
+
+    (tmp_path / fleet.SERIES_FILENAME).write_text(
+        '{"ts": 1.0, "replica": "router", "role": "router", '
+        '"series": {"tpufw_router_queue_depth": 1}}\n'
+        '{"ts": 2.0, "replica": "rou'  # torn tail
+    )
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "-- fleet observatory --" in out
+    assert "1 records" in out  # the parseable line survived
+
+
+def test_fleet_digest_garbage_series_file_noted(
+    summary, tmp_path, capsys
+):
+    from tpufw.obs import fleet
+
+    (tmp_path / fleet.SERIES_FILENAME).write_text("not json at all\n")
+    assert summary.main(["obs_summary", str(tmp_path)]) == 0
+    assert "nothing parseable" in capsys.readouterr().out

@@ -1,0 +1,158 @@
+"""tpufw.obs.promtext: the tolerant exposition parser and its
+bit-exact renderer.
+
+The load-bearing property is the round trip against the repo's own
+Registry: ``render(parse(registry.render())) == registry.render()``
+byte-for-byte, across counters, labeled children, escaping-hostile
+label values, multi-line HELP text, and full histograms. That
+equality is what keeps promtext and registry.py from drifting into
+two dialects of the same format. The tolerance half is tested
+separately: torn lines, foreign comments, and malformed label blocks
+must drop, never raise.
+"""
+
+import math
+
+from tpufw.obs import promtext
+from tpufw.obs.registry import Registry
+
+
+def _full_registry() -> Registry:
+    r = Registry()
+    c = r.counter("tpufw_t_requests_total", "requests in")
+    c.inc(5)
+    c.inc(2, tenant="alpha")
+    c.inc(1, tenant="beta", route="x")
+    r.counter("tpufw_t_zero_total", "pre-registered, never inc'd")
+    g = r.gauge("tpufw_t_depth", "queue depth")
+    g.set(3.5)
+    g.set(0, tenant="alpha")
+    h = r.histogram("tpufw_t_seconds", "latency", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    h.observe(0.5, tenant="alpha")
+    return r
+
+
+# ---------------------------------------------------- the round trip
+
+
+def test_round_trip_is_byte_exact():
+    text = _full_registry().render()
+    assert promtext.render(promtext.parse(text)) == text
+
+
+def test_round_trip_survives_escaping_hostile_content():
+    r = Registry()
+    c = r.counter("tpufw_t_total", 'help with "quotes", \\backslash\\\nand a newline')
+    c.inc(1, path='C:\\dir\\"file"\nline2')
+    text = r.render()
+    assert promtext.render(promtext.parse(text)) == text
+    # And the parsed label value is the original unescaped string.
+    fams = promtext.parse(text)
+    sample = next(s for f in fams for s in f.samples if s.labels)
+    assert sample.labels_dict()["path"] == 'C:\\dir\\"file"\nline2'
+    assert fams[0].help == 'help with "quotes", \\backslash\\\nand a newline'
+
+
+def test_round_trip_preserves_float_value_text():
+    # Values like 0.1 must re-render with the registry's repr-based
+    # formatting, not drift through float round-tripping.
+    r = Registry()
+    r.gauge("tpufw_t_g", "g").set(0.1)
+    r.counter("tpufw_t_c_total", "c").inc(10**15 + 1)
+    text = r.render()
+    assert "0.1" in text and str(10**15 + 1) in text
+    assert promtext.render(promtext.parse(text)) == text
+
+
+def test_histogram_family_owns_its_suffix_samples():
+    text = _full_registry().render()
+    fams = {f.name: f for f in promtext.parse(text)}
+    hist = fams["tpufw_t_seconds"]
+    assert hist.kind == "histogram"
+    names = {s.name for s in hist.samples}
+    assert names == {
+        "tpufw_t_seconds_bucket",
+        "tpufw_t_seconds_sum",
+        "tpufw_t_seconds_count",
+    }
+    # Cumulative buckets end at +Inf and agree with _count.
+    inf = [
+        s for s in hist.samples
+        if s.name.endswith("_bucket")
+        and s.labels_dict().get("le") == "+Inf"
+        and "tenant" not in s.labels_dict()
+    ]
+    count = next(
+        s for s in hist.samples
+        if s.name.endswith("_count") and not s.labels
+    )
+    assert inf[0].value == count.value == 2
+
+
+# ---------------------------------------------------------- flatten
+
+
+def test_flatten_keys_are_canonical_and_buckets_drop():
+    flat = promtext.flatten(_full_registry().render())
+    assert flat["tpufw_t_requests_total"] == 5
+    assert flat['tpufw_t_requests_total{tenant="alpha"}'] == 2
+    # Multi-label key is sorted regardless of inc() kwarg order.
+    assert flat['tpufw_t_requests_total{route="x",tenant="beta"}'] == 1
+    assert flat["tpufw_t_zero_total"] == 0
+    assert flat["tpufw_t_seconds_sum"] == 5.05
+    assert flat["tpufw_t_seconds_count"] == 2
+    assert not any("_bucket" in k for k in flat)
+
+
+def test_sample_key_parse_sample_key_invert():
+    key = promtext.sample_key(
+        "tpufw_x", {"b": 'v"2', "a": "v\\1"}
+    )
+    name, labels = promtext.parse_sample_key(key)
+    assert name == "tpufw_x"
+    assert labels == {"a": "v\\1", "b": 'v"2'}
+    assert promtext.parse_sample_key("bare") == ("bare", {})
+
+
+# --------------------------------------------------------- tolerance
+
+
+def test_torn_and_malformed_lines_drop_not_raise():
+    text = (
+        "# HELP tpufw_ok help\n"
+        "# TYPE tpufw_ok counter\n"
+        "tpufw_ok 1\n"
+        "tpufw_torn{label=\"unterminated\n"  # torn mid-label
+        "tpufw_no_value\n"  # no value token
+        "tpufw_bad_value not_a_float\n"
+        "{\"json\": \"line\"}\n"  # foreign content
+        "# EOF\n"  # OpenMetrics terminator: unknown comment
+        "tpufw_ok2 2 1700000000\n"  # timestamped sample
+        "tpufw_ok3 3 17 extra\n"  # >2 trailing tokens
+    )
+    flat = promtext.flatten(text)
+    assert flat == {"tpufw_ok": 1.0, "tpufw_ok2": 2.0}
+
+
+def test_untyped_samples_get_own_families():
+    fams = promtext.parse("a_total 1\nb_total 2\na_total{x=\"1\"} 3\n")
+    assert [f.name for f in fams] == ["a_total", "b_total", "a_total"]
+    assert all(f.kind == "" and f.help is None for f in fams)
+
+
+def test_non_finite_values_parse_and_render():
+    text = "a NaN\nb +Inf\nc -Inf\n"
+    fams = promtext.parse(text)
+    values = {f.name: f.samples[0].value for f in fams}
+    assert math.isnan(values["a"])
+    assert values["b"] == float("inf")
+    assert values["c"] == float("-inf")
+    assert promtext.render(fams) == text
+
+
+def test_empty_document():
+    assert promtext.parse("") == []
+    assert promtext.render([]) == ""
+    assert promtext.flatten("") == {}

@@ -383,3 +383,50 @@ def test_discovery_fails_loudly_without_a_source():
         discover_replicas({"JOBSET_NAME": "x"})
     with pytest.raises(ValueError, match="BOTH"):
         discover_replicas({"TPUFW_ROUTER_PREFILL": "p0:1"})
+
+
+# ------------------------------- fleet-facing queue/metric exports
+
+def test_wfq_tracks_per_tenant_depths_with_zero_persistence():
+    q = WeightedFairQueue({})
+    q.push("a", 1, "a0")
+    q.push("a", 1, "a1")
+    q.push("b", 1, "b0")
+    assert q.depths() == {"a": 2, "b": 1}
+    drained = [q.pop() for _ in range(3)]
+    assert set(drained) == {"a0", "a1", "b0"}
+    # Drained tenants stay present at 0 (gauge series must keep
+    # reporting 0, not vanish).
+    assert q.depths() == {"a": 0, "b": 0}
+
+
+def test_metrics_expose_tenant_queue_depth_and_deferred():
+    srv = RouterServer(
+        [_StubPrefill("p0")], [_StubDecode("d0")],
+        port=0, max_inflight=1,
+    )
+    try:
+        with srv._lock:
+            srv._inflight = 1  # force deferral
+        assert not srv._admit("vip", 1.0, timeout=0.05)
+        srv._release()
+        text = srv.render_metrics()
+        assert 'tpufw_router_deferred_total{tenant="vip"} 1' in text
+        assert 'tpufw_router_queue_depth{tenant="vip"} 0' in text
+        # Unlabeled totals and the pre-registered token counter are
+        # present from the first scrape (absent-series rule).
+        assert "tpufw_router_queue_depth 0" in text
+        assert "tpufw_router_tokens_total 0" in text
+    finally:
+        srv.close()
+
+
+def test_generate_counts_tokens_total():
+    srv = RouterServer([_StubPrefill("p0")], [_StubDecode("d0")], port=0)
+    try:
+        code, body, _h = srv.generate({"prompt": [1, 2], "max_new": 4})
+        assert code == 200 and body["tokens"] == [7, 8]
+        text = srv.render_metrics()
+        assert "tpufw_router_tokens_total 2" in text
+    finally:
+        srv.close()

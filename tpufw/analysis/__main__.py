@@ -109,6 +109,18 @@ def main(argv: List[str] | None = None) -> int:
         ),
     )
     ap.add_argument(
+        "--manifest",
+        action="append",
+        metavar="PATH",
+        default=None,
+        help=(
+            "additional manifest to verify with the deploy layer, on "
+            "top of the deploy/ scan set (repeatable) — e.g. a fleet "
+            "scaling-recommendation artifact; disables the replay "
+            "cache for the run"
+        ),
+    )
+    ap.add_argument(
         "--since",
         metavar="REF",
         help=(
@@ -154,7 +166,9 @@ def main(argv: List[str] | None = None) -> int:
         else None
     )
     cache_path = None
-    if args.cache is not None:
+    if args.cache is not None and not args.manifest:
+        # Extra manifests live outside the scan signature's file set;
+        # a cached replay would silently skip them.
         cache_path = (
             os.path.join(root, args.cache)
             if args.cache == incremental.DEFAULT_CACHE
@@ -191,7 +205,8 @@ def main(argv: List[str] | None = None) -> int:
             seen = set()
             for layer in layers:
                 for f in core.run_analysis(
-                    paths, root=root, rules=rules, layer=layer
+                    paths, root=root, rules=rules, layer=layer,
+                    extra_manifests=args.manifest,
                 ):
                     # Layers overlap (TPU000 parse errors fire in
                     # every layer; "all" subsumes the rest) — one

@@ -456,6 +456,7 @@ def run_analysis(
     rules: Optional[Iterable[str]] = None,
     checkers: Optional[Sequence[Checker]] = None,
     layer: str = "all",
+    extra_manifests: Optional[Sequence[str]] = None,
 ) -> List[Finding]:
     """Parse ``paths``, run the (optionally filtered) checker set, and
     return suppression-filtered findings sorted by location. Parse
@@ -480,6 +481,16 @@ def run_analysis(
 
         if manifests.yaml_available():
             deploy_files = manifests.collect_deploy_files(root)
+            # Explicit extra manifests (--manifest): artifacts outside
+            # the fixed deploy/ scan set, e.g. fleet scaling
+            # recommendations, verified with the same rule set.
+            for mpath in extra_manifests or ():
+                df = manifests.load_manifest(mpath)
+                if df is None:
+                    raise ValueError(
+                        f"--manifest {mpath}: unreadable"
+                    )
+                deploy_files.append(df)
         elif layer == "deploy":
             raise ValueError(
                 "--layer deploy needs pyyaml to parse manifests "

@@ -236,13 +236,18 @@ class TopologyMathChecker(Checker):
                     f"{w.name!r} (want AxB or AxBxC)",
                     symbol=f"topology-syntax:{w.name}",
                 )
-            elif tpu and prod != tpu * w.workers:
+            elif tpu and prod != tpu * max(1, w.parallelism):
+                # Per-SLICE math: a replicatedJob's replicas are
+                # independent gangs, each on its own slice of this
+                # topology — only parallelism (pods per gang)
+                # multiplies the chip count the selector describes.
+                per_slice = tpu * max(1, w.parallelism)
                 yield _dfinding(
                     self, df, df.find_line(str(topo)),
                     f"{w.kind} {w.name!r}: topology {topo} = {prod} chips"
-                    f" but the workload covers {tpu} {TPU_RESOURCE} x "
-                    f"{w.workers} worker(s) = {tpu * w.workers} — slice "
-                    "shape and chip math disagree",
+                    f" but one gang covers {tpu} {TPU_RESOURCE} x "
+                    f"{max(1, w.parallelism)} worker pod(s) = {per_slice}"
+                    " — slice shape and chip math disagree",
                     symbol=f"topology:{w.name}",
                 )
 
@@ -259,7 +264,9 @@ class TopologyMathChecker(Checker):
                 symbol=f"completions:{w.name}",
             )
 
-        yield from self._check_mesh_env(df, w, tpu * w.workers)
+        # Mesh axes, like topology, describe one gang's slice — not
+        # the sum over replicas.
+        yield from self._check_mesh_env(df, w, tpu * max(1, w.parallelism))
 
     def _check_mesh_env(
         self, df: "mf.DeployFile", w: "mf.PodWorkload", chips: int

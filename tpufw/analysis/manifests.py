@@ -213,6 +213,27 @@ def _render_chart(root: str) -> List[DeployFile]:
     return out
 
 
+def load_manifest(path: str) -> Optional[DeployFile]:
+    """Parse one manifest from an arbitrary path — the ``--manifest``
+    CLI flag's loader, for artifacts outside the fixed deploy/ scan
+    set (fleet scaling-recommendation YAML, generated files in temp
+    dirs). Returns None when the file is unreadable."""
+    import yaml
+
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return None
+    try:
+        docs = [d for d in yaml.safe_load_all(text) if d is not None]
+        err = None
+    except yaml.YAMLError as e:
+        docs = []
+        err = f"yaml parse error: {e}"
+    return DeployFile(path, text, "manifest", parse_error=err, docs=docs)
+
+
 def collect_deploy_files(root: str) -> List[DeployFile]:
     """Every deploy artifact under ``root``, parsed. Missing
     directories simply contribute nothing (fixture trees)."""
@@ -273,11 +294,19 @@ class PodWorkload:
 
     @property
     def workers(self) -> int:
+        """Total pods across every gang (parallelism x replicas) —
+        fleet-wide totals like chip counts."""
         return max(1, self.parallelism) * max(1, self.replicas)
 
     @property
     def is_multihost(self) -> bool:
-        return self.workers > 1
+        """Pods *within one gang* cooperate via jax.distributed; a
+        replicatedJob's replicas are independent gangs, so only
+        parallelism > 1 means multi-host bootstrap wiring is needed.
+        (Scaling a serving pool to replicas: 3 must not start
+        demanding JOBSET_NAME plumbing each single-pod replica never
+        reads.)"""
+        return max(1, self.parallelism) > 1
 
     def containers(self) -> List[dict]:
         out = []

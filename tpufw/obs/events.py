@@ -57,6 +57,10 @@ SCHEMA: Dict[str, FrozenSet[str]] = {
     ),
     "goodput": frozenset({"wall_s", "goodput_ratio"}),
     "hang": frozenset({"timeout_s", "armed_for_s"}),
+    # Fleet observatory (tpufw.obs.fleet): alert-rule transitions and
+    # the scaling decisions sustained alerts turn into.
+    "fleet_alert": frozenset({"rule", "state", "series", "value"}),
+    "fleet_recommendation": frozenset({"pools", "reason", "artifact"}),
 }
 
 

@@ -50,12 +50,27 @@ def _fmt(v: float) -> str:
     return str(int(v)) if v == int(v) else repr(v)
 
 
+def escape_help(s: str) -> str:
+    """HELP-line escaping per the text-format spec: backslash and
+    newline only (quotes are legal verbatim in HELP text)."""
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(s: str) -> str:
+    """Label-value escaping per the text-format spec: backslash,
+    double-quote, newline. Without this a label value containing a
+    quote tears the exposition line for every conformant parser."""
+    return (
+        s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
 def _label_key(labels: Dict[str, str]) -> LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
 def _label_str(key: LabelKey, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in key]
+    parts = [f'{k}="{escape_label_value(v)}"' for k, v in key]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -79,7 +94,9 @@ class _Metric:
     def _header(self) -> list:
         lines = []
         if self.help:
-            lines.append(f"# HELP {self.name} {self.help}")
+            lines.append(
+                f"# HELP {self.name} {escape_help(self.help)}"
+            )
         lines.append(f"# TYPE {self.name} {self.kind}")
         return lines
 

@@ -160,8 +160,13 @@ class WeightedFairQueue:
         self._default = float(default_weight)
         self._vt = 0.0
         self._finish: Dict[str, float] = {}
-        self._heap: List[Tuple[float, int, Any]] = []
+        self._heap: List[Tuple[float, int, str, Any]] = []
         self._seq = 0
+        # Per-tenant queued count. Entries persist at 0 after a tenant
+        # drains so its gauge series keeps reporting 0 instead of
+        # vanishing (absent-series vs zero, same rationale as the
+        # pre-initialized counters in tpufw.obs.registry).
+        self._depth: Dict[str, int] = {}
 
     def weight(self, tenant: str) -> float:
         return max(1e-9, float(self._weights.get(tenant, self._default)))
@@ -170,14 +175,20 @@ class WeightedFairQueue:
         start = max(self._vt, self._finish.get(tenant, 0.0))
         fin = start + float(cost) / self.weight(tenant)
         self._finish[tenant] = fin
-        heapq.heappush(self._heap, (fin, self._seq, item))
+        heapq.heappush(self._heap, (fin, self._seq, tenant, item))
         self._seq += 1
+        self._depth[tenant] = self._depth.get(tenant, 0) + 1
         return fin
 
     def pop(self) -> Any:
-        fin, _, item = heapq.heappop(self._heap)
+        fin, _, tenant, item = heapq.heappop(self._heap)
         self._vt = max(self._vt, fin)
+        self._depth[tenant] = max(0, self._depth.get(tenant, 1) - 1)
         return item
+
+    def depths(self) -> Dict[str, int]:
+        """Per-tenant queued counts (drained tenants stay at 0)."""
+        return dict(self._depth)
 
     def __len__(self) -> int:
         return len(self._heap)
@@ -323,14 +334,19 @@ class _Metrics:
             "proxy_errors_total",
             "request_seconds_total",
             "piggyback_total",
+            "deferred_total",
+            "tokens_total",
         )
 
-    def inc(self, name: str, v: float = 1.0) -> None:
-        self.registry.counter(self.PREFIX + name).inc(v)
+    def inc(self, name: str, v: float = 1.0, **labels) -> None:
+        self.registry.counter(self.PREFIX + name).inc(v, **labels)
 
     def register(self, *names: str) -> None:
         for name in names:
             self.registry.counter(self.PREFIX + name)
+
+    def set_gauge(self, name: str, v: float, **labels) -> None:
+        self.registry.gauge(self.PREFIX + name).set(float(v), **labels)
 
     def render(self, gauges: Dict[str, float]) -> str:
         for name, v in gauges.items():
@@ -642,11 +658,17 @@ class RouterServer:
     def render_metrics(self) -> str:
         with self._lock:
             depth = len(self.policy.queue)
+            depths = self.policy.queue.depths()
             decode_free = sum(
                 r.free_pages
                 for r in self._states.values()
                 if r.role == "decode" and r.healthy
             )
+        # Per-tenant WFQ depth rides as labeled children next to the
+        # unlabeled total — queue pressure visible per tenant before
+        # it becomes TTFT (drained tenants keep a 0 series).
+        for tenant, n in depths.items():
+            self._metrics.set_gauge("queue_depth", n, tenant=tenant)
         return self._metrics.render(
             {
                 "queue_depth": depth,
@@ -673,6 +695,13 @@ class RouterServer:
         with self._lock:
             self.policy.queue.push(tenant, cost, ev)
             self._pump_locked()
+            deferred = not ev.is_set()
+        if deferred:
+            # Admission was not immediate: the request sat behind the
+            # inflight cap. The counter is the alert-friendly
+            # companion of the queue-depth gauge (a scrape can miss a
+            # transient queue; it cannot miss a counter increment).
+            self._metrics.inc("deferred_total", tenant=tenant)
         if ev.wait(timeout):
             return True
         with self._lock:
@@ -770,6 +799,7 @@ class RouterServer:
         self._metrics.inc("requests_total")
         self._metrics.inc("piggyback_total")
         self._metrics.inc("request_seconds_total", latency)
+        self._metrics.inc("tokens_total", len(tokens))
         self._events.emit(
             "router_request", tenant=tenant, replica=pig,
             latency_s=round(latency, 6),
@@ -1009,6 +1039,7 @@ class RouterServer:
             )
             self._metrics.inc("requests_total")
             self._metrics.inc("request_seconds_total", latency)
+            self._metrics.inc("tokens_total", len(tokens))
             self._events.emit(
                 "router_request", tenant=tenant, replica=name,
                 latency_s=round(latency, 6),
@@ -1112,12 +1143,29 @@ def main_router() -> int:
         events=events,
         tracer=tracer,
     )
+    # Fleet observatory attach point: the collector scrapes this
+    # router's own exposition in-process plus every replica's framed-
+    # TCP signals probe. collector_from_env is None (no thread, no
+    # files) unless TPUFW_FLEET_SCRAPE_S is set — the disabled path
+    # is byte-identical to a build without the observatory.
+    from tpufw.obs import fleet as obs_fleet
+
+    fleet_targets = [
+        obs_fleet.Target("router", "router", server.render_metrics)
+    ] + [
+        obs_fleet.Target(c.name, c.role, c.signals)
+        for c in prefill + decode
+    ]
+    collector = obs_fleet.collector_from_env(
+        fleet_targets, health_fn=server.health, default_dir=tdir or "."
+    )
     print(json.dumps(
         {
             "serving_role": "router",
             "port": server.port,
             "prefill": len(prefill),
             "decode": len(decode),
+            "fleet": collector is not None,
         }
     ), flush=True)
     try:
@@ -1125,6 +1173,8 @@ def main_router() -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         server.close()
+        if collector is not None:
+            collector.stop()
         tracer.close()
         events.close()
     return 0
