@@ -667,15 +667,22 @@ def _measure_disagg(
         from tpufw.obs import fleet as obs_fleet
 
         os.makedirs(fleet_dir, exist_ok=True)
-        collector = obs_fleet.FleetCollector(
-            [
-                obs_fleet.Target("prefill-0", "prefill", pe.signals),
-                obs_fleet.Target("decode-0", "decode", de.signals),
-            ],
-            obs_fleet.SeriesStore(
-                os.path.join(fleet_dir, obs_fleet.SERIES_FILENAME)
-            ),
+        fleet_store = obs_fleet.SeriesStore(
+            os.path.join(fleet_dir, obs_fleet.SERIES_FILENAME)
         )
+        try:
+            collector = obs_fleet.FleetCollector(
+                [
+                    obs_fleet.Target(
+                        "prefill-0", "prefill", pe.signals
+                    ),
+                    obs_fleet.Target("decode-0", "decode", de.signals),
+                ],
+                fleet_store,
+            )
+        except BaseException:
+            fleet_store.close()
+            raise
 
     def one(p):
         # wire: consumes decode-reply via out

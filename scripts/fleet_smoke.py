@@ -124,20 +124,26 @@ def main() -> int:
     store = fleet.SeriesStore(
         os.path.join(fdir, fleet.SERIES_FILENAME), max_records=4096
     )
-    recommender = fleet.ScalingRecommender(
-        fdir, manifest, cooldown_s=60.0, events=events
-    )
-    collector = fleet.FleetCollector(
-        [
-            fleet.Target("router", "router", router.render_metrics),
-            fleet.Target("prefill-0", "prefill", pe_client.signals),
-            fleet.Target("decode-0", "decode", de_client.signals),
-        ],
-        store,
-        events=events,
-        recommender=recommender,
-        health_fn=router.health,
-    )
+    try:
+        recommender = fleet.ScalingRecommender(
+            fdir, manifest, cooldown_s=60.0, events=events
+        )
+        collector = fleet.FleetCollector(
+            [
+                fleet.Target("router", "router", router.render_metrics),
+                fleet.Target("prefill-0", "prefill", pe_client.signals),
+                fleet.Target("decode-0", "decode", de_client.signals),
+            ],
+            store,
+            events=events,
+            recommender=recommender,
+            health_fn=router.health,
+        )
+    except BaseException:
+        # Recommender/collector wiring raising must not strand the
+        # series handle (TPU019).
+        store.close()
+        raise
 
     # ---- sweep 1: pre-traffic baseline (the instant queries revisit)
     derived0 = collector.scrape_once()

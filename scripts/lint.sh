@@ -12,8 +12,10 @@
 #      JAX/TPU rules (docs/ANALYSIS.md): hot-loop purity, mesh-axis
 #      names, RNG discipline, env + observability registry hygiene,
 #      jit donation, recompile churn, dtype drift, lock discipline,
-#      and the distributed-protocol layer (wire contracts, SPMD
-#      divergence, HTTP surface, metric cardinality). No
+#      the distributed-protocol layer (wire contracts, SPMD
+#      divergence, HTTP surface, metric cardinality), and the
+#      resource-lifetime layer (acquire/release pairing, CV
+#      discipline, counter balance, donation windows). No
 #      dependencies, so it always runs; exits non-zero on any
 #      finding not absorbed by analysis_baseline.json.
 #
@@ -22,9 +24,10 @@
 # milliseconds) and gates only on findings in files you changed since
 # HEAD — see docs/ANALYSIS.md "Incremental mode".
 #
-# `--layer {python,deploy,protocol,all}` is forwarded to tpulint
-# (deploy runs the cross-layer manifest rules TPU010-014, needs
-# pyyaml; protocol runs the distributed-protocol rules TPU015-018).
+# `--layer {python,deploy,protocol,lifetime,all}` is forwarded to
+# tpulint (deploy runs the cross-layer manifest rules TPU010-014,
+# needs pyyaml; protocol runs the distributed-protocol rules
+# TPU015-018; lifetime runs the resource-lifetime rules TPU019-022).
 # Without --layer, tpulint also honors TPUFW_LINT_LAYERS (comma
 # list) — see docs/ENV.md. Any other extra args are forwarded to
 # ruff.

@@ -1337,6 +1337,37 @@ def test_all_rules_fire_on_fixtures(tmp_path):
                 "    assert r.status == 200\n"
                 "    q = fetch(base + '/metricz')\n"
             ),
+            # TPU019: exception path between acquire and release.
+            # TPU020: bare wait outside a while loop.
+            # TPU021: marked counter incremented, never decremented.
+            # TPU022: donated arg read inside its donation window.
+            "lifecycle.py": (
+                "import threading\n"
+                "class Pool:\n"
+                "    def __init__(self):\n"
+                "        self._cv = threading.Condition()\n"
+                "        self.inflight = 0  # resource: counter jobs\n"
+                "        self.ready = False\n"
+                "    def grab(self):\n"
+                "        # resource: acquires pages\n"
+                "        return [1]\n"
+                "    def give(self, ids):\n"
+                "        # resource: releases pages\n"
+                "        pass\n"
+                "    def use(self, work):\n"
+                "        ids = self.grab()\n"
+                "        work(ids)\n"
+                "        self.give(ids)\n"
+                "    def bad_wait(self):\n"
+                "        with self._cv:\n"
+                "            if not self.ready:\n"
+                "                self._cv.wait()\n"
+                "    def bump(self):\n"
+                "        self.inflight += 1\n"
+                "    def window(self, fn, x):\n"
+                "        out = fn(x)  # resource: donates x\n"
+                "        return x + out\n"
+            ),
         },
     )
     rules = {f.rule for f in out}
@@ -1344,6 +1375,7 @@ def test_all_rules_fire_on_fixtures(tmp_path):
         "TPU001", "TPU002", "TPU003", "TPU004", "TPU005",
         "TPU006", "TPU007", "TPU008", "TPU009",
         "TPU015", "TPU016", "TPU017", "TPU018",
+        "TPU019", "TPU020", "TPU021", "TPU022",
     }
     if deploy_files:
         want |= {"TPU010", "TPU011", "TPU012", "TPU013", "TPU014"}
@@ -3059,3 +3091,887 @@ def test_cli_env_layer_default(tmp_path, monkeypatch):
     assert main([str(mod), "--no-baseline"]) == 2
     monkeypatch.delenv("TPUFW_LINT_LAYERS")
     assert main([str(mod), "--no-baseline"]) == 0
+
+
+# ======================================================== lifetime layer
+#
+# TPU019-022 fixtures. The resource grammar is comment-driven
+# (`# resource: <verb> <kind>`), so every fixture spells out its own
+# acquire/release/transfer protocol — nothing here depends on jax or
+# threading actually importing at lint time.
+
+POOL_PROTO = (
+    "class Pool:\n"
+    "    def grab(self):\n"
+    "        # resource: acquires pages\n"
+    "        return [1]\n"
+    "    def give(self, ids):\n"
+    "        # resource: releases pages\n"
+    "        pass\n"
+)
+
+
+# ---------------------------------------------------------------- TPU019
+
+
+def test_tpu019_exception_path_leak_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": POOL_PROTO + (
+                "    def use(self, work):\n"
+                "        ids = self.grab()\n"
+                "        work(ids)\n"
+                "        self.give(ids)\n"
+            )
+        },
+        rules=["TPU019"],
+    )
+    assert any(
+        f.symbol == "leak:Pool.use:pages:exc-exit" for f in out
+    ), keys(out)
+
+
+def test_tpu019_early_return_leak_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": POOL_PROTO + (
+                "    def early(self, flag):\n"
+                "        ids = self.grab()\n"
+                "        if flag:\n"
+                "            return None\n"
+                "        self.give(ids)\n"
+                "        return ids\n"
+            )
+        },
+        rules=["TPU019"],
+    )
+    assert any(
+        f.symbol == "leak:Pool.early:pages:return-exit" for f in out
+    ), keys(out)
+
+
+def test_tpu019_site_marker_acquire_positive(tmp_path):
+    # No contracts at all: the acquire/release are site markers on the
+    # statements themselves.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "def fetch(path, parse):\n"
+                "    fh = open(path)  # resource: acquires file-handle\n"
+                "    data = parse(fh)\n"
+                "    fh.close()  # resource: releases file-handle\n"
+                "    return data\n"
+            )
+        },
+        rules=["TPU019"],
+    )
+    assert any(
+        f.symbol == "leak:fetch:file-handle:exc-exit" for f in out
+    ), keys(out)
+
+
+def test_tpu019_pr11_submit_time_done_slot_leak(tmp_path):
+    """The PR 11 decode bug, verbatim shape: a bundle that is already
+    done at submit time returned early WITHOUT releasing the slot the
+    method had just claimed."""
+    proto = (
+        "class Decode:\n"
+        "    def alloc_slot(self):\n"
+        "        # resource: acquires slot\n"
+        "        return 0\n"
+        "    def release_slot(self, slot):\n"
+        "        # resource: releases slot\n"
+        "        pass\n"
+        "    def splice(self, slot, bundle):\n"
+        "        # resource: transfers slot\n"
+        "        pass\n"
+    )
+    buggy = proto + (
+        "    def submit(self, bundle):\n"
+        "        slot = self.alloc_slot()\n"
+        "        if bundle['done']:\n"
+        "            return {'tokens': bundle['tokens']}\n"
+        "        self.splice(slot, bundle)\n"
+        "        return slot\n"
+    )
+    out = run_fixture(tmp_path, {"mod.py": buggy}, rules=["TPU019"])
+    assert any(
+        f.symbol == "leak:Decode.submit:slot:return-exit" for f in out
+    ), keys(out)
+
+
+def test_tpu019_pr11_submit_fix_negative(tmp_path):
+    """The shipped fix for the submit-time-done leak lints clean: the
+    done-check precedes allocation and the splice handoff is guarded."""
+    fixed = (
+        "class Decode:\n"
+        "    def alloc_slot(self):\n"
+        "        # resource: acquires slot\n"
+        "        return 0\n"
+        "    def release_slot(self, slot):\n"
+        "        # resource: releases slot\n"
+        "        pass\n"
+        "    def splice(self, slot, bundle):\n"
+        "        # resource: transfers slot\n"
+        "        pass\n"
+        "    def submit(self, bundle):\n"
+        "        if bundle['done']:\n"
+        "            return {'tokens': bundle['tokens']}\n"
+        "        slot = self.alloc_slot()\n"
+        "        try:\n"
+        "            self.splice(slot, bundle)\n"
+        "        except BaseException:\n"
+        "            self.release_slot(slot)\n"
+        "            raise\n"
+        "        return slot\n"
+    )
+    out = run_fixture(tmp_path, {"mod.py": fixed}, rules=["TPU019"])
+    assert out == [], keys(out)
+
+
+def test_tpu019_pr11_queue_wait_timeout_inflight_leak(tmp_path):
+    """The PR 11 router bug, verbatim shape: the queue-wait stage
+    timing ran AFTER the admit granted a credit but BEFORE the
+    release-guaranteeing try — a raise there shrank the effective
+    inflight cap forever."""
+    proto = (
+        "class Router:\n"
+        "    def _admit(self, tenant, timeout):\n"
+        "        # resource: acquires inflight-credit\n"
+        "        return True\n"
+        "    def _release(self):\n"
+        "        # resource: releases inflight-credit\n"
+        "        pass\n"
+    )
+    buggy = proto + (
+        "    def generate(self, req, clock, stage):\n"
+        "        t0 = clock()\n"
+        "        if not self._admit(req['tenant'], 600.0):\n"
+        "            return 503\n"
+        "        stage('req_queue_wait', clock() - t0)\n"
+        "        try:\n"
+        "            return self.dispatch(req)\n"
+        "        finally:\n"
+        "            self._release()\n"
+    )
+    out = run_fixture(tmp_path, {"mod.py": buggy}, rules=["TPU019"])
+    assert any(
+        f.symbol == "leak:Router.generate:inflight-credit:exc-exit"
+        for f in out
+    ), keys(out)
+    # The refusal branch (admit returned False) acquires nothing: no
+    # return-path finding for the 503.
+    assert not any("return-exit" in f.symbol for f in out), keys(out)
+
+
+def test_tpu019_pr11_queue_wait_fix_negative(tmp_path):
+    """Moving the stage timing inside the try (the shipped fix) lints
+    clean."""
+    fixed = (
+        "class Router:\n"
+        "    def _admit(self, tenant, timeout):\n"
+        "        # resource: acquires inflight-credit\n"
+        "        return True\n"
+        "    def _release(self):\n"
+        "        # resource: releases inflight-credit\n"
+        "        pass\n"
+        "    def generate(self, req, clock, stage):\n"
+        "        t0 = clock()\n"
+        "        if not self._admit(req['tenant'], 600.0):\n"
+        "            return 503\n"
+        "        try:\n"
+        "            stage('req_queue_wait', clock() - t0)\n"
+        "            return self.dispatch(req)\n"
+        "        finally:\n"
+        "            self._release()\n"
+    )
+    out = run_fixture(tmp_path, {"mod.py": fixed}, rules=["TPU019"])
+    assert out == [], keys(out)
+
+
+def test_tpu019_try_finally_release_negative(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": POOL_PROTO + (
+                "    def used(self, work):\n"
+                "        ids = self.grab()\n"
+                "        try:\n"
+                "            work(ids)\n"
+                "        finally:\n"
+                "            self.give(ids)\n"
+            )
+        },
+        rules=["TPU019"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu019_with_managed_negative(tmp_path):
+    # An acquire marker on a with-header is auto-discharged by the
+    # context manager — no obligation opens.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "def scan(path, parse):\n"
+                "    with open(path) as fh:"
+                "  # resource: acquires file-handle\n"
+                "        return parse(fh)\n"
+            )
+        },
+        rules=["TPU019"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu019_site_transfer_negative(tmp_path):
+    # A statement-level transfer marker discharges on every edge: the
+    # registry now owns the pages.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": POOL_PROTO + (
+                "    def park(self, reg):\n"
+                "        ids = self.grab()\n"
+                "        reg['ids'] = ids  # resource: transfers pages\n"
+                "        return None\n"
+            )
+        },
+        rules=["TPU019"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu019_own_contract_return_handoff_negative(tmp_path):
+    # A function that itself declares `acquires pages` may RETURN
+    # holding them — that is the handoff to its caller.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": POOL_PROTO + (
+                "    def grab_wrap(self):\n"
+                "        # resource: acquires pages\n"
+                "        ids = self.grab()\n"
+                "        return ids\n"
+            )
+        },
+        rules=["TPU019"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu019_none_binder_branch_negative(tmp_path):
+    # Binder-aware branch filtering: on the `ids is None` edge the
+    # acquisition demonstrably failed, so the bare return is not a
+    # leak; the success path releases.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": POOL_PROTO + (
+                "    def maybe(self):\n"
+                "        ids = self.grab()\n"
+                "        if ids is None:\n"
+                "            return None\n"
+                "        self.give(ids)\n"
+                "        return True\n"
+            )
+        },
+        rules=["TPU019"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu019_suppressed(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": POOL_PROTO + (
+                "    def use(self, work):\n"
+                "        ids = self.grab()"
+                "  # tpulint: disable=TPU019\n"
+                "        work(ids)\n"
+                "        self.give(ids)\n"
+            )
+        },
+        rules=["TPU019"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu019_class_local_contract_resolution(tmp_path):
+    """A method named like another class's contracted method must NOT
+    inherit that contract: Sched._admit acquires nothing even though
+    Router._admit does (the serve.py scheduler/router collision)."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "class Router:\n"
+                "    def _admit(self):\n"
+                "        # resource: acquires inflight-credit\n"
+                "        return True\n"
+                "    def _release(self):\n"
+                "        # resource: releases inflight-credit\n"
+                "        pass\n"
+                "class Sched:\n"
+                "    def _admit(self):\n"
+                "        return True\n"
+                "    def loop(self, work):\n"
+                "        if self._admit():\n"
+                "            work()\n"
+            )
+        },
+        rules=["TPU019"],
+    )
+    assert out == [], keys(out)
+
+
+# ---------------------------------------------------------------- TPU020
+
+CV_PROTO = (
+    "import threading\n"
+    "class Q:\n"
+    "    def __init__(self):\n"
+    "        self._cv = threading.Condition()\n"
+    "        self.ready = False\n"
+)
+
+
+def test_tpu020_wait_without_while_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": CV_PROTO + (
+                "    def bad(self):\n"
+                "        with self._cv:\n"
+                "            if not self.ready:\n"
+                "                self._cv.wait()\n"
+            )
+        },
+        rules=["TPU020"],
+    )
+    assert any(
+        f.symbol == "wait-no-while:Q.bad:_cv" for f in out
+    ), keys(out)
+
+
+def test_tpu020_notify_outside_lock_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": CV_PROTO + (
+                "    def kick(self):\n"
+                "        self._cv.notify_all()\n"
+            )
+        },
+        rules=["TPU020"],
+    )
+    assert any(
+        f.symbol == "notify-unlocked:Q.kick:_cv" for f in out
+    ), keys(out)
+
+
+def test_tpu020_predicate_write_no_notify_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": CV_PROTO + (
+                "    def waiter(self):\n"
+                "        with self._cv:\n"
+                "            while not self.ready:\n"
+                "                self._cv.wait()\n"
+                "    def silent(self):\n"
+                "        with self._cv:\n"
+                "            self.ready = True\n"
+            )
+        },
+        rules=["TPU020"],
+    )
+    hit = [
+        f for f in out
+        if f.symbol == "predicate-no-notify:Q.silent:ready"
+    ]
+    assert hit, keys(out)
+    assert hit[0].severity == "warning"
+
+
+def test_tpu020_while_wrapped_wait_negative(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": CV_PROTO + (
+                "    def waiter(self):\n"
+                "        with self._cv:\n"
+                "            while not self.ready:\n"
+                "                self._cv.wait()\n"
+                "    def wake(self):\n"
+                "        with self._cv:\n"
+                "            self.ready = True\n"
+                "            self._cv.notify_all()\n"
+            )
+        },
+        rules=["TPU020"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu020_locked_helper_negative(tmp_path):
+    # `*_locked` naming means the caller holds the monitor — same
+    # house convention TPU009 honors.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": CV_PROTO + (
+                "    def kick_locked(self):\n"
+                "        self._cv.notify_all()\n"
+            )
+        },
+        rules=["TPU020"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu020_write_then_notify_via_helper_negative(tmp_path):
+    # The notify may live one self-call hop away from the write.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": CV_PROTO + (
+                "    def waiter(self):\n"
+                "        with self._cv:\n"
+                "            while not self.ready:\n"
+                "                self._cv.wait()\n"
+                "    def _wake_locked(self):\n"
+                "        self._cv.notify_all()\n"
+                "    def flip(self):\n"
+                "        with self._cv:\n"
+                "            self.ready = True\n"
+                "            self._wake_locked()\n"
+            )
+        },
+        rules=["TPU020"],
+    )
+    assert out == [], keys(out)
+
+
+# ---------------------------------------------------------------- TPU021
+
+
+def test_tpu021_never_decremented_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "class G:\n"
+                "    def __init__(self):\n"
+                "        self.n = 0  # resource: counter jobs\n"
+                "    def bump(self):\n"
+                "        self.n += 1\n"
+            )
+        },
+        rules=["TPU021"],
+    )
+    assert any(f.symbol == "never-dec:G:n" for f in out), keys(out)
+
+
+def test_tpu021_unbalanced_exception_path_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "class H:\n"
+                "    def __init__(self):\n"
+                "        self.n = 0  # resource: counter jobs\n"
+                "    def run(self, work):\n"
+                "        self.n += 1\n"
+                "        work()\n"
+                "        self.n -= 1\n"
+            )
+        },
+        rules=["TPU021"],
+    )
+    assert any(
+        f.symbol == "unbalanced:H.run:n" for f in out
+    ), keys(out)
+
+
+def test_tpu021_finally_order_positive(tmp_path):
+    """Regression pin for the _prefill_chunked fix: a raise-capable
+    call sitting BEFORE the decrement inside the finally still skips
+    it — order inside the finally matters."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "class K:\n"
+                "    def __init__(self, reg):\n"
+                "        self.n = 0  # resource: counter jobs\n"
+                "        self.reg = reg\n"
+                "    def run(self, work):\n"
+                "        self.n += 1\n"
+                "        try:\n"
+                "            work()\n"
+                "        finally:\n"
+                "            self.reg.remove(work)\n"
+                "            self.n -= 1\n"
+            )
+        },
+        rules=["TPU021"],
+    )
+    assert any(
+        f.symbol == "unbalanced:K.run:n" for f in out
+    ), keys(out)
+
+
+def test_tpu021_finally_balanced_negative(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "class H:\n"
+                "    def __init__(self):\n"
+                "        self.n = 0  # resource: counter jobs\n"
+                "    def run(self, work):\n"
+                "        self.n += 1\n"
+                "        try:\n"
+                "            work()\n"
+                "        finally:\n"
+                "            self.n -= 1\n"
+            )
+        },
+        rules=["TPU021"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu021_cross_method_pair_negative(tmp_path):
+    # inc in one method, dec in another: an explicit start/finish
+    # protocol, not an imbalance.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "class M:\n"
+                "    def __init__(self):\n"
+                "        self.n = 0  # resource: counter jobs\n"
+                "    def start(self):\n"
+                "        self.n += 1\n"
+                "    def finish(self):\n"
+                "        self.n -= 1\n"
+            )
+        },
+        rules=["TPU021"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu021_unmarked_counter_silent(tmp_path):
+    # Only `# resource: counter` gauges participate: plain attributes
+    # never fire, marked or balanced or not.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "class P:\n"
+                "    def __init__(self):\n"
+                "        self.n = 0\n"
+                "    def bump(self):\n"
+                "        self.n += 1\n"
+            )
+        },
+        rules=["TPU021"],
+    )
+    assert out == [], keys(out)
+
+
+# ---------------------------------------------------------------- TPU022
+
+
+def test_tpu022_read_in_window_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "def step(fn, x):\n"
+                "    out = fn(x)  # resource: donates x\n"
+                "    norm = x.sum()\n"
+                "    return out, norm\n"
+            )
+        },
+        rules=["TPU022"],
+    )
+    assert any(
+        f.symbol == "donation-window:step:x" for f in out
+    ), keys(out)
+
+
+def test_tpu022_self_attr_read_before_rebind_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "class S:\n"
+                "    def tick(self, fn):\n"
+                "        out = fn(self.cache)"
+                "  # resource: donates self.cache\n"
+                "        y = self.cache + 1\n"
+                "        self.cache = out\n"
+                "        return y\n"
+            )
+        },
+        rules=["TPU022"],
+    )
+    assert any(
+        f.symbol == "donation-window:S.tick:self.cache" for f in out
+    ), keys(out)
+
+
+def test_tpu022_branch_read_positive(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "def run(fn, x, flag):\n"
+                "    out = fn(x)  # resource: donates x\n"
+                "    if flag:\n"
+                "        return x\n"
+                "    return out\n"
+            )
+        },
+        rules=["TPU022"],
+    )
+    assert any(
+        f.symbol == "donation-window:run:x" for f in out
+    ), keys(out)
+
+
+def test_tpu022_read_after_block_until_ready_negative(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "def ok(fn, x):\n"
+                "    out = fn(x)  # resource: donates x\n"
+                "    out.block_until_ready()\n"
+                "    return x + out\n"
+            )
+        },
+        rules=["TPU022"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu022_rebound_by_dispatch_negative(tmp_path):
+    # The dispatch's own assignment replaces the donated name: there
+    # is no window at all.
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "def ok(fn, x):\n"
+                "    x = fn(x)  # resource: donates x\n"
+                "    return x\n"
+            )
+        },
+        rules=["TPU022"],
+    )
+    assert out == [], keys(out)
+
+
+def test_tpu022_rebind_closes_window_negative(tmp_path):
+    out = run_fixture(
+        tmp_path,
+        {
+            "mod.py": (
+                "def ok(fn, x):\n"
+                "    out = fn(x)  # resource: donates x\n"
+                "    x = out\n"
+                "    return x\n"
+            )
+        },
+        rules=["TPU022"],
+    )
+    assert out == [], keys(out)
+
+
+# ----------------------------------------- lifetime regression pins
+
+
+def test_tpu019_regression_ctor_guard(tmp_path):
+    """SeriesStore-shape: __init__ both declares the contract (the
+    constructed object hands the handle to its caller) and must not
+    leak it when post-open repair work raises."""
+    buggy = (
+        "class Store:\n"
+        "    def __init__(self, path, repair):\n"
+        "        # resource: acquires file-handle\n"
+        "        self._f = open(path)"
+        "  # resource: acquires file-handle\n"
+        "        repair(self._f)\n"
+        "    def close(self):\n"
+        "        # resource: releases file-handle\n"
+        "        pass\n"
+    )
+    out = run_fixture(tmp_path, {"mod.py": buggy}, rules=["TPU019"])
+    assert any(
+        f.symbol == "leak:Store.__init__:file-handle:exc-exit"
+        for f in out
+    ), keys(out)
+    fixed = (
+        "class Store:\n"
+        "    def __init__(self, path, repair):\n"
+        "        # resource: acquires file-handle\n"
+        "        self._f = open(path)"
+        "  # resource: acquires file-handle\n"
+        "        try:\n"
+        "            repair(self._f)\n"
+        "        except BaseException:\n"
+        "            self._f.close()\n"
+        "            raise\n"
+        "    def close(self):\n"
+        "        # resource: releases file-handle\n"
+        "        pass\n"
+    )
+    out2 = run_fixture(
+        tmp_path / "fixed", {"mod.py": fixed}, rules=["TPU019"]
+    )
+    assert out2 == [], keys(out2)
+
+
+def test_tpu019_regression_insert_flips_ownership(tmp_path):
+    """roles.py prefill-shape: before the insert the frame owns the
+    pages; after it the transient slot does. The error handler must
+    release whichever is held — and the straight-line version without
+    the guard is the bug TPU019 pins."""
+    proto = (
+        "class Eng:\n"
+        "    def acquire_pages(self, n):\n"
+        "        # resource: acquires pages\n"
+        "        return list(range(n))\n"
+        "    def release_pages(self, ids):\n"
+        "        # resource: releases pages\n"
+        "        pass\n"
+        "    def insert(self, ids):\n"
+        "        # resource: transfers pages\n"
+        "        return 0\n"
+        "    def release_slot(self, slot):\n"
+        "        # resource: releases slot\n"
+        "        pass\n"
+    )
+    buggy = proto + (
+        "    def prefill(self, prompt, compute, export):\n"
+        "        ids = self.acquire_pages(len(prompt))\n"
+        "        compute(prompt)\n"
+        "        slot = self.insert(ids)\n"
+        "        wire = export(slot)\n"
+        "        self.release_slot(slot)\n"
+        "        return wire\n"
+    )
+    out = run_fixture(tmp_path, {"mod.py": buggy}, rules=["TPU019"])
+    assert any(
+        f.symbol == "leak:Eng.prefill:pages:exc-exit" for f in out
+    ), keys(out)
+    fixed = proto + (
+        "    def prefill(self, prompt, compute, export):\n"
+        "        ids = self.acquire_pages(len(prompt))\n"
+        "        try:\n"
+        "            compute(prompt)\n"
+        "            slot = self.insert(ids)\n"
+        "            wire = export(slot)\n"
+        "        except BaseException:\n"
+        "            self.release_pages(ids)\n"
+        "            raise\n"
+        "        self.release_slot(slot)\n"
+        "        return wire\n"
+    )
+    out2 = run_fixture(
+        tmp_path / "fixed", {"mod.py": fixed}, rules=["TPU019"]
+    )
+    assert out2 == [], keys(out2)
+
+
+# ----------------------------------------------- lifetime layer plumbing
+
+
+def test_live_tree_lifetime_layer_clean():
+    """The lifetime layer on its own must exit clean on the repo — the
+    gate the lifetime-lint CI job enforces, with an EMPTY baseline:
+    every live finding was fixed or carries an inline justification."""
+    paths = [
+        os.path.join(ROOT, p)
+        for p in ("tpufw", "scripts", "bench.py")
+        if os.path.exists(os.path.join(ROOT, p))
+    ]
+    findings = run_analysis(paths, root=ROOT, layer="lifetime")
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_lifetime_layer_selected_rules_only(tmp_path):
+    """layer='lifetime' runs TPU019-022 and nothing below; the python
+    layer conversely never fires them."""
+    files = {
+        "mod.py": (
+            "import jax\n"
+            "def f(key, shape):\n"
+            "    a = jax.random.normal(key, shape)\n"
+            "    return a + jax.random.normal(key, shape)\n"
+            "def grab():\n"
+            "    # resource: acquires pages\n"
+            "    return [1]\n"
+            "def use(work):\n"
+            "    ids = grab()\n"
+            "    work(ids)\n"
+            "    return None\n"
+        ),
+    }
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.write_text(text)
+    py = run_analysis(
+        [str(tmp_path)], root=str(tmp_path), layer="python"
+    )
+    lt = run_analysis(
+        [str(tmp_path)], root=str(tmp_path), layer="lifetime"
+    )
+    assert {f.rule for f in py} == {"TPU003"}, keys(py)
+    assert {f.rule for f in lt} == {"TPU019"}, keys(lt)
+
+
+def test_cli_list_rules_groups_by_layer(capsys):
+    from tpufw.analysis.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "layer lifetime:" in out
+    block = out.split("layer lifetime:")[1].split("layer ")[0]
+    for rule in ("TPU019", "TPU020", "TPU021", "TPU022"):
+        assert rule in block, out
+    # And the grouping is real: TPU001 lives under python, not lifetime.
+    assert "TPU001" not in block, out
+
+
+def test_cli_json_layer_field(tmp_path, capsys):
+    from tpufw.analysis.__main__ import main
+
+    (tmp_path / "pyproject.toml").write_text("[project]\nname='x'\n")
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "def grab():\n"
+        "    # resource: acquires pages\n"
+        "    return [1]\n"
+        "def use(work):\n"
+        "    ids = grab()\n"
+        "    work(ids)\n"
+        "    return None\n"
+    )
+    rc = main(
+        [str(mod), "--json", "--no-baseline", "--layer", "lifetime"]
+    )
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    layers = {f["rule"]: f["layer"] for f in doc["findings"]}
+    assert layers == {"TPU019": "lifetime"}, doc["findings"]

@@ -13,12 +13,13 @@ whole-scan replay cache (see :mod:`tpufw.analysis.incremental`), and
 ``--since <ref>`` gates the exit code on findings in files changed
 since ``ref`` — the pre-commit fast path.
 
-``--layer {python,deploy,protocol,all}`` (default ``all``) selects
-the scan set: ``python`` is the stdlib-only ast rules (TPU001-009),
-``deploy`` parses ``deploy/`` and runs the cross-layer rules
-(TPU010-014, requires pyyaml), ``protocol`` runs the
+``--layer {python,deploy,protocol,lifetime,all}`` (default ``all``)
+selects the scan set: ``python`` is the stdlib-only ast rules
+(TPU001-009), ``deploy`` parses ``deploy/`` and runs the cross-layer
+rules (TPU010-014, requires pyyaml), ``protocol`` runs the
 distributed-protocol rules (TPU015-018) over the python scan set,
-``all`` runs everything — degrading past the deploy half with a
+``lifetime`` runs the resource-lifetime/concurrency-liveness rules
+(TPU019-022) over the same set, ``all`` runs everything — degrading past the deploy half with a
 stderr notice when pyyaml is missing. When ``--layer`` is not given,
 ``TPUFW_LINT_LAYERS`` (a comma list, e.g. ``python,protocol``) picks
 the default instead — findings from the listed layers are merged and
@@ -70,10 +71,11 @@ def main(argv: List[str] | None = None) -> int:
         help=(
             "scan layer: python = ast rules over .py files, deploy = "
             "TPU010-014 over deploy/ (needs pyyaml), protocol = "
-            "TPU015-018 wire/SPMD contracts over .py files, all = "
-            "everything (default; deploy half skipped with a notice "
-            "if pyyaml is missing). Unset, TPUFW_LINT_LAYERS (comma "
-            "list) picks the default"
+            "TPU015-018 wire/SPMD contracts over .py files, lifetime "
+            "= TPU019-022 resource-lifetime/liveness rules over .py "
+            "files, all = everything (default; deploy half skipped "
+            "with a notice if pyyaml is missing). Unset, "
+            "TPUFW_LINT_LAYERS (comma list) picks the default"
         ),
     )
     ap.add_argument(
@@ -132,8 +134,18 @@ def main(argv: List[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     if args.list_rules:
-        for c in core.all_checkers():
-            print(f"{c.rule}  {c.name}  [{c.severity}]  layer={c.layer}")
+        checkers = core.all_checkers()
+        by_layer: dict = {}
+        for c in checkers:
+            by_layer.setdefault(c.layer, []).append(c)
+        # Present layers in the canonical LAYERS order so the output
+        # is stable for tooling that diffs it.
+        order = [l for l in core.LAYERS if l in by_layer]
+        order += [l for l in by_layer if l not in order]
+        for layer in order:
+            print(f"layer {layer}:")
+            for c in by_layer[layer]:
+                print(f"  {c.rule}  {c.name}  [{c.severity}]")
         return 0
 
     root = core.find_repo_root(args.paths[0] if args.paths else ".")
@@ -262,11 +274,20 @@ def main(argv: List[str] | None = None) -> int:
         sarif.write_sarif(args.sarif, new)
 
     if args.json:
+        # Tooling partitions results by layer without re-parsing rule
+        # IDs; TPU000 parse errors belong to every layer -> "core".
+        layer_of = {c.rule: c.layer for c in core.all_checkers()}
+
+        def as_dict(f):
+            d = f.as_dict()
+            d["layer"] = layer_of.get(f.rule, "core")
+            return d
+
         print(
             json.dumps(
                 {
-                    "findings": [f.as_dict() for f in new],
-                    "baselined": [f.as_dict() for f in old],
+                    "findings": [as_dict(f) for f in new],
+                    "baselined": [as_dict(f) for f in old],
                     "stale_baseline_keys": sorted(stale),
                 },
                 indent=2,

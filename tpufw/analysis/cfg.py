@@ -1,0 +1,385 @@
+"""Intra-function control-flow graphs for the lifetime layer.
+
+Every path-sensitive rule in :mod:`tpufw.analysis.lifetime` (acquire/
+release pairing, counter balance, donation windows) asks the same
+question: *can execution reach a function exit while still holding
+something?* Answering it needs more than the lexical ancestor walks
+the earlier layers get away with — it needs explicit edges for the
+ways Python leaves a region early:
+
+- ``return`` / ``raise`` / ``break`` / ``continue`` statements;
+- the *implicit* exception edge out of any statement that can raise
+  (a call, an ``assert``, an ``await``) into the innermost matching
+  handler — or clean out of the function;
+- ``finally`` blocks, which every in-``try`` exit must traverse.
+
+The graph is statement-granular: one node per ``ast.stmt`` occurrence
+(compound statements contribute a *header* node for their test /
+items, then recurse). ``finally`` bodies are **duplicated per
+continuation** (fall-through, return, exception, break, continue), the
+textbook trick that keeps a return path from "leaking" into the
+after-``try`` code of some other path. Rules attach meaning to nodes
+(resource events) and run a worklist dataflow over the edges; this
+module knows nothing about resources.
+
+Deliberate imprecision, documented so the rules can document it:
+
+- "may raise" is syntactic: a statement raises iff it contains a
+  ``Call``, ``Await``, ``Raise``, or ``Assert``. Attribute access,
+  subscripts, and arithmetic are treated as non-raising — flagging
+  every ``KeyError``-shaped edge would drown the true positives.
+- Every handler of a ``try`` is a possible target of every raising
+  statement in its body (no type matching); the exception *escapes*
+  the ``try`` too unless some handler is catch-all (bare ``except``,
+  ``except BaseException``, or ``except Exception``).
+- ``with`` blocks get no special exception semantics (a suppressing
+  ``__exit__`` is invisible); the *lifetime* layer handles
+  ``with``-managed acquisition at the event level instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Edge kinds. "true"/"false" are the two arms of a test-bearing header
+# (If/While — the lifetime layer refines obligations along them);
+# "exc" carries an in-flight exception; everything else is "next".
+EDGE_NEXT = "next"
+EDGE_TRUE = "true"
+EDGE_FALSE = "false"
+EDGE_EXC = "exc"
+
+# Node kinds (``Node.kind``).
+N_ENTRY = "entry"
+N_STMT = "stmt"
+N_RETURN_EXIT = "return-exit"  # normal completion (return / fall-off)
+N_EXC_EXIT = "exc-exit"  # exception escapes the function
+
+
+@dataclasses.dataclass
+class Node:
+    id: int
+    kind: str
+    stmt: Optional[ast.stmt] = None  # None for entry/exit nodes
+
+    @property
+    def line(self) -> int:
+        return getattr(self.stmt, "lineno", 0) if self.stmt else 0
+
+
+class CFG:
+    """One function's control-flow graph."""
+
+    def __init__(self) -> None:
+        self.nodes: List[Node] = []
+        self.succs: Dict[int, List[Tuple[int, str]]] = {}
+        self.entry = self._new(N_ENTRY)
+        self.exit_return = self._new(N_RETURN_EXIT)
+        self.exit_exc = self._new(N_EXC_EXIT)
+
+    def _new(self, kind: str, stmt: Optional[ast.stmt] = None) -> int:
+        n = Node(len(self.nodes), kind, stmt)
+        self.nodes.append(n)
+        self.succs[n.id] = []
+        return n.id
+
+    def edge(self, a: int, b: int, kind: str = EDGE_NEXT) -> None:
+        if (b, kind) not in self.succs[a]:
+            self.succs[a].append((b, kind))
+
+    def node(self, i: int) -> Node:
+        return self.nodes[i]
+
+    def preds_of_exit(self, exit_id: int) -> List[Tuple[int, str]]:
+        """(node, edge kind) pairs flowing into ``exit_id``."""
+        out = []
+        for a, succs in self.succs.items():
+            for b, kind in succs:
+                if b == exit_id:
+                    out.append((a, kind))
+        return out
+
+
+# Builtins that raise only on type-confused arguments — treating
+# them as raise sites would make every statement between an acquire
+# and its release a phantom leak path, drowning the signal the
+# lifetime layer exists for.
+_NO_RAISE_BUILTINS = frozenset({
+    "len", "int", "float", "bool", "str", "repr", "abs", "min", "max",
+    "list", "tuple", "dict", "set", "frozenset", "sorted", "enumerate",
+    "zip", "range", "isinstance", "issubclass", "id", "getattr",
+    "hasattr", "callable", "print",
+})
+
+
+def may_raise(node: ast.AST) -> bool:
+    """Syntactic may-raise: contains a call-shaped or raise-shaped
+    expression (minus the benign-builtin whitelist above). Nested
+    function/class bodies don't execute here and are excluded (their
+    *decorators* still count via the header)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Await, ast.Raise, ast.Assert)):
+            return True
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if (
+                isinstance(f, ast.Name)
+                and f.id in _NO_RAISE_BUILTINS
+            ):
+                continue
+            return True
+    return False
+
+
+def _is_catch_all(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Attribute):
+        names = [t.attr]
+    elif isinstance(t, ast.Tuple):
+        for el in t.elts:
+            if isinstance(el, ast.Name):
+                names.append(el.id)
+            elif isinstance(el, ast.Attribute):
+                names.append(el.attr)
+    return any(n in ("BaseException", "Exception") for n in names)
+
+
+class _Ctx:
+    """Continuation targets visible to the statement being built.
+    ``finally`` wrapping replaces each with its finally-copy."""
+
+    __slots__ = ("ret_to", "exc_to", "break_to", "continue_to")
+
+    def __init__(self, ret_to, exc_to, break_to=None, continue_to=None):
+        self.ret_to = ret_to
+        self.exc_to = exc_to
+        self.break_to = break_to
+        self.continue_to = continue_to
+
+    def clone(self, **kw) -> "_Ctx":
+        c = _Ctx(self.ret_to, self.exc_to, self.break_to,
+                 self.continue_to)
+        for k, v in kw.items():
+            setattr(c, k, v)
+        return c
+
+
+class _Builder:
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.cfg = CFG()
+
+    def build(self) -> CFG:
+        cfg = self.cfg
+        ctx = _Ctx(ret_to=cfg.exit_return, exc_to=cfg.exit_exc)
+        first = self._seq(self.fn.body, cfg.exit_return, ctx)
+        cfg.edge(cfg.entry, first)
+        return cfg
+
+    # -- sequencing --------------------------------------------------
+
+    def _seq(
+        self, stmts: Sequence[ast.stmt], after: int, ctx: _Ctx
+    ) -> int:
+        """Wire ``stmts`` so the sequence falls through to ``after``;
+        returns the entry node of the first statement."""
+        entry = after
+        for stmt in reversed(stmts):
+            entry = self._stmt(stmt, entry, ctx)
+        return entry
+
+    def _stmt(self, stmt: ast.stmt, after: int, ctx: _Ctx) -> int:
+        cfg = self.cfg
+        if isinstance(stmt, ast.Return):
+            n = cfg._new(N_STMT, stmt)
+            cfg.edge(n, ctx.ret_to)
+            if stmt.value is not None and may_raise(stmt.value):
+                cfg.edge(n, ctx.exc_to, EDGE_EXC)
+            return n
+        if isinstance(stmt, ast.Raise):
+            n = cfg._new(N_STMT, stmt)
+            cfg.edge(n, ctx.exc_to, EDGE_EXC)
+            return n
+        if isinstance(stmt, ast.Break):
+            n = cfg._new(N_STMT, stmt)
+            cfg.edge(n, ctx.break_to if ctx.break_to is not None
+                     else after)
+            return n
+        if isinstance(stmt, ast.Continue):
+            n = cfg._new(N_STMT, stmt)
+            cfg.edge(n, ctx.continue_to if ctx.continue_to is not None
+                     else after)
+            return n
+        if isinstance(stmt, ast.If):
+            n = cfg._new(N_STMT, stmt)
+            body = self._seq(stmt.body, after, ctx)
+            cfg.edge(n, body, EDGE_TRUE)
+            if stmt.orelse:
+                orelse = self._seq(stmt.orelse, after, ctx)
+                cfg.edge(n, orelse, EDGE_FALSE)
+            else:
+                cfg.edge(n, after, EDGE_FALSE)
+            if may_raise(stmt.test):
+                cfg.edge(n, ctx.exc_to, EDGE_EXC)
+            return n
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, after, ctx)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, after, ctx)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            n = cfg._new(N_STMT, stmt)
+            body = self._seq(stmt.body, after, ctx)
+            cfg.edge(n, body)
+            if any(may_raise(item.context_expr) for item in stmt.items):
+                cfg.edge(n, ctx.exc_to, EDGE_EXC)
+            return n
+        if isinstance(stmt, ast.Match):
+            n = cfg._new(N_STMT, stmt)
+            fell = False
+            for case in stmt.cases:
+                body = self._seq(case.body, after, ctx)
+                cfg.edge(n, body, EDGE_TRUE)
+                if (isinstance(case.pattern, ast.MatchAs)
+                        and case.pattern.pattern is None
+                        and case.guard is None):
+                    fell = True  # wildcard arm: some case always runs
+            if not fell:
+                cfg.edge(n, after, EDGE_FALSE)
+            if may_raise(stmt.subject):
+                cfg.edge(n, ctx.exc_to, EDGE_EXC)
+            return n
+        # Simple statement (assign, expr, assert, import, ...).
+        n = cfg._new(N_STMT, stmt)
+        cfg.edge(n, after)
+        if may_raise(stmt):
+            cfg.edge(n, ctx.exc_to, EDGE_EXC)
+        return n
+
+    def _loop(self, stmt: ast.stmt, after: int, ctx: _Ctx) -> int:
+        cfg = self.cfg
+        header = cfg._new(N_STMT, stmt)
+        loop_ctx = ctx.clone(break_to=after, continue_to=header)
+        body = self._seq(stmt.body, header, loop_ctx)
+        if isinstance(stmt, ast.While):
+            cfg.edge(header, body, EDGE_TRUE)
+            test = stmt.test
+            infinite = (
+                isinstance(test, ast.Constant) and bool(test.value)
+            )
+            if not infinite:
+                exit_to = (
+                    self._seq(stmt.orelse, after, ctx)
+                    if stmt.orelse else after
+                )
+                cfg.edge(header, exit_to, EDGE_FALSE)
+            if may_raise(test):
+                cfg.edge(header, ctx.exc_to, EDGE_EXC)
+        else:  # For / AsyncFor: iteration may end any time
+            cfg.edge(header, body, EDGE_TRUE)
+            exit_to = (
+                self._seq(stmt.orelse, after, ctx)
+                if stmt.orelse else after
+            )
+            cfg.edge(header, exit_to, EDGE_FALSE)
+            if may_raise(stmt.iter):
+                cfg.edge(header, ctx.exc_to, EDGE_EXC)
+        return header
+
+    def _try(self, stmt: ast.Try, after: int, ctx: _Ctx) -> int:
+        cfg = self.cfg
+
+        # finally duplication: each continuation target T reachable
+        # from inside the try is replaced by a fresh copy of the
+        # finally body whose tail falls through to T.
+        if stmt.finalbody:
+            copies: Dict[int, int] = {}
+
+            def through_finally(target: int) -> int:
+                if target not in copies:
+                    copies[target] = self._seq(
+                        stmt.finalbody, target, ctx
+                    )
+                return copies[target]
+        else:
+            def through_finally(target: int) -> int:
+                return target
+
+        after_f = through_finally(after)
+        inner = ctx.clone(
+            ret_to=through_finally(ctx.ret_to),
+            exc_to=through_finally(ctx.exc_to),
+        )
+        if ctx.break_to is not None:
+            inner.break_to = through_finally(ctx.break_to)
+        if ctx.continue_to is not None:
+            inner.continue_to = through_finally(ctx.continue_to)
+
+        # Handlers run with the outer continuations (their own raises
+        # propagate out through the finally).
+        handler_entries: List[int] = []
+        catch_all = False
+        for h in stmt.handlers:
+            handler_entries.append(self._seq(h.body, after_f, inner))
+            catch_all = catch_all or _is_catch_all(h)
+
+        # Exceptions in the body dispatch to every handler — and
+        # escape too, unless some handler is catch-all.
+        if stmt.handlers:
+            dispatch = cfg._new(N_STMT, stmt)
+            for he in handler_entries:
+                cfg.edge(dispatch, he)
+            if not catch_all:
+                cfg.edge(dispatch, inner.exc_to, EDGE_EXC)
+            body_exc = dispatch
+        else:
+            body_exc = inner.exc_to
+
+        body_ctx = inner.clone(exc_to=body_exc)
+        # else: runs after the body completes; its exceptions skip the
+        # handlers.
+        else_entry = (
+            self._seq(stmt.orelse, after_f, inner)
+            if stmt.orelse else after_f
+        )
+        return self._seq(stmt.body, else_entry, body_ctx)
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for one FunctionDef/AsyncFunctionDef."""
+    return _Builder(fn).build()
+
+
+def reachable_between(
+    cfg: CFG,
+    start: int,
+    stop_nodes,
+    include_exc: bool = True,
+):
+    """Node ids reachable from ``start`` (exclusive) without passing
+    *through* any node in ``stop_nodes`` (stop nodes themselves are
+    not expanded, but ARE yielded when first reached — the caller
+    decides whether a stop node also counts as inside the window).
+    Used by the donation-window rule."""
+    seen = set()
+    work = [
+        b for b, kind in cfg.succs[start]
+        if include_exc or kind != EDGE_EXC
+    ]
+    while work:
+        n = work.pop()
+        if n in seen:
+            continue
+        seen.add(n)
+        if n in stop_nodes:
+            continue
+        for b, kind in cfg.succs[n]:
+            if include_exc or kind != EDGE_EXC:
+                work.append(b)
+    return seen

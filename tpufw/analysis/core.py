@@ -36,7 +36,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
 
 SEVERITIES = ("error", "warning", "info")
 
-LAYERS = ("python", "deploy", "protocol", "all")
+LAYERS = ("python", "deploy", "protocol", "lifetime", "all")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*tpulint:\s*(disable|disable-file)\s*=\s*"
@@ -403,7 +403,7 @@ def collect_files(paths: Sequence[str], root: str) -> List[SourceFile]:
 
 
 def all_checkers() -> List[Checker]:
-    """The shipped rule set, TPU001..TPU018 (import here, not at
+    """The shipped rule set, TPU001..TPU022 (import here, not at
     module top, so core stays importable from checker modules)."""
     from tpufw.analysis.deploy import (
         BootstrapWiringChecker,
@@ -416,6 +416,12 @@ def all_checkers() -> List[Checker]:
     from tpufw.analysis.dtypes import DtypeDriftChecker
     from tpufw.analysis.envreg import EnvRegistryChecker
     from tpufw.analysis.hotloop import HotLoopPurityChecker
+    from tpufw.analysis.lifetime import (
+        ConditionDisciplineChecker,
+        CounterBalanceChecker,
+        DonationWindowChecker,
+        ResourceLifetimeChecker,
+    )
     from tpufw.analysis.locks import LockDisciplineChecker
     from tpufw.analysis.meshaxes import MeshAxisChecker
     from tpufw.analysis.obsnames import ObsNameChecker
@@ -447,6 +453,10 @@ def all_checkers() -> List[Checker]:
         SpmdDivergenceChecker(),
         HttpSurfaceChecker(),
         MetricLabelChecker(),
+        ResourceLifetimeChecker(),
+        ConditionDisciplineChecker(),
+        CounterBalanceChecker(),
+        DonationWindowChecker(),
     ]
 
 
@@ -466,7 +476,9 @@ def run_analysis(
     the single-process ast rules, "deploy" parses ``deploy/`` under
     the root and runs TPU010-014, "protocol" parses ``paths`` and runs
     the distributed-protocol rules TPU015-018 (same python scan set,
-    no manifests), "all" (default) does everything. The deploy layer
+    no manifests), "lifetime" runs the resource-lifetime and
+    concurrency-liveness rules TPU019-022 over the python scan set,
+    "all" (default) does everything. The deploy layer
     degrades to nothing (with no error) when pyyaml is absent and
     layer="all"; requesting layer="deploy" without pyyaml raises
     ValueError.

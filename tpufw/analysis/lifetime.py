@@ -1,0 +1,1129 @@
+"""tpulint layer 5 — resource-lifetime & concurrency-liveness rules
+(TPU019-TPU022).
+
+The most expensive bugs this repo has shipped were lifetime bugs found
+only in review: the decode slot leaked for submit-time-done bundles,
+and the router inflight credit leaked when a queue wait timed out.
+Every serving subsystem re-implements the same acquire/release
+protocol (allocator pages, pool slots, inflight credits, chunked-
+prefill tickets, series-store file handles); this layer lets the code
+*declare* the protocol once and then proves, over a real control-flow
+graph (:mod:`tpufw.analysis.cfg`), that no path — raise, early
+return, ``except``-swallowed — exits still holding something.
+
+Marker grammar (``# resource:`` comments)::
+
+    # resource: acquires <kind>      # trailing -> this statement acquires
+    # resource: releases <kind>      # trailing -> this statement releases
+    # resource: transfers <kind>     # trailing -> ownership handed off here
+    # resource: counter <kind>       # trailing on a gauge's init assignment
+    # resource: donates <name>[, ..] # trailing on a donated jit dispatch
+
+A marker *alone on its line inside a function* is that function's
+**contract** instead of a statement event: callers of a function whose
+contract says ``acquires pages`` pick up a pages obligation at the
+call site, ``releases``/``transfers`` contracts discharge one — the
+one-hop callgraph follow that lets ``export_slot -> wire ->
+splice_slot`` check end to end without whole-program analysis.
+Contract calls are resolved by the callee's *simple name* (the
+terminal attribute), so ``self.pool.allocator.release(ids)`` matches
+``PageAllocator.release``; for ``__init__`` contracts the class name
+is registered too (``SeriesStore(path)`` acquires the file handle).
+
+TPU019  acquire/release pairing. Path-sensitive obligation dataflow:
+        an acquire adds an obligation (on the *normal* out-edge only —
+        a raising acquire acquired nothing), releases discharge on
+        every edge, statement-level transfers discharge on every edge,
+        contract transfers only on the normal edge (a raising callee
+        transferred nothing). ``with``-managed acquisitions are
+        auto-discharged; ``try/finally`` releases cover every exit by
+        CFG construction. An obligation bound to an assignment target
+        is value-filtered at ``if x is None`` / ``if not x`` branches
+        (the alloc-returns-None idiom), and a function whose own
+        contract acquires a kind may *return* holding it (that IS the
+        handoff to the caller) — but may not leak it on a raise.
+
+TPU020  condition-variable discipline, on classes owning a
+        ``threading.Condition``: a ``cv.wait()`` with no enclosing
+        ``while`` (spurious wakeups / missed re-checks), a
+        ``notify``/``notify_all`` outside ``with cv`` (or the lock the
+        Condition wraps; ``*_locked``-suffixed methods are exempt by
+        house convention — their callers hold the monitor), and a
+        method that writes a predicate attribute (one read by a
+        wait-loop's test) under the lock with no reachable notify.
+
+TPU021  counter balance, for gauges marked ``# resource: counter``:
+        a method containing both an increment and a decrement must
+        have the decrement post-dominate the increment (every path
+        from ``+=`` to exit passes ``-=``, exception edges included —
+        the try/finally shape); a counter with increments but no
+        decrement anywhere in its class can only saturate.
+
+TPU022  single-flight donation windows: after a statement marked
+        ``# resource: donates a, b`` dispatches a jit that donates
+        those buffers, reading ``a`` or ``b`` before a
+        ``block_until_ready`` or a rebinding of the name is a read of
+        memory the accelerator may already have overwritten.
+
+Known limits (see docs/ANALYSIS.md): contract resolution is by simple
+name (rename or suppress on collision); may-raise is syntactic
+(calls/asserts, not subscripts); obligations are per-kind sets, not
+counts; rebinding an obligated name is not itself a leak.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from . import cfg as cfgmod
+from .core import Checker, Finding, Project, SourceFile
+
+_RES_RE = re.compile(
+    r"#\s*resource:\s*(acquires|releases|transfers|counter|donates)"
+    r"\s+([A-Za-z0-9_.,\- ]+?)\s*(?:—.*)?$"
+)
+
+_SITE_VERBS = ("acquires", "releases", "transfers")
+
+
+# ------------------------------------------------------------ parsing
+
+
+class _Marker:
+    __slots__ = ("line", "verb", "arg", "standalone")
+
+    def __init__(self, line: int, verb: str, arg: str, standalone: bool):
+        self.line = line
+        self.verb = verb
+        self.arg = arg
+        self.standalone = standalone
+
+
+def _scan_markers(f: SourceFile) -> List[_Marker]:
+    out: List[_Marker] = []
+    for i, text in enumerate(f.lines, start=1):
+        m = _RES_RE.search(text)
+        if not m:
+            continue
+        before = text[: m.start()].strip()
+        standalone = before == "" or before.endswith("#")
+        # ``x = 1  # noqa  # resource: ...`` is trailing; a pure
+        # comment line (possibly after other comments) is standalone.
+        if before.startswith("#"):
+            standalone = True
+        out.append(
+            _Marker(i, m.group(1), m.group(2).strip(), standalone)
+        )
+    return out
+
+
+class _FnInfo:
+    """One function: node, qualified name, class context, span."""
+
+    __slots__ = ("node", "qname", "cls", "name")
+
+    def __init__(self, node, qname, cls):
+        self.node = node
+        self.qname = qname
+        self.cls = cls  # ClassDef or None (immediate owner only)
+        self.name = node.name
+
+
+def _walk_functions(f: SourceFile) -> List[_FnInfo]:
+    out: List[_FnInfo] = []
+    if f.tree is None:
+        return out
+
+    def walk(node: ast.AST, prefix: str, cls) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                out.append(_FnInfo(child, q, cls))
+                walk(child, q, None)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                walk(child, q, child)
+            else:
+                walk(child, prefix, cls)
+
+    walk(f.tree, "", None)
+    return out
+
+
+def _enclosing(fns: Sequence[_FnInfo], line: int) -> Optional[_FnInfo]:
+    best = None
+    for fi in fns:
+        lo = fi.node.lineno
+        hi = fi.node.end_lineno or lo
+        if lo <= line <= hi and (best is None or lo > best.node.lineno):
+            best = fi
+    return best
+
+
+def _innermost_stmt(fn: ast.AST, line: int) -> Optional[ast.stmt]:
+    best: Optional[ast.stmt] = None
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.stmt) or sub is fn:
+            continue
+        lo = getattr(sub, "lineno", None)
+        hi = getattr(sub, "end_lineno", None)
+        if lo is None or hi is None or not (lo <= line <= hi):
+            continue
+        if best is None or lo > best.lineno or (
+            lo == best.lineno and hi <= (best.end_lineno or hi)
+        ):
+            best = sub
+    return best
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for an exact ``self.x`` attribute access."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+_COMPOUND = (
+    ast.If, ast.While, ast.For, ast.AsyncFor, ast.With, ast.AsyncWith,
+    ast.Try, ast.Match,
+)
+
+
+def _header_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The expressions a compound statement's *header* evaluates (the
+    part its CFG node represents — body calls belong to body nodes)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.Match):
+        return [stmt.subject]
+    return []
+
+
+def _calls_in_stmt(stmt: ast.stmt) -> List[ast.Call]:
+    """Calls this statement's CFG node evaluates."""
+    roots: List[ast.AST]
+    if isinstance(stmt, _COMPOUND):
+        roots = _header_exprs(stmt)
+    else:
+        roots = [stmt]
+    out = []
+    for r in roots:
+        for sub in ast.walk(r):
+            if isinstance(sub, ast.Call):
+                out.append(sub)
+    return out
+
+
+def _assign_binder(stmt: ast.stmt) -> Optional[str]:
+    """Single-Name assignment target, for value-filtered obligations."""
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        t = stmt.targets[0]
+        if isinstance(t, ast.Name):
+            return t.id
+    if isinstance(stmt, ast.AnnAssign) and isinstance(
+        stmt.target, ast.Name
+    ):
+        return stmt.target.id
+    return None
+
+
+def _branch_filter(
+    test: ast.AST, binder: str
+) -> Tuple[bool, bool]:
+    """(keep_on_true, keep_on_false) for an obligation bound to
+    ``binder`` at a branch on ``test``. Conservative default: keep."""
+
+    def is_binder(n: ast.AST) -> bool:
+        return isinstance(n, ast.Name) and n.id == binder
+
+    def none_test(n: ast.AST) -> Optional[bool]:
+        """True => 'binder is None' shape, False => 'is not None'."""
+        if (
+            isinstance(n, ast.Compare)
+            and len(n.ops) == 1
+            and is_binder(n.left)
+            and isinstance(n.comparators[0], ast.Constant)
+            and n.comparators[0].value is None
+        ):
+            if isinstance(n.ops[0], ast.Is):
+                return True
+            if isinstance(n.ops[0], ast.IsNot):
+                return False
+        return None
+
+    if is_binder(test):
+        return True, False  # truthy -> held; falsy -> never acquired
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        if is_binder(test.operand):
+            return False, True
+        nt = none_test(test.operand)
+        if nt is True:
+            return True, False
+    nt = none_test(test)
+    if nt is True:
+        return False, True
+    if nt is False:
+        return True, False
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        # 'if b is None and <...>': on the true edge every conjunct
+        # holds, so the binder IS None there. The false edge learns
+        # nothing (any conjunct may have failed).
+        for v in test.values:
+            if none_test(v) is True or (
+                isinstance(v, ast.UnaryOp)
+                and isinstance(v.op, ast.Not)
+                and is_binder(v.operand)
+            ):
+                return False, True
+    return True, True
+
+
+# -------------------------------------------------------- event model
+
+
+class _Events:
+    """Resource events one CFG node performs."""
+
+    __slots__ = (
+        "acquires",  # [(kind, binder)]
+        "releases",  # {kind} — discharge on every out-edge
+        "transfers_all",  # {kind} — statement-level: every edge
+        "transfers_ok",  # {kind} — contract call: normal edge only
+        "test_acquires",  # [(kind, on_true: bool)] — If-test acquire
+    )
+
+    def __init__(self):
+        self.acquires = []
+        self.releases = set()
+        self.transfers_all = set()
+        self.transfers_ok = set()
+        self.test_acquires = []
+
+    def empty(self) -> bool:
+        return not (
+            self.acquires or self.releases or self.transfers_all
+            or self.transfers_ok or self.test_acquires
+        )
+
+
+def _call_in(tree: ast.AST, call: ast.Call) -> bool:
+    return any(sub is call for sub in ast.walk(tree))
+
+
+def _collect_events(
+    fn: _FnInfo,
+    site_by_line: Dict[int, List[Tuple[str, str]]],
+    contracts: Dict[str, Set[Tuple[str, str]]],
+    by_class: Optional[Dict[Tuple[str, str], Set[Tuple[str, str]]]] = None,
+    class_methods: Optional[Dict[str, Set[str]]] = None,
+) -> Dict[int, _Events]:
+    """line-of-stmt -> events, keyed by the statement's lineno (CFG
+    nodes for the same stmt share events; finally copies inherit)."""
+    out: Dict[int, _Events] = {}
+
+    def ev(stmt: ast.stmt) -> _Events:
+        key = stmt.lineno
+        if key not in out:
+            out[key] = _Events()
+        return out[key]
+
+    # Site markers -> innermost enclosing statement.
+    for line, pairs in site_by_line.items():
+        stmt = _innermost_stmt(fn.node, line)
+        if stmt is None:
+            continue
+        e = ev(stmt)
+        for verb, kind in pairs:
+            if verb == "acquires":
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    continue  # with-managed: discharged at exit
+                e.acquires.append((kind, _assign_binder(stmt)))
+            elif verb == "releases":
+                e.releases.add(kind)
+            elif verb == "transfers":
+                e.transfers_all.add(kind)
+
+    # Contract calls.
+    for sub in ast.walk(fn.node):
+        if not isinstance(sub, ast.stmt) or sub is fn.node:
+            continue
+        # Skip statements of nested function definitions: they run on
+        # the inner function's activation, not this one's.
+        calls = _calls_in_stmt(sub)
+        if not calls:
+            continue
+        for call in calls:
+            t = _terminal_name(call.func)
+            if t is None or t == fn.name:
+                continue
+            # ``self.X(...)`` where the enclosing class defines X:
+            # resolve against THAT method's contract only (possibly
+            # none), never a same-named method of another class.
+            entry = contracts.get(t, ())
+            if (
+                class_methods is not None
+                and fn.cls is not None
+                and isinstance(call.func, ast.Attribute)
+                and isinstance(call.func.value, ast.Name)
+                and call.func.value.id == "self"
+                and t in class_methods.get(fn.cls.name, ())
+            ):
+                entry = (by_class or {}).get((fn.cls.name, t), set())
+            for verb, kind in entry:
+                e = ev(sub)
+                if verb == "acquires":
+                    if isinstance(sub, (ast.With, ast.AsyncWith)):
+                        continue  # with-managed acquisition
+                    if isinstance(sub, ast.If) and _call_in(
+                        sub.test, call
+                    ):
+                        on_true = not (
+                            isinstance(sub.test, ast.UnaryOp)
+                            and isinstance(sub.test.op, ast.Not)
+                        )
+                        e.test_acquires.append((kind, on_true))
+                    else:
+                        e.acquires.append(
+                            (kind, _assign_binder(sub))
+                        )
+                elif verb == "releases":
+                    e.releases.add(kind)
+                elif verb == "transfers":
+                    e.transfers_ok.add(kind)
+    # Nested defs: drop events attached to their statements — walk
+    # found them, but they don't execute in this frame.
+    nested: List[Tuple[int, int]] = []
+    for sub in ast.walk(fn.node):
+        if sub is not fn.node and isinstance(
+            sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            nested.append((sub.lineno, sub.end_lineno or sub.lineno))
+    if nested:
+        for line in list(out):
+            if any(lo < line <= hi for lo, hi in nested):
+                del out[line]
+    return out
+
+
+# ------------------------------------------------------------- TPU019
+
+
+class ResourceLifetimeChecker(Checker):
+    rule = "TPU019"
+    name = "resource-lifetime"
+    severity = "error"
+    layer = "lifetime"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        # Pass 1: contracts from standalone markers, tree-wide.  Two
+        # registries: a global one keyed by terminal name, and a
+        # class-scoped one so ``self.X(...)`` resolves against the
+        # enclosing class's own method before any same-named method
+        # elsewhere in the tree (a scheduler's ``_admit`` must not
+        # inherit the router's ``_admit`` contract).
+        contracts: Dict[str, Set[Tuple[str, str]]] = {}
+        by_class: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        class_methods: Dict[str, Set[str]] = {}
+        per_file: Dict[str, Tuple[List[_FnInfo], List[_Marker]]] = {}
+        for f in project.files:
+            if f.tree is None:
+                continue
+            fns = _walk_functions(f)
+            markers = _scan_markers(f)
+            per_file[f.relpath] = (fns, markers)
+            for fi in fns:
+                if fi.cls is not None:
+                    class_methods.setdefault(fi.cls.name, set()).add(
+                        fi.name
+                    )
+            for m in markers:
+                if not m.standalone or m.verb not in _SITE_VERBS:
+                    continue
+                fi = _enclosing(fns, m.line)
+                if fi is None:
+                    continue
+                names = {fi.name}
+                if fi.name == "__init__" and fi.cls is not None:
+                    names.add(fi.cls.name)
+                for n in names:
+                    contracts.setdefault(n, set()).add(
+                        (m.verb, m.arg)
+                    )
+                if fi.cls is not None:
+                    by_class.setdefault(
+                        (fi.cls.name, fi.name), set()
+                    ).add((m.verb, m.arg))
+
+        # Pass 2: per-function obligation dataflow.
+        for f in project.files:
+            if f.relpath not in per_file:
+                continue
+            fns, markers = per_file[f.relpath]
+            site: Dict[_FnInfo, Dict[int, List[Tuple[str, str]]]] = {}
+            own: Dict[_FnInfo, Set[str]] = {}
+            for m in markers:
+                if m.verb not in _SITE_VERBS:
+                    continue
+                fi = _enclosing(fns, m.line)
+                if fi is None:
+                    continue
+                if m.standalone:
+                    if m.verb == "acquires":
+                        own.setdefault(fi, set()).add(m.arg)
+                    continue
+                site.setdefault(fi, {}).setdefault(m.line, []).append(
+                    (m.verb, m.arg)
+                )
+            for fi in fns:
+                yield from self._check_fn(
+                    f, fi, site.get(fi, {}), contracts,
+                    own.get(fi, set()), by_class, class_methods,
+                )
+
+    def _check_fn(
+        self,
+        f: SourceFile,
+        fi: _FnInfo,
+        site_by_line: Dict[int, List[Tuple[str, str]]],
+        contracts: Dict[str, Set[Tuple[str, str]]],
+        own_acquires: Set[str],
+        by_class: Dict[Tuple[str, str], Set[Tuple[str, str]]],
+        class_methods: Dict[str, Set[str]],
+    ) -> Iterator[Finding]:
+        events = _collect_events(
+            fi, site_by_line, contracts, by_class, class_methods
+        )
+        if not any(
+            e.acquires or e.test_acquires for e in events.values()
+        ):
+            return
+        graph = cfgmod.build_cfg(fi.node)
+        # Worklist may-analysis: node -> set of (kind, binder, line).
+        state: Dict[int, Set[Tuple[str, Optional[str], int]]] = {
+            graph.entry: set()
+        }
+        work = [graph.entry]
+        leaks: Dict[
+            Tuple[str, str], Tuple[int, int]
+        ] = {}  # (kind, exit-kind) -> (acquire line, exit line)
+        while work:
+            n = work.pop()
+            node = graph.node(n)
+            s_in = state.get(n, set())
+            e = events.get(node.line) if node.stmt is not None else None
+            for succ, ekind in graph.succs[n]:
+                s = set(s_in)
+                if e is not None:
+                    if e.releases or e.transfers_all:
+                        gone = e.releases | e.transfers_all
+                        s = {o for o in s if o[0] not in gone}
+                    if ekind != cfgmod.EDGE_EXC and e.transfers_ok:
+                        s = {
+                            o for o in s
+                            if o[0] not in e.transfers_ok
+                        }
+                    if ekind != cfgmod.EDGE_EXC:
+                        for kind, binder in e.acquires:
+                            s.add((kind, binder, node.line))
+                        for kind, on_true in e.test_acquires:
+                            if (ekind == cfgmod.EDGE_TRUE) == on_true:
+                                s.add((kind, None, node.line))
+                if (
+                    node.stmt is not None
+                    and isinstance(node.stmt, (ast.If, ast.While))
+                    and ekind in (cfgmod.EDGE_TRUE, cfgmod.EDGE_FALSE)
+                ):
+                    kept = set()
+                    for kind, binder, line in s:
+                        if binder is None:
+                            kept.add((kind, binder, line))
+                            continue
+                        kt, kf = _branch_filter(node.stmt.test, binder)
+                        if (kt if ekind == cfgmod.EDGE_TRUE else kf):
+                            kept.add((kind, binder, line))
+                    s = kept
+                target = graph.node(succ)
+                if target.kind in (
+                    cfgmod.N_RETURN_EXIT, cfgmod.N_EXC_EXIT
+                ):
+                    for kind, binder, line in s:
+                        if (
+                            target.kind == cfgmod.N_RETURN_EXIT
+                            and kind in own_acquires
+                        ):
+                            continue  # declared handoff to the caller
+                        key = (kind, target.kind)
+                        exit_line = node.line or line
+                        if key not in leaks or leaks[key][1] > exit_line:
+                            leaks[key] = (line, exit_line)
+                    continue
+                if succ not in state:
+                    state[succ] = set(s)
+                    work.append(succ)
+                elif not s <= state[succ]:
+                    state[succ] |= s
+                    work.append(succ)
+        for (kind, exit_kind), (acq_line, exit_line) in sorted(
+            leaks.items(), key=lambda kv: kv[1]
+        ):
+            how = (
+                "an exception path"
+                if exit_kind == cfgmod.N_EXC_EXIT
+                else "a return path"
+            )
+            anchor = ast.Name(
+                id="x", lineno=acq_line, col_offset=0
+            )
+            yield self.finding(
+                f,
+                anchor,
+                f"{fi.qname}: {kind!r} acquired here can reach "
+                f"function exit via {how} (around line {exit_line}) "
+                "without a release or ownership transfer — wrap in "
+                "try/finally, release in the handler, or mark the "
+                "handoff with '# resource: transfers'",
+                symbol=f"leak:{fi.qname}:{kind}:{exit_kind}",
+            )
+
+
+# ------------------------------------------------------------- TPU020
+
+
+_CV_CTORS = {"Condition"}
+
+
+def _parent_map(root: ast.AST) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _ancestors(node, parents) -> Iterator[ast.AST]:
+    cur = parents.get(id(node))
+    while cur is not None:
+        yield cur
+        cur = parents.get(id(cur))
+
+
+class ConditionDisciplineChecker(Checker):
+    rule = "TPU020"
+    name = "cv-discipline"
+    severity = "error"
+    layer = "lifetime"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for f in project.files:
+            if f.tree is None:
+                continue
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.ClassDef):
+                    yield from self._check_class(f, node)
+
+    def _check_class(
+        self, f: SourceFile, cls: ast.ClassDef
+    ) -> Iterator[Finding]:
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        # Condition attrs + the explicit lock each one wraps (if any).
+        cvs: Dict[str, Optional[str]] = {}
+        for m in methods:
+            for sub in ast.walk(m):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                v = sub.value
+                if not (
+                    isinstance(v, ast.Call)
+                    and _terminal_name(v.func) in _CV_CTORS
+                ):
+                    continue
+                for t in sub.targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    lock = (
+                        _self_attr(v.args[0]) if v.args else None
+                    )
+                    cvs[attr] = lock
+        if not cvs:
+            return
+
+        # Which methods notify which cv (for the one-hop reach check).
+        notify_methods: Dict[str, Set[str]] = {}  # cv -> {method}
+        calls_of: Dict[str, Set[str]] = {}  # method -> self-calls
+        for m in methods:
+            for sub in ast.walk(m):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                if isinstance(fn, ast.Attribute):
+                    recv = _self_attr(fn.value)
+                    if recv in cvs and fn.attr in (
+                        "notify", "notify_all"
+                    ):
+                        notify_methods.setdefault(recv, set()).add(
+                            m.name
+                        )
+                    if (
+                        isinstance(fn.value, ast.Name)
+                        and fn.value.id == "self"
+                    ):
+                        calls_of.setdefault(m.name, set()).add(fn.attr)
+
+        def reaches_notify(method: str, cv: str) -> bool:
+            if method in notify_methods.get(cv, ()):
+                return True
+            return any(
+                callee in notify_methods.get(cv, ())
+                for callee in calls_of.get(method, ())
+            )
+
+        def holds(node, parents, cv: str) -> bool:
+            lock = cvs.get(cv)
+            for a in _ancestors(node, parents):
+                if isinstance(a, (ast.With, ast.AsyncWith)):
+                    for item in a.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr == cv or (lock and attr == lock):
+                            return True
+            return False
+
+        predicate_attrs: Dict[str, Set[str]] = {}  # cv -> attrs
+        wait_sites = []  # (method, call node, cv, parents)
+        for m in methods:
+            parents = _parent_map(m)
+            for sub in ast.walk(m):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                if not isinstance(fn, ast.Attribute):
+                    continue
+                recv = _self_attr(fn.value)
+                if recv not in cvs:
+                    continue
+                if fn.attr == "wait":
+                    wait_sites.append((m, sub, recv, parents))
+                    # Predicate attrs: the wait loop's test plus any
+                    # if-guards between the loop and the wait.
+                    loop = None
+                    for a in _ancestors(sub, parents):
+                        if isinstance(a, ast.While):
+                            loop = a
+                            break
+                    if loop is not None:
+                        pool = [loop.test] + [
+                            a.test
+                            for a in _ancestors(sub, parents)
+                            if isinstance(a, ast.If)
+                            and a.lineno >= loop.lineno
+                        ]
+                        attrs = predicate_attrs.setdefault(
+                            recv, set()
+                        )
+                        for t in pool:
+                            for n2 in ast.walk(t):
+                                a2 = _self_attr(n2)
+                                if a2:
+                                    attrs.add(a2)
+                elif fn.attr in ("notify", "notify_all"):
+                    if m.name.endswith("_locked"):
+                        continue  # caller holds the monitor (house
+                        # convention, same as TPU009's helper rule)
+                    if not holds(sub, parents, recv):
+                        yield self.finding(
+                            f,
+                            sub,
+                            f"{cls.name}.{m.name}: notify on "
+                            f"self.{recv} outside 'with "
+                            f"self.{recv}' — a waiter can miss the "
+                            "wakeup between its predicate check and "
+                            "its wait",
+                            symbol=(
+                                f"notify-unlocked:{cls.name}."
+                                f"{m.name}:{recv}"
+                            ),
+                        )
+
+        for m, call, cv, parents in wait_sites:
+            in_while = any(
+                isinstance(a, ast.While)
+                for a in _ancestors(call, parents)
+            )
+            if not in_while:
+                yield self.finding(
+                    f,
+                    call,
+                    f"{cls.name}.{m.name}: self.{cv}.wait() outside "
+                    "a while-predicate loop — spurious wakeups and "
+                    "missed notifies make a bare wait return without "
+                    "its condition holding",
+                    symbol=f"wait-no-while:{cls.name}.{m.name}:{cv}",
+                )
+
+        # Predicate-state writes with no reachable notify.
+        for m in methods:
+            if m.name.endswith("_locked"):
+                continue
+            parents = _parent_map(m)
+            for sub in ast.walk(m):
+                target = None
+                if isinstance(sub, ast.AugAssign):
+                    target = _self_attr(sub.target)
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        target = target or _self_attr(t)
+                if target is None:
+                    continue
+                for cv, attrs in predicate_attrs.items():
+                    if target not in attrs:
+                        continue
+                    if not holds(sub, parents, cv):
+                        continue  # unlocked writes are TPU009's beat
+                    if reaches_notify(m.name, cv):
+                        continue
+                    yield self.finding(
+                        f,
+                        sub,
+                        f"{cls.name}.{m.name}: writes predicate "
+                        f"state self.{target} under self.{cv} but "
+                        "no notify is reachable — sleepers re-check "
+                        "only on timeout (or never)",
+                        symbol=(
+                            f"predicate-no-notify:{cls.name}."
+                            f"{m.name}:{target}"
+                        ),
+                        severity="warning",
+                    )
+
+
+# ------------------------------------------------------------- TPU021
+
+
+class CounterBalanceChecker(Checker):
+    rule = "TPU021"
+    name = "counter-balance"
+    severity = "error"
+    layer = "lifetime"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for f in project.files:
+            if f.tree is None:
+                continue
+            markers = [
+                m for m in _scan_markers(f)
+                if m.verb == "counter" and not m.standalone
+            ]
+            if not markers:
+                continue
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.ClassDef):
+                    yield from self._check_class(f, node, markers)
+
+    def _check_class(
+        self, f: SourceFile, cls: ast.ClassDef, markers
+    ) -> Iterator[Finding]:
+        lo, hi = cls.lineno, cls.end_lineno or cls.lineno
+        counters: Dict[str, str] = {}  # attr -> kind
+        for m in markers:
+            if not (lo <= m.line <= hi):
+                continue
+            stmt = _innermost_stmt(cls, m.line)
+            attr = None
+            if isinstance(stmt, ast.Assign) and stmt.targets:
+                attr = _self_attr(stmt.targets[0])
+                if attr is None and isinstance(
+                    stmt.targets[0], ast.Name
+                ):
+                    attr = stmt.targets[0].id
+            elif isinstance(stmt, ast.AnnAssign):
+                attr = _self_attr(stmt.target)
+                if attr is None and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    attr = stmt.target.id
+            if attr:
+                counters[attr] = m.arg
+        if not counters:
+            return
+        methods = [
+            n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+
+        def delta(stmt: ast.stmt, attr: str) -> Optional[str]:
+            """'inc' / 'dec' when ``stmt`` adjusts ``self.attr``."""
+            if isinstance(stmt, ast.AugAssign):
+                if _self_attr(stmt.target) != attr:
+                    return None
+                if isinstance(stmt.op, ast.Add):
+                    return "inc"
+                if isinstance(stmt.op, ast.Sub):
+                    return "dec"
+                return None
+            if isinstance(stmt, ast.Assign):
+                if not any(
+                    _self_attr(t) == attr for t in stmt.targets
+                ):
+                    return None
+                for sub in ast.walk(stmt.value):
+                    if isinstance(sub, ast.BinOp) and any(
+                        _self_attr(s) == attr
+                        for s in (sub.left, sub.right)
+                    ):
+                        if isinstance(sub.op, ast.Add):
+                            return "inc"
+                        if isinstance(sub.op, ast.Sub):
+                            return "dec"
+            return None
+
+        sites: Dict[str, Dict[str, List[Tuple[ast.stmt, str]]]] = {}
+        for attr in counters:
+            sites[attr] = {}
+            for m in methods:
+                hits = []
+                for sub in ast.walk(m):
+                    if isinstance(sub, ast.stmt):
+                        d = delta(sub, attr)
+                        if d:
+                            hits.append((sub, d))
+                if hits:
+                    sites[attr][m.name] = hits
+
+        by_name = {m.name: m for m in methods}
+        for attr, kind in counters.items():
+            per_method = sites[attr]
+            incs = [
+                (mn, s) for mn, hs in per_method.items()
+                for s, d in hs if d == "inc"
+            ]
+            decs = [
+                (mn, s) for mn, hs in per_method.items()
+                for s, d in hs if d == "dec"
+            ]
+            if incs and not decs:
+                mn, s = incs[0]
+                yield self.finding(
+                    f,
+                    s,
+                    f"{cls.name}: counter {kind!r} (self.{attr}) is "
+                    "incremented but never decremented anywhere in "
+                    "the class — the gauge can only saturate",
+                    symbol=f"never-dec:{cls.name}:{attr}",
+                )
+                continue
+            # Methods containing both sides must balance on every
+            # path — the try/finally shape, checked on the CFG.
+            for mn, hits in per_method.items():
+                kinds = {d for _s, d in hits}
+                if kinds != {"inc", "dec"}:
+                    continue
+                yield from self._balance(
+                    f, cls, by_name[mn], attr, kind, hits
+                )
+
+    def _balance(
+        self, f, cls, method, attr, kind, hits
+    ) -> Iterator[Finding]:
+        inc_lines = {s.lineno for s, d in hits if d == "inc"}
+        dec_lines = {s.lineno for s, d in hits if d == "dec"}
+        graph = cfgmod.build_cfg(method)
+        state: Dict[int, Set[int]] = {graph.entry: set()}
+        work = [graph.entry]
+        leak: Optional[Tuple[int, int]] = None
+        while work:
+            n = work.pop()
+            node = graph.node(n)
+            s_in = state.get(n, set())
+            s = set(s_in)
+            line = node.line
+            if line in dec_lines:
+                s = set()  # any reachable dec discharges
+            elif line in inc_lines:
+                s = s | {line}
+            for succ, ekind in graph.succs[n]:
+                out = s
+                if ekind == cfgmod.EDGE_EXC and line in inc_lines:
+                    out = s_in  # the raising inc never incremented
+                target = graph.node(succ)
+                if target.kind in (
+                    cfgmod.N_RETURN_EXIT, cfgmod.N_EXC_EXIT
+                ):
+                    for inc_line in out:
+                        if leak is None or inc_line < leak[0]:
+                            leak = (inc_line, line or inc_line)
+                    continue
+                if succ not in state:
+                    state[succ] = set(out)
+                    work.append(succ)
+                elif not out <= state[succ]:
+                    state[succ] |= out
+                    work.append(succ)
+        if leak is not None:
+            anchor = ast.Name(
+                id="x", lineno=leak[0], col_offset=0
+            )
+            yield self.finding(
+                f,
+                anchor,
+                f"{cls.name}.{method.name}: counter {kind!r} "
+                f"(self.{attr}) incremented here but a path reaches "
+                f"function exit (around line {leak[1]}) without the "
+                "decrement — move the decrement into a finally or "
+                "cover the raising statements",
+                symbol=(
+                    f"unbalanced:{cls.name}.{method.name}:{attr}"
+                ),
+            )
+
+
+# ------------------------------------------------------------- TPU022
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'self.cache' / 'x' for a pure Name/Attribute chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+class DonationWindowChecker(Checker):
+    rule = "TPU022"
+    name = "donation-window"
+    severity = "error"
+    layer = "lifetime"
+
+    def check(self, project: Project) -> Iterator[Finding]:
+        for f in project.files:
+            if f.tree is None:
+                continue
+            fns = _walk_functions(f)
+            for m in _scan_markers(f):
+                if m.verb != "donates" or m.standalone:
+                    continue
+                fi = _enclosing(fns, m.line)
+                if fi is None:
+                    continue
+                names = [
+                    n.strip() for n in m.arg.split(",") if n.strip()
+                ]
+                yield from self._check_window(f, fi, m.line, names)
+
+    def _check_window(
+        self, f: SourceFile, fi: _FnInfo, line: int,
+        names: List[str],
+    ) -> Iterator[Finding]:
+        dispatch = _innermost_stmt(fi.node, line)
+        if dispatch is None:
+            return
+        # A name the dispatch itself rebinds has no window: its new
+        # binding IS the result, the donated buffer is unreachable.
+        bound = set()
+        if isinstance(dispatch, ast.Assign):
+            for t in dispatch.targets:
+                for el in (
+                    t.elts if isinstance(t, ast.Tuple) else [t]
+                ):
+                    d = _dotted(el)
+                    if d:
+                        bound.add(d)
+        open_names = [n for n in names if n not in bound]
+        if not open_names:
+            return
+        graph = cfgmod.build_cfg(fi.node)
+        dispatch_nodes = [
+            n.id for n in graph.nodes
+            if n.stmt is not None and n.stmt.lineno == dispatch.lineno
+        ]
+
+        def closes(stmt: ast.stmt, name: str) -> bool:
+            for call in _calls_in_stmt(stmt):
+                if _terminal_name(call.func) == "block_until_ready":
+                    return True
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    for el in (
+                        t.elts if isinstance(t, ast.Tuple) else [t]
+                    ):
+                        if _dotted(el) == name:
+                            return True
+            return False
+
+        for name in open_names:
+            reported = False
+            for dn in dispatch_nodes:
+                if reported:
+                    break
+                stop = {
+                    n.id for n in graph.nodes
+                    if n.stmt is not None
+                    and n.stmt.lineno != dispatch.lineno
+                    and closes(n.stmt, name)
+                }
+                for nid in cfgmod.reachable_between(graph, dn, stop):
+                    node = graph.node(nid)
+                    if node.stmt is None or nid in stop:
+                        continue
+                    if node.stmt.lineno == dispatch.lineno:
+                        continue
+                    roots = (
+                        _header_exprs(node.stmt)
+                        if isinstance(node.stmt, _COMPOUND)
+                        else [node.stmt]
+                    )
+                    hit = None
+                    for r in roots:
+                        for sub in ast.walk(r):
+                            if (
+                                isinstance(
+                                    sub, (ast.Name, ast.Attribute)
+                                )
+                                and isinstance(
+                                    getattr(sub, "ctx", None),
+                                    ast.Load,
+                                )
+                                and _dotted(sub) == name
+                            ):
+                                hit = sub
+                                break
+                        if hit:
+                            break
+                    if hit is not None:
+                        yield self.finding(
+                            f,
+                            node.stmt,
+                            f"{fi.qname}: reads {name!r} inside its "
+                            "donation window (dispatched at line "
+                            f"{dispatch.lineno}) — the donated "
+                            "buffer may already be overwritten; "
+                            "rebind the name from the jit's output "
+                            "or block_until_ready first",
+                            symbol=(
+                                f"donation-window:{fi.qname}:{name}"
+                            ),
+                        )
+                        reported = True
+                        break

@@ -67,6 +67,19 @@ _RULE_HELP = {
               "but never claimed",
     "TPU018": "metric label carries an id-shaped value (trace/request/"
               "uuid): unbounded time-series cardinality",
+    "TPU019": "resource lifetime: a path (raise, early return, "
+              "swallowed except) exits with an acquired resource "
+              "(pages, slots, inflight credits, tickets, file "
+              "handles) unreleased and untransferred",
+    "TPU020": "condition-variable discipline: wait() without a while-"
+              "predicate loop, notify outside the owning lock, or "
+              "predicate-state write with no reachable notify",
+    "TPU021": "counter balance: a marked gauge increments on a path "
+              "with no post-dominating decrement (or never decrements "
+              "at all)",
+    "TPU022": "single-flight donation window: donated-buffer leaves "
+              "read between the marked dispatch and its "
+              "block_until_ready / result rebind",
 }
 
 
@@ -138,7 +151,7 @@ def to_sarif(findings: Sequence[Finding]) -> dict:
                     "driver": {
                         "name": "tpulint",
                         "organization": "tpufw",
-                        "semanticVersion": "4.0.0",
+                        "semanticVersion": "5.0.0",
                         "rules": rules,
                     }
                 },

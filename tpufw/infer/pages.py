@@ -195,6 +195,7 @@ class PageAllocator:
         """Pop ``n`` free pages with refcount 1, or None (all-or-
         nothing — a partial grab would deadlock two part-admitted
         rows)."""
+        # resource: acquires pages
         if n > len(self.free):
             return None
         ids = [self.free.pop() for _ in range(n)]
@@ -209,6 +210,7 @@ class PageAllocator:
     def release(self, ids: Sequence[int]) -> int:
         """Drop one row reference per id; free those that hit 0 and are
         not trie-held. Returns the number actually freed."""
+        # resource: releases pages
         freed = 0
         for i in ids:
             r = self.refs.get(i, 0) - 1
@@ -720,23 +722,31 @@ class PagedSlotPool(SlotPool):
             # Cap so >= 1 suffix token always remains: the first output
             # token's logits need a real forward pass.
             shared = self.prefix.match(prompt)[: (p - 1) // self.page]
+        # resource: acquires pages
         # Reference the shared pages FIRST so eviction below can't free
         # them out from under us (match() alone leaves refcount at 0
         # for pages only the trie holds).
-        self.allocator.ref(shared)
+        self.allocator.ref(shared)  # resource: acquires pages
         n_new = n_total - len(shared)
-        ids = self.allocator.alloc(n_new)
-        if ids is None and self.prefix is not None:
-            self.prefix.evict(
-                n_new - self.allocator.n_free, self.allocator
-            )
+        try:
             ids = self.allocator.alloc(n_new)
+            if ids is None and self.prefix is not None:
+                self.prefix.evict(
+                    n_new - self.allocator.n_free, self.allocator
+                )
+                ids = self.allocator.alloc(n_new)
+        except BaseException:
+            # Trie surgery raising mid-evict must not strand the
+            # shared-page refs taken above (TPU019).
+            self.allocator.release(shared)
+            raise
         if ids is None:
             self.allocator.release(shared)
             return None
         return shared + ids, len(shared)
 
     def release_pages(self, ids: Sequence[int]) -> int:
+        # resource: releases pages
         return self.allocator.release(ids)
 
     def register_prefix(
@@ -791,6 +801,7 @@ class PagedSlotPool(SlotPool):
         ``acquire_pages``); the first ``shared_n`` ids are prefix pages
         attached by reference, never written."""
         paths, names, leaves, treedef = self._pool_flat()
+        # resource: transfers pages
         row_leaves = self._aligned_row(paths, row_cache)
         table_row = np.zeros((self.per_row,), np.int32)
         table_row[: len(page_ids)] = page_ids
@@ -899,30 +910,38 @@ class PagedSlotPool(SlotPool):
             # Same cap as acquire_pages: >= 1 suffix token must remain
             # so the first output token's logits get a real forward.
             shared = self.prefix.match(prompt)[: (p - 1) // self.page]
+        # resource: acquires pages
         # ref() pins the shared pages host-side right now (eviction
         # can't reclaim them); their KV is gathered lazily by the
         # first chunk_step. refcounts make the deferral safe: pinned
         # pages are never reallocated, so their content is stable.
-        self.allocator.ref(shared)
-        seen = None
-        if _track_seen(self.sampling):
-            m = np.zeros((1, self.model.cfg.vocab_size), bool)
-            if shared:
-                m[0, np.asarray(
-                    prompt[: len(shared) * self.page], np.int64
-                )] = True
-            seen = jnp.asarray(m)
-        return ChunkedPrefill(
-            prompt=prompt,
-            rng=rng,
-            chunk_pages=max(1, int(chunk_pages)),
-            n_total=self.n_pages_for(max(need, p)),
-            row_cache=None,  # first chunk_step attaches (leaf read)
-            seen_row=seen,
-            cursor=len(shared) * self.page,
-            page_ids=list(shared),
-            shared_n=len(shared),
-        )
+        self.allocator.ref(shared)  # resource: acquires pages
+        try:
+            seen = None
+            if _track_seen(self.sampling):
+                m = np.zeros((1, self.model.cfg.vocab_size), bool)
+                if shared:
+                    m[0, np.asarray(
+                        prompt[: len(shared) * self.page], np.int64
+                    )] = True
+                seen = jnp.asarray(m)
+            cp = ChunkedPrefill(
+                prompt=prompt,
+                rng=rng,
+                chunk_pages=max(1, int(chunk_pages)),
+                n_total=self.n_pages_for(max(need, p)),
+                row_cache=None,  # first chunk_step attaches (leaf read)
+                seen_row=seen,
+                cursor=len(shared) * self.page,
+                page_ids=list(shared),
+                shared_n=len(shared),
+            )
+        except BaseException:
+            # A host-array failure here must not strand the shared
+            # refs: nobody has the cursor object yet (TPU019).
+            self.allocator.release(shared)
+            raise
+        return cp
 
     def chunk_step(
         self, cp: ChunkedPrefill, unlocked=None
@@ -945,6 +964,10 @@ class PagedSlotPool(SlotPool):
         interleave with a chunk's device time — but the CALLER must
         still guarantee only one chunk_step is in flight per pool
         (concurrent calls would fork the arena leaves)."""
+        # No acquires-contract here: every page this call grabs is
+        # transferred into cp.page_ids before it can return or raise,
+        # so the CALLER holds nothing — cp's owner discharges via
+        # finalize_chunked / abandon_chunked.
         p = len(cp.prompt)
         start = cp.cursor
         left = p - start
@@ -965,7 +988,7 @@ class PagedSlotPool(SlotPool):
                 ids = self.allocator.alloc(n_new)
             if ids is None:
                 return "stalled"
-            cp.page_ids.extend(ids)
+            cp.page_ids.extend(ids)  # resource: transfers pages
         tokens = np.zeros((1, width), np.int32)
         tokens[0, :n_real] = np.asarray(
             cp.prompt[start:start + n_real], np.int32
@@ -989,7 +1012,7 @@ class PagedSlotPool(SlotPool):
                 cp.row_cache = self._attach_row(
                     cp.page_ids[: cp.shared_n]
                 )
-            out_leaves, cp.row_cache, first, done0, cp.seen_row = (
+            out_leaves, cp.row_cache, first, done0, cp.seen_row = (  # resource: donates leaves
                 _prefill_chunk_jit(
                     tuple(leaves), cp.row_cache, self.params,
                     jnp.asarray(tokens), jnp.asarray(chunk_ids),
@@ -1037,6 +1060,7 @@ class PagedSlotPool(SlotPool):
         call just installs the table row + cursors — zero new program
         keys. The row cache's cache_index (fixed to the prompt length
         inside the chunk jit) supplies the slot cursor."""
+        # resource: transfers pages
         self.insert_paged(
             slot, cp.row_cache, cp.first_int, len(cp.prompt), budget,
             cp.page_ids, self.per_row, row_seen=cp.seen_row,
@@ -1047,6 +1071,7 @@ class PagedSlotPool(SlotPool):
         checkpointed full pages stay resident (held) — that IS the
         resume point a re-admission's ``start_chunked`` picks up —
         while unheld pages free immediately. Returns pages freed."""
+        # resource: releases pages
         freed = self.allocator.release(cp.page_ids)
         cp.page_ids = []
         return freed
@@ -1055,6 +1080,8 @@ class PagedSlotPool(SlotPool):
         """Free ``slot``: freeze its masks, zero its page-table row,
         return its pages to the allocator. Returns pages actually freed
         (shared/held pages may stay resident)."""
+        # resource: releases pages
+        # resource: releases slot
         self.done, self.remaining = _retire_jit(
             self.done, self.remaining, slot
         )
@@ -1094,6 +1121,7 @@ class PagedSlotPool(SlotPool):
         took at the chunk boundary — the scheduler's retire path does,
         so a row finishing mid-chunk exports the pages it owned when
         the chunk was launched, not whatever the list mutated to."""
+        # resource: transfers slot
         ids = list(
             self.slot_pages[slot] if page_ids is None else page_ids
         )
@@ -1140,6 +1168,7 @@ class PagedSlotPool(SlotPool):
         and restore the cursors. Raises ValueError on any layout
         mismatch — a bundle from a differently-shaped pool must be
         rejected before it scribbles on the arena."""
+        # resource: transfers pages
         if int(state["page"]) != self.page:
             raise ValueError(
                 f"bundle page size {state['page']} != pool page "

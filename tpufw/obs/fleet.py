@@ -128,28 +128,37 @@ class SeriesStore:
         max_records: int = 4096,
         clock: Callable[[], float] = time.time,
     ):
+        # resource: acquires file-handle
         self.path = path
         self.max_records = max(16, int(max_records))
         self._clock = clock
         self._lock = threading.Lock()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._count = len(read_series(path)) if os.path.exists(path) else 0
-        self._f = open(path, "a", encoding="utf-8")  # noqa: SIM115
-        # A predecessor killed mid-write leaves an unterminated tail;
-        # appending straight after it would glue the first new record
-        # onto the torn line and lose BOTH. Terminate it first.
-        torn = False
+        self._f = open(path, "a", encoding="utf-8")  # noqa: SIM115  # resource: acquires file-handle
         try:
-            with open(path, "rb") as fh:
-                fh.seek(0, os.SEEK_END)
-                if fh.tell() > 0:
-                    fh.seek(-1, os.SEEK_END)
-                    torn = fh.read(1) != b"\n"
-        except OSError:
-            pass
-        if torn:
-            self._f.write("\n")
-            self._f.flush()
+            # A predecessor killed mid-write leaves an unterminated
+            # tail; appending straight after it would glue the first
+            # new record onto the torn line and lose BOTH. Terminate
+            # it first.
+            torn = False
+            try:
+                with open(path, "rb") as fh:
+                    fh.seek(0, os.SEEK_END)
+                    if fh.tell() > 0:
+                        fh.seek(-1, os.SEEK_END)
+                        torn = fh.read(1) != b"\n"
+            except OSError:
+                pass
+            if torn:
+                self._f.write("\n")
+                self._f.flush()
+        except BaseException:
+            # A half-built store must not strand the append handle
+            # (TPU019): if the torn-tail repair raises, the caller
+            # never gets an object to close().
+            self._f.close()
+            raise
 
     def append(
         self,
@@ -191,6 +200,10 @@ class SeriesStore:
             for rec in kept:
                 f.write(json.dumps(rec, sort_keys=True) + "\n")
         self._f.close()
+        # Park in the closed state append() tolerates: if the rename
+        # or reopen below raises, _f must not point at a closed
+        # handle every later append() would crash on.
+        self._f = None
         os.replace(tmp, self.path)
         self._f = open(self.path, "a", encoding="utf-8")  # noqa: SIM115
         self._count = len(kept)
@@ -209,6 +222,7 @@ class SeriesStore:
         return records
 
     def close(self) -> None:
+        # resource: releases file-handle
         with self._lock:
             if self._f is not None:
                 self._f.close()
@@ -903,6 +917,8 @@ class FleetCollector:
         clock: Callable[[], float] = time.time,
         mono: Callable[[], float] = time.monotonic,
     ):
+        # resource: transfers file-handle — the collector owns the
+        # store from here on; FleetCollector.stop() closes it.
         self.targets = list(targets)
         self.store = store
         self.events = events if events is not None else obs_events.NULL
@@ -1056,26 +1072,32 @@ def collector_from_env(
         os.path.join(fleet_dir, SERIES_FILENAME),
         max_records=env_int("fleet_max_records", 4096),
     )
-    events = obs_events.EventLog(
-        os.path.join(fleet_dir, EVENTS_FILENAME)
-    )
-    recommender = None
-    manifest = env_str("fleet_manifest", "")
-    if manifest and os.path.exists(manifest):
-        recommender = ScalingRecommender(
-            fleet_dir,
-            manifest,
-            cooldown_s=env_float("fleet_cooldown_s", 300.0),
-            max_replicas=env_int("fleet_max_replicas", 8),
-            events=events,
+    try:
+        events = obs_events.EventLog(
+            os.path.join(fleet_dir, EVENTS_FILENAME)
         )
-    collector = FleetCollector(
-        targets,
-        store,
-        events=events,
-        recommender=recommender,
-        health_fn=health_fn,
-    )
+        recommender = None
+        manifest = env_str("fleet_manifest", "")
+        if manifest and os.path.exists(manifest):
+            recommender = ScalingRecommender(
+                fleet_dir,
+                manifest,
+                cooldown_s=env_float("fleet_cooldown_s", 300.0),
+                max_replicas=env_int("fleet_max_replicas", 8),
+                events=events,
+            )
+        collector = FleetCollector(
+            targets,
+            store,
+            events=events,
+            recommender=recommender,
+            health_fn=health_fn,
+        )
+    except BaseException:
+        # Anything between the open and the ownership handoff to the
+        # collector raising would strand the series handle (TPU019).
+        store.close()
+        raise
     return collector.start(scrape_s)
 
 
@@ -1244,39 +1266,48 @@ def _cmd_collect(args: argparse.Namespace) -> int:
         os.path.join(args.dir, SERIES_FILENAME),
         max_records=args.max_records,
     )
-    events = obs_events.EventLog(
-        os.path.join(args.dir, EVENTS_FILENAME)
-    )
-    recommender = None
-    if args.manifest:
-        recommender = ScalingRecommender(
-            args.dir,
-            args.manifest,
-            cooldown_s=args.cooldown_s,
-            events=events,
-        )
-    collector = FleetCollector(
-        targets,
-        store,
-        events=events,
-        recommender=recommender,
-        health_fn=health_fn,
-    )
-    stop = threading.Event()
-    deadline = (
-        time.monotonic() + args.duration if args.duration else None
-    )
+    events = None
+    # Everything from here to the scrape loop runs under the close
+    # guarantee: a raise while wiring the collector must not strand
+    # the series handle the store just opened (TPU019).
     try:
-        while not stop.is_set():
-            collector.scrape_once()
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            stop.wait(args.interval)
-    except KeyboardInterrupt:
-        pass
+        events = obs_events.EventLog(
+            os.path.join(args.dir, EVENTS_FILENAME)
+        )
+        recommender = None
+        if args.manifest:
+            recommender = ScalingRecommender(
+                args.dir,
+                args.manifest,
+                cooldown_s=args.cooldown_s,
+                events=events,
+            )
+        collector = FleetCollector(
+            targets,
+            store,
+            events=events,
+            recommender=recommender,
+            health_fn=health_fn,
+        )
+        stop = threading.Event()
+        deadline = (
+            time.monotonic() + args.duration if args.duration else None
+        )
+        try:
+            while not stop.is_set():
+                collector.scrape_once()
+                if (
+                    deadline is not None
+                    and time.monotonic() >= deadline
+                ):
+                    break
+                stop.wait(args.interval)
+        except KeyboardInterrupt:
+            pass
     finally:
         store.close()
-        events.close()
+        if events is not None:
+            events.close()
     print(
         json.dumps(
             {

@@ -157,6 +157,10 @@ class HangWatchdog:
         with self._cv:
             self._deadline = None
             self._armed_at = None
+            # Wake the watchdog out of its stale timed wait so it
+            # parks on the untimed disarmed wait immediately instead
+            # of burning one spurious wakeup at the old deadline.
+            self._cv.notify()
 
     def stop(self) -> None:
         with self._cv:
@@ -180,6 +184,9 @@ class HangWatchdog:
                 armed_for = now - (self._armed_at or now)
                 # One dump per stall: stay disarmed until the loop
                 # proves liveness by arming again.
+                # tpulint: disable=TPU020 — this thread is the only
+                # waiter on _cv; a self-disarm by the sole consumer
+                # has nobody to notify.
                 self._deadline = None
                 self._armed_at = None
                 self.fired += 1

@@ -141,7 +141,7 @@ class PrefillEngine:
         #: pages promised to in-flight chunked admissions; admission
         #: blocks (rather than deadlocks) while the sum would pass the
         #: arena, so every admitted prefill can always finish.
-        self._reserved = 0
+        self._reserved = 0  # resource: counter reserved-pages
         #: Chunk-turn tickets, scheduled SRPT (shortest remaining
         #: prompt first, admission order on ties): equal-length
         #: prompts drain in strict FIFO — identical completion order
@@ -155,7 +155,7 @@ class PrefillEngine:
         #: RELEASED around its device call — exactly one chunk may
         #: compute at a time or the arena leaves would fork.
         self._chunk_busy = False
-        self.prefill_inflight = 0
+        self.prefill_inflight = 0  # resource: counter prefill-inflight
         self.prefill_chunks = 0
         self.prefill_resumes = 0
         self.migrations = 0
@@ -221,33 +221,48 @@ class PrefillEngine:
                     "prefill arena exhausted — in-flight admissions "
                     "plus trie-held pages left no room"
                 )
-            t_admit = time.perf_counter()
-            admit_s = t_admit - t_lock
             ids, shared_n = grant
-            if shared_n:
-                cache, _f, first, _d, seen = self.pool.prefill_shared(
-                    prompt, ids[:shared_n], rng
-                )
-            else:
-                cache, _f, first, _d, seen = (
-                    # tpulint: disable=TPU003 — exclusive if/else arms:
-                    # exactly ONE of prefill_shared/prefill_row consumes
-                    # this request's rng.
-                    slots_mod.prefill_row(
-                        self.pool.row_model, self.pool.params, prompt,
-                        rng, sampling=self.pool.sampling,
-                        eos_id=self._eos, pad_to=len(prompt),
-                    )
-                )
+            inserted = False
             slot = 0  # transient occupancy: insert -> export -> release
-            self.pool.insert_paged(
-                slot, cache, first, len(prompt), max_new - 1,
-                ids, shared_n, row_seen=seen,
-            )
-            self.pool.register_prefix(prompt, ids)
-            t_compute = time.perf_counter()
-            compute_s = t_compute - t_admit
-            state = self.pool.export_slot(slot)
+            try:
+                t_admit = time.perf_counter()
+                admit_s = t_admit - t_lock
+                if shared_n:
+                    cache, _f, first, _d, seen = (
+                        self.pool.prefill_shared(
+                            prompt, ids[:shared_n], rng
+                        )
+                    )
+                else:
+                    cache, _f, first, _d, seen = (
+                        # tpulint: disable=TPU003 — exclusive if/else
+                        # arms: exactly ONE of prefill_shared/
+                        # prefill_row consumes this request's rng.
+                        slots_mod.prefill_row(
+                            self.pool.row_model, self.pool.params,
+                            prompt, rng, sampling=self.pool.sampling,
+                            eos_id=self._eos, pad_to=len(prompt),
+                        )
+                    )
+                self.pool.insert_paged(
+                    slot, cache, first, len(prompt), max_new - 1,
+                    ids, shared_n, row_seen=seen,
+                )
+                inserted = True
+                self.pool.register_prefix(prompt, ids)
+                t_compute = time.perf_counter()
+                compute_s = t_compute - t_admit
+                state = self.pool.export_slot(slot)
+            except BaseException:
+                # The grant must not outlive a failed prefill/export
+                # (TPU019): pre-insert the pages are still owned by
+                # this frame, post-insert the transient slot owns
+                # them — release whichever holder is live.
+                if inserted:
+                    self.pool.release_slot(slot)
+                else:
+                    self.pool.release_pages(ids)
+                raise
             self.pool.release_slot(slot)
             export_s = time.perf_counter() - t_compute
             # Stage timings seal into the header BEFORE encode: the
@@ -380,26 +395,46 @@ class PrefillEngine:
                         "chunked admissions never drained"
                     )
                 self._cv.wait(0.25)
-            self._reserved += n_prompt_pages
-            self.prefill_inflight += 1
             job_index = self._job_index
             self._job_index += 1
+            t0 = time.monotonic()
+            # Raise-capable work (rng fold, start_chunked) runs AFTER
+            # the reservation only under exception cover: a failure
+            # here must hand back the counters it bumped, or the door
+            # predicate above wedges every later admission (TPU019/
+            # TPU021 — the queue-wait-leak bug class from PR 11).
             rng = jax.random.fold_in(
                 jax.random.key(self._seed_base), job_index
             )
-            t0 = time.monotonic()
-            cp = self.pool.start_chunked(
-                prompt, len(prompt), rng, self.prefill_chunk_pages
-            )
-            if cp.resumed:
-                self.prefill_resumes += 1
-            admit_s = time.perf_counter() - t_lock
+            self._reserved += n_prompt_pages
+            self.prefill_inflight += 1
+            cp = None
+            try:
+                cp = self.pool.start_chunked(
+                    prompt, len(prompt), rng, self.prefill_chunk_pages
+                )
+                if cp.resumed:
+                    self.prefill_resumes += 1
+                admit_s = time.perf_counter() - t_lock
+            except BaseException:
+                # abandon_chunked may itself raise; the counter
+                # restitution must survive that or the door predicate
+                # wedges (TPU021).
+                try:
+                    if cp is not None:
+                        self.pool.abandon_chunked(cp)
+                finally:
+                    self._reserved -= n_prompt_pages
+                    self.prefill_inflight -= 1
+                    self._cv.notify_all()
+                raise
         chunk_w = max(1, self.prefill_chunk_pages) * self.pool.page
-        token = _ChunkTicket(
-            remaining=-(-(len(prompt) - cp.cursor) // chunk_w),
-            seq=job_index,
-        )
+        token = None
         try:
+            token = _ChunkTicket(
+                remaining=-(-(len(prompt) - cp.cursor) // chunk_w),
+                seq=job_index,
+            )
             queue_chunks_s = 0.0
             compute_s = 0.0
             t_mark = time.perf_counter()
@@ -536,10 +571,14 @@ class PrefillEngine:
             raise
         finally:
             with self._cv:
-                if token in self._rr:  # failure paths still hold one
-                    self._rr.remove(token)
+                # Counters first: nothing before them may raise, or a
+                # failed ticket teardown would wedge the door
+                # predicate forever (TPU021).
                 self._reserved -= n_prompt_pages
                 self.prefill_inflight -= 1
+                if token is not None and token in self._rr:
+                    # failure paths still hold a queue ticket
+                    self._rr.remove(token)
                 self._cv.notify_all()
 
 
@@ -869,7 +908,7 @@ class DecodeEngine:
             cp = self.pool.start_chunked(
                 prompt, need, rng, self.prefill_chunk_pages
             )
-            self._jobs[slot] = {
+            self._jobs[slot] = {  # resource: transfers pages
                 "tokens": [],
                 "budget": max_new - 1,
                 "done": False,
@@ -969,6 +1008,10 @@ class DecodeEngine:
                 # Every piggyback prefill is stalled on pages and no
                 # decode slot is live to free any: sleep on the
                 # condition instead of spinning until a release lands.
+                # tpulint: disable=TPU020 — deliberate timed backoff,
+                # not a predicate wait: the caller's collect loop IS
+                # the enclosing retry loop, and a spurious wakeup just
+                # re-polls the stall condition one tick early.
                 self._cv.wait(0.001)
             return
         use_spec = self._ema is not None and self._ema.use_spec(

@@ -485,7 +485,7 @@ class RouterServer:
             )
         )
         self._lock = threading.Lock()
-        self._inflight = 0
+        self._inflight = 0  # resource: counter inflight-credit
         self._last_reprobe = time.monotonic()
         self._states: Dict[str, ReplicaState] = {}
         for client in self._prefill:
@@ -690,6 +690,7 @@ class RouterServer:
             ev.set()
 
     def _admit(self, tenant: str, cost: float, timeout: float) -> bool:
+        # resource: acquires inflight-credit
         ev = threading.Event()
         ev.abandoned = False
         with self._lock:
@@ -713,6 +714,7 @@ class RouterServer:
         return False
 
     def _release(self) -> None:
+        # resource: releases inflight-credit
         with self._lock:
             self._inflight -= 1
             self._pump_locked()
@@ -870,11 +872,16 @@ class RouterServer:
         tq0 = time.perf_counter()
         if not self._admit(tenant, cost, timeout=600.0):
             return 503, {"error": "queue wait timed out"}, trace_hdr
-        queue_s = time.perf_counter() - tq0
-        reqtrace.stage(
-            self._tracer, ctx, "req_queue_wait", queue_s, role="router"
-        )
         try:
+            # Everything after a granted credit runs under the
+            # release-guaranteeing try: a raise in even the trace
+            # plumbing would otherwise strand the inflight slot and
+            # shrink the router's effective cap forever (TPU019).
+            queue_s = time.perf_counter() - tq0
+            reqtrace.stage(
+                self._tracer, ctx, "req_queue_wait", queue_s,
+                role="router",
+            )
             ta0 = time.perf_counter()
             self._reprobe_unhealthy()
             name, pname, reason = self._pick(session, n_pages)

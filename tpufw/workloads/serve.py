@@ -766,6 +766,10 @@ class _Batcher:
                 else:
                     tick.append(nxt)
                     rows += len(nxt.prompts)
+            # tpulint: disable=TPU020 — consumer-side pop: shrinking
+            # the queue only makes the wait predicate ("queue
+            # non-empty") falser; there is no waiter this write could
+            # unblock, so a notify would be a spurious wakeup.
             self._queue = rest
             return tick
 
@@ -1165,7 +1169,7 @@ class _SlotScheduler:
         self._pool = None  # tpufw.infer.slots.SlotPool (lazy, keyed)
         self._pool_key: Optional[tuple] = None
         self._slots: list[Optional[_SlotJob]] = [None] * self.n_slots
-        self._n_active = 0
+        self._n_active = 0  # resource: counter slots-occupied
         # Monotonic indices namespacing the rng streams (fold_in of
         # two DIFFERENT base seeds, so prefill and chunk draws never
         # collide); both restored by reset_after_warmup so warmup is
@@ -1581,6 +1585,11 @@ class _SlotScheduler:
                 if req.next_job < len(req.jobs) and req.error is None:
                     budget_closed = True
             with self._cv:
+                # tpulint: disable=TPU020 — consumer-side sweep of
+                # finished/failed requests: removal only makes the
+                # scheduler's own "queue non-empty" predicate falser;
+                # completion waiters watch req.done events, not this
+                # list, so there is nobody to notify.
                 self._queue = [
                     r
                     for r in self._queue
@@ -1715,25 +1724,33 @@ class _SlotScheduler:
         cp = self._pool.start_chunked(
             job.prompt, need, rng, self.prefill_chunk_pages
         )
-        if self.prefix_enabled:
-            hit = cp.shared_n > 0
-            if self._metrics is not None:
-                self._metrics.inc(
-                    "prefix_hits_total" if hit else "prefix_misses_total"
+        try:
+            if self.prefix_enabled:
+                hit = cp.shared_n > 0
+                if self._metrics is not None:
+                    self._metrics.inc(
+                        "prefix_hits_total" if hit
+                        else "prefix_misses_total"
+                    )
+                    if hit:
+                        # Trie hits ARE the resume path: a preempted
+                        # prefill's checkpointed pages come back here.
+                        self._metrics.registry.counter(
+                            "tpufw_prefill_resumes_total"
+                        ).inc()
+                self._events.emit(
+                    "serve_prefix",
+                    hit=hit,
+                    shared_pages=cp.shared_n,
+                    prompt_tokens=len(job.prompt),
                 )
-                if hit:
-                    # Trie hits ARE the resume path: a preempted
-                    # prefill's checkpointed pages come back here.
-                    self._metrics.registry.counter(
-                        "tpufw_prefill_resumes_total"
-                    ).inc()
-            self._events.emit(
-                "serve_prefix",
-                hit=hit,
-                shared_pages=cp.shared_n,
-                prompt_tokens=len(job.prompt),
-            )
-        job.cp = cp
+        except BaseException:
+            # The caller's isolate-req handler swallows this raise
+            # (_fail_req): the cursor's page refs would leak silently
+            # if the metrics/event plumbing failed here (TPU019).
+            self._free_pages(self._pool.abandon_chunked(cp))
+            raise
+        job.cp = cp  # resource: transfers pages
         self._slots[slot] = job
         self._n_active += 1
         self._set_prefill_inflight()
@@ -1756,6 +1773,7 @@ class _SlotScheduler:
         consumed. ``grant`` is the paged mode's (page_ids, shared_n)
         from acquire_pages — this method owns releasing it on the
         early-finish path (the caller releases on exceptions)."""
+        # resource: transfers pages
         jax = self._jax
         # Namespaced, replayable prefill stream: a fresh base key per
         # call, folded with the monotonic job index. The paged shared
