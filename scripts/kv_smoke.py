@@ -1,0 +1,340 @@
+"""CI smoke for the global KV fabric: prefix-affinity routing and
+zero-divergence session migration across a two-replica decode pool,
+ganged in ONE process on CPU.
+
+The replicas are real engines (tpufw.serve.roles, llama3_tiny random
+init, int8 KV so quantized codes + scales cross every boundary); the
+router talks to them through ``LocalReplica``, the same client
+interface TcpReplica gives it in a cluster. Drain is invoked directly
+(``DecodeEngine.drain()`` — the exact body the SIGTERM handler runs)
+because killing the shared CI process would end the smoke too. What
+must hold:
+
+- prefix-affinity routing: after one piggybacked request builds a
+  replica's radix trie, a COLD prompt sharing the prefix (different
+  session, different tail) routes to THAT replica — even though pure
+  occupancy scoring would pick the emptier peer — and its chunked
+  prefill attaches the shared pages (pool.prefix_hits advances, and
+  the router counts the steer on
+  tpufw_router_prefix_affinity_hits_total);
+- zero-divergence resumption: a sticky session decoding on replica A
+  is drained mid-request (scale-in semantics); A exports the
+  session's slot to the shared spill directory, the router re-homes
+  the request onto surviving replica B through the normal splice
+  path, and the client receives EXACTLY the token stream an
+  undisturbed control run produces — plus ``resumed: true`` and the
+  survivor's name;
+- the drained replica leaves rotation (/healthz shows ``draining``)
+  and the router's /metrics counts the re-home;
+- the KV-fabric ledger digests: serve_spill + router_rehome events
+  land in events-router.jsonl and obs_summary prints the kv fabric
+  section.
+
+Exit 0 on success; any assertion failure exits nonzero. Honors
+TPUFW_TELEMETRY_DIR so CI can upload the artifacts.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+)
+
+MAX_NEW = 6
+RESUME_NEW = 24
+PAGE = 16
+
+# http: claims
+
+
+def _post(base: str, body: dict):
+    """(status, parsed-body, headers) — 4xx/5xx included, not raised."""
+    req = urllib.request.Request(
+        base + "/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return resp.status, json.loads(resp.read()), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), e.headers
+
+
+def main() -> int:
+    # wire: produces router-request
+    # wire: consumes router-response via body
+    import jax
+    import jax.numpy as jnp
+
+    from tpufw.infer import SamplingConfig
+    from tpufw.infer.spill import SpillTier
+    from tpufw.models import LLAMA_CONFIGS, Llama
+    from tpufw.obs.events import EventLog, read_events
+    from tpufw.serve.roles import DecodeEngine, PrefillEngine
+    from tpufw.serve.router import (
+        LocalReplica,
+        RouterPolicy,
+        RouterServer,
+    )
+
+    greedy = SamplingConfig(temperature=0.0)
+    cfg = dataclasses.replace(
+        LLAMA_CONFIGS["llama3_tiny"].decode_config(), max_seq_len=64
+    )
+    model = Llama(cfg)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    from tpufw.workloads.env import env_opt_str
+
+    tdir = env_opt_str("telemetry_dir") or tempfile.mkdtemp(
+        prefix="tpufw-kv-smoke-"
+    )
+    os.makedirs(tdir, exist_ok=True)
+    events = EventLog(os.path.join(tdir, "events-router.jsonl"))
+    failures: list[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        print(("ok: " if ok else "FAILED: ") + what)
+        if not ok:
+            failures.append(what)
+
+    shared = list(range(40, 72))  # 32 tokens = 2 full trie pages
+
+    # ---- prefix-affinity routing across a two-replica pool ----
+    # No dedicated prefill replica: every request piggybacks, so the
+    # serving replica's chunked prefill checkpoints the prompt into
+    # its OWN trie — the state the affinity digests advertise.
+    aff_dir = os.path.join(tdir, "spill-aff")
+    pig = dict(
+        n_slots=4, chunk=2, prefill_chunk_pages=1, piggyback=0.05,
+        affinity_k=2, sampling=greedy, page=PAGE, kv_quant="int8",
+        events=events,
+    )
+    de_a = DecodeEngine(
+        model, params, spill=SpillTier(64, aff_dir), **pig
+    )
+    de_b = DecodeEngine(
+        model, params, spill=SpillTier(64, aff_dir), **pig
+    )
+    aff_router = RouterServer(
+        [],
+        [LocalReplica("decode-a", de_a), LocalReplica("decode-b", de_b)],
+        policy=RouterPolicy(affinity_k=2),
+        port=0, page=PAGE, events=events, spill_dir=aff_dir,
+    )
+    abase = f"http://127.0.0.1:{aff_router.port}"
+    status, warm, _h = _post(abase, {
+        "prompt": shared + [7, 9], "max_new": MAX_NEW,
+        "tenant": "smoke", "session": "aff0",
+    })
+    check(
+        status == 200 and warm.get("piggyback") is True,
+        f"warm request piggybacked onto {warm.get('replica')} "
+        f"(got {status})",
+    )
+    first_home = warm.get("replica")
+    status, body, _h = _post(abase, {
+        "prompt": shared + [11, 3], "max_new": MAX_NEW,
+        "tenant": "smoke", "session": "aff1",
+    })
+    check(
+        status == 200 and body.get("replica") == first_home,
+        "cold prompt sharing the prefix steered to the replica "
+        f"holding it (got {body.get('replica')}, "
+        f"trie home {first_home}) — occupancy alone would pick the "
+        "emptier peer",
+    )
+    holder = de_a if first_home == "decode-a" else de_b
+    check(
+        holder.pool.prefix_hits >= 1,
+        "affinity landed on a real trie hit "
+        f"(prefix_hits={holder.pool.prefix_hits}, "
+        f"prefix_misses={holder.pool.prefix_misses})",
+    )
+    with urllib.request.urlopen(abase + "/metrics", timeout=60) as resp:
+        aff_metrics = resp.read().decode()
+    aff_line = next(
+        (
+            line for line in aff_metrics.splitlines()
+            if line.startswith("tpufw_router_prefix_affinity_hits_total")
+        ),
+        "",
+    )
+    check(
+        aff_line and float(aff_line.split()[-1]) >= 1,
+        f"router counted the affinity steer ({aff_line!r})",
+    )
+    aff_router.close()
+
+    # ---- zero-divergence drain -> re-home -> resume ----
+    # Control: an undisturbed run of the same prompt through fresh
+    # engines (fresh prefill on purpose: a trie hit under int8
+    # recomputes the suffix over dequantized KV, so only COLD-vs-COLD
+    # prefills are comparable bit-for-bit).
+    mig_prompt = shared + [7, 9]
+    common = dict(sampling=greedy, page=PAGE, kv_quant="int8",
+                  events=events)
+    pe_ctl = PrefillEngine(model, params, n_slots=2, **common)
+    de_ctl = DecodeEngine(model, params, n_slots=4, chunk=2, **common)
+    ctl_router = RouterServer(
+        [LocalReplica("prefill-0", pe_ctl)],
+        [LocalReplica("decode-0", de_ctl)],
+        port=0, page=PAGE, events=events,
+    )
+    status, ctl, _h = _post(
+        f"http://127.0.0.1:{ctl_router.port}",
+        {"prompt": mig_prompt, "max_new": RESUME_NEW, "tenant": "smoke"},
+    )
+    ctl_router.close()
+    check(
+        status == 200 and len(ctl.get("tokens", [])) == RESUME_NEW,
+        f"control run decoded {RESUME_NEW} tokens (got {status})",
+    )
+
+    mig_dir = os.path.join(tdir, "spill-mig")
+    pe_live = PrefillEngine(model, params, n_slots=2, **common)
+    de_live_a = DecodeEngine(
+        model, params, n_slots=4, chunk=2,
+        spill=SpillTier(64, mig_dir), **common
+    )
+    de_live_b = DecodeEngine(
+        model, params, n_slots=4, chunk=2,
+        spill=SpillTier(64, mig_dir), **common
+    )
+    live_router = RouterServer(
+        [LocalReplica("prefill-0", pe_live)],
+        [
+            LocalReplica("decode-a", de_live_a),
+            LocalReplica("decode-b", de_live_b),
+        ],
+        port=0, page=PAGE, events=events, spill_dir=mig_dir,
+    )
+    lbase = f"http://127.0.0.1:{live_router.port}"
+    result: dict = {}
+
+    def _request():
+        result["resp"] = _post(lbase, {
+            "prompt": mig_prompt, "max_new": RESUME_NEW,
+            "tenant": "smoke", "session": "mig",
+        })
+
+    t = threading.Thread(target=_request)
+    t.start()
+    # decode-a wins the tie-broken pick; drain it the moment the
+    # session's slot is live (splice landed, decode chunks running —
+    # on a cold replica the chunk compiles mid-request, so the window
+    # is wide). Scale-in never waits for a quiet moment either.
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        with de_live_a._cv:
+            busy = any(
+                not j["done"] for j in de_live_a._jobs.values()
+            )
+        if busy:
+            break
+        time.sleep(0.002)
+    check(busy, "session went live on decode-a before the drain")
+    drained = de_live_a.drain()  # the SIGTERM handler's exact body
+    t.join(timeout=600.0)
+    status, body, _h = result.get("resp", (0, {}, None))
+    check(
+        "mig" in drained.get("sessions", []),
+        f"drain exported the live session ({drained})",
+    )
+    check(
+        status == 200 and body.get("resumed") is True
+        and body.get("replica") == "decode-b",
+        "request survived the drain: re-homed onto decode-b "
+        f"(got {status}, resumed={body.get('resumed')}, "
+        f"replica={body.get('replica')})",
+    )
+    check(
+        body.get("tokens") == ctl.get("tokens"),
+        "ZERO token divergence vs the undisturbed control "
+        f"(got {body.get('tokens')} vs {ctl.get('tokens')})",
+    )
+    check(
+        de_live_a.sessions_drained == 1
+        and de_live_b.sessions_resumed == 1,
+        "both engines account the migration "
+        f"(drained={de_live_a.sessions_drained}, "
+        f"resumed={de_live_b.sessions_resumed})",
+    )
+    check(
+        de_live_b.pool.allocator.in_use == 0,
+        "survivor returned every page after retire "
+        f"(in_use={de_live_b.pool.allocator.in_use})",
+    )
+    with urllib.request.urlopen(lbase + "/healthz", timeout=60) as resp:
+        health = json.loads(resp.read())
+    check(
+        health["replicas"]["decode-a"].get("draining") is True,
+        "/healthz shows decode-a out of rotation (draining)",
+    )
+    with urllib.request.urlopen(lbase + "/metrics", timeout=60) as resp:
+        metrics = resp.read().decode()
+    check(
+        "tpufw_router_session_rehomes_total 1" in metrics,
+        "router counted the re-home on /metrics",
+    )
+    live_router.close()
+
+    # ---- KV-fabric ledger digests ----
+    ev = read_events(os.path.join(tdir, "events-router.jsonl"))
+    spills = [e for e in ev if e.get("kind") == "serve_spill"]
+    rehomes = [e for e in ev if e.get("kind") == "router_rehome"]
+    check(
+        any(
+            e.get("entry") == "session" and e.get("direction") == "out"
+            for e in spills
+        ),
+        f"drain emitted the session spill event ({len(spills)} "
+        "serve_spill record(s))",
+    )
+    check(
+        len(rehomes) == 1 and rehomes[0].get("replica") == "decode-b",
+        f"router emitted the re-home event ({rehomes})",
+    )
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "obs_summary.py"),
+         tdir],
+        capture_output=True, text=True, timeout=120,
+    )
+    print(proc.stdout, end="")
+    check(
+        proc.returncode == 0 and "kv fabric" in proc.stdout
+        and "re-home" in proc.stdout,
+        "obs_summary digests the kv-fabric ledger",
+    )
+
+    events.close()
+    if failures:
+        print(f"kv-smoke FAILED ({len(failures)} check(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("kv-smoke OK: affinity steered the shared prefix home, and "
+          "a drained replica's session resumed with zero divergence")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

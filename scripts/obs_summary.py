@@ -168,6 +168,58 @@ def summarize_router(paths: list[str]) -> None:
         )
 
 
+def summarize_kv_fabric(paths: list[str]) -> None:
+    """KV-fabric digest: pages crossing the HBM/host-RAM boundary
+    (serve_spill events, both directions), session drains, and
+    router re-homes. Prints nothing when the run never spilled."""
+    events = []
+    for p in paths:
+        try:
+            events.extend(read_events(p))
+        except OSError:
+            continue
+    spills = [e for e in events if e.get("kind") == "serve_spill"]
+    rehomes = [e for e in events if e.get("kind") == "router_rehome"]
+    if not spills and not rehomes:
+        return
+    print("-- kv fabric --")
+    for entry in ("trie", "session"):
+        moves = [e for e in spills if e.get("entry") == entry]
+        if not moves:
+            continue
+        dirs = collections.Counter(
+            e.get("direction", "?") for e in moves
+        )
+        pages = sum(e.get("pages", 0) or 0 for e in moves)
+        total_b = sum(e.get("bytes", 0) or 0 for e in moves)
+        sessions = sum(e.get("sessions", 0) or 0 for e in moves)
+        dropped = sum(e.get("dropped", 0) or 0 for e in moves)
+        walls = sorted(
+            e["wall_s"]
+            for e in moves
+            if isinstance(e.get("wall_s"), (int, float))
+        )
+        line = (
+            f"  {len(moves)} {entry} spill move(s) "
+            f"({', '.join(f'{d}={n}' for d, n in sorted(dirs.items()))})"
+        )
+        if pages:
+            line += f": {pages} pages, {_fmt_count(total_b)}B"
+        if sessions or dropped:
+            line += f": {sessions} session(s) exported, {dropped} dropped"
+        if walls:
+            line += f", p95 wall {_fmt_s(_percentile(walls, 0.95))}"
+        print(line)
+    if rehomes:
+        where = collections.Counter(
+            e.get("replica", "?") for e in rehomes
+        )
+        print(
+            f"  {len(rehomes)} session re-home(s): "
+            + ", ".join(f"{r}={n}" for r, n in sorted(where.items()))
+        )
+
+
 def summarize_spec(paths: list[str]) -> None:
     """Speculative-decoding digest from serve_spec events: how many
     verify passes ran, what fraction of drafted tokens the target
@@ -373,6 +425,10 @@ def summarize_metrics(path: str) -> None:
         "tpufw_router_requests_total",
         "tpufw_router_rejects_total",
         "tpufw_router_decode_pages_free",
+        "tpufw_router_prefix_affinity_hits_total",
+        "tpufw_router_session_rehomes_total",
+        "tpufw_kv_spill_pages",
+        "tpufw_kv_spill_bytes_total",
         "tpufw_slo_ttft_attainment",
         "tpufw_slo_tok_attainment",
         "tpufw_slo_requests_total",
@@ -554,6 +610,9 @@ def main(argv: list[str]) -> int:
     print("-- events --")
     summarize_events(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
     summarize_router(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
+    summarize_kv_fabric(
+        sorted(glob.glob(os.path.join(out, "events*.jsonl")))
+    )
     summarize_spec(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
     summarize_slo(sorted(glob.glob(os.path.join(out, "events*.jsonl"))))
     print("-- spans (total time) --")

@@ -77,6 +77,35 @@ def test_bundle_seen_row_roundtrip():
     assert back["paths"][-1] != "seen"
 
 
+def test_bundle_optional_session_fields_roundtrip():
+    # KV fabric: a drained replica stamps the sticky session id and
+    # the tokens it already emitted into the header so the survivor
+    # can resume mid-stream. Both are OPTIONAL — VERSION stays 1 and
+    # pre-fabric decoders ignore them.
+    state = _state(np.float32)
+    state["session"] = "mig-42"
+    state["tokens"] = [5, 6, 7]
+    data = encode_bundle(state)
+    assert struct.unpack(">H", data[4:6])[0] == 1  # wire version pinned
+    back = decode_bundle(data)
+    assert back["session"] == "mig-42"
+    assert back["tokens"] == [5, 6, 7]
+    # A plain migration bundle omits them; decode yields None, not a
+    # KeyError (the roles-side resume check is `tokens is not None`).
+    plain = decode_bundle(encode_bundle(_state(np.float32)))
+    assert plain["session"] is None and plain["tokens"] is None
+    # Mistyped values are rejected by the same schema pass as every
+    # other header field.
+    hdr_end = 10 + struct.unpack(">I", data[6:10])[0]
+    hjson = json.loads(data[10:hdr_end])
+    hjson["session"] = 7
+    bad = json.dumps(hjson, sort_keys=True).encode("utf-8")
+    rebuilt = data[:6] + struct.pack(">I", len(bad)) + bad + data[hdr_end:-4]
+    rebuilt += struct.pack(">I", zlib.crc32(rebuilt) & 0xFFFFFFFF)
+    with pytest.raises(BundleError, match="session"):
+        decode_bundle(rebuilt)
+
+
 def test_bundle_checksum_tamper_rejected():
     data = bytearray(encode_bundle(_state(np.float32)))
     data[len(data) // 2] ^= 0x40  # flip one payload bit in flight

@@ -11,6 +11,7 @@ tests/test_migrate.py.
 import pytest
 
 from tpufw.cluster.discovery import discover_replicas
+from tpufw.serve.bundle import chunk_digests, load_session, store_session
 from tpufw.serve.router import (
     ReplicaState,
     RouterPolicy,
@@ -181,7 +182,7 @@ class _StubPrefill:
             "migrations": 0,
         }
 
-    def prefill(self, prompt, max_new, trace=None):
+    def prefill(self, prompt, max_new, trace=None, session=None):
         self.calls += 1
         self.last_trace = trace
         if self.fail:
@@ -428,5 +429,152 @@ def test_generate_counts_tokens_total():
         assert code == 200 and body["tokens"] == [7, 8]
         text = srv.render_metrics()
         assert "tpufw_router_tokens_total 2" in text
+    finally:
+        srv.close()
+
+
+# ------------------------------------------- KV fabric: affinity
+
+PAGE = 16
+
+
+def test_affinity_depth_is_deepest_advertised_chunk():
+    digests = chunk_digests(list(range(3 * PAGE)), PAGE, 4)
+    assert len(digests) == 3
+    r = _decode("d0")
+    assert RouterPolicy.affinity_depth(r, digests) == 0
+    r.prefix_digests = tuple(digests[:2])
+    assert RouterPolicy.affinity_depth(r, digests) == 2
+    # Digests are cumulative: advertising only the DEEPEST one still
+    # means the replica holds chunks 0..2 (a trie path's tip digest
+    # covers the whole path).
+    r.prefix_digests = (digests[2],)
+    assert RouterPolicy.affinity_depth(r, digests) == 3
+    r.prefix_digests = ("not-a-digest",)
+    assert RouterPolicy.affinity_depth(r, digests) == 0
+    assert RouterPolicy.affinity_depth(r, []) == 0
+
+
+def test_pick_decode_prefers_digest_match_over_occupancy():
+    digests = chunk_digests(list(range(2 * PAGE)), PAGE, 4)
+    p = RouterPolicy(affinity_k=4)
+    holder = _decode("d0", used=20)  # busier, but holds the prefix
+    empty = _decode("d1", used=0)
+    holder.prefix_digests = tuple(digests)
+    # Occupancy alone picks the empty replica...
+    name, _ = p.pick_decode("", [holder, empty], 2)
+    assert name == "d1" and p.affinity_hits == 0
+    # ...the digest match out-ranks the load gap and is counted.
+    name, _ = p.pick_decode("", [holder, empty], 2, digests=digests)
+    assert name == "d0" and p.affinity_hits == 1
+    # Prefill pick ranks the same way.
+    pf_cold = ReplicaState("p0", "prefill", pages_total=9, pages_in_use=0)
+    pf_warm = ReplicaState("p1", "prefill", pages_total=9, pages_in_use=5)
+    pf_warm.prefix_digests = (digests[-1],)
+    assert p.pick_prefill([pf_cold, pf_warm], digests=digests) == "p1"
+
+
+def test_session_stickiness_beats_prefix_affinity():
+    digests = chunk_digests(list(range(2 * PAGE)), PAGE, 4)
+    p = RouterPolicy(affinity_k=4)
+    d0, d1 = _decode("d0"), _decode("d1")
+    name, _ = p.pick_decode("sess", [d0, d1], 2)
+    other = {"d0": d1, "d1": d0}[name]
+    # The other replica now advertises the session's whole prefix —
+    # the pin still wins (the session's OWN pages out-rank a shared
+    # prefix copy).
+    other.prefix_digests = tuple(digests)
+    again, _ = p.pick_decode("sess", [d0, d1], 2, digests=digests)
+    assert again == name
+
+
+def test_piggyback_prefers_digest_match():
+    digests = chunk_digests(list(range(2 * PAGE)), PAGE, 4)
+    p = RouterPolicy(affinity_k=4)
+
+    def pig(name, used):
+        r = _decode(name, used=used)
+        r.prefill_chunk_pages = 2
+        r.piggyback_waterline = 0.1
+        return r
+
+    holder, empty = pig("d0", 12), pig("d1", 0)
+    holder.prefix_digests = tuple(digests)
+    assert p.pick_piggyback([holder, empty], 2) == "d1"
+    assert p.pick_piggyback([holder, empty], 2, digests=digests) == "d0"
+
+
+# ---------------------------------------- KV fabric: drain/re-home
+
+def test_draining_replica_refused_by_every_picker():
+    p = RouterPolicy()
+    live, leaving = _decode("d0", used=30), _decode("d1", used=0)
+    leaving.draining = 1
+    leaving.prefill_chunk_pages = 2
+    leaving.piggyback_waterline = 0.1
+    assert not p.decode_fits(leaving, 1)
+    assert not p.piggyback_fits(leaving, 1)
+    assert p.pick_piggyback([leaving], 1) is None
+    pf = ReplicaState("p0", "prefill", pages_total=9, draining=1)
+    assert p.pick_prefill([pf]) is None
+    # A session pinned to the draining replica re-homes to the
+    # survivor instead of 429ing.
+    p.pin_session("s", "d1")
+    name, reason = p.pick_decode("s", [live, leaving], 1)
+    assert name == "d0" and reason == ""
+
+
+class _DrainingDecode(_StubDecode):
+    """First decode() reply reports the replica drained mid-request
+    (partial tokens, session exported to the spill store)."""
+
+    def decode(self, bundle):
+        self.calls += 1
+        return {
+            "tokens": [1], "drained": True, "session": "mig",
+            **self.signals(), "draining": 1,
+        }
+
+
+def test_drained_reply_rehomes_session_from_spill_store(tmp_path):
+    # wire: consumes session-bundle via spill-store
+    store_session(str(tmp_path), "mig", b"TPFB-session-bundle")
+    srv = RouterServer(
+        [_StubPrefill("p0")],
+        [_DrainingDecode("d0"), _StubDecode("d1")],
+        port=0, spill_dir=str(tmp_path),
+    )
+    try:
+        code, body, _h = srv.generate(
+            {"prompt": [1, 2, 3], "max_new": 4, "session": "mig"}
+        )
+        # d0 (name-order winner) drained; the router re-read the
+        # exported bundle and finished on d1 via the normal decode
+        # path.
+        assert code == 200
+        assert body["resumed"] is True and body["replica"] == "d1"
+        assert body["tokens"] == [7, 8]
+        # The bundle is consumed, the pin moved, the drain latched.
+        assert load_session(str(tmp_path), "mig") is None
+        assert srv.policy._affinity["mig"] == "d1"
+        with srv._lock:
+            assert srv._states["d0"].draining
+        text = srv.render_metrics()
+        assert "tpufw_router_session_rehomes_total 1" in text
+        h = srv.health()
+        assert h["replicas"]["d0"]["draining"] is True
+    finally:
+        srv.close()
+
+
+def test_drained_reply_without_spill_store_is_an_error():
+    srv = RouterServer(
+        [_StubPrefill("p0")], [_DrainingDecode("d0")], port=0,
+    )
+    try:
+        code, body, _h = srv.generate(
+            {"prompt": [1], "max_new": 2, "session": "mig"}
+        )
+        assert code == 502 and "draining" in body["error"]
     finally:
         srv.close()

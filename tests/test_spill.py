@@ -1,0 +1,339 @@
+"""KV fabric: host-RAM page-spill tier (tpufw.infer.spill), prefix
+digests + session store (tpufw.serve.bundle), and the arena
+spill/restore path (tpufw.infer.pages via tpufw.serve.roles).
+
+Layered like the fabric itself:
+
+- SpillTier unit contracts — pure stdlib, no jax: LRU accounting in
+  pages, demote-to-disk past the RAM budget, transparent reload,
+  consume-on-pop, session write-through landing on the SAME path the
+  router's ``session_path`` computes, torn-file drop.
+- Digest contracts — ``chunk_digests`` is the jax-free affinity
+  identity (cumulative, page-aligned, k-capped);
+  ``advertised_digests`` covers resident AND spilled paths and only
+  recomputes when the trie version or spill counters move.
+- PARITY (the tentpole's acceptance bar): a trie page evicted to the
+  spill tier and restored through the normal splice path is
+  BIT-EQUAL to the bytes that left — at bf16 and at int8 (codes +
+  page-structured scales travel raw) — with zero retraces, and a
+  drained decode slot resumed from its session bundle emits EXACTLY
+  the undisturbed run's greedy tokens.
+"""
+
+import dataclasses
+import os
+
+import pytest
+
+from tpufw.infer.spill import SpillTier, key_name, trie_key
+from tpufw.serve.bundle import (
+    chunk_digests,
+    advertised_digests,
+    drop_session,
+    load_session,
+    session_path,
+    store_session,
+)
+
+PAGE = 16
+MAX_NEW = 6
+
+
+# ------------------------------------------------------- SpillTier
+
+def _blob(n_bytes=64, fill=0x5A):
+    return bytes([fill]) * n_bytes
+
+
+def test_spill_lru_demotes_to_disk_and_reloads(tmp_path):
+    tier = SpillTier(2, str(tmp_path), persist_kinds=())
+    tier.put("trie", "a", _blob(fill=1), 1)
+    tier.put("trie", "b", _blob(fill=2), 1)
+    tier.put("trie", "c", _blob(fill=3), 1)  # RAM 3 > 2: "a" demotes
+    st = tier.stats()
+    assert st["ram_pages"] == 2 and st["dir_pages"] == 1
+    assert os.path.exists(tmp_path / key_name("trie", "a"))
+    # get() reloads the demoted entry transparently, bytes intact.
+    assert tier.get("trie", "a") == _blob(fill=1)
+    assert tier.get("trie", "b") == _blob(fill=2)
+    # pop removes RAM and disk; consumed entries count as restores.
+    tier.pop("trie", "a")
+    assert not os.path.exists(tmp_path / key_name("trie", "a"))
+    assert ("trie", "a") not in tier
+    assert tier.restored_total == 1
+    assert tier.stats()["spilled_pages_total"] == 3
+
+
+def test_spill_without_directory_drops_lru():
+    tier = SpillTier(2, "")
+    for i, name in enumerate(("a", "b", "c")):
+        tier.put("trie", name, _blob(fill=i), 1)
+    assert tier.get("trie", "a") is None  # dropped, nowhere to demote
+    assert tier.dropped_total == 1
+    assert tier.get("trie", "c") == _blob(fill=2)
+    # get() touches LRU order: "b" was just read via... (c admitted
+    # last, b oldest now) — another put evicts the LRU, which is "b".
+    assert tier.get("trie", "b") is not None
+    tier.put("trie", "d", _blob(), 1)
+    assert tier.get("trie", "c") is None and tier.get("trie", "b")
+
+
+def test_spill_session_write_through_matches_router_path(tmp_path):
+    # Sessions persist at put time — they must survive the draining
+    # PROCESS — and land on the exact path bundle.session_path gives
+    # the (jax-free) router.
+    tier = SpillTier(64, str(tmp_path))
+    tier.put("session", "user-42", b"SESSBYTES", 3)
+    assert load_session(str(tmp_path), "user-42") == b"SESSBYTES"
+    assert session_path(str(tmp_path), "user-42") == os.path.join(
+        str(tmp_path), key_name("session", "user-42")
+    )
+    store_session(str(tmp_path), "other", b"X")
+    assert load_session(str(tmp_path), "other") == b"X"
+    drop_session(str(tmp_path), "other")
+    assert load_session(str(tmp_path), "other") is None
+    drop_session(str(tmp_path), "other")  # idempotent
+
+
+def test_spill_torn_file_dropped_not_served(tmp_path):
+    tier = SpillTier(0, str(tmp_path), persist_kinds=())
+    tier.put("trie", "x", _blob(), 1)  # budget 0: demotes immediately
+    os.unlink(tmp_path / key_name("trie", "x"))  # reclaimed under us
+    assert tier.get("trie", "x") is None
+    assert tier.dropped_total == 1
+    assert ("trie", "x") not in tier  # never retried
+
+
+def test_trie_key_is_the_full_token_path():
+    assert trie_key([3, 1, 4]) == "3,1,4"
+    assert trie_key([]) == ""
+    # key_name keeps arbitrary names filesystem-safe and distinct.
+    assert key_name("trie", "a/b\\c") != key_name("trie", "a_b_c")
+    assert key_name("trie", "x") != key_name("session", "x")
+
+
+# --------------------------------------------------------- digests
+
+def test_chunk_digests_cumulative_page_aligned_and_capped():
+    toks = list(range(100, 140))  # 40 tokens = 2 full pages + tail
+    d = chunk_digests(toks, PAGE, 4)
+    assert len(d) == 2  # the 8-token tail is not a chunk
+    # Cumulative: digest 0 is the digest of the first page alone.
+    assert d[0] == chunk_digests(toks[:PAGE], PAGE, 4)[0]
+    # Digest i commits to the WHOLE path: a change in chunk 0 moves
+    # every digest, a change in chunk 1 only the deeper ones.
+    other = [1] + toks[1:]
+    assert chunk_digests(other, PAGE, 4)[0] != d[0]
+    deep = toks[:PAGE] + [9] + toks[PAGE + 1:]
+    d2 = chunk_digests(deep, PAGE, 4)
+    assert d2[0] == d[0] and d2[1] != d[1]
+    assert chunk_digests(toks, PAGE, 1) == d[:1]  # k caps depth
+    assert chunk_digests(toks, 0, 4) == []
+    assert chunk_digests(toks, PAGE, 0) == []
+
+
+class _StubPrefix:
+    def __init__(self, paths, version=1):
+        self._paths = [tuple(p) for p in paths]
+        self.version = version
+
+    def paths(self, k, limit=512):
+        return self._paths[:limit]
+
+
+class _StubPool:
+    def __init__(self, prefix, page=PAGE):
+        self.prefix = prefix
+        self.page = page
+
+
+def test_advertised_digests_cover_resident_and_spilled_paths():
+    base = list(range(200, 232))  # 2 full pages
+    pool = _StubPool(_StubPrefix([base[:PAGE], base]))
+    tier = SpillTier(8, "")
+    spilled = list(range(50, 82))
+    tier.put("trie", trie_key(spilled), _blob(), 1)
+    cache = {}
+    ads = advertised_digests(pool, tier, 4, cache)
+    # Resident paths advertise their deepest cumulative digest (every
+    # node IS a path, so depth-1 is covered by the shorter path)...
+    assert chunk_digests(base, PAGE, 4)[-1] in ads
+    assert chunk_digests(base, PAGE, 4)[0] in ads
+    # ...and a spilled path advertises EVERY cumulative depth: the
+    # router may only match its first chunk.
+    for h in chunk_digests(spilled, PAGE, 4):
+        assert h in ads
+    # Cache: same trie version + spill counters -> same object.
+    assert advertised_digests(pool, tier, 4, cache) is ads
+    # A spill-counter move invalidates...
+    tier.pop("trie", trie_key(spilled))
+    ads2 = advertised_digests(pool, tier, 4, cache)
+    assert ads2 is not ads
+    assert chunk_digests(spilled, PAGE, 4)[0] not in ads2
+    # ...and so does a trie version bump (chunk-boundary contract).
+    pool.prefix.version += 1
+    assert advertised_digests(pool, tier, 4, cache) is not ads2
+
+
+# ------------------------------------------- arena spill <-> restore
+
+jax = pytest.importorskip("jax")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax.numpy as jnp
+
+    from tpufw.models import LLAMA_CONFIGS, Llama
+
+    base = LLAMA_CONFIGS["llama3_tiny"].decode_config()
+    cfg = dataclasses.replace(base, max_seq_len=64)
+    model = Llama(cfg)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+@pytest.mark.parametrize("kv_quant", ["", "int8"], ids=["bf16", "int8"])
+def test_trie_spill_restore_bit_equal_zero_retrace(tiny, kv_quant):
+    """Evict a resident trie path to the spill tier, restore it
+    through the next admission, and pin three things: the arena bytes
+    after restore equal the bytes that left (bf16 and int8 — codes
+    AND page-structured scales), the restored path serves a prefix
+    HIT whose decode matches the never-spilled greedy output, and the
+    whole round trip re-enters the existing page_import/export
+    programs (zero retraces)."""
+    from tpufw.infer import SamplingConfig, generate_text
+    from tpufw.infer import pages as pages_mod
+    from tpufw.serve.roles import DecodeEngine, PrefillEngine
+
+    model, params = tiny
+    greedy = SamplingConfig(temperature=0.0)
+    base = list(range(3, 35))  # 32 tokens = 2 full trie pages
+    tails = ([7, 9], [99, 98], [77, 76])
+    pe = PrefillEngine(
+        model, params, sampling=greedy, page=PAGE, kv_quant=kv_quant,
+        n_slots=2, spill=SpillTier(64),
+    )
+    de = DecodeEngine(
+        model, params, sampling=greedy, page=PAGE, kv_quant=kv_quant,
+        n_slots=4, chunk=2,
+    )
+    want = generate_text(
+        model, params, [base + t for t in tails],
+        max_new_tokens=MAX_NEW, sampling=greedy,
+    )
+
+    def spill_path():
+        """Evict the resident ``base`` path through the engine's
+        spill hook — the same callback arena pressure fires inside
+        acquire_pages."""
+        free0 = pe.pool.allocator.n_free
+        pe.pool.prefix.evict(
+            2, pe.pool.allocator, on_evict=pe.pool._spill_hook()
+        )
+        assert pe.pool.prefix.match(base) == []
+        assert pe.pool.allocator.n_free == free0 + 2
+        # Both path depths sit in the tier under full-path keys.
+        assert set(pe._spill.names("trie")) == {
+            trie_key(base[:PAGE]), trie_key(base)
+        }
+
+    # Seed the trie, then run one full spill -> restore cycle to warm
+    # the 1-page export/import programs (first-use traces).
+    de.collect(de.submit(pe.prefill(base + tails[0], MAX_NEW)))
+    spill_path()
+    out = de.collect(de.submit(pe.prefill(base + tails[1], MAX_NEW)))
+    assert out == want[1]
+    assert pe.pool.spill_pages_out == 2 == pe.pool.spill_pages_in
+    assert pe.pool.prefix_hits >= 1
+    assert pe._spill.names("trie") == []  # consumed on restore
+    # Cycle 2, measured: snapshot the resident bytes, spill, restore
+    # through the next admission — bit-equal and zero retraces.
+    ids0 = pe.pool.prefix.match(base)
+    assert len(ids0) == 2
+    before = pe.pool.export_pages_state(ids0)
+    t0 = dict(pages_mod.TRACE_COUNTS)
+    spill_path()
+    out = de.collect(de.submit(pe.prefill(base + tails[2], MAX_NEW)))
+    assert out == want[2]
+    assert pe.pool.spill_pages_in == 4
+    ids1 = pe.pool.prefix.match(base)
+    assert len(ids1) == 2
+    after = pe.pool.export_pages_state(ids1)
+    for a, b, path in zip(
+        before["arrays"], after["arrays"], before["paths"]
+    ):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        # Bit fidelity, not closeness: int8 codes and their fp32
+        # scales must re-enter the arena exactly as they left.
+        assert a.tobytes() == b.tobytes(), path
+    assert (
+        pages_mod.TRACE_COUNTS["page_import"] == t0["page_import"]
+    ), "spill restore must not retrace page_import"
+    assert (
+        pages_mod.TRACE_COUNTS["page_export"] == t0["page_export"]
+    ), "spill export must not retrace page_export"
+
+
+@pytest.mark.parametrize("kv_quant", ["", "int8"], ids=["bf16", "int8"])
+def test_drained_session_resumes_with_zero_divergence(
+    tiny, tmp_path, kv_quant
+):
+    """Scale-in, engine level: a session decoding on replica A is
+    drained; its slot exports as a session bundle to the shared spill
+    dir; replica B restores it through the normal splice path and the
+    CLIENT-visible token list equals the undisturbed control exactly
+    — under both KV dtypes. (The router half of this seam lives in
+    scripts/kv_smoke.py.)"""
+    from tpufw.infer import SamplingConfig, generate_text
+    from tpufw.serve.roles import DecodeEngine, PrefillEngine
+
+    model, params = tiny
+    greedy = SamplingConfig(temperature=0.0)
+    prompt = list(range(3, 37))
+    want = generate_text(
+        model, params, [prompt], max_new_tokens=12, sampling=greedy
+    )
+    common = dict(
+        sampling=greedy, page=PAGE, kv_quant=kv_quant, chunk=2,
+    )
+    pe = PrefillEngine(
+        model, params, sampling=greedy, page=PAGE, kv_quant=kv_quant,
+        n_slots=2,
+    )
+    de_a = DecodeEngine(
+        model, params, n_slots=4,
+        spill=SpillTier(64, str(tmp_path)), **common
+    )
+    de_b = DecodeEngine(
+        model, params, n_slots=4,
+        spill=SpillTier(64, str(tmp_path)), **common
+    )
+    slot = de_a.submit(pe.prefill(prompt, 12, session="mig"))
+    # Drain races the decode worker: whatever the session emitted so
+    # far rides the bundle's "tokens" field, and the budget math on
+    # the survivor re-derives the remaining chunks.
+    drained = de_a.drain()
+    assert drained["drained"] is True
+    out_a = de_a.collect_ex(slot)
+    if "mig" in drained["sessions"]:
+        assert out_a.get("drained") is True
+        data = load_session(str(tmp_path), "mig")
+        assert data is not None
+        out = de_b.collect_ex(de_b.submit(data))
+        assert out["tokens"] == want[0], "token divergence across drain"
+        assert de_a.sessions_drained == 1
+        assert de_b.sessions_resumed == 1
+        assert de_b.pool.allocator.in_use == 0  # retired clean
+    else:
+        # The decode worker finished the whole budget before the
+        # drain latched — rare on CPU, but then the undisturbed
+        # output itself must already be parity.
+        assert out_a["tokens"] == want[0]
+    # Draining is latched: new raw admissions are refused.
+    assert de_a.signals()["draining"] == 1
+    with pytest.raises(RuntimeError):
+        de_a.submit_raw(prompt, 4)
+    de_a.drain()  # idempotent

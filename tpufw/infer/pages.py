@@ -60,7 +60,7 @@ from tpufw.ops.quant import dequantize_kv, quantize_kv
 TRACE_COUNTS: Dict[str, int] = {
     "paged_insert": 0, "clear_table": 0, "prefix_attach": 0,
     "suffix_prefill": 0, "page_export": 0, "page_splice": 0,
-    "prefill_chunk": 0,
+    "prefill_chunk": 0, "page_import": 0,
 }
 
 #: unstacked rank of each KV arena leaf — (n_pages, page, *feat); the
@@ -385,6 +385,30 @@ def _export_pages_jit(leaves, ids, *, names):
     return tuple(out)
 
 
+@partial(jax.jit, static_argnames=("names",), donate_argnames=("leaves",))
+def _import_pages_jit(leaves, page_arrays, ids, *, names):
+    """Scatter spilled pages back into arena pages ``ids`` — the
+    donating twin of ``_export_pages_jit`` and exactly the page-payload
+    half of ``_splice_pages_jit`` (no table row, no cursors: trie pages
+    belong to no slot, rows find them through the prefix match). Raw
+    stores both ways means spill -> restore is bit-identical storage.
+    Programs are keyed by the page count, same budget class as
+    export."""
+    TRACE_COUNTS["page_import"] += 1
+    k = 0
+    out = []
+    for name, leaf in zip(names, leaves):
+        rank = _export_rank(name)
+        if rank is None:
+            out.append(leaf)
+            continue
+        a = _collapse_arena(leaf, rank)
+        vals = page_arrays[k].astype(leaf.dtype)
+        out.append(a.at[:, ids].set(vals).reshape(leaf.shape))
+        k += 1
+    return tuple(out)
+
+
 @partial(
     jax.jit,
     static_argnames=("names",),
@@ -643,6 +667,21 @@ class PagedSlotPool(SlotPool):
     allocator: Any = None
     prefix: Any = None
     slot_pages: Any = None  # per-slot page ids this row references
+    #: Spill-tier callbacks (tpufw.serve.roles wires them to a
+    #: tpufw.infer.spill.SpillTier + the TPFB codec; None = no spill).
+    #: trie_spill(path_tokens, state) receives an evicted trie page's
+    #: export state; trie_restore(path_tokens) -> state | None CONSUMES
+    #: the matching spill entry (the pages are back in the arena — a
+    #: kept copy would go stale the moment decode appends).
+    trie_spill: Any = None
+    trie_restore: Any = None
+    # Admission-outcome counters for signals()/bench: requests whose
+    # trie match (incl. spill restores) covered >= 1 page vs not, and
+    # pages moved across the HBM <-> spill boundary.
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    spill_pages_out: int = 0
+    spill_pages_in: int = 0
 
     @classmethod
     def create_paged(
@@ -727,22 +766,35 @@ class PagedSlotPool(SlotPool):
         # them out from under us (match() alone leaves refcount at 0
         # for pages only the trie holds).
         self.allocator.ref(shared)  # resource: acquires pages
-        n_new = n_total - len(shared)
         try:
+            # Where the resident match ends, the spill tier may still
+            # know the next chunks — restore them before prefilling.
+            self._extend_shared_from_spill(
+                prompt, shared, (p - 1) // self.page
+            )
+            n_new = n_total - len(shared)
             ids = self.allocator.alloc(n_new)
             if ids is None and self.prefix is not None:
                 self.prefix.evict(
-                    n_new - self.allocator.n_free, self.allocator
+                    n_new - self.allocator.n_free, self.allocator,
+                    on_evict=self._spill_hook(),
                 )
                 ids = self.allocator.alloc(n_new)
         except BaseException:
             # Trie surgery raising mid-evict must not strand the
-            # shared-page refs taken above (TPU019).
+            # shared-page refs taken above (TPU019). ``shared`` was
+            # extended in place, so restored pages release too (their
+            # trie hold keeps them resident — work not lost).
             self.allocator.release(shared)
             raise
         if ids is None:
             self.allocator.release(shared)
             return None
+        if self.prefix is not None and p > 1:
+            if shared:
+                self.prefix_hits += 1
+            else:
+                self.prefix_misses += 1
         return shared + ids, len(shared)
 
     def release_pages(self, ids: Sequence[int]) -> int:
@@ -760,6 +812,139 @@ class PagedSlotPool(SlotPool):
         n_full = len(prompt) // self.page
         adopted = self.prefix.insert(prompt, list(page_ids)[:n_full])
         self.allocator.hold(adopted)
+
+    # ---- spill tier (KV fabric) -----------------------------------
+
+    def _spill_hook(self):
+        """``on_evict`` callback for ``PrefixCache.evict``: export each
+        victim page's bytes to the spill tier while the arena content
+        is still valid. Best-effort — a failed spill degrades to the
+        plain eviction this always was, never breaks an admission."""
+        if self.trie_spill is None:
+            return None
+
+        def cb(path_tokens, page_id):
+            try:
+                state = self.export_pages_state([page_id])
+                # wire: produces kv-spill-page via callback
+                self.trie_spill(tuple(path_tokens), state)
+                self.spill_pages_out += 1
+            except Exception:
+                pass
+
+        return cb
+
+    def export_pages_state(self, ids: Sequence[int]) -> Dict[str, Any]:
+        """Snapshot arbitrary arena pages (no slot attached) as a
+        migration-shaped state dict — the trie-spill serialization.
+        Cursors are zeroed placeholders so ``tpufw.serve.bundle``'s
+        required header fields are satisfied; ``import_pages`` ignores
+        them. Same raw gather as ``export_slot``, so int8 codes +
+        scales ship as stored and a later import is bit-identical."""
+        ids = [int(i) for i in ids]
+        paths, names, leaves, _ = self._pool_flat()
+        arrays = _export_pages_jit(
+            tuple(leaves),
+            jnp.asarray(np.asarray(ids, np.int32)),
+            names=names,
+        )
+        return {
+            "page": self.page,
+            "kv_quant": self.model.cfg.kv_quant or "",
+            "n_pages": len(ids),
+            "paths": [
+                p for p, n in zip(paths, names)
+                if _export_rank(n) is not None
+            ],
+            "arrays": [np.asarray(a) for a in arrays],
+            "token": 0, "pos": 0, "remaining": 0, "done": True,
+            "cache_index": 0, "seen": None,
+        }
+
+    def import_pages(
+        self, page_ids: Sequence[int], state: Dict[str, Any]
+    ) -> None:
+        """Scatter a spill bundle's page payload into freshly
+        allocated arena pages — the restore half of the spill tier and
+        the same layout contract as ``splice_slot`` (page size, quant
+        mode, leaf paths all validated before anything touches the
+        arena). No cursors, no table row: the pages re-enter service
+        through the prefix trie, not a slot."""
+        # resource: transfers pages
+        if int(state["page"]) != self.page:
+            raise ValueError(
+                f"spill page size {state['page']} != pool page "
+                f"{self.page}"
+            )
+        if (state.get("kv_quant") or "") != (
+            self.model.cfg.kv_quant or ""
+        ):
+            raise ValueError(
+                f"spill kv_quant {state.get('kv_quant')!r} != pool "
+                f"kv_quant {self.model.cfg.kv_quant!r}"
+            )
+        if len(page_ids) != int(state["n_pages"]):
+            raise ValueError(
+                f"spill bundle carries {state['n_pages']} pages but "
+                f"{len(page_ids)} were allocated"
+            )
+        paths, names, leaves, treedef = self._pool_flat()
+        want = [
+            p for p, n in zip(paths, names)
+            if _export_rank(n) is not None
+        ]
+        if list(state["paths"]) != want:
+            raise ValueError(
+                "spill bundle leaf layout does not match this pool "
+                f"(got {list(state['paths'])!r}, want {want!r})"
+            )
+        out = _import_pages_jit(
+            tuple(leaves),
+            tuple(jnp.asarray(a) for a in state["arrays"]),
+            jnp.asarray(np.asarray(page_ids, np.int32)),
+            names=names,
+        )
+        self.cache = jax.tree_util.tree_unflatten(treedef, list(out))
+
+    def _extend_shared_from_spill(
+        self, prompt: Sequence[int], shared: List[int], cap: int
+    ) -> None:
+        """Extend a trie match chunk-by-chunk from the spill tier:
+        while the NEXT full-page chunk of ``prompt`` has a spill entry,
+        allocate one fresh page (its alloc ref IS the row's reference,
+        matching ``ref(shared)`` on matched pages), scatter the bytes
+        back in, and re-adopt the path into the trie (held) so later
+        requests hit it resident. Mutates ``shared`` in place.
+
+        Best-effort and non-raising: under arena pressure (alloc
+        fails) it stops rather than evicting — restoring by evicting
+        would just churn pages through the spill tier — and a torn or
+        mismatched entry stops the walk; the row prefills the rest."""
+        if self.trie_restore is None or self.prefix is None:
+            return
+        while len(shared) < cap:
+            end = (len(shared) + 1) * self.page
+            try:
+                # wire: consumes kv-spill-page via callback
+                state = self.trie_restore(
+                    tuple(int(t) for t in prompt[:end])
+                )
+            except Exception:
+                return
+            if state is None:
+                return
+            ids = self.allocator.alloc(1)  # resource: acquires pages
+            if ids is None:
+                return
+            try:
+                self.import_pages(ids, state)
+            except Exception:
+                self.allocator.release(ids)  # resource: releases pages
+                return
+            adopted = self.prefix.insert(prompt[:end], shared + ids)
+            self.allocator.hold(adopted)
+            shared.extend(ids)
+            self.spill_pages_in += 1
 
     # ---- device ops -----------------------------------------------
 
@@ -917,6 +1102,16 @@ class PagedSlotPool(SlotPool):
         # pages are never reallocated, so their content is stable.
         self.allocator.ref(shared)  # resource: acquires pages
         try:
+            # Spill-tier continuation of the resident match, same as
+            # acquire_pages (restored pages join the deferred attach).
+            self._extend_shared_from_spill(
+                prompt, shared, (p - 1) // self.page
+            )
+            if self.prefix is not None and p > 1:
+                if shared:
+                    self.prefix_hits += 1
+                else:
+                    self.prefix_misses += 1
             seen = None
             if _track_seen(self.sampling):
                 m = np.zeros((1, self.model.cfg.vocab_size), bool)
@@ -983,7 +1178,8 @@ class PagedSlotPool(SlotPool):
             ids = self.allocator.alloc(n_new)
             if ids is None and self.prefix is not None:
                 self.prefix.evict(
-                    n_new - self.allocator.n_free, self.allocator
+                    n_new - self.allocator.n_free, self.allocator,
+                    on_evict=self._spill_hook(),
                 )
                 ids = self.allocator.alloc(n_new)
             if ids is None:

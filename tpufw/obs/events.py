@@ -50,8 +50,17 @@ SCHEMA: Dict[str, FrozenSet[str]] = {
     "serve_prefill_chunk": frozenset(
         {"prompt_tokens", "cursor", "final"}
     ),
+    # KV fabric (tpufw.infer.spill + tpufw.serve.bundle.attach_spill):
+    # one record per movement across the HBM/host-RAM boundary.
+    # ``entry`` is "trie" (one prefix page) or "session" (a drained
+    # slot's bundle); ``direction`` is "out" (spill) or "in" (restore).
+    # Page/byte/wall fields ride along where the mover knows them.
+    "serve_spill": frozenset({"entry", "direction"}),
     "router_request": frozenset({"tenant", "replica", "latency_s"}),
     "router_reject": frozenset({"tenant", "reason"}),
+    # A drained replica's sticky session resumed on a survivor from
+    # the shared spill store (zero-divergence re-home).
+    "router_rehome": frozenset({"session", "replica"}),
     "slo_violation": frozenset(
         {"tenant", "metric", "value_ms", "target_ms"}
     ),

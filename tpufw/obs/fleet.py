@@ -308,6 +308,21 @@ SIGNAL_SERIES: Tuple[Tuple[str, str], ...] = (
     ("prefill_inflight", "tpufw_fleet_replica_prefill_inflight"),
     ("prefill_chunks", "tpufw_fleet_replica_prefill_chunks"),
     ("piggyback_waterline", "tpufw_fleet_replica_piggyback_waterline"),
+    # KV fabric: drain state, prefix-cache hit counters, and spill-
+    # tier occupancy/lifetime totals. prefix_digests (the one list-
+    # valued signal) is intentionally absent — series are numeric.
+    ("draining", "tpufw_fleet_replica_draining"),
+    ("sessions_drained", "tpufw_fleet_replica_sessions_drained"),
+    ("sessions_resumed", "tpufw_fleet_replica_sessions_resumed"),
+    ("prefix_hits", "tpufw_fleet_replica_prefix_hits"),
+    ("prefix_misses", "tpufw_fleet_replica_prefix_misses"),
+    ("spill_ram_pages", "tpufw_fleet_replica_spill_ram_pages"),
+    ("spill_dir_pages", "tpufw_fleet_replica_spill_dir_pages"),
+    ("spill_pages_total", "tpufw_fleet_replica_spill_pages_total"),
+    (
+        "spill_restored_total",
+        "tpufw_fleet_replica_spill_restored_total",
+    ),
 )
 
 
@@ -388,6 +403,20 @@ class _Deriver:
             out["tpufw_fleet_page_occupancy"] = (
                 pages_in_use / pages_total
             )
+        # KV fabric: pages parked outside HBM (hot host RAM + the
+        # directory tier) fleet-wide, replicas mid-drain, and the
+        # cross-replica prefix hit ratio — THE number the affinity
+        # router is supposed to hold invariant as the pool scales.
+        out["tpufw_fleet_spill_pages"] = total(
+            "tpufw_fleet_replica_spill_ram_pages"
+        ) + total("tpufw_fleet_replica_spill_dir_pages")
+        out["tpufw_fleet_draining_replicas"] = total(
+            "tpufw_fleet_replica_draining"
+        )
+        ph = total("tpufw_fleet_replica_prefix_hits")
+        pm = total("tpufw_fleet_replica_prefix_misses")
+        if ph + pm > 0:
+            out["tpufw_fleet_prefix_hit_ratio"] = ph / (ph + pm)
 
         tok_delta = tok_dt = req_delta = req_dt = pig_delta = 0.0
         for rec in live:

@@ -22,10 +22,13 @@ jax.
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
 import struct
+import time
 import zlib
-from typing import Any, Dict
+from typing import Any, Dict, List, Sequence
 
 import numpy as np
 
@@ -60,6 +63,18 @@ HEADER_SCHEMA: Dict[str, tuple] = {
     # producers that predate the field still splice fine — the slot
     # just drafts from its generated tokens alone.
     "prompt": (list, 1, False),
+    # KV-fabric session resumption fields, optional (VERSION stays 1;
+    # old decoders splice these bundles unchanged and simply start the
+    # emitted-token list from `token` alone):
+    # - "session": the router's sticky session id, stamped at prefill
+    #   and carried through drain bundles so the router can re-home a
+    #   killed replica's sessions by name.
+    # - "tokens": every token the ORIGIN replica already emitted (the
+    #   last one == `token`). A resuming replica seeds its emitted
+    #   list from this so the client receives the full, divergence-
+    #   free sequence across the migration seam.
+    "session": (str, 1, False),
+    "tokens": (list, 1, False),
 }
 
 #: Non-array metadata fields copied between state dict and header
@@ -233,3 +248,195 @@ def peek_trace(data: bytes) -> "Dict[str, Any] | None":
         return None
     except Exception:
         return None
+
+
+# --------------------------------------------------- prefix digests
+#
+# The affinity identity both sides of the wire agree on: a cumulative
+# blake2b chain over page-aligned token chunks — EXACTLY the radix
+# trie's chunking (tpufw.infer.prefix splits at full pages and drops
+# the tail), so digest i names the same KV a trie path of depth i+1
+# holds. Replicas advertise the digests of their resident (and
+# spilled-but-restorable) trie paths in signals(); the router hashes
+# an incoming prompt the same way and steers to the deepest match.
+# Cumulative chaining means a digest commits to the WHOLE path, never
+# a lone chunk — matching the trie's path-is-the-unit-of-reuse rule.
+
+#: Digest width: 8 bytes / 16 hex chars. Affinity is a routing hint
+#: backed by an exact token-compare in the trie, so collisions cost a
+#: misrouted request, never a wrong token.
+PREFIX_DIGEST_SIZE = 8
+
+
+def chunk_digests(
+    tokens: Sequence[int], page: int, k: int
+) -> List[str]:
+    """Cumulative digests of the first ``min(k, full-pages)`` page-
+    aligned chunks of ``tokens``; digest i covers chunks 0..i. Pure
+    stdlib — the router calls this per request and never loads jax."""
+    out: List[str] = []
+    if page <= 0 or k <= 0:
+        return out
+    h = hashlib.blake2b(digest_size=PREFIX_DIGEST_SIZE)
+    n_full = len(tokens) // page
+    for i in range(min(int(k), n_full)):
+        chunk = tokens[i * page:(i + 1) * page]
+        h.update(",".join(str(int(t)) for t in chunk).encode())
+        h.update(b"|")  # chunk boundary: len(chunk) is fixed, but be explicit
+        out.append(h.hexdigest())
+    return out
+
+
+# ----------------------------------------------------- session store
+#
+# The cross-process half of the spill tier (tpufw.infer.spill): a
+# drained replica writes each live session's bundle to a shared
+# directory (TPUFW_KV_SPILL_DIR), and the ROUTER — which never loads
+# jax, hence these helpers living here — reads it back to re-home the
+# session onto a surviving replica. File names match SpillTier's
+# directory tier (kind "session"), so an engine-side spill and a
+# drain write land on the same path.
+
+
+def session_path(directory: str, session: str) -> str:
+    """On-disk path for one session's spill bundle — blake2b of the
+    id keeps arbitrary session strings filesystem-safe."""
+    h = hashlib.blake2b(session.encode("utf-8"), digest_size=16)
+    return os.path.join(directory, f"session-{h.hexdigest()}.tpfb")
+
+
+def store_session(directory: str, session: str, data: bytes) -> str:
+    """Atomically persist a session bundle (temp file + rename: a
+    concurrently re-homing router never sees a torn bundle)."""
+    # wire: produces session-bundle via file
+    os.makedirs(directory, exist_ok=True)
+    path = session_path(directory, session)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+    return path
+
+
+def load_session(directory: str, session: str) -> "bytes | None":
+    """Fetch a session bundle, or None when the session was never
+    drained (the caller falls back to a plain 502)."""
+    # wire: consumes session-bundle via file
+    try:
+        with open(session_path(directory, session), "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def drop_session(directory: str, session: str) -> None:
+    """Delete a consumed session bundle — a re-homed session must not
+    resurrect from a stale spill file on its next failover."""
+    try:
+        os.unlink(session_path(directory, session))
+    except OSError:
+        pass
+
+
+# ------------------------------------------------------ spill wiring
+
+def attach_spill(pool, tier, *, events=None, on_restore=None):
+    """Wire ``tier`` (tpufw.infer.spill.SpillTier) into ``pool``'s
+    trie-spill callbacks with this module's TPFB codec at the
+    boundary: an evicted trie page is encoded exactly like a migration
+    bundle (raw int8 codes + page-structured scales), and restore
+    decodes into the same splice-shaped state ``import_pages``
+    scatters back — so spill -> restore is bit-equal by construction.
+
+    ``on_restore(seconds)`` feeds the ``tpufw_kv_restore_seconds``
+    histogram where a metrics registry exists (host-side fetch +
+    decode wall; the device scatter rides the admission's own admit
+    stage). ``events`` (tpufw.obs.events API) gets one ``serve_spill``
+    record per page moved across the HBM boundary."""
+
+    def _spill(path_tokens, state):
+        # wire: produces kv-spill-page via spill-tier
+        data = encode_bundle(state)
+        from tpufw.infer.spill import trie_key
+
+        tier.put(
+            "trie", trie_key(path_tokens), data, int(state["n_pages"])
+        )
+        if events is not None:
+            events.emit(
+                "serve_spill", entry="trie", direction="out",
+                pages=int(state["n_pages"]), bytes=len(data),
+            )
+
+    def _restore(path_tokens):
+        # wire: consumes kv-spill-page via spill-tier
+        from tpufw.infer.spill import trie_key
+
+        name = trie_key(path_tokens)
+        t0 = time.perf_counter()
+        data = tier.get("trie", name)
+        if data is None:
+            return None
+        try:
+            state = decode_bundle(data)
+        except BundleError:
+            tier.pop("trie", name)  # torn entry: never retry it
+            return None
+        # Consume the entry: its pages are back in the arena, and a
+        # kept host copy would go stale the moment decode appends.
+        tier.pop("trie", name)
+        wall = time.perf_counter() - t0
+        if on_restore is not None:
+            on_restore(wall)
+        if events is not None:
+            events.emit(
+                "serve_spill", entry="trie", direction="in",
+                pages=int(state["n_pages"]), bytes=len(data),
+                wall_s=round(wall, 6),
+            )
+        return state
+
+    pool.trie_spill = _spill
+    pool.trie_restore = _restore
+
+
+def advertised_digests(pool, tier, k: int, cache: Dict[str, Any]):
+    """The digest set a replica advertises in its ``signals()`` reply:
+    one cumulative digest per resident trie path (every node IS a
+    path, so every depth <= k is covered by enumeration) plus every
+    cumulative depth of each spilled-but-restorable path. Cached in
+    ``cache`` keyed on (trie version, spill counters, k) — recomputed
+    only at chunk boundaries that actually changed the resident set,
+    which is the "digest updates at chunk boundaries" contract."""
+    prefix = getattr(pool, "prefix", None)
+    ver = prefix.version if prefix is not None else -1
+    stamp = None
+    if tier is not None:
+        stamp = (
+            tier.spilled_pages_total,
+            tier.restored_total,
+            tier.dropped_total,
+        )
+    key = (ver, stamp, int(k))
+    if cache.get("key") == key:
+        return cache["digests"]
+    page = int(pool.page)
+    out: List[str] = []
+    seen = set()
+    if prefix is not None:
+        for path in prefix.paths(int(k), limit=512):
+            d = chunk_digests(path, page, k)
+            if d and d[-1] not in seen:
+                seen.add(d[-1])
+                out.append(d[-1])
+    if tier is not None:
+        for name in tier.names("trie"):
+            toks = [int(t) for t in name.split(",") if t]
+            for h in chunk_digests(toks, page, k):
+                if h not in seen:
+                    seen.add(h)
+                    out.append(h)
+    out = out[:1024]
+    cache["key"] = key
+    cache["digests"] = out
+    return out

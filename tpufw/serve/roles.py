@@ -45,6 +45,8 @@ from tpufw.obs import trace as obs_trace
 from tpufw.serve import transport
 from tpufw.serve.bundle import (
     BundleError,
+    advertised_digests,
+    attach_spill,
     decode_bundle,
     encode_bundle,
 )
@@ -87,6 +89,23 @@ class _ChunkTicket:
         self.blocked = False
 
 
+def _fabric_signals(sig: Dict[str, Any], pool, spill) -> None:
+    """KV-fabric occupancy/outcome numbers shared by both roles'
+    ``signals()``: trie hit counters (the bench's hit-rate source) and
+    spill-tier tier sizes + lifetime totals (the fleet deriver's spill
+    occupancy series). Numeric-only on purpose — these ride into
+    ``tpufw.obs.fleet``'s per-signal time series."""
+    if pool.prefix is not None:
+        sig["prefix_hits"] = pool.prefix_hits
+        sig["prefix_misses"] = pool.prefix_misses
+    if spill is not None:
+        st = spill.stats()
+        sig["spill_ram_pages"] = st["ram_pages"]
+        sig["spill_dir_pages"] = st["dir_pages"]
+        sig["spill_pages_total"] = st["spilled_pages_total"]
+        sig["spill_restored_total"] = st["restored_total"]
+
+
 class PrefillEngine:
     """One prefill replica: admission + prefix cache + page export.
 
@@ -108,6 +127,8 @@ class PrefillEngine:
         seed_base: int = 0,
         prefix_cache: bool = True,
         prefill_chunk_pages: int = 0,
+        spill=None,
+        affinity_k: int = 0,
         events=None,
         tracer=None,
     ):
@@ -129,6 +150,14 @@ class PrefillEngine:
         self._job_index = 0
         self._events = events if events is not None else obs_events.NULL
         self._tracer = tracer if tracer is not None else obs_trace.NULL
+        # KV fabric: host-RAM spill tier behind the trie (evicted
+        # pages keep their KV; restore skips the chunk's re-prefill)
+        # and the digest set the router's affinity steering reads.
+        self._spill = spill
+        self._affinity_k = max(0, int(affinity_k))
+        self._digest_cache: Dict[str, Any] = {}
+        if spill is not None:
+            attach_spill(self.pool, spill, events=self._events)
         self._lock = threading.Lock()
         # Chunked mode: the engine lock is RELEASED between chunks, so
         # concurrent admissions interleave at chunk granularity instead
@@ -174,10 +203,18 @@ class PrefillEngine:
             sig["prefill_chunk_pages"] = self.prefill_chunk_pages
             sig["prefill_inflight"] = self.prefill_inflight
             sig["prefill_chunks"] = self.prefill_chunks
+        _fabric_signals(sig, self.pool, self._spill)
+        if self._affinity_k:
+            # wire: produces role-signals via prefix_digests
+            sig["prefix_digests"] = advertised_digests(
+                self.pool, self._spill, self._affinity_k,
+                self._digest_cache,
+            )
         return sig
 
     def prefill(
-        self, prompt: Sequence[int], max_new: int, trace=None
+        self, prompt: Sequence[int], max_new: int, trace=None,
+        session: Optional[str] = None,
     ) -> bytes:
         """Admit one request, export its slot as a page bundle, free
         the slot. Returns the serialized bundle (the first sampled
@@ -195,7 +232,9 @@ class PrefillEngine:
         import jax
 
         if self.prefill_chunk_pages:
-            return self._prefill_chunked(prompt, max_new, trace)
+            return self._prefill_chunked(
+                prompt, max_new, trace, session=session
+            )
         ctx = reqtrace.parse(trace)
         ctx = ctx.child() if ctx is not None else None
         prompt = list(prompt)
@@ -287,6 +326,11 @@ class PrefillEngine:
             # replica mines its n-gram proposals from them. Optional,
             # so old decoders splice the bundle unchanged.
             state["prompt"] = [int(t) for t in prompt]
+            if session:
+                # Sticky session id stamped at prefill: the decode
+                # side carries it through drain bundles so the router
+                # can re-home the session by name.
+                state["session"] = str(session)
             data = encode_bundle(state)
             self.migrations += 1
             self.migration_bytes += len(data)
@@ -337,7 +381,8 @@ class PrefillEngine:
             self._cv.acquire()
 
     def _prefill_chunked(
-        self, prompt: Sequence[int], max_new: int, trace=None
+        self, prompt: Sequence[int], max_new: int, trace=None,
+        session: Optional[str] = None,
     ) -> bytes:
         """Chunked admission: advance the prompt one page-aligned
         chunk per SRPT turn, with the engine mutex released both
@@ -530,6 +575,8 @@ class PrefillEngine:
                 tmeta.update(ctx.meta())
             state["trace"] = tmeta
             state["prompt"] = [int(t) for t in prompt]
+            if session:
+                state["session"] = str(session)
             data = encode_bundle(state)
             self.migrations += 1
             self.migration_bytes += len(data)
@@ -607,6 +654,8 @@ class DecodeEngine:
         spec_min_accept: float = 0.25,
         prefill_chunk_pages: int = 0,
         piggyback: float = 0.0,
+        spill=None,
+        affinity_k: int = 0,
         events=None,
         tracer=None,
     ):
@@ -616,11 +665,18 @@ class DecodeEngine:
         per_row = cache_len // page
         pages = arena_pages or n_slots * per_row + 1
         pool_model, row_model = _paged_models(model, page, kv_quant, pages)
-        # No prefix trie on the decode side: bundles arrive prefilled,
-        # and a trie hold would pin migrated pages past their row.
+        # Prefix trie on the decode side ONLY with piggyback prefill
+        # enabled: the splice path never trie-registers (a hold would
+        # pin migrated pages past their row), but piggybacked chunked
+        # prefills checkpoint into the trie exactly like a prefill
+        # replica's — which is what the router's prefix-affinity
+        # steering keys on at the decode pool.
+        piggy = bool(
+            max(0, int(prefill_chunk_pages)) and float(piggyback) > 0
+        )
         self.pool = PagedSlotPool.create_paged(
             pool_model, row_model, params, n_slots,
-            sampling=sampling, eos_id=eos_id, prefix_cache=False,
+            sampling=sampling, eos_id=eos_id, prefix_cache=piggy,
         )
         self.page = page
         self.n_slots = n_slots
@@ -638,6 +694,25 @@ class DecodeEngine:
         self.piggyback = max(0.0, float(piggyback))
         self._events = events if events is not None else obs_events.NULL
         self._tracer = tracer if tracer is not None else obs_trace.NULL
+        # KV fabric: spill tier (trie pages under piggyback, session
+        # bundles at drain — "session" entries persist to the shared
+        # directory the router re-homes from), affinity digests, and
+        # the drain latch that turns scale-in into migration.
+        self._spill = spill
+        self._affinity_k = max(0, int(affinity_k))
+        self._digest_cache: Dict[str, Any] = {}
+        if spill is not None:
+            attach_spill(self.pool, spill, events=self._events)
+        self._draining = False
+        # Set (lock-free, atomic attribute write) by drain() BEFORE it
+        # contends for ``_cv``: the collect loop holds the lock across
+        # chunks, so without a yield point the drain could only latch
+        # in the submit->collect gap. The loop checks this flag at
+        # every chunk boundary and waits the lock away so the export
+        # sees the slots live.
+        self._drain_pending = False
+        self.sessions_drained = 0
+        self.sessions_resumed = 0
         # Speculative self-drafting (n-gram proposals against the
         # request's own history, verified by spec_steps' single
         # jitted pass). No draft model on a replica — the monolithic
@@ -702,11 +777,25 @@ class DecodeEngine:
             sig["prefill_chunk_pages"] = self.prefill_chunk_pages
             sig["piggyback_waterline"] = self.piggyback
             sig["prefill_inflight"] = inflight
+        # Draining rides the signals so the router stops steering new
+        # work here the moment the drain latch flips (the reprobe after
+        # a failed decode reads this too).
+        sig["draining"] = 1 if self._draining else 0
+        if self.sessions_drained or self.sessions_resumed:
+            sig["sessions_drained"] = self.sessions_drained
+            sig["sessions_resumed"] = self.sessions_resumed
+        _fabric_signals(sig, self.pool, self._spill)
+        if self._affinity_k and self.pool.prefix is not None:
+            # wire: produces role-signals via prefix_digests
+            sig["prefix_digests"] = advertised_digests(
+                self.pool, self._spill, self._affinity_k,
+                self._digest_cache,
+            )
         return sig
 
     def can_accept(self, n_pages: int) -> bool:
         with self._cv:
-            if len(self._jobs) >= self.n_slots:
+            if self._draining or len(self._jobs) >= self.n_slots:
                 return False
             deficit = self._cp_deficit_locked()
         return n_pages + deficit <= self.pool.allocator.n_free
@@ -735,7 +824,7 @@ class DecodeEngine:
         a = self.pool.allocator
         with self._cv:
             n_jobs = len(self._jobs)
-            if n_jobs >= self.n_slots:
+            if self._draining or n_jobs >= self.n_slots:
                 return False
             deficit = self._cp_deficit_locked()
         return (
@@ -756,7 +845,24 @@ class DecodeEngine:
         state = decode_bundle(data)
         ctx = reqtrace.parse(state.get("trace"))
         ctx = ctx.child() if ctx is not None else None
+        # Resumed session bundle (drain export): seed the emitted list
+        # so the client receives one continuous sequence, and lift the
+        # budget by the tokens already emitted so the budget_left math
+        # (budget - (len(tokens) - 1)) lands exactly at the origin
+        # replica's remaining count — zero-divergence resumption.
+        emitted = state.get("tokens")
+        resumed = isinstance(emitted, list) and len(emitted) > 0
+        if resumed:
+            tokens0 = [int(t) for t in emitted]
+            budget0 = int(state["remaining"]) + len(tokens0) - 1
+        else:
+            tokens0 = [int(state["token"])]
+            budget0 = int(state["remaining"])
         with self._cv:
+            if self._draining:
+                raise RuntimeError(
+                    "decode replica draining — no new admissions"
+                )
             free = [
                 s for s in range(self.n_slots) if s not in self._jobs
             ]
@@ -795,8 +901,8 @@ class DecodeEngine:
                 raise
             splice_s = time.perf_counter() - t0p
             job = {
-                "tokens": [int(state["token"])],
-                "budget": int(state["remaining"]),
+                "tokens": tokens0,
+                "budget": budget0,
                 "done": bool(state["done"])
                 or int(state["remaining"]) <= 0,
                 # Prompt ids when the producer shipped them (optional
@@ -805,6 +911,9 @@ class DecodeEngine:
                 "history": [
                     int(t) for t in (state.get("prompt") or [])
                 ],
+                # Sticky session id (optional header field): drain
+                # exports this slot under it so the router can re-home.
+                "session": state.get("session") or None,
                 "ctx": ctx,
                 "splice_s": splice_s,
                 # perf_counter at splice end: first_flush measures
@@ -825,6 +934,8 @@ class DecodeEngine:
                 # The first (and only) token arrived inside the
                 # bundle — it is flushed the moment the splice lands.
                 job["first_flush_s"] = 0.0
+            if resumed:
+                self.sessions_resumed += 1
             self.migrations += 1
             self.migration_bytes += len(data)
             self._cv.notify_all()
@@ -843,7 +954,8 @@ class DecodeEngine:
         return slot
 
     def submit_raw(
-        self, prompt: Sequence[int], max_new: int, trace=None
+        self, prompt: Sequence[int], max_new: int, trace=None,
+        session: Optional[str] = None,
     ) -> int:
         """Piggyback admission: accept a RAW prompt — no prefill hop,
         no bundle migration — and prefill it chunk-by-chunk inside the
@@ -875,6 +987,10 @@ class DecodeEngine:
                 f"capacity is {a.capacity}"
             )
         with self._cv:
+            if self._draining:
+                raise RuntimeError(
+                    "decode replica draining — no new admissions"
+                )
             free = [
                 s for s in range(self.n_slots) if s not in self._jobs
             ]
@@ -913,6 +1029,7 @@ class DecodeEngine:
                 "budget": max_new - 1,
                 "done": False,
                 "history": list(prompt),
+                "session": str(session) if session else None,
                 "ctx": ctx,
                 "splice_s": 0.0,
                 "t_ready": time.perf_counter(),
@@ -929,6 +1046,80 @@ class DecodeEngine:
             slot=slot, pages=n_total,
         )
         return slot
+
+    # ---- drain (scale-in / SIGTERM) -------------------------------
+
+    def drain(self) -> Dict[str, Any]:
+        """Turn scale-down from "drop sessions" into "migrate them":
+        latch the drain flag (admissions start refusing), export every
+        live session's slot as a spill bundle to the session store
+        (``SpillTier`` persists kind "session" to the shared
+        directory), release the slots, and mark the jobs drained so
+        in-flight ``collect_ex`` calls return immediately with the
+        ``drained`` flag. The router re-homes each sticky session onto
+        a surviving replica, which restores through the normal splice
+        path — zero token divergence under greedy decode (the engine
+        default). Sessions mid-piggyback-prefill (no slot yet) and
+        sessionless jobs have nothing to resume; their partial work is
+        dropped and the caller sees a plain drained reply. Idempotent:
+        a second drain finds no live jobs."""
+        # wire: produces session-bundle via spill-tier
+        t0 = time.monotonic()
+        exported: List[str] = []
+        dropped = 0
+        # Ask the chunk-driving collector (which holds _cv across
+        # device calls) to yield at its next chunk boundary — without
+        # this the drain only ever latches between requests.
+        self._drain_pending = True
+        with self._cv:
+            self._drain_pending = False
+            self._draining = True
+            for slot, job in list(self._jobs.items()):
+                if job["done"]:
+                    continue
+                session = job.get("session")
+                cp = job.get("cp")
+                if cp is not None:
+                    # resource: releases pages
+                    self.pool.abandon_chunked(cp)
+                    job["cp"] = None
+                    dropped += 1
+                elif session and self._spill is not None:
+                    # Export BEFORE release: after release the table
+                    # row is zeroed and the pages may be reassigned.
+                    state = self.pool.export_slot(slot)
+                    state["session"] = str(session)
+                    state["tokens"] = [int(t) for t in job["tokens"]]
+                    if job.get("history"):
+                        state["prompt"] = [
+                            int(t) for t in job["history"]
+                        ]
+                    data = encode_bundle(state)
+                    self._spill.put(
+                        "session", str(session), data,
+                        int(state["n_pages"]),
+                    )
+                    self.pool.release_slot(slot)
+                    if self._ema is not None:
+                        self._ema.vacate(slot)
+                    self.sessions_drained += 1
+                    exported.append(str(session))
+                else:
+                    self.pool.release_slot(slot)
+                    if self._ema is not None:
+                        self._ema.vacate(slot)
+                    dropped += 1
+                job["done"] = True
+                job["drained"] = True
+            self._cv.notify_all()
+        self._events.emit(
+            "serve_spill", entry="session", direction="out",
+            sessions=len(exported), dropped=dropped,
+            wall_s=round(time.monotonic() - t0, 6),
+        )
+        return {
+            "drained": True, "sessions": exported, "dropped": dropped,
+        }
 
     # ---- decode loop ----------------------------------------------
 
@@ -1133,11 +1324,28 @@ class DecodeEngine:
                             job["prefill_queue_s"], 6
                         )
                         out["prefill_chunks"] = job["prefill_chunks"]
+                    if job.get("drained"):
+                        # The replica drained mid-request: the reply
+                        # carries the drained flag (+ session id when
+                        # resumable) so the router re-homes instead of
+                        # returning a truncated generation.
+                        out["drained"] = True
+                        if job.get("session"):
+                            out["session"] = job["session"]
                     return out
                 if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"slot {slot} did not finish in {timeout}s"
                     )
+                if self._drain_pending:
+                    # A drain is blocked on this lock: yield it for a
+                    # beat so the export runs against live slots.
+                    # tpulint: disable=TPU020 — deliberate timed
+                    # yield, not a predicate wait: this loop IS the
+                    # retry loop, and the drain marks the job done
+                    # before the wait expires.
+                    self._cv.wait(0.002)
+                    continue
                 self._run_chunk_locked()
 
 
@@ -1174,10 +1382,24 @@ def _build_engine(role: str):
     n_slots = max(1, env_int("serve_slots", 8))
     sampling = SamplingConfig(temperature=0.0)
     events, tracer = role_telemetry(role)
+    # KV fabric: TPUFW_KV_SPILL pages of host RAM (0 = off) with
+    # TPUFW_KV_SPILL_DIR as the overflow + session-store directory;
+    # either knob alone enables the tier. The advertisement depth
+    # matches the router's TPUFW_ROUTER_PREFIX_AFFINITY so both ends
+    # hash the same k chunks.
+    spill_pages = max(0, env_int("kv_spill", 0))
+    spill_dir = env_str("kv_spill_dir", "")
+    spill = None
+    if spill_pages or spill_dir:
+        from tpufw.infer.spill import SpillTier
+
+        spill = SpillTier(spill_pages, spill_dir)
     common = dict(
         sampling=sampling, page=page, kv_quant=kv_quant,
         n_slots=n_slots, seed_base=env_int("seed", 0),
         prefill_chunk_pages=max(0, env_int("serve_prefill_chunk", 0)),
+        spill=spill,
+        affinity_k=max(0, env_int("router_prefix_affinity", 0)),
         events=events, tracer=tracer,
     )
     if role == "prefill":
@@ -1217,7 +1439,7 @@ def serve_prefill(engine: PrefillEngine, port: int):
             ).encode()
         return engine.prefill(
             [int(t) for t in prompt], int(max_new),
-            trace=req.get("trace"),
+            trace=req.get("trace"), session=req.get("session"),
         )
 
     srv, bound = transport.serve_frames(port)
@@ -1238,6 +1460,11 @@ def serve_decode(engine: DecodeEngine, port: int):
             req = json.loads(frame.decode("utf-8"))
             if req.get("signals"):
                 return json.dumps(engine.signals()).encode()
+            if req.get("drain"):
+                # Scale-in hook (manifest 13's preStop + kv_smoke):
+                # export live sessions to the store, refuse new work.
+                # wire: produces control-frame via drain-reply
+                return json.dumps(engine.drain()).encode()
             if req.get("prompt") is not None:
                 # Raw-prompt piggyback admission: the router steers
                 # here when spare chunk capacity clears the waterline.
@@ -1246,6 +1473,7 @@ def serve_decode(engine: DecodeEngine, port: int):
                         [int(t) for t in req["prompt"]],
                         int(req.get("max_new", 1)),
                         trace=req.get("trace"),
+                        session=req.get("session"),
                     )
                 except (ValueError, RuntimeError) as e:
                     return json.dumps(
@@ -1270,6 +1498,26 @@ def serve_decode(engine: DecodeEngine, port: int):
     return srv, bound
 
 
+def install_drain_handler(engine) -> None:
+    """SIGTERM -> drain: kubelet sends TERM at pod deletion/scale-in
+    (manifest 13 also hits the peer-port drain op from a preStop hook,
+    belt and braces), so live sessions export to the session store,
+    then the process lingers TPUFW_SERVE_DRAIN_GRACE_S seconds —
+    enough for in-flight collect replies (carrying the ``drained``
+    flag) to flush to the router — before exiting."""
+
+    import signal
+
+    def _on_term(signum, frame):
+        try:
+            engine.drain()
+            time.sleep(max(0.0, env_float("serve_drain_grace_s", 5.0)))
+        finally:
+            raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+
+
 def main_role(role: str) -> int:
     """Container entrypoint for TPUFW_SERVE_ROLE != "". Blocks
     forever (the pod's lifetime IS the replica's lifetime)."""
@@ -1283,6 +1531,7 @@ def main_role(role: str) -> int:
         srv, bound = serve_prefill(engine, port)
     elif role == "decode":
         srv, bound = serve_decode(engine, port)
+        install_drain_handler(engine)
     else:
         raise ValueError(
             f"unknown TPUFW_SERVE_ROLE={role!r} "

@@ -43,7 +43,13 @@ from tpufw.obs import slo as obs_slo
 from tpufw.obs import trace as obs_trace
 from tpufw.obs.registry import Registry as ObsRegistry
 from tpufw.serve import transport
-from tpufw.serve.bundle import MAGIC, peek_trace
+from tpufw.serve.bundle import (
+    MAGIC,
+    chunk_digests,
+    drop_session,
+    load_session,
+    peek_trace,
+)
 from tpufw.workloads.env import env_float, env_int, env_str
 
 DEFAULT_ROUTER_PORT = 8478
@@ -57,6 +63,15 @@ _SIGNAL_KEYS = (
     "spec_k", "spec_passes",
     "prefill_chunk_pages", "prefill_inflight", "prefill_chunks",
     "piggyback_waterline",
+    # KV fabric: drain state, prefix-cache hit counters, spill-tier
+    # occupancy, and the advertised trie digests the affinity hash
+    # steers on (the one non-numeric signal — fleet's numeric-only
+    # series collection skips it by type).
+    "draining", "sessions_drained", "sessions_resumed",
+    "prefix_hits", "prefix_misses",
+    "spill_ram_pages", "spill_dir_pages",
+    "spill_pages_total", "spill_restored_total",
+    "prefix_digests",
 )
 
 
@@ -90,6 +105,20 @@ class ReplicaState:
     prefill_inflight: int = 0
     prefill_chunks: int = 0
     piggyback_waterline: float = 0.0
+    # KV fabric: a draining replica (SIGTERM / scale-in) refuses new
+    # work and is leaving rotation; prefix_digests is its advertised
+    # resident-or-spilled trie coverage (cumulative chunk digests,
+    # tpufw.serve.bundle.chunk_digests) the affinity hash steers on.
+    draining: int = 0
+    sessions_drained: int = 0
+    sessions_resumed: int = 0
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    spill_ram_pages: int = 0
+    spill_dir_pages: int = 0
+    spill_pages_total: int = 0
+    spill_restored_total: int = 0
+    prefix_digests: Tuple[str, ...] = ()
     healthy: bool = True
     last_seen: float = 0.0
 
@@ -205,28 +234,65 @@ class RouterPolicy:
         tenant_weights: Optional[Dict[str, float]] = None,
         saturation: float = 0.95,
         retry_after_s: int = 5,
+        affinity_k: int = 0,
     ):
         self.queue = WeightedFairQueue(tenant_weights)
         self.saturation = float(saturation)
         self.retry_after_s = int(retry_after_s)
+        #: Prefix-affinity depth: hash the first k page-aligned chunks
+        #: of each prompt (tpufw.serve.bundle.chunk_digests) and steer
+        #: to the replica already advertising them. 0 = occupancy only.
+        self.affinity_k = max(0, int(affinity_k))
+        #: Picks won by a nonzero digest match (the server mirrors the
+        #: delta into tpufw_router_prefix_affinity_hits_total).
+        self.affinity_hits = 0
         self._affinity: Dict[str, str] = {}
 
     # ---- replica choice -------------------------------------------
 
+    @staticmethod
+    def affinity_depth(
+        r: ReplicaState, digests: Sequence[str]
+    ) -> int:
+        """Deepest chunk index (1-based) of ``digests`` this replica
+        advertises. Digests are cumulative (digest i covers chunks
+        0..i), so the deepest match is exactly the prefix the replica
+        can serve from its trie or spill tier without recompute."""
+        if not digests or not r.prefix_digests:
+            return 0
+        have = set(r.prefix_digests)
+        depth = 0
+        for i, d in enumerate(digests):
+            if d in have:
+                depth = i + 1
+        return depth
+
     def pick_prefill(
-        self, replicas: Sequence[ReplicaState]
+        self,
+        replicas: Sequence[ReplicaState],
+        digests: Sequence[str] = (),
     ) -> Optional[str]:
-        ok = [r for r in replicas if r.healthy]
+        ok = [r for r in replicas if r.healthy and not r.draining]
         if not ok:
             return None
-        return min(ok, key=lambda r: (r.score(), r.name)).name
+        best = min(
+            ok,
+            key=lambda r: (
+                -self.affinity_depth(r, digests), r.score(), r.name
+            ),
+        )
+        if self.affinity_depth(best, digests) > 0:
+            self.affinity_hits += 1
+        return best.name
 
     def decode_fits(self, r: ReplicaState, n_pages: int) -> bool:
         """Can this decode replica take a bundle of ``n_pages`` now —
         a free slot, the pages themselves, and room under the
         saturation waterline (the headroom that keeps in-flight rows'
         decode growth from hitting a full arena)."""
-        if not r.healthy or r.slots_active >= max(1, r.slots_total):
+        if not r.healthy or r.draining:
+            return False
+        if r.slots_active >= max(1, r.slots_total):
             return False
         if n_pages > r.free_pages:
             return False
@@ -239,12 +305,16 @@ class RouterPolicy:
         session: str,
         replicas: Sequence[ReplicaState],
         n_pages: int,
+        digests: Sequence[str] = (),
     ) -> Tuple[Optional[str], str]:
         """(replica_name, "") or (None, reject_reason). A session
         sticks to its previous decode replica while that replica can
         still take it — its earlier turns' pages (and any prefix
         reuse downstream) live there — and is re-homed, not failed,
-        when the replica is gone or full."""
+        when the replica is gone or full. Session stickiness beats
+        prefix affinity (the session's OWN pages out-rank a shared
+        prefix); among the rest, the deepest digest match wins and
+        occupancy score breaks ties."""
         by_name = {r.name: r for r in replicas}
         if session:
             pinned = self._affinity.get(session)
@@ -255,7 +325,15 @@ class RouterPolicy:
         fits = [r for r in replicas if self.decode_fits(r, n_pages)]
         if not fits:
             return None, "saturated"
-        name = min(fits, key=lambda r: (r.score(), r.name)).name
+        best = min(
+            fits,
+            key=lambda r: (
+                -self.affinity_depth(r, digests), r.score(), r.name
+            ),
+        )
+        if self.affinity_depth(best, digests) > 0:
+            self.affinity_hits += 1
+        name = best.name
         if session:
             self._affinity[session] = name
         return name, ""
@@ -268,7 +346,7 @@ class RouterPolicy:
         replica's own ``submit_raw`` admission test (minus the
         in-flight piggyback deficits only the replica can see — it
         re-checks and refuses, and the router falls back)."""
-        if not r.healthy or r.role != "decode":
+        if not r.healthy or r.draining or r.role != "decode":
             return False
         if not (r.prefill_chunk_pages and r.piggyback_waterline > 0):
             return False
@@ -284,6 +362,7 @@ class RouterPolicy:
         replicas: Sequence[ReplicaState],
         n_pages: int,
         max_chunks: Optional[int] = None,
+        digests: Sequence[str] = (),
     ) -> Optional[str]:
         """Least-loaded decode replica with piggyback headroom, or
         None when no replica clears its waterline.
@@ -306,7 +385,15 @@ class RouterPolicy:
         ]
         if not fits:
             return None
-        return min(fits, key=lambda r: (r.score(), r.name)).name
+        best = min(
+            fits,
+            key=lambda r: (
+                -self.affinity_depth(r, digests), r.score(), r.name
+            ),
+        )
+        if self.affinity_depth(best, digests) > 0:
+            self.affinity_hits += 1
+        return best.name
 
     def pin_session(self, session: str, name: str) -> None:
         """Record decode affinity for a replica chosen outside
@@ -336,6 +423,8 @@ class _Metrics:
             "piggyback_total",
             "deferred_total",
             "tokens_total",
+            "prefix_affinity_hits_total",
+            "session_rehomes_total",
         )
 
     def inc(self, name: str, v: float = 1.0, **labels) -> None:
@@ -369,9 +458,12 @@ class LocalReplica:
         return self._engine.signals()
 
     def prefill(
-        self, prompt: Sequence[int], max_new: int, trace=None
+        self, prompt: Sequence[int], max_new: int, trace=None,
+        session: Optional[str] = None,
     ) -> bytes:
-        return self._engine.prefill(prompt, max_new, trace=trace)
+        return self._engine.prefill(
+            prompt, max_new, trace=trace, session=session
+        )
 
     def decode(self, bundle: bytes) -> Dict[str, Any]:
         slot = self._engine.submit(bundle)
@@ -379,9 +471,12 @@ class LocalReplica:
         return {**out, **self._engine.signals()}
 
     def decode_raw(
-        self, prompt: Sequence[int], max_new: int, trace=None
+        self, prompt: Sequence[int], max_new: int, trace=None,
+        session: Optional[str] = None,
     ) -> Dict[str, Any]:
-        slot = self._engine.submit_raw(prompt, max_new, trace=trace)
+        slot = self._engine.submit_raw(
+            prompt, max_new, trace=trace, session=session
+        )
         out = self._engine.collect_ex(slot)
         return {**out, **self._engine.signals()}
 
@@ -408,13 +503,24 @@ class TcpReplica:
         reply = self._call(json.dumps({"signals": True}).encode())
         return json.loads(reply.decode("utf-8"))
 
+    def drain(self) -> Dict[str, Any]:
+        """Ask the replica to export its live sessions to the spill
+        store and refuse new work — the programmatic scale-in hook
+        (manifest 13's preStop runs exactly this against localhost)."""
+        # wire: produces control-frame
+        reply = self._call(json.dumps({"drain": True}).encode())
+        return json.loads(reply.decode("utf-8"))
+
     def prefill(
-        self, prompt: Sequence[int], max_new: int, trace=None
+        self, prompt: Sequence[int], max_new: int, trace=None,
+        session: Optional[str] = None,
     ) -> bytes:
         # wire: produces control-frame via req
         req = {"prompt": list(prompt), "max_new": int(max_new)}
         if trace:
             req["trace"] = str(trace)
+        if session:
+            req["session"] = str(session)
         reply = self._call(json.dumps(req).encode())
         if reply[:4] != MAGIC:
             err = json.loads(reply.decode("utf-8"))
@@ -428,12 +534,15 @@ class TcpReplica:
         return out
 
     def decode_raw(
-        self, prompt: Sequence[int], max_new: int, trace=None
+        self, prompt: Sequence[int], max_new: int, trace=None,
+        session: Optional[str] = None,
     ) -> Dict[str, Any]:
         # wire: produces control-frame via req
         req = {"prompt": list(prompt), "max_new": int(max_new)}
         if trace:
             req["trace"] = str(trace)
+        if session:
+            req["session"] = str(session)
         out = json.loads(
             self._call(json.dumps(req).encode()).decode("utf-8")
         )
@@ -465,12 +574,18 @@ class RouterServer:
         registry: Optional[ObsRegistry] = None,
         tracer=None,
         slo=None,
+        spill_dir: str = "",
     ):
         self._prefill = list(prefill)
         self._decode = list(decode)
         self.policy = policy if policy is not None else RouterPolicy()
         self.page = max(1, int(page))
         self.max_inflight = max(1, int(max_inflight))
+        #: Shared session store (TPUFW_KV_SPILL_DIR): when a decode
+        #: replica drains mid-request, its exported session bundles
+        #: land here and the router re-homes the request to a
+        #: surviving replica instead of failing it.
+        self.spill_dir = str(spill_dir or "")
         self._metrics = _Metrics(registry)
         self._events = events if events is not None else obs_events.NULL
         self._tracer = tracer if tracer is not None else obs_trace.NULL
@@ -633,6 +748,7 @@ class RouterServer:
                         {"piggyback_waterline": r.piggyback_waterline}
                         if r.piggyback_waterline else {}
                     ),
+                    **({"draining": True} if r.draining else {}),
                 }
                 for name, r in self._states.items()
             }
@@ -722,19 +838,74 @@ class RouterServer:
     # ---- the proxy path -------------------------------------------
 
     def _pick(
-        self, session: str, n_pages: int
+        self, session: str, n_pages: int, digests: Sequence[str] = ()
     ) -> Tuple[Optional[str], Optional[str], str]:
         """(decode_name, prefill_name, reject_reason) under the lock."""
         with self._lock:
+            h0 = self.policy.affinity_hits
             name, reason = self.policy.pick_decode(
                 session,
                 [r for r in self._states.values() if r.role == "decode"],
                 n_pages,
+                digests,
             )
             pname = self.policy.pick_prefill(
-                [r for r in self._states.values() if r.role == "prefill"]
+                [r for r in self._states.values() if r.role == "prefill"],
+                digests,
             )
+            dh = self.policy.affinity_hits - h0
+        if dh:
+            self._metrics.inc("prefix_affinity_hits_total", dh)
         return name, pname, reason
+
+    def _rehome(
+        self, session: str, exclude: set, n_pages: int, ctx
+    ) -> Tuple[Optional[Dict[str, Any]], str]:
+        """Resume a drained session on a surviving decode replica.
+
+        The draining replica exported the session's slot (prompt +
+        every emitted token + its KV pages) to the shared spill
+        directory before refusing further work; the router reads that
+        bundle back and re-dispatches it through the NORMAL decode
+        path — the survivor splices the pages and continues sampling
+        from the exact KV state, so the resumed token stream cannot
+        diverge. Returns (decode_reply, replica) or (None, "")."""
+        # wire: consumes session-bundle via spill-store
+        if not (self.spill_dir and session):
+            return None, ""
+        data = load_session(self.spill_dir, session)
+        if data is None:
+            return None, ""
+        with self._lock:
+            fits = [
+                r for r in self._states.values()
+                if r.role == "decode" and r.name not in exclude
+                and self.policy.decode_fits(r, n_pages)
+            ]
+            target = (
+                min(fits, key=lambda r: (r.score(), r.name)).name
+                if fits else ""
+            )
+        if not target:
+            return None, ""
+        dclient = next(c for c in self._decode if c.name == target)
+        try:
+            out = dclient.decode(data)
+        except Exception:  # noqa: BLE001 — proxy boundary
+            self._metrics.inc("proxy_errors_total")
+            with self._lock:
+                self._states[target].healthy = False
+            return None, ""
+        with self._lock:
+            self._states[target].update(out, now=time.monotonic())
+            self.policy.pin_session(session, target)
+        drop_session(self.spill_dir, session)
+        self._metrics.inc("session_rehomes_total")
+        self._events.emit(
+            "router_rehome", session=session, replica=target,
+            pages=n_pages, trace=ctx.trace_id,
+        )
+        return out, target
 
     def _piggyback(
         self,
@@ -763,14 +934,34 @@ class RouterServer:
         # wire: produces router-response
         dclient = next(c for c in self._decode if c.name == pig)
         tp0 = time.perf_counter()
+        resumed = False
+        err = ""
         try:
-            out = dclient.decode_raw(prompt, max_new, trace=ctx.wire())
+            out = dclient.decode_raw(
+                prompt, max_new, trace=ctx.wire(), session=session or None,
+            )
         except Exception as e:  # noqa: BLE001 — proxy boundary
             self._metrics.inc("proxy_errors_total")
             with self._lock:
                 self._states[pig].healthy = False
-            self.policy.forget_session(session)
-            return 502, {"error": f"{type(e).__name__}: {e}"}, trace_hdr
+            out, err = None, f"{type(e).__name__}: {e}"
+        if out is not None and out.get("drained"):
+            with self._lock:
+                self._states[pig].update(out, now=time.monotonic())
+            # The drained reply names the session the replica actually
+            # exported — prefer it for the spill-store lookup (the
+            # replica's id is authoritative for its own bundle).
+            session = str(out.get("session") or "") or session
+            out, err = None, "decode replica draining"
+        if out is None:
+            # Same recovery as the splice path: the drained replica
+            # exported this session's slot before exiting; a survivor
+            # resumes it from the shared spill store.
+            out, rname = self._rehome(session, {pig}, n_pages, ctx)
+            if out is None:
+                self.policy.forget_session(session)
+                return 502, {"error": err}, trace_hdr
+            pig, resumed = rname, True
         rpc_s = time.perf_counter() - tp0
         reqtrace.stage(
             self._tracer, ctx, "req_piggyback_rpc", rpc_s, replica=pig,
@@ -821,6 +1012,7 @@ class RouterServer:
                 "trace": ctx.trace_id,
                 "ttft_s": round(ttft, 6),
                 "stages": stages,
+                "resumed": resumed,
             },
             trace_hdr,
         )
@@ -868,6 +1060,13 @@ class RouterServer:
             )
         trace_hdr = ((reqtrace.HEADER, ctx.wire()),)
         n_pages = self.n_pages_for(len(prompt), max_new)
+        # Prefix-affinity digests: jax-free, same page-granular
+        # chunking as the replicas' radix tries, computed once per
+        # request and matched against every pick's advertised set.
+        digs = (
+            chunk_digests(prompt, self.page, self.policy.affinity_k)
+            if self.policy.affinity_k else ()
+        )
         cost = len(prompt) + max_new
         tq0 = time.perf_counter()
         if not self._admit(tenant, cost, timeout=600.0):
@@ -884,13 +1083,13 @@ class RouterServer:
             )
             ta0 = time.perf_counter()
             self._reprobe_unhealthy()
-            name, pname, reason = self._pick(session, n_pages)
+            name, pname, reason = self._pick(session, n_pages, digs)
             if name is None or pname is None:
                 # Everything pickable may just be marked unhealthy
                 # from a transient failure — force a probe and retry
                 # once before turning traffic away.
                 self._reprobe_unhealthy(force=True)
-                name, pname, reason = self._pick(session, n_pages)
+                name, pname, reason = self._pick(session, n_pages, digs)
             admit_s = time.perf_counter() - ta0
             if name is None:
                 self._metrics.inc("rejects_total")
@@ -912,6 +1111,7 @@ class RouterServer:
             # the migration hop entirely.
             pig = None
             with self._lock:
+                h0 = self.policy.affinity_hits
                 pstate = self._states.get(pname) if pname else None
                 if pname is None or (
                     pstate is not None and pstate.prefill_inflight > 0
@@ -923,7 +1123,11 @@ class RouterServer:
                         ],
                         n_pages,
                         max_chunks=None if pname is None else 1,
+                        digests=digs,
                     )
+                dh = self.policy.affinity_hits - h0
+            if dh:
+                self._metrics.inc("prefix_affinity_hits_total", dh)
             if pig is not None:
                 return self._piggyback(
                     pig, prompt, max_new, ctx, tenant, session,
@@ -960,7 +1164,10 @@ class RouterServer:
             with self._lock:
                 self._states[pname].prefill_inflight += 1
             try:
-                bundle = pclient.prefill(prompt, max_new, trace=ctx.wire())
+                bundle = pclient.prefill(
+                    prompt, max_new, trace=ctx.wire(),
+                    session=session or None,
+                )
             except Exception as e:  # noqa: BLE001 — proxy boundary
                 self._metrics.inc("proxy_errors_total")
                 with self._lock:
@@ -1012,14 +1219,32 @@ class RouterServer:
             stages["wire"] = round(wire_s, 6)
             reqtrace.stage(self._tracer, ctx, "req_wire", wire_s)
             td0 = time.perf_counter()
+            resumed = False
+            err = ""
             try:
                 out = dclient.decode(bundle)
             except Exception as e:  # noqa: BLE001 — proxy boundary
                 self._metrics.inc("proxy_errors_total")
                 with self._lock:
                     self._states[name].healthy = False
-                self.policy.forget_session(session)
-                return 502, {"error": f"{type(e).__name__}: {e}"}, trace_hdr
+                out, err = None, f"{type(e).__name__}: {e}"
+            if out is not None and out.get("drained"):
+                # The replica drained (SIGTERM / scale-in) while this
+                # request was decoding: its reply carries partial
+                # tokens and its exported session sits in the spill
+                # store. Fold its final signals in, then re-home —
+                # under the session id the reply names (authoritative
+                # for the replica's own export).
+                with self._lock:
+                    self._states[name].update(out, now=time.monotonic())
+                session = str(out.get("session") or "") or session
+                out, err = None, "decode replica draining"
+            if out is None:
+                out, rname = self._rehome(session, {name}, n_pages, ctx)
+                if out is None:
+                    self.policy.forget_session(session)
+                    return 502, {"error": err}, trace_hdr
+                name, resumed = rname, True
             decode_rtt = time.perf_counter() - td0
             reqtrace.stage(
                 self._tracer, ctx, "req_decode_rpc", decode_rtt,
@@ -1064,6 +1289,7 @@ class RouterServer:
                     "trace": ctx.trace_id,
                     "ttft_s": round(ttft, 6),
                     "stages": stages,
+                    "resumed": resumed,
                 },
                 trace_hdr,
             )
@@ -1127,6 +1353,7 @@ def main_router() -> int:
         ),
         saturation=env_float("router_saturation", 0.95),
         retry_after_s=env_int("router_retry_after_s", 5),
+        affinity_k=env_int("router_prefix_affinity", 0),
     )
     events = obs_events.NULL
     tracer = obs_trace.NULL
@@ -1149,6 +1376,7 @@ def main_router() -> int:
         max_inflight=env_int("router_inflight", 4),
         events=events,
         tracer=tracer,
+        spill_dir=env_str("kv_spill_dir", ""),
     )
     # Fleet observatory attach point: the collector scrapes this
     # router's own exposition in-process plus every replica's framed-

@@ -1026,6 +1026,31 @@ class _SlotScheduler:
                 f"{self.prefill_chunk_pages}: chunked prefill is "
                 "page-granular and needs TPUFW_SERVE_PAGE > 0"
             )
+        # KV fabric: host-RAM spill tier behind the page arena.
+        # TPUFW_KV_SPILL budgets it in PAGES (the arena's own unit);
+        # TPUFW_KV_SPILL_DIR adds the directory overflow / session
+        # store. Evicted prefix pages demote there instead of dying,
+        # and a later prompt sharing the prefix restores them through
+        # the normal splice path instead of re-prefilling.
+        self.kv_spill_pages = max(0, env_int("kv_spill", 0))
+        self.kv_spill_dir = env_str("kv_spill_dir", "")
+        self._spill = None
+        if self.kv_spill_pages or self.kv_spill_dir:
+            if not self.page:
+                raise ValueError(
+                    f"TPUFW_KV_SPILL={self.kv_spill_pages}: the spill "
+                    "tier is page-granular and needs "
+                    "TPUFW_SERVE_PAGE > 0"
+                )
+            from tpufw.infer.spill import SpillTier
+
+            self._spill = SpillTier(
+                self.kv_spill_pages, self.kv_spill_dir
+            )
+        # Scrape-time delta cursor: the tier's byte total is monotonic
+        # but registry counters only inc, so /metrics advances the
+        # counter by the delta since the last scrape.
+        self._spill_seen_bytes = 0
         if self.page:
             cap = model.cfg.max_seq_len
             # Every cache-ladder rung is a pow2 >= cache_floor or the
@@ -1140,6 +1165,16 @@ class _SlotScheduler:
                 metrics.registry.counter("tpufw_prefill_chunks_total")
                 metrics.registry.counter("tpufw_prefill_resumes_total")
                 metrics.registry.gauge("tpufw_prefill_inflight")
+            if self._spill is not None:
+                # KV-fabric series also live OUTSIDE the prefix (the
+                # disagg engines report the same spill tier); gated so
+                # a spill-less exposition stays byte-identical.
+                metrics.registry.counter("tpufw_kv_spill_bytes_total")
+                metrics.registry.gauge("tpufw_kv_spill_pages")
+                metrics.registry.histogram(
+                    "tpufw_kv_restore_seconds",
+                    "Spill-tier restore wall (host fetch + decode)",
+                )
             if self.spec_k:
                 # Speculation metrics live OUTSIDE the tpufw_serve_
                 # prefix (they also serve the disagg DecodeEngine);
@@ -1470,6 +1505,25 @@ class _SlotScheduler:
                     pad_id=0,
                     eos_id=self._eos,
                 )
+        if self.page and self._spill is not None:
+            # Re-wired on every pool rebuild: the spill closures close
+            # over the pool they serialize for. The tier itself (and
+            # its contents) survives rebuilds — a cache-ladder switch
+            # does not forget spilled KV.
+            from tpufw.serve import bundle as serve_bundle
+
+            serve_bundle.attach_spill(
+                self._pool,
+                self._spill,
+                events=self._events,
+                on_restore=(
+                    self._metrics.registry.histogram(
+                        "tpufw_kv_restore_seconds"
+                    ).observe
+                    if self._metrics is not None
+                    else None
+                ),
+            )
         if self._perf.enabled:
             # Mount the cost observatory on the pool (dynamic attr:
             # SlotPool/PagedSlotPool read it via getattr) so insert /
@@ -2724,6 +2778,28 @@ class _Server:
             if self._batcher.page:
                 g["pages_in_use"] = float(self._batcher.pages_in_use)
                 g["pages_total"] = float(self._batcher.pages_total)
+            spill = getattr(self._batcher, "_spill", None)
+            if spill is not None:
+                # Unprefixed KV-fabric series refresh here too (same
+                # scrape-time single-source-of-truth contract as the
+                # gauges dict; the tier owns the numbers).
+                st = spill.stats()
+                reg = self.metrics.registry
+                reg.gauge("tpufw_kv_spill_pages").set(
+                    float(st["ram_pages"]), tier="ram"
+                )
+                reg.gauge("tpufw_kv_spill_pages").set(
+                    float(st["dir_pages"]), tier="dir"
+                )
+                delta = (
+                    st["spilled_bytes_total"]
+                    - self._batcher._spill_seen_bytes
+                )
+                if delta > 0:
+                    reg.counter("tpufw_kv_spill_bytes_total").inc(delta)
+                    self._batcher._spill_seen_bytes = st[
+                        "spilled_bytes_total"
+                    ]
         return g
 
     def _run_tick(
