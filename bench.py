@@ -1450,6 +1450,264 @@ def _serve_disagg_main(argv: list) -> int:
     return 0
 
 
+def _load_main(argv: list) -> int:
+    """``python bench.py load [out.json]`` — the load-observatory
+    tier: a capacity-frontier sweep (tpufw.load) against a real
+    in-process gang, plus the harness-attachment overhead arm. Writes
+    BENCH_load.json: per-tenant attainment-vs-offered-load curves,
+    goodput, TTFT stage decomposition, the detected knee, and the
+    decode per-token p50 regression with the load harness + executor
+    attached (budget: < 3%).
+
+    Rungs and targets are CALIBRATED from a sequential probe rather
+    than hard-coded — on any backend the ladder brackets the measured
+    service capacity (0.5x..4x), so the knee lands mid-ladder and the
+    artifact shape is machine-independent even though the absolute
+    numbers are not."""
+    import dataclasses as _dc
+    import tempfile as _tf
+    import threading as _threading
+    import urllib.request as _rq
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpufw.infer import SamplingConfig
+    from tpufw.load import GangExecutor, MixConfig, TraceWriter
+    from tpufw.load.sweep import SweepConfig, run_sweep
+    from tpufw.models import LLAMA_CONFIGS, Llama
+    from tpufw.obs import fleet
+    from tpufw.obs.events import EventLog
+    from tpufw.obs.registry import Registry
+    from tpufw.obs.slo import SloTracker
+    from tpufw.serve.roles import DecodeEngine, PrefillEngine
+    from tpufw.serve.router import LocalReplica, RouterServer
+
+    cfg = _dc.replace(
+        LLAMA_CONFIGS["llama3_tiny"].decode_config(), max_seq_len=128
+    )
+    model = Llama(cfg)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    greedy = SamplingConfig(temperature=0.0)
+    common = dict(sampling=greedy, page=16, kv_quant="int8")
+    fdir = _tf.mkdtemp(prefix="tpufw-bench-load-")
+    events = EventLog(os.path.join(fdir, fleet.EVENTS_FILENAME))
+    reg = Registry()
+    slo = SloTracker(
+        reg, events, ttft_ms=60000.0, tok_ms=60000.0, goal=0.9,
+        windows=(10.0, 60.0),
+    )
+    max_inflight = 2  # small admission window => a reachable knee
+    router = RouterServer(
+        [LocalReplica("prefill-0",
+                      PrefillEngine(model, params, n_slots=2,
+                                    **common))],
+        [LocalReplica("decode-0",
+                      DecodeEngine(model, params, n_slots=4, chunk=2,
+                                   **common))],
+        port=0, page=16, max_inflight=max_inflight,
+        events=events, registry=reg, slo=slo,
+    )
+    base = f"http://127.0.0.1:{router.port}"
+
+    def post(body: dict) -> dict:
+        req = _rq.Request(
+            base + "/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with _rq.urlopen(req, timeout=600) as resp:
+            return json.loads(resp.read())
+
+    def tok_s(reply: dict, wall: float) -> float:
+        n = len(reply.get("tokens", []))
+        ttft = float(reply.get("ttft_s", 0.0))
+        return (wall - ttft) / (n - 1) if n > 1 else wall
+
+    def sequential_arm(n: int, tenant: str) -> list:
+        # Long decode runs (23 steady-state steps) so the per-token
+        # p50 integrates over enough device work to resolve a 3%
+        # delta above timer noise.
+        out = []
+        for i in range(n):
+            t0 = time.perf_counter()
+            reply = post({"prompt": [5 + i, 7, 11, 13, 17, 19],
+                          "max_new": 24, "tenant": tenant})
+            out.append(tok_s(reply, time.perf_counter() - t0))
+        return sorted(out)
+
+    try:
+        from tpufw.load import ReplayClient, schedule
+
+        mix = MixConfig(
+            seed=7, process="poisson",
+            tenants=(("vip", 3.0), ("batch", 1.0)),
+            prompt_len_base=8, prompt_len_cap=24,
+            prefix_len=8, n_prefixes=2,
+            max_new_base=6, max_new_cap=8,
+            session_ratio=0.2, prefix_ratio=0.5,
+        )
+
+        def burst(seed: int) -> list:
+            c = ReplayClient(base, None, threads=8)
+            c.run(schedule(_dc.replace(
+                mix, seed=seed, rate_rps=60.0, duration_s=2.0
+            )))
+            return c.records
+
+        # ---- calibration -----------------------------------------
+        sequential_arm(3, "default")  # jit warmup, sequential paths
+        # Burst A compiles the concurrency-only paths (piggyback
+        # admission, chunked prefill under contention) and is
+        # discarded; burst B, driven far past capacity, measures the
+        # SATURATED operating point: achieved throughput (~ true
+        # service capacity) and saturated server-side TTFT.
+        burst(101)
+        recs = [r for r in burst(102) if r["status"] == 200]
+        wall = max(r["ts_done"] for r in recs) - min(
+            r["ts_sent"] for r in recs
+        )
+        achieved_rps = len(recs) / max(1e-3, wall)
+        sat = sorted(float(r["ttft_s"]) for r in recs
+                     if "ttft_s" in r)
+        t_hi = sat[len(sat) // 2]
+        t0 = time.perf_counter()
+        probe = [post({"prompt": [2, 3, 5, 7], "max_new": 8,
+                       "tenant": "default"}) for _ in range(4)]
+        service_s = (time.perf_counter() - t0) / 4
+        t_lo = sum(float(r["ttft_s"]) for r in probe) / 4
+        # Ladder brackets the measured capacity. The vip target is
+        # 1.5x the SEQUENTIAL unloaded TTFT — above the slowest
+        # admission path's (dedicated prefill + migration hop)
+        # no-queue latency, so under-capacity rungs pass on any path
+        # mix, while saturated rungs accumulate queue wait well past
+        # it — a knee exists by construction wherever the frontier
+        # is.
+        rungs = tuple(
+            round(achieved_rps * m, 3) for m in (0.2, 0.5, 1.0, 2.0)
+        )
+        ttft_target = 1.5 * t_lo
+        sweep = SweepConfig(
+            rungs=rungs, hold_s=5.0, settle_s=1.0, goal=0.9,
+            ttft_target_s=ttft_target, tok_target_s=60.0,
+            # vip pays for the tighter target it gets; batch is the
+            # best-effort tier — the per-tenant curves must diverge
+            # past the knee.
+            tenant_targets=(
+                ("vip", (ttft_target, 60.0)),
+                ("batch", (3.0 * ttft_target, 60.0)),
+            ),
+            # Open-loop fidelity holds only up to the client pool
+            # size — past it the harness degrades toward closed-loop
+            # and high rungs flatter the server. 16 workers keeps the
+            # top rung honestly oversubscribed.
+            threads=16,
+        )
+
+        # ---- the attached observatory (sweep + overhead arm) ------
+        store = fleet.SeriesStore(
+            os.path.join(fdir, fleet.SERIES_FILENAME),
+            max_records=4096,
+        )
+        recommender = fleet.ScalingRecommender(
+            fdir,
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "deploy",
+                "manifests", "13-serve-disagg-v5e8-jobset.yaml",
+            ),
+            cooldown_s=3600.0, events=events,
+        )
+        collector = fleet.FleetCollector(
+            [fleet.Target("router", "router", router.render_metrics)],
+            store, events=events, recommender=recommender,
+            health_fn=router.health,
+        )
+        executor = GangExecutor(
+            router,
+            spawn={"decode": lambda name: LocalReplica(
+                name, DecodeEngine(model, params, n_slots=4, chunk=2,
+                                   **common))},
+            events=events, slo=slo, burn_window="10s",
+        )
+        executor.subscribe(recommender)
+        stop_scrape = _threading.Event()
+
+        def scrape_loop() -> None:
+            while not stop_scrape.wait(0.5):
+                collector.scrape_once()
+
+        scraper = _threading.Thread(target=scrape_loop, daemon=True)
+        scraper.start()
+        trace = TraceWriter(os.path.join(fdir, "load-trace.jsonl"))
+        try:
+            payload = run_sweep(
+                base, mix, sweep, trace=trace, events=events,
+                slo=slo, fleet_records=store.read(),
+            )
+        finally:
+            trace.close()
+            stop_scrape.set()
+            scraper.join(timeout=5)
+        # ---- overhead arms: identical sequential traffic with the
+        # observatory attached (collector scraping + executor
+        # subscribed) vs detached, ALTERNATED so clock drift between
+        # arms averages out instead of masquerading as overhead -----
+        attached: list = []
+        detached: list = []
+        for _ in range(2):
+            detached += sequential_arm(16, "default")
+            stop2 = _threading.Event()
+
+            def scrape_loop2(ev=stop2) -> None:
+                while not ev.wait(0.5):
+                    collector.scrape_once()
+
+            th = _threading.Thread(target=scrape_loop2, daemon=True)
+            th.start()
+            attached += sequential_arm(16, "default")
+            stop2.set()
+            th.join(timeout=5)
+        attached.sort()
+        detached.sort()
+        base_p50 = detached[len(detached) // 2]
+        att_p50 = attached[len(attached) // 2]
+        payload.update({
+            "model": "llama3_tiny",
+            "platform": jax.default_backend(),
+            "calibration": {
+                "service_s": round(service_s, 6),
+                "ttft_unloaded_s": round(t_lo, 6),
+                "ttft_saturated_s": round(t_hi, 6),
+                "ttft_target_s": round(ttft_target, 6),
+                "achieved_rps": round(achieved_rps, 3),
+                "max_inflight": max_inflight,
+            },
+            "overhead": {
+                "detached_tok_p50_s": round(base_p50, 6),
+                "attached_tok_p50_s": round(att_p50, 6),
+                "tok_p50_regression": round(
+                    (att_p50 - base_p50) / base_p50, 4
+                ),
+                "budget": 0.03,
+            },
+        })
+        executor.close()
+        store.close()
+    finally:
+        events.close()
+        router.close()
+    out_path = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_load.json"
+    )
+    with open(out_path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    _emit({k: payload[k] for k in ("bench", "knee", "overhead")})
+    return 0
+
+
 def _worker() -> int:
     import signal
 
@@ -2723,4 +2981,6 @@ def _worker() -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serve-disagg":
         sys.exit(_serve_disagg_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "load":
+        sys.exit(_load_main(sys.argv[2:]))
     sys.exit(_worker() if _IS_WORKER else _orchestrate())

@@ -1,0 +1,381 @@
+"""CI smoke for the load observatory: one full CLOSED autoscaling
+cycle — measured burn rate in, replica membership change out — on a
+real CPU gang in a single process.
+
+A 3-role serving gang (llama3_tiny random init behind LocalReplica +
+RouterServer) runs a seeded MMPP burst mix through the real HTTP
+surface via tpufw.load's ReplayClient. The "burst" tenant carries an
+impossibly tight per-token target (0.1 µs), so every burst request
+violates deterministically and the fast/slow burn-rate pair — on
+compressed 4s/12s windows — pegs at 1/(1−goal) = 100. What must
+hold, in causal order:
+
+- pre-traffic sweep: both roles live, no alerts;
+- burst replay lands real load-trace.jsonl records and the
+  re-aggregated ``tpufw_fleet_slo_burn_rate`` crosses the pair →
+  ``load_tok_burn`` fires → ScalingRecommender emits ONE decision
+  (decode +1) → the subscribed GangExecutor spawns a REAL decode
+  engine, registers it with the router (membership visible in
+  /healthz), and stamps a ``scale_action`` add event carrying the
+  burn rate at decision time;
+- recovery: the burst tenant's target is relaxed (standing in for
+  restored capacity — CPU latency is too noisy to assert the real
+  thing), violations age out of the 4s window, good traffic lands,
+  and ``poll_recovery()`` stamps ``scale_action`` recovered;
+- scale-in: traffic stops, ``tpufw_fleet_requests_per_s`` falls to
+  ~0, the idle rule fires after its hold, the recommender (cooldown
+  elapsed) steps decode −1, and the executor drains + deregisters
+  the replica IT spawned (the base gang is untouchable);
+- the whole cycle completes in < 90 s, obs_summary digests the dir
+  (per-rung table + scale-action timeline), and a torn trace tail
+  degrades gracefully.
+
+Exit 0 on success. Honors TPUFW_LOAD_DIR so CI can upload the trace,
+series, events, and decision artifacts.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+)
+
+PAGE = 16
+CYCLE_BUDGET_S = 90.0
+
+
+def _post(base: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        base + "/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=600) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from tpufw.infer import SamplingConfig
+    from tpufw.load import (
+        GangExecutor, MixConfig, ReplayClient, TraceWriter,
+        read_trace, schedule,
+    )
+    from tpufw.models import LLAMA_CONFIGS, Llama
+    from tpufw.obs import fleet
+    from tpufw.obs.events import EventLog, read_events
+    from tpufw.obs.registry import Registry
+    from tpufw.obs.slo import SloTracker
+    from tpufw.serve.roles import DecodeEngine, PrefillEngine
+    from tpufw.serve.router import LocalReplica, RouterServer
+    from tpufw.workloads.env import env_opt_str
+
+    t_cycle0 = time.monotonic()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    manifest = os.path.join(
+        repo, "deploy", "manifests", "13-serve-disagg-v5e8-jobset.yaml"
+    )
+    fdir = env_opt_str("load_dir") or tempfile.mkdtemp(
+        prefix="tpufw-load-smoke-"
+    )
+    os.makedirs(fdir, exist_ok=True)
+
+    failures: list = []
+
+    def check(ok: bool, what: str) -> None:
+        print(("ok: " if ok else "FAILED: ") + what)
+        if not ok:
+            failures.append(what)
+
+    # ---- the gang -------------------------------------------------
+    greedy = SamplingConfig(temperature=0.0)
+    cfg = dataclasses.replace(
+        LLAMA_CONFIGS["llama3_tiny"].decode_config(), max_seq_len=64
+    )
+    model = Llama(cfg)
+    params = jax.jit(model.init)(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    events = EventLog(os.path.join(fdir, fleet.EVENTS_FILENAME))
+    common = dict(sampling=greedy, page=PAGE, kv_quant="int8")
+    pe = PrefillEngine(model, params, n_slots=2, **common)
+    de = DecodeEngine(model, params, n_slots=4, chunk=2, **common)
+    reg = Registry()
+    # Generous defaults, one poisoned tenant: every "burst" request
+    # (max_new >= 3, so per-token latency is judged) misses the 0.1 µs
+    # tok target by construction — the deterministic CPU stand-in for
+    # a genuinely overloaded pool. Compressed 4s/12s windows keep the
+    # whole burn->recover cycle inside the CI budget.
+    slo = SloTracker(
+        reg, events, ttft_ms=60000.0, tok_ms=60000.0, goal=0.99,
+        windows=(4.0, 12.0), tenants={"burst": (60000.0, 0.0001)},
+    )
+    router = RouterServer(
+        [LocalReplica("prefill-0", pe)], [LocalReplica("decode-0", de)],
+        port=0, page=PAGE, events=events, registry=reg, slo=slo,
+    )
+    base = f"http://127.0.0.1:{router.port}"
+
+    # ---- observatory + the closed loop ----------------------------
+    store = fleet.SeriesStore(
+        os.path.join(fdir, fleet.SERIES_FILENAME), max_records=4096
+    )
+    try:
+        recommender = fleet.ScalingRecommender(
+            fdir, manifest, cooldown_s=3.0, events=events
+        )
+        rules = (
+            fleet.BurnRateRule(
+                name="load_tok_burn", metric="tok",
+                fast_window="4s", slow_window="12s",
+                severity="page", scale="decode:+1",
+            ),
+            # Scale-in signal: requests_per_s derives from the sweep-
+            # over-sweep counter delta, so it is absent pre-traffic
+            # (no instance -> no pending), high under the burst, and
+            # ~0 two sweeps after traffic stops.
+            fleet.AlertRule(
+                name="load_idle_traffic",
+                series="tpufw_fleet_requests_per_s",
+                op="<", threshold=0.05, for_s=2.0,
+                severity="info", scale="decode:-1",
+            ),
+        )
+        collector = fleet.FleetCollector(
+            [
+                fleet.Target("router", "router", router.render_metrics),
+            ],
+            store,
+            events=events,
+            rules=rules,
+            recommender=recommender,
+            health_fn=router.health,
+        )
+    except BaseException:
+        store.close()  # wiring raising must not strand the handle
+        raise
+
+    def spawn_decode(name: str):
+        # jit cache is process-wide and warm, so the new engine joins
+        # in milliseconds — the CPU analog of a pod passing readiness.
+        return LocalReplica(
+            name, DecodeEngine(model, params, n_slots=4, chunk=2,
+                               **common)
+        )
+
+    executor = GangExecutor(
+        router, spawn={"decode": spawn_decode}, events=events,
+        slo=slo, burn_window="4s",
+    )
+    executor.subscribe(recommender)
+
+    def decode_count() -> int:
+        return sum(
+            1 for r in router.health()["replicas"].values()
+            if r["role"] == "decode"
+        )
+
+    # ---- warm the jit caches under the generous default tenant ----
+    body = _post(base, {"prompt": [3, 5, 7, 9], "max_new": 6,
+                        "tenant": "default"})
+    check(len(body.get("tokens", [])) == 6, "warmup request served")
+
+    # ---- sweep 1: pre-traffic baseline ----------------------------
+    derived0 = collector.scrape_once()
+    check(
+        derived0.get('tpufw_fleet_replicas{role="prefill"}') == 1.0
+        and derived0.get('tpufw_fleet_replicas{role="decode"}') == 1.0,
+        "sweep 1 sees both roles live",
+    )
+    ev_path = os.path.join(fdir, fleet.EVENTS_FILENAME)
+    check(
+        not [e for e in read_events(ev_path)
+             if e.get("kind") == "fleet_alert"],
+        "no alerts before traffic",
+    )
+
+    # ---- burst: seeded MMPP mix through the real HTTP surface -----
+    events.emit("load_phase", phase="burst")
+    slo.set_phase("burst")
+    mix = MixConfig(
+        seed=20, process="mmpp", rate_rps=5.0, duration_s=2.5,
+        tenants=(("burst", 1.0),),
+        prompt_len_base=8, prompt_len_cap=24,
+        prefix_len=8, n_prefixes=2,
+        max_new_base=6, max_new_cap=8,
+        session_ratio=0.2, mmpp_burst_factor=4.0, mmpp_dwell_s=0.8,
+    )
+    trace = TraceWriter(os.path.join(fdir, "load-trace.jsonl"))
+    try:
+        client = ReplayClient(base, trace, threads=4, rung=0,
+                              offered_rps=mix.rate_rps)
+        summary = client.run(schedule(mix))
+        check(
+            summary["completed"] > 0,
+            f"burst replay served through the router ({summary})",
+        )
+
+        # ---- sweep 2: burn crosses the pair -> decision -> scale-up ---
+        derived1 = collector.scrape_once()
+        fast = derived1.get(
+            'tpufw_fleet_slo_burn_rate{metric="tok",tenant="burst",window="4s"}'
+        )
+        slow = derived1.get(
+            'tpufw_fleet_slo_burn_rate{metric="tok",tenant="burst",window="12s"}'
+        )
+        check(
+            fast is not None and fast > 14.4
+            and slow is not None and slow > 6.0,
+            f"burn rate crossed the fast/slow pair (4s={fast}, 12s={slow})",
+        )
+        check(decode_count() == 2, "executor scaled the decode pool up")
+        adds = [e for e in read_events(ev_path)
+                if e.get("kind") == "scale_action"
+                and e.get("action") == "add"]
+        check(
+            len(adds) == 1 and adds[0]["pool"] == "decode"
+            and adds[0].get("burn", 0.0) > 14.4,
+            f"scale_action add carries burn-rate-at-decision ({adds})",
+        )
+        check(
+            reg.counter("tpufw_router_replica_changes_total").value(
+                role="decode", op="add"
+            ) == 1.0,
+            "membership change counted on the router",
+        )
+        spawned = adds[0]["replica"] if adds else ""
+
+        # ---- recovery: capacity "restored", burn falls under 1 --------
+        # Relaxing the tenant target stands in for restored capacity —
+        # asserting a real CPU latency drop from +1 replica would flake.
+        slo.tenants["burst"] = (60000.0, 60000.0)
+        time.sleep(4.2)  # violations age out of the 4s fast window
+        for i in range(2):
+            _post(base, {"prompt": [11 + i, 13, 17], "max_new": 6,
+                         "tenant": "burst"})
+        recovered = executor.poll_recovery()
+        check(
+            recovered is not None
+            and recovered["action"] == "recovered"
+            and recovered["replica"] == spawned
+            and recovered.get("burn", 1.0) < 1.0,
+            f"burn recovery observed and linked to the decision "
+            f"({recovered})",
+        )
+
+        # ---- scale-in: idle rule -> decision -> drain + deregister ----
+        events.emit("load_phase", phase="idle")
+        slo.set_phase("")
+        deadline = time.monotonic() + 30.0
+        while decode_count() > 1 and time.monotonic() < deadline:
+            collector.scrape_once()
+            time.sleep(0.7)
+        check(decode_count() == 1, "idle cooldown scaled the pool back in")
+        removes = [e for e in read_events(ev_path)
+                   if e.get("kind") == "scale_action"
+                   and e.get("action") == "remove"]
+        check(
+            len(removes) == 1 and removes[0]["replica"] == spawned,
+            f"executor drained and removed ITS replica, not the base gang "
+            f"({removes})",
+        )
+        decisions = sorted(
+            f for f in os.listdir(fdir)
+            if f.startswith("fleet-rec-") and f.endswith(".json")
+        )
+        check(
+            len(decisions) == 2,
+            f"one decision up, one decision down ({decisions})",
+        )
+
+        # ---- the causal chain, reconstructed from the event log alone -
+        kinds = [
+            (e["kind"], e.get("action") or e.get("state") or e.get("phase"))
+            for e in read_events(ev_path)
+            if e.get("kind") in (
+                "fleet_alert", "fleet_recommendation", "scale_action",
+                "load_phase",
+            )
+        ]
+        want = [
+            ("load_phase", "burst"),
+            ("fleet_alert", "firing"),
+            ("fleet_recommendation", None),
+            ("scale_action", "add"),
+            ("scale_action", "recovered"),
+            ("load_phase", "idle"),
+            ("scale_action", "remove"),
+        ]
+        it = iter(kinds)
+        ordered = all(
+            any(k == wk and (wa is None or a == wa) for k, a in it)
+            for wk, wa in want
+        )
+        check(ordered, f"event log tells the full causal story ({kinds})")
+
+    finally:
+        trace.close()
+
+    # ---- trace file: real records, torn tail degrades -------------
+    trace_path = os.path.join(fdir, "load-trace.jsonl")
+    n_recs = len(read_trace(trace_path))
+    check(
+        n_recs == summary["offered"],
+        f"every burst request landed a trace record ({n_recs})",
+    )
+    with open(trace_path, "a", encoding="utf-8") as f:
+        f.write('{"ts_offered": 9e9, "tenant": "to')  # SIGKILL mid-write
+    check(
+        len(read_trace(trace_path)) == n_recs,
+        "torn trace tail drops only the torn line",
+    )
+
+    # ---- digest ---------------------------------------------------
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "obs_summary.py"),
+         fdir],
+        capture_output=True, text=True, timeout=120,
+    )
+    print(proc.stdout, end="")
+    check(
+        proc.returncode == 0 and "load observatory" in proc.stdout
+        and "scale actions" in proc.stdout,
+        "obs_summary digests the load dir (rung table + timeline)",
+    )
+
+    cycle_s = time.monotonic() - t_cycle0
+    check(
+        cycle_s < CYCLE_BUDGET_S,
+        f"full closed cycle in {cycle_s:.1f}s < {CYCLE_BUDGET_S:.0f}s",
+    )
+
+    executor.close()
+    store.close()
+    events.close()
+    router.close()
+    if failures:
+        print(f"load-smoke FAILED ({len(failures)} check(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("load-smoke OK: burst -> burn -> recommendation -> scale-up "
+          "-> recovery -> idle -> scale-down, closed end to end in "
+          f"{cycle_s:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

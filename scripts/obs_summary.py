@@ -598,6 +598,102 @@ def summarize_fleet(out: str, window_s: float = 300.0) -> None:
             )
 
 
+def summarize_load(out: str) -> None:
+    """Load observatory digest: per-rung attainment table (from the
+    torn-tolerant load-trace reader), the detected knee from
+    BENCH_load.json, and the scale_action timeline with each
+    decision's burn rate. Prints nothing when the dir has neither a
+    load trace nor a load bench payload."""
+    from tpufw.load.genload import read_trace
+
+    trace_path = os.path.join(out, "load-trace.jsonl")
+    bench = _load_json(os.path.join(out, "BENCH_load.json"))
+    recs = read_trace(trace_path)
+    if bench is None and not recs:
+        return
+    print("-- load observatory --")
+    if recs:
+        rungs: dict = {}
+        for r in recs:
+            rungs.setdefault(
+                (r["rung"], r["offered_rps"]), []
+            ).append(r)
+        print(
+            f"  {len(recs)} trace record(s), {len(rungs)} rung(s):"
+        )
+        print(
+            "    rung  rps      offered  ok    429   err   "
+            "ttft_p50  ttft_p95"
+        )
+        for (rung, rps), rs in sorted(rungs.items()):
+            ok = sum(1 for r in rs if r["status"] == 200)
+            rej = sum(1 for r in rs if r["status"] == 429)
+            ttfts = sorted(
+                float(r["ttft_s"]) for r in rs
+                if isinstance(r.get("ttft_s"), (int, float))
+            )
+            print(
+                f"    {rung:<5} {rps:<8g} {len(rs):<8} {ok:<5} "
+                f"{rej:<5} {len(rs) - ok - rej:<5} "
+                f"{_fmt_s(_percentile(ttfts, 50)):>8}  "
+                f"{_fmt_s(_percentile(ttfts, 95)):>8}"
+            )
+    if bench is not None:
+        goal = bench.get("goal")
+        for rung in bench.get("rungs", []):
+            tens = rung.get("tenants", {})
+            att = " ".join(
+                f"{t}={st.get('attainment', 0):.3f}"
+                for t, st in sorted(tens.items())
+            )
+            print(
+                f"  rung {rung.get('rung')} "
+                f"@{rung.get('offered_rps')}rps: "
+                f"attainment={rung.get('attainment', 0):.3f} "
+                f"goodput={rung.get('goodput_tok_s', 0):.1f}tok/s "
+                f"[{att}]"
+            )
+        knee = bench.get("knee")
+        if knee is not None:
+            print(
+                f"  knee: rung {knee.get('rung')} @ "
+                f"{knee.get('offered_rps')} rps "
+                f"(attainment {knee.get('attainment')} >= goal {goal})"
+            )
+        else:
+            print(f"  knee: none (no rung met goal {goal})")
+    actions = []
+    phases = []
+    for path in sorted(glob.glob(os.path.join(out, "events*.jsonl"))):
+        for e in read_events(path):
+            if e.get("kind") == "scale_action":
+                actions.append(e)
+            elif e.get("kind") == "load_phase":
+                phases.append(e)
+    if phases:
+        print(
+            "  phases: "
+            + " -> ".join(str(e.get("phase")) for e in phases[-8:])
+        )
+    if actions:
+        print("  scale actions:")
+        for e in actions[-10:]:
+            burn = e.get("burn")
+            print(
+                f"    {e.get('ts', 0):.3f} {e.get('action'):<10} "
+                f"{e.get('pool')}/{e.get('replica') or '-'}"
+                + (f" burn={burn}" if burn is not None else "")
+                + (
+                    f" decision@{e.get('decision_ts')}"
+                    if e.get("decision_ts") is not None else ""
+                )
+                + (
+                    f" recovery={_fmt_s(float(e['recovery_s']))}"
+                    if e.get("recovery_s") is not None else ""
+                )
+            )
+
+
 def main(argv: list[str]) -> int:
     if len(argv) != 2:
         print(__doc__.strip(), file=sys.stderr)
@@ -630,6 +726,7 @@ def main(argv: list[str]) -> int:
         print("-- metrics snapshot --")
         summarize_metrics(prom)
     summarize_fleet(out)
+    summarize_load(out)
     summarize_crash_bundles(out)
     return 0
 

@@ -2840,6 +2840,33 @@ def test_tpu017_claimed_header_unserved(tmp_path):
     assert keys(out) == ["header:X-Missing-Header:unserved"], keys(out)
 
 
+def test_tpu017_membership_routing_counts_as_served(tmp_path):
+    """Routers that gate with `path not in (...)` serve every route in
+    the tuple — the membership test is the routing decision."""
+    out = run_fixture(
+        tmp_path,
+        {
+            "server.py": (
+                "# http: serves\n"
+                "def handle(self):\n"
+                "    if self.path not in ('/pingz', '/replicaz'):\n"
+                "        return\n"
+                "    self._reply(200, b'ok')\n"
+            ),
+            "smoke.py": (
+                "# http: claims\n"
+                "def smoke(fetch, base):\n"
+                "    r = fetch(base + '/pingz')\n"
+                "    assert r.status == 200\n"
+                "    q = fetch(base + '/replicaz')\n"
+                "    assert q.status == 200\n"
+            ),
+        },
+        rules=["TPU017"],
+    )
+    assert out == [], keys(out)
+
+
 def test_tpu017_served_unclaimed_warning(tmp_path):
     """An endpoint nothing tests or documents is a warning, not an
     error — it works, but nothing would notice it breaking."""

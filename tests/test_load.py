@@ -1,0 +1,370 @@
+"""Load observatory (tpufw.load): generator determinism, trace
+schema + torn tolerance, capacity-frontier scoring, and the closed
+scaling loop (recommender -> executor -> router membership).
+
+The determinism tests are the load tier's contract with every future
+bench: same seed + mix ⇒ byte-identical offered schedule, so two
+rungs — or the same rung across a code change — compare on identical
+traffic. The live HTTP loop runs in scripts/load_smoke.py; here the
+router is exercised in-process and the executor against stubs.
+"""
+
+import dataclasses
+import json
+import os
+import threading
+
+import pytest
+
+from tpufw.load import (
+    GangExecutor,
+    MixConfig,
+    TraceWriter,
+    parse_tenant_weights,
+    read_trace,
+    schedule,
+    schedule_digest,
+    validate_trace_record,
+)
+from tpufw.load.sweep import SweepConfig, detect_knee, rung_stats
+from tpufw.obs import events as obs_events
+from tpufw.obs import fleet
+from tpufw.obs.registry import Registry
+from tpufw.obs.slo import SloTracker
+
+MANIFEST = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "deploy",
+    "manifests",
+    "13-serve-disagg-v5e8-jobset.yaml",
+)
+
+MIX = MixConfig(
+    seed=11,
+    process="mmpp",
+    rate_rps=25.0,
+    duration_s=4.0,
+    tenants=(("vip", 3.0), ("batch", 1.0)),
+    prefix_ratio=0.6,
+    session_ratio=0.3,
+)
+
+
+# ------------------------------------------------------ determinism
+
+
+def test_same_seed_same_mix_is_byte_identical():
+    a, b = schedule(MIX), schedule(MIX)
+    ja = json.dumps([dataclasses.asdict(r) for r in a], sort_keys=True)
+    jb = json.dumps([dataclasses.asdict(r) for r in b], sort_keys=True)
+    assert ja == jb  # arrivals AND prompts AND sessions, bytewise
+    assert schedule_digest(a) == schedule_digest(b)
+
+
+def test_seed_change_changes_schedule():
+    a = schedule(MIX)
+    b = schedule(dataclasses.replace(MIX, seed=MIX.seed + 1))
+    assert schedule_digest(a) != schedule_digest(b)
+
+
+@pytest.mark.parametrize("process", ["poisson", "mmpp", "diurnal"])
+def test_arrival_processes_stay_in_window(process):
+    cfg = dataclasses.replace(MIX, process=process)
+    reqs = schedule(cfg)
+    assert reqs, "no arrivals generated"
+    assert all(0.0 <= r.t < cfg.duration_s for r in reqs)
+    assert [r.t for r in reqs] == sorted(r.t for r in reqs)
+    # Loose count bound (seeded, so stable): base rate*duration is
+    # 100; MMPP averages (1+burst_factor)/2 times that in the limit.
+    assert 0.2 * 100 < len(reqs) < 8.0 * 100
+
+
+def test_mix_shape_tenants_prefixes_sessions():
+    reqs = schedule(MIX)
+    by_tenant = {t: 0 for t, _ in MIX.tenants}
+    for r in reqs:
+        by_tenant[r.tenant] += 1
+    assert by_tenant["vip"] > by_tenant["batch"]  # 3:1 weights
+    # Prefix sharing: some prompts must open with an identical
+    # prefix_len-token run (pool of n_prefixes shared prefixes).
+    heads = [r.prompt[: MIX.prefix_len] for r in reqs
+             if len(r.prompt) >= MIX.prefix_len]
+    shared = len(heads) - len(set(heads))
+    assert shared > 0
+    sessions = [r for r in reqs if r.session]
+    assert sessions
+    # A continued turn reuses its session id.
+    by_sid = {}
+    for r in sessions:
+        by_sid.setdefault(r.session, []).append(r)
+    assert any(len(v) > 1 for v in by_sid.values())
+
+
+def test_mix_config_validates():
+    with pytest.raises(ValueError):
+        MixConfig(process="lunar")
+    with pytest.raises(ValueError):
+        MixConfig(rate_rps=0.0)
+
+
+def test_parse_tenant_weights():
+    assert parse_tenant_weights("vip:3,batch:1") == (
+        ("vip", 3.0), ("batch", 1.0),
+    )
+    assert parse_tenant_weights("solo") == (("solo", 1.0),)
+    assert parse_tenant_weights("a:bad,,b:2") == (("b", 2.0),)
+    assert parse_tenant_weights("") == (("default", 1.0),)
+
+
+# ------------------------------------------------------ trace file
+
+
+def _rec(**kw):
+    base = {
+        "ts_offered": 1.0, "ts_sent": 1.0, "ts_done": 1.5,
+        "tenant": "vip", "status": 200, "rung": 0,
+        "offered_rps": 2.0, "n_prompt": 8, "max_new": 4,
+    }
+    base.update(kw)
+    return base
+
+
+def test_trace_roundtrip_and_torn_tail(tmp_path):
+    path = str(tmp_path / "load-trace.jsonl")
+    with TraceWriter(path) as w:
+        w.append(_rec(ttft_s=0.1, tok_s=0.01, n_tokens=4))
+        w.append(_rec(status=429, tenant="batch"))
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"ts_offered": 2.0, "tenant": "v')  # SIGKILL mid-write
+    recs = read_trace(path)
+    assert len(recs) == 2
+    assert recs[1]["status"] == 429
+    with pytest.raises(ValueError):
+        validate_trace_record({"tenant": "vip"})
+
+
+# --------------------------------------------------- sweep scoring
+
+
+def test_rung_stats_attainment_counts_rejects_against_tenant():
+    sweep = SweepConfig(ttft_target_s=0.5, tok_target_s=1.0)
+    recs = [
+        _rec(ttft_s=0.1, n_tokens=10),          # good
+        _rec(ttft_s=0.9, n_tokens=10),          # ttft miss
+        _rec(status=429),                        # rejected: counts
+        _rec(tenant="batch", ttft_s=0.2, n_tokens=5),
+    ]
+    out = rung_stats(recs, sweep, wall_s=2.0)
+    vip = out["tenants"]["vip"]
+    assert vip["offered"] == 3 and vip["good"] == 1
+    assert vip["rejected"] == 1
+    assert vip["attainment"] == pytest.approx(1 / 3)
+    assert out["tenants"]["batch"]["attainment"] == 1.0
+    assert out["attainment"] == pytest.approx(2 / 4)
+    assert out["goodput_tok_s"] == pytest.approx(15 / 2.0)
+
+
+def test_detect_knee_is_last_goal_meeting_rung():
+    rungs = [
+        {"rung": 0, "offered_rps": 1.0, "attainment": 1.0},
+        {"rung": 1, "offered_rps": 2.0, "attainment": 0.995},
+        {"rung": 2, "offered_rps": 4.0, "attainment": 0.7},
+        {"rung": 3, "offered_rps": 8.0, "attainment": 0.4},
+    ]
+    knee = detect_knee(rungs, goal=0.99)
+    assert knee == {
+        "rung": 1, "offered_rps": 2.0, "attainment": 0.995,
+    }
+    assert detect_knee(rungs, goal=1.1) is None
+
+
+def test_rung_stats_stage_decomposition():
+    sweep = SweepConfig()
+    recs = [
+        _rec(ttft_s=0.1, stages={"req_queue_wait": 0.2,
+                                 "req_prefill": 0.1}),
+        _rec(ttft_s=0.1, stages={"req_queue_wait": 0.4}),
+    ]
+    out = rung_stats(recs, sweep, wall_s=1.0)
+    assert out["stages_mean_s"]["req_queue_wait"] == pytest.approx(0.3)
+    assert out["stages_mean_s"]["req_prefill"] == pytest.approx(0.1)
+
+
+# ------------------------------------------------- closed-loop exec
+
+
+class _StubRouter:
+    def __init__(self):
+        self.added = []
+        self.removed = []
+
+    def add_replica(self, client, role):
+        self.added.append((client.name, role))
+
+    def remove_replica(self, name, *, drain=True):
+        self.removed.append((name, drain))
+
+
+class _StubReplica:
+    def __init__(self, name):
+        self.name = name
+        self.closed = False
+        self.drained = False
+
+    def drain(self):
+        self.drained = True
+        return {"draining": True}
+
+    def close(self):
+        self.closed = True
+
+
+def _decision(pool, frm, to, ts=100.0):
+    return {
+        "ts": ts,
+        "pools": {pool: {"from": frm, "to": to}},
+        "reason": ["load_tok_burn"],
+    }
+
+
+def test_executor_applies_scale_up_then_lifo_scale_down(tmp_path):
+    log = obs_events.EventLog(str(tmp_path / "ev.jsonl"))
+    router = _StubRouter()
+    ex = GangExecutor(
+        router,
+        spawn={"decode": _StubReplica},
+        events=log,
+        wall_clock=lambda: 7.0,
+    )
+    ex.on_decision(_decision("decode", 1, 2))
+    ex.on_decision(_decision("decode", 2, 3))
+    assert router.added == [
+        ("decode-auto1", "decode"), ("decode-auto2", "decode"),
+    ]
+    ex.on_decision(_decision("decode", 3, 2))
+    assert router.removed == [("decode-auto2", True)]  # LIFO
+    log.close()
+    events = obs_events.read_events(str(tmp_path / "ev.jsonl"))
+    actions = [
+        (e["action"], e["replica"])
+        for e in events if e["kind"] == "scale_action"
+    ]
+    assert actions == [
+        ("add", "decode-auto1"),
+        ("add", "decode-auto2"),
+        ("remove", "decode-auto2"),
+    ]
+    assert all(
+        e["decision_ts"] == 100.0
+        for e in events if e["kind"] == "scale_action"
+    )
+
+
+def test_executor_never_removes_base_gang():
+    router = _StubRouter()
+    ex = GangExecutor(router, spawn={"decode": _StubReplica})
+    ex.on_decision(_decision("decode", 2, 1))
+    assert router.removed == []
+    assert ex.actions[-1]["action"] == "skipped"
+    ex.on_decision(_decision("prefill", 1, 2))  # no prefill factory
+    assert ex.actions[-1]["action"] == "skipped"
+
+
+def test_executor_recovery_links_decision_to_burn_drop():
+    clock = [0.0]
+    reg = Registry()
+    slo = SloTracker(
+        reg, ttft_ms=100.0, goal=0.99, windows=(4.0, 12.0),
+        clock=lambda: clock[0],
+    )
+    router = _StubRouter()
+    ex = GangExecutor(
+        router, spawn={"decode": _StubReplica}, slo=slo,
+        burn_window="4s", wall_clock=lambda: clock[0],
+    )
+    slo.observe("burst", ttft_s=5.0)  # violation: burn pegs high
+    ex.on_decision(_decision("decode", 1, 2))
+    assert ex.actions[-1]["action"] == "add"
+    assert ex.actions[-1]["burn"] > 1.0  # burn-rate-at-decision
+    assert ex.poll_recovery() is None  # still burning
+    # Violations age out of the fast window; good traffic lands.
+    clock[0] = 6.0
+    for _ in range(3):
+        slo.observe("burst", ttft_s=0.01)
+    rec = ex.poll_recovery()
+    assert rec is not None and rec["action"] == "recovered"
+    assert rec["replica"] == "decode-auto1"
+    assert rec["burn"] < 1.0
+    assert ex.poll_recovery() is None  # one recovery per scale-up
+
+
+def test_executor_close_drains_every_spawned_replica():
+    router = _StubRouter()
+    ex = GangExecutor(router, spawn={"decode": _StubReplica})
+    ex.on_decision(_decision("decode", 1, 3))
+    ex.close()
+    assert [n for n, _ in router.removed] == [
+        "decode-auto2", "decode-auto1",
+    ]
+    ex.close()  # idempotent
+    assert len(router.removed) == 2
+
+
+def test_recommender_listener_receives_decision(tmp_path):
+    rec = fleet.ScalingRecommender(
+        str(tmp_path), MANIFEST, cooldown_s=0.0,
+        clock=lambda: 0.0, wall_clock=lambda: 42.0,
+    )
+    got = []
+    rec.listeners.append(got.append)
+    rec.listeners.append(lambda d: 1 / 0)  # raising subscriber: inert
+    decision = rec.consider(
+        [{"name": "load_tok_burn", "scale": "decode:+1"}], now=0.0
+    )
+    assert got == [decision]
+    assert decision["pools"] == {"decode": {"from": 1, "to": 2}}
+
+
+def test_slo_max_burn_and_phase_stamp(tmp_path):
+    log = obs_events.EventLog(str(tmp_path / "ev.jsonl"))
+    clock = [0.0]
+    slo = SloTracker(
+        Registry(), log, ttft_ms=100.0, goal=0.99,
+        windows=(4.0, 12.0), clock=lambda: clock[0],
+    )
+    assert slo.max_burn() == 0.0
+    slo.set_phase("rung-1")
+    slo.observe("vip", ttft_s=5.0)
+    slo.set_phase("")
+    slo.observe("vip", ttft_s=6.0)
+    assert slo.max_burn("4s") == pytest.approx(100.0)
+    assert slo.max_burn("12s") == pytest.approx(100.0)
+    log.close()
+    violations = [
+        e for e in obs_events.read_events(str(tmp_path / "ev.jsonl"))
+        if e["kind"] == "slo_violation"
+    ]
+    assert violations[0]["phase"] == "rung-1"
+    assert "phase" not in violations[1]
+
+
+def test_trace_writer_is_thread_safe(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    w = TraceWriter(path)
+    try:
+        threads = [
+            threading.Thread(
+                target=lambda i=i: [
+                    w.append(_rec(rung=i)) for _ in range(25)
+                ],
+            )
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        w.close()
+    recs = read_trace(path)
+    assert len(recs) == 100  # no torn interleaving

@@ -578,3 +578,129 @@ def test_drained_reply_without_spill_store_is_an_error():
         assert code == 502 and "draining" in body["error"]
     finally:
         srv.close()
+
+
+# ----------------------------------------- elastic membership + 429s
+
+
+class _SaturatedDecode(_StubDecode):
+    def signals(self):
+        return {
+            "role": "decode", "pages_total": 40, "pages_in_use": 40,
+            "slots_total": 4, "slots_active": 4, "migrations": 0,
+        }
+
+
+def test_reject_counter_carries_tenant_label():
+    # Rejected load must attribute per tenant — the capacity curves
+    # count a 429 against the tenant whose request it was.
+    from tpufw.obs.registry import Registry
+
+    reg = Registry()
+    srv = RouterServer(
+        [_StubPrefill("p0")], [_SaturatedDecode("d0")],
+        port=0, registry=reg,
+    )
+    try:
+        code, _body, _h = srv.generate(
+            {"prompt": [1, 2], "max_new": 2, "tenant": "vip"}
+        )
+        assert code == 429
+        c = reg.counter("tpufw_router_rejects_total")
+        assert c.value(tenant="vip") == 1.0
+        assert c.value(tenant="batch") == 0.0
+    finally:
+        srv.close()
+
+
+def test_add_replica_joins_rotation_and_counts():
+    from tpufw.obs.registry import Registry
+
+    reg = Registry()
+    srv = RouterServer(
+        [_StubPrefill("p0")], [_SaturatedDecode("d0")],
+        port=0, registry=reg,
+    )
+    try:
+        code, _body, _h = srv.generate({"prompt": [1], "max_new": 2})
+        assert code == 429  # only decode replica is saturated
+        d1 = _StubDecode("d1")
+        out = srv.add_replica(d1, "decode")
+        assert out == {"name": "d1", "role": "decode", "healthy": True}
+        code, body, _h = srv.generate({"prompt": [1], "max_new": 2})
+        assert code == 200 and body["replica"] == "d1"
+        assert reg.counter(
+            "tpufw_router_replica_changes_total"
+        ).value(role="decode", op="add") == 1.0
+        with pytest.raises(ValueError):
+            srv.add_replica(_StubDecode("d1"), "decode")  # name taken
+        with pytest.raises(ValueError):
+            srv.add_replica(_StubDecode("d2"), "oracle")
+    finally:
+        srv.close()
+
+
+def test_remove_replica_drains_and_refuses_last_of_role():
+    class _DrainableDecode(_StubDecode):
+        drained = False
+
+        def drain(self):
+            self.drained = True
+            return {"draining": True, "exported": []}
+
+    d0, d1 = _DrainableDecode("d0"), _StubDecode("d1")
+    srv = RouterServer([_StubPrefill("p0")], [d0, d1], port=0)
+    try:
+        out = srv.remove_replica("d0")
+        assert d0.drained and out["role"] == "decode"
+        with srv._lock:
+            assert "d0" not in srv._states
+        with pytest.raises(ValueError):
+            srv.remove_replica("d1")  # last decode replica stays
+        with pytest.raises(KeyError):
+            srv.remove_replica("ghost")
+        code, body, _h = srv.generate({"prompt": [1], "max_new": 2})
+        assert code == 200 and body["replica"] == "d1"
+    finally:
+        srv.close()
+
+
+def test_replicas_http_surface_validates_and_registers():
+    import json as _json
+    import urllib.request
+
+    srv = RouterServer(
+        [_StubPrefill("p0")], [_StubDecode("d0")], port=0,
+    )
+
+    def _post(obj):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}" + "/replicas",
+            data=_json.dumps(obj).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, _json.loads(resp.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, _json.loads(e.read().decode())
+
+    try:
+        code, body = _post({"op": "add", "name": "d9"})
+        assert code == 400 and "missing fields" in body["error"]
+        code, body = _post({"op": "remove", "name": "d0"})
+        assert code == 400  # last decode replica
+        code, body = _post({"op": "levitate"})
+        assert code == 400
+        # A TcpReplica pointing nowhere registers unhealthy — the
+        # reprobe path owns its recovery, same as a startup straggler.
+        code, body = _post({
+            "op": "add", "name": "d9", "host": "127.0.0.1",
+            "port": 1, "role": "decode",
+        })
+        assert code == 200 and body["healthy"] is False
+        code, body = _post({"op": "remove", "name": "d9"})
+        assert code == 200 and body["name"] == "d9"
+    finally:
+        srv.close()

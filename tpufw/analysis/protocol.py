@@ -1012,6 +1012,15 @@ class HttpSurfaceChecker(Checker):
                             s.value, str
                         ) and _ENDPOINT_RE.match(s.value):
                             out.endpoints.setdefault(s.value, (f, s))
+                        # path in ("/a", "/b") — membership routing
+                        elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                            for elt in s.elts:
+                                if isinstance(elt, ast.Constant) and \
+                                        isinstance(elt.value, str) and \
+                                        _ENDPOINT_RE.match(elt.value):
+                                    out.endpoints.setdefault(
+                                        elt.value, (f, elt)
+                                    )
             if isinstance(node, ast.Call):
                 nm = cg.call_name(node)
                 if nm in ("_reply", "reply", "send_response") and \

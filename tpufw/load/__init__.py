@@ -1,0 +1,24 @@
+"""Load observatory: deterministic traffic replay, capacity-frontier
+sweeps, and the closed-loop scaling executor. jax-free, like the
+router it drives — importable on a laptop, a CI runner, or a TPU host
+without pulling in the training stack."""
+
+from tpufw.load.genload import (  # noqa: F401
+    LOAD_TRACE_REQUIRED,
+    MixConfig,
+    Offered,
+    ReplayClient,
+    TraceWriter,
+    parse_tenant_weights,
+    read_trace,
+    schedule,
+    schedule_digest,
+    validate_trace_record,
+)
+from tpufw.load.sweep import (  # noqa: F401
+    SweepConfig,
+    detect_knee,
+    rung_stats,
+    run_sweep,
+)
+from tpufw.load.executor import GangExecutor  # noqa: F401

@@ -1,0 +1,69 @@
+"""Operator CLI for the load observatory.
+
+    python -m tpufw.load sweep --base-url http://router:8080 \
+        --rungs 1,2,4,8 --hold-s 10 --out BENCH_load.json
+
+Replays the TPUFW_LOAD_* mix (docs/ENV.md) against a live router at
+each rung and writes the BENCH_load payload. The in-process hooks
+(SLO phase stamps, fleet joins, executor) are only available when the
+sweep shares a process with the gang — bench.py's ``load`` tier and
+scripts/load_smoke.py do that; this CLI is the remote-router case.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tpufw.load.genload import MixConfig, TraceWriter
+from tpufw.load.sweep import SweepConfig, run_sweep, write_payload
+from tpufw.workloads.env import env_opt_str
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tpufw.load")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sw = sub.add_parser("sweep", help="run a capacity sweep")
+    sw.add_argument("--base-url", required=True)
+    sw.add_argument(
+        "--rungs", default="1,2,4,8",
+        help="comma-separated offered rps per rung",
+    )
+    sw.add_argument("--hold-s", type=float, default=6.0)
+    sw.add_argument("--settle-s", type=float, default=1.0)
+    sw.add_argument("--goal", type=float, default=0.99)
+    sw.add_argument("--ttft-target-s", type=float, default=2.0)
+    sw.add_argument("--tok-target-s", type=float, default=0.2)
+    sw.add_argument("--threads", type=int, default=8)
+    sw.add_argument("--out", default="BENCH_load.json")
+    args = ap.parse_args(argv)
+
+    mix = MixConfig.from_env()
+    sweep = SweepConfig(
+        rungs=tuple(
+            float(r) for r in args.rungs.split(",") if r.strip()
+        ),
+        hold_s=args.hold_s,
+        settle_s=args.settle_s,
+        goal=args.goal,
+        ttft_target_s=args.ttft_target_s,
+        tok_target_s=args.tok_target_s,
+        threads=args.threads,
+    )
+    trace_dir = env_opt_str("load_dir") or os.path.dirname(
+        os.path.abspath(args.out)
+    )
+    trace = TraceWriter(os.path.join(trace_dir, "load-trace.jsonl"))
+    try:
+        payload = run_sweep(args.base_url, mix, sweep, trace=trace)
+    finally:
+        trace.close()
+    write_payload(payload, args.out)
+    print(json.dumps({"knee": payload["knee"]}, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

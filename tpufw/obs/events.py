@@ -70,6 +70,11 @@ SCHEMA: Dict[str, FrozenSet[str]] = {
     # the scaling decisions sustained alerts turn into.
     "fleet_alert": frozenset({"rule", "state", "series", "value"}),
     "fleet_recommendation": frozenset({"pools", "reason", "artifact"}),
+    # Load observatory (tpufw.load): executor action applying a
+    # scaling decision (add/remove/skipped/recovered/error), and a
+    # sweep/smoke phase boundary (rung-N, burst, idle, done).
+    "scale_action": frozenset({"pool", "action", "replica"}),
+    "load_phase": frozenset({"phase"}),
 }
 
 

@@ -843,6 +843,12 @@ class ScalingRecommender:
         self._seq = len(
             _glob.glob(os.path.join(out_dir, "fleet-rec-*.json"))
         )
+        #: Decision subscribers (e.g. tpufw.load.GangExecutor) — each
+        #: called with the decision record after it is written and
+        #: emitted. Same contract as EventLog.listeners: snapshot
+        #: iteration, a raising subscriber is swallowed so it can
+        #: never block the decision from landing on disk.
+        self.listeners: List[Callable[[dict], None]] = []
 
     def consider(
         self, firing: Sequence[dict], now: Optional[float] = None
@@ -921,6 +927,11 @@ class ScalingRecommender:
             artifact=yaml_path,
             replicas=new_counts,
         )
+        for fn in tuple(self.listeners):
+            try:
+                fn(decision)
+            except Exception:
+                pass
         return decision
 
 

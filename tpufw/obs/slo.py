@@ -105,6 +105,11 @@ class SloTracker:
             raise ValueError(f"bad SLO windows {windows!r}")
         self._clock = clock
         self._lock = threading.Lock()
+        # Load-observatory phase label (e.g. "rung-2", "burst") —
+        # stamped onto slo_violation events so a sweep's violations
+        # attribute to their rung. Events-only on purpose: a metric
+        # label would churn the fleet deriver's key space (TPU018).
+        self._phase = ""
         # tenant -> deque of (t, ttft_ok, tok_ok); tok_ok is None for
         # single-token requests (no steady-state decode to judge).
         self._obs: Dict[str, deque] = {}
@@ -148,6 +153,12 @@ class SloTracker:
         """(ttft_ms, tok_ms) for a tenant — override or defaults."""
         return self.tenants.get(tenant, (self.ttft_ms, self.tok_ms))
 
+    def set_phase(self, phase: str) -> None:
+        """Stamp subsequent slo_violation events with a load phase
+        ("" clears). The sweep runner calls this at rung boundaries."""
+        with self._lock:
+            self._phase = str(phase)
+
     # ------------------------------------------------------ observe
 
     def observe(
@@ -165,6 +176,9 @@ class SloTracker:
         ttft_ok = ttft_s * 1e3 <= ttft_tgt
         tok_ok = None if tok_s is None else (tok_s * 1e3 <= tok_tgt)
         now = self._clock()
+        with self._lock:
+            phase = self._phase
+        extra = {"phase": phase} if phase else {}
         self._h_ttft.observe(ttft_s, tenant=tenant)
         if tok_s is not None:
             self._h_tok.observe(tok_s, tenant=tenant)
@@ -174,14 +188,14 @@ class SloTracker:
             self.events.emit(
                 "slo_violation", level="warn", tenant=tenant,
                 metric="ttft", value_ms=round(ttft_s * 1e3, 3),
-                target_ms=ttft_tgt, trace=trace,
+                target_ms=ttft_tgt, trace=trace, **extra,
             )
         if tok_ok is False:
             self._c_violations.inc(tenant=tenant, metric="tok")
             self.events.emit(
                 "slo_violation", level="warn", tenant=tenant,
                 metric="tok", value_ms=round((tok_s or 0.0) * 1e3, 3),
-                target_ms=tok_tgt, trace=trace,
+                target_ms=tok_tgt, trace=trace, **extra,
             )
         with self._lock:
             q = self._obs.get(tenant)
@@ -256,6 +270,24 @@ class SloTracker:
         return (1.0 - self.attainment(tenant, metric, window)) / (
             1.0 - self.goal
         )
+
+    def max_burn(self, window: Optional[str] = None) -> float:
+        """Worst burn rate across every (tenant, metric) pair over
+        one window — the executor's recovery signal. ``window`` is
+        the gauge's label string ("60s"); None means the fastest
+        window. Tenant list is snapshotted under the lock, burn math
+        runs outside it (burn_rate re-acquires)."""
+        if window is None:
+            w = self.windows[0]
+        else:
+            w = float(str(window).rstrip("s"))
+        with self._lock:
+            tenants = list(self._obs)
+        worst = 0.0
+        for tenant in tenants:
+            for metric in ("ttft", "tok"):
+                worst = max(worst, self.burn_rate(tenant, metric, w))
+        return worst
 
     # --------------------------------------------------------- env
 

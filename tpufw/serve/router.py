@@ -425,6 +425,7 @@ class _Metrics:
             "tokens_total",
             "prefix_affinity_hits_total",
             "session_rehomes_total",
+            "replica_changes_total",
         )
 
     def inc(self, name: str, v: float = 1.0, **labels) -> None:
@@ -479,6 +480,15 @@ class LocalReplica:
         )
         out = self._engine.collect_ex(slot)
         return {**out, **self._engine.signals()}
+
+    def drain(self) -> Dict[str, Any]:
+        """Session-safe scale-in, same contract as TcpReplica.drain —
+        the executor drains through the client so LocalReplica and
+        TcpReplica gangs scale in identically."""
+        fn = getattr(self._engine, "drain", None)
+        if callable(fn):
+            return fn()
+        return {"draining": True, "exported": [], "dropped": 0}
 
 
 class TcpReplica:
@@ -641,7 +651,7 @@ class RouterServer:
                     self._reply(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
-                if self.path != "/generate":
+                if self.path not in ("/generate", "/replicas"):
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
                 try:
@@ -649,6 +659,10 @@ class RouterServer:
                     req = json.loads(self.rfile.read(n).decode("utf-8"))
                 except (ValueError, UnicodeDecodeError) as e:
                     self._reply(400, {"error": f"bad request: {e}"})
+                    return
+                if self.path == "/replicas":
+                    code, obj = server.replicas_api(req)
+                    self._reply(code, obj)
                     return
                 code, obj, headers = server.generate(
                     req,
@@ -706,6 +720,112 @@ class RouterServer:
                 for r in self._states.values()
                 if r.role == role
             ]
+
+    # ---- elastic membership ---------------------------------------
+
+    def add_replica(self, client, role: str) -> dict:
+        """Register a replica client into a pool at runtime — the
+        scale-out half of the closed loop (tpufw.load.GangExecutor
+        and the POST /replicas surface both land here). The probe
+        runs outside the lock; a replica that cannot answer signals
+        still registers, just unhealthy (the reprobe path gives it
+        its second chance, same as a startup straggler)."""
+        if role not in ("prefill", "decode"):
+            raise ValueError(f"unknown replica role {role!r}")
+        sig = None
+        try:
+            sig = client.signals()
+        except Exception:  # noqa: BLE001 — probe failure = unhealthy
+            pass
+        with self._lock:
+            if client.name in self._states:
+                raise ValueError(
+                    f"replica name {client.name!r} already registered"
+                )
+            pool = self._prefill if role == "prefill" else self._decode
+            pool.append(client)
+            state = ReplicaState(client.name, role)
+            self._states[client.name] = state
+            if sig is None:
+                state.healthy = False
+            else:
+                state.update(sig, now=time.monotonic())
+        self._metrics.inc("replica_changes_total", role=role, op="add")
+        return {"name": client.name, "role": role,
+                "healthy": sig is not None}
+
+    def remove_replica(self, name: str, *, drain: bool = True) -> dict:
+        """Deregister a replica — session-safe scale-in. The drain
+        call (exports live sessions to the spill store, PR 19) runs
+        BEFORE the membership change and outside the lock, so
+        in-flight requests on other threads still see the replica
+        while it exports; the last replica of a role is refused, the
+        door stays open."""
+        with self._lock:
+            state = self._states.get(name)
+            if state is None:
+                raise KeyError(f"no replica named {name!r}")
+            role = state.role
+            pool = self._prefill if role == "prefill" else self._decode
+            if sum(1 for s in self._states.values()
+                   if s.role == role) <= 1:
+                raise ValueError(
+                    f"refusing to remove last {role} replica {name!r}"
+                )
+            client = next(c for c in pool if c.name == name)
+            # Draining replicas stop winning _pick while the export
+            # runs; membership is surgically removed after.
+            state.draining = 1
+        drained: dict = {}
+        if drain:
+            fn = getattr(client, "drain", None)
+            if callable(fn):
+                try:
+                    drained = fn()
+                except Exception as e:  # noqa: BLE001
+                    drained = {"error": f"{type(e).__name__}: {e}"}
+        with self._lock:
+            pool = self._prefill if role == "prefill" else self._decode
+            if client in pool:
+                pool.remove(client)
+            self._states.pop(name, None)
+        self._metrics.inc(
+            "replica_changes_total", role=role, op="remove"
+        )
+        return {"name": name, "role": role, "drained": drained}
+
+    def replicas_api(self, req: dict) -> Tuple[int, dict]:
+        """POST /replicas — the out-of-process executor surface.
+        ``{"op": "add", "name", "host", "port", "role"}`` joins a
+        framed-TCP replica; ``{"op": "remove", "name"}`` drains and
+        deregisters. Returns (code, body) like generate()."""
+        op = req.get("op")
+        if op == "add":
+            missing = [
+                k for k in ("name", "host", "port", "role")
+                if not req.get(k)
+            ]
+            if missing:
+                return 400, {"error": f"missing fields {missing}"}
+            try:
+                client = TcpReplica(
+                    str(req["name"]), str(req["host"]),
+                    int(req["port"]), str(req["role"]),
+                )
+                return 200, self.add_replica(client, str(req["role"]))
+            except (ValueError, TypeError) as e:
+                return 400, {"error": str(e)}
+        if op == "remove":
+            if not req.get("name"):
+                return 400, {"error": "missing fields ['name']"}
+            try:
+                return 200, self.remove_replica(
+                    str(req["name"]),
+                    drain=bool(req.get("drain", True)),
+                )
+            except (KeyError, ValueError) as e:
+                return 400, {"error": str(e)}
+        return 400, {"error": f"unknown op {op!r}"}
 
     def n_pages_for(self, prompt_len: int, max_new: int) -> int:
         need = max(1, prompt_len + max_new - 1)
@@ -1092,7 +1212,10 @@ class RouterServer:
                 name, pname, reason = self._pick(session, n_pages, digs)
             admit_s = time.perf_counter() - ta0
             if name is None:
-                self._metrics.inc("rejects_total")
+                # Tenant-labeled so rejected load attributes per
+                # tenant in the capacity curves — a 429 is offered
+                # load the SLO did not serve.
+                self._metrics.inc("rejects_total", tenant=tenant)
                 self._events.emit(
                     "router_reject", tenant=tenant, reason=reason,
                     trace=ctx.trace_id,
@@ -1134,7 +1257,7 @@ class RouterServer:
                     queue_s, admit_s, n_pages, trace_hdr, t0,
                 )
             if pname is None:
-                self._metrics.inc("rejects_total")
+                self._metrics.inc("rejects_total", tenant=tenant)
                 self._events.emit(
                     "router_reject", tenant=tenant, reason="no_prefill",
                     trace=ctx.trace_id,
